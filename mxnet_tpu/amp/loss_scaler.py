@@ -1,0 +1,32 @@
+"""Dynamic loss scaler (ref: python/mxnet/amp/loss_scaler.py).
+
+Same semantics: scale doubles every ``scale_window`` clean steps, halves on
+overflow; overflow check is a fused isfinite-scan (≈ multi_all_finite,
+src/operator/all_finite.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0, scale_window=2000):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+        self.has_overflow = False
+
+    def post_backward(self, grads) -> bool:
+        """Check grads; update scale. Returns True if step must be skipped."""
+        finite = bool(jnp.stack(
+            [jnp.isfinite(g._data).all() for g in grads]).all()) if grads else True
+        self.has_overflow = not finite
+        if self.has_overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+        return self.has_overflow

@@ -1,0 +1,399 @@
+"""Tape-based autograd with MXNet semantics on top of ``jax.vjp``.
+
+Re-imagines the reference's imperative autograd (python/mxnet/autograd.py;
+C++ tape in src/imperative/imperative.cc: RecordOp:204, Backward:387) the
+TPU-native way: instead of nnvm graph nodes + an FGradient registry, every
+recorded op captures its ``jax.vjp`` closure (residuals live in device HBM),
+and ``backward()`` walks the tape reverse-topologically. Higher-order grads
+(``grad(create_graph=True)``, ref autograd.py:272) fall out for free because
+a vjp closure is itself jax-differentiable, so backward re-enters the tape.
+
+Public API mirrors python/mxnet/autograd.py: record/pause/train_mode/
+predict_mode scopes (:121,145), is_recording/is_training, mark_variables,
+backward (:245), grad (:272), and custom-VJP ``Function`` (:389-519).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode",
+    "is_recording", "is_training", "set_recording", "set_training",
+    "mark_variables", "backward", "grad", "Function",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _State()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev, _STATE.recording = _STATE.recording, bool(flag)
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev, _STATE.training = _STATE.training, bool(flag)
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+
+    def __enter__(self):
+        self._prev_rec = set_recording(self._rec) if self._rec is not None else None
+        self._prev_train = set_training(self._train) if self._train is not None else None
+        return self
+
+    def __exit__(self, *exc):
+        if self._rec is not None:
+            set_recording(self._prev_rec)
+        if self._train is not None:
+            set_training(self._prev_train)
+
+    # allow use as decorator, like reference _RecordingStateScope users
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with self.__class__(self._rec, self._train):
+                return fn(*a, **kw)
+
+        return wrapped
+
+
+def record(train_mode: bool = True) -> _Scope:
+    """Scope in which executed ops are recorded for backward (ref autograd.py:121)."""
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    """Scope that suspends recording (ref autograd.py:145)."""
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape graph
+# ---------------------------------------------------------------------------
+
+class Node:
+    """One recorded op: inputs (NDArray refs), outputs (by entry), vjp closure.
+
+    Analogue of an nnvm::Node stamped into AGInfo (include/mxnet/imperative.h:54);
+    the FGradient functor is replaced by the captured ``jax.vjp`` closure.
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "n_out", "name", "out_shapes",
+                 "out_dtypes", "tuple_out", "fn")
+
+    def __init__(self, vjp_fn, inputs, n_out, name, out_shapes, out_dtypes,
+                 tuple_out=None, fn=None):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # list of NDArray (strong refs keep residual graph alive)
+        self.n_out = n_out
+        self.name = name
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+        # whether the differentiated fn returned a tuple (vjp cotangent must match)
+        self.tuple_out = (n_out > 1) if tuple_out is None else tuple_out
+        # primal fn(raw_inputs) — needed to re-derive the vjp with inputs as
+        # tape inputs for create_graph (higher-order) backward
+        self.fn = fn
+
+
+def _entry(arr):
+    return getattr(arr, "_autograd_entry", None)
+
+
+def mark_variables(variables, gradients, grad_reqs="write") -> None:
+    """Attach gradient buffers to arrays (ref autograd.py mark_variables;
+    C++ Imperative::MarkVariables src/imperative/imperative.cc:134)."""
+    if not isinstance(variables, (list, tuple)):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g
+        var._grad_req = req
+        var._autograd_entry = None  # becomes a fresh leaf
+
+
+def _toposort(head_nodes: Sequence[Node]) -> List[Node]:
+    order: List[Node] = []
+    seen = set()
+    stack: List[Tuple[Node, int]] = [(n, 0) for n in head_nodes if n is not None]
+    on_path = set()
+    while stack:
+        node, idx = stack.pop()
+        nid = id(node)
+        if idx == 0:
+            if nid in seen:
+                continue
+            on_path.add(nid)
+        children = node.inputs
+        if idx < len(children):
+            stack.append((node, idx + 1))
+            ent = _entry(children[idx])
+            if ent is not None and id(ent[0]) not in seen:
+                stack.append((ent[0], 0))
+        else:
+            on_path.discard(nid)
+            if nid not in seen:
+                seen.add(nid)
+                order.append(node)
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False,
+             train_mode: bool = True, create_graph: bool = False):
+    """Run backward from ``heads`` accumulating into attached ``.grad`` buffers.
+
+    Mirrors Imperative::Backward (src/imperative/imperative.cc:387): assemble
+    the reachable tape subgraph, seed head cotangents (ones for scalars), walk
+    reverse-topo calling each node's vjp, and write/add into marked leaves per
+    their grad_req. ``create_graph=True`` re-records the vjp calls themselves
+    so second-order ``backward`` works (ref autograd.py:272).
+    """
+    import jax.numpy as jnp
+    from ..ndarray import NDArray
+    from ..ops.dispatch import invoke
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    if len(head_grads) != len(heads):
+        raise MXNetError("len(head_grads) must equal len(heads)")
+
+    # Seed cotangents per (node, out_index); leaves seed .grad directly.
+    # With create_graph=True cotangents are kept as *tracked* NDArrays so the
+    # backward computation itself lands on the tape (second order).
+    cotangents = {}
+    track = bool(create_graph)
+
+    def _raw(x):
+        return x._data if isinstance(x, NDArray) else x
+
+    def _accumulate(arr, cot):
+        if track and not isinstance(cot, NDArray):
+            cot = NDArray(cot)
+        ent = _entry(arr)
+        if ent is not None:
+            node, oidx = ent
+            key = (id(node), oidx)
+            prev = cotangents.get(key)
+            if prev is None:
+                cotangents[key] = cot
+            elif track:
+                cotangents[key] = prev + cot  # recorded NDArray add
+            else:
+                cotangents[key] = _raw(prev) + _raw(cot)
+        req = getattr(arr, "_grad_req", None)
+        if req and req != "null" and getattr(arr, "_grad", None) is not None:
+            g = arr._grad
+            key = id(arr)
+            if req == "add" or key in _written_leaves:
+                if track:
+                    res = NDArray(g._data)
+                    res._autograd_entry = g._autograd_entry
+                    res = res + cot
+                    g._data = jnp.broadcast_to(res._data, g.shape).astype(g._data.dtype)
+                    g._autograd_entry = res._autograd_entry
+                else:
+                    g._data = g._data + jnp.broadcast_to(_raw(cot), g.shape).astype(g._data.dtype)
+            else:
+                g._data = jnp.broadcast_to(_raw(cot), g.shape).astype(g._data.dtype)
+                if track:
+                    g._autograd_entry = getattr(cot, "_autograd_entry", None)
+                _written_leaves.add(key)
+
+    _written_leaves: set = set()
+
+    head_nodes = []
+    for h, hg in zip(heads, head_grads):
+        if hg is None:
+            # reference semantics: default head gradient is ones for any
+            # shape (mx.nd.NDArray.backward)
+            hg_val = jnp.ones(h.shape, dtype=h._data.dtype)
+        else:
+            hg_val = _raw(hg)
+        ent = _entry(h)
+        if ent is not None:
+            head_nodes.append(ent[0])
+        _accumulate(h, hg_val)
+
+    if not head_nodes:
+        # reference raises when the head has no recorded graph
+        # (src/imperative/imperative.cc Backward: "is not part of a graph")
+        raise MXNetError(
+            "Cannot differentiate: the output was not computed inside an "
+            "autograd.record() scope (no computational graph attached)")
+
+    order = _toposort(head_nodes)
+
+    with _Scope(bool(create_graph), train_mode):
+        for node in reversed(order):
+            outs = []
+            missing = True
+            for i in range(node.n_out):
+                c = cotangents.pop((id(node), i), None)
+                if c is not None:
+                    missing = False
+                outs.append(c)
+            if missing or node.vjp_fn is None:
+                continue
+            if track:
+                # keep cotangents tracked: zero-fill as fresh NDArrays
+                outs_nd = [
+                    c if isinstance(c, NDArray) else
+                    NDArray(c) if c is not None else
+                    NDArray(jnp.zeros(node.out_shapes[i], node.out_dtypes[i]))
+                    for i, c in enumerate(outs)
+                ]
+                tup = node.tuple_out
+                n_c = len(outs_nd)
+                if node.fn is not None:
+                    # re-derive vjp so primal inputs become tape inputs:
+                    # grads of grads then flow into them (≈ backward mirroring,
+                    # src/nnvm/gradient.cc:142)
+                    primal = node.fn
+
+                    def back_fn(*vals, _primal=primal, _nc=n_c, _tup=tup):
+                        cots, xs = vals[:_nc], vals[_nc:]
+                        import jax as _jax
+
+                        _, vjp = _jax.vjp(_primal, *xs)
+                        return vjp(tuple(cots) if _tup else cots[0])
+
+                    in_cots = invoke(back_fn, outs_nd + list(node.inputs),
+                                     name=f"backward_{node.name}")
+                else:
+                    vjp = node.vjp_fn
+                    in_cots = invoke(
+                        lambda *cs: vjp(tuple(cs) if tup else cs[0]),
+                        outs_nd, name=f"backward_{node.name}")
+                if not isinstance(in_cots, tuple):
+                    in_cots = (in_cots,)
+                in_cots = in_cots[:len(node.inputs)]
+            else:
+                outs = [
+                    _raw(c) if c is not None else jnp.zeros(node.out_shapes[i], node.out_dtypes[i])
+                    for i, c in enumerate(outs)
+                ]
+                arg = tuple(outs) if node.tuple_out else outs[0]
+                in_cots = node.vjp_fn(arg)
+            for inp, cot in zip(node.inputs, in_cots):
+                if cot is None:
+                    continue
+                dt = str(getattr(_raw(cot), "dtype", ""))
+                if dt.startswith("float0") or dt == "":
+                    continue  # integer/bool inputs: no gradient
+                _accumulate(inp, cot)
+            if not retain_graph and not create_graph:
+                node.vjp_fn = None
+                node.inputs = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return grads of heads wrt variables without touching existing .grad
+    buffers (ref autograd.py:272)."""
+    from ..ndarray import NDArray, zeros_like
+
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    if retain_graph is None:
+        retain_graph = create_graph
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", None)) for v in variables]
+    temps = [zeros_like(v) for v in variables]
+    try:
+        for v, t in zip(variables, temps):
+            v._grad, v._grad_req = t, "write"
+        backward(heads, head_grads, retain_graph=retain_graph,
+                 train_mode=train_mode, create_graph=create_graph)
+    finally:
+        for v, (g, r) in zip(variables, saved):
+            v._grad, v._grad_req = g, r
+    return temps[0] if single else temps
+
+
+class Function:
+    """User-defined differentiable function (ref autograd.py:389-519).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved: Tuple[Any, ...] = ()
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from ..ndarray import NDArray
+
+        rec = is_recording()
+        with pause(train_mode=is_training()):
+            outputs = self.forward(*inputs)
+        if not rec:
+            return outputs
+        single = isinstance(outputs, NDArray)
+        outs = [outputs] if single else list(outputs)
+
+        func = self
+
+        def vjp_fn(cots):
+            if single:
+                cots = (cots,)
+            with pause():
+                gin = func.backward(*[NDArray(c) for c in cots])
+            if isinstance(gin, NDArray):
+                gin = (gin,)
+            return tuple(g._data if isinstance(g, NDArray) else g for g in gin)
+
+        node = Node(vjp_fn, list(inputs), len(outs), type(self).__name__,
+                    [o.shape for o in outs], [o._data.dtype for o in outs])
+        for i, o in enumerate(outs):
+            o._autograd_entry = (node, i)
+        return outputs if single else type(outputs)(outs)

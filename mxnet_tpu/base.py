@@ -1,0 +1,119 @@
+"""Foundational helpers: errors, env-var config, dtype tables, registries.
+
+TPU-native re-imagination of the reference's dmlc-core utilities
+(ref: 3rdparty/dmlc-core usage across src/; env vars documented in
+docs/static_site/src/pages/api/faq/env_var.md:41-406). Instead of
+``dmlc::GetEnv`` sprinkled at C++ use-sites, we expose one typed accessor,
+and instead of ``DMLC_REGISTRY_*`` C++ macros, a tiny generic Registry.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Generic, Iterator, Optional, TypeVar
+
+import numpy as _onp
+
+__all__ = [
+    "MXNetError",
+    "DeferredInitializationError",
+    "get_env",
+    "Registry",
+    "numeric_types",
+    "integer_types",
+    "string_types",
+]
+
+
+class MXNetError(RuntimeError):
+    """Top-level framework error (ref: include/mxnet/base.h dmlc::Error)."""
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before shapes known (ref: python/mxnet/gluon/parameter.py:36)."""
+
+
+numeric_types = (float, int, _onp.generic)
+integer_types = (int, _onp.integer)
+string_types = (str,)
+
+_TRUE = {"1", "true", "yes", "on"}
+_FALSE = {"0", "false", "no", "off", ""}
+
+
+def get_env(name: str, default: Any = None, typ: Optional[type] = None) -> Any:
+    """Typed env-var accessor, the analogue of ``dmlc::GetEnv``.
+
+    All framework tunables use the ``MXNET_`` prefix like the reference
+    (docs/static_site/src/pages/api/faq/env_var.md).
+    """
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    t = typ if typ is not None else (type(default) if default is not None else str)
+    if t is bool:
+        low = raw.strip().lower()
+        if low in _TRUE:
+            return True
+        if low in _FALSE:
+            return False
+        raise MXNetError(f"env var {name}={raw!r} is not a boolean")
+    try:
+        return t(raw)
+    except ValueError as e:
+        raise MXNetError(f"env var {name}={raw!r} is not a valid {t.__name__}") from e
+
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    """Generic name->object registry.
+
+    Replaces the reference's C++ ``DMLC_REGISTRY_REGISTER`` /
+    ``MXNET_REGISTER_*`` macro families (e.g. op registry
+    include/mxnet/op_attr_types.h:218-332, kvstore factory
+    src/kvstore/kvstore.cc:42-85) with one Python mechanism.
+    """
+
+    def __init__(self, kind: str, ignore_case: bool = True):
+        self.kind = kind
+        self._ignore_case = ignore_case
+        self._map: Dict[str, T] = {}
+
+    def _key(self, name: str) -> str:
+        return name.lower() if self._ignore_case else name
+
+    def register(self, name: Optional[str] = None, obj: Optional[T] = None, *, allow_override: bool = False):
+        """Register ``obj`` under ``name``; usable as decorator."""
+
+        def do(o: T, nm: Optional[str]) -> T:
+            n = self._key(nm if nm is not None else getattr(o, "__name__"))
+            if n in self._map and not allow_override and self._map[n] is not o:
+                raise MXNetError(f"{self.kind} '{n}' is already registered")
+            self._map[n] = o
+            return o
+
+        if obj is not None:
+            return do(obj, name)
+        if callable(name) and not isinstance(name, str):
+            return do(name, None)  # bare @registry.register
+        return lambda o: do(o, name)
+
+    def get(self, name: str) -> T:
+        key = self._key(name)
+        if key not in self._map:
+            raise MXNetError(
+                f"unknown {self.kind} '{name}'; known: {sorted(self._map)}")
+        return self._map[key]
+
+    def find(self, name: str) -> Optional[T]:
+        return self._map.get(self._key(name))
+
+    def __contains__(self, name: str) -> bool:
+        return self._key(name) in self._map
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._map)
+
+    def items(self):
+        return self._map.items()

@@ -1,0 +1,10 @@
+"""Gluon — the imperative/hybrid layer API (ref: python/mxnet/gluon/)."""
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Parameter, Constant
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import metric
+from . import data
+from . import model_zoo
+from .utils import split_and_load, clip_global_norm, split_data

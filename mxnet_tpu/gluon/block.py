@@ -1,0 +1,459 @@
+"""gluon.Block / HybridBlock — the layer system.
+
+Ref: python/mxnet/gluon/block.py (Block:203, HybridBlock:998,
+SymbolBlock:1716). TPU-native redesign of the hybridize machinery
+(SURVEY.md §3.3): the reference traces ``forward`` once under
+deferred-compute into an nnvm Symbol and replays it through CachedOp
+(src/imperative/cached_op.cc:776) with its own memory planner and fusion
+passes; here ``hybridize()`` swaps the call path to a ``jax.jit``-compiled
+function of (parameters, rng key, inputs) — XLA is the pass pipeline. The
+subtleties live in ``_CachedOp``:
+
+  * parameters + the global RNG key are lifted to traced inputs, so random
+    ops stay live across calls instead of baking one sample;
+  * in-place NDArray mutations during the trace (BatchNorm moving stats,
+    RNG advance, any user ``a[:] =``) are captured by the mutation-watcher
+    protocol (ndarray._mutation_scope) and returned as extra jit outputs,
+    then rebound eagerly — replacing the reference's mutable-graph
+    semantics losslessly;
+  * under ``autograd.record()``, the whole jitted call is recorded as ONE
+    tape node via ops.dispatch.invoke — mirroring CachedOp's lazily-built
+    backward graph (cached_op.cc:1016) with jax.vjp through the jit.
+
+Deferred parameter init (ref block.py HybridBlock.infer_shape): layers
+implement ``infer_shape(*args)``; ``__call__`` catches
+DeferredInitializationError, infers, finishes init, retries — compositional
+because each child handles its own.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..base import DeferredInitializationError, MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, _mutation_scope
+from .parameter import Constant, Parameter
+from .. import autograd as _autograd
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+def _flatten_nd(obj):
+    """Flatten nested (list/tuple/dict) structures of NDArrays."""
+    leaves: List[NDArray] = []
+
+    def rec(o):
+        if isinstance(o, NDArray):
+            leaves.append(o)
+            return ("@",)
+        if o is None:
+            return (None,)
+        if isinstance(o, (list, tuple)):
+            return (type(o).__name__, [rec(x) for x in o])
+        if isinstance(o, dict):
+            return ("dict", [(k, rec(v)) for k, v in sorted(o.items())])
+        return ("#", o)  # static aux value
+
+    tree = rec(obj)
+    return leaves, tree
+
+
+def _unflatten_nd(tree, leaves, wrap=lambda v: v):
+    it = iter(leaves)
+
+    def rec(t):
+        tag = t[0]
+        if tag == "@":
+            return wrap(next(it))
+        if tag is None:
+            return None
+        if tag == "list":
+            return [rec(x) for x in t[1]]
+        if tag == "tuple":
+            return tuple(rec(x) for x in t[1])
+        if tag == "dict":
+            return {k: rec(v) for k, v in t[1]}
+        return t[1]
+
+    return rec(tree)
+
+
+class Block:
+    """Base container (ref block.py:203). Attribute assignment registers
+    children and Parameters, like the reference's Gluon 2.0 (no name_scope)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._children: "Dict[str, Block]" = {}
+        self._reg_params: "Dict[str, Parameter]" = {}
+        self._forward_hooks: List[Callable] = []
+        self._forward_pre_hooks: List[Callable] = []
+
+    # -- registration -------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            existing = self.__dict__.get("_reg_params")
+            if existing is not None:
+                existing[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block", name: Optional[str] = None):
+        self._children[name if name is not None else str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return _HookHandle(self._forward_hooks, hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return _HookHandle(self._forward_pre_hooks, hook)
+
+    # -- parameter access ---------------------------------------------------
+    def collect_params(self, select: Optional[str] = None) -> "Dict[str, Parameter]":
+        """Structured-name → Parameter dict (ref block.py collect_params)."""
+        out: Dict[str, Parameter] = {}
+
+        def rec(block: Block, prefix: str):
+            for pname, p in block._reg_params.items():
+                full = prefix + pname
+                p._structure_name = full
+                out[full] = p
+            for cname, c in block._children.items():
+                rec(c, prefix + cname + ".")
+
+        rec(self, "")
+        if select is not None:
+            import re
+
+            pat = re.compile(select)
+            out = {k: v for k, v in out.items() if pat.match(k)}
+        return out
+
+    @property
+    def params(self):
+        return self._reg_params
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False, device=None):
+        """Initialize all parameters; ``init`` is the default for params
+        without their own initializer (ref Block.initialize)."""
+        from .. import initializer as _init_mod
+
+        default = init if init is not None else _init_mod.Uniform()
+        if isinstance(default, str):
+            default = _init_mod.create(default)
+        for p in self.collect_params().values():
+            p.initialize(init=None, ctx=ctx or device, default_init=default,
+                         force_reinit=force_reinit)
+        return self
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        for c in self._children.values():
+            c.cast(dtype)
+        return self
+
+    def zero_grad(self):
+        for p in self.collect_params().values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.collect_params().values():
+            p.reset_ctx(ctx)
+
+    reset_device = reset_ctx
+
+    def apply(self, fn):
+        for c in self._children.values():
+            c.apply(fn)
+        fn(self)
+        return self
+
+    def setattr(self, name, value):
+        """Set an attr on all registered params (ref Block.setattr), e.g.
+        net.setattr('grad_req', 'null')."""
+        for p in self.collect_params().values():
+            setattr(p, name, value)
+
+    # -- save / load --------------------------------------------------------
+    def save_parameters(self, filename: str, deduplicate: bool = False):
+        """Ref block.py:341 — structured-name keyed weights file."""
+        from ..ndarray.utils import save
+
+        arg_dict = {name: p.data() for name, p in self.collect_params().items()
+                    if p._data is not None}
+        save(filename, arg_dict)
+
+    def load_parameters(self, filename: str, ctx=None, allow_missing: bool = False,
+                        ignore_extra: bool = False, cast_dtype: bool = False,
+                        dtype_source: str = "current", device=None):
+        """Ref block.py:379."""
+        from ..ndarray.utils import load
+
+        loaded = load(filename)
+        params = self.collect_params()
+        if not allow_missing:
+            for name in params:
+                if name not in loaded and params[name]._data is None and \
+                        params[name]._deferred_init is None:
+                    pass  # uninitialized-and-unsaved handled below
+                if name not in loaded:
+                    raise MXNetError(
+                        f"Parameter '{name}' is missing in file '{filename}'. "
+                        "Set allow_missing=True to ignore missing parameters.")
+        for name, value in loaded.items():
+            if name not in params:
+                if not ignore_extra:
+                    raise MXNetError(
+                        f"Parameter '{name}' loaded from file '{filename}' is "
+                        "not present in the Block. Set ignore_extra=True to ignore.")
+                continue
+            p = params[name]
+            if cast_dtype:
+                p.cast(value._data.dtype)
+            p.set_data(value)
+        return self
+
+    def save(self, prefix):
+        """Structured whole-model save (ref block.py:577)."""
+        self.save_parameters(prefix + "-model.params")
+
+    def load(self, prefix):
+        self.load_parameters(prefix + "-model.params")
+
+    # -- call path ----------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        try:
+            out = self.forward(*args, **kwargs)
+        except DeferredInitializationError:
+            self._deferred_infer_and_init(*args, **kwargs)
+            out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def _deferred_infer_and_init(self, *args, **kwargs):
+        infer = getattr(self, "infer_shape", None)
+        if infer is None:
+            raise
+        infer(*args, **kwargs)
+        for p in self._reg_params.values():
+            p._finish_deferred_init()
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def hybridize(self, active: bool = True, **kwargs):
+        """On a plain Block: recurse (ref Block.hybridize)."""
+        for c in self._children.values():
+            c.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        lines = [f"{type(self).__name__}:"]
+        for name, p in self.collect_params().items():
+            lines.append(f"  {name:60s} {str(p.shape):20s} {p.dtype}")
+        total = sum(int(jnp.prod(jnp.array(p.shape))) for p in self.collect_params().values()
+                    if p.shape is not None)
+        lines.append(f"  total parameters: {total}")
+        print("\n".join(lines))
+
+    def __repr__(self):
+        mods = "\n".join(f"  ({k}): {type(v).__name__}" for k, v in self._children.items())
+        return f"{type(self).__name__}(\n{mods}\n)" if mods else f"{type(self).__name__}()"
+
+
+class _HookHandle:
+    def __init__(self, lst, fn):
+        self._lst, self._fn = lst, fn
+
+    def detach(self):
+        if self._fn in self._lst:
+            self._lst.remove(self._fn)
+
+
+class _CachedOp:
+    """jit-backed graph executor for one HybridBlock (≈ CachedOp,
+    src/imperative/cached_op.cc). See module docstring for semantics."""
+
+    def __init__(self, block: "HybridBlock"):
+        self.block = block
+        self._jits: Dict[Any, Any] = {}
+        self._holders: Dict[Any, dict] = {}
+
+    def clear(self):
+        self._jits.clear()
+        self._holders.clear()
+
+    def __call__(self, args, kwargs):
+        from ..random import key_holder
+
+        if kwargs:
+            raise MXNetError("hybridized blocks do not support kwargs in forward")
+        block = self.block
+        params = [p for p in block.collect_params().values() if p._data is not None]
+        state_arrays: List[NDArray] = [p.data() for p in params] + [key_holder()]
+        arg_leaves, arg_tree = _flatten_nd(args)
+        training = _autograd.is_training()
+        key = (training, repr(arg_tree), len(state_arrays))
+
+        holder = self._holders.get(key)
+        if holder is None:
+            holder = self._holders[key] = {"state": state_arrays}
+        holder["state"] = state_arrays
+
+        if key not in self._jits:
+            n_state = len(state_arrays)
+
+            def raw(*vals):
+                h = self._holders[key]
+                sarr = h["state"]
+                svals, avals = vals[:n_state], vals[n_state:]
+                saved = [(a, a._data) for a in sarr]
+                ms = _mutation_scope()
+                try:
+                    with _autograd.pause(train_mode=training), ms:
+                        for a, v in zip(sarr, svals):
+                            a._data = v
+                        call_args = _unflatten_nd(arg_tree, list(avals), wrap=NDArray)
+                        out = block.forward(*call_args)
+                    out_leaves, out_tree = _flatten_nd(out)
+                    state_ids = {id(a) for a in sarr}
+                    # keep mutations of pre-existing arrays: state arrays
+                    # (their pre-trace value is the swapped-in tracer) and
+                    # any array that existed before the trace
+                    mutated = [
+                        (a, a._data) for (a, prev) in ms.mutated.values()
+                        if id(a) in state_ids or not isinstance(prev, jax.core.Tracer)
+                    ]
+                    h["out_tree"] = out_tree
+                    h["mutated_refs"] = [a for a, _ in mutated]
+                    h["n_out"] = len(out_leaves)
+                    return tuple(o._data for o in out_leaves) + tuple(v for _, v in mutated)
+                finally:
+                    for a, v in saved:
+                        a._data = v
+                    for a, prev in ms.mutated.values():
+                        if not isinstance(prev, jax.core.Tracer):
+                            a._data = prev
+
+            self._jits[key] = jax.jit(raw)
+
+        jit_fn = self._jits[key]
+        inputs = state_arrays + arg_leaves
+
+        from ..ops.dispatch import invoke
+
+        res = invoke(jit_fn, inputs, name=f"cached_op_{type(block).__name__}")
+        if isinstance(res, NDArray):
+            res = (res,)
+        n_out = holder["n_out"]
+        out_leaves, mutated_vals = res[:n_out], res[n_out:]
+        for a, v in zip(holder["mutated_refs"], mutated_vals):
+            a._set_data(v._data)
+        return _unflatten_nd(holder["out_tree"], list(out_leaves))
+
+
+class HybridBlock(Block):
+    """Block that can JIT its forward (ref block.py:998)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_op: Optional[_CachedOp] = None
+        self._warmed_up = False
+        self._flags: Dict[str, Any] = {}
+
+    def hybridize(self, active: bool = True, static_alloc: bool = False,
+                  static_shape: bool = False, inline_limit: int = 2,
+                  forward_bulk_size: Optional[int] = None,
+                  backward_bulk_size: Optional[int] = None, **kwargs):
+        """Ref block.py:1419. static_alloc/static_shape are implicit under
+        XLA (all jit'd code is statically planned); flags kept for compat."""
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc, static_shape=static_shape,
+                           **kwargs)
+        if self._cached_op is not None:
+            self._cached_op.clear()
+        self._warmed_up = False
+        for c in self._children.values():
+            # children run inside the parent's trace; no nested jit needed,
+            # but mark them so standalone calls also compile
+            if isinstance(c, HybridBlock):
+                c._active = False  # avoid nested jit overhead under parent
+            c.hybridize(False, **kwargs) if isinstance(c, HybridBlock) else c.hybridize(active, **kwargs)
+        return self
+
+    def optimize_for(self, x, *args, backend=None, clear=False, **kwargs):
+        """Ref block.py:1325 — backend partitioning is XLA's job here; this
+        hybridizes and warms the cache on the given input."""
+        self.hybridize(True, **kwargs)
+        return self(x, *args)
+
+    def __call__(self, *args, **kwargs):
+        leaves, _ = _flatten_nd(args)
+        if leaves:
+            self._last_args_spec = [(l.shape, l._data.dtype) for l in leaves]
+        if not self._active:
+            return super().__call__(*args, **kwargs)
+        if not self._warmed_up:
+            # first call runs eagerly: completes deferred init + shape
+            # discovery, exactly like the reference's trace-on-first-call
+            out = super().__call__(*args, **kwargs)
+            self._warmed_up = True
+            return out
+        if self._cached_op is None:
+            self._cached_op = _CachedOp(self)
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self._cached_op(args, kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def export(self, path: str, epoch: int = 0, remove_amp_cast: bool = True):
+        """Ref block.py:1514. Serializes compiled StableHLO + params —
+        the TPU-native analogue of symbol-json + params (see SymbolBlock)."""
+        from .symbol_block import export_hybrid
+
+        return export_hybrid(self, path, epoch)
+
+    def infer_shape(self, *args):
+        """Layers with deferred params override this (ref HybridBlock.infer_shape)."""
+        raise MXNetError(
+            f"{type(self).__name__} has deferred-init parameters but does not "
+            "implement infer_shape")
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Runs an exported computation (ref block.py:1716). Construct via
+    SymbolBlock.imports(path) — see gluon/symbol_block.py."""
+
+    def __init__(self, outputs=None, inputs=None, params=None):
+        super().__init__()
+        self._exported = outputs  # jax.export.Exported or callable
+
+    @staticmethod
+    def imports(symbol_file, input_names=None, param_file=None, ctx=None):
+        from .symbol_block import import_exported
+
+        return import_exported(symbol_file, param_file, ctx)
+
+    def forward(self, *args):
+        from ..ops.dispatch import invoke
+
+        if self._exported is None:
+            raise MXNetError("SymbolBlock has no graph; use SymbolBlock.imports")
+        fn = self._exported
+        return invoke(lambda *xs: fn(*xs), list(args), name="symbol_block")

@@ -1,0 +1,6 @@
+"""gluon.data — datasets, samplers, DataLoader (ref: python/mxnet/gluon/data/)."""
+from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
+from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
+                      FilterSampler, IntervalSampler)
+from .dataloader import DataLoader, default_batchify_fn
+from . import vision

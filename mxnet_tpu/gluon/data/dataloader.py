@@ -1,0 +1,155 @@
+"""DataLoader (ref: python/mxnet/gluon/data/dataloader.py).
+
+Reference architecture: fork worker processes that build batches in POSIX
+shared memory (CPUSharedStorageManager) and ForkingPickler them back
+(dataloader.py:28-138,186). TPU-native redesign: workers produce **numpy**
+host batches (fork-shared pages, no custom shm manager needed) via a
+multiprocessing pool; the main process overlaps device transfer
+(host→HBM ≈ pin_memory+copy) with a prefetch window. jax is never touched
+in workers — PJRT owns the device, exactly why the reference needed its
+pthread_atfork engine teardown (src/initialize.cc:71-163), which this
+design makes unnecessary.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+from typing import Callable, List, Optional
+
+import numpy as _onp
+
+from ...base import MXNetError, get_env
+from ...ndarray.ndarray import NDArray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def _stack_np(data):
+    if isinstance(data[0], (_onp.ndarray, _onp.generic)):
+        return _onp.stack([_onp.asarray(d) for d in data])
+    if isinstance(data[0], NDArray):
+        return _onp.stack([d.asnumpy() for d in data])
+    if isinstance(data[0], (tuple, list)):
+        return tuple(_stack_np([d[i] for d in data]) for i in range(len(data[0])))
+    return _onp.asarray(data)
+
+
+def default_batchify_fn(data):
+    """Stack samples into an NDArray batch (ref dataloader.py default_batchify_fn)."""
+    out = _stack_np(data)
+    if isinstance(out, tuple):
+        return tuple(NDArray(o) for o in out)
+    return NDArray(out)
+
+
+def default_mp_batchify_fn(data):
+    """Worker-side batchify: stay in numpy (crosses the process boundary)."""
+    return _stack_np(data)
+
+
+# module-level worker state (set by pool initializer; fork-shared)
+_worker_dataset = None
+_worker_batchify = None
+
+
+def _worker_init(dataset, batchify_fn):
+    global _worker_dataset, _worker_batchify
+    _worker_dataset = dataset
+    _worker_batchify = batchify_fn
+
+
+def _worker_fn(indices: List[int]):
+    return _worker_batchify([_worker_dataset[i] for i in indices])
+
+
+def _to_device(batch):
+    if isinstance(batch, tuple):
+        return tuple(_to_device(b) for b in batch)
+    if isinstance(batch, _onp.ndarray):
+        return NDArray(batch)
+    return batch
+
+
+class DataLoader:
+    """Ref dataloader.py DataLoader; same constructor surface."""
+
+    def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
+                 shuffle: bool = False, sampler: Optional[Sampler] = None,
+                 last_batch: Optional[str] = None,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 batchify_fn: Optional[Callable] = None,
+                 num_workers: int = 0, pin_memory: bool = False,
+                 pin_device_id: int = 0, prefetch: Optional[int] = None,
+                 thread_pool: bool = False, timeout: int = 120,
+                 try_nopython: Optional[bool] = None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size must be specified unless "
+                                 "batch_sampler is given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False when sampler is given")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or last_batch:
+            raise MXNetError(
+                "batch_size/shuffle/sampler/last_batch must not be given "
+                "when batch_sampler is specified")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        self._batchify_fn = batchify_fn
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+        self._pool = None
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _get_pool(self):
+        if self._pool is None:
+            if self._thread_pool:
+                from multiprocessing.pool import ThreadPool
+
+                self._pool = ThreadPool(self._num_workers, _worker_init,
+                                        (self._dataset, self._mp_batchify()))
+            else:
+                ctx = mp.get_context("fork")
+                self._pool = ctx.Pool(self._num_workers, _worker_init,
+                                      (self._dataset, self._mp_batchify()))
+        return self._pool
+
+    def _mp_batchify(self):
+        if self._batchify_fn is not None:
+            return self._batchify_fn
+        return default_mp_batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            batchify = self._batchify_fn or default_batchify_fn
+            for indices in self._batch_sampler:
+                yield _to_device(batchify([self._dataset[i] for i in indices]))
+            return
+
+        pool = self._get_pool()
+        batches = list(self._batch_sampler)
+        window = self._prefetch or 2
+        pending = []
+        idx = 0
+        while idx < len(batches) or pending:
+            while idx < len(batches) and len(pending) < window:
+                pending.append(pool.apply_async(_worker_fn, (batches[idx],)))
+                idx += 1
+            res = pending.pop(0).get(self._timeout)
+            yield _to_device(res)
+
+    def __del__(self):
+        if self._pool is not None:
+            try:
+                self._pool.terminate()
+            except Exception:
+                pass

@@ -1,0 +1,114 @@
+"""Datasets (ref: python/mxnet/gluon/data/dataset.py)."""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Sequence
+
+import numpy as _onp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
+
+
+class Dataset:
+    """Abstract dataset (ref dataset.py Dataset)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def filter(self, fn: Callable) -> "SimpleDataset":
+        return SimpleDataset([self[i] for i in range(len(self)) if fn(self[i])])
+
+    def shard(self, num_shards: int, index: int) -> "SimpleDataset":
+        """Even sharding for multi-worker loading (ref dataset.py shard)."""
+        if index >= num_shards:
+            raise MXNetError(f"shard index {index} out of range {num_shards}")
+        items = [self[i] for i in range(index, len(self), num_shards)]
+        return SimpleDataset(items)
+
+    def take(self, count: int) -> "SimpleDataset":
+        return SimpleDataset([self[i] for i in range(min(count, len(self)))])
+
+    def transform(self, fn: Callable, lazy: bool = True) -> "Dataset":
+        return _LazyTransformDataset(self, fn) if lazy else \
+            SimpleDataset([fn(self[i]) for i in range(len(self))])
+
+    def transform_first(self, fn: Callable, lazy: bool = True) -> "Dataset":
+        def tfirst(item):
+            if isinstance(item, tuple):
+                return (fn(item[0]),) + item[1:]
+            return fn(item)
+
+        return self.transform(tfirst, lazy)
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, dataset: Dataset, fn: Callable):
+        self._dataset = dataset
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._dataset)
+
+    def __getitem__(self, idx):
+        return self._fn(self._dataset[idx])
+
+
+class SimpleDataset(Dataset):
+    def __init__(self, data: Sequence):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class ArrayDataset(Dataset):
+    """Zip of equal-length arrays (ref dataset.py ArrayDataset)."""
+
+    def __init__(self, *args):
+        if not args:
+            raise MXNetError("ArrayDataset needs at least one input")
+        self._length = len(args[0])
+        self._data = []
+        for i, a in enumerate(args):
+            if len(a) != self._length:
+                raise MXNetError(
+                    f"All arrays must have the same length; input {i} has "
+                    f"{len(a)} vs {self._length}")
+            if isinstance(a, NDArray):
+                a = a.asnumpy()  # host-side for cheap indexing in workers
+            self._data.append(a)
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+
+class RecordFileDataset(Dataset):
+    """Dataset over a RecordIO file (ref dataset.py RecordFileDataset;
+    format from src/io — see mxnet_tpu/io/recordio.py)."""
+
+    def __init__(self, filename: str):
+        from ...io.recordio import MXIndexedRecordIO
+
+        self._filename = filename
+        idx_file = os.path.splitext(filename)[0] + ".idx"
+        self._record = MXIndexedRecordIO(idx_file, filename, "r")
+
+    def __len__(self):
+        return len(self._record.keys)
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])

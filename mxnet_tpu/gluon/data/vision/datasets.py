@@ -1,0 +1,143 @@
+"""Vision datasets (ref: python/mxnet/gluon/data/vision/datasets.py).
+
+MNIST/FashionMNIST/CIFAR read the standard binary formats from
+``root`` (default $MXNET_HOME/datasets/...). This build environment has no
+network egress, so when files are absent the datasets fall back to a
+**deterministic synthetic sample set** (class-templated images + noise,
+fixed seed) — clearly flagged via ``.synthetic`` — so end-to-end training
+and convergence tests run anywhere. Real files are used when present.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional
+
+import numpy as _onp
+
+from ....base import get_env
+from ..dataset import ArrayDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100"]
+
+
+def _data_root():
+    return os.path.expanduser(
+        get_env("MXNET_HOME", os.path.join("~", ".mxnet")) + "/datasets")
+
+
+def _synthetic_images(num: int, num_classes: int, shape, seed: int, channels=1,
+                      template_seed: int = 1234):
+    """Class-templated images: template[class] + noise — linearly separable
+    enough that LeNet converges in a few hundred steps, hard enough that an
+    untrained model is at chance. Templates are drawn from ``template_seed``
+    (shared across train/test splits so generalization is measurable);
+    ``seed`` only varies labels and noise per split."""
+    templates = _onp.random.RandomState(template_seed).uniform(
+        0, 1.0, (num_classes,) + shape).astype(_onp.float32)
+    rng = _onp.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, num).astype(_onp.int32)
+    noise = rng.normal(0, 0.3, (num,) + shape).astype(_onp.float32)
+    images = _onp.clip(templates[labels] * 0.7 + noise, 0, 1)
+    images = (images * 255).astype(_onp.uint8)
+    if channels == 1:
+        images = images[..., None]
+    return images, labels
+
+
+class MNIST(ArrayDataset):
+    """Ref datasets.py MNIST (IDX format files)."""
+
+    _shape = (28, 28)
+    _channels = 1
+    _classes = 10
+    _files = {True: ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"),
+              False: ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")}
+    _dirname = "mnist"
+
+    def __init__(self, root: Optional[str] = None, train: bool = True,
+                 transform=None):
+        self._train = train
+        root = os.path.expanduser(root) if root else \
+            os.path.join(_data_root(), self._dirname)
+        self.synthetic = False
+        data, label = self._load(root, train)
+        if transform is not None:
+            data = _onp.stack([transform(d) for d in data])
+        super().__init__(data, label)
+
+    def _load(self, root, train):
+        imgf, labf = (os.path.join(root, f) for f in self._files[train])
+        if os.path.exists(imgf) and os.path.exists(labf):
+            with gzip.open(labf, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                label = _onp.frombuffer(f.read(), dtype=_onp.uint8).astype(_onp.int32)
+            with gzip.open(imgf, "rb") as f:
+                _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+                data = _onp.frombuffer(f.read(), dtype=_onp.uint8)
+                data = data.reshape(num, rows, cols, 1)
+            return data, label
+        self.synthetic = True
+        n = 8192 if train else 1024
+        return _synthetic_images(n, self._classes, self._shape,
+                                 seed=7 if train else 8, channels=self._channels)
+
+
+class FashionMNIST(MNIST):
+    _dirname = "fashion-mnist"
+
+
+class CIFAR10(ArrayDataset):
+    """Ref datasets.py CIFAR10 (binary batches)."""
+
+    _classes = 10
+    _dirname = "cifar10"
+    _train_files = [f"data_batch_{i}.bin" for i in range(1, 6)]
+    _test_files = ["test_batch.bin"]
+
+    def __init__(self, root: Optional[str] = None, train: bool = True,
+                 transform=None):
+        root = os.path.expanduser(root) if root else \
+            os.path.join(_data_root(), self._dirname)
+        self.synthetic = False
+        data, label = self._load(root, train)
+        if transform is not None:
+            data = _onp.stack([transform(d) for d in data])
+        super().__init__(data, label)
+
+    def _read_batch(self, fname):
+        with open(fname, "rb") as f:
+            raw = _onp.frombuffer(f.read(), dtype=_onp.uint8)
+        rec = raw.reshape(-1, 3073)
+        label = rec[:, 0].astype(_onp.int32)
+        data = rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return data, label
+
+    def _load(self, root, train):
+        files = self._train_files if train else self._test_files
+        paths = [os.path.join(root, f) for f in files]
+        if all(os.path.exists(p) for p in paths):
+            parts = [self._read_batch(p) for p in paths]
+            return (_onp.concatenate([p[0] for p in parts]),
+                    _onp.concatenate([p[1] for p in parts]))
+        self.synthetic = True
+        n = 8192 if train else 1024
+        img, lab = _synthetic_images(n, self._classes, (32, 32, 3),
+                                     seed=9 if train else 10, channels=0)
+        return img, lab
+
+
+class CIFAR100(CIFAR10):
+    _classes = 100
+    _dirname = "cifar100"
+    _train_files = ["train.bin"]
+    _test_files = ["test.bin"]
+
+    def _read_batch(self, fname):
+        with open(fname, "rb") as f:
+            raw = _onp.frombuffer(f.read(), dtype=_onp.uint8)
+        rec = raw.reshape(-1, 3074)
+        label = rec[:, 1].astype(_onp.int32)  # fine label
+        data = rec[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return data, label

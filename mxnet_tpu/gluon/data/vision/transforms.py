@@ -1,0 +1,193 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms/).
+
+Transforms operate on host-side numpy HWC uint8 images (what datasets
+yield) and compose via nn.Sequential-like chaining; ToTensor converts to
+CHW float32 NDArray-compatible numpy. Kept numpy-only so they run inside
+DataLoader worker processes (no jax in workers — see dataloader.py).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as _onp
+
+from ....base import MXNetError
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "Cast", "RandomBrightness", "RandomContrast"]
+
+
+class Transform:
+    def __call__(self, x):
+        raise NotImplementedError
+
+
+class Compose(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self._transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+
+class Cast(Transform):
+    def __init__(self, dtype="float32"):
+        self._dtype = dtype
+
+    def __call__(self, x):
+        return _onp.asarray(x, dtype=self._dtype)
+
+
+class ToTensor(Transform):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (ref transforms ToTensor)."""
+
+    def __call__(self, x):
+        x = _onp.asarray(x)
+        if x.ndim == 2:
+            x = x[:, :, None]
+        return (x.astype(_onp.float32) / 255.0).transpose(2, 0, 1)
+
+
+class Normalize(Transform):
+    """CHW float: (x - mean) / std per channel."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        self._mean = _onp.asarray(mean, _onp.float32).reshape(-1, 1, 1)
+        self._std = _onp.asarray(std, _onp.float32).reshape(-1, 1, 1)
+
+    def __call__(self, x):
+        return (x - self._mean) / self._std
+
+
+def _resize_hwc(img: _onp.ndarray, size: Tuple[int, int]) -> _onp.ndarray:
+    """Bilinear resize in numpy (reference uses OpenCV)."""
+    h, w = img.shape[:2]
+    out_w, out_h = size
+    if (h, w) == (out_h, out_w):
+        return img
+    ys = _onp.linspace(0, h - 1, out_h)
+    xs = _onp.linspace(0, w - 1, out_w)
+    y0 = _onp.floor(ys).astype(int)
+    x0 = _onp.floor(xs).astype(int)
+    y1 = _onp.minimum(y0 + 1, h - 1)
+    x1 = _onp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    img_f = img.astype(_onp.float32)
+    if img_f.ndim == 2:
+        img_f = img_f[:, :, None]
+    top = img_f[y0][:, x0] * (1 - wx) + img_f[y0][:, x1] * wx
+    bot = img_f[y1][:, x0] * (1 - wx) + img_f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    if img.dtype == _onp.uint8:
+        out = _onp.clip(out, 0, 255).astype(_onp.uint8)
+    return out
+
+
+class Resize(Transform):
+    def __init__(self, size: Union[int, Tuple[int, int]], keep_ratio=False,
+                 interpolation=1):
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._keep = keep_ratio
+
+    def __call__(self, x):
+        x = _onp.asarray(x)
+        if self._keep:
+            h, w = x.shape[:2]
+            scale = min(self._size[0] / w, self._size[1] / h)
+            size = (max(1, int(w * scale)), max(1, int(h * scale)))
+        else:
+            size = self._size
+        return _resize_hwc(x, size)
+
+
+class CenterCrop(Transform):
+    def __init__(self, size: Union[int, Tuple[int, int]]):
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, x):
+        x = _onp.asarray(x)
+        h, w = x.shape[:2]
+        cw, ch = self._size
+        y0 = max(0, (h - ch) // 2)
+        x0 = max(0, (w - cw) // 2)
+        return x[y0:y0 + ch, x0:x0 + cw]
+
+
+class RandomCrop(Transform):
+    def __init__(self, size: Union[int, Tuple[int, int]], pad=None):
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+
+    def __call__(self, x):
+        x = _onp.asarray(x)
+        if self._pad:
+            p = self._pad
+            x = _onp.pad(x, ((p, p), (p, p)) + ((0, 0),) * (x.ndim - 2))
+        h, w = x.shape[:2]
+        cw, ch = self._size
+        y0 = _onp.random.randint(0, max(1, h - ch + 1))
+        x0 = _onp.random.randint(0, max(1, w - cw + 1))
+        return x[y0:y0 + ch, x0:x0 + cw]
+
+
+class RandomResizedCrop(Transform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def __call__(self, x):
+        x = _onp.asarray(x)
+        h, w = x.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * _onp.random.uniform(*self._scale)
+            ar = _onp.exp(_onp.random.uniform(_onp.log(self._ratio[0]),
+                                              _onp.log(self._ratio[1])))
+            cw = int(round(_onp.sqrt(target * ar)))
+            ch = int(round(_onp.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                x0 = _onp.random.randint(0, w - cw + 1)
+                y0 = _onp.random.randint(0, h - ch + 1)
+                return _resize_hwc(x[y0:y0 + ch, x0:x0 + cw], self._size)
+        return _resize_hwc(CenterCrop(min(h, w))(x), self._size)
+
+
+class RandomFlipLeftRight(Transform):
+    def __call__(self, x):
+        if _onp.random.rand() < 0.5:
+            return _onp.asarray(x)[:, ::-1].copy()
+        return _onp.asarray(x)
+
+
+class RandomFlipTopBottom(Transform):
+    def __call__(self, x):
+        if _onp.random.rand() < 0.5:
+            return _onp.asarray(x)[::-1].copy()
+        return _onp.asarray(x)
+
+
+class RandomBrightness(Transform):
+    def __init__(self, brightness: float):
+        self._b = brightness
+
+    def __call__(self, x):
+        x = _onp.asarray(x, _onp.float32)
+        f = 1.0 + _onp.random.uniform(-self._b, self._b)
+        return _onp.clip(x * f, 0, 255 if x.max() > 1.1 else 1.0)
+
+
+class RandomContrast(Transform):
+    def __init__(self, contrast: float):
+        self._c = contrast
+
+    def __call__(self, x):
+        x = _onp.asarray(x, _onp.float32)
+        f = 1.0 + _onp.random.uniform(-self._c, self._c)
+        mean = x.mean()
+        return _onp.clip((x - mean) * f + mean, 0, 255 if x.max() > 1.1 else 1.0)

@@ -1,0 +1,341 @@
+"""Loss blocks (ref: python/mxnet/gluon/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ops.dispatch import call
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
+           "SquaredHingeLoss", "LogisticLoss", "TripletLoss", "PoissonNLLLoss",
+           "CosineEmbeddingLoss", "SDMLLoss"]
+
+
+def _reshape_like(x, y):
+    return x.reshape(y.shape)
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+class Loss(HybridBlock):
+    """Base loss (ref loss.py Loss): scalar-izes over all but batch axis."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def _mean(self, loss):
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, sw=None):
+            loss = jnp.square(l.reshape(p.shape) - p) * (self._weight / 2.0)
+            if sw is not None:
+                loss = loss * sw
+            axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+            return loss.mean(axis=axes) if axes else loss
+
+        args = (pred, label) if sample_weight is None else (pred, label, sample_weight)
+        return call(f, args, {}, name="l2_loss")
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, sw=None):
+            loss = jnp.abs(l.reshape(p.shape) - p) * self._weight
+            if sw is not None:
+                loss = loss * sw
+            axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+            return loss.mean(axis=axes) if axes else loss
+
+        args = (pred, label) if sample_weight is None else (pred, label, sample_weight)
+        return call(f, args, {}, name="l1_loss")
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        def f(p, l, sw=None):
+            lab = l.reshape(p.shape)
+            if not self._from_sigmoid:
+                # log(1+exp(-|x|)) + max(x,0) - x*z  (stable)
+                loss = jax.nn.softplus(-jnp.abs(p)) + jnp.maximum(p, 0) - p * lab
+            else:
+                eps = 1e-12
+                loss = -(lab * jnp.log(p + eps) + (1 - lab) * jnp.log(1 - p + eps))
+            if self._weight is not None:
+                loss = loss * self._weight
+            if sw is not None:
+                loss = loss * sw
+            axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+            return loss.mean(axis=axes) if axes else loss
+
+        args = (pred, label) if sample_weight is None else (pred, label, sample_weight)
+        return call(f, args, {}, name="sigmoid_bce")
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Ref loss.py SoftmaxCrossEntropyLoss."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, sw=None):
+            logp = p if self._from_logits else jax.nn.log_softmax(p, axis=self._axis)
+            if self._sparse:
+                li = l.astype(jnp.int32)
+                if li.ndim == logp.ndim:
+                    li = li.squeeze(self._axis)
+                loss = -jnp.take_along_axis(logp, li[..., None], axis=self._axis).squeeze(self._axis)
+            else:
+                loss = -(l.reshape(logp.shape) * logp).sum(axis=self._axis)
+            if self._weight is not None:
+                loss = loss * self._weight
+            if sw is not None:
+                loss = loss * sw
+            axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+            return loss.mean(axis=axes) if axes else loss
+
+        args = (pred, label) if sample_weight is None else (pred, label, sample_weight)
+        return call(f, args, {}, name="softmax_ce")
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, sw=None):
+            logp = p if self._from_logits else jax.nn.log_softmax(p, axis=self._axis)
+            loss = l * (jnp.log(jnp.clip(l, 1e-12, None)) - logp)
+            loss = loss.sum(axis=self._axis) / l.shape[self._axis] * l.shape[self._axis]
+            loss = loss / p.shape[self._axis] * p.shape[self._axis]
+            loss = loss.mean(axis=tuple(i for i in range(loss.ndim) if i != self._batch_axis)) \
+                if loss.ndim > 1 else loss
+            if sw is not None:
+                loss = loss * sw
+            return loss / p.shape[self._axis]
+
+        args = (pred, label) if sample_weight is None else (pred, label, sample_weight)
+        return call(f, args, {}, name="kldiv")
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification (ref loss.py CTCLoss →
+    src/operator/nn/ctc_loss.cc). Implemented with a lax.scan forward
+    algorithm in log space — XLA-friendly, no warp-ctc."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        from ..ops.ctc import ctc_loss as _ctc
+
+        args = [pred, label]
+        if pred_lengths is not None:
+            args.append(pred_lengths)
+        if label_lengths is not None:
+            args.append(label_lengths)
+
+        def f(p, l, pl=None, ll=None):
+            if self._layout == "TNC":
+                p = jnp.swapaxes(p, 0, 1)
+            if self._label_layout == "TN":
+                l = jnp.swapaxes(l, 0, 1)
+            loss = _ctc(p, l, pl, ll)
+            if self._weight is not None:
+                loss = loss * self._weight
+            return loss
+
+        return call(f, tuple(args), {}, name="ctc_loss")
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, sw=None):
+            d = jnp.abs(l.reshape(p.shape) - p)
+            loss = jnp.where(d > self._rho, d - 0.5 * self._rho,
+                             0.5 / self._rho * jnp.square(d))
+            if self._weight is not None:
+                loss = loss * self._weight
+            if sw is not None:
+                loss = loss * sw
+            axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+            return loss.mean(axis=axes) if axes else loss
+
+        args = (pred, label) if sample_weight is None else (pred, label, sample_weight)
+        return call(f, args, {}, name="huber")
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, sw=None):
+            loss = jnp.maximum(0.0, self._margin - p * l.reshape(p.shape))
+            if self._weight is not None:
+                loss = loss * self._weight
+            if sw is not None:
+                loss = loss * sw
+            axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+            return loss.mean(axis=axes) if axes else loss
+
+        args = (pred, label) if sample_weight is None else (pred, label, sample_weight)
+        return call(f, args, {}, name="hinge")
+
+
+class SquaredHingeLoss(HingeLoss):
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, sw=None):
+            loss = jnp.square(jnp.maximum(0.0, self._margin - p * l.reshape(p.shape)))
+            if sw is not None:
+                loss = loss * sw
+            axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+            return loss.mean(axis=axes) if axes else loss
+
+        args = (pred, label) if sample_weight is None else (pred, label, sample_weight)
+        return call(f, args, {}, name="sq_hinge")
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed", **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._fmt = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, sw=None):
+            lab = l.reshape(p.shape)
+            if self._fmt == "signed":
+                lab = (lab + 1.0) / 2.0
+            loss = jax.nn.softplus(-jnp.abs(p)) + jnp.maximum(p, 0) - p * lab
+            if sw is not None:
+                loss = loss * sw
+            axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+            return loss.mean(axis=axes) if axes else loss
+
+        args = (pred, label) if sample_weight is None else (pred, label, sample_weight)
+        return call(f, args, {}, name="logistic")
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        def f(p, pos, neg):
+            loss = jnp.sum(jnp.square(pos - p) - jnp.square(neg - p),
+                           axis=tuple(range(1, p.ndim)))
+            return jnp.maximum(loss + self._margin, 0.0)
+
+        return call(f, (pred, positive, negative), {}, name="triplet")
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._full = compute_full
+
+    def forward(self, pred, label, sample_weight=None, epsilon=1e-08):
+        def f(p, l, sw=None):
+            t = l.reshape(p.shape)
+            if self._from_logits:
+                loss = jnp.exp(p) - t * p
+            else:
+                loss = p - t * jnp.log(p + epsilon)
+            if self._full:
+                loss = loss + t * jnp.log(jnp.clip(t, 1.0, None)) - t + \
+                    0.5 * jnp.log(2 * jnp.pi * jnp.clip(t, 1.0, None))
+            if sw is not None:
+                loss = loss * sw
+            return loss.mean()
+
+        args = (pred, label) if sample_weight is None else (pred, label, sample_weight)
+        return call(f, args, {}, name="poisson_nll")
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        def f(a, b, l):
+            cos = (a * b).sum(-1) / (jnp.linalg.norm(a, axis=-1) *
+                                     jnp.linalg.norm(b, axis=-1) + 1e-12)
+            lab = l.reshape(cos.shape)
+            return jnp.where(lab == 1, 1.0 - cos,
+                             jnp.maximum(0.0, cos - self._margin))
+
+        return call(f, (input1, input2, label), {}, name="cosine_embedding")
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (ref loss.py SDMLLoss)."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smooth = smoothing_parameter
+
+    def forward(self, x1, x2):
+        def f(a, b):
+            n = a.shape[0]
+            dist = jnp.sqrt(jnp.sum(jnp.square(a[:, None, :] - b[None, :, :]), -1) + 1e-12)
+            logits = -dist
+            target = jnp.eye(n) * (1 - self._smooth) + \
+                (1 - jnp.eye(n)) * self._smooth / (n - 1)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -(target * logp).sum(-1).mean()
+
+        return call(f, (x1, x2), {}, name="sdml")

@@ -1,0 +1,382 @@
+"""Evaluation metrics (ref: python/mxnet/gluon/metric.py)."""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as _onp
+
+from ..base import MXNetError, Registry
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy", "Perplexity",
+           "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
+           "create", "check_label_shapes"]
+
+_REG: Registry = Registry("metric")
+
+
+def register(klass):
+    _REG.register(klass.__name__.lower(), klass)
+    return klass
+
+
+def create(metric, *args, **kwargs):
+    if isinstance(metric, EvalMetric):
+        return metric
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m, *args, **kwargs))
+        return composite
+    return _REG.get(metric)(*args, **kwargs)
+
+
+def check_label_shapes(labels, preds, wrap=False, shape=False):
+    if not shape:
+        ln, pn = len(labels), len(preds)
+        if ln != pn:
+            raise MXNetError(f"Shape of labels {ln} does not match shape of predictions {pn}")
+    if wrap:
+        if isinstance(labels, NDArray):
+            labels = [labels]
+        if isinstance(preds, NDArray):
+            preds = [preds]
+    return labels, preds
+
+
+def _np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else _onp.asarray(x)
+
+
+class EvalMetric:
+    """Ref metric.py EvalMetric."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def __str__(self):
+        return f"EvalMetric: {dict([self.get()])}"
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return (names, values)
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p = _np(pred)
+            l = _np(label).astype("int32")
+            if p.ndim > l.ndim:
+                p = p.argmax(axis=self.axis)
+            p = p.astype("int32").reshape(-1)
+            l = l.reshape(-1)
+            self.sum_metric += (p == l).sum()
+            self.num_inst += len(l)
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p = _np(pred)
+            l = _np(label).astype("int32").reshape(-1)
+            topk = _onp.argsort(p, axis=-1)[:, -self.top_k:]
+            self.sum_metric += (topk == l[:, None]).any(axis=1).sum()
+            self.num_inst += len(l)
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+        self.reset_stats()
+
+    def reset_stats(self):
+        self._tp = self._fp = self._fn = 0
+
+    def reset(self):
+        super().reset()
+        self.reset_stats()
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p = _np(pred)
+            if p.ndim > 1 and p.shape[-1] > 1:
+                p = p.argmax(-1)
+            else:
+                p = (p.reshape(-1) > 0.5).astype("int32")
+            l = _np(label).astype("int32").reshape(-1)
+            self._tp += int(((p == 1) & (l == 1)).sum())
+            self._fp += int(((p == 1) & (l == 0)).sum())
+            self._fn += int(((p == 0) & (l == 1)).sum())
+            self.num_inst += len(l)
+
+    def get(self):
+        prec = self._tp / (self._tp + self._fp) if self._tp + self._fp else 0.0
+        rec = self._tp / (self._tp + self._fn) if self._tp + self._fn else 0.0
+        f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+        return (self.name, f1)
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient."""
+
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self._t = {"tp": 0, "fp": 0, "tn": 0, "fn": 0}
+
+    def reset(self):
+        super().reset()
+        self._t = {"tp": 0, "fp": 0, "tn": 0, "fn": 0}
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p = _np(pred)
+            if p.ndim > 1 and p.shape[-1] > 1:
+                p = p.argmax(-1)
+            else:
+                p = (p.reshape(-1) > 0.5).astype("int32")
+            l = _np(label).astype("int32").reshape(-1)
+            self._t["tp"] += int(((p == 1) & (l == 1)).sum())
+            self._t["fp"] += int(((p == 1) & (l == 0)).sum())
+            self._t["tn"] += int(((p == 0) & (l == 0)).sum())
+            self._t["fn"] += int(((p == 0) & (l == 1)).sum())
+            self.num_inst += len(l)
+
+    def get(self):
+        tp, fp, tn, fn = (self._t[k] for k in ("tp", "fp", "tn", "fn"))
+        denom = math.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return (self.name, (tp * tn - fp * fn) / denom if denom else 0.0)
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            l, p = _np(label), _np(pred)
+            self.sum_metric += float(_onp.abs(l.reshape(p.shape) - p).mean()) * l.shape[0]
+            self.num_inst += l.shape[0]
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            l, p = _np(label), _np(pred)
+            self.sum_metric += float(((l.reshape(p.shape) - p) ** 2).mean()) * l.shape[0]
+            self.num_inst += l.shape[0]
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.sqrt(self.sum_metric / self.num_inst))
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            l = _np(label).astype("int64").reshape(-1)
+            p = _np(pred).reshape(len(l), -1)
+            prob = p[_onp.arange(len(l)), l]
+            self.sum_metric += float((-_onp.log(prob + self.eps)).sum())
+            self.num_inst += len(l)
+
+
+@register
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity", **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.ignore_label = ignore_label
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            l = _np(label).astype("int64").reshape(-1)
+            p = _np(pred).reshape(len(l), -1)
+            prob = p[_onp.arange(len(l)), l]
+            if self.ignore_label is not None:
+                ignore = (l == self.ignore_label)
+                prob = prob[~ignore]
+            self.sum_metric += float((-_onp.log(prob + self.eps)).sum())
+            self.num_inst += len(prob)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(eps=eps, name=name, **kwargs)
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+        self._labels: List[_onp.ndarray] = []
+        self._preds: List[_onp.ndarray] = []
+
+    def reset(self):
+        super().reset()
+        self._labels, self._preds = [], []
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self._labels.append(_np(label).reshape(-1))
+            self._preds.append(_np(pred).reshape(-1))
+            self.num_inst += len(self._labels[-1])
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        l = _onp.concatenate(self._labels)
+        p = _onp.concatenate(self._preds)
+        return (self.name, float(_onp.corrcoef(l, p)[0, 1]))
+
+
+@register
+class Loss(EvalMetric):
+    """Mean of the recorded loss values (ref metric.py Loss)."""
+
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        if isinstance(preds, NDArray):
+            preds = [preds]
+        for pred in preds:
+            v = _np(pred)
+            self.sum_metric += float(v.sum())
+            self.num_inst += v.size
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False, **kwargs):
+        super().__init__(name, **kwargs)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            v = self._feval(_np(label), _np(pred))
+            if isinstance(v, tuple):
+                s, n = v
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += v
+                self.num_inst += 1
+
+
+class Torch(Loss):
+    """Compat alias kept from the reference metric zoo."""
+
+    def __init__(self, name="torch", **kwargs):
+        super().__init__(name, **kwargs)
+
+
+def np(numpy_feval, name="custom", allow_extra_outputs=False):
+    return CustomMetric(numpy_feval, name, allow_extra_outputs)

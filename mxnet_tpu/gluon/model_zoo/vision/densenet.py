@@ -1,0 +1,87 @@
+"""DenseNet 121/161/169/201 (ref: python/mxnet/gluon/model_zoo/vision/densenet.py)."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ....numpy import concatenate
+from ... import nn
+from ...block import HybridBlock
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169", "densenet201"]
+
+_SPEC = {121: (64, 32, [6, 12, 24, 16]),
+         161: (96, 48, [6, 12, 36, 24]),
+         169: (64, 32, [6, 12, 32, 32]),
+         201: (64, 32, [6, 12, 48, 32])}
+
+
+class _DenseLayer(HybridBlock):
+    def __init__(self, growth_rate, bn_size, dropout, **kw):
+        super().__init__(**kw)
+        self.body = nn.HybridSequential()
+        self.body.add(nn.BatchNorm(), nn.Activation("relu"),
+                      nn.Conv2D(bn_size * growth_rate, 1, use_bias=False),
+                      nn.BatchNorm(), nn.Activation("relu"),
+                      nn.Conv2D(growth_rate, 3, padding=1, use_bias=False))
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.body(x)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return concatenate([x, out], axis=1)
+
+
+def _transition(channels):
+    out = nn.HybridSequential()
+    out.add(nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(channels, 1, use_bias=False), nn.AvgPool2D(2, 2))
+    return out
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, **kw):
+        super().__init__(**kw)
+        self.features = nn.HybridSequential()
+        self.features.add(nn.Conv2D(num_init_features, 7, 2, 3, use_bias=False),
+                          nn.BatchNorm(), nn.Activation("relu"),
+                          nn.MaxPool2D(3, 2, 1))
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            blk = nn.HybridSequential()
+            for _ in range(num_layers):
+                blk.add(_DenseLayer(growth_rate, bn_size, dropout))
+            self.features.add(blk)
+            num_features += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                num_features //= 2
+                self.features.add(_transition(num_features))
+        self.features.add(nn.BatchNorm(), nn.Activation("relu"),
+                          nn.GlobalAvgPool2D(), nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def _get(num, pretrained=False, **kw):
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable: no network egress")
+    init, growth, config = _SPEC[num]
+    return DenseNet(init, growth, config, **kw)
+
+
+def densenet121(**kw):
+    return _get(121, **kw)
+
+
+def densenet161(**kw):
+    return _get(161, **kw)
+
+
+def densenet169(**kw):
+    return _get(169, **kw)
+
+
+def densenet201(**kw):
+    return _get(201, **kw)

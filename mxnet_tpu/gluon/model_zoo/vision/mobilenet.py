@@ -1,0 +1,145 @@
+"""MobileNet v1/v2 (ref: python/mxnet/gluon/model_zoo/vision/mobilenet.py).
+
+Depthwise convs = grouped convs with groups=channels — one XLA op via
+feature_group_count (no special kernel like the reference's
+depthwise_convolution-inl.h).
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ... import nn
+from ...block import HybridBlock
+
+__all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
+           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
+           "mobilenet_v2_0_75", "mobilenet_v2_0_5", "mobilenet_v2_0_25",
+           "get_mobilenet", "get_mobilenet_v2"]
+
+
+def _add_conv(out, channels, kernel=1, stride=1, pad=0, num_group=1,
+              active=True, relu6=True):
+    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
+                      use_bias=False))
+    out.add(nn.BatchNorm())
+    if active:
+        out.add(nn.HybridLambda(lambda x: x.clip(0, 6)) if relu6
+                else nn.Activation("relu"))
+
+
+class _DWSep(HybridBlock):
+    """Depthwise-separable unit (ref mobilenet.py _add_conv_dw)."""
+
+    def __init__(self, dw_channels, channels, stride, **kw):
+        super().__init__(**kw)
+        self.body = nn.HybridSequential()
+        _add_conv(self.body, dw_channels, kernel=3, stride=stride, pad=1,
+                  num_group=dw_channels, relu6=False)
+        _add_conv(self.body, channels, relu6=False)
+
+    def forward(self, x):
+        return self.body(x)
+
+
+class MobileNet(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kw):
+        super().__init__(**kw)
+        self.features = nn.HybridSequential()
+        _add_conv(self.features, int(32 * multiplier), 3, 2, 1, relu6=False)
+        dw_channels = [int(x * multiplier) for x in
+                       [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
+        channels = [int(x * multiplier) for x in
+                    [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
+        strides = [1, 2] * 3 + [1] * 5 + [2, 1]
+        for dwc, c, s in zip(dw_channels, channels, strides):
+            self.features.add(_DWSep(dwc, c, s))
+        self.features.add(nn.GlobalAvgPool2D(), nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+class _LinearBottleneck(HybridBlock):
+    """Inverted residual (ref mobilenet.py LinearBottleneck)."""
+
+    def __init__(self, in_channels, channels, t, stride, **kw):
+        super().__init__(**kw)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        self.out = nn.HybridSequential()
+        _add_conv(self.out, in_channels * t)
+        _add_conv(self.out, in_channels * t, kernel=3, stride=stride, pad=1,
+                  num_group=in_channels * t)
+        _add_conv(self.out, channels, active=False)
+
+    def forward(self, x):
+        out = self.out(x)
+        return out + x if self.use_shortcut else out
+
+
+class MobileNetV2(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kw):
+        super().__init__(**kw)
+        self.features = nn.HybridSequential()
+        _add_conv(self.features, int(32 * multiplier), 3, 2, 1)
+        in_c = [int(multiplier * x) for x in
+                [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3 + [160] * 3]
+        channels = [int(multiplier * x) for x in
+                    [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3 + [160] * 3 + [320]]
+        ts = [1] + [6] * 16
+        strides = [1, 2] * 2 + [1, 1, 2] + [1] * 6 + [2] + [1] * 3
+        for ic, c, t, s in zip(in_c, channels, ts, strides):
+            self.features.add(_LinearBottleneck(ic, c, t, s))
+        last = int(1280 * multiplier) if multiplier > 1.0 else 1280
+        _add_conv(self.features, last)
+        self.features.add(nn.GlobalAvgPool2D())
+        self.output = nn.HybridSequential()
+        self.output.add(nn.Conv2D(classes, 1, use_bias=False), nn.Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def get_mobilenet(multiplier, pretrained=False, **kwargs):
+    net = MobileNet(multiplier, **kwargs)
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable: no network egress")
+    return net
+
+
+def get_mobilenet_v2(multiplier, pretrained=False, **kwargs):
+    net = MobileNetV2(multiplier, **kwargs)
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable: no network egress")
+    return net
+
+
+def mobilenet1_0(**kw):
+    return get_mobilenet(1.0, **kw)
+
+
+def mobilenet0_75(**kw):
+    return get_mobilenet(0.75, **kw)
+
+
+def mobilenet0_5(**kw):
+    return get_mobilenet(0.5, **kw)
+
+
+def mobilenet0_25(**kw):
+    return get_mobilenet(0.25, **kw)
+
+
+def mobilenet_v2_1_0(**kw):
+    return get_mobilenet_v2(1.0, **kw)
+
+
+def mobilenet_v2_0_75(**kw):
+    return get_mobilenet_v2(0.75, **kw)
+
+
+def mobilenet_v2_0_5(**kw):
+    return get_mobilenet_v2(0.5, **kw)
+
+
+def mobilenet_v2_0_25(**kw):
+    return get_mobilenet_v2(0.25, **kw)

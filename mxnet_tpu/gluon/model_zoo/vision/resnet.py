@@ -1,0 +1,244 @@
+"""ResNet v1/v2 (ref: python/mxnet/gluon/model_zoo/vision/resnet.py).
+
+Same family surface: resnet18/34/50/101/152 in both versions, BasicBlock ×
+Bottleneck, get_resnet(version, num_layers). thumbnail=True uses the CIFAR
+3x3 stem.
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ... import nn
+from ...block import HybridBlock
+
+__all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
+           "BottleneckV1", "BottleneckV2", "get_resnet",
+           "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+           "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+           "resnet101_v2", "resnet152_v2"]
+
+
+def _conv3x3(channels, stride, in_channels=0):
+    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
+                     use_bias=False, in_channels=in_channels)
+
+
+class BasicBlockV1(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kw):
+        super().__init__(**kw)
+        self.body = nn.HybridSequential()
+        self.body.add(_conv3x3(channels, stride, in_channels), nn.BatchNorm(),
+                      nn.Activation("relu"), _conv3x3(channels, 1, channels),
+                      nn.BatchNorm())
+        if downsample:
+            self.downsample = nn.HybridSequential()
+            self.downsample.add(
+                nn.Conv2D(channels, 1, strides=stride, use_bias=False,
+                          in_channels=in_channels), nn.BatchNorm())
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x if self.downsample is None else self.downsample(x)
+        out = self.body(x)
+        return (out + residual).relu()
+
+
+class BottleneckV1(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kw):
+        super().__init__(**kw)
+        self.body = nn.HybridSequential()
+        self.body.add(nn.Conv2D(channels // 4, 1, strides=stride, use_bias=False),
+                      nn.BatchNorm(), nn.Activation("relu"),
+                      _conv3x3(channels // 4, 1, channels // 4),
+                      nn.BatchNorm(), nn.Activation("relu"),
+                      nn.Conv2D(channels, 1, strides=1, use_bias=False),
+                      nn.BatchNorm())
+        if downsample:
+            self.downsample = nn.HybridSequential()
+            self.downsample.add(
+                nn.Conv2D(channels, 1, strides=stride, use_bias=False,
+                          in_channels=in_channels), nn.BatchNorm())
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x if self.downsample is None else self.downsample(x)
+        out = self.body(x)
+        return (out + residual).relu()
+
+
+class BasicBlockV2(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kw):
+        super().__init__(**kw)
+        self.bn1 = nn.BatchNorm()
+        self.conv1 = _conv3x3(channels, stride, in_channels)
+        self.bn2 = nn.BatchNorm()
+        self.conv2 = _conv3x3(channels, 1, channels)
+        self.downsample = nn.Conv2D(channels, 1, strides=stride, use_bias=False,
+                                    in_channels=in_channels) if downsample else None
+
+    def forward(self, x):
+        residual = x
+        x = self.bn1(x).relu()
+        if self.downsample is not None:
+            residual = self.downsample(x)
+        x = self.conv1(x)
+        x = self.bn2(x).relu()
+        x = self.conv2(x)
+        return x + residual
+
+
+class BottleneckV2(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kw):
+        super().__init__(**kw)
+        self.bn1 = nn.BatchNorm()
+        self.conv1 = nn.Conv2D(channels // 4, 1, strides=1, use_bias=False)
+        self.bn2 = nn.BatchNorm()
+        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
+        self.bn3 = nn.BatchNorm()
+        self.conv3 = nn.Conv2D(channels, 1, strides=1, use_bias=False)
+        self.downsample = nn.Conv2D(channels, 1, strides=stride, use_bias=False,
+                                    in_channels=in_channels) if downsample else None
+
+    def forward(self, x):
+        residual = x
+        x = self.bn1(x).relu()
+        if self.downsample is not None:
+            residual = self.downsample(x)
+        x = self.conv1(x)
+        x = self.bn2(x).relu()
+        x = self.conv2(x)
+        x = self.bn3(x).relu()
+        x = self.conv3(x)
+        return x + residual
+
+
+class ResNetV1(HybridBlock):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False, **kw):
+        super().__init__(**kw)
+        if len(channels) != len(layers) + 1:
+            raise MXNetError("channels must have len(layers)+1 entries")
+        self.features = nn.HybridSequential()
+        if thumbnail:
+            self.features.add(_conv3x3(channels[0], 1, 0))
+        else:
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False),
+                              nn.BatchNorm(), nn.Activation("relu"),
+                              nn.MaxPool2D(3, 2, 1))
+        for i, num_layer in enumerate(layers):
+            stride = 1 if i == 0 else 2
+            self.features.add(self._make_layer(
+                block, num_layer, channels[i + 1], stride, channels[i]))
+        self.features.add(nn.GlobalAvgPool2D())
+        self.output = nn.Dense(classes)
+
+    def _make_layer(self, block, layers, channels, stride, in_channels=0):
+        layer = nn.HybridSequential()
+        layer.add(block(channels, stride, channels != in_channels,
+                        in_channels=in_channels))
+        for _ in range(layers - 1):
+            layer.add(block(channels, 1, False, in_channels=channels))
+        return layer
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+class ResNetV2(HybridBlock):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False, **kw):
+        super().__init__(**kw)
+        self.features = nn.HybridSequential()
+        self.features.add(nn.BatchNorm(scale=False, center=False))
+        if thumbnail:
+            self.features.add(_conv3x3(channels[0], 1, 0))
+        else:
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False),
+                              nn.BatchNorm(), nn.Activation("relu"),
+                              nn.MaxPool2D(3, 2, 1))
+        in_channels = channels[0]
+        for i, num_layer in enumerate(layers):
+            stride = 1 if i == 0 else 2
+            self.features.add(self._make_layer(
+                block, num_layer, channels[i + 1], stride, in_channels))
+            in_channels = channels[i + 1]
+        self.features.add(nn.BatchNorm(), nn.Activation("relu"),
+                          nn.GlobalAvgPool2D(), nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def _make_layer(self, block, layers, channels, stride, in_channels=0):
+        layer = nn.HybridSequential()
+        layer.add(block(channels, stride, channels != in_channels,
+                        in_channels=in_channels))
+        for _ in range(layers - 1):
+            layer.add(block(channels, 1, False, in_channels=channels))
+        return layer
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+# spec table (ref resnet.py resnet_spec)
+_SPEC = {18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+         34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+         50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+         101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+         152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048])}
+_VERSIONS = [(ResNetV1, BasicBlockV1, BottleneckV1),
+             (ResNetV2, BasicBlockV2, BottleneckV2)]
+
+
+def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
+    if num_layers not in _SPEC:
+        raise MXNetError(f"invalid resnet depth {num_layers}; options {sorted(_SPEC)}")
+    if version not in (1, 2):
+        raise MXNetError("version must be 1 or 2")
+    block_type, layers, channels = _SPEC[num_layers]
+    resnet_class, basic, bottleneck = _VERSIONS[version - 1]
+    block = basic if block_type == "basic_block" else bottleneck
+    net = resnet_class(block, layers, channels, **kwargs)
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable: no network egress; "
+                         "load_parameters from a local file instead")
+    return net
+
+
+def resnet18_v1(**kw):
+    return get_resnet(1, 18, **kw)
+
+
+def resnet34_v1(**kw):
+    return get_resnet(1, 34, **kw)
+
+
+def resnet50_v1(**kw):
+    return get_resnet(1, 50, **kw)
+
+
+def resnet101_v1(**kw):
+    return get_resnet(1, 101, **kw)
+
+
+def resnet152_v1(**kw):
+    return get_resnet(1, 152, **kw)
+
+
+def resnet18_v2(**kw):
+    return get_resnet(2, 18, **kw)
+
+
+def resnet34_v2(**kw):
+    return get_resnet(2, 34, **kw)
+
+
+def resnet50_v2(**kw):
+    return get_resnet(2, 50, **kw)
+
+
+def resnet101_v2(**kw):
+    return get_resnet(2, 101, **kw)
+
+
+def resnet152_v2(**kw):
+    return get_resnet(2, 152, **kw)

@@ -1,0 +1,67 @@
+"""SqueezeNet 1.0/1.1 (ref: python/mxnet/gluon/model_zoo/vision/squeezenet.py)."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ....numpy import concatenate
+from ... import nn
+from ...block import HybridBlock
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class _Fire(HybridBlock):
+    def __init__(self, squeeze, expand1x1, expand3x3, **kw):
+        super().__init__(**kw)
+        self.squeeze = nn.Conv2D(squeeze, 1, activation="relu")
+        self.expand1 = nn.Conv2D(expand1x1, 1, activation="relu")
+        self.expand3 = nn.Conv2D(expand3x3, 3, padding=1, activation="relu")
+
+    def forward(self, x):
+        x = self.squeeze(x)
+        return concatenate([self.expand1(x), self.expand3(x)], axis=1)
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version, classes=1000, **kw):
+        super().__init__(**kw)
+        if version not in ("1.0", "1.1"):
+            raise MXNetError("version must be '1.0' or '1.1'")
+        self.features = nn.HybridSequential()
+        if version == "1.0":
+            self.features.add(nn.Conv2D(96, 7, 2, activation="relu"),
+                              nn.MaxPool2D(3, 2, ceil_mode=True),
+                              _Fire(16, 64, 64), _Fire(16, 64, 64),
+                              _Fire(32, 128, 128),
+                              nn.MaxPool2D(3, 2, ceil_mode=True),
+                              _Fire(32, 128, 128), _Fire(48, 192, 192),
+                              _Fire(48, 192, 192), _Fire(64, 256, 256),
+                              nn.MaxPool2D(3, 2, ceil_mode=True),
+                              _Fire(64, 256, 256))
+        else:
+            self.features.add(nn.Conv2D(64, 3, 2, activation="relu"),
+                              nn.MaxPool2D(3, 2, ceil_mode=True),
+                              _Fire(16, 64, 64), _Fire(16, 64, 64),
+                              nn.MaxPool2D(3, 2, ceil_mode=True),
+                              _Fire(32, 128, 128), _Fire(32, 128, 128),
+                              nn.MaxPool2D(3, 2, ceil_mode=True),
+                              _Fire(48, 192, 192), _Fire(48, 192, 192),
+                              _Fire(64, 256, 256), _Fire(64, 256, 256))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.HybridSequential()
+        self.output.add(nn.Conv2D(classes, 1, activation="relu"),
+                        nn.GlobalAvgPool2D(), nn.Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable: no network egress")
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable: no network egress")
+    return SqueezeNet("1.1", **kw)

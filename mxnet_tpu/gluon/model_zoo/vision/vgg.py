@@ -1,0 +1,76 @@
+"""VGG 11/13/16/19 (+BN variants) (ref: python/mxnet/gluon/model_zoo/vision/vgg.py)."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ... import nn
+from ...block import HybridBlock
+
+__all__ = ["VGG", "get_vgg", "vgg11", "vgg13", "vgg16", "vgg19",
+           "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn"]
+
+_SPEC = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+         13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+         16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+         19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+
+
+class VGG(HybridBlock):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False, **kw):
+        super().__init__(**kw)
+        self.features = nn.HybridSequential()
+        for num, f in zip(layers, filters):
+            for _ in range(num):
+                self.features.add(nn.Conv2D(f, 3, padding=1))
+                if batch_norm:
+                    self.features.add(nn.BatchNorm())
+                self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(2, 2))
+        self.features.add(nn.Flatten(),
+                          nn.Dense(4096, activation="relu"), nn.Dropout(0.5),
+                          nn.Dense(4096, activation="relu"), nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def get_vgg(num_layers, pretrained=False, **kwargs):
+    if num_layers not in _SPEC:
+        raise MXNetError(f"invalid vgg depth {num_layers}")
+    layers, filters = _SPEC[num_layers]
+    net = VGG(layers, filters, **kwargs)
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable: no network egress")
+    return net
+
+
+def vgg11(**kw):
+    return get_vgg(11, **kw)
+
+
+def vgg13(**kw):
+    return get_vgg(13, **kw)
+
+
+def vgg16(**kw):
+    return get_vgg(16, **kw)
+
+
+def vgg19(**kw):
+    return get_vgg(19, **kw)
+
+
+def vgg11_bn(**kw):
+    return get_vgg(11, batch_norm=True, **kw)
+
+
+def vgg13_bn(**kw):
+    return get_vgg(13, batch_norm=True, **kw)
+
+
+def vgg16_bn(**kw):
+    return get_vgg(16, batch_norm=True, **kw)
+
+
+def vgg19_bn(**kw):
+    return get_vgg(19, batch_norm=True, **kw)

@@ -1,0 +1,84 @@
+"""Activation blocks (ref: python/mxnet/gluon/nn/activations.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "Swish", "GELU", "SiLU"]
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self._act_type = activation
+
+    def forward(self, x):
+        return npx.activation(x, act_type=self._act_type)
+
+    def __repr__(self):
+        return f"Activation({self._act_type})"
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="leaky", slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=None, in_channels=1, **kwargs):
+        super().__init__(**kwargs)
+        from ... import initializer as _ini
+
+        self.alpha = Parameter(shape=(in_channels,),
+                               init=alpha_initializer or _ini.Constant(0.25),
+                               name="alpha")
+
+    def forward(self, x):
+        return npx.leaky_relu(x, gamma=self.alpha.data(), act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf", **kwargs):
+        super().__init__(**kwargs)
+        self._approx = approximation
+
+    def forward(self, x):
+        return npx.activation(x, act_type="gelu" if self._approx != "erf" else "erf_gelu")
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._beta = beta
+
+    def forward(self, x):
+        from ...ops.dispatch import call
+        import jax
+
+        return call(lambda a: a * jax.nn.sigmoid(self._beta * a), (x,), {}, name="swish")
+
+
+class SiLU(HybridBlock):
+    def forward(self, x):
+        return npx.activation(x, act_type="silu")

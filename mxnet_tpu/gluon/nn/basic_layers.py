@@ -1,0 +1,363 @@
+"""Basic Gluon layers (ref: python/mxnet/gluon/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ... import numpy_extension as npx
+from ... import numpy as np_mod
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
+           "BatchNorm", "SyncBatchNorm", "LayerNorm", "GroupNorm",
+           "InstanceNorm", "Flatten", "Lambda", "HybridLambda", "Identity",
+           "Concatenate", "HybridConcatenate"]
+
+
+class Sequential(Block):
+    """Stack of blocks (ref basic_layers.py Sequential)."""
+
+    def __init__(self, *blocks):
+        super().__init__()
+        for b in blocks:
+            self.add(b)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x, *args)
+            args = ()
+        return x
+
+    def __getitem__(self, key):
+        vals = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*vals[key])
+            return net
+        return vals[key]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def hybridize(self, active=True, **kwargs):
+        for c in self._children.values():
+            c.hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    """Hybridizable stack — jits as one XLA computation when hybridized."""
+
+    def __init__(self, *blocks):
+        super().__init__()
+        for b in blocks:
+            self.add(b)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x, *args)
+            args = ()
+        return x
+
+    def __getitem__(self, key):
+        vals = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*vals[key])
+            return net
+        return vals[key]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (ref basic_layers.py Dense →
+    npx.fully_connected, src/operator/nn/fully_connected.cc)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype=jnp.float32, weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self._act = activation
+        self.weight = Parameter(shape=(units, in_units), dtype=dtype,
+                                init=weight_initializer,
+                                allow_deferred_init=True, name="weight")
+        if use_bias:
+            self.bias = Parameter(shape=(units,), dtype=dtype,
+                                  init=bias_initializer,
+                                  allow_deferred_init=True, name="bias")
+        else:
+            self.bias = None
+
+    def infer_shape(self, x, *args):
+        in_units = x.size // x.shape[0] if self._flatten else x.shape[-1]
+        self.weight.shape = (self._units, in_units)
+        if self.bias is not None:
+            self.bias.shape = (self._units,)
+
+    def forward(self, x):
+        out = npx.fully_connected(x, self.weight.data(),
+                                  self.bias.data() if self.bias is not None else None,
+                                  num_hidden=self._units,
+                                  no_bias=self.bias is None,
+                                  flatten=self._flatten)
+        if self._act is not None:
+            out = npx.activation(out, act_type=self._act)
+        return out
+
+    def __repr__(self):
+        return f"Dense({self._units}, act={self._act})"
+
+
+class Dropout(HybridBlock):
+    """Ref basic_layers.py Dropout → npx.dropout."""
+
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        return npx.dropout(x, p=self._rate, axes=self._axes)
+
+    def __repr__(self):
+        return f"Dropout(p={self._rate})"
+
+
+class Embedding(HybridBlock):
+    """Ref basic_layers.py Embedding → npx.embedding."""
+
+    def __init__(self, input_dim, output_dim, dtype=jnp.float32,
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter(shape=(input_dim, output_dim), dtype=dtype,
+                                init=weight_initializer, name="weight")
+
+    def forward(self, x):
+        return npx.embedding(x, self.weight.data(), input_dim=self._input_dim,
+                             output_dim=self._output_dim)
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class BatchNorm(HybridBlock):
+    """Ref basic_layers.py BatchNorm → npx.batch_norm
+    (src/operator/nn/batch_norm.cc). Moving stats are non-differentiable
+    parameters mutated in place during training forward."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        shape = (in_channels,)
+        self.gamma = Parameter(shape=shape, init=gamma_initializer,
+                               allow_deferred_init=True,
+                               differentiable=scale, name="gamma")
+        self.beta = Parameter(shape=shape, init=beta_initializer,
+                              allow_deferred_init=True,
+                              differentiable=center, name="beta")
+        self.running_mean = Parameter(shape=shape, init=running_mean_initializer,
+                                      allow_deferred_init=True,
+                                      differentiable=False, name="running_mean")
+        self.running_var = Parameter(shape=shape, init=running_variance_initializer,
+                                     allow_deferred_init=True,
+                                     differentiable=False, name="running_var")
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p.shape = (c,)
+
+    def forward(self, x):
+        return npx.batch_norm(x, self.gamma.data(), self.beta.data(),
+                              self.running_mean.data(), self.running_var.data(),
+                              eps=self._epsilon, momentum=self._momentum,
+                              fix_gamma=not self._scale,
+                              use_global_stats=self._use_global_stats,
+                              axis=self._axis)
+
+    def __repr__(self):
+        return f"BatchNorm(axis={self._axis})"
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BN (ref contrib SyncBatchNorm, src/operator/contrib/
+    sync_batch_norm.cc). Under pjit/shard_map the batch axis is already
+    global — XLA computes global batch statistics — so this is BatchNorm;
+    kept as a distinct class for API parity."""
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        kwargs.pop("ndev", None)
+        super().__init__(in_channels=in_channels, **kwargs)
+
+
+class LayerNorm(HybridBlock):
+    """Ref basic_layers.py LayerNorm → npx.layer_norm."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = Parameter(shape=(in_channels,), init=gamma_initializer,
+                               allow_deferred_init=True, differentiable=scale,
+                               name="gamma")
+        self.beta = Parameter(shape=(in_channels,), init=beta_initializer,
+                              allow_deferred_init=True, differentiable=center,
+                              name="beta")
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return npx.layer_norm(x, self.gamma.data(), self.beta.data(),
+                              axis=self._axis, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    """Ref basic_layers.py GroupNorm → npx.group_norm."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.gamma = Parameter(shape=(in_channels,), init=gamma_initializer,
+                               allow_deferred_init=True, differentiable=scale,
+                               name="gamma")
+        self.beta = Parameter(shape=(in_channels,), init=beta_initializer,
+                              allow_deferred_init=True, differentiable=center,
+                              name="beta")
+
+    def infer_shape(self, x, *args):
+        c = x.shape[1]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return npx.group_norm(x, self.gamma.data(), self.beta.data(),
+                              num_groups=self._num_groups, eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    """Ref basic_layers.py InstanceNorm → npx.instance_norm."""
+
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._epsilon = epsilon
+        self.gamma = Parameter(shape=(in_channels,), init=gamma_initializer,
+                               allow_deferred_init=True, differentiable=scale,
+                               name="gamma")
+        self.beta = Parameter(shape=(in_channels,), init=beta_initializer,
+                              allow_deferred_init=True, differentiable=center,
+                              name="beta")
+
+    def infer_shape(self, x, *args):
+        c = x.shape[1]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return npx.instance_norm(x, self.gamma.data(), self.beta.data(),
+                                 eps=self._epsilon)
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def __repr__(self):
+        return "Flatten()"
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Lambda(Block):
+    """Wrap a function as a Block (ref basic_layers.py Lambda)."""
+
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            fn = getattr(np_mod, function, None) or getattr(npx, function, None)
+            if fn is None:
+                raise MXNetError(f"unknown function name '{function}' for Lambda")
+            function = fn
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            fn = getattr(np_mod, function, None) or getattr(npx, function, None)
+            if fn is None:
+                raise MXNetError(f"unknown function name '{function}' for HybridLambda")
+            function = fn
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class Concatenate(Sequential):
+    """Run children on same input, concat outputs (ref nn.HybridConcatenate)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        outs = [b(x) for b in self._children.values()]
+        return np_mod.concatenate(outs, axis=self.axis)
+
+
+class HybridConcatenate(HybridSequential):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        outs = [b(x) for b in self._children.values()]
+        return np_mod.concatenate(outs, axis=self.axis)

@@ -1,0 +1,256 @@
+"""Convolution & pooling Gluon layers (ref: python/mxnet/gluon/nn/conv_layers.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _Conv(HybridBlock):
+    """Shared conv machinery (ref conv_layers.py _Conv →
+    src/operator/nn/convolution.cc). Weight layout OIHW like the reference."""
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, in_channels, activation, use_bias,
+                 weight_initializer, bias_initializer, ndim,
+                 transpose=False, output_padding=0, **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = _tup(kernel_size, ndim)
+        self._strides = _tup(strides, ndim)
+        self._padding = _tup(padding, ndim)
+        self._dilation = _tup(dilation, ndim)
+        self._groups = groups
+        self._act = activation
+        self._ndim = ndim
+        self._transpose = transpose
+        self._output_padding = _tup(output_padding, ndim)
+        if transpose:
+            wshape = (in_channels, channels // groups) + self._kernel
+        else:
+            wshape = (channels, in_channels // groups if in_channels else 0) + self._kernel
+        self.weight = Parameter(shape=wshape, init=weight_initializer,
+                                allow_deferred_init=True, name="weight")
+        self.bias = Parameter(shape=(channels,), init=bias_initializer,
+                              allow_deferred_init=True, name="bias") if use_bias else None
+
+    def infer_shape(self, x, *args):
+        c_in = x.shape[1]
+        if self._transpose:
+            self.weight.shape = (c_in, self._channels // self._groups) + self._kernel
+        else:
+            self.weight.shape = (self._channels, c_in // self._groups) + self._kernel
+        if self.bias is not None:
+            self.bias.shape = (self._channels,)
+
+    def forward(self, x):
+        b = self.bias.data() if self.bias is not None else None
+        if self._transpose:
+            out = npx.deconvolution(x, self.weight.data(), b,
+                                    kernel=self._kernel, stride=self._strides,
+                                    dilate=self._dilation, pad=self._padding,
+                                    adj=self._output_padding,
+                                    num_filter=self._channels,
+                                    num_group=self._groups,
+                                    no_bias=self.bias is None)
+        else:
+            out = npx.convolution(x, self.weight.data(), b,
+                                  kernel=self._kernel, stride=self._strides,
+                                  dilate=self._dilation, pad=self._padding,
+                                  num_filter=self._channels,
+                                  num_group=self._groups,
+                                  no_bias=self.bias is None)
+        if self._act is not None:
+            out = npx.activation(out, act_type=self._act)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._channels}, kernel={self._kernel}, "
+                f"stride={self._strides})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, 1, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, 2, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, 3, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, 1,
+                         transpose=True, output_padding=output_padding, **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, 2,
+                         transpose=True, output_padding=output_padding, **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, 3,
+                         transpose=True, output_padding=output_padding, **kwargs)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, ndim, count_include_pad=True, **kwargs):
+        super().__init__(**kwargs)
+        self._kernel = _tup(pool_size, ndim)
+        self._stride = _tup(strides if strides is not None else pool_size, ndim)
+        self._pad = _tup(padding, ndim)
+        self._global = global_pool
+        self._type = pool_type
+        self._convention = "full" if ceil_mode else "valid"
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x):
+        return npx.pooling(x, kernel=self._kernel, pool_type=self._type,
+                           stride=self._stride, pad=self._pad,
+                           global_pool=self._global,
+                           count_include_pad=self._count_include_pad,
+                           pooling_convention=self._convention)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(size={self._kernel}, stride={self._stride})"
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max", 1, **kwargs)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max", 2, **kwargs)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max", 3, **kwargs)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg", 1,
+                         count_include_pad, **kwargs)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg", 2,
+                         count_include_pad, **kwargs)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg", 3,
+                         count_include_pad, **kwargs)
+
+
+class GlobalMaxPool1D(_Pool):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__(1, None, 0, False, True, "max", 1, **kwargs)
+
+
+class GlobalMaxPool2D(_Pool):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__(1, None, 0, False, True, "max", 2, **kwargs)
+
+
+class GlobalMaxPool3D(_Pool):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__(1, None, 0, False, True, "max", 3, **kwargs)
+
+
+class GlobalAvgPool1D(_Pool):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__(1, None, 0, False, True, "avg", 1, **kwargs)
+
+
+class GlobalAvgPool2D(_Pool):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__(1, None, 0, False, True, "avg", 2, **kwargs)
+
+
+class GlobalAvgPool3D(_Pool):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__(1, None, 0, False, True, "avg", 3, **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Ref conv_layers.py ReflectionPad2D → pad op."""
+
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        self._padding = _tup(padding, 4) if not isinstance(padding, int) else (padding,) * 4
+
+    def forward(self, x):
+        from ...ops.dispatch import call
+
+        pl, pr, pt, pb = self._padding
+        return call(lambda a: jnp.pad(a, ((0, 0), (0, 0), (pt, pb), (pl, pr)),
+                                      mode="reflect"), (x,), {}, name="reflection_pad")

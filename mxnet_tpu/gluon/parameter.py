@@ -1,0 +1,221 @@
+"""gluon.Parameter — deferred-init parameter handle.
+
+Ref: python/mxnet/gluon/parameter.py:47 (Parameter), :711 (Constant).
+Same lifecycle: construct with possibly-unknown shape (0 = unknown dim),
+``initialize()`` defers until shapes are known (layers call
+``infer_shape`` at first forward), ``data()`` raises
+DeferredInitializationError until then. TPU-native simplification: one
+logical copy of the data — multi-device replication/sharding is carried by
+jax.sharding on the underlying array, not per-ctx replicas, so
+``list_data()`` has one entry (the reference's per-GPU copies are an NCCL-ism).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import DeferredInitializationError, MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray
+from .. import initializer as _init_mod
+
+__all__ = ["Parameter", "Constant"]
+
+
+class Parameter:
+    def __init__(self, shape=None, dtype=jnp.float32, initializer=None,
+                 lr_mult: float = 1.0, wd_mult: float = 1.0,
+                 grad_req: str = "write", allow_deferred_init: bool = False,
+                 differentiable: bool = True, stype: str = "default",
+                 grad_stype: str = "default", init=None, name: str = "weight"):
+        self._name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = jnp.dtype(dtype) if dtype is not None else jnp.float32
+        self.init = init if init is not None else initializer
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self._grad_req = grad_req if differentiable else "null"
+        self._allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._data: Optional[NDArray] = None
+        self._deferred_init = None   # (init, ctx, default_init)
+        self._ctx: Optional[Context] = None
+        self._structure_name = None  # set by Block registration
+
+    # -- naming -------------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._structure_name or self._name
+
+    def __repr__(self):
+        return f"Parameter({self.name}, shape={self._shape}, dtype={self.dtype})"
+
+    # -- shape --------------------------------------------------------------
+    @property
+    def shape(self) -> Optional[Tuple[int, ...]]:
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        unknown_ok = all(
+            s1 in (0, -1) or s1 == s2 for s1, s2 in zip(self._shape, new_shape)
+        ) and len(self._shape) == len(new_shape)
+        if not unknown_ok:
+            raise MXNetError(
+                f"Expected shape {new_shape} is incompatible with given shape {self._shape}")
+        self._shape = tuple(new_shape)
+
+    def _shape_known(self) -> bool:
+        return self._shape is not None and all(s > 0 for s in self._shape)
+
+    # -- grad_req -----------------------------------------------------------
+    @property
+    def grad_req(self) -> str:
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req: str):
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"grad_req must be write/add/null, got {req}")
+        if not self._differentiable:
+            req = "null"
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = None
+            else:
+                self._data.attach_grad(req)
+
+    # -- initialization -----------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit: bool = False, device=None):
+        """Ref parameter.py Parameter.initialize. Defers when shape unknown."""
+        if default_init is None:
+            default_init = _init_mod.Uniform()
+        ctx = ctx or device
+        if self._data is not None and not force_reinit:
+            return
+        if not self._shape_known():
+            if self._allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                f"Cannot initialize Parameter '{self.name}' because it has "
+                f"invalid shape {self._shape} and deferred init is not allowed")
+        self._init_impl(init, ctx, default_init)
+
+    def _init_impl(self, init, ctx, default_init):
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0] if ctx else None
+        self._ctx = ctx or current_context()
+        arr = NDArray(jnp.zeros(self._shape, self.dtype), ctx=self._ctx)
+        ini = init if init is not None else (self.init if self.init is not None else default_init)
+        if isinstance(ini, str):
+            ini = _init_mod.create(ini)
+        ini(_init_mod.InitDesc(self.name), arr)
+        if arr._data.dtype != self.dtype:
+            arr._set_data(arr._data.astype(self.dtype))
+        self._data = arr
+        self._deferred_init = None
+        if self._grad_req != "null":
+            arr.attach_grad(self._grad_req)
+
+    def _finish_deferred_init(self):
+        """Complete a deferred init once layers set the full shape
+        (ref parameter.py:336)."""
+        if self._deferred_init is None:
+            return
+        if not self._shape_known():
+            raise DeferredInitializationError(
+                f"Parameter '{self.name}' shape still unknown: {self._shape}")
+        init, ctx, default_init = self._deferred_init
+        self._init_impl(init, ctx, default_init)
+
+    # -- access -------------------------------------------------------------
+    def data(self, ctx=None) -> NDArray:
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"Parameter '{self.name}' has not been initialized yet because "
+                    "initialization was deferred. Actual initialization happens "
+                    "during the first forward pass.")
+            raise MXNetError(
+                f"Parameter '{self.name}' has not been initialized. You should "
+                "initialize parameters with Block.initialize().")
+        return self._data
+
+    def list_data(self) -> List[NDArray]:
+        return [self.data()]
+
+    def grad(self, ctx=None) -> NDArray:
+        d = self.data()
+        if d._grad is None:
+            raise MXNetError(
+                f"Cannot get gradient array for Parameter '{self.name}' "
+                "because grad_req='null'")
+        return d._grad
+
+    def list_grad(self) -> List[NDArray]:
+        return [self.grad()]
+
+    def list_ctx(self):
+        return [self._ctx or current_context()]
+
+    def set_data(self, data):
+        if isinstance(data, NDArray):
+            data = data._data
+        else:
+            data = jnp.asarray(data)
+        if self._data is None:
+            self.shape = data.shape
+            if self._deferred_init is not None:
+                self._finish_deferred_init()
+            else:
+                self.initialize(init=_init_mod.Constant(NDArray(data)))
+        self._data._set_data(data.astype(self.dtype))
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            self._data.zero_grad()
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx if not isinstance(ctx, (list, tuple)) else ctx[0])
+            self._ctx = ctx if not isinstance(ctx, (list, tuple)) else ctx[0]
+
+    reset_device = reset_ctx
+
+    def cast(self, dtype):
+        self.dtype = jnp.dtype(dtype)
+        if self._data is not None:
+            had_grad = self._data._grad is not None
+            self._data._set_data(self._data._data.astype(self.dtype))
+            if had_grad:
+                self._data.attach_grad(self._grad_req)
+
+    # -- misc ---------------------------------------------------------------
+    def var(self):
+        """Legacy symbolic var handle — returns self (symbol layer is unified)."""
+        return self
+
+
+class Constant(Parameter):
+    """Non-trainable constant (ref parameter.py:711)."""
+
+    def __init__(self, value, name: str = "const"):
+        if not isinstance(value, NDArray):
+            value = NDArray(jnp.asarray(value))
+        self._value = value
+        super().__init__(shape=value.shape, dtype=value._data.dtype,
+                         init=_init_mod.Constant(value), grad_req="null",
+                         differentiable=False, name=name)
+
+    @property
+    def value(self):
+        return self._value

@@ -1,0 +1,92 @@
+"""Model export / import — the deploy format.
+
+Ref: HybridBlock.export (block.py:1514) writes symbol-json + params;
+SymbolBlock.imports (block.py:1716) reloads for inference. TPU-native
+equivalent: serialize the jitted forward as **StableHLO** via jax.export
+(portable, runnable without the Python model class) next to a params file.
+Files written: ``{path}-symbol.stablehlo`` and ``{path}-{epoch:04d}.params``.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ndarray.utils import load as nd_load, save as nd_save
+from .. import autograd as _autograd
+
+__all__ = ["export_hybrid", "import_exported"]
+
+
+def export_hybrid(block, path: str, epoch: int = 0):
+    """Serialize block's inference graph (StableHLO) + parameters."""
+    spec = getattr(block, "_last_args_spec", None)
+    if spec is None:
+        raise MXNetError(
+            "export requires the block to have been called at least once "
+            "(shapes are taken from the last forward)")
+
+    params = {name: p for name, p in block.collect_params().items()
+              if p._data is not None}
+    names = sorted(params)
+    pvals = [params[n].data()._data for n in names]
+
+    def fn(pv, *xs):
+        saved = [(params[n].data(), params[n].data()._data) for n in names]
+        try:
+            with _autograd.pause(train_mode=False):
+                for (arr, _), v in zip(saved, pv):
+                    arr._data = v
+                out = block.forward(*[NDArray(x) for x in xs])
+            if isinstance(out, NDArray):
+                return out._data
+            return tuple(o._data if isinstance(o, NDArray) else o for o in out)
+        finally:
+            for arr, v in saved:
+                arr._data = v
+
+    example = [jax.ShapeDtypeStruct(s, d) for (s, d) in spec]
+    exported = jax.export.export(jax.jit(fn))(
+        [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in pvals], *example)
+    blob = exported.serialize()
+
+    sym_file = f"{path}-symbol.stablehlo"
+    param_file = f"{path}-{epoch:04d}.params"
+    with open(sym_file, "wb") as f:
+        f.write(blob)
+    nd_save(param_file, {n: NDArray(v) for n, v in zip(names, pvals)})
+    with open(f"{path}-meta.json", "w") as f:
+        json.dump({"param_names": names,
+                   "input_specs": [[list(s), str(jnp.dtype(d))] for s, d in spec]}, f)
+    return sym_file, param_file
+
+
+def import_exported(symbol_file: str, param_file: Optional[str] = None, ctx=None):
+    """Rebuild a runnable block from exported artifacts."""
+    from .block import SymbolBlock
+
+    base = symbol_file.replace("-symbol.stablehlo", "")
+    with open(symbol_file, "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    if param_file is None:
+        cand = [p for p in os.listdir(os.path.dirname(base) or ".")
+                if p.startswith(os.path.basename(base)) and p.endswith(".params")]
+        if not cand:
+            raise MXNetError("no params file found next to symbol file")
+        param_file = os.path.join(os.path.dirname(base) or ".", sorted(cand)[-1])
+    with open(base + "-meta.json") as f:
+        meta = json.load(f)
+    params = nd_load(param_file)
+    pvals = [params[n]._data for n in meta["param_names"]]
+
+    def runner(*xs):
+        return exported.call(pvals, *xs)
+
+    blk = SymbolBlock(outputs=runner)
+    blk._imported_params = params
+    return blk

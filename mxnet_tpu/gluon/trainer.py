@@ -1,0 +1,142 @@
+"""gluon.Trainer — the training-loop integration point.
+
+Ref: python/mxnet/gluon/trainer.py (541 LoC): _init_kvstore decision table
+(:188-277), step = allreduce_grads + update (:334,363,411). TPU-native
+differences (SURVEY.md §2.3): there is no parameter server and no
+update-on-kvstore optimizer placement for dist — gradients are already
+globally reduced either trivially (single chip) or by psum inside the
+parallel train step; the kvstore object carries the API (and single-host
+multi-copy reduction for compat). rescale_grad is adjusted by the number of
+workers like the reference.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from ..kvstore import KVStoreBase, create as kv_create
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="tpu", compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)):
+            param_list = [params[k] for k in sorted(params.keys())]
+        elif isinstance(params, (list, tuple)):
+            param_list = list(params)
+        else:
+            raise MXNetError("params must be dict or list of Parameters")
+        self._params: List[Parameter] = []
+        self._param2idx: Dict[str, int] = {}
+        for i, p in enumerate(param_list):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"Trainer expects Parameters, got {type(p)}")
+            self._param2idx[p.name or str(i)] = i
+            self._params.append(p)
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_name = kvstore
+        self._kvstore: Optional[KVStoreBase] = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore if update_on_kvstore is not None else False
+        self._states_to_init = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params:
+                raise MXNetError(
+                    "optimizer_params must be None if optimizer is an Optimizer "
+                    "instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                             **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    # -- kvstore ------------------------------------------------------------
+    def _init_kvstore(self):
+        """Ref trainer.py:188-277, minus PS modes: on TPU the reduction is
+        either a no-op (one logical copy) or handled by psum in parallel
+        train steps; dist modes set rescale by worker count."""
+        if self._kv_name is None or self._kv_name is False:
+            self._kvstore = None
+        else:
+            kv = self._kv_name if isinstance(self._kv_name, KVStoreBase) else \
+                kv_create(self._kv_name)
+            self._kvstore = kv
+            nw = kv.num_workers
+            if nw > 1:
+                self._optimizer.rescale_grad = self._scale / nw
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    # -- the step -----------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + update (ref trainer.py:334)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """Ref trainer.py:363. With one logical copy per param this is a
+        no-op; kvstore pushpull is invoked when a param has device replicas
+        (API-compat path)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            grads = p.list_grad()
+            if len(grads) > 1:
+                self._kvstore.pushpull(i, grads, out=grads)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Ref trainer.py:411 — local fused updates."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            updater(i, p.grad(), p.data())
+
+    def zero_grad(self):
+        for p in self._params:
+            p.zero_grad()
+
+    # -- state persistence (ref trainer.py:482,511) -------------------------
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "rb") as f:
+            self._updaters[0].set_states(f.read())

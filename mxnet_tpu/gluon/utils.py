@@ -1,0 +1,83 @@
+"""Gluon utilities (ref: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    """Ref utils.py split_data."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        lo = i * step
+        hi = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(lo, hi)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Ref utils.py split_and_load. On TPU one logical array is usually
+    sharded by the mesh instead; this keeps the multi-ctx API working."""
+    if not isinstance(data, NDArray):
+        data = NDArray(jnp.asarray(data))
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm: float,
+                     check_isfinite: bool = True) -> float:
+    """Ref utils.py clip_global_norm."""
+    if not arrays:
+        raise MXNetError("arrays must not be empty")
+    total = float(jnp.sqrt(sum(jnp.sum(jnp.square(a._data)) for a in arrays)))
+    if check_isfinite and not math.isfinite(total):
+        import warnings
+
+        warnings.warn("nan or inf is detected. Clipping results will be undefined.")
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data(a._data * scale)
+    return total
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    import hashlib
+
+    h = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Kept for API parity; this environment has no egress, so only local
+    file:// copies succeed."""
+    raise MXNetError(
+        "download() is unavailable: the build environment has no network "
+        "egress. Provide files locally.")

@@ -1,0 +1,224 @@
+"""Weight initializers (ref: python/mxnet/initializer.py).
+
+Same registry + class surface (Zero/One/Constant/Uniform/Normal/Orthogonal/
+Xavier/MSRAPrelu/Bilinear/LSTMBias); draws use the global JAX key. An
+Initializer is called with (name, array) like the reference's
+InitDesc-driven dispatch, or via init_array(shape) functionally.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from .base import MXNetError, Registry
+from .ndarray.ndarray import NDArray
+from .random import next_key
+
+_REG: Registry = Registry("initializer")
+register = _REG.register
+alias = register
+
+
+class Initializer:
+    """Base initializer; subclasses implement _init_weight(name, arr)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr: NDArray):
+        self.init(name, arr)
+
+    def init(self, name, arr: NDArray):
+        name = (name or "").lower()
+        if name.endswith("bias") or name.endswith("beta") or name.endswith("running_mean") \
+                or name.endswith("moving_mean"):
+            arr._set_data(jnp.zeros_like(arr._data))
+        elif name.endswith("gamma") or name.endswith("running_var") or name.endswith("moving_var"):
+            arr._set_data(jnp.ones_like(arr._data))
+        else:
+            self._init_weight(name, arr)
+
+    def _init_weight(self, name, arr: NDArray):
+        raise NotImplementedError
+
+    def _fill(self, arr: NDArray, data):
+        arr._set_data(jnp.asarray(data, dtype=arr._data.dtype))
+
+    def __repr__(self):
+        kw = ", ".join(f"{k}={v}" for k, v in self._kwargs.items())
+        return f"{type(self).__name__}({kw})"
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+
+@register("zeros")
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._fill(arr, jnp.zeros(arr.shape))
+
+
+@register("ones")
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._fill(arr, jnp.ones(arr.shape))
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        v = self.value
+        if isinstance(v, NDArray):
+            v = v._data
+        self._fill(arr, jnp.broadcast_to(jnp.asarray(v), arr.shape))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        self._fill(arr, jax.random.uniform(next_key(), arr.shape,
+                                           minval=-self.scale, maxval=self.scale))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        self._fill(arr, jax.random.normal(next_key(), arr.shape) * self.sigma)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        if len(arr.shape) < 2:
+            self._fill(arr, jax.random.normal(next_key(), arr.shape) * 0.01)
+            return
+        self._fill(arr, jax.nn.initializers.orthogonal(self.scale)(
+            next_key(), arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    """Ref initializer.py Xavier: magnitude scaled by fan in/out/avg."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        if len(shape) < 2:
+            self._fill(arr, jax.random.normal(next_key(), shape) * 0.01)
+            return
+        hw_scale = 1.0
+        for d in shape[2:]:
+            hw_scale *= d
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"bad factor_type {self.factor_type}")
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            self._fill(arr, jax.random.uniform(next_key(), shape, minval=-scale, maxval=scale))
+        else:
+            self._fill(arr, jax.random.normal(next_key(), shape) * scale)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Deconv upsampling kernels (ref initializer.py Bilinear)."""
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        weight = _onp.zeros(int(_onp.prod(shape)), dtype=_onp.float32)
+        f = _onp.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(weight.size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._fill(arr, weight.reshape(shape))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias 1.0 (ref initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = _onp.zeros(arr.shape, dtype=_onp.float32)
+        n = arr.shape[0] // 4
+        b[n:2 * n] = self.forget_bias
+        self._fill(arr, b)
+
+
+class Mixed:
+    """Pattern-routed initializer (ref initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for pat, ini in self.map:
+            if pat.match(name):
+                ini(name, arr)
+                return
+        raise MXNetError(f"Parameter {name} did not match any pattern")
+
+
+class InitDesc(str):
+    """Name-with-attrs descriptor (ref initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+def create(name, **kwargs) -> Initializer:
+    if isinstance(name, Initializer):
+        return name
+    return _REG.get(name)(**kwargs)

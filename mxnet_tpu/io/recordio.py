@@ -1,0 +1,191 @@
+"""RecordIO container format (ref: python/mxnet/recordio.py + dmlc-core
+recordio; packed by tools/im2rec).
+
+Binary format preserved from the reference so existing .rec datasets load:
+each record = [magic:u32][lrecord:u32][data][pad to 4B], magic=0xced7230a,
+lrecord upper 3 bits = continuation flag (cflag), lower 29 = length.
+A C++ reader with the same framing lives in src/recordio.cc (native path).
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, List, Optional
+
+import numpy as _onp
+
+from ..base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+_LENGTH_MASK = (1 << 29) - 1
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (ref recordio.py MXRecordIO)."""
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self._fp = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self._fp = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError(f"Invalid flag {self.flag}")
+
+    def close(self):
+        if self._fp is not None:
+            self._fp.close()
+            self._fp = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self) -> int:
+        return self._fp.tell()
+
+    def write(self, buf: bytes):
+        if not self.writable:
+            raise MXNetError("RecordIO not opened for writing")
+        header = struct.pack("<II", _MAGIC, len(buf) & _LENGTH_MASK)
+        self._fp.write(header)
+        self._fp.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self._fp.write(b"\x00" * pad)
+
+    def read(self) -> Optional[bytes]:
+        if self.writable:
+            raise MXNetError("RecordIO not opened for reading")
+        header = self._fp.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError(f"Invalid magic {magic:#x} in {self.uri}")
+        length = lrec & _LENGTH_MASK
+        data = self._fp.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self._fp.read(pad)
+        return data
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Keyed random access via sidecar .idx (ref recordio.py MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str, key_type=int):
+        self.idx_path = idx_path
+        self.idx: Dict = {}
+        self.keys: List = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    key, pos = line.strip().split("\t")
+                    key = key_type(key)
+                    self.idx[key] = int(pos)
+                    self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.idx:
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def seek(self, idx):
+        self._fp.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf: bytes):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+class IRHeader:
+    """Image record header (ref recordio.py IRHeader namedtuple)."""
+
+    __slots__ = ("flag", "label", "id", "id2")
+
+    def __init__(self, flag, label, id, id2):
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+    def __iter__(self):
+        return iter((self.flag, self.label, self.id, self.id2))
+
+
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Ref recordio.py pack: header (+multi-label) + payload."""
+    flag, label, id_, id2 = header
+    label = _onp.asarray(label, dtype=_onp.float32)
+    if label.ndim == 0:
+        hdr = struct.pack(_IR_FORMAT, 0, float(label), int(id_), int(id2))
+        return hdr + s
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, int(id_), int(id2))
+    return hdr + label.tobytes() + s
+
+
+def unpack(s: bytes):
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    payload = s[_IR_SIZE:]
+    if flag > 0:
+        label = _onp.frombuffer(payload[:flag * 4], dtype=_onp.float32)
+        payload = payload[flag * 4:]
+    header = IRHeader(flag, label, id_, id2)
+    return header, payload
+
+
+def pack_img(header: IRHeader, img: _onp.ndarray, quality: int = 95,
+             img_fmt: str = ".npy") -> bytes:
+    """Pack a raw image array. The reference encodes JPEG via OpenCV; with
+    no cv2 in this environment arrays are stored as .npy payloads (fmt tag
+    kept for API parity)."""
+    import io as _io
+
+    buf = _io.BytesIO()
+    _onp.save(buf, _onp.asarray(img))
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s: bytes):
+    import io as _io
+
+    header, payload = unpack(s)
+    img = _onp.load(_io.BytesIO(payload), allow_pickle=False)
+    return header, img

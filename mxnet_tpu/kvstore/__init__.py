@@ -1,0 +1,202 @@
+"""KVStore — the distributed-communication compatibility surface.
+
+Reference architecture (SURVEY.md §2.3): local/device comm trees, NCCL,
+ps-lite parameter server (src/kvstore/). TPU-native stance: ALL transports
+collapse into XLA collectives — single-host reduction is a fused jnp sum
+(PJRT handles device placement), multi-host rides jax.distributed + psum
+over ICI/DCN inside the parallel module's shard_map step. What remains here
+is the *API*: the KVStoreBase plugin registry (ref python/mxnet/kvstore/
+base.py:74,220,245) with broadcast/pushpull capability probes, so Gluon
+Trainer code keeps working unchanged; 'tpu' is the default backend the way
+'device' was the reference's.
+
+The optimizer-on-kvstore mode (ref kvstore_dist_server.h) is supported via
+set_optimizer/Updater like the reference's update_on_kvstore path.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, Registry
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["KVStoreBase", "KVStore", "TPUKVStore", "create"]
+
+_REG: Registry = Registry("kvstore")
+
+
+class KVStoreBase:
+    """Plugin base (ref python/mxnet/kvstore/base.py:74). Backends implement
+    broadcast + pushpull; capability probes mirror the reference."""
+
+    OPTIMIZER = "optimizer"
+    CAPABILITIES = ["optimizer"]
+
+    @staticmethod
+    def register(klass):
+        _REG.register(klass.__name__.lower(), klass)
+        return klass
+
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        raise NotImplementedError
+
+    @property
+    def type(self) -> str:
+        return type(self).__name__.lower()
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+
+def _as_list(v):
+    return v if isinstance(v, (list, tuple)) else [v]
+
+
+@KVStoreBase.register
+class KVStore(KVStoreBase):
+    """Single-process store covering the reference's 'local'/'device' modes
+    (src/kvstore/kvstore_local.h:122-240): push sums per-key values, pull
+    broadcasts; optional optimizer-on-store (set_optimizer + Updater)."""
+
+    def __init__(self, name: str = "device"):
+        self._name = name
+        self._store: Dict[Any, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+
+    # -- modern API ---------------------------------------------------------
+    def broadcast(self, key, value, out, priority=0):
+        vals = _as_list(value)
+        src = vals[0]
+        self._store[key] = NDArray(src._data)
+        for o in _as_list(out):
+            o._set_data(jax.device_put(src._data, o.ctx.jax_device()))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        vals = _as_list(value)
+        if len(vals) == 1:
+            reduced = vals[0]._data
+        else:
+            reduced = jnp.sum(jnp.stack([v._data for v in vals]), axis=0)
+        if self._updater is not None:
+            if key not in self._store:
+                raise MXNetError(f"key {key} must be init'd (broadcast) before pushpull")
+            self._updater(key, NDArray(reduced), self._store[key])
+            result = self._store[key]._data
+        else:
+            result = reduced
+        if out is not None:
+            for o in _as_list(out):
+                o._set_data(jax.device_put(result, o.ctx.jax_device()).astype(o._data.dtype))
+        else:
+            for v in vals:
+                v._set_data(jax.device_put(result, v.ctx.jax_device()))
+
+    # -- legacy API (ref include/mxnet/kvstore.h init/push/pull) ------------
+    def init(self, key, value):
+        keys, vals = (key, value) if isinstance(key, (list, tuple)) else ([key], [value])
+        for k, v in zip(keys, vals):
+            self._store[k] = NDArray(v._data)
+
+    def push(self, key, value, priority=0):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        vals = value if isinstance(key, (list, tuple)) else [value]
+        for k, v in zip(keys, vals):
+            vs = _as_list(v)
+            reduced = vs[0]._data if len(vs) == 1 else \
+                jnp.sum(jnp.stack([x._data for x in vs]), axis=0)
+            if self._updater is not None:
+                self._updater(k, NDArray(reduced), self._store[k])
+            else:
+                self._store[k]._set_data(self._store[k]._data + reduced)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        outs = out if isinstance(key, (list, tuple)) else [out]
+        for k, o in zip(keys, outs):
+            for oo in _as_list(o):
+                oo._set_data(jax.device_put(self._store[k]._data, oo.ctx.jax_device()))
+
+    # -- optimizer-on-store -------------------------------------------------
+    def set_optimizer(self, optimizer):
+        from ..optimizer import Updater
+
+        self._optimizer = optimizer
+        self._updater = Updater(optimizer)
+
+    set_updater = None  # legacy name assigned below
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return capability.lower() in KVStoreBase.CAPABILITIES
+
+    @property
+    def type(self):
+        return self._name
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("kvstore has no optimizer")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("kvstore has no optimizer")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+KVStore.set_updater = KVStore._set_updater
+
+
+@KVStoreBase.register
+class TPUKVStore(KVStore):
+    """Default backend: single-host reduction now; across hosts the gradient
+    allreduce rides the shard_map psum in parallel.train_step (ICI/DCN) —
+    this object then only carries optimizer state + API compat, exactly how
+    the reference's Horovod plugin delegates comm (kvstore/horovod.py:26)."""
+
+    def __init__(self, name: str = "tpu"):
+        super().__init__(name)
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count()
+
+
+def create(name: str = "tpu") -> KVStoreBase:
+    """Factory (ref src/kvstore/kvstore.cc:42-85). Accepts reference names:
+    local/device → KVStore; tpu/dist/dist_sync/dist_device_sync/dist_tpu →
+    TPUKVStore; horovod/byteps raise with guidance."""
+    name = name.lower()
+    if name in ("local", "device", "nccl"):
+        return KVStore(name)
+    if name in ("tpu", "dist_tpu", "dist", "dist_sync", "dist_async",
+                "dist_device_sync", "dist_sync_device"):
+        return TPUKVStore(name)
+    if name in _REG:
+        return _REG.get(name)()
+    raise MXNetError(f"unknown kvstore type '{name}'")

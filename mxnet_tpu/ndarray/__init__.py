@@ -1,0 +1,97 @@
+"""``mx.nd`` — legacy imperative array namespace.
+
+The reference keeps two array APIs: legacy mx.nd (python/mxnet/ndarray/,
+21.4k LoC of generated wrappers) and mx.np (NumPy semantics). Here both share
+one NDArray type; mx.nd re-exports creation/math plus the legacy-named ops
+so reference scripts port mechanically. Legacy-only spellings (relu, Concat,
+batch_dot, ...) are provided as aliases.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      zeros_like, ones_like, full_like, concatenate, stack,
+                      split, waitall, from_jax, _mutation_scope)
+from ..ops.dispatch import wrap_op, call
+
+# legacy op spellings (ref: python/mxnet/ndarray/ndarray.py generated table)
+abs = wrap_op(jnp.abs, "abs")
+exp = wrap_op(jnp.exp, "exp")
+log = wrap_op(jnp.log, "log")
+sqrt = wrap_op(jnp.sqrt, "sqrt")
+square = wrap_op(jnp.square, "square")
+sin = wrap_op(jnp.sin, "sin")
+cos = wrap_op(jnp.cos, "cos")
+tanh = wrap_op(jnp.tanh, "tanh")
+sigmoid = wrap_op(jax.nn.sigmoid, "sigmoid")
+relu = wrap_op(jax.nn.relu, "relu")
+softmax = wrap_op(jax.nn.softmax, "softmax")
+log_softmax = wrap_op(jax.nn.log_softmax, "log_softmax")
+dot = wrap_op(jnp.dot, "dot")
+sum = wrap_op(jnp.sum, "sum")
+mean = wrap_op(jnp.mean, "mean")
+max = wrap_op(jnp.max, "max")
+min = wrap_op(jnp.min, "min")
+argmax = wrap_op(jnp.argmax, "argmax")
+argmin = wrap_op(jnp.argmin, "argmin")
+clip = wrap_op(jnp.clip, "clip")
+maximum = wrap_op(jnp.maximum, "maximum")
+minimum = wrap_op(jnp.minimum, "minimum")
+where = wrap_op(jnp.where, "where")
+power = wrap_op(jnp.power, "power")
+sign = wrap_op(jnp.sign, "sign")
+floor = wrap_op(jnp.floor, "floor")
+ceil = wrap_op(jnp.ceil, "ceil")
+round = wrap_op(jnp.round, "round")
+norm = wrap_op(jnp.linalg.norm, "norm")
+add = wrap_op(jnp.add, "add")
+subtract = wrap_op(jnp.subtract, "subtract")
+multiply = wrap_op(jnp.multiply, "multiply")
+divide = wrap_op(jnp.divide, "divide")
+negative = wrap_op(jnp.negative, "negative")
+reshape = wrap_op(jnp.reshape, "reshape")
+transpose = wrap_op(jnp.transpose, "transpose")
+expand_dims = wrap_op(jnp.expand_dims, "expand_dims")
+squeeze = wrap_op(jnp.squeeze, "squeeze")
+tile = wrap_op(jnp.tile, "tile")
+repeat = wrap_op(jnp.repeat, "repeat")
+flip = wrap_op(jnp.flip, "flip")
+take = wrap_op(jnp.take, "take")
+broadcast_to = wrap_op(jnp.broadcast_to, "broadcast_to")
+broadcast_add = add
+broadcast_sub = subtract
+broadcast_mul = multiply
+broadcast_div = divide
+elemwise_add = add
+elemwise_sub = subtract
+elemwise_mul = multiply
+elemwise_div = divide
+Concat = concatenate
+concat = concatenate
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    """Ref: src/operator/tensor/dot.cc batch_dot — batched matmul on the MXU."""
+    def f(x, y):
+        if transpose_a:
+            x = jnp.swapaxes(x, -1, -2)
+        if transpose_b:
+            y = jnp.swapaxes(y, -1, -2)
+        return jnp.matmul(x, y)
+
+    return call(f, (a, b), {}, name="batch_dot")
+
+
+def flatten(a):
+    return call(lambda x: x.reshape(x.shape[0], -1), (a,), {}, name="flatten")
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype=None):
+    return call(lambda i: jax.nn.one_hot(i, depth, dtype=jnp.dtype(dtype) if dtype else jnp.float32)
+                * (on_value - off_value) + off_value, (indices,), {}, name="one_hot")
+
+
+from . import random  # noqa: E402
+from .utils import save, load  # noqa: E402

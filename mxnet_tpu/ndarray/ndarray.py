@@ -1,0 +1,641 @@
+"""NDArray: a mutable, device-resident tensor over an immutable ``jax.Array``.
+
+TPU-native re-design of the reference NDArray (include/mxnet/ndarray.h:82-1165,
+src/ndarray/). The reference couples a ref-counted storage chunk with an engine
+variable for async dependency tracking; on TPU, PJRT already gives async
+dispatch + buffer lifetime, so NDArray reduces to: a rebindable handle to a
+``jax.Array`` (mutation = functional update + rebind), an autograd entry
+(tape node), and a grad buffer. Known, documented divergence from the
+reference (SURVEY.md §7 hard part 1): slices are copies, not views — writing
+through ``a[1:3] = x`` works (functional scatter on the base), but a slice
+taken *before* a write does not alias the base afterwards.
+
+Async semantics: ``wait_to_read`` ≈ jax block_until_ready; worker-thread
+exceptions surface there like the reference engine's rethrow-at-wait
+(src/engine/threaded_engine.h:463).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError, numeric_types
+from ..context import Context, cpu, current_context, tpu
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "zeros_like", "ones_like", "full_like", "waitall", "concatenate",
+           "stack", "split", "_mutation_scope", "from_jax", "newaxis"]
+
+newaxis = None
+
+# Active mutation watchers: HybridBlock tracing registers a set here so that
+# in-place writes during a jit trace are captured as extra outputs
+# (our replacement for the reference's deferred-compute mutation model,
+# src/imperative/imperative.cc:301 RecordDeferredCompute).
+_MUTATION_WATCHERS: list = []
+
+
+class _mutation_scope:
+    """Context manager collecting every NDArray mutated inside it.
+
+    ``mutated`` maps id(arr) -> (arr, value_before_first_mutation) so a
+    tracer (hybridize) can restore pre-trace values and emit the final
+    values as extra jit outputs."""
+
+    def __init__(self):
+        self.mutated: "dict[int, tuple]" = {}
+
+    def __enter__(self):
+        _MUTATION_WATCHERS.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _MUTATION_WATCHERS.pop()
+
+
+def _dtype_of(obj, dtype):
+    if dtype is not None:
+        return jnp.dtype(dtype)
+    return None
+
+
+class NDArray:
+    """See module docstring. API mirrors mx.np.ndarray + mx.nd.NDArray."""
+
+    __slots__ = ("_data", "_grad", "_grad_req", "_autograd_entry", "__weakref__")
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data, dtype=_dtype_of(data, dtype))
+        elif dtype is not None and data.dtype != jnp.dtype(dtype):
+            data = data.astype(jnp.dtype(dtype))
+        if ctx is not None:
+            dev = ctx.jax_device()
+            try:
+                cur = next(iter(data.devices())) if hasattr(data, "devices") else None
+            except Exception:
+                cur = None
+            if cur is not dev:
+                data = jax.device_put(data, dev)
+        self._data = data
+        self._grad = None
+        self._grad_req = None
+        self._autograd_entry = None
+
+    # -- mutation ----------------------------------------------------------
+    def _set_data(self, new_data):
+        """All rebinding funnels through here so jit tracing can observe
+        mutations (see _mutation_scope)."""
+        for w in _MUTATION_WATCHERS:
+            if id(self) not in w.mutated:
+                w.mutated[id(self)] = (self, self._data)
+        self._data = new_data
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _onp.dtype(self._data.dtype.name) if hasattr(self._data.dtype, "name") else self._data.dtype
+
+    @property
+    def size(self) -> int:
+        s = 1
+        for d in self._data.shape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def itemsize(self) -> int:
+        return jnp.dtype(self._data.dtype).itemsize
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * self.itemsize
+
+    @property
+    def ctx(self) -> Context:
+        try:
+            dev = next(iter(self._data.devices()))
+        except Exception:
+            return current_context()
+        return cpu(dev.id) if dev.platform == "cpu" else tpu(dev.id)
+
+    context = ctx
+    device = ctx
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    @property
+    def stype(self) -> str:
+        return "default"  # sparse storage types are handled in ndarray.sparse
+
+    # -- host interop ------------------------------------------------------
+    def asnumpy(self) -> _onp.ndarray:
+        """Blocking device→host copy (ref ndarray.h SyncCopyToCPU)."""
+        return _onp.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self):
+        return self.asnumpy().item()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.item()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise MXNetError(
+                "The truth value of an array with more than one element is ambiguous")
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self._data.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        try:
+            vals = _onp.array2string(self.asnumpy(), separator=", ")
+        except Exception:
+            vals = f"<unmaterialized {self._data}>"
+        return f"array({vals}, ctx={self.ctx})"
+
+    # -- async / engine semantics -----------------------------------------
+    def wait_to_read(self):
+        """Block until value ready; async errors rethrow here
+        (ref src/engine/threaded_engine.h:463)."""
+        jax.block_until_ready(self._data)
+        return self
+
+    def wait_to_write(self):
+        jax.block_until_ready(self._data)
+        return self
+
+    # -- device / dtype movement ------------------------------------------
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.ctx:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+    to_device = as_in_context
+
+    def copyto(self, other) -> "NDArray":
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()))
+        if isinstance(other, NDArray):
+            other._set_data(jax.device_put(self._data, other.ctx.jax_device())
+                            .astype(other._data.dtype))
+            return other
+        raise MXNetError(f"copyto target must be Context or NDArray, got {type(other)}")
+
+    def copy(self) -> "NDArray":
+        return NDArray(jnp.array(self._data, copy=True))
+
+    def astype(self, dtype, copy: bool = True) -> "NDArray":
+        from ..ops.dispatch import call
+
+        if not copy and jnp.dtype(dtype) == self._data.dtype:
+            return self
+        return call(lambda x: x.astype(jnp.dtype(dtype)), (self,), {}, name="astype")
+
+    # -- autograd ----------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        """Allocate a gradient buffer (ref mx.nd.NDArray.attach_grad)."""
+        self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype))
+        self._grad_req = grad_req
+        self._autograd_entry = None
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._set_data(jnp.zeros_like(self._grad._data))
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._data)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- indexing ----------------------------------------------------------
+    def _clean_key(self, key):
+        def conv(k):
+            if isinstance(k, NDArray):
+                return k._data
+            return k
+
+        if isinstance(key, tuple):
+            return tuple(conv(k) for k in key)
+        return conv(key)
+
+    def __getitem__(self, key):
+        from ..ops.dispatch import call
+
+        if isinstance(key, NDArray) and key.dtype == _onp.bool_:
+            return call(lambda x, m: x[m], (self, key), {}, name="boolean_mask")
+        ckey = self._clean_key(key)
+        nd_in = [self]
+        if isinstance(key, NDArray):
+            return call(lambda x, k: x[k], (self, key), {}, name="take")
+        return call(lambda x: x[ckey], (self,), {}, name="getitem")
+
+    def __setitem__(self, key, value):
+        ckey = self._clean_key(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        new = self._data.at[ckey].set(jnp.asarray(value, dtype=self._data.dtype)
+                                      if not isinstance(value, jax.Array) else
+                                      value.astype(self._data.dtype))
+        from .. import autograd
+
+        if autograd.is_recording() and self._autograd_entry is not None:
+            # record the functional scatter so grads flow through the write
+            from ..ops.dispatch import invoke
+
+            vsrc = NDArray(value) if isinstance(value, jax.Array) else None
+            if vsrc is not None:
+                res = invoke(lambda x, v: x.at[ckey].set(v.astype(x.dtype)),
+                             [self, vsrc], name="setitem")
+            else:
+                res = invoke(lambda x: x.at[ckey].set(value), [self], name="setitem")
+            self._set_data(res._data)
+            self._autograd_entry = res._autograd_entry
+        else:
+            self._set_data(new)
+
+    # -- arithmetic helpers ------------------------------------------------
+    def _binary(self, other, jfn, name, reverse=False):
+        from ..ops.dispatch import call
+
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return call(jfn, (a, b), {}, name=name)
+        if isinstance(other, numeric_types) or isinstance(other, _onp.ndarray) or _onp.isscalar(other):
+            if reverse:
+                return call(lambda x: jfn(other, x), (self,), {}, name=name)
+            return call(lambda x: jfn(x, other), (self,), {}, name=name)
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary(o, jnp.add, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, jnp.subtract, "subtract")
+
+    def __rsub__(self, o):
+        return self._binary(o, jnp.subtract, "rsubtract", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, jnp.multiply, "multiply")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, jnp.true_divide, "true_divide")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, jnp.true_divide, "rtrue_divide", reverse=True)
+
+    def __floordiv__(self, o):
+        return self._binary(o, jnp.floor_divide, "floor_divide")
+
+    def __rfloordiv__(self, o):
+        return self._binary(o, jnp.floor_divide, "rfloor_divide", reverse=True)
+
+    def __mod__(self, o):
+        return self._binary(o, jnp.mod, "mod")
+
+    def __rmod__(self, o):
+        return self._binary(o, jnp.mod, "rmod", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, jnp.power, "power")
+
+    def __rpow__(self, o):
+        return self._binary(o, jnp.power, "rpower", reverse=True)
+
+    def __matmul__(self, o):
+        return self._binary(o, jnp.matmul, "matmul")
+
+    def __rmatmul__(self, o):
+        return self._binary(o, jnp.matmul, "rmatmul", reverse=True)
+
+    def __neg__(self):
+        from ..ops.dispatch import call
+
+        return call(jnp.negative, (self,), {}, name="negative")
+
+    def __abs__(self):
+        from ..ops.dispatch import call
+
+        return call(jnp.abs, (self,), {}, name="abs")
+
+    # in-place ops rebind (functional under the hood; recorded when taping)
+    def _inplace(self, other, jfn, name):
+        res = self._binary(other, jfn, name)
+        if res is NotImplemented:
+            return res
+        self._set_data(res._data)
+        self._autograd_entry = res._autograd_entry
+        return self
+
+    def __iadd__(self, o):
+        return self._inplace(o, jnp.add, "add")
+
+    def __isub__(self, o):
+        return self._inplace(o, jnp.subtract, "subtract")
+
+    def __imul__(self, o):
+        return self._inplace(o, jnp.multiply, "multiply")
+
+    def __itruediv__(self, o):
+        return self._inplace(o, jnp.true_divide, "true_divide")
+
+    # comparisons
+    def __eq__(self, o):
+        return self._binary(o, lambda a, b: a == b, "equal")
+
+    def __ne__(self, o):
+        return self._binary(o, lambda a, b: a != b, "not_equal")
+
+    def __lt__(self, o):
+        return self._binary(o, lambda a, b: a < b, "less")
+
+    def __le__(self, o):
+        return self._binary(o, lambda a, b: a <= b, "less_equal")
+
+    def __gt__(self, o):
+        return self._binary(o, lambda a, b: a > b, "greater")
+
+    def __ge__(self, o):
+        return self._binary(o, lambda a, b: a >= b, "greater_equal")
+
+    # -- shape ops as methods ---------------------------------------------
+    def _unary_method(self, jfn, name, **kwargs):
+        from ..ops.dispatch import call
+
+        return call(jfn, (self,), kwargs, name=name)
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return self._unary_method(lambda x: jnp.reshape(x, shape), "reshape")
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        ax = axes if axes else None
+        return self._unary_method(lambda x: jnp.transpose(x, ax), "transpose")
+
+    def swapaxes(self, a1, a2):
+        return self._unary_method(lambda x: jnp.swapaxes(x, a1, a2), "swapaxes")
+
+    def flatten(self):
+        return self._unary_method(lambda x: jnp.reshape(x, (-1,)), "flatten")
+
+    def ravel(self):
+        return self.flatten()
+
+    def squeeze(self, axis=None):
+        return self._unary_method(lambda x: jnp.squeeze(x, axis), "squeeze")
+
+    def expand_dims(self, axis):
+        return self._unary_method(lambda x: jnp.expand_dims(x, axis), "expand_dims")
+
+    def broadcast_to(self, shape):
+        return self._unary_method(lambda x: jnp.broadcast_to(x, shape), "broadcast_to")
+
+    def repeat(self, repeats, axis=None):
+        return self._unary_method(lambda x: jnp.repeat(x, repeats, axis), "repeat")
+
+    def tile(self, reps):
+        return self._unary_method(lambda x: jnp.tile(x, reps), "tile")
+
+    def clip(self, a_min=None, a_max=None):
+        return self._unary_method(lambda x: jnp.clip(x, a_min, a_max), "clip")
+
+    def sum(self, axis=None, dtype=None, keepdims=False):
+        return self._unary_method(lambda x: jnp.sum(x, axis=axis, dtype=dtype,
+                                                    keepdims=keepdims), "sum")
+
+    def mean(self, axis=None, dtype=None, keepdims=False):
+        return self._unary_method(lambda x: jnp.mean(x, axis=axis, dtype=dtype,
+                                                     keepdims=keepdims), "mean")
+
+    def prod(self, axis=None, keepdims=False):
+        return self._unary_method(lambda x: jnp.prod(x, axis=axis, keepdims=keepdims), "prod")
+
+    def max(self, axis=None, keepdims=False):
+        return self._unary_method(lambda x: jnp.max(x, axis=axis, keepdims=keepdims), "max")
+
+    def min(self, axis=None, keepdims=False):
+        return self._unary_method(lambda x: jnp.min(x, axis=axis, keepdims=keepdims), "min")
+
+    def argmax(self, axis=None):
+        return self._unary_method(lambda x: jnp.argmax(x, axis=axis), "argmax")
+
+    def argmin(self, axis=None):
+        return self._unary_method(lambda x: jnp.argmin(x, axis=axis), "argmin")
+
+    def cumsum(self, axis=None, dtype=None):
+        return self._unary_method(lambda x: jnp.cumsum(x, axis=axis, dtype=dtype), "cumsum")
+
+    def all(self, axis=None, keepdims=False):
+        return self._unary_method(lambda x: jnp.all(x, axis=axis, keepdims=keepdims), "all")
+
+    def any(self, axis=None, keepdims=False):
+        return self._unary_method(lambda x: jnp.any(x, axis=axis, keepdims=keepdims), "any")
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return self._unary_method(lambda x: jnp.std(x, axis=axis, ddof=ddof,
+                                                    keepdims=keepdims), "std")
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return self._unary_method(lambda x: jnp.var(x, axis=axis, ddof=ddof,
+                                                    keepdims=keepdims), "var")
+
+    def round(self, decimals=0):
+        return self._unary_method(lambda x: jnp.round(x, decimals), "round")
+
+    def argsort(self, axis=-1):
+        return self._unary_method(lambda x: jnp.argsort(x, axis=axis), "argsort")
+
+    def sort(self, axis=-1):
+        return self._unary_method(lambda x: jnp.sort(x, axis=axis), "sort")
+
+    def nonzero(self):
+        return tuple(NDArray(i) for i in jnp.nonzero(self._data))
+
+    def trace(self, offset=0, axis1=0, axis2=1):
+        return self._unary_method(lambda x: jnp.trace(x, offset, axis1, axis2), "trace")
+
+    def dot(self, other):
+        return self._binary(other, jnp.dot, "dot")
+
+    def abs(self):
+        return self.__abs__()
+
+    def sqrt(self):
+        return self._unary_method(jnp.sqrt, "sqrt")
+
+    def exp(self):
+        return self._unary_method(jnp.exp, "exp")
+
+    def log(self):
+        return self._unary_method(jnp.log, "log")
+
+    def sigmoid(self):
+        return self._unary_method(jax.nn.sigmoid, "sigmoid")
+
+    def tanh(self):
+        return self._unary_method(jnp.tanh, "tanh")
+
+    def relu(self):
+        return self._unary_method(jax.nn.relu, "relu")
+
+    def softmax(self, axis=-1):
+        return self._unary_method(lambda x: jax.nn.softmax(x, axis=axis), "softmax")
+
+    def norm(self, ord=None, axis=None, keepdims=False):
+        return self._unary_method(lambda x: jnp.linalg.norm(x, ord=ord, axis=axis,
+                                                            keepdims=keepdims), "norm")
+
+    def take(self, indices, axis=None, mode="clip"):
+        from ..ops.dispatch import call
+
+        idx = indices if isinstance(indices, NDArray) else NDArray(jnp.asarray(indices))
+        return call(lambda x, i: jnp.take(x, i, axis=axis, mode=mode),
+                    (self, idx), {}, name="take")
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype=None):
+        return self._unary_method(
+            lambda x: jax.nn.one_hot(x, depth, dtype=dtype or jnp.float32)
+            * (on_value - off_value) + off_value, "one_hot")
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
+# ---------------------------------------------------------------------------
+# creation routines (shared by mx.nd and mx.np namespaces)
+# ---------------------------------------------------------------------------
+
+def from_jax(a) -> NDArray:
+    return NDArray(a)
+
+
+def array(obj, dtype=None, ctx: Optional[Context] = None) -> NDArray:
+    if isinstance(obj, NDArray):
+        data = obj._data
+        if dtype is not None:
+            data = data.astype(jnp.dtype(dtype))
+        return NDArray(data, ctx=ctx)
+    return NDArray(jnp.asarray(obj, dtype=jnp.dtype(dtype) if dtype is not None else None),
+                   ctx=ctx)
+
+
+def zeros(shape, dtype=None, ctx=None, **kwargs) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.zeros(shape, dtype=jnp.dtype(dtype) if dtype else jnp.float32), ctx=ctx)
+
+
+def ones(shape, dtype=None, ctx=None, **kwargs) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.ones(shape, dtype=jnp.dtype(dtype) if dtype else jnp.float32), ctx=ctx)
+
+
+def full(shape, fill_value, dtype=None, ctx=None) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.full(shape, fill_value,
+                            dtype=jnp.dtype(dtype) if dtype else None), ctx=ctx)
+
+
+def empty(shape, dtype=None, ctx=None) -> NDArray:
+    return zeros(shape, dtype=dtype, ctx=ctx)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None) -> NDArray:
+    return NDArray(jnp.arange(start, stop, step,
+                              dtype=jnp.dtype(dtype) if dtype else None), ctx=ctx)
+
+
+def zeros_like(a: NDArray) -> NDArray:
+    return NDArray(jnp.zeros_like(a._data))
+
+
+def ones_like(a: NDArray) -> NDArray:
+    return NDArray(jnp.ones_like(a._data))
+
+
+def full_like(a: NDArray, fill_value, dtype=None) -> NDArray:
+    return NDArray(jnp.full_like(a._data, fill_value,
+                                 dtype=jnp.dtype(dtype) if dtype else None))
+
+
+def concatenate(arrays, axis=0):
+    from ..ops.dispatch import invoke
+
+    return invoke(lambda *xs: jnp.concatenate(xs, axis=axis), list(arrays), name="concatenate")
+
+
+def stack(arrays, axis=0):
+    from ..ops.dispatch import invoke
+
+    return invoke(lambda *xs: jnp.stack(xs, axis=axis), list(arrays), name="stack")
+
+
+def split(ary: NDArray, indices_or_sections, axis=0):
+    from ..ops.dispatch import call
+
+    return call(lambda x: tuple(jnp.split(x, indices_or_sections, axis=axis)),
+                (ary,), {}, name="split")
+
+
+def waitall():
+    """Block until all outstanding device work completes
+    (ref mx.nd.waitall → Engine::WaitForAll, include/mxnet/engine.h:234)."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass

@@ -1,0 +1,71 @@
+"""``mx.nd.random`` — legacy random namespace (ref python/mxnet/ndarray/random.py).
+
+Same samplers as mx.np.random but with the legacy argument spellings
+(shape= instead of size=).
+"""
+from __future__ import annotations
+
+from ..numpy import random as _npr
+from ..random import seed  # noqa: F401
+
+__all__ = ["seed", "uniform", "normal", "randn", "randint", "exponential",
+           "gamma", "poisson", "shuffle", "multinomial"]
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    return _npr.uniform(low, high, size=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    return _npr.normal(loc, scale, size=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def randn(*shape, dtype=None, ctx=None, **kw):
+    return _npr.randn(*shape, dtype=dtype, ctx=ctx)
+
+
+def randint(low, high=None, shape=None, dtype=None, ctx=None, out=None, **kw):
+    return _npr.randint(low, high, size=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None, **kw):
+    return _npr.exponential(scale, size=shape, dtype=dtype, ctx=ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, **kw):
+    return _npr.gamma(alpha, size=shape, dtype=dtype, ctx=ctx) * beta
+
+
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None, **kw):
+    return _npr.poisson(lam, size=shape, dtype=dtype, ctx=ctx)
+
+
+def shuffle(x):
+    return _npr.shuffle(x)
+
+
+def multinomial(data, shape=1, get_prob=False, dtype="int32", **kw):
+    """Sample category indices from probability rows (ref _sample_multinomial)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ndarray.ndarray import NDArray
+    from ..random import next_key
+
+    p = data._data
+    n = shape if isinstance(shape, int) else int(shape[0])
+    logits = jnp.log(jnp.clip(p, 1e-30, None))
+    if p.ndim == 1:
+        out = jax.random.categorical(next_key(), logits, shape=(n,))
+    else:
+        out = jax.random.categorical(next_key(), logits[:, None, :], axis=-1,
+                                     shape=(p.shape[0], n))
+        if n == 1:
+            out = out[:, 0]
+    res = NDArray(out.astype(jnp.dtype(dtype)))
+    if get_prob:
+        lp = jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                                 out.reshape(out.shape + (1,)) if p.ndim > 1 else out[..., None],
+                                 axis=-1).squeeze(-1)
+        return res, NDArray(lp)
+    return res

@@ -1,0 +1,84 @@
+"""NDArray serialization: ``mx.nd.save`` / ``mx.nd.load``.
+
+Ref: python/mxnet/ndarray/utils.py:149,222 → src/ndarray/ndarray.cc:1729,1852
+(binary magic + versioned chunks). TPU-native format: a zip container of
+npy payloads (numpy savez) with a manifest entry encoding list-vs-dict —
+portable, mmap-friendly on the host, and loadable without the framework.
+bfloat16 payloads are stored as uint16 with a dtype tag.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Union
+
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError
+from .ndarray import NDArray
+
+_MAGIC_KEY = "__mxnet_tpu_nd_format__"
+_BF16_SUFFIX = "::bfloat16"
+
+
+def _encode(arr: NDArray) -> _onp.ndarray:
+    a = arr.asnumpy() if isinstance(arr, NDArray) else _onp.asarray(arr)
+    return a
+
+
+def save(fname: str, data: Union[NDArray, List[NDArray], Dict[str, NDArray]]):
+    """Save one array, a list, or a str->array dict (ref utils.py:149)."""
+    payload = {}
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        payload[_MAGIC_KEY] = _onp.array("list")
+        for i, a in enumerate(data):
+            _put(payload, f"arr:{i}", a)
+    elif isinstance(data, dict):
+        payload[_MAGIC_KEY] = _onp.array("dict")
+        for k, a in data.items():
+            _put(payload, f"key:{k}", a)
+    else:
+        raise MXNetError(f"save expects NDArray/list/dict, got {type(data)}")
+    with open(fname, "wb") as f:
+        _onp.savez(f, **payload)
+
+
+def _put(payload, key, a):
+    if not isinstance(a, NDArray):
+        raise MXNetError(f"save expects NDArray values, got {type(a)}")
+    raw = a._data
+    if raw.dtype == jnp.bfloat16:
+        payload[key + _BF16_SUFFIX] = _onp.asarray(raw.view(jnp.uint16))
+    else:
+        payload[key] = _onp.asarray(raw)
+
+
+def _get(z, key):
+    if key.endswith(_BF16_SUFFIX):
+        return NDArray(jnp.asarray(z[key]).view(jnp.bfloat16))
+    return NDArray(jnp.asarray(z[key]))
+
+
+def load(fname: str):
+    """Load what ``save`` wrote (ref utils.py:222)."""
+    z = _onp.load(fname, allow_pickle=False)
+    if _MAGIC_KEY not in z:
+        raise MXNetError(f"{fname} is not an mxnet_tpu NDArray file")
+    kind = str(z[_MAGIC_KEY])
+    if kind == "list":
+        items = []
+        for key in z.files:
+            if key == _MAGIC_KEY:
+                continue
+            base = key.split("::")[0]
+            idx = int(base.split(":", 1)[1])
+            items.append((idx, _get(z, key)))
+        return [a for _, a in sorted(items, key=lambda t: t[0])]
+    out = {}
+    for key in z.files:
+        if key == _MAGIC_KEY:
+            continue
+        base = key.split("::")[0]
+        out[base.split(":", 1)[1]] = _get(z, key)
+    return out

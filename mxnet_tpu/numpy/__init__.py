@@ -1,0 +1,296 @@
+"""``mx.np`` — NumPy-compatible array API on TPU.
+
+Re-imagines python/mxnet/numpy/multiarray.py (12.2k LoC of generated
+``_npi_*`` FFI wrappers, SURVEY.md §2.4) the TPU way: instead of per-op C++
+shims (src/api/operator/**), every function is a thin autograd-aware lift of
+the corresponding ``jax.numpy`` function via ops.dispatch.wrap_op — jnp/XLA
+already implements NumPy semantics, so the op corpus collapses to a name
+table. The array type is the shared NDArray (mutable handle, tape-aware).
+
+Divergences from the reference are documented in docs/divergences.md
+(notably: default integer dtypes follow jnp, slices are copies).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import (NDArray, array, waitall, from_jax, newaxis)
+from ..ndarray import ndarray as _nd
+from ..ops.dispatch import wrap_op, call, invoke
+
+ndarray = NDArray  # mx.np.ndarray is the NDArray class
+
+# dtype aliases (mx.np exposes numpy dtypes)
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+bfloat16 = jnp.bfloat16
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+bool_ = _onp.bool_
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+dtype = _onp.dtype
+
+
+# -- creation (ctx-aware) ----------------------------------------------------
+
+def _creation(jfn):
+    def f(*args, ctx=None, device=None, dtype=None, **kwargs):
+        if dtype is not None:
+            kwargs["dtype"] = jnp.dtype(dtype)
+        out = jfn(*args, **kwargs)
+        return NDArray(out, ctx=ctx or device)
+
+    f.__name__ = jfn.__name__
+    return f
+
+
+def zeros(shape, dtype=float32, order="C", ctx=None, device=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.zeros(shape, dtype=jnp.dtype(dtype) if dtype else jnp.float32),
+                   ctx=ctx or device)
+
+
+def ones(shape, dtype=float32, order="C", ctx=None, device=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.ones(shape, dtype=jnp.dtype(dtype) if dtype else jnp.float32),
+                   ctx=ctx or device)
+
+
+def full(shape, fill_value, dtype=None, order="C", ctx=None, device=None, out=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    if isinstance(fill_value, NDArray):
+        fill_value = fill_value._data
+    res = NDArray(jnp.full(shape, fill_value, dtype=jnp.dtype(dtype) if dtype else None),
+                  ctx=ctx or device)
+    if out is not None:
+        out._set_data(res._data)
+        return out
+    return res
+
+
+def empty(shape, dtype=float32, order="C", ctx=None, device=None):
+    return zeros(shape, dtype=dtype, ctx=ctx, device=device)
+
+
+def eye(N, M=None, k=0, dtype=float32, ctx=None, device=None):
+    return NDArray(jnp.eye(N, M, k, dtype=jnp.dtype(dtype)), ctx=ctx or device)
+
+
+def identity(n, dtype=float32, ctx=None, device=None):
+    return eye(n, dtype=dtype, ctx=ctx, device=device)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    return NDArray(jnp.arange(start, stop, step,
+                              dtype=jnp.dtype(dtype) if dtype else None), ctx=ctx or device)
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None, device=None):
+    out = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                       dtype=jnp.dtype(dtype) if dtype else None, axis=axis)
+    if retstep:
+        return NDArray(out[0], ctx=ctx or device), out[1]
+    return NDArray(out, ctx=ctx or device)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None, device=None):
+    return NDArray(jnp.logspace(start, stop, num, endpoint, base,
+                                jnp.dtype(dtype) if dtype else None, axis), ctx=ctx or device)
+
+
+def asarray(obj, dtype=None, ctx=None, device=None):
+    return array(obj, dtype=dtype, ctx=ctx or device)
+
+
+def ascontiguousarray(obj, dtype=None):
+    return array(obj, dtype=dtype)
+
+
+def copy(a):
+    return a.copy() if isinstance(a, NDArray) else array(a)
+
+
+def zeros_like(a, dtype=None, order="C", ctx=None, device=None):
+    return NDArray(jnp.zeros_like(a._data if isinstance(a, NDArray) else a,
+                                  dtype=jnp.dtype(dtype) if dtype else None), ctx=ctx or device)
+
+
+def ones_like(a, dtype=None, order="C", ctx=None, device=None):
+    return NDArray(jnp.ones_like(a._data if isinstance(a, NDArray) else a,
+                                 dtype=jnp.dtype(dtype) if dtype else None), ctx=ctx or device)
+
+
+def full_like(a, fill_value, dtype=None, order="C", ctx=None, device=None):
+    return NDArray(jnp.full_like(a._data if isinstance(a, NDArray) else a, fill_value,
+                                 dtype=jnp.dtype(dtype) if dtype else None), ctx=ctx or device)
+
+
+def empty_like(a, dtype=None, order="C", ctx=None, device=None):
+    return zeros_like(a, dtype=dtype, ctx=ctx, device=device)
+
+
+def meshgrid(*xi, **kwargs):
+    outs = jnp.meshgrid(*[x._data if isinstance(x, NDArray) else x for x in xi], **kwargs)
+    return [NDArray(o) for o in outs]
+
+
+def tril(m, k=0):
+    return call(lambda x: jnp.tril(x, k), (m,), {}, name="tril")
+
+
+def triu(m, k=0):
+    return call(lambda x: jnp.triu(x, k), (m,), {}, name="triu")
+
+
+# -- mechanically lifted jnp functions --------------------------------------
+# Everything listed here is autograd-aware via ops.dispatch (NDArray args →
+# differentiable inputs; scalars/config closed over). Mirrors the generated
+# op table of the reference (python/mxnet/numpy/multiarray.py __all__).
+
+_LIFTED = [
+    # elementwise math
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "negative", "positive", "power", "float_power", "mod", "remainder", "fmod",
+    "absolute", "abs", "fabs", "sign", "rint", "fix", "floor", "ceil", "trunc",
+    "sqrt", "cbrt", "square", "reciprocal", "exp", "expm1", "exp2", "log",
+    "log2", "log10", "log1p", "logaddexp", "logaddexp2",
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan", "arctan2",
+    "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    "degrees", "radians", "deg2rad", "rad2deg", "hypot", "copysign",
+    "maximum", "minimum", "fmax", "fmin", "heaviside", "nan_to_num", "interp",
+    "gcd", "lcm", "i0", "sinc", "ldexp", "frexp", "signbit", "nextafter",
+    # comparison / logical
+    "equal", "not_equal", "less", "less_equal", "greater", "greater_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+    "isfinite", "isinf", "isnan", "isneginf", "isposinf", "isclose",
+    "array_equal", "allclose",
+    # bit ops
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "invert",
+    "left_shift", "right_shift",
+    # reductions
+    "sum", "prod", "mean", "std", "var", "min", "max", "amin", "amax", "ptp",
+    "nansum", "nanprod", "nanmean", "nanstd", "nanvar", "nanmin", "nanmax",
+    "all", "any", "count_nonzero", "median", "nanmedian", "quantile",
+    "percentile", "nanquantile", "nanpercentile", "average",
+    "argmax", "argmin", "nanargmax", "nanargmin",
+    "cumsum", "cumprod", "nancumsum", "nancumprod",
+    # sorting / searching
+    "sort", "argsort", "lexsort", "partition", "argpartition", "searchsorted",
+    "nonzero", "argwhere", "flatnonzero", "where", "extract", "diff", "ediff1d",
+    "unwrap", "trapezoid",
+    # linear algebra (top-level)
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum", "kron",
+    "cross", "trace", "diagonal", "diag", "diagflat", "diag_indices_from",
+    # shape manipulation
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "expand_dims", "squeeze", "broadcast_to", "broadcast_arrays",
+    "concatenate", "stack", "vstack", "hstack", "dstack", "column_stack",
+    "row_stack" if hasattr(jnp, "row_stack") else "vstack",
+    "split", "array_split", "vsplit", "hsplit", "dsplit",
+    "tile", "repeat", "flip", "fliplr", "flipud", "roll", "rot90",
+    "atleast_1d", "atleast_2d", "atleast_3d", "pad", "resize",
+    "append", "insert", "delete",
+    # indexing
+    "take", "take_along_axis", "put_along_axis", "choose", "compress",
+    "unravel_index", "ravel_multi_index", "indices", "ix_",
+    "tril_indices", "triu_indices", "diag_indices",
+    "select", "piecewise",
+    # sets
+    "unique", "intersect1d", "union1d", "setdiff1d", "setxor1d", "isin", "in1d",
+    # statistics
+    "bincount", "digitize", "histogram", "histogram2d", "histogramdd",
+    "histogram_bin_edges", "corrcoef", "cov", "correlate", "convolve",
+    # rounding
+    "round", "around", "clip",
+    # dtype & misc
+    "astype" if hasattr(jnp, "astype") else "asarray",
+    "real", "imag", "conj", "conjugate", "angle",
+    "shape", "ndim", "size", "result_type", "can_cast", "promote_types",
+    "isscalar", "iscomplexobj", "isrealobj",
+    "vander", "gradient", "ndindex" if hasattr(jnp, "ndindex") else "asarray",
+]
+
+_g = globals()
+for _name in dict.fromkeys(_LIFTED):
+    if _name in _g:
+        continue
+    _j = getattr(jnp, _name, None)
+    if _j is None:
+        continue
+    _g[_name] = wrap_op(_j, _name)
+
+
+def _to_raw(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def may_share_memory(a, b):
+    return False  # functional arrays never alias observably
+
+
+def shares_memory(a, b):
+    return False
+
+
+def _seq_op(jfn, name):
+    """Ops taking a *sequence* of arrays (concatenate family) — each element
+    becomes a differentiable input."""
+
+    def op(arrays, *args, **kwargs):
+        arrays = list(arrays)
+        nd = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a)) for a in arrays]
+        return invoke(lambda *xs: jfn(list(xs), *args, **kwargs), nd, name=name)
+
+    op.__name__ = name
+    return op
+
+
+concatenate = _seq_op(jnp.concatenate, "concatenate")
+stack = _seq_op(jnp.stack, "stack")
+vstack = _seq_op(jnp.vstack, "vstack")
+hstack = _seq_op(jnp.hstack, "hstack")
+dstack = _seq_op(jnp.dstack, "dstack")
+column_stack = _seq_op(jnp.column_stack, "column_stack")
+row_stack = vstack
+
+
+def expand_dims(a, axis):  # noqa: F811 — ensure method-consistent version
+    return call(lambda x: jnp.expand_dims(x, axis), (a,), {}, name="expand_dims")
+
+
+def split(ary, indices_or_sections, axis=0):  # noqa: F811 — returns list like numpy
+    res = call(lambda x: tuple(jnp.split(x, indices_or_sections, axis=axis)),
+               (ary,), {}, name="split")
+    return list(res) if isinstance(res, tuple) else [res]
+
+
+def array_split(ary, indices_or_sections, axis=0):  # noqa: F811
+    res = call(lambda x: tuple(jnp.array_split(x, indices_or_sections, axis=axis)),
+               (ary,), {}, name="array_split")
+    return list(res) if isinstance(res, tuple) else [res]
+
+
+def bfloat16_cast(a):
+    return a.astype(jnp.bfloat16)
+
+
+from . import linalg  # noqa: E402
+from . import random  # noqa: E402
+from . import fft  # noqa: E402
+
+__all__ = [n for n in _g if not n.startswith("_")]

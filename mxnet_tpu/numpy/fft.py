@@ -1,0 +1,18 @@
+"""``mx.np.fft`` — lifted from jnp.fft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import wrap_op
+
+_NAMES = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
+          "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft",
+          "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+_g = globals()
+for _name in _NAMES:
+    _j = getattr(jnp.fft, _name, None)
+    if _j is not None:
+        _g[_name] = wrap_op(_j, f"fft.{_name}")
+
+__all__ = [n for n in _NAMES if n in _g]

@@ -1,0 +1,22 @@
+"""``mx.np.linalg`` — lifted from jnp.linalg (ref: src/operator/numpy/linalg/,
+python/mxnet/numpy/linalg.py). XLA lowers these to MXU-friendly HLO."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import wrap_op
+
+_NAMES = [
+    "norm", "cholesky", "qr", "svd", "svdvals", "eig", "eigh", "eigvals",
+    "eigvalsh", "inv", "pinv", "solve", "lstsq", "det", "slogdet",
+    "matrix_rank", "matrix_power", "multi_dot", "tensorinv", "tensorsolve",
+    "cond", "matmul", "outer", "cross", "trace", "diagonal",
+]
+
+_g = globals()
+for _name in _NAMES:
+    _j = getattr(jnp.linalg, _name, None)
+    if _j is not None:
+        _g[_name] = wrap_op(_j, f"linalg.{_name}")
+
+__all__ = [n for n in _NAMES if n in _g]

@@ -1,0 +1,369 @@
+"""``mx.npx`` — NumPy-extension namespace: the NN operator surface.
+
+Ref: python/mxnet/numpy_extension/ + the ``_npx_*`` op shims (src/api/operator).
+Each function lifts a pure kernel from ops.nn into NDArray land with autograd
+via ops.dispatch. Stateful semantics handled here, not in kernels:
+  * batch_norm mutates moving_mean/var in-place like the reference kernel
+    (src/operator/nn/batch_norm.cc) — via NDArray._set_data so jit traces
+    capture the update;
+  * dropout / rrelu draw from the global RNG (mxnet_tpu.random) and are
+    identity under predict mode (autograd.is_training gates, matching
+    mode-dependent ops in the reference).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ops import nn as _nn
+from ..ops.dispatch import call, invoke, wrap_op
+from ..random import next_key
+from ..util import is_np_array, set_np, reset_np  # noqa: F401
+
+__all__ = [
+    "activation", "leaky_relu", "relu", "sigmoid", "fully_connected",
+    "convolution", "deconvolution", "pooling", "batch_norm", "layer_norm",
+    "group_norm", "instance_norm", "lrn", "dropout", "softmax", "log_softmax",
+    "masked_softmax", "masked_log_softmax", "softmax_cross_entropy",
+    "embedding", "one_hot", "pick", "topk", "sequence_mask", "sequence_last",
+    "sequence_reverse", "rnn", "gamma", "gammaln", "erf", "erfinv", "digamma",
+    "reshape_like", "slice_like", "broadcast_like", "shape_array", "batch_dot",
+    "arange_like", "gather_nd", "scatter_nd", "index_update", "index_add",
+    "smooth_l1", "all_finite", "multi_sum_sq", "clip_by_global_norm",
+    "waitall", "load", "save", "set_np", "reset_np", "is_np_array",
+    "cpu", "gpu", "tpu", "num_gpus", "num_tpus", "current_context",
+]
+
+from ..context import cpu, gpu, tpu, num_gpus, num_tpus, current_context  # noqa: E402
+from ..ndarray import waitall  # noqa: E402
+from ..ndarray.utils import load, save  # noqa: E402
+
+
+# -- activations -------------------------------------------------------------
+
+def activation(data, act_type: str = "relu", **kw):
+    return call(lambda x: _nn.activation(x, act_type), (data,), {}, name=f"activation_{act_type}")
+
+
+def leaky_relu(data, gamma=None, act_type: str = "leaky", slope: float = 0.25,
+               lower_bound: float = 0.125, upper_bound: float = 0.334, **kw):
+    key = None
+    if act_type == "rrelu" and autograd.is_training():
+        key = next_key()
+    args = (data, gamma) if gamma is not None else (data,)
+
+    def f(x, g=None):
+        return _nn.leaky_relu(x, g, act_type=act_type, slope=slope,
+                              lower_bound=lower_bound, upper_bound=upper_bound,
+                              rng_key=key)
+
+    return call(f, args, {}, name=f"leaky_relu_{act_type}")
+
+
+relu = wrap_op(jax.nn.relu, "relu")
+sigmoid = wrap_op(jax.nn.sigmoid, "sigmoid")
+erf = wrap_op(jax.scipy.special.erf, "erf")
+erfinv = wrap_op(jax.scipy.special.erfinv, "erfinv")
+gamma = wrap_op(lambda x: jnp.exp(jax.scipy.special.gammaln(x)), "gamma")
+gammaln = wrap_op(jax.scipy.special.gammaln, "gammaln")
+digamma = wrap_op(jax.scipy.special.digamma, "digamma")
+
+
+# -- layers ------------------------------------------------------------------
+
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True, **kw):
+    args = (x, weight) if bias is None or no_bias else (x, weight, bias)
+
+    def f(xx, ww, bb=None):
+        return _nn.fully_connected(xx, ww, bb, no_bias=no_bias, flatten=flatten)
+
+    return call(f, args, {}, name="fully_connected")
+
+
+def convolution(data, weight, bias=None, kernel=None, stride=1, dilate=1,
+                pad=0, num_filter=None, num_group=1, no_bias=False,
+                layout=None, **kw):
+    args = (data, weight) if bias is None or no_bias else (data, weight, bias)
+
+    def f(x, w, b=None):
+        return _nn.convolution(x, w, b, stride=stride, dilate=dilate, pad=pad,
+                               num_group=num_group, no_bias=no_bias)
+
+    return call(f, args, {}, name="convolution")
+
+
+def deconvolution(data, weight, bias=None, kernel=None, stride=1, dilate=1,
+                  pad=0, adj=0, num_filter=None, num_group=1, no_bias=False,
+                  target_shape=None, **kw):
+    args = (data, weight) if bias is None or no_bias else (data, weight, bias)
+
+    def f(x, w, b=None):
+        return _nn.deconvolution(x, w, b, stride=stride, dilate=dilate, pad=pad,
+                                 adj=adj, num_group=num_group, no_bias=no_bias,
+                                 target_shape=target_shape)
+
+    return call(f, args, {}, name="deconvolution")
+
+
+def pooling(data, kernel=1, pool_type="max", stride=None, pad=0,
+            global_pool=False, count_include_pad=True,
+            pooling_convention="valid", layout=None, **kw):
+    return call(lambda x: _nn.pooling(x, kernel=kernel, pool_type=pool_type,
+                                      stride=stride, pad=pad, global_pool=global_pool,
+                                      count_include_pad=count_include_pad,
+                                      pooling_convention=pooling_convention),
+                (data,), {}, name=f"pooling_{pool_type}")
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1, **kw):
+    """Training mode updates running stats in place (see module docstring)."""
+    training = autograd.is_training()
+    if training and not use_global_stats:
+        res = call(lambda xx, g, b, m, v: _nn.batch_norm_train(
+            xx, g, b, m, v, eps=eps, momentum=momentum, axis=axis,
+            fix_gamma=fix_gamma),
+            (x, gamma, beta, running_mean, running_var), {}, name="batch_norm")
+        out, new_mean, new_var = res
+        running_mean._set_data(jax.lax.stop_gradient(new_mean._data))
+        running_var._set_data(jax.lax.stop_gradient(new_var._data))
+        if output_mean_var:
+            return out, new_mean, new_var
+        return out
+    out = call(lambda xx, g, b, m, v: _nn.batch_norm_infer(
+        xx, g, b, m, v, eps=eps, axis=axis, fix_gamma=fix_gamma),
+        (x, gamma, beta, running_mean, running_var), {}, name="batch_norm")
+    if output_mean_var:
+        return out, running_mean, running_var
+    return out
+
+
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5, **kw):
+    return call(lambda xx, g, b: _nn.layer_norm(xx, g, b, axis=axis, eps=eps),
+                (x, gamma, beta), {}, name="layer_norm")
+
+
+def group_norm(x, gamma, beta, num_groups=1, eps=1e-5, **kw):
+    return call(lambda xx, g, b: _nn.group_norm(xx, g, b, num_groups=num_groups, eps=eps),
+                (x, gamma, beta), {}, name="group_norm")
+
+
+def instance_norm(x, gamma, beta, eps=1e-5, **kw):
+    return call(lambda xx, g, b: _nn.instance_norm(xx, g, b, eps=eps),
+                (x, gamma, beta), {}, name="instance_norm")
+
+
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
+    return call(lambda x: _nn.lrn(x, alpha, beta, knorm, nsize), (data,), {}, name="lrn")
+
+
+def dropout(data, p=0.5, mode="training", axes=(), **kw):
+    if not autograd.is_training() and mode != "always":
+        return data
+    if p <= 0.0:
+        return data
+    key = next_key()
+    return call(lambda x: _nn.dropout(x, key, p=p, axes=axes), (data,), {}, name="dropout")
+
+
+# -- softmax -----------------------------------------------------------------
+
+def softmax(data, axis=-1, length=None, temperature=None, use_length=False, **kw):
+    if length is not None:
+        return call(lambda x, l: _nn.softmax(x, axis=axis, temperature=temperature,
+                                             length=l, use_length=True),
+                    (data, length), {}, name="softmax")
+    return call(lambda x: _nn.softmax(x, axis=axis, temperature=temperature),
+                (data,), {}, name="softmax")
+
+
+def log_softmax(data, axis=-1, temperature=None, **kw):
+    return call(lambda x: _nn.log_softmax(x, axis=axis, temperature=temperature),
+                (data,), {}, name="log_softmax")
+
+
+def masked_softmax(data, mask, axis=-1, temperature=1.0, **kw):
+    return call(lambda x, m: _nn.masked_softmax(x, m, axis=axis, temperature=temperature),
+                (data, mask), {}, name="masked_softmax")
+
+
+def masked_log_softmax(data, mask, axis=-1, temperature=1.0, **kw):
+    return call(lambda x, m: _nn.masked_log_softmax(x, m, axis=axis, temperature=temperature),
+                (data, mask), {}, name="masked_log_softmax")
+
+
+def softmax_cross_entropy(logits, labels, sparse_label=True, axis=-1, **kw):
+    return call(lambda lg, lb: _nn.softmax_cross_entropy(lg, lb, sparse_label=sparse_label,
+                                                         axis=axis),
+                (logits, labels), {}, name="softmax_cross_entropy")
+
+
+# -- indexing / misc ---------------------------------------------------------
+
+def embedding(data, weight, input_dim=None, output_dim=None, sparse_grad=False, **kw):
+    return call(lambda i, w: _nn.embedding(i, w), (data, weight), {}, name="embedding")
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32", **kw):
+    return call(lambda i: _nn.one_hot(i, depth, on_value, off_value, jnp.dtype(dtype)),
+                (data,), {}, name="one_hot")
+
+
+def pick(data, index, axis=-1, keepdims=False, mode="clip", **kw):
+    return call(lambda x, i: _nn.pick(x, i, axis=axis, keepdims=keepdims, mode=mode),
+                (data, index), {}, name="pick")
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False, dtype="float32", **kw):
+    return call(lambda x: _nn.topk(x, k=k, axis=axis, ret_typ=ret_typ,
+                                   is_ascend=is_ascend, dtype=jnp.dtype(dtype)),
+                (data,), {}, name="topk")
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0, **kw):
+    if sequence_length is None:
+        return call(lambda x: _nn.sequence_mask(x, None, False, value, axis),
+                    (data,), {}, name="sequence_mask")
+    return call(lambda x, l: _nn.sequence_mask(x, l, True, value, axis),
+                (data, sequence_length), {}, name="sequence_mask")
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0, **kw):
+    if sequence_length is None:
+        return call(lambda x: _nn.sequence_last(x, None, False, axis), (data,), {},
+                    name="sequence_last")
+    return call(lambda x, l: _nn.sequence_last(x, l, True, axis),
+                (data, sequence_length), {}, name="sequence_last")
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0, **kw):
+    if sequence_length is None:
+        return call(lambda x: _nn.sequence_reverse(x, None, False, axis), (data,), {},
+                    name="sequence_reverse")
+    return call(lambda x, l: _nn.sequence_reverse(x, l, True, axis),
+                (data, sequence_length), {}, name="sequence_reverse")
+
+
+# -- shape helpers -----------------------------------------------------------
+
+def reshape_like(lhs, rhs, **kw):
+    return call(lambda a, b: a.reshape(b.shape), (lhs, rhs), {}, name="reshape_like")
+
+
+def slice_like(data, shape_like, axes=None, **kw):
+    def f(a, b):
+        slices = [slice(None)] * a.ndim
+        ax = axes if axes is not None else range(a.ndim)
+        for i in ax:
+            slices[i] = slice(0, b.shape[i])
+        return a[tuple(slices)]
+
+    return call(f, (data, shape_like), {}, name="slice_like")
+
+
+def broadcast_like(lhs, rhs, **kw):
+    return call(lambda a, b: jnp.broadcast_to(a, b.shape), (lhs, rhs), {},
+                name="broadcast_like")
+
+
+def shape_array(data, **kw):
+    return NDArray(jnp.asarray(data.shape, dtype=jnp.int64))
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None, **kw):
+    n = data.size if axis is None else data.shape[axis]
+    return NDArray(jnp.arange(n, dtype=jnp.float32) * step + start)
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False, **kw):
+    from ..ndarray import batch_dot as _bd
+
+    return _bd(a, b, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def gather_nd(data, indices, **kw):
+    def f(x, idx):
+        idx = idx.astype(jnp.int32)
+        return x[tuple(idx[i] for i in range(idx.shape[0]))]
+
+    return call(f, (data, indices), {}, name="gather_nd")
+
+
+def scatter_nd(data, indices, shape, **kw):
+    def f(v, idx):
+        idx = idx.astype(jnp.int32)
+        out = jnp.zeros(shape, v.dtype)
+        return out.at[tuple(idx[i] for i in range(idx.shape[0]))].set(v)
+
+    return call(f, (data, indices), {}, name="scatter_nd")
+
+
+def index_update(data, indices, val, **kw):
+    return call(lambda x, i, v: x.at[tuple(i.astype(jnp.int32)[k] for k in range(i.shape[0]))].set(v),
+                (data, indices, val), {}, name="index_update")
+
+
+def index_add(data, indices, val, **kw):
+    return call(lambda x, i, v: x.at[tuple(i.astype(jnp.int32)[k] for k in range(i.shape[0]))].add(v),
+                (data, indices, val), {}, name="index_add")
+
+
+def smooth_l1(data, scalar=1.0, **kw):
+    def f(x):
+        s2 = scalar * scalar
+        return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * x * x,
+                         jnp.abs(x) - 0.5 / s2)
+
+    return call(f, (data,), {}, name="smooth_l1")
+
+
+# -- AMP helpers (ref: src/operator/all_finite.cc) ---------------------------
+
+def all_finite(data, init_output=True, **kw):
+    """1.0 if every element finite else 0.0 — grad-scan for the loss scaler."""
+    return call(lambda x: jnp.isfinite(x).all().astype(jnp.float32), (data,), {},
+                name="all_finite")
+
+
+def multi_all_finite(*arrays, num_arrays=None, init_output=True, **kw):
+    return invoke(lambda *xs: jnp.stack([jnp.isfinite(x).all() for x in xs]).all()
+                  .astype(jnp.float32), list(arrays), name="multi_all_finite")
+
+
+def multi_sum_sq(*arrays, num_arrays=None, **kw):
+    return invoke(lambda *xs: tuple(jnp.sum(jnp.square(x)) for x in xs),
+                  list(arrays), name="multi_sum_sq")
+
+
+def clip_by_global_norm(arrays, max_norm: float):
+    """Utility used by trainers (gluon Trainer has clip_gradient per-array;
+    global-norm clip is the transformer-era extra)."""
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data)) for a in arrays))
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-12))
+    for a in arrays:
+        a._set_data(a._data * scale)
+    return float(total)
+
+
+# -- fused RNN (ref: src/operator/rnn.cc) ------------------------------------
+
+def rnn(data, parameters, state, state_cell=None, mode="lstm",
+        state_size=None, num_layers=1, bidirectional=False, p=0.0,
+        state_outputs=True, projection_size=None, sequence_length=None,
+        use_sequence_length=False, **kw):
+    from ..ops import rnn as _rnn
+
+    return _rnn.rnn_fused(data, parameters, state, state_cell, mode=mode,
+                          state_size=state_size, num_layers=num_layers,
+                          bidirectional=bidirectional, p=p,
+                          state_outputs=state_outputs,
+                          sequence_length=sequence_length,
+                          use_sequence_length=use_sequence_length)

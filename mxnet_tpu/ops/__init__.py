@@ -1,0 +1,10 @@
+"""Op layer: dispatch core + kernel corpus.
+
+Replaces the reference's NNVM op registry + 205k LoC of C++/CUDA kernels
+(SURVEY.md §2.2) with pure-jax kernels lowered by XLA. Modules:
+  dispatch — eager invoke + autograd capture (≈ src/imperative dispatch)
+  nn       — dense NN primitives (≈ src/operator/nn/)
+  rnn      — fused recurrent layers via lax.scan (≈ src/operator/rnn.cc)
+"""
+from .dispatch import invoke, call, wrap_op, infer_shape
+from . import nn

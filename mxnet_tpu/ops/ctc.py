@@ -1,0 +1,66 @@
+"""CTC loss via log-space forward algorithm under lax.scan.
+
+Ref: src/operator/nn/ctc_loss.cc (warp-ctc / cuDNN CTC in the reference).
+TPU-native: static-shape dynamic programming over the extended label
+sequence, vectorized over batch; blank = 0 (reference convention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG = -1e30
+
+
+def ctc_loss(pred, labels, pred_lengths=None, label_lengths=None):
+    """pred: (N, T, C) logits or probabilities (softmax applied here);
+    labels: (N, L) int labels, 0 = blank/padding. Returns (N,) loss."""
+    n, t, c = pred.shape
+    logp = jax.nn.log_softmax(pred, axis=-1)
+    labels = labels.astype(jnp.int32)
+    l = labels.shape[1]
+    if label_lengths is None:
+        label_lengths = jnp.sum((labels > 0).astype(jnp.int32), axis=1)
+    else:
+        label_lengths = label_lengths.astype(jnp.int32)
+    if pred_lengths is None:
+        pred_lengths = jnp.full((n,), t, jnp.int32)
+    else:
+        pred_lengths = pred_lengths.astype(jnp.int32)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank → length 2L+1
+    s = 2 * l + 1
+    ext = jnp.zeros((n, s), jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    pos = jnp.arange(s)
+
+    # transition allowed from i-2 when ext[i] != blank and ext[i] != ext[i-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)))[:, :s]
+    skip_ok = (pos[None, :] % 2 == 1) & (ext != ext_m2) & (pos[None, :] >= 2)
+
+    alpha0 = jnp.full((n, s), NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2], 1)[:, 0])
+
+    def step(alpha, inputs):
+        lp_t, t_idx = inputs  # lp_t: (N, C)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)  # (N, S)
+        a_m1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=NEG)[:, :s]
+        a_m2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=NEG)[:, :s]
+        a_m2 = jnp.where(skip_ok, a_m2, NEG)
+        new = jnp.logaddexp(jnp.logaddexp(alpha, a_m1), a_m2) + emit
+        # keep old alpha for sequences already past their length
+        active = (t_idx < pred_lengths)[:, None]
+        new = jnp.where(active, new, alpha)
+        return new, None
+
+    lps = jnp.moveaxis(logp, 1, 0)  # (T, N, C)
+    alpha, _ = lax.scan(step, alpha0, (lps[1:], jnp.arange(1, t)))
+
+    end1 = 2 * label_lengths        # final blank
+    end2 = 2 * label_lengths - 1    # final label
+    a_end1 = jnp.take_along_axis(alpha, end1[:, None], 1)[:, 0]
+    a_end2 = jnp.take_along_axis(alpha, jnp.maximum(end2, 0)[:, None], 1)[:, 0]
+    ll = jnp.logaddexp(a_end1, jnp.where(label_lengths > 0, a_end2, NEG))
+    return -ll

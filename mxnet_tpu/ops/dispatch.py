@@ -1,0 +1,144 @@
+"""Eager op invocation — the hot path.
+
+TPU-native replacement for the reference's imperative dispatch chain
+(mx.np fn → FFI → Imperative::Invoke → engine → kernel; SURVEY.md §3.1,
+src/imperative/imperative.cc:49,98, imperative_utils.h:636). Here every op is
+a pure jax-traceable function; XLA/PJRT provides the async engine, memory
+planner and kernel fusion that MXNet hand-built (SURVEY.md §7 design stance),
+so "dispatch" reduces to: unwrap NDArrays → (optionally capture jax.vjp for
+the autograd tape) → run → wrap outputs.
+
+Shape/type inference (ref FInferShape/FInferType, imperative_utils.h:169
+SetShapeType) is delegated to jax's abstract evaluation — ``infer_shape``
+below exposes it for API parity.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError
+
+__all__ = ["invoke", "call", "infer_shape", "wrap_op"]
+
+
+def _wrap(data, like=None):
+    from ..ndarray import NDArray
+
+    return NDArray(data)
+
+
+def _is_inexact(x) -> bool:
+    try:
+        return jnp.issubdtype(x.dtype, jnp.inexact)
+    except Exception:
+        return False
+
+
+def invoke(fn: Callable, inputs: Sequence, name: str = "op",
+           n_out: Optional[int] = None, out=None):
+    """Execute ``fn(*raw_inputs)``, recording a tape node when autograd is on.
+
+    ``fn`` must be a pure jax function of exactly the raw arrays of
+    ``inputs`` (close over scalars/config). Returns NDArray or tuple thereof.
+    Analogue of Imperative::Invoke + RecordOp (imperative.cc:98,204).
+    """
+    from .. import autograd
+    from ..ndarray import NDArray
+
+    raw = [x._data for x in inputs]
+    recording = autograd.is_recording() and any(_is_inexact(r) for r in raw)
+
+    if recording:
+        try:
+            out_raw, vjp_fn = jax.vjp(fn, *raw)
+        except TypeError:
+            # fn not differentiable (e.g. integer outputs only) — run plain
+            out_raw, vjp_fn = fn(*raw), None
+    else:
+        out_raw, vjp_fn = fn(*raw), None
+
+    single = not isinstance(out_raw, (tuple, list))
+    outs_raw = [out_raw] if single else list(out_raw)
+
+    if recording and any(_is_inexact(o) for o in outs_raw):
+        node = autograd.Node(
+            vjp_fn, list(inputs), len(outs_raw), name,
+            [getattr(o, "shape", ()) for o in outs_raw],
+            [getattr(o, "dtype", jnp.float32) for o in outs_raw],
+            tuple_out=not single, fn=fn)
+        outs = []
+        for i, o in enumerate(outs_raw):
+            nd = NDArray(o)
+            nd._autograd_entry = (node, i)
+            outs.append(nd)
+    else:
+        outs = [NDArray(o) for o in outs_raw]
+
+    if out is not None:
+        if single:
+            out._set_data(outs[0]._data.astype(out._data.dtype)
+                          if out._data.dtype != outs[0]._data.dtype else outs[0]._data)
+            out._autograd_entry = getattr(outs[0], "_autograd_entry", None)
+            return out
+        raise MXNetError("out= is only supported for single-output ops")
+    return outs[0] if single else tuple(outs)
+
+
+def call(fn: Callable, args: Tuple, kwargs: dict, name: str = "op", out=None):
+    """Invoke ``fn`` on a mixed arg list: NDArrays become differentiable
+    inputs, everything else is closed over (the analogue of dmlc::Parameter
+    op params, SURVEY.md §2.2)."""
+    from ..ndarray import NDArray
+
+    nd_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+    nd_kw = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
+    nd_args = [args[i] for i in nd_pos] + [kwargs[k] for k in nd_kw]
+    if not nd_args:
+        # pure creation/config op
+        res = fn(*args, **kwargs)
+        single = not isinstance(res, (tuple, list))
+        if out is not None and single:
+            out._set_data(jnp.asarray(res))
+            return out
+        return _wrap(res) if single else tuple(_wrap(r) for r in res)
+
+    n_pos = len(nd_pos)
+
+    def f(*xs):
+        full = list(args)
+        kw = dict(kwargs)
+        for i, x in zip(nd_pos, xs[:n_pos]):
+            full[i] = x
+        for k, x in zip(nd_kw, xs[n_pos:]):
+            kw[k] = x
+        return fn(*full, **kw)
+
+    return invoke(f, nd_args, name=name, out=out)
+
+
+def wrap_op(jfn: Callable, name: Optional[str] = None):
+    """Lift a jnp-level function into an NDArray-level op with autograd."""
+    opname = name or getattr(jfn, "__name__", "op")
+
+    def op(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        return call(jfn, args, kwargs, name=opname, out=out)
+
+    op.__name__ = opname
+    op.__doc__ = getattr(jfn, "__doc__", None)
+    return op
+
+
+def infer_shape(fn: Callable, *arg_shapes, dtype=jnp.float32):
+    """Abstract-eval shape/dtype inference — parity surface for the
+    reference's InferShape pass (src/imperative/infer_graph_attr_pass.cc:553)."""
+    avals = [jax.ShapeDtypeStruct(s, dtype) if isinstance(s, tuple) else s
+             for s in arg_shapes]
+    out = jax.eval_shape(fn, *avals)
+    if isinstance(out, (tuple, list)):
+        return [(o.shape, o.dtype) for o in out]
+    return (out.shape, out.dtype)

@@ -1,0 +1,672 @@
+"""Optimizers (ref: python/mxnet/optimizer/, 3.9k LoC; fused update kernels
+src/operator/optimizer_op.cc:313-398).
+
+Same registry/API surface: ``create('sgd', ...)``, ``create_state``,
+``update(index, weight, grad, state)``, Updater for update-on-kvstore.
+TPU-native twist: each optimizer's math is one pure jitted function over
+(weight, grad, state, scalars); the Trainer can also batch ALL parameters
+into a single jitted pytree update (``update_multi``) — the analogue of the
+reference's multi-tensor ``multi_sgd_*`` aggregation
+(MXNET_OPTIMIZER_AGGREGATION_SIZE) with XLA doing the fusion.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, Registry, get_env
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Optimizer", "register", "create", "Updater", "get_updater",
+           "SGD", "NAG", "Adam", "AdamW", "Adamax", "Nadam", "RMSProp",
+           "AdaGrad", "AdaDelta", "Ftrl", "Signum", "LARS", "LAMB", "SGLD",
+           "DCASGD", "Test"]
+
+_REG: Registry = Registry("optimizer")
+
+
+def register(klass):
+    _REG.register(klass.__name__.lower(), klass)
+    return klass
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.get(name)(**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (ref python/mxnet/optimizer/optimizer.py)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, aggregate_num=None,
+                 use_fused_step=True, **extra):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.param_dict = param_dict or {}
+        self.idx2name = dict(param_idx2name or {})
+        self.lr_mult: Dict[Any, float] = {}
+        self.wd_mult: Dict[Any, float] = {}
+        self._index_update_count: Dict[Any, int] = {}
+        self.num_update = 0
+        self.begin_num_update = 0
+
+    # -- bookkeeping (ref optimizer.py _update_count / learning rates) ------
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            self._index_update_count.setdefault(idx, self.begin_num_update)
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx], self.num_update)
+
+    def _get_lr(self, index) -> float:
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        param = self.param_dict.get(index)
+        if param is not None:
+            lr *= getattr(param, "lr_mult", 1.0)
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        param = self.param_dict.get(index)
+        if param is not None:
+            wd *= getattr(param, "wd_mult", 1.0)
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been defined")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult.update(args_wd_mult)
+
+    # -- state --------------------------------------------------------------
+    def create_state(self, index, weight: NDArray):
+        return None
+
+    def create_state_multi_precision(self, index, weight: NDArray):
+        if self.multi_precision and weight.dtype == jnp.float16:
+            w32 = NDArray(weight._data.astype(jnp.float32))
+            return (w32, self.create_state(index, w32))
+        return self.create_state(index, weight)
+
+    # -- update -------------------------------------------------------------
+    def _prep_grad(self, grad):
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def step(self, indices, weights, grads, states):
+        for i, w, g, s in zip(indices, weights, grads, states):
+            self.update(i, w, g, s)
+
+    def update(self, index, weight: NDArray, grad: NDArray, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == jnp.float16:
+            w32, inner = state
+            g32 = NDArray(grad._data.astype(jnp.float32))
+            self.update(index, w32, g32, inner)
+            weight._set_data(w32._data.astype(jnp.float16))
+        else:
+            self.update(index, weight, grad, state)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr})"
+
+
+def _jit(fn):
+    return jax.jit(fn, donate_argnums=())
+
+
+# ---------------------------------------------------------------------------
+# concrete optimizers — each with a single jitted pure kernel
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("nesterov", "has_mom"))
+def _sgd_kernel(w, g, mom, lr, wd, rescale, clip, momentum, nesterov=False, has_mom=True):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -jnp.abs(clip), jnp.abs(clip)), g)
+    g = g + wd * w
+    if has_mom:
+        mom = momentum * mom - lr * g
+        if nesterov:
+            w = w + momentum * mom - lr * g
+        else:
+            w = w + mom
+    else:
+        w = w - lr * g
+    return w, mom
+
+
+@register
+class SGD(Optimizer):
+    """SGD + momentum (+nesterov) (ref optimizer/sgd.py; kernel
+    src/operator/optimizer_op.cc sgd_mom_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom = state._data if state is not None else jnp.zeros((), weight._data.dtype)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        nesterov = isinstance(self, NAG)
+        w, m = _sgd_kernel(weight._data, grad._data, mom, lr, wd,
+                           self.rescale_grad, clip, self.momentum,
+                           nesterov=nesterov, has_mom=state is not None)
+        weight._set_data(w)
+        if state is not None:
+            state._set_data(m)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (ref optimizer/nag.py)."""
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (ref optimizer/sgld.py)."""
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def update(self, index, weight, grad, state):
+        from ..random import next_key
+
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep_grad(grad._data) + wd * weight._data
+        noise = jax.random.normal(next_key(), weight.shape, weight._data.dtype) * math.sqrt(lr)
+        weight._set_data(weight._data - lr / 2 * g + noise)
+
+
+@jax.jit
+def _adam_kernel(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps, t):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -jnp.abs(clip), jnp.abs(clip)), g)
+    g = g + wd * w
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1 ** t)
+    vhat = v / (1 - beta2 ** t)
+    w = w - lr * mhat / (jnp.sqrt(vhat) + eps)
+    return w, m, v
+
+
+@register
+class Adam(Optimizer):
+    """Ref optimizer/adam.py; kernel src/operator/optimizer_op.cc adam_update."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        m, v = state
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        w, mm, vv = _adam_kernel(weight._data, grad._data, m._data, v._data,
+                                 lr, wd, self.rescale_grad, clip,
+                                 self.beta1, self.beta2, self.epsilon, t)
+        weight._set_data(w)
+        m._set_data(mm)
+        v._set_data(vv)
+
+
+@jax.jit
+def _adamw_kernel(w, g, m, v, lr, eta, wd, rescale, clip, beta1, beta2, eps, t):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -jnp.abs(clip), jnp.abs(clip)), g)
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1 ** t)
+    vhat = v / (1 - beta2 ** t)
+    w = w - eta * (lr * mhat / (jnp.sqrt(vhat) + eps) + wd * w)
+    return w, m, v
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay (ref optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, correct_bias=True, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kwargs)
+        self.eta = eta
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        m, v = state
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        w, mm, vv = _adamw_kernel(weight._data, grad._data, m._data, v._data,
+                                  lr, self.eta, wd, self.rescale_grad, clip,
+                                  self.beta1, self.beta2, self.epsilon, t)
+        weight._set_data(w)
+        m._set_data(mm)
+        v._set_data(vv)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        lr /= (1 - self.beta1 ** t)
+        m, u = state
+        g = self._prep_grad(grad._data) + wd * weight._data
+        mm = self.beta1 * m._data + (1 - self.beta1) * g
+        uu = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        weight._set_data(weight._data - lr * mm / (uu + 1e-8))
+        m._set_data(mm)
+        u._set_data(uu)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep_grad(grad._data) + wd * weight._data
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        mm = self.beta1 * m._data + (1 - self.beta1) * g
+        vv = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        g_prime = g / (1 - self.m_schedule)
+        m_prime = mm / (1 - m_schedule_next)
+        v_prime = vv / (1 - self.beta2 ** t)
+        m_bar = (1 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._set_data(weight._data - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon))
+        m._set_data(mm)
+        v._set_data(vv)
+
+
+@register
+class RMSProp(Optimizer):
+    """Ref optimizer/rmsprop.py (Tieleman&Hinton / Graves centered variants)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum, self.epsilon = rho, momentum, epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        if self.centered:
+            return (NDArray(z), NDArray(z), NDArray(z))
+        return (NDArray(z),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep_grad(grad._data) + wd * weight._data
+        if self.centered:
+            n, gm, delta = state
+            nn = self.rho * n._data + (1 - self.rho) * jnp.square(g)
+            gg = self.rho * gm._data + (1 - self.rho) * g
+            dd = self.momentum * delta._data - lr * g / jnp.sqrt(nn - jnp.square(gg) + self.epsilon)
+            w = weight._data + dd
+            n._set_data(nn)
+            gm._set_data(gg)
+            delta._set_data(dd)
+        else:
+            (n,) = state
+            nn = self.rho * n._data + (1 - self.rho) * jnp.square(g)
+            w = weight._data - lr * g / jnp.sqrt(nn + self.epsilon)
+            n._set_data(nn)
+        if self.clip_weights:
+            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+        weight._set_data(w)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep_grad(grad._data) + wd * weight._data
+        hh = state._data + jnp.square(g)
+        weight._set_data(weight._data - lr * g / (jnp.sqrt(hh) + self.epsilon))
+        state._set_data(hh)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = self._prep_grad(grad._data) + wd * weight._data
+        acc_g, acc_delta = state
+        ag = self.rho * acc_g._data + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / jnp.sqrt(ag + self.epsilon) * g
+        ad = self.rho * acc_delta._data + (1 - self.rho) * jnp.square(delta)
+        weight._set_data(weight._data - self.lr * delta)
+        acc_g._set_data(ag)
+        acc_delta._set_data(ad)
+
+
+@register
+class Ftrl(Optimizer):
+    """Ref optimizer/ftrl.py (ftrl_update kernel)."""
+
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep_grad(grad._data)
+        z, n = state
+        nn = n._data + jnp.square(g)
+        sigma = (jnp.sqrt(nn) - jnp.sqrt(n._data)) / lr
+        zz = z._data + g - sigma * weight._data
+        w = jnp.where(jnp.abs(zz) > self.lamda1,
+                      -(zz - jnp.sign(zz) * self.lamda1) /
+                      ((self.beta + jnp.sqrt(nn)) / lr + wd), 0.0)
+        weight._set_data(w.astype(weight._data.dtype))
+        z._set_data(zz)
+        n._set_data(nn)
+
+
+@register
+class Signum(Optimizer):
+    """signSGD + momentum (ref optimizer/signum.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep_grad(grad._data) + wd * weight._data
+        if state is not None:
+            mm = self.momentum * state._data - (1 - self.momentum) * g
+            w = (1 - lr * self.wd_lh) * weight._data + lr * jnp.sign(mm)
+            state._set_data(mm)
+        else:
+            w = (1 - lr * self.wd_lh) * weight._data - lr * jnp.sign(g)
+        weight._set_data(w)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (ref optimizer/lars.py)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep_grad(grad._data)
+        w_norm = jnp.linalg.norm(weight._data)
+        g_norm = jnp.linalg.norm(g)
+        ratio = jnp.where((w_norm > 0) & (g_norm > 0),
+                          self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon),
+                          1.0)
+        g = g + wd * weight._data
+        if state is not None:
+            mm = self.momentum * state._data + lr * ratio * g
+            weight._set_data(weight._data - mm)
+            state._set_data(mm)
+        else:
+            weight._set_data(weight._data - lr * ratio * g)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for batch training (ref optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep_grad(grad._data)
+        m, v = state
+        mm = self.beta1 * m._data + (1 - self.beta1) * g
+        vv = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        if self.bias_correction:
+            mhat = mm / (1 - self.beta1 ** t)
+            vhat = vv / (1 - self.beta2 ** t)
+        else:
+            mhat, vhat = mm, vv
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * weight._data
+        w_norm = jnp.linalg.norm(weight._data)
+        r_norm = jnp.linalg.norm(r)
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        weight._set_data(weight._data - lr * ratio * r)
+        m._set_data(mm)
+        v._set_data(vv)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (ref optimizer/dcasgd.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.lamda = momentum, lamda
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z) if self.momentum != 0.0 else None, NDArray(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep_grad(grad._data) + wd * weight._data
+        mom, prev = state
+        comp = g + self.lamda * g * g * (weight._data - prev._data)
+        if mom is not None:
+            mm = self.momentum * mom._data - lr * comp
+            w = weight._data + mm
+            mom._set_data(mm)
+        else:
+            w = weight._data - lr * comp
+        prev._set_data(weight._data)
+        weight._set_data(w)
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer used by reference tests (optimizer.py Test)."""
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        weight._set_data(weight._data - self.lr * self._prep_grad(grad._data))
+        state._set_data(state._data + grad._data)
+
+
+class Updater:
+    """Serializable update closure for update-on-kvstore
+    (ref python/mxnet/optimizer/updater.py:31)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        payload = {k: _states_to_numpy(v) for k, v in self.states.items()}
+        return pickle.dumps((payload, self.optimizer) if dump_optimizer else payload)
+
+    def set_states(self, states):
+        import pickle
+
+        obj = pickle.loads(states)
+        if isinstance(obj, tuple):
+            payload, self.optimizer = obj
+        else:
+            payload = obj
+        self.states = {k: _states_from_numpy(v) for k, v in payload.items()}
+        self.states_synced = {k: False for k in self.states}
+
+
+def _states_to_numpy(s):
+    if s is None:
+        return None
+    if isinstance(s, NDArray):
+        return s.asnumpy()
+    if isinstance(s, tuple):
+        return tuple(_states_to_numpy(x) for x in s)
+    return s
+
+
+def _states_from_numpy(s):
+    import numpy as _onp
+
+    if s is None:
+        return None
+    if isinstance(s, _onp.ndarray):
+        return NDArray(jnp.asarray(s))
+    if isinstance(s, tuple):
+        return tuple(_states_from_numpy(x) for x in s)
+    return s
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

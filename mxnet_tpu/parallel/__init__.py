@@ -1,0 +1,20 @@
+"""parallel — meshes, sharding rules, SPMD train steps, collectives.
+
+This subsystem has NO reference counterpart at its level of generality: the
+reference implements data parallelism only (SURVEY.md §2.3/§5 — KVStore
+flavors over NCCL/ps-lite). TPU-native design per the north star: a
+``jax.sharding.Mesh`` over the pod slice, named axes (dp/fsdp/tp/sp/ep/pp),
+sharding rules annotated on parameter/activation pytrees, XLA inserting
+ICI/DCN collectives. Modules:
+
+  mesh        — mesh construction & axis conventions
+  collectives — psum/all_gather/ppermute wrappers (the NCCL-API analogue)
+  trainer     — SPMD train-step builder (dp + tp + sp composable)
+  ring        — ring attention (sequence parallelism over the sp axis)
+"""
+from .mesh import (make_mesh, default_mesh, data_parallel_spec,
+                   MeshConfig, with_sharding)
+from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
+                          broadcast_from, barrier)
+from .trainer import ShardedTrainer, make_train_step, shard_params
+from . import ring

@@ -1,0 +1,65 @@
+"""Collective wrappers — the NCCL/ps-lite API analogue over XLA.
+
+Ref mapping (SURVEY.md §2.3): ncclAllReduce/tree-reduce (src/kvstore/comm.h,
+comm_tree.h, gpu_topology.h) → lax.psum over a mesh axis; ps-lite ZPush/ZPull
+→ nothing (SPMD replaces the server). These helpers are valid *inside*
+shard_map/pjit-traced functions; the hand-built PCIe spanning trees of the
+reference are replaced by XLA's ICI routing.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+from jax import lax
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "ppermute",
+           "broadcast_from", "barrier", "axis_index", "axis_size"]
+
+AxisName = Union[str, Sequence[str]]
+
+
+def all_reduce(x, axis_name: AxisName = "dp", op: str = "sum"):
+    """≈ ncclAllReduce (src/kvstore/kvstore_nccl.h)."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unknown all_reduce op {op}")
+
+
+def all_gather(x, axis_name: AxisName = "dp", axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: AxisName = "dp", axis: int = 0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def ppermute(x, perm, axis_name: AxisName = "sp"):
+    """Neighbor exchange — the ring-attention building block."""
+    return lax.ppermute(x, axis_name, perm)
+
+
+def broadcast_from(x, axis_name: AxisName = "dp", src: int = 0):
+    """≈ KVStore broadcast (comm.h Broadcast): take src's value everywhere."""
+    idx = lax.axis_index(axis_name)
+    masked = jax.numpy.where(idx == src, x, jax.numpy.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def barrier(axis_name: AxisName = "dp"):
+    """Synchronization fence (≈ engine WaitForAll across ranks)."""
+    return lax.psum(jax.numpy.ones(()), axis_name)
+
+
+def axis_index(axis_name: AxisName = "dp"):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str = "dp"):
+    return lax.axis_size(axis_name) if hasattr(lax, "axis_size") else lax.psum(1, axis_name)

@@ -1,0 +1,85 @@
+"""Device mesh construction + axis conventions.
+
+Axis names follow the scaling-book convention: 'dp' (data), 'fsdp'
+(parameter shard over data), 'tp' (tensor/model), 'sp' (sequence/context),
+'ep' (expert), 'pp' (pipeline stage). A 1-axis dp mesh reproduces the
+reference's data parallelism (KVStore); everything else is new capability.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as _onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "default_mesh", "MeshConfig", "data_parallel_spec",
+           "with_sharding", "P"]
+
+
+@dataclass
+class MeshConfig:
+    """Named axis sizes; -1 on one axis = fill with remaining devices."""
+
+    dp: int = -1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+
+    def axes(self) -> Dict[str, int]:
+        return {k: v for k, v in (("dp", self.dp), ("tp", self.tp),
+                                  ("sp", self.sp), ("pp", self.pp),
+                                  ("ep", self.ep))}
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None, **kw) -> Mesh:
+    """Build a Mesh from named axis sizes; one axis may be -1 (auto).
+
+    make_mesh({'dp': -1})  — pure data parallel over all chips
+    make_mesh({'dp': -1, 'tp': 4})  — dp × 4-way tensor parallel
+    """
+    if axes is None:
+        axes = {"dp": -1}
+    axes = dict(axes, **kw)
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    fixed = 1
+    auto_axis = None
+    for name, size in axes.items():
+        if size == -1:
+            if auto_axis is not None:
+                raise MXNetError("only one mesh axis may be -1")
+            auto_axis = name
+        else:
+            fixed *= size
+    if auto_axis is not None:
+        if n % fixed:
+            raise MXNetError(f"{n} devices not divisible by fixed axes {fixed}")
+        axes[auto_axis] = n // fixed
+    total = 1
+    for v in axes.values():
+        total *= v
+    if total != n:
+        raise MXNetError(f"mesh {axes} needs {total} devices, have {n}")
+    names = tuple(axes)
+    shape = tuple(axes[a] for a in names)
+    arr = _onp.array(devices).reshape(shape)
+    return Mesh(arr, names)
+
+
+def default_mesh() -> Mesh:
+    """All devices on one 'dp' axis (the reference's multi-GPU DP analogue)."""
+    return make_mesh({"dp": -1})
+
+
+def data_parallel_spec(mesh: Mesh):
+    """(input spec, param spec) for plain DP: batch over dp, params replicated."""
+    return P("dp"), P()
+
+
+def with_sharding(mesh: Mesh, spec: P):
+    return NamedSharding(mesh, spec)

@@ -1,0 +1,140 @@
+"""mx.profiler — tracing/profiling API over jax.profiler.
+
+Ref: python/mxnet/profiler.py + src/profiler/ (2.9k LoC chrome-tracing
+collector). TPU-native: XProf/perfetto traces come from jax.profiler
+(start_trace/stop_trace, TraceAnnotation ≈ ProfileTask/named scopes);
+set_config/set_state/dumps keep the reference API. Autostart via
+MXNET_PROFILER_AUTOSTART like the reference (env_var.md:246).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from typing import Optional
+
+import jax
+
+from .base import get_env
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
+           "resume", "Scope", "Task", "Frame", "Event", "Counter", "Marker"]
+
+_config = {"filename": "profile.json", "profile_all": False, "aggregate_stats": False}
+_state = {"running": False, "dir": None}
+_counters = {}
+
+
+def set_config(**kwargs):
+    """Ref profiler.py set_config: filename, profile_{symbolic,imperative,
+    memory,api,all}, aggregate_stats... The trace directory derives from
+    filename."""
+    _config.update(kwargs)
+
+
+def set_state(state_name: str = "stop", profile_process: str = "worker"):
+    if state_name == "run" and not _state["running"]:
+        logdir = os.path.splitext(_config.get("filename", "profile.json"))[0] + "_xprof"
+        os.makedirs(logdir, exist_ok=True)
+        jax.profiler.start_trace(logdir)
+        _state.update(running=True, dir=logdir)
+    elif state_name == "stop" and _state["running"]:
+        jax.profiler.stop_trace()
+        _state.update(running=False)
+
+
+def state() -> str:
+    return "run" if _state["running"] else "stop"
+
+
+def pause(profile_process="worker"):
+    set_state("stop")
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+def dump(finished: bool = True, profile_process: str = "worker"):
+    if _state["running"]:
+        set_state("stop")
+
+
+def dumps(reset: bool = False, format: str = "table") -> str:
+    """Aggregate-stats text (ref profiler.py dumps). Counter table only —
+    kernel-level stats live in the XProf trace."""
+    lines = ["Profile Statistics:"]
+    for name, v in _counters.items():
+        lines.append(f"  {name}: {v}")
+    if reset:
+        _counters.clear()
+    return "\n".join(lines)
+
+
+class Scope:
+    """Named scope annotated into the device trace (≈ ProfileOperator)."""
+
+    def __init__(self, name: str = "<unk>:"):
+        self.name = name
+        self._ctx = None
+
+    def __enter__(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ctx.__exit__(*exc)
+
+
+class Task:
+    """Ref profiler.py Task — host-side duration."""
+
+    def __init__(self, domain=None, name: str = "task"):
+        self.name = name
+        self._start = None
+
+    def start(self):
+        self._start = time.monotonic()
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def stop(self):
+        if self._start is not None:
+            self._ann.__exit__(None, None, None)
+            _counters[f"task:{self.name}:sec"] = time.monotonic() - self._start
+            self._start = None
+
+
+Frame = Task
+Event = Task
+
+
+class Counter:
+    """Ref profiler.py Counter."""
+
+    def __init__(self, domain=None, name: str = "counter", value: int = 0):
+        self.name = name
+        _counters[name] = value
+
+    def set_value(self, v):
+        _counters[self.name] = v
+
+    def increment(self, delta=1):
+        _counters[self.name] = _counters.get(self.name, 0) + delta
+
+    def decrement(self, delta=1):
+        _counters[self.name] = _counters.get(self.name, 0) - delta
+
+
+class Marker:
+    def __init__(self, domain=None, name: str = "marker"):
+        self.name = name
+
+    def mark(self, scope="process"):
+        _counters[f"marker:{self.name}"] = time.monotonic()
+
+
+if get_env("MXNET_PROFILER_AUTOSTART", 0, int):
+    set_state("run")
+    atexit.register(lambda: set_state("stop"))

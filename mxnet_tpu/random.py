@@ -1,0 +1,48 @@
+"""Global random state: ``mx.random.seed`` and per-draw key derivation.
+
+The reference keeps per-device Philox/mt19937 generator states inside the
+ResourceManager (include/mxnet/random_generator.h:84-158, src/resource.cc);
+ops request kRandom resources. TPU-native design: one global JAX PRNG key
+held in an NDArray so that (a) eager draws split it statefully, and
+(b) a ``jax.jit`` trace (hybridize) can lift the key to a traced input and
+capture the advanced key as an extra output via the NDArray mutation-watcher
+protocol — making dropout/random ops correctly re-randomized across jitted
+calls instead of baking one mask in.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["seed", "key_holder", "next_key", "split_key"]
+
+# raw uint32[2] representation so it serializes/travels like a normal array
+_KEY = NDArray(jax.random.key_data(jax.random.PRNGKey(0)))
+
+
+def key_holder() -> NDArray:
+    """The NDArray holding the current raw key; hybridize traces include it
+    in their implicit state so draws stay live under jit."""
+    return _KEY
+
+
+def seed(seed_state: int, ctx=None):
+    """Seed the global generator (ref: mx.random.seed python/mxnet/random.py)."""
+    _KEY._set_data(jax.random.key_data(jax.random.PRNGKey(int(seed_state))))
+
+
+def next_key():
+    """Advance the global state and return a fresh typed key for one draw."""
+    k = jax.random.wrap_key_data(_KEY._data)
+    new, sub = jax.random.split(k)
+    _KEY._set_data(jax.random.key_data(new))
+    return sub
+
+
+def split_key(n: int):
+    k = jax.random.wrap_key_data(_KEY._data)
+    keys = jax.random.split(k, n + 1)
+    _KEY._set_data(jax.random.key_data(keys[0]))
+    return keys[1:]

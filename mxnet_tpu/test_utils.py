@@ -1,0 +1,148 @@
+"""Test utilities (ref: python/mxnet/test_utils.py).
+
+Same surface the reference's op tests rely on (SURVEY.md §4):
+assert_almost_equal, check_numeric_gradient (finite differences),
+default_context, rand_ndarray, same/almost-equal helpers.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as _onp
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray
+from . import autograd
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+default_device = default_context
+
+
+def _as_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else _onp.asarray(x)
+
+
+def same(a, b) -> bool:
+    return _onp.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False) -> bool:
+    return _onp.allclose(_as_np(a), _as_np(b), rtol=rtol, atol=atol,
+                         equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
+    a_np, b_np = _as_np(a), _as_np(b)
+    if not _onp.allclose(a_np, b_np, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        err = _onp.abs(a_np - b_np)
+        rel = err / (_onp.abs(b_np) + atol)
+        raise AssertionError(
+            f"{names[0]} and {names[1]} differ: max abs err "
+            f"{err.max():.3e}, max rel err {rel.max():.3e}\n"
+            f"{names[0]}: {a_np.flatten()[:8]}...\n{names[1]}: {b_np.flatten()[:8]}...")
+
+
+def assert_allclose(a, b, rtol=1e-5, atol=1e-8):
+    assert_almost_equal(a, b, rtol=rtol, atol=atol)
+
+
+def rand_ndarray(shape, dtype=_onp.float32, ctx=None) -> NDArray:
+    from .numpy import random as npr
+
+    return npr.uniform(-1.0, 1.0, size=shape, dtype=dtype, ctx=ctx)
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(_onp.random.randint(1, dim + 1, size=ndim))
+
+
+def check_numeric_gradient(f: Callable, inputs: Sequence[NDArray],
+                           eps: float = 1e-3, rtol: float = 1e-2,
+                           atol: float = 1e-3, argnums: Optional[List[int]] = None):
+    """Finite-difference gradient check — the reference's core op-test tool
+    (test_utils.py check_numeric_gradient). ``f(*inputs)`` must return a
+    scalar-reducible NDArray; compares tape grads vs central differences."""
+    import jax.numpy as jnp
+
+    inputs = [x if isinstance(x, NDArray) else NDArray(jnp.asarray(x, jnp.float32))
+              for x in inputs]
+    argnums = list(range(len(inputs))) if argnums is None else argnums
+
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = f(*inputs)
+        loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    analytic = [inputs[i].grad.asnumpy() for i in argnums]
+
+    for gi, i in enumerate(argnums):
+        base = inputs[i].asnumpy().astype(_onp.float64)
+        fd = _onp.zeros_like(base)
+        flat = base.reshape(-1)
+        fdf = fd.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            inputs[i]._set_data(jnp.asarray(base.reshape(base.shape), jnp.float32))
+            with autograd.pause():
+                fp = float(_sum_of(f(*inputs)))
+            flat[j] = orig - eps
+            inputs[i]._set_data(jnp.asarray(base.reshape(base.shape), jnp.float32))
+            with autograd.pause():
+                fm = float(_sum_of(f(*inputs)))
+            flat[j] = orig
+            inputs[i]._set_data(jnp.asarray(base.reshape(base.shape), jnp.float32))
+            fdf[j] = (fp - fm) / (2 * eps)
+        assert_almost_equal(analytic[gi], fd, rtol=rtol, atol=atol,
+                            names=(f"analytic_grad[{i}]", f"numeric_grad[{i}]"))
+
+
+def _sum_of(out):
+    if isinstance(out, (list, tuple)):
+        return sum(float(o.sum().item()) for o in out)
+    return out.sum().item()
+
+
+def check_symbolic_forward(fn, inputs, expected, rtol=1e-5, atol=1e-20):
+    out = fn(*[NDArray(x) if not isinstance(x, NDArray) else x for x in inputs])
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o, e in zip(outs, expected):
+        assert_almost_equal(o, e, rtol=rtol, atol=atol)
+
+
+def discard_stderr(fn):
+    return fn
+
+
+class environment:
+    """Temporarily set env vars (ref test_utils environment)."""
+
+    def __init__(self, name, value=None):
+        self._items = name if isinstance(name, dict) else {name: value}
+
+    def __enter__(self):
+        import os
+
+        self._saved = {k: os.environ.get(k) for k in self._items}
+        for k, v in self._items.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        return self
+
+    def __exit__(self, *exc):
+        import os
+
+        for k, v in self._saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

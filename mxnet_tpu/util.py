@@ -1,0 +1,83 @@
+"""Global numpy-mode switches + misc utilities.
+
+Ref: python/mxnet/util.py:53,487,760 (set_np/use_np/np_shape/np_array).
+In the TPU build the NumPy array is the only array type, so these are
+compatibility no-ops that track the flag for introspection.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+_state = threading.local()
+
+
+def _flags():
+    if not hasattr(_state, "np_shape"):
+        _state.np_shape = True
+        _state.np_array = True
+    return _state
+
+
+def is_np_shape() -> bool:
+    return _flags().np_shape
+
+
+def is_np_array() -> bool:
+    return _flags().np_array
+
+
+def set_np_shape(active: bool) -> bool:
+    prev = _flags().np_shape
+    _flags().np_shape = bool(active)
+    return prev
+
+
+def set_np(shape: bool = True, array: bool = True, dtype: bool = False):
+    """Ref util.py:760. The TPU build is always NumPy-semantics; recorded for
+    compatibility."""
+    _flags().np_shape = shape
+    _flags().np_array = array
+
+
+def reset_np():
+    set_np(True, True)
+
+
+def use_np(func):
+    """Decorator form (ref util.py:487) — identity here."""
+    @functools.wraps(func)
+    def wrapped(*a, **kw):
+        return func(*a, **kw)
+
+    return wrapped
+
+
+use_np_array = use_np
+use_np_shape = use_np
+
+
+def np_shape(active: bool = True):
+    class _Scope:
+        def __enter__(self):
+            self.prev = set_np_shape(active)
+
+        def __exit__(self, *exc):
+            set_np_shape(self.prev)
+
+    return _Scope()
+
+
+np_array = np_shape
+
+
+def get_gpu_count() -> int:
+    from .context import num_gpus
+
+    return num_gpus()
+
+
+def getenv(name):
+    from .base import get_env
+
+    return get_env(name)

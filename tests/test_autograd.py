@@ -1,0 +1,161 @@
+"""Autograd tests (ref: tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_basic_backward():
+    x = mx.np.array([1., 2., 3.])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain():
+    x = mx.np.array([0.5, -0.5])
+    x.attach_grad()
+    with ag.record():
+        y = mx.np.exp(mx.np.sin(x)).sum()
+    y.backward()
+    expect = onp.exp(onp.sin(x.asnumpy())) * onp.cos(x.asnumpy())
+    assert_almost_equal(x.grad, expect, rtol=1e-5)
+
+
+def test_head_grad():
+    x = mx.np.array([1., 2.])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3
+    y.backward(mx.np.array([1., 10.]))
+    assert_almost_equal(x.grad, onp.array([3., 30.], onp.float32))
+
+
+def test_grad_add_req():
+    x = mx.np.array([1., 2.])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with ag.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(x.grad, onp.array([4., 4.], onp.float32))
+
+
+def test_recording_scopes():
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+        with ag.pause():
+            assert not ag.is_recording()
+        with ag.predict_mode():
+            assert not ag.is_training()
+    assert not ag.is_recording()
+
+
+def test_no_record_no_grad():
+    x = mx.np.array([1.0])
+    x.attach_grad()
+    y = x * 5  # outside record
+    assert y._autograd_entry is None
+
+
+def test_detach():
+    x = mx.np.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, onp.array([4.0], onp.float32))  # d(y_const*x)/dx = y = 4
+
+
+def test_mark_variables():
+    x = mx.np.array([1., 2.])
+    g = mx.np.zeros((2,))
+    ag.mark_variables([x], [g])
+    with ag.record():
+        (x * x).sum().backward()
+    assert_almost_equal(g, 2 * x.asnumpy())
+
+
+def test_autograd_grad_api():
+    x = mx.np.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+    (gx,) = ag.grad([y], [x])
+    assert_almost_equal(gx, onp.array([27.0], onp.float32))
+    # .grad untouched
+    assert_almost_equal(x.grad, onp.zeros(1, onp.float32))
+
+
+def test_higher_order():
+    x = mx.np.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+        gx = ag.grad(y, x, create_graph=True, retain_graph=True)
+    gx.backward()  # d(3x^2)/dx = 6x = 12
+    assert_almost_equal(x.grad, onp.array([12.0], onp.float32))
+
+
+def test_multi_output_and_shared_input():
+    x = mx.np.array([1., 2.])
+    x.attach_grad()
+    with ag.record():
+        y = x * x + x * 3  # x used twice
+    y.backward()  # non-scalar head seeds ones (reference semantics)
+    assert_almost_equal(x.grad, 2 * x.asnumpy() + 3)
+
+
+def test_function_custom_grad():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            import jax.numpy as jnp
+
+            y = mx.NDArray(1 / (1 + jnp.exp(-x._data)))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return mx.NDArray(dy._data * y._data * (1 - y._data))
+
+    f = Sigmoid()
+    x = mx.np.array([0.5])
+    x.attach_grad()
+    with ag.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + onp.exp(-0.5))
+    assert_almost_equal(x.grad, onp.array([s * (1 - s)], onp.float32), rtol=1e-5)
+
+
+def test_fd_gradient_checker():
+    check_numeric_gradient(lambda x: (x * x + 2 * x).sum(),
+                           [mx.np.array([0.3, -0.4, 0.7])])
+    check_numeric_gradient(lambda a, b: (a * b).sum(),
+                           [mx.np.array([1.0, 2.0]), mx.np.array([3.0, 4.0])])
+
+
+def test_training_flag_ops():
+    x = mx.np.ones((100,))
+    with ag.record(train_mode=True):
+        y = mx.npx.dropout(x, p=0.5)
+    assert float((y == 0).sum()) > 0
+    with ag.record(train_mode=False):
+        y2 = mx.npx.dropout(x, p=0.5)
+    assert float((y2 == 0).sum()) == 0
+
+
+def test_shape_error_is_sync():
+    # shape errors raise at op call like the reference's imperative
+    # SetShapeType (imperative_utils.h:169); value errors (inf/nan, OOB
+    # gather clipping) follow XLA semantics — documented divergence.
+    with pytest.raises(Exception):
+        mx.np.ones((2, 3)) @ mx.np.ones((4, 5))

@@ -1,0 +1,278 @@
+"""Gluon block/layer tests (ref: tests/python/unittest/test_gluon.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    return net
+
+
+def test_dense_deferred_init():
+    net = nn.Dense(3)
+    net.initialize()
+    x = mx.np.ones((2, 7))
+    y = net(x)
+    assert y.shape == (2, 3)
+    assert net.weight.shape == (3, 7)
+    # flatten semantics
+    net2 = nn.Dense(3, flatten=False)
+    net2.initialize()
+    y2 = net2(mx.np.ones((2, 5, 7)))
+    assert y2.shape == (2, 5, 3)
+
+
+def test_sequential_and_collect_params():
+    net = _mlp()
+    net.initialize()
+    net(mx.np.ones((2, 8)))
+    params = net.collect_params()
+    assert set(params) == {"0.weight", "0.bias", "1.weight", "1.bias"}
+    assert params["0.weight"].shape == (16, 8)
+    sel = net.collect_params(".*weight")
+    assert set(sel) == {"0.weight", "1.weight"}
+
+
+def test_hybridize_consistency():
+    net = _mlp()
+    net.initialize()
+    x = mx.np.random.uniform(size=(3, 6))
+    y_eager = net(x)
+    net.hybridize()
+    y1 = net(x)  # warmup (eager)
+    y2 = net(x)  # jitted
+    assert_almost_equal(y_eager, y1, rtol=1e-5)
+    assert_almost_equal(y1, y2, rtol=1e-5)
+
+
+def test_conv_block_shapes():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(), nn.Activation("relu"),
+            nn.MaxPool2D(), nn.Conv2D(16, 3, padding=1), nn.GlobalAvgPool2D(),
+            nn.Flatten(), nn.Dense(10))
+    net.initialize()
+    y = net(mx.np.ones((2, 3, 16, 16)))
+    assert y.shape == (2, 10)
+
+
+def test_save_load_parameters(tmp_path):
+    net = _mlp()
+    net.initialize()
+    x = mx.np.random.uniform(size=(2, 5))
+    y = net(x)
+    f = str(tmp_path / "mlp.params")
+    net.save_parameters(f)
+    net2 = _mlp()
+    net2.load_parameters(f)
+    assert_almost_equal(net2(x), y)
+    # mismatched name detection
+    net3 = nn.Dense(4)
+    with pytest.raises(Exception):
+        net3.load_parameters(f)
+
+
+def test_grad_req_and_zero_grad():
+    net = _mlp()
+    net.initialize()
+    x = mx.np.ones((2, 4))
+    with mx.autograd.record():
+        net(x).sum().backward()
+    w = net[0].weight
+    assert float(onp.abs(w.grad().asnumpy()).sum()) > 0
+    net.zero_grad()
+    assert float(onp.abs(w.grad().asnumpy()).sum()) == 0
+    net.setattr("grad_req", "null")
+    assert w.grad_req == "null"
+
+
+def test_layers_forward_semantics():
+    # Dropout identity in inference
+    d = nn.Dropout(0.5)
+    x = mx.np.ones((10, 10))
+    assert_almost_equal(d(x), x.asnumpy())
+    # Embedding
+    emb = nn.Embedding(20, 5)
+    emb.initialize()
+    out = emb(mx.np.array([1, 2], dtype=onp.int32))
+    assert out.shape == (2, 5)
+    # LayerNorm normalizes
+    ln = nn.LayerNorm()
+    ln.initialize()
+    y = ln(mx.np.random.uniform(size=(4, 8)))
+    assert abs(float(y.mean())) < 1e-5
+    # PReLU
+    pr = nn.PReLU()
+    pr.initialize()
+    out = pr(mx.np.array([[-2.0, 2.0]]))
+    assert_almost_equal(out, onp.array([[-0.5, 2.0]], onp.float32))
+    # GELU/SiLU/Swish run
+    for blk in (nn.GELU(), nn.SiLU(), nn.Swish(), nn.ELU(), nn.SELU()):
+        blk.initialize()
+        blk(mx.np.ones((2, 2)))
+
+
+def test_batchnorm_train_vs_eval():
+    bn = nn.BatchNorm()
+    bn.initialize()
+    x = mx.np.random.normal(0, 2, size=(8, 4))
+    with mx.autograd.record():
+        y_train = bn(x)
+    # batch-normalized output should have ~zero mean, unit var per channel
+    yn = y_train.asnumpy()
+    assert abs(yn.mean()) < 1e-4
+    assert onp.allclose(yn.var(axis=0), 1.0, atol=1e-2)
+    # running stats moved toward batch stats
+    assert not onp.allclose(bn.running_mean.data().asnumpy(), 0.0)
+    y_eval = bn(x)
+    assert not onp.allclose(y_eval.asnumpy(), yn)
+
+
+def test_conv_transpose():
+    net = nn.Conv2DTranspose(4, 3, strides=2, padding=1, output_padding=1)
+    net.initialize()
+    y = net(mx.np.ones((1, 2, 8, 8)))
+    assert y.shape == (1, 4, 16, 16)
+
+
+def test_block_apply_cast():
+    import jax.numpy as jnp
+
+    net = _mlp()
+    net.initialize()
+    net(mx.np.ones((1, 4)))
+    net.cast(jnp.float16)
+    assert net[0].weight.dtype == jnp.float16
+    seen = []
+    net.apply(lambda b: seen.append(type(b).__name__))
+    assert "Dense" in seen
+
+
+def test_forward_hooks():
+    net = nn.Dense(2)
+    net.initialize()
+    calls = []
+    h1 = net.register_forward_pre_hook(lambda blk, args: calls.append("pre"))
+    h2 = net.register_forward_hook(lambda blk, args, out: calls.append("post"))
+    net(mx.np.ones((1, 3)))
+    assert calls == ["pre", "post"]
+    h1.detach()
+    h2.detach()
+    calls.clear()
+    net(mx.np.ones((1, 3)))
+    assert calls == []
+
+
+def test_export_symbolblock(tmp_path):
+    net = _mlp()
+    net.initialize()
+    x = mx.np.random.uniform(size=(2, 6))
+    y = net(x)
+    path = str(tmp_path / "model")
+    net.export(path)
+    blk = mx.gluon.SymbolBlock.imports(path + "-symbol.stablehlo")
+    y2 = blk(x)
+    assert_almost_equal(y2, y, rtol=1e-5)
+
+
+def test_trainer_updates_params():
+    net = _mlp()
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.5})
+    x = mx.np.ones((2, 4))
+    net(x)  # trigger deferred init
+    w_before = net[0].weight.data().asnumpy().copy()
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(batch_size=2)
+    assert not onp.allclose(w_before, net[0].weight.data().asnumpy())
+    assert trainer.learning_rate == 0.5
+    trainer.set_learning_rate(0.1)
+    assert trainer.learning_rate == pytest.approx(0.1)
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = _mlp()
+    net.initialize()
+    t = mx.gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    x = mx.np.ones((2, 4))
+    for _ in range(2):
+        with mx.autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        t.step(2)
+    f = str(tmp_path / "trainer.states")
+    t.save_states(f)
+    t2 = mx.gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    t2.load_states(f)
+    assert set(t2._updaters[0].states.keys()) == set(t._updaters[0].states.keys())
+
+
+def test_losses():
+    gl = mx.gluon.loss
+    pred = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    label = mx.np.array([[1.5, 2.5], [2.0, 5.0]])
+    l2 = gl.L2Loss()(pred, label)
+    assert_almost_equal(l2, ((label.asnumpy() - pred.asnumpy()) ** 2 / 2).mean(1))
+    l1 = gl.L1Loss()(pred, label)
+    assert_almost_equal(l1, onp.abs(label.asnumpy() - pred.asnumpy()).mean(1))
+    logits = mx.np.random.uniform(size=(4, 5))
+    y = mx.np.array([0, 2, 4, 1], dtype=onp.int32)
+    ce = gl.SoftmaxCrossEntropyLoss()(logits, y)
+    lp = onp.log(onp.exp(logits.asnumpy()) /
+                 onp.exp(logits.asnumpy()).sum(-1, keepdims=True))
+    assert_almost_equal(ce, -lp[onp.arange(4), y.asnumpy()], rtol=1e-4)
+    bce = gl.SigmoidBCELoss()(mx.np.array([[0.0]]), mx.np.array([[1.0]]))
+    assert_almost_equal(bce, onp.array([onp.log(2)], onp.float32), rtol=1e-5)
+    h = gl.HuberLoss()(pred, label)
+    assert h.shape == (2,)
+    hinge = gl.HingeLoss()(mx.np.array([[0.5]]), mx.np.array([[1.0]]))
+    assert_almost_equal(hinge, onp.array([0.5], onp.float32))
+
+
+def test_ctc_loss():
+    T, N, C = 10, 2, 5
+    pred = mx.np.random.uniform(size=(N, T, C))
+    label = mx.np.array([[1, 2, 0, 0], [3, 3, 1, 0]], dtype=onp.int32)
+    loss = mx.gluon.loss.CTCLoss()(pred, label)
+    assert loss.shape == (N,)
+    assert bool((loss > 0).all())
+
+
+def test_metrics():
+    m = mx.gluon.metric.Accuracy()
+    m.update(mx.np.array([1, 0, 1]), mx.np.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7]]))
+    assert m.get()[1] == 1.0
+    m2 = mx.gluon.metric.MSE()
+    m2.update(mx.np.array([1.0, 2.0]), mx.np.array([1.5, 2.0]))
+    assert m2.get()[1] == pytest.approx(0.125)
+    comp = mx.gluon.metric.CompositeEvalMetric()
+    comp.add(mx.gluon.metric.Accuracy())
+    comp.add(mx.gluon.metric.TopKAccuracy(top_k=2))
+    comp.update(mx.np.array([1]), mx.np.array([[0.1, 0.9]]))
+    names, vals = comp.get()
+    assert vals[0] == 1.0 and vals[1] == 1.0
+    topk = mx.gluon.metric.TopKAccuracy(top_k=2)
+    topk.update(mx.np.array([2]), mx.np.array([[0.5, 0.3, 0.4]]))
+    assert topk.get()[1] == 1.0
+    ppl = mx.gluon.metric.Perplexity()
+    ppl.update(mx.np.array([0]), mx.np.array([[1.0, 0.0]]))
+    assert ppl.get()[1] == pytest.approx(1.0)
+
+
+def test_split_and_load():
+    data = mx.np.arange(12).reshape(6, 2)
+    parts = mx.gluon.split_and_load(data, [mx.cpu(0)])
+    assert len(parts) == 1
+    parts2 = mx.gluon.utils.split_data(data, 3)
+    assert [p.shape for p in parts2] == [(2, 2)] * 3
+    arrays = [mx.np.full((2,), 3.0), mx.np.full((2,), 4.0)]
+    total = mx.gluon.clip_global_norm(arrays, 1.0)
+    assert total == pytest.approx(onp.sqrt(2 * 9 + 2 * 16), rel=1e-4)
+    assert float(mx.np.linalg.norm(mx.np.concatenate(arrays))) <= 1.0001

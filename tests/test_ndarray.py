@@ -1,0 +1,160 @@
+"""NDArray semantics tests (ref: tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = mx.np.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == onp.float32
+    b = mx.np.ones((2, 3), dtype=onp.int32)
+    assert b.dtype == onp.int32
+    c = mx.np.array([[1, 2], [3, 4.5]])
+    assert_almost_equal(c, onp.array([[1, 2], [3, 4.5]], onp.float32))
+    assert mx.np.arange(5).shape == (5,)
+    assert mx.np.eye(3).shape == (3, 3)
+    assert mx.np.linspace(0, 1, 11).shape == (11,)
+    assert mx.np.full((2,), 7.0).asnumpy()[0] == 7.0
+
+
+def test_arithmetic():
+    a = mx.np.array([[1., 2.], [3., 4.]])
+    b = mx.np.array([[5., 6.], [7., 8.]])
+    assert_almost_equal(a + b, onp.array([[6, 8], [10, 12]], onp.float32))
+    assert_almost_equal(a - b, -onp.array([[4, 4], [4, 4]], onp.float32))
+    assert_almost_equal(a * 2, onp.array([[2, 4], [6, 8]], onp.float32))
+    assert_almost_equal(2 / a, 2 / a.asnumpy())
+    assert_almost_equal(a ** 2, a.asnumpy() ** 2)
+    assert_almost_equal(a @ b, a.asnumpy() @ b.asnumpy())
+    assert_almost_equal(-a, -a.asnumpy())
+    assert_almost_equal(abs(-a), a.asnumpy())
+    assert_almost_equal(10 - a, 10 - a.asnumpy())
+    assert_almost_equal(a % 2, a.asnumpy() % 2)
+
+
+def test_inplace_ops():
+    a = mx.np.ones((2, 2))
+    orig = a
+    a += 5
+    assert a is orig
+    assert_almost_equal(a, onp.full((2, 2), 6.0, onp.float32))
+    a *= 2
+    assert_almost_equal(a, onp.full((2, 2), 12.0, onp.float32))
+
+
+def test_indexing():
+    a = mx.np.arange(24).reshape(2, 3, 4)
+    npy = a.asnumpy()
+    assert_almost_equal(a[1], npy[1])
+    assert_almost_equal(a[:, 1], npy[:, 1])
+    assert_almost_equal(a[..., -1], npy[..., -1])
+    assert_almost_equal(a[0, 1:3], npy[0, 1:3])
+    idx = mx.np.array([0, 1], dtype=onp.int32)
+    assert_almost_equal(a[idx], npy[[0, 1]])
+    mask = a > 10
+    assert_almost_equal(a[mask], npy[npy > 10])
+
+
+def test_setitem():
+    a = mx.np.zeros((3, 3))
+    a[1] = 5.0
+    assert_almost_equal(a[1], onp.full((3,), 5.0, onp.float32))
+    a[0, 0] = -1
+    assert a[0, 0].item() == -1
+    a[:, 2] = mx.np.array([7., 8., 9.])
+    assert_almost_equal(a[:, 2], onp.array([7, 8, 9], onp.float32))
+
+
+def test_scalar_conversions():
+    a = mx.np.array([3.5])
+    assert float(a) == 3.5
+    assert int(mx.np.array([4])) == 4
+    assert bool(mx.np.array([1]))
+    with pytest.raises(Exception):
+        bool(mx.np.ones((2,)))
+    assert a.item() == 3.5
+
+
+def test_shape_methods():
+    a = mx.np.arange(12)
+    assert a.reshape(3, 4).shape == (3, 4)
+    assert a.reshape((3, 4)).shape == (3, 4)
+    assert a.reshape(3, 4).T.shape == (4, 3)
+    assert a.reshape(3, 4).transpose(1, 0).shape == (4, 3)
+    assert a.reshape(1, 12).squeeze().shape == (12,)
+    assert a.expand_dims(0).shape == (1, 12)
+    assert a.reshape(3, 4).flatten().shape == (12,)
+    assert len(a) == 12
+    assert a.size == 12
+    assert a.ndim == 1
+
+
+def test_reductions():
+    a = mx.np.array([[1., 5.], [3., 2.]])
+    assert a.sum().item() == 11.0
+    assert a.max().item() == 5.0
+    assert a.min().item() == 1.0
+    assert a.mean().item() == pytest.approx(2.75)
+    assert_almost_equal(a.sum(axis=0), onp.array([4, 7], onp.float32))
+    assert a.argmax().item() == 1
+    assert_almost_equal(a.argmax(axis=1), onp.array([1, 0]))
+
+
+def test_astype_copy():
+    a = mx.np.arange(4)
+    b = a.astype(onp.float16)
+    assert b.dtype == onp.float16
+    c = a.copy()
+    c[0] = 99
+    assert a[0].item() == 0
+
+
+def test_wait_and_ctx():
+    a = mx.np.ones((4,))
+    a.wait_to_read()
+    mx.nd.waitall()
+    assert a.ctx is not None
+    b = a.as_in_context(mx.cpu(0))
+    assert b.ctx == mx.cpu(0)
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs")
+    d = {"w": mx.np.random.uniform(size=(3, 3)), "b": mx.np.arange(3)}
+    mx.nd.save(f, d)
+    loaded = mx.nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], d["w"])
+    lst = [mx.np.ones((2,)), mx.np.zeros((3,))]
+    mx.nd.save(f, lst)
+    l2 = mx.nd.load(f)
+    assert len(l2) == 2 and l2[0].shape == (2,)
+    # bf16 roundtrip
+    import jax.numpy as jnp
+
+    bf = mx.np.ones((4,)).astype(jnp.bfloat16)
+    mx.nd.save(f, [bf])
+    back = mx.nd.load(f)[0]
+    assert back._data.dtype == jnp.bfloat16
+
+
+def test_concat_stack_split():
+    a, b = mx.np.ones((2, 3)), mx.np.zeros((2, 3))
+    assert mx.np.concatenate([a, b], axis=0).shape == (4, 3)
+    assert mx.np.stack([a, b]).shape == (2, 2, 3)
+    parts = mx.np.split(mx.np.arange(12).reshape(4, 3), 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+
+
+def test_legacy_nd_namespace():
+    a = mx.nd.array([[1., -2.], [3., -4.]])
+    assert_almost_equal(mx.nd.relu(a), onp.maximum(a.asnumpy(), 0))
+    assert_almost_equal(mx.nd.dot(a, a), a.asnumpy() @ a.asnumpy())
+    bd = mx.nd.batch_dot(mx.np.ones((2, 3, 4)), mx.np.ones((2, 4, 5)))
+    assert bd.shape == (2, 3, 5)
+    assert mx.nd.flatten(mx.np.ones((2, 3, 4))).shape == (2, 12)
+    oh = mx.nd.one_hot(mx.np.array([0, 2], dtype=onp.int32), 3)
+    assert_almost_equal(oh, onp.eye(3, dtype=onp.float32)[[0, 2]])

@@ -1,0 +1,205 @@
+"""mx.np op correctness vs NumPy + gradient spot checks
+(ref: tests/python/unittest/test_numpy_op.py — forward vs numpy reference,
+FD gradient checking per SURVEY.md §4)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+UNARY = ["exp", "log", "sqrt", "sin", "cos", "tanh", "abs", "square",
+         "floor", "ceil", "sign", "log1p", "expm1", "arctan", "sinh", "cosh"]
+BINARY = ["add", "subtract", "multiply", "true_divide", "maximum", "minimum",
+          "power", "arctan2", "hypot"]
+REDUCE = ["sum", "mean", "max", "min", "prod", "std", "var"]
+
+
+@pytest.mark.parametrize("name", UNARY)
+def test_unary_vs_numpy(name):
+    x = onp.random.uniform(0.1, 2.0, (3, 4)).astype(onp.float32)
+    got = getattr(mx.np, name)(mx.np.array(x))
+    want = getattr(onp, name)(x)
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", BINARY)
+def test_binary_vs_numpy(name):
+    a = onp.random.uniform(0.5, 2.0, (3, 4)).astype(onp.float32)
+    b = onp.random.uniform(0.5, 2.0, (4,)).astype(onp.float32)
+    got = getattr(mx.np, name)(mx.np.array(a), mx.np.array(b))
+    want = getattr(onp, name)(a, b)
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", REDUCE)
+def test_reduce_vs_numpy(name):
+    x = onp.random.uniform(-1, 1, (3, 4, 5)).astype(onp.float32)
+    for axis in (None, 0, (0, 2)):
+        got = getattr(mx.np, name)(mx.np.array(x), axis=axis)
+        want = getattr(onp, name)(x, axis=axis)
+        assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_matmul_einsum_tensordot():
+    a = onp.random.randn(3, 4).astype(onp.float32)
+    b = onp.random.randn(4, 5).astype(onp.float32)
+    assert_almost_equal(mx.np.matmul(mx.np.array(a), mx.np.array(b)), a @ b, rtol=1e-4)
+    assert_almost_equal(mx.np.einsum("ij,jk->ik", mx.np.array(a), mx.np.array(b)),
+                        a @ b, rtol=1e-4)
+    assert_almost_equal(mx.np.tensordot(mx.np.array(a), mx.np.array(b), axes=1),
+                        a @ b, rtol=1e-4)
+    assert_almost_equal(mx.np.dot(mx.np.array(a), mx.np.array(b)), a @ b, rtol=1e-4)
+
+
+def test_manipulation():
+    x = onp.arange(24).reshape(2, 3, 4).astype(onp.float32)
+    mxx = mx.np.array(x)
+    assert_almost_equal(mx.np.transpose(mxx, (2, 0, 1)), x.transpose(2, 0, 1))
+    assert_almost_equal(mx.np.flip(mxx, 1), onp.flip(x, 1))
+    assert_almost_equal(mx.np.roll(mxx, 2, 2), onp.roll(x, 2, 2))
+    assert_almost_equal(mx.np.tile(mxx, (1, 2, 1)), onp.tile(x, (1, 2, 1)))
+    assert_almost_equal(mx.np.repeat(mxx, 2, 0), onp.repeat(x, 2, 0))
+    assert_almost_equal(mx.np.pad(mxx, ((0, 0), (1, 1), (0, 0))),
+                        onp.pad(x, ((0, 0), (1, 1), (0, 0))))
+    assert_almost_equal(mx.np.where(mxx > 10, mxx, -mxx), onp.where(x > 10, x, -x))
+    assert_almost_equal(mx.np.clip(mxx, 3, 10), onp.clip(x, 3, 10))
+
+
+def test_sorting():
+    x = onp.random.randn(4, 5).astype(onp.float32)
+    mxx = mx.np.array(x)
+    assert_almost_equal(mx.np.sort(mxx, axis=1), onp.sort(x, axis=1))
+    assert_almost_equal(mx.np.argsort(mxx, axis=1), onp.argsort(x, axis=1))
+    assert_almost_equal(mx.np.argmax(mxx, axis=0), onp.argmax(x, axis=0))
+
+
+def test_linalg():
+    a = onp.random.randn(4, 4).astype(onp.float32)
+    spd = a @ a.T + 4 * onp.eye(4, dtype=onp.float32)
+    mspd = mx.np.array(spd)
+    assert_almost_equal(mx.np.linalg.cholesky(mspd), onp.linalg.cholesky(spd),
+                        rtol=1e-3, atol=1e-4)
+    assert_almost_equal(mx.np.linalg.inv(mspd), onp.linalg.inv(spd), rtol=1e-2, atol=1e-3)
+    assert_almost_equal(mx.np.linalg.norm(mspd), onp.linalg.norm(spd), rtol=1e-4)
+    sign, logdet = onp.linalg.slogdet(spd)
+    msign, mlogdet = mx.np.linalg.slogdet(mspd)
+    assert_almost_equal(mlogdet, logdet, rtol=1e-3, atol=1e-3)
+
+
+def test_grads_through_np_ops():
+    check_numeric_gradient(lambda x: mx.np.exp(x).sum(), [mx.np.array([0.1, 0.5])])
+    check_numeric_gradient(lambda x: mx.np.sum(x * x, axis=0).sum(),
+                           [mx.np.array([[1.0, 2.0], [3.0, 4.0]])])
+    check_numeric_gradient(
+        lambda a, b: mx.np.matmul(a, b).sum(),
+        [mx.np.array(onp.random.randn(2, 3).astype(onp.float32)),
+         mx.np.array(onp.random.randn(3, 2).astype(onp.float32))], rtol=2e-2)
+
+
+def test_random_shapes_and_determinism():
+    mx.random.seed(42)
+    a = mx.np.random.uniform(size=(3, 3))
+    b = mx.np.random.normal(0, 1, size=(2, 2))
+    c = mx.np.random.randint(0, 10, size=(5,))
+    assert a.shape == (3, 3) and b.shape == (2, 2) and c.shape == (5,)
+    assert c.asnumpy().min() >= 0 and c.asnumpy().max() < 10
+    mx.random.seed(42)
+    a2 = mx.np.random.uniform(size=(3, 3))
+    assert_almost_equal(a, a2)
+    # successive draws differ
+    a3 = mx.np.random.uniform(size=(3, 3))
+    assert not onp.allclose(a2.asnumpy(), a3.asnumpy())
+
+
+def test_npx_ops():
+    x = onp.random.randn(2, 5).astype(onp.float32)
+    got = mx.npx.softmax(mx.np.array(x), axis=-1)
+    e = onp.exp(x - x.max(-1, keepdims=True))
+    assert_almost_equal(got, e / e.sum(-1, keepdims=True), rtol=1e-4)
+    got = mx.npx.log_softmax(mx.np.array(x), axis=-1)
+    assert_almost_equal(got, onp.log(e / e.sum(-1, keepdims=True)), rtol=1e-4, atol=1e-5)
+    # one_hot / pick / topk
+    idx = mx.np.array([1, 3], dtype=onp.int32)
+    oh = mx.npx.one_hot(idx, 5)
+    assert_almost_equal(oh, onp.eye(5, dtype=onp.float32)[[1, 3]])
+    picked = mx.npx.pick(mx.np.array(x), idx, axis=1)
+    assert_almost_equal(picked, x[onp.arange(2), [1, 3]])
+    tk = mx.npx.topk(mx.np.array(x), k=2, ret_typ="value")
+    assert_almost_equal(tk, onp.sort(x, axis=-1)[:, ::-1][:, :2], rtol=1e-5)
+
+
+def test_npx_sequence_ops():
+    x = onp.arange(12).reshape(3, 2, 2).astype(onp.float32)  # (T,B,...)
+    lengths = mx.np.array([1, 3], dtype=onp.int32)
+    masked = mx.npx.sequence_mask(mx.np.array(x), lengths, True, value=-1.0)
+    w = masked.asnumpy()
+    assert w[0, 0, 0] == 0 and w[1, 0, 0] == -1 and w[2, 1, 1] == 11
+    last = mx.npx.sequence_last(mx.np.array(x), lengths, True)
+    assert_almost_equal(last, onp.stack([x[0, 0], x[2, 1]]))
+    rev = mx.npx.sequence_reverse(mx.np.array(x), lengths, True)
+    assert rev.shape == x.shape
+
+
+def test_fully_connected_and_conv_shapes():
+    x = mx.np.random.uniform(size=(2, 3, 8, 8))
+    w = mx.np.random.uniform(size=(16, 3, 3, 3))
+    b = mx.np.zeros((16,))
+    y = mx.npx.convolution(x, w, b, kernel=(3, 3), num_filter=16, pad=(1, 1))
+    assert y.shape == (2, 16, 8, 8)
+    y2 = mx.npx.convolution(x, w, b, kernel=(3, 3), num_filter=16, stride=(2, 2))
+    assert y2.shape == (2, 16, 3, 3)
+    xf = mx.np.random.uniform(size=(4, 10))
+    wf = mx.np.random.uniform(size=(5, 10))
+    bf = mx.np.zeros((5,))
+    yf = mx.npx.fully_connected(xf, wf, bf, num_hidden=5)
+    assert_almost_equal(yf, xf.asnumpy() @ wf.asnumpy().T + bf.asnumpy(), rtol=1e-4)
+
+
+def test_conv_grad():
+    x = mx.np.random.uniform(size=(1, 2, 5, 5))
+    w = mx.np.random.uniform(size=(3, 2, 3, 3))
+    check_numeric_gradient(
+        lambda a, b: mx.npx.convolution(a, b, None, kernel=(3, 3),
+                                        num_filter=3, no_bias=True).sum(),
+        [x, w], rtol=3e-2, atol=1e-2)
+
+
+def test_pooling_vs_manual():
+    x = onp.arange(16).reshape(1, 1, 4, 4).astype(onp.float32)
+    mp = mx.npx.pooling(mx.np.array(x), kernel=(2, 2), pool_type="max", stride=(2, 2))
+    assert_almost_equal(mp, onp.array([[[[5, 7], [13, 15]]]], onp.float32))
+    ap = mx.npx.pooling(mx.np.array(x), kernel=(2, 2), pool_type="avg", stride=(2, 2))
+    assert_almost_equal(ap, onp.array([[[[2.5, 4.5], [10.5, 12.5]]]], onp.float32))
+    gp = mx.npx.pooling(mx.np.array(x), pool_type="max", global_pool=True)
+    assert gp.shape == (1, 1, 1, 1) and gp.item() == 15.0
+
+
+def test_norm_ops():
+    x = onp.random.randn(2, 3, 4).astype(onp.float32)
+    g = onp.ones(3, onp.float32)
+    b = onp.zeros(3, onp.float32)
+    out = mx.npx.batch_norm(mx.np.array(x), mx.np.array(g), mx.np.array(b),
+                            mx.np.zeros((3,)), mx.np.ones((3,)))
+    # inference mode: (x-0)/sqrt(1+eps)
+    assert_almost_equal(out, x / onp.sqrt(1 + 1e-5), rtol=1e-4)
+    g4 = onp.ones(4, onp.float32)
+    b4 = onp.zeros(4, onp.float32)
+    ln = mx.npx.layer_norm(mx.np.array(x), mx.np.array(g4), mx.np.array(b4), axis=-1)
+    want = (x - x.mean(-1, keepdims=True)) / onp.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(ln, want, rtol=1e-3, atol=1e-4)
+
+
+def test_embedding():
+    w = mx.np.random.uniform(size=(10, 4))
+    idx = mx.np.array([1, 5, 1], dtype=onp.int32)
+    out = mx.npx.embedding(idx, w)
+    assert_almost_equal(out, w.asnumpy()[[1, 5, 1]])
+    # gradient: scatter-add into rows
+    w.attach_grad()
+    with mx.autograd.record():
+        loss = mx.npx.embedding(idx, w).sum()
+    loss.backward()
+    expect = onp.zeros((10, 4), onp.float32)
+    for i in [1, 5, 1]:
+        expect[i] += 1
+    assert_almost_equal(w.grad, expect)
