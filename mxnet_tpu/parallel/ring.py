@@ -12,6 +12,7 @@ fallback computes plain attention.
 """
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
@@ -63,13 +64,20 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     sp = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     if scale is None:
-        scale = 1.0 / float(jnp.sqrt(q.shape[-1]))
+        scale = 1.0 / math.sqrt(q.shape[-1])
     block_len = q.shape[2]
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     acc0 = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
     max0 = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
     sum0 = jnp.zeros(q.shape[:3], jnp.float32)
+    # newer JAX: the scan carry must be marked varying over the manual axis
+    if hasattr(lax, "pcast"):
+        acc0, max0, sum0 = (lax.pcast(a, (axis_name,), to="varying")
+                            for a in (acc0, max0, sum0))
+    elif hasattr(lax, "pvary"):
+        acc0, max0, sum0 = (lax.pvary(a, (axis_name,))
+                            for a in (acc0, max0, sum0))
 
     def body(i, state):
         k_blk, v_blk, carry = state
@@ -93,7 +101,7 @@ def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
     useful alone for long sequences on one chip."""
     b, h, t, d = q.shape
     if scale is None:
-        scale = 1.0 / float(jnp.sqrt(d))
+        scale = 1.0 / math.sqrt(d)
     nblk = max(1, (t + block_size - 1) // block_size)
     pad = nblk * block_size - t
     if pad:

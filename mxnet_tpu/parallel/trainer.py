@@ -142,44 +142,54 @@ def _opt_update(kind: str, pvals, grads, state, lr, wd, momentum, t,
     raise MXNetError(f"unknown sharded optimizer '{kind}'")
 
 
-def make_train_step(net, loss_fn, names: List[str], mesh: Mesh,
-                    param_specs: List[P], batch_spec: P = P("dp"),
+def make_train_step(net, loss_fn, names: List[str],
                     optimizer: str = "sgd", learning_rate: float = 0.01,
                     weight_decay: float = 0.0, momentum: float = 0.9,
                     donate: bool = True):
     """Build one jitted SPMD train step:
-    step(pvals, rng, opt_state, t, x, y) -> (pvals', rng', opt_state', loss).
+    step(tvals, avals, rng, opt_state, t, x, y)
+        -> (tvals', mutated_state, opt_state', loss).
 
-    Gradient reduction over 'dp' is inserted by XLA (params replicated /
-    sharded on non-dp axes ⇒ psum over ICI), replacing the reference's
-    KVStore push/pull (trainer.py:363)."""
+    ``tvals`` are trainable parameter values (grad_req != 'null'); ``avals``
+    are auxiliary state (BatchNorm running stats etc., grad_req == 'null')
+    which is never differentiated or optimizer-updated — its new values come
+    back through ``mutated_state`` (the forward's in-place updates), exactly
+    like the reference's aux-state split (mx Parameter grad_req,
+    trainer.py:411 skips null-grad params).
+
+    Shardings are carried by the committed input arrays (shard_params /
+    device_put in the caller); XLA inserts the gradient reduction over 'dp'
+    (params replicated / sharded on non-dp axes ⇒ psum over ICI), replacing
+    the reference's KVStore push/pull (trainer.py:363)."""
     fn, arrs, holder = _functional_apply(net, names, training=True)
+    params = net.collect_params()
+    train_ix = [i for i, n in enumerate(names) if params[n].grad_req != "null"]
+    aux_ix = [i for i, n in enumerate(names) if params[n].grad_req == "null"]
+    holder["train_ix"], holder["aux_ix"] = train_ix, aux_ix
 
-    def loss_of(pvals_and_key, x, y):
-        outs, mutated = fn(pvals_and_key, x)
+    def assemble(tvals, avals, key_val):
+        allv: List[Any] = [None] * (len(names) + 1)
+        for i, v in zip(train_ix, tvals):
+            allv[i] = v
+        for i, v in zip(aux_ix, avals):
+            allv[i] = v
+        allv[-1] = key_val
+        return allv
+
+    def loss_of(tvals, avals, key_val, x, y):
+        outs, mutated = fn(assemble(tvals, avals, key_val), x)
         pred = outs[0]
         loss = loss_fn(pred, y)
         return jnp.mean(loss), (mutated,)
 
-    def step(pvals, key_val, opt_state, t, x, y):
-        allvals = list(pvals) + [key_val]
+    def step(tvals, avals, key_val, opt_state, t, x, y):
         (loss, (mutated,)), grads = jax.value_and_grad(loss_of, has_aux=True)(
-            allvals, x, y)
-        pgrads = grads[:len(pvals)]
-        new_p, new_state = _opt_update(optimizer, pvals, pgrads, opt_state,
+            tvals, avals, key_val, x, y)
+        new_p, new_state = _opt_update(optimizer, tvals, grads, opt_state,
                                        learning_rate, weight_decay, momentum, t)
-        new_key = mutated[-1] if mutated else key_val
-        return new_p, new_key, new_state, loss, mutated
+        return new_p, mutated, new_state, loss
 
-    in_shardings = (
-        tuple(NamedSharding(mesh, s) for s in param_specs),
-        NamedSharding(mesh, P()),
-        None,  # opt state sharding inferred
-        None,
-        NamedSharding(mesh, batch_spec),
-        NamedSharding(mesh, batch_spec),
-    )
-    jitted = jax.jit(step, donate_argnums=(0, 2) if donate else ())
+    jitted = jax.jit(step, donate_argnums=(0, 3) if donate else ())
     return jitted, holder
 
 
@@ -201,12 +211,18 @@ class ShardedTrainer:
 
         self.net = net
         self.mesh = mesh if mesh is not None else default_mesh()
-        self.names, self.pvals, self.specs = shard_params(net, self.mesh, spec_fn)
+        self.names, allvals, self.specs = shard_params(net, self.mesh, spec_fn)
         self._step_fn, self._holder = make_train_step(
-            net, loss_fn, self.names, self.mesh, self.specs, batch_spec,
-            optimizer, learning_rate, weight_decay, momentum)
+            net, loss_fn, self.names, optimizer, learning_rate,
+            weight_decay, momentum)
+        self.pvals = [allvals[i] for i in self._holder["train_ix"]]
+        self.avals = [allvals[i] for i in self._holder["aux_ix"]]
+        self._params = net.collect_params()
+        self.train_names = [self.names[i] for i in self._holder["train_ix"]]
+        self.aux_names = [self.names[i] for i in self._holder["aux_ix"]]
         self.opt_state = _opt_init(optimizer, self.pvals)
         self._t = 0
+        self._batch_spec = batch_spec
         from ..random import key_holder
 
         self._key = key_holder()._data
@@ -217,19 +233,22 @@ class ShardedTrainer:
             x = x._data
         if isinstance(y, NDArray):
             y = y._data
-        xb = jax.device_put(x, NamedSharding(self.mesh, P("dp")))
-        yb = jax.device_put(y, NamedSharding(self.mesh, P("dp")))
+        xb = jax.device_put(x, NamedSharding(self.mesh, self._batch_spec))
+        yb = jax.device_put(y, NamedSharding(self.mesh, self._batch_spec))
         self._t += 1
-        self.pvals, self._key, self.opt_state, loss, mutated = self._step_fn(
-            self.pvals, self._key, self.opt_state, self._t, xb, yb)
-        # write back mutated aux state (BN stats) + params into the net
+        self.pvals, mutated, self.opt_state, loss = self._step_fn(
+            self.pvals, self.avals, self._key, self.opt_state, self._t, xb, yb)
+        # write back: trainable params from the optimizer, then mutated state
+        # (BN stats, RNG key) from the forward — mutated refs never overlap
+        # trainables, so order is safe.
+        params = self._params
+        for n, v in zip(self.train_names, self.pvals):
+            params[n].data()._set_data(v)
         refs = self._holder.get("mutated_refs", [])
         for a, v in zip(refs, mutated):
             a._set_data(v)
-        params = self.net.collect_params()
-        for n, v in zip(self.names, self.pvals):
-            params[n].data()._set_data(v)
+        self.avals = [params[n].data()._data for n in self.aux_names]
         from ..random import key_holder
 
-        key_holder()._set_data(self._key)
+        self._key = key_holder()._data
         return float(loss)
