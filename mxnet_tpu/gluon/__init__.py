@@ -3,6 +3,7 @@ from .block import Block, HybridBlock, SymbolBlock
 from .parameter import Parameter, Constant
 from .trainer import Trainer
 from . import nn
+from . import rnn
 from . import loss
 from . import metric
 from . import data

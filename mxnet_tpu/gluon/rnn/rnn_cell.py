@@ -1,0 +1,357 @@
+"""Recurrent cells + unroll helpers (ref: python/mxnet/gluon/rnn/rnn_cell.py).
+
+Cells are explicit single-step recurrences for custom loops; the fused
+layers in rnn_layer.py are the performance path (one lax.scan under jit).
+``unroll`` is a static Python loop — inside a hybridized block the whole
+unrolled graph compiles to one XLA computation, the analogue of the
+reference's unfused cell graphs.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ... import numpy as _np
+from ... import numpy_extension as npx
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "ResidualCell",
+           "BidirectionalCell", "ZoneoutCell"]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+class RecurrentCell(HybridBlock):
+    """Base class: one step of recurrence (ref rnn_cell.py:RecurrentCell)."""
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        func = func or _np.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def __call__(self, inputs, states=None, **kwargs):
+        if states is None:
+            states = self.begin_state(batch_size=inputs.shape[0],
+                                      dtype=inputs.dtype)
+        return super().__call__(inputs, states, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell ``length`` steps (ref rnn_cell.py unroll).
+
+        inputs: (N, T, C) for NTC, (T, N, C) for TNC, or list of (N, C).
+        Returns (outputs, states); outputs merged into one array on the
+        time axis when merge_outputs is not False."""
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            batch = seq[0].shape[0]
+        else:
+            axis = layout.find("T")
+            if axis == 0:
+                seq = [inputs[t] for t in range(length)]
+            else:
+                seq = [inputs[:, t] for t in range(length)]
+            batch = inputs.shape[layout.find("N")]
+        if len(seq) != length:
+            raise MXNetError(f"unroll length {length} != inputs {len(seq)}")
+
+        states = begin_state if begin_state is not None else self.begin_state(
+            batch_size=batch, dtype=seq[0].dtype)
+        outputs = []
+        all_states = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+            if valid_length is not None:
+                all_states.append(states)
+
+        if valid_length is not None:
+            # freeze states past each sequence's end + zero padded outputs
+            states = []
+            for i in range(len(all_states[0])):
+                stk = _np.stack([s[i] for s in all_states], axis=0)  # (T,N,...)
+                idx = _np.maximum(valid_length.astype(jnp.int32) - 1, 0)
+                picked = stk[idx, _np.arange(batch)]
+                states.append(picked)
+            outputs = [
+                out * (valid_length > t).astype(out.dtype).reshape(-1, 1)
+                for t, out in enumerate(outputs)]
+
+        if merge_outputs is False:
+            return outputs, states
+        axis = layout.find("T")
+        merged = _np.stack(outputs, axis=axis)
+        return merged, states
+
+
+class HybridRecurrentCell(RecurrentCell):
+    """Alias kept for API parity (all our cells are hybridizable)."""
+
+
+class _GatedCell(RecurrentCell):
+    _num_gates = 1
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype=jnp.float32, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = self._num_gates
+        self.i2h_weight = Parameter(shape=(ng * hidden_size, input_size),
+                                    init=i2h_weight_initializer, dtype=dtype,
+                                    allow_deferred_init=True, name="i2h_weight")
+        self.h2h_weight = Parameter(shape=(ng * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer, dtype=dtype,
+                                    allow_deferred_init=True, name="h2h_weight")
+        self.i2h_bias = Parameter(shape=(ng * hidden_size,),
+                                  init=i2h_bias_initializer, dtype=dtype,
+                                  allow_deferred_init=True, name="i2h_bias")
+        self.h2h_bias = Parameter(shape=(ng * hidden_size,),
+                                  init=h2h_bias_initializer, dtype=dtype,
+                                  allow_deferred_init=True, name="h2h_bias")
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._num_gates * self._hidden_size,
+                                 x.shape[-1])
+
+    def _proj(self, inputs, states):
+        i2h = npx.fully_connected(inputs, self.i2h_weight.data(),
+                                  self.i2h_bias.data(),
+                                  num_hidden=self._num_gates * self._hidden_size)
+        h2h = npx.fully_connected(states[0], self.h2h_weight.data(),
+                                  self.h2h_bias.data(),
+                                  num_hidden=self._num_gates * self._hidden_size)
+        return i2h, h2h
+
+
+class RNNCell(_GatedCell):
+    """Elman cell: h' = act(W·x + b + R·h + r) (ref rnn_cell.py RNNCell)."""
+    _num_gates = 1
+
+    def __init__(self, hidden_size, activation="tanh", **kwargs):
+        super().__init__(hidden_size, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._proj(inputs, states)
+        out = npx.activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(_GatedCell):
+    """LSTM cell, gate order [i, f, g, o] (ref rnn_cell.py LSTMCell)."""
+    _num_gates = 4
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._proj(inputs, states)
+        g = i2h + h2h
+        h = self._hidden_size
+        i, f, gg, o = (g[:, :h], g[:, h:2 * h], g[:, 2 * h:3 * h], g[:, 3 * h:])
+        c = i.sigmoid() * gg.tanh() + f.sigmoid() * states[1]
+        out = o.sigmoid() * c.tanh()
+        return out, [out, c]
+
+
+class GRUCell(_GatedCell):
+    """GRU cell, cuDNN gate order [r, z, n] with the reset gate applied to
+    the h2h candidate incl. its bias (ref rnn_cell.py GRUCell)."""
+    _num_gates = 3
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._proj(inputs, states)
+        h = self._hidden_size
+        xr, xz, xn = i2h[:, :h], i2h[:, h:2 * h], i2h[:, 2 * h:]
+        hr, hz, hn = h2h[:, :h], h2h[:, h:2 * h], h2h[:, 2 * h:]
+        r = (xr + hr).sigmoid()
+        z = (xz + hz).sigmoid()
+        n = (xn + r * hn).tanh()
+        out = (1.0 - z) * n + z * states[0]
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack of cells applied in sequence each step (ref SequentialRNNCell)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._cells: List[RecurrentCell] = []
+
+    def add(self, *cells):
+        for c in cells:
+            self._cells.append(c)
+            setattr(self, f"cell{len(self._cells) - 1}", c)
+
+    def __len__(self):
+        return len(self._cells)
+
+    def __getitem__(self, i):
+        return self._cells[i]
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._cells, batch_size)
+
+    def begin_state(self, **kwargs):
+        return _cells_begin_state(self._cells, **kwargs)
+
+    def forward(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info())
+            inputs, st = cell(inputs, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+
+class DropoutCell(RecurrentCell):
+    """Dropout on the step output (ref DropoutCell)."""
+
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def begin_state(self, **kwargs):
+        return []
+
+    def forward(self, inputs, states):
+        return npx.dropout(inputs, p=self._rate), states
+
+
+class ResidualCell(RecurrentCell):
+    """Adds the input to the base cell's output (ref ResidualCell)."""
+
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, **kwargs):
+        return self.base_cell.begin_state(**kwargs)
+
+    def forward(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+class ZoneoutCell(RecurrentCell):
+    """Zoneout regularization: randomly keep previous state entries (ref
+    ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._zo, self._zs = zoneout_outputs, zoneout_states
+        self._prev_out = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, **kwargs):
+        self._prev_out = None
+        return self.base_cell.begin_state(**kwargs)
+
+    def forward(self, inputs, states):
+        from ... import autograd
+
+        out, next_states = self.base_cell(inputs, states)
+        if autograd.is_training():
+            def mix(p, new, old):
+                if p <= 0.0 or old is None:
+                    return new
+                mask = (npx.dropout(_np.ones_like(new), p=p, mode="always") > 0)
+                return _np.where(mask, new, old)
+
+            prev = self._prev_out
+            out = mix(self._zo, out, prev)
+            next_states = [mix(self._zs, ns, s)
+                           for ns, s in zip(next_states, states)]
+        self._prev_out = out
+        return out, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Runs two cells over opposite directions; only usable via unroll (ref
+    BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell, self.r_cell = l_cell, r_cell
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info([self.l_cell, self.r_cell], batch_size)
+
+    def begin_state(self, **kwargs):
+        return _cells_begin_state([self.l_cell, self.r_cell], **kwargs)
+
+    def forward(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+        else:
+            axis = layout.find("T")
+            seq = [inputs[t] if axis == 0 else inputs[:, t]
+                   for t in range(length)]
+        batch = seq[0].shape[0]
+        states = begin_state if begin_state is not None else self.begin_state(
+            batch_size=batch, dtype=seq[0].dtype)
+        nl = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(
+            length, seq, states[:nl], layout="TNC" if layout == "TNC" else layout,
+            merge_outputs=False, valid_length=valid_length)
+        if valid_length is None:
+            r_seq = seq[::-1]
+        else:
+            stacked = _np.stack(seq, axis=0)
+            r_seq = list(npx.sequence_reverse(
+                stacked, sequence_length=valid_length,
+                use_sequence_length=True))
+        r_out, r_states = self.r_cell.unroll(
+            length, r_seq, states[nl:], layout=layout,
+            merge_outputs=False, valid_length=valid_length)
+        if valid_length is None:
+            r_out = r_out[::-1]
+        else:
+            r_out = list(npx.sequence_reverse(
+                _np.stack(r_out, axis=0), sequence_length=valid_length,
+                use_sequence_length=True))
+        outputs = [_np.concatenate([lo, ro], axis=-1)
+                   for lo, ro in zip(l_out, r_out)]
+        states = l_states + r_states
+        if merge_outputs is False:
+            return outputs, states
+        return _np.stack(outputs, axis=layout.find("T")), states

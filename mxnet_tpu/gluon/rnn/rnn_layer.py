@@ -1,0 +1,150 @@
+"""Fused recurrent layers RNN/LSTM/GRU (ref: python/mxnet/gluon/rnn/rnn_layer.py
+→ npx.rnn fused op, src/operator/rnn.cc).
+
+Parameters are held unfused per layer/direction (``l0_i2h_weight``,
+``r0_h2h_bias``, ... — the reference's naming) and concatenated into the
+fused op's flat vector inside forward; the concat is traced, so gradients
+flow back to the individual parameters and hybridize compiles the whole
+layer into one XLA computation with the scan inside.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ... import numpy_extension as npx
+from ... import numpy as _np
+from ...base import MXNetError
+from ...ops.rnn import gates_of
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers=1, layout="TNC",
+                 dropout=0.0, bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype=jnp.float32, use_sequence_length=False, **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"Invalid layout '{layout}'; must be TNC or NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._use_sequence_length = use_sequence_length
+        self._gates = gates_of(mode)
+
+        ng, nh = self._gates, hidden_size
+        for l in range(num_layers):
+            in_sz = input_size if l == 0 else nh * self._dir
+            for d in ("l", "r")[:self._dir]:
+                setattr(self, f"{d}{l}_i2h_weight", Parameter(
+                    shape=(ng * nh, in_sz), init=i2h_weight_initializer,
+                    dtype=dtype, allow_deferred_init=True,
+                    name=f"{d}{l}_i2h_weight"))
+                setattr(self, f"{d}{l}_h2h_weight", Parameter(
+                    shape=(ng * nh, nh), init=h2h_weight_initializer,
+                    dtype=dtype, allow_deferred_init=True,
+                    name=f"{d}{l}_h2h_weight"))
+                setattr(self, f"{d}{l}_i2h_bias", Parameter(
+                    shape=(ng * nh,), init=i2h_bias_initializer, dtype=dtype,
+                    allow_deferred_init=True, name=f"{d}{l}_i2h_bias"))
+                setattr(self, f"{d}{l}_h2h_bias", Parameter(
+                    shape=(ng * nh,), init=h2h_bias_initializer, dtype=dtype,
+                    allow_deferred_init=True, name=f"{d}{l}_h2h_bias"))
+
+    # -- state ---------------------------------------------------------------
+    def state_info(self, batch_size=0):
+        info = [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            info.append(dict(info[0]))
+        return info
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial hidden (and cell) state, zeros by default (ref
+        rnn_layer.py begin_state)."""
+        func = func or _np.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    # -- shape inference -----------------------------------------------------
+    def infer_shape(self, x, *args, **kwargs):
+        in_sz = x.shape[2] if self._layout == "TNC" else x.shape[-1]
+        ng, nh = self._gates, self._hidden_size
+        for l in range(self._num_layers):
+            lin = in_sz if l == 0 else nh * self._dir
+            for d in ("l", "r")[:self._dir]:
+                getattr(self, f"{d}{l}_i2h_weight").shape = (ng * nh, lin)
+
+    def _flat_params(self):
+        ws, bs = [], []
+        for l in range(self._num_layers):
+            for d in ("l", "r")[:self._dir]:
+                ws.append(getattr(self, f"{d}{l}_i2h_weight").data().reshape(-1))
+                ws.append(getattr(self, f"{d}{l}_h2h_weight").data().reshape(-1))
+                bs.append(getattr(self, f"{d}{l}_i2h_bias").data())
+                bs.append(getattr(self, f"{d}{l}_h2h_bias").data())
+        return _np.concatenate(ws + bs, axis=0)
+
+    # -- forward -------------------------------------------------------------
+    def forward(self, x, states=None, sequence_length=None):
+        """x: (T, N, C) for TNC layout, (N, T, C) for NTC. If ``states`` is
+        given returns (output, out_states); else just output (ref
+        rnn_layer.py forward_kernel)."""
+        skip_states = states is None
+        if self._layout == "NTC":
+            x = x.transpose(1, 0, 2)
+        if skip_states:
+            states = self.begin_state(batch_size=x.shape[1],
+                                      dtype=x.dtype)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+
+        res = npx.rnn(x, self._flat_params(), *states,
+                      mode=self._mode, state_size=self._hidden_size,
+                      num_layers=self._num_layers,
+                      bidirectional=self._dir == 2, p=self._dropout,
+                      state_outputs=True,
+                      sequence_length=sequence_length,
+                      use_sequence_length=sequence_length is not None)
+        out, out_states = res[0], list(res[1:])
+        if self._layout == "NTC":
+            out = out.transpose(1, 0, 2)
+        return out if skip_states else (out, out_states)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._hidden_size}, "
+                f"num_layers={self._num_layers}, "
+                f"bidirectional={self._dir == 2}, layout={self._layout})")
+
+
+class RNN(_RNNLayer):
+    """Vanilla (Elman) RNN with relu/tanh activation (ref rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu", **kwargs):
+        if activation not in ("relu", "tanh"):
+            raise MXNetError("RNN activation must be 'relu' or 'tanh'")
+        super().__init__(f"rnn_{activation}", hidden_size, num_layers, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (ref rnn_layer.py LSTM; gates [i, f, g, o])."""
+
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, **kwargs)
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (ref rnn_layer.py GRU; cuDNN gate order [r, z, n])."""
+
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, **kwargs)

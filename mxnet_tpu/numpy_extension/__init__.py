@@ -359,11 +359,43 @@ def rnn(data, parameters, state, state_cell=None, mode="lstm",
         state_size=None, num_layers=1, bidirectional=False, p=0.0,
         state_outputs=True, projection_size=None, sequence_length=None,
         use_sequence_length=False, **kw):
+    """Fused multi-layer RNN (ref src/operator/rnn.cc:297-421 → ops.rnn
+    lax.scan kernel). Inter-layer dropout draws from the global RNG and is
+    active only under autograd training mode, like the reference's mode-
+    dependent dropout."""
     from ..ops import rnn as _rnn
+    from ..random import next_key
 
-    return _rnn.rnn_fused(data, parameters, state, state_cell, mode=mode,
-                          state_size=state_size, num_layers=num_layers,
-                          bidirectional=bidirectional, p=p,
-                          state_outputs=state_outputs,
-                          sequence_length=sequence_length,
-                          use_sequence_length=use_sequence_length)
+    drop = p if (p > 0.0 and autograd.is_training() and num_layers > 1) else 0.0
+    key = jax.random.key_data(next_key()) if drop > 0.0 else None
+
+    inputs = [data, parameters, state]
+    if mode == "lstm":
+        if state_cell is None:
+            raise MXNetError("lstm mode requires state_cell")
+        inputs.append(state_cell)
+    if use_sequence_length:
+        if sequence_length is None:
+            raise MXNetError("use_sequence_length=True requires sequence_length")
+        inputs.append(sequence_length)
+
+    def f(*raw):
+        x, params, h0 = raw[0], raw[1], raw[2]
+        i = 3
+        c0 = None
+        if mode == "lstm":
+            c0 = raw[i]
+            i += 1
+        seq = raw[i] if use_sequence_length else None
+        return _rnn.rnn_fused(x, params, h0, c0, mode=mode,
+                              state_size=state_size, num_layers=num_layers,
+                              bidirectional=bidirectional, p=drop,
+                              projection_size=projection_size,
+                              sequence_length=seq,
+                              use_sequence_length=use_sequence_length,
+                              dropout_key=key)
+
+    res = call(f, tuple(inputs), {}, name=f"rnn_{mode}")
+    if not state_outputs:
+        return res[0]
+    return res

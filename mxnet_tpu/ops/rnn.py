@@ -1,0 +1,198 @@
+"""Fused recurrent layers — the TPU-native analogue of the reference's RNN op
+(src/operator/rnn.cc:297-421: fused multi-layer LSTM/GRU/vanilla-RNN with
+cuDNN on GPU).
+
+TPU-first design: the input projection for the WHOLE sequence is one large
+matmul (T·B, C)×(C, G·H) done outside the recurrence — that's the MXU-shaped
+bulk of the FLOPs — and only the small h·Wh product lives inside
+``lax.scan``. No data-dependent Python control flow; variable-length
+sequences are handled by masking inside the scan (static shapes, XLA-
+friendly), mirroring the reference's use_sequence_length path.
+
+Parameter packing follows the reference/cuDNN convention
+(src/operator/rnn-inl.h GetRnnParamSize): all weights first — per layer,
+per direction: W (i2h) then R (h2h), row-major with gate blocks stacked —
+then all biases in the same order (b_W then b_R). Gate order: LSTM
+[i, f, g, o]; GRU [r, z, n]; vanilla 1 gate.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+__all__ = ["gates_of", "param_size", "unpack_params", "pack_params",
+           "rnn_fused", "cell_step"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def gates_of(mode: str) -> int:
+    if mode not in _GATES:
+        raise MXNetError(f"unknown RNN mode '{mode}'")
+    return _GATES[mode]
+
+
+def _layer_shapes(mode: str, input_size: int, state_size: int,
+                  num_layers: int, bidirectional: bool):
+    """Yield (layer, direction, wi_shape, wh_shape, b_shape)."""
+    g = gates_of(mode)
+    d = 2 if bidirectional else 1
+    for l in range(num_layers):
+        in_sz = input_size if l == 0 else state_size * d
+        for dd in range(d):
+            yield (l, dd, (g * state_size, in_sz),
+                   (g * state_size, state_size), (g * state_size,))
+
+
+def param_size(mode: str, input_size: int, state_size: int,
+               num_layers: int = 1, bidirectional: bool = False) -> int:
+    """Total flat parameter length (ref rnn-inl.h GetRnnParamSize)."""
+    n = 0
+    for (_, _, wi, wh, b) in _layer_shapes(mode, input_size, state_size,
+                                           num_layers, bidirectional):
+        n += wi[0] * wi[1] + wh[0] * wh[1] + 2 * b[0]
+    return n
+
+
+def unpack_params(params, mode: str, input_size: int, state_size: int,
+                  num_layers: int = 1, bidirectional: bool = False):
+    """Split a flat parameter vector into per-(layer, direction) tuples
+    (wi, wh, bi, bh). Weights come first, then biases (cuDNN layout)."""
+    shapes = list(_layer_shapes(mode, input_size, state_size, num_layers,
+                                bidirectional))
+    ws: List[Tuple] = []
+    off = 0
+    for (_, _, wi_s, wh_s, _) in shapes:
+        wi = params[off:off + wi_s[0] * wi_s[1]].reshape(wi_s)
+        off += wi_s[0] * wi_s[1]
+        wh = params[off:off + wh_s[0] * wh_s[1]].reshape(wh_s)
+        off += wh_s[0] * wh_s[1]
+        ws.append((wi, wh))
+    out = []
+    for (wi, wh), (_, _, _, _, b_s) in zip(ws, shapes):
+        bi = params[off:off + b_s[0]]
+        off += b_s[0]
+        bh = params[off:off + b_s[0]]
+        off += b_s[0]
+        out.append((wi, wh, bi, bh))
+    if off != params.shape[0]:
+        raise MXNetError(
+            f"RNN parameter vector has {params.shape[0]} elements, expected {off}")
+    return out
+
+
+def pack_params(per_layer, mode: str = "lstm"):
+    """Inverse of unpack_params: flat vector from [(wi, wh, bi, bh), ...]."""
+    flats = [jnp.concatenate([wi.reshape(-1), wh.reshape(-1)])
+             for (wi, wh, _, _) in per_layer]
+    flats += [jnp.concatenate([bi, bh]) for (_, _, bi, bh) in per_layer]
+    return jnp.concatenate(flats)
+
+
+def cell_step(mode: str, xp_t, h, c, wh, bh):
+    """One recurrence step given the precomputed input projection ``xp_t``
+    (= x_t·Wiᵀ + bi). Returns (h', c')."""
+    hp = h @ wh.T
+    if mode == "lstm":
+        g = xp_t + hp + bh
+        i, f, gg, o = jnp.split(g, 4, axis=-1)
+        c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+        h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+        return h2, c2
+    if mode == "gru":
+        # cuDNN formulation: bh_n gated by r (matches the reference kernel)
+        xr, xz, xn = jnp.split(xp_t, 3, axis=-1)
+        hr, hz, hn = jnp.split(hp + bh, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        return (1.0 - z) * n + z * h, c
+    act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+    return act(xp_t + hp + bh), c
+
+
+def _reverse_seq(x, seq_len):
+    """Reverse along time axis 0; with per-batch lengths, reverse only each
+    sequence's valid prefix (ref SequenceReverse, src/operator/sequence_reverse.cc)."""
+    if seq_len is None:
+        return x[::-1]
+    t = x.shape[0]
+    tidx = jnp.arange(t)[:, None]                       # (T, 1)
+    lens = seq_len.astype(jnp.int32)[None, :]           # (1, B)
+    ridx = jnp.where(tidx < lens, lens - 1 - tidx, tidx)  # (T, B)
+    return jnp.take_along_axis(
+        x, ridx.reshape(ridx.shape + (1,) * (x.ndim - 2)), axis=0)
+
+
+def _scan_layer(mode: str, x, h0, c0, wi, wh, bi, bh, seq_len=None,
+                reverse: bool = False):
+    """Run one direction of one layer over (T, B, C) input."""
+    if reverse:
+        x = _reverse_seq(x, seq_len)
+    xp = jnp.einsum("tbc,gc->tbg", x, wi) + bi  # one big MXU matmul
+    tidx = jnp.arange(x.shape[0])
+
+    def step(carry, inp):
+        h, c = carry
+        xp_t, t = inp
+        h2, c2 = cell_step(mode, xp_t, h, c, wh, bh)
+        if seq_len is not None:
+            m = (t < seq_len)[:, None]
+            h2 = jnp.where(m, h2, h)
+            c2 = jnp.where(m, c2, c)
+        return (h2, c2), h2
+
+    (hT, cT), ys = lax.scan(step, (h0, c0), (xp, tidx))
+    if reverse:
+        ys = _reverse_seq(ys, seq_len)
+    return ys, hT, cT
+
+
+def rnn_fused(data, parameters, state, state_cell=None, mode: str = "lstm",
+              state_size: Optional[int] = None, num_layers: int = 1,
+              bidirectional: bool = False, p: float = 0.0,
+              state_outputs: bool = True, projection_size=None,
+              sequence_length=None, use_sequence_length: bool = False,
+              dropout_key=None):
+    """Fused multi-layer (bi)RNN over TNC input (pure-jnp kernel).
+
+    data: (T, B, C); state/state_cell: (L·D, B, H); parameters: flat vector.
+    Returns (out, hy) or (out, hy, cy) for LSTM — callers drop states when
+    state_outputs is False (ref src/operator/rnn.cc output arity).
+    """
+    if projection_size is not None:
+        raise MXNetError("projection_size (LSTMP) is not supported")
+    if state_size is None:
+        state_size = state.shape[-1]
+    d = 2 if bidirectional else 1
+    per_layer = unpack_params(parameters, mode, data.shape[-1], state_size,
+                              num_layers, bidirectional)
+    seq_len = sequence_length if use_sequence_length else None
+
+    hy, cy = [], []
+    out = data
+    for l in range(num_layers):
+        if p > 0.0 and l > 0 and dropout_key is not None:
+            k = jax.random.fold_in(jax.random.wrap_key_data(dropout_key), l)
+            out = out * jax.random.bernoulli(k, 1.0 - p, out.shape) / (1.0 - p)
+        dir_outs = []
+        for dd in range(d):
+            wi, wh, bi, bh = per_layer[l * d + dd]
+            h0 = state[l * d + dd]
+            c0 = state_cell[l * d + dd] if state_cell is not None else h0
+            ys, hT, cT = _scan_layer(mode, out, h0, c0, wi, wh, bi, bh,
+                                     seq_len=seq_len, reverse=(dd == 1))
+            dir_outs.append(ys)
+            hy.append(hT)
+            cy.append(cT)
+        out = dir_outs[0] if d == 1 else jnp.concatenate(dir_outs, axis=-1)
+
+    hy = jnp.stack(hy)
+    if mode == "lstm":
+        return out, hy, jnp.stack(cy)
+    return out, hy
