@@ -34,6 +34,13 @@ def _cells_begin_state(cells, **kwargs):
 class RecurrentCell(HybridBlock):
     """Base class: one step of recurrence (ref rnn_cell.py:RecurrentCell)."""
 
+    def reset(self):
+        """Reset per-sequence state before starting a new sequence (ref
+        rnn_cell.py RecurrentCell.reset)."""
+        for child in self._children.values():
+            if isinstance(child, RecurrentCell):
+                child.reset()
+
     def state_info(self, batch_size=0):
         raise NotImplementedError
 
@@ -68,6 +75,7 @@ class RecurrentCell(HybridBlock):
         if len(seq) != length:
             raise MXNetError(f"unroll length {length} != inputs {len(seq)}")
 
+        self.reset()
         states = begin_state if begin_state is not None else self.begin_state(
             batch_size=batch, dtype=seq[0].dtype)
         outputs = []
@@ -275,6 +283,10 @@ class ZoneoutCell(RecurrentCell):
         self._zo, self._zs = zoneout_outputs, zoneout_states
         self._prev_out = None
 
+    def reset(self):
+        super().reset()
+        self._prev_out = None
+
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
 
@@ -288,8 +300,11 @@ class ZoneoutCell(RecurrentCell):
         out, next_states = self.base_cell(inputs, states)
         if autograd.is_training():
             def mix(p, new, old):
-                if p <= 0.0 or old is None:
+                if p <= 0.0:
                     return new
+                if old is None:
+                    # first step zones against zeros (ref rnn_cell.py:960)
+                    old = _np.zeros_like(new)
                 mask = (npx.dropout(_np.ones_like(new), p=p, mode="always") > 0)
                 return _np.where(mask, new, old)
 
@@ -331,7 +346,7 @@ class BidirectionalCell(RecurrentCell):
             batch_size=batch, dtype=seq[0].dtype)
         nl = len(self.l_cell.state_info())
         l_out, l_states = self.l_cell.unroll(
-            length, seq, states[:nl], layout="TNC" if layout == "TNC" else layout,
+            length, seq, states[:nl], layout=layout,
             merge_outputs=False, valid_length=valid_length)
         if valid_length is None:
             r_seq = seq[::-1]

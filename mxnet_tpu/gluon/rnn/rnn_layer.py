@@ -100,6 +100,10 @@ class _RNNLayer(HybridBlock):
         """x: (T, N, C) for TNC layout, (N, T, C) for NTC. If ``states`` is
         given returns (output, out_states); else just output (ref
         rnn_layer.py forward_kernel)."""
+        if self._use_sequence_length != (sequence_length is not None):
+            raise MXNetError(
+                "sequence_length must be given iff the layer was built with "
+                "use_sequence_length=True (ref rnn_layer.py forward)")
         skip_states = states is None
         if self._layout == "NTC":
             x = x.transpose(1, 0, 2)

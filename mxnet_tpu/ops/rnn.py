@@ -86,7 +86,7 @@ def unpack_params(params, mode: str, input_size: int, state_size: int,
     return out
 
 
-def pack_params(per_layer, mode: str = "lstm"):
+def pack_params(per_layer):
     """Inverse of unpack_params: flat vector from [(wi, wh, bi, bh), ...]."""
     flats = [jnp.concatenate([wi.reshape(-1), wh.reshape(-1)])
              for (wi, wh, _, _) in per_layer]
@@ -162,8 +162,8 @@ def rnn_fused(data, parameters, state, state_cell=None, mode: str = "lstm",
     """Fused multi-layer (bi)RNN over TNC input (pure-jnp kernel).
 
     data: (T, B, C); state/state_cell: (L·D, B, H); parameters: flat vector.
-    Returns (out, hy) or (out, hy, cy) for LSTM — callers drop states when
-    state_outputs is False (ref src/operator/rnn.cc output arity).
+    Returns ``out`` alone when state_outputs is False, else (out, hy) or
+    (out, hy, cy) for LSTM (ref src/operator/rnn.cc output arity).
     """
     if projection_size is not None:
         raise MXNetError("projection_size (LSTMP) is not supported")
@@ -192,6 +192,8 @@ def rnn_fused(data, parameters, state, state_cell=None, mode: str = "lstm",
             cy.append(cT)
         out = dir_outs[0] if d == 1 else jnp.concatenate(dir_outs, axis=-1)
 
+    if not state_outputs:
+        return out
     hy = jnp.stack(hy)
     if mode == "lstm":
         return out, hy, jnp.stack(cy)

@@ -8,26 +8,24 @@ every test runs under a known seed, printed on failure as
 import os
 
 # Force the 8-device virtual CPU mesh unless the user explicitly asks to run
-# the suite on TPU (MXNET_TEST_TPU=1). The axon TPU plugin registers itself
-# at *interpreter start* (sitecustomize) whenever PALLAS_AXON_POOL_IPS is
-# set, and once registered even JAX_PLATFORMS=cpu imports may touch the TPU
-# tunnel — so if the trigger env was present at startup, re-exec the test
-# process with it stripped. Env-var change alone is not enough.
+# the suite on TPU (MXNET_TEST_TPU=1). The TPU-tunnel sitecustomize imports
+# jax at *interpreter start* whenever PALLAS_AXON_POOL_IPS is set, which
+# freezes jax's platform config to the tunnel backend — mutating
+# os.environ["JAX_PLATFORMS"] afterwards is a no-op, and touching
+# jax.devices() then hangs dialing the tunnel. (An os.execve re-exec is no
+# good either: pytest's fd-level capture is already active when conftests
+# load, so the child's output lands in a discarded temp file.) The working
+# fix is jax.config.update, which takes effect before any backend client is
+# created.
 if not os.environ.get("MXNET_TEST_TPU"):
-    if os.environ.get("PALLAS_AXON_POOL_IPS") and \
-            not os.environ.get("_MXNET_TPU_CONFTEST_REEXEC"):
-        import sys
-
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["_MXNET_TPU_CONFTEST_REEXEC"] = "1"
-        os.execve(sys.executable, [sys.executable, "-m", "pytest"]
-                  + sys.argv[1:], env)
-    os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = (
             _flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import random as _pyrandom
 

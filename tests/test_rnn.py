@@ -115,7 +115,7 @@ def test_ntc_layout():
 
 def test_variable_length_masking():
     T, B, C, H = 6, 3, 4, 5
-    layer = rnn.LSTM(H)
+    layer = rnn.LSTM(H, use_sequence_length=True)
     layer.initialize()
     x = mx.np.random.uniform(size=(T, B, C))
     lens = mx.np.array([6, 3, 1], dtype="int32")
