@@ -1,3 +1,12 @@
 """Model zoo (ref: python/mxnet/gluon/model_zoo/)."""
 from . import vision
-from .vision import get_model
+from . import bert
+from .bert import (BERTModel, BERTForPretrain, get_bert, bert_12_768_12,
+                   bert_24_1024_16)
+
+
+def get_model(name, **kwargs):
+    """Vision + NLP model factory (ref model_zoo/__init__.py get_model)."""
+    if name in bert._BERT_SPECS:
+        return get_bert(name, **kwargs)
+    return vision.get_model(name, **kwargs)

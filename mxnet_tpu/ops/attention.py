@@ -1,0 +1,335 @@
+"""Fused attention: Pallas TPU flash-attention kernel + jnp fallback.
+
+Reference counterpart: the interleaved-matmul self-attention helper kernels
+in src/operator/contrib/transformer.cc (which fuse QKV projections and
+softmax(QK^T)V on GPU). TPU-native redesign: a single blockwise
+online-softmax kernel (flash attention) written in Pallas so the whole
+score/softmax/weighted-sum pipeline stays in VMEM — O(T) memory instead of
+the O(T^2) score matrix, MXU-friendly (bq x d) x (d x bk) tiles.
+
+Dispatch rules:
+  * TPU + (no mask or causal) + tile-able shapes  -> pallas kernel
+  * everything else                               -> attention_reference
+Backward is a hand-written blockwise flash backward (custom VJP): row lse is
+recomputed blockwise, then dq/dk/dv accumulate over (q-block, kv-block)
+pairs inside lax.scan — no O(Tq*Tk) tensor is ever materialized, so training
+memory stays O(T) end to end (the eager fallback forward still builds the
+full score matrix; the pallas forward + this backward never do).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention", "attention_reference"]
+
+_NEG_INF = float("-inf")
+
+
+def attention_reference(q, k, v, mask=None, scale: Optional[float] = None):
+    """Plain softmax attention on (B, H, T, D). ``mask`` is boolean
+    broadcastable to (B, H, Tq, Tk): True = attend."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, _NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1)
+    if mask is not None:  # fully-masked rows -> zeros, not NaN
+        w = jnp.where(jnp.isfinite(logits).any(-1, keepdims=True), w, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v)
+
+
+def _pick_block(t: int, preferred=(512, 256, 128, 64, 32, 16, 8)) -> int:
+    for b in preferred:
+        if t % b == 0:
+            return b
+    return 0
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  scale: float, causal: bool, bq: int, bk: int, nk: int):
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    i = pl.program_id(1)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)           # (bq, d)
+        k = k_ref[0].astype(jnp.float32)           # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        if causal:
+            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                      # (bq, 1)
+        cur = s.max(axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, cur)
+        # fully-masked-so-far rows: keep exp() finite
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(jnp.where(jnp.isfinite(s), s - safe_m, _NEG_INF))
+        corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
+        l_new = l_ref[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # skip fully-masked kv blocks above the diagonal
+        pl.when(j * bk <= i * bq + (bq - 1))(_step)
+    else:
+        _step()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0, ...] = (acc_ref[...] /
+                         jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _flash_forward_pallas(q, k, v, causal: bool, scale: float):
+    """(B, H, T, D) flash attention via pallas_call; returns (B, H, T, D)."""
+    import jax.experimental.pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq, bk = _pick_block(tq), _pick_block(tk)
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+    nq, nk = tq // bq, tk // bk
+
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, nk=nk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[_vmem((bq, d)), _vmem((bq, 128)), _vmem((bq, 128))],
+        compiler_params=_tpu_params(),
+    )(qr, kr, vr)
+    return out.reshape(b, h, tq, d)
+
+
+def _vmem(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+def _tpu_params():
+    from jax.experimental.pallas import tpu as pltpu
+
+    try:
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except (AttributeError, TypeError):
+        try:
+            return pltpu.TPUCompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"))
+        except (AttributeError, TypeError):
+            return None
+
+
+def _use_pallas(q, k, mask) -> bool:
+    if mask is not None:
+        return False
+    try:
+        platform = q.devices().pop().platform if hasattr(q, "devices") \
+            else jax.default_backend()
+    except Exception:
+        platform = jax.default_backend()
+    if platform != "tpu":
+        return False
+    tq, tk, d = q.shape[2], k.shape[2], q.shape[-1]
+    return (_pick_block(tq) > 0 and _pick_block(tk) > 0 and d <= 256
+            and d % 8 == 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, mask, causal: bool, scale: float):
+    if _use_pallas(q, k, mask):
+        try:
+            return _flash_forward_pallas(q, k, v, causal, scale)
+        except Exception:
+            pass
+    m = mask
+    if causal:
+        cm = jnp.tril(jnp.ones((q.shape[2], k.shape[2]), bool))[None, None]
+        m = cm if m is None else jnp.logical_and(m, cm)
+    return attention_reference(q, k, v, mask=m, scale=scale)
+
+
+def _flash_fwd(q, k, v, mask, causal, scale):
+    out = _flash(q, k, v, mask, causal, scale)
+    return out, (q, k, v, mask, out)
+
+
+def _mask_block(mask, qi, kj, bq, bk):
+    """Slice a (B,H?,Tq?,Tk?) broadcastable mask to the (qi,kj) block."""
+    if mask is None:
+        return None
+    mq = (jax.lax.dynamic_slice_in_dim(mask, qi * bq, bq, axis=2)
+          if mask.shape[2] != 1 else mask)
+    return (jax.lax.dynamic_slice_in_dim(mq, kj * bk, bk, axis=3)
+            if mask.shape[3] != 1 else mq)
+
+
+def _block_logits(q_blk, k_blk, scale, causal, qi, kj, bq, bk, mask):
+    """(B,H,bq,bk) masked logits for block pair (qi, kj)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, k_blk).astype(jnp.float32) * scale
+    if causal:
+        qpos = qi * bq + jnp.arange(bq)
+        kpos = kj * bk + jnp.arange(bk)
+        s = jnp.where((qpos[:, None] >= kpos[None, :])[None, None], s, _NEG_INF)
+    mb = _mask_block(mask, qi, kj, bq, bk)
+    if mb is not None:
+        s = jnp.where(mb, s, _NEG_INF)
+    return s
+
+
+def _flash_bwd(causal, scale, res, g):
+    """Blockwise flash-attention backward: O(T) memory via lse recompute.
+
+    Standard flash recipe: recompute row lse blockwise, then
+      D_i  = sum(g_i * out_i)
+      p_ij = exp(s_ij - lse_i)
+      ds   = p * (g @ v^T - D)
+      dq_i = sum_j ds @ k_j * scale ; dk_j = sum_i ds^T @ q_i * scale
+      dv_j = sum_i p^T @ g_i
+    Only O(T)-sized tensors cross scan steps — never the full (Tq, Tk)
+    score matrix."""
+    q, k, v, mask, out = res
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq = _pick_block(tq, (256, 128, 64, 32, 16, 8, 4, 2, 1))
+    bk = _pick_block(tk, (256, 128, 64, 32, 16, 8, 4, 2, 1))
+    nq, nk = tq // bq, tk // bk
+
+    if mask is not None:  # normalize to 4-D for block slicing
+        mask = mask.reshape((1,) * (4 - mask.ndim) + mask.shape)
+
+    def blk(x, i, bsz):
+        return jax.lax.dynamic_slice_in_dim(x, i * bsz, bsz, axis=2)
+
+    # ---- pass 1: row lse, blockwise over kv ------------------------------
+    def lse_row(qi):
+        q_blk = blk(q, qi, bq).astype(jnp.float32)
+
+        def body(carry, kj):
+            m_run, l_run = carry
+            s = _block_logits(q_blk, blk(k, kj, bk).astype(jnp.float32),
+                              scale, causal, qi, kj, bq, bk, mask)
+            m_new = jnp.maximum(m_run, s.max(-1))
+            safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.where(jnp.isfinite(s), jnp.exp(s - safe[..., None]), 0.0)
+            corr = jnp.where(jnp.isfinite(m_run),
+                             jnp.exp(m_run - safe), 0.0)
+            return (m_new, l_run * corr + p.sum(-1)), None
+
+        m0 = jnp.full((b, h, bq), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, bq), jnp.float32)
+        (m_f, l_f), _ = jax.lax.scan(body, (m0, l0), jnp.arange(nk))
+        return m_f + jnp.log(jnp.where(l_f == 0.0, 1.0, l_f))
+
+    _, lse = jax.lax.scan(lambda c, qi: (c, lse_row(qi)), 0, jnp.arange(nq))
+    lse = lse.transpose(1, 2, 0, 3).reshape(b, h, tq)       # (B,H,Tq)
+
+    gf = g.astype(jnp.float32)
+    delta = (gf * out.astype(jnp.float32)).sum(-1)          # (B,H,Tq)
+
+    # ---- pass 2: dq (outer q blocks, inner kv blocks) --------------------
+    def dq_row(qi):
+        q_blk = blk(q, qi, bq).astype(jnp.float32)
+        g_blk = blk(gf, qi, bq)
+        lse_blk = blk(lse.reshape(b, h, tq, 1), qi, bq)[..., 0]
+        d_blk = blk(delta.reshape(b, h, tq, 1), qi, bq)[..., 0]
+
+        def body(acc, kj):
+            k_blk = blk(k, kj, bk).astype(jnp.float32)
+            v_blk = blk(v, kj, bk).astype(jnp.float32)
+            s = _block_logits(q_blk, k_blk, scale, causal, qi, kj, bq, bk,
+                              mask)
+            p = jnp.where(jnp.isfinite(s),
+                          jnp.exp(s - lse_blk[..., None]), 0.0)
+            dp = jnp.einsum("bhqd,bhkd->bhqk", g_blk, v_blk)
+            ds = p * (dp - d_blk[..., None])
+            return acc + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk) * scale, None
+
+        acc0 = jnp.zeros((b, h, bq, d), jnp.float32)
+        dq_blk, _ = jax.lax.scan(body, acc0, jnp.arange(nk))
+        return dq_blk
+
+    _, dq_blocks = jax.lax.scan(lambda c, qi: (c, dq_row(qi)), 0,
+                                jnp.arange(nq))
+    dq = dq_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, tq, d)
+
+    # ---- pass 3: dk/dv (outer kv blocks, inner q blocks) -----------------
+    def dkv_col(kj):
+        k_blk = blk(k, kj, bk).astype(jnp.float32)
+        v_blk = blk(v, kj, bk).astype(jnp.float32)
+
+        def body(carry, qi):
+            dk_acc, dv_acc = carry
+            q_blk = blk(q, qi, bq).astype(jnp.float32)
+            g_blk = blk(gf, qi, bq)
+            lse_blk = blk(lse.reshape(b, h, tq, 1), qi, bq)[..., 0]
+            d_blk = blk(delta.reshape(b, h, tq, 1), qi, bq)[..., 0]
+            s = _block_logits(q_blk, k_blk, scale, causal, qi, kj, bq, bk,
+                              mask)
+            p = jnp.where(jnp.isfinite(s),
+                          jnp.exp(s - lse_blk[..., None]), 0.0)
+            dp = jnp.einsum("bhqd,bhkd->bhqk", g_blk, v_blk)
+            ds = p * (dp - d_blk[..., None])
+            dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, q_blk) * scale
+            dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, g_blk)
+            return (dk_acc, dv_acc), None
+
+        z = jnp.zeros((b, h, bk, d), jnp.float32)
+        (dk_blk, dv_blk), _ = jax.lax.scan(body, (z, z), jnp.arange(nq))
+        return jnp.stack([dk_blk, dv_blk])
+
+    _, dkv = jax.lax.scan(lambda c, kj: (c, dkv_col(kj)), 0, jnp.arange(nk))
+    dk = dkv[:, 0].transpose(1, 2, 0, 3, 4).reshape(b, h, tk, d)
+    dv = dkv[:, 1].transpose(1, 2, 0, 3, 4).reshape(b, h, tk, d)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, mask=None, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Fused multi-head attention on (B, H, T, D) arrays.
+
+    mask: optional boolean, broadcastable to (B, H, Tq, Tk); True = attend.
+    causal: apply a lower-triangular mask (composable with ``mask``).
+    scale: logit scale; defaults to 1/sqrt(D).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if mask is not None and mask.dtype != jnp.bool_:
+        mask = mask.astype(bool)
+    return _flash(q, k, v, mask, bool(causal), float(scale))

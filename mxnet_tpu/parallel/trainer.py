@@ -177,8 +177,9 @@ def make_train_step(net, loss_fn, names: List[str],
         return allv
 
     def loss_of(tvals, avals, key_val, x, y):
-        outs, mutated = fn(assemble(tvals, avals, key_val), x)
-        pred = outs[0]
+        xs = x if isinstance(x, (tuple, list)) else (x,)
+        outs, mutated = fn(assemble(tvals, avals, key_val), *xs)
+        pred = outs[0] if len(outs) == 1 else tuple(outs)
         loss = loss_fn(pred, y)
         return jnp.mean(loss), (mutated,)
 
@@ -229,12 +230,17 @@ class ShardedTrainer:
 
     def step(self, x, y) -> float:
         """One SPMD step; returns scalar loss."""
-        if isinstance(x, NDArray):
-            x = x._data
-        if isinstance(y, NDArray):
-            y = y._data
-        xb = jax.device_put(x, NamedSharding(self.mesh, self._batch_spec))
-        yb = jax.device_put(y, NamedSharding(self.mesh, self._batch_spec))
+        def put(v):
+            if isinstance(v, (tuple, list)):
+                return tuple(put(e) for e in v)
+            if isinstance(v, NDArray):
+                v = v._data
+            spec = self._batch_spec
+            if getattr(v, "ndim", 1) < len(spec):
+                spec = P(*spec[:v.ndim])
+            return jax.device_put(v, NamedSharding(self.mesh, spec))
+
+        xb, yb = put(x), put(y)
         self._t += 1
         self.pvals, mutated, self.opt_state, loss = self._step_fn(
             self.pvals, self.avals, self._key, self.opt_state, self._t, xb, yb)
