@@ -44,12 +44,12 @@ class MultiHeadAttentionCell(HybridBlock):
                              in_units=units)
         self.dropout = nn.Dropout(dropout) if dropout else None
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, valid_length=None):
         from ... import numpy as mnp
         qkv = self.qkv(x)                      # (B, T, 3U)
         q, k, v = mnp.split(qkv, 3, axis=-1)
         out = npx.multi_head_attention(q, k, v, num_heads=self._num_heads,
-                                       mask=mask)
+                                       mask=mask, valid_length=valid_length)
         out = self.proj(out)
         if self.dropout is not None:
             out = self.dropout(out)
@@ -89,8 +89,8 @@ class TransformerEncoderCell(HybridBlock):
         self.layer_norm_ffn = nn.LayerNorm(epsilon=layer_norm_eps,
                                            in_channels=units)
 
-    def forward(self, x, mask=None):
-        x = self.layer_norm_att(x + self.attention(x, mask))
+    def forward(self, x, mask=None, valid_length=None):
+        x = self.layer_norm_att(x + self.attention(x, mask, valid_length))
         x = self.layer_norm_ffn(x + self.ffn(x))
         return x
 
@@ -110,9 +110,9 @@ class BERTEncoder(HybridBlock):
                 units, hidden_size, num_heads, dropout=dropout,
                 layer_norm_eps=layer_norm_eps))
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, valid_length=None):
         for cell in self.layers:
-            x = cell(x, mask)
+            x = cell(x, mask, valid_length)
         return x
 
 
@@ -161,15 +161,9 @@ class BERTModel(HybridBlock):
         if self.embed_dropout is not None:
             emb = self.embed_dropout(emb)
 
-        mask = None
-        if valid_length is not None:
-            # (B,) -> (B, 1, 1, T): key positions beyond valid_length masked
-            from ... import numpy as mnp
-            ar = mnp.arange(seq_len)
-            mask = (ar.reshape(1, 1, 1, seq_len) <
-                    valid_length.reshape(-1, 1, 1, 1))
-
-        out = self.encoder(emb, mask)
+        # per-row key lengths ride the pallas kernel's SMEM length input
+        # (a boolean mask would force the O(T^2) reference fallback)
+        out = self.encoder(emb, None, valid_length)
         pooled = self.pooler(out[:, 0])
         return out, pooled
 

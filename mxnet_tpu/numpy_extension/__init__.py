@@ -36,6 +36,7 @@ __all__ = [
     "arange_like", "gather_nd", "scatter_nd", "index_update", "index_add",
     "smooth_l1", "all_finite", "multi_sum_sq", "clip_by_global_norm",
     "multi_head_attention", "flash_attention",
+    "foreach", "while_loop", "cond",
     "waitall", "load", "save", "set_np", "reset_np", "is_np_array",
     "cpu", "gpu", "tpu", "num_gpus", "num_tpus", "current_context",
 ]
@@ -403,46 +404,61 @@ def rnn(data, parameters, state, state_cell=None, mode="lstm",
 
 
 # -- fused attention ---------------------------------------------------------
-def flash_attention(query, key, value, mask=None, causal=False, scale=None,
-                    out=None):
+def flash_attention(query, key, value, mask=None, valid_length=None,
+                    causal=False, scale=None, out=None):
     """Fused flash attention on (B, H, T, D) NDArrays (pallas on TPU).
 
+    ``valid_length``: (B,) key lengths — stays on the pallas kernel
+    (boolean ``mask`` falls back to the reference path).
     Ref counterpart: src/operator/contrib/transformer.cc interleaved-matmul
     attention kernels; redesigned as a blockwise online-softmax TPU kernel
     (ops/attention.py)."""
     from ..ops import attention as _att
 
-    inputs = (query, key, value) + ((mask,) if mask is not None else ())
+    extras = [x for x in (mask, valid_length) if x is not None]
+    has_mask = mask is not None
 
     def f(*raw):
-        m = raw[3] if len(raw) > 3 else None
+        m = raw[3] if has_mask else None
+        vl = raw[3 + has_mask] if valid_length is not None else None
         return _att.flash_attention(raw[0], raw[1], raw[2], mask=m,
-                                    causal=causal, scale=scale)
+                                    kv_valid_length=vl, causal=causal,
+                                    scale=scale)
 
-    return call(f, inputs, {}, name="flash_attention", out=out)
+    return call(f, (query, key, value) + tuple(extras), {},
+                name="flash_attention", out=out)
 
 
 def multi_head_attention(query, key, value, num_heads, mask=None,
-                         causal=False, scale=None, out=None):
-    """(B, T, H*D) -> (B, T, H*D) fused multi-head attention."""
+                         valid_length=None, causal=False, scale=None,
+                         out=None):
+    """(B, T, H*D) -> (B, T, H*D) fused multi-head attention.
+    ``valid_length``: (B,) key lengths (pallas-friendly padding mask)."""
     from ..ops import attention as _att
 
     if query.shape[-1] % num_heads:
         raise MXNetError(f"embedding dim {query.shape[-1]} not divisible by "
                          f"num_heads {num_heads}")
-    inputs = (query, key, value) + ((mask,) if mask is not None else ())
+    extras = [x for x in (mask, valid_length) if x is not None]
+    has_mask = mask is not None
 
     def f(*raw):
         q, k, v = raw[0], raw[1], raw[2]
-        m = raw[3] if len(raw) > 3 else None
+        m = raw[3] if has_mask else None
+        vl = raw[3 + has_mask] if valid_length is not None else None
         b, tq, emb = q.shape
         tk = k.shape[1]
         d = emb // num_heads
         qh = q.reshape(b, tq, num_heads, d).transpose(0, 2, 1, 3)
         kh = k.reshape(b, tk, num_heads, d).transpose(0, 2, 1, 3)
         vh = v.reshape(b, tk, num_heads, d).transpose(0, 2, 1, 3)
-        o = _att.flash_attention(qh, kh, vh, mask=m, causal=causal,
-                                 scale=scale)
+        o = _att.flash_attention(qh, kh, vh, mask=m, kv_valid_length=vl,
+                                 causal=causal, scale=scale)
         return o.transpose(0, 2, 1, 3).reshape(b, tq, emb)
 
-    return call(f, inputs, {}, name="multi_head_attention", out=out)
+    return call(f, (query, key, value) + tuple(extras), {},
+                name="multi_head_attention", out=out)
+
+
+# -- control flow ------------------------------------------------------------
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: E402

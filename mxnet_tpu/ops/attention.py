@@ -51,8 +51,9 @@ def _pick_block(t: int, preferred=(512, 256, 128, 64, 32, 16, 8)) -> int:
     return 0
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale: float, causal: bool, bq: int, bk: int, nk: int):
+def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                  *, scale: float, causal: bool, has_len: bool, bq: int,
+                  bk: int, nk: int):
     import jax.experimental.pallas as pl
 
     j = pl.program_id(2)
@@ -71,10 +72,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
             qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        if has_len:
+            s = jnp.where(kpos < len_ref[pl.program_id(0), 0], s, _NEG_INF)
         m_prev = m_ref[:, :1]                      # (bq, 1)
         cur = s.max(axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, cur)
@@ -90,11 +93,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
+    run = jnp.bool_(True)
     if causal:
         # skip fully-masked kv blocks above the diagonal
-        pl.when(j * bk <= i * bq + (bq - 1))(_step)
-    else:
-        _step()
+        run = jnp.logical_and(run, j * bk <= i * bq + (bq - 1))
+    if has_len:
+        # skip kv blocks entirely past the row's valid length
+        run = jnp.logical_and(run, j * bk < len_ref[pl.program_id(0), 0])
+    pl.when(run)(_step)
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -103,9 +109,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                          jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
 
 
-def _flash_forward_pallas(q, k, v, causal: bool, scale: float):
-    """(B, H, T, D) flash attention via pallas_call; returns (B, H, T, D)."""
+def _flash_forward_pallas(q, k, v, causal: bool, scale: float, kv_len=None):
+    """(B, H, T, D) flash attention via pallas_call; returns (B, H, T, D).
+    ``kv_len``: optional (B,) int32 per-row valid key length."""
     import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, h, tq, d = q.shape
     tk = k.shape[2]
@@ -114,13 +122,23 @@ def _flash_forward_pallas(q, k, v, causal: bool, scale: float):
     kr = k.reshape(b * h, tk, d)
     vr = v.reshape(b * h, tk, d)
     nq, nk = tq // bq, tk // bk
+    has_len = kv_len is not None
+    if has_len:
+        lens = jnp.broadcast_to(kv_len.astype(jnp.int32)[:, None],
+                                (b, h)).reshape(b * h, 1)
+    else:
+        lens = jnp.full((b * h, 1), tk, jnp.int32)
 
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk)
+                               has_len=has_len, bq=bq, bk=bk, nk=nk)
     out = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
         in_specs=[
+            # whole (BH, 1) lengths vector in SMEM (SMEM blocks must cover
+            # the array); kernel indexes it by program_id(0)
+            pl.BlockSpec((b * h, 1), lambda b_, i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
@@ -129,7 +147,7 @@ def _flash_forward_pallas(q, k, v, causal: bool, scale: float):
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
         scratch_shapes=[_vmem((bq, d)), _vmem((bq, 128)), _vmem((bq, 128))],
         compiler_params=_tpu_params(),
-    )(qr, kr, vr)
+    )(lens, qr, kr, vr)
     return out.reshape(b, h, tq, d)
 
 
@@ -168,23 +186,34 @@ def _use_pallas(q, k, mask) -> bool:
             and d % 8 == 0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, mask, causal: bool, scale: float):
+def _merge_mask(mask, kv_len, tq, tk, causal):
+    """Combine boolean mask, (B,) kv_len and causal flag into one boolean
+    mask (or None). O(B*T + T^2) worst case — fallback path only."""
+    m = mask
+    if kv_len is not None:
+        lm = (jnp.arange(tk)[None, :] < kv_len[:, None])[:, None, None, :]
+        m = lm if m is None else jnp.logical_and(m, lm)
+    if causal:
+        cm = jnp.tril(jnp.ones((tq, tk), bool))[None, None]
+        m = cm if m is None else jnp.logical_and(m, cm)
+    return m
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash(q, k, v, mask, kv_len, causal: bool, scale: float):
     if _use_pallas(q, k, mask):
         try:
-            return _flash_forward_pallas(q, k, v, causal, scale)
+            return _flash_forward_pallas(q, k, v, causal, scale,
+                                         kv_len=kv_len)
         except Exception:
             pass
-    m = mask
-    if causal:
-        cm = jnp.tril(jnp.ones((q.shape[2], k.shape[2]), bool))[None, None]
-        m = cm if m is None else jnp.logical_and(m, cm)
+    m = _merge_mask(mask, kv_len, q.shape[2], k.shape[2], causal)
     return attention_reference(q, k, v, mask=m, scale=scale)
 
 
-def _flash_fwd(q, k, v, mask, causal, scale):
-    out = _flash(q, k, v, mask, causal, scale)
-    return out, (q, k, v, mask, out)
+def _flash_fwd(q, k, v, mask, kv_len, causal, scale):
+    out = _flash(q, k, v, mask, kv_len, causal, scale)
+    return out, (q, k, v, mask, kv_len, out)
 
 
 def _mask_block(mask, qi, kj, bq, bk):
@@ -221,9 +250,12 @@ def _flash_bwd(causal, scale, res, g):
       dv_j = sum_i p^T @ g_i
     Only O(T)-sized tensors cross scan steps — never the full (Tq, Tk)
     score matrix."""
-    q, k, v, mask, out = res
+    q, k, v, mask, kv_len, out = res
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    if kv_len is not None:
+        lm = (jnp.arange(tk)[None, :] < kv_len[:, None])[:, None, None, :]
+        mask = lm if mask is None else jnp.logical_and(mask, lm)
     bq = _pick_block(tq, (256, 128, 64, 32, 16, 8, 4, 2, 1))
     bk = _pick_block(tk, (256, 128, 64, 32, 16, 8, 4, 2, 1))
     nq, nk = tq // bq, tk // bk
@@ -314,22 +346,29 @@ def _flash_bwd(causal, scale, res, g):
     _, dkv = jax.lax.scan(lambda c, kj: (c, dkv_col(kj)), 0, jnp.arange(nk))
     dk = dkv[:, 0].transpose(1, 2, 0, 3, 4).reshape(b, h, tk, d)
     dv = dkv[:, 1].transpose(1, 2, 0, 3, 4).reshape(b, h, tk, d)
-    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None, None)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, mask=None, causal: bool = False,
-                    scale: Optional[float] = None):
+                    scale: Optional[float] = None, kv_valid_length=None):
     """Fused multi-head attention on (B, H, T, D) arrays.
 
-    mask: optional boolean, broadcastable to (B, H, Tq, Tk); True = attend.
-    causal: apply a lower-triangular mask (composable with ``mask``).
+    mask: optional boolean, broadcastable to (B, H, Tq, Tk); True = attend
+        (general masks run the reference fallback).
+    kv_valid_length: optional (B,) int lengths — key positions >= length are
+        masked. Unlike ``mask``, this stays on the pallas kernel (the
+        standard padded-batch case).
+    causal: apply a lower-triangular mask (composable with the others).
     scale: logit scale; defaults to 1/sqrt(D).
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if mask is not None and mask.dtype != jnp.bool_:
         mask = mask.astype(bool)
-    return _flash(q, k, v, mask, bool(causal), float(scale))
+    if kv_valid_length is not None:
+        kv_valid_length = kv_valid_length.astype(jnp.int32)
+    return _flash(q, k, v, mask, kv_valid_length, bool(causal), float(scale))

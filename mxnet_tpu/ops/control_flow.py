@@ -1,0 +1,240 @@
+"""Control-flow ops: foreach / while_loop / cond.
+
+Reference: src/operator/control_flow.cc:477-548 — stateful C++ ops holding
+sub-CachedOps for the loop body, with hand-built backward graphs. TPU-native
+redesign: the user-defined function (UDF) is traced once into the
+corresponding XLA structured-control-flow primitive (lax.scan /
+lax.while_loop-with-bound / lax.cond), which gives compiler-legal control
+flow on TPU and autodiff for free — no sub-graph executors, no dynamic
+shapes.
+
+UDFs operate on NDArrays (same contract as mx.nd.contrib.foreach etc.);
+they are invoked with tape recording paused because gradients flow through
+the outer jax.vjp of the whole loop, not per-op tape nodes.
+
+while_loop matches the reference's semantics: a ``max_iterations`` bound is
+mandatory (XLA needs static shapes), step outputs are stacked into a
+max_iterations-long leading axis, and positions past the actual trip count
+are zero-filled.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _flatten(x) -> Tuple[list, Callable]:
+    """Flatten NDArray / (nested) list-tuple of NDArrays; return rebuilder."""
+    from ..ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        return [x], lambda vals: vals[0]
+    if isinstance(x, (list, tuple)):
+        parts, rebuilds, counts = [], [], []
+        for item in x:
+            p, rb = _flatten(item)
+            parts.extend(p)
+            rebuilds.append(rb)
+            counts.append(len(p))
+        def rebuild(vals):
+            out, i = [], 0
+            for rb, c in zip(rebuilds, counts):
+                out.append(rb(vals[i:i + c]))
+                i += c
+            return out
+        return parts, rebuild
+    raise MXNetError(f"control-flow arguments must be NDArrays or nested "
+                     f"lists of NDArrays, got {type(x)}")
+
+
+def _call_udf(udf, *args):
+    """Run a UDF on NDArrays with tape recording paused (see module doc).
+
+    The global RNG key is restored if the UDF advanced it with a traced
+    value (e.g. dropout inside the loop body): the trace closes over a
+    concrete key snapshot, so stochastic layers reuse one mask across
+    iterations — variational-dropout semantics — instead of leaking a
+    tracer into the global key."""
+    from .. import autograd
+    from ..random import key_holder
+
+    kh = key_holder()
+    saved = kh._data
+    try:
+        with autograd.pause(train_mode=autograd.is_training()):
+            return udf(*args)
+    finally:
+        if isinstance(kh._data, jax.core.Tracer):
+            kh._data = saved
+
+
+def _preflight(udf, *args):
+    """Run the UDF once eagerly (predict mode, no recording) so gluon
+    blocks finish deferred parameter init BEFORE the body is traced into
+    lax.scan/cond. Inside a trace, Block.__call__ would silently
+    initialize deferred params with tracer values that escape the scan
+    (UnexpectedTracerError at best, garbage params at worst), so this
+    must run unconditionally — we cannot see through the UDF's closure to
+    know whether its blocks are initialized. Cost: one eager body step
+    per call (1/T of the scan work for foreach; for cond, lax.cond traces
+    both branches anyway). The reference needs no analogue: its shape
+    inference is a graph pass (src/imperative/infer_graph_attr_pass.cc)."""
+    from .. import autograd
+
+    with autograd.pause(train_mode=False):
+        udf(*args)
+
+
+def foreach(body: Callable, data, init_states):
+    """Scan ``body`` over the leading axis of ``data``.
+
+    body(data_slice, states) -> (outputs, new_states). Returns
+    (outputs stacked on axis 0, final states). Ref: the `_foreach` op
+    (src/operator/control_flow.cc registration `foreach`)."""
+    from ..ndarray import NDArray
+    from .dispatch import invoke
+
+    data_flat, data_rebuild = _flatten(data)
+    state_flat, state_rebuild = _flatten(init_states)
+    n_data, n_state = len(data_flat), len(state_flat)
+    if not data_flat:
+        raise MXNetError("foreach needs at least one data array")
+    length = data_flat[0].shape[0]
+    for d in data_flat:
+        if d.shape[0] != length:
+            raise MXNetError("foreach data arrays must share leading dim")
+
+    _preflight(body, data_rebuild([d[0] for d in data_flat]),
+               state_rebuild(list(state_flat)))
+    meta = {}
+
+    def f(*raw):
+        d_raw, s_raw = raw[:n_data], raw[n_data:]
+
+        def step(carry, xs):
+            x_nd = data_rebuild([NDArray(x) for x in xs])
+            s_nd = state_rebuild([NDArray(c) for c in carry])
+            outs, new_states = _call_udf(body, x_nd, s_nd)
+            o_flat, o_rb = _flatten(outs)
+            ns_flat, _ = _flatten(new_states)
+            if len(ns_flat) != n_state:
+                raise MXNetError("foreach body changed the number of states")
+            meta["out_rebuild"], meta["n_out"] = o_rb, len(o_flat)
+            return (tuple(a._data for a in ns_flat),
+                    tuple(o._data for o in o_flat))
+
+        final, ys = lax.scan(step, tuple(s_raw), tuple(d_raw))
+        return tuple(ys) + tuple(final)
+
+    res = invoke(f, data_flat + state_flat, name="foreach")
+    res = res if isinstance(res, tuple) else (res,)
+    n_out = meta["n_out"]
+    outputs = meta["out_rebuild"](list(res[:n_out]))
+    states = state_rebuild(list(res[n_out:]))
+    return outputs, states
+
+
+def while_loop(cond_fn: Callable, func: Callable, loop_vars,
+               max_iterations: int):
+    """Bounded while loop. cond_fn(*loop_vars) -> boolean scalar;
+    func(*loop_vars) -> (step_outputs, new_loop_vars). Returns
+    (outputs stacked to max_iterations with unused tail zero-filled,
+    final loop_vars). Ref: `_while_loop` op (control_flow.cc)."""
+    from ..ndarray import NDArray
+    from .dispatch import invoke
+
+    if max_iterations is None or max_iterations <= 0:
+        raise MXNetError("while_loop requires a positive max_iterations "
+                         "(static bound for XLA)")
+    var_flat, var_rebuild = _flatten(loop_vars)
+    n_var = len(var_flat)
+    _pre = var_rebuild(list(var_flat))
+    _pre_list = _pre if isinstance(_pre, list) else [_pre]
+    _preflight(func, *_pre_list)
+    meta = {}
+
+    def _as_bool(x):
+        raw = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        return raw.reshape(()).astype(bool)
+
+    def f(*raw):
+        def step(carry, _):
+            active, vals = carry
+            v_nd = var_rebuild([NDArray(v) for v in vals])
+            v_list = v_nd if isinstance(v_nd, list) else [v_nd]
+            active = jnp.logical_and(active,
+                                     _as_bool(_call_udf(cond_fn, *v_list)))
+            outs, new_vars = _call_udf(func, *v_list)
+            o_flat, o_rb = _flatten(outs)
+            nv_flat, _ = _flatten(new_vars)
+            if len(nv_flat) != n_var:
+                raise MXNetError("while_loop func changed loop_vars arity")
+            meta["out_rebuild"], meta["n_out"] = o_rb, len(o_flat)
+            for nv, v in zip(nv_flat, vals):
+                if nv._data.dtype != v.dtype:
+                    raise MXNetError(
+                        f"while_loop func changed a loop var dtype "
+                        f"{v.dtype} -> {nv._data.dtype}; loop vars must "
+                        f"keep shape and dtype (ref control_flow.cc)")
+            new_vals = tuple(jnp.where(active, nv._data, v)
+                             for nv, v in zip(nv_flat, vals))
+            ys = tuple(jnp.where(active, o._data, jnp.zeros_like(o._data))
+                       for o in o_flat)
+            return (active, new_vals), ys + (active,)
+
+        (_, final), ys = lax.scan(step, (jnp.bool_(True), tuple(raw)), None,
+                                  length=max_iterations)
+        steps = ys[-1].sum().astype(jnp.int32)
+        return tuple(ys[:-1]) + tuple(final) + (steps,)
+
+    res = invoke(f, var_flat, name="while_loop")
+    res = res if isinstance(res, tuple) else (res,)
+    n_out = meta["n_out"]
+    outputs = meta["out_rebuild"](list(res[:n_out]))
+    states = var_rebuild(list(res[n_out:n_out + n_var]))
+    return outputs, states
+
+
+def cond(pred: Callable, then_func: Callable, else_func: Callable, inputs):
+    """Conditional: run then_func(*inputs) or else_func(*inputs) depending on
+    pred(*inputs). Branch outputs must match in shape/dtype.
+    Ref: `_cond` op (control_flow.cc)."""
+    from ..ndarray import NDArray
+    from .dispatch import invoke
+
+    in_flat, in_rebuild = _flatten(inputs)
+    _pre = in_rebuild(list(in_flat))
+    _pre_list = _pre if isinstance(_pre, list) else [_pre]
+    _preflight(then_func, *_pre_list)
+    _preflight(else_func, *_pre_list)
+    meta = {}
+
+    def f(*raw):
+        nd = in_rebuild([NDArray(r) for r in raw])
+        nd_list = nd if isinstance(nd, list) else [nd]
+        p = _call_udf(pred, *nd_list)
+        p_raw = (p._data if isinstance(p, NDArray)
+                 else jnp.asarray(p)).reshape(()).astype(bool)
+
+        def branch(takes_then, vals):
+            nd_b = in_rebuild([NDArray(v) for v in vals])
+            lst = nd_b if isinstance(nd_b, list) else [nd_b]
+            out = _call_udf(then_func if takes_then else else_func, *lst)
+            o_flat, o_rb = _flatten(out)
+            meta["out_rebuild"] = o_rb
+            return tuple(o._data for o in o_flat)
+
+        return lax.cond(p_raw,
+                        lambda vals: branch(True, vals),
+                        lambda vals: branch(False, vals), tuple(raw))
+
+    res = invoke(f, in_flat, name="cond")
+    res = res if isinstance(res, tuple) else (res,)
+    return meta["out_rebuild"](list(res))

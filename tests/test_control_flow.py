@@ -1,0 +1,162 @@
+"""Control-flow op tests (ref: tests/python/unittest/test_contrib_control_flow.py)."""
+import numpy as onp
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu import numpy_extension as npx
+from mxnet_tpu.base import MXNetError
+
+
+def test_foreach_cumsum():
+    data = mx.np.array(onp.arange(12).reshape(4, 3), dtype='float32')
+    init = mx.np.zeros((3,))
+
+    def body(x, states):
+        s = states[0] + x
+        return s, [s]
+
+    outs, states = npx.foreach(body, data, [init])
+    expect = onp.cumsum(onp.arange(12).reshape(4, 3), axis=0)
+    assert onp.allclose(outs.asnumpy(), expect)
+    assert onp.allclose(states[0].asnumpy(), expect[-1])
+
+
+def test_foreach_grad():
+    data = mx.np.array(onp.random.RandomState(0).rand(5, 4), dtype='float32')
+    init = mx.np.ones((4,))
+    data.attach_grad()
+
+    def body(x, states):
+        s = states[0] * x
+        return s, [s]
+
+    with autograd.record():
+        outs, states = npx.foreach(body, data, [init])
+        (outs.sum() + states[0].sum()).backward()
+    # numeric check against prod-based closed form via finite differences
+    d = data.asnumpy()
+
+    def f(d):
+        s = onp.ones(4); tot = 0.0
+        for t in range(5):
+            s = s * d[t]; tot += s.sum()
+        return tot + s.sum()
+
+    eps = 1e-3
+    for idx in [(0, 0), (2, 3), (4, 1)]:
+        dp = d.copy(); dp[idx] += eps
+        dm = d.copy(); dm[idx] -= eps
+        fd = (f(dp) - f(dm)) / (2 * eps)
+        assert abs(fd - data.grad.asnumpy()[idx]) < 1e-2
+
+
+def test_foreach_multiple_data_and_outputs():
+    a = mx.np.array(onp.arange(6).reshape(3, 2), dtype='float32')
+    b = mx.np.array(onp.arange(6, 12).reshape(3, 2), dtype='float32')
+    init = mx.np.zeros((2,))
+
+    def body(xs, states):
+        x, y = xs
+        s = states[0] + x * y
+        return [x + y, s], [s]
+
+    outs, states = npx.foreach(body, [a, b], [init])
+    assert outs[0].shape == (3, 2) and outs[1].shape == (3, 2)
+    assert onp.allclose(outs[0].asnumpy(), (a + b).asnumpy())
+
+
+def test_while_loop_basic():
+    i = mx.np.array([0], dtype='float32')
+    s = mx.np.array([0], dtype='float32')
+
+    outs, states = npx.while_loop(
+        lambda i, s: (i < 5).reshape(()),
+        lambda i, s: (i * 2, [i + 1, s + i]),
+        [i, s], max_iterations=10)
+    # 5 active steps: outputs i*2 for i=0..4, then zero-padded
+    assert outs.shape[0] == 10
+    assert onp.allclose(outs.asnumpy()[:5, 0], [0, 2, 4, 6, 8])
+    assert onp.allclose(outs.asnumpy()[5:], 0)
+    assert float(states[0].asnumpy()[0]) == 5
+    assert float(states[1].asnumpy()[0]) == 10  # 0+1+2+3+4
+
+
+def test_while_loop_grad():
+    x = mx.np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        outs, states = npx.while_loop(
+            lambda v: (v < 100).reshape(()),
+            lambda v: (v, [v * v]),
+            [x], max_iterations=5)
+        states[0].backward()
+    # 2 -> 4 -> 16 -> 256(stop): f = ((x^2)^2)^2 = x^8? cond: v<100: v=2 yes,
+    # v=4 yes, v=16 yes, v=256 no -> 3 squarings: d/dx x^8 = 8x^7 = 1024
+    assert abs(float(x.grad.asnumpy()[0]) - 1024.0) < 1e-2
+
+
+def test_while_loop_requires_bound():
+    with pytest.raises(MXNetError):
+        npx.while_loop(lambda v: v < 5, lambda v: (v, [v]),
+                       [mx.np.array([0.0])], max_iterations=0)
+
+
+def test_cond():
+    x = mx.np.array([3.0])
+    y = mx.np.array([5.0])
+    out = npx.cond(lambda a, b: (a < b).reshape(()),
+                   lambda a, b: a * 2,
+                   lambda a, b: b * 10, [x, y])
+    assert float(out.asnumpy()[0]) == 6.0
+    out2 = npx.cond(lambda a, b: (a > b).reshape(()),
+                    lambda a, b: a * 2,
+                    lambda a, b: b * 10, [x, y])
+    assert float(out2.asnumpy()[0]) == 50.0
+
+
+def test_cond_grad():
+    x = mx.np.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        out = npx.cond(lambda a: (a < 10).reshape(()),
+                       lambda a: a * a,
+                       lambda a: a, [x])
+        out.backward()
+    assert abs(float(x.grad.asnumpy()[0]) - 6.0) < 1e-5
+
+
+def test_foreach_inside_jit_hybridize():
+    """foreach must be traceable (used inside hybridized blocks)."""
+    import jax
+
+    def step(x):
+        nd = mx.np.array(x) if not isinstance(x, mx.nd.NDArray) else x
+        outs, st = npx.foreach(lambda xx, ss: (xx + ss[0], [ss[0] + 1.0]),
+                               nd, [mx.np.zeros(x.shape[1:])])
+        return outs._data
+
+    f = jax.jit(lambda x: step(mx.nd.NDArray(x)))
+    r = f(jnp.ones((3, 2)))
+    assert onp.allclose(onp.asarray(r), [[1, 1], [2, 2], [3, 3]])
+
+
+def test_while_loop_rejects_dtype_change():
+    with pytest.raises(MXNetError):
+        npx.while_loop(lambda v: (v > 1).reshape(()),
+                       lambda v: (v, [v / 2.0]),
+                       [mx.np.array([9], dtype='int32')], max_iterations=8)
+
+
+def test_foreach_with_deferred_init_block():
+    """Gluon blocks with deferred shapes must initialize inside foreach."""
+    net_cell = mx.gluon.rnn.RNNCell(8)
+    out = mx.gluon.nn.Dense(1)
+    for b in (net_cell, out):
+        b.initialize(mx.init.Xavier())
+    x = mx.np.array(onp.random.RandomState(0).rand(4, 2, 3), dtype='float32')
+    h0 = mx.np.zeros((2, 8))
+    outs, st = npx.foreach(lambda xt, s: net_cell(xt, s), x, [h0])
+    y = out(st[0])
+    assert y.shape == (2, 1)
