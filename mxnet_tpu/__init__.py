@@ -40,6 +40,8 @@ from .lr_scheduler import LRScheduler
 from . import lr_scheduler
 from . import kvstore
 from . import gluon
+from . import engine
+from . import storage
 from . import profiler
 from . import runtime
 from . import amp

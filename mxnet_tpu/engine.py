@@ -1,0 +1,218 @@
+"""mx.engine — async dependency engine (host-side scheduler).
+
+Parity surface for the reference's Engine
+(include/mxnet/engine.h:155-318: NewVariable/PushAsync/PushSync/
+WaitForVar/WaitForAll/DeleteVariable). Device-side async dispatch is
+XLA/PJRT's job on TPU (SURVEY.md §7); this engine schedules host-side
+work — data loading, decode, prefetch, checkpoint IO — on the native C++
+scheduler (src/mxtpu/engine.cc) with read/write-var serialization and
+rethrow-at-wait error semantics. ``MXNET_ENGINE_TYPE=NaiveEngine``
+selects the synchronous debug engine (ref src/engine/engine.cc:32-49),
+which is also the fallback when the native library is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Callable, Optional, Sequence
+
+from . import _native
+from .base import MXNetError
+
+__all__ = ["Engine", "NativeEngine", "NaiveEngine", "get", "push",
+           "wait_for_var", "wait_for_all", "new_var", "delete_var"]
+
+
+class Var:
+    """Opaque scheduling variable (ref engine.h VarHandle)."""
+
+    __slots__ = ("_handle", "_engine")
+
+    def __init__(self, handle, engine):
+        self._handle = handle
+        self._engine = engine
+
+
+class Engine:
+    """Abstract engine interface."""
+
+    def new_var(self) -> Var:
+        raise NotImplementedError
+
+    def delete_var(self, var: Var):
+        raise NotImplementedError
+
+    def push(self, fn: Callable[[], None], read: Sequence[Var] = (),
+             write: Sequence[Var] = (), priority: int = 0):
+        raise NotImplementedError
+
+    def wait_for_var(self, var: Var):
+        raise NotImplementedError
+
+    def wait_for_all(self):
+        raise NotImplementedError
+
+
+class NaiveEngine(Engine):
+    """Synchronous engine: every push runs inline (ref NaiveEngine,
+    src/engine/naive_engine.cc). Deterministic; used for debugging and as
+    the no-native fallback. Error semantics preserved: a failed op poisons
+    its write vars, later ops on them are skipped, waits rethrow."""
+
+    def __init__(self):
+        self._errs = {}
+        self._first_err: Optional[BaseException] = None
+
+    def new_var(self) -> Var:
+        return Var(object(), self)
+
+    def delete_var(self, var: Var):
+        self._errs.pop(var._handle, None)
+
+    def push(self, fn, read=(), write=(), priority=0):
+        # same contract as the native engine: only READ deps propagate
+        # poison; a successful write supersedes a poisoned value
+        for v in read:
+            err = self._errs.get(v._handle)
+            if err is not None:
+                for w in write:
+                    self._errs[w._handle] = err
+                return
+        try:
+            fn()
+            for w in write:
+                self._errs.pop(w._handle, None)
+        except BaseException as e:  # noqa: BLE001 — poison + rethrow later
+            for w in write:
+                self._errs[w._handle] = e
+            if self._first_err is None:
+                self._first_err = e
+
+    def wait_for_var(self, var: Var):
+        err = self._errs.get(var._handle)
+        if err is not None:
+            raise err
+
+    def wait_for_all(self):
+        err, self._first_err = self._first_err, None
+        if err is not None:
+            raise err
+
+
+# One module-static CFUNCTYPE trampoline shared by every pushed op: the
+# thunk itself is never freed, so there is no freed-while-executing race
+# and no per-op CFUNCTYPE leak. The op's Python closure is parked in
+# _op_registry under an integer id passed through the C ctx pointer and
+# popped exactly once, when the op runs.
+_op_registry = {}
+_op_lock = threading.Lock()
+_op_counter = 0
+
+
+def _static_trampoline(ctx, err_buf, err_len):
+    with _op_lock:
+        fn = _op_registry.pop(ctx, None)
+    if fn is None:
+        return 0
+    try:
+        fn()
+        return 0
+    except BaseException as e:  # noqa: BLE001 — marshal to C
+        msg = f"{type(e).__name__}: {e}".encode()[: err_len - 1]
+        ctypes.memmove(err_buf, msg + b"\x00", len(msg) + 1)
+        return 1
+
+
+_STATIC_CB = _native.OP_FN(_static_trampoline)
+
+
+class NativeEngine(Engine):
+    """Ctypes binding over the C++ dependency scheduler."""
+
+    def __init__(self, nthreads: Optional[int] = None):
+        lib = _native.get_lib()
+        if lib is None:
+            raise MXNetError("native runtime not available")
+        self._lib = lib
+        if nthreads is None:
+            nthreads = int(os.environ.get(
+                "MXNET_CPU_WORKER_NTHREADS", min(8, os.cpu_count() or 4)))
+        self._handle = lib.MXTPUEngineCreate(int(nthreads))
+        if not self._handle:
+            raise MXNetError("engine creation failed")
+
+    def new_var(self) -> Var:
+        return Var(self._lib.MXTPUEngineNewVar(self._handle), self)
+
+    def delete_var(self, var: Var):
+        self._lib.MXTPUEngineDeleteVar(self._handle, var._handle)
+        var._handle = None
+
+    def push(self, fn, read=(), write=(), priority=0):
+        global _op_counter
+        with _op_lock:
+            _op_counter += 1
+            op_id = _op_counter
+            _op_registry[op_id] = fn
+        n_r, n_w = len(read), len(write)
+        r_arr = (ctypes.c_void_p * max(1, n_r))(
+            *[v._handle for v in read] or [None])
+        w_arr = (ctypes.c_void_p * max(1, n_w))(
+            *[v._handle for v in write] or [None])
+        rc = self._lib.MXTPUEnginePush(self._handle, _STATIC_CB, op_id,
+                                       r_arr, n_r, w_arr, n_w, int(priority))
+        if rc != 0:
+            with _op_lock:
+                _op_registry.pop(op_id, None)
+            raise MXNetError(self._lib.MXTPUGetLastError().decode())
+
+    def wait_for_var(self, var: Var):
+        if self._lib.MXTPUEngineWaitForVar(self._handle, var._handle) != 0:
+            raise MXNetError(self._lib.MXTPUGetLastError().decode())
+
+    def wait_for_all(self):
+        if self._lib.MXTPUEngineWaitForAll(self._handle) != 0:
+            raise MXNetError(self._lib.MXTPUGetLastError().decode())
+
+    @property
+    def num_outstanding(self) -> int:
+        return int(self._lib.MXTPUEngineOutstanding(self._handle))
+
+
+_engine: Optional[Engine] = None
+_engine_lock = threading.Lock()
+
+
+def get() -> Engine:
+    """Process-global engine, selected by MXNET_ENGINE_TYPE
+    (ThreadedEngine default / NaiveEngine), ref src/engine/engine.cc."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            kind = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
+            if kind != "NaiveEngine" and _native.native_available():
+                _engine = NativeEngine()
+            else:
+                _engine = NaiveEngine()
+        return _engine
+
+
+def new_var() -> Var:
+    return get().new_var()
+
+
+def delete_var(var: Var):
+    get().delete_var(var)
+
+
+def push(fn, read=(), write=(), priority=0):
+    get().push(fn, read=read, write=write, priority=priority)
+
+
+def wait_for_var(var: Var):
+    get().wait_for_var(var)
+
+
+def wait_for_all():
+    get().wait_for_all()

@@ -32,16 +32,35 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        self._fp = None
+        self._nat = None  # (lib, handle) when the C++ reader/writer is used
         if self.flag == "w":
-            self._fp = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self._fp = open(self.uri, "rb")
             self.writable = False
         else:
             raise MXNetError(f"Invalid flag {self.flag}")
+        from .. import _native
+
+        lib = _native.get_lib()
+        if lib is not None:
+            h = (lib.MXTPURecordIOWriterCreate(self.uri.encode())
+                 if self.writable
+                 else lib.MXTPURecordIOReaderCreate(self.uri.encode()))
+            if h:
+                self._nat = (lib, h)
+                return
+            raise MXNetError(lib.MXTPUGetLastError().decode())
+        self._fp = open(self.uri, "wb" if self.writable else "rb")
 
     def close(self):
+        if self._nat is not None:
+            lib, h = self._nat
+            if self.writable:
+                lib.MXTPURecordIOWriterClose(h)
+            else:
+                lib.MXTPURecordIOReaderClose(h)
+            self._nat = None
         if self._fp is not None:
             self._fp.close()
             self._fp = None
@@ -63,11 +82,20 @@ class MXRecordIO:
         self.open()
 
     def tell(self) -> int:
+        if self._nat is not None:
+            lib, h = self._nat
+            return int(lib.MXTPURecordIOWriterTell(h) if self.writable
+                       else lib.MXTPURecordIOReaderTell(h))
         return self._fp.tell()
 
     def write(self, buf: bytes):
         if not self.writable:
             raise MXNetError("RecordIO not opened for writing")
+        if self._nat is not None:
+            lib, h = self._nat
+            if lib.MXTPURecordIOWriterWrite(h, bytes(buf), len(buf)) < 0:
+                raise MXNetError(lib.MXTPUGetLastError().decode())
+            return
         header = struct.pack("<II", _MAGIC, len(buf) & _LENGTH_MASK)
         self._fp.write(header)
         self._fp.write(buf)
@@ -78,6 +106,19 @@ class MXRecordIO:
     def read(self) -> Optional[bytes]:
         if self.writable:
             raise MXNetError("RecordIO not opened for reading")
+        if self._nat is not None:
+            import ctypes
+
+            lib, h = self._nat
+            n = ctypes.c_uint32(0)
+            ptr = lib.MXTPURecordIOReaderNext(h, ctypes.byref(n))
+            if not ptr:
+                if n.value == 0:
+                    return None  # EOF
+                raise MXNetError(f"corrupt record in {self.uri}")
+            data = ctypes.string_at(ptr, n.value)
+            lib.MXTPUStorageFree(ptr)
+            return data
         header = self._fp.read(8)
         if len(header) < 8:
             return None
@@ -117,7 +158,12 @@ class MXIndexedRecordIO(MXRecordIO):
         super().close()
 
     def seek(self, idx):
-        self._fp.seek(self.idx[idx])
+        pos = self.idx[idx]
+        if self._nat is not None:
+            lib, h = self._nat
+            lib.MXTPURecordIOReaderSeek(h, pos)
+        else:
+            self._fp.seek(pos)
 
     def read_idx(self, idx):
         self.seek(idx)

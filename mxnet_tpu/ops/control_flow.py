@@ -54,6 +54,15 @@ def _flatten(x) -> Tuple[list, Callable]:
                      f"lists of NDArrays, got {type(x)}")
 
 
+def _signature(x):
+    """Nesting-structure signature of an NDArray / nested list tree."""
+    from ..ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        return "nd"
+    return tuple(_signature(i) for i in x)
+
+
 def _call_udf(udf, *args):
     """Run a UDF on NDArrays with tape recording paused (see module doc).
 
@@ -187,12 +196,11 @@ def while_loop(cond_fn: Callable, func: Callable, loop_vars,
                              for nv, v in zip(nv_flat, vals))
             ys = tuple(jnp.where(active, o._data, jnp.zeros_like(o._data))
                        for o in o_flat)
-            return (active, new_vals), ys + (active,)
+            return (active, new_vals), ys
 
         (_, final), ys = lax.scan(step, (jnp.bool_(True), tuple(raw)), None,
                                   length=max_iterations)
-        steps = ys[-1].sum().astype(jnp.int32)
-        return tuple(ys[:-1]) + tuple(final) + (steps,)
+        return tuple(ys) + tuple(final)
 
     res = invoke(f, var_flat, name="while_loop")
     res = res if isinstance(res, tuple) else (res,)
@@ -228,7 +236,9 @@ def cond(pred: Callable, then_func: Callable, else_func: Callable, inputs):
             lst = nd_b if isinstance(nd_b, list) else [nd_b]
             out = _call_udf(then_func if takes_then else else_func, *lst)
             o_flat, o_rb = _flatten(out)
-            meta["out_rebuild"] = o_rb
+            key = "then" if takes_then else "else"
+            meta["rb_" + key] = o_rb
+            meta["sig_" + key] = _signature(out)
             return tuple(o._data for o in o_flat)
 
         return lax.cond(p_raw,
@@ -237,4 +247,9 @@ def cond(pred: Callable, then_func: Callable, else_func: Callable, inputs):
 
     res = invoke(f, in_flat, name="cond")
     res = res if isinstance(res, tuple) else (res,)
-    return meta["out_rebuild"](list(res))
+    if meta["sig_then"] != meta["sig_else"]:
+        raise MXNetError(
+            f"cond branches must return the same structure; then: "
+            f"{meta['sig_then']}, else: {meta['sig_else']} "
+            f"(ref _cond op output contract, control_flow.cc)")
+    return meta["rb_then"](list(res))

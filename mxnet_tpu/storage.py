@@ -1,0 +1,38 @@
+"""mx.storage — host storage pool introspection.
+
+Parity surface for the reference's Storage singleton
+(include/mxnet/storage.h:40-146; pooled manager
+src/storage/pooled_storage_manager.h). Device (HBM) allocation is owned
+by PJRT/XLA on TPU; the native pool (src/mxtpu/storage.cc) backs
+host-side buffers — recordio payloads, decode scratch. Pool cap env:
+``MXTPU_MEM_POOL_LIMIT_MB`` (analog of MXNET_GPU_MEM_POOL_RESERVE).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict
+
+from . import _native
+
+__all__ = ["pool_stats", "release_all"]
+
+
+def pool_stats() -> Dict[str, int]:
+    """{'used_bytes', 'pooled_bytes', 'os_allocs', 'pool_hits'} — zeros
+    when the native runtime is unavailable."""
+    lib = _native.get_lib()
+    if lib is None:
+        return {"used_bytes": 0, "pooled_bytes": 0, "os_allocs": 0,
+                "pool_hits": 0}
+    vals = [ctypes.c_int64(0) for _ in range(4)]
+    lib.MXTPUStorageStats(*[ctypes.byref(v) for v in vals])
+    return {"used_bytes": vals[0].value, "pooled_bytes": vals[1].value,
+            "os_allocs": vals[2].value, "pool_hits": vals[3].value}
+
+
+def release_all():
+    """Drop every pooled free buffer back to the OS (ref
+    Storage::ReleaseAll)."""
+    lib = _native.get_lib()
+    if lib is not None:
+        lib.MXTPUStorageReleaseAll()

@@ -1,0 +1,180 @@
+// C API for the native runtime — the ctypes binding surface.
+//
+// Counterpart of the reference's C API layer (include/mxnet/c_api.h,
+// src/c_api/): flat extern "C" entry points over engine/storage/recordio,
+// -1 + thread-local error string on failure (ref MXGetLastError).
+// Python side: mxnet_tpu/_native/.
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#include "engine.h"
+
+namespace mxtpu {
+void* StorageAlloc(size_t size);
+void StorageFree(void* p);
+void StorageReleaseAll();
+void StorageStats(int64_t* used, int64_t* pooled, int64_t* allocs,
+                  int64_t* hits);
+
+struct RecordIOWriter;
+struct RecordIOReader;
+RecordIOWriter* WriterOpen(const char* path);
+int64_t WriterWrite(RecordIOWriter* w, const void* data, uint32_t len);
+int64_t WriterTell(RecordIOWriter* w);
+void WriterClose(RecordIOWriter* w);
+RecordIOReader* ReaderOpen(const char* path);
+void* ReaderNext(RecordIOReader* r, uint32_t* len);
+void ReaderSeek(RecordIOReader* r, int64_t offset);
+int64_t ReaderTell(RecordIOReader* r);
+void ReaderClose(RecordIOReader* r);
+}  // namespace mxtpu
+
+namespace {
+thread_local std::string last_error;
+
+int Fail(const std::string& msg) {
+  last_error = msg;
+  return -1;
+}
+}  // namespace
+
+extern "C" {
+
+// Engine op callback: returns 0 on success; on failure writes a message
+// into err_buf and returns nonzero. Invoked on an engine worker thread
+// (ctypes re-acquires the GIL for Python callbacks).
+typedef int (*MXTPUOpFn)(void* ctx, char* err_buf, int err_buf_len);
+
+const char* MXTPUGetLastError() { return last_error.c_str(); }
+
+void* MXTPUEngineCreate(int nthreads) {
+  try {
+    return new mxtpu::Engine(nthreads);
+  } catch (const std::exception& e) {
+    Fail(e.what());
+    return nullptr;
+  }
+}
+
+void MXTPUEngineFree(void* engine) {
+  delete static_cast<mxtpu::Engine*>(engine);
+}
+
+void* MXTPUEngineNewVar(void* engine) {
+  return static_cast<mxtpu::Engine*>(engine)->NewVar();
+}
+
+void MXTPUEngineDeleteVar(void* engine, void* var) {
+  static_cast<mxtpu::Engine*>(engine)->DeleteVar(
+      static_cast<mxtpu::Var*>(var));
+}
+
+int MXTPUEnginePush(void* engine, MXTPUOpFn fn, void* ctx, void** read_vars,
+                    int n_read, void** write_vars, int n_write,
+                    int priority) {
+  try {
+    std::vector<mxtpu::Var*> reads(n_read), writes(n_write);
+    for (int i = 0; i < n_read; ++i)
+      reads[i] = static_cast<mxtpu::Var*>(read_vars[i]);
+    for (int i = 0; i < n_write; ++i)
+      writes[i] = static_cast<mxtpu::Var*>(write_vars[i]);
+    static_cast<mxtpu::Engine*>(engine)->Push(
+        [fn, ctx]() -> std::string {
+          char buf[4096];
+          buf[0] = '\0';
+          int rc = fn(ctx, buf, sizeof(buf));
+          if (rc == 0) return "";
+          return buf[0] != '\0' ? std::string(buf)
+                                : std::string("engine op failed");
+        },
+        std::move(reads), std::move(writes), priority);
+    return 0;
+  } catch (const std::exception& e) {
+    return Fail(e.what());
+  }
+}
+
+int MXTPUEngineWaitForVar(void* engine, void* var) {
+  std::string err = static_cast<mxtpu::Engine*>(engine)->WaitForVar(
+      static_cast<mxtpu::Var*>(var));
+  if (!err.empty()) return Fail(err);
+  return 0;
+}
+
+int MXTPUEngineWaitForAll(void* engine) {
+  std::string err = static_cast<mxtpu::Engine*>(engine)->WaitForAll();
+  if (!err.empty()) return Fail(err);
+  return 0;
+}
+
+int64_t MXTPUEngineOutstanding(void* engine) {
+  return static_cast<mxtpu::Engine*>(engine)->num_outstanding();
+}
+
+// ---------------------------------------------------------------- storage
+void* MXTPUStorageAlloc(int64_t size) {
+  try {
+    return mxtpu::StorageAlloc(static_cast<size_t>(size));
+  } catch (const std::exception& e) {
+    Fail(e.what());
+    return nullptr;
+  }
+}
+
+void MXTPUStorageFree(void* p) { mxtpu::StorageFree(p); }
+
+void MXTPUStorageReleaseAll() { mxtpu::StorageReleaseAll(); }
+
+void MXTPUStorageStats(int64_t* used, int64_t* pooled, int64_t* allocs,
+                       int64_t* hits) {
+  mxtpu::StorageStats(used, pooled, allocs, hits);
+}
+
+// --------------------------------------------------------------- recordio
+void* MXTPURecordIOWriterCreate(const char* path) {
+  void* w = mxtpu::WriterOpen(path);
+  if (w == nullptr) Fail(std::string("cannot open for write: ") + path);
+  return w;
+}
+
+int64_t MXTPURecordIOWriterWrite(void* w, const void* data, uint32_t len) {
+  int64_t pos = mxtpu::WriterWrite(
+      static_cast<mxtpu::RecordIOWriter*>(w), data, len);
+  if (pos < 0) Fail("recordio write failed");
+  return pos;
+}
+
+int64_t MXTPURecordIOWriterTell(void* w) {
+  return mxtpu::WriterTell(static_cast<mxtpu::RecordIOWriter*>(w));
+}
+
+void MXTPURecordIOWriterClose(void* w) {
+  mxtpu::WriterClose(static_cast<mxtpu::RecordIOWriter*>(w));
+}
+
+void* MXTPURecordIOReaderCreate(const char* path) {
+  void* r = mxtpu::ReaderOpen(path);
+  if (r == nullptr) Fail(std::string("cannot open for read: ") + path);
+  return r;
+}
+
+// Returns buffer (free with MXTPUStorageFree); *len = 0 & NULL at EOF,
+// *len = 0xffffffff & NULL on corruption.
+void* MXTPURecordIOReaderNext(void* r, uint32_t* len) {
+  return mxtpu::ReaderNext(static_cast<mxtpu::RecordIOReader*>(r), len);
+}
+
+void MXTPURecordIOReaderSeek(void* r, int64_t offset) {
+  mxtpu::ReaderSeek(static_cast<mxtpu::RecordIOReader*>(r), offset);
+}
+
+int64_t MXTPURecordIOReaderTell(void* r) {
+  return mxtpu::ReaderTell(static_cast<mxtpu::RecordIOReader*>(r));
+}
+
+void MXTPURecordIOReaderClose(void* r) {
+  mxtpu::ReaderClose(static_cast<mxtpu::RecordIOReader*>(r));
+}
+
+}  // extern "C"

@@ -1,0 +1,260 @@
+// See engine.h. Dependency-granting discipline (per var, FIFO):
+// consecutive reads at the queue head are granted together while no
+// writer is active; a write is granted alone once readers drain. This is
+// the same serialization contract as the reference's VersionedVarBlock
+// chains (src/engine/threaded_engine.h:104-229) built with a simpler
+// mutex+deque representation.
+#include "engine.h"
+
+namespace mxtpu {
+
+// ---------------------------------------------------------------- ThreadPool
+ThreadPool::ThreadPool(int nthreads, Engine* engine)
+    : engine_(engine), nthreads_(nthreads) {
+  Restart();
+}
+
+ThreadPool::~ThreadPool() { Shutdown(); }
+
+void ThreadPool::Restart() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    shutdown_ = false;
+  }
+  for (int i = 0; i < nthreads_; ++i) {
+    workers_.emplace_back([this] { WorkerLoop(); });
+  }
+}
+
+void ThreadPool::Shutdown() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    shutdown_ = true;
+  }
+  cv_.notify_all();
+  for (auto& t : workers_) {
+    if (t.joinable()) t.join();
+  }
+  workers_.clear();
+}
+
+void ThreadPool::Enqueue(Opr* op) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.push(op);
+  }
+  cv_.notify_one();
+}
+
+void ThreadPool::WorkerLoop() {
+  for (;;) {
+    Opr* op = nullptr;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait(lk, [this] { return shutdown_ || !queue_.empty(); });
+      if (shutdown_ && queue_.empty()) return;
+      op = queue_.top();
+      queue_.pop();
+    }
+    engine_->ExecuteOpr(op);
+  }
+}
+
+// -------------------------------------------------------------------- Engine
+Engine::Engine(int nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  pool_.reset(new ThreadPool(nthreads, this));
+}
+
+Engine::~Engine() {
+  WaitForAll();
+  pool_->Shutdown();
+}
+
+Var* Engine::NewVar() { return new Var(); }
+
+void Engine::DeleteVar(Var* var) {
+  // A write op marks the var for deletion; it is freed when this op's
+  // write grant releases (OnComplete), i.e. after every earlier user.
+  // Pushing further ops on the var afterwards is a caller bug (same
+  // contract as ref Engine::DeleteVariable, engine.h:246).
+  Push(
+      [var]() -> std::string {
+        var->to_delete = true;  // holder of the exclusive write grant
+        return "";
+      },
+      {}, {var}, 0);
+}
+
+void Engine::Push(std::function<std::string()> fn, std::vector<Var*> reads,
+                  std::vector<Var*> writes, int priority, bool always_run) {
+  auto* op = new Opr();
+  op->fn = std::move(fn);
+  // Dedupe: repeated vars would deadlock (an op's own read grant blocks
+  // its write grant); a var in both lists is a write (ref
+  // imperative_utils.h:318 SetDependency does the same dedup).
+  {
+    std::unordered_set<Var*> wset(writes.begin(), writes.end());
+    for (Var* w : wset) op->writes.push_back(w);
+    std::unordered_set<Var*> rset;
+    for (Var* r : reads) {
+      if (wset.count(r) == 0 && rset.insert(r).second)
+        op->reads.push_back(r);
+    }
+  }
+  op->priority = priority;
+  op->always_run = always_run;
+  op->seq = seq_.fetch_add(1);
+  outstanding_.fetch_add(1);
+  int ndeps = static_cast<int>(op->reads.size() + op->writes.size());
+  if (ndeps == 0) {
+    pool_->Enqueue(op);
+    return;
+  }
+  op->pending.store(ndeps);
+  EnqueueRequests(op);
+}
+
+void Engine::EnqueueRequests(Opr* op) {
+  // Enqueue every request first, then try to grant: a var granting
+  // immediately must not dispatch before all requests are registered, so
+  // pre-bias pending by 1 and drop the bias at the end.
+  op->pending.fetch_add(1);
+  for (Var* v : op->reads) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    v->queue.emplace_back(op, false);
+  }
+  for (Var* v : op->writes) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    v->queue.emplace_back(op, true);
+  }
+  for (Var* v : op->reads) TryGrant(v);
+  for (Var* v : op->writes) TryGrant(v);
+  if (op->pending.fetch_sub(1) == 1) pool_->Enqueue(op);
+}
+
+void Engine::TryGrant(Var* var) {
+  std::vector<Opr*> ready;
+  {
+    std::lock_guard<std::mutex> lk(var->mu);
+    while (!var->queue.empty()) {
+      Opr* op = var->queue.front().first;
+      bool is_write = var->queue.front().second;
+      if (is_write) {
+        if (var->active_readers > 0 || var->active_writer) break;
+        var->active_writer = true;
+        var->queue.pop_front();
+        if (op->pending.fetch_sub(1) == 1) ready.push_back(op);
+        break;  // writer is exclusive
+      }
+      if (var->active_writer) break;
+      var->active_readers++;
+      var->queue.pop_front();
+      if (op->pending.fetch_sub(1) == 1) ready.push_back(op);
+    }
+  }
+  for (Opr* op : ready) pool_->Enqueue(op);
+}
+
+void Engine::ExecuteOpr(Opr* op) {
+  // Propagate sticky errors from READ dependencies (ref
+  // threaded_engine.cc exception chaining): skip the body, forward the
+  // error. Write-only vars don't propagate — the op produces fresh data
+  // that supersedes the poisoned value.
+  std::shared_ptr<std::string> dep_err;
+  for (Var* v : op->reads) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (v->exc) { dep_err = v->exc; break; }
+  }
+  std::string err;
+  if (dep_err && !op->always_run) {
+    err = *dep_err;
+  } else {
+    try {
+      err = op->fn();
+    } catch (const std::exception& e) {
+      err = e.what();
+    } catch (...) {
+      err = "unknown C++ exception in engine op";
+    }
+  }
+  if (!err.empty()) {
+    auto eptr = std::make_shared<std::string>(err);
+    for (Var* v : op->writes) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->exc = eptr;
+    }
+    std::lock_guard<std::mutex> lk(err_mu_);
+    if (first_error_.empty()) first_error_ = err;
+  } else {
+    // a successful write supersedes any stale poison on the var
+    for (Var* v : op->writes) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->exc.reset();
+    }
+  }
+  OnComplete(op);
+}
+
+void Engine::OnComplete(Opr* op) {
+  for (Var* v : op->reads) {
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->active_readers--;
+    }
+    TryGrant(v);
+  }
+  for (Var* v : op->writes) {
+    bool del;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->active_writer = false;
+      del = v->to_delete && v->queue.empty();
+    }
+    if (del) {
+      delete v;
+    } else {
+      TryGrant(v);
+    }
+  }
+  delete op;
+  if (outstanding_.fetch_sub(1) == 1) {
+    std::lock_guard<std::mutex> lk(done_mu_);
+    done_cv_.notify_all();
+  }
+}
+
+std::string Engine::WaitForVar(Var* var) {
+  std::mutex m;
+  std::condition_variable cv;
+  bool done = false;
+  std::string err;
+  Push(
+      [&]() -> std::string {
+        {
+          std::lock_guard<std::mutex> lk(var->mu);
+          if (var->exc) err = *var->exc;
+        }
+        {
+          std::lock_guard<std::mutex> lk(m);
+          done = true;
+        }
+        cv.notify_one();
+        return "";
+      },
+      {var}, {}, /*priority=*/1 << 20, /*always_run=*/true);
+  std::unique_lock<std::mutex> lk(m);
+  cv.wait(lk, [&] { return done; });
+  return err;
+}
+
+std::string Engine::WaitForAll() {
+  std::unique_lock<std::mutex> lk(done_mu_);
+  done_cv_.wait(lk, [this] { return outstanding_.load() == 0; });
+  std::lock_guard<std::mutex> elk(err_mu_);
+  std::string e = first_error_;
+  first_error_.clear();
+  return e;
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,126 @@
+// Async dependency engine — native scheduler for host-side work.
+//
+// TPU-native counterpart of the reference's ThreadedEngine
+// (include/mxnet/engine.h:155-318, src/engine/threaded_engine.h:104-352):
+// ops are closures with read/write variable lists; conflicting ops are
+// serialized in program order per variable, independent ops run in
+// parallel on a priority thread pool. On TPU the *device* side of this
+// role belongs to XLA/PJRT's async dispatch (SURVEY.md §7 design stance);
+// this engine schedules the host side: data loading, decode, IO,
+// prefetch, checkpoint writes.
+//
+// Error semantics mirror the reference (threaded_engine.h:64-65,387,463):
+// a failed op attaches its error to every written variable; dependent ops
+// are skipped and propagate it; WaitForVar/WaitForAll rethrow.
+#ifndef MXTPU_ENGINE_H_
+#define MXTPU_ENGINE_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_set>
+#include <vector>
+
+namespace mxtpu {
+
+class Engine;
+struct Opr;
+
+// One scheduling variable (ref ThreadedVar, threaded_engine.h:104).
+struct Var {
+  std::mutex mu;
+  // FIFO of pending requests; bool = is_write. Program order per var.
+  std::deque<std::pair<Opr*, bool>> queue;
+  int active_readers = 0;
+  bool active_writer = false;
+  // sticky error from a failed producer (ref ExceptionRef)
+  std::shared_ptr<std::string> exc;
+  // set by DeleteVar's write op; the var is freed when that op releases
+  // (ref ThreadedVar::ReadyToOwn-style delete-on-last-use)
+  bool to_delete = false;
+};
+
+// One pushed operation (ref ThreadedOpr, threaded_engine.h:234).
+struct Opr {
+  std::function<std::string()> fn;  // "" on success, else error message
+  std::vector<Var*> reads;
+  std::vector<Var*> writes;
+  std::atomic<int> pending{0};  // un-granted var requests
+  int priority = 0;
+  uint64_t seq = 0;  // FIFO tiebreak within a priority
+  // run fn even when a dependency carries a sticky error — used by
+  // WaitForVar-style ops whose body must signal regardless
+  bool always_run = false;
+};
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int nthreads, Engine* engine);
+  ~ThreadPool();
+  void Enqueue(Opr* op);
+  void Shutdown();
+  void Restart();
+
+ private:
+  void WorkerLoop();
+  struct Cmp {
+    bool operator()(Opr* a, Opr* b) const {
+      if (a->priority != b->priority) return a->priority < b->priority;
+      return a->seq > b->seq;  // lower seq first
+    }
+  };
+  Engine* engine_;
+  int nthreads_;
+  std::priority_queue<Opr*, std::vector<Opr*>, Cmp> queue_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool shutdown_ = false;
+  std::vector<std::thread> workers_;
+};
+
+class Engine {
+ public:
+  explicit Engine(int nthreads);
+  ~Engine();
+
+  Var* NewVar();
+  // Deletion is itself a write op so it runs after all pending users
+  // (ref Engine::DeleteVariable, engine.h:246).
+  void DeleteVar(Var* var);
+  void Push(std::function<std::string()> fn, std::vector<Var*> reads,
+            std::vector<Var*> writes, int priority,
+            bool always_run = false);
+  // Returns error string ("" if clean) once all prior ops on var finished.
+  std::string WaitForVar(Var* var);
+  std::string WaitForAll();
+  int64_t num_outstanding() const { return outstanding_.load(); }
+
+  // internal: called by workers
+  void ExecuteOpr(Opr* op);
+
+ private:
+  friend class ThreadPool;
+  void EnqueueRequests(Opr* op);
+  // Grant queued requests on var while legal; dispatch ops reaching 0 deps.
+  void TryGrant(Var* var);
+  void OnComplete(Opr* op);
+
+  std::unique_ptr<ThreadPool> pool_;
+  std::atomic<int64_t> outstanding_{0};
+  std::atomic<uint64_t> seq_{0};
+  std::mutex done_mu_;
+  std::condition_variable done_cv_;
+  std::mutex err_mu_;
+  std::string first_error_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_ENGINE_H_

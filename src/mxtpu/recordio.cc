@@ -1,0 +1,111 @@
+// RecordIO reader/writer — native IO path for .rec datasets.
+//
+// Binary-compatible with the reference container format
+// (python/mxnet/recordio.py + dmlc-core recordio, packed by tools/im2rec):
+// record = [magic:u32][lrecord:u32][data][pad to 4B], magic 0xced7230a,
+// lrecord = cflag(3 bits) << 29 | length(29 bits). This implementation
+// reads/writes the simple single-part form (cflag 0) the Python layer
+// produces, with buffered stdio and pooled data buffers.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace mxtpu {
+
+void* StorageAlloc(size_t size);
+void StorageFree(void* p);
+
+namespace {
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+}  // namespace
+
+struct RecordIOWriter {
+  FILE* fp;
+  uint64_t nrecords = 0;
+};
+
+struct RecordIOReader {
+  FILE* fp;
+};
+
+RecordIOWriter* WriterOpen(const char* path) {
+  FILE* fp = ::fopen(path, "wb");
+  if (fp == nullptr) return nullptr;
+  auto* w = new RecordIOWriter();
+  w->fp = fp;
+  return w;
+}
+
+// Returns the byte offset the record was written at (for .idx files),
+// or -1 on error.
+int64_t WriterWrite(RecordIOWriter* w, const void* data, uint32_t len) {
+  if (len > kLenMask) return -1;
+  int64_t pos = ::ftell(w->fp);
+  uint32_t header[2] = {kMagic, len};
+  if (::fwrite(header, 4, 2, w->fp) != 2) return -1;
+  if (len != 0 && ::fwrite(data, 1, len, w->fp) != len) return -1;
+  uint32_t pad = (4 - (len & 3u)) & 3u;
+  const char zeros[4] = {0, 0, 0, 0};
+  if (pad != 0 && ::fwrite(zeros, 1, pad, w->fp) != pad) return -1;
+  w->nrecords++;
+  return pos;
+}
+
+int64_t WriterTell(RecordIOWriter* w) { return ::ftell(w->fp); }
+
+void WriterClose(RecordIOWriter* w) {
+  if (w == nullptr) return;
+  ::fclose(w->fp);
+  delete w;
+}
+
+RecordIOReader* ReaderOpen(const char* path) {
+  FILE* fp = ::fopen(path, "rb");
+  if (fp == nullptr) return nullptr;
+  auto* r = new RecordIOReader();
+  r->fp = fp;
+  return r;
+}
+
+// Reads the next record. Returns a StorageAlloc'd buffer (caller frees
+// with StorageFree) and sets *len; nullptr + *len=0 at EOF; nullptr +
+// *len=uint32(-1) on corruption.
+void* ReaderNext(RecordIOReader* r, uint32_t* len) {
+  uint32_t header[2];
+  size_t got = ::fread(header, 4, 2, r->fp);
+  if (got == 0) {
+    *len = 0;
+    return nullptr;  // clean EOF
+  }
+  if (got != 2 || header[0] != kMagic) {
+    *len = static_cast<uint32_t>(-1);
+    return nullptr;
+  }
+  uint32_t n = header[1] & kLenMask;
+  *len = n;
+  void* buf = StorageAlloc(n == 0 ? 1 : n);
+  if (n != 0 && ::fread(buf, 1, n, r->fp) != n) {
+    StorageFree(buf);
+    *len = static_cast<uint32_t>(-1);
+    return nullptr;
+  }
+  uint32_t pad = (4 - (n & 3u)) & 3u;
+  if (pad != 0) ::fseek(r->fp, pad, SEEK_CUR);
+  return buf;
+}
+
+void ReaderSeek(RecordIOReader* r, int64_t offset) {
+  ::fseek(r->fp, static_cast<long>(offset), SEEK_SET);
+}
+
+int64_t ReaderTell(RecordIOReader* r) { return ::ftell(r->fp); }
+
+void ReaderClose(RecordIOReader* r) {
+  if (r == nullptr) return;
+  ::fclose(r->fp);
+  delete r;
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,125 @@
+// Pooled host storage manager.
+//
+// Counterpart of the reference's PooledStorageManager
+// (src/storage/pooled_storage_manager.h, selected via env in
+// src/storage/storage.cc:68-79): freed buffers are bucketed by
+// rounded-up size and recycled. On TPU the *device* (HBM) allocator
+// belongs to PJRT/XLA buffer assignment (SURVEY.md §7); this pool serves
+// host-side staging: record buffers, decode scratch, batchify output.
+//
+// Rounding strategy: round-to-power-of-two buckets (ref RoundPower2),
+// minimum 64-byte alignment. Pool cap from MXTPU_MEM_POOL_LIMIT_MB
+// (default 1024); beyond the cap frees go straight to the OS — analog of
+// MXNET_GPU_MEM_POOL_RESERVE's pressure valve.
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace mxtpu {
+
+class PooledStorage {
+ public:
+  static PooledStorage* Get() {
+    static PooledStorage inst;
+    return &inst;
+  }
+
+  void* Alloc(size_t size) {
+    size_t rounded = RoundPow2(size);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = pool_.find(rounded);
+      if (it != pool_.end() && !it->second.empty()) {
+        void* p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes_ -= rounded;
+        used_bytes_ += rounded;
+        hits_++;
+        sizes_[p] = rounded;
+        return p;
+      }
+    }
+    void* p = ::aligned_alloc(64, rounded);
+    if (p == nullptr) throw std::bad_alloc();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      used_bytes_ += rounded;
+      allocs_++;
+      sizes_[p] = rounded;
+    }
+    return p;
+  }
+
+  void Free(void* p) {
+    if (p == nullptr) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = sizes_.find(p);
+    if (it == sizes_.end()) {
+      ::free(p);  // not ours / already released from the pool
+      return;
+    }
+    size_t rounded = it->second;
+    sizes_.erase(it);
+    used_bytes_ -= rounded;
+    if (pooled_bytes_ + rounded <= limit_bytes_) {
+      pool_[rounded].push_back(p);
+      pooled_bytes_ += rounded;
+    } else {
+      ::free(p);
+    }
+  }
+
+  void ReleaseAll() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : pool_) {
+      for (void* p : kv.second) ::free(p);
+    }
+    pool_.clear();
+    pooled_bytes_ = 0;
+  }
+
+  void Stats(int64_t* used, int64_t* pooled, int64_t* allocs, int64_t* hits) {
+    std::lock_guard<std::mutex> lk(mu_);
+    *used = static_cast<int64_t>(used_bytes_);
+    *pooled = static_cast<int64_t>(pooled_bytes_);
+    *allocs = static_cast<int64_t>(allocs_);
+    *hits = static_cast<int64_t>(hits_);
+  }
+
+ private:
+  PooledStorage() {
+    const char* env = ::getenv("MXTPU_MEM_POOL_LIMIT_MB");
+    size_t mb = 1024;
+    if (env != nullptr) {
+      long v = ::atol(env);
+      if (v >= 0) mb = static_cast<size_t>(v);
+    }
+    limit_bytes_ = mb << 20;
+  }
+
+  static size_t RoundPow2(size_t size) {
+    size_t r = 64;
+    while (r < size) r <<= 1;
+    return r;
+  }
+
+  std::mutex mu_;
+  std::unordered_map<size_t, std::vector<void*>> pool_;
+  std::unordered_map<void*, size_t> sizes_;
+  size_t used_bytes_ = 0, pooled_bytes_ = 0, limit_bytes_ = 0;
+  size_t allocs_ = 0, hits_ = 0;
+};
+
+void* StorageAlloc(size_t size) { return PooledStorage::Get()->Alloc(size); }
+void StorageFree(void* p) { PooledStorage::Get()->Free(p); }
+void StorageReleaseAll() { PooledStorage::Get()->ReleaseAll(); }
+void StorageStats(int64_t* used, int64_t* pooled, int64_t* allocs,
+                  int64_t* hits) {
+  PooledStorage::Get()->Stats(used, pooled, allocs, hits);
+}
+
+}  // namespace mxtpu

@@ -160,3 +160,11 @@ def test_foreach_with_deferred_init_block():
     outs, st = npx.foreach(lambda xt, s: net_cell(xt, s), x, [h0])
     y = out(st[0])
     assert y.shape == (2, 1)
+
+
+def test_cond_rejects_mismatched_branch_structure():
+    x = mx.np.array([3.0])
+    with pytest.raises(MXNetError):
+        npx.cond(lambda a: (a < 10).reshape(()),
+                 lambda a: [a, [a * 2]],
+                 lambda a: [a, a * 2], [x])

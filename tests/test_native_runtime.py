@@ -1,0 +1,215 @@
+"""Native runtime tests: C++ engine, storage pool, recordio.
+
+Mirrors the reference's C++ runtime test strategy (SURVEY.md §4:
+tests/cpp/engine/threaded_engine_test.cc push/wait semantics,
+storage/storage_test.cc pool reuse, tests/python/unittest/
+test_exc_handling.py async rethrow) driven from Python via ctypes.
+"""
+import os
+import time
+import random
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _native, engine
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.io.recordio import MXRecordIO, MXIndexedRecordIO
+
+native = pytest.mark.skipif(not _native.native_available(),
+                            reason="native runtime unavailable")
+
+
+@pytest.fixture(scope="module")
+def eng():
+    return engine.NativeEngine(4)
+
+
+@native
+def test_engine_write_serialization(eng):
+    """Ops writing one var run in program order (ref threaded_engine_test)."""
+    v = eng.new_var()
+    results = []
+
+    def make(i):
+        def f():
+            time.sleep(random.random() * 0.002)
+            results.append(i)
+        return f
+
+    for i in range(64):
+        eng.push(make(i), write=(v,))
+    eng.wait_for_all()
+    assert results == list(range(64))
+    eng.delete_var(v)
+
+
+@native
+def test_engine_readers_see_committed_writes(eng):
+    v = eng.new_var()
+    state = {"val": 0}
+    seen = []
+    for i in range(1, 5):
+        eng.push(lambda i=i: state.__setitem__("val", i), write=(v,))
+        for _ in range(4):
+            eng.push(lambda: seen.append(state["val"]), read=(v,))
+    eng.wait_for_all()
+    assert sorted(set(seen)) == [1, 2, 3, 4]
+    eng.delete_var(v)
+
+
+@native
+def test_engine_independent_ops_run_parallel(eng):
+    """Two sleeps on distinct vars overlap on the pool."""
+    v1, v2 = eng.new_var(), eng.new_var()
+    t0 = time.perf_counter()
+    eng.push(lambda: time.sleep(0.2), write=(v1,))
+    eng.push(lambda: time.sleep(0.2), write=(v2,))
+    eng.wait_for_all()
+    assert time.perf_counter() - t0 < 0.35
+    eng.delete_var(v1)
+    eng.delete_var(v2)
+
+
+@native
+def test_engine_exception_rethrow_and_poison(eng):
+    """Failed op poisons its write var; dependents skip; waits rethrow
+    (ref test_exc_handling.py + threaded_engine.h:387,463)."""
+    v = eng.new_var()
+
+    def boom():
+        raise ValueError("kaput")
+
+    eng.push(boom, write=(v,))
+    ran = []
+    eng.push(lambda: ran.append(1), read=(v,))
+    with pytest.raises(MXNetError, match="kaput"):
+        eng.wait_for_var(v)
+    assert ran == []
+    with pytest.raises(MXNetError):
+        eng.wait_for_all()
+    # fresh write clears the poison
+    eng.push(lambda: ran.append(2), write=(v,))
+    eng.wait_for_var(v)
+    eng.push(lambda: ran.append(3), read=(v,))
+    eng.wait_for_all()
+    assert ran == [2, 3]
+    eng.delete_var(v)
+
+
+def test_naive_engine_same_contract():
+    e = engine.NaiveEngine()
+    v = e.new_var()
+    out = []
+    e.push(lambda: out.append(1), write=(v,))
+    e.push(lambda: (_ for _ in ()).throw(ValueError("bad")), write=(v,))
+    e.push(lambda: out.append(2), read=(v,))  # skipped: poisoned
+    with pytest.raises(ValueError):
+        e.wait_for_var(v)
+    assert out == [1]
+
+
+@native
+def test_storage_pool_reuse():
+    lib = _native.get_lib()
+    before = mx.storage.pool_stats()
+    p1 = lib.MXTPUStorageAlloc(5000)      # 8192 bucket
+    lib.MXTPUStorageFree(p1)
+    p2 = lib.MXTPUStorageAlloc(8000)      # same bucket -> hit
+    after = mx.storage.pool_stats()
+    assert after["pool_hits"] > before["pool_hits"]
+    lib.MXTPUStorageFree(p2)
+    mx.storage.release_all()
+    assert mx.storage.pool_stats()["pooled_bytes"] == 0
+
+
+@native
+def test_recordio_native_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = MXRecordIO(path, "w")
+    assert w._nat is not None
+    payloads = [os.urandom(n) for n in (0, 1, 3, 4, 5, 1000)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = MXRecordIO(path, "r")
+    assert r._nat is not None
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+
+@native
+def test_recordio_cross_impl_compat(tmp_path):
+    """Native-written .rec readable by the pure-Python framing and back."""
+    path = str(tmp_path / "x.rec")
+    w = MXRecordIO(path, "w")   # native
+    for i in range(4):
+        w.write(f"rec-{i}".encode())
+    w.close()
+    # read with the pure-Python fallback
+    r = MXRecordIO.__new__(MXRecordIO)
+    r.uri, r.flag, r.writable = path, "r", False
+    r._nat, r._fp = None, open(path, "rb")
+    for i in range(4):
+        assert r.read() == f"rec-{i}".encode()
+    assert r.read() is None
+    r.close()
+
+
+@native
+def test_indexed_recordio_native(tmp_path):
+    idx = str(tmp_path / "a.idx")
+    rec = str(tmp_path / "a.rec")
+    w = MXIndexedRecordIO(idx, rec, "w")
+    for i in range(10):
+        w.write_idx(i, f"item{i}".encode() * (i + 1))
+    w.close()
+    r = MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(7) == b"item7" * 8
+    assert r.read_idx(0) == b"item0"
+    assert r.read_idx(9) == b"item9" * 10
+    r.close()
+
+
+@native
+def test_engine_pipeline_through_vars(eng):
+    """Producer->consumer chains via shared vars preserve dataflow order."""
+    stages = [eng.new_var() for _ in range(3)]
+    log = []
+    eng.push(lambda: log.append("load"), write=(stages[0],))
+    eng.push(lambda: log.append("decode"), read=(stages[0],),
+             write=(stages[1],))
+    eng.push(lambda: log.append("batch"), read=(stages[1],),
+             write=(stages[2],))
+    eng.wait_for_var(stages[2])
+    assert log == ["load", "decode", "batch"]
+    for v in stages:
+        eng.delete_var(v)
+
+
+@native
+def test_engine_read_write_same_var_no_deadlock(eng):
+    """read+write of the same var must not self-deadlock (dedup as in ref
+    imperative_utils.h:318 SetDependency)."""
+    v = eng.new_var()
+    out = []
+    eng.push(lambda: out.append(1), read=(v,), write=(v,))
+    eng.wait_for_all()
+    assert out == [1]
+    eng.delete_var(v)
+
+
+def test_naive_engine_write_supersedes_poison():
+    e = engine.NaiveEngine()
+    v = e.new_var()
+
+    def bad():
+        raise ValueError("boom")
+
+    e.push(bad, write=(v,))
+    e.push(lambda: None, write=(v,))   # fresh write clears poison
+    e.wait_for_var(v)                  # must NOT raise
+    with pytest.raises(ValueError):
+        e.wait_for_all()               # first error still reported once
