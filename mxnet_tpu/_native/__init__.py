@@ -106,6 +106,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.MXTPURecordIOReaderNext.restype = c.c_void_p
     lib.MXTPURecordIOReaderNext.argtypes = [c.c_void_p,
                                             c.POINTER(c.c_uint32)]
+    lib.MXTPURecordIOReaderSkip.restype = c.c_int64
+    lib.MXTPURecordIOReaderSkip.argtypes = [c.c_void_p]
     lib.MXTPURecordIOReaderSeek.argtypes = [c.c_void_p, c.c_int64]
     lib.MXTPURecordIOReaderTell.restype = c.c_int64
     lib.MXTPURecordIOReaderTell.argtypes = [c.c_void_p]
@@ -113,11 +115,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
-# engine op callback signature: (ctx, err_buf, err_buf_len) -> int.
+# engine op callback signature: (ctx, err_buf, err_buf_len, skipped) -> int.
 # err_buf is POINTER(c_char), NOT c_char_p: ctypes would convert c_char_p
 # to an immutable bytes copy, making the error write-back impossible.
+# skipped=1 -> a dependency failed: release per-op state, do no real work.
 OP_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
-                         ctypes.POINTER(ctypes.c_char), ctypes.c_int)
+                         ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+                         ctypes.c_int)
 
 
 def get_lib():

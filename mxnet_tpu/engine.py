@@ -110,10 +110,10 @@ _op_lock = threading.Lock()
 _op_counter = 0
 
 
-def _static_trampoline(ctx, err_buf, err_len):
+def _static_trampoline(ctx, err_buf, err_len, skipped):
     with _op_lock:
         fn = _op_registry.pop(ctx, None)
-    if fn is None:
+    if fn is None or skipped:
         return 0
     try:
         fn()

@@ -1,0 +1,7 @@
+"""mx.io — data iterators and RecordIO (ref: python/mxnet/io/ + recordio.py)."""
+from . import recordio
+from .recordio import (MXRecordIO, MXIndexedRecordIO, IRHeader, pack, unpack,
+                       pack_img, unpack_img)
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,
+                 ImageRecordIter, PrefetchingIter, ResizeIter,
+                 register_iter, create_iter, list_data_iters)

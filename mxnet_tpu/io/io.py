@@ -1,0 +1,541 @@
+"""mx.io — legacy DataIter layer.
+
+Reference: python/mxnet/io/io.py (DataIter/DataBatch/DataDesc,
+NDArrayIter, MXDataIter registry MXListDataIters) and the C++ iterator
+pipeline (src/io/iter_image_recordio_2.cc threaded decode +
+iter_prefetcher.h). TPU-native redesign: iterators are Python, but the IO
+hot path rides the native runtime — records come off the C++ RecordIO
+reader (src/mxtpu/recordio.cc) and batch decode/augment work is scheduled
+on the C++ dependency engine (mx.engine) so decode overlaps training,
+playing the role of the reference's prefetcher thread.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as _onp
+
+from ..base import MXNetError
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ImageRecordIter", "PrefetchingIter", "ResizeIter",
+           "register_iter", "create_iter", "list_data_iters"]
+
+
+class DataDesc:
+    """Shape/type descriptor of one input (ref io.py DataDesc)."""
+
+    def __init__(self, name: str, shape, dtype=_onp.float32,
+                 layout: str = "NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.layout = layout
+
+    def __repr__(self):
+        return f"DataDesc[{self.name},{self.shape},{self.dtype},{self.layout}]"
+
+
+class DataBatch:
+    """One minibatch (ref io.py DataBatch): lists of NDArray data/label,
+    pad = #fake tail samples, index = sample indices."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator base (ref io.py DataIter): next()/reset() + iter protocol."""
+
+    def __init__(self, batch_size: int = 0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        return []
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        return []
+
+
+_ITER_REGISTRY: Dict[str, Any] = {}
+
+
+def register_iter(name: str, creator=None):
+    """Register a DataIter factory (ref C++ DataIter registry,
+    MXListDataIters)."""
+    def reg(c):
+        _ITER_REGISTRY[name] = c
+        return c
+    return reg(creator) if creator is not None else reg
+
+
+def create_iter(name: str, **kwargs) -> DataIter:
+    if name not in _ITER_REGISTRY:
+        raise MXNetError(f"unknown data iter '{name}'; "
+                         f"available: {sorted(_ITER_REGISTRY)}")
+    return _ITER_REGISTRY[name](**kwargs)
+
+
+def list_data_iters() -> List[str]:
+    return sorted(_ITER_REGISTRY)
+
+
+def _as_nd(x):
+    from ..ndarray import NDArray
+    from .. import numpy as mnp
+
+    if isinstance(x, NDArray):
+        return x
+    return mnp.array(x)
+
+
+class NDArrayIter(DataIter):
+    """Batching iterator over in-memory arrays (ref io.py NDArrayIter).
+
+    last_batch_handle: 'pad' (wrap, report pad count), 'discard', or
+    'roll_over' (leftover prepended to the next epoch)."""
+
+    def __init__(self, data, label=None, batch_size: int = 1,
+                 shuffle: bool = False, last_batch_handle: str = "pad",
+                 data_name: str = "data", label_name: str = "softmax_label"):
+        super().__init__(batch_size)
+        self._data = self._init_arrays(data, data_name)
+        self._label = self._init_arrays(label, label_name)
+        self._shuffle = shuffle
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError(f"bad last_batch_handle {last_batch_handle}")
+        self._lbh = last_batch_handle
+        self._n = next(iter(self._data.values())).shape[0] if self._data else 0
+        for name, arr in list(self._data.items()) + list(self._label.items()):
+            if arr.shape[0] != self._n:
+                raise MXNetError(f"array '{name}' first dim {arr.shape[0]} "
+                                 f"!= {self._n}")
+        self._order = _onp.arange(self._n)
+        self._carry = _onp.array([], dtype=_onp.int64)  # roll_over leftover
+        self.reset()
+
+    @staticmethod
+    def _init_arrays(data, default_name) -> "OrderedDict[str, _onp.ndarray]":
+        out: "OrderedDict[str, _onp.ndarray]" = OrderedDict()
+        if data is None:
+            return out
+        if isinstance(data, dict):
+            for k, v in data.items():
+                out[k] = _onp.asarray(getattr(v, "asnumpy", lambda: v)()
+                                      if hasattr(v, "asnumpy") else v)
+            return out
+        if isinstance(data, (list, tuple)):
+            for i, v in enumerate(data):
+                name = default_name if len(data) == 1 else f"{default_name}{i}"
+                out[name] = _onp.asarray(
+                    v.asnumpy() if hasattr(v, "asnumpy") else v)
+            return out
+        out[default_name] = _onp.asarray(
+            data.asnumpy() if hasattr(data, "asnumpy") else data)
+        return out
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self._data.items()]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self._label.items()]
+
+    def reset(self):
+        order = _onp.arange(self._n)
+        if self._shuffle:
+            _onp.random.shuffle(order)
+        self._order = _onp.concatenate([self._carry, order]) \
+            if self._carry.size else order
+        self._carry = _onp.array([], dtype=_onp.int64)
+        self._cursor = 0
+
+    def next(self) -> DataBatch:
+        b = self.batch_size
+        start = self._cursor
+        remaining = len(self._order) - start
+        if remaining <= 0:
+            raise StopIteration
+        pad = 0
+        if remaining < b:
+            if self._lbh == "discard":
+                raise StopIteration
+            if self._lbh == "roll_over":
+                self._carry = self._order[start:]
+                raise StopIteration
+            pad = b - remaining
+            idx = _onp.concatenate([self._order[start:], self._order[:pad]])
+        else:
+            idx = self._order[start:start + b]
+        self._cursor += b
+        data = [_as_nd(v[idx]) for v in self._data.values()]
+        label = [_as_nd(v[idx]) for v in self._label.values()]
+        return DataBatch(data, label, pad=pad, index=idx,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+register_iter("NDArrayIter", NDArrayIter)
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (ref src/io/iter_csv.cc registration CSVIter)."""
+
+    def __init__(self, data_csv: str, data_shape, label_csv: Optional[str] = None,
+                 label_shape=(1,), batch_size: int = 1, **kwargs):
+        data = _onp.loadtxt(data_csv, delimiter=",", ndmin=2,
+                            dtype=_onp.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _onp.loadtxt(label_csv, delimiter=",", ndmin=2,
+                                 dtype=_onp.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        self._inner = NDArrayIter(data, label, batch_size=batch_size,
+                                  last_batch_handle="discard")
+        super().__init__(batch_size)
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+register_iter("CSVIter", CSVIter)
+
+
+class ImageRecordIter(DataIter):
+    """Image iterator over packed .rec files (ref ImageRecordIter,
+    src/io/iter_image_recordio_2.cc + augmenters).
+
+    Decode + augment per batch is pushed onto the native engine
+    (mx.engine) with a prefetch window, overlapping IO with training like
+    the reference's decode thread pool + prefetcher."""
+
+    def __init__(self, path_imgrec: str, data_shape, batch_size: int,
+                 path_imgidx: Optional[str] = None, shuffle: bool = False,
+                 rand_crop: bool = False, rand_mirror: bool = False,
+                 resize: int = 0, mean_r: float = 0.0, mean_g: float = 0.0,
+                 mean_b: float = 0.0, std_r: float = 1.0, std_g: float = 1.0,
+                 std_b: float = 1.0, scale: float = 1.0,
+                 preprocess_threads: int = 4, prefetch_buffer: int = 4,
+                 seed: Optional[int] = None, round_batch: bool = True,
+                 **kwargs):
+        super().__init__(batch_size)
+        from .recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
+
+        self._unpack_img = unpack_img
+        self.data_shape = tuple(data_shape)  # (C, H, W)
+        if len(self.data_shape) != 3:
+            raise MXNetError("data_shape must be (channels, height, width)")
+        self._aug = dict(rand_crop=rand_crop, rand_mirror=rand_mirror,
+                         resize=resize, mean=_onp.array([mean_r, mean_g, mean_b],
+                                                        _onp.float32),
+                         std=_onp.array([std_r, std_g, std_b], _onp.float32),
+                         scale=scale)
+        self._shuffle = shuffle
+        self._rng = _onp.random.RandomState(seed)
+        self._round_batch = round_batch
+        self._prefetch = max(1, prefetch_buffer)
+
+        self._seed = seed if seed is not None else 0
+        self._epoch = 0
+        if path_imgidx:
+            self._rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self._keys = list(self._rec.keys)
+        else:
+            # no index: header-only scan to collect record offsets
+            self._rec = MXRecordIO(path_imgrec, "r")
+            self._keys = None
+            self._offsets = []
+            while True:
+                pos = self._rec.tell()
+                if not self._rec.skip_record():
+                    break
+                self._offsets.append(pos)
+        self._lock = threading.Lock()  # reader handle is stateful
+        self._vars: Dict[int, Any] = {}
+        self._engine = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def _num_samples(self):
+        return len(self._keys) if self._keys is not None else len(self._offsets)
+
+    def reset(self):
+        # drain in-flight prefetch ops: their closures write into _slots at
+        # completion time, so abandoning them would let a stale epoch's
+        # batches land in the new epoch's dict (and leak engine vars)
+        if self._engine is not None:
+            for bi, var in list(self._vars.items()):
+                self._engine.wait_for_var(var)
+                self._engine.delete_var(var)
+            self._vars.clear()
+        n = self._num_samples()
+        order = _onp.arange(n)
+        if self._shuffle:
+            self._rng.shuffle(order)
+        self._order = order
+        self._cursor = 0
+        self._slots: Dict[int, Any] = {}
+        self._scheduled = 0
+        self._epoch += 1
+
+    def _read_raw(self, i: int) -> bytes:
+        with self._lock:
+            if self._keys is not None:
+                return self._rec.read_idx(self._keys[i])
+            self._rec.seek_pos(self._offsets[i])
+            return self._rec.read()
+
+    def _augment(self, img: _onp.ndarray, rng) -> _onp.ndarray:
+        a = self._aug
+        c, h, w = self.data_shape
+        if a["resize"]:
+            from PIL import Image
+            ih, iw = img.shape[:2]
+            short = min(ih, iw)
+            ratio = a["resize"] / short
+            img = _onp.asarray(Image.fromarray(img.astype(_onp.uint8)).resize(
+                (max(w, int(iw * ratio)), max(h, int(ih * ratio)))))
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            from PIL import Image
+            img = _onp.asarray(
+                Image.fromarray(img.astype(_onp.uint8)).resize((w, h)))
+            ih, iw = h, w
+        if a["rand_crop"]:
+            y0 = rng.randint(0, ih - h + 1)
+            x0 = rng.randint(0, iw - w + 1)
+        else:
+            y0, x0 = (ih - h) // 2, (iw - w) // 2
+        img = img[y0:y0 + h, x0:x0 + w]
+        if a["rand_mirror"] and rng.rand() < 0.5:
+            img = img[:, ::-1]
+        img = img.astype(_onp.float32)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if img.shape[2] < c:
+            img = _onp.repeat(img, c, axis=2)
+        img = (img[:, :, :c] - a["mean"][:c]) / a["std"][:c] * a["scale"]
+        return img.transpose(2, 0, 1)  # HWC -> CHW
+
+    def _load_batch(self, bi: int, idx: Sequence[int], pad: int):
+        # per-batch RandomState: worker threads never share RNG state, and
+        # augmentation draws are reproducible for a given (seed, epoch,
+        # batch) regardless of thread scheduling
+        rng = _onp.random.RandomState(
+            (self._seed * 1000003 + self._epoch * 9973 + bi) % (2 ** 32))
+        slots = self._slots
+
+        def work():
+            xs = _onp.empty((self.batch_size,) + self.data_shape,
+                            _onp.float32)
+            ys = _onp.empty((self.batch_size,), _onp.float32)
+            for j, i in enumerate(idx):
+                header, img = self._unpack_img(self._read_raw(int(i)))
+                xs[j] = self._augment(img, rng)
+                lab = _onp.asarray(header.label)
+                ys[j] = float(lab if lab.ndim == 0 else lab.flat[0])
+            slots[bi] = (xs, ys, pad, _onp.asarray(idx))
+        return work
+
+    def _schedule(self):
+        from .. import engine as _engine
+
+        if self._engine is None:
+            self._engine = _engine.get()
+        n = len(self._order)
+        while (self._scheduled * self.batch_size < n and
+               self._scheduled < self._next_batch() + self._prefetch):
+            bi = self._scheduled
+            start = bi * self.batch_size
+            idx = self._order[start:start + self.batch_size]
+            pad = 0
+            if len(idx) < self.batch_size:
+                if not self._round_batch:
+                    break
+                pad = self.batch_size - len(idx)
+                idx = _onp.concatenate([idx, self._order[:pad]])
+            var = self._engine.new_var()
+            self._engine.push(self._load_batch(bi, idx, pad), write=(var,))
+            self._vars[bi] = var
+            self._scheduled += 1
+
+    def _next_batch(self):
+        return self._cursor
+
+    def next(self) -> DataBatch:
+        n = len(self._order)
+        start = self._cursor * self.batch_size
+        if start >= n or (not self._round_batch and
+                          start + self.batch_size > n):
+            raise StopIteration
+        self._schedule()
+        bi = self._cursor
+        if bi not in self._vars:
+            raise StopIteration
+        self._engine.wait_for_var(self._vars[bi])
+        self._engine.delete_var(self._vars.pop(bi))
+        xs, ys, pad, idx = self._slots.pop(bi)
+        self._cursor += 1
+        return DataBatch([_as_nd(xs)], [_as_nd(ys)], pad=pad, index=idx,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+register_iter("ImageRecordIter", ImageRecordIter)
+
+
+class ResizeIter(DataIter):
+    """Truncate/extend an iterator to a fixed #batches (ref io.py ResizeIter)."""
+
+    def __init__(self, data_iter: DataIter, size: int,
+                 reset_internal: bool = True):
+        super().__init__(data_iter.batch_size)
+        self._it = data_iter
+        self._size = size
+        self._reset_internal = reset_internal
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+        if self._reset_internal:
+            self._it.reset()
+
+    def next(self):
+        if self._i >= self._size:
+            raise StopIteration
+        self._i += 1
+        try:
+            return self._it.next()
+        except StopIteration:
+            self._it.reset()
+            return self._it.next()
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+
+class PrefetchingIter(DataIter):
+    """Async prefetch wrapper over any DataIter(s) via the native engine
+    (ref io.py PrefetchingIter / src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self._iters = list(iters)
+        # rename_data/rename_label: per-iter {old_name: new_name} dicts
+        # applied to provide_data/provide_label (ref io.py PrefetchingIter)
+        for rn, attr in ((rename_data, "rename_data"),
+                         (rename_label, "rename_label")):
+            if rn is not None and len(rn) != len(self._iters):
+                raise MXNetError(f"{attr} needs one dict per iterator")
+        self._rename_data = rename_data
+        self._rename_label = rename_label
+        from .. import engine as _engine
+        self._engine = _engine.get()
+        self._slot = {}
+        self._var = None
+        self._kick()
+
+    @staticmethod
+    def _renamed(descs, mapping):
+        if not mapping:
+            return descs
+        return [DataDesc(mapping.get(d.name, d.name), d.shape, d.dtype,
+                         d.layout) for d in descs]
+
+    def _fetch(self):
+        try:
+            self._slot["batch"] = [it.next() for it in self._iters]
+        except StopIteration:
+            self._slot["batch"] = None
+
+    def _kick(self):
+        self._var = self._engine.new_var()
+        self._slot = {}
+        self._engine.push(self._fetch, write=(self._var,))
+
+    def reset(self):
+        self._engine.wait_for_var(self._var)
+        self._engine.delete_var(self._var)
+        for it in self._iters:
+            it.reset()
+        self._kick()
+
+    def next(self):
+        self._engine.wait_for_var(self._var)
+        self._engine.delete_var(self._var)
+        batches = self._slot.get("batch")
+        if batches is None:
+            self._kick()  # keep a live var for a subsequent reset()
+            raise StopIteration
+        self._kick()
+        b = batches[0]
+        if len(batches) == 1:
+            return b
+        return DataBatch(sum([x.data for x in batches], []),
+                         sum([(x.label or []) for x in batches], []),
+                         pad=b.pad, index=b.index)
+
+    @property
+    def provide_data(self):
+        return sum([self._renamed(it.provide_data,
+                                  self._rename_data[i]
+                                  if self._rename_data else None)
+                    for i, it in enumerate(self._iters)], [])
+
+    @property
+    def provide_label(self):
+        return sum([self._renamed(it.provide_label,
+                                  self._rename_label[i]
+                                  if self._rename_label else None)
+                    for i, it in enumerate(self._iters)], [])

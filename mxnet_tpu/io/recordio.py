@@ -81,6 +81,36 @@ class MXRecordIO:
         self.close()
         self.open()
 
+    def seek_pos(self, offset: int):
+        """Seek the read cursor to a byte offset (reader only)."""
+        if self.writable:
+            raise MXNetError("seek_pos on a writer")
+        if self._nat is not None:
+            lib, h = self._nat
+            lib.MXTPURecordIOReaderSeek(h, int(offset))
+        else:
+            self._fp.seek(offset)
+
+    def skip_record(self) -> bool:
+        """Advance past one record reading only its header; False at EOF."""
+        if self.writable:
+            raise MXNetError("skip_record on a writer")
+        if self._nat is not None:
+            lib, h = self._nat
+            n = int(lib.MXTPURecordIOReaderSkip(h))
+            if n == -2:
+                raise MXNetError(f"corrupt record in {self.uri}")
+            return n >= 0
+        header = self._fp.read(8)
+        if len(header) < 8:
+            return False
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError(f"Invalid magic {magic:#x} in {self.uri}")
+        length = lrec & _LENGTH_MASK
+        self._fp.seek(length + ((4 - (length % 4)) % 4), 1)
+        return True
+
     def tell(self) -> int:
         if self._nat is not None:
             lib, h = self._nat
@@ -218,20 +248,34 @@ def unpack(s: bytes):
 
 
 def pack_img(header: IRHeader, img: _onp.ndarray, quality: int = 95,
-             img_fmt: str = ".npy") -> bytes:
-    """Pack a raw image array. The reference encodes JPEG via OpenCV; with
-    no cv2 in this environment arrays are stored as .npy payloads (fmt tag
-    kept for API parity)."""
+             img_fmt: str = ".jpg") -> bytes:
+    """Pack an image array as an encoded payload (ref recordio.py pack_img,
+    which encodes via OpenCV; here PIL: JPEG/PNG, or raw .npy)."""
     import io as _io
 
+    img = _onp.asarray(img)
+    fmt = img_fmt.lower()
     buf = _io.BytesIO()
-    _onp.save(buf, _onp.asarray(img))
+    if fmt in (".jpg", ".jpeg", ".png"):
+        from PIL import Image
+
+        pil = Image.fromarray(img.astype(_onp.uint8))
+        pil.save(buf, "JPEG" if fmt != ".png" else "PNG",
+                 **({"quality": quality} if fmt != ".png" else {}))
+    else:
+        _onp.save(buf, img)
     return pack(header, buf.getvalue())
 
 
 def unpack_img(s: bytes):
+    """Decode a packed image record (JPEG/PNG via PIL, or .npy)."""
     import io as _io
 
     header, payload = unpack(s)
-    img = _onp.load(_io.BytesIO(payload), allow_pickle=False)
+    if payload[:6] == b"\x93NUMPY":
+        img = _onp.load(_io.BytesIO(payload), allow_pickle=False)
+    else:
+        from PIL import Image
+
+        img = _onp.asarray(Image.open(_io.BytesIO(payload)).convert("RGB"))
     return header, img

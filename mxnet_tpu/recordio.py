@@ -1,0 +1,6 @@
+"""Legacy alias: ``mx.recordio`` (ref python/mxnet/recordio.py)."""
+from .io.recordio import (MXRecordIO, MXIndexedRecordIO, IRHeader, pack,
+                          unpack, pack_img, unpack_img)
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]

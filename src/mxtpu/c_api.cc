@@ -25,6 +25,7 @@ int64_t WriterTell(RecordIOWriter* w);
 void WriterClose(RecordIOWriter* w);
 RecordIOReader* ReaderOpen(const char* path);
 void* ReaderNext(RecordIOReader* r, uint32_t* len);
+int64_t ReaderSkip(RecordIOReader* r);
 void ReaderSeek(RecordIOReader* r, int64_t offset);
 int64_t ReaderTell(RecordIOReader* r);
 void ReaderClose(RecordIOReader* r);
@@ -43,8 +44,10 @@ extern "C" {
 
 // Engine op callback: returns 0 on success; on failure writes a message
 // into err_buf and returns nonzero. Invoked on an engine worker thread
-// (ctypes re-acquires the GIL for Python callbacks).
-typedef int (*MXTPUOpFn)(void* ctx, char* err_buf, int err_buf_len);
+// (ctypes re-acquires the GIL for Python callbacks). skipped != 0 means a
+// dependency failed: release per-op state, do no real work.
+typedef int (*MXTPUOpFn)(void* ctx, char* err_buf, int err_buf_len,
+                         int skipped);
 
 const char* MXTPUGetLastError() { return last_error.c_str(); }
 
@@ -80,13 +83,13 @@ int MXTPUEnginePush(void* engine, MXTPUOpFn fn, void* ctx, void** read_vars,
     for (int i = 0; i < n_write; ++i)
       writes[i] = static_cast<mxtpu::Var*>(write_vars[i]);
     static_cast<mxtpu::Engine*>(engine)->Push(
-        [fn, ctx]() -> std::string {
+        [fn, ctx](bool skipped) -> std::string {
           char buf[4096];
           buf[0] = '\0';
-          int rc = fn(ctx, buf, sizeof(buf));
+          int rc = fn(ctx, buf, sizeof(buf), skipped ? 1 : 0);
           if (rc == 0) return "";
           return buf[0] != '\0' ? std::string(buf)
-                                : std::string("engine op failed");
+                                 : std::string("engine op failed");
         },
         std::move(reads), std::move(writes), priority);
     return 0;
@@ -163,6 +166,11 @@ void* MXTPURecordIOReaderCreate(const char* path) {
 // *len = 0xffffffff & NULL on corruption.
 void* MXTPURecordIOReaderNext(void* r, uint32_t* len) {
   return mxtpu::ReaderNext(static_cast<mxtpu::RecordIOReader*>(r), len);
+}
+
+// header-only skip: returns payload length, -1 EOF, -2 corruption
+int64_t MXTPURecordIOReaderSkip(void* r) {
+  return mxtpu::ReaderSkip(static_cast<mxtpu::RecordIOReader*>(r));
 }
 
 void MXTPURecordIOReaderSeek(void* r, int64_t offset) {

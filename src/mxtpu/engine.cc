@@ -79,15 +79,16 @@ void Engine::DeleteVar(Var* var) {
   // Pushing further ops on the var afterwards is a caller bug (same
   // contract as ref Engine::DeleteVariable, engine.h:246).
   Push(
-      [var]() -> std::string {
+      [var](bool) -> std::string {
         var->to_delete = true;  // holder of the exclusive write grant
         return "";
       },
       {}, {var}, 0);
 }
 
-void Engine::Push(std::function<std::string()> fn, std::vector<Var*> reads,
-                  std::vector<Var*> writes, int priority, bool always_run) {
+void Engine::Push(std::function<std::string(bool)> fn,
+                  std::vector<Var*> reads, std::vector<Var*> writes,
+                  int priority, bool always_run) {
   auto* op = new Opr();
   op->fn = std::move(fn);
   // Dedupe: repeated vars would deadlock (an op's own read grant blocks
@@ -166,18 +167,16 @@ void Engine::ExecuteOpr(Opr* op) {
     std::lock_guard<std::mutex> lk(v->mu);
     if (v->exc) { dep_err = v->exc; break; }
   }
+  bool skipped = (dep_err != nullptr) && !op->always_run;
   std::string err;
-  if (dep_err && !op->always_run) {
-    err = *dep_err;
-  } else {
-    try {
-      err = op->fn();
-    } catch (const std::exception& e) {
-      err = e.what();
-    } catch (...) {
-      err = "unknown C++ exception in engine op";
-    }
+  try {
+    err = op->fn(skipped);
+  } catch (const std::exception& e) {
+    err = e.what();
+  } catch (...) {
+    err = "unknown C++ exception in engine op";
   }
+  if (skipped) err = *dep_err;  // propagate regardless of cleanup result
   if (!err.empty()) {
     auto eptr = std::make_shared<std::string>(err);
     for (Var* v : op->writes) {
@@ -230,7 +229,7 @@ std::string Engine::WaitForVar(Var* var) {
   bool done = false;
   std::string err;
   Push(
-      [&]() -> std::string {
+      [&](bool) -> std::string {
         {
           std::lock_guard<std::mutex> lk(var->mu);
           if (var->exc) err = *var->exc;

@@ -49,7 +49,11 @@ struct Var {
 
 // One pushed operation (ref ThreadedOpr, threaded_engine.h:234).
 struct Opr {
-  std::function<std::string()> fn;  // "" on success, else error message
+  // fn(skipped): "" on success, else error. skipped=true means a read
+  // dependency carried a sticky error and the body must NOT do real work —
+  // the call still happens so language bindings can release per-op
+  // resources (the Python closure registry).
+  std::function<std::string(bool)> fn;
   std::vector<Var*> reads;
   std::vector<Var*> writes;
   std::atomic<int> pending{0};  // un-granted var requests
@@ -94,7 +98,7 @@ class Engine {
   // Deletion is itself a write op so it runs after all pending users
   // (ref Engine::DeleteVariable, engine.h:246).
   void DeleteVar(Var* var);
-  void Push(std::function<std::string()> fn, std::vector<Var*> reads,
+  void Push(std::function<std::string(bool)> fn, std::vector<Var*> reads,
             std::vector<Var*> writes, int priority,
             bool always_run = false);
   // Returns error string ("" if clean) once all prior ops on var finished.

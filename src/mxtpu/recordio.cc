@@ -96,6 +96,19 @@ void* ReaderNext(RecordIOReader* r, uint32_t* len) {
   return buf;
 }
 
+// Skip one record reading only its 8-byte header (for offset indexing).
+// Returns payload length, -1 at EOF, -2 on corruption.
+int64_t ReaderSkip(RecordIOReader* r) {
+  uint32_t header[2];
+  size_t got = ::fread(header, 4, 2, r->fp);
+  if (got == 0) return -1;
+  if (got != 2 || header[0] != kMagic) return -2;
+  uint32_t n = header[1] & kLenMask;
+  uint32_t pad = (4 - (n & 3u)) & 3u;
+  ::fseek(r->fp, n + pad, SEEK_CUR);
+  return static_cast<int64_t>(n);
+}
+
 void ReaderSeek(RecordIOReader* r, int64_t offset) {
   ::fseek(r->fp, static_cast<long>(offset), SEEK_SET);
 }

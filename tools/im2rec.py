@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""im2rec — pack an image folder into RecordIO (ref tools/im2rec.py).
+
+Two modes, same as the reference:
+  --list      walk a directory, write a .lst file (index \t label \t path)
+  (default)   read a .lst file, encode images, write .rec + .idx
+
+Usage:
+  python tools/im2rec.py prefix image_root --list [--recursive]
+  python tools/im2rec.py prefix image_root [--quality 95] [--resize N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(args):
+    image_list = []
+    label_map = {}
+    if args.recursive:
+        for root, dirs, files in sorted(os.walk(args.root)):
+            dirs.sort()
+            for fn in sorted(files):
+                if fn.lower().endswith(EXTS):
+                    cat = os.path.relpath(root, args.root).split(os.sep)[0]
+                    if cat not in label_map:
+                        label_map[cat] = len(label_map)
+                    image_list.append(
+                        (os.path.relpath(os.path.join(root, fn), args.root),
+                         label_map[cat]))
+    else:
+        for fn in sorted(os.listdir(args.root)):
+            if fn.lower().endswith(EXTS):
+                image_list.append((fn, 0))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    with open(args.prefix + ".lst", "w") as f:
+        for i, (path, label) in enumerate(image_list):
+            f.write(f"{i}\t{label}\t{path}\n")
+    print(f"wrote {len(image_list)} entries to {args.prefix}.lst; "
+          f"{len(label_map)} classes")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            label = [float(x) for x in parts[1:-1]]
+            yield idx, label[0] if len(label) == 1 else label, parts[-1]
+
+
+def make_rec(args):
+    import numpy as np
+    from PIL import Image
+
+    from mxnet_tpu.io.recordio import IRHeader, MXIndexedRecordIO, pack_img
+
+    lst = args.prefix + ".lst"
+    if not os.path.isfile(lst):
+        raise SystemExit(f"list file {lst} not found; run --list first")
+    rec = MXIndexedRecordIO(args.prefix + ".idx", args.prefix + ".rec", "w")
+    n = 0
+    for idx, label, rel in read_list(lst):
+        img = Image.open(os.path.join(args.root, rel)).convert("RGB")
+        if args.resize:
+            w, h = img.size
+            short = min(w, h)
+            ratio = args.resize / short
+            img = img.resize((int(w * ratio), int(h * ratio)))
+        header = IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, pack_img(header, np.asarray(img),
+                                    quality=args.quality,
+                                    img_fmt=args.encoding))
+        n += 1
+        if n % 1000 == 0:
+            print(f"packed {n} images")
+    rec.close()
+    print(f"wrote {n} records to {args.prefix}.rec (+.idx)")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="prefix for .lst/.rec/.idx files")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--list", action="store_true", help="make a .lst file")
+    p.add_argument("--recursive", action="store_true",
+                   help="walk subdirs; subdir name = class label")
+    p.add_argument("--shuffle", action="store_true", default=True)
+    p.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter side to N before packing")
+    p.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    args = p.parse_args()
+    if args.list:
+        make_list(args)
+    else:
+        make_rec(args)
+
+
+if __name__ == "__main__":
+    main()
