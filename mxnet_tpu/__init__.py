@@ -47,6 +47,7 @@ from . import recordio  # legacy alias: mx.recordio (ref python/mxnet/recordio.p
 from . import profiler
 from . import runtime
 from . import amp
+from . import contrib
 from . import parallel
 from . import test_utils
 

@@ -1,0 +1,3 @@
+"""mx.contrib (ref: python/mxnet/contrib/): quantization, ONNX export."""
+from . import quantization
+from .quantization import quantize_net

@@ -1,0 +1,350 @@
+"""INT8 quantization (ref: src/operator/quantization/ +
+python/mxnet/contrib/quantization.py).
+
+TPU-native redesign: the reference lowers to MKL-DNN/cuDNN int8 kernels
+via the QuantizeGraph pass (quantize_graph_pass.cc:286,629); here
+quantized layers run int8 x int8 -> int32 matmuls/convs directly on the
+MXU through lax.dot_general(preferred_element_type=int32), and the
+"graph pass" is a gluon-tree rewrite: quantize_net() swaps Dense/Conv2D
+blocks for Quantized* wrappers with calibrated activation ranges.
+
+Calibration matches the reference's two modes (calibrate.cc):
+  * naive   — running min/max of each layer input
+  * entropy — KL-divergence-optimal threshold over a 2048-bin histogram
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ops.dispatch import call
+
+__all__ = ["quantize", "dequantize", "requantize", "quantize_net",
+           "QuantizedDense", "QuantizedConv2D", "CalibrationCollector"]
+
+_INT8_RANGE = 127.0
+
+
+# ---------------------------------------------------------------- core ops
+def _quantize_raw(x, min_range, max_range):
+    """Symmetric int8 quantization (ref quantize_v2 'auto' mode)."""
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    scale = jnp.where(amax > 0, _INT8_RANGE / amax, 1.0)
+    q = jnp.clip(jnp.round(x * scale), -127, 127).astype(jnp.int8)
+    return q, -amax, amax
+
+
+def quantize(data, min_range=None, max_range=None, out_type="int8"):
+    """(data, min, max) -> (int8 data, min, max). Ref: quantize_v2.cc."""
+    if out_type != "int8":
+        raise MXNetError("only int8 quantization is supported")
+    if min_range is None or max_range is None:
+        mn = float(jnp.min(data._data if isinstance(data, NDArray) else data))
+        mx_ = float(jnp.max(data._data if isinstance(data, NDArray) else data))
+        min_range = min_range if min_range is not None else mn
+        max_range = max_range if max_range is not None else mx_
+
+    def f(x):
+        return _quantize_raw(x, jnp.float32(min_range), jnp.float32(max_range))
+
+    return call(f, (data,), {}, name="quantize")
+
+
+def dequantize(data, min_range, max_range):
+    """int8 -> float32 (ref dequantize.cc)."""
+    def f(x):
+        amax = jnp.maximum(jnp.abs(jnp.float32(min_range)),
+                           jnp.abs(jnp.float32(max_range)))
+        return x.astype(jnp.float32) * (amax / _INT8_RANGE)
+
+    return call(f, (data,), {}, name="dequantize")
+
+
+def requantize(data, min_range, max_range, out_min, out_max):
+    """int32 accumulator -> int8 with a new range (ref requantize.cc)."""
+    def f(x):
+        in_scale = max(abs(min_range), abs(max_range)) / (2.0 ** 31 - 1)
+        out_amax = max(abs(out_min), abs(out_max))
+        out_scale = _INT8_RANGE / out_amax if out_amax > 0 else 1.0
+        return jnp.clip(jnp.round(x.astype(jnp.float32) * in_scale *
+                                  out_scale), -127, 127).astype(jnp.int8)
+
+    return call(f, (data,), {}, name="requantize")
+
+
+# ------------------------------------------------------------- calibration
+def _kl_divergence(p, q):
+    p = p / max(p.sum(), 1e-12)
+    q = q / max(q.sum(), 1e-12)
+    mask = p > 0
+    qm = _onp.where(q > 0, q, 1e-12)
+    return float(_onp.sum(p[mask] * _onp.log(p[mask] / qm[mask])))
+
+
+def optimal_threshold_kl(arr: _onp.ndarray, num_bins: int = 2048,
+                         num_quantized_bins: int = 255) -> float:
+    """KL-optimal |threshold| for int8 (ref calibrate.cc entropy mode:
+    histogram the |activations|, scan candidate clips, pick min-KL)."""
+    a = _onp.abs(_onp.asarray(arr, _onp.float32).ravel())
+    amax = float(a.max()) if a.size else 1.0
+    if amax == 0.0:
+        return 1e-8
+    hist, edges = _onp.histogram(a, bins=num_bins, range=(0, amax))
+    best_kl, best_t = _onp.inf, amax
+    # scan thresholds from num_quantized_bins..num_bins
+    for i in range(num_quantized_bins, num_bins + 1, 8):
+        t = edges[i] if i < len(edges) else amax
+        sliced = hist[:i].astype(_onp.float64)
+        if sliced.size == 0 or sliced.sum() == 0:
+            continue
+        # p: clipped distribution — outlier mass folded into the edge bin
+        p = sliced.copy()
+        p[-1] += hist[i:].sum()
+        # q: int8-quantized version of the UN-inflated slice; clipping is
+        # penalized because p's inflated edge bin has no counterpart in q
+        factor = sliced.size / num_quantized_bins
+        q = _onp.zeros_like(sliced)
+        for j in range(num_quantized_bins):
+            start = int(j * factor)
+            stop = max(int((j + 1) * factor), start + 1)
+            chunk = sliced[start:stop]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[start:stop] = _onp.where(chunk > 0, chunk.sum() / nz, 0)
+        kl = _kl_divergence(p, q)
+        if kl < best_kl:
+            best_kl, best_t = kl, float(t)
+    return best_t
+
+
+class CalibrationCollector:
+    """Accumulates per-layer activation stats during calibration forwards
+    (ref quantization.py _LayerOutputCollector/_LayerOutputMinMaxCollector)."""
+
+    def __init__(self, mode: str = "naive"):
+        if mode not in ("naive", "entropy"):
+            raise MXNetError(f"bad calib mode {mode}")
+        self.mode = mode
+        self.min_max: Dict[str, List[float]] = {}
+        self.samples: Dict[str, List[_onp.ndarray]] = {}
+
+    def collect(self, name: str, arr):
+        a = _onp.asarray(arr._data if isinstance(arr, NDArray) else arr)
+        if self.mode == "naive":
+            mn, mx_ = float(a.min()), float(a.max())
+            if name in self.min_max:
+                self.min_max[name][0] = min(self.min_max[name][0], mn)
+                self.min_max[name][1] = max(self.min_max[name][1], mx_)
+            else:
+                self.min_max[name] = [mn, mx_]
+        else:
+            self.samples.setdefault(name, []).append(a.ravel())
+
+    def thresholds(self) -> Dict[str, float]:
+        if self.mode == "naive":
+            return {k: max(abs(v[0]), abs(v[1]))
+                    for k, v in self.min_max.items()}
+        return {k: optimal_threshold_kl(_onp.concatenate(v))
+                for k, v in self.samples.items()}
+
+
+# --------------------------------------------------------- quantized layers
+def _quantize_weight_per_channel(w: jnp.ndarray, axis: int = 0):
+    """Per-output-channel symmetric int8 weights (ref channel-wise scales
+    in quantized fc/conv)."""
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    amax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.where(amax > 0, _INT8_RANGE / amax, 1.0)
+    wq = jnp.clip(jnp.round(w * scale), -127, 127).astype(jnp.int8)
+    return wq, (amax / _INT8_RANGE).reshape(-1)  # dequant scale per channel
+
+
+class QuantizedDense:
+    """Drop-in forward for a calibrated Dense (ref quantized_fully_connected.cc):
+    int8 activations x int8 weights -> int32 on the MXU -> float32 out."""
+
+    def __init__(self, dense, act_threshold: float):
+        from ..gluon import nn as _nn
+
+        if not hasattr(dense, "weight"):
+            raise MXNetError("QuantizedDense wraps a Dense block")
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self._act = dense._act
+        w = dense.weight.data()._data
+        self._wq, self._wscale = _quantize_weight_per_channel(w, axis=0)
+        self._bias = None if dense.bias is None else dense.bias.data()._data
+        self._t = float(act_threshold)
+        self.name = getattr(dense, "name", "dense")
+
+    def __call__(self, x):
+        def f(xr):
+            t = jnp.float32(self._t)
+            xs = jnp.where(t > 0, _INT8_RANGE / t, 1.0)
+            flat = xr.reshape(xr.shape[0], -1) if self._flatten else xr
+            xq = jnp.clip(jnp.round(flat * xs), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, self._wq.T, (((flat.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (self._wscale / xs)
+            if self._bias is not None:
+                out = out + self._bias
+            if self._act is not None:
+                from ..ops import nn as _opsnn
+                out = _opsnn.activation(out, self._act)
+            return out
+
+        return call(f, (x,), {}, name="quantized_dense")
+
+
+class QuantizedConv2D:
+    """Calibrated int8 conv (ref quantized_conv.cc): int8 x int8 -> int32
+    via lax.conv_general_dilated with int32 accumulation."""
+
+    def __init__(self, conv, act_threshold: float):
+        w = conv.weight.data()._data  # (O, I, kH, kW)
+        self._wq, self._wscale = _quantize_weight_per_channel(w, axis=0)
+        self._bias = None if conv.bias is None else conv.bias.data()._data
+        self._strides = conv._strides if isinstance(conv._strides, tuple) \
+            else (conv._strides,) * 2
+        self._padding = conv._padding if isinstance(conv._padding, tuple) \
+            else (conv._padding,) * 2
+        self._dilation = getattr(conv, "_dilation", (1, 1))
+        if not isinstance(self._dilation, tuple):
+            self._dilation = (self._dilation,) * 2
+        self._groups = getattr(conv, "_groups", 1)
+        self._act = getattr(conv, "_act", None)
+        self._t = float(act_threshold)
+        self.name = getattr(conv, "name", "conv")
+
+    def __call__(self, x):
+        def f(xr):
+            t = jnp.float32(self._t)
+            xs = jnp.where(t > 0, _INT8_RANGE / t, 1.0)
+            xq = jnp.clip(jnp.round(xr * xs), -127, 127).astype(jnp.int8)
+            pad = [(self._padding[0], self._padding[0]),
+                   (self._padding[1], self._padding[1])]
+            acc = jax.lax.conv_general_dilated(
+                xq, self._wq, window_strides=self._strides, padding=pad,
+                rhs_dilation=self._dilation,
+                feature_group_count=self._groups,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * \
+                (self._wscale.reshape(1, -1, 1, 1) / xs)
+            if self._bias is not None:
+                out = out + self._bias.reshape(1, -1, 1, 1)
+            if self._act is not None:
+                from ..ops import nn as _opsnn
+                out = _opsnn.activation(out, self._act)
+            return out
+
+        return call(f, (x,), {}, name="quantized_conv2d")
+
+
+# ------------------------------------------------------------ net rewrite
+def _quantizable(block) -> bool:
+    from ..gluon import nn as _nn
+
+    return isinstance(block, (_nn.Dense, _nn.Conv2D))
+
+
+def _walk_blocks(block, prefix=""):
+    for name, child in block._children.items():
+        path = f"{prefix}{name}"
+        yield path, block, name, child
+        yield from _walk_blocks(child, path + ".")
+
+
+def quantize_net(net, calib_data=None, calib_mode: str = "naive",
+                 quantized_dtype: str = "int8",
+                 exclude_layers: Optional[Sequence[str]] = None,
+                 num_calib_batches: Optional[int] = None):
+    """Convert a float net into an int8-quantized one
+    (ref contrib/quantization.py quantize_net).
+
+    calib_data: iterable of input batches (NDArray or tuple) used to
+    calibrate per-layer activation ranges. Returns a NEW callable net; the
+    original is untouched.
+    """
+    import copy
+
+    from ..gluon import nn as _nn
+
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 supported")
+    if calib_mode not in ("naive", "entropy", "none"):
+        raise MXNetError(f"bad calib mode {calib_mode}")
+    exclude = set(exclude_layers or [])
+
+    qnet = copy.deepcopy(net)
+    targets = [(path, parent, name, child)
+               for path, parent, name, child in _walk_blocks(qnet)
+               if _quantizable(child) and path not in exclude]
+    if not targets:
+        return qnet
+
+    if calib_mode != "none":
+        collector = CalibrationCollector(calib_mode)
+        if calib_data is None:
+            raise MXNetError(f"calib_mode={calib_mode} needs calib_data")
+        # hook each target block's input
+        originals = {}
+        for path, parent, name, child in targets:
+            orig_fwd = child.forward
+
+            def hooked(x, *a, _p=path, _f=orig_fwd, **kw):
+                collector.collect(_p, x)
+                return _f(x, *a, **kw)
+
+            originals[path] = (child, orig_fwd)
+            child.forward = hooked
+        seen = 0
+        for batch in calib_data:
+            xs = batch if isinstance(batch, (tuple, list)) else (batch,)
+            qnet(*xs)
+            seen += 1
+            if num_calib_batches is not None and seen >= num_calib_batches:
+                break
+        for child, orig in originals.values():
+            child.forward = orig
+        thresholds = collector.thresholds()
+    else:
+        thresholds = {}
+
+    for path, parent, name, child in targets:
+        t = thresholds.get(path, _INT8_RANGE)
+        if isinstance(child, _nn.Dense):
+            q = QuantizedDense(child, t)
+        else:
+            q = QuantizedConv2D(child, t)
+        # swap into the parent block (children registry + attribute)
+        parent._children[name] = _QuantizedShim(q)
+        if getattr(parent, name, None) is child:
+            object.__setattr__(parent, name, parent._children[name])
+    return qnet
+
+
+class _QuantizedShim:
+    """Minimal Block-like wrapper so quantized layers sit in _children."""
+
+    def __init__(self, q):
+        self._q = q
+        self._children = {}
+
+    def __call__(self, x, *args):
+        return self._q(x)
+
+    def collect_params(self, *a, **kw):
+        return {}
+
+    def hybridize(self, *a, **kw):
+        pass
+
+    def __repr__(self):
+        return f"Quantized({getattr(self._q, 'name', '?')})"

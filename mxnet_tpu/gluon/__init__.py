@@ -8,4 +8,5 @@ from . import loss
 from . import metric
 from . import data
 from . import model_zoo
+from . import probability
 from .utils import split_and_load, clip_global_norm, split_data

@@ -1,0 +1,18 @@
+"""gluon.probability (ref: python/mxnet/gluon/probability/).
+
+Distributions, transformations and StochasticBlock, TPU-native: sampling
+uses jax.random (per-call keys from the global Philox stream,
+mxnet_tpu.random), densities are pure jnp and differentiate through the
+autograd tape. Reparameterized sampling (has_grad=True) flows gradients
+through rsample like the reference's F.npx ops did.
+"""
+from .distributions import (Distribution, Normal, LogNormal, HalfNormal,
+                            Laplace, Cauchy, Uniform, Exponential, Gamma,
+                            Beta, Dirichlet, Poisson, Bernoulli, Binomial,
+                            Geometric, Categorical, OneHotCategorical,
+                            MultivariateNormal, StudentT, Gumbel,
+                            kl_divergence, register_kl)
+from .transformation import (Transformation, AffineTransformation,
+                             ExpTransformation, SigmoidTransformation,
+                             ComposeTransformation, TransformedDistribution)
+from .stochastic_block import StochasticBlock, StochasticSequential

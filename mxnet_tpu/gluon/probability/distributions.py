@@ -1,0 +1,824 @@
+"""Distribution classes (ref: python/mxnet/gluon/probability/distributions/).
+
+Each distribution wraps pure-jnp log_prob/mean/variance plus jax.random
+sampling. sample() is stochastic and un-differentiated; sample_n mirrors
+the reference surface. For reparameterizable families rsample() (ref
+has_grad path) keeps the autograd tape connected through the noise.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ...ops.dispatch import call
+from ...random import next_key
+
+__all__ = ["Distribution", "Normal", "LogNormal", "HalfNormal", "Laplace",
+           "Cauchy", "Uniform", "Exponential", "Gamma", "Beta", "Dirichlet",
+           "Poisson", "Bernoulli", "Binomial", "Geometric", "Categorical",
+           "OneHotCategorical", "MultivariateNormal", "StudentT", "Gumbel",
+           "kl_divergence", "register_kl"]
+
+
+def _raw(x):
+    if isinstance(x, NDArray):
+        return x._data
+    return jnp.asarray(x, jnp.float32)
+
+
+def _nd_op(fn, *nd_args, name="prob_op"):
+    args = tuple(a if isinstance(a, NDArray) else NDArray(jnp.asarray(a, jnp.float32))
+                 for a in nd_args)
+    return call(fn, args, {}, name=name)
+
+
+class Distribution:
+    """Base class (ref distribution.py Distribution)."""
+
+    has_grad = False          # rsample support
+    support = None
+    event_dim = 0
+
+    def __init__(self, **params):
+        self._params = params
+
+    # -- stats, overridden by subclasses -----------------------------------
+    def log_prob(self, value) -> NDArray:
+        raise NotImplementedError
+
+    def prob(self, value) -> NDArray:
+        lp = self.log_prob(value)
+        return _nd_op(jnp.exp, lp, name="prob")
+
+    @property
+    def mean(self) -> NDArray:
+        raise NotImplementedError
+
+    @property
+    def variance(self) -> NDArray:
+        raise NotImplementedError
+
+    @property
+    def stddev(self) -> NDArray:
+        return _nd_op(jnp.sqrt, self.variance, name="stddev")
+
+    def entropy(self) -> NDArray:
+        raise NotImplementedError
+
+    def cdf(self, value) -> NDArray:
+        raise NotImplementedError
+
+    def icdf(self, value) -> NDArray:
+        raise NotImplementedError
+
+    # -- sampling ----------------------------------------------------------
+    def sample(self, size: Tuple[int, ...] = ()) -> NDArray:
+        """Draw without gradient (stop_gradient around rsample when
+        reparameterizable)."""
+        s = self._sample_impl(size)
+        return _nd_op(jax.lax.stop_gradient, s, name="sample")
+
+    def rsample(self, size: Tuple[int, ...] = ()) -> NDArray:
+        if not self.has_grad:
+            raise MXNetError(f"{type(self).__name__} is not reparameterizable")
+        return self._sample_impl(size)
+
+    def sample_n(self, n: int) -> NDArray:
+        return self.sample((n,))
+
+    def _sample_impl(self, size) -> NDArray:
+        raise NotImplementedError
+
+    def _batch_shape(self, *params) -> Tuple[int, ...]:
+        shape = ()
+        for p in params:
+            shape = jnp.broadcast_shapes(shape, _raw(p).shape)
+        return shape
+
+    def broadcast_to(self, shape):
+        new = {k: (v if v is None else
+                   _nd_op(lambda a: jnp.broadcast_to(a, shape), v,
+                          name="broadcast"))
+               for k, v in self._params.items()}
+        return type(self)(**new)
+
+
+class Normal(Distribution):
+    """Gaussian (ref distributions/normal.py)."""
+
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kw):
+        super().__init__(loc=loc, scale=scale)
+        self.loc, self.scale = loc, scale
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            var = scale ** 2
+            return (-((v - loc) ** 2) / (2 * var) - jnp.log(scale)
+                    - 0.5 * math.log(2 * math.pi))
+        return _nd_op(f, value, self.loc, self.scale, name="normal_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda l, s: jnp.broadcast_to(
+            l, jnp.broadcast_shapes(l.shape, s.shape)),
+            self.loc, self.scale, name="mean")
+
+    @property
+    def variance(self):
+        return _nd_op(lambda l, s: jnp.broadcast_to(
+            s ** 2, jnp.broadcast_shapes(l.shape, s.shape)),
+            self.loc, self.scale, name="variance")
+
+    def entropy(self):
+        return _nd_op(lambda s: 0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(s), self.scale, name="entropy")
+
+    def cdf(self, value):
+        return _nd_op(lambda v, l, s: jax.scipy.stats.norm.cdf(v, l, s),
+                      value, self.loc, self.scale, name="cdf")
+
+    def icdf(self, value):
+        return _nd_op(lambda v, l, s: jax.scipy.stats.norm.ppf(v, l, s),
+                      value, self.loc, self.scale, name="icdf")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.loc, self.scale)
+
+        def f(loc, scale):
+            eps = jax.random.normal(key, shape)
+            return loc + scale * eps
+
+        return _nd_op(f, self.loc, self.scale, name="normal_sample")
+
+
+class LogNormal(Distribution):
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kw):
+        super().__init__(loc=loc, scale=scale)
+        self.loc, self.scale = loc, scale
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            lv = jnp.log(v)
+            return (-((lv - loc) ** 2) / (2 * scale ** 2) - jnp.log(scale)
+                    - lv - 0.5 * math.log(2 * math.pi))
+        return _nd_op(f, value, self.loc, self.scale, name="lognormal_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda l, s: jnp.exp(l + s ** 2 / 2),
+                      self.loc, self.scale, name="mean")
+
+    @property
+    def variance(self):
+        return _nd_op(lambda l, s: (jnp.exp(s ** 2) - 1)
+                      * jnp.exp(2 * l + s ** 2),
+                      self.loc, self.scale, name="variance")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.loc, self.scale)
+
+        def f(loc, scale):
+            return jnp.exp(loc + scale * jax.random.normal(key, shape))
+
+        return _nd_op(f, self.loc, self.scale, name="lognormal_sample")
+
+
+class HalfNormal(Distribution):
+    has_grad = True
+
+    def __init__(self, scale=1.0, **kw):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def log_prob(self, value):
+        def f(v, s):
+            return (0.5 * math.log(2 / math.pi) - jnp.log(s)
+                    - v ** 2 / (2 * s ** 2)
+                    + jnp.where(v >= 0, 0.0, -jnp.inf))
+        return _nd_op(f, value, self.scale, name="halfnormal_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda s: s * math.sqrt(2 / math.pi), self.scale,
+                      name="mean")
+
+    @property
+    def variance(self):
+        return _nd_op(lambda s: s ** 2 * (1 - 2 / math.pi), self.scale,
+                      name="variance")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.scale)
+        return _nd_op(lambda s: jnp.abs(s * jax.random.normal(key, shape)),
+                      self.scale, name="halfnormal_sample")
+
+
+class Laplace(Distribution):
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kw):
+        super().__init__(loc=loc, scale=scale)
+        self.loc, self.scale = loc, scale
+
+    def log_prob(self, value):
+        return _nd_op(lambda v, l, s: -jnp.abs(v - l) / s
+                      - jnp.log(2 * s), value, self.loc, self.scale,
+                      name="laplace_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda l, s: jnp.broadcast_to(
+            l, jnp.broadcast_shapes(l.shape, s.shape)), self.loc, self.scale,
+            name="mean")
+
+    @property
+    def variance(self):
+        return _nd_op(lambda l, s: jnp.broadcast_to(
+            2 * s ** 2, jnp.broadcast_shapes(l.shape, s.shape)),
+            self.loc, self.scale, name="variance")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.loc, self.scale)
+
+        def f(loc, scale):
+            u = jax.random.uniform(key, shape, minval=-0.5 + 1e-7,
+                                   maxval=0.5)
+            return loc - scale * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+
+        return _nd_op(f, self.loc, self.scale, name="laplace_sample")
+
+
+class Cauchy(Distribution):
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kw):
+        super().__init__(loc=loc, scale=scale)
+        self.loc, self.scale = loc, scale
+
+    def log_prob(self, value):
+        return _nd_op(lambda v, l, s: -jnp.log(math.pi * s *
+                      (1 + ((v - l) / s) ** 2)),
+                      value, self.loc, self.scale, name="cauchy_logp")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.loc, self.scale)
+
+        def f(loc, scale):
+            u = jax.random.uniform(key, shape, minval=1e-7, maxval=1 - 1e-7)
+            return loc + scale * jnp.tan(math.pi * (u - 0.5))
+
+        return _nd_op(f, self.loc, self.scale, name="cauchy_sample")
+
+
+class Uniform(Distribution):
+    has_grad = True
+
+    def __init__(self, low=0.0, high=1.0, **kw):
+        super().__init__(low=low, high=high)
+        self.low, self.high = low, high
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = jnp.logical_and(v >= lo, v <= hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return _nd_op(f, value, self.low, self.high, name="uniform_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda lo, hi: (lo + hi) / 2, self.low, self.high,
+                      name="mean")
+
+    @property
+    def variance(self):
+        return _nd_op(lambda lo, hi: (hi - lo) ** 2 / 12, self.low,
+                      self.high, name="variance")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.low, self.high)
+
+        def f(lo, hi):
+            return lo + (hi - lo) * jax.random.uniform(key, shape)
+
+        return _nd_op(f, self.low, self.high, name="uniform_sample")
+
+
+class Exponential(Distribution):
+    has_grad = True
+
+    def __init__(self, scale=1.0, **kw):
+        super().__init__(scale=scale)
+        self.scale = scale  # mean (ref uses scale=1/rate)
+
+    def log_prob(self, value):
+        return _nd_op(lambda v, s: -v / s - jnp.log(s), value, self.scale,
+                      name="exponential_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda s: s + 0, self.scale, name="mean")
+
+    @property
+    def variance(self):
+        return _nd_op(lambda s: s ** 2, self.scale, name="variance")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.scale)
+
+        def f(s):
+            u = jax.random.uniform(key, shape, minval=1e-7, maxval=1.0)
+            return -s * jnp.log(u)
+
+        return _nd_op(f, self.scale, name="exponential_sample")
+
+
+class Gamma(Distribution):
+    def __init__(self, shape=1.0, scale=1.0, **kw):
+        super().__init__(shape=shape, scale=scale)
+        self.shape_param, self.scale = shape, scale
+
+    def log_prob(self, value):
+        def f(v, a, s):
+            return ((a - 1) * jnp.log(v) - v / s - jax.lax.lgamma(a)
+                    - a * jnp.log(s))
+        return _nd_op(f, value, self.shape_param, self.scale,
+                      name="gamma_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda a, s: a * s, self.shape_param, self.scale,
+                      name="mean")
+
+    @property
+    def variance(self):
+        return _nd_op(lambda a, s: a * s ** 2, self.shape_param, self.scale,
+                      name="variance")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.shape_param, self.scale)
+
+        def f(a, s):
+            return jax.random.gamma(key, jnp.broadcast_to(a, shape)) * s
+
+        return _nd_op(f, self.shape_param, self.scale, name="gamma_sample")
+
+
+class Beta(Distribution):
+    def __init__(self, alpha=1.0, beta=1.0, **kw):
+        super().__init__(alpha=alpha, beta=beta)
+        self.alpha, self.beta = alpha, beta
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            lbeta = (jax.lax.lgamma(a) + jax.lax.lgamma(b)
+                     - jax.lax.lgamma(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+        return _nd_op(f, value, self.alpha, self.beta, name="beta_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda a, b: a / (a + b), self.alpha, self.beta,
+                      name="mean")
+
+    @property
+    def variance(self):
+        return _nd_op(lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                      self.alpha, self.beta, name="variance")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.alpha, self.beta)
+
+        def f(a, b):
+            return jax.random.beta(key, jnp.broadcast_to(a, shape),
+                                   jnp.broadcast_to(b, shape))
+
+        return _nd_op(f, self.alpha, self.beta, name="beta_sample")
+
+
+class Dirichlet(Distribution):
+    event_dim = 1
+
+    def __init__(self, alpha, **kw):
+        super().__init__(alpha=alpha)
+        self.alpha = alpha
+
+    def log_prob(self, value):
+        def f(v, a):
+            return (jnp.sum((a - 1) * jnp.log(v), -1)
+                    + jax.lax.lgamma(jnp.sum(a, -1))
+                    - jnp.sum(jax.lax.lgamma(a), -1))
+        return _nd_op(f, value, self.alpha, name="dirichlet_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda a: a / jnp.sum(a, -1, keepdims=True),
+                      self.alpha, name="mean")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        a_shape = _raw(self.alpha).shape
+        shape = size + a_shape
+
+        def f(a):
+            return jax.random.dirichlet(key, jnp.broadcast_to(a, shape))
+
+        return _nd_op(f, self.alpha, name="dirichlet_sample")
+
+
+class Poisson(Distribution):
+    def __init__(self, rate=1.0, **kw):
+        super().__init__(rate=rate)
+        self.rate = rate
+
+    def log_prob(self, value):
+        return _nd_op(lambda v, r: v * jnp.log(r) - r
+                      - jax.lax.lgamma(v + 1.0), value, self.rate,
+                      name="poisson_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda r: r + 0, self.rate, name="mean")
+
+    @property
+    def variance(self):
+        return _nd_op(lambda r: r + 0, self.rate, name="variance")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.rate)
+
+        def f(r):
+            return jax.random.poisson(key, jnp.broadcast_to(r, shape)
+                                      ).astype(jnp.float32)
+
+        return _nd_op(f, self.rate, name="poisson_sample")
+
+
+class Bernoulli(Distribution):
+    def __init__(self, prob=None, logit=None, **kw):
+        if (prob is None) == (logit is None):
+            raise MXNetError("exactly one of prob/logit required")
+        super().__init__(prob=prob, logit=logit)
+        self._prob, self._logit = prob, logit
+
+    @property
+    def prob_param(self):
+        if self._prob is not None:
+            return self._prob
+        return _nd_op(jax.nn.sigmoid, self._logit, name="sigmoid")
+
+    def log_prob(self, value):
+        if self._logit is not None:
+            def f(v, lg):
+                return v * lg - jax.nn.softplus(lg)
+            return _nd_op(f, value, self._logit, name="bernoulli_logp")
+
+        def f(v, p):
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return _nd_op(f, value, self._prob, name="bernoulli_logp")
+
+    @property
+    def mean(self):
+        return self.prob_param
+
+    @property
+    def variance(self):
+        return _nd_op(lambda p: p * (1 - p), self.prob_param,
+                      name="variance")
+
+    def entropy(self):
+        return _nd_op(lambda p: -(p * jnp.log(p)
+                                  + (1 - p) * jnp.log1p(-p)),
+                      self.prob_param, name="entropy")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        p = self.prob_param
+        shape = size + self._batch_shape(p)
+        return _nd_op(lambda pp: jax.random.bernoulli(
+            key, jnp.broadcast_to(pp, shape)).astype(jnp.float32), p,
+            name="bernoulli_sample")
+
+
+class Binomial(Distribution):
+    def __init__(self, n=1, prob=0.5, **kw):
+        super().__init__(n=n, prob=prob)
+        self.n, self._prob = n, prob
+
+    def log_prob(self, value):
+        def f(v, p):
+            n = jnp.float32(self.n)
+            comb = (jax.lax.lgamma(n + 1) - jax.lax.lgamma(v + 1)
+                    - jax.lax.lgamma(n - v + 1))
+            return comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+        return _nd_op(f, value, self._prob, name="binomial_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda p: self.n * p, self._prob, name="mean")
+
+    @property
+    def variance(self):
+        return _nd_op(lambda p: self.n * p * (1 - p), self._prob,
+                      name="variance")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self._prob)
+
+        def f(p):
+            ps = jnp.broadcast_to(p, shape)
+            draws = jax.random.bernoulli(
+                key, ps[..., None] * jnp.ones(self.n))
+            return draws.sum(-1).astype(jnp.float32)
+
+        return _nd_op(f, self._prob, name="binomial_sample")
+
+
+class Geometric(Distribution):
+    """#failures before first success (ref geometric.py)."""
+
+    def __init__(self, prob=0.5, **kw):
+        super().__init__(prob=prob)
+        self._prob = prob
+
+    def log_prob(self, value):
+        return _nd_op(lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+                      value, self._prob, name="geometric_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda p: (1 - p) / p, self._prob, name="mean")
+
+    @property
+    def variance(self):
+        return _nd_op(lambda p: (1 - p) / p ** 2, self._prob,
+                      name="variance")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self._prob)
+
+        def f(p):
+            u = jax.random.uniform(key, shape, minval=1e-7, maxval=1.0)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-jnp.broadcast_to(
+                p, shape)))
+
+        return _nd_op(f, self._prob, name="geometric_sample")
+
+
+class Categorical(Distribution):
+    """Integer-class distribution over the trailing axis (ref
+    categorical.py)."""
+
+    def __init__(self, num_events=None, prob=None, logit=None, **kw):
+        if (prob is None) == (logit is None):
+            raise MXNetError("exactly one of prob/logit required")
+        super().__init__(prob=prob, logit=logit)
+        self._prob, self._logit = prob, logit
+        self.num_events = num_events or _raw(
+            prob if prob is not None else logit).shape[-1]
+
+    @property
+    def logit_param(self):
+        if self._logit is not None:
+            return self._logit
+        return _nd_op(jnp.log, self._prob, name="log")
+
+    def log_prob(self, value):
+        def f(v, lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], -1)[..., 0]
+        return _nd_op(f, value, self.logit_param, name="categorical_logp")
+
+    @property
+    def prob_param(self):
+        if self._prob is not None:
+            return self._prob
+        return _nd_op(lambda lg: jax.nn.softmax(lg, -1), self._logit,
+                      name="softmax")
+
+    def entropy(self):
+        return _nd_op(lambda lg: -jnp.sum(
+            jax.nn.softmax(lg, -1) * jax.nn.log_softmax(lg, -1), -1),
+            self.logit_param, name="entropy")
+
+    def _sample_impl(self, size):
+        key = next_key()
+
+        def f(lg):
+            return jax.random.categorical(
+                key, lg, axis=-1, shape=size + lg.shape[:-1]
+            ).astype(jnp.float32)
+
+        return _nd_op(f, self.logit_param, name="categorical_sample")
+
+
+class OneHotCategorical(Categorical):
+    event_dim = 1
+
+    def log_prob(self, value):
+        def f(v, lg):
+            return jnp.sum(v * jax.nn.log_softmax(lg, -1), -1)
+        return _nd_op(f, value, self.logit_param, name="onehot_logp")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        n = self.num_events
+
+        def f(lg):
+            idx = jax.random.categorical(key, lg, axis=-1,
+                                         shape=size + lg.shape[:-1])
+            return jax.nn.one_hot(idx, n)
+
+        return _nd_op(f, self.logit_param, name="onehot_sample")
+
+
+class MultivariateNormal(Distribution):
+    event_dim = 1
+    has_grad = True
+
+    def __init__(self, loc, cov=None, scale_tril=None, **kw):
+        if (cov is None) == (scale_tril is None):
+            raise MXNetError("exactly one of cov/scale_tril required")
+        super().__init__(loc=loc, cov=cov, scale_tril=scale_tril)
+        self.loc = loc
+        self._cov, self._tril = cov, scale_tril
+
+    @property
+    def scale_tril(self):
+        if self._tril is not None:
+            return self._tril
+        return _nd_op(jnp.linalg.cholesky, self._cov, name="cholesky")
+
+    def log_prob(self, value):
+        def f(v, loc, L):
+            d = loc.shape[-1]
+            diff = v - loc
+            Lb = jnp.broadcast_to(L, diff.shape[:-1] + L.shape[-2:])
+            sol = jax.scipy.linalg.solve_triangular(Lb, diff[..., None],
+                                                    lower=True)[..., 0]
+            maha = jnp.sum(sol ** 2, -1)
+            logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2,
+                                                      axis2=-1)), -1)
+            return -0.5 * (maha + logdet + d * math.log(2 * math.pi))
+        return _nd_op(f, value, self.loc, self.scale_tril, name="mvn_logp")
+
+    @property
+    def mean(self):
+        return self.loc if isinstance(self.loc, NDArray) \
+            else NDArray(_raw(self.loc))
+
+    def _sample_impl(self, size):
+        key = next_key()
+
+        def f(loc, L):
+            shape = size + loc.shape
+            eps = jax.random.normal(key, shape)
+            return loc + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return _nd_op(f, self.loc, self.scale_tril, name="mvn_sample")
+
+
+class StudentT(Distribution):
+    def __init__(self, df=1.0, loc=0.0, scale=1.0, **kw):
+        super().__init__(df=df, loc=loc, scale=scale)
+        self.df, self.loc, self.scale = df, loc, scale
+
+    def log_prob(self, value):
+        def f(v, df, loc, scale):
+            z = (v - loc) / scale
+            return (jax.lax.lgamma((df + 1) / 2) - jax.lax.lgamma(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(scale)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+        return _nd_op(f, value, self.df, self.loc, self.scale,
+                      name="studentt_logp")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.df, self.loc, self.scale)
+
+        def f(df, loc, scale):
+            t = jax.random.t(key, jnp.broadcast_to(df, shape))
+            return loc + scale * t
+
+        return _nd_op(f, self.df, self.loc, self.scale,
+                      name="studentt_sample")
+
+
+class Gumbel(Distribution):
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kw):
+        super().__init__(loc=loc, scale=scale)
+        self.loc, self.scale = loc, scale
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            z = (v - loc) / scale
+            return -(z + jnp.exp(-z)) - jnp.log(scale)
+        return _nd_op(f, value, self.loc, self.scale, name="gumbel_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda l, s: l + s * 0.5772156649015329,
+                      self.loc, self.scale, name="mean")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.loc, self.scale)
+
+        def f(loc, scale):
+            return loc + scale * jax.random.gumbel(key, shape)
+
+        return _nd_op(f, self.loc, self.scale, name="gumbel_sample")
+
+
+# ------------------------------------------------------------ KL registry
+_KL_REGISTRY: Dict[Tuple[type, type], Callable] = {}
+
+
+def register_kl(type_p, type_q):
+    """Decorator registering KL(p||q) (ref divergence.py register_kl)."""
+    def dec(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return dec
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> NDArray:
+    for (tp, tq), fn in _KL_REGISTRY.items():
+        if isinstance(p, tp) and isinstance(q, tq):
+            return fn(p, q)
+    raise MXNetError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def f(pl, ps, ql, qs):
+        vr = (ps / qs) ** 2
+        return 0.5 * (vr + ((pl - ql) / qs) ** 2 - 1 - jnp.log(vr))
+    return _nd_op(f, p.loc, p.scale, q.loc, q.scale, name="kl_normal")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    def f(pp, qp):
+        return (pp * (jnp.log(pp) - jnp.log(qp))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+    return _nd_op(f, p.prob_param, q.prob_param, name="kl_bernoulli")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    def f(pl, ql):
+        pp = jax.nn.softmax(pl, -1)
+        return jnp.sum(pp * (jax.nn.log_softmax(pl, -1)
+                             - jax.nn.log_softmax(ql, -1)), -1)
+    return _nd_op(f, p.logit_param, q.logit_param, name="kl_categorical")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_unif_unif(p, q):
+    def f(pl, ph, ql, qh):
+        ok = jnp.logical_and(ql <= pl, qh >= ph)
+        return jnp.where(ok, jnp.log((qh - ql) / (ph - pl)), jnp.inf)
+    return _nd_op(f, p.low, p.high, q.low, q.high, name="kl_uniform")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    def f(ps, qs):
+        r = ps / qs
+        return jnp.log(qs / ps) + r - 1
+    return _nd_op(f, p.scale, q.scale, name="kl_exponential")
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    def f(pa, ps, qa, qs):
+        return ((pa - qa) * jax.scipy.special.digamma(pa)
+                - jax.lax.lgamma(pa) + jax.lax.lgamma(qa)
+                + qa * (jnp.log(qs) - jnp.log(ps))
+                + pa * (ps / qs - 1))
+    return _nd_op(f, p.shape_param, p.scale, q.shape_param, q.scale,
+                  name="kl_gamma")

@@ -1,0 +1,60 @@
+"""StochasticBlock (ref: python/mxnet/gluon/probability/block/).
+
+A HybridBlock whose forward can record auxiliary losses (e.g. KL terms
+for a VAE) via add_loss; collected after each call on .losses.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+__all__ = ["StochasticBlock", "StochasticSequential"]
+
+
+class StochasticBlock(HybridBlock):
+    """forward() may call self.add_loss(x); losses are gathered per call
+    (ref stochastic_block.py StochasticBlock._flush)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._pending_losses = []
+        self._losses = []
+
+    def add_loss(self, loss):
+        self._pending_losses.append(loss)
+
+    @property
+    def losses(self):
+        return self._losses
+
+    def __call__(self, *args, **kwargs):
+        self._pending_losses = []
+        out = super().__call__(*args, **kwargs)
+        self._losses = self._pending_losses
+        return out
+
+
+class StochasticSequential(StochasticBlock):
+    """Sequential that accumulates child StochasticBlock losses
+    (ref stochastic_block.py StochasticSequential)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x, *args)
+            args = ()
+            if isinstance(b, StochasticBlock):
+                for loss in b.losses:
+                    self.add_loss(loss)
+        return x
+
+    def __getitem__(self, key):
+        return list(self._children.values())[key]
+
+    def __len__(self):
+        return len(self._children)

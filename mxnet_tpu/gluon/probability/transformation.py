@@ -1,0 +1,120 @@
+"""Bijective transformations + TransformedDistribution
+(ref: python/mxnet/gluon/probability/transformation/)."""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ...ops.dispatch import call
+from .distributions import Distribution, _nd_op
+
+__all__ = ["Transformation", "AffineTransformation", "ExpTransformation",
+           "SigmoidTransformation", "ComposeTransformation",
+           "TransformedDistribution"]
+
+
+class Transformation:
+    """y = f(x) bijection with log|det J| (ref transformation.py)."""
+
+    def __call__(self, x) -> NDArray:
+        raise NotImplementedError
+
+    def inverse(self, y) -> NDArray:
+        raise NotImplementedError
+
+    def log_det_jacobian(self, x, y) -> NDArray:
+        raise NotImplementedError
+
+
+class AffineTransformation(Transformation):
+    def __init__(self, loc=0.0, scale=1.0):
+        self.loc, self.scale = loc, scale
+
+    def __call__(self, x):
+        return _nd_op(lambda xx, l, s: l + s * xx, x, self.loc, self.scale,
+                      name="affine_fwd")
+
+    def inverse(self, y):
+        return _nd_op(lambda yy, l, s: (yy - l) / s, y, self.loc,
+                      self.scale, name="affine_inv")
+
+    def log_det_jacobian(self, x, y):
+        return _nd_op(lambda xx, s: jnp.broadcast_to(
+            jnp.log(jnp.abs(s)), jnp.broadcast_shapes(
+                xx.shape, jnp.shape(s))), x, self.scale, name="affine_ldj")
+
+
+class ExpTransformation(Transformation):
+    def __call__(self, x):
+        return _nd_op(jnp.exp, x, name="exp_fwd")
+
+    def inverse(self, y):
+        return _nd_op(jnp.log, y, name="exp_inv")
+
+    def log_det_jacobian(self, x, y):
+        return _nd_op(lambda xx: xx + 0, x, name="exp_ldj")
+
+
+class SigmoidTransformation(Transformation):
+    def __call__(self, x):
+        return _nd_op(jax.nn.sigmoid, x, name="sigmoid_fwd")
+
+    def inverse(self, y):
+        return _nd_op(lambda yy: jnp.log(yy) - jnp.log1p(-yy), y,
+                      name="sigmoid_inv")
+
+    def log_det_jacobian(self, x, y):
+        return _nd_op(lambda xx: -jax.nn.softplus(-xx)
+                      - jax.nn.softplus(xx), x, name="sigmoid_ldj")
+
+
+class ComposeTransformation(Transformation):
+    def __init__(self, parts: List[Transformation]):
+        if not parts:
+            raise MXNetError("empty transformation list")
+        self.parts = list(parts)
+
+    def __call__(self, x):
+        for t in self.parts:
+            x = t(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.parts):
+            y = t.inverse(y)
+        return y
+
+    def log_det_jacobian(self, x, y):
+        total = None
+        cur = x
+        for t in self.parts:
+            nxt = t(cur)
+            ldj = t.log_det_jacobian(cur, nxt)
+            total = ldj if total is None else total + ldj
+            cur = nxt
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """push-forward of a base distribution through transformations
+    (ref transformed_distribution.py)."""
+
+    def __init__(self, base: Distribution, transforms):
+        if isinstance(transforms, Transformation):
+            transforms = [transforms]
+        super().__init__()
+        self.base = base
+        self.transform = ComposeTransformation(list(transforms))
+        self.has_grad = base.has_grad
+
+    def _sample_impl(self, size=()):
+        return self.transform(self.base._sample_impl(size))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        ldj = self.transform.log_det_jacobian(x, value)
+        return self.base.log_prob(x) - ldj

@@ -189,7 +189,9 @@ class NDArrayIter(DataIter):
                 self._carry = self._order[start:]
                 raise StopIteration
             pad = b - remaining
-            idx = _onp.concatenate([self._order[start:], self._order[:pad]])
+            # np.resize cycles the whole order, so pad > len(order) works
+            idx = _onp.concatenate([self._order[start:],
+                                    _onp.resize(self._order, pad)])
         else:
             idx = self._order[start:start + b]
         self._cursor += b
@@ -397,7 +399,7 @@ class ImageRecordIter(DataIter):
                 if not self._round_batch:
                     break
                 pad = self.batch_size - len(idx)
-                idx = _onp.concatenate([idx, self._order[:pad]])
+                idx = _onp.concatenate([idx, _onp.resize(self._order, pad)])
             var = self._engine.new_var()
             self._engine.push(self._load_batch(bi, idx, pad), write=(var,))
             self._vars[bi] = var

@@ -574,6 +574,142 @@ class LAMB(Optimizer):
 
 
 @register
+class FTML(Optimizer):
+    """Follow the Moving Leader (ref optimizer/ftml.py; Zheng & Kwok 2017)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z), NDArray(z))  # d, v, z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep_grad(grad._data) + wd * weight._data
+        d, v, z = state
+        vv = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        denom = jnp.sqrt(vv / (1 - self.beta2 ** t)) + self.epsilon
+        dd = (1 - self.beta1 ** t) / lr * denom
+        sigma = dd - self.beta1 * d._data
+        zz = self.beta1 * z._data + (1 - self.beta1) * g \
+            - sigma * weight._data
+        weight._set_data(-zz / dd)
+        d._set_data(dd)
+        v._set_data(vv)
+        z._set_data(zz)
+
+
+@register
+class LANS(Optimizer):
+    """LAMB with normalized gradients (ref optimizer/lans.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def _trust(self, w_norm, r_norm):
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        return jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep_grad(grad._data)
+        gnorm = jnp.linalg.norm(g)
+        g = jnp.where(gnorm > 0, g / gnorm, g)  # LANS normalizes grads
+        m, v = state
+        mm = self.beta1 * m._data + (1 - self.beta1) * g
+        vv = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        mhat = mm / (1 - self.beta1 ** t)
+        vhat = vv / (1 - self.beta2 ** t)
+        denom = jnp.sqrt(vhat) + self.epsilon
+        w_norm = jnp.linalg.norm(weight._data)
+        # momentum part
+        r1 = mhat / denom + wd * weight._data
+        # gradient part (Nesterov-style second term)
+        r2 = g / denom + wd * weight._data
+        ratio1 = self._trust(w_norm, jnp.linalg.norm(r1))
+        ratio2 = self._trust(w_norm, jnp.linalg.norm(r2))
+        w = weight._data - lr * (self.beta1 * ratio1 * r1
+                                 + (1 - self.beta1) * ratio2 * r2)
+        weight._set_data(w)
+        m._set_data(mm)
+        v._set_data(vv)
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD with LARS-style layer-wise scaling + warmup
+    (ref optimizer/lbsgd.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        if warmup_strategy not in ("linear", "power2", "sqrt", "lars"):
+            raise ValueError(f"unknown warmup_strategy {warmup_strategy}")
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_updates = max(1, warmup_epochs * updates_per_epoch)
+        # large-batch scaling: target lr = base lr * batch_scale, reached
+        # via warmup (ref lbsgd.py lr scheduling)
+        self.batch_scale = batch_scale
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def _warmup_lr(self, lr, t):
+        target = lr * self.batch_scale
+        if t >= self.warmup_updates:
+            return target
+        frac = (t + 1) / self.warmup_updates
+        if self.warmup_strategy == "power2":
+            frac = frac ** 2
+        elif self.warmup_strategy == "sqrt":
+            frac = frac ** 0.5
+        elif self.warmup_strategy == "lars":
+            frac = 1.0  # layer-wise scaling alone (phi below) handles it
+        return lr + (target - lr) * frac if self.batch_scale > 1 \
+            else target * frac
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._warmup_lr(self._get_lr(index), t)
+        wd = self._get_wd(index)
+        g = self._prep_grad(grad._data) + wd * weight._data
+        # LARS trust ratio per layer
+        w_norm = jnp.linalg.norm(weight._data)
+        g_norm = jnp.linalg.norm(g)
+        phi = jnp.where((w_norm > 0) & (g_norm > 0), w_norm / g_norm, 1.0)
+        step = lr * jnp.minimum(phi, 1.0) * g
+        if state is not None:
+            mm = self.momentum * state._data + step
+            weight._set_data(weight._data - mm)
+            state._set_data(mm)
+        else:
+            weight._set_data(weight._data - step)
+
+
+@register
 class DCASGD(Optimizer):
     """Delay-compensated async SGD (ref optimizer/dcasgd.py)."""
 

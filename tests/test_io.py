@@ -202,3 +202,24 @@ def test_engine_skipped_op_releases_closure():
         pass
     with em._op_lock:
         assert len(em._op_registry) == 0
+
+
+def test_ndarray_iter_pad_exceeds_dataset():
+    """pad wraps cyclically even when batch_size > 2x dataset size."""
+    it = NDArrayIter(onp.arange(2).astype('float32'), batch_size=5,
+                     last_batch_handle="pad")
+    b = next(iter(it))
+    assert b.data[0].shape == (5,)
+    assert b.pad == 3
+    assert list(b.data[0].asnumpy()) == [0, 1, 0, 1, 0]
+
+
+def test_image_record_iter_batch_exceeds_dataset(tmp_path):
+    prefix = _write_rec(tmp_path, n=2)
+    it = ImageRecordIter(path_imgrec=prefix + ".rec",
+                         path_imgidx=prefix + ".idx",
+                         data_shape=(3, 24, 24), batch_size=5)
+    b = next(iter(it))
+    assert b.data[0].shape == (5, 3, 24, 24)
+    assert b.pad == 3
+    assert list(b.label[0].asnumpy()) == [0, 1, 0, 1, 0]

@@ -1,0 +1,181 @@
+"""gluon.probability tests (ref: tests/python/unittest/test_gluon_probability_v2.py)."""
+import math
+
+import numpy as onp
+import pytest
+import scipy.stats as ss
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import probability as mgp
+
+
+def _nd(x):
+    return mx.np.array(onp.asarray(x), dtype='float32')
+
+
+@pytest.mark.parametrize("dist,params,sp", [
+    (mgp.Normal, dict(loc=0.5, scale=2.0), ss.norm(0.5, 2.0)),
+    (mgp.Laplace, dict(loc=-1.0, scale=1.5), ss.laplace(-1.0, 1.5)),
+    (mgp.Cauchy, dict(loc=0.0, scale=1.0), ss.cauchy(0, 1)),
+    (mgp.Uniform, dict(low=-2.0, high=3.0), ss.uniform(-2.0, 5.0)),
+    (mgp.Exponential, dict(scale=2.0), ss.expon(scale=2.0)),
+    (mgp.Gamma, dict(shape=3.0, scale=0.5), ss.gamma(3.0, scale=0.5)),
+    (mgp.Beta, dict(alpha=2.0, beta=3.0), ss.beta(2.0, 3.0)),
+    (mgp.Gumbel, dict(loc=1.0, scale=2.0), ss.gumbel_r(1.0, 2.0)),
+    (mgp.StudentT, dict(df=5.0, loc=0.0, scale=1.0), ss.t(5.0)),
+    (mgp.LogNormal, dict(loc=0.0, scale=0.5), ss.lognorm(0.5)),
+    (mgp.HalfNormal, dict(scale=2.0), ss.halfnorm(scale=2.0)),
+])
+def test_log_prob_matches_scipy(dist, params, sp):
+    d = dist(**params)
+    xs = sp.rvs(size=20, random_state=0).astype('float32')
+    got = d.log_prob(_nd(xs)).asnumpy()
+    want = sp.logpdf(xs)
+    assert onp.allclose(got, want, atol=1e-4, rtol=1e-4), (got, want)
+
+
+@pytest.mark.parametrize("dist,params,sp", [
+    (mgp.Poisson, dict(rate=3.0), ss.poisson(3.0)),
+    (mgp.Bernoulli, dict(prob=0.3), ss.bernoulli(0.3)),
+    (mgp.Geometric, dict(prob=0.25), None),
+    (mgp.Binomial, dict(n=10, prob=0.4), ss.binom(10, 0.4)),
+])
+def test_discrete_log_prob(dist, params, sp):
+    d = dist(**params)
+    if sp is not None:
+        xs = sp.rvs(size=20, random_state=0).astype('float32')
+        want = sp.logpmf(xs)
+    else:  # scipy geom counts trials; ours counts failures (ref parity)
+        xs = (ss.geom(0.25).rvs(size=20, random_state=0) - 1).astype('float32')
+        want = ss.geom(0.25).logpmf(xs + 1)
+    got = d.log_prob(_nd(xs)).asnumpy()
+    assert onp.allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_sampling_moments():
+    mx.random.seed(7)
+    d = mgp.Normal(loc=2.0, scale=3.0)
+    s = d.sample((20000,)).asnumpy()
+    assert abs(s.mean() - 2.0) < 0.1
+    assert abs(s.std() - 3.0) < 0.1
+    g = mgp.Gamma(shape=2.0, scale=1.5)
+    s = g.sample((20000,)).asnumpy()
+    assert abs(s.mean() - 3.0) < 0.1
+    c = mgp.Categorical(logit=_nd([0.0, math.log(3.0)]))
+    s = c.sample((20000,)).asnumpy()
+    assert abs(s.mean() - 0.75) < 0.02  # P(1)=0.75
+
+
+def test_rsample_gradient_flows():
+    loc = _nd([1.0]); loc.attach_grad()
+    scale = _nd([2.0]); scale.attach_grad()
+    mx.random.seed(0)
+    with autograd.record():
+        d = mgp.Normal(loc=loc, scale=scale)
+        z = d.rsample((64,))
+        (z ** 2).mean().backward()
+    assert abs(float(loc.grad.asnumpy()[0])) > 0
+    assert abs(float(scale.grad.asnumpy()[0])) > 0
+    with pytest.raises(MXNetError):
+        mgp.Poisson(rate=1.0).rsample(())
+
+
+def test_kl_divergence():
+    p = mgp.Normal(loc=0.0, scale=1.0)
+    q = mgp.Normal(loc=1.0, scale=2.0)
+    got = float(mgp.kl_divergence(p, q).asnumpy())
+    want = math.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    assert abs(got - want) < 1e-5
+    b1, b2 = mgp.Bernoulli(prob=0.3), mgp.Bernoulli(prob=0.6)
+    got = float(mgp.kl_divergence(b1, b2).asnumpy())
+    want = 0.3 * math.log(0.3 / 0.6) + 0.7 * math.log(0.7 / 0.4)
+    assert abs(got - want) < 1e-5
+    with pytest.raises(MXNetError):
+        mgp.kl_divergence(p, mgp.Poisson(rate=1.0))
+
+
+def test_categorical_logp_and_entropy():
+    logits = _nd([[0.0, 1.0, 2.0]])
+    c = mgp.Categorical(logit=logits)
+    lp = c.log_prob(_nd([[2.0]])).asnumpy() if False else \
+        c.log_prob(_nd([2.0]).reshape(1)).asnumpy()
+    want = ss.multinomial(1, onp.exp([0, 1, 2]) / onp.exp([0, 1, 2]).sum())
+    p = onp.exp([0, 1, 2]) / onp.exp([0, 1, 2]).sum()
+    assert onp.allclose(lp, onp.log(p[2]), atol=1e-5)
+    ent = float(c.entropy().asnumpy())
+    assert abs(ent - float(-(p * onp.log(p)).sum())) < 1e-5
+
+
+def test_mvn_log_prob():
+    cov = onp.array([[2.0, 0.5], [0.5, 1.0]], 'float32')
+    loc = onp.array([1.0, -1.0], 'float32')
+    d = mgp.MultivariateNormal(loc=_nd(loc), cov=_nd(cov))
+    xs = onp.random.RandomState(0).randn(5, 2).astype('float32')
+    got = d.log_prob(_nd(xs)).asnumpy()
+    want = ss.multivariate_normal(loc, cov).logpdf(xs)
+    assert onp.allclose(got, want, atol=1e-4)
+
+
+def test_transformed_distribution():
+    # exp(Normal) == LogNormal
+    base = mgp.Normal(loc=0.3, scale=0.6)
+    d = mgp.TransformedDistribution(base, mgp.ExpTransformation())
+    xs = onp.array([0.5, 1.0, 2.5], 'float32')
+    got = d.log_prob(_nd(xs)).asnumpy()
+    want = ss.lognorm(0.6, scale=math.exp(0.3)).logpdf(xs)
+    assert onp.allclose(got, want, atol=1e-4)
+    # affine + sigmoid compose: roundtrip
+    t = mgp.ComposeTransformation([
+        mgp.AffineTransformation(loc=1.0, scale=2.0),
+        mgp.SigmoidTransformation()])
+    x = _nd([0.1, -0.2])
+    y = t(x)
+    back = t.inverse(y).asnumpy()
+    assert onp.allclose(back, x.asnumpy(), atol=1e-5)
+
+
+def test_stochastic_block_vae_style():
+    """A VAE-ish encoder: KL loss collected via add_loss, trains."""
+    import jax
+
+    class Encoder(mgp.StochasticBlock):
+        def __init__(self):
+            super().__init__()
+            self.mu = mx.gluon.nn.Dense(4)
+            self.logvar = mx.gluon.nn.Dense(4)
+
+        def forward(self, x):
+            mu, logvar = self.mu(x), self.logvar(x)
+            std = (logvar * 0.5).exp()
+            q = mgp.Normal(loc=mu, scale=std)
+            z = q.rsample(())
+            kl = mgp.kl_divergence(q, mgp.Normal(loc=0.0, scale=1.0))
+            self.add_loss(kl.sum(axis=-1).mean())
+            return z
+
+    mx.random.seed(1)
+    enc = Encoder()
+    dec = mx.gluon.nn.Dense(8)
+    enc.initialize(mx.init.Xavier()); dec.initialize(mx.init.Xavier())
+    x = _nd(onp.random.RandomState(0).rand(16, 8))
+    params = {**enc.collect_params(), **dec.collect_params()}
+    tr = mx.gluon.Trainer(params, 'adam', {'learning_rate': 0.01})
+    losses = []
+    for _ in range(30):
+        with autograd.record():
+            z = enc(x)
+            rec = ((dec(z) - x) ** 2).mean()
+            loss = rec + 0.01 * enc.losses[0]
+            loss.backward()
+        tr.step(16)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_broadcast_to_with_dual_params():
+    b = mgp.Bernoulli(prob=_nd([0.5])).broadcast_to((3,))
+    assert b.mean.shape == (3,)
+    c = mgp.Categorical(logit=_nd([[0.0, 1.0]])).broadcast_to((3, 2))
+    assert c.prob_param.shape == (3, 2)

@@ -1,0 +1,137 @@
+"""INT8 quantization tests (ref: tests/python/quantization/)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib.quantization import (CalibrationCollector,
+                                            optimal_threshold_kl, dequantize,
+                                            quantize, quantize_net,
+                                            requantize)
+
+
+def test_quantize_dequantize_roundtrip():
+    x = mx.np.array(onp.random.RandomState(0).uniform(-3, 3, (4, 16)),
+                    dtype='float32')
+    q, mn, mx_ = quantize(x)
+    assert q.asnumpy().dtype == onp.int8
+    back = dequantize(q, float(mn.asnumpy()), float(mx_.asnumpy()))
+    err = onp.abs(back.asnumpy() - x.asnumpy()).max()
+    assert err < 3.0 / 127  # one quantization step
+
+
+def test_requantize():
+    acc = mx.np.array(onp.array([[2 ** 20, -2 ** 22]]), dtype='int32')
+    out = requantize(acc, -2.0 ** 30, 2.0 ** 30, -1.0, 1.0)
+    assert out.asnumpy().dtype == onp.int8
+
+
+def test_kl_threshold_reasonable():
+    rs = onp.random.RandomState(0)
+    # gaussian bulk + a few huge outliers: KL threshold must clip outliers
+    a = onp.concatenate([rs.normal(0, 1, 100000), [80.0, -90.0]])
+    t = optimal_threshold_kl(a)
+    assert 2.0 < t < 40.0
+
+
+def test_calibration_collector_naive():
+    c = CalibrationCollector("naive")
+    c.collect("l1", onp.array([-1.0, 2.0]))
+    c.collect("l1", onp.array([-5.0, 1.0]))
+    assert c.thresholds()["l1"] == 5.0
+
+
+@pytest.fixture(scope="module")
+def float_net():
+    mx.random.seed(3)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+            mx.gluon.nn.Conv2D(16, 3, strides=2, padding=1, activation="relu"),
+            mx.gluon.nn.Flatten(),
+            mx.gluon.nn.Dense(32, activation="relu"),
+            mx.gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((1, 3, 16, 16)))
+    return net
+
+
+@pytest.mark.parametrize("mode", ["naive", "entropy"])
+def test_quantize_net_close_to_float(float_net, mode):
+    rs = onp.random.RandomState(1)
+    calib = [mx.np.array(rs.rand(8, 3, 16, 16), dtype='float32')
+             for _ in range(4)]
+    qnet = quantize_net(float_net, calib_data=calib, calib_mode=mode)
+    x = mx.np.array(rs.rand(8, 3, 16, 16), dtype='float32')
+    ref = float_net(x).asnumpy()
+    out = qnet(x).asnumpy()
+    denom = onp.abs(ref).max() + 1e-6
+    if mode == "naive":
+        # no clipping: max error bounded by quantization steps
+        assert onp.abs(out - ref).max() / denom < 0.15
+    else:
+        # KL clips outliers: judge by mean error, not max
+        assert onp.abs(out - ref).mean() / denom < 0.15
+    # argmax agreement (classification survives quantization)
+    agree = (ref.argmax(1) == out.argmax(1)).mean()
+    assert agree >= 0.75
+
+
+def test_quantize_net_original_untouched(float_net):
+    x = mx.np.array(onp.random.RandomState(2).rand(2, 3, 16, 16),
+                    dtype='float32')
+    before = float_net(x).asnumpy()
+    calib = [x]
+    quantize_net(float_net, calib_data=calib, calib_mode="naive")
+    after = float_net(x).asnumpy()
+    assert onp.array_equal(before, after)
+
+
+def test_quantize_net_exclude(float_net):
+    x = mx.np.array(onp.random.RandomState(2).rand(2, 3, 16, 16),
+                    dtype='float32')
+    qnet = quantize_net(float_net, calib_data=[x], calib_mode="naive",
+                        exclude_layers=["4"])  # keep final Dense float
+    from mxnet_tpu.gluon import nn as gnn
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert kinds.count("_QuantizedShim") == 3
+    assert "Dense" in kinds
+
+
+def test_quantize_net_requires_calib_data(float_net):
+    with pytest.raises(MXNetError):
+        quantize_net(float_net, calib_mode="entropy")
+
+
+def test_new_optimizers_converge():
+    """FTML / LANS / LBSGD reduce a regression loss (ref optimizer tests)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    for name, kw in [("ftml", {}), ("lans", {}), ("lbsgd", {"momentum": 0.9})]:
+        mx.random.seed(0)
+        net = mx.gluon.nn.Dense(1)
+        net.initialize(mx.init.Xavier())
+        rs = onp.random.RandomState(0)
+        X = mx.np.array(rs.rand(64, 8), dtype='float32')
+        w_true = rs.rand(8, 1).astype('float32')
+        Y = mx.np.array(onp.asarray(X._data) @ w_true)
+        tr = mx.gluon.Trainer(net.collect_params(), name,
+                              {"learning_rate": 0.05, **kw})
+        first = last = None
+        for _ in range(100):
+            with autograd.record():
+                l = ((net(X) - Y) ** 2).mean()
+            l.backward(); tr.step(64)
+            v = float(l.asnumpy())
+            first = v if first is None else first
+            last = v
+        assert last < first * 0.2, (name, first, last)
+
+
+def test_quantize_net_mode_none(float_net):
+    qnet = quantize_net(float_net, calib_mode="none")
+    x = mx.np.array(onp.random.RandomState(4).rand(2, 3, 16, 16),
+                    dtype='float32')
+    assert qnet(x).shape == (2, 10)
+    with pytest.raises(MXNetError):
+        quantize_net(float_net, calib_mode="bogus")
