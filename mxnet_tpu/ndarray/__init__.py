@@ -95,3 +95,4 @@ def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype=None):
 
 from . import random  # noqa: E402
 from .utils import save, load  # noqa: E402
+from . import sparse  # noqa: E402

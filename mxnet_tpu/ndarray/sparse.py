@@ -1,0 +1,262 @@
+"""Sparse NDArray storage types: row_sparse and CSR.
+
+Reference: python/mxnet/ndarray/sparse.py + src/ndarray storage types
+(include/mxnet/ndarray.h:63-65) and the sparse op corpus
+(src/operator/tensor dot/cast_storage/retain).
+
+TPU-native stance (SURVEY.md §7 hard part #5): XLA has no first-class
+sparse, so these types hold index/value arrays on device and compute
+through dense-friendly primitives — gather/scatter/segment-sum — which
+XLA maps onto the MXU/VPU well at embedding-table sparsity. The supported
+surface is the one that matters in practice (sparse embedding gradients,
+csr feature matrices): construction, dense round-trip, retain, sparse
+dot, elementwise add, save/load. Everything else raises, loudly, instead
+of silently densifying.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "retain",
+           "dot", "add"]
+
+
+class BaseSparseNDArray:
+    stype = "undefined"
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def asnumpy(self):
+        return _onp.asarray(self.todense()._data)
+
+    def copy(self):
+        raise NotImplementedError
+
+    def todense(self) -> NDArray:
+        raise NotImplementedError
+
+    def tostype(self, stype: str):
+        if stype == self.stype:
+            return self.copy()
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self.todense(), stype)
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self.shape} "
+                f"nnz-storage={self.data.shape}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(data (K, ...), indices (K,)) — K stored rows of a (N, ...) array
+    (ref sparse.py RowSparseNDArray). Indices are sorted unique row ids."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data: NDArray, indices: NDArray,
+                 shape: Tuple[int, ...]):
+        self.data = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
+        self.indices = indices if isinstance(indices, NDArray) \
+            else NDArray(jnp.asarray(indices, jnp.int32))
+        self._shape = tuple(shape)
+        if self.data._data.shape[0] != self.indices._data.shape[0]:
+            raise MXNetError("row_sparse data/indices row count mismatch")
+        if self.data._data.shape[1:] != self._shape[1:]:
+            raise MXNetError("row_sparse data trailing dims != shape")
+
+    def copy(self):
+        return RowSparseNDArray(NDArray(self.data._data),
+                                NDArray(self.indices._data), self._shape)
+
+    def todense(self) -> NDArray:
+        dense = jnp.zeros(self._shape, self.data._data.dtype)
+        dense = dense.at[self.indices._data.astype(jnp.int32)].set(
+            self.data._data)
+        return NDArray(dense)
+
+    def retain(self, rows) -> "RowSparseNDArray":
+        return retain(self, rows)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row 2-D matrix (ref sparse.py CSRNDArray)."""
+
+    stype = "csr"
+
+    def __init__(self, data: NDArray, indices: NDArray, indptr: NDArray,
+                 shape: Tuple[int, int]):
+        self.data = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
+        self.indices = indices if isinstance(indices, NDArray) \
+            else NDArray(jnp.asarray(indices, jnp.int32))
+        self.indptr = indptr if isinstance(indptr, NDArray) \
+            else NDArray(jnp.asarray(indptr, jnp.int32))
+        if len(shape) != 2:
+            raise MXNetError("csr is 2-D only")
+        self._shape = tuple(shape)
+
+    def copy(self):
+        return CSRNDArray(NDArray(self.data._data),
+                          NDArray(self.indices._data),
+                          NDArray(self.indptr._data), self._shape)
+
+    def _row_ids(self):
+        """Expand indptr to one row id per nnz (static nnz)."""
+        nnz = self.data._data.shape[0]
+        ptr = self.indptr._data
+        return (jnp.searchsorted(ptr, jnp.arange(nnz), side="right") - 1
+                ).astype(jnp.int32)
+
+    def todense(self) -> NDArray:
+        dense = jnp.zeros(self._shape, self.data._data.dtype)
+        rows = self._row_ids()
+        cols = self.indices._data.astype(jnp.int32)
+        dense = dense.at[rows, cols].add(self.data._data)
+        return NDArray(dense)
+
+
+# -------------------------------------------------------------- factories
+def row_sparse_array(arg, shape: Optional[Tuple[int, ...]] = None,
+                     dtype=jnp.float32) -> RowSparseNDArray:
+    """From (data, indices) or a dense array (ref sparse.py
+    row_sparse_array)."""
+    if isinstance(arg, tuple) and len(arg) == 2:
+        data, indices = arg
+        data = jnp.asarray(data._data if isinstance(data, NDArray) else data,
+                           dtype)
+        indices = jnp.asarray(
+            indices._data if isinstance(indices, NDArray) else indices,
+            jnp.int32)
+        if shape is None:
+            n = int(indices.max()) + 1 if indices.size else 0
+            shape = (n,) + data.shape[1:]
+        return RowSparseNDArray(NDArray(data), NDArray(indices), shape)
+    dense = jnp.asarray(arg._data if isinstance(arg, NDArray) else arg, dtype)
+    return _dense_to_row_sparse(dense)
+
+
+def _dense_to_row_sparse(dense: jnp.ndarray) -> RowSparseNDArray:
+    flat = dense.reshape(dense.shape[0], -1)
+    nz = _onp.nonzero(_onp.asarray((jnp.abs(flat) > 0).any(axis=1)))[0]
+    idx = jnp.asarray(nz, jnp.int32)
+    return RowSparseNDArray(NDArray(dense[idx]), NDArray(idx), dense.shape)
+
+
+def csr_matrix(arg, shape: Optional[Tuple[int, int]] = None,
+               dtype=jnp.float32) -> CSRNDArray:
+    """From (data, indices, indptr) or a dense 2-D array (ref sparse.py
+    csr_matrix)."""
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        indptr = jnp.asarray(
+            indptr._data if isinstance(indptr, NDArray) else indptr,
+            jnp.int32)
+        if shape is None:
+            raise MXNetError("csr_matrix from triple needs explicit shape")
+        return CSRNDArray(
+            NDArray(jnp.asarray(
+                data._data if isinstance(data, NDArray) else data, dtype)),
+            NDArray(jnp.asarray(
+                indices._data if isinstance(indices, NDArray) else indices,
+                jnp.int32)),
+            NDArray(indptr), shape)
+    dense = _onp.asarray(arg._data if isinstance(arg, NDArray) else arg,
+                         dtype)
+    if dense.ndim != 2:
+        raise MXNetError("csr is 2-D only")
+    rows, cols = _onp.nonzero(dense)
+    data = dense[rows, cols]
+    indptr = _onp.zeros(dense.shape[0] + 1, _onp.int32)
+    _onp.add.at(indptr, rows + 1, 1)
+    indptr = _onp.cumsum(indptr).astype(_onp.int32)
+    return CSRNDArray(NDArray(jnp.asarray(data)),
+                      NDArray(jnp.asarray(cols, jnp.int32)),
+                      NDArray(jnp.asarray(indptr)), dense.shape)
+
+
+# -------------------------------------------------------------------- ops
+def cast_storage(arr, stype: str):
+    """dense <-> sparse conversion (ref src/operator/tensor/cast_storage.cc)."""
+    if isinstance(arr, BaseSparseNDArray):
+        if stype == "default":
+            return arr.todense()
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    if stype == "row_sparse":
+        return _dense_to_row_sparse(arr._data)
+    if stype == "csr":
+        return csr_matrix(arr)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def retain(rsp: RowSparseNDArray, rows) -> RowSparseNDArray:
+    """Keep only the listed rows (ref _retain sparse op)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    want = jnp.asarray(rows._data if isinstance(rows, NDArray) else rows,
+                       jnp.int32)
+    stored = rsp.indices._data
+    # positions of wanted rows in the stored list; missing -> zero row
+    pos = jnp.searchsorted(stored, want)
+    pos = jnp.clip(pos, 0, max(stored.shape[0] - 1, 0))
+    hit = stored[pos] == want if stored.shape[0] else jnp.zeros(
+        want.shape, bool)
+    vals = rsp.data._data[pos]
+    vals = jnp.where(hit.reshape((-1,) + (1,) * (vals.ndim - 1)), vals, 0)
+    return RowSparseNDArray(NDArray(vals), NDArray(want), rsp.shape)
+
+
+def dot(lhs, rhs, transpose_a: bool = False) -> NDArray:
+    """Sparse dot (ref src/operator/tensor/dot.cc sparse kernels):
+    csr x dense, csr^T x dense, row_sparse^T-free forms."""
+    if isinstance(lhs, CSRNDArray):
+        dense = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        rows = lhs._row_ids()
+        cols = lhs.indices._data.astype(jnp.int32)
+        vals = lhs.data._data
+        if transpose_a:
+            # csr^T x dense: scatter-add each nnz into its column's row
+            out = jnp.zeros((lhs.shape[1], dense.shape[1]), vals.dtype)
+            out = out.at[cols].add(vals[:, None] * dense[rows])
+            return NDArray(out)
+        out = jnp.zeros((lhs.shape[0], dense.shape[1]), vals.dtype)
+        out = out.at[rows].add(vals[:, None] * dense[cols])
+        return NDArray(out)
+    if isinstance(lhs, RowSparseNDArray):
+        dense = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        if transpose_a:
+            # (N, D)^T x (N, M) with only K stored rows -> (D, M)
+            sel = dense[lhs.indices._data.astype(jnp.int32)]
+            return NDArray(jnp.einsum("kd,km->dm", lhs.data._data, sel))
+        return NDArray(lhs.todense()._data @ dense)
+    raise MXNetError("dot: unsupported sparse operand combination")
+
+
+def add(a, b):
+    """Elementwise add over matching or mixed storage."""
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        if a.shape != b.shape:
+            raise MXNetError("shape mismatch")
+        # dense merge over the union of stored rows, re-sparsified
+        dense = a.todense()._data + b.todense()._data
+        idx = _onp.union1d(_onp.asarray(a.indices._data),
+                           _onp.asarray(b.indices._data))
+        idx = jnp.asarray(idx, jnp.int32)
+        return RowSparseNDArray(NDArray(dense[idx]), NDArray(idx), a.shape)
+    da = a.todense() if isinstance(a, BaseSparseNDArray) else a
+    db = b.todense() if isinstance(b, BaseSparseNDArray) else b
+    return NDArray(da._data + db._data)

@@ -44,20 +44,59 @@ def save(fname: str, data: Union[NDArray, List[NDArray], Dict[str, NDArray]]):
         _onp.savez(f, **payload)
 
 
-def _put(payload, key, a):
-    if not isinstance(a, NDArray):
-        raise MXNetError(f"save expects NDArray values, got {type(a)}")
-    raw = a._data
+def _put_raw(payload, key, raw):
+    """One jnp payload under ``key`` (bfloat16 stored as tagged uint16)."""
     if raw.dtype == jnp.bfloat16:
         payload[key + _BF16_SUFFIX] = _onp.asarray(raw.view(jnp.uint16))
     else:
         payload[key] = _onp.asarray(raw)
 
 
-def _get(z, key):
-    if key.endswith(_BF16_SUFFIX):
-        return NDArray(jnp.asarray(z[key]).view(jnp.bfloat16))
-    return NDArray(jnp.asarray(z[key]))
+def _put(payload, key, a):
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    if "::" in key:
+        raise MXNetError(f"'::' is reserved in save keys: {key!r}")
+    if isinstance(a, RowSparseNDArray):
+        _put_raw(payload, key + "::rsp::data", a.data._data)
+        payload[key + "::rsp::indices"] = _onp.asarray(a.indices._data)
+        payload[key + "::rsp::shape"] = _onp.asarray(a.shape, _onp.int64)
+        return
+    if isinstance(a, CSRNDArray):
+        _put_raw(payload, key + "::csr::data", a.data._data)
+        payload[key + "::csr::indices"] = _onp.asarray(a.indices._data)
+        payload[key + "::csr::indptr"] = _onp.asarray(a.indptr._data)
+        payload[key + "::csr::shape"] = _onp.asarray(a.shape, _onp.int64)
+        return
+    if not isinstance(a, NDArray):
+        raise MXNetError(f"save expects NDArray values, got {type(a)}")
+    _put_raw(payload, key, a._data)
+
+
+def _assemble(z, base, keys):
+    """Rebuild one logical entry from its npz keys."""
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    by_suffix = {k[len(base):]: k for k in keys}
+
+    def raw(suffix):
+        if suffix + _BF16_SUFFIX in by_suffix:
+            return jnp.asarray(
+                z[by_suffix[suffix + _BF16_SUFFIX]]).view(jnp.bfloat16)
+        return jnp.asarray(z[by_suffix[suffix]])
+
+    if any(s.startswith("::rsp::data") for s in by_suffix):
+        return RowSparseNDArray(
+            NDArray(raw("::rsp::data")),
+            NDArray(jnp.asarray(z[by_suffix["::rsp::indices"]])),
+            tuple(int(x) for x in z[by_suffix["::rsp::shape"]]))
+    if any(s.startswith("::csr::data") for s in by_suffix):
+        return CSRNDArray(
+            NDArray(raw("::csr::data")),
+            NDArray(jnp.asarray(z[by_suffix["::csr::indices"]])),
+            NDArray(jnp.asarray(z[by_suffix["::csr::indptr"]])),
+            tuple(int(x) for x in z[by_suffix["::csr::shape"]]))
+    return NDArray(raw(""))
 
 
 def load(fname: str):
@@ -66,19 +105,14 @@ def load(fname: str):
     if _MAGIC_KEY not in z:
         raise MXNetError(f"{fname} is not an mxnet_tpu NDArray file")
     kind = str(z[_MAGIC_KEY])
-    if kind == "list":
-        items = []
-        for key in z.files:
-            if key == _MAGIC_KEY:
-                continue
-            base = key.split("::")[0]
-            idx = int(base.split(":", 1)[1])
-            items.append((idx, _get(z, key)))
-        return [a for _, a in sorted(items, key=lambda t: t[0])]
-    out = {}
+    groups: dict = {}
     for key in z.files:
         if key == _MAGIC_KEY:
             continue
-        base = key.split("::")[0]
-        out[base.split(":", 1)[1]] = _get(z, key)
-    return out
+        groups.setdefault(key.split("::")[0], []).append(key)
+    if kind == "list":
+        items = [(int(base.split(":", 1)[1]), _assemble(z, base, keys))
+                 for base, keys in groups.items()]
+        return [a for _, a in sorted(items, key=lambda t: t[0])]
+    return {base.split(":", 1)[1]: _assemble(z, base, keys)
+            for base, keys in groups.items()}

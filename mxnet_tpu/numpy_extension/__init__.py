@@ -37,6 +37,7 @@ __all__ = [
     "smooth_l1", "all_finite", "multi_sum_sq", "clip_by_global_norm",
     "multi_head_attention", "flash_attention",
     "foreach", "while_loop", "cond",
+    "box_iou", "box_nms", "roi_align",
     "waitall", "load", "save", "set_np", "reset_np", "is_np_array",
     "cpu", "gpu", "tpu", "num_gpus", "num_tpus", "current_context",
 ]
@@ -462,3 +463,36 @@ def multi_head_attention(query, key, value, num_heads, mask=None,
 
 # -- control flow ------------------------------------------------------------
 from ..ops.control_flow import foreach, while_loop, cond  # noqa: E402
+
+
+# -- bounding boxes / detection (ref src/operator/contrib/bounding_box.cc,
+# multibox_*.cc, roi_align.cc) ----------------------------------------------
+def box_iou(lhs, rhs, format="corner", out=None):
+    from ..ops import boxes as _bx
+
+    return call(lambda a, b: _bx.box_iou(a, b, fmt=format), (lhs, rhs), {},
+                name="box_iou", out=out)
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1,
+            force_suppress=False, out=None):
+    from ..ops import boxes as _bx
+
+    return call(lambda d: _bx.box_nms(
+        d, overlap_thresh=overlap_thresh, valid_thresh=valid_thresh,
+        topk=topk, coord_start=coord_start, score_index=score_index,
+        id_index=id_index, force_suppress=force_suppress), (data,), {},
+        name="box_nms", out=out)
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=2,
+              out=None):
+    from ..ops import boxes as _bx
+
+    ps = pooled_size if isinstance(pooled_size, (tuple, list)) \
+        else (pooled_size, pooled_size)
+    return call(lambda d, r: _bx.roi_align(
+        d, r, tuple(ps), spatial_scale=spatial_scale,
+        sample_ratio=sample_ratio), (data, rois), {}, name="roi_align",
+        out=out)

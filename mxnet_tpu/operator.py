@@ -1,0 +1,96 @@
+"""mx.operator — Python custom ops with autograd.
+
+Reference: mx.operator.CustomOp/CustomOpProp + src/operator/custom/
+custom.cc (a Python-callback op running on a dedicated worker thread).
+TPU-native: the user's forward/backward are numpy-level callables run on
+the host via the tape (eager) — XLA handles everything jit-traceable;
+CustomOp exists for genuinely foreign host code.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["CustomOp", "register", "get", "create"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+class CustomOp:
+    """Subclass and implement forward(...) and optionally backward(...).
+
+    forward(*arrays) -> array or tuple (numpy in, numpy out)
+    backward(out_grads, inputs, outputs) -> tuple of input grads
+    """
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, out_grads, inputs, outputs):
+        raise MXNetError(
+            f"{type(self).__name__} does not implement backward")
+
+
+def register(name: str):
+    """Decorator: register a CustomOp subclass under ``name``
+    (ref mx.operator.register)."""
+    def dec(klass):
+        if not issubclass(klass, CustomOp):
+            raise MXNetError("register expects a CustomOp subclass")
+        _REGISTRY[name] = klass
+        return klass
+    return dec
+
+
+def get(name: str) -> type:
+    if name not in _REGISTRY:
+        raise MXNetError(f"no custom op '{name}'; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def create(name: str, **kwargs) -> Callable:
+    """Build an NDArray-level callable for a registered custom op, with
+    tape autograd wired to the op's backward()."""
+    op = get(name)(**kwargs)
+
+    def call_op(*inputs):
+        from . import autograd
+
+        nd_in = [x if isinstance(x, NDArray) else NDArray(jnp.asarray(x))
+                 for x in inputs]
+        np_in = [_onp.asarray(x._data) for x in nd_in]
+        res = op.forward(*np_in)
+        single = not isinstance(res, (tuple, list))
+        outs_np = [res] if single else list(res)
+        outs = [NDArray(jnp.asarray(o)) for o in outs_np]
+
+        if autograd.is_recording():
+            def vjp_fn(cotangents):
+                cts = [cotangents] if single else list(cotangents)
+                cts_np = [_onp.asarray(c) for c in cts]
+                grads = op.backward(cts_np if not single else cts_np[0],
+                                    np_in, outs_np)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                if len(grads) != len(nd_in):
+                    raise MXNetError(
+                        f"custom op '{name}' backward returned "
+                        f"{len(grads)} grads for {len(nd_in)} inputs")
+                return tuple(jnp.asarray(g) for g in grads)
+
+            node = autograd.Node(
+                vjp_fn, nd_in, len(outs), f"custom_{name}",
+                [o.shape for o in outs], [o.dtype for o in outs],
+                tuple_out=not single, fn=None)
+            for i, o in enumerate(outs):
+                o._autograd_entry = (node, i)
+        return outs[0] if single else tuple(outs)
+
+    call_op.__name__ = name
+    return call_op

@@ -1,0 +1,270 @@
+"""Bounding-box / detection ops (pure jnp kernels).
+
+Reference: src/operator/contrib/bounding_box.cc (box_iou, box_nms),
+src/operator/contrib/roi_align.cc, src/operator/contrib/multibox_*.cc
+(SSD prior/target/detection). TPU-native: everything is static-shape —
+NMS is a greedy O(N^2) suppression under lax.fori_loop (no dynamic
+compaction; suppressed entries are marked -1 like the reference's
+out-of-range convention), ROI align is bilinear gather, anchors are
+closed-form meshgrids.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["box_iou", "box_nms", "roi_align", "multibox_prior",
+           "multibox_target", "multibox_detection", "bbox_clip",
+           "box_encode", "box_decode"]
+
+
+def _corner_area(boxes):
+    w = jnp.maximum(boxes[..., 2] - boxes[..., 0], 0)
+    h = jnp.maximum(boxes[..., 3] - boxes[..., 1], 0)
+    return w * h
+
+
+def _to_corner(boxes, fmt):
+    if fmt == "corner":
+        return boxes
+    # center: (cx, cy, w, h)
+    cx, cy, w, h = (boxes[..., i] for i in range(4))
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+
+def box_iou(lhs, rhs, fmt: str = "corner"):
+    """Pairwise IoU: (..., N, 4) x (..., M, 4) -> (..., N, M)
+    (ref bounding_box.cc _contrib_box_iou)."""
+    a = _to_corner(lhs, fmt)[..., :, None, :]
+    b = _to_corner(rhs, fmt)[..., None, :, :]
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:], b[..., 2:])
+    inter = jnp.prod(jnp.maximum(br - tl, 0), -1)
+    union = (_corner_area(a) + _corner_area(b) - inter)
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def box_nms(data, overlap_thresh: float = 0.5, valid_thresh: float = 0.0,
+            topk: int = -1, coord_start: int = 2, score_index: int = 1,
+            id_index: int = -1, force_suppress: bool = False):
+    """Greedy NMS (ref bounding_box.cc _contrib_box_nms).
+
+    data: (B, N, K) rows [.. id .. score .. x1 y1 x2 y2 ..]; returns the
+    same shape, sorted by score, suppressed/invalid rows filled with -1.
+    """
+    if data.ndim == 2:
+        return box_nms(data[None], overlap_thresh, valid_thresh, topk,
+                       coord_start, score_index, id_index,
+                       force_suppress)[0]
+    b, n, k = data.shape
+    scores = data[..., score_index]
+    order = jnp.argsort(-scores, axis=1)
+    sorted_rows = jnp.take_along_axis(data, order[..., None], axis=1)
+    boxes = lax.dynamic_slice_in_dim(sorted_rows, coord_start, 4, axis=2)
+    scores = sorted_rows[..., score_index]
+    valid = scores > valid_thresh
+    if topk > 0:
+        valid = jnp.logical_and(valid, jnp.arange(n)[None, :] < topk)
+    iou = box_iou(boxes, boxes)                      # (B, N, N)
+    if id_index >= 0 and not force_suppress:
+        ids = sorted_rows[..., id_index]
+        same = ids[:, :, None] == ids[:, None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    def body(i, keep):
+        active = jnp.logical_and(keep[:, i], valid[:, i])   # (B,)
+        sup = jnp.logical_and(iou[:, i] > overlap_thresh,
+                              jnp.arange(n)[None, :] > i)
+        new_keep = jnp.where(jnp.logical_and(active[:, None], sup),
+                             False, keep)
+        return new_keep
+
+    keep = lax.fori_loop(0, n, body, jnp.ones((b, n), bool))
+    keep = jnp.logical_and(keep, valid)
+    # compact kept rows to the front (score order), -1 fill after — the
+    # reference's output convention (bounding_box.cc)
+    rank = jnp.argsort(jnp.where(keep, 0, 1) * n + jnp.arange(n)[None, :],
+                       axis=1)
+    out = jnp.take_along_axis(sorted_rows, rank[..., None], axis=1)
+    keep_c = jnp.take_along_axis(keep, rank, axis=1)
+    return jnp.where(keep_c[..., None], out, -jnp.ones_like(out))
+
+
+def bbox_clip(boxes, height, width):
+    x1 = jnp.clip(boxes[..., 0], 0, width)
+    y1 = jnp.clip(boxes[..., 1], 0, height)
+    x2 = jnp.clip(boxes[..., 2], 0, width)
+    y2 = jnp.clip(boxes[..., 3], 0, height)
+    return jnp.stack([x1, y1, x2, y2], -1)
+
+
+def roi_align(data, rois, pooled_size: Tuple[int, int],
+              spatial_scale: float = 1.0, sample_ratio: int = 2):
+    """ROI Align (ref roi_align.cc): bilinear-sampled average pooling.
+
+    data: (B, C, H, W); rois: (R, 5) rows [batch_idx, x1, y1, x2, y2]
+    in image coords. Returns (R, C, PH, PW)."""
+    ph, pw = pooled_size
+    sr = max(1, sample_ratio)
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w, bin_h = rw / pw, rh / ph
+        # sample grid: (ph*sr, pw*sr) bilinear points
+        ys = y1 + (jnp.arange(ph * sr) + 0.5) * (bin_h / sr)
+        xs = x1 + (jnp.arange(pw * sr) + 0.5) * (bin_w / sr)
+        img = data[bi]                                 # (C, H, W)
+        c, h, w = img.shape
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+        wy1 = jnp.clip(ys - y0, 0, 1)
+        wx1 = jnp.clip(xs - x0, 0, 1)
+        # gather 4 corners: (C, ph*sr, pw*sr)
+        v00 = img[:, y0i][:, :, x0i]
+        v01 = img[:, y0i][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0i]
+        v11 = img[:, y1i][:, :, x1i]
+        top = v00 * (1 - wx1)[None, None, :] + v01 * wx1[None, None, :]
+        bot = v10 * (1 - wx1)[None, None, :] + v11 * wx1[None, None, :]
+        vals = top * (1 - wy1)[None, :, None] + bot * wy1[None, :, None]
+        # average each sr x sr sample block -> (C, ph, pw)
+        vals = vals.reshape(c, ph, sr, pw, sr)
+        return vals.mean((2, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+def multibox_prior(feat_shape: Tuple[int, int],
+                   sizes: Sequence[float] = (1.0,),
+                   ratios: Sequence[float] = (1.0,),
+                   steps: Tuple[float, float] = (-1.0, -1.0),
+                   offsets: Tuple[float, float] = (0.5, 0.5)):
+    """Anchor boxes for one feature map (ref multibox_prior.cc).
+
+    Returns (H*W*A, 4) corner boxes in [0, 1]; A = len(sizes) +
+    len(ratios) - 1 (first size pairs with every ratio, remaining sizes
+    with ratio 1 — the reference's convention)."""
+    h, w = feat_shape
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1)  # (H, W, 2)
+
+    whs = []
+    for r in ratios:
+        sr = math.sqrt(r)
+        whs.append((sizes[0] * sr, sizes[0] / sr))
+    for s in sizes[1:]:
+        whs.append((s, s))
+    wh = jnp.asarray(whs, jnp.float32)                 # (A, 2) (w, h)
+
+    cyx = jnp.broadcast_to(cyx[:, :, None, :], (h, w, wh.shape[0], 2))
+    half_w = wh[None, None, :, 0] / 2
+    half_h = wh[None, None, :, 1] / 2
+    out = jnp.stack([cyx[..., 1] - half_w, cyx[..., 0] - half_h,
+                     cyx[..., 1] + half_w, cyx[..., 0] + half_h], -1)
+    return out.reshape(-1, 4)
+
+
+_VARIANCES = (0.1, 0.1, 0.2, 0.2)
+
+
+def box_encode(anchors, gt, variances=_VARIANCES):
+    """Corner gt vs corner anchors -> (dx, dy, dw, dh) regression targets."""
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = (anchors[..., 0] + anchors[..., 2]) / 2
+    ay = (anchors[..., 1] + anchors[..., 3]) / 2
+    gw = jnp.maximum(gt[..., 2] - gt[..., 0], 1e-8)
+    gh = jnp.maximum(gt[..., 3] - gt[..., 1], 1e-8)
+    gx = (gt[..., 0] + gt[..., 2]) / 2
+    gy = (gt[..., 1] + gt[..., 3]) / 2
+    return jnp.stack([(gx - ax) / aw / variances[0],
+                      (gy - ay) / ah / variances[1],
+                      jnp.log(gw / aw) / variances[2],
+                      jnp.log(gh / ah) / variances[3]], -1)
+
+
+def box_decode(anchors, deltas, variances=_VARIANCES):
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = (anchors[..., 0] + anchors[..., 2]) / 2
+    ay = (anchors[..., 1] + anchors[..., 3]) / 2
+    cx = deltas[..., 0] * variances[0] * aw + ax
+    cy = deltas[..., 1] * variances[1] * ah + ay
+    w = jnp.exp(jnp.clip(deltas[..., 2] * variances[2], -10, 10)) * aw
+    h = jnp.exp(jnp.clip(deltas[..., 3] * variances[3], -10, 10)) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+
+def multibox_target(anchors, labels, iou_thresh: float = 0.5,
+                    variances=_VARIANCES):
+    """Training targets (ref multibox_target.cc).
+
+    anchors: (A, 4) corners; labels: (B, M, 5) rows [cls, x1, y1, x2, y2],
+    cls = -1 padding. Returns (box_target (B, A*4), box_mask (B, A*4),
+    cls_target (B, A)) with cls_target in {0 = background, gt_cls + 1}."""
+    def one(lab):
+        gt_valid = lab[:, 0] >= 0                     # (M,)
+        gt_boxes = lab[:, 1:5]
+        iou = box_iou(anchors, gt_boxes)              # (A, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, 1)                  # (A,)
+        best_iou = jnp.max(iou, 1)
+        pos = best_iou >= iou_thresh
+        # force-match: each VALID gt's best anchor is positive for that gt;
+        # padding rows scatter out of range (mode='drop') so they can't
+        # clobber anchor 0's assignment
+        best_anchor = jnp.argmax(iou, 0)              # (M,)
+        safe_anchor = jnp.where(gt_valid, best_anchor,
+                                anchors.shape[0]).astype(jnp.int32)
+        forced_gt = jnp.full((anchors.shape[0],), -1, jnp.int32)
+        forced_gt = forced_gt.at[safe_anchor].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32), mode="drop")
+        matched_gt = jnp.where(forced_gt >= 0, forced_gt,
+                               best_gt.astype(jnp.int32))
+        pos = jnp.logical_or(pos, forced_gt >= 0)
+        tgt_boxes = gt_boxes[matched_gt]
+        tgt_cls = lab[:, 0][matched_gt]
+        box_t = box_encode(anchors, tgt_boxes, variances)
+        box_t = jnp.where(pos[:, None], box_t, 0.0)
+        mask = jnp.where(pos[:, None],
+                         jnp.ones_like(box_t), jnp.zeros_like(box_t))
+        cls_t = jnp.where(pos, tgt_cls + 1, 0.0)
+        return box_t.reshape(-1), mask.reshape(-1), cls_t
+
+    bt, bm, ct = jax.vmap(one)(labels)
+    return bt, bm, ct
+
+
+def multibox_detection(cls_prob, loc_pred, anchors,
+                       threshold: float = 0.01, nms_threshold: float = 0.45,
+                       nms_topk: int = 400, variances=_VARIANCES):
+    """Decode + per-class NMS (ref multibox_detection.cc).
+
+    cls_prob: (B, C+1, A) softmax class probabilities (class 0 =
+    background); loc_pred: (B, A*4); anchors: (A, 4).
+    Returns (B, A, 6) rows [cls_id, score, x1, y1, x2, y2], invalid -1."""
+    b, num_cls_p1, a = cls_prob.shape
+    deltas = loc_pred.reshape(b, a, 4)
+    boxes = box_decode(anchors[None], deltas, variances)   # (B, A, 4)
+    scores = cls_prob[:, 1:, :]                            # (B, C, A)
+    cls_id = jnp.argmax(scores, 1).astype(jnp.float32)     # (B, A)
+    score = jnp.max(scores, 1)
+    rows = jnp.concatenate([cls_id[..., None], score[..., None], boxes], -1)
+    rows = jnp.where(score[..., None] > threshold, rows, -1.0)
+    return box_nms(rows, overlap_thresh=nms_threshold,
+                   valid_thresh=threshold, topk=nms_topk,
+                   coord_start=2, score_index=1, id_index=0)

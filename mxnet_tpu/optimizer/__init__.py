@@ -194,11 +194,27 @@ class SGD(Optimizer):
         return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        mom = state._data if state is not None else jnp.zeros((), weight._data.dtype)
         clip = self.clip_gradient if self.clip_gradient is not None else -1.0
         nesterov = isinstance(self, NAG)
+        if isinstance(grad, RowSparseNDArray):
+            # lazy row-wise update: only stored rows touched (ref
+            # sgd_update row_sparse kernel, optimizer_op.cc)
+            rows = grad.indices._data.astype(jnp.int32)
+            mom = state._data[rows] if state is not None \
+                else jnp.zeros((), weight._data.dtype)
+            w_r, m_r = _sgd_kernel(
+                weight._data[rows], grad.data._data, mom, lr, wd,
+                self.rescale_grad, clip, self.momentum,
+                nesterov=nesterov, has_mom=state is not None)
+            weight._set_data(weight._data.at[rows].set(w_r))
+            if state is not None:
+                state._set_data(state._data.at[rows].set(m_r))
+            return
+        mom = state._data if state is not None else jnp.zeros((), weight._data.dtype)
         w, m = _sgd_kernel(weight._data, grad._data, mom, lr, wd,
                            self.rescale_grad, clip, self.momentum,
                            nesterov=nesterov, has_mom=state is not None)
@@ -256,11 +272,25 @@ class Adam(Optimizer):
         return (NDArray(z), NDArray(z))
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         self._update_count(index)
         t = self._index_update_count[index]
         lr, wd = self._get_lr(index), self._get_wd(index)
         m, v = state
         clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        if isinstance(grad, RowSparseNDArray):
+            # lazy adam (ref adam_update row_sparse kernel): moments and
+            # weight advance only on the stored rows
+            rows = grad.indices._data.astype(jnp.int32)
+            w_r, mm_r, vv_r = _adam_kernel(
+                weight._data[rows], grad.data._data, m._data[rows],
+                v._data[rows], lr, wd, self.rescale_grad, clip,
+                self.beta1, self.beta2, self.epsilon, t)
+            weight._set_data(weight._data.at[rows].set(w_r))
+            m._set_data(m._data.at[rows].set(mm_r))
+            v._set_data(v._data.at[rows].set(vv_r))
+            return
         w, mm, vv = _adam_kernel(weight._data, grad._data, m._data, v._data,
                                  lr, wd, self.rescale_grad, clip,
                                  self.beta1, self.beta2, self.epsilon, t)

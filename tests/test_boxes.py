@@ -1,0 +1,182 @@
+"""Detection op + SSD tests (ref: tests/python/unittest/test_contrib_operator.py
+box_nms/box_iou tests + example/ssd)."""
+import numpy as onp
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import numpy_extension as npx
+from mxnet_tpu.ops import boxes as bx
+
+
+def test_box_iou():
+    a = jnp.array([[0.0, 0, 2, 2]])
+    b = jnp.array([[1.0, 1, 3, 3], [0, 0, 2, 2], [5, 5, 6, 6]])
+    iou = bx.box_iou(a, b)
+    assert onp.allclose(iou, [[1 / 7, 1.0, 0.0]], atol=1e-6)
+
+
+def test_box_iou_center_format():
+    # center (1,1) w=h=2 -> corners (0,0,2,2); shifted by (1,1) -> IoU 1/7
+    a = jnp.array([[1.0, 1, 2, 2]])
+    b = jnp.array([[2.0, 2, 2, 2]])
+    iou = bx.box_iou(a, b, fmt="center")
+    assert abs(float(iou[0, 0]) - 1 / 7) < 1e-6
+    # identical center boxes -> IoU 1
+    assert abs(float(bx.box_iou(a, a, fmt="center")[0, 0]) - 1.0) < 1e-6
+
+
+def test_box_nms_suppression():
+    # rows: [cls, score, x1, y1, x2, y2]
+    rows = jnp.array([[
+        [0, 0.9, 0, 0, 10, 10],
+        [0, 0.8, 1, 1, 10.5, 10.5],   # heavy overlap with first -> out
+        [0, 0.7, 20, 20, 30, 30],
+        [1, 0.6, 0, 0, 10, 10],       # different class -> kept
+        [0, 0.0, 0, 0, 1, 1],         # below valid_thresh
+    ]])
+    out = bx.box_nms(rows, overlap_thresh=0.5, valid_thresh=0.05,
+                     id_index=0)
+    kept = out[0, :, 1]
+    assert onp.allclose(kept, [0.9, 0.7, 0.6, -1, -1], atol=1e-6)
+    # force_suppress ignores class ids
+    out2 = bx.box_nms(rows, overlap_thresh=0.5, valid_thresh=0.05,
+                      id_index=0, force_suppress=True)
+    assert onp.allclose(out2[0, :, 1], [0.9, 0.7, -1, -1, -1], atol=1e-6)
+
+
+def test_npx_box_ops():
+    rows = mx.np.array(onp.array([[[0, 0.9, 0, 0, 2, 2],
+                                   [0, 0.8, 0, 0, 2, 2]]], 'float32'))
+    out = npx.box_nms(rows, overlap_thresh=0.5, id_index=0)
+    assert float(out.asnumpy()[0, 1, 1]) == -1.0
+    a = mx.np.array(onp.array([[0, 0, 1, 1]], 'float32'))
+    iou = npx.box_iou(a, a)
+    assert float(iou.asnumpy()[0, 0]) == 1.0
+
+
+def test_roi_align_shapes_and_identity():
+    data = jnp.arange(2 * 3 * 8 * 8, dtype=jnp.float32).reshape(2, 3, 8, 8)
+    rois = jnp.array([[0, 0, 0, 7, 7], [1, 2, 2, 6, 6]], jnp.float32)
+    out = bx.roi_align(data, rois, (4, 4), spatial_scale=1.0)
+    assert out.shape == (2, 3, 4, 4)
+    # a full-image roi average-pools to roughly the image mean
+    assert abs(float(out[0].mean()) - float(data[0].mean())) < 2.0
+
+
+def test_multibox_prior():
+    anchors = bx.multibox_prior((2, 3), sizes=(0.5, 0.25), ratios=(1, 2))
+    # A = len(sizes)+len(ratios)-1 = 3 per cell
+    assert anchors.shape == (2 * 3 * 3, 4)
+    # first anchor of first cell: size 0.5 ratio 1 centered at (1/6, 1/4)
+    cx, cy = 1 / 6, 1 / 4
+    assert onp.allclose(anchors[0], [cx - 0.25, cy - 0.25,
+                                     cx + 0.25, cy + 0.25], atol=1e-6)
+
+
+def test_box_encode_decode_roundtrip():
+    rs = onp.random.RandomState(0)
+    anchors = jnp.asarray(rs.rand(10, 2), jnp.float32)
+    anchors = jnp.concatenate([anchors, anchors + 0.3], -1)
+    gt = jnp.asarray(rs.rand(10, 2), jnp.float32)
+    gt = jnp.concatenate([gt, gt + 0.4], -1)
+    deltas = bx.box_encode(anchors, gt)
+    back = bx.box_decode(anchors, deltas)
+    assert onp.allclose(back, gt, atol=1e-5)
+
+
+def test_multibox_target():
+    anchors = jnp.array([[0.0, 0, 0.4, 0.4], [0.5, 0.5, 1, 1],
+                         [0.0, 0.6, 0.4, 1.0]])
+    # one gt box matching anchor 1 closely; class 2
+    labels = jnp.array([[[2.0, 0.52, 0.52, 0.98, 0.98],
+                         [-1, 0, 0, 0, 0]]])
+    bt, bm, ct = bx.multibox_target(anchors, labels)
+    assert ct.shape == (1, 3)
+    assert float(ct[0, 1]) == 3.0        # class 2 -> target 3
+    assert float(ct[0, 0]) == 0.0        # background
+    assert bm.reshape(1, 3, 4)[0, 1].sum() == 4.0
+    assert bm.reshape(1, 3, 4)[0, 0].sum() == 0.0
+
+
+def test_multibox_detection_roundtrip():
+    anchors = jnp.array([[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]])
+    # loc_pred zero -> boxes == anchors; cls 1 confident on anchor 0
+    cls_prob = jnp.array([[[0.05, 0.9], [0.9, 0.05], [0.05, 0.05]]])
+    loc = jnp.zeros((1, 8))
+    out = bx.multibox_detection(cls_prob, loc, anchors)
+    row = out[0, 0]
+    assert float(row[0]) == 0.0          # class id 0 (first non-bg)
+    assert abs(float(row[1]) - 0.9) < 1e-6
+    assert onp.allclose(row[2:], anchors[0], atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def tiny_ssd():
+    mx.random.seed(0)
+    from mxnet_tpu.gluon.model_zoo.ssd import SSD
+    backbone = mx.gluon.nn.HybridSequential()
+    backbone.add(mx.gluon.nn.Conv2D(16, 3, strides=2, padding=1,
+                                    activation="relu"),
+                 mx.gluon.nn.Conv2D(32, 3, strides=2, padding=1,
+                                    activation="relu"))
+    net = SSD([backbone], num_classes=3,
+              sizes=[[0.2, 0.3], [0.4, 0.5], [0.6, 0.7]],
+              ratios=[[1, 2, 0.5]] * 3, num_extras=2)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_ssd_forward_and_train_step(tiny_ssd):
+    from mxnet_tpu.gluon.model_zoo.ssd import training_targets, detections
+    from mxnet_tpu import autograd
+
+    x = mx.np.array(onp.random.RandomState(0).rand(2, 3, 64, 64),
+                    dtype='float32')
+    cls_preds, box_preds, anchors = tiny_ssd(x)
+    A = anchors.shape[0]
+    assert cls_preds.shape == (2, A, 4)
+    assert box_preds.shape == (2, A * 4)
+
+    labels = mx.np.array(onp.array(
+        [[[1.0, 0.1, 0.1, 0.4, 0.4], [-1, 0, 0, 0, 0]],
+         [[2.0, 0.5, 0.5, 0.9, 0.9], [0.0, 0.0, 0.0, 0.3, 0.3]]],
+        'float32'))
+    L_cls = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    L_box = mx.gluon.loss.HuberLoss()
+    tr = mx.gluon.Trainer(tiny_ssd.collect_params(), 'sgd',
+                          {'learning_rate': 0.1, 'momentum': 0.9})
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            cls_preds, box_preds, anchors = tiny_ssd(x)
+            bt, bm, ct = training_targets(anchors, labels)
+            cls_l = L_cls(cls_preds.reshape(-1, 4),
+                          ct.reshape(-1).astype('int32')).mean()
+            box_l = L_box(box_preds * bm, bt * bm).mean()
+            loss = cls_l + box_l
+            loss.backward()
+        tr.step(2)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0], losses
+
+    dets = detections(cls_preds, box_preds, anchors)
+    assert dets.shape == (2, A, 6)
+
+
+def test_ssd_resnet50_constructs():
+    net = mx.gluon.model_zoo.get_model("ssd_512_resnet50_v1", classes=20)
+    net.initialize(mx.init.Xavier())
+    x = mx.np.zeros((1, 3, 128, 128))
+    cls_preds, box_preds, anchors = net(x)
+    assert cls_preds.shape[-1] == 21
+    assert anchors.shape[0] * 4 == box_preds.shape[1]
+
+
+def test_multibox_target_padding_rows_dont_corrupt():
+    """Padding gt rows must not steal anchor 0's force-match."""
+    anchors = jnp.array([[0.0, 0, 0.2, 0.2], [0.5, 0.5, 0.7, 0.7]])
+    labels = jnp.array([[[0.0, 0, 0, 0.1, 0.4],
+                         [-1, 0, 0, 0, 0], [-1, 0, 0, 0, 0]]])
+    bt, bm, ct = bx.multibox_target(anchors, labels)
+    assert float(ct[0, 0]) == 1.0  # gt class 0 -> target 1 on its best anchor

@@ -1,0 +1,113 @@
+"""Extension loading + custom op tests (ref: example/extensions/,
+tests/python/unittest/test_operator.py custom-op section)."""
+import os
+import subprocess
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, operator
+from mxnet_tpu.base import MXNetError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def native_ext(tmp_path_factory):
+    src = os.path.join(REPO, "example", "extensions", "custom_ops.c")
+    so = str(tmp_path_factory.mktemp("ext") / "libcustom_ops.so")
+    res = subprocess.run(["gcc", "-shared", "-fPIC", "-O2", "-o", so, src,
+                          "-lm"], capture_output=True, text=True)
+    if res.returncode != 0:
+        pytest.skip(f"no C toolchain: {res.stderr}")
+    return so
+
+
+def test_load_native_extension(native_ext):
+    ops = mx.library.load(native_ext)
+    assert set(ops) == {"ext_gelu_fast", "ext_softsign"}
+    from mxnet_tpu import numpy_extension as npx
+    x = mx.np.array(onp.linspace(-3, 3, 7), dtype='float32')
+    out = npx.ext_softsign(x).asnumpy()
+    want = onp.linspace(-3, 3, 7) / (1 + onp.abs(onp.linspace(-3, 3, 7)))
+    assert onp.allclose(out, want, atol=1e-6)
+    g = npx.ext_gelu_fast(x).asnumpy()
+    import jax, jax.numpy as jnp
+    want_g = jax.nn.gelu(jnp.asarray(onp.linspace(-3, 3, 7), jnp.float32))
+    assert onp.allclose(g, want_g, atol=1e-3)
+
+
+def test_load_native_extension_under_jit(native_ext):
+    """pure_callback keeps extension ops usable inside jax.jit."""
+    import jax, jax.numpy as jnp
+    from mxnet_tpu import numpy_extension as npx
+    if not hasattr(npx, "ext_softsign"):
+        mx.library.load(native_ext)
+
+    def f(x):
+        return npx.ext_softsign(mx.nd.NDArray(x))._data * 2
+
+    out = jax.jit(f)(jnp.ones((4,)))
+    assert onp.allclose(onp.asarray(out), 1.0)
+
+
+def test_load_python_extension(tmp_path):
+    p = str(tmp_path / "pyext.py")
+    with open(p, "w") as f:
+        f.write(
+            "def register_ops(mx):\n"
+            "    def double(x, out=None):\n"
+            "        return x * 2\n"
+            "    return {'ext_double': double}\n")
+    ops = mx.library.load(p)
+    assert "ext_double" in ops
+    from mxnet_tpu import numpy_extension as npx
+    assert float(npx.ext_double(mx.np.array([3.0])).asnumpy()[0]) == 6.0
+
+
+def test_load_errors(tmp_path):
+    with pytest.raises(MXNetError):
+        mx.library.load("/nope/missing.so")
+    p = str(tmp_path / "bad.py")
+    open(p, "w").write("x = 1\n")
+    with pytest.raises(MXNetError):
+        mx.library.load(p)
+
+
+def test_custom_op_with_backward():
+    @operator.register("scaled_square")
+    class ScaledSquare(operator.CustomOp):
+        def __init__(self, scale=1.0):
+            self.scale = float(scale)
+
+        def forward(self, x):
+            return self.scale * x * x
+
+        def backward(self, out_grad, inputs, outputs):
+            return (2.0 * self.scale * inputs[0] * out_grad,)
+
+    op = operator.create("scaled_square", scale=3.0)
+    x = mx.np.array([1.0, 2.0], dtype='float32')
+    x.attach_grad()
+    with autograd.record():
+        y = op(x)
+        y.sum().backward()
+    assert onp.allclose(y.asnumpy(), [3.0, 12.0])
+    assert onp.allclose(x.grad.asnumpy(), [6.0, 12.0])
+
+
+def test_custom_op_registry_errors():
+    with pytest.raises(MXNetError):
+        operator.get("missing_op")
+    with pytest.raises(MXNetError):
+        @operator.register("notanop")
+        class NotAnOp:  # noqa
+            pass
+
+
+def test_onnx_gated():
+    from mxnet_tpu.contrib import onnx as monnx
+    net = mx.gluon.nn.Dense(2)
+    with pytest.raises(MXNetError, match="onnx|StableHLO"):
+        monnx.export_model(net, "/tmp/x", [(1, 4)])

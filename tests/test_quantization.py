@@ -135,3 +135,20 @@ def test_quantize_net_mode_none(float_net):
     assert qnet(x).shape == (2, 10)
     with pytest.raises(MXNetError):
         quantize_net(float_net, calib_mode="bogus")
+
+
+def test_quantize_net_none_mode_dynamic_ranges(float_net):
+    """calib_mode='none' -> dynamic per-batch activation ranges, accuracy
+    comparable to naive calibration (not garbage integer rounding)."""
+    rs = onp.random.RandomState(7)
+    x = mx.np.array(rs.rand(4, 3, 16, 16), dtype='float32')
+    qnet = quantize_net(float_net, calib_mode="none")
+    ref = float_net(x).asnumpy()
+    out = qnet(x).asnumpy()
+    denom = onp.abs(ref).max() + 1e-6
+    assert onp.abs(out - ref).max() / denom < 0.15
+    # collect_params/hybridize must work on the rewritten net
+    assert isinstance(qnet.collect_params(), dict)
+    qnet.hybridize()
+    out2 = qnet(x).asnumpy()
+    assert onp.allclose(out, out2, atol=1e-5)

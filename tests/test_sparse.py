@@ -1,0 +1,137 @@
+"""Sparse ndarray tests (ref: tests/python/unittest/test_sparse_ndarray.py,
+test_sparse_operator.py)."""
+import numpy as onp
+import pytest
+import scipy.sparse as sps
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ndarray import sparse as mxs
+
+
+def test_row_sparse_roundtrip():
+    dense = onp.zeros((6, 3), 'float32')
+    dense[1] = 1.0
+    dense[4] = [1, 2, 3]
+    rsp = mxs.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    assert list(rsp.indices.asnumpy()) == [1, 4]
+    assert onp.array_equal(rsp.asnumpy(), dense)
+    rsp2 = mxs.row_sparse_array(
+        (onp.ones((2, 3), 'float32'), onp.array([0, 5])), shape=(6, 3))
+    assert rsp2.todense().asnumpy()[5].sum() == 3.0
+
+
+def test_csr_roundtrip():
+    rs = onp.random.RandomState(0)
+    dense = rs.rand(5, 7).astype('float32') * (rs.rand(5, 7) > 0.6)
+    csr = mxs.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert onp.allclose(csr.asnumpy(), dense)
+    ref = sps.csr_matrix(dense)
+    assert onp.array_equal(csr.indptr.asnumpy(), ref.indptr)
+    assert onp.array_equal(csr.indices.asnumpy(), ref.indices)
+
+
+def test_cast_storage():
+    dense = mx.np.array(onp.eye(4, dtype='float32'))
+    rsp = mxs.cast_storage(dense, "row_sparse")
+    csr = mxs.cast_storage(dense, "csr")
+    back1 = mxs.cast_storage(rsp, "default")
+    back2 = csr.tostype("default")
+    assert onp.array_equal(back1.asnumpy(), onp.eye(4))
+    assert onp.array_equal(back2.asnumpy(), onp.eye(4))
+    rsp2 = csr.tostype("row_sparse")
+    assert rsp2.stype == "row_sparse"
+    assert onp.array_equal(rsp2.asnumpy(), onp.eye(4))
+
+
+def test_retain():
+    dense = onp.zeros((6, 2), 'float32')
+    dense[[1, 3, 5]] = [[1, 1], [3, 3], [5, 5]]
+    rsp = mxs.row_sparse_array(dense)
+    kept = mxs.retain(rsp, onp.array([1, 2, 5]))
+    out = kept.todense().asnumpy()
+    assert out[1].sum() == 2 and out[5].sum() == 10
+    assert out[3].sum() == 0 and out[2].sum() == 0
+
+
+def test_sparse_dot_matches_dense():
+    rs = onp.random.RandomState(1)
+    dense_a = (rs.rand(6, 5) * (rs.rand(6, 5) > 0.5)).astype('float32')
+    b = rs.rand(5, 4).astype('float32')
+    csr = mxs.csr_matrix(dense_a)
+    got = mxs.dot(csr, mx.np.array(b)).asnumpy()
+    assert onp.allclose(got, dense_a @ b, atol=1e-5)
+    # transpose: (6,5)^T x (6,4)
+    c = rs.rand(6, 4).astype('float32')
+    got_t = mxs.dot(csr, mx.np.array(c), transpose_a=True).asnumpy()
+    assert onp.allclose(got_t, dense_a.T @ c, atol=1e-5)
+    # row_sparse^T x dense
+    rsp = mxs.row_sparse_array(dense_a)
+    got_r = mxs.dot(rsp, mx.np.array(c), transpose_a=True).asnumpy()
+    assert onp.allclose(got_r, dense_a.T @ c, atol=1e-5)
+
+
+def test_sparse_add():
+    a = mxs.row_sparse_array((onp.ones((1, 2), 'float32'), [1]), shape=(4, 2))
+    b = mxs.row_sparse_array((onp.full((2, 2), 2.0, 'float32'), [1, 3]),
+                             shape=(4, 2))
+    s = mxs.add(a, b)
+    assert s.stype == "row_sparse"
+    assert list(s.indices.asnumpy()) == [1, 3]
+    out = s.todense().asnumpy()
+    assert out[1].sum() == 6.0 and out[3].sum() == 4.0
+
+
+def test_sparse_save_load(tmp_path):
+    p = str(tmp_path / "sp.ndz")
+    rsp = mxs.row_sparse_array((onp.ones((2, 3), 'float32'), [0, 2]),
+                               shape=(5, 3))
+    csr = mxs.csr_matrix(onp.eye(3, dtype='float32'))
+    dense = mx.np.ones((2, 2))
+    mx.nd.save(p, {"rsp": rsp, "csr": csr, "dense": dense})
+    back = mx.nd.load(p)
+    assert back["rsp"].stype == "row_sparse"
+    assert onp.array_equal(back["rsp"].asnumpy(), rsp.asnumpy())
+    assert back["csr"].stype == "csr"
+    assert onp.array_equal(back["csr"].asnumpy(), onp.eye(3))
+    assert onp.array_equal(back["dense"].asnumpy(), onp.ones((2, 2)))
+
+
+@pytest.mark.parametrize("opt,kw", [("sgd", {"momentum": 0.9}),
+                                    ("adam", {})])
+def test_lazy_sparse_optimizer_update(opt, kw):
+    """Row-sparse grads update ONLY the stored rows (lazy semantics)."""
+    import mxnet_tpu.optimizer as mopt
+
+    o = mopt.create(opt, learning_rate=0.1, **kw)
+    w = mx.nd.NDArray(mx.np.ones((5, 3))._data)
+    state = o.create_state(0, w)
+    g = mxs.row_sparse_array((onp.ones((2, 3), 'float32'), [1, 3]),
+                             shape=(5, 3))
+    before = w.asnumpy().copy()
+    o.update(0, w, g, state)
+    after = w.asnumpy()
+    changed = onp.abs(after - before).sum(axis=1) > 0
+    assert list(changed) == [False, True, False, True, False]
+    # dense-equivalent on the touched rows
+    o2 = mopt.create(opt, learning_rate=0.1, **kw)
+    w2 = mx.nd.NDArray(mx.np.ones((5, 3))._data)
+    st2 = o2.create_state(0, w2)
+    gd = mx.nd.NDArray(g.todense()._data)
+    o2.update(0, w2, gd, st2)
+    assert onp.allclose(after[[1, 3]], w2.asnumpy()[[1, 3]], atol=1e-6)
+
+
+def test_sparse_save_load_bf16(tmp_path):
+    import jax.numpy as jnp
+    p = str(tmp_path / "bf.ndz")
+    rsp = mxs.RowSparseNDArray(
+        mx.nd.NDArray(jnp.ones((2, 3), jnp.bfloat16)),
+        mx.nd.NDArray(jnp.array([0, 2], jnp.int32)), (4, 3))
+    mx.nd.save(p, {"w": rsp})
+    back = mx.nd.load(p)["w"]
+    assert back.data._data.dtype == jnp.bfloat16
+    with pytest.raises(MXNetError):
+        mx.nd.save(str(tmp_path / "x.ndz"), {"a::b": mx.np.ones((2,))})
