@@ -208,6 +208,7 @@ def batch_norm_train(x, gamma, beta, moving_mean, moving_var,
     Ref: src/operator/nn/batch_norm.cc — the reference mutates moving stats
     in-place inside the kernel; we return them functionally and the npx layer
     rebinds (visible to jit tracing via the mutation-watcher protocol)."""
+    axis = axis % x.ndim  # negative axis (e.g. -1) must match positive ids
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
     axes = tuple(i for i in range(x.ndim) if i != axis)
@@ -228,6 +229,7 @@ def batch_norm_train(x, gamma, beta, moving_mean, moving_var,
 
 def batch_norm_infer(x, gamma, beta, moving_mean, moving_var,
                      eps: float = 1e-5, axis: int = 1, fix_gamma: bool = False):
+    axis = axis % x.ndim
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
     shape = [1] * x.ndim

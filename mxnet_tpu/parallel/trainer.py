@@ -145,7 +145,7 @@ def _opt_update(kind: str, pvals, grads, state, lr, wd, momentum, t,
 def make_train_step(net, loss_fn, names: List[str],
                     optimizer: str = "sgd", learning_rate: float = 0.01,
                     weight_decay: float = 0.0, momentum: float = 0.9,
-                    donate: bool = True):
+                    donate: bool = True, compute_dtype=None):
     """Build one jitted SPMD train step:
     step(tvals, avals, rng, opt_state, t, x, y)
         -> (tvals', mutated_state, opt_state', loss).
@@ -178,14 +178,33 @@ def make_train_step(net, loss_fn, names: List[str],
 
     def loss_of(tvals, avals, key_val, x, y):
         xs = x if isinstance(x, (tuple, list)) else (x,)
-        outs, mutated = fn(assemble(tvals, avals, key_val), *xs)
+        if compute_dtype is not None:
+            # AMP: forward runs in compute_dtype (bf16 on the MXU), master
+            # params stay fp32 in the optimizer (ref amp loss-scale-free
+            # bf16 policy; python/mxnet/amp). No loss scaling needed for
+            # bf16 — the exponent range matches fp32.
+            cast = lambda v: (v.astype(compute_dtype)  # noqa: E731
+                              if jnp.issubdtype(v.dtype, jnp.floating)
+                              else v)
+            tv = [cast(v) for v in tvals]
+            av = [cast(v) for v in avals]
+            xs = tuple(cast(v) for v in xs)
+        else:
+            tv, av = tvals, avals
+        outs, mutated = fn(assemble(tv, av, key_val), *xs)
         pred = outs[0] if len(outs) == 1 else tuple(outs)
         loss = loss_fn(pred, y)
-        return jnp.mean(loss), (mutated,)
+        return jnp.mean(loss).astype(jnp.float32), (mutated,)
 
     def step(tvals, avals, key_val, opt_state, t, x, y):
         (loss, (mutated,)), grads = jax.value_and_grad(loss_of, has_aux=True)(
             tvals, avals, key_val, x, y)
+        if compute_dtype is not None:
+            # mutated aux state (BN stats) came out of the bf16 forward;
+            # keep the persistent copies fp32 so precision doesn't decay
+            mutated = [m.astype(jnp.float32)
+                       if jnp.issubdtype(m.dtype, jnp.floating) else m
+                       for m in mutated]
         new_p, new_state = _opt_update(optimizer, tvals, grads, opt_state,
                                        learning_rate, weight_decay, momentum, t)
         return new_p, mutated, new_state, loss
@@ -207,7 +226,7 @@ class ShardedTrainer:
                  optimizer: str = "sgd", learning_rate: float = 0.01,
                  weight_decay: float = 0.0, momentum: float = 0.9,
                  spec_fn: Callable = replicated_spec_fn,
-                 batch_spec: P = P("dp")):
+                 batch_spec: P = P("dp"), compute_dtype=None):
         from .mesh import default_mesh
 
         self.net = net
@@ -215,7 +234,7 @@ class ShardedTrainer:
         self.names, allvals, self.specs = shard_params(net, self.mesh, spec_fn)
         self._step_fn, self._holder = make_train_step(
             net, loss_fn, self.names, optimizer, learning_rate,
-            weight_decay, momentum)
+            weight_decay, momentum, compute_dtype=compute_dtype)
         self.pvals = [allvals[i] for i in self._holder["train_ix"]]
         self.avals = [allvals[i] for i in self._holder["aux_ix"]]
         self._params = net.collect_params()

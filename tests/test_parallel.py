@@ -132,3 +132,42 @@ def test_blockwise_attention_matches_reference():
             mask = None
         ref = attention_reference(q, k, v, mask=mask)
         onp.testing.assert_allclose(onp.array(out), onp.array(ref), atol=2e-5)
+
+
+def test_sharded_trainer_bf16_compute():
+    """compute_dtype=bfloat16: fp32 master params, bf16 forward; must
+    still converge and keep param/aux dtypes fp32 across steps."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from jax.sharding import PartitionSpec as P
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(32, activation="relu"),
+            mx.gluon.nn.BatchNorm(axis=-1),
+            mx.gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((2, 8)))
+
+    def ce(pred, y):
+        logp = jax.nn.log_softmax(pred.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr = ShardedTrainer(net, ce, mesh=mesh, optimizer="adam",
+                        learning_rate=5e-3, batch_spec=P("dp"),
+                        compute_dtype=jnp.bfloat16)
+    rs = onp.random.RandomState(0)
+    x = rs.rand(32, 8).astype("float32")
+    y = (x.sum(1) > 4).astype("int32")
+    losses = [tr.step(x, y) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.5, losses
+    for v in tr.pvals:
+        assert v.dtype == jnp.float32  # master params stay fp32
+    for v in tr.avals:
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            assert v.dtype == jnp.float32  # BN stats stay fp32
