@@ -256,8 +256,11 @@ class ImageRecordIter(DataIter):
                  std_b: float = 1.0, scale: float = 1.0,
                  preprocess_threads: int = 4, prefetch_buffer: int = 4,
                  seed: Optional[int] = None, round_batch: bool = True,
-                 **kwargs):
+                 label_width: int = 1, **kwargs):
         super().__init__(batch_size)
+        if label_width < 1:
+            raise MXNetError("label_width must be >= 1")
+        self.label_width = label_width
         from .recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
 
         self._unpack_img = unpack_img
@@ -300,7 +303,9 @@ class ImageRecordIter(DataIter):
 
     @property
     def provide_label(self):
-        return [DataDesc("softmax_label", (self.batch_size,))]
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [DataDesc("softmax_label", shape)]
 
     def _num_samples(self):
         return len(self._keys) if self._keys is not None else len(self._offsets)
@@ -372,14 +377,20 @@ class ImageRecordIter(DataIter):
         slots = self._slots
 
         def work():
+            lw = self.label_width
             xs = _onp.empty((self.batch_size,) + self.data_shape,
                             _onp.float32)
-            ys = _onp.empty((self.batch_size,), _onp.float32)
+            ys = _onp.empty((self.batch_size,) if lw == 1
+                            else (self.batch_size, lw), _onp.float32)
             for j, i in enumerate(idx):
                 header, img = self._unpack_img(self._read_raw(int(i)))
                 xs[j] = self._augment(img, rng)
-                lab = _onp.asarray(header.label)
-                ys[j] = float(lab if lab.ndim == 0 else lab.flat[0])
+                lab = _onp.asarray(header.label, _onp.float32).reshape(-1)
+                if lab.size < lw:
+                    raise MXNetError(
+                        f"record {int(i)} carries {lab.size} label values "
+                        f"but label_width={lw}")
+                ys[j] = lab[0] if lw == 1 else lab[:lw]
             slots[bi] = (xs, ys, pad, _onp.asarray(idx))
         return work
 

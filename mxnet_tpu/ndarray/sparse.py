@@ -78,6 +78,21 @@ class RowSparseNDArray(BaseSparseNDArray):
         if self.data._data.shape[1:] != self._shape[1:]:
             raise MXNetError("row_sparse data trailing dims != shape")
 
+    def _sort_indices(self):
+        """retain()/todense() assume sorted unique indices (searchsorted);
+        sort (data, indices) jointly so an unsorted input can't silently
+        return wrong rows. Called from the user-facing factory only —
+        internal constructions are sorted by construction, and this check
+        blocks on a device->host sync."""
+        idx = self.indices._data
+        if idx.shape[0] > 1 and bool(jnp.any(idx[1:] <= idx[:-1])):
+            order = jnp.argsort(idx)
+            idx = idx[order]
+            if bool(jnp.any(idx[1:] == idx[:-1])):
+                raise MXNetError("row_sparse indices must be unique")
+            self.indices = NDArray(idx)
+            self.data = NDArray(self.data._data[order])
+
     def copy(self):
         return RowSparseNDArray(NDArray(self.data._data),
                                 NDArray(self.indices._data), self._shape)
@@ -143,7 +158,9 @@ def row_sparse_array(arg, shape: Optional[Tuple[int, ...]] = None,
         if shape is None:
             n = int(indices.max()) + 1 if indices.size else 0
             shape = (n,) + data.shape[1:]
-        return RowSparseNDArray(NDArray(data), NDArray(indices), shape)
+        out = RowSparseNDArray(NDArray(data), NDArray(indices), shape)
+        out._sort_indices()
+        return out
     dense = jnp.asarray(arg._data if isinstance(arg, NDArray) else arg, dtype)
     return _dense_to_row_sparse(dense)
 

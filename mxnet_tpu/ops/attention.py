@@ -199,14 +199,31 @@ def _merge_mask(mask, kv_len, tq, tk, causal):
     return m
 
 
+_pallas_fallback_warned = False
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
 def _flash(q, k, v, mask, kv_len, causal: bool, scale: float):
     if _use_pallas(q, k, mask):
         try:
             return _flash_forward_pallas(q, k, v, causal, scale,
                                          kv_len=kv_len)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 - any kernel failure degrades
+            # A broken TPU kernel (or VMEM OOM) must not silently become an
+            # O(T^2) slowdown: warn once so regressions are visible, and let
+            # MXNET_FLASH_NO_FALLBACK=1 turn the fallback into a hard error.
+            import os
+            import warnings
+
+            if os.environ.get("MXNET_FLASH_NO_FALLBACK"):
+                raise
+            global _pallas_fallback_warned
+            if not _pallas_fallback_warned:
+                _pallas_fallback_warned = True
+                warnings.warn(
+                    "pallas flash-attention kernel failed; falling back to "
+                    f"the O(T^2) reference path: {type(e).__name__}: {e}",
+                    RuntimeWarning, stacklevel=2)
     m = _merge_mask(mask, kv_len, q.shape[2], k.shape[2], causal)
     return attention_reference(q, k, v, mask=m, scale=scale)
 

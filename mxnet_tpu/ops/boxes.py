@@ -153,8 +153,10 @@ def multibox_prior(feat_shape: Tuple[int, int],
     """Anchor boxes for one feature map (ref multibox_prior.cc).
 
     Returns (H*W*A, 4) corner boxes in [0, 1]; A = len(sizes) +
-    len(ratios) - 1 (first size pairs with every ratio, remaining sizes
-    with ratio 1 — the reference's convention)."""
+    len(ratios) - 1. Anchor order per cell matches the reference kernel:
+    every size paired with ratios[0] first, then ratios[1:] paired with
+    sizes[0]; widths carry the reference's in_height/in_width aspect
+    correction."""
     h, w = feat_shape
     step_y = steps[0] if steps[0] > 0 else 1.0 / h
     step_x = steps[1] if steps[1] > 0 else 1.0 / w
@@ -162,12 +164,14 @@ def multibox_prior(feat_shape: Tuple[int, int],
     cx = (jnp.arange(w) + offsets[1]) * step_x
     cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1)  # (H, W, 2)
 
+    aspect = h / w
     whs = []
-    for r in ratios:
-        sr = math.sqrt(r)
-        whs.append((sizes[0] * sr, sizes[0] / sr))
-    for s in sizes[1:]:
-        whs.append((s, s))
+    r0 = math.sqrt(ratios[0]) if ratios else 1.0
+    for s in sizes:
+        whs.append((s * aspect * r0, s / r0))
+    for r in ratios[1:]:
+        rr = math.sqrt(r)
+        whs.append((sizes[0] * aspect * rr, sizes[0] / rr))
     wh = jnp.asarray(whs, jnp.float32)                 # (A, 2) (w, h)
 
     cyx = jnp.broadcast_to(cyx[:, :, None, :], (h, w, wh.shape[0], 2))

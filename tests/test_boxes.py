@@ -68,10 +68,12 @@ def test_multibox_prior():
     anchors = bx.multibox_prior((2, 3), sizes=(0.5, 0.25), ratios=(1, 2))
     # A = len(sizes)+len(ratios)-1 = 3 per cell
     assert anchors.shape == (2 * 3 * 3, 4)
-    # first anchor of first cell: size 0.5 ratio 1 centered at (1/6, 1/4)
+    # first anchor of first cell: size 0.5 ratio 1 centered at (1/6, 1/4);
+    # half-width carries the reference's in_height/in_width (= 2/3) factor
     cx, cy = 1 / 6, 1 / 4
-    assert onp.allclose(anchors[0], [cx - 0.25, cy - 0.25,
-                                     cx + 0.25, cy + 0.25], atol=1e-6)
+    hw, hh = 0.5 * (2 / 3) / 2, 0.5 / 2
+    assert onp.allclose(anchors[0], [cx - hw, cy - hh,
+                                     cx + hw, cy + hh], atol=1e-6)
 
 
 def test_box_encode_decode_roundtrip():
@@ -180,3 +182,22 @@ def test_multibox_target_padding_rows_dont_corrupt():
                          [-1, 0, 0, 0, 0], [-1, 0, 0, 0, 0]]])
     bt, bm, ct = bx.multibox_target(anchors, labels)
     assert float(ct[0, 0]) == 1.0  # gt class 0 -> target 1 on its best anchor
+
+
+def test_multibox_prior_extra_sizes_use_first_ratio():
+    # extra sizes pair with ratios[0], not ratio 1 (ref multibox_prior.cc)
+    anchors = bx.multibox_prior((1, 1), sizes=(0.5, 0.25), ratios=(4.0,))
+    w = anchors[:, 2] - anchors[:, 0]
+    h = anchors[:, 3] - anchors[:, 1]
+    assert onp.allclose(w[1], 0.25 * 2.0, atol=1e-6)
+    assert onp.allclose(h[1], 0.25 / 2.0, atol=1e-6)
+
+
+def test_multibox_prior_reference_anchor_order():
+    # per-cell order matches the reference kernel: every size with
+    # ratios[0] first, then ratios[1:] with sizes[0]
+    anchors = bx.multibox_prior((1, 1), sizes=(0.5, 0.25), ratios=(1.0, 4.0))
+    w = onp.asarray(anchors[:, 2] - anchors[:, 0])
+    h = onp.asarray(anchors[:, 3] - anchors[:, 1])
+    expect = [(0.5, 0.5), (0.25, 0.25), (0.5 * 2, 0.5 / 2)]
+    assert onp.allclose(list(zip(w, h)), expect, atol=1e-6)

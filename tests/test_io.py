@@ -223,3 +223,33 @@ def test_image_record_iter_batch_exceeds_dataset(tmp_path):
     assert b.data[0].shape == (5, 3, 24, 24)
     assert b.pad == 3
     assert list(b.label[0].asnumpy()) == [0, 1, 0, 1, 0]
+
+
+def test_image_record_iter_label_width(tmp_path):
+    # multi-label records surface the full (B, label_width) vector
+    # (ref ImageRecordIter label_width)
+    prefix = str(tmp_path / "ml")
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rs = onp.random.RandomState(0)
+    for i in range(6):
+        img = rs.randint(0, 255, (30, 30, 3), dtype=onp.uint8)
+        rec.write_idx(i, pack_img(
+            IRHeader(0, onp.array([i, i + 10, i + 20], onp.float32), i, 0),
+            img, img_fmt=".png"))
+    rec.close()
+    it = ImageRecordIter(path_imgrec=prefix + ".rec",
+                         path_imgidx=prefix + ".idx",
+                         data_shape=(3, 24, 24), batch_size=3,
+                         label_width=3)
+    assert it.provide_label[0].shape == (3, 3)
+    batches = list(it)
+    lab = onp.concatenate([b.label[0].asnumpy() for b in batches])
+    assert lab.shape == (6, 3)
+    assert list(lab[:, 1]) == [i + 10 for i in range(6)]
+    # label_width wider than the stored labels is a loud error
+    it = ImageRecordIter(path_imgrec=prefix + ".rec",
+                         path_imgidx=prefix + ".idx",
+                         data_shape=(3, 24, 24), batch_size=3,
+                         label_width=5)
+    with pytest.raises(MXNetError, match="label_width"):
+        list(it)

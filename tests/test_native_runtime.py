@@ -60,13 +60,22 @@ def test_engine_readers_see_committed_writes(eng):
 
 @native
 def test_engine_independent_ops_run_parallel(eng):
-    """Two sleeps on distinct vars overlap on the pool."""
+    """Two sleeps on distinct vars overlap on the pool (structural check:
+    the ops' [start, end] intervals intersect — immune to scheduler-load
+    flakiness that a wall-clock bound is not)."""
     v1, v2 = eng.new_var(), eng.new_var()
-    t0 = time.perf_counter()
-    eng.push(lambda: time.sleep(0.2), write=(v1,))
-    eng.push(lambda: time.sleep(0.2), write=(v2,))
+    spans = {}
+
+    def op(name):
+        spans[name] = [time.perf_counter(), None]
+        time.sleep(0.2)
+        spans[name][1] = time.perf_counter()
+
+    eng.push(lambda: op("a"), write=(v1,))
+    eng.push(lambda: op("b"), write=(v2,))
     eng.wait_for_all()
-    assert time.perf_counter() - t0 < 0.35
+    (a0, a1), (b0, b1) = spans["a"], spans["b"]
+    assert max(a0, b0) < min(a1, b1), f"no overlap: a={spans['a']} b={spans['b']}"
     eng.delete_var(v1)
     eng.delete_var(v2)
 

@@ -135,3 +135,16 @@ def test_sparse_save_load_bf16(tmp_path):
     assert back.data._data.dtype == jnp.bfloat16
     with pytest.raises(MXNetError):
         mx.nd.save(str(tmp_path / "x.ndz"), {"a::b": mx.np.ones((2,))})
+
+
+def test_row_sparse_unsorted_indices_sorted_on_construction():
+    # retain()/todense() assume sorted indices; the constructor must sort
+    data = onp.array([[3., 3.], [1., 1.]], 'float32')
+    rsp = mxs.row_sparse_array((data, [3, 1]), shape=(4, 2))
+    assert list(rsp.indices.asnumpy()) == [1, 3]
+    dense = rsp.todense().asnumpy()
+    assert onp.allclose(dense[1], [1., 1.]) and onp.allclose(dense[3], [3., 3.])
+    kept = rsp.retain([3]).todense().asnumpy()
+    assert onp.allclose(kept[3], [3., 3.]) and onp.allclose(kept[1], 0)
+    with pytest.raises(MXNetError, match="unique"):
+        mxs.row_sparse_array((data, [2, 2]), shape=(4, 2))
