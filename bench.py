@@ -26,11 +26,16 @@ def main():
     platform = jax.devices()[0].platform
     batch = 128 if platform == "tpu" else 8
     image = 224 if platform == "tpu" else 64
+    # channel-last on TPU: channels ride the 128-lane minor tile, so convs
+    # feed the MXU without layout-transpose pairs (see ops/nn.py layout note)
+    layout = "NHWC" if platform == "tpu" else "NCHW"
 
     mx.random.seed(0)
-    net = mx.gluon.model_zoo.get_model("resnet50_v1")
+    net = mx.gluon.model_zoo.get_model("resnet50_v1", layout=layout)
     net.initialize(mx.init.Xavier())
-    net(mx.np.zeros((2, 3, image, image)))
+    shape = ((2, image, image, 3) if layout == "NHWC"
+             else (2, 3, image, image))
+    net(mx.np.zeros(shape))
 
     def ce(pred, y):
         logp = jax.nn.log_softmax(pred.astype(jnp.float32))
@@ -46,7 +51,9 @@ def main():
                              if platform == "tpu" else None)
 
     rs = onp.random.RandomState(0)
-    x = onp.asarray(rs.rand(batch, 3, image, image), onp.float32)
+    xshape = ((batch, image, image, 3) if layout == "NHWC"
+              else (batch, 3, image, image))
+    x = onp.asarray(rs.rand(*xshape), onp.float32)
     y = onp.asarray(rs.randint(0, 1000, size=(batch,)), onp.int32)
 
     for _ in range(3):  # warmup (compile + first exec), full write-back path
@@ -80,11 +87,21 @@ def main():
 
     ips = batch * n_steps / dt
     baseline = 363.69  # V100 fp32 b128 training, BASELINE.md
+    # MFU: ResNet-50 fwd ≈ 4.1 GFLOP/img @224², train ≈ 3× fwd, against the
+    # chip's bf16 peak (compute_dtype above is bf16 on TPU). Peak table by
+    # device kind; unknown kinds report no MFU rather than a wrong one.
+    peaks = {"v5 lite": 197e12, "v5litepod": 197e12, "v4": 275e12,
+             "v5p": 459e12, "v6 lite": 918e12, "v6e": 918e12}
+    kind = jax.devices()[0].device_kind.lower()
+    peak = next((v for k, v in peaks.items() if k in kind), None)
+    mfu = (ips * 3 * 4.089e9 / peak) if (platform == "tpu" and peak) else None
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec_per_chip",
         "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(ips / baseline, 4),
+        "layout": layout,
+        "mfu": round(mfu, 4) if mfu is not None else None,
     }))
 
 

@@ -50,18 +50,19 @@ class Context:
         return _DEVTYPE_ALIASES[self.device_type]
 
     def jax_device(self):
-        """Resolve to a concrete jax.Device (accelerator falls back to host
-        platform when no TPU is attached, so CPU-only CI still runs)."""
+        """Resolve to a concrete PROCESS-LOCAL jax.Device (multi-process:
+        jax.devices() enumerates the whole job; only local ones are
+        addressable). Accelerator falls back to host platform when no TPU is
+        attached, so CPU-only CI still runs."""
         import jax
 
         if self.kind == "tpu":
             devs = _accelerator_devices()
             if devs:
                 return devs[self.device_id % len(devs)]
-            # graceful fallback: behave like the reference's storage fallback
-            devs = jax.devices("cpu")
-            return devs[self.device_id % len(devs)]
-        devs = jax.devices("cpu")
+        # cpu context (or accelerator fallback, mirroring the reference's
+        # storage fallback): the host backend always exists
+        devs = jax.local_devices(backend="cpu")
         return devs[self.device_id % len(devs)]
 
     # -- protocol -----------------------------------------------------------
@@ -94,7 +95,7 @@ def _accelerator_devices() -> List:
     import jax
 
     try:
-        default = jax.devices()
+        default = jax.local_devices()
     except RuntimeError:
         return []
     return [d for d in default if d.platform != "cpu"]

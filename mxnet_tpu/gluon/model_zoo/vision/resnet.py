@@ -17,23 +17,32 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "resnet101_v2", "resnet152_v2"]
 
 
-def _conv3x3(channels, stride, in_channels=0):
+def _conv3x3(channels, stride, in_channels=0, layout="NCHW"):
     return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
+                     use_bias=False, in_channels=in_channels, layout=layout)
+
+
+def _bn_axis(layout):
+    return 1 if layout.startswith("NC") else -1
 
 
 class BasicBlockV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kw):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 layout="NCHW", **kw):
         super().__init__(**kw)
+        ax = _bn_axis(layout)
         self.body = nn.HybridSequential()
-        self.body.add(_conv3x3(channels, stride, in_channels), nn.BatchNorm(),
-                      nn.Activation("relu"), _conv3x3(channels, 1, channels),
-                      nn.BatchNorm())
+        self.body.add(_conv3x3(channels, stride, in_channels, layout),
+                      nn.BatchNorm(axis=ax),
+                      nn.Activation("relu"),
+                      _conv3x3(channels, 1, channels, layout),
+                      nn.BatchNorm(axis=ax))
         if downsample:
             self.downsample = nn.HybridSequential()
             self.downsample.add(
                 nn.Conv2D(channels, 1, strides=stride, use_bias=False,
-                          in_channels=in_channels), nn.BatchNorm())
+                          in_channels=in_channels, layout=layout),
+                nn.BatchNorm(axis=ax))
         else:
             self.downsample = None
 
@@ -44,20 +53,25 @@ class BasicBlockV1(HybridBlock):
 
 
 class BottleneckV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kw):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 layout="NCHW", **kw):
         super().__init__(**kw)
+        ax = _bn_axis(layout)
         self.body = nn.HybridSequential()
-        self.body.add(nn.Conv2D(channels // 4, 1, strides=stride, use_bias=False),
-                      nn.BatchNorm(), nn.Activation("relu"),
-                      _conv3x3(channels // 4, 1, channels // 4),
-                      nn.BatchNorm(), nn.Activation("relu"),
-                      nn.Conv2D(channels, 1, strides=1, use_bias=False),
-                      nn.BatchNorm())
+        self.body.add(nn.Conv2D(channels // 4, 1, strides=stride,
+                                use_bias=False, layout=layout),
+                      nn.BatchNorm(axis=ax), nn.Activation("relu"),
+                      _conv3x3(channels // 4, 1, channels // 4, layout),
+                      nn.BatchNorm(axis=ax), nn.Activation("relu"),
+                      nn.Conv2D(channels, 1, strides=1, use_bias=False,
+                                layout=layout),
+                      nn.BatchNorm(axis=ax))
         if downsample:
             self.downsample = nn.HybridSequential()
             self.downsample.add(
                 nn.Conv2D(channels, 1, strides=stride, use_bias=False,
-                          in_channels=in_channels), nn.BatchNorm())
+                          in_channels=in_channels, layout=layout),
+                nn.BatchNorm(axis=ax))
         else:
             self.downsample = None
 
@@ -68,14 +82,17 @@ class BottleneckV1(HybridBlock):
 
 
 class BasicBlockV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kw):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 layout="NCHW", **kw):
         super().__init__(**kw)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
+        ax = _bn_axis(layout)
+        self.bn1 = nn.BatchNorm(axis=ax)
+        self.conv1 = _conv3x3(channels, stride, in_channels, layout)
+        self.bn2 = nn.BatchNorm(axis=ax)
+        self.conv2 = _conv3x3(channels, 1, channels, layout)
         self.downsample = nn.Conv2D(channels, 1, strides=stride, use_bias=False,
-                                    in_channels=in_channels) if downsample else None
+                                    in_channels=in_channels,
+                                    layout=layout) if downsample else None
 
     def forward(self, x):
         residual = x
@@ -89,16 +106,21 @@ class BasicBlockV2(HybridBlock):
 
 
 class BottleneckV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kw):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 layout="NCHW", **kw):
         super().__init__(**kw)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, 1, strides=1, use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, 1, strides=1, use_bias=False)
+        ax = _bn_axis(layout)
+        self.bn1 = nn.BatchNorm(axis=ax)
+        self.conv1 = nn.Conv2D(channels // 4, 1, strides=1, use_bias=False,
+                               layout=layout)
+        self.bn2 = nn.BatchNorm(axis=ax)
+        self.conv2 = _conv3x3(channels // 4, stride, channels // 4, layout)
+        self.bn3 = nn.BatchNorm(axis=ax)
+        self.conv3 = nn.Conv2D(channels, 1, strides=1, use_bias=False,
+                               layout=layout)
         self.downsample = nn.Conv2D(channels, 1, strides=stride, use_bias=False,
-                                    in_channels=in_channels) if downsample else None
+                                    in_channels=in_channels,
+                                    layout=layout) if downsample else None
 
     def forward(self, x):
         residual = x
@@ -114,30 +136,37 @@ class BottleneckV2(HybridBlock):
 
 
 class ResNetV1(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False, **kw):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+                 layout="NCHW", **kw):
         super().__init__(**kw)
         if len(channels) != len(layers) + 1:
             raise MXNetError("channels must have len(layers)+1 entries")
+        self._layout = layout
+        ax = _bn_axis(layout)
         self.features = nn.HybridSequential()
         if thumbnail:
-            self.features.add(_conv3x3(channels[0], 1, 0))
+            self.features.add(_conv3x3(channels[0], 1, 0, layout))
         else:
-            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False),
-                              nn.BatchNorm(), nn.Activation("relu"),
-                              nn.MaxPool2D(3, 2, 1))
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False,
+                                        layout=layout),
+                              nn.BatchNorm(axis=ax), nn.Activation("relu"),
+                              nn.MaxPool2D(3, 2, 1, layout=layout))
         for i, num_layer in enumerate(layers):
             stride = 1 if i == 0 else 2
             self.features.add(self._make_layer(
-                block, num_layer, channels[i + 1], stride, channels[i]))
-        self.features.add(nn.GlobalAvgPool2D())
+                block, num_layer, channels[i + 1], stride, channels[i],
+                layout=layout))
+        self.features.add(nn.GlobalAvgPool2D(layout=layout))
         self.output = nn.Dense(classes)
 
-    def _make_layer(self, block, layers, channels, stride, in_channels=0):
+    def _make_layer(self, block, layers, channels, stride, in_channels=0,
+                    layout="NCHW"):
         layer = nn.HybridSequential()
         layer.add(block(channels, stride, channels != in_channels,
-                        in_channels=in_channels))
+                        in_channels=in_channels, layout=layout))
         for _ in range(layers - 1):
-            layer.add(block(channels, 1, False, in_channels=channels))
+            layer.add(block(channels, 1, False, in_channels=channels,
+                            layout=layout))
         return layer
 
     def forward(self, x):
@@ -146,32 +175,39 @@ class ResNetV1(HybridBlock):
 
 
 class ResNetV2(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False, **kw):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+                 layout="NCHW", **kw):
         super().__init__(**kw)
+        self._layout = layout
+        ax = _bn_axis(layout)
         self.features = nn.HybridSequential()
-        self.features.add(nn.BatchNorm(scale=False, center=False))
+        self.features.add(nn.BatchNorm(axis=ax, scale=False, center=False))
         if thumbnail:
-            self.features.add(_conv3x3(channels[0], 1, 0))
+            self.features.add(_conv3x3(channels[0], 1, 0, layout))
         else:
-            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False),
-                              nn.BatchNorm(), nn.Activation("relu"),
-                              nn.MaxPool2D(3, 2, 1))
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False,
+                                        layout=layout),
+                              nn.BatchNorm(axis=ax), nn.Activation("relu"),
+                              nn.MaxPool2D(3, 2, 1, layout=layout))
         in_channels = channels[0]
         for i, num_layer in enumerate(layers):
             stride = 1 if i == 0 else 2
             self.features.add(self._make_layer(
-                block, num_layer, channels[i + 1], stride, in_channels))
+                block, num_layer, channels[i + 1], stride, in_channels,
+                layout=layout))
             in_channels = channels[i + 1]
-        self.features.add(nn.BatchNorm(), nn.Activation("relu"),
-                          nn.GlobalAvgPool2D(), nn.Flatten())
+        self.features.add(nn.BatchNorm(axis=ax), nn.Activation("relu"),
+                          nn.GlobalAvgPool2D(layout=layout), nn.Flatten())
         self.output = nn.Dense(classes)
 
-    def _make_layer(self, block, layers, channels, stride, in_channels=0):
+    def _make_layer(self, block, layers, channels, stride, in_channels=0,
+                    layout="NCHW"):
         layer = nn.HybridSequential()
         layer.add(block(channels, stride, channels != in_channels,
-                        in_channels=in_channels))
+                        in_channels=in_channels, layout=layout))
         for _ in range(layers - 1):
-            layer.add(block(channels, 1, False, in_channels=channels))
+            layer.add(block(channels, 1, False, in_channels=channels,
+                            layout=layout))
         return layer
 
     def forward(self, x):

@@ -21,12 +21,14 @@ def _tup(v, n):
 
 class _Conv(HybridBlock):
     """Shared conv machinery (ref conv_layers.py _Conv →
-    src/operator/nn/convolution.cc). Weight layout OIHW like the reference."""
+    src/operator/nn/convolution.cc). Weight layout follows the data layout:
+    OIHW for channel-first (reference default), OHWI for channel-last
+    (NHWC — the TPU-preferred layout, channels on the minor 128-lane tile)."""
 
     def __init__(self, channels, kernel_size, strides, padding, dilation,
                  groups, in_channels, activation, use_bias,
                  weight_initializer, bias_initializer, ndim,
-                 transpose=False, output_padding=0, **kwargs):
+                 transpose=False, output_padding=0, layout=None, **kwargs):
         super().__init__(**kwargs)
         self._channels = channels
         self._in_channels = in_channels
@@ -39,21 +41,27 @@ class _Conv(HybridBlock):
         self._ndim = ndim
         self._transpose = transpose
         self._output_padding = _tup(output_padding, ndim)
-        if transpose:
-            wshape = (in_channels, channels // groups) + self._kernel
-        else:
-            wshape = (channels, in_channels // groups if in_channels else 0) + self._kernel
-        self.weight = Parameter(shape=wshape, init=weight_initializer,
+        self._layout = layout
+        self._channel_last = layout is not None and not layout.startswith("NC")
+        self.weight = Parameter(shape=self._weight_shape(in_channels),
+                                init=weight_initializer,
                                 allow_deferred_init=True, name="weight")
         self.bias = Parameter(shape=(channels,), init=bias_initializer,
                               allow_deferred_init=True, name="bias") if use_bias else None
 
-    def infer_shape(self, x, *args):
-        c_in = x.shape[1]
+    def _weight_shape(self, c_in):
+        g = self._groups
         if self._transpose:
-            self.weight.shape = (c_in, self._channels // self._groups) + self._kernel
+            major, minor = c_in, self._channels // g
         else:
-            self.weight.shape = (self._channels, c_in // self._groups) + self._kernel
+            major, minor = self._channels, (c_in // g if c_in else 0)
+        if self._channel_last:
+            return (major,) + self._kernel + (minor,)
+        return (major, minor) + self._kernel
+
+    def infer_shape(self, x, *args):
+        c_in = x.shape[-1] if self._channel_last else x.shape[1]
+        self.weight.shape = self._weight_shape(c_in)
         if self.bias is not None:
             self.bias.shape = (self._channels,)
 
@@ -66,14 +74,16 @@ class _Conv(HybridBlock):
                                     adj=self._output_padding,
                                     num_filter=self._channels,
                                     num_group=self._groups,
-                                    no_bias=self.bias is None)
+                                    no_bias=self.bias is None,
+                                    layout=self._layout)
         else:
             out = npx.convolution(x, self.weight.data(), b,
                                   kernel=self._kernel, stride=self._strides,
                                   dilate=self._dilation, pad=self._padding,
                                   num_filter=self._channels,
                                   num_group=self._groups,
-                                  no_bias=self.bias is None)
+                                  no_bias=self.bias is None,
+                                  layout=self._layout)
         if self._act is not None:
             out = npx.activation(out, act_type=self._act)
         return out
@@ -90,7 +100,8 @@ class Conv1D(_Conv):
                  in_channels=0, **kwargs):
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, 1, **kwargs)
+                         weight_initializer, bias_initializer, 1,
+                         layout=layout, **kwargs)
 
 
 class Conv2D(_Conv):
@@ -100,7 +111,8 @@ class Conv2D(_Conv):
                  bias_initializer="zeros", in_channels=0, **kwargs):
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, 2, **kwargs)
+                         weight_initializer, bias_initializer, 2,
+                         layout=layout, **kwargs)
 
 
 class Conv3D(_Conv):
@@ -111,7 +123,8 @@ class Conv3D(_Conv):
                  in_channels=0, **kwargs):
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, 3, **kwargs)
+                         weight_initializer, bias_initializer, 3,
+                         layout=layout, **kwargs)
 
 
 class Conv1DTranspose(_Conv):
@@ -122,7 +135,8 @@ class Conv1DTranspose(_Conv):
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, in_channels, activation, use_bias,
                          weight_initializer, bias_initializer, 1,
-                         transpose=True, output_padding=output_padding, **kwargs)
+                         transpose=True, output_padding=output_padding,
+                         layout=layout, **kwargs)
 
 
 class Conv2DTranspose(_Conv):
@@ -134,7 +148,8 @@ class Conv2DTranspose(_Conv):
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, in_channels, activation, use_bias,
                          weight_initializer, bias_initializer, 2,
-                         transpose=True, output_padding=output_padding, **kwargs)
+                         transpose=True, output_padding=output_padding,
+                         layout=layout, **kwargs)
 
 
 class Conv3DTranspose(_Conv):
@@ -146,12 +161,13 @@ class Conv3DTranspose(_Conv):
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, in_channels, activation, use_bias,
                          weight_initializer, bias_initializer, 3,
-                         transpose=True, output_padding=output_padding, **kwargs)
+                         transpose=True, output_padding=output_padding,
+                         layout=layout, **kwargs)
 
 
 class _Pool(HybridBlock):
     def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
-                 pool_type, ndim, count_include_pad=True, **kwargs):
+                 pool_type, ndim, count_include_pad=True, layout=None, **kwargs):
         super().__init__(**kwargs)
         self._kernel = _tup(pool_size, ndim)
         self._stride = _tup(strides if strides is not None else pool_size, ndim)
@@ -160,13 +176,15 @@ class _Pool(HybridBlock):
         self._type = pool_type
         self._convention = "full" if ceil_mode else "valid"
         self._count_include_pad = count_include_pad
+        self._layout = layout
 
     def forward(self, x):
         return npx.pooling(x, kernel=self._kernel, pool_type=self._type,
                            stride=self._stride, pad=self._pad,
                            global_pool=self._global,
                            count_include_pad=self._count_include_pad,
-                           pooling_convention=self._convention)
+                           pooling_convention=self._convention,
+                           layout=self._layout)
 
     def __repr__(self):
         return f"{type(self).__name__}(size={self._kernel}, stride={self._stride})"
@@ -175,70 +193,73 @@ class _Pool(HybridBlock):
 class MaxPool1D(_Pool):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, **kwargs):
-        super().__init__(pool_size, strides, padding, ceil_mode, False, "max", 1, **kwargs)
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max", 1,
+                         layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pool):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, **kwargs):
-        super().__init__(pool_size, strides, padding, ceil_mode, False, "max", 2, **kwargs)
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max", 2,
+                         layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pool):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, **kwargs):
-        super().__init__(pool_size, strides, padding, ceil_mode, False, "max", 3, **kwargs)
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max", 3,
+                         layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pool):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(pool_size, strides, padding, ceil_mode, False, "avg", 1,
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class AvgPool2D(_Pool):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(pool_size, strides, padding, ceil_mode, False, "avg", 2,
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class AvgPool3D(_Pool):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(pool_size, strides, padding, ceil_mode, False, "avg", 3,
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class GlobalMaxPool1D(_Pool):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__(1, None, 0, False, True, "max", 1, **kwargs)
+        super().__init__(1, None, 0, False, True, "max", 1, layout=layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pool):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__(1, None, 0, False, True, "max", 2, **kwargs)
+        super().__init__(1, None, 0, False, True, "max", 2, layout=layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pool):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__(1, None, 0, False, True, "max", 3, **kwargs)
+        super().__init__(1, None, 0, False, True, "max", 3, layout=layout, **kwargs)
 
 
 class GlobalAvgPool1D(_Pool):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__(1, None, 0, False, True, "avg", 1, **kwargs)
+        super().__init__(1, None, 0, False, True, "avg", 1, layout=layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pool):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__(1, None, 0, False, True, "avg", 2, **kwargs)
+        super().__init__(1, None, 0, False, True, "avg", 2, layout=layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pool):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__(1, None, 0, False, True, "avg", 3, **kwargs)
+        super().__init__(1, None, 0, False, True, "avg", 3, layout=layout, **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

@@ -15,6 +15,7 @@ set_optimizer/Updater like the reference's update_on_kvstore path.
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional, Union
 
 import jax
@@ -170,13 +171,121 @@ KVStore.set_updater = KVStore._set_updater
 
 @KVStoreBase.register
 class TPUKVStore(KVStore):
-    """Default backend: single-host reduction now; across hosts the gradient
-    allreduce rides the shard_map psum in parallel.train_step (ICI/DCN) —
-    this object then only carries optimizer state + API compat, exactly how
-    the reference's Horovod plugin delegates comm (kvstore/horovod.py:26)."""
+    """Default backend. Single-process: local reduction (like 'device').
+    Multi-process: values are additionally allreduced across the process
+    group — the sync semantics of the reference's dist_sync mode
+    (src/kvstore/kvstore_dist_server.h sync aggregation; every worker sees
+    the same reduced value before continuing). The process group must be
+    joined first via mxnet_tpu.parallel.dist.init (tools/launch.py sets the
+    env). Inside jitted SPMD train steps gradients ride psum over ICI/DCN
+    instead (parallel/trainer.py) — this store is the host-side compat path,
+    the way the reference's Horovod plugin delegates comm
+    (kvstore/horovod.py:26).
+
+    Optimizer-on-store in dist mode: the reference runs the updater once on
+    the server with the aggregated gradient and workers pull the result;
+    here every process applies the same deterministic updater to the same
+    aggregated value — equivalent trajectories as long as initial store
+    state matches (broadcast() guarantees it, seeding from rank 0)."""
 
     def __init__(self, name: str = "tpu"):
         super().__init__(name)
+        # The reference's dist kvstore connects the worker to the tracker at
+        # construction (kvstore_dist.h Van start). Same here: if a launcher
+        # advertised a multi-process job (env) but the group isn't joined
+        # yet, join now — and fail loudly if that's impossible, because
+        # proceeding would silently train N divergent single-process models.
+        from ..parallel import dist
+
+        want = os.environ.get("MXNET_DIST_NUM_PROCESSES") or \
+            os.environ.get("DMLC_NUM_WORKER")
+        if want and int(want) > 1 and jax.process_count() == 1:
+            try:
+                dist.init()
+            except Exception as e:
+                raise MXNetError(
+                    f"kvstore '{name}': launcher advertises {want} processes "
+                    f"but joining the group failed ({e}); call "
+                    "mxnet_tpu.parallel.dist.init() before any jax API use"
+                ) from e
+
+    def _global_sum(self, x):
+        if self.num_workers > 1:
+            # process_count>1 implies the group is joined (jax can't see
+            # remote processes otherwise)
+            from ..parallel import dist
+
+            return dist.allreduce_host(x)
+        return x
+
+    def broadcast(self, key, value, out, priority=0):
+        vals = _as_list(value)
+        src = vals[0]._data
+        if self.num_workers > 1:
+            from ..parallel import dist
+
+            src = dist.broadcast_host(src)
+        self._store[key] = NDArray(src)
+        for o in _as_list(out):
+            o._set_data(jax.device_put(src, o.ctx.jax_device()))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        vals = _as_list(value)
+        if len(vals) == 1:
+            reduced = vals[0]._data
+        else:
+            reduced = jnp.sum(jnp.stack([v._data for v in vals]), axis=0)
+        reduced = self._global_sum(reduced)
+        if self._updater is not None:
+            if key not in self._store:
+                raise MXNetError(f"key {key} must be init'd (broadcast) "
+                                 "before pushpull")
+            self._updater(key, NDArray(reduced), self._store[key])
+            result = self._store[key]._data
+        else:
+            result = reduced
+        if out is not None:
+            for o in _as_list(out):
+                o._set_data(jax.device_put(result, o.ctx.jax_device())
+                            .astype(o._data.dtype))
+        else:
+            for v in vals:
+                v._set_data(jax.device_put(result, v.ctx.jax_device()))
+
+    def pushpull_group(self, keys, values, outs=None):
+        """Fused pushpull over many keys: ONE cross-process collective for
+        the whole group instead of one per key. The reference batches too —
+        its NCCL store sorts keys by size and fuses (kvstore_nccl.h); here
+        per-key local reductions are concatenated into one flat buffer per
+        dtype, allreduced once, and split back. Only valid without an
+        optimizer-on-store (Trainer's allreduce path)."""
+        if self._updater is not None:
+            raise MXNetError("pushpull_group does not support "
+                             "optimizer-on-store; use per-key pushpull")
+        outs = values if outs is None else outs
+        reduced = []
+        for vals in values:
+            vs = _as_list(vals)
+            reduced.append(vs[0]._data if len(vs) == 1 else
+                           jnp.sum(jnp.stack([v._data for v in vs]), axis=0))
+        if self.num_workers > 1:
+            from ..parallel import dist
+
+            by_dtype: Dict[Any, List[int]] = {}
+            for i, r in enumerate(reduced):
+                by_dtype.setdefault(jnp.dtype(r.dtype), []).append(i)
+            for dt, idxs in by_dtype.items():
+                flat = jnp.concatenate([reduced[i].ravel() for i in idxs])
+                flat = jnp.asarray(dist.allreduce_host(flat))
+                off = 0
+                for i in idxs:
+                    n = reduced[i].size
+                    reduced[i] = flat[off:off + n].reshape(reduced[i].shape)
+                    off += n
+        for r, out in zip(reduced, outs):
+            for o in _as_list(out):
+                o._set_data(jax.device_put(r, o.ctx.jax_device())
+                            .astype(o._data.dtype))
 
     @property
     def rank(self) -> int:
@@ -189,8 +298,10 @@ class TPUKVStore(KVStore):
 
 def create(name: str = "tpu") -> KVStoreBase:
     """Factory (ref src/kvstore/kvstore.cc:42-85). Accepts reference names:
-    local/device → KVStore; tpu/dist/dist_sync/dist_device_sync/dist_tpu →
-    TPUKVStore; horovod/byteps raise with guidance."""
+    local/device → KVStore (single-process); tpu/dist/dist_sync/
+    dist_device_sync/dist_tpu → TPUKVStore (cross-process allreduce when a
+    process group is joined). 'dist_async' maps to the same sync store —
+    stronger consistency than the reference's async server, never weaker."""
     name = name.lower()
     if name in ("local", "device", "nccl"):
         return KVStore(name)

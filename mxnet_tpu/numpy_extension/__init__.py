@@ -96,20 +96,21 @@ def convolution(data, weight, bias=None, kernel=None, stride=1, dilate=1,
 
     def f(x, w, b=None):
         return _nn.convolution(x, w, b, stride=stride, dilate=dilate, pad=pad,
-                               num_group=num_group, no_bias=no_bias)
+                               num_group=num_group, no_bias=no_bias,
+                               layout=layout)
 
     return call(f, args, {}, name="convolution")
 
 
 def deconvolution(data, weight, bias=None, kernel=None, stride=1, dilate=1,
                   pad=0, adj=0, num_filter=None, num_group=1, no_bias=False,
-                  target_shape=None, **kw):
+                  target_shape=None, layout=None, **kw):
     args = (data, weight) if bias is None or no_bias else (data, weight, bias)
 
     def f(x, w, b=None):
         return _nn.deconvolution(x, w, b, stride=stride, dilate=dilate, pad=pad,
                                  adj=adj, num_group=num_group, no_bias=no_bias,
-                                 target_shape=target_shape)
+                                 target_shape=target_shape, layout=layout)
 
     return call(f, args, {}, name="deconvolution")
 
@@ -120,7 +121,8 @@ def pooling(data, kernel=1, pool_type="max", stride=None, pad=0,
     return call(lambda x: _nn.pooling(x, kernel=kernel, pool_type=pool_type,
                                       stride=stride, pad=pad, global_pool=global_pool,
                                       count_include_pad=count_include_pad,
-                                      pooling_convention=pooling_convention),
+                                      pooling_convention=pooling_convention,
+                                      layout=layout),
                 (data,), {}, name=f"pooling_{pool_type}")
 
 

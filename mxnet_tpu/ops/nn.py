@@ -3,10 +3,15 @@
 TPU-native replacement for src/operator/nn/ (32.2k LoC of CUDA/cuDNN/MKL-DNN
 kernels, SURVEY.md §2.2): convolution/deconvolution → lax.conv_general_dilated
 (lowers onto the MXU), pooling → lax.reduce_window, norms/softmax →
-jnp reductions that XLA fuses, fully_connected → dot_general. Layouts follow
-the reference default NCHW/OIHW (src/operator/nn/convolution-inl.h); XLA's
-layout assignment re-tiles for the MXU so no NHWC rewrite is needed at the
-API level.
+jnp reductions that XLA fuses, fully_connected → dot_general.
+
+Layouts: the reference exposes a ``layout`` parameter on conv/pool
+(src/operator/nn/convolution-inl.h, mshadow layout enums); default is
+channel-first NCHW/OIHW, with NHWC/NWC/NDHWC as the channel-last variants
+(weights then OHWI-style, matching the reference's mshadow mapping).
+Channel-last is the TPU-preferred layout: the channel dim maps onto the
+128-lane minor tile, so bf16 convs feed the MXU without the layout-transpose
+pairs XLA otherwise inserts around NCHW convs.
 
 All functions here take/return raw jax arrays; NDArray lifting happens in
 numpy_extension (npx).
@@ -52,46 +57,86 @@ def fully_connected(x, weight, bias=None, num_hidden: Optional[int] = None,
 
 # -- convolution -------------------------------------------------------------
 
-def _conv_dn(ndim: int):
-    if ndim == 3:
-        return ("NCH", "OIH", "NCH")
-    if ndim == 4:
-        return ("NCHW", "OIHW", "NCHW")
-    if ndim == 5:
-        return ("NCDHW", "OIDHW", "NCDHW")
-    raise MXNetError(f"convolution expects 3-5d input, got {ndim}d")
+_CHANNEL_FIRST = {3: "NCW", 4: "NCHW", 5: "NCDHW"}
+_CHANNEL_LAST = {3: "NWC", 4: "NHWC", 5: "NDHWC"}
+
+
+def _norm_layout(layout: Optional[str], ndim: int) -> str:
+    """Validate/default a conv layout string for an ndim-d input."""
+    if ndim not in _CHANNEL_FIRST:
+        raise MXNetError(f"convolution expects 3-5d input, got {ndim}d")
+    if layout is None:
+        return _CHANNEL_FIRST[ndim]
+    layout = str(layout)
+    if layout not in (_CHANNEL_FIRST[ndim], _CHANNEL_LAST[ndim]):
+        raise MXNetError(
+            f"unsupported layout {layout!r} for {ndim}d convolution; "
+            f"expected {_CHANNEL_FIRST[ndim]} or {_CHANNEL_LAST[ndim]}")
+    return layout
+
+
+def _conv_dn(layout: str):
+    """lhs/rhs/out dimension-number specs for a layout string.
+
+    Channel-first NCHW pairs with OIHW weights, channel-last NHWC with OHWI —
+    the reference's mshadow ConvertLayout mapping (convolution-inl.h)."""
+    spatial = layout.replace("N", "").replace("C", "")
+    if layout[1] == "C":  # channel-first
+        return (layout, "OI" + spatial, layout)
+    return (layout, "O" + spatial + "I", layout)
+
+
+def _bias_shape(layout: str):
+    """Broadcast shape placing the channel dim per layout."""
+    return tuple(-1 if c == "C" else 1 for c in layout)
 
 
 def convolution(x, weight, bias=None, kernel=None, stride=1, dilate=1, pad=0,
                 num_filter: Optional[int] = None, num_group: int = 1,
                 no_bias: bool = False, layout: Optional[str] = None):
-    """N-D convolution, NCHW/OIHW (ref: src/operator/nn/convolution.cc).
+    """N-D convolution (ref: src/operator/nn/convolution.cc).
 
-    Grouped conv (num_group>1) maps to feature_group_count — depthwise convs
-    stay a single fused XLA op instead of the reference's special depthwise
-    kernel (src/operator/nn/depthwise_convolution-inl.h)."""
+    layout selects NCHW/OIHW (reference default) or NHWC/OHWI (TPU-preferred
+    channel-last). Grouped conv (num_group>1) maps to feature_group_count —
+    depthwise convs stay a single fused XLA op instead of the reference's
+    special depthwise kernel (src/operator/nn/depthwise_convolution-inl.h)."""
     n = x.ndim - 2
+    layout = _norm_layout(layout, x.ndim)
     strides = _tuple(stride, n)
     dilation = _tuple(dilate, n)
     padding = [(p, p) for p in _tuple(pad, n)]
-    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _conv_dn(x.ndim))
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _conv_dn(layout))
     y = lax.conv_general_dilated(
         x, weight, window_strides=strides, padding=padding,
         rhs_dilation=dilation, dimension_numbers=dn,
         feature_group_count=num_group,
         preferred_element_type=None)
     if bias is not None and not no_bias:
-        y = y + bias.reshape((1, -1) + (1,) * n)
+        y = y + bias.reshape(_bias_shape(layout))
     return y
 
 
 def deconvolution(x, weight, bias=None, kernel=None, stride=1, dilate=1, pad=0,
                   adj=0, num_filter: Optional[int] = None, num_group: int = 1,
-                  no_bias: bool = False, target_shape=None):
+                  no_bias: bool = False, target_shape=None,
+                  layout: Optional[str] = None):
     """Transposed convolution (ref: src/operator/nn/deconvolution.cc).
 
     Implemented as the gradient of convolution: lax.conv_transpose with
-    IOHW-style kernel (reference stores weight as (in, out/group, *k))."""
+    IOHW-style kernel (reference stores weight as (in, out/group, *k)).
+    Channel-last layouts are handled by transposing around the channel-first
+    kernel (deconv is off the model-zoo hot path; XLA fuses the transposes)."""
+    lay = _norm_layout(layout, x.ndim)
+    if lay[1] != "C":  # channel-last: NHWC x, IHWO-style weight
+        perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+        wperm = (0, weight.ndim - 1) + tuple(range(1, weight.ndim - 1))
+        y = deconvolution(jnp.transpose(x, perm), jnp.transpose(weight, wperm),
+                          bias, kernel=kernel, stride=stride, dilate=dilate,
+                          pad=pad, adj=adj, num_filter=num_filter,
+                          num_group=num_group, no_bias=no_bias,
+                          target_shape=target_shape)
+        inv = (0,) + tuple(range(2, x.ndim)) + (1,)
+        return jnp.transpose(y, inv)
     n = x.ndim - 2
     strides = _tuple(stride, n)
     dilation = _tuple(dilate, n)
@@ -107,7 +152,7 @@ def deconvolution(x, weight, bias=None, kernel=None, stride=1, dilate=1, pad=0,
         padding.append((lo, hi))
     x_dilated_dn = lax.conv_dimension_numbers(
         x.shape, (weight.shape[1] * num_group, weight.shape[0] // num_group) + kshape,
-        _conv_dn(x.ndim))
+        _conv_dn(_CHANNEL_FIRST[x.ndim]))
     # flip spatial dims + swap in/out channels → conv on lhs-dilated input
     w = jnp.flip(weight, axis=tuple(range(2, weight.ndim)))
     if num_group > 1:
@@ -130,24 +175,27 @@ def deconvolution(x, weight, bias=None, kernel=None, stride=1, dilate=1, pad=0,
 def pooling(x, kernel=1, pool_type: str = "max", stride=None, pad=0,
             global_pool: bool = False, count_include_pad: bool = True,
             pooling_convention: str = "valid", layout=None):
-    """Max/avg/lp pooling over NC+spatial (ref: src/operator/nn/pooling.cc)."""
+    """Max/avg/lp pooling (ref: src/operator/nn/pooling.cc); layout selects
+    channel-first (NCHW, reference default) or channel-last (NHWC)."""
     n = x.ndim - 2
+    lay = _norm_layout(layout, x.ndim)
+    last = lay[1] != "C"  # channel-last
     if global_pool:
-        axes = tuple(range(2, x.ndim))
+        axes = tuple(range(1, x.ndim - 1)) if last else tuple(range(2, x.ndim))
         if pool_type == "max":
             return jnp.max(x, axis=axes, keepdims=True)
         return jnp.mean(x, axis=axes, keepdims=True)
     ks = _tuple(kernel, n)
     strides = _tuple(stride if stride is not None else ks, n)
     pads = _tuple(pad, n)
-    window = (1, 1) + ks
-    strides_f = (1, 1) + strides
+    window = (1,) + ks + (1,) if last else (1, 1) + ks
+    strides_f = (1,) + strides + (1,) if last else (1, 1) + strides
     if pooling_convention == "full":
         # ceil-mode: pad high edge enough that ceil division is covered
-        padding = ((0, 0), (0, 0)) + tuple(
-            (p, p + s - 1) for p, s in zip(pads, strides))
+        sp = tuple((p, p + s - 1) for p, s in zip(pads, strides))
     else:
-        padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+        sp = tuple((p, p) for p in pads)
+    padding = ((0, 0),) + sp + ((0, 0),) if last else ((0, 0), (0, 0)) + sp
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, window, strides_f, padding)

@@ -1,0 +1,162 @@
+"""Multi-process (multi-host) distributed execution.
+
+The reference's multi-node story is a ps-lite parameter server wired by env
+vars (DMLC_ROLE/DMLC_PS_ROOT_URI/DMLC_NUM_WORKER, src/kvstore/kvstore_dist.h;
+launcher tools/launch.py:72-116). TPU-native replacement: no server processes
+— every process joins one JAX coordination service (jax.distributed), all
+reduction is an XLA collective over ICI/DCN (or gloo on CPU hosts for tests).
+This module owns process-group lifecycle + host-level collectives; the
+KVStore/Trainer layers call into it so the reference API keeps working
+multi-process (kvstore 'dist_sync' ≈ sync allreduce semantics of
+kvstore_dist_server.h sync mode).
+
+Env vars (set by tools/launch.py; DMLC_* aliases accepted for parity):
+
+  MXNET_DIST_COORDINATOR    host:port of process 0's coordinator
+  MXNET_DIST_NUM_PROCESSES  world size
+  MXNET_DIST_PROCESS_ID     this process's rank
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..base import MXNetError
+
+_initialized = False
+
+
+def _env(*names, default=None):
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return default
+
+
+def init(coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None,
+         local_device_ids=None) -> None:
+    """Join the process group (ref: ps-lite Van start, kvstore_dist.h:431
+    worker connect). Reads MXNET_DIST_*/DMLC_* env when args are omitted;
+    no-op when already initialized or when running single-process."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or _env(
+        "MXNET_DIST_COORDINATOR")
+    if coordinator_address is None:
+        uri = _env("DMLC_PS_ROOT_URI")
+        port = _env("DMLC_PS_ROOT_PORT")
+        if uri and port:
+            coordinator_address = f"{uri}:{port}"
+    if num_processes is None:
+        v = _env("MXNET_DIST_NUM_PROCESSES", "DMLC_NUM_WORKER")
+        num_processes = int(v) if v else None
+    if process_id is None:
+        v = _env("MXNET_DIST_PROCESS_ID", "DMLC_WORKER_ID")
+        process_id = int(v) if v else None
+    if coordinator_address is None:
+        if num_processes in (None, 1):
+            return  # single process — nothing to join
+        raise MXNetError(
+            "multi-process init needs a coordinator address: set "
+            "MXNET_DIST_COORDINATOR (tools/launch.py does) or pass "
+            "coordinator_address=")
+    import jax
+
+    # CPU multi-process collectives ride gloo (the DCN-emulation path used
+    # by the nightly-style localhost tests; real pods use ICI/DCN). The
+    # setting only affects the CPU backend, so apply it unconditionally —
+    # gating on the selected platform would miss auto-selected CPU.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    try:
+        jax.distributed.initialize(coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id,
+                                   local_device_ids=local_device_ids)
+    except RuntimeError as e:
+        # user already called jax.distributed.initialize() directly —
+        # standard JAX practice on pods; adopt their group rather than fail
+        if "already initialized" not in str(e).lower():
+            raise
+    _initialized = True
+
+
+def initialized() -> bool:
+    return _initialized
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        import jax
+
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def rank() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def num_workers() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+# -- host-level collectives ---------------------------------------------------
+# These move *host-resident* values between processes — the analogue of the
+# reference's ZPush/ZPull worker↔server hop (kvstore_dist.h:431,518). Device-
+# resident training state never goes through here; it is psum'd inside the
+# jitted SPMD step (parallel/trainer.py) where XLA owns the collective.
+
+def allgather_host(x):
+    """Gather a same-shaped host value from every process → stacked along a
+    new leading axis (world_size, *x.shape), identical on all ranks."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x)
+
+
+def allreduce_host(x, average: bool = False):
+    """Sum (or average) a host value across processes; sync semantics match
+    the reference's dist_sync mode (kvstore_dist_server.h sync aggregation)."""
+    import jax.numpy as jnp
+
+    g = allgather_host(x)
+    out = jnp.mean(g, axis=0) if average else jnp.sum(g, axis=0)
+    return out
+
+
+def broadcast_host(x, root: int = 0):
+    """Broadcast rank root's host value to every process (ref
+    KVStore::Broadcast / ps-lite init pull)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return x
+    if root != 0:
+        raise MXNetError("broadcast_host supports root=0 only "
+                         "(multihost_utils.broadcast_one_to_all semantics)")
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(x)
+
+
+def barrier(name: str = "mx_barrier") -> None:
+    """Block until every process reaches this point (ref ps-lite Barrier)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

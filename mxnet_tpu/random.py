@@ -18,31 +18,46 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["seed", "key_holder", "next_key", "split_key"]
 
-# raw uint32[2] representation so it serializes/travels like a normal array
-_KEY = NDArray(jax.random.key_data(jax.random.PRNGKey(0)))
+# Lazily created on first use: materializing a PRNGKey compiles a tiny XLA
+# computation, and `import mxnet_tpu` must not touch the backend —
+# jax.distributed.initialize() (parallel/dist.py) is only legal before the
+# backend client exists. Identity is stable: the same NDArray object is
+# rebound in place forever after, so hybridize traces holding key_holder()
+# keep seeing updates.
+_KEY = NDArray.__new__(NDArray)
+_KEY_READY = False
+
+
+def _ensure_key():
+    global _KEY_READY
+    if not _KEY_READY:
+        _KEY.__init__(jax.random.key_data(jax.random.PRNGKey(0)))
+        _KEY_READY = True
+    return _KEY
 
 
 def key_holder() -> NDArray:
     """The NDArray holding the current raw key; hybridize traces include it
     in their implicit state so draws stay live under jit."""
-    return _KEY
+    return _ensure_key()
 
 
 def seed(seed_state: int, ctx=None):
     """Seed the global generator (ref: mx.random.seed python/mxnet/random.py)."""
-    _KEY._set_data(jax.random.key_data(jax.random.PRNGKey(int(seed_state))))
+    _ensure_key()._set_data(
+        jax.random.key_data(jax.random.PRNGKey(int(seed_state))))
 
 
 def next_key():
     """Advance the global state and return a fresh typed key for one draw."""
-    k = jax.random.wrap_key_data(_KEY._data)
+    k = jax.random.wrap_key_data(_ensure_key()._data)
     new, sub = jax.random.split(k)
     _KEY._set_data(jax.random.key_data(new))
     return sub
 
 
 def split_key(n: int):
-    k = jax.random.wrap_key_data(_KEY._data)
+    k = jax.random.wrap_key_data(_ensure_key()._data)
     keys = jax.random.split(k, n + 1)
     _KEY._set_data(jax.random.key_data(keys[0]))
     return keys[1:]

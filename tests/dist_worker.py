@@ -1,0 +1,83 @@
+"""Multi-process worker run by tests/test_dist.py via tools/launch.py.
+
+Port of the reference's nightly multi-node checks
+(tests/nightly/dist_sync_kvstore.py:102-419): numeric equality of synced
+values across ranks, then a 10-step Gluon Trainer run whose parameters must
+stay bit-exact across all ranks despite per-rank data.
+
+Not collected by pytest (no test_ prefix) — it asserts on its own and
+prints DIST-OK on success; the launcher propagates any failure.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.parallel import dist  # noqa: E402
+
+
+def main():
+    dist.init()
+    rank, nw = dist.rank(), dist.num_workers()
+    assert nw == int(os.environ["MXNET_DIST_NUM_PROCESSES"]), \
+        (nw, os.environ["MXNET_DIST_NUM_PROCESSES"])
+
+    # -- kvstore numeric equality (ref dist_sync_kvstore.py check_diff) -----
+    kv = mx.kvstore.create("dist_sync")
+    assert kv.rank == rank and kv.num_workers == nw
+    v = mx.np.ones((3, 4)) * (rank + 1)
+    out = mx.np.zeros((3, 4))
+    kv.pushpull("k1", v, out=out)
+    expect = float(sum(range(1, nw + 1)))
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((3, 4), expect))
+
+    # broadcast: every rank ends with rank 0's value
+    b = mx.np.full((2, 2), float(rank + 5))
+    o = mx.np.zeros((2, 2))
+    kv.broadcast("k2", b, o)
+    onp.testing.assert_allclose(o.asnumpy(), onp.full((2, 2), 5.0))
+
+    # -- 10-step trainer lockstep (ref dist_sync gluon-trainer rows) --------
+    mx.random.seed(7)  # identical init on every rank
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(32, activation="relu"),
+            mx.gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((2, 16)))
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1, "momentum": 0.9},
+                               kvstore="dist_sync")
+
+    rs = onp.random.RandomState(100 + rank)  # per-rank data
+    for _ in range(10):
+        x = mx.np.array(rs.rand(8, 16).astype("float32"))
+        y = mx.np.array(rs.randint(0, 10, size=(8,)).astype("int32"))
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(8)
+
+    flat = onp.concatenate([p.data().asnumpy().ravel()
+                            for _, p in sorted(net.collect_params().items())])
+    gathered = onp.asarray(dist.allgather_host(flat))
+    for r in range(nw):
+        onp.testing.assert_array_equal(
+            gathered[0], gathered[r],
+            err_msg=f"rank {r} params diverged from rank 0")
+
+    dist.barrier()
+    print(f"DIST-OK rank {rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

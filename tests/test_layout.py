@@ -1,0 +1,138 @@
+"""Channel-last (NHWC) layout support through conv/pool/model-zoo.
+
+The reference exposes ``layout=`` on conv/pool layers
+(src/operator/nn/convolution-inl.h mshadow layout enums;
+python/mxnet/gluon/nn/conv_layers.py). On TPU channel-last is the
+MXU-preferred layout; these tests pin NHWC numerics to the NCHW reference
+path (weights related by OIHW→OHWI transpose).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+
+
+def _rand(*shape, seed=0):
+    return onp.random.RandomState(seed).rand(*shape).astype("float32")
+
+
+def test_conv2d_nhwc_matches_nchw():
+    mx.random.seed(0)
+    c1 = nn.Conv2D(8, 3, strides=2, padding=1, in_channels=4)
+    c2 = nn.Conv2D(8, 3, strides=2, padding=1, in_channels=4, layout="NHWC")
+    c1.initialize()
+    c2.initialize()
+    w = c1.weight.data().asnumpy()
+    c2.weight.set_data(mx.np.array(onp.transpose(w, (0, 2, 3, 1))))
+    c2.bias.set_data(c1.bias.data())
+    x = _rand(2, 4, 16, 16)
+    y1 = c1(mx.np.array(x)).asnumpy()
+    y2 = c2(mx.np.array(onp.transpose(x, (0, 2, 3, 1)))).asnumpy()
+    assert y2.shape == (2, 8, 8, 8)
+    onp.testing.assert_allclose(y1, onp.transpose(y2, (0, 3, 1, 2)), atol=1e-5)
+
+
+def test_conv2d_nhwc_grouped():
+    mx.random.seed(0)
+    c1 = nn.Conv2D(8, 3, padding=1, groups=4, in_channels=8)
+    c2 = nn.Conv2D(8, 3, padding=1, groups=4, in_channels=8, layout="NHWC")
+    c1.initialize()
+    c2.initialize()
+    w = c1.weight.data().asnumpy()
+    c2.weight.set_data(mx.np.array(onp.transpose(w, (0, 2, 3, 1))))
+    c2.bias.set_data(c1.bias.data())
+    x = _rand(2, 8, 9, 9)
+    y1 = c1(mx.np.array(x)).asnumpy()
+    y2 = c2(mx.np.array(onp.transpose(x, (0, 2, 3, 1)))).asnumpy()
+    onp.testing.assert_allclose(y1, onp.transpose(y2, (0, 3, 1, 2)), atol=1e-5)
+
+
+def test_conv1d_nwc():
+    mx.random.seed(0)
+    c1 = nn.Conv1D(6, 3, padding=1, in_channels=4)
+    c2 = nn.Conv1D(6, 3, padding=1, in_channels=4, layout="NWC")
+    c1.initialize()
+    c2.initialize()
+    w = c1.weight.data().asnumpy()
+    c2.weight.set_data(mx.np.array(onp.transpose(w, (0, 2, 1))))
+    c2.bias.set_data(c1.bias.data())
+    x = _rand(2, 4, 11)
+    y1 = c1(mx.np.array(x)).asnumpy()
+    y2 = c2(mx.np.array(onp.transpose(x, (0, 2, 1)))).asnumpy()
+    onp.testing.assert_allclose(y1, onp.transpose(y2, (0, 2, 1)), atol=1e-5)
+
+
+def test_conv2d_transpose_nhwc():
+    mx.random.seed(0)
+    c1 = nn.Conv2DTranspose(6, 3, strides=2, padding=1, output_padding=1,
+                            in_channels=4)
+    c2 = nn.Conv2DTranspose(6, 3, strides=2, padding=1, output_padding=1,
+                            in_channels=4, layout="NHWC")
+    c1.initialize()
+    c2.initialize()
+    w = c1.weight.data().asnumpy()  # (in, out/g, kh, kw)
+    c2.weight.set_data(mx.np.array(onp.transpose(w, (0, 2, 3, 1))))
+    c2.bias.set_data(c1.bias.data())
+    x = _rand(2, 4, 7, 7)
+    y1 = c1(mx.np.array(x)).asnumpy()
+    y2 = c2(mx.np.array(onp.transpose(x, (0, 2, 3, 1)))).asnumpy()
+    onp.testing.assert_allclose(y1, onp.transpose(y2, (0, 3, 1, 2)), atol=1e-5)
+
+
+@pytest.mark.parametrize("pool_cls,kw", [
+    (nn.MaxPool2D, dict(pool_size=3, strides=2, padding=1)),
+    (nn.AvgPool2D, dict(pool_size=2, strides=2)),
+    (nn.AvgPool2D, dict(pool_size=3, strides=2, padding=1,
+                        count_include_pad=False)),
+    (nn.MaxPool2D, dict(pool_size=3, strides=2, ceil_mode=True)),
+    (nn.GlobalAvgPool2D, dict()),
+    (nn.GlobalMaxPool2D, dict()),
+])
+def test_pool_nhwc_matches_nchw(pool_cls, kw):
+    p1 = pool_cls(**kw)
+    p2 = pool_cls(layout="NHWC", **kw)
+    x = _rand(2, 4, 15, 15, seed=1)
+    y1 = p1(mx.np.array(x)).asnumpy()
+    y2 = p2(mx.np.array(onp.transpose(x, (0, 2, 3, 1)))).asnumpy()
+    onp.testing.assert_allclose(y1, onp.transpose(y2, (0, 3, 1, 2)), atol=1e-6)
+
+
+def test_bad_layout_raises():
+    with pytest.raises(mx.base.MXNetError):
+        c = nn.Conv2D(4, 3, in_channels=2, layout="CHWN")
+        c.initialize()
+        c(mx.np.zeros((1, 2, 8, 8)))
+
+
+def test_resnet18_nhwc_matches_nchw():
+    mx.random.seed(1)
+    n1 = mx.gluon.model_zoo.get_model("resnet18_v1", classes=10)
+    n1.initialize(mx.init.Xavier())
+    n1(mx.np.zeros((2, 3, 32, 32)))
+    mx.random.seed(1)
+    n2 = mx.gluon.model_zoo.get_model("resnet18_v1", classes=10, layout="NHWC")
+    n2.initialize(mx.init.Xavier())
+    n2(mx.np.zeros((2, 32, 32, 3)))
+    p1d = dict(n1.collect_params().items())
+    p2d = dict(n2.collect_params().items())
+    assert set(p1d) == set(p2d)
+    for k, p in p1d.items():
+        v = p.data().asnumpy()
+        tgt = p2d[k]
+        if v.ndim == 4 and tuple(tgt.shape) != tuple(v.shape):
+            v = onp.transpose(v, (0, 2, 3, 1))
+        assert tuple(tgt.shape) == tuple(v.shape)
+        tgt.set_data(mx.np.array(v))
+    x = _rand(2, 3, 32, 32, seed=3)
+    o1 = n1(mx.np.array(x)).asnumpy()
+    o2 = n2(mx.np.array(onp.transpose(x, (0, 2, 3, 1)))).asnumpy()
+    onp.testing.assert_allclose(o1, o2, atol=1e-4)
+
+
+def test_resnet_v2_nhwc_forward_shape():
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.get_model("resnet18_v2", classes=7, layout="NHWC")
+    net.initialize(mx.init.Xavier())
+    out = net(mx.np.zeros((2, 32, 32, 3)))
+    assert out.shape == (2, 7)

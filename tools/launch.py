@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Distributed job launcher (ref: tools/launch.py:72-116, dmlc-tracker).
+
+Reference semantics: submit N workers (+ servers) via local/ssh/mpi
+launchers, plumbing DMLC_* env vars so each process finds the tracker.
+TPU-native version: no server processes exist — every worker joins one JAX
+coordination service (mxnet_tpu.parallel.dist). This launcher forks N local
+worker processes (--launcher local, the mode the reference's nightly dist
+tests use: tests/nightly/test_distributed_training-gpu.sh:5-18) or prints
+the per-host commands for ssh/pod launchers, setting:
+
+  MXNET_DIST_COORDINATOR    host:port of the rank-0 coordinator
+  MXNET_DIST_NUM_PROCESSES  world size
+  MXNET_DIST_PROCESS_ID     rank of the process
+
+Usage:
+  python tools/launch.py -n 4 python train.py --my-args
+  python tools/launch.py -n 2 --launcher local --port 23456 python worker.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(n: int, cmd, port=None, env_extra=None) -> int:
+    """Fork n local worker processes sharing one coordinator (ref
+    dmlc-tracker local launcher). Returns the first nonzero exit code."""
+    port = port or _free_port()
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env["MXNET_DIST_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["MXNET_DIST_NUM_PROCESSES"] = str(n)
+        env["MXNET_DIST_PROCESS_ID"] = str(rank)
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    try:
+        for p in procs:
+            r = p.wait()
+            if r != 0 and rc == 0:
+                rc = r
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        rc = 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return rc
+
+
+def print_ssh_plan(n: int, hosts, cmd, port: int) -> None:
+    """Emit the per-host command lines for an ssh/pod launcher (the
+    reference shells out to ssh directly; on TPU pods the platform launcher
+    — GKE/gcloud — runs one command per host, so we print the plan)."""
+    coord = f"{hosts[0]}:{port}"
+    for rank in range(n):
+        host = hosts[rank % len(hosts)]
+        envs = (f"MXNET_DIST_COORDINATOR={coord} "
+                f"MXNET_DIST_NUM_PROCESSES={n} MXNET_DIST_PROCESS_ID={rank}")
+        print(f"ssh {host} '{envs} {' '.join(cmd)}'")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job",
+        usage="launch.py [-h] -n N [--launcher {local,ssh}] command ...")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="file with one host per line (ssh launcher)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="coordinator port (default: pick a free one)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if not args.command:
+        ap.error("no command given")
+    if args.launcher == "local":
+        return launch_local(args.num_workers, args.command, port=args.port)
+    hosts = ["127.0.0.1"]
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [ln.strip() for ln in f if ln.strip()]
+    print_ssh_plan(args.num_workers, hosts, args.command,
+                   args.port or _free_port())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
