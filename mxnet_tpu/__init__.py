@@ -49,6 +49,10 @@ from . import recordio  # legacy alias: mx.recordio (ref python/mxnet/recordio.p
 from . import profiler
 from . import runtime
 from . import amp
+from . import symbol
+from . import symbol as sym
+from . import visualization
+from . import visualization as viz
 from . import contrib
 from . import parallel
 from . import test_utils

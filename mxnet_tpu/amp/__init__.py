@@ -124,3 +124,52 @@ def convert_hybrid_block(block, target_dtype="bfloat16", target_dtype_ops=None,
 
 
 convert_model = convert_hybrid_block
+
+
+def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
+                   fp32_ops=None, excluded_sym_names=None):
+    """Graph-level cast insertion on an mx.symbol.Symbol — the analogue of
+    the reference's ReducePrecision NNVM pass (src/nnvm/, amp.py
+    convert_symbol). MXU-class op nodes get their floating inputs cast to
+    the target dtype and their outputs cast back to fp32, so the heavy
+    matmuls run on the MXU in bf16/fp16 while the surrounding graph keeps
+    its dtype contract. Returns a new Symbol; casts appear as ``amp_cast``
+    nodes in tojson() like the reference's."""
+    from ..symbol.symbol import _Node, _unique, register_op
+
+    register_op("amp_cast", _amp_cast)
+    dt = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") \
+        else jnp.float16
+    allow = set(target_dtype_ops or _BF16_OPS)
+    deny = set(fp32_ops or ()) | set(excluded_sym_names or ())
+
+    def _cast_node(inp, dtype):
+        src, _ = inp
+        return (_Node(_unique(f"{src.name}_amp_cast"), "amp_cast",
+                      {"dtype": str(jnp.dtype(dtype))}, [inp]), 0)
+
+    def pass_fn(node, new_inputs):
+        if node.op not in allow or node.op in deny or node.name in deny:
+            return None
+        casted = [_cast_node(i, dt) for i in new_inputs]
+        core = _Node(node.name, node.op, dict(node.attrs), casted,
+                     node.fn, node.n_out)
+        if node.n_out != 1:
+            # multi-output ops (e.g. rnn with states): cast inputs only —
+            # a single-output cast wrapper would break consumers of
+            # outputs 1+ (rewrite enforces arity preservation)
+            return core
+        return _cast_node((core, 0), jnp.float32)[0]
+
+    return sym.rewrite(pass_fn)
+
+
+def _amp_cast(data, dtype="float32"):
+    """Registered symbol op: dtype cast that passes non-float data through
+    (ref: amp_cast op, src/operator/tensor/amp_cast.cc)."""
+    from ..ops.dispatch import call
+
+    d = jnp.dtype(dtype)
+    return call(lambda x: x.astype(d)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                (data,), {}, name="amp_cast")

@@ -25,7 +25,8 @@ from ..ndarray import NDArray
 from ..ops.dispatch import call
 
 __all__ = ["quantize", "dequantize", "requantize", "quantize_net",
-           "QuantizedDense", "QuantizedConv2D", "CalibrationCollector"]
+           "quantize_symbol", "QuantizedDense", "QuantizedConv2D",
+           "CalibrationCollector"]
 
 _INT8_RANGE = 127.0
 
@@ -164,6 +165,43 @@ def _quantize_weight_per_channel(w: jnp.ndarray, axis: int = 0):
     return wq, (amax / _INT8_RANGE).reshape(-1)  # dequant scale per channel
 
 
+def _int8_act_scale(x, threshold):
+    """Activation scale from a calibrated threshold (None → dynamic range)."""
+    t = jnp.max(jnp.abs(x)) if threshold is None else jnp.float32(threshold)
+    return jnp.where(t > 0, _INT8_RANGE / t, 1.0)
+
+
+def _int8_dense(flat, wq, wscale, bias, threshold):
+    """Shared int8 FC core: quantize activations, int8×int8→int32 on the
+    MXU, dequantize (used by both the block and the symbol rewrite path)."""
+    xs = _int8_act_scale(flat, threshold)
+    xq = jnp.clip(jnp.round(flat * xs), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, wq.T, (((flat.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (wscale / xs)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _int8_conv(x, wq, wscale, bias, threshold, strides, pads, dilation,
+               groups):
+    """Shared int8 conv core (NCHW), int32 accumulation."""
+    n = x.ndim - 2
+    xs = _int8_act_scale(x, threshold)
+    xq = jnp.clip(jnp.round(x * xs), -127, 127).astype(jnp.int8)
+    acc = jax.lax.conv_general_dilated(
+        xq, wq, window_strides=strides, padding=pads, rhs_dilation=dilation,
+        feature_group_count=groups,
+        preferred_element_type=jnp.int32)
+    scale_shape = (1, -1) + (1,) * n
+    out = acc.astype(jnp.float32) * (wscale.reshape(scale_shape) / xs)
+    if bias is not None:
+        out = out + bias.reshape(scale_shape)
+    return out
+
+
 class QuantizedDense:
     """Drop-in forward for a calibrated Dense (ref quantized_fully_connected.cc):
     int8 activations x int8 weights -> int32 on the MXU -> float32 out."""
@@ -187,16 +225,8 @@ class QuantizedDense:
     def __call__(self, x):
         def f(xr):
             flat = xr.reshape(xr.shape[0], -1) if self._flatten else xr
-            t = (jnp.max(jnp.abs(flat)) if self._t is None
-                 else jnp.float32(self._t))
-            xs = jnp.where(t > 0, _INT8_RANGE / t, 1.0)
-            xq = jnp.clip(jnp.round(flat * xs), -127, 127).astype(jnp.int8)
-            acc = jax.lax.dot_general(
-                xq, self._wq.T, (((flat.ndim - 1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32)
-            out = acc.astype(jnp.float32) * (self._wscale / xs)
-            if self._bias is not None:
-                out = out + self._bias
+            out = _int8_dense(flat, self._wq, self._wscale, self._bias,
+                              self._t)
             if self._act is not None:
                 from ..ops import nn as _opsnn
                 out = _opsnn.activation(out, self._act)
@@ -227,22 +257,11 @@ class QuantizedConv2D:
 
     def __call__(self, x):
         def f(xr):
-            t = (jnp.max(jnp.abs(xr)) if self._t is None
-                 else jnp.float32(self._t))
-            xs = jnp.where(t > 0, _INT8_RANGE / t, 1.0)
-            xq = jnp.clip(jnp.round(xr * xs), -127, 127).astype(jnp.int8)
             pad = [(self._padding[0], self._padding[0]),
                    (self._padding[1], self._padding[1])]
-            acc = jax.lax.conv_general_dilated(
-                xq, self._wq, window_strides=self._strides, padding=pad,
-                rhs_dilation=self._dilation,
-                feature_group_count=self._groups,
-                dimension_numbers=("NCHW", "OIHW", "NCHW"),
-                preferred_element_type=jnp.int32)
-            out = acc.astype(jnp.float32) * \
-                (self._wscale.reshape(1, -1, 1, 1) / xs)
-            if self._bias is not None:
-                out = out + self._bias.reshape(1, -1, 1, 1)
+            out = _int8_conv(xr, self._wq, self._wscale, self._bias,
+                             self._t, self._strides, pad, self._dilation,
+                             self._groups)
             if self._act is not None:
                 from ..ops import nn as _opsnn
                 out = _opsnn.activation(out, self._act)
@@ -349,3 +368,85 @@ class _QuantizedShim(_Block):
 
     def __repr__(self):
         return f"Quantized({getattr(self._q, 'name', '?')})"
+
+
+# ------------------------------------------------------ symbol-level pass
+def _quantized_fully_connected(x, weight, bias=None, threshold=None,
+                               num_hidden=None, no_bias=False, flatten=True,
+                               **kw):
+    """Registered symbol op: calibrated int8 FC (ref
+    src/operator/quantization/quantized_fully_connected.cc). Weights are
+    quantized per-channel at eval; threshold=None uses dynamic ranges."""
+    args = (x, weight) if bias is None or no_bias else (x, weight, bias)
+
+    def f(xr, w, *rest):
+        b = rest[0] if rest else None
+        flat = xr.reshape(xr.shape[0], -1) if flatten and xr.ndim > 2 else xr
+        wq, wscale = _quantize_weight_per_channel(w, axis=0)
+        return _int8_dense(flat, wq, wscale, b, threshold)
+
+    return call(f, args, {}, name="quantized_fully_connected")
+
+
+def _quantized_convolution(data, weight, bias=None, threshold=None,
+                           kernel=None, stride=1, dilate=1, pad=0,
+                           num_filter=None, num_group=1, no_bias=False,
+                           layout=None, **kw):
+    """Registered symbol op: calibrated int8 conv (ref quantized_conv.cc);
+    NCHW only — the int8 path is an inference rewrite, run it before any
+    layout conversion."""
+    from ..ops.nn import _tuple as _tup
+
+    if layout is not None and not str(layout).startswith("NC"):
+        raise MXNetError("quantized_convolution supports channel-first "
+                         "layouts only")
+    args = (data, weight) if bias is None or no_bias else (data, weight, bias)
+
+    def f(xr, w, *rest):
+        b = rest[0] if rest else None
+        n = xr.ndim - 2
+        wq, wscale = _quantize_weight_per_channel(w, axis=0)
+        return _int8_conv(xr, wq, wscale, b, threshold, _tup(stride, n),
+                          [(p, p) for p in _tup(pad, n)], _tup(dilate, n),
+                          num_group)
+
+    return call(f, args, {}, name="quantized_convolution")
+
+
+def quantize_symbol(sym, excluded_sym_names=(), excluded_op_names=(),
+                    thresholds=None, quantized_dtype="int8"):
+    """INT8 graph rewrite on an mx.symbol.Symbol — the analogue of the
+    reference's QuantizeGraph NNVM pass (src/operator/quantization/
+    quantize_graph_pass.cc:286). fully_connected / convolution nodes are
+    replaced by their quantized registry ops; ``thresholds`` maps node name
+    → calibrated activation threshold (from CalibrationCollector), missing
+    entries fall back to dynamic per-batch ranges.
+
+    Traced-closure nodes (built by symbol.trace / HybridBlock.symbolize)
+    carry no declarative attrs to rebuild from, so they are left unchanged
+    and reported; quantize the block with quantize_net instead. Returns
+    (quantized_symbol, skipped_node_names)."""
+    from ..symbol.symbol import _Node, register_op
+
+    if str(quantized_dtype) != "int8":
+        raise MXNetError("only int8 quantization is supported")
+    register_op("quantized_fully_connected", _quantized_fully_connected)
+    register_op("quantized_convolution", _quantized_convolution)
+    thresholds = dict(thresholds or {})
+    excluded = set(excluded_sym_names)
+    excluded_ops = set(excluded_op_names)
+    skipped = []
+
+    def pass_fn(node, new_inputs):
+        if node.op not in ("fully_connected", "convolution") or \
+                node.name in excluded or node.op in excluded_ops:
+            return None
+        if node.fn is not None:
+            skipped.append(node.name)
+            return None
+        attrs = dict(node.attrs)
+        attrs["threshold"] = thresholds.get(node.name)
+        return _Node(f"quantized_{node.name}", f"quantized_{node.op}",
+                     attrs, new_inputs, None, 1)
+
+    return sym.rewrite(pass_fn), skipped

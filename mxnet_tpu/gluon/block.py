@@ -385,11 +385,15 @@ class HybridBlock(Block):
             self._cached_op.clear()
         self._warmed_up = False
         for c in self._children.values():
-            # children run inside the parent's trace; no nested jit needed,
-            # but mark them so standalone calls also compile
             if isinstance(c, HybridBlock):
-                c._active = False  # avoid nested jit overhead under parent
-            c.hybridize(False, **kwargs) if isinstance(c, HybridBlock) else c.hybridize(active, **kwargs)
+                # children are inlined into this block's single jitted
+                # graph; a per-child cache would only add call overhead and
+                # jit-under-jit mutation-watcher hazards, so deactivate
+                # theirs (call hybridize() on the child directly to compile
+                # it standalone)
+                c.hybridize(False, **kwargs)
+            else:
+                c.hybridize(active, **kwargs)
         return self
 
     def optimize_for(self, x, *args, backend=None, clear=False, **kwargs):
@@ -399,9 +403,10 @@ class HybridBlock(Block):
         return self(x, *args)
 
     def __call__(self, *args, **kwargs):
-        leaves, _ = _flatten_nd(args)
+        leaves, tree = _flatten_nd(args)
         if leaves:
-            self._last_args_spec = [(l.shape, l._data.dtype) for l in leaves]
+            self._last_args_spec = (
+                tree, [(l.shape, l._data.dtype) for l in leaves])
         if not self._active:
             return super().__call__(*args, **kwargs)
         if not self._warmed_up:
@@ -420,11 +425,76 @@ class HybridBlock(Block):
         return out
 
     def export(self, path: str, epoch: int = 0, remove_amp_cast: bool = True):
-        """Ref block.py:1514. Serializes compiled StableHLO + params —
-        the TPU-native analogue of symbol-json + params (see SymbolBlock)."""
+        """Ref block.py:1514. Serializes compiled StableHLO + params (the
+        executable artifact — see SymbolBlock) AND the nnvm-style
+        ``{path}-symbol.json`` of the traced op graph for tooling/
+        visualization parity with the reference's symbol-json."""
+        import logging
+
         from .symbol_block import export_hybrid
 
-        return export_hybrid(self, path, epoch)
+        out = export_hybrid(self, path, epoch)
+        try:
+            self.symbolize().save(f"{path}-symbol.json")
+        except Exception as e:  # stablehlo is the executable artifact;
+            # the json graph is descriptive — degrade loudly, not silently
+            logging.getLogger(__name__).warning(
+                "export: could not write %s-symbol.json: %s", path, e)
+        return out
+
+    def _all_blocks(self):
+        yield self
+        for c in self._children.values():
+            if isinstance(c, Block):
+                yield from c._all_blocks()
+
+    def symbolize(self, *args) -> "mxnet_tpu.symbol.Symbol":
+        """Trace this block's forward into an mx.symbol.Symbol — the
+        TPU-native producer of the reference's deferred-compute symbol
+        (block.py:1135 _build_cache → GetDeferredComputeSymbol). Parameters
+        appear as named variables; BN running stats are auxiliary states.
+        With no args, replays the structure/shapes of the last real call.
+        User forward hooks are suspended during the trace (it feeds
+        synthetic zero inputs that must not leak into e.g. calibration)."""
+        from .. import symbol as _sym
+        from ..ndarray import NDArray
+        from .. import numpy as _np
+
+        if not args:
+            spec = getattr(self, "_last_args_spec", None)
+            if spec is None:
+                raise MXNetError("symbolize() needs example inputs (or call "
+                                 "the block once first)")
+            tree, leaf_specs = spec
+            leaves = [_np.zeros(s, dtype=d) for s, d in leaf_specs]
+            args = _unflatten_nd(tree, leaves)
+        params = {k: p.data() for k, p in self.collect_params().items()
+                  if p._data is not None}
+        aux = [k for k in params
+               if k.rsplit(".", 1)[-1] in ("running_mean", "running_var")]
+        leaves, tree = _flatten_nd(tuple(args))
+        names = ["data" if i == 0 else f"data{i}" for i in range(len(leaves))]
+        # trace eagerly (drop jit caching so every op dispatches through
+        # invoke, the recorder) with hooks suspended everywhere
+        saved = [(b, b._forward_hooks, b._forward_pre_hooks, b._active
+                  if isinstance(b, HybridBlock) else None)
+                 for b in self._all_blocks()]
+        for b, *_ in saved:
+            b._forward_hooks, b._forward_pre_hooks = [], []
+            if isinstance(b, HybridBlock):
+                b._active = False
+        try:
+            def run(*flat):
+                structured = _unflatten_nd(tree, list(flat))
+                return self(*structured)
+
+            return _sym.trace(run, leaves, input_names=names, known=params,
+                              aux=aux)
+        finally:
+            for b, fh, fph, act in saved:
+                b._forward_hooks, b._forward_pre_hooks = fh, fph
+                if act is not None:
+                    b._active = act
 
     def infer_shape(self, *args):
         """Layers with deferred params override this (ref HybridBlock.infer_shape)."""

@@ -30,6 +30,9 @@ def export_hybrid(block, path: str, epoch: int = 0):
         raise MXNetError(
             "export requires the block to have been called at least once "
             "(shapes are taken from the last forward)")
+    tree, leaf_specs = spec
+
+    from .block import _unflatten_nd
 
     params = {name: p for name, p in block.collect_params().items()
               if p._data is not None}
@@ -42,7 +45,8 @@ def export_hybrid(block, path: str, epoch: int = 0):
             with _autograd.pause(train_mode=False):
                 for (arr, _), v in zip(saved, pv):
                     arr._data = v
-                out = block.forward(*[NDArray(x) for x in xs])
+                args = _unflatten_nd(tree, [NDArray(x) for x in xs])
+                out = block.forward(*args)
             if isinstance(out, NDArray):
                 return out._data
             return tuple(o._data if isinstance(o, NDArray) else o for o in out)
@@ -50,7 +54,7 @@ def export_hybrid(block, path: str, epoch: int = 0):
             for arr, v in saved:
                 arr._data = v
 
-    example = [jax.ShapeDtypeStruct(s, d) for (s, d) in spec]
+    example = [jax.ShapeDtypeStruct(s, d) for (s, d) in leaf_specs]
     exported = jax.export.export(jax.jit(fn))(
         [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in pvals], *example)
     blob = exported.serialize()
@@ -62,7 +66,8 @@ def export_hybrid(block, path: str, epoch: int = 0):
     nd_save(param_file, {n: NDArray(v) for n, v in zip(names, pvals)})
     with open(f"{path}-meta.json", "w") as f:
         json.dump({"param_names": names,
-                   "input_specs": [[list(s), str(jnp.dtype(d))] for s, d in spec]}, f)
+                   "input_specs": [[list(s), str(jnp.dtype(d))]
+                                   for s, d in leaf_specs]}, f)
     return sym_file, param_file
 
 

@@ -65,7 +65,10 @@ def _dtype_of(obj, dtype):
 class NDArray:
     """See module docstring. API mirrors mx.np.ndarray + mx.nd.NDArray."""
 
-    __slots__ = ("_data", "_grad", "_grad_req", "_autograd_entry", "__weakref__")
+    # _dc_entry: deferred-compute stamp (node, out_idx) set while a
+    # symbol.trace scope records the op graph (ref RecordDeferredCompute)
+    __slots__ = ("_data", "_grad", "_grad_req", "_autograd_entry",
+                 "_dc_entry", "__weakref__")
     __array_priority__ = 1000.0
 
     def __init__(self, data, ctx: Optional[Context] = None, dtype=None):

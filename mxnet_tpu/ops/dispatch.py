@@ -14,6 +14,8 @@ below exposes it for API parity.
 """
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -22,7 +24,45 @@ import numpy as _onp
 
 from ..base import MXNetError
 
-__all__ = ["invoke", "call", "infer_shape", "wrap_op"]
+__all__ = ["invoke", "call", "infer_shape", "wrap_op", "deferred_compute",
+           "is_deferred_compute"]
+
+
+# -- deferred compute ---------------------------------------------------------
+# Analogue of Imperative::RecordDeferredCompute (src/imperative/
+# imperative.cc:301, Gluon-2 hybridize tracing): inside the scope ops run
+# eagerly AND stamp their outputs with a graph record, from which
+# symbol.trace assembles a Symbol.
+
+_DC_STATE = threading.local()
+
+
+class _DCNode:
+    __slots__ = ("fn", "inputs", "name", "n_out", "token")
+
+    def __init__(self, fn, inputs, name, n_out, token):
+        self.fn = fn
+        self.inputs = inputs      # NDArray inputs (leaf discovery)
+        self.name = name
+        self.n_out = n_out
+        self.token = token        # identifies the recording session, so a
+        #                           later trace ignores stale stamps
+
+
+@contextlib.contextmanager
+def deferred_compute():
+    """Yields a session token; records made inside carry it."""
+    prev = getattr(_DC_STATE, "token", None)
+    token = object()
+    _DC_STATE.token = token
+    try:
+        yield token
+    finally:
+        _DC_STATE.token = prev
+
+
+def is_deferred_compute() -> bool:
+    return getattr(_DC_STATE, "token", None) is not None
 
 
 def _wrap(data, like=None):
@@ -78,11 +118,19 @@ def invoke(fn: Callable, inputs: Sequence, name: str = "op",
     else:
         outs = [NDArray(o) for o in outs_raw]
 
+    if is_deferred_compute():
+        dc = _DCNode(fn, list(inputs), name, len(outs_raw),
+                     _DC_STATE.token)
+        for i, nd in enumerate(outs):
+            nd._dc_entry = (dc, i)
+
     if out is not None:
         if single:
             out._set_data(outs[0]._data.astype(out._data.dtype)
                           if out._data.dtype != outs[0]._data.dtype else outs[0]._data)
             out._autograd_entry = getattr(outs[0], "_autograd_entry", None)
+            if is_deferred_compute():
+                out._dc_entry = getattr(outs[0], "_dc_entry", None)
             return out
         raise MXNetError("out= is only supported for single-output ops")
     return outs[0] if single else tuple(outs)
@@ -98,6 +146,9 @@ def call(fn: Callable, args: Tuple, kwargs: dict, name: str = "op", out=None):
     nd_kw = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
     nd_args = [args[i] for i in nd_pos] + [kwargs[k] for k in nd_kw]
     if not nd_args:
+        if is_deferred_compute():
+            # record creation ops as nullary graph nodes
+            return invoke(lambda: fn(*args, **kwargs), [], name=name, out=out)
         # pure creation/config op
         res = fn(*args, **kwargs)
         single = not isinstance(res, (tuple, list))

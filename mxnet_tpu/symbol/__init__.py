@@ -1,0 +1,87 @@
+"""mx.sym / mx.symbol namespace (ref: python/mxnet/symbol/__init__.py).
+
+Op builders are generated on attribute access from the same op registry the
+imperative frontends use (np/npx/nd) — the analogue of the reference's
+import-time code generation from the C op registry (symbol/register.py).
+``mx.sym.convolution(data=x, ...)`` builds a graph node; reference CamelCase
+names (``mx.sym.Convolution``) alias through. Array parameters that the
+reference auto-creates as trailing Variables (weight/bias/gamma/...) are
+auto-created here too for the structured-op table below.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Dict, List
+
+from .symbol import (Symbol, Variable, var, Group, fromjson, load, trace,
+                     register_op, resolve_op, _apply_op, _unique, _ALIASES)
+
+__all__ = ["Symbol", "Variable", "var", "Group", "fromjson", "load", "trace",
+           "register_op", "resolve_op"]
+
+# array-input names per structured op (ref: each op's FListInputNames),
+# keyed by the actual npx signature names; missing ones are auto-created as
+# Variables like the reference's sym.FullyConnected(data=x, num_hidden=k)
+# creating fc_weight/fc_bias
+_AUTO_VARS: Dict[str, List[str]] = {
+    "fully_connected": ["x", "weight", "bias"],
+    "convolution": ["data", "weight", "bias"],
+    "deconvolution": ["data", "weight", "bias"],
+    "batch_norm": ["x", "gamma", "beta", "running_mean", "running_var"],
+    "layer_norm": ["x", "gamma", "beta"],
+    "embedding": ["data", "weight"],
+}
+
+
+def _make_builder(public_name: str):
+    opname = _ALIASES.get(public_name, public_name)
+    f = resolve_op(opname)  # raises for unknown ops
+
+    def build(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        try:
+            sig = inspect.signature(f)
+            param_names = list(sig.parameters)
+            # reference callers say data=...; some npx signatures call the
+            # first input x — accept both
+            if "data" in kwargs and "data" not in param_names and param_names:
+                kwargs[param_names[0]] = kwargs.pop("data")
+            bound = sig.bind_partial(*args, **kwargs)
+            items = list(bound.arguments.items())
+        except (ValueError, TypeError):
+            items = [(f"arg{i}", a) for i, a in enumerate(args)]
+            items += list(kwargs.items())
+            param_names = []
+        base = name or _unique(opname)
+        arr, attrs = {}, {}
+        for k, v in items:
+            if isinstance(v, Symbol):
+                arr[k] = v
+            else:
+                attrs[k] = v
+        no_bias = bool(attrs.get("no_bias", False))
+        for pname in _AUTO_VARS.get(opname, []):
+            if pname in arr or pname in attrs:  # given (even as None)
+                continue
+            if pname == "bias" and no_bias:
+                continue
+            arr[pname] = Variable(f"{base}_{pname}")
+        # positional order must match the signature
+        order = [p for p in param_names if p in arr] + \
+                [k for k in arr if k not in param_names]
+        sym_args = [arr[p] for p in order]
+        return _apply_op(opname, sym_args, attrs, name=base)
+
+    build.__name__ = public_name
+    build.__doc__ = (f.__doc__ or "") + \
+        "\n\n(symbolic builder over the imperative op)"
+    return build
+
+
+def __getattr__(name: str):
+    if name.startswith("_"):
+        raise AttributeError(name)
+    try:
+        return _make_builder(name)
+    except Exception as e:
+        raise AttributeError(f"mx.sym has no op '{name}': {e}") from None

@@ -1,0 +1,573 @@
+"""mx.symbol — the graph-manipulation surface.
+
+Reference: python/mxnet/symbol/symbol.py (15.8k LoC) — `Symbol` wraps an nnvm
+graph; compose ops without data, `infer_shape`, `tojson`, `get_internals`,
+bind/eval; it backs hybridize tracing, AMP conversion, quantization, ONNX and
+visualization.
+
+TPU-native design: a Symbol is a lazy op-graph whose nodes bind the SAME
+NDArray-level op functions the imperative frontend uses (mx.np/mx.npx/mx.nd
+— all jax-traceable). There is no separate symbolic kernel path to keep in
+sync: `bind` interprets the graph eagerly, `infer_shape` runs jax abstract
+evaluation over the same interpreter, and `jax.jit` around an Executor gives
+the compiled path. Graphs come from two sources:
+
+1. composed by hand from ``Variable`` + ``mx.sym.<op>`` builders (this file),
+2. traced from imperative code via the deferred-compute scope in
+   ops/dispatch.py (the analogue of the reference's RecordDeferredCompute,
+   src/imperative/imperative.cc:301) — see :func:`trace`.
+
+JSON: ``tojson`` emits the reference's nnvm-json shape (nodes/arg_nodes/
+heads) so graph tooling ports over; registry-named ops round-trip through
+``fromjson``, traced closures serialize descriptively (shape/op name) but
+re-execute only from the live trace, with StableHLO as the faithful
+serialized executable (gluon/symbol_block.py).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+__all__ = ["Symbol", "Variable", "var", "Group", "fromjson", "load",
+           "trace", "register_op", "resolve_op"]
+
+
+class _Node:
+    """One graph node: a variable (op is None) or an op application."""
+
+    __slots__ = ("name", "op", "attrs", "inputs", "fn", "n_out")
+
+    def __init__(self, name: str, op: Optional[str], attrs: Dict[str, Any],
+                 inputs: List[Tuple["_Node", int]],
+                 fn: Optional[Callable] = None, n_out: int = 1):
+        self.name = name
+        self.op = op           # None → variable ("null" in nnvm json)
+        self.attrs = attrs     # JSON-able op parameters
+        self.inputs = inputs   # [(producer node, output index)]
+        self.fn = fn           # executable: fn(*raw_input_arrays) -> raw out
+        self.n_out = n_out
+
+    def is_var(self) -> bool:
+        return self.op is None
+
+
+# -- op registry --------------------------------------------------------------
+# name -> NDArray-level callable; populated lazily from the np/npx/nd
+# namespaces plus explicit registrations, mirroring how the reference
+# code-generates sym ops from the same registry as nd ops (SURVEY §2.4).
+
+_OP_REGISTRY: Dict[str, Callable] = {}
+_NAMESPACES_LOADED = False
+
+# reference CamelCase aliases (python/mxnet/symbol/register.py style)
+_ALIASES = {
+    "FullyConnected": "fully_connected",
+    "Convolution": "convolution",
+    "Deconvolution": "deconvolution",
+    "Activation": "activation",
+    "Pooling": "pooling",
+    "BatchNorm": "batch_norm",
+    "LayerNorm": "layer_norm",
+    "Dropout": "dropout",
+    "Embedding": "embedding",
+    "Concat": "concatenate",
+    "Flatten": "flatten",
+    "Reshape": "reshape",
+    "SoftmaxActivation": "softmax",
+}
+
+
+def register_op(name: str, fn: Callable) -> None:
+    _OP_REGISTRY[name] = fn
+
+
+def _load_namespaces() -> None:
+    global _NAMESPACES_LOADED
+    if _NAMESPACES_LOADED:
+        return
+    import mxnet_tpu
+
+    for mod in (mxnet_tpu.npx, mxnet_tpu.np, mxnet_tpu.nd):
+        for nm in dir(mod):
+            if nm.startswith("_"):
+                continue
+            f = getattr(mod, nm)
+            if callable(f) and nm not in _OP_REGISTRY:
+                _OP_REGISTRY[nm] = f
+    _NAMESPACES_LOADED = True
+
+
+def resolve_op(name: str) -> Callable:
+    _load_namespaces()
+    name = _ALIASES.get(name, name)
+    if name not in _OP_REGISTRY:
+        raise MXNetError(f"symbol op '{name}' is not a registered op")
+    return _OP_REGISTRY[name]
+
+
+_UID = [0]
+
+
+def _unique(prefix: str) -> str:
+    _UID[0] += 1
+    return f"{prefix}{_UID[0]}"
+
+
+class Symbol:
+    """A (multi-)output handle into an op graph (ref symbol.py Symbol)."""
+
+    def __init__(self, outputs: List[Tuple[_Node, int]]):
+        self._outputs = list(outputs)
+
+    # -- graph walks --------------------------------------------------------
+    def _topo(self) -> List[_Node]:
+        seen, order = set(), []
+
+        def visit(node: _Node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for src, _ in node.inputs:
+                visit(src)
+            order.append(node)
+
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def _variables(self) -> List[_Node]:
+        return [n for n in self._topo() if n.is_var()]
+
+    # -- reference API ------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._outputs[0][0].name
+
+    def list_arguments(self) -> List[str]:
+        """Ref symbol.py list_arguments: variables in topo order, aux last
+        convention relaxed (aux split out by list_auxiliary_states)."""
+        return [n.name for n in self._variables()
+                if not n.attrs.get("__aux__")]
+
+    def list_auxiliary_states(self) -> List[str]:
+        """Variables marked auxiliary (e.g. BN running stats captured by
+        trace()); ref symbol.py list_auxiliary_states."""
+        return [n.name for n in self._variables() if n.attrs.get("__aux__")]
+
+    def list_outputs(self) -> List[str]:
+        return [f"{node.name}_output{idx}" if node.n_out > 1
+                else f"{node.name}_output"
+                for node, idx in self._outputs]
+
+    def get_internals(self) -> "Symbol":
+        """Every node as an output (ref symbol.py get_internals)."""
+        outs: List[Tuple[_Node, int]] = []
+        for n in self._topo():
+            for i in range(n.n_out):
+                outs.append((n, i))
+        return Symbol(outs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            base = [n.rstrip("0123456789") for n in names]
+            for i, (full, b) in enumerate(zip(names, base)):
+                if index in (full, b, self._outputs[i][0].name):
+                    return Symbol([self._outputs[i]])
+            raise MXNetError(f"no output named '{index}'; have {names}")
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self._outputs)
+
+    def __iter__(self):
+        return (Symbol([o]) for o in self._outputs)
+
+    # -- composition --------------------------------------------------------
+    def __call__(self, **kwargs: "Symbol") -> "Symbol":
+        """Compose: substitute variables by name (ref symbol.py __call__ /
+        _compose). Returns a new Symbol; this one is unchanged."""
+        for v in kwargs.values():
+            if not isinstance(v, Symbol) or len(v._outputs) != 1:
+                raise MXNetError("compose expects single-output Symbols")
+        mapping: Dict[int, Tuple[_Node, int]] = {}
+        for n in self._variables():
+            if n.name in kwargs:
+                mapping[id(n)] = kwargs[n.name]._outputs[0]
+        unknown = set(kwargs) - {n.name for n in self._variables()}
+        if unknown:
+            raise MXNetError(f"compose got unknown argument(s) {unknown}")
+        clones: Dict[int, _Node] = {}
+
+        def clone(node: _Node, idx: int) -> Tuple[_Node, int]:
+            if id(node) in mapping:
+                return mapping[id(node)]
+            if id(node) not in clones:
+                new_inputs = [clone(src, i) for src, i in node.inputs]
+                clones[id(node)] = _Node(node.name, node.op,
+                                         dict(node.attrs), new_inputs,
+                                         node.fn, node.n_out)
+            return (clones[id(node)], idx)
+
+        return Symbol([clone(node, idx) for node, idx in self._outputs])
+
+    # -- execution ----------------------------------------------------------
+    def _interpret(self, bindings: Dict[str, Any]) -> List[Any]:
+        """Evaluate the graph with NDArray values for variables."""
+        from ..ndarray import NDArray
+
+        values: Dict[Tuple[int, int], Any] = {}
+        for node in self._topo():
+            if node.is_var():
+                if node.name not in bindings:
+                    raise MXNetError(f"unbound argument '{node.name}'")
+                v = bindings[node.name]
+                values[(id(node), 0)] = v if isinstance(v, NDArray) \
+                    else NDArray(jnp.asarray(v))
+            else:
+                ins = [values[(id(s), i)] for s, i in node.inputs]
+                if node.fn is not None:
+                    raw = node.fn(*[x._data for x in ins])
+                    outs = raw if isinstance(raw, (tuple, list)) else [raw]
+                    outs = [NDArray(o) for o in outs]
+                else:
+                    f = resolve_op(node.op)
+                    res = f(*ins, **{k: v for k, v in node.attrs.items()
+                                     if not k.startswith("__")})
+                    outs = list(res) if isinstance(res, (tuple, list)) \
+                        else [res]
+                for i, o in enumerate(outs):
+                    values[(id(node), i)] = o
+        return [values[(id(n), i)] for n, i in self._outputs]
+
+    def eval(self, ctx=None, **kwargs):
+        """Ref symbol.py eval: bind + forward in one call."""
+        return self._interpret(kwargs)
+
+    def bind(self, ctx=None, args: Optional[Dict[str, Any]] = None,
+             aux_states: Optional[Dict[str, Any]] = None):
+        """Minimal Executor (ref executor.py is a CachedOp wrapper; here the
+        compiled path is jax.jit around the interpreter)."""
+        sym = self
+        bound = dict(args or {})
+        bound.update(aux_states or {})
+
+        class Executor:
+            def __init__(self):
+                self.arg_dict = bound
+
+            def forward(self, **kw):
+                b = dict(self.arg_dict)
+                b.update(kw)
+                self.outputs = sym._interpret(b)
+                return self.outputs
+
+        return Executor()
+
+    # -- inference ----------------------------------------------------------
+    def infer_shape(self, **kwargs):
+        """Ref symbol.py infer_shape → (arg_shapes, out_shapes, aux_shapes).
+        kwargs: name → shape tuple (dtype assumed float32) or
+        jax.ShapeDtypeStruct."""
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        all_names = arg_names + aux_names
+        missing = [n for n in all_names if n not in kwargs]
+        if missing:
+            raise MXNetError(f"infer_shape missing shapes for {missing}")
+        structs = {n: (jax.ShapeDtypeStruct(tuple(s), jnp.float32)
+                       if isinstance(s, (tuple, list)) else s)
+                   for n, s in kwargs.items()}
+
+        def f(vals):
+            nds = {n: self._mk_nd(v) for n, v in vals.items()}
+            return [o._data for o in self._interpret(nds)]
+
+        outs = jax.eval_shape(f, structs)
+        out_shapes = [tuple(o.shape) for o in outs]
+        arg_shapes = [tuple(structs[n].shape) for n in arg_names]
+        aux_shapes = [tuple(structs[n].shape) for n in aux_names]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, **kwargs):
+        """Ref symbol.py infer_type — dtypes via the same abstract eval.
+        Shapes are rank-1 placeholders; pass ShapeDtypeStructs to
+        infer_shape when shape-dependent promotion matters."""
+        arg_names = self.list_arguments() + self.list_auxiliary_states()
+        missing = [n for n in arg_names if n not in kwargs]
+        if missing:
+            raise MXNetError(f"infer_type missing dtypes for {missing}")
+        shapes = {n: jax.ShapeDtypeStruct((1,), jnp.dtype(d))
+                  for n, d in kwargs.items()}
+
+        def f(vals):
+            nds = {n: self._mk_nd(v) for n, v in vals.items()}
+            return [o._data for o in self._interpret(nds)]
+
+        res = jax.eval_shape(f, shapes)
+        return ([jnp.dtype(kwargs[n]) for n in arg_names],
+                [jnp.dtype(o.dtype) for o in res], [])
+
+    @staticmethod
+    def _mk_nd(aval):
+        from ..ndarray import NDArray
+
+        nd = NDArray.__new__(NDArray)
+        nd._data = aval
+        nd._grad = None
+        nd._grad_req = None
+        nd._autograd_entry = None
+        return nd
+
+    # -- graph rewriting ----------------------------------------------------
+    def rewrite(self, fn: Callable) -> "Symbol":
+        """Rebuild the graph bottom-up, giving ``fn(node, new_inputs)`` the
+        chance to substitute each op node — the TPU-native pass surface
+        (analogue of the reference's NNVM passes: QuantizeGraph,
+        ReducePrecision; src/nnvm/). fn returns a replacement _Node (which
+        must preserve the node's output arity) or None to keep the default
+        clone. Variables are shared, not cloned, so bindings keep working."""
+        memo: Dict[int, _Node] = {}
+
+        def build(node: _Node, idx: int) -> Tuple[_Node, int]:
+            if node.is_var():
+                return (node, idx)
+            if id(node) not in memo:
+                new_inputs = [build(s, i) for s, i in node.inputs]
+                rep = fn(node, new_inputs)
+                if rep is None:
+                    rep = _Node(node.name, node.op, dict(node.attrs),
+                                new_inputs, node.fn, node.n_out)
+                elif rep.n_out != node.n_out:
+                    raise MXNetError(
+                        f"rewrite replacement for '{node.name}' changes "
+                        f"output arity {node.n_out} -> {rep.n_out}")
+                memo[id(node)] = rep
+            return (memo[id(node)], idx)
+
+        return Symbol([build(n, i) for n, i in self._outputs])
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self) -> str:
+        """nnvm-json shape (ref symbol.py tojson / save): nodes with
+        "op"/"name"/"attrs"/"inputs", arg_nodes, heads."""
+        order = self._topo()
+        index = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            entry: Dict[str, Any] = {
+                "op": "null" if n.is_var() else n.op,
+                "name": n.name,
+                "inputs": [[index[id(s)], i, 0] for s, i in n.inputs],
+            }
+            attrs = {k: (v if isinstance(v, str) else json.dumps(v))
+                     for k, v in n.attrs.items() if not k.startswith("__")}
+            if n.fn is not None and not n.is_var():
+                attrs["__traced__"] = "true"
+            if attrs:
+                entry["attrs"] = attrs
+            nodes.append(entry)
+        return json.dumps({
+            "nodes": nodes,
+            "arg_nodes": [index[id(n)] for n in order if n.is_var()],
+            "heads": [[index[id(n)], i, 0] for n, i in self._outputs],
+            "attrs": {"mxnet_version": ["int", 20000]},
+        }, indent=2)
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- debugging ----------------------------------------------------------
+    def debug_str(self) -> str:
+        lines = []
+        for n in self._topo():
+            kind = "Variable" if n.is_var() else n.op
+            ins = ", ".join(s.name for s, _ in n.inputs)
+            lines.append(f"{kind} {n.name}({ins})")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        outs = ", ".join(self.list_outputs())
+        return f"<Symbol {outs}>"
+
+    # -- operators (build graph nodes like reference sym arithmetic) --------
+    def _binop(self, other, opname, swap=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if swap else (self, other)
+            return _apply_op(opname, [a, b], {})
+        val = other
+        const = _Node(_unique("_const"), "_const", {"value": val}, [],
+                      fn=lambda v=val: jnp.asarray(v), n_out=1)
+        cs = Symbol([(const, 0)])
+        a, b = (cs, self) if swap else (self, cs)
+        return _apply_op(opname, [a, b], {})
+
+    def __add__(self, other):
+        return self._binop(other, "add")
+
+    def __radd__(self, other):
+        return self._binop(other, "add", swap=True)
+
+    def __sub__(self, other):
+        return self._binop(other, "subtract")
+
+    def __rsub__(self, other):
+        return self._binop(other, "subtract", swap=True)
+
+    def __mul__(self, other):
+        return self._binop(other, "multiply")
+
+    def __rmul__(self, other):
+        return self._binop(other, "multiply", swap=True)
+
+    def __truediv__(self, other):
+        return self._binop(other, "divide")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, "divide", swap=True)
+
+    def __neg__(self):
+        return _apply_op("negative", [self], {})
+
+
+def _apply_op(opname: str, sym_args: Sequence[Symbol],
+              attrs: Dict[str, Any], name: Optional[str] = None) -> Symbol:
+    resolve_op(opname)  # validate early
+    for s in sym_args:
+        if len(s._outputs) != 1:
+            raise MXNetError(f"op '{opname}' inputs must be single-output "
+                             "symbols; index with sym[i] first")
+    node = _Node(name or _unique(opname.lower() + ""),
+                 opname, dict(attrs),
+                 [s._outputs[0] for s in sym_args])
+    # multi-output ops: probe lazily at eval; n_out adjusted by interpreter
+    return Symbol([(node, 0)])
+
+
+def Variable(name: str, **attrs) -> Symbol:
+    """Ref symbol.py var/Variable."""
+    return Symbol([(_Node(name, None, dict(attrs), []), 0)])
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    """Ref symbol.py Group: one Symbol with all outputs."""
+    outs: List[Tuple[_Node, int]] = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def fromjson(text: str) -> Symbol:
+    """Rebuild a Symbol from nnvm-json (registry ops only; traced closures
+    cannot be re-executed from JSON — reload those via SymbolBlock/StableHLO)."""
+    data = json.loads(text)
+    nodes: List[_Node] = []
+    for entry in data["nodes"]:
+        raw_attrs = entry.get("attrs", {})
+        attrs = {}
+        for k, v in raw_attrs.items():
+            try:
+                attrs[k] = json.loads(v) if isinstance(v, str) else v
+            except (json.JSONDecodeError, TypeError):
+                attrs[k] = v
+        inputs = [(nodes[i], oi) for i, oi, _ in entry["inputs"]]
+        if entry["op"] == "null":
+            nodes.append(_Node(entry["name"], None, attrs, []))
+        else:
+            if attrs.pop("__traced__", None):
+                raise MXNetError(
+                    f"node '{entry['name']}' is a traced closure; JSON holds "
+                    "its structure only — reload the executable graph via "
+                    "SymbolBlock.imports (StableHLO)")
+            resolve_op(entry["op"])
+            nodes.append(_Node(entry["name"], entry["op"], attrs, inputs))
+    heads = [(nodes[i], oi) for i, oi, _ in data["heads"]]
+    return Symbol(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return fromjson(f.read())
+
+
+# -- tracing imperative code into a Symbol ------------------------------------
+
+def trace(fn: Callable, example_inputs: Sequence, input_names=None,
+          known: Optional[Dict[str, Any]] = None,
+          aux: Optional[Sequence[str]] = None) -> Symbol:
+    """Run ``fn(*example_inputs)`` eagerly while recording every dispatched
+    op (deferred compute, ref imperative.cc:301), then assemble the Symbol.
+
+    known maps names to NDArrays fn closes over (e.g. parameters) so their
+    variables get stable names; aux lists known-names to mark auxiliary
+    (e.g. BN running stats). Everything else fn creates internally appears
+    as a traced constant node.
+    """
+    from ..ndarray import NDArray
+    from ..ops import dispatch
+
+    example_inputs = list(example_inputs)
+    input_names = list(input_names or
+                       [f"data{i}" if i else "data"
+                        for i in range(len(example_inputs))])
+    known = dict(known or {})
+    aux = set(aux or ())
+
+    with dispatch.deferred_compute() as token:
+        outs = fn(*example_inputs)
+    outs = outs if isinstance(outs, (tuple, list)) else [outs]
+
+    id2name: Dict[int, Tuple[str, bool]] = {}
+    for nm, v in zip(input_names, example_inputs):
+        if isinstance(v, NDArray):
+            id2name[id(v)] = (nm, False)
+    for nm, v in known.items():
+        if isinstance(v, NDArray) and id(v) not in id2name:
+            id2name[id(v)] = (nm, nm in aux)
+
+    nodes: Dict[int, _Node] = {}
+
+    def node_for(nd: NDArray) -> Tuple[_Node, int]:
+        # explicit names take precedence over any recorded producer, and
+        # stamps from *other* trace sessions are ignored (stale arrays
+        # produced under an earlier scope are plain leaves here)
+        rec = getattr(nd, "_dc_entry", None)
+        if rec is not None and rec[0].token is not token:
+            rec = None
+        if rec is None or id(nd) in id2name:
+            if id(nd) in nodes:
+                return (nodes[id(nd)], 0)
+            if id(nd) in id2name:
+                nm, is_aux = id2name[id(nd)]
+                n = _Node(nm, None, {"__aux__": True} if is_aux else {}, [])
+            else:
+                # captured constant (anchor boxes, masks, ...): embed its
+                # value so the Symbol stays evaluable without a binding
+                val = nd._data
+                n = _Node(_unique("_const"), "_const", {}, [],
+                          fn=lambda v=val: v, n_out=1)
+            nodes[id(nd)] = n
+            return (n, 0)
+        dc, idx = rec
+        if id(dc) in nodes:
+            return (nodes[id(dc)], idx)
+        ins = [node_for(x) for x in dc.inputs]
+        n = _Node(_unique(dc.name + "_"), dc.name, {}, ins, fn=dc.fn,
+                  n_out=dc.n_out)
+        nodes[id(dc)] = n
+        return (n, idx)
+
+    return Symbol([node_for(o) for o in outs])

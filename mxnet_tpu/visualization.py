@@ -1,0 +1,165 @@
+"""Network visualization (ref: python/mxnet/visualization.py).
+
+``print_summary`` renders the layer table (name, output shape, params) and
+``plot_network`` emits a Graphviz DOT description of a Symbol graph. Like
+the reference, plot_network returns an object with ``.source`` and
+``render``; rendering to an image needs the optional graphviz binary — the
+DOT text itself is always produced (zero extra dependencies).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+_PARAM_SUFFIXES = ("weight", "bias", "gamma", "beta", "running_mean",
+                   "running_var", "mean", "var")
+
+
+def _is_param_name(name: str) -> bool:
+    return (name.rsplit("_", 1)[-1] in _PARAM_SUFFIXES or
+            name.rsplit(".", 1)[-1] in _PARAM_SUFFIXES)
+
+
+def _node_shapes(symbol, shape: Optional[Dict[str, tuple]] = None):
+    """Best-effort per-node output shapes via get_internals().infer_shape."""
+    if not shape:
+        return {}
+    try:
+        internals = symbol.get_internals()
+        args = internals.list_arguments() + internals.list_auxiliary_states()
+        known = dict(shape)
+        missing = [a for a in args if a not in known]
+        if missing:
+            return {}
+        _, outs, _ = internals.infer_shape(**known)
+        return dict(zip(internals.list_outputs(), outs))
+    except Exception:
+        return {}
+
+
+def print_summary(symbol, shape: Optional[Dict[str, tuple]] = None,
+                  line_length: int = 76) -> None:
+    """Ref visualization.py print_summary: one row per op node with output
+    shape and parameter count; totals at the bottom."""
+    shapes = _node_shapes(symbol, shape)
+    internals = symbol.get_internals()
+    out_names = internals.list_outputs()
+    arg_set = set(symbol.list_arguments()) | \
+        set(symbol.list_auxiliary_states())
+
+    print("=" * line_length)
+    print(f"{'Layer (type)':<34}{'Output Shape':<22}{'Param #':<12}")
+    print("=" * line_length)
+    total = 0
+    heads = set(symbol.list_outputs())
+    nodes = symbol._topo()
+    # parameter count: product of each param-like variable's inferred shape
+    # (suffix rule, like the reference's weight/bias/gamma/beta convention)
+    var_shape = {}
+    if shape:
+        for nm in arg_set:
+            if nm in shape:
+                var_shape[nm] = shape[nm]
+    for n in nodes:
+        if n.is_var():
+            continue
+        out_shape = ""
+        for cand in (f"{n.name}_output", n.name):
+            for on, os in shapes.items():
+                if on.startswith(cand):
+                    out_shape = str(tuple(os))
+                    break
+            if out_shape:
+                break
+        nparams = 0
+        for src, _ in n.inputs:
+            if src.is_var() and _is_param_name(src.name) and \
+                    src.name in var_shape:
+                c = 1
+                for d in var_shape[src.name]:
+                    c *= d
+                nparams += c
+        total += nparams
+        mark = " *" if f"{n.name}_output" in heads or n.name in heads else ""
+        print(f"{(n.name + ' (' + (n.op or 'null') + ')')[:33]:<34}"
+              f"{out_shape:<22}{nparams:<12}{mark}")
+    print("=" * line_length)
+    print(f"Total params: {total}")
+    print("=" * line_length)
+
+
+class _Digraph:
+    """Tiny stand-in for graphviz.Digraph: holds DOT source; render() uses
+    the graphviz binary when present."""
+
+    def __init__(self, source: str, name: str):
+        self.source = source
+        self.name = name
+
+    def render(self, filename=None, format: str = "pdf"):
+        import shutil
+        import subprocess
+        import tempfile
+
+        if shutil.which("dot") is None:
+            raise MXNetError("graphviz 'dot' binary not found; use .source")
+        filename = filename or self.name
+        with tempfile.NamedTemporaryFile("w", suffix=".dot",
+                                         delete=False) as f:
+            f.write(self.source)
+            src = f.name
+        out = f"{filename}.{format}"
+        subprocess.run(["dot", f"-T{format}", src, "-o", out], check=True)
+        return out
+
+    def _repr_svg_(self):  # notebook integration like graphviz objects
+        return None
+
+
+_OP_STYLE = {
+    "convolution": ("#4a90d9", "box"),
+    "fully_connected": ("#4a90d9", "box"),
+    "batch_norm": ("#f5a623", "box"),
+    "pooling": ("#7ed321", "box"),
+    "activation": ("#d0021b", "ellipse"),
+}
+
+
+def plot_network(symbol, title: str = "plot",
+                 shape: Optional[Dict[str, tuple]] = None,
+                 node_attrs: Optional[dict] = None,
+                 hide_weights: bool = True) -> _Digraph:
+    """Ref visualization.py plot_network → DOT graph of the Symbol."""
+    shapes = _node_shapes(symbol, shape)
+    lines = [f'digraph "{title}" {{', "  rankdir=BT;",
+             '  node [fontsize=10, style=filled, fillcolor="#e8e8e8"];']
+    nodes = symbol._topo()
+    index = {id(n): i for i, n in enumerate(nodes)}
+    arg_like = {n.name for n in nodes if n.is_var()}
+    weight_like = {nm for nm in arg_like if _is_param_name(nm)}
+    skip = weight_like if hide_weights else set()
+    for n in nodes:
+        if n.is_var() and n.name in skip:
+            continue
+        label = n.name if n.is_var() else f"{n.name}\\n{n.op}"
+        for cand in (f"{n.name}_output", n.name):
+            if cand in shapes:
+                label += f"\\n{tuple(shapes[cand])}"
+                break
+        color, shp = ("#cccccc", "oval") if n.is_var() else \
+            _OP_STYLE.get(n.op, ("#9b9b9b", "box"))
+        lines.append(f'  n{index[id(n)]} [label="{label}", '
+                     f'fillcolor="{color}", shape={shp}];')
+    for n in nodes:
+        if n.is_var() and n.name in skip:
+            continue
+        for src, _ in n.inputs:
+            if src.is_var() and src.name in skip:
+                continue
+            lines.append(f"  n{index[id(src)]} -> n{index[id(n)]};")
+    lines.append("}")
+    return _Digraph("\n".join(lines), title)

@@ -1,0 +1,338 @@
+"""mx.symbol facade — compose/eval/infer/json/trace/visualize.
+
+Reference surface: python/mxnet/symbol/symbol.py (Symbol, Variable, Group,
+infer_shape, tojson, get_internals, compose) + visualization.py. Here the
+Symbol is a lazy graph over the imperative op corpus (symbol/symbol.py).
+"""
+import json
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp():
+    x = mx.sym.Variable("x")
+    fc1 = mx.sym.FullyConnected(data=x, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=3, name="fc2")
+    return fc2
+
+
+def test_list_arguments_auto_vars():
+    sym = _mlp()
+    assert sym.list_arguments() == [
+        "x", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    assert sym.list_outputs() == ["fc2_output"]
+
+
+def test_infer_shape():
+    sym = _mlp()
+    args, outs, aux = sym.infer_shape(
+        x=(4, 16), fc1_weight=(8, 16), fc1_bias=(8,),
+        fc2_weight=(3, 8), fc2_bias=(3,))
+    assert outs == [(4, 3)]
+    assert aux == []
+
+
+def test_infer_type():
+    sym = mx.sym.Variable("a") + mx.sym.Variable("b")
+    args, outs, _ = sym.infer_type(a="float32", b="float32")
+    assert outs[0] == onp.dtype("float32")
+
+
+def test_eval_matches_numpy():
+    sym = _mlp()
+    rs = onp.random.RandomState(0)
+    vals = {"x": rs.rand(4, 16).astype("float32"),
+            "fc1_weight": rs.rand(8, 16).astype("float32"),
+            "fc1_bias": rs.rand(8).astype("float32"),
+            "fc2_weight": rs.rand(3, 8).astype("float32"),
+            "fc2_bias": rs.rand(3).astype("float32")}
+    out = sym.eval(**{k: mx.np.array(v) for k, v in vals.items()})[0]
+    h = onp.maximum(vals["x"] @ vals["fc1_weight"].T + vals["fc1_bias"], 0)
+    ref = h @ vals["fc2_weight"].T + vals["fc2_bias"]
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_bind_executor():
+    sym = mx.sym.Variable("x") * 3.0
+    ex = sym.bind(args={"x": mx.np.ones((2, 2))})
+    out = ex.forward()[0]
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2, 2), 3.0))
+
+
+def test_tojson_roundtrip():
+    sym = _mlp()
+    js = sym.tojson()
+    data = json.loads(js)
+    assert {n["op"] for n in data["nodes"]} == \
+        {"null", "fully_connected", "activation"}
+    sym2 = mx.sym.fromjson(js)
+    assert sym2.list_arguments() == sym.list_arguments()
+    rs = onp.random.RandomState(1)
+    vals = {"x": mx.np.array(rs.rand(2, 16).astype("float32")),
+            "fc1_weight": mx.np.array(rs.rand(8, 16).astype("float32")),
+            "fc1_bias": mx.np.zeros((8,)),
+            "fc2_weight": mx.np.array(rs.rand(3, 8).astype("float32")),
+            "fc2_bias": mx.np.zeros((3,))}
+    o1 = sym.eval(**vals)[0].asnumpy()
+    o2 = sym2.eval(**vals)[0].asnumpy()
+    onp.testing.assert_allclose(o1, o2, atol=1e-6)
+
+
+def test_save_load(tmp_path):
+    sym = _mlp()
+    f = str(tmp_path / "net-symbol.json")
+    sym.save(f)
+    sym2 = mx.sym.load(f)
+    assert sym2.list_outputs() == sym.list_outputs()
+
+
+def test_get_internals_and_getitem():
+    sym = _mlp()
+    internals = sym.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names and "relu1_output" in names
+    relu = internals["relu1_output"]
+    args, outs, _ = relu.infer_shape(
+        x=(2, 16), fc1_weight=(8, 16), fc1_bias=(8,))
+    assert outs == [(2, 8)]
+
+
+def test_group():
+    a = mx.sym.Variable("a")
+    g = mx.sym.Group([a * 2.0, a + 1.0])
+    assert g.num_outputs == 2
+    outs = g.eval(a=mx.np.ones((2,)))
+    assert outs[0].asnumpy().tolist() == [2.0, 2.0]
+    assert outs[1].asnumpy().tolist() == [2.0, 2.0]
+
+
+def test_compose():
+    base = _mlp()
+    y = mx.sym.Variable("y")
+    comp = base(x=y * 2.0)
+    assert "y" in comp.list_arguments()
+    assert "x" not in comp.list_arguments()
+
+
+def test_compose_unknown_name_raises():
+    with pytest.raises(MXNetError):
+        _mlp()(nope=mx.sym.Variable("z"))
+
+
+def test_unbound_eval_raises():
+    with pytest.raises(MXNetError):
+        _mlp().eval(x=mx.np.ones((1, 16)))
+
+
+def test_unknown_op_raises():
+    with pytest.raises(AttributeError):
+        mx.sym.definitely_not_an_op
+
+
+def test_operators():
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    expr = (a + b) * 2.0 - b / 2.0
+    av = onp.array([2.0, 4.0], "float32")
+    bv = onp.array([1.0, 2.0], "float32")
+    out = expr.eval(a=mx.np.array(av), b=mx.np.array(bv))[0]
+    onp.testing.assert_allclose(out.asnumpy(), (av + bv) * 2 - bv / 2)
+
+
+def test_symbolize_block_and_export_json(tmp_path):
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu"),
+            mx.gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mx.np.array(onp.random.RandomState(0).rand(2, 10).astype("float32"))
+    ref = net(x).asnumpy()
+
+    sym = net.symbolize()
+    args = sym.list_arguments()
+    assert "data" in args and any("weight" in a for a in args)
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    out = sym.eval(data=x, **params)[0]
+    onp.testing.assert_allclose(out.asnumpy(), ref, atol=1e-5)
+
+    # export writes the descriptive symbol json next to the stablehlo
+    net.hybridize()
+    net(x)
+    path = str(tmp_path / "mlp")
+    net.export(path)
+    with open(path + "-symbol.json") as f:
+        data = json.load(f)
+    assert any(n["op"] == "fully_connected" for n in data["nodes"])
+
+
+def test_symbolize_batchnorm_aux():
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(4), mx.gluon.nn.BatchNorm())
+    net.initialize()
+    x = mx.np.ones((2, 6))
+    net(x)
+    sym = net.symbolize()
+    aux = sym.list_auxiliary_states()
+    assert any("running_mean" in a for a in aux)
+    assert any("running_var" in a for a in aux)
+    assert not any("running" in a for a in sym.list_arguments())
+
+
+def test_print_summary_and_plot(capsys):
+    sym = _mlp()
+    shapes = {"x": (2, 16), "fc1_weight": (8, 16), "fc1_bias": (8,),
+              "fc2_weight": (3, 8), "fc2_bias": (3,)}
+    mx.visualization.print_summary(sym, shape=shapes)
+    out = capsys.readouterr().out
+    assert "fc1" in out and "Total params" in out
+    # 8*16+8 + 3*8+3 = 163
+    assert "163" in out
+
+    dot = mx.visualization.plot_network(sym, shape=shapes)
+    assert "digraph" in dot.source
+    assert "fc1" in dot.source
+    # weights hidden by default
+    assert "fc1_weight" not in dot.source
+
+
+def test_amp_convert_symbol():
+    """Cast-insertion pass (ref ReducePrecision): matmul-class nodes get
+    bf16 input casts + fp32 output cast; numerics stay close."""
+    sym = _mlp()
+    conv = mx.amp.convert_symbol(sym, target_dtype="bfloat16")
+    js = json.loads(conv.tojson())
+    assert any(n["op"] == "amp_cast" for n in js["nodes"])
+    rs = onp.random.RandomState(0)
+    vals = {"x": mx.np.array(rs.rand(4, 16).astype("float32")),
+            "fc1_weight": mx.np.array(rs.rand(8, 16).astype("float32")),
+            "fc1_bias": mx.np.zeros((8,)),
+            "fc2_weight": mx.np.array(rs.rand(3, 8).astype("float32")),
+            "fc2_bias": mx.np.zeros((3,))}
+    o32 = sym.eval(**vals)[0].asnumpy()
+    obf = conv.eval(**vals)[0].asnumpy()
+    assert obf.dtype == onp.float32  # output cast back
+    onp.testing.assert_allclose(o32, obf, rtol=2e-2, atol=2e-2)
+    # arguments unchanged — variables are shared, not cloned
+    assert conv.list_arguments() == sym.list_arguments()
+
+
+def test_amp_convert_symbol_excluded():
+    sym = _mlp()
+    conv = mx.amp.convert_symbol(sym, excluded_sym_names=["fc1"])
+    js = json.loads(conv.tojson())
+    casts = [n for n in js["nodes"] if n["op"] == "amp_cast"]
+    # only fc2 converted: 3 input casts + 1 output cast
+    assert len(casts) == 4
+
+
+def test_quantize_symbol():
+    """QuantizeGraph-pass analogue: int8 FC nodes, numerics within int8
+    tolerance of fp32."""
+    from mxnet_tpu.contrib.quantization import quantize_symbol
+
+    sym = _mlp()
+    qsym, skipped = quantize_symbol(sym, thresholds={"fc1": 4.0})
+    assert skipped == []
+    js = json.loads(qsym.tojson())
+    ops = {n["op"] for n in js["nodes"]}
+    assert "quantized_fully_connected" in ops
+    assert "fully_connected" not in ops
+    rs = onp.random.RandomState(3)
+    vals = {"x": mx.np.array(rs.rand(4, 16).astype("float32")),
+            "fc1_weight": mx.np.array(
+                (rs.rand(8, 16) - 0.5).astype("float32")),
+            "fc1_bias": mx.np.zeros((8,)),
+            "fc2_weight": mx.np.array(
+                (rs.rand(3, 8) - 0.5).astype("float32")),
+            "fc2_bias": mx.np.zeros((3,))}
+    o32 = sym.eval(**vals)[0].asnumpy()
+    oq = qsym.eval(**vals)[0].asnumpy()
+    onp.testing.assert_allclose(o32, oq, rtol=0.1, atol=0.1)
+
+
+def test_quantize_symbol_skips_traced():
+    from mxnet_tpu.contrib.quantization import quantize_symbol
+
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    x = mx.np.ones((2, 6))
+    net(x)
+    sym = net.symbolize()
+    qsym, skipped = quantize_symbol(sym)
+    assert len(skipped) == 1  # traced closure reported, not silently kept
+
+
+def test_trace_captured_constant():
+    """Arrays captured from outside the trace become embedded constants,
+    not unbound variables (code-review regression)."""
+    c = mx.np.array([2.0, 3.0])
+    x = mx.np.ones((2,))
+    sym = mx.sym.trace(lambda a: a * c, [x], input_names=["data"])
+    assert sym.list_arguments() == ["data"]
+    out = sym.eval(data=mx.np.ones((2,)))[0]
+    onp.testing.assert_allclose(out.asnumpy(), [2.0, 3.0])
+
+
+def test_trace_ignores_stale_stamps():
+    """Stamps from an earlier deferred-compute session must not leak into
+    a new trace (code-review regression)."""
+    from mxnet_tpu.ops import dispatch
+
+    x = mx.np.ones((2,))
+    with dispatch.deferred_compute():
+        y = x + 1.0  # stamped under the first session
+    sym = mx.sym.trace(lambda a: a * 2.0, [y], input_names=["data"])
+    assert sym.list_arguments() == ["data"]
+    out = sym.eval(data=mx.np.array([5.0, 5.0]))[0]
+    onp.testing.assert_allclose(out.asnumpy(), [10.0, 10.0])
+
+
+def test_symbolize_nested_args():
+    """Nested-structure inputs replay with the right arity
+    (code-review regression)."""
+    class TwoIn(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d = mx.gluon.nn.Dense(3)
+
+        def forward(self, x, states):
+            h, c = states
+            return self.d(x) + h + c
+
+    net = TwoIn()
+    net.initialize()
+    x, h, c = mx.np.ones((2, 4)), mx.np.zeros((2, 3)), mx.np.zeros((2, 3))
+    ref = net(x, [h, c]).asnumpy()
+    sym = net.symbolize()
+    binds = {k: p.data() for k, p in net.collect_params().items()}
+    out = sym.eval(data=x, data1=h, data2=c, **binds)[0]
+    onp.testing.assert_allclose(out.asnumpy(), ref, atol=1e-6)
+
+
+def test_amp_convert_symbol_multi_output_rnn():
+    """Multi-output traced nodes (npx.rnn) keep all outputs usable after
+    conversion (code-review regression)."""
+    rs = onp.random.RandomState(0)
+    t, b, i, h = 3, 2, 4, 5
+    x = mx.np.array(rs.rand(t, b, i).astype("float32"))
+    nparams = (i * h + h * h + 2 * h)
+    w = mx.np.array(rs.rand(nparams).astype("float32") * 0.1)
+    s0 = mx.np.zeros((1, b, h))
+
+    def f(xx, ww, ss):
+        return mx.npx.rnn(data=xx, parameters=ww, state=ss, mode="rnn_tanh",
+                          state_size=h, num_layers=1, state_outputs=True)
+
+    sym = mx.sym.trace(f, [x, w, s0], input_names=["x", "w", "s"])
+    conv = mx.amp.convert_symbol(sym, target_dtype="bfloat16",
+                                 target_dtype_ops=["rnn"])
+    outs = conv.eval(x=x, w=w, s=s0)
+    ref = f(x, w, s0)
+    assert len(outs) == len(ref)
+    onp.testing.assert_allclose(outs[0].asnumpy(),
+                                ref[0].asnumpy(), rtol=3e-2, atol=3e-2)
