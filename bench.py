@@ -1,20 +1,55 @@
-"""Headline benchmark: ResNet-50 training throughput on one chip.
+"""Headline benchmarks over the five BASELINE configs.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+Prints ONE JSON line. Top-level fields are the headline metric (ResNet-50
+training img/s/chip vs the reference's published V100 fp32 b128 number,
+BASELINE.md perf.md:243-254); ``extra_metrics`` carries the other BASELINE
+configs (BERT-base pretrain samples/sec, LeNet-5, LSTM LM, SSD-ResNet50) —
+the reference publishes no numbers for those, so their vs_baseline is null.
 
-Baseline: the reference's published ResNet-50 fp32 b128 training number,
-363.69 img/s on V100 (BASELINE.md, perf.md:243-254). The full SPMD train
-step (fwd+bwd+SGD, one jitted XLA computation) is timed end to end with
-device sync; host-side write-backs are excluded by driving the raw step fn.
+Each config times the raw jitted SPMD step (fwd+bwd+optimizer as one XLA
+computation) end to end with a device sync; host-side write-backs are
+excluded by driving the step function directly, with the param chain
+carrying the step-to-step dependency.
 """
 from __future__ import annotations
 
 import json
 import time
+import traceback
 
 
-def main():
+def _timed_raw_steps(trainer, xd, yd, n_steps, mesh):
+    """Drive trainer._step_fn directly; returns seconds for n_steps."""
+    step = trainer._step_fn
+    pvals, avals, key = trainer.pvals, trainer.avals, trainer._key
+    opt_state, t = trainer.opt_state, trainer._t
+
+    xd = trainer._put(xd)
+    yd = trainer._put(yd)
+    t += 1
+    pvals, mutated, opt_state, loss = step(pvals, avals, key, opt_state,
+                                           t, xd, yd)
+    float(loss)  # absorb residual compile before the timed region
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        t += 1
+        pvals, mutated, opt_state, loss = step(pvals, avals, key, opt_state,
+                                               t, xd, yd)
+    float(loss)  # scalar D2H read drains the pipeline (a relay can report
+    # block_until_ready early; a host transfer cannot lie)
+    return time.perf_counter() - t0
+
+
+def _ce(pred, y):
+    import jax
+    import jax.numpy as jnp
+
+    logp = jax.nn.log_softmax(pred.astype(jnp.float32))
+    return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+
+
+def bench_resnet50(on_tpu):
+    """BASELINE config #2: ResNet-50 training img/s (vs V100 fp32 b128)."""
     import jax
     import jax.numpy as jnp
     import numpy as onp
@@ -23,12 +58,11 @@ def main():
     from mxnet_tpu.parallel.mesh import make_mesh
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
-    platform = jax.devices()[0].platform
-    batch = 128 if platform == "tpu" else 8
-    image = 224 if platform == "tpu" else 64
+    batch = 128 if on_tpu else 8
+    image = 224 if on_tpu else 64
     # channel-last on TPU: channels ride the 128-lane minor tile, so convs
-    # feed the MXU without layout-transpose pairs (see ops/nn.py layout note)
-    layout = "NHWC" if platform == "tpu" else "NCHW"
+    # feed the MXU without layout-transpose pairs (see ops/nn.py)
+    layout = "NHWC" if on_tpu else "NCHW"
 
     mx.random.seed(0)
     net = mx.gluon.model_zoo.get_model("resnet50_v1", layout=layout)
@@ -37,72 +71,262 @@ def main():
              else (2, 3, image, image))
     net(mx.np.zeros(shape))
 
-    def ce(pred, y):
-        logp = jax.nn.log_softmax(pred.astype(jnp.float32))
-        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
-
     mesh = make_mesh({"dp": -1}, devices=jax.devices()[:1])
     # bf16 compute on the MXU (master params fp32) — the TPU-native analog
-    # of the reference's fp16 rows in perf.md; the fp32 baseline row is
-    # still the comparison denominator, conservatively.
-    trainer = ShardedTrainer(net, ce, mesh=mesh, optimizer="sgd",
+    # of the reference's fp16 rows; the fp32 baseline row is still the
+    # comparison denominator, conservatively.
+    trainer = ShardedTrainer(net, _ce, mesh=mesh, optimizer="sgd",
                              learning_rate=0.05, momentum=0.9,
-                             compute_dtype=jnp.bfloat16
-                             if platform == "tpu" else None)
-
+                             compute_dtype=jnp.bfloat16 if on_tpu else None)
     rs = onp.random.RandomState(0)
     xshape = ((batch, image, image, 3) if layout == "NHWC"
               else (batch, 3, image, image))
     x = onp.asarray(rs.rand(*xshape), onp.float32)
     y = onp.asarray(rs.randint(0, 1000, size=(batch,)), onp.int32)
-
-    for _ in range(3):  # warmup (compile + first exec), full write-back path
-        loss = trainer.step(x, y)
-
-    # timed region drives the raw jitted step (no host write-backs); the
-    # param chain carries the step-to-step dependency. avals/key are held
-    # constant — legal inputs, same computation.
-    step = trainer._step_fn
-    pvals, avals, key = trainer.pvals, trainer.avals, trainer._key
-    opt_state, t = trainer.opt_state, trainer._t
-    from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as P
-
-    sh = NamedSharding(mesh, P("dp"))  # same sharding the warmup compiled for
-    xd, yd = jax.device_put(x, sh), jax.device_put(y, sh)
-    t += 1
-    pvals, mutated, opt_state, loss = step(pvals, avals, key, opt_state,
-                                           t, xd, yd)
-    float(loss)  # absorb any residual compile before the timed region
-
-    n_steps = 20 if platform == "tpu" else 5
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        t += 1
-        pvals, mutated, opt_state, loss = step(pvals, avals, key, opt_state,
-                                               t, xd, yd)
-    float(loss)  # scalar host transfer fully drains the pipeline (the axon
-    # relay can report block_until_ready early; a D2H read cannot lie)
-    dt = time.perf_counter() - t0
-
+    for _ in range(2):
+        trainer.step(x, y)
+    n_steps = 20 if on_tpu else 3
+    dt = _timed_raw_steps(trainer, x, y, n_steps, mesh)
     ips = batch * n_steps / dt
-    baseline = 363.69  # V100 fp32 b128 training, BASELINE.md
-    # MFU: ResNet-50 fwd ≈ 4.1 GFLOP/img @224², train ≈ 3× fwd, against the
-    # chip's bf16 peak (compute_dtype above is bf16 on TPU). Peak table by
-    # device kind; unknown kinds report no MFU rather than a wrong one.
+    # MFU: ResNet-50 fwd ≈ 4.1 GFLOP/img @224², train ≈ 3× fwd, against
+    # the chip's bf16 peak; unknown kinds report no MFU rather than wrong
     peaks = {"v5 lite": 197e12, "v5litepod": 197e12, "v4": 275e12,
              "v5p": 459e12, "v6 lite": 918e12, "v6e": 918e12}
     kind = jax.devices()[0].device_kind.lower()
     peak = next((v for k, v in peaks.items() if k in kind), None)
-    mfu = (ips * 3 * 4.089e9 / peak) if (platform == "tpu" and peak) else None
-    print(json.dumps({
-        "metric": "resnet50_train_imgs_per_sec_per_chip",
-        "value": round(ips, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(ips / baseline, 4),
-        "layout": layout,
-        "mfu": round(mfu, 4) if mfu is not None else None,
-    }))
+    mfu = (ips * 3 * 4.089e9 / peak) if (on_tpu and peak) else None
+    return {"metric": "resnet50_train_imgs_per_sec_per_chip",
+            "value": round(ips, 2), "unit": "images/sec",
+            "vs_baseline": round(ips / 363.69, 4),
+            "layout": layout,
+            "mfu": round(mfu, 4) if mfu is not None else None}
+
+
+def bench_bert_base(on_tpu):
+    """BASELINE config #3: BERT-base pretraining samples/sec (MLM+NSP,
+    seq 128, masked positions 20; ref example/ ... no published number)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.bert import BERTForPretrain, get_bert
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    if on_tpu:
+        batch, seq, npred = 32, 128, 20
+        bert = get_bert("bert_12_768_12", vocab_size=30522, max_length=512)
+    else:
+        batch, seq, npred = 4, 32, 4
+        bert = get_bert("bert_12_768_12", vocab_size=1000, max_length=64,
+                        num_layers=2, units=64, hidden_size=128, num_heads=2)
+    mx.random.seed(0)
+    net = BERTForPretrain(bert)
+    net.initialize(mx.init.Xavier())
+    vocab = net._vocab_size
+
+    rs = onp.random.RandomState(0)
+    tokens = rs.randint(0, vocab, size=(2, seq)).astype("int32")
+    segs = onp.zeros((2, seq), "int32")
+    vlen = onp.full((2,), seq, "int32")
+    pos = rs.randint(0, seq, size=(2, npred)).astype("int32")
+    net(mx.np.array(tokens), mx.np.array(segs), mx.np.array(vlen),
+        mx.np.array(pos))
+
+    def loss_fn(pred, y):
+        mlm_scores, nsp_scores = pred
+        mlm_y, nsp_y = y
+        lp = jax.nn.log_softmax(mlm_scores.astype(jnp.float32), -1)
+        mlm = -jnp.take_along_axis(lp, mlm_y[..., None], -1)[..., 0]
+        lp2 = jax.nn.log_softmax(nsp_scores.astype(jnp.float32), -1)
+        nsp = -jnp.take_along_axis(lp2, nsp_y[:, None], -1)[:, 0]
+        return jnp.mean(mlm, axis=-1) + nsp
+
+    mesh = make_mesh({"dp": -1}, devices=jax.devices()[:1])
+    trainer = ShardedTrainer(net, loss_fn, mesh=mesh, optimizer="adamw",
+                             learning_rate=1e-4, weight_decay=0.01,
+                             compute_dtype=jnp.bfloat16 if on_tpu else None)
+    x = (rs.randint(0, vocab, size=(batch, seq)).astype("int32"),
+         onp.zeros((batch, seq), "int32"),
+         onp.full((batch,), seq, "int32"),
+         rs.randint(0, seq, size=(batch, npred)).astype("int32"))
+    y = (rs.randint(0, vocab, size=(batch, npred)).astype("int32"),
+         rs.randint(0, 2, size=(batch,)).astype("int32"))
+    for _ in range(2):
+        trainer.step(x, y)
+    n_steps = 20 if on_tpu else 3
+    dt = _timed_raw_steps(trainer, x, y, n_steps, mesh)
+    return {"metric": "bert_base_pretrain_samples_per_sec_per_chip",
+            "value": round(batch * n_steps / dt, 2), "unit": "samples/sec",
+            "vs_baseline": None, "seq_len": seq}
+
+
+def bench_lenet(on_tpu):
+    """BASELINE config #1: LeNet-5 training img/s."""
+    import jax
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    batch = 1024 if on_tpu else 64
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.get_model("lenet")
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((2, 1, 28, 28)))
+    mesh = make_mesh({"dp": -1}, devices=jax.devices()[:1])
+    trainer = ShardedTrainer(net, _ce, mesh=mesh, optimizer="sgd",
+                             learning_rate=0.05, momentum=0.9)
+    rs = onp.random.RandomState(0)
+    x = onp.asarray(rs.rand(batch, 1, 28, 28), onp.float32)
+    y = onp.asarray(rs.randint(0, 10, size=(batch,)), onp.int32)
+    for _ in range(2):
+        trainer.step(x, y)
+    n_steps = 30 if on_tpu else 5
+    dt = _timed_raw_steps(trainer, x, y, n_steps, mesh)
+    return {"metric": "lenet_train_imgs_per_sec_per_chip",
+            "value": round(batch * n_steps / dt, 2), "unit": "images/sec",
+            "vs_baseline": None}
+
+
+def bench_lstm_lm(on_tpu):
+    """BASELINE config #4: word-level LSTM LM (PTB-style: 2x650, seq 35)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn, rnn
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    if on_tpu:
+        vocab, embed, hidden, layers, batch, seq = 10000, 650, 650, 2, 64, 35
+    else:
+        vocab, embed, hidden, layers, batch, seq = 200, 32, 32, 1, 8, 12
+
+    class LSTMLM(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.embedding = nn.Embedding(vocab, embed)
+            self.lstm = rnn.LSTM(hidden, num_layers=layers)
+            self.decoder = nn.Dense(vocab, flatten=False)
+
+        def forward(self, x):          # (B, T) tokens
+            e = self.embedding(x).transpose(1, 0, 2)   # TNC for the RNN
+            out = self.lstm(e)                          # (T, B, H)
+            return self.decoder(out).transpose(1, 0, 2)  # (B, T, V)
+
+    mx.random.seed(0)
+    net = LSTMLM()
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((2, seq), dtype="int32"))
+
+    def loss_fn(pred, y):
+        lp = jax.nn.log_softmax(pred.astype(jnp.float32), -1)
+        nll = -jnp.take_along_axis(lp, y[..., None], -1)[..., 0]
+        return jnp.mean(nll, axis=-1)
+
+    mesh = make_mesh({"dp": -1}, devices=jax.devices()[:1])
+    trainer = ShardedTrainer(net, loss_fn, mesh=mesh, optimizer="sgd",
+                             learning_rate=1.0)
+    rs = onp.random.RandomState(0)
+    x = rs.randint(0, vocab, size=(batch, seq)).astype("int32")
+    y = rs.randint(0, vocab, size=(batch, seq)).astype("int32")
+    for _ in range(2):
+        trainer.step(x, y)
+    n_steps = 20 if on_tpu else 3
+    dt = _timed_raw_steps(trainer, x, y, n_steps, mesh)
+    toks = batch * seq * n_steps / dt
+    return {"metric": "lstm_lm_tokens_per_sec_per_chip",
+            "value": round(toks, 2), "unit": "tokens/sec",
+            "vs_baseline": None, "samples_per_sec": round(toks / seq, 2)}
+
+
+def bench_ssd(on_tpu):
+    """BASELINE config #5: SSD-ResNet50 training img/s. Targets
+    (multibox_target) are precomputed for the synthetic labels — anchors
+    are static per input shape — so the timed step is the same one-jit
+    fwd+bwd+update as the other configs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.ssd import training_targets
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    mx.random.seed(0)
+    if on_tpu:
+        batch, image = 32, 512
+        net = mx.gluon.model_zoo.get_model("ssd_512_resnet50_v1", classes=20)
+    else:
+        batch, image = 2, 64
+        from mxnet_tpu.gluon.model_zoo.ssd import SSD
+        from mxnet_tpu.gluon import nn
+
+        backbone = nn.HybridSequential()
+        backbone.add(nn.Conv2D(8, 3, strides=2, padding=1,
+                               activation="relu"),
+                     nn.Conv2D(16, 3, strides=2, padding=1,
+                               activation="relu"))
+        net = SSD([backbone], num_classes=3,
+                  sizes=[[0.2, 0.272]] * 4, ratios=[[1, 2, 0.5]] * 4)
+    net.initialize(mx.init.Xavier())
+    cls_p, box_p, anchors = net(mx.np.zeros((2, 3, image, image)))
+
+    rs = onp.random.RandomState(0)
+    x = onp.asarray(rs.rand(batch, 3, image, image), onp.float32)
+    # synthetic ground truth: one box per image, padded label rows = -1
+    ncls = net.num_classes
+    labels = onp.full((batch, 3, 5), -1.0, "float32")
+    labels[:, 0, 0] = rs.randint(0, ncls, size=batch)
+    xy = rs.rand(batch, 2) * 0.5
+    labels[:, 0, 1:3] = xy
+    labels[:, 0, 3:5] = xy + 0.3
+    bt, bm, ct = training_targets(anchors, mx.np.array(labels))
+    targets = (ct._data, bt._data, bm._data)
+
+    def loss_fn(pred, y):
+        cls_preds, box_preds, _anchors = pred
+        cls_t, box_t, box_m = y
+        lp = jax.nn.log_softmax(cls_preds.astype(jnp.float32), -1)
+        cls_l = -jnp.take_along_axis(
+            lp, cls_t[..., None].astype(jnp.int32), -1)[..., 0]
+        box_l = jnp.abs(box_preds.astype(jnp.float32) - box_t) * box_m
+        return jnp.mean(cls_l, axis=-1) + jnp.mean(box_l, axis=-1)
+
+    mesh = make_mesh({"dp": -1}, devices=jax.devices()[:1])
+    trainer = ShardedTrainer(net, loss_fn, mesh=mesh, optimizer="sgd",
+                             learning_rate=0.01, momentum=0.9,
+                             compute_dtype=jnp.bfloat16 if on_tpu else None)
+    for _ in range(2):
+        trainer.step(x, targets)
+    n_steps = 10 if on_tpu else 2
+    dt = _timed_raw_steps(trainer, x, targets, n_steps, mesh)
+    return {"metric": "ssd_resnet50_train_imgs_per_sec_per_chip",
+            "value": round(batch * n_steps / dt, 2), "unit": "images/sec",
+            "vs_baseline": None, "image_size": image}
+
+
+def main():
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    result = bench_resnet50(on_tpu)
+    extras = []
+    for fn in (bench_bert_base, bench_lenet, bench_lstm_lm, bench_ssd):
+        try:
+            extras.append(fn(on_tpu))
+        except Exception:
+            extras.append({"metric": fn.__name__, "value": None,
+                           "error": traceback.format_exc(limit=2)
+                           .splitlines()[-1]})
+    result["extra_metrics"] = extras
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -114,6 +114,13 @@ class Block:
         self._forward_pre_hooks.append(hook)
         return _HookHandle(self._forward_pre_hooks, hook)
 
+    def _all_blocks(self):
+        """This block + every descendant (any Block subclass)."""
+        yield self
+        for c in self._children.values():
+            if isinstance(c, Block):
+                yield from c._all_blocks()
+
     # -- parameter access ---------------------------------------------------
     def collect_params(self, select: Optional[str] = None) -> "Dict[str, Parameter]":
         """Structured-name → Parameter dict (ref block.py collect_params)."""
@@ -441,12 +448,6 @@ class HybridBlock(Block):
             logging.getLogger(__name__).warning(
                 "export: could not write %s-symbol.json: %s", path, e)
         return out
-
-    def _all_blocks(self):
-        yield self
-        for c in self._children.values():
-            if isinstance(c, Block):
-                yield from c._all_blocks()
 
     def symbolize(self, *args) -> "mxnet_tpu.symbol.Symbol":
         """Trace this block's forward into an mx.symbol.Symbol — the

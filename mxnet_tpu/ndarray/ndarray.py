@@ -301,6 +301,7 @@ class NDArray:
                 res = invoke(lambda x: x.at[ckey].set(value), [self], name="setitem")
             self._set_data(res._data)
             self._autograd_entry = res._autograd_entry
+            self._dc_entry = getattr(res, "_dc_entry", None)
         else:
             self._set_data(new)
 
@@ -380,6 +381,10 @@ class NDArray:
             return res
         self._set_data(res._data)
         self._autograd_entry = res._autograd_entry
+        # keep the deferred-compute stamp current too, else traced graphs
+        # silently drop in-place updates (the _DCNode input snapshot makes
+        # this safe — no self-cycle)
+        self._dc_entry = getattr(res, "_dc_entry", None)
         return self
 
     def __iadd__(self, o):

@@ -42,7 +42,11 @@ class _DCNode:
 
     def __init__(self, fn, inputs, name, n_out, token):
         self.fn = fn
-        self.inputs = inputs      # NDArray inputs (leaf discovery)
+        # inputs are SNAPSHOT pairs (ndarray, its _dc_entry at record time):
+        # in-place ops rebind the array's stamp to the new node, so reading
+        # stamps later would see the consumer instead of the producer (a
+        # cycle for `h += a`); the snapshot pins the true dataflow edge
+        self.inputs = inputs
         self.name = name
         self.n_out = n_out
         self.token = token        # identifies the recording session, so a
@@ -119,8 +123,8 @@ def invoke(fn: Callable, inputs: Sequence, name: str = "op",
         outs = [NDArray(o) for o in outs_raw]
 
     if is_deferred_compute():
-        dc = _DCNode(fn, list(inputs), name, len(outs_raw),
-                     _DC_STATE.token)
+        snap = [(x, getattr(x, "_dc_entry", None)) for x in inputs]
+        dc = _DCNode(fn, snap, name, len(outs_raw), _DC_STATE.token)
         for i, nd in enumerate(outs):
             nd._dc_entry = (dc, i)
 

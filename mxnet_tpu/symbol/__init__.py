@@ -13,6 +13,7 @@ from __future__ import annotations
 import inspect
 from typing import Dict, List
 
+from ..base import MXNetError
 from .symbol import (Symbol, Variable, var, Group, fromjson, load, trace,
                      register_op, resolve_op, _apply_op, _unique, _ALIASES)
 
@@ -39,37 +40,63 @@ def _make_builder(public_name: str):
 
     def build(*args, **kwargs):
         name = kwargs.pop("name", None)
+        base = name or _unique(opname)
         try:
             sig = inspect.signature(f)
             param_names = list(sig.parameters)
+            var_positional = any(p.kind == p.VAR_POSITIONAL
+                                 for p in sig.parameters.values())
             # reference callers say data=...; some npx signatures call the
             # first input x — accept both
-            if "data" in kwargs and "data" not in param_names and param_names:
+            if "data" in kwargs and "data" not in param_names and \
+                    param_names and not var_positional:
                 kwargs[param_names[0]] = kwargs.pop("data")
-            bound = sig.bind_partial(*args, **kwargs)
-            items = list(bound.arguments.items())
         except (ValueError, TypeError):
-            items = [(f"arg{i}", a) for i, a in enumerate(args)]
-            items += list(kwargs.items())
-            param_names = []
-        base = name or _unique(opname)
-        arr, attrs = {}, {}
-        for k, v in items:
-            if isinstance(v, Symbol):
-                arr[k] = v
+            param_names, var_positional = [], True
+        if not var_positional:
+            try:
+                # num_outputs is graph metadata, not an op kwarg
+                meta = {k: kwargs.pop(k) for k in ("num_outputs",)
+                        if k in kwargs}
+                bound = sig.bind_partial(*args, **kwargs)
+            except TypeError:
+                kwargs.update(meta)
+                var_positional = True
             else:
-                attrs[k] = v
-        no_bias = bool(attrs.get("no_bias", False))
-        for pname in _AUTO_VARS.get(opname, []):
-            if pname in arr or pname in attrs:  # given (even as None)
-                continue
-            if pname == "bias" and no_bias:
-                continue
-            arr[pname] = Variable(f"{base}_{pname}")
-        # positional order must match the signature
-        order = [p for p in param_names if p in arr] + \
-                [k for k in arr if k not in param_names]
-        sym_args = [arr[p] for p in order]
+                kwargs.update(meta)
+        if not var_positional:
+            items = list(bound.arguments.items())
+            items += [(k, v) for k, v in meta.items()]
+            arr, attrs = {}, {}
+            for k, v in items:
+                if isinstance(v, Symbol):
+                    arr[k] = v
+                else:
+                    attrs[k] = v
+            no_bias = bool(attrs.get("no_bias", False))
+            for pname in _AUTO_VARS.get(opname, []):
+                if pname in arr or pname in attrs:  # given (even as None)
+                    continue
+                if pname == "bias" and no_bias:
+                    continue
+                arr[pname] = Variable(f"{base}_{pname}")
+            # positional order must match the signature
+            order = [p for p in param_names if p in arr] + \
+                    [k for k in arr if k not in param_names]
+            sym_args = [arr[p] for p in order]
+            return _apply_op(opname, sym_args, attrs, name=base)
+        # *args-style op (e.g. wrap_op'd jnp passthroughs): keep a
+        # positional template — None marks a Symbol input slot, literals
+        # ride along verbatim (pos_args is interpreted by Symbol._interpret)
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                raise MXNetError(
+                    f"op '{opname}' takes *args; pass Symbol inputs "
+                    "positionally, not as keyword '%s'" % k)
+        sym_args = [a for a in args if isinstance(a, Symbol)]
+        attrs = dict(kwargs)
+        attrs["pos_args"] = [None if isinstance(a, Symbol) else a
+                             for a in args]
         return _apply_op(opname, sym_args, attrs, name=base)
 
     build.__name__ = public_name

@@ -180,6 +180,8 @@ class Symbol:
                 if index in (full, b, self._outputs[i][0].name):
                     return Symbol([self._outputs[i]])
             raise MXNetError(f"no output named '{index}'; have {names}")
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
         return Symbol([self._outputs[index]])
 
     def __len__(self):
@@ -241,10 +243,36 @@ class Symbol:
                     outs = [NDArray(o) for o in outs]
                 else:
                     f = resolve_op(node.op)
-                    res = f(*ins, **{k: v for k, v in node.attrs.items()
-                                     if not k.startswith("__")})
+                    kw = {k: v for k, v in node.attrs.items()
+                          if not k.startswith("__")}
+                    kw.pop("num_outputs", None)  # graph metadata
+                    pos_template = kw.pop("pos_args", None)
+                    if pos_template is not None:
+                        # *args-style op: None slots take Symbol inputs in
+                        # order, literals ride along verbatim
+                        it = iter(ins)
+                        call_args = [next(it) if slot is None else slot
+                                     for slot in pos_template]
+                        res = f(*call_args, **kw)
+                    else:
+                        import inspect
+                        try:
+                            sig = inspect.signature(f)
+                            if not any(p.kind == p.VAR_KEYWORD
+                                       for p in sig.parameters.values()):
+                                kw = {k: v for k, v in kw.items()
+                                      if k in sig.parameters}
+                        except (ValueError, TypeError):
+                            pass
+                        res = f(*ins, **kw)
                     outs = list(res) if isinstance(res, (tuple, list)) \
                         else [res]
+                if len(outs) != node.n_out:
+                    raise MXNetError(
+                        f"op '{node.op}' node '{node.name}' produced "
+                        f"{len(outs)} outputs but the symbol declares "
+                        f"{node.n_out}; pass num_outputs={len(outs)} when "
+                        "building multi-output symbol ops")
                 for i, o in enumerate(outs):
                     values[(id(node), i)] = o
         return [values[(id(n), i)] for n, i in self._outputs]
@@ -299,11 +327,13 @@ class Symbol:
         return arg_shapes, out_shapes, aux_shapes
 
     def infer_type(self, **kwargs):
-        """Ref symbol.py infer_type — dtypes via the same abstract eval.
-        Shapes are rank-1 placeholders; pass ShapeDtypeStructs to
-        infer_shape when shape-dependent promotion matters."""
-        arg_names = self.list_arguments() + self.list_auxiliary_states()
-        missing = [n for n in arg_names if n not in kwargs]
+        """Ref symbol.py infer_type → (arg_types, out_types, aux_types),
+        aligned with list_arguments()/list_auxiliary_states(). Shapes are
+        rank-1 placeholders; pass ShapeDtypeStructs to infer_shape when
+        shape-dependent promotion matters."""
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        missing = [n for n in arg_names + aux_names if n not in kwargs]
         if missing:
             raise MXNetError(f"infer_type missing dtypes for {missing}")
         shapes = {n: jax.ShapeDtypeStruct((1,), jnp.dtype(d))
@@ -315,7 +345,8 @@ class Symbol:
 
         res = jax.eval_shape(f, shapes)
         return ([jnp.dtype(kwargs[n]) for n in arg_names],
-                [jnp.dtype(o.dtype) for o in res], [])
+                [jnp.dtype(o.dtype) for o in res],
+                [jnp.dtype(kwargs[n]) for n in aux_names])
 
     @staticmethod
     def _mk_nd(aval):
@@ -447,11 +478,13 @@ def _apply_op(opname: str, sym_args: Sequence[Symbol],
         if len(s._outputs) != 1:
             raise MXNetError(f"op '{opname}' inputs must be single-output "
                              "symbols; index with sym[i] first")
+    # multi-output composed ops declare arity via num_outputs (reference
+    # split/SliceChannel convention); the interpreter enforces the match
+    n_out = int(attrs.get("num_outputs", 1))
     node = _Node(name or _unique(opname.lower() + ""),
                  opname, dict(attrs),
-                 [s._outputs[0] for s in sym_args])
-    # multi-output ops: probe lazily at eval; n_out adjusted by interpreter
-    return Symbol([(node, 0)])
+                 [s._outputs[0] for s in sym_args], n_out=n_out)
+    return Symbol([(node, i) for i in range(n_out)])
 
 
 def Variable(name: str, **attrs) -> Symbol:
@@ -493,7 +526,8 @@ def fromjson(text: str) -> Symbol:
                     "its structure only — reload the executable graph via "
                     "SymbolBlock.imports (StableHLO)")
             resolve_op(entry["op"])
-            nodes.append(_Node(entry["name"], entry["op"], attrs, inputs))
+            nodes.append(_Node(entry["name"], entry["op"], attrs, inputs,
+                               n_out=int(attrs.get("num_outputs", 1))))
     heads = [(nodes[i], oi) for i, oi, _ in data["heads"]]
     return Symbol(heads)
 
@@ -540,11 +574,12 @@ def trace(fn: Callable, example_inputs: Sequence, input_names=None,
 
     nodes: Dict[int, _Node] = {}
 
-    def node_for(nd: NDArray) -> Tuple[_Node, int]:
-        # explicit names take precedence over any recorded producer, and
-        # stamps from *other* trace sessions are ignored (stale arrays
-        # produced under an earlier scope are plain leaves here)
-        rec = getattr(nd, "_dc_entry", None)
+    def node_for(nd: NDArray, rec) -> Tuple[_Node, int]:
+        # rec is the _dc_entry SNAPSHOT for this use of nd (in-place ops
+        # rebind the live stamp, so the recorded edge is authoritative).
+        # Explicit names take precedence over any recorded producer, and
+        # stamps from other trace sessions are ignored (stale arrays from
+        # an earlier scope are plain leaves here).
         if rec is not None and rec[0].token is not token:
             rec = None
         if rec is None or id(nd) in id2name:
@@ -564,10 +599,11 @@ def trace(fn: Callable, example_inputs: Sequence, input_names=None,
         dc, idx = rec
         if id(dc) in nodes:
             return (nodes[id(dc)], idx)
-        ins = [node_for(x) for x in dc.inputs]
+        ins = [node_for(x, e) for x, e in dc.inputs]
         n = _Node(_unique(dc.name + "_"), dc.name, {}, ins, fn=dc.fn,
                   n_out=dc.n_out)
         nodes[id(dc)] = n
         return (n, idx)
 
-    return Symbol([node_for(o) for o in outs])
+    return Symbol([node_for(o, getattr(o, "_dc_entry", None))
+                   for o in outs])

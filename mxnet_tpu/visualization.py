@@ -55,6 +55,7 @@ def print_summary(symbol, shape: Optional[Dict[str, tuple]] = None,
     print(f"{'Layer (type)':<34}{'Output Shape':<22}{'Param #':<12}")
     print("=" * line_length)
     total = 0
+    counted = set()
     heads = set(symbol.list_outputs())
     nodes = symbol._topo()
     # parameter count: product of each param-like variable's inferred shape
@@ -83,7 +84,10 @@ def print_summary(symbol, shape: Optional[Dict[str, tuple]] = None,
                 for d in var_shape[src.name]:
                     c *= d
                 nparams += c
-        total += nparams
+                # shared (tied) params count once in the total
+                if id(src) not in counted:
+                    counted.add(id(src))
+                    total += c
         mark = " *" if f"{n.name}_output" in heads or n.name in heads else ""
         print(f"{(n.name + ' (' + (n.op or 'null') + ')')[:33]:<34}"
               f"{out_shape:<22}{nparams:<12}{mark}")

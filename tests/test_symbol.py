@@ -336,3 +336,91 @@ def test_amp_convert_symbol_multi_output_rnn():
     assert len(outs) == len(ref)
     onp.testing.assert_allclose(outs[0].asnumpy(),
                                 ref[0].asnumpy(), rtol=3e-2, atol=3e-2)
+
+
+def test_trace_inplace_ops_recorded():
+    """In-place += inside a traced forward must appear in the graph
+    (code-review regression: stale stamps dropped the update)."""
+    a = mx.np.array([1.0, 1.0])
+    w = mx.np.array([3.0, 3.0])
+    def f(x):
+        h = x * w
+        h += x
+        return h
+    sym = mx.sym.trace(f, [a], input_names=["data"], known={"w": w})
+    out = sym.eval(data=mx.np.array([2.0, 2.0]), w=w)[0]
+    onp.testing.assert_allclose(out.asnumpy(), [8.0, 8.0])  # 2*3 + 2
+
+
+def test_sym_multi_output_arity_enforced():
+    """Composed multi-output ops need num_outputs; a silent single-output
+    truncation must raise instead (code-review regression)."""
+    v = mx.sym.Variable("v")
+    bad = mx.sym.split(v, 2, axis=0)
+    with pytest.raises(MXNetError, match="num_outputs"):
+        bad.eval(v=mx.np.arange(4))
+    good = mx.sym.split(v, 2, axis=0, num_outputs=2)
+    assert good.num_outputs == 2
+    outs = good.eval(v=mx.np.arange(4.0))
+    assert outs[0].asnumpy().tolist() == [0.0, 1.0]
+    assert outs[1].asnumpy().tolist() == [2.0, 3.0]
+
+
+def test_sym_slice_getitem():
+    ints = _mlp().get_internals()
+    sub = ints[0:2]
+    assert sub.num_outputs == 2
+    assert len(sub.list_outputs()) == 2
+
+
+def test_infer_type_aux_split():
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(4), mx.gluon.nn.BatchNorm())
+    net.initialize()
+    net(mx.np.ones((2, 6)))
+    sym = net.symbolize()
+    kwargs = {n: "float32" for n in
+              sym.list_arguments() + sym.list_auxiliary_states()}
+    kwargs["data"] = "float32"
+    arg_t, out_t, aux_t = sym.infer_type(**kwargs)
+    assert len(arg_t) == len(sym.list_arguments())
+    assert len(aux_t) == len(sym.list_auxiliary_states()) == 2
+
+
+def test_symbolize_with_plain_block_child():
+    """Non-hybrid Block children must not break symbolize
+    (code-review regression)."""
+    class Plain(mx.gluon.Block):
+        def forward(self, x):
+            return x * 2.0
+
+    class Outer(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.plain = Plain()
+            self.dense = mx.gluon.nn.Dense(3)
+
+        def forward(self, x):
+            return self.dense(self.plain(x))
+
+    net = Outer()
+    net.initialize()
+    x = mx.np.ones((2, 5))
+    ref = net(x).asnumpy()
+    sym = net.symbolize()
+    binds = {k: p.data() for k, p in net.collect_params().items()}
+    out = sym.eval(data=x, **binds)[0]
+    onp.testing.assert_allclose(out.asnumpy(), ref, atol=1e-6)
+
+
+def test_print_summary_tied_params_counted_once(capsys):
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("tied_weight")
+    a = mx.sym.FullyConnected(data=x, weight=w, num_hidden=4,
+                              no_bias=True, name="fc_a")
+    b = mx.sym.FullyConnected(data=a, weight=w, num_hidden=4,
+                              no_bias=True, name="fc_b")
+    mx.visualization.print_summary(
+        b, shape={"x": (1, 4), "tied_weight": (4, 4)})
+    out = capsys.readouterr().out
+    assert "Total params: 16" in out  # not 32
