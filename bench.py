@@ -18,7 +18,7 @@ import time
 import traceback
 
 
-def _timed_raw_steps(trainer, xd, yd, n_steps, mesh):
+def _timed_raw_steps(trainer, xd, yd, n_steps):
     """Drive trainer._step_fn directly; returns seconds for n_steps."""
     step = trainer._step_fn
     pvals, avals, key = trainer.pvals, trainer.avals, trainer._key
@@ -86,7 +86,7 @@ def bench_resnet50(on_tpu):
     for _ in range(2):
         trainer.step(x, y)
     n_steps = 20 if on_tpu else 3
-    dt = _timed_raw_steps(trainer, x, y, n_steps, mesh)
+    dt = _timed_raw_steps(trainer, x, y, n_steps)
     ips = batch * n_steps / dt
     # MFU: ResNet-50 fwd ≈ 4.1 GFLOP/img @224², train ≈ 3× fwd, against
     # the chip's bf16 peak; unknown kinds report no MFU rather than wrong
@@ -156,7 +156,7 @@ def bench_bert_base(on_tpu):
     for _ in range(2):
         trainer.step(x, y)
     n_steps = 20 if on_tpu else 3
-    dt = _timed_raw_steps(trainer, x, y, n_steps, mesh)
+    dt = _timed_raw_steps(trainer, x, y, n_steps)
     return {"metric": "bert_base_pretrain_samples_per_sec_per_chip",
             "value": round(batch * n_steps / dt, 2), "unit": "samples/sec",
             "vs_baseline": None, "seq_len": seq}
@@ -185,7 +185,7 @@ def bench_lenet(on_tpu):
     for _ in range(2):
         trainer.step(x, y)
     n_steps = 30 if on_tpu else 5
-    dt = _timed_raw_steps(trainer, x, y, n_steps, mesh)
+    dt = _timed_raw_steps(trainer, x, y, n_steps)
     return {"metric": "lenet_train_imgs_per_sec_per_chip",
             "value": round(batch * n_steps / dt, 2), "unit": "images/sec",
             "vs_baseline": None}
@@ -238,7 +238,7 @@ def bench_lstm_lm(on_tpu):
     for _ in range(2):
         trainer.step(x, y)
     n_steps = 20 if on_tpu else 3
-    dt = _timed_raw_steps(trainer, x, y, n_steps, mesh)
+    dt = _timed_raw_steps(trainer, x, y, n_steps)
     toks = batch * seq * n_steps / dt
     return {"metric": "lstm_lm_tokens_per_sec_per_chip",
             "value": round(toks, 2), "unit": "tokens/sec",
@@ -306,7 +306,7 @@ def bench_ssd(on_tpu):
     for _ in range(2):
         trainer.step(x, targets)
     n_steps = 10 if on_tpu else 2
-    dt = _timed_raw_steps(trainer, x, targets, n_steps, mesh)
+    dt = _timed_raw_steps(trainer, x, targets, n_steps)
     return {"metric": "ssd_resnet50_train_imgs_per_sec_per_chip",
             "value": round(batch * n_steps / dt, 2), "unit": "images/sec",
             "vs_baseline": None, "image_size": image}

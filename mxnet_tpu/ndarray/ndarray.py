@@ -288,9 +288,12 @@ class NDArray:
                                       if not isinstance(value, jax.Array) else
                                       value.astype(self._data.dtype))
         from .. import autograd
+        from ..ops import dispatch as _dispatch
 
-        if autograd.is_recording() and self._autograd_entry is not None:
-            # record the functional scatter so grads flow through the write
+        if (autograd.is_recording() and self._autograd_entry is not None) \
+                or _dispatch.is_deferred_compute():
+            # record the functional scatter so the write survives in the
+            # tape (grads flow through it) and in traced symbol graphs
             from ..ops.dispatch import invoke
 
             vsrc = NDArray(value) if isinstance(value, jax.Array) else None

@@ -223,9 +223,17 @@ _LIFTED = [
     "shape", "ndim", "size", "result_type", "can_cast", "promote_types",
     "isscalar", "iscomplexobj", "isrealobj",
     "vander", "gradient", "ndindex" if hasattr(jnp, "ndindex") else "asarray",
+    # polynomial / windowing / misc numeric tail (ref src/operator/numpy/)
+    "polyval", "polyfit", "polyadd", "polysub", "polymul", "polyder",
+    "polyint", "roots",
+    "trim_zeros", "apply_along_axis", "apply_over_axes",
+    "hamming", "hanning", "blackman", "bartlett", "kaiser",
+    "interp", "ediff1d", "i0", "sinc", "heaviside", "packbits", "unpackbits",
+    "spacing", "unwrap", "nan_to_num", "searchsorted",
 ]
 
 _g = globals()
+_g["fix"] = wrap_op(jnp.trunc, "fix")  # jnp.fix is deprecated; same op
 for _name in dict.fromkeys(_LIFTED):
     if _name in _g:
         continue
@@ -287,6 +295,15 @@ def array_split(ary, indices_or_sections, axis=0):  # noqa: F811
 
 def bfloat16_cast(a):
     return a.astype(jnp.bfloat16)
+
+
+# numpy aliases jnp dropped (ref numpy<->mxnet parity table)
+in1d = wrap_op(lambda ar1, ar2, assume_unique=False, invert=False:
+               jnp.isin(ar1, ar2, assume_unique=assume_unique,
+                        invert=invert).ravel(), "in1d")
+msort = wrap_op(lambda a: jnp.sort(a, axis=0), "msort")
+trapz = wrap_op(getattr(jnp, "trapezoid", getattr(jnp, "trapz", None)),
+                "trapz")
 
 
 from . import linalg  # noqa: E402

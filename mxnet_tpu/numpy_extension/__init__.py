@@ -498,3 +498,129 @@ def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=2,
         d, r, tuple(ps), spatial_scale=spatial_scale,
         sample_ratio=sample_ratio), (data, rois), {}, name="roi_align",
         out=out)
+
+
+# -- spatial / contrib ops (ref src/operator/contrib/, bilinear_sampler.cc,
+# spatial_transformer.cc, grid_generator.cc, count_sketch.cc) ----------------
+def bilinear_sampler(data, grid, out=None):
+    from ..ops import spatial as _sp
+
+    return call(_sp.bilinear_sampler, (data, grid), {},
+                name="bilinear_sampler", out=out)
+
+
+def grid_generator(data, transform_type="affine", target_shape=None,
+                   out=None):
+    from ..ops import spatial as _sp
+
+    return call(lambda d: _sp.grid_generator(
+        d, transform_type=transform_type,
+        target_shape=tuple(target_shape) if target_shape else None),
+        (data,), {}, name="grid_generator", out=out)
+
+
+def spatial_transformer(data, loc, target_shape, transform_type="affine",
+                        sampler_type="bilinear", out=None):
+    from ..ops import spatial as _sp
+
+    return call(lambda d, l: _sp.spatial_transformer(
+        d, l, tuple(target_shape), transform_type=transform_type,
+        sampler_type=sampler_type), (data, loc), {},
+        name="spatial_transformer", out=out)
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                           num_filter=None, num_group=1,
+                           num_deformable_group=1, no_bias=False, out=None):
+    from ..ops import spatial as _sp
+
+    args = (data, offset, weight) if bias is None or no_bias \
+        else (data, offset, weight, bias)
+
+    def f(d, o, w, b=None):
+        return _sp.deformable_convolution(
+            d, o, w, b, kernel=kernel, stride=stride, pad=pad,
+            dilate=dilate, num_filter=num_filter, num_group=num_group,
+            num_deformable_group=num_deformable_group)
+
+    return call(f, args, {}, name="deformable_convolution", out=out)
+
+
+def count_sketch(data, h, s, out_dim, out=None):
+    from ..ops import spatial as _sp
+
+    return call(lambda d, hh, ss: _sp.count_sketch(d, hh, ss, int(out_dim)),
+                (data, h, s), {}, name="count_sketch", out=out)
+
+
+def adaptive_max_pool2d(data, output_size, out=None):
+    from ..ops import spatial as _sp
+
+    return call(lambda x: _sp.adaptive_max_pool2d(x, output_size), (data,),
+                {}, name="adaptive_max_pool2d", out=out)
+
+
+def adaptive_avg_pool1d(data, output_size, out=None):
+    from ..ops import spatial as _sp
+
+    return call(lambda x: _sp.adaptive_avg_pool1d(x, output_size), (data,),
+                {}, name="adaptive_avg_pool1d", out=out)
+
+
+def adaptive_avg_pool3d(data, output_size, out=None):
+    from ..ops import spatial as _sp
+
+    return call(lambda x: _sp.adaptive_avg_pool3d(x, output_size), (data,),
+                {}, name="adaptive_avg_pool3d", out=out)
+
+
+# -- dynamic-shape recipes (SURVEY §7 hard part 3) ---------------------------
+# XLA needs static shapes; the reference's data-dependent ops (BooleanMask,
+# np.unique) map onto pad-to-static recipes: fix the output size up front,
+# results are compacted to the front and padded with fill, and the true
+# count comes back alongside. Eager callers can keep plain np.unique /
+# fancy indexing; these are the jit-safe forms.
+
+def boolean_mask(data, mask, axis=0, size=None, fill_value=0, out=None):
+    """Ref: src/operator/contrib/boolean_mask.cc. Rows of ``data`` where
+    ``mask`` is nonzero, compacted to the front. Under jit pass ``size``
+    (static output length, default len(mask)); returns (selected, count)
+    where rows past count hold fill_value."""
+    import jax.numpy as _jnp
+
+    def f(d, m):
+        mb = m.astype(bool).reshape(-1)
+        n = mb.shape[0]
+        k = n if size is None else int(size)
+        d2 = _jnp.moveaxis(d, axis, 0)
+        # stable compaction: position of each selected row in the output
+        pos = _jnp.cumsum(mb) - 1
+        src = _jnp.where(mb, pos, n)  # non-selected scatter to a dump row
+        gathered = _jnp.full((k + 1,) + d2.shape[1:], fill_value, d2.dtype)
+        gathered = gathered.at[_jnp.clip(src, 0, k)].set(
+            _jnp.where(mb.reshape((-1,) + (1,) * (d2.ndim - 1)), d2,
+                       gathered[-1]), mode="drop")
+        outv = _jnp.moveaxis(gathered[:k], 0, axis)
+        return outv, _jnp.sum(mb).astype(_jnp.int32)
+
+    return call(f, (data, mask), {}, name="boolean_mask", out=out)
+
+
+def unique_padded(data, size=None, fill_value=0, out=None):
+    """jit-safe np.unique: sorted unique values padded with fill_value to a
+    static ``size`` (default data.size); returns (values, count). Uses the
+    jnp.unique size= recipe (the reference's np.unique is host-side and
+    dynamically shaped — src/operator/numpy/np_unique_op.cc)."""
+    import jax.numpy as _jnp
+
+    def f(d):
+        k = d.size if size is None else int(size)
+        vals = _jnp.unique(d.reshape(-1), size=k, fill_value=fill_value)
+        # count = number of distinct values actually present
+        flat = _jnp.sort(d.reshape(-1))
+        distinct = _jnp.concatenate([_jnp.ones((1,), bool),
+                                     flat[1:] != flat[:-1]])
+        return vals, _jnp.sum(distinct).astype(_jnp.int32)
+
+    return call(f, (data,), {}, name="unique_padded", out=out)

@@ -1,0 +1,209 @@
+"""Spatial / contrib ops: bilinear sampling, spatial transformer,
+deformable convolution, count sketch, adaptive max pooling.
+
+TPU-native replacements for src/operator/contrib/ kernels
+(deformable_convolution.cc, count_sketch.cc, adaptive_avg_pooling.cc) and
+src/operator/{bilinear_sampler,spatial_transformer,grid_generator}.cc.
+Everything is gather/scatter + einsum — XLA lowers the contractions onto
+the MXU and fuses the bilinear weights; no hand scheduling.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .nn import _tuple
+
+
+def bilinear_gather(x, ys, xs):
+    """Sample x (N,C,H,W) at absolute float coords ys/xs (N, *S) with
+    bilinear weights; out-of-range taps contribute 0 (the reference's
+    border behavior in bilinear_sampler.cc). Returns (N, C, *S)."""
+    N, C, H, W = x.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = (ys - y0)[:, None]          # (N, 1, *S)
+    wx = (xs - x0)[:, None]
+
+    def tap(yi, xi):
+        valid = ((yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1))
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        g = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, yc, xc)
+        return g * valid[:, None].astype(x.dtype)
+
+    v00 = tap(y0, x0)
+    v01 = tap(y0, x0 + 1)
+    v10 = tap(y0 + 1, x0)
+    v11 = tap(y0 + 1, x0 + 1)
+    return ((1 - wy) * (1 - wx) * v00 + (1 - wy) * wx * v01 +
+            wy * (1 - wx) * v10 + wy * wx * v11)
+
+
+def bilinear_sampler(data, grid):
+    """Ref: src/operator/bilinear_sampler.cc. grid (N, 2, Ho, Wo) holds
+    normalized coords in [-1, 1], channel 0 = x, channel 1 = y (reference
+    convention); output (N, C, Ho, Wo)."""
+    N, C, H, W = data.shape
+    gx, gy = grid[:, 0], grid[:, 1]
+    xs = (gx + 1) * (W - 1) / 2
+    ys = (gy + 1) * (H - 1) / 2
+    return bilinear_gather(data, ys, xs)
+
+
+def grid_generator(data, transform_type: str = "affine",
+                   target_shape: Optional[Tuple[int, int]] = None):
+    """Ref: src/operator/grid_generator.cc. affine: data (N, 6) affine
+    matrices → grid (N, 2, H, W); warp: data (N, 2, H, W) flow field →
+    normalized grid."""
+    if transform_type == "affine":
+        if target_shape is None:
+            raise MXNetError("grid_generator(affine) needs target_shape")
+        h, w = target_shape
+        theta = data.reshape(-1, 2, 3)
+        ys, xs = jnp.meshgrid(jnp.linspace(-1, 1, h),
+                              jnp.linspace(-1, 1, w), indexing="ij")
+        base = jnp.stack([xs.ravel(), ys.ravel(),
+                          jnp.ones(h * w, data.dtype)])      # (3, HW)
+        out = jnp.einsum("nij,jk->nik", theta, base)          # (N, 2, HW)
+        return out.reshape(-1, 2, h, w)
+    if transform_type == "warp":
+        n, _, h, w = data.shape
+        ys, xs = jnp.meshgrid(jnp.arange(h, dtype=data.dtype),
+                              jnp.arange(w, dtype=data.dtype), indexing="ij")
+        gx = (data[:, 0] + xs) * 2 / max(w - 1, 1) - 1
+        gy = (data[:, 1] + ys) * 2 / max(h - 1, 1) - 1
+        return jnp.stack([gx, gy], axis=1)
+    raise MXNetError(f"unknown transform_type {transform_type}")
+
+
+def spatial_transformer(data, loc, target_shape,
+                        transform_type: str = "affine",
+                        sampler_type: str = "bilinear"):
+    """Ref: src/operator/spatial_transformer.cc — affine grid + bilinear
+    sampling of data at the transformed locations."""
+    if sampler_type != "bilinear":
+        raise MXNetError("only bilinear sampling is supported")
+    grid = grid_generator(loc, transform_type, tuple(target_shape))
+    return bilinear_sampler(data, grid)
+
+
+def deformable_convolution(x, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                           num_filter: Optional[int] = None,
+                           num_group: int = 1,
+                           num_deformable_group: int = 1):
+    """Deformable convolution v1 (ref: src/operator/contrib/
+    deformable_convolution.cc, deformable_im2col.h). offset has
+    2*num_deformable_group*kh*kw channels laid out (dg, tap, (y, x)) like
+    the reference's deformable_im2col indexing; sampling is bilinear with
+    zero padding outside the input."""
+    N, C, H, W = x.shape
+    kh, kw = _tuple(kernel, 2)
+    sh, sw = _tuple(stride, 2)
+    ph, pw = _tuple(pad, 2)
+    dh, dw = _tuple(dilate, 2)
+    O = weight.shape[0]
+    K = kh * kw
+    dg = num_deformable_group
+    if C % num_group or O % num_group or C % dg:
+        raise MXNetError("channels must divide num_group/num_deformable_group")
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    if offset.shape != (N, 2 * dg * K, Ho, Wo):
+        raise MXNetError(
+            f"offset shape {offset.shape} != {(N, 2 * dg * K, Ho, Wo)}")
+
+    ky, kx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw,
+                          indexing="ij")
+    base_y = (jnp.arange(Ho) * sh - ph)[:, None, None] + \
+        jnp.zeros((1, Wo, 1)) + ky.ravel()[None, None, :]     # (Ho, Wo, K)
+    base_x = (jnp.arange(Wo) * sw - pw)[None, :, None] + \
+        jnp.zeros((Ho, 1, 1)) + kx.ravel()[None, None, :]
+
+    off = offset.reshape(N, dg, K, 2, Ho, Wo)
+    ys = base_y[None, None] + off[:, :, :, 0].transpose(0, 1, 3, 4, 2)
+    xs = base_x[None, None] + off[:, :, :, 1].transpose(0, 1, 3, 4, 2)
+    # ys/xs: (N, dg, Ho, Wo, K)
+
+    Cg = C // dg
+    patches = []
+    for g in range(dg):
+        samp = bilinear_gather(x[:, g * Cg:(g + 1) * Cg],
+                               ys[:, g], xs[:, g])   # (N, Cg, Ho, Wo, K)
+        patches.append(samp)
+    patches = jnp.concatenate(patches, axis=1)        # (N, C, Ho, Wo, K)
+
+    cg = C // num_group
+    w = weight.reshape(num_group, O // num_group, cg, K)
+    p = patches.reshape(N, num_group, cg, Ho, Wo, K)
+    out = jnp.einsum("ngchwk,gock->ngohw", p, w)
+    out = out.reshape(N, O, Ho, Wo)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def count_sketch(data, h, s, out_dim: int):
+    """Ref: src/operator/contrib/count_sketch.cc — random feature
+    compression: out[n, h[j]] += s[j] * data[n, j]."""
+    n, in_dim = data.shape
+    hv = h.reshape(-1).astype(jnp.int32)
+    sv = s.reshape(-1).astype(data.dtype)
+    out = jnp.zeros((n, out_dim), data.dtype)
+    return out.at[:, hv].add(data * sv)
+
+
+def _adaptive_cells(size, out_size):
+    """Reference adaptive pooling cell boundaries: [floor(i*s/o),
+    ceil((i+1)*s/o))."""
+    import math
+
+    return [(int(math.floor(i * size / out_size)),
+             int(math.ceil((i + 1) * size / out_size)))
+            for i in range(out_size)]
+
+
+def adaptive_max_pool2d(x, output_size):
+    """Max twin of adaptive_avg_pool2d (ref contrib AdaptiveAvgPooling2D;
+    torch-parity max variant used by detection heads)."""
+    out_h, out_w = _tuple(output_size, 2)
+    n, c, h, w = x.shape
+    if h % out_h == 0 and w % out_w == 0:
+        r = x.reshape(n, c, out_h, h // out_h, out_w, w // out_w)
+        return r.max(axis=(3, 5))
+    rows = []
+    for y0, y1 in _adaptive_cells(h, out_h):
+        cols = [x[:, :, y0:y1, x0:x1].max(axis=(2, 3))
+                for x0, x1 in _adaptive_cells(w, out_w)]
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+def adaptive_avg_pool1d(x, output_size):
+    n, c, w = x.shape
+    out_w = output_size if isinstance(output_size, int) else output_size[0]
+    if w % out_w == 0:
+        return x.reshape(n, c, out_w, w // out_w).mean(axis=3)
+    return jnp.stack([x[:, :, a:b].mean(axis=2)
+                      for a, b in _adaptive_cells(w, out_w)], axis=-1)
+
+
+def adaptive_avg_pool3d(x, output_size):
+    od, oh, ow = _tuple(output_size, 3)
+    n, c, d, h, w = x.shape
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        r = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        return r.mean(axis=(3, 5, 7))
+    out = []
+    for d0, d1 in _adaptive_cells(d, od):
+        rows = []
+        for y0, y1 in _adaptive_cells(h, oh):
+            cols = [x[:, :, d0:d1, y0:y1, x0:x1].mean(axis=(2, 3, 4))
+                    for x0, x1 in _adaptive_cells(w, ow)]
+            rows.append(jnp.stack(cols, axis=-1))
+        out.append(jnp.stack(rows, axis=-2))
+    return jnp.stack(out, axis=-3)

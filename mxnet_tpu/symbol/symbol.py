@@ -118,6 +118,29 @@ def _unique(prefix: str) -> str:
     return f"{prefix}{_UID[0]}"
 
 
+_KW_FILTER_CACHE: Dict[int, Optional[frozenset]] = {}
+
+
+def _kw_filter(f) -> Optional[frozenset]:
+    """Allowed kwarg names for f, or None when f takes **kwargs.
+    Memoized — Executor.forward re-interprets graphs every step and
+    inspect.signature is too slow for the hot path."""
+    key = id(f)
+    if key not in _KW_FILTER_CACHE:
+        import inspect
+
+        try:
+            sig = inspect.signature(f)
+            if any(p.kind == p.VAR_KEYWORD
+                   for p in sig.parameters.values()):
+                _KW_FILTER_CACHE[key] = None
+            else:
+                _KW_FILTER_CACHE[key] = frozenset(sig.parameters)
+        except (ValueError, TypeError):
+            _KW_FILTER_CACHE[key] = None
+    return _KW_FILTER_CACHE[key]
+
+
 class Symbol:
     """A (multi-)output handle into an op graph (ref symbol.py Symbol)."""
 
@@ -255,15 +278,10 @@ class Symbol:
                                      for slot in pos_template]
                         res = f(*call_args, **kw)
                     else:
-                        import inspect
-                        try:
-                            sig = inspect.signature(f)
-                            if not any(p.kind == p.VAR_KEYWORD
-                                       for p in sig.parameters.values()):
-                                kw = {k: v for k, v in kw.items()
-                                      if k in sig.parameters}
-                        except (ValueError, TypeError):
-                            pass
+                        allowed = _kw_filter(f)
+                        if allowed is not None:
+                            kw = {k: v for k, v in kw.items()
+                                  if k in allowed}
                         res = f(*ins, **kw)
                     outs = list(res) if isinstance(res, (tuple, list)) \
                         else [res]
@@ -577,12 +595,13 @@ def trace(fn: Callable, example_inputs: Sequence, input_names=None,
     def node_for(nd: NDArray, rec) -> Tuple[_Node, int]:
         # rec is the _dc_entry SNAPSHOT for this use of nd (in-place ops
         # rebind the live stamp, so the recorded edge is authoritative).
-        # Explicit names take precedence over any recorded producer, and
-        # stamps from other trace sessions are ignored (stale arrays from
-        # an earlier scope are plain leaves here).
+        # A valid current-session record always wins — even for a named
+        # input, whose record means it was mutated in place during the
+        # trace (the pre-mutation uses reach the named leaf through the
+        # rec=None snapshots). Stamps from other sessions are leaves.
         if rec is not None and rec[0].token is not token:
             rec = None
-        if rec is None or id(nd) in id2name:
+        if rec is None:
             if id(nd) in nodes:
                 return (nodes[id(nd)], 0)
             if id(nd) in id2name:

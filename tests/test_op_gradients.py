@@ -1,0 +1,195 @@
+"""Finite-difference gradient sweep across the op corpus.
+
+The reference checks every differentiable op's backward against central
+differences (python/mxnet/test_utils.py check_numeric_gradient, used
+throughout tests/python/unittest/test_operator.py / test_numpy_op.py).
+Same harness here: each case is (name, fn over NDArrays, input builders);
+the tape gradient (jax.vjp under autograd.record) must match FD.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _pos(*shape, seed=0, lo=0.2, hi=1.8):
+    rs = onp.random.RandomState(seed)
+    return mx.np.array((rs.rand(*shape) * (hi - lo) + lo).astype("float32"))
+
+
+def _sym(*shape, seed=0, scale=1.0):
+    rs = onp.random.RandomState(seed)
+    return mx.np.array(((rs.rand(*shape) - 0.5) * 2 * scale)
+                       .astype("float32"))
+
+
+# (op name, fn, input builders) — shapes small so FD stays cheap
+UNARY = [
+    ("exp", lambda a: mx.np.exp(a), lambda: _sym(3, 4)),
+    ("log", lambda a: mx.np.log(a), lambda: _pos(3, 4)),
+    ("log2", lambda a: mx.np.log2(a), lambda: _pos(3, 4)),
+    ("log10", lambda a: mx.np.log10(a), lambda: _pos(3, 4)),
+    ("log1p", lambda a: mx.np.log1p(a), lambda: _pos(3, 4)),
+    ("expm1", lambda a: mx.np.expm1(a), lambda: _sym(3, 4)),
+    ("sqrt", lambda a: mx.np.sqrt(a), lambda: _pos(3, 4)),
+    ("cbrt", lambda a: mx.np.cbrt(a), lambda: _pos(3, 4)),
+    ("square", lambda a: mx.np.square(a), lambda: _sym(3, 4)),
+    ("reciprocal", lambda a: mx.np.reciprocal(a), lambda: _pos(3, 4)),
+    ("sin", lambda a: mx.np.sin(a), lambda: _sym(3, 4)),
+    ("cos", lambda a: mx.np.cos(a), lambda: _sym(3, 4)),
+    ("tan", lambda a: mx.np.tan(a), lambda: _sym(3, 4, scale=0.5)),
+    ("arcsin", lambda a: mx.np.arcsin(a), lambda: _sym(3, 4, scale=0.7)),
+    ("arccos", lambda a: mx.np.arccos(a), lambda: _sym(3, 4, scale=0.7)),
+    ("arctan", lambda a: mx.np.arctan(a), lambda: _sym(3, 4)),
+    ("sinh", lambda a: mx.np.sinh(a), lambda: _sym(3, 4)),
+    ("cosh", lambda a: mx.np.cosh(a), lambda: _sym(3, 4)),
+    ("tanh", lambda a: mx.np.tanh(a), lambda: _sym(3, 4)),
+    ("arcsinh", lambda a: mx.np.arcsinh(a), lambda: _sym(3, 4)),
+    ("arccosh", lambda a: mx.np.arccosh(a),
+     lambda: _pos(3, 4, lo=1.2, hi=2.5)),
+    ("arctanh", lambda a: mx.np.arctanh(a), lambda: _sym(3, 4, scale=0.7)),
+    ("abs", lambda a: mx.np.abs(a), lambda: _pos(3, 4)),
+    ("negative", lambda a: mx.np.negative(a), lambda: _sym(3, 4)),
+    ("sigmoid", lambda a: mx.npx.sigmoid(a), lambda: _sym(3, 4)),
+    ("relu", lambda a: mx.npx.relu(a), lambda: _pos(3, 4)),
+    ("softmax", lambda a: mx.npx.softmax(a), lambda: _sym(3, 4)),
+    ("log_softmax", lambda a: mx.npx.log_softmax(a), lambda: _sym(3, 4)),
+    ("erf", lambda a: mx.np.erf(a) if hasattr(mx.np, "erf")
+     else mx.npx.erf(a), lambda: _sym(3, 4)),
+    ("i0", lambda a: mx.np.i0(a), lambda: _sym(4,)),
+    ("sinc", lambda a: mx.np.sinc(a), lambda: _pos(4,)),
+    ("cumsum", lambda a: mx.np.cumsum(a, axis=1), lambda: _sym(3, 4)),
+    ("cumprod", lambda a: mx.np.cumprod(a, axis=1), lambda: _pos(3, 4)),
+    ("flip", lambda a: mx.np.flip(a, axis=1), lambda: _sym(3, 4)),
+    ("roll", lambda a: mx.np.roll(a, 2, axis=1), lambda: _sym(3, 4)),
+    ("transpose", lambda a: mx.np.transpose(a), lambda: _sym(3, 4)),
+    ("reshape", lambda a: mx.np.reshape(a, (4, 3)), lambda: _sym(3, 4)),
+    ("tile", lambda a: mx.np.tile(a, (2, 1)), lambda: _sym(2, 3)),
+    ("repeat", lambda a: mx.np.repeat(a, 2, axis=0), lambda: _sym(2, 3)),
+    ("pad", lambda a: mx.np.pad(a, ((1, 1), (0, 2))), lambda: _sym(2, 3)),
+    ("triu", lambda a: mx.np.triu(a), lambda: _sym(3, 3)),
+    ("tril", lambda a: mx.np.tril(a), lambda: _sym(3, 3)),
+    ("diagonal", lambda a: mx.np.diagonal(a), lambda: _sym(3, 3)),
+    ("trace_op", lambda a: mx.np.trace(a), lambda: _sym(3, 3)),
+    ("sum", lambda a: mx.np.sum(a, axis=0), lambda: _sym(3, 4)),
+    ("mean", lambda a: mx.np.mean(a, axis=1), lambda: _sym(3, 4)),
+    ("prod", lambda a: mx.np.prod(a, axis=1), lambda: _pos(2, 3)),
+    ("std", lambda a: mx.np.std(a, axis=1), lambda: _pos(3, 4)),
+    ("var", lambda a: mx.np.var(a, axis=1), lambda: _pos(3, 4)),
+    ("max", lambda a: mx.np.max(a, axis=1), lambda: _sym(3, 4)),
+    ("min", lambda a: mx.np.min(a, axis=1), lambda: _sym(3, 4)),
+    ("logsumexp", lambda a: mx.np.logaddexp(a, a) if not
+     hasattr(mx.np, "logsumexp") else mx.np.logsumexp(a), lambda: _sym(3,)),
+    ("norm", lambda a: mx.np.linalg.norm(a), lambda: _pos(3, 4)),
+    ("sort", lambda a: mx.np.sort(a, axis=1), lambda: _sym(3, 4)),
+    ("clip", lambda a: mx.np.clip(a, -0.5, 0.5), lambda: _sym(3, 4)),
+    ("where", lambda a: mx.np.where(a > 0, a * 2.0, a * 3.0),
+     lambda: _sym(3, 4)),
+    ("take", lambda a: mx.np.take(a, mx.np.array([0, 2]), axis=1),
+     lambda: _sym(3, 4)),
+    ("expand_sq", lambda a: mx.np.squeeze(mx.np.expand_dims(a, 0), 0),
+     lambda: _sym(3, 4)),
+    ("interp_x", lambda a: mx.np.interp(
+        a, mx.np.array([0.0, 1.0, 2.0]), mx.np.array([0.0, 3.0, 4.0])),
+     lambda: _pos(4, lo=0.3, hi=1.7)),
+    ("trapz", lambda a: mx.np.trapz(a), lambda: _sym(5,)),
+    ("ediff1d", lambda a: mx.np.ediff1d(a), lambda: _sym(5,)),
+    ("polyval_x", lambda a: mx.np.polyval(mx.np.array([1.0, 2.0, 3.0]), a),
+     lambda: _sym(4,)),
+    ("kron", lambda a: mx.np.kron(a, mx.np.array([[1.0, 2.0]])),
+     lambda: _sym(2, 2)),
+    ("heaviside_smoothed", lambda a: mx.np.heaviside(
+        a, mx.np.array(0.5)) * a, lambda: _pos(4,)),
+]
+
+BINARY = [
+    ("add", lambda a, b: a + b),
+    ("subtract", lambda a, b: a - b),
+    ("multiply", lambda a, b: a * b),
+    ("divide", lambda a, b: a / b),
+    ("power", lambda a, b: mx.np.power(a, b)),
+    ("maximum", lambda a, b: mx.np.maximum(a, b)),
+    ("minimum", lambda a, b: mx.np.minimum(a, b)),
+    ("hypot", lambda a, b: mx.np.hypot(a, b)),
+    ("arctan2", lambda a, b: mx.np.arctan2(a, b)),
+    ("logaddexp", lambda a, b: mx.np.logaddexp(a, b)),
+    ("fmod_like", lambda a, b: a - mx.np.floor(a / b) * b),
+    ("dot", lambda a, b: mx.np.dot(a, b)),
+    ("matmul", lambda a, b: mx.np.matmul(a, b)),
+    ("inner", lambda a, b: mx.np.inner(a, b)),
+    ("outer", lambda a, b: mx.np.outer(
+        mx.np.reshape(a, (-1,)), mx.np.reshape(b, (-1,)))),
+    ("tensordot", lambda a, b: mx.np.tensordot(a, b, axes=1)),
+    ("cross3", lambda a, b: mx.np.cross(
+        mx.np.reshape(a, (3, 3)), mx.np.reshape(b, (3, 3)))),
+]
+
+
+@pytest.mark.parametrize("name,fn,builder", UNARY,
+                         ids=[c[0] for c in UNARY])
+def test_unary_gradient(name, fn, builder):
+    check_numeric_gradient(fn, [builder()], rtol=3e-2, atol=3e-2)
+
+
+@pytest.mark.parametrize("name,fn", BINARY, ids=[c[0] for c in BINARY])
+def test_binary_gradient(name, fn):
+    a = _pos(3, 3, seed=5, lo=0.4, hi=1.6)
+    b = _pos(3, 3, seed=7, lo=0.4, hi=1.6)
+    check_numeric_gradient(fn, [a, b], rtol=3e-2, atol=3e-2)
+
+
+NN_CASES = [
+    ("fully_connected", lambda x, w, b: mx.npx.fully_connected(
+        x, w, b, num_hidden=4),
+     [(2, 6), (4, 6), (4,)]),
+    ("convolution", lambda x, w, b: mx.npx.convolution(
+        x, w, b, kernel=(3, 3), pad=(1, 1), num_filter=3),
+     [(1, 2, 5, 5), (3, 2, 3, 3), (3,)]),
+    ("deconvolution", lambda x, w, b: mx.npx.deconvolution(
+        x, w, b, kernel=(2, 2), stride=(2, 2), num_filter=3),
+     [(1, 2, 3, 3), (2, 3, 2, 2), (3,)]),
+    ("layer_norm", lambda x, g, b: mx.npx.layer_norm(x, g, b),
+     [(3, 6), (6,), (6,)]),
+    ("embedding_w", None, None),  # placeholder replaced below
+]
+
+
+@pytest.mark.parametrize(
+    "name,fn,shapes",
+    [c for c in NN_CASES if c[1] is not None],
+    ids=[c[0] for c in NN_CASES if c[1] is not None])
+def test_nn_gradient(name, fn, shapes):
+    rs = onp.random.RandomState(11)
+    args = [mx.np.array(((rs.rand(*s) - 0.5)).astype("float32"))
+            for s in shapes]
+    check_numeric_gradient(fn, args, rtol=3e-2, atol=3e-2)
+
+
+def test_embedding_weight_gradient():
+    idx = mx.np.array(onp.array([[0, 2], [1, 1]], "int32"))
+    w = _sym(4, 3, seed=13)
+    check_numeric_gradient(
+        lambda weight: mx.npx.embedding(idx, weight), [w],
+        rtol=3e-2, atol=3e-2)
+
+
+def test_pooling_gradients():
+    x = _pos(1, 2, 6, 6, seed=17)
+    for pt in ("max", "avg"):
+        check_numeric_gradient(
+            lambda a, p=pt: mx.npx.pooling(a, kernel=(2, 2), stride=(2, 2),
+                                           pool_type=p),
+            [x], rtol=3e-2, atol=3e-2)
+
+
+def test_batch_norm_inference_gradient():
+    x = _sym(2, 3, 4, 4, seed=19)
+    g = _pos(3, seed=20)
+    b = _sym(3, seed=21)
+    rm = mx.np.zeros((3,))
+    rv = mx.np.ones((3,))
+    check_numeric_gradient(
+        lambda xx, gg, bb: mx.npx.batch_norm(xx, gg, bb, rm, rv,
+                                             use_global_stats=True),
+        [x, g, b], rtol=3e-2, atol=3e-2)

@@ -1,0 +1,271 @@
+"""Spatial/contrib op tests: deformable conv, bilinear sampler, spatial
+transformer, count_sketch, adaptive pools.
+
+Reference coverage model (SURVEY §4): numpy-forward reference +
+finite-difference gradient checks (test_utils.check_numeric_gradient).
+Targets: src/operator/contrib/deformable_convolution.cc, count_sketch.cc,
+bilinear_sampler.cc, spatial_transformer.cc, grid_generator.cc.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _rand(*shape, seed=0, scale=1.0):
+    return (onp.random.RandomState(seed).rand(*shape) * scale).astype(
+        "float32")
+
+
+class TestDeformableConvolution:
+    def test_zero_offset_equals_regular_conv(self):
+        """With all-zero offsets the op must reduce exactly to convolution
+        (the reference's deformable_im2col degenerates to im2col)."""
+        x = mx.np.array(_rand(2, 4, 9, 9, seed=1))
+        w = mx.np.array(_rand(6, 4, 3, 3, seed=2) - 0.5)
+        off = mx.np.zeros((2, 2 * 9, 7, 7))
+        out = mx.npx.deformable_convolution(x, off, w, kernel=(3, 3),
+                                            num_filter=6)
+        ref = mx.npx.convolution(x, w, kernel=(3, 3), num_filter=6,
+                                 no_bias=True)
+        onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                    rtol=1e-4, atol=1e-4)
+
+    def test_integer_offset_shifts_sampling(self):
+        """An integer offset of (0, +1) on every tap samples one column to
+        the right — equivalent to shifting the input left."""
+        x_np = _rand(1, 1, 6, 8, seed=3)
+        x = mx.np.array(x_np)
+        w = mx.np.ones((1, 1, 1, 1))
+        off = onp.zeros((1, 2, 6, 8), "float32")
+        off[:, 1] = 1.0  # x-offset
+        out = mx.npx.deformable_convolution(x, mx.np.array(off), w,
+                                            kernel=(1, 1), num_filter=1)
+        expect = onp.zeros_like(x_np)
+        expect[..., :-1] = x_np[..., 1:]  # border tap falls outside → 0
+        onp.testing.assert_allclose(out.asnumpy(), expect, atol=1e-5)
+
+    def test_stride_pad_dilate_zero_offset(self):
+        x = mx.np.array(_rand(1, 2, 11, 11, seed=4))
+        w = mx.np.array(_rand(3, 2, 3, 3, seed=5) - 0.5)
+        off = mx.np.zeros((1, 18, 5, 5))
+        out = mx.npx.deformable_convolution(
+            x, off, w, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+            dilate=(2, 2), num_filter=3)
+        # 11 + 2 - 2*2 - 1 = 8 → //2 + 1 = 5
+        ref = mx.npx.convolution(x, w, kernel=(3, 3), stride=(2, 2),
+                                 pad=(1, 1), dilate=(2, 2), num_filter=3,
+                                 no_bias=True)
+        onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                    rtol=1e-4, atol=1e-4)
+
+    def test_deformable_groups(self):
+        x = mx.np.array(_rand(1, 4, 6, 6, seed=6))
+        w = mx.np.array(_rand(2, 4, 3, 3, seed=7) - 0.5)
+        off = mx.np.array(_rand(1, 2 * 2 * 9, 4, 4, seed=8) - 0.5)
+        out = mx.npx.deformable_convolution(x, off, w, kernel=(3, 3),
+                                            num_filter=2,
+                                            num_deformable_group=2)
+        assert out.shape == (1, 2, 4, 4)
+        assert onp.isfinite(out.asnumpy()).all()
+
+    def test_gradients(self):
+        x = mx.np.array(_rand(1, 2, 5, 5, seed=9))
+        w = mx.np.array(_rand(2, 2, 3, 3, seed=10) - 0.5)
+        off = mx.np.array(_rand(1, 18, 3, 3, seed=11) * 0.3)
+        check_numeric_gradient(
+            lambda a, o, b: mx.npx.deformable_convolution(
+                a, o, b, kernel=(3, 3), num_filter=2),
+            [x, off, w], rtol=3e-2, atol=3e-2)
+
+
+class TestBilinearSampler:
+    def test_identity_grid(self):
+        x_np = _rand(2, 3, 5, 7, seed=0)
+        ys, xs = onp.meshgrid(onp.linspace(-1, 1, 5),
+                              onp.linspace(-1, 1, 7), indexing="ij")
+        grid = onp.stack([xs, ys])[None].repeat(2, 0).astype("float32")
+        out = mx.npx.bilinear_sampler(mx.np.array(x_np), mx.np.array(grid))
+        onp.testing.assert_allclose(out.asnumpy(), x_np, atol=1e-5)
+
+    def test_out_of_range_is_zero(self):
+        x = mx.np.ones((1, 1, 4, 4))
+        grid = onp.full((1, 2, 2, 2), -3.0, "float32")
+        out = mx.npx.bilinear_sampler(x, mx.np.array(grid))
+        onp.testing.assert_allclose(out.asnumpy(), 0.0)
+
+    def test_gradient(self):
+        x = mx.np.array(_rand(1, 2, 4, 4, seed=1))
+        grid = mx.np.array((_rand(1, 2, 3, 3, seed=2) - 0.5))
+        check_numeric_gradient(
+            lambda a, g: mx.npx.bilinear_sampler(a, g), [x, grid],
+            rtol=3e-2, atol=3e-2)
+
+
+class TestSpatialTransformer:
+    def test_identity_affine(self):
+        x_np = _rand(2, 2, 6, 6, seed=0)
+        theta = onp.tile(onp.array([1, 0, 0, 0, 1, 0], "float32"), (2, 1))
+        out = mx.npx.spatial_transformer(mx.np.array(x_np),
+                                         mx.np.array(theta), (6, 6))
+        onp.testing.assert_allclose(out.asnumpy(), x_np, atol=1e-4)
+
+    def test_translation(self):
+        x_np = onp.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        # shift sampling one pixel right in x: offset 2/(W-1) normalized
+        theta = onp.array([[1, 0, 2.0 / 3, 0, 1, 0]], "float32")
+        out = mx.npx.spatial_transformer(mx.np.array(x_np),
+                                         mx.np.array(theta), (4, 4))
+        expect = onp.zeros_like(x_np)
+        expect[..., :-1] = x_np[..., 1:]
+        onp.testing.assert_allclose(out.asnumpy(), expect, atol=1e-4)
+
+    def test_grid_generator_warp(self):
+        flow = mx.np.zeros((1, 2, 3, 3))
+        grid = mx.npx.grid_generator(flow, "warp")
+        assert grid.shape == (1, 2, 3, 3)
+        g = grid.asnumpy()
+        onp.testing.assert_allclose(g[0, 0, 0], [-1, 0, 1], atol=1e-6)
+
+
+class TestCountSketch:
+    def test_forward_matches_numpy(self):
+        rs = onp.random.RandomState(0)
+        d = rs.rand(3, 10).astype("float32")
+        h = rs.randint(0, 6, size=10)
+        s = rs.choice([-1.0, 1.0], size=10).astype("float32")
+        out = mx.npx.count_sketch(mx.np.array(d), mx.np.array(h),
+                                  mx.np.array(s), 6)
+        ref = onp.zeros((3, 6), "float32")
+        for j in range(10):
+            ref[:, h[j]] += s[j] * d[:, j]
+        onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5,
+                                    atol=1e-5)
+
+    def test_gradient_wrt_data(self):
+        rs = onp.random.RandomState(1)
+        d = mx.np.array(rs.rand(2, 6).astype("float32"))
+        h = mx.np.array(rs.randint(0, 4, size=6))
+        s = mx.np.array(rs.choice([-1.0, 1.0], size=6).astype("float32"))
+        check_numeric_gradient(
+            lambda a: mx.npx.count_sketch(a, h, s, 4), [d],
+            rtol=2e-2, atol=2e-2)
+
+
+class TestAdaptivePools:
+    def test_max2d_divisible(self):
+        x = _rand(2, 3, 8, 8, seed=0)
+        out = mx.npx.adaptive_max_pool2d(mx.np.array(x), (4, 4))
+        ref = x.reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))
+        onp.testing.assert_allclose(out.asnumpy(), ref, atol=1e-6)
+
+    def test_max2d_non_divisible(self):
+        x = _rand(1, 2, 5, 7, seed=1)
+        out = mx.npx.adaptive_max_pool2d(mx.np.array(x), (2, 3))
+        assert out.shape == (1, 2, 2, 3)
+        # cell (0,0) covers rows [0,3), cols [0,3)
+        onp.testing.assert_allclose(out.asnumpy()[0, :, 0, 0],
+                                    x[0, :, 0:3, 0:3].max(axis=(1, 2)),
+                                    atol=1e-6)
+
+    def test_avg1d_and_3d(self):
+        x1 = _rand(2, 3, 12, seed=2)
+        o1 = mx.npx.adaptive_avg_pool1d(mx.np.array(x1), 4)
+        onp.testing.assert_allclose(
+            o1.asnumpy(), x1.reshape(2, 3, 4, 3).mean(axis=3), atol=1e-6)
+        x3 = _rand(1, 2, 4, 6, 8, seed=3)
+        o3 = mx.npx.adaptive_avg_pool3d(mx.np.array(x3), (2, 3, 4))
+        ref = x3.reshape(1, 2, 2, 2, 3, 2, 4, 2).mean(axis=(3, 5, 7))
+        onp.testing.assert_allclose(o3.asnumpy(), ref, atol=1e-6)
+
+    def test_avg2d_gradient(self):
+        x = mx.np.array(_rand(1, 2, 6, 6, seed=4))
+        check_numeric_gradient(
+            lambda a: mx.npx.adaptive_max_pool2d(a, (3, 3)), [x],
+            rtol=2e-2, atol=2e-2)
+
+
+def test_new_numpy_tail_ops():
+    """The numpy long-tail additions dispatch and match onp."""
+    a = onp.array([3.0, 0.0, 1.0, 2.0], "float32")
+    onp.testing.assert_allclose(
+        mx.np.polyval(mx.np.array([2.0, 1.0]), mx.np.array(a)).asnumpy(),
+        onp.polyval([2.0, 1.0], a))
+    onp.testing.assert_allclose(
+        mx.np.trapz(mx.np.array(a)).asnumpy(), onp.trapz(a))
+    onp.testing.assert_allclose(
+        mx.np.in1d(mx.np.array(a), mx.np.array([1.0, 3.0])).asnumpy(),
+        onp.in1d(a, [1.0, 3.0]))
+    onp.testing.assert_allclose(
+        mx.np.msort(mx.np.array(a)).asnumpy(), onp.sort(a, axis=0))
+    onp.testing.assert_allclose(
+        mx.np.interp(mx.np.array([0.5, 1.5]), mx.np.array([0.0, 1.0, 2.0]),
+                     mx.np.array([0.0, 10.0, 20.0])).asnumpy(),
+        [5.0, 15.0])
+    onp.testing.assert_allclose(
+        mx.np.ediff1d(mx.np.array(a)).asnumpy(), onp.ediff1d(a))
+    assert mx.np.hamming(5).asnumpy().shape == (5,)
+    onp.testing.assert_allclose(
+        mx.np.trim_zeros(mx.np.array([0.0, 1.0, 2.0, 0.0])).asnumpy(),
+        [1.0, 2.0])
+    onp.testing.assert_allclose(
+        mx.np.sinc(mx.np.array([0.0, 0.5])).asnumpy(),
+        onp.sinc([0.0, 0.5]), rtol=1e-6)
+    onp.testing.assert_allclose(
+        mx.np.heaviside(mx.np.array([-1.0, 0.0, 2.0]),
+                        mx.np.array(0.5)).asnumpy(),
+        onp.heaviside([-1.0, 0.0, 2.0], 0.5))
+
+
+class TestDynamicShapeRecipes:
+    """jit-safe pad-to-static forms of data-dependent ops (SURVEY §7 hard
+    part 3; ref src/operator/contrib/boolean_mask.cc, np_unique_op.cc)."""
+
+    def test_boolean_mask_basic(self):
+        d = mx.np.array(onp.arange(12, dtype="float32").reshape(4, 3))
+        m = mx.np.array(onp.array([1, 0, 1, 1], "float32"))
+        sel, cnt = mx.npx.boolean_mask(d, m)
+        assert int(cnt.item()) == 3
+        onp.testing.assert_allclose(sel.asnumpy()[:3],
+                                    d.asnumpy()[[0, 2, 3]])
+        onp.testing.assert_allclose(sel.asnumpy()[3], 0.0)
+
+    def test_boolean_mask_static_size_under_jit(self):
+        import jax
+
+        def f(draw, mraw):
+            d, m = mx.np.array(draw), mx.np.array(mraw)
+            sel, cnt = mx.npx.boolean_mask(d, m, size=2)
+            return sel._data, cnt._data
+
+        jf = jax.jit(f)
+        d = onp.arange(8, dtype="float32").reshape(4, 2)
+        sel, cnt = jf(d, onp.array([0, 1, 0, 1], "float32"))
+        assert sel.shape == (2, 2)
+        assert int(cnt) == 2
+        onp.testing.assert_allclose(onp.asarray(sel), d[[1, 3]])
+
+    def test_boolean_mask_axis1(self):
+        d = mx.np.array(onp.arange(6, dtype="float32").reshape(2, 3))
+        m = mx.np.array(onp.array([0, 1, 1], "float32"))
+        sel, cnt = mx.npx.boolean_mask(d, m, axis=1, size=2)
+        assert int(cnt.item()) == 2
+        onp.testing.assert_allclose(sel.asnumpy(), d.asnumpy()[:, 1:])
+
+    def test_unique_padded(self):
+        d = mx.np.array(onp.array([3.0, 1.0, 3.0, 2.0, 1.0], "float32"))
+        vals, cnt = mx.npx.unique_padded(d, size=5, fill_value=-1)
+        assert int(cnt.item()) == 3
+        onp.testing.assert_allclose(vals.asnumpy()[:3], [1.0, 2.0, 3.0])
+
+    def test_unique_padded_under_jit(self):
+        import jax
+
+        def f(raw):
+            vals, cnt = mx.npx.unique_padded(mx.np.array(raw), size=4)
+            return vals._data, cnt._data
+
+        vals, cnt = jax.jit(f)(onp.array([5, 5, 7, 7], "float32"))
+        assert vals.shape == (4,)
+        assert int(cnt) == 2

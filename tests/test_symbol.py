@@ -424,3 +424,32 @@ def test_print_summary_tied_params_counted_once(capsys):
         b, shape={"x": (1, 4), "tied_weight": (4, 4)})
     out = capsys.readouterr().out
     assert "Total params: 16" in out  # not 32
+
+
+def test_trace_setitem_recorded():
+    """a[i] = v inside a trace must survive in the graph
+    (code-review regression)."""
+    x = mx.np.ones((3,))
+
+    def f(a):
+        h = a * 3.0
+        h[0] = 99.0
+        return h
+
+    sym = mx.sym.trace(f, [x], input_names=["data"])
+    out = sym.eval(data=mx.np.array([2.0, 2.0, 2.0]))[0]
+    onp.testing.assert_allclose(out.asnumpy(), [99.0, 6.0, 6.0])
+
+
+def test_trace_input_mutated_inplace():
+    """A trace input mutated in place and returned must trace to the op,
+    not to identity (code-review regression)."""
+    a = mx.np.array([2.0, 2.0])
+
+    def f(x):
+        x += 5.0
+        return x
+
+    sym = mx.sym.trace(f, [a], input_names=["data"])
+    out = sym.eval(data=mx.np.array([2.0, 2.0]))[0]
+    onp.testing.assert_allclose(out.asnumpy(), [7.0, 7.0])
