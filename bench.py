@@ -20,21 +20,25 @@ import traceback
 
 def _timed_raw_steps(trainer, xd, yd, n_steps):
     """Drive trainer._step_fn directly; returns seconds for n_steps."""
+    import jax.numpy as jnp
+
     step = trainer._step_fn
     pvals, avals, key = trainer.pvals, trainer.avals, trainer._key
     opt_state, t = trainer.opt_state, trainer._t
+    scale = trainer._scale_state
+    lr = jnp.float32(trainer.learning_rate)
 
     xd = trainer._put(xd)
     yd = trainer._put(yd)
     t += 1
-    pvals, mutated, opt_state, loss = step(pvals, avals, key, opt_state,
-                                           t, xd, yd)
+    pvals, mutated, opt_state, scale, loss = step(
+        pvals, avals, key, opt_state, t, lr, scale, xd, yd)
     float(loss)  # absorb residual compile before the timed region
     t0 = time.perf_counter()
     for _ in range(n_steps):
         t += 1
-        pvals, mutated, opt_state, loss = step(pvals, avals, key, opt_state,
-                                               t, xd, yd)
+        pvals, mutated, opt_state, scale, loss = step(
+            pvals, avals, key, opt_state, t, lr, scale, xd, yd)
     float(loss)  # scalar D2H read drains the pipeline (a relay can report
     # block_until_ready early; a host transfer cannot lie)
     return time.perf_counter() - t0
@@ -72,12 +76,23 @@ def bench_resnet50(on_tpu):
     net(mx.np.zeros(shape))
 
     mesh = make_mesh({"dp": -1}, devices=jax.devices()[:1])
-    # bf16 compute on the MXU (master params fp32) — the TPU-native analog
-    # of the reference's fp16 rows; the fp32 baseline row is still the
-    # comparison denominator, conservatively.
+    # low-precision compute on the MXU (master params fp32) — bf16 by
+    # default (the TPU-native analog of the reference's fp16 rows), fp16
+    # with in-step dynamic loss scaling via MXNET_BENCH_DTYPE=fp16; the
+    # fp32 baseline row stays the comparison denominator, conservatively.
+    import os
+
+    dt = os.environ.get("MXNET_BENCH_DTYPE", "bf16").lower()
+    dtypes = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+              "fp16": jnp.float16, "float16": jnp.float16,
+              "fp32": None, "float32": None}
+    if dt not in dtypes:
+        raise SystemExit(f"MXNET_BENCH_DTYPE={dt!r} invalid; "
+                         f"choose from {sorted(dtypes)}")
+    compute = dtypes[dt]
     trainer = ShardedTrainer(net, _ce, mesh=mesh, optimizer="sgd",
                              learning_rate=0.05, momentum=0.9,
-                             compute_dtype=jnp.bfloat16 if on_tpu else None)
+                             compute_dtype=compute if on_tpu else None)
     rs = onp.random.RandomState(0)
     xshape = ((batch, image, image, 3) if layout == "NHWC"
               else (batch, 3, image, image))

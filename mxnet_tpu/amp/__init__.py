@@ -54,9 +54,21 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
 
 def init_trainer(trainer):
     """Attach dynamic loss scaling to a Trainer (fp16 path; ref amp.py
-    init_trainer)."""
+    init_trainer). ShardedTrainer runs its scaling fused inside the jitted
+    step (all_finite + per-leaf select, parallel/trainer.py) — construct it
+    with compute_dtype=float16 and this call just validates that."""
     if not _state["initialized"]:
         raise MXNetError("amp.init() must be called before amp.init_trainer()")
+    from ..parallel.trainer import ShardedTrainer
+
+    if isinstance(trainer, ShardedTrainer):
+        if _state["target_dtype"] == jnp.float16 and \
+                not trainer._dynamic_scaling:
+            raise MXNetError(
+                "amp fp16 with ShardedTrainer: pass "
+                "compute_dtype=jnp.float16 at construction — scaling runs "
+                "inside the jitted step")
+        return
     if _state["loss_scaler"] is not None:
         trainer._amp_loss_scaler = _state["loss_scaler"]
 

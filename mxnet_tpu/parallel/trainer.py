@@ -100,62 +100,167 @@ def _functional_apply(net, names: List[str], training: bool):
     return fn, arrs, holder
 
 
-# -- functional optimizer kernels (used inside pjit) -------------------------
+# -- traced optimizer adapter (reuses the full 20-optimizer registry) --------
+#
+# Every imperative optimizer follows one shape: host bookkeeping
+# (_update_count / _get_lr) + a pure jitted kernel over raw arrays behind
+# NDArray handles (optimizer/__init__.py). Inside the pjit step we replay
+# update() with lr and the update count t supplied as TRACED values (the
+# kernels take them as regular arguments, so nothing bakes in), and thread
+# the optimizer state through the step as flat raw-array lists.
 
-def _opt_init(kind: str, pvals):
-    if kind == "sgd":
-        return [jnp.zeros_like(p) for p in pvals]
-    if kind in ("adam", "adamw", "lamb"):
-        return ([jnp.zeros_like(p) for p in pvals],
-                [jnp.zeros_like(p) for p in pvals])
-    raise MXNetError(f"unknown sharded optimizer '{kind}'")
+
+class _TracedCounts(dict):
+    """Stands in for Optimizer._index_update_count during tracing: every
+    index reads the traced step counter."""
+
+    def __init__(self, t):
+        super().__init__()
+        self._t = t
+
+    def __getitem__(self, key):
+        return self._t
+
+    def setdefault(self, key, default=None):
+        return self._t
 
 
-def _opt_update(kind: str, pvals, grads, state, lr, wd, momentum, t,
-                beta1=0.9, beta2=0.999, eps=1e-8):
-    if kind == "sgd":
-        moms = state
-        new_p, new_m = [], []
-        for p, g, m in zip(pvals, grads, moms):
-            g = g + wd * p
-            m2 = momentum * m - lr * g
-            new_p.append((p + m2).astype(p.dtype))
-            new_m.append(m2)
-        return new_p, new_m
-    if kind in ("adam", "adamw"):
-        ms, vs = state
-        new_p, new_m, new_v = [], [], []
-        for p, g, m, v in zip(pvals, grads, ms, vs):
-            if kind == "adam":
-                g = g + wd * p
-            m2 = beta1 * m + (1 - beta1) * g
-            v2 = beta2 * v + (1 - beta2) * jnp.square(g)
-            mhat = m2 / (1 - beta1 ** t)
-            vhat = v2 / (1 - beta2 ** t)
-            upd = lr * mhat / (jnp.sqrt(vhat) + eps)
-            if kind == "adamw":
-                upd = upd + lr * wd * p
-            new_p.append((p - upd).astype(p.dtype))
-            new_m.append(m2)
-            new_v.append(v2)
-        return new_p, (new_m, new_v)
-    raise MXNetError(f"unknown sharded optimizer '{kind}'")
+# optimizers whose update() keeps host-side per-step state or data-dependent
+# Python control flow — unreplayable inside a trace (nadam's m_schedule
+# running product, lbsgd's warmup branch on t, sgld's host math.sqrt(lr) +
+# per-call RNG draw). They stay available on the eager gluon.Trainer path.
+_UNTRACEABLE_OPTIMIZERS = {"nadam", "lbsgd", "sgld"}
+
+
+def _make_opt(optimizer, learning_rate, weight_decay, momentum, **extra):
+    from .. import optimizer as opt_mod
+
+    if isinstance(optimizer, opt_mod.Optimizer):
+        opt = optimizer
+    else:
+        kwargs = dict(learning_rate=learning_rate, wd=weight_decay, **extra)
+        if optimizer in ("sgd", "nag", "signum"):
+            kwargs["momentum"] = momentum
+        opt = opt_mod.create(optimizer, **kwargs)
+    name = type(opt).__name__.lower()
+    if name in _UNTRACEABLE_OPTIMIZERS:
+        raise MXNetError(
+            f"optimizer '{name}' keeps host-side per-step state or "
+            "data-dependent control flow and cannot replay inside the "
+            "jitted SPMD step; use it with gluon.Trainer (eager)")
+    return opt
+
+
+class _OptAdapter:
+    """Functional bridge: init_state(pvals) → flat state leaves;
+    update(pvals, grads, leaves, lr, t) → (new_pvals, new_leaves)."""
+
+    def __init__(self, optimizer):
+        self.opt = optimizer
+        self._tree = None  # per-param state structure template
+
+    @staticmethod
+    def _flatten(state):
+        if state is None:
+            return []
+        if isinstance(state, NDArray):
+            return [state._data]
+        if isinstance(state, (tuple, list)):
+            out = []
+            for s in state:
+                out.extend(_OptAdapter._flatten(s))
+            return out
+        raise MXNetError(f"unsupported optimizer state leaf {type(state)}")
+
+    @staticmethod
+    def _rebuild(template, leaves_iter):
+        if template is None:
+            return None
+        if isinstance(template, NDArray):
+            return NDArray(next(leaves_iter))
+        return tuple(_OptAdapter._rebuild(t, leaves_iter) for t in template)
+
+    def init_state(self, pvals) -> List[Any]:
+        self._tree = [self.opt.create_state(i, NDArray(p))
+                      for i, p in enumerate(pvals)]
+        leaves: List[Any] = []
+        self.leaf_param_ix: List[int] = []  # leaf → owning param (sharding)
+        # optimizers may alias one buffer across slots (Adam's (m, v) share
+        # a zeros array; DCASGD's prev-weight IS the param array) — both
+        # step args are donated, so every leaf needs a distinct buffer
+        seen = {id(p) for p in pvals}
+        for i, s in enumerate(self._tree):
+            ls = self._flatten(s)
+            for leaf in ls:
+                if id(leaf) in seen:
+                    leaf = jnp.array(leaf, copy=True)
+                seen.add(id(leaf))
+                leaves.append(leaf)
+            self.leaf_param_ix.extend([i] * len(ls))
+        return leaves
+
+    def update(self, pvals, grads, leaves, lr, t):
+        import copy
+
+        opt = copy.copy(self.opt)
+        opt.rescale_grad = 1.0  # scaling handled by the step
+        opt.lr_scheduler = None
+        opt.lr = lr                       # traced scalar
+        opt._index_update_count = _TracedCounts(t)
+        opt.num_update = 0                # only read host-side; unused here
+        opt._update_count = lambda *a, **k: None
+        it = iter(leaves)
+        new_p, new_leaves = [], []
+        for i, (p, g) in enumerate(zip(pvals, grads)):
+            w = NDArray(p)
+            st = self._rebuild(self._tree[i], it)
+            opt.update(i, w, NDArray(g.astype(p.dtype)), st)
+            new_p.append(w._data.astype(p.dtype))
+            new_leaves.extend(self._flatten(st))
+        return new_p, new_leaves
+
+
+def all_finite(grads):
+    """Fused finiteness scan over a gradient list — the reference's
+    all_finite op (src/operator/all_finite.cc) that drives dynamic loss
+    scaling."""
+    flags = [jnp.isfinite(jnp.sum(g.astype(jnp.float32))) for g in grads]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_and(out, f)
+    return out
 
 
 def make_train_step(net, loss_fn, names: List[str],
-                    optimizer: str = "sgd", learning_rate: float = 0.01,
+                    optimizer="sgd", learning_rate: float = 0.01,
                     weight_decay: float = 0.0, momentum: float = 0.9,
-                    donate: bool = True, compute_dtype=None):
-    """Build one jitted SPMD train step:
-    step(tvals, avals, rng, opt_state, t, x, y)
-        -> (tvals', mutated_state, opt_state', loss).
+                    donate: bool = True, compute_dtype=None,
+                    loss_scale_growth_interval: int = 2000):
+    """Build the jitted SPMD train machinery. Returns
+    (step, grad_fn, apply_fn, adapter, holder):
+
+    step(tvals, avals, rng, opt_state, t, lr, scale_state, x, y)
+        -> (tvals', mutated_state, opt_state', scale_state', loss)
 
     ``tvals`` are trainable parameter values (grad_req != 'null'); ``avals``
-    are auxiliary state (BatchNorm running stats etc., grad_req == 'null')
-    which is never differentiated or optimizer-updated — its new values come
-    back through ``mutated_state`` (the forward's in-place updates), exactly
-    like the reference's aux-state split (mx Parameter grad_req,
-    trainer.py:411 skips null-grad params).
+    are auxiliary state (BatchNorm running stats etc.) which is never
+    differentiated or optimizer-updated — its new values come back through
+    ``mutated_state``, exactly like the reference's aux-state split.
+    ``lr`` is a traced scalar (LR schedules never recompile) and the
+    optimizer can be ANY registry optimizer or Optimizer instance — its
+    imperative update() replays inside the trace with traced lr/t
+    (_OptAdapter).
+
+    fp16 (compute_dtype == float16) enables dynamic loss scaling in the
+    step (ref python/mxnet/amp/loss_scaler.py + all_finite op): the loss is
+    multiplied by scale_state[0] before the backward, gradients unscaled,
+    and on overflow the update is skipped (per-leaf select) and the scale
+    halves; after ``loss_scale_growth_interval`` clean steps it doubles.
+    bf16 needs none of this (fp32-range exponents) and fp32/bf16 steps run
+    with the scale pinned at 1.
+
+    grad_fn/apply_fn split the step for gradient accumulation (micro-batch
+    grads summed host-side between applies).
 
     Shardings are carried by the committed input arrays (shard_params /
     device_put in the caller); XLA inserts the gradient reduction over 'dp'
@@ -166,6 +271,10 @@ def make_train_step(net, loss_fn, names: List[str],
     train_ix = [i for i, n in enumerate(names) if params[n].grad_req != "null"]
     aux_ix = [i for i, n in enumerate(names) if params[n].grad_req == "null"]
     holder["train_ix"], holder["aux_ix"] = train_ix, aux_ix
+    adapter = _OptAdapter(_make_opt(optimizer, learning_rate, weight_decay,
+                                    momentum))
+    dynamic_scaling = compute_dtype is not None and \
+        jnp.dtype(compute_dtype) == jnp.float16
 
     def assemble(tvals, avals, key_val):
         allv: List[Any] = [None] * (len(names) + 1)
@@ -176,13 +285,11 @@ def make_train_step(net, loss_fn, names: List[str],
         allv[-1] = key_val
         return allv
 
-    def loss_of(tvals, avals, key_val, x, y):
+    def loss_of(tvals, avals, key_val, scale, x, y):
         xs = x if isinstance(x, (tuple, list)) else (x,)
         if compute_dtype is not None:
-            # AMP: forward runs in compute_dtype (bf16 on the MXU), master
-            # params stay fp32 in the optimizer (ref amp loss-scale-free
-            # bf16 policy; python/mxnet/amp). No loss scaling needed for
-            # bf16 — the exponent range matches fp32.
+            # AMP: forward runs in compute_dtype on the MXU, master params
+            # stay fp32 in the optimizer (ref python/mxnet/amp)
             cast = lambda v: (v.astype(compute_dtype)  # noqa: E731
                               if jnp.issubdtype(v.dtype, jnp.floating)
                               else v)
@@ -193,46 +300,77 @@ def make_train_step(net, loss_fn, names: List[str],
             tv, av = tvals, avals
         outs, mutated = fn(assemble(tv, av, key_val), *xs)
         pred = outs[0] if len(outs) == 1 else tuple(outs)
-        loss = loss_fn(pred, y)
-        return jnp.mean(loss).astype(jnp.float32), (mutated,)
+        loss = jnp.mean(loss_fn(pred, y)).astype(jnp.float32)
+        return loss * scale, (loss, mutated)
 
-    def step(tvals, avals, key_val, opt_state, t, x, y):
-        (loss, (mutated,)), grads = jax.value_and_grad(loss_of, has_aux=True)(
-            tvals, avals, key_val, x, y)
+    def compute_grads(tvals, avals, key_val, scale, x, y):
+        (_, (loss, mutated)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(tvals, avals, key_val, scale, x, y)
         if compute_dtype is not None:
-            # mutated aux state (BN stats) came out of the bf16 forward;
-            # keep the persistent copies fp32 so precision doesn't decay
+            # mutated aux state (BN stats) came out of the low-precision
+            # forward; keep the persistent copies fp32
             mutated = [m.astype(jnp.float32)
                        if jnp.issubdtype(m.dtype, jnp.floating) else m
                        for m in mutated]
-        new_p, new_state = _opt_update(optimizer, tvals, grads, opt_state,
-                                       learning_rate, weight_decay, momentum, t)
-        return new_p, mutated, new_state, loss
+        grads = [g.astype(jnp.float32) / scale for g in grads]
+        return grads, mutated, loss
+
+    def apply_update(tvals, opt_state, t, lr, scale_state, grads):
+        scale, good = scale_state
+        new_p, new_state = adapter.update(tvals, grads, opt_state, lr, t)
+        if dynamic_scaling:
+            ok = all_finite(grads)
+            new_p = [jnp.where(ok, n, p) for n, p in zip(new_p, tvals)]
+            new_state = [jnp.where(ok, n, s)
+                         for n, s in zip(new_state, opt_state)]
+            grown = good + 1 >= loss_scale_growth_interval
+            new_scale = jnp.where(
+                ok, jnp.where(grown, scale * 2.0, scale),
+                jnp.maximum(scale * 0.5, 1.0))
+            new_good = jnp.where(ok, jnp.where(grown, 0, good + 1), 0)
+            scale_state = (new_scale, new_good)
+        return new_p, new_state, scale_state
+
+    def step(tvals, avals, key_val, opt_state, t, lr, scale_state, x, y):
+        grads, mutated, loss = compute_grads(
+            tvals, avals, key_val, scale_state[0], x, y)
+        new_p, new_state, scale_state = apply_update(
+            tvals, opt_state, t, lr, scale_state, grads)
+        return new_p, mutated, new_state, scale_state, loss
 
     jitted = jax.jit(step, donate_argnums=(0, 3) if donate else ())
-    return jitted, holder
+    grad_fn = jax.jit(compute_grads)
+    apply_fn = jax.jit(apply_update, donate_argnums=(0, 1) if donate else ())
+    return jitted, grad_fn, apply_fn, adapter, holder
 
 
 class ShardedTrainer:
     """End-to-end SPMD trainer for a gluon net over a Mesh.
 
     Capability summary vs reference: DP (≈ kvstore 'device'/'dist_sync'),
-    plus fsdp/tp param sharding the reference lacks. Multi-host: build the
-    mesh from jax.devices() after jax.distributed.initialize() — the same
-    code runs, collectives ride ICI within a slice and DCN across
+    plus fsdp/tp param sharding the reference lacks; any registry optimizer
+    (the full 20, ref trainer.py's Optimizer integration); LR schedulers
+    (traced lr — no recompiles); gradient accumulation; fp16 dynamic loss
+    scaling in-step; checkpoint save/load restorable onto a different mesh
+    (ref Trainer.save_states/load_states, trainer.py:482,511). Multi-host:
+    build the mesh from jax.devices() after jax.distributed.initialize() —
+    the same code runs, collectives ride ICI within a slice and DCN across
     (north-star requirement)."""
 
     def __init__(self, net, loss_fn, mesh: Optional[Mesh] = None,
-                 optimizer: str = "sgd", learning_rate: float = 0.01,
+                 optimizer="sgd", learning_rate: float = 0.01,
                  weight_decay: float = 0.0, momentum: float = 0.9,
                  spec_fn: Callable = replicated_spec_fn,
-                 batch_spec: P = P("dp"), compute_dtype=None):
+                 batch_spec: P = P("dp"), compute_dtype=None,
+                 lr_scheduler=None, grad_accum: int = 1,
+                 init_loss_scale: float = 2.0 ** 16):
         from .mesh import default_mesh
 
         self.net = net
         self.mesh = mesh if mesh is not None else default_mesh()
         self.names, allvals, self.specs = shard_params(net, self.mesh, spec_fn)
-        self._step_fn, self._holder = make_train_step(
+        (self._step_fn, self._grad_fn, self._apply_fn, self._adapter,
+         self._holder) = make_train_step(
             net, loss_fn, self.names, optimizer, learning_rate,
             weight_decay, momentum, compute_dtype=compute_dtype)
         self.pvals = [allvals[i] for i in self._holder["train_ix"]]
@@ -240,12 +378,48 @@ class ShardedTrainer:
         self._params = net.collect_params()
         self.train_names = [self.names[i] for i in self._holder["train_ix"]]
         self.aux_names = [self.names[i] for i in self._holder["aux_ix"]]
-        self.opt_state = _opt_init(optimizer, self.pvals)
+        self.opt_state = self._adapter.init_state(self.pvals)
+        # momenta etc. share their parameter's placement (FSDP: optimizer
+        # state shards with the param, the ZeRO property)
+        tspecs = [self.specs[i] for i in self._holder["train_ix"]]
+        self.opt_state = [
+            jax.device_put(s, NamedSharding(
+                self.mesh, tspecs[pi] if s.shape == self.pvals[pi].shape
+                else P()))
+            for s, pi in zip(self.opt_state, self._adapter.leaf_param_ix)]
         self._t = 0
         self._batch_spec = batch_spec
+        # an Optimizer instance brings its own lr / scheduler — honor them
+        # (its update() replays with the trainer-supplied traced lr)
+        opt = self._adapter.opt
+        self._lr = float(opt.lr) if optimizer is opt else learning_rate
+        self.lr_scheduler = lr_scheduler if lr_scheduler is not None \
+            else getattr(opt, "lr_scheduler", None)
+        self.grad_accum = int(grad_accum)
+        self._accum: Optional[List[Any]] = None
+        self._micro = 0
+        self._dynamic_scaling = compute_dtype is not None and \
+            jnp.dtype(compute_dtype) == jnp.float16
+        self._scale_state = (
+            jnp.float32(init_loss_scale if self._dynamic_scaling else 1.0),
+            jnp.int32(0))
         from ..random import key_holder
 
         self._key = key_holder()._data
+
+    # -- lr -----------------------------------------------------------------
+    @property
+    def learning_rate(self) -> float:
+        if self.lr_scheduler is not None:
+            return float(self.lr_scheduler(self._t))
+        return self._lr
+
+    def set_learning_rate(self, lr: float):
+        self._lr = float(lr)
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self._scale_state[0])
 
     def _put(self, v):
         """Shard a batch value (or tuple tree of them) per batch_spec; the
@@ -260,18 +434,14 @@ class ShardedTrainer:
             spec = P(*spec[:v.ndim])
         return jax.device_put(v, NamedSharding(self.mesh, spec))
 
-    def step(self, x, y) -> float:
-        """One SPMD step; returns scalar loss."""
-        xb, yb = self._put(x), self._put(y)
-        self._t += 1
-        self.pvals, mutated, self.opt_state, loss = self._step_fn(
-            self.pvals, self.avals, self._key, self.opt_state, self._t, xb, yb)
-        # write back: trainable params from the optimizer, then mutated state
-        # (BN stats, RNG key) from the forward — mutated refs never overlap
-        # trainables, so order is safe.
+    def _write_back_params(self):
         params = self._params
         for n, v in zip(self.train_names, self.pvals):
             params[n].data()._set_data(v)
+
+    def _write_back(self, mutated):
+        params = self._params
+        self._write_back_params()
         refs = self._holder.get("mutated_refs", [])
         for a, v in zip(refs, mutated):
             a._set_data(v)
@@ -279,4 +449,96 @@ class ShardedTrainer:
         from ..random import key_holder
 
         self._key = key_holder()._data
+
+    def step(self, x, y) -> float:
+        """One SPMD step; returns scalar loss. With grad_accum=k, every
+        k-th call applies the averaged accumulated gradient (the k-1 other
+        calls only accumulate — ref gradient-accumulation idiom over
+        grad_req='add')."""
+        xb, yb = self._put(x), self._put(y)
+        lr = jnp.float32(self.learning_rate)
+        if self.grad_accum <= 1:
+            self._t += 1
+            (self.pvals, mutated, self.opt_state, self._scale_state,
+             loss) = self._step_fn(self.pvals, self.avals, self._key,
+                                   self.opt_state, self._t, lr,
+                                   self._scale_state, xb, yb)
+            self._write_back(mutated)
+            return float(loss)
+        grads, mutated, loss = self._grad_fn(
+            self.pvals, self.avals, self._key, self._scale_state[0], xb, yb)
+        self._accum = grads if self._accum is None else \
+            [a + g for a, g in zip(self._accum, grads)]
+        self._micro += 1
+        self._write_back(mutated)
+        if self._micro >= self.grad_accum:
+            self._t += 1
+            avg = [g / self.grad_accum for g in self._accum]
+            (self.pvals, self.opt_state, self._scale_state) = self._apply_fn(
+                self.pvals, self.opt_state, self._t, lr, self._scale_state,
+                avg)
+            self._accum, self._micro = None, 0
+            self._write_back_params()
         return float(loss)
+
+    # -- checkpoint (ref Trainer.save_states/load_states) -------------------
+    def save_states(self, fname: str):
+        """Full training state → one .npz: params (train+aux), optimizer
+        state leaves, RNG key, step count, loss scale. Arrays are gathered
+        to host unsharded, so the file restores onto ANY mesh shape."""
+        import numpy as onp
+
+        blob: Dict[str, Any] = {}
+        for n, v in zip(self.train_names, self.pvals):
+            blob[f"param/{n}"] = onp.asarray(v)
+        for n, v in zip(self.aux_names, self.avals):
+            blob[f"aux/{n}"] = onp.asarray(v)
+        for i, s in enumerate(self.opt_state):
+            blob[f"opt/{i}"] = onp.asarray(s)
+        blob["meta/t"] = onp.asarray(self._t)
+        blob["meta/key"] = onp.asarray(self._key)
+        blob["meta/scale"] = onp.asarray(self._scale_state[0])
+        blob["meta/good"] = onp.asarray(self._scale_state[1])
+        with open(fname, "wb") as f:
+            onp.savez(f, **blob)
+
+    def load_states(self, fname: str):
+        """Restore a save_states checkpoint onto THIS trainer's mesh: each
+        array is re-placed per the trainer's sharding specs."""
+        import numpy as onp
+
+        with onp.load(fname) as z:
+            blob = {k: z[k] for k in z.files}
+        spec_of = dict(zip(self.names, self.specs))
+
+        def place(name, v):
+            return jax.device_put(jnp.asarray(v), NamedSharding(
+                self.mesh, spec_of.get(name, P())))
+
+        for key in list(blob):
+            if key.startswith("param/"):
+                n = key[len("param/"):]
+                if n not in self.train_names:
+                    raise MXNetError(f"checkpoint param '{n}' unknown")
+        self.pvals = [place(n, blob[f"param/{n}"]) for n in self.train_names]
+        self.avals = [place(n, blob[f"aux/{n}"]) for n in self.aux_names]
+        tspecs = [self.specs[i] for i in self._holder["train_ix"]]
+        self.opt_state = [
+            jax.device_put(jnp.asarray(blob[f"opt/{i}"]), NamedSharding(
+                self.mesh,
+                tspecs[pi] if blob[f"opt/{i}"].shape ==
+                tuple(self.pvals[pi].shape) else P()))
+            for i, pi in enumerate(self._adapter.leaf_param_ix)]
+        self._t = int(blob["meta/t"])
+        self._key = jnp.asarray(blob["meta/key"])
+        self._scale_state = (jnp.float32(blob["meta/scale"]),
+                             jnp.int32(blob["meta/good"]))
+        params = self._params
+        for n, v in zip(self.train_names, self.pvals):
+            params[n].data()._set_data(v)
+        for n, v in zip(self.aux_names, self.avals):
+            params[n].data()._set_data(v)
+        from ..random import key_holder
+
+        key_holder()._set_data(self._key)
+        self._accum, self._micro = None, 0
