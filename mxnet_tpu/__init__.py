@@ -46,6 +46,8 @@ from . import library
 from . import operator
 from . import io
 from . import recordio  # legacy alias: mx.recordio (ref python/mxnet/recordio.py)
+from . import image
+from . import image as img  # legacy alias: mx.img (ref python/mxnet/__init__.py)
 from . import profiler
 from . import runtime
 from . import amp

@@ -1,0 +1,10 @@
+"""mx.image — image decode, augmentation and iterators.
+
+Reference surface: python/mxnet/image/__init__.py (re-exports image.py and
+detection.py). TPU-native stance: decode/augment is host-side work that must
+never touch the accelerator per sample — the numpy/PIL pipeline here feeds
+device memory once per *batch*; only the batched geometric ops (imrotate)
+run as jitted XLA computations.
+"""
+from .image import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403

@@ -1,0 +1,1026 @@
+"""Read, decode and augment individual images; batch them with ImageIter.
+
+Reference: python/mxnet/image/image.py (imread/imdecode/imresize at 51-213,
+augmenter classes at 761-1170, CreateAugmenter at 1171, ImageIter at 1285).
+
+TPU-first redesign, not a translation:
+
+* The reference funnels every op through OpenCV kernels wrapped as NDArray
+  operators (``_internal._cvimresize`` etc.). Here decode/resize ride PIL
+  and the arithmetic augmenters are plain numpy — this is host-side IO work;
+  putting it on the accelerator per-sample would serialize H2D transfers on
+  the hot path. Device memory is touched once per batch, in ImageIter.
+* Augmenters accept and return either host numpy arrays (the internal fast
+  path) or ``mx.nd.NDArray`` (API parity with reference call sites); the
+  output kind mirrors the input kind.
+* ``imrotate``/``random_rotate`` are the exception: the reference implements
+  them as batched device ops (nd.BilinearSampler, image.py:618-760); ours is
+  a jittable jnp bilinear grid-sample so rotation of an NCHW batch stays one
+  fused XLA computation on TPU.
+"""
+from __future__ import annotations
+
+import io as _io
+import json
+import logging
+import numbers
+import os
+import random
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+
+__all__ = [
+    "imread", "imdecode", "imresize", "imwrite", "scale_down",
+    "copyMakeBorder", "resize_short", "fixed_crop", "random_crop",
+    "center_crop", "color_normalize", "random_size_crop", "imrotate",
+    "random_rotate",
+    "Augmenter", "SequentialAug", "ResizeAug", "ForceResizeAug",
+    "RandomCropAug", "RandomSizedCropAug", "CenterCropAug", "RandomOrderAug",
+    "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+    "HueJitterAug", "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+    "RandomGrayAug", "HorizontalFlipAug", "CastAug",
+    "CreateAugmenter", "ImageIter",
+]
+
+_GRAY_COEF = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+# ---------------------------------------------------------------------------
+# host<->NDArray shims
+# ---------------------------------------------------------------------------
+
+def _is_nd(x):
+    return isinstance(x, nd.NDArray)
+
+
+def _to_host(src):
+    """Return (host numpy array, was_ndarray flag)."""
+    if _is_nd(src):
+        return src.asnumpy(), True
+    return np.asarray(src), False
+
+
+def _wrap(arr, was_nd):
+    if was_nd:
+        from ..context import cpu
+        return nd.array(arr, ctx=cpu())
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# decode / resize primitives (PIL-backed; ref image.py:51-213 wraps OpenCV)
+# ---------------------------------------------------------------------------
+
+# cv2 interp code -> PIL resample filter (ref _get_interp_method docstring)
+_PIL_INTERP = {}
+
+
+def _pil_interp(code):
+    from PIL import Image
+
+    if not _PIL_INTERP:
+        _PIL_INTERP.update({
+            0: Image.Resampling.NEAREST,
+            1: Image.Resampling.BILINEAR,
+            2: Image.Resampling.BICUBIC,
+            3: Image.Resampling.BOX,       # area-based
+            4: Image.Resampling.LANCZOS,
+        })
+    return _PIL_INTERP[code]
+
+
+def _get_interp_method(interp, sizes=()):
+    """Resolve interp code 9 (auto by size) / 10 (random) to a concrete
+    method 0-4 (ref image.py:302-356 semantics)."""
+    if interp == 9:
+        if sizes:
+            assert len(sizes) == 4
+            oh, ow, nh, nw = sizes
+            if nh > oh and nw > ow:
+                return 2
+            if nh < oh and nw < ow:
+                return 3
+            return 1
+        return 2
+    if interp == 10:
+        return random.randint(0, 4)
+    if interp not in (0, 1, 2, 3, 4):
+        raise ValueError("Unknown interp method %d" % interp)
+    return interp
+
+
+def imdecode(buf, flag=1, to_rgb=True, out_type="ndarray"):
+    """Decode an image byte buffer to HWC uint8 (ref image.py:154-213).
+
+    flag=0 decodes grayscale (HW1); to_rgb=False returns BGR channel order
+    like the reference's OpenCV path. ``out_type='numpy'`` keeps the result
+    on host (internal fast path; the reference has no such switch because
+    its NDArrays are host-resident on cpu ctx anyway).
+    """
+    from PIL import Image
+
+    if isinstance(buf, nd.NDArray):
+        buf = buf.asnumpy().tobytes()
+    elif isinstance(buf, np.ndarray):
+        buf = buf.tobytes()
+    if not isinstance(buf, (bytes, bytearray, memoryview)):
+        raise TypeError("buf must be bytes or NDArray/ndarray of bytes")
+    try:
+        img = Image.open(_io.BytesIO(bytes(buf)))
+        if flag == 0:
+            arr = np.asarray(img.convert("L"))[:, :, None]
+        else:
+            arr = np.asarray(img.convert("RGB"))
+            if not to_rgb:
+                arr = arr[:, :, ::-1]
+    except Exception as e:
+        raise MXNetError(f"imdecode failed: {e}")
+    if out_type == "numpy":
+        return arr
+    return _wrap(arr, True)
+
+
+def imread(filename, flag=1, to_rgb=True, out_type="ndarray"):
+    """Read and decode an image file to HWC uint8 (ref image.py:51-95)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb, out_type=out_type)
+
+
+def imwrite(filename, img):
+    """Encode an HWC image to disk by extension (convenience; the reference
+    exposes this only through cv2)."""
+    from PIL import Image
+
+    arr, _ = _to_host(img)
+    Image.fromarray(np.clip(arr, 0, 255).astype(np.uint8).squeeze()).save(filename)
+
+
+def imresize(src, w, h, interp=2):
+    """Resize to exactly (w, h) (ref image.py:96-153)."""
+    from PIL import Image
+
+    arr, was_nd = _to_host(src)
+    method = _get_interp_method(interp, (arr.shape[0], arr.shape[1], h, w))
+    dtype = arr.dtype
+    img = arr
+    if dtype != np.uint8:
+        # PIL resizes float via mode 'F' per channel; keep precision
+        chans = [Image.fromarray(img[:, :, c].astype(np.float32), mode="F")
+                 .resize((int(w), int(h)), _pil_interp(method))
+                 for c in range(img.shape[2])]
+        out = np.stack([np.asarray(c) for c in chans], axis=2).astype(dtype)
+    else:
+        out = np.asarray(Image.fromarray(img.squeeze(-1) if img.shape[2] == 1
+                                         else img)
+                         .resize((int(w), int(h)), _pil_interp(method)))
+        if out.ndim == 2:
+            out = out[:, :, None]
+    return _wrap(out, was_nd)
+
+
+def scale_down(src_size, size):
+    """Shrink crop (w, h) to fit inside src (w, h), keeping aspect
+    (ref image.py:214-247)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+# cv2 border type -> numpy pad mode (ref copyMakeBorder docstring)
+_PAD_MODES = {0: "constant", 1: "symmetric", 2: "reflect", 3: "edge",
+              4: "wrap"}
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, values=0):  # noqa: A002
+    """Pad image borders (ref image.py:249-301, cv2.copyMakeBorder)."""
+    arr, was_nd = _to_host(src)
+    mode = _PAD_MODES.get(type)
+    if mode is None:
+        raise ValueError(f"unknown border type {type}")
+    pad = ((top, bot), (left, right), (0, 0))
+    if mode == "constant":
+        vals = np.asarray(values, arr.dtype).reshape(-1)
+        out = np.stack([
+            np.pad(arr[:, :, c], pad[:2], mode="constant",
+                   constant_values=vals[c % len(vals)])
+            for c in range(arr.shape[2])], axis=2)
+    else:
+        out = np.pad(arr, pad, mode=mode)
+    return _wrap(out, was_nd)
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to ``size`` keeping aspect (ref image.py:357-418)."""
+    arr, was_nd = _to_host(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return _wrap(
+        imresize(arr, new_w, new_h,
+                 interp=_get_interp_method(interp, (h, w, new_h, new_w))),
+        was_nd)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop at a fixed box, optionally resize to ``size`` (w, h)
+    (ref image.py:419-450)."""
+    arr, was_nd = _to_host(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        sizes = (h, w, size[1], size[0])
+        out, _ = _to_host(imresize(out, *size,
+                                   interp=_get_interp_method(interp, sizes)))
+    return _wrap(out, was_nd)
+
+
+def random_crop(src, size, interp=2):
+    """Random-position crop of ``size`` (w, h), scaled down to fit
+    (ref image.py:451-489). Returns (img, (x0, y0, w, h))."""
+    arr, was_nd = _to_host(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = random.randint(0, w - new_w)
+    y0 = random.randint(0, h - new_h)
+    out = fixed_crop(arr, x0, y0, new_w, new_h, size, interp)
+    return _wrap(out, was_nd), (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Centered crop of ``size`` (w, h), scaled down to fit
+    (ref image.py:490-538). Returns (img, (x0, y0, w, h))."""
+    arr, was_nd = _to_host(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = int((w - new_w) / 2)
+    y0 = int((h - new_h) / 2)
+    out = fixed_crop(arr, x0, y0, new_w, new_h, size, interp)
+    return _wrap(out, was_nd), (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """Subtract mean, divide by std (ref image.py:539-562)."""
+    arr, was_nd = _to_host(src)
+    arr = arr.astype(np.float32)
+    if mean is not None:
+        arr = arr - _to_host(mean)[0].astype(np.float32)
+    if std is not None:
+        arr = arr / _to_host(std)[0].astype(np.float32)
+    return _wrap(arr, was_nd)
+
+
+def random_size_crop(src, size, area, ratio, interp=2, **kwargs):
+    """Random crop with jittered area and aspect ratio (Inception-style,
+    ref image.py:563-617). Returns (img, (x0, y0, w, h))."""
+    arr, was_nd = _to_host(src)
+    h, w = arr.shape[:2]
+    src_area = h * w
+    if "min_area" in kwargs:
+        area = kwargs.pop("min_area")
+    assert not kwargs, "unexpected keyword arguments for `random_size_crop`."
+    if isinstance(area, numbers.Number):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = random.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(random.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = random.randint(0, w - new_w)
+            y0 = random.randint(0, h - new_h)
+            out = fixed_crop(arr, x0, y0, new_w, new_h, size, interp)
+            return _wrap(out, was_nd), (x0, y0, new_w, new_h)
+    out, box = center_crop(arr, size, interp)
+    return _wrap(_to_host(out)[0], was_nd), box
+
+
+# ---------------------------------------------------------------------------
+# batched device-side rotation (ref image.py:618-760 uses nd.BilinearSampler)
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample_nchw(src, grid_x, grid_y):
+    """Sample NCHW ``src`` at normalized grid coords in [-1, 1]
+    (jnp; zero padding outside, matching BilinearSampler semantics)."""
+    import jax.numpy as jnp
+
+    n, c, h, w = src.shape
+    x = (grid_x + 1.0) * (w - 1) / 2.0     # (N, H, W) in pixel coords
+    y = (grid_y + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    src_flat = src.reshape(n, c, h * w)
+
+    def gather(ix, iy):
+        inside = ((ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1))
+        ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+        # one flat (y*w + x) gather per corner: a pair of chained
+        # take_along_axis calls would wrongly evaluate the y map at the
+        # gathered x column
+        flat = (iyc * w + ixc).reshape(n, 1, -1).repeat(c, 1)
+        vals = jnp.take_along_axis(src_flat, flat, axis=2) \
+            .reshape(n, c, *ix.shape[1:])
+        return vals * inside[:, None, :, :]
+
+    out = (gather(x0, y0) * ((1 - wx) * (1 - wy))[:, None]
+           + gather(x0 + 1, y0) * (wx * (1 - wy))[:, None]
+           + gather(x0, y0 + 1) * ((1 - wx) * wy)[:, None]
+           + gather(x0 + 1, y0 + 1) * (wx * wy)[:, None])
+    return out
+
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """Rotate CHW image / NCHW batch by degrees; one fused XLA computation
+    (ref image.py:618-726; BilinearSampler replaced by a jnp grid sample)."""
+    import jax.numpy as jnp
+
+    if zoom_in and zoom_out:
+        raise ValueError("`zoom_in` and `zoom_out` cannot be both True")
+    arr, was_nd = _to_host(src)
+    if arr.dtype != np.float32:
+        raise TypeError("Only `float32` images are supported by this function")
+    expanded = False
+    if arr.ndim == 3:
+        expanded = True
+        arr = arr[None]
+        if not isinstance(rotation_degrees, numbers.Number):
+            raise TypeError("When a single image is passed the rotation "
+                            "angle is required to be a scalar.")
+    elif arr.ndim != 4:
+        raise ValueError("Only 3D and 4D are supported by this function")
+    if isinstance(rotation_degrees, numbers.Number):
+        rotation_degrees = np.full((len(arr),), rotation_degrees, np.float32)
+    else:
+        rotation_degrees = _to_host(rotation_degrees)[0].astype(np.float32)
+    if len(arr) != len(rotation_degrees):
+        raise ValueError("The number of images must be equal to the number "
+                         "of rotation angles")
+
+    x = jnp.asarray(arr)
+    rad = jnp.asarray(rotation_degrees) * (np.pi / 180.0)
+    n, _, h, w = arr.shape
+    hscale = (h - 1) / 2.0
+    wscale = (w - 1) / 2.0
+    ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32) - hscale,
+                          jnp.arange(w, dtype=jnp.float32) - wscale,
+                          indexing="ij")
+    c = jnp.cos(rad)[:, None, None]
+    s = jnp.sin(rad)[:, None, None]
+    gx = (xs[None] * c - ys[None] * s) / wscale
+    gy = (xs[None] * s + ys[None] * c) / hscale
+
+    if zoom_in or zoom_out:
+        rho = np.sqrt(h * h + w * w)
+        ang = np.arctan(h / w)
+        a = jnp.abs(rad)[:, None, None]
+        max_x = jnp.maximum(jnp.abs(rho * jnp.cos(ang + a)),
+                            jnp.abs(rho * jnp.cos(ang - a)))
+        max_y = jnp.maximum(jnp.abs(rho * jnp.sin(ang + a)),
+                            jnp.abs(rho * jnp.sin(ang - a)))
+        if zoom_out:
+            scale = jnp.maximum(max_x / w, max_y / h)
+        else:
+            scale = jnp.minimum(w / max_x, h / max_y)
+        gx = gx * scale
+        gy = gy * scale
+
+    out = _bilinear_sample_nchw(x, gx, gy)
+    out = np.asarray(out)
+    if expanded:
+        out = out[0]
+    return _wrap(out, was_nd)
+
+
+def random_rotate(src, angle_limits, zoom_in=False, zoom_out=False):
+    """Rotate by a uniform random angle in ``angle_limits``
+    (ref image.py:727-760)."""
+    arr_ndim = src.ndim
+    if arr_ndim == 3:
+        degrees = random.uniform(*angle_limits)
+    else:
+        n = src.shape[0]
+        degrees = np.random.uniform(*angle_limits, size=n).astype(np.float32)
+    return imrotate(src, degrees, zoom_in=zoom_in, zoom_out=zoom_out)
+
+
+# ---------------------------------------------------------------------------
+# augmenters (ref image.py:761-1170)
+# ---------------------------------------------------------------------------
+
+class Augmenter:
+    """Image augmenter base; ``dumps()`` serializes name+params to JSON
+    (ref image.py:761-786)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = {}
+        for k, v in kwargs.items():
+            if _is_nd(v):
+                v = v.asnumpy()
+            if isinstance(v, np.ndarray):
+                v = v.tolist()
+            self._kwargs[k] = v
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError("Must override implementation.")
+
+
+class SequentialAug(Augmenter):
+    """Apply a list of augmenters in order (ref image.py:787-809)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), [x.dumps() for x in self.ts]]
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    """Resize shorter edge (ref image.py:810-829)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    """Resize to exact (w, h) ignoring aspect (ref image.py:830-850)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        sizes = (src.shape[0], src.shape[1], self.size[1], self.size[0])
+        return imresize(src, *self.size,
+                        interp=_get_interp_method(self.interp, sizes))
+
+
+class RandomCropAug(Augmenter):
+    """Random crop (ref image.py:851-870)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    """Random crop w/ area+ratio jitter (ref image.py:871-904)."""
+
+    def __init__(self, size, area, ratio, interp=2, **kwargs):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        if "min_area" in kwargs:
+            area = kwargs.pop("min_area")
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+        assert not kwargs, "unexpected keyword arguments for `RandomSizedCropAug`."
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    """Center crop (ref image.py:905-924)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomOrderAug(Augmenter):
+    """Apply augmenters in random order (ref image.py:925-948)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), [x.dumps() for x in self.ts]]
+
+    def __call__(self, src):
+        random.shuffle(self.ts)
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    """src *= 1 + U(-b, b) (ref image.py:949-967)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        arr, was_nd = _to_host(src)
+        alpha = 1.0 + random.uniform(-self.brightness, self.brightness)
+        return _wrap(arr.astype(np.float32) * alpha, was_nd)
+
+
+class ContrastJitterAug(Augmenter):
+    """Scale around the mean gray level (ref image.py:968-990)."""
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        arr, was_nd = _to_host(src)
+        arr = arr.astype(np.float32)
+        alpha = 1.0 + random.uniform(-self.contrast, self.contrast)
+        gray = arr * _GRAY_COEF
+        gray = (3.0 * (1.0 - alpha) / gray.size) * np.sum(gray)
+        return _wrap(arr * alpha + gray, was_nd)
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend with per-pixel gray (ref image.py:991-1014)."""
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        arr, was_nd = _to_host(src)
+        arr = arr.astype(np.float32)
+        alpha = 1.0 + random.uniform(-self.saturation, self.saturation)
+        gray = np.sum(arr * _GRAY_COEF, axis=2, keepdims=True)
+        return _wrap(arr * alpha + gray * (1.0 - alpha), was_nd)
+
+
+class HueJitterAug(Augmenter):
+    """Rotate hue via the YIQ linear approximation (ref image.py:1015-1048,
+    citing beesbuzz.biz/code/hsv_color_transforms.php)."""
+
+    _TYIQ = np.array([[0.299, 0.587, 0.114],
+                      [0.596, -0.274, -0.321],
+                      [0.211, -0.523, 0.311]], np.float32)
+    _ITYIQ = np.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]], np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        arr, was_nd = _to_host(src)
+        alpha = random.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], np.float32)
+        t = (self._ITYIQ @ bt @ self._TYIQ).T
+        return _wrap(arr.astype(np.float32) @ t, was_nd)
+
+
+class ColorJitterAug(RandomOrderAug):
+    """Brightness+contrast+saturation in random order (ref image.py:1049-1071)."""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise (ref image.py:1072-1097)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd, eigval=eigval, eigvec=eigvec)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        arr, was_nd = _to_host(src)
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return _wrap(arr.astype(np.float32) + rgb.astype(np.float32), was_nd)
+
+
+class ColorNormalizeAug(Augmenter):
+    """Mean/std normalization (ref image.py:1098-1117)."""
+
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = None if mean is None else _to_host(mean)[0]
+        self.std = None if std is None else _to_host(std)[0]
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    """Convert to gray with probability p (ref image.py:1118-1139)."""
+
+    _MAT = np.array([[0.21, 0.21, 0.21],
+                     [0.72, 0.72, 0.72],
+                     [0.07, 0.07, 0.07]], np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            arr, was_nd = _to_host(src)
+            src = _wrap(arr.astype(np.float32) @ self._MAT, was_nd)
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    """Mirror horizontally with probability p (ref image.py:1140-1158)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            arr, was_nd = _to_host(src)
+            src = _wrap(arr[:, ::-1], was_nd)
+        return src
+
+
+class CastAug(Augmenter):
+    """Cast to a dtype, default float32 (ref image.py:1159-1170)."""
+
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        if _is_nd(src):
+            return src.astype(self.typ)
+        return np.asarray(src).astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Build the standard augmenter list (ref image.py:1171-1284):
+    resize → crop → mirror → cast → color jitter → hue → pca → gray →
+    normalize."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.08,
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+
+    auglist.append(CastAug())
+
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    elif mean is not None:
+        mean = _to_host(mean)[0]
+        assert mean.shape[0] in (1, 3)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    elif std is not None:
+        std = _to_host(std)[0]
+        assert std.shape[0] in (1, 3)
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+
+    return auglist
+
+
+# ---------------------------------------------------------------------------
+# ImageIter (ref image.py:1285-1614)
+# ---------------------------------------------------------------------------
+
+class ImageIter:
+    """Image iterator over .rec files, .lst lists or in-memory image lists
+    with the full augmentation stack (ref image.py:1285).
+
+    TPU-native data flow: samples are decoded and augmented as host numpy
+    (never per-sample device ops); the assembled NCHW batch crosses to
+    device memory once, as a single ``nd.array`` put.
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", dtype="float32",
+                 last_batch_handle="pad", **kwargs):
+        from ..io.io import DataDesc
+        from ..io import recordio
+
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        assert dtype in ("int32", "float32", "int64", "float64"), \
+            dtype + " label not supported"
+        if path_imgrec:
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(path_imgidx,
+                                                         path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        else:
+            self.imgrec = None
+
+        if path_imglist:
+            logging.info("ImageIter: loading image list %s...", path_imglist)
+            with open(path_imglist) as fin:
+                imglist = {}
+                imgkeys = []
+                for line in iter(fin.readline, ""):
+                    line = line.strip().split("\t")
+                    label = np.array(line[1:-1], dtype=dtype)
+                    key = int(line[0])
+                    imglist[key] = (label, line[-1])
+                    imgkeys.append(key)
+                self.imglist = imglist
+        elif isinstance(imglist, list):
+            result = {}
+            imgkeys = []
+            for index, img in enumerate(imglist, 1):
+                key = str(index)
+                if len(img) > 2:
+                    label = np.array(img[:-1], dtype=dtype)
+                elif isinstance(img[0], numbers.Number):
+                    label = np.array([img[0]], dtype=dtype)
+                else:
+                    label = np.array(img[0], dtype=dtype)
+                result[key] = (label, img[-1])
+                imgkeys.append(str(key))
+            self.imglist = result
+        else:
+            self.imglist = None
+        self.path_root = path_root
+
+        self.check_data_shape(data_shape)
+        self.provide_data = [DataDesc(data_name, (batch_size,) + data_shape)]
+        if label_width > 1:
+            self.provide_label = [DataDesc(label_name,
+                                           (batch_size, label_width))]
+        else:
+            self.provide_label = [DataDesc(label_name, (batch_size,))]
+        self.batch_size = batch_size
+        self.data_shape = data_shape
+        self.label_width = label_width
+        self.shuffle = shuffle
+        if self.imgrec is None:
+            self.seq = imgkeys
+        elif shuffle or num_parts > 1 or path_imgidx:
+            assert self.imgidx is not None
+            self.seq = self.imgidx
+        else:
+            self.seq = None
+
+        if num_parts > 1:
+            assert part_index < num_parts
+            N = len(self.seq)
+            C = N // num_parts
+            self.seq = self.seq[part_index * C:(part_index + 1) * C]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self._allow_read = True
+        self.last_batch_handle = last_batch_handle
+        self.num_image = len(self.seq) if self.seq is not None else None
+        self._cache_data = None
+        self._cache_label = None
+        self._cache_idx = None
+        self.reset()
+
+    # -- epoch control ------------------------------------------------------
+    def reset(self):
+        if self.seq is not None and self.shuffle:
+            random.shuffle(self.seq)
+        if self.last_batch_handle != "roll_over" or self._cache_data is None:
+            if self.imgrec is not None:
+                self.imgrec.reset()
+            self.cur = 0
+            self._allow_read = True
+
+    def hard_reset(self):
+        if self.seq is not None and self.shuffle:
+            random.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+        self._allow_read = True
+        self._cache_data = None
+        self._cache_label = None
+        self._cache_idx = None
+
+    # -- sample level -------------------------------------------------------
+    def next_sample(self):
+        """Return (label, raw image bytes) for the next sample."""
+        from ..io import recordio
+
+        if self._allow_read is False:
+            raise StopIteration
+        if self.seq is not None:
+            if self.cur < self.num_image:
+                idx = self.seq[self.cur]
+            else:
+                if self.last_batch_handle != "discard":
+                    self.cur = 0
+                raise StopIteration
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                if self.imglist is None:
+                    return header.label, img
+                return self.imglist[idx][0], img
+            label, fname = self.imglist[idx]
+            return label, self.read_image(fname)
+        s = self.imgrec.read()
+        if s is None:
+            if self.last_batch_handle != "discard":
+                self.imgrec.reset()
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def _batchify(self, batch_data, batch_label, start=0):
+        i = start
+        batch_size = self.batch_size
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = self.imdecode(s)
+                try:
+                    self.check_valid_image(data)
+                except RuntimeError as e:
+                    logging.debug("Invalid image, skipping: %s", str(e))
+                    continue
+                data = self.augmentation_transform(data)
+                assert i < batch_size, \
+                    "Batch size must be multiples of augmenter output length"
+                batch_data[i] = self.postprocess_data(data)
+                lab = np.asarray(label, np.float32).reshape(-1)
+                batch_label[i] = lab[0] if batch_label.ndim == 1 \
+                    else lab[:batch_label.shape[1]]
+                i += 1
+        except StopIteration:
+            if not i:
+                raise StopIteration
+        return i
+
+    def next(self):
+        """Return the next DataBatch (device NDArrays, pad count set)."""
+        from ..io.io import DataBatch
+
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        if self._cache_data is not None:
+            assert self._cache_label is not None
+            assert self._cache_idx is not None
+            batch_data = self._cache_data
+            batch_label = self._cache_label
+            i = self._cache_idx
+        else:
+            batch_data = np.zeros((batch_size, c, h, w), np.float32)
+            batch_label = np.empty(self.provide_label[0].shape, np.float32)
+            i = self._batchify(batch_data, batch_label)
+        pad = batch_size - i
+        if pad != 0:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            if (self.last_batch_handle == "roll_over"
+                    and self._cache_data is None):
+                self._cache_data = batch_data
+                self._cache_label = batch_label
+                self._cache_idx = i
+                raise StopIteration
+            _ = self._batchify(batch_data, batch_label, i)
+            if self.last_batch_handle == "pad":
+                self._allow_read = False
+            else:
+                self._cache_data = None
+                self._cache_label = None
+                self._cache_idx = None
+        # single per-batch host->device put
+        return DataBatch([nd.array(batch_data)], [nd.array(batch_label)],
+                         pad=pad)
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        return self
+
+    # -- helpers ------------------------------------------------------------
+    def check_data_shape(self, data_shape):
+        if not len(data_shape) == 3:
+            raise ValueError(
+                "data_shape should have length 3, with dimensions CxHxW")
+        if not data_shape[0] == 3:
+            raise ValueError("This iterator expects inputs to have 3 channels.")
+
+    def check_valid_image(self, data):
+        if len(data[0].shape) == 0:
+            raise RuntimeError("Data shape is wrong")
+
+    def imdecode(self, s):
+        """Decode record payload to a host HWC array."""
+        def locate():
+            if self.seq is not None:
+                idx = self.seq[(self.cur % self.num_image) - 1]
+            else:
+                idx = (self.cur % self.num_image) - 1
+            if self.imglist is not None:
+                _, fname = self.imglist[idx]
+                return "Broken image filename: {}".format(fname)
+            return "Broken image index: {}".format(idx)
+
+        if isinstance(s, np.ndarray):
+            return s  # already-decoded array
+        raw = bytes(s) if not isinstance(s, bytes) else s
+        if raw[:6] == b"\x93NUMPY":  # .npy payload (repo pack_img fallback)
+            return np.load(_io.BytesIO(raw), allow_pickle=False)
+        try:
+            img = imdecode(raw, out_type="numpy")
+        except Exception as e:
+            raise RuntimeError("{}, {}".format(locate(), e))
+        return img
+
+    def read_image(self, fname):
+        with open(os.path.join(self.path_root, fname), "rb") as fin:
+            return fin.read()
+
+    def augmentation_transform(self, data):
+        for aug in self.auglist:
+            data = aug(data)
+        return data
+
+    def postprocess_data(self, datum):
+        """HWC host array -> CHW for the batch buffer."""
+        return np.transpose(np.asarray(datum, np.float32), (2, 0, 1))

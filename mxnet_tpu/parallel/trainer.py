@@ -415,6 +415,13 @@ class ShardedTrainer:
         return self._lr
 
     def set_learning_rate(self, lr: float):
+        if self.lr_scheduler is not None:
+            # parity with Optimizer.set_learning_rate: _lr would be dead
+            # (the property always consults the scheduler), so a silent
+            # write here would let the caller believe the LR changed
+            raise MXNetError(
+                "LRScheduler of the trainer has already been defined; "
+                "mutate the scheduler instead of calling set_learning_rate")
         self._lr = float(lr)
 
     @property
@@ -456,9 +463,12 @@ class ShardedTrainer:
         calls only accumulate — ref gradient-accumulation idiom over
         grad_req='add')."""
         xb, yb = self._put(x), self._put(y)
-        lr = jnp.float32(self.learning_rate)
         if self.grad_accum <= 1:
             self._t += 1
+            # lr AFTER the increment: update k uses scheduler(k), matching
+            # the eager Optimizer path (optimizer/__init__.py _update_count
+            # before _get_lr)
+            lr = jnp.float32(self.learning_rate)
             (self.pvals, mutated, self.opt_state, self._scale_state,
              loss) = self._step_fn(self.pvals, self.avals, self._key,
                                    self.opt_state, self._t, lr,
@@ -473,6 +483,7 @@ class ShardedTrainer:
         self._write_back(mutated)
         if self._micro >= self.grad_accum:
             self._t += 1
+            lr = jnp.float32(self.learning_rate)
             avg = [g / self.grad_accum for g in self._accum]
             (self.pvals, self.opt_state, self._scale_state) = self._apply_fn(
                 self.pvals, self.opt_state, self._t, lr, self._scale_state,
@@ -488,6 +499,13 @@ class ShardedTrainer:
         to host unsharded, so the file restores onto ANY mesh shape."""
         import numpy as onp
 
+        if self._micro != 0:
+            # load_states resets the accumulator, so a checkpoint taken
+            # mid-window would silently drop consumed micro-batches
+            raise MXNetError(
+                f"save_states called mid gradient-accumulation window "
+                f"({self._micro}/{self.grad_accum} micro-batches pending); "
+                f"step to a window boundary first")
         blob: Dict[str, Any] = {}
         for n, v in zip(self.train_names, self.pvals):
             blob[f"param/{n}"] = onp.asarray(v)

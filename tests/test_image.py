@@ -1,0 +1,430 @@
+"""mx.image: decode/resize/crop primitives, augmenters, ImageIter(s).
+
+Mirrors reference tests/python/unittest/test_image.py strategy: synthetic
+images through every augmenter + iterator source, with exact-math checks
+where the op is deterministic.
+"""
+import json
+import os
+import random
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as mimg
+from mxnet_tpu.io import recordio
+
+
+@pytest.fixture(scope="module")
+def img_dir(tmp_path_factory):
+    from PIL import Image
+
+    d = tmp_path_factory.mktemp("imgs")
+    rng = onp.random.RandomState(0)
+    for i in range(8):
+        arr = (rng.rand(40 + i, 50, 3) * 255).astype(onp.uint8)
+        Image.fromarray(arr).save(d / f"i{i}.png")
+    return d
+
+
+@pytest.fixture(scope="module")
+def rec_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("rec")
+    idx, rec = str(d / "t.idx"), str(d / "t.rec")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = onp.random.RandomState(1)
+    for i in range(10):
+        img = (rng.rand(40, 50, 3) * 255).astype(onp.uint8)
+        hdr = recordio.IRHeader(0, float(i % 3), i, 0)
+        w.write_idx(i, recordio.pack_img(hdr, img, quality=95))
+    w.close()
+    return idx, rec
+
+
+def test_imread_imdecode_roundtrip(img_dir):
+    img = mx.image.imread(str(img_dir / "i0.png"))
+    assert isinstance(img, mx.nd.NDArray)
+    assert img.shape == (40, 50, 3) and str(img.dtype).endswith("uint8")
+    with open(img_dir / "i0.png", "rb") as f:
+        buf = f.read()
+    dec = mx.image.imdecode(buf)
+    assert onp.array_equal(img.asnumpy(), dec.asnumpy())  # PNG is lossless
+    gray = mx.image.imdecode(buf, flag=0)
+    assert gray.shape == (40, 50, 1)
+    bgr = mx.image.imdecode(buf, to_rgb=False)
+    assert onp.array_equal(bgr.asnumpy()[:, :, ::-1], dec.asnumpy())
+    assert isinstance(mx.image.imdecode(buf, out_type="numpy"), onp.ndarray)
+
+
+def test_imresize_and_interp(img_dir):
+    img = mx.image.imread(str(img_dir / "i0.png"))
+    out = mx.image.imresize(img, 25, 30)
+    assert out.shape == (30, 25, 3)
+    # float input keeps dtype
+    f32 = mx.image.imresize(img.astype("float32"), 20, 20)
+    assert f32.shape == (20, 20, 3) and str(f32.dtype).endswith("float32")
+    # interp=9 auto: enlarge->bicubic(2), shrink->area(3), mixed->bilinear(1)
+    interp = mimg.image._get_interp_method
+    assert interp(9, (10, 10, 20, 20)) == 2
+    assert interp(9, (20, 20, 10, 10)) == 3
+    assert interp(9, (20, 10, 10, 20)) == 1
+    assert interp(9) == 2
+    assert interp(10) in (0, 1, 2, 3, 4)
+    with pytest.raises(ValueError):
+        interp(7)
+
+
+def test_scale_down():
+    assert mx.image.scale_down((640, 480), (720, 120)) == (640, 106)
+    assert mx.image.scale_down((360, 1000), (480, 500)) == (360, 375)
+    assert mx.image.scale_down((100, 100), (50, 50)) == (50, 50)
+
+
+def test_copy_make_border():
+    arr = onp.arange(2 * 3 * 3, dtype=onp.uint8).reshape(2, 3, 3)
+    out = mx.image.copyMakeBorder(arr, 1, 2, 3, 4, type=0, values=7)
+    assert out.shape == (5, 10, 3)
+    assert (out[0] == 7).all() and (out[:, :3] == 7).all()
+    assert onp.array_equal(out[1:3, 3:6], arr)
+    rep = mx.image.copyMakeBorder(arr, 1, 1, 1, 1, type=3)
+    assert onp.array_equal(rep[0, 1:4], arr[0])  # replicated edge row
+
+
+def test_resize_short(img_dir):
+    img = mx.image.imread(str(img_dir / "i0.png"))  # 40x50
+    out = mx.image.resize_short(img, 20)
+    assert out.shape == (20, 25, 3)
+    tall = mx.nd.array(onp.zeros((100, 20, 3), onp.uint8))
+    out = mx.image.resize_short(tall, 10)
+    assert out.shape == (50, 10, 3)
+
+
+def test_crops(img_dir):
+    img = mx.image.imread(str(img_dir / "i0.png"))
+    arr = img.asnumpy()
+    fc = mx.image.fixed_crop(img, 5, 7, 20, 22)
+    assert onp.array_equal(fc.asnumpy(), arr[7:29, 5:25])
+    fc2 = mx.image.fixed_crop(img, 0, 0, 20, 20, size=(10, 10))
+    assert fc2.shape == (10, 10, 3)
+    cc, (x0, y0, w, h) = mx.image.center_crop(img, (30, 24))
+    assert cc.shape == (24, 30, 3)
+    assert onp.array_equal(cc.asnumpy(), arr[y0:y0 + h, x0:x0 + w])
+    rc, (x0, y0, w, h) = mx.image.random_crop(img, (30, 24))
+    assert rc.shape == (24, 30, 3)
+    assert 0 <= x0 <= 50 - w and 0 <= y0 <= 40 - h
+    # crop larger than image scales down, then resizes back up
+    big, _ = mx.image.center_crop(img, (100, 100))
+    assert big.shape == (100, 100, 3)
+
+
+def test_random_size_crop(img_dir):
+    img = mx.image.imread(str(img_dir / "i1.png"))
+    out, (x0, y0, w, h) = mx.image.random_size_crop(
+        img, (32, 32), area=(0.5, 1.0), ratio=(0.9, 1.1))
+    assert out.shape == (32, 32, 3)
+    assert w * h >= 0.5 * 41 * 50 * 0.9  # area respected (ratio slack)
+
+
+def test_color_normalize(img_dir):
+    img = mx.image.imread(str(img_dir / "i0.png"))
+    mean = onp.array([10.0, 20.0, 30.0], onp.float32)
+    std = onp.array([2.0, 4.0, 5.0], onp.float32)
+    out = mx.image.color_normalize(img, mean, std)
+    exp = (img.asnumpy().astype(onp.float32) - mean) / std
+    assert onp.allclose(out.asnumpy(), exp, atol=1e-5)
+
+
+def test_imrotate_exact_angles():
+    rng = onp.random.RandomState(2)
+    img = rng.rand(1, 3, 17, 17).astype(onp.float32)
+    # 0 degrees is identity
+    out0 = mx.image.imrotate(mx.nd.array(img), 0.0).asnumpy()
+    assert onp.allclose(out0, img, atol=1e-5)
+    # 180 degrees == flip both axes (grid aligns exactly)
+    out180 = mx.image.imrotate(mx.nd.array(img), 180.0).asnumpy()
+    assert onp.allclose(out180, img[:, :, ::-1, ::-1], atol=1e-4)
+    # per-image angles in a batch
+    batch = onp.concatenate([img, img], 0)
+    out = mx.image.imrotate(mx.nd.array(batch),
+                            mx.nd.array([0.0, 180.0])).asnumpy()
+    assert onp.allclose(out[0], img[0], atol=1e-5)
+    assert onp.allclose(out[1], img[0, :, ::-1, ::-1], atol=1e-4)
+    # zoom flags
+    zi = mx.image.imrotate(img[0], 45.0, zoom_in=True)
+    zo = mx.image.imrotate(img[0], 45.0, zoom_out=True)
+    assert zi.shape == zo.shape == (3, 17, 17)
+    with pytest.raises(ValueError):
+        mx.image.imrotate(img[0], 45.0, zoom_in=True, zoom_out=True)
+    with pytest.raises(TypeError):
+        mx.image.imrotate(img.astype(onp.uint8)[0], 45.0)
+    with pytest.raises(TypeError):
+        mx.image.imrotate(img[0], onp.array([3.0, 4.0]))
+    out = mx.image.random_rotate(mx.nd.array(img), (-10, 10))
+    assert out.shape == img.shape
+
+
+def test_augmenter_determinism_and_dumps(img_dir):
+    img = mx.image.imread(str(img_dir / "i0.png")).asnumpy().astype(onp.float32)
+    flip = mx.image.HorizontalFlipAug(1.0)
+    assert onp.array_equal(flip(img), img[:, ::-1])
+    cast = mx.image.CastAug()
+    assert cast(img.astype(onp.uint8)).dtype == onp.float32
+    # hue with hue=0 is near-identity (the YIQ matrices are approximate
+    # inverses: per-element error ~1.4e-3, so ~1.0 absolute on 0-255 scale)
+    hue = mx.image.HueJitterAug(0.0)
+    assert onp.allclose(hue(img), img, atol=1.5)
+    # brightness bounds: output within (1±b) * src
+    random.seed(3)
+    br = mx.image.BrightnessJitterAug(0.5)
+    out = br(img)
+    assert (out <= img * 1.5 + 1e-3).all() and (out >= img * 0.5 - 1e-3).all()
+    # saturation of a gray image is identity
+    gray = onp.full((8, 8, 3), 77.0, onp.float32)
+    sat = mx.image.SaturationJitterAug(0.9)
+    assert onp.allclose(sat(gray), gray, atol=1e-3)
+    # dumps are JSON round-trippable
+    for aug in (flip, cast, hue, br, mx.image.ResizeAug(10),
+                mx.image.LightingAug(0.1, onp.ones(3), onp.eye(3))):
+        name, kw = json.loads(aug.dumps())
+        assert name == aug.__class__.__name__.lower()
+        assert isinstance(kw, dict)
+    seq = mx.image.SequentialAug([flip, cast])
+    assert seq(img).dtype == onp.float32
+    name, inner = seq.dumps()
+    assert name == "sequentialaug" and len(inner) == 2
+
+
+def test_create_augmenter_composition():
+    augs = mx.image.CreateAugmenter((3, 24, 24), resize=30, rand_crop=True,
+                                    rand_mirror=True, mean=True, std=True,
+                                    brightness=0.1, contrast=0.1,
+                                    saturation=0.1, hue=0.1, pca_noise=0.05,
+                                    rand_gray=0.1)
+    kinds = [a.__class__.__name__ for a in augs]
+    assert kinds == ["ResizeAug", "RandomCropAug", "HorizontalFlipAug",
+                     "CastAug", "ColorJitterAug", "HueJitterAug",
+                     "LightingAug", "RandomGrayAug", "ColorNormalizeAug"]
+    # rand_resize path
+    augs = mx.image.CreateAugmenter((3, 24, 24), rand_crop=True,
+                                    rand_resize=True)
+    assert augs[0].__class__.__name__ == "RandomSizedCropAug"
+    # default path has center crop
+    augs = mx.image.CreateAugmenter((3, 24, 24))
+    assert augs[0].__class__.__name__ == "CenterCropAug"
+    out = augs[0](onp.zeros((30, 30, 3), onp.uint8))
+    assert out.shape == (24, 24, 3)
+
+
+def test_image_iter_imglist(img_dir):
+    imglist = [[float(i % 2), f"i{i}.png"] for i in range(8)]
+    it = mx.image.ImageIter(batch_size=3, data_shape=(3, 32, 32),
+                            imglist=imglist, path_root=str(img_dir))
+    batch = next(it)
+    assert batch.data[0].shape == (3, 3, 32, 32)
+    assert batch.label[0].shape == (3,)
+    # pad epoch: 8 samples / bs 3 -> 3 batches, last pad=1
+    it.reset()
+    pads = [b.pad for b in it]
+    assert pads == [0, 0, 1]
+    # discard drops the ragged tail
+    it2 = mx.image.ImageIter(batch_size=3, data_shape=(3, 32, 32),
+                             imglist=imglist, path_root=str(img_dir),
+                             last_batch_handle="discard")
+    assert len(list(it2)) == 2
+    # roll_over carries the tail into the next epoch
+    it3 = mx.image.ImageIter(batch_size=3, data_shape=(3, 32, 32),
+                             imglist=imglist, path_root=str(img_dir),
+                             last_batch_handle="roll_over")
+    n1 = len(list(it3))
+    it3.reset()
+    n2 = len(list(it3))
+    assert n1 == 2 and n2 == 3  # 2 rolled samples + 8 = 10 -> 3 full batches
+
+
+def test_image_iter_lst_file(img_dir, tmp_path):
+    lst = tmp_path / "data.lst"
+    with open(lst, "w") as f:
+        for i in range(8):
+            f.write(f"{i}\t{i % 2}\ti{i}.png\n")
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                            path_imglist=str(lst), path_root=str(img_dir),
+                            shuffle=True)
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 28, 28)
+    labels = set()
+    it.reset()
+    for b in it:
+        labels.update(b.label[0].asnumpy().tolist())
+    assert labels == {0.0, 1.0}
+
+
+def test_image_iter_multilabel(img_dir):
+    imglist = [[[float(i), float(i + 1)], f"i{i}.png"] for i in range(8)]
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                            label_width=2, imglist=imglist,
+                            path_root=str(img_dir))
+    batch = next(it)
+    assert batch.label[0].shape == (4, 2)
+    lab = batch.label[0].asnumpy()
+    assert onp.allclose(lab[:, 1], lab[:, 0] + 1)
+
+
+def test_image_iter_rec(rec_files):
+    idx, rec = rec_files
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                            path_imgrec=rec, path_imgidx=idx, shuffle=True,
+                            rand_mirror=True)
+    seen = 0
+    for b in it:
+        seen += b.data[0].shape[0] - b.pad
+    assert seen == 10
+    # sequential .rec without index
+    it2 = mx.image.ImageIter(batch_size=5, data_shape=(3, 32, 32),
+                             path_imgrec=rec)
+    assert len(list(it2)) == 2
+    # num_parts partitioning
+    p0 = mx.image.ImageIter(batch_size=2, data_shape=(3, 32, 32),
+                            path_imgrec=rec, path_imgidx=idx,
+                            num_parts=2, part_index=0)
+    p1 = mx.image.ImageIter(batch_size=2, data_shape=(3, 32, 32),
+                            path_imgrec=rec, path_imgidx=idx,
+                            num_parts=2, part_index=1)
+    assert p0.num_image == p1.num_image == 5
+
+
+def test_image_iter_validation(img_dir):
+    with pytest.raises(ValueError):
+        mx.image.ImageIter(batch_size=2, data_shape=(1, 8, 8),
+                           imglist=[[0.0, "i0.png"]], path_root=str(img_dir))
+    with pytest.raises(AssertionError):
+        mx.image.ImageIter(batch_size=2, data_shape=(3, 8, 8))
+
+
+# ---------------------------------------------------------------------------
+# detection
+# ---------------------------------------------------------------------------
+
+def _det_imglist(n):
+    out = []
+    for i in range(n):
+        nobj = 1 + i % 3
+        lab = [4.0, 5.0, 0.0, 0.0]
+        for j in range(nobj):
+            lab += [float(j), 0.1, 0.2, 0.6, 0.7]
+        out.append([lab, f"i{i}.png"])
+    return out
+
+
+def test_det_hflip_label_math():
+    lab = onp.array([[0.0, 0.1, 0.2, 0.6, 0.7]], onp.float32)
+    img = onp.random.rand(8, 8, 3).astype(onp.float32)
+    aug = mx.image.DetHorizontalFlipAug(1.0)
+    out, lab2 = aug(img, lab.copy())
+    assert onp.array_equal(out, img[:, ::-1])
+    assert onp.allclose(lab2[0], [0.0, 0.4, 0.2, 0.9, 0.7], atol=1e-6)
+
+
+def test_det_random_pad_updates_labels():
+    random.seed(0)
+    lab = onp.array([[0.0, 0.25, 0.25, 0.75, 0.75]], onp.float32)
+    img = onp.full((40, 40, 3), 100, onp.uint8)
+    aug = mx.image.DetRandomPadAug(area_range=(2.0, 3.0), pad_val=(1, 2, 3))
+    out, lab2 = aug(img, lab.copy())
+    assert out.shape[0] > 40 and out.shape[1] > 40
+    # normalized box shrinks when canvas grows
+    assert (lab2[0, 3] - lab2[0, 1]) < 0.5
+    assert (lab2[0, 4] - lab2[0, 2]) < 0.5
+
+
+def test_det_random_crop_keeps_objects():
+    random.seed(1)
+    lab = onp.array([[0.0, 0.3, 0.3, 0.7, 0.7]], onp.float32)
+    img = onp.random.rand(60, 60, 3).astype(onp.float32)
+    aug = mx.image.DetRandomCropAug(min_object_covered=0.5,
+                                    area_range=(0.3, 1.0))
+    for _ in range(5):
+        out, lab2 = aug(img, lab.copy())
+        assert lab2.shape[1] == 5
+        assert (lab2[:, 1:] >= 0).all() and (lab2[:, 1:] <= 1).all()
+        assert (lab2[:, 3] > lab2[:, 1]).all()
+
+
+def test_det_borrow_and_select():
+    img = onp.random.rand(16, 16, 3).astype(onp.float32)
+    lab = onp.array([[0.0, 0.1, 0.1, 0.9, 0.9]], onp.float32)
+    borrow = mx.image.DetBorrowAug(mx.image.CastAug())
+    out, lab2 = borrow(img.astype(onp.uint8), lab)
+    assert out.dtype == onp.float32 and lab2 is lab
+    with pytest.raises(TypeError):
+        mx.image.DetBorrowAug("not an augmenter")
+    sel = mx.image.DetRandomSelectAug([borrow], skip_prob=0.0)
+    out, _ = sel(img.astype(onp.uint8), lab)
+    assert out.dtype == onp.float32
+    skip = mx.image.DetRandomSelectAug([], skip_prob=0.0)
+    assert skip.skip_prob == 1
+
+
+def test_create_det_augmenter():
+    augs = mx.image.CreateDetAugmenter((3, 64, 64), resize=70, rand_crop=0.5,
+                                       rand_pad=0.5, rand_mirror=True,
+                                       mean=True, std=True, brightness=0.1,
+                                       hue=0.1, pca_noise=0.05, rand_gray=0.1)
+    img = onp.random.rand(80, 90, 3).astype(onp.float32) * 255
+    lab = onp.array([[0.0, 0.2, 0.2, 0.8, 0.8],
+                     [1.0, 0.4, 0.4, 0.9, 0.9]], onp.float32)
+    for _ in range(3):
+        out, lab2 = img, lab.copy()
+        for aug in augs:
+            out, lab2 = aug(out, lab2)
+        assert out.shape == (64, 64, 3)
+        assert lab2.shape[1] == 5
+
+
+def test_image_det_iter(img_dir):
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 48, 48),
+                               imglist=_det_imglist(6),
+                               path_root=str(img_dir))
+    assert it.label_shape == (3, 5)
+    batch = next(it)
+    assert batch.data[0].shape == (2, 3, 48, 48)
+    assert batch.label[0].shape == (2, 3, 5)
+    lab = batch.label[0].asnumpy()
+    assert (lab[0, 1:] == -1).all()  # first sample has 1 object, rest padded
+    # reshape validation
+    it.reshape(data_shape=(3, 32, 32))
+    assert it.provide_data[0].shape == (2, 3, 32, 32)
+    with pytest.raises(ValueError):
+        it.reshape(label_shape=(1, 5))  # can't shrink
+    with pytest.raises(ValueError):
+        it.reshape(label_shape=(4, 7))  # width mismatch
+    it.reshape(label_shape=(5, 5))
+    assert it.label_shape == (5, 5)
+
+
+def test_image_det_iter_sync_and_draw(img_dir):
+    a = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                              imglist=_det_imglist(6),
+                              path_root=str(img_dir))
+    b = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                              imglist=_det_imglist(3),
+                              path_root=str(img_dir))
+    assert a.label_shape[0] >= b.label_shape[0]
+    b = a.sync_label_shape(b)
+    assert a.label_shape == b.label_shape
+    imgs = list(b.draw_next(color=(255, 0, 0)))
+    assert len(imgs) == 3 and imgs[0].shape == (32, 32, 3)
+
+
+def test_det_parse_label_errors(img_dir):
+    it = mx.image.ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                               imglist=_det_imglist(2),
+                               path_root=str(img_dir))
+    with pytest.raises(RuntimeError):
+        it._parse_label(onp.array([1.0, 2.0]))  # too short
+    with pytest.raises(RuntimeError):
+        # inconsistent width: (size - header) % obj_width != 0
+        it._parse_label(onp.array([2.0, 5.0, 0.0, 0.1, 0.1, 0.9, 0.9, 1.0]))
+    with pytest.raises(RuntimeError):
+        # no valid box (xmax <= xmin)
+        it._parse_label(onp.array([2.0, 5.0, 0.0, 0.9, 0.1, 0.1, 0.7]))
