@@ -63,6 +63,12 @@ def get_env(name: str, default: Any = None, typ: Optional[type] = None) -> Any:
         raise MXNetError(f"env var {name}={raw!r} is not a valid {t.__name__}") from e
 
 
+def data_dir() -> str:
+    """Data cache directory, $MXNET_HOME or ~/.mxnet
+    (ref python/mxnet/base.py data_dir)."""
+    return os.path.expanduser(get_env("MXNET_HOME", os.path.join("~", ".mxnet")))
+
+
 T = TypeVar("T")
 
 

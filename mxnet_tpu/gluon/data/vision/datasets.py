@@ -16,15 +16,41 @@ from typing import Optional
 
 import numpy as _onp
 
-from ....base import get_env
 from ..dataset import ArrayDataset
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100"]
 
 
 def _data_root():
-    return os.path.expanduser(
-        get_env("MXNET_HOME", os.path.join("~", ".mxnet")) + "/datasets")
+    from ....base import data_dir
+
+    return os.path.join(data_dir(), "datasets")
+
+
+def _fetch_missing(root: str, dirname: str, fnames) -> bool:
+    """Fetch missing dataset files from the gluon repo into ``root``.
+
+    Only attempted when MXNET_GLUON_REPO is set (ref downloads from the
+    Apache bucket unconditionally; this environment has no egress, so the
+    opt-in keeps the offline synthetic fallback instant). file:// repos
+    work — point MXNET_GLUON_REPO at a local tree laid out as
+    ``gluon/dataset/<dirname>/<fname>``. Returns True if all files exist
+    afterwards."""
+    paths = [os.path.join(root, f) for f in fnames]
+    if all(os.path.exists(p) for p in paths):
+        return True
+    if not os.environ.get("MXNET_GLUON_REPO"):
+        return False
+    from ...utils import download, _get_repo_file_url
+
+    try:
+        for f, p in zip(fnames, paths):
+            if not os.path.exists(p):
+                download(_get_repo_file_url(f"gluon/dataset/{dirname}", f),
+                         path=p, retries=1)
+    except Exception:
+        return False
+    return all(os.path.exists(p) for p in paths)
 
 
 def _synthetic_images(num: int, num_classes: int, shape, seed: int, channels=1,
@@ -68,6 +94,7 @@ class MNIST(ArrayDataset):
         super().__init__(data, label)
 
     def _load(self, root, train):
+        _fetch_missing(root, self._dirname, self._files[train])
         imgf, labf = (os.path.join(root, f) for f in self._files[train])
         if os.path.exists(imgf) and os.path.exists(labf):
             with gzip.open(labf, "rb") as f:
@@ -116,6 +143,7 @@ class CIFAR10(ArrayDataset):
 
     def _load(self, root, train):
         files = self._train_files if train else self._test_files
+        _fetch_missing(root, self._dirname, files)
         paths = [os.path.join(root, f) for f in files]
         if all(os.path.exists(p) for p in paths):
             parts = [self._read_batch(p) for p in paths]

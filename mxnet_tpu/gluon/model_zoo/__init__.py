@@ -2,6 +2,8 @@
 from . import vision
 from . import bert
 from . import ssd
+from . import model_store
+from .model_store import get_model_file
 from .bert import (BERTModel, BERTForPretrain, get_bert, bert_12_768_12,
                    bert_24_1024_16)
 from .ssd import SSD, ssd_512_resnet50_v1, ssd_300_resnet34_v1

@@ -1,0 +1,116 @@
+"""Pretrained-weight file store.
+
+Reference: python/mxnet/gluon/model_zoo/model_store.py (get_model_file at
+75, purge at 129, _model_sha1 table at 30-66).
+
+Deviations, by design: the reference ships a frozen sha1 table for weights
+hosted on the Apache S3 bucket — those are MXNet-format arrays and do not
+apply to this framework's .params files. Here the table maps every zoo
+model name to an *optional* sha1 (None = no published checksum yet) and is
+extendable at runtime via ``register_model`` — so a team hosting its own
+converted weights (``MXNET_GLUON_REPO=file:///srv/models`` works offline)
+gets cache+checksum+atomic-download behavior identical to the reference.
+Files are fetched as bare ``.params`` (no zip wrapper).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+from ... import base
+from ...base import MXNetError
+from ..utils import check_sha1, download, _get_repo_url
+
+__all__ = ["get_model_file", "purge", "register_model", "short_hash"]
+
+# every name the zoo factory knows; sha1 is filled in when weights are
+# published (register_model) — None means "fetch without checksum"
+_model_sha1 = {name: None for name in [
+    "alexnet", "lenet",
+    "densenet121", "densenet161", "densenet169", "densenet201",
+    "inceptionv3",
+    "mobilenet0.25", "mobilenet0.5", "mobilenet0.75", "mobilenet1.0",
+    "mobilenetv2_0.25", "mobilenetv2_0.5", "mobilenetv2_0.75",
+    "mobilenetv2_1.0",
+    "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+    "resnet152_v1",
+    "resnet18_v2", "resnet34_v2", "resnet50_v2", "resnet101_v2",
+    "resnet152_v2",
+    "squeezenet1.0", "squeezenet1.1",
+    "vgg11", "vgg11_bn", "vgg13", "vgg13_bn", "vgg16", "vgg16_bn",
+    "vgg19", "vgg19_bn",
+    "bert_base", "ssd_resnet50",
+]}
+
+_url_format = "{repo_url}gluon/models/{file_name}.params"
+
+
+def register_model(name: str, sha1: str | None = None):
+    """Register (or update) a model name in the store, optionally with the
+    sha1 of its published .params file."""
+    _model_sha1[name] = sha1
+
+
+def short_hash(name: str) -> str:
+    """First 8 hash chars used in the canonical file name
+    (ref model_store.py:70-73); '00000000' while no checksum is published."""
+    if name not in _model_sha1:
+        raise ValueError(f"Pretrained model for {name} is not available.")
+    sha1 = _model_sha1[name]
+    return sha1[:8] if sha1 else "00000000"
+
+
+def get_model_file(name: str,
+                   root: str = os.path.join("~", ".mxnet", "models")) -> str:
+    """Return the local path of a pretrained .params file, downloading from
+    the repo (MXNET_GLUON_REPO) on cache miss/mismatch
+    (ref model_store.py:75-127)."""
+    if root == os.path.join("~", ".mxnet", "models"):
+        root = os.path.join(base.data_dir(), "models")
+    file_name = f"{name}-{short_hash(name)}"
+    root = os.path.expanduser(root)
+    file_path = os.path.join(root, file_name + ".params")
+    sha1_hash = _model_sha1.get(name)
+    if os.path.exists(file_path):
+        if not sha1_hash or check_sha1(file_path, sha1_hash):
+            return file_path
+        logging.warning("Mismatch in the content of model file detected. "
+                        "Downloading again.")
+    else:
+        logging.info("Model file not found. Downloading to %s.", file_path)
+
+    os.makedirs(root, exist_ok=True)
+    url = _url_format.format(repo_url=_get_repo_url(), file_name=file_name)
+    try:
+        download(url, path=file_path, overwrite=True, sha1_hash=sha1_hash)
+    except Exception as e:
+        raise MXNetError(
+            f"Failed to fetch pretrained weights for '{name}' from {url}: "
+            f"{e}. Host weights at $MXNET_GLUON_REPO/gluon/models/ "
+            f"(file:// URLs work offline) or place the file at "
+            f"{file_path}.") from e
+    if sha1_hash and not check_sha1(file_path, sha1_hash):
+        raise ValueError("Downloaded file has different hash. "
+                         "Please try again.")
+    return file_path
+
+
+def load_pretrained(net, name: str, root=None, ctx=None):
+    """Shared ``pretrained=True`` path for zoo constructors: resolve the
+    weight file via the store and load it onto ``ctx``."""
+    path = get_model_file(name, root) if root else get_model_file(name)
+    net.load_parameters(path, ctx=ctx)
+    return net
+
+
+def purge(root: str = os.path.join("~", ".mxnet", "models")):
+    """Delete every cached .params under ``root``
+    (ref model_store.py:129-140)."""
+    if root == os.path.join("~", ".mxnet", "models"):
+        root = os.path.join(base.data_dir(), "models")
+    root = os.path.expanduser(root)
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

@@ -29,10 +29,10 @@ class AlexNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def alexnet(pretrained=False, ctx=None, **kwargs):
+def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
     net = AlexNet(**kwargs)
     if pretrained:
-        from ....base import MXNetError
+        from ..model_store import load_pretrained
 
-        raise MXNetError("pretrained weights unavailable: no network egress")
+        load_pretrained(net, "alexnet", root, ctx)
     return net

@@ -1,7 +1,6 @@
 """DenseNet 121/161/169/201 (ref: python/mxnet/gluon/model_zoo/vision/densenet.py)."""
 from __future__ import annotations
 
-from ....base import MXNetError
 from ....numpy import concatenate
 from ... import nn
 from ...block import HybridBlock
@@ -64,11 +63,14 @@ class DenseNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def _get(num, pretrained=False, **kw):
-    if pretrained:
-        raise MXNetError("pretrained weights unavailable: no network egress")
+def _get(num, pretrained=False, ctx=None, root=None, **kw):
     init, growth, config = _SPEC[num]
-    return DenseNet(init, growth, config, **kw)
+    net = DenseNet(init, growth, config, **kw)
+    if pretrained:
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, f"densenet{num}", root, ctx)
+    return net
 
 
 def densenet121(**kw):

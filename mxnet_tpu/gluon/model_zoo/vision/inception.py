@@ -1,7 +1,6 @@
 """Inception V3 (ref: python/mxnet/gluon/model_zoo/vision/inception.py)."""
 from __future__ import annotations
 
-from ....base import MXNetError
 from ....numpy import concatenate
 from ... import nn
 from ...block import HybridBlock
@@ -109,7 +108,10 @@ class Inception3(HybridBlock):
         return self.output(self.features(x))
 
 
-def inception_v3(pretrained=False, **kw):
+def inception_v3(pretrained=False, ctx=None, root=None, **kw):
+    net = Inception3(**kw)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable: no network egress")
-    return Inception3(**kw)
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, "inceptionv3", root, ctx)
+    return net

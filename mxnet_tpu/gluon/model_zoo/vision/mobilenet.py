@@ -6,7 +6,6 @@ depthwise_convolution-inl.h).
 """
 from __future__ import annotations
 
-from ....base import MXNetError
 from ... import nn
 from ...block import HybridBlock
 
@@ -99,17 +98,31 @@ class MobileNetV2(HybridBlock):
         return self.output(self.features(x))
 
 
-def get_mobilenet(multiplier, pretrained=False, **kwargs):
+def _version_suffix(multiplier) -> str:
+    """Store-name suffix for a width multiplier: 1.0->'1.0', 0.5->'0.5',
+    0.75->'0.75', 0.25->'0.25' (the model_store key set)."""
+    return str(float(multiplier))
+
+
+def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
+                  **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable: no network egress")
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, f"mobilenet{_version_suffix(multiplier)}",
+                        root, ctx)
     return net
 
 
-def get_mobilenet_v2(multiplier, pretrained=False, **kwargs):
+def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
+                     **kwargs):
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable: no network egress")
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, f"mobilenetv2_{_version_suffix(multiplier)}",
+                        root, ctx)
     return net
 
 

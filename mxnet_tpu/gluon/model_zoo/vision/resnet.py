@@ -225,7 +225,8 @@ _VERSIONS = [(ResNetV1, BasicBlockV1, BottleneckV1),
              (ResNetV2, BasicBlockV2, BottleneckV2)]
 
 
-def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
+def get_resnet(version, num_layers, pretrained=False, ctx=None,
+               root=None, **kwargs):
     if num_layers not in _SPEC:
         raise MXNetError(f"invalid resnet depth {num_layers}; options {sorted(_SPEC)}")
     if version not in (1, 2):
@@ -235,8 +236,9 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
     block = basic if block_type == "basic_block" else bottleneck
     net = resnet_class(block, layers, channels, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable: no network egress; "
-                         "load_parameters from a local file instead")
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, f"resnet{num_layers}_v{version}", root, ctx)
     return net
 
 

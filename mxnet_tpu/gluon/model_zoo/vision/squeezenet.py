@@ -55,13 +55,19 @@ class SqueezeNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def squeezenet1_0(pretrained=False, **kw):
+def squeezenet1_0(pretrained=False, ctx=None, root=None, **kw):
+    net = SqueezeNet("1.0", **kw)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable: no network egress")
-    return SqueezeNet("1.0", **kw)
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, "squeezenet1.0", root, ctx)
+    return net
 
 
-def squeezenet1_1(pretrained=False, **kw):
+def squeezenet1_1(pretrained=False, ctx=None, root=None, **kw):
+    net = SqueezeNet("1.1", **kw)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable: no network egress")
-    return SqueezeNet("1.1", **kw)
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, "squeezenet1.1", root, ctx)
+    return net

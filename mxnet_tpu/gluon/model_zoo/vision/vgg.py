@@ -34,13 +34,17 @@ class VGG(HybridBlock):
         return self.output(self.features(x))
 
 
-def get_vgg(num_layers, pretrained=False, **kwargs):
+def get_vgg(num_layers, pretrained=False, ctx=None, root=None,
+            **kwargs):
     if num_layers not in _SPEC:
         raise MXNetError(f"invalid vgg depth {num_layers}")
     layers, filters = _SPEC[num_layers]
     net = VGG(layers, filters, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable: no network egress")
+        from ..model_store import load_pretrained
+
+        bn = "_bn" if kwargs.get("batch_norm") else ""
+        load_pretrained(net, f"vgg{num_layers}{bn}", root, ctx)
     return net
 
 
