@@ -1,0 +1,55 @@
+# Build/test entry points for the mxtpu native runtime and test suite.
+# The analogue of the reference's ci/docker/runtime_functions.sh build
+# configs, including the sanitizer builds (ref sanitizer/asan profiles).
+#
+#   make native       release libmxtpu.so (what mxnet_tpu._native builds JIT)
+#   make native-test  plain native unit-test binary + run
+#   make asan         native tests under AddressSanitizer
+#   make tsan         native tests under ThreadSanitizer
+#   make test         python suite on the 8-device virtual CPU mesh
+#   make ci           everything CI runs
+
+CXX      ?= g++
+CXXFLAGS ?= -std=c++17 -O2 -fPIC -Wall -pthread
+SRC      := $(wildcard src/mxtpu/*.cc)
+TESTSRC  := src/mxtpu/tests/test_native.cc
+BUILD    := build
+
+.PHONY: native native-test asan tsan test ci clean
+
+native: $(BUILD)/libmxtpu.so
+
+$(BUILD)/libmxtpu.so: $(SRC) src/mxtpu/engine.h
+	@mkdir -p $(BUILD)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $(SRC)
+
+$(BUILD)/test_native: $(SRC) $(TESTSRC) src/mxtpu/engine.h
+	@mkdir -p $(BUILD)
+	$(CXX) $(CXXFLAGS) -o $@ $(SRC) $(TESTSRC)
+
+native-test: $(BUILD)/test_native
+	$(BUILD)/test_native
+
+$(BUILD)/test_native_asan: $(SRC) $(TESTSRC) src/mxtpu/engine.h
+	@mkdir -p $(BUILD)
+	$(CXX) $(CXXFLAGS) -O1 -g -fsanitize=address -fno-omit-frame-pointer \
+		-o $@ $(SRC) $(TESTSRC)
+
+asan: $(BUILD)/test_native_asan
+	ASAN_OPTIONS=detect_leaks=1 $(BUILD)/test_native_asan
+
+$(BUILD)/test_native_tsan: $(SRC) $(TESTSRC) src/mxtpu/engine.h
+	@mkdir -p $(BUILD)
+	$(CXX) $(CXXFLAGS) -O1 -g -fsanitize=thread -fno-omit-frame-pointer \
+		-o $@ $(SRC) $(TESTSRC)
+
+tsan: $(BUILD)/test_native_tsan
+	$(BUILD)/test_native_tsan
+
+test:
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -q
+
+ci: native native-test asan tsan test
+
+clean:
+	rm -rf $(BUILD)

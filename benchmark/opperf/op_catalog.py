@@ -1,0 +1,235 @@
+"""Operator catalog for opperf: category -> op name -> input recipe.
+
+Reference: benchmark/opperf/nd_operations/*.py (unary_operators.py,
+binary_operators.py, gemm_operators.py, reduction_operators.py, ...) each
+hand-build op lists; here one declarative table drives the whole harness.
+Ops are resolved against the live ``mx.np``/``mx.npx``/``mx.nd`` registries
+at run time — a missing name is reported as skipped, not an error, so the
+catalog can deliberately name the full reference surface.
+
+Input recipes are callables ``(dtype) -> (args, kwargs)`` evaluated fresh
+per op so each benchmark owns its device buffers.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+DEFAULT_SHAPE = (1024, 1024)
+LARGE_K = 2**18
+
+
+def _arr(shape=DEFAULT_SHAPE, dtype="float32", positive=False):
+    def make(mx):
+        rng = onp.random.RandomState(0)
+        a = rng.uniform(0.5 if positive else -1.0, 1.0,
+                        shape).astype(dtype)
+        return mx.np.array(a)
+    return make
+
+
+def _iarr(shape=DEFAULT_SHAPE, hi=100):
+    def make(mx):
+        return mx.np.array(
+            onp.random.RandomState(0).randint(0, hi, shape).astype("int32"))
+    return make
+
+
+UNARY = ["abs", "arccos", "arccosh", "arcsin", "arcsinh", "arctan",
+         "arctanh", "cbrt", "ceil", "cos", "cosh", "degrees", "exp",
+         "expm1", "fix", "floor", "log", "log10", "log1p", "log2",
+         "negative", "radians", "reciprocal", "rint", "sign", "sin",
+         "sinh", "sqrt", "square", "tan", "tanh", "trunc"]
+
+BINARY = ["add", "subtract", "multiply", "divide", "mod", "power",
+          "maximum", "minimum", "hypot", "arctan2", "copysign",
+          "fmax", "fmin", "fmod", "logaddexp"]
+
+COMPARISON = ["equal", "not_equal", "greater", "greater_equal", "less",
+              "less_equal", "logical_and", "logical_or", "logical_xor"]
+
+REDUCTION = ["sum", "prod", "mean", "std", "var", "min", "max",
+             "argmin", "argmax", "nansum", "nanprod"]
+
+SORT_SEARCH = ["sort", "argsort", "nonzero", "where", "unique"]
+
+MANIPULATION = ["transpose", "flip", "reshape", "ravel", "squeeze",
+                "expand_dims", "roll", "rot90", "tile", "repeat",
+                "concatenate", "stack", "split", "clip", "tril", "triu"]
+
+LINALG = ["dot", "matmul", "tensordot", "einsum", "linalg.norm",
+          "linalg.svd", "linalg.cholesky", "linalg.inv", "linalg.det",
+          "linalg.eigh", "linalg.solve", "linalg.slogdet"]
+
+RANDOM = ["random.uniform", "random.normal", "random.randint",
+          "random.choice", "random.shuffle", "random.gamma",
+          "random.exponential", "random.laplace", "random.beta"]
+
+NN_ACTIVATION = ["sigmoid", "relu", "leaky_relu", "softmax", "log_softmax"]
+# act_type-parameterized forms of npx.activation / npx.leaky_relu
+NN_ACT_TYPED = {"gelu": ("leaky_relu", {"act_type": "gelu"}),
+                "elu": ("leaky_relu", {"act_type": "elu"}),
+                "selu": ("leaky_relu", {"act_type": "selu"}),
+                "softsign": ("activation", {"act_type": "softsign"}),
+                "tanh_act": ("activation", {"act_type": "tanh"})}
+
+
+def build_catalog(mx):
+    """Materialize the category -> op -> (callable, args, kwargs) map."""
+    np_ = mx.np
+    npx = mx.npx
+
+    def np_op(name):
+        obj = np_
+        for part in name.split("."):
+            obj = getattr(obj, part, None)
+            if obj is None:
+                return None
+        return obj
+
+    cat = {}
+
+    cat["unary"] = {n: (np_op(n), [_arr(positive=True)], {})
+                    for n in UNARY}
+    cat["binary_broadcast"] = {
+        n: (np_op(n), [_arr(positive=True), _arr((1024, 1), positive=True)],
+            {})
+        for n in BINARY}
+    cat["binary_elementwise"] = {
+        n: (np_op(n), [_arr(positive=True), _arr(positive=True)], {})
+        for n in BINARY}
+    cat["comparison"] = {n: (np_op(n), [_arr(), _arr()], {})
+                         for n in COMPARISON}
+    cat["reduction"] = {n: (np_op(n), [_arr()], {}) for n in REDUCTION}
+    cat["sort_search"] = {n: (np_op(n), [_arr((LARGE_K,))], {})
+                          for n in SORT_SEARCH}
+    cat["sort_search"]["where"] = (np_op("where"),
+                                   [_arr((LARGE_K,)), _arr((LARGE_K,)),
+                                    _arr((LARGE_K,))], {})
+    cat["sort_search"]["topk"] = (getattr(npx, "topk", None),
+                                  [_arr((LARGE_K,))], {"k": 64})
+
+    man = {}
+    for n in MANIPULATION:
+        fn = np_op(n)
+        if n == "reshape":
+            man[n] = (lambda a, _fn=fn: _fn(a, (-1,)), [_arr()], {})
+        elif n == "expand_dims":
+            man[n] = (fn, [_arr()], {"axis": 0})
+        elif n == "roll":
+            man[n] = (fn, [_arr()], {"shift": 17})
+        elif n == "tile":
+            man[n] = (fn, [_arr((256, 256))], {"reps": (4, 4)})
+        elif n == "repeat":
+            man[n] = (fn, [_arr((256, 256))], {"repeats": 4})
+        elif n in ("concatenate", "stack"):
+            man[n] = (lambda seq, _fn=fn: _fn(list(seq)),
+                      [lambda mx: (mx.np.array(onp.ones((512, 512), "f4")),
+                                   mx.np.array(onp.ones((512, 512), "f4")))],
+                      {})
+        elif n == "split":
+            man[n] = (fn, [_arr()], {"indices_or_sections": 4})
+        elif n == "clip":
+            man[n] = (fn, [_arr()], {"a_min": -0.5, "a_max": 0.5})
+        else:
+            man[n] = (fn, [_arr()], {})
+    cat["manipulation"] = man
+
+    lin = {}
+    for n in LINALG:
+        fn = np_op(n)
+        if n == "tensordot":
+            lin[n] = (fn, [_arr(), _arr()], {"axes": 1})
+        elif n == "einsum":
+            lin[n] = (lambda a, b, _fn=fn: _fn("ij,jk->ik", a, b),
+                      [_arr(), _arr()], {})
+        elif n in ("linalg.cholesky", "linalg.inv", "linalg.eigh",
+                   "linalg.det", "linalg.slogdet", "linalg.solve"):
+            def spd(mx, _n=n):
+                rng = onp.random.RandomState(0)
+                a = rng.rand(256, 256).astype("float32")
+                return mx.np.array(a @ a.T + 256 * onp.eye(256, dtype="f4"))
+            if n == "linalg.solve":
+                lin[n] = (fn, [spd, _arr((256, 16))], {})
+            else:
+                lin[n] = (fn, [spd], {})
+        elif n == "linalg.svd":
+            lin[n] = (fn, [_arr((256, 256))], {})
+        elif n == "linalg.norm":
+            lin[n] = (fn, [_arr()], {})
+        else:
+            lin[n] = (fn, [_arr(), _arr()], {})
+    cat["gemm_linalg"] = lin
+
+    rnd = {}
+    for n in RANDOM:
+        fn = np_op(n)
+        if n == "random.randint":
+            rnd[n] = (fn, [], {"low": 0, "high": 100, "size": DEFAULT_SHAPE})
+        elif n == "random.choice":
+            rnd[n] = (fn, [], {"a": 1024, "size": (LARGE_K,)})
+        elif n == "random.shuffle":
+            rnd[n] = (fn, [_arr((LARGE_K,))], {})
+        elif n == "random.beta":
+            rnd[n] = (lambda _fn=fn: _fn(2.0, 3.0, size=DEFAULT_SHAPE),
+                      [], {})
+        elif n == "random.gamma":
+            rnd[n] = (lambda _fn=fn: _fn(2.0, size=DEFAULT_SHAPE), [], {})
+        elif n == "random.laplace":
+            rnd[n] = (lambda _fn=fn: _fn(0.0, 1.0, size=DEFAULT_SHAPE),
+                      [], {})
+        else:
+            rnd[n] = (fn, [], {"size": DEFAULT_SHAPE})
+    cat["random"] = rnd
+
+    act = {}
+    for n in NN_ACTIVATION:
+        fn = getattr(npx, n, None) or np_op(n)
+        if n in ("softmax", "log_softmax"):
+            act[n] = (fn, [_arr()], {"axis": -1})
+        else:
+            act[n] = (fn, [_arr()], {})
+    for n, (base, kw) in NN_ACT_TYPED.items():
+        act[n] = (getattr(npx, base, None), [_arr()], kw)
+    cat["nn_activation"] = act
+
+    cat["nn_basic"] = {
+        "fully_connected": (
+            getattr(npx, "fully_connected", None),
+            [_arr((64, 1024)), _arr((512, 1024)), _arr((512,))],
+            {"num_hidden": 512}),
+        "batch_norm": (
+            getattr(npx, "batch_norm", None),
+            [_arr((32, 64, 56, 56)), _arr((64,), positive=True),
+             _arr((64,)), _arr((64,)), _arr((64,), positive=True)],
+            {}),
+        "layer_norm": (
+            getattr(npx, "layer_norm", None),
+            [_arr((64, 1024)), _arr((1024,), positive=True), _arr((1024,))],
+            {"axis": -1}),
+        "dropout": (getattr(npx, "dropout", None), [_arr()], {"p": 0.5}),
+        "embedding": (
+            getattr(npx, "embedding", None),
+            [_iarr((64, 128), hi=1000), _arr((1000, 256))],
+            {"input_dim": 1000, "output_dim": 256}),
+    }
+
+    cat["nn_conv"] = {
+        "convolution": (
+            getattr(npx, "convolution", None),
+            [_arr((32, 64, 56, 56)), _arr((64, 64, 3, 3)), _arr((64,))],
+            {"kernel": (3, 3), "num_filter": 64, "pad": (1, 1)}),
+        "pooling_max": (
+            getattr(npx, "pooling", None),
+            [_arr((32, 64, 56, 56))],
+            {"kernel": (2, 2), "pool_type": "max", "stride": (2, 2)}),
+        "pooling_avg": (
+            getattr(npx, "pooling", None),
+            [_arr((32, 64, 56, 56))],
+            {"kernel": (2, 2), "pool_type": "avg", "stride": (2, 2)}),
+        "deconvolution": (
+            getattr(npx, "deconvolution", None),
+            [_arr((32, 64, 28, 28)), _arr((64, 64, 2, 2))],
+            {"kernel": (2, 2), "num_filter": 64, "stride": (2, 2)}),
+    }
+
+    return cat

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""opperf — per-operator latency harness.
+
+Reference: benchmark/opperf/opperf.py (run_all_mxnet_operator_benchmarks,
+CLI at the bottom) + utils/benchmark_utils.py run_performance_test. The
+reference profiles each imperative op through the engine with
+warmup/runs; here each op is timed through this framework's imperative
+dispatch (NDArray -> jax), with a device sync (``wait_to_read``) draining
+the async queue only at the loop edges — same discipline as the
+reference's ``mx.nd.waitall`` bracketing.
+
+Forward is timed alone; then forward+backward (autograd tape -> vjp) and
+backward is reported as the difference, mirroring the reference's
+fwd/bwd split from profiler output.
+
+Usage:
+  python benchmark/opperf/opperf.py                       # all categories
+  python benchmark/opperf/opperf.py --categories unary,reduction
+  python benchmark/opperf/opperf.py --ops add,dot,conv2d
+  python benchmark/opperf/opperf.py -f md -o results.md   # markdown table
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx  # noqa: E402
+from benchmark.opperf.op_catalog import build_catalog  # noqa: E402
+
+
+def _materialize(spec, arg_makers, kwargs):
+    args = []
+    for m in arg_makers:
+        v = m(mx) if callable(m) else m
+        args.append(v)
+    return args, dict(kwargs)
+
+
+def _sync(v):
+    if isinstance(v, (tuple, list)):
+        for e in v:
+            _sync(e)
+    elif hasattr(v, "wait_to_read"):
+        v.wait_to_read()
+    elif hasattr(v, "block_until_ready"):
+        v.block_until_ready()
+
+
+def time_forward(fn, args, kwargs, warmup, runs):
+    for _ in range(warmup):
+        out = fn(*args, **kwargs)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = fn(*args, **kwargs)
+    _sync(out)
+    return (time.perf_counter() - t0) / runs * 1000.0
+
+
+def time_forward_backward(fn, args, kwargs, warmup, runs):
+    """Returns avg fwd+bwd ms, or None when the op isn't differentiable."""
+    from mxnet_tpu import autograd
+
+    nd_args = [a for a in args
+               if isinstance(a, mx.nd.NDArray) and "float" in str(a.dtype)]
+    if not nd_args:
+        return None
+
+    def once():
+        for a in nd_args:
+            a.attach_grad()
+        with autograd.record():
+            out = fn(*args, **kwargs)
+            if isinstance(out, (tuple, list)):
+                out = out[0]
+            loss = out.sum() if "float" in str(out.dtype) else None
+        if loss is None:
+            return None
+        loss.backward()
+        return nd_args[0].grad
+
+    try:
+        for _ in range(warmup):
+            g = once()
+            if g is None:
+                return None
+        _sync(g)
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            g = once()
+        _sync(g)
+        return (time.perf_counter() - t0) / runs * 1000.0
+    except Exception:
+        return None
+
+
+def run_op_benchmark(name, fn, arg_makers, kwargs, warmup, runs):
+    args, kw = _materialize(name, arg_makers, kwargs)
+    res = {"operator": name}
+    res["avg_forward_time_ms"] = round(
+        time_forward(fn, args, kw, warmup, runs), 4)
+    total = time_forward_backward(fn, args, kw, max(1, warmup // 2),
+                                  max(1, runs // 2))
+    if total is not None:
+        res["avg_backward_time_ms"] = round(
+            max(0.0, total - res["avg_forward_time_ms"]), 4)
+    return res
+
+
+def run_benchmarks(categories=None, ops=None, warmup=10, runs=50,
+                   verbose=True):
+    """Run the catalog; returns {category: [per-op result dicts]} plus a
+    'skipped' list of ops the registry doesn't expose."""
+    catalog = build_catalog(mx)
+    results, skipped = {}, []
+    for cat, table in catalog.items():
+        if categories and cat not in categories:
+            continue
+        out = []
+        for name, (fn, arg_makers, kwargs) in table.items():
+            if ops and name not in ops:
+                continue
+            if fn is None:
+                skipped.append(f"{cat}/{name}")
+                continue
+            try:
+                r = run_op_benchmark(name, fn, arg_makers, kwargs,
+                                     warmup, runs)
+            except Exception as e:
+                skipped.append(f"{cat}/{name}: {type(e).__name__}: {e}")
+                continue
+            out.append(r)
+            if verbose:
+                bwd = r.get("avg_backward_time_ms", "-")
+                print(f"[{cat}] {name}: fwd "
+                      f"{r['avg_forward_time_ms']} ms, bwd {bwd} ms",
+                      flush=True)
+        if out:
+            results[cat] = out
+    if skipped:
+        results["skipped"] = skipped
+    return results
+
+
+def to_markdown(results):
+    lines = []
+    for cat, rows in results.items():
+        if cat == "skipped":
+            continue
+        lines.append(f"## {cat}\n")
+        lines.append("| operator | fwd (ms) | bwd (ms) |")
+        lines.append("|---|---|---|")
+        for r in rows:
+            lines.append(f"| {r['operator']} | {r['avg_forward_time_ms']} "
+                         f"| {r.get('avg_backward_time_ms', '-')} |")
+        lines.append("")
+    for s in results.get("skipped", []):
+        lines.append(f"- skipped: {s}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--categories", default=None,
+                   help="comma-separated category filter")
+    p.add_argument("--ops", default=None,
+                   help="comma-separated op-name filter")
+    p.add_argument("--warmup", type=int, default=10)
+    p.add_argument("--runs", type=int, default=50)
+    p.add_argument("-f", "--output-format", choices=("json", "md"),
+                   default="json")
+    p.add_argument("-o", "--output-file", default=None)
+    p.add_argument("-q", "--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    cats = args.categories.split(",") if args.categories else None
+    ops = args.ops.split(",") if args.ops else None
+    results = run_benchmarks(cats, ops, args.warmup, args.runs,
+                             verbose=not args.quiet)
+    payload = (to_markdown(results) if args.output_format == "md"
+               else json.dumps(results, indent=1))
+    if args.output_file:
+        with open(args.output_file, "w") as f:
+            f.write(payload)
+        print(f"wrote {args.output_file}")
+    else:
+        print(payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -224,27 +224,34 @@ void Engine::OnComplete(Opr* op) {
 }
 
 std::string Engine::WaitForVar(Var* var) {
-  std::mutex m;
-  std::condition_variable cv;
-  bool done = false;
-  std::string err;
+  // The signal state is heap-shared with the worker: a stack condvar
+  // would let this frame (and the condvar) die while the worker is still
+  // inside notify_one — a use-after-free TSAN catches. The worker's
+  // shared_ptr copy keeps the state alive past the waiter's return.
+  struct WaitState {
+    std::mutex m;
+    std::condition_variable cv;
+    bool done = false;
+    std::string err;
+  };
+  auto st = std::make_shared<WaitState>();
   Push(
-      [&](bool) -> std::string {
+      [st, var](bool) -> std::string {
         {
           std::lock_guard<std::mutex> lk(var->mu);
-          if (var->exc) err = *var->exc;
+          if (var->exc) st->err = *var->exc;
         }
         {
-          std::lock_guard<std::mutex> lk(m);
-          done = true;
+          std::lock_guard<std::mutex> lk(st->m);
+          st->done = true;
+          st->cv.notify_one();
         }
-        cv.notify_one();
         return "";
       },
       {var}, {}, /*priority=*/1 << 20, /*always_run=*/true);
-  std::unique_lock<std::mutex> lk(m);
-  cv.wait(lk, [&] { return done; });
-  return err;
+  std::unique_lock<std::mutex> lk(st->m);
+  st->cv.wait(lk, [&] { return st->done; });
+  return st->err;
 }
 
 std::string Engine::WaitForAll() {

@@ -1,0 +1,201 @@
+"""Exception propagation at the Python dispatch layer + thread safety.
+
+Reference: tests/python/unittest/test_exc_handling.py (imperative errors
+surface at the sync point and do not poison later work) and the
+thread-safety suites under tests/cpp/engine. Design difference, asserted
+here: this framework raises eagerly at dispatch (XLA validates shapes at
+trace time) instead of deferring to wait_to_read — but the recovery
+guarantees (failed op leaves the runtime healthy, failed IO record
+identifies itself, engine errors rethrow at wait) match the reference.
+"""
+import threading
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# dispatch-layer exceptions
+# ---------------------------------------------------------------------------
+
+def test_imperative_shape_error_raises():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.ones((4, 5))
+    with pytest.raises(Exception):
+        mx.nd.dot(a, b)
+
+
+def test_runtime_healthy_after_failed_op():
+    """Analogue of ref test_exc_post_fail: a failed op must not poison
+    subsequent independent work."""
+    a = mx.nd.ones((2, 3))
+    with pytest.raises(Exception):
+        mx.nd.dot(a, mx.nd.ones((4, 5)))
+    # independent compute still works, same arrays still readable
+    c = mx.nd.dot(a, mx.nd.ones((3, 2)))
+    assert c.asnumpy().shape == (2, 2)
+    assert float(a.sum().asnumpy()) == 6.0
+
+
+def test_exc_inside_autograd_recovery():
+    a = mx.nd.ones((2, 2))
+    a.attach_grad()
+    with pytest.raises(Exception):
+        with mx.autograd.record():
+            mx.nd.dot(a, mx.nd.ones((3, 3)))
+    # the tape is reusable afterwards
+    with mx.autograd.record():
+        loss = (a * a).sum()
+    loss.backward()
+    assert onp.allclose(a.grad.asnumpy(), 2 * onp.ones((2, 2)))
+
+
+def test_exc_gluon_deferred_init_shape_mismatch():
+    """Ref test_exc_gluon: bad input dim surfaces as a Python exception,
+    and the block stays usable with the correct dim."""
+    net = mx.gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    with pytest.raises(Exception):
+        net(mx.nd.ones((2, 5)))
+    out = net(mx.nd.ones((2, 8)))
+    assert out.shape == (2, 4)
+
+
+def test_multiple_waits_after_engine_error():
+    """Engine-path async error rethrows at EVERY wait on the poisoned var
+    (ref test_exc_multiple_waits)."""
+    from mxnet_tpu import engine
+
+    eng = engine.get()
+    var = eng.new_var()
+
+    def boom():
+        raise RuntimeError("scheduled failure")
+
+    eng.push(boom, write=[var])
+    with pytest.raises(Exception):
+        eng.wait_for_var(var)
+    eng.delete_var(var)
+    # engine continues to run new work afterwards
+    var2 = eng.new_var()
+    done = []
+    eng.push(lambda: done.append(1), write=[var2])
+    eng.wait_for_var(var2)
+    eng.delete_var(var2)
+    assert done == [1]
+
+
+def test_broken_record_identifies_itself(tmp_path):
+    """ImageIter raises with the offending index/filename in the message
+    (ref image.py ImageIter.imdecode locate())."""
+    from mxnet_tpu.io import recordio
+
+    idx, rec = str(tmp_path / "b.idx"), str(tmp_path / "b.rec")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    w.write_idx(0, recordio.pack(recordio.IRHeader(0, 0.0, 0, 0),
+                                 b"not an image"))
+    w.close()
+    it = mx.image.ImageIter(batch_size=1, data_shape=(3, 8, 8),
+                            path_imgrec=rec, path_imgidx=idx)
+    with pytest.raises(RuntimeError, match="Broken image"):
+        next(it)
+
+
+# ---------------------------------------------------------------------------
+# thread safety
+# ---------------------------------------------------------------------------
+
+def _run_threads(fn, n=8):
+    errs = []
+
+    def wrapped(i):
+        try:
+            fn(i)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=wrapped, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+
+
+def test_concurrent_imperative_ops():
+    """N threads hammer independent imperative chains on shared inputs;
+    every result must be exact."""
+    base = mx.nd.array(onp.arange(64, dtype="f4").reshape(8, 8))
+    results = [None] * 8
+
+    def work(i):
+        acc = base
+        for _ in range(20):
+            acc = acc + i
+        results[i] = acc.asnumpy()
+
+    _run_threads(work)
+    for i, r in enumerate(results):
+        assert onp.allclose(r, base.asnumpy() + 20 * i)
+
+
+def test_concurrent_hybridized_forward():
+    """Concurrent forwards through one jitted CachedOp give identical
+    results — including when threads race the FIRST trace (the
+    _CachedOp trace lock serializes the parameter->tracer swap)."""
+    for trial in range(5):
+        net = mx.gluon.nn.Dense(16, in_units=32)
+        net.initialize()
+        net.hybridize()
+        x = mx.nd.array(
+            onp.random.RandomState(trial).rand(4, 32).astype("f4"))
+        results = [None] * 8
+
+        def work(i):
+            results[i] = net(x).asnumpy()
+
+        _run_threads(work)  # cold start: all 8 race the trace
+        expected = net(x).asnumpy()
+        for r in results:
+            assert onp.allclose(r, expected, atol=1e-6)
+
+
+def test_concurrent_autograd_scopes():
+    """autograd.record() state is thread-local (ref test_thread_local.py):
+    recording in one thread must not leak into another."""
+    flags = {}
+
+    def recorder(i):
+        if i % 2 == 0:
+            with mx.autograd.record():
+                flags[i] = mx.autograd.is_recording()
+        else:
+            flags[i] = mx.autograd.is_recording()
+
+    _run_threads(recorder)
+    for i, v in flags.items():
+        assert v == (i % 2 == 0), flags
+
+
+def test_concurrent_engine_pushes():
+    """Many threads pushing engine work on disjoint vars all complete."""
+    from mxnet_tpu import engine
+
+    eng = engine.get()
+    out = [0] * 32
+
+    def work(i):
+        var = eng.new_var()
+
+        def job(j=i):
+            out[j] = j * j
+
+        eng.push(job, write=[var])
+        eng.wait_for_var(var)
+        eng.delete_var(var)
+
+    _run_threads(work, n=32)
+    assert out == [i * i for i in range(32)]
