@@ -55,6 +55,7 @@ from . import symbol
 from . import symbol as sym
 from . import visualization
 from . import visualization as viz
+from . import model
 from . import contrib
 from . import parallel
 from . import test_utils

@@ -1,4 +1,5 @@
 """mx.contrib (ref: python/mxnet/contrib/): quantization, ONNX export."""
 from . import quantization
 from . import onnx
+from . import tensorboard
 from .quantization import quantize_net

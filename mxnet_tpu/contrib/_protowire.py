@@ -1,0 +1,84 @@
+"""Minimal protobuf wire-format codec (encode + decode).
+
+Shared by the tensorboard bridge and the ONNX module: this environment
+has neither the protobuf runtime nor the generated message classes, so
+both serialize their messages directly at the wire level (varint tags,
+length-delimited submessages). Only the features those formats need are
+implemented: varint/fixed32/fixed64/length-delimited fields, packed
+repeats, and a generic decoder returning {field_number: [values]}.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+__all__ = ["varint", "field_varint", "field_bytes", "field_double",
+           "field_float", "decode_message", "decode_varint"]
+
+
+def varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def field_varint(num: int, val: int) -> bytes:
+    return varint(num << 3) + varint(val)
+
+
+def field_bytes(num: int, payload: bytes) -> bytes:
+    return varint(num << 3 | 2) + varint(len(payload)) + payload
+
+
+def field_double(num: int, val: float) -> bytes:
+    return varint(num << 3 | 1) + struct.pack("<d", val)
+
+
+def field_float(num: int, val: float) -> bytes:
+    return varint(num << 3 | 5) + struct.pack("<f", val)
+
+
+def decode_varint(buf: bytes, off: int) -> Tuple[int, int]:
+    """Returns (value, new offset)."""
+    shift = 0
+    val = 0
+    while True:
+        b = buf[off]
+        off += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, off
+        shift += 7
+
+
+def decode_message(buf: bytes) -> Dict[int, List]:
+    """Parse one message level: field number -> list of raw values
+    (int for varint/fixed, bytes for length-delimited — nested messages
+    decode recursively on the bytes)."""
+    out: Dict[int, List] = {}
+    off = 0
+    while off < len(buf):
+        key, off = decode_varint(buf, off)
+        num, wt = key >> 3, key & 7
+        if wt == 0:
+            val, off = decode_varint(buf, off)
+        elif wt == 1:
+            val = struct.unpack("<q", buf[off:off + 8])[0]
+            off += 8
+        elif wt == 2:
+            ln, off = decode_varint(buf, off)
+            val = buf[off:off + ln]
+            off += ln
+        elif wt == 5:
+            val = struct.unpack("<i", buf[off:off + 4])[0]
+            off += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        out.setdefault(num, []).append(val)
+    return out

@@ -1,0 +1,164 @@
+"""TensorBoard bridge: event-file SummaryWriter + metric callback.
+
+Reference: python/mxnet/contrib/tensorboard.py (LogMetricsCallback, which
+delegates to the external mxboard SummaryWriter). This environment has no
+tensorboard/mxboard package, so the event-file writer itself is
+implemented here from the wire format down: TFRecord framing
+(length + masked crc32c of length + payload + masked crc32c of payload)
+around hand-encoded Event/Summary protobufs (scalars + text). Files are
+readable by any standard TensorBoard.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+from typing import Optional
+
+__all__ = ["SummaryWriter", "LogMetricsCallback"]
+
+
+# ---------------------------------------------------------------------------
+# crc32c (Castagnoli), table-driven — TFRecord framing needs it masked
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# protobuf wire encoding shared with contrib.onnx
+from ._protowire import (varint as _varint, field_varint as _field_varint,
+                         field_bytes as _field_bytes,
+                         field_double as _field_double,
+                         field_float as _field_float)
+
+
+def _summary_value(tag: str, simple_value: Optional[float] = None,
+                   text: Optional[str] = None) -> bytes:
+    # Summary.Value: tag=1, simple_value=2, tensor=8; metadata=9
+    body = _field_bytes(1, tag.encode())
+    if simple_value is not None:
+        body += _field_float(2, float(simple_value))
+    if text is not None:
+        # TensorProto{dtype=1:DT_STRING(7), string_val=8} + plugin 'text'
+        tensor = _field_varint(1, 7) + _field_bytes(8, text.encode())
+        body += _field_bytes(8, tensor)
+        plugin = _field_bytes(1, _field_bytes(1, b"text"))  # metadata.plugin_data.plugin_name
+        body += _field_bytes(9, plugin)
+    return body
+
+
+def _event(wall_time: float, step: int, summary: Optional[bytes] = None,
+           file_version: Optional[str] = None) -> bytes:
+    # Event: wall_time=1(double), step=2(int64), file_version=3, summary=5
+    body = _field_double(1, wall_time)
+    if step:
+        body += _field_varint(2, step)
+    if file_version is not None:
+        body += _field_bytes(3, file_version.encode())
+    if summary is not None:
+        body += _field_bytes(5, summary)
+    return body
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+
+class SummaryWriter:
+    """Append scalar/text summaries to a tfevents file under ``logdir``.
+
+    API shape follows mxboard/torch SummaryWriter: add_scalar, add_scalars,
+    add_text, flush, close, context manager.
+    """
+
+    def __init__(self, logdir: str, filename_suffix: str = ""):
+        os.makedirs(logdir, exist_ok=True)
+        fname = "events.out.tfevents.%010d.%s%s" % (
+            int(time.time()), os.uname().nodename, filename_suffix)
+        self._path = os.path.join(logdir, fname)
+        self._fp = open(self._path, "ab")
+        self._lock = threading.Lock()
+        self._write_event(_event(time.time(), 0,
+                                 file_version="brain.Event:2"))
+
+    def _write_event(self, payload: bytes):
+        header = struct.pack("<Q", len(payload))
+        rec = (header + struct.pack("<I", _masked_crc(header)) + payload
+               + struct.pack("<I", _masked_crc(payload)))
+        with self._lock:
+            self._fp.write(rec)
+
+    def add_scalar(self, tag: str, value, global_step: int = 0,
+                   walltime: Optional[float] = None):
+        val = float(value[1]) if isinstance(value, tuple) else float(value)
+        summary = _field_bytes(1, _summary_value(tag, simple_value=val))
+        self._write_event(_event(walltime or time.time(),
+                                 int(global_step), summary))
+
+    def add_scalars(self, main_tag: str, tag_scalar_dict,
+                    global_step: int = 0):
+        for k, v in tag_scalar_dict.items():
+            self.add_scalar(f"{main_tag}/{k}", v, global_step)
+
+    def add_text(self, tag: str, text: str, global_step: int = 0):
+        summary = _field_bytes(1, _summary_value(tag, text=text))
+        self._write_event(_event(time.time(), int(global_step), summary))
+
+    def flush(self):
+        with self._lock:
+            self._fp.flush()
+
+    def close(self):
+        with self._lock:
+            if not self._fp.closed:
+                self._fp.flush()
+                self._fp.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class LogMetricsCallback:
+    """Per-batch metric logger (ref contrib/tensorboard.py:24-76): call
+    with a BatchEndParam-style object carrying eval_metric."""
+
+    def __init__(self, logging_dir: str, prefix: Optional[str] = None):
+        self.prefix = prefix
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value,
+                                           getattr(param, "nbatch", 0))

@@ -212,9 +212,17 @@ class BatchNorm(HybridBlock):
 
 class SyncBatchNorm(BatchNorm):
     """Cross-device BN (ref contrib SyncBatchNorm, src/operator/contrib/
-    sync_batch_norm.cc). Under pjit/shard_map the batch axis is already
-    global — XLA computes global batch statistics — so this is BatchNorm;
-    kept as a distinct class for API parity."""
+    sync_batch_norm.cc).
+
+    Boundary, explicitly: this is correct under **GSPMD** — a batch-sharded
+    input inside one ``jit``/``pjit`` computation reduces over the GLOBAL
+    batch axis (XLA inserts the cross-device all-reduce for the moment
+    sums), which is exactly the reference kernel's semantics. It is NOT
+    correct inside ``shard_map``/per-device manual-collective code, where
+    each shard would silently compute local statistics; there you must
+    ``jax.lax.pmean`` the moments yourself. Tested in
+    tests/test_small_parity.py::test_sync_batch_norm_global_stats.
+    """
 
     def __init__(self, in_channels=0, num_devices=None, **kwargs):
         kwargs.pop("ndev", None)

@@ -34,6 +34,17 @@ class Parameter:
         self._shape = tuple(shape) if shape is not None else None
         self.dtype = jnp.dtype(dtype) if dtype is not None else jnp.float32
         self.init = init if init is not None else initializer
+        # storage types are visible for reference-compat branching (ref
+        # parameter.py _stype decision tables). Data itself stays dense on
+        # TPU (HBM wants dense; sparse pays off only on the host/IO side) —
+        # row_sparse is accepted and recorded, anything else is refused
+        # loudly rather than silently trained dense.
+        if stype not in ("default", "row_sparse", "csr"):
+            raise MXNetError(f"invalid stype '{stype}'")
+        if grad_stype not in ("default", "row_sparse", "csr"):
+            raise MXNetError(f"invalid grad_stype '{grad_stype}'")
+        self._stype = stype
+        self._grad_stype = grad_stype
         self.lr_mult = lr_mult
         self.wd_mult = wd_mult
         self._grad_req = grad_req if differentiable else "null"
@@ -48,6 +59,15 @@ class Parameter:
     @property
     def name(self) -> str:
         return self._structure_name or self._name
+
+    @property
+    def stype(self) -> str:
+        """Declared storage type (data itself is dense-backed on TPU)."""
+        return self._stype
+
+    @property
+    def grad_stype(self) -> str:
+        return self._grad_stype
 
     def __repr__(self):
         return f"Parameter({self.name}, shape={self._shape}, dtype={self.dtype})"

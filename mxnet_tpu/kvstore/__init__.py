@@ -79,6 +79,20 @@ class KVStore(KVStoreBase):
         self._store: Dict[Any, NDArray] = {}
         self._updater = None
         self._optimizer = None
+        self._compression = None
+
+    def set_gradient_compression(self, compression_params):
+        """Enable 2-bit gradient compression on pushed values (ref
+        kvstore.py set_gradient_compression + gradient_compression.cc)."""
+        from .gradient_compression import GradientCompression
+
+        self._compression = GradientCompression(**dict(compression_params))
+
+    def _maybe_compress(self, key, vals):
+        if self._compression is None:
+            return vals
+        return [self._compression.compress(key, i, v)
+                for i, v in enumerate(vals)]
 
     # -- modern API ---------------------------------------------------------
     def broadcast(self, key, value, out, priority=0):
@@ -89,7 +103,7 @@ class KVStore(KVStoreBase):
             o._set_data(jax.device_put(src._data, o.ctx.jax_device()))
 
     def pushpull(self, key, value, out=None, priority=0):
-        vals = _as_list(value)
+        vals = self._maybe_compress(key, _as_list(value))
         if len(vals) == 1:
             reduced = vals[0]._data
         else:
@@ -118,7 +132,7 @@ class KVStore(KVStoreBase):
         keys = key if isinstance(key, (list, tuple)) else [key]
         vals = value if isinstance(key, (list, tuple)) else [value]
         for k, v in zip(keys, vals):
-            vs = _as_list(v)
+            vs = self._maybe_compress(k, _as_list(v))
             reduced = vs[0]._data if len(vs) == 1 else \
                 jnp.sum(jnp.stack([x._data for x in vs]), axis=0)
             if self._updater is not None:

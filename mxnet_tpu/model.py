@@ -1,0 +1,61 @@
+"""Legacy ``mx.model`` checkpoint helpers.
+
+Reference: python/mxnet/model.py (save_checkpoint:189, load_params:221,
+load_checkpoint:238, BatchEndParam:41). The FeedForward trainer class was
+already gone in the reference's 2.x line — Gluon is the training surface —
+but the checkpoint file format (``prefix-symbol.json`` +
+``prefix-NNNN.params`` with ``arg:``/``aux:`` key prefixes) remains the
+interchange format tools expect, so it is preserved bit-compatibly here.
+"""
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+
+from . import ndarray as nd
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_params",
+           "load_checkpoint"]
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Save ``prefix-symbol.json`` + ``prefix-%04d.params``
+    (ref model.py:189-219)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def load_params(prefix, epoch):
+    """Split a saved dict into (arg_params, aux_params)
+    (ref model.py:221-237)."""
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    if not save_dict:
+        logging.warning("Params file '%s' is empty",
+                        "%s-%04d.params" % (prefix, epoch))
+        return arg_params, aux_params
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """Load (symbol, arg_params, aux_params) (ref model.py:238-276)."""
+    from . import symbol as sym
+
+    symbol = sym.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

@@ -1,0 +1,142 @@
+"""Round-2 parity fixes: stype visibility, SyncBatchNorm GSPMD boundary,
+2-bit gradient compression, legacy mx.model checkpoints.
+
+References: ndarray.py stype/tostype, parameter.py stype tables,
+src/kvstore/gradient_compression.cc, python/mxnet/model.py:189-276,
+src/operator/contrib/sync_batch_norm.cc.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# stype
+# ---------------------------------------------------------------------------
+
+def test_ndarray_tostype_roundtrip():
+    dense = mx.nd.array(onp.array([[1., 0., 2.], [0., 0., 0.],
+                                   [3., 0., 0.]], "f4"))
+    assert dense.stype == "default"
+    assert dense.tostype("default") is dense
+    rsp = dense.tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert onp.allclose(rsp.todense().asnumpy(), dense.asnumpy())
+    csr = dense.tostype("csr")
+    assert csr.stype == "csr"
+    assert onp.allclose(csr.todense().asnumpy(), dense.asnumpy())
+    with pytest.raises(MXNetError):
+        dense.tostype("bogus")
+
+
+def test_parameter_stype_visible_and_validated():
+    p = mx.gluon.Parameter(shape=(4, 3), stype="row_sparse",
+                           grad_stype="row_sparse")
+    assert p.stype == "row_sparse" and p.grad_stype == "row_sparse"
+    assert mx.gluon.Parameter(shape=(2,)).stype == "default"
+    with pytest.raises(MXNetError):
+        mx.gluon.Parameter(shape=(2,), stype="nope")
+    with pytest.raises(MXNetError):
+        mx.gluon.Parameter(shape=(2,), grad_stype="nope")
+
+
+# ---------------------------------------------------------------------------
+# SyncBatchNorm under GSPMD
+# ---------------------------------------------------------------------------
+
+def test_sync_batch_norm_global_stats():
+    """A batch-sharded input inside one jit must use GLOBAL batch moments:
+    sharded output == unsharded output bit-for-nearly-bit."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    net = mx.gluon.nn.SyncBatchNorm(in_channels=8)
+    net.initialize()
+    rng = onp.random.RandomState(0)
+    # per-shard slices have deliberately different means so local-stats
+    # BN would give a visibly different answer
+    x = onp.concatenate([rng.rand(2, 8, 4, 4) + 3 * i for i in range(8)],
+                        axis=0).astype("f4")
+    with mx.autograd.record():  # training mode: batch statistics
+        expected = net(mx.nd.array(x)).asnumpy()
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = Mesh(onp.array(devs[:8]), ("dp",))
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    with mx.autograd.record():
+        sharded = net(mx.nd.NDArray(xs)).asnumpy()
+    assert onp.allclose(sharded, expected, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# gradient compression
+# ---------------------------------------------------------------------------
+
+def test_gradient_compression_quantize_and_residual():
+    from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+
+    gc = GradientCompression(type="2bit", threshold=0.5)
+    g = mx.nd.array(onp.array([0.7, -0.9, 0.2, -0.1], "f4"))
+    q1 = gc.compress("w", 0, g).asnumpy()
+    assert onp.allclose(q1, [0.5, -0.5, 0.0, 0.0])
+    # error feedback: residual [0.2, -0.4, 0.2, -0.1] joins the next grad
+    q2 = gc.compress("w", 0, g).asnumpy()
+    # acc = g + residual = [0.9, -1.3, 0.4, -0.2] -> [0.5, -0.5, 0, 0]
+    assert onp.allclose(q2, [0.5, -0.5, 0.0, 0.0])
+    q3 = gc.compress("w", 0, mx.nd.array(onp.zeros(4, "f4"))).asnumpy()
+    # residual [0.4, -0.8, 0.4, -0.2] alone still fires two levels + 0.4
+    assert onp.allclose(q3, [0.0, -0.5, 0.0, 0.0]) or \
+        onp.allclose(q3, [0.5, -0.5, 0.0, 0.0])
+    with pytest.raises(MXNetError):
+        GradientCompression(type="1bit")
+    with pytest.raises(MXNetError):
+        GradientCompression(threshold=-1.0)
+
+
+def test_kvstore_compression_end_to_end():
+    kv = mx.kvstore.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    a = mx.nd.array(onp.array([2.0, -2.0, 0.1, 0.0], "f4"))
+    b = mx.nd.array(onp.array([2.0, -2.0, 0.1, 0.0], "f4"))
+    out = mx.nd.zeros((4,))
+    kv.pushpull("g", [a, b], out=out)
+    # each value quantizes to [0.5, -0.5, 0, 0]; sum of 2
+    assert onp.allclose(out.asnumpy(), [1.0, -1.0, 0.0, 0.0])
+    # residuals persist per slot: big remainders fire again next round
+    a2 = mx.nd.zeros((4,))
+    b2 = mx.nd.zeros((4,))
+    out2 = mx.nd.zeros((4,))
+    kv.pushpull("g", [a2, b2], out=out2)
+    assert onp.allclose(out2.asnumpy(), [1.0, -1.0, 0.0, 0.0])
+
+
+# ---------------------------------------------------------------------------
+# legacy mx.model checkpoints
+# ---------------------------------------------------------------------------
+
+def test_model_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "ckpt")
+    x = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(x, num_hidden=3, name="fc1") \
+        if hasattr(mx.sym, "FullyConnected") else x
+    arg = {"fc1_weight": mx.nd.array(onp.random.RandomState(0)
+                                     .rand(3, 4).astype("f4")),
+           "fc1_bias": mx.nd.zeros((3,))}
+    aux = {"bn_mean": mx.nd.ones((3,))}
+    mx.model.save_checkpoint(prefix, 7, net, arg, aux)
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
+    assert set(arg2) == set(arg) and set(aux2) == set(aux)
+    for k in arg:
+        assert onp.allclose(arg2[k].asnumpy(), arg[k].asnumpy())
+    assert onp.allclose(aux2["bn_mean"].asnumpy(), aux["bn_mean"].asnumpy())
+    # params-only load
+    arg3, aux3 = mx.model.load_params(prefix, 7)
+    assert set(arg3) == set(arg)
+    # empty save warns but returns empty dicts
+    mx.model.save_checkpoint(prefix + "2", 0, None, {}, {})
+    arg4, aux4 = mx.model.load_params(prefix + "2", 0)
+    assert arg4 == {} and aux4 == {}
