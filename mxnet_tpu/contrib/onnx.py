@@ -1,56 +1,615 @@
-"""ONNX export/import (ref: python/mxnet/contrib/onnx/).
+"""ONNX export/import, implemented at the protobuf wire level.
 
-The ``onnx`` package is not part of this environment's baked-in set, so
-the functional deploy format here is StableHLO
-(gluon.symbol_block.export_hybrid — portable, runnable without the model
-class). This module keeps the reference's ONNX API surface and activates
-when ``onnx`` is installed: export walks the traced jaxpr of the
-hybridized forward and maps primitives to ONNX nodes (a seam — only the
-common NN subset is mapped).
+Reference: python/mxnet/contrib/onnx/ (mx2onnx/export_onnx.py op-translator
+registry, onnx2mx/import_onnx.py GraphProto walker). The reference leans on
+the external ``onnx`` package for message classes; this environment doesn't
+have it, so the ModelProto/GraphProto/NodeProto messages are encoded and
+decoded directly with the shared wire codec (contrib/_protowire.py) from
+the onnx.proto3 field numbers. Files produced here load in stock
+onnxruntime/netron; import accepts any ONNX model using the mapped op set.
+
+Mapped ops (both directions): Conv, ConvTranspose, Gemm, MatMul,
+BatchNormalization, MaxPool/AveragePool/Global*, Relu/Sigmoid/Tanh/
+Softsign/Elu/Selu/LeakyRelu, Softmax/LogSoftmax, Flatten, Reshape,
+Transpose, Concat, Dropout, Add/Sub/Mul/Div/Pow/Max/Min, Neg/Exp/Log/
+Sqrt/Abs, ReduceMean/ReduceSum, Gather (embedding), Identity.
+Opset 13, default domain.
 """
 from __future__ import annotations
 
+import struct
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as onp
+
 from ..base import MXNetError
+from ._protowire import (decode_message, decode_varint, field_bytes,
+                         field_float, field_varint)
 
-__all__ = ["export_model", "get_model_metadata", "import_model"]
+__all__ = ["export_model", "import_model", "get_model_metadata",
+           "import_to_gluon"]
 
+OPSET = 13
 
-def _require_onnx():
-    try:
-        import onnx  # noqa: F401
-        return onnx
-    except ImportError as e:
-        raise MXNetError(
-            "the 'onnx' package is not installed in this environment; use "
-            "the StableHLO deploy format instead "
-            "(HybridBlock.export / SymbolBlock.imports, "
-            "gluon/symbol_block.py) or install onnx") from e
+# ONNX TensorProto data types
+_DT_FLOAT, _DT_INT64, _DT_INT32, _DT_BOOL = 1, 7, 6, 9
+_NP2DT = {"float32": _DT_FLOAT, "int64": _DT_INT64, "int32": _DT_INT32,
+          "bool": _DT_BOOL}
+_DT2NP = {v: k for k, v in _NP2DT.items()}
 
-
-def export_model(net, path: str, input_shapes, input_types=None,
-                 onnx_file_path: str = "model.onnx", **kwargs):
-    """Export a hybridized net to ONNX (ref mx2onnx/export_onnx.py:56)."""
-    onnx = _require_onnx()
-    raise MXNetError(
-        "ONNX export mapping is not implemented for this backend yet; "
-        "export via StableHLO (HybridBlock.export) which is the native "
-        "deploy format")
+# AttributeProto.AttributeType
+_AT_FLOAT, _AT_INT, _AT_STRING, _AT_TENSOR = 1, 2, 3, 4
+_AT_FLOATS, _AT_INTS, _AT_STRINGS = 6, 7, 8
 
 
-def get_model_metadata(model_file: str):
-    onnx = _require_onnx()
-    m = onnx.load(model_file)
-    ins = [(i.name, tuple(d.dim_value for d in
-                          i.type.tensor_type.shape.dim))
-           for i in m.graph.input]
-    outs = [(o.name, tuple(d.dim_value for d in
-                           o.type.tensor_type.shape.dim))
-            for o in m.graph.output]
-    return {"input_tensor_data": ins, "output_tensor_data": outs}
+# ---------------------------------------------------------------------------
+# message builders (field numbers from onnx.proto3)
+# ---------------------------------------------------------------------------
+
+def _attr_int(name: str, val: int) -> bytes:
+    # negative ints must be two's-complement-masked: varint() of a negative
+    # Python int never terminates (>> keeps the sign bit forever)
+    return (field_bytes(1, name.encode())
+            + field_varint(3, int(val) & 0xFFFFFFFFFFFFFFFF)
+            + field_varint(20, _AT_INT))
+
+
+def _attr_float(name: str, val: float) -> bytes:
+    return (field_bytes(1, name.encode()) + field_float(2, float(val))
+            + field_varint(20, _AT_FLOAT))
+
+
+def _attr_ints(name: str, vals: Sequence[int]) -> bytes:
+    body = field_bytes(1, name.encode())
+    for v in vals:
+        body += field_varint(8, int(v) & 0xFFFFFFFFFFFFFFFF)
+    body += field_varint(20, _AT_INTS)
+    return body
+
+
+def _tensor(name: str, arr: onp.ndarray) -> bytes:
+    dt = _NP2DT.get(str(arr.dtype))
+    if dt is None:
+        arr = arr.astype(onp.float32)
+        dt = _DT_FLOAT
+    body = b"".join(field_varint(1, d) for d in arr.shape)
+    body += field_varint(2, dt)
+    body += field_bytes(8, name.encode())
+    body += field_bytes(9, onp.ascontiguousarray(arr).tobytes())
+    return body
+
+
+def _node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+          name: str, attrs: Sequence[bytes] = ()) -> bytes:
+    body = b"".join(field_bytes(1, i.encode()) for i in inputs)
+    body += b"".join(field_bytes(2, o.encode()) for o in outputs)
+    body += field_bytes(3, name.encode())
+    body += field_bytes(4, op_type.encode())
+    body += b"".join(field_bytes(5, a) for a in attrs)
+    return body
+
+
+def _value_info(name: str, shape: Sequence[int],
+                dtype: int = _DT_FLOAT) -> bytes:
+    dims = b"".join(field_bytes(1, field_varint(1, int(d))) for d in shape)
+    tensor_type = field_varint(1, dtype) + field_bytes(2, dims)
+    return (field_bytes(1, name.encode())
+            + field_bytes(2, field_bytes(1, tensor_type)))
+
+
+def _graph(nodes: List[bytes], name: str, initializers: List[bytes],
+           inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    body = b"".join(field_bytes(1, n) for n in nodes)
+    body += field_bytes(2, name.encode())
+    body += b"".join(field_bytes(5, t) for t in initializers)
+    body += b"".join(field_bytes(11, i) for i in inputs)
+    body += b"".join(field_bytes(12, o) for o in outputs)
+    return body
+
+
+def _model(graph: bytes) -> bytes:
+    opset = field_bytes(1, b"") + field_varint(2, OPSET)
+    return (field_varint(1, 8)                      # ir_version 8
+            + field_bytes(2, b"mxnet_tpu")          # producer_name
+            + field_bytes(3, b"2.0")                # producer_version
+            + field_bytes(8, opset)
+            + field_bytes(7, graph))
+
+
+# ---------------------------------------------------------------------------
+# export: Symbol graph -> ONNX
+# ---------------------------------------------------------------------------
+
+def _pair(v, default=None):
+    """Normalize int-or-pair attrs to a 2-list."""
+    if v is None:
+        return default
+    if isinstance(v, (int, float)):
+        return [int(v), int(v)]
+    return [int(x) for x in v]
+
+
+class _Exporter:
+    def __init__(self, params: Dict[str, Any]):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.params = params
+        self._uid = 0
+
+    def uid(self, base: str) -> str:
+        self._uid += 1
+        return f"{base}_{self._uid}"
+
+    def add(self, op_type, inputs, output, name, attrs=()):
+        self.nodes.append(_node(op_type, inputs, [output], name, attrs))
+
+    def const_tensor(self, name: str, arr: onp.ndarray):
+        self.initializers.append(_tensor(name, arr))
+        return name
+
+    def emit(self, node, in_names: List[str], out_name: str):
+        """Translate one Symbol op node to ONNX node(s)."""
+        op = node.op
+        a = node.attrs
+
+        def ints(key, default=None):
+            return _pair(a.get(key), default)
+
+        if op == "fully_connected":
+            x = in_names[0]
+            if a.get("flatten", True):
+                fx = self.uid("flat")
+                self.add("Flatten", [x], fx, self.uid("Flatten"),
+                         [_attr_int("axis", 1)])
+                x = fx
+            gemm_attrs = [_attr_int("transB", 1), _attr_float("alpha", 1.0),
+                          _attr_float("beta", 1.0)]
+            self.add("Gemm", [x] + in_names[1:], out_name,
+                     self.uid("Gemm"), gemm_attrs)
+        elif op == "convolution":
+            attrs = [_attr_ints("kernel_shape", ints("kernel")),
+                     _attr_ints("strides", ints("stride", [1, 1])),
+                     _attr_ints("dilations", ints("dilate", [1, 1])),
+                     _attr_int("group", int(a.get("num_group", 1) or 1))]
+            p = ints("pad", [0, 0])
+            attrs.append(_attr_ints("pads", p + p))
+            self.add("Conv", in_names, out_name, self.uid("Conv"), attrs)
+        elif op == "deconvolution":
+            attrs = [_attr_ints("kernel_shape", ints("kernel")),
+                     _attr_ints("strides", ints("stride", [1, 1])),
+                     _attr_int("group", int(a.get("num_group", 1) or 1))]
+            p = ints("pad", [0, 0])
+            attrs.append(_attr_ints("pads", p + p))
+            self.add("ConvTranspose", in_names, out_name,
+                     self.uid("ConvT"), attrs)
+        elif op == "batch_norm":
+            attrs = [_attr_float("epsilon", float(a.get("eps", 1e-5))),
+                     _attr_float("momentum", float(a.get("momentum", 0.9)))]
+            self.add("BatchNormalization", in_names, out_name,
+                     self.uid("BN"), attrs)
+        elif op.startswith("pooling"):
+            pool_type = a.get("pool_type", op.split("_")[-1])
+            if a.get("global_pool"):
+                kind = ("GlobalMaxPool" if pool_type == "max"
+                        else "GlobalAveragePool")
+                self.add(kind, in_names, out_name, self.uid(kind))
+            else:
+                kind = "MaxPool" if pool_type == "max" else "AveragePool"
+                attrs = [_attr_ints("kernel_shape", ints("kernel")),
+                         _attr_ints("strides",
+                                    ints("stride") or ints("kernel"))]
+                p = ints("pad", [0, 0])
+                attrs.append(_attr_ints("pads", p + p))
+                self.add(kind, in_names, out_name, self.uid(kind), attrs)
+        elif op.startswith("activation") or op.startswith("leaky_relu"):
+            act = a.get("act_type", "relu")
+            table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+                     "softsign": "Softsign", "elu": "Elu", "selu": "Selu",
+                     "gelu": "Gelu", "leaky": "LeakyRelu"}
+            if act not in table:
+                raise MXNetError(f"activation '{act}' has no ONNX mapping")
+            attrs = []
+            if act == "leaky":
+                attrs = [_attr_float("alpha", float(a.get("slope", 0.25)))]
+            self.add(table[act], in_names[:1], out_name,
+                     self.uid(table[act]), attrs)
+        elif op in ("relu", "sigmoid", "tanh", "softsign"):
+            self.add(op.capitalize() if op != "softsign" else "Softsign",
+                     in_names, out_name, self.uid(op))
+        elif op in ("softmax", "log_softmax"):
+            kind = "Softmax" if op == "softmax" else "LogSoftmax"
+            self.add(kind, in_names, out_name, self.uid(kind),
+                     [_attr_int("axis", int(a.get("axis", -1)))])
+        elif op == "flatten":
+            self.add("Flatten", in_names, out_name, self.uid("Flatten"),
+                     [_attr_int("axis", 1)])
+        elif op == "reshape":
+            shape = a.get("newshape") or a.get("shape") or a.get("__arg1")
+            if shape is None:
+                raise MXNetError(
+                    f"reshape node '{node.name}' lacks a recorded shape")
+            if isinstance(shape, (int, float)):
+                shape = [int(shape)]
+            sname = self.const_tensor(
+                self.uid("shape"), onp.asarray([int(s) for s in shape],
+                                               onp.int64))
+            self.add("Reshape", [in_names[0], sname], out_name,
+                     self.uid("Reshape"))
+        elif op == "transpose":
+            axes = a.get("axes") or a.get("__arg1")
+            attrs = [_attr_ints("perm", [int(x) for x in axes])] if axes \
+                else []
+            self.add("Transpose", in_names, out_name,
+                     self.uid("Transpose"), attrs)
+        elif op == "concatenate":
+            self.add("Concat", in_names, out_name, self.uid("Concat"),
+                     [_attr_int("axis", int(a.get("axis", 0) or 0))])
+        elif op == "dropout":
+            ratio = self.const_tensor(
+                self.uid("ratio"),
+                onp.asarray(float(a.get("p", 0.5)), onp.float32))
+            self.add("Dropout", [in_names[0], ratio], out_name,
+                     self.uid("Dropout"))
+        elif op == "embedding":
+            # npx.embedding(indices, weight) -> Gather(weight, indices)
+            self.add("Gather", [in_names[1], in_names[0]], out_name,
+                     self.uid("Gather"), [_attr_int("axis", 0)])
+        elif op in ("add", "subtract", "multiply", "divide", "power",
+                    "maximum", "minimum"):
+            table = {"add": "Add", "subtract": "Sub", "multiply": "Mul",
+                     "divide": "Div", "power": "Pow", "maximum": "Max",
+                     "minimum": "Min"}
+            self.add(table[op], in_names, out_name, self.uid(table[op]))
+        elif op in ("negative", "exp", "log", "sqrt", "abs"):
+            table = {"negative": "Neg", "exp": "Exp", "log": "Log",
+                     "sqrt": "Sqrt", "abs": "Abs"}
+            self.add(table[op], in_names, out_name, self.uid(table[op]))
+        elif op in ("mean", "sum"):
+            kind = "ReduceMean" if op == "mean" else "ReduceSum"
+            axis = a.get("axis", a.get("__arg1"))
+            attrs = [_attr_int("keepdims",
+                               1 if a.get("keepdims") else 0)]
+            if axis is not None:
+                axes = [axis] if isinstance(axis, int) else list(axis)
+                attrs.append(_attr_ints("axes", axes))
+            self.add(kind, in_names, out_name, self.uid(kind), attrs)
+        elif op in ("dot", "matmul"):
+            self.add("MatMul", in_names, out_name, self.uid("MatMul"))
+        elif op == "_const":
+            val = onp.asarray(node.fn())
+            self.const_tensor(out_name, val)
+        elif op in ("identity", "copy"):
+            self.add("Identity", in_names, out_name, self.uid("Identity"))
+        else:
+            raise MXNetError(
+                f"op '{op}' (node '{node.name}') has no ONNX mapping; "
+                f"mapped set is in contrib/onnx.py")
+
+
+def export_model(sym, params: Dict[str, Any], input_shapes: Sequence,
+                 input_types=None, onnx_file_path: str = "model.onnx",
+                 verbose: bool = False, **kwargs) -> str:
+    """Export (Symbol, params) to an ONNX file
+    (ref mx2onnx/export_onnx.py export_model).
+
+    ``sym`` may also be a HybridBlock — it is traced with zero inputs of
+    ``input_shapes`` first. ``params`` values are NDArrays keyed by the
+    symbol's variable names.
+    """
+    from .. import ndarray as nd
+    from ..symbol.symbol import Symbol
+
+    if not isinstance(sym, Symbol):
+        block = sym
+        import mxnet_tpu as mx
+
+        xs = [nd.zeros(tuple(s)) for s in input_shapes]
+        # trace op-by-op: a hybridized block records one opaque
+        # cached_op node, so deactivate jit for the trace and restore
+        was_active = getattr(block, "_active", False)
+        if was_active:
+            block.hybridize(False)
+        try:
+            block(*xs)
+            params = {n: p.data()
+                      for n, p in block.collect_params().items()}
+            sym = mx.sym.trace(lambda *ins: block(*ins), xs, known=params)
+        finally:
+            if was_active:
+                block.hybridize(True)
+
+    exp = _Exporter(params)
+    order = sym._topo()
+    names: Dict[Tuple[int, int], str] = {}
+    inputs: List[bytes] = []
+    input_iter = iter(input_shapes)
+    for n in order:
+        if n.is_var():
+            names[(id(n), 0)] = n.name
+            if n.name in params:
+                val = params[n.name]
+                exp.const_tensor(
+                    n.name, onp.asarray(val.asnumpy()
+                                        if hasattr(val, "asnumpy") else val))
+            else:
+                try:
+                    shape = tuple(next(input_iter))
+                except StopIteration:
+                    raise MXNetError(
+                        f"no input shape provided for free input "
+                        f"'{n.name}'")
+                dt = _DT_FLOAT
+                if input_types is not None:
+                    t = (input_types[len(inputs)]
+                         if isinstance(input_types, (list, tuple))
+                         else input_types)
+                    dt = _NP2DT.get(str(onp.dtype(t)), _DT_FLOAT)
+                inputs.append(_value_info(n.name, shape, dt))
+
+    for n in order:
+        if n.is_var():
+            continue
+        in_names = [names[(id(s), i)] for s, i in n.inputs]
+        if n.n_out > 1:
+            raise MXNetError(
+                f"multi-output op '{n.op}' is not ONNX-mappable here")
+        out_name = f"{n.name}_out"
+        names[(id(n), 0)] = out_name
+        exp.emit(n, in_names, out_name)
+
+    # outputs: name only — declaring a shape we did not infer would
+    # misdescribe the tensor (a () shape reads as rank-0 to checkers)
+    outputs = [field_bytes(1, names[(id(hn), hi)].encode())
+               for hn, hi in sym._outputs]
+
+    graph = _graph(exp.nodes, "mxnet_tpu_graph", exp.initializers,
+                   inputs, outputs)
+    blob = _model(graph)
+    with open(onnx_file_path, "wb") as f:
+        f.write(blob)
+    return onnx_file_path
+
+
+# ---------------------------------------------------------------------------
+# import: ONNX -> Symbol + params
+# ---------------------------------------------------------------------------
+
+def _decode_attr(buf: bytes):
+    f = decode_message(buf)
+    name = f[1][0].decode()
+    at = f.get(20, [0])[0]
+    # proto3 omits zero-valued scalars — default every scalar read
+    if at == _AT_INT:
+        v = f.get(3, [0])[0]
+        return name, (v if v < (1 << 63) else v - (1 << 64))
+    if at == _AT_FLOAT:
+        return name, struct.unpack(
+            "<f", struct.pack("<I", f.get(2, [0])[0] & 0xFFFFFFFF))[0]
+    if at == _AT_STRING:
+        return name, f.get(4, [b""])[0].decode()
+    if at == _AT_INTS:
+        return name, [v if v < (1 << 63) else v - (1 << 64)
+                      for v in f.get(8, [])]
+    if at == _AT_FLOATS:
+        return name, [struct.unpack(
+            "<f", struct.pack("<I", v & 0xFFFFFFFF))[0]
+            for v in f.get(7, [])]
+    if at == _AT_TENSOR:
+        return name, _decode_tensor(f[5][0])
+    return name, None
+
+
+def _decode_tensor(buf: bytes) -> onp.ndarray:
+    f = decode_message(buf)
+    dims = f.get(1, [])
+    dt = f.get(2, [_DT_FLOAT])[0]
+    np_dt = _DT2NP.get(dt, "float32")
+    if 9 in f:  # raw_data
+        arr = onp.frombuffer(f[9][0], dtype=np_dt)
+    elif 4 in f:  # float_data (packed chunks or unpacked fixed32)
+        fvals: List[float] = []
+        for chunk in f[4]:
+            if isinstance(chunk, bytes):
+                fvals.extend(onp.frombuffer(chunk, dtype="<f4"))
+            else:
+                fvals.append(struct.unpack(
+                    "<f", struct.pack("<I", chunk & 0xFFFFFFFF))[0])
+        arr = onp.asarray(fvals, onp.float32)
+    elif 7 in f:  # int64_data
+        ivals: List[int] = []
+        for chunk in f[7]:
+            if isinstance(chunk, bytes):
+                off = 0
+                while off < len(chunk):
+                    v, off = decode_varint(chunk, off)
+                    ivals.append(v if v < (1 << 63) else v - (1 << 64))
+            else:
+                ivals.append(chunk)
+        arr = onp.asarray(ivals, onp.int64)
+    else:
+        arr = onp.zeros([d for d in dims] or [], np_dt)
+    return arr.reshape(dims) if dims else arr.reshape(())
+
+
+def _decode_value_info(buf: bytes):
+    f = decode_message(buf)
+    name = f[1][0].decode()
+    shape: List[int] = []
+    if 2 in f:
+        t = decode_message(f[2][0])
+        if 1 in t:
+            tt = decode_message(t[1][0])
+            if 2 in tt:
+                sh = decode_message(tt[2][0])
+                for dim in sh.get(1, []):
+                    d = decode_message(dim)
+                    shape.append(d.get(1, [0])[0])
+    return name, tuple(shape)
+
+
+def _import_graph(gbuf: bytes):
+    import mxnet_tpu as mx
+    from .. import ndarray as nd
+
+    g = decode_message(gbuf)
+    params: Dict[str, Any] = {}
+    for t in g.get(5, []):
+        arr = _decode_tensor(t)
+        tname = decode_message(t)[8][0].decode()
+        params[tname] = nd.array(arr)
+
+    env: Dict[str, Any] = {}
+    sym_inputs = []
+    for vi in g.get(11, []):
+        name, shape = _decode_value_info(vi)
+        if name not in params:
+            env[name] = mx.sym.Variable(name)
+            sym_inputs.append((name, shape))
+    for pname in params:
+        env[pname] = mx.sym.Variable(pname)
+
+    for node_buf in g.get(1, []):
+        f = decode_message(node_buf)
+        ins = [b.decode() for b in f.get(1, [])]
+        outs = [b.decode() for b in f.get(2, [])]
+        op = f[4][0].decode()
+        attrs = dict(_decode_attr(a) for a in f.get(5, []))
+        x = [env[i] for i in ins if i in env]
+
+        def pads2(default=(0, 0)):
+            p = attrs.get("pads")
+            return tuple(p[:2]) if p else default
+
+        if op == "Conv":
+            out = mx.sym.Convolution(
+                *x, kernel=tuple(attrs["kernel_shape"]),
+                stride=tuple(attrs.get("strides", [1, 1])),
+                dilate=tuple(attrs.get("dilations", [1, 1])),
+                pad=pads2(), num_group=int(attrs.get("group", 1)),
+                num_filter=0, no_bias=len(x) < 3)
+        elif op == "ConvTranspose":
+            out = mx.sym.Deconvolution(
+                *x, kernel=tuple(attrs["kernel_shape"]),
+                stride=tuple(attrs.get("strides", [1, 1])),
+                pad=pads2(), num_group=int(attrs.get("group", 1)),
+                num_filter=0, no_bias=len(x) < 3)
+        elif op == "Gemm":
+            if attrs.get("transB", 0) != 1:
+                raise MXNetError("Gemm without transB=1 unsupported")
+            out = mx.sym.FullyConnected(*x, num_hidden=0,
+                                        no_bias=len(x) < 3, flatten=False)
+        elif op == "MatMul":
+            out = mx.sym.dot(*x)
+        elif op == "BatchNormalization":
+            out = mx.sym.BatchNorm(
+                *x, eps=float(attrs.get("epsilon", 1e-5)),
+                momentum=float(attrs.get("momentum", 0.9)),
+                use_global_stats=True)
+        elif op in ("MaxPool", "AveragePool"):
+            out = mx.sym.Pooling(
+                *x, kernel=tuple(attrs["kernel_shape"]),
+                stride=tuple(attrs.get("strides", [1, 1])),
+                pad=pads2(),
+                pool_type="max" if op == "MaxPool" else "avg")
+        elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+            out = mx.sym.Pooling(
+                *x, global_pool=True,
+                pool_type="max" if op == "GlobalMaxPool" else "avg")
+        elif op in ("Relu", "Sigmoid", "Tanh", "Softsign", "Elu", "Selu",
+                    "Gelu", "LeakyRelu"):
+            table = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+                     "Softsign": "softsign"}
+            if op in table:
+                out = mx.sym.Activation(*x, act_type=table[op])
+            else:
+                kind = {"Elu": "elu", "Selu": "selu", "Gelu": "gelu",
+                        "LeakyRelu": "leaky"}[op]
+                out = mx.sym.LeakyReLU(
+                    *x, act_type=kind,
+                    slope=float(attrs.get("alpha", 0.25)))
+        elif op in ("Softmax", "LogSoftmax"):
+            fn = mx.sym.softmax if op == "Softmax" else mx.sym.log_softmax
+            out = fn(*x, axis=int(attrs.get("axis", -1)))
+        elif op == "Flatten":
+            out = mx.sym.Flatten(*x)
+        elif op == "Reshape":
+            if ins[1] not in params:
+                raise MXNetError(
+                    "Reshape with a non-initializer shape input "
+                    f"('{ins[1]}') is not supported by this importer")
+            shape = params[ins[1]].asnumpy().astype(int).tolist()
+            out = mx.sym.reshape(env[ins[0]], tuple(shape))
+        elif op == "Transpose":
+            perm = attrs.get("perm")
+            out = mx.sym.transpose(*x, axes=tuple(perm)) if perm \
+                else mx.sym.transpose(*x)
+        elif op == "Concat":
+            out = mx.sym.Concat(*x, axis=int(attrs.get("axis", 0)))
+        elif op == "Dropout":
+            out = env[ins[0]]  # inference no-op
+        elif op == "Gather":
+            out = mx.sym.Embedding(env[ins[1]], env[ins[0]])
+        elif op in ("Add", "Sub", "Mul", "Div", "Pow", "Max", "Min"):
+            table = {"Add": "add", "Sub": "subtract", "Mul": "multiply",
+                     "Div": "divide", "Pow": "power", "Max": "maximum",
+                     "Min": "minimum"}
+            out = getattr(mx.sym, table[op])(*x)
+        elif op in ("Neg", "Exp", "Log", "Sqrt", "Abs"):
+            table = {"Neg": "negative", "Exp": "exp", "Log": "log",
+                     "Sqrt": "sqrt", "Abs": "abs"}
+            out = getattr(mx.sym, table[op])(*x)
+        elif op in ("ReduceMean", "ReduceSum"):
+            fn = mx.sym.mean if op == "ReduceMean" else mx.sym.sum
+            axes = attrs.get("axes")
+            out = fn(*x, axis=tuple(axes) if axes else None,
+                     keepdims=bool(attrs.get("keepdims", 0)))
+        elif op == "Identity":
+            out = env[ins[0]]
+        else:
+            raise MXNetError(f"ONNX op '{op}' has no import mapping")
+        env[outs[0]] = out
+
+    out_syms = []
+    for vi in g.get(12, []):
+        name, _ = _decode_value_info(vi)
+        if name not in env:
+            raise MXNetError(f"graph output '{name}' was never produced")
+        out_syms.append(env[name])
+    sym = out_syms[0] if len(out_syms) == 1 else mx.sym.Group(out_syms)
+    return sym, params, sym_inputs
 
 
 def import_model(model_file: str):
-    onnx = _require_onnx()
-    raise MXNetError(
-        "ONNX import mapping is not implemented for this backend yet; "
-        "use SymbolBlock.imports on a StableHLO export")
+    """Load an ONNX file -> (sym, arg_params, aux_params)
+    (ref onnx2mx/import_model.py)."""
+    with open(model_file, "rb") as f:
+        m = decode_message(f.read())
+    sym, params, _ = _import_graph(m[7][0])
+    return sym, params, {}
+
+
+def get_model_metadata(model_file: str):
+    """Input/output names+shapes (ref onnx2mx/import_model.py
+    get_model_metadata)."""
+    with open(model_file, "rb") as f:
+        m = decode_message(f.read())
+    g = decode_message(m[7][0])
+    init_names = {decode_message(t)[8][0].decode() for t in g.get(5, [])}
+    ins = [_decode_value_info(vi) for vi in g.get(11, [])]
+    outs = [_decode_value_info(vi) for vi in g.get(12, [])]
+    return {"input_tensor_data": [i for i in ins if i[0] not in init_names],
+            "output_tensor_data": outs}
+
+
+def import_to_gluon(model_file: str, ctx=None):
+    """ONNX -> callable binding the imported params
+    (ref onnx2mx/import_to_gluon.py)."""
+    sym, params, _ = import_model(model_file)
+    meta = get_model_metadata(model_file)
+
+    def forward(*args):
+        feed = {n: a for (n, _), a in zip(meta["input_tensor_data"], args)}
+        feed.update(params)
+        return sym.eval(**feed)
+
+    return forward

@@ -147,6 +147,14 @@ class NDArray:
     def stype(self) -> str:
         return "default"  # sparse storage types are handled in ndarray.sparse
 
+    def tostype(self, stype: str):
+        """Convert to a storage type (ref ndarray.py tostype ->
+        cast_storage); 'default' is identity, sparse types return the
+        classes from ``mx.nd.sparse``."""
+        from .sparse import cast_storage
+
+        return cast_storage(self, stype)
+
     # -- host interop ------------------------------------------------------
     def asnumpy(self) -> _onp.ndarray:
         """Blocking device→host copy (ref ndarray.h SyncCopyToCPU)."""
@@ -422,21 +430,23 @@ class NDArray:
         return self._binary(o, lambda a, b: a >= b, "greater_equal")
 
     # -- shape ops as methods ---------------------------------------------
-    def _unary_method(self, jfn, name, **kwargs):
+    def _unary_method(self, jfn, name, _attrs=None, **kwargs):
         from ..ops.dispatch import call
 
-        return call(jfn, (self,), kwargs, name=name)
+        return call(jfn, (self,), kwargs, name=name, attrs=_attrs)
 
     def reshape(self, *shape, **kwargs):
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
-        return self._unary_method(lambda x: jnp.reshape(x, shape), "reshape")
+        return self._unary_method(lambda x: jnp.reshape(x, shape), "reshape",
+                                  _attrs={"newshape": list(shape)})
 
     def transpose(self, *axes):
         if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
             axes = tuple(axes[0])
         ax = axes if axes else None
-        return self._unary_method(lambda x: jnp.transpose(x, ax), "transpose")
+        return self._unary_method(lambda x: jnp.transpose(x, ax), "transpose",
+                                  _attrs={"axes": list(ax) if ax else None})
 
     def swapaxes(self, a1, a2):
         return self._unary_method(lambda x: jnp.swapaxes(x, a1, a2), "swapaxes")

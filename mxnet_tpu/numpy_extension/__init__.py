@@ -50,7 +50,8 @@ from ..ndarray.utils import load, save  # noqa: E402
 # -- activations -------------------------------------------------------------
 
 def activation(data, act_type: str = "relu", **kw):
-    return call(lambda x: _nn.activation(x, act_type), (data,), {}, name=f"activation_{act_type}")
+    return call(lambda x: _nn.activation(x, act_type), (data,), {},
+                name=f"activation_{act_type}", attrs={"act_type": act_type})
 
 
 def leaky_relu(data, gamma=None, act_type: str = "leaky", slope: float = 0.25,
@@ -65,7 +66,8 @@ def leaky_relu(data, gamma=None, act_type: str = "leaky", slope: float = 0.25,
                               lower_bound=lower_bound, upper_bound=upper_bound,
                               rng_key=key)
 
-    return call(f, args, {}, name=f"leaky_relu_{act_type}")
+    return call(f, args, {}, name=f"leaky_relu_{act_type}",
+                attrs={"act_type": act_type, "slope": slope})
 
 
 relu = wrap_op(jax.nn.relu, "relu")
@@ -86,7 +88,9 @@ def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
     def f(xx, ww, bb=None):
         return _nn.fully_connected(xx, ww, bb, no_bias=no_bias, flatten=flatten)
 
-    return call(f, args, {}, name="fully_connected")
+    return call(f, args, {}, name="fully_connected",
+                attrs={"num_hidden": num_hidden, "no_bias": no_bias,
+                       "flatten": flatten})
 
 
 def convolution(data, weight, bias=None, kernel=None, stride=1, dilate=1,
@@ -99,7 +103,11 @@ def convolution(data, weight, bias=None, kernel=None, stride=1, dilate=1,
                                num_group=num_group, no_bias=no_bias,
                                layout=layout)
 
-    return call(f, args, {}, name="convolution")
+    return call(f, args, {}, name="convolution",
+                attrs={"kernel": kernel, "stride": stride, "dilate": dilate,
+                       "pad": pad, "num_filter": num_filter,
+                       "num_group": num_group, "no_bias": no_bias,
+                       "layout": layout})
 
 
 def deconvolution(data, weight, bias=None, kernel=None, stride=1, dilate=1,
@@ -112,7 +120,11 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=1, dilate=1,
                                  adj=adj, num_group=num_group, no_bias=no_bias,
                                  target_shape=target_shape, layout=layout)
 
-    return call(f, args, {}, name="deconvolution")
+    return call(f, args, {}, name="deconvolution",
+                attrs={"kernel": kernel, "stride": stride, "dilate": dilate,
+                       "pad": pad, "adj": adj, "num_filter": num_filter,
+                       "num_group": num_group, "no_bias": no_bias,
+                       "target_shape": target_shape, "layout": layout})
 
 
 def pooling(data, kernel=1, pool_type="max", stride=None, pad=0,
@@ -123,7 +135,12 @@ def pooling(data, kernel=1, pool_type="max", stride=None, pad=0,
                                       count_include_pad=count_include_pad,
                                       pooling_convention=pooling_convention,
                                       layout=layout),
-                (data,), {}, name=f"pooling_{pool_type}")
+                (data,), {}, name=f"pooling_{pool_type}",
+                attrs={"kernel": kernel, "pool_type": pool_type,
+                       "stride": stride, "pad": pad,
+                       "global_pool": global_pool,
+                       "pooling_convention": pooling_convention,
+                       "layout": layout})
 
 
 def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
@@ -135,7 +152,10 @@ def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
         res = call(lambda xx, g, b, m, v: _nn.batch_norm_train(
             xx, g, b, m, v, eps=eps, momentum=momentum, axis=axis,
             fix_gamma=fix_gamma),
-            (x, gamma, beta, running_mean, running_var), {}, name="batch_norm")
+            (x, gamma, beta, running_mean, running_var), {},
+            name="batch_norm",
+            attrs={"eps": eps, "momentum": momentum, "axis": axis,
+                   "fix_gamma": fix_gamma})
         out, new_mean, new_var = res
         running_mean._set_data(jax.lax.stop_gradient(new_mean._data))
         running_var._set_data(jax.lax.stop_gradient(new_var._data))
@@ -144,7 +164,9 @@ def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
         return out
     out = call(lambda xx, g, b, m, v: _nn.batch_norm_infer(
         xx, g, b, m, v, eps=eps, axis=axis, fix_gamma=fix_gamma),
-        (x, gamma, beta, running_mean, running_var), {}, name="batch_norm")
+        (x, gamma, beta, running_mean, running_var), {}, name="batch_norm",
+        attrs={"eps": eps, "momentum": momentum, "axis": axis,
+               "fix_gamma": fix_gamma})
     if output_mean_var:
         return out, running_mean, running_var
     return out
@@ -152,7 +174,8 @@ def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
 
 def layer_norm(x, gamma, beta, axis=-1, eps=1e-5, **kw):
     return call(lambda xx, g, b: _nn.layer_norm(xx, g, b, axis=axis, eps=eps),
-                (x, gamma, beta), {}, name="layer_norm")
+                (x, gamma, beta), {}, name="layer_norm",
+                attrs={"axis": axis, "eps": eps})
 
 
 def group_norm(x, gamma, beta, num_groups=1, eps=1e-5, **kw):
@@ -175,7 +198,8 @@ def dropout(data, p=0.5, mode="training", axes=(), **kw):
     if p <= 0.0:
         return data
     key = next_key()
-    return call(lambda x: _nn.dropout(x, key, p=p, axes=axes), (data,), {}, name="dropout")
+    return call(lambda x: _nn.dropout(x, key, p=p, axes=axes), (data,), {},
+                name="dropout", attrs={"p": p})
 
 
 # -- softmax -----------------------------------------------------------------
@@ -186,12 +210,12 @@ def softmax(data, axis=-1, length=None, temperature=None, use_length=False, **kw
                                              length=l, use_length=True),
                     (data, length), {}, name="softmax")
     return call(lambda x: _nn.softmax(x, axis=axis, temperature=temperature),
-                (data,), {}, name="softmax")
+                (data,), {}, name="softmax", attrs={"axis": axis})
 
 
 def log_softmax(data, axis=-1, temperature=None, **kw):
     return call(lambda x: _nn.log_softmax(x, axis=axis, temperature=temperature),
-                (data,), {}, name="log_softmax")
+                (data,), {}, name="log_softmax", attrs={"axis": axis})
 
 
 def masked_softmax(data, mask, axis=-1, temperature=1.0, **kw):
@@ -213,7 +237,9 @@ def softmax_cross_entropy(logits, labels, sparse_label=True, axis=-1, **kw):
 # -- indexing / misc ---------------------------------------------------------
 
 def embedding(data, weight, input_dim=None, output_dim=None, sparse_grad=False, **kw):
-    return call(lambda i, w: _nn.embedding(i, w), (data, weight), {}, name="embedding")
+    return call(lambda i, w: _nn.embedding(i, w), (data, weight), {},
+                name="embedding",
+                attrs={"input_dim": input_dim, "output_dim": output_dim})
 
 
 def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32", **kw):

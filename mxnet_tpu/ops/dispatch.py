@@ -38,10 +38,11 @@ _DC_STATE = threading.local()
 
 
 class _DCNode:
-    __slots__ = ("fn", "inputs", "name", "n_out", "token")
+    __slots__ = ("fn", "inputs", "name", "n_out", "token", "attrs")
 
-    def __init__(self, fn, inputs, name, n_out, token):
+    def __init__(self, fn, inputs, name, n_out, token, attrs=None):
         self.fn = fn
+        self.attrs = attrs or {}
         # inputs are SNAPSHOT pairs (ndarray, its _dc_entry at record time):
         # in-place ops rebind the array's stamp to the new node, so reading
         # stamps later would see the consumer instead of the producer (a
@@ -83,7 +84,7 @@ def _is_inexact(x) -> bool:
 
 
 def invoke(fn: Callable, inputs: Sequence, name: str = "op",
-           n_out: Optional[int] = None, out=None):
+           n_out: Optional[int] = None, out=None, attrs=None):
     """Execute ``fn(*raw_inputs)``, recording a tape node when autograd is on.
 
     ``fn`` must be a pure jax function of exactly the raw arrays of
@@ -124,7 +125,8 @@ def invoke(fn: Callable, inputs: Sequence, name: str = "op",
 
     if is_deferred_compute():
         snap = [(x, getattr(x, "_dc_entry", None)) for x in inputs]
-        dc = _DCNode(fn, snap, name, len(outs_raw), _DC_STATE.token)
+        dc = _DCNode(fn, snap, name, len(outs_raw), _DC_STATE.token,
+                     attrs=attrs)
         for i, nd in enumerate(outs):
             nd._dc_entry = (dc, i)
 
@@ -140,11 +142,34 @@ def invoke(fn: Callable, inputs: Sequence, name: str = "op",
     return outs[0] if single else tuple(outs)
 
 
-def call(fn: Callable, args: Tuple, kwargs: dict, name: str = "op", out=None):
+def _jsonable(v) -> bool:
+    if isinstance(v, (bool, int, float, str, type(None))):
+        return True
+    if isinstance(v, (tuple, list)):
+        return all(_jsonable(e) for e in v)
+    return False
+
+
+def call(fn: Callable, args: Tuple, kwargs: dict, name: str = "op", out=None,
+         attrs: Optional[dict] = None):
     """Invoke ``fn`` on a mixed arg list: NDArrays become differentiable
     inputs, everything else is closed over (the analogue of dmlc::Parameter
-    op params, SURVEY.md §2.2)."""
+    op params, SURVEY.md §2.2). JSON-able kwargs (plus scalar positionals,
+    plus any explicit ``attrs`` from wrappers that close over their config)
+    ride along as graph attrs so deferred-compute traces keep op
+    parameters — the Symbol/ONNX layers read them back."""
     from ..ndarray import NDArray
+
+    if is_deferred_compute():  # attrs are only read by symbol tracing;
+        # building them on eager dispatch would tax the op hot path
+        auto = {k: v for k, v in kwargs.items() if _jsonable(v)}
+        auto.update({f"__arg{i}": a for i, a in enumerate(args)
+                     if not isinstance(a, NDArray) and _jsonable(a)})
+        if attrs:
+            auto.update({k: v for k, v in attrs.items() if _jsonable(v)})
+        attrs = auto
+    else:
+        attrs = None
 
     nd_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
     nd_kw = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
@@ -152,7 +177,8 @@ def call(fn: Callable, args: Tuple, kwargs: dict, name: str = "op", out=None):
     if not nd_args:
         if is_deferred_compute():
             # record creation ops as nullary graph nodes
-            return invoke(lambda: fn(*args, **kwargs), [], name=name, out=out)
+            return invoke(lambda: fn(*args, **kwargs), [], name=name,
+                          out=out, attrs=attrs)
         # pure creation/config op
         res = fn(*args, **kwargs)
         single = not isinstance(res, (tuple, list))
@@ -172,7 +198,7 @@ def call(fn: Callable, args: Tuple, kwargs: dict, name: str = "op", out=None):
             kw[k] = x
         return fn(*full, **kw)
 
-    return invoke(f, nd_args, name=name, out=out)
+    return invoke(f, nd_args, name=name, out=out, attrs=attrs)
 
 
 def wrap_op(jfn: Callable, name: Optional[str] = None):

@@ -260,18 +260,33 @@ def batch_norm_train(x, gamma, beta, moving_mean, moving_var,
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
     axes = tuple(i for i in range(x.ndim) if i != axis)
+    stat_dt = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.astype(stat_dt)
     if use_global_stats:
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
     else:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
-        new_mean = moving_mean * momentum + mean * (1 - momentum)
-        new_var = moving_var * momentum + var * (1 - momentum)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        # running stats keep their own dtype (f32 master buffers): the f32
+        # blend would otherwise silently promote bf16 stat buffers, changing
+        # checkpoint dtypes and the jit input signature
+        new_mean = (moving_mean * momentum
+                    + mean * (1 - momentum)).astype(moving_mean.dtype)
+        new_var = (moving_var * momentum
+                   + var * (1 - momentum)).astype(moving_var.dtype)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    inv = lax.rsqrt(var + eps).reshape(shape)
-    out = (x - mean.reshape(shape)) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    # statistics in f32 for numeric safety, but the big activation tensor
+    # is touched ONLY in its own dtype: fold (mean, var, gamma, beta) into
+    # per-channel scale/shift f32 vectors, cast those C-sized vectors down,
+    # apply. Under bf16 compute this keeps every NHWC intermediate bf16 —
+    # mixing f32 scalars into the affine would promote the whole tensor to
+    # f32 and double HBM traffic on an HBM-bound step (TPU perf note).
+    inv = lax.rsqrt(var + eps)
+    scale = (gamma * inv).astype(x.dtype).reshape(shape)
+    shift = (beta - mean * gamma * inv).astype(x.dtype).reshape(shape)
+    out = x * scale + shift
     return out, new_mean, new_var
 
 
@@ -282,8 +297,12 @@ def batch_norm_infer(x, gamma, beta, moving_mean, moving_var,
         gamma = jnp.ones_like(gamma)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    inv = lax.rsqrt(moving_var + eps).reshape(shape)
-    return (x - moving_mean.reshape(shape)) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    # same dtype discipline as batch_norm_train: fold stats to per-channel
+    # scale/shift, cast the small vectors, keep the activation in x.dtype
+    inv = lax.rsqrt(moving_var + eps)
+    scale = (gamma * inv).astype(x.dtype).reshape(shape)
+    shift = (beta - moving_mean * gamma * inv).astype(x.dtype).reshape(shape)
+    return x * scale + shift
 
 
 def layer_norm(x, gamma, beta, axis: int = -1, eps: float = 1e-5):

@@ -619,8 +619,8 @@ def trace(fn: Callable, example_inputs: Sequence, input_names=None,
         if id(dc) in nodes:
             return (nodes[id(dc)], idx)
         ins = [node_for(x, e) for x, e in dc.inputs]
-        n = _Node(_unique(dc.name + "_"), dc.name, {}, ins, fn=dc.fn,
-                  n_out=dc.n_out)
+        n = _Node(_unique(dc.name + "_"), dc.name, dict(dc.attrs), ins,
+                  fn=dc.fn, n_out=dc.n_out)
         nodes[id(dc)] = n
         return (n, idx)
 

@@ -106,8 +106,12 @@ def test_custom_op_registry_errors():
             pass
 
 
-def test_onnx_gated():
+def test_onnx_unmapped_op_raises():
+    # contrib.onnx is a real wire-level exporter now (tests/test_onnx.py);
+    # the gate that remains is a clear error for ops outside the mapped set
     from mxnet_tpu.contrib import onnx as monnx
-    net = mx.gluon.nn.Dense(2)
-    with pytest.raises(MXNetError, match="onnx|StableHLO"):
-        monnx.export_model(net, "/tmp/x", [(1, 4)])
+    a = mx.sym.Variable("a")
+    out = mx.sym.sin(a)
+    with pytest.raises(MXNetError, match="no ONNX mapping"):
+        monnx.export_model(out, {}, [(2, 2)],
+                           onnx_file_path="/tmp/never.onnx")
