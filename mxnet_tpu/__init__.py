@@ -56,6 +56,7 @@ from . import symbol as sym
 from . import visualization
 from . import visualization as viz
 from . import model
+from . import _ffi
 from . import contrib
 from . import parallel
 from . import test_utils

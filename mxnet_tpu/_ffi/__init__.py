@@ -1,0 +1,17 @@
+"""Packed-function FFI — Python side of the registry runtime.
+
+Reference: python/mxnet/_ffi/function.py:46 (Function over the TVM-style
+registry; ctypes and Cython variants). Here: ctypes only, over the native
+registry in src/mxtpu/registry.cc. Functions registered from C++ are
+callable from Python and vice versa — Python callables registered through
+``register_func`` are wrapped in a CFUNCTYPE trampoline and become
+visible to native callers under the same name.
+
+Supported value types: int, float, str, bytes-as-handle-free (opaque
+pointers as int), None.
+"""
+from .function import (Function, get_global_func, list_global_func_names,
+                       register_func, remove_global_func)
+
+__all__ = ["Function", "get_global_func", "list_global_func_names",
+           "register_func", "remove_global_func"]

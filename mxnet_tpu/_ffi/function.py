@@ -1,0 +1,215 @@
+"""ctypes bridge to the native PackedFunc registry (src/mxtpu/registry.cc).
+
+Reference: python/mxnet/_ffi/function.py (Function, get_global_func,
+register_func, list_global_func_names over the new-FFI runtime).
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..base import MXNetError
+
+# type codes — keep in sync with src/mxtpu/registry.h
+K_INT, K_FLOAT, K_HANDLE, K_STR, K_NULL = 0, 1, 2, 3, 4
+
+
+class FFIValue(ctypes.Union):
+    _fields_ = [("v_int", ctypes.c_int64),
+                ("v_float", ctypes.c_double),
+                ("v_handle", ctypes.c_void_p),
+                ("v_str", ctypes.c_char_p)]
+
+
+PACKED_CFN = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.POINTER(FFIValue), ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+    ctypes.POINTER(FFIValue), ctypes.POINTER(ctypes.c_int), ctypes.c_void_p)
+
+
+def _lib():
+    from .._native import get_lib
+
+    lib = get_lib()
+    if lib is None:
+        raise MXNetError("native runtime not available; FFI registry needs "
+                         "the compiled libmxtpu")
+    if not getattr(lib, "_ffi_bound", False):
+        c = ctypes
+        lib.MXTPUFuncRegister.restype = c.c_int
+        lib.MXTPUFuncRegister.argtypes = [c.c_char_p, PACKED_CFN,
+                                          c.c_void_p, c.c_int]
+        lib.MXTPUFuncRemove.restype = c.c_int
+        lib.MXTPUFuncRemove.argtypes = [c.c_char_p]
+        lib.MXTPUFuncGet.restype = c.c_void_p
+        lib.MXTPUFuncGet.argtypes = [c.c_char_p]
+        lib.MXTPUFuncCall.restype = c.c_int
+        lib.MXTPUFuncCall.argtypes = [c.c_void_p, c.POINTER(FFIValue),
+                                      c.POINTER(c.c_int), c.c_int,
+                                      c.POINTER(FFIValue),
+                                      c.POINTER(c.c_int)]
+        lib.MXTPUFuncListNames.restype = c.c_int
+        lib.MXTPUFuncListNames.argtypes = [c.POINTER(c.c_char_p), c.c_int]
+        lib.MXTPUSetLastError.restype = None
+        lib.MXTPUSetLastError.argtypes = [c.c_char_p]
+        lib._ffi_bound = True
+    return lib
+
+
+def _pack(args):
+    """Python args -> (FFIValue array, type-code array, keepalive list)."""
+    vals = (FFIValue * max(1, len(args)))()
+    codes = (ctypes.c_int * max(1, len(args)))()
+    keep: List[Any] = []
+    for i, a in enumerate(args):
+        if a is None:
+            codes[i] = K_NULL
+            vals[i].v_int = 0
+        elif isinstance(a, bool) or isinstance(a, int):
+            codes[i] = K_INT
+            vals[i].v_int = int(a)
+        elif isinstance(a, float):
+            codes[i] = K_FLOAT
+            vals[i].v_float = a
+        elif isinstance(a, str):
+            b = a.encode()
+            keep.append(b)
+            codes[i] = K_STR
+            vals[i].v_str = b
+        else:
+            raise MXNetError(
+                f"FFI argument type {type(a).__name__} is not packable "
+                f"(int/float/str/None)")
+    return vals, codes, keep
+
+
+def _unpack(val: FFIValue, code: int):
+    if code == K_INT:
+        return val.v_int
+    if code == K_FLOAT:
+        return val.v_float
+    if code == K_STR:
+        return val.v_str.decode() if val.v_str else ""
+    if code == K_HANDLE:
+        return val.v_handle
+    return None
+
+
+class Function:
+    """Callable handle to a registered packed function
+    (ref _ffi/function.py Function)."""
+
+    def __init__(self, handle, name: str = "<unnamed>"):
+        self._handle = handle
+        self.name = name
+
+    def __call__(self, *args):
+        lib = _lib()
+        vals, codes, keep = _pack(args)
+        ret = FFIValue()
+        ret_code = ctypes.c_int(K_NULL)
+        rc = lib.MXTPUFuncCall(self._handle, vals, codes, len(args),
+                               ctypes.byref(ret), ctypes.byref(ret_code))
+        if rc != 0:
+            raise MXNetError(lib.MXTPUGetLastError().decode())
+        del keep
+        return _unpack(ret, ret_code.value)
+
+    def __repr__(self):
+        return f"<ffi.Function {self.name}>"
+
+
+def get_global_func(name: str,
+                    allow_missing: bool = False) -> Optional[Function]:
+    """Look a function up by name (ref _ffi/function.py get_global_func)."""
+    lib = _lib()
+    h = lib.MXTPUFuncGet(name.encode())
+    if not h:
+        if allow_missing:
+            return None
+        raise MXNetError(f"no such global function: {name}")
+    return Function(h, name)
+
+
+def list_global_func_names() -> List[str]:
+    lib = _lib()
+    n = lib.MXTPUFuncListNames(None, 0)
+    arr = (ctypes.c_char_p * n)()
+    lib.MXTPUFuncListNames(arr, n)
+    return [s.decode() for s in arr[:n] if s]
+
+
+# Python-registered callables: trampolines must outlive the registration
+_py_funcs: Dict[str, Any] = {}
+_py_lock = threading.Lock()
+# one FFI string return per trampoline call kept alive until the next call
+_ret_keepalive: Dict[str, bytes] = {}
+
+
+def register_func(name_or_fn, fn: Optional[Callable] = None,
+                  override: bool = True):
+    """Register a Python callable under ``name`` so native (and Python)
+    callers can invoke it (ref _ffi/function.py register_func). Usable as
+    a decorator: ``@register_func("mypkg.myfn")``."""
+    if callable(name_or_fn) and fn is None:
+        return register_func(name_or_fn.__name__, name_or_fn,
+                             override=override)
+    name = name_or_fn
+    if fn is None:
+        def deco(f):
+            register_func(name, f, override=override)
+            return f
+        return deco
+
+    def trampoline(args_p, codes_p, n, ret_p, ret_code_p, _ctx):
+        try:
+            args = [_unpack(args_p[i], codes_p[i]) for i in range(n)]
+            out = fn(*args)
+            if out is None:
+                ret_code_p[0] = K_NULL
+            elif isinstance(out, bool) or isinstance(out, int):
+                ret_p[0].v_int = int(out)
+                ret_code_p[0] = K_INT
+            elif isinstance(out, float):
+                ret_p[0].v_float = out
+                ret_code_p[0] = K_FLOAT
+            elif isinstance(out, str):
+                b = out.encode()
+                with _py_lock:
+                    _ret_keepalive[name] = b
+                ret_p[0].v_str = b
+                ret_code_p[0] = K_STR
+            else:
+                raise MXNetError(
+                    f"FFI return type {type(out).__name__} not packable")
+            return 0
+        except Exception as e:
+            # surface the real Python error through the native last-error
+            # channel — a bare -1 would make the caller read whatever
+            # stale message the thread-local buffer held
+            try:
+                _lib().MXTPUSetLastError(
+                    f"{type(e).__name__}: {e}".encode())
+            except Exception:
+                pass
+            return -1
+
+    cfn = PACKED_CFN(trampoline)
+    lib = _lib()
+    rc = lib.MXTPUFuncRegister(name.encode(), cfn, None,
+                               1 if override else 0)
+    if rc != 0:
+        raise MXNetError(lib.MXTPUGetLastError().decode())
+    with _py_lock:
+        _py_funcs[name] = (cfn, fn)
+    return fn
+
+
+def remove_global_func(name: str):
+    lib = _lib()
+    if lib.MXTPUFuncRemove(name.encode()) != 0:
+        raise MXNetError(lib.MXTPUGetLastError().decode())
+    with _py_lock:
+        _py_funcs.pop(name, None)
+        _ret_keepalive.pop(name, None)

@@ -205,11 +205,14 @@ class _Exporter:
             act = a.get("act_type", "relu")
             table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
                      "softsign": "Softsign", "elu": "Elu", "selu": "Selu",
-                     "gelu": "Gelu", "leaky": "LeakyRelu"}
+                     "leaky": "LeakyRelu"}
             if act not in table:
+                # gelu deliberately excluded: no default-domain Gelu
+                # until opset 20
                 raise MXNetError(f"activation '{act}' has no ONNX mapping")
             attrs = []
-            if act == "leaky":
+            if act in ("leaky", "elu"):
+                # ops/nn.py leaky_relu uses `slope` as the elu alpha too
                 attrs = [_attr_float("alpha", float(a.get("slope", 0.25)))]
             self.add(table[act], in_names[:1], out_name,
                      self.uid(table[act]), attrs)
@@ -388,12 +391,26 @@ def _decode_attr(buf: bytes):
     if at == _AT_STRING:
         return name, f.get(4, [b""])[0].decode()
     if at == _AT_INTS:
-        return name, [v if v < (1 << 63) else v - (1 << 64)
-                      for v in f.get(8, [])]
+        vals = []
+        for v in f.get(8, []):
+            if isinstance(v, bytes):  # proto3 packed encoding
+                off = 0
+                while off < len(v):
+                    x, off = decode_varint(v, off)
+                    vals.append(x if x < (1 << 63) else x - (1 << 64))
+            else:
+                vals.append(v if v < (1 << 63) else v - (1 << 64))
+        return name, vals
     if at == _AT_FLOATS:
-        return name, [struct.unpack(
-            "<f", struct.pack("<I", v & 0xFFFFFFFF))[0]
-            for v in f.get(7, [])]
+        fvals = []
+        for v in f.get(7, []):
+            if isinstance(v, bytes):  # packed fixed32
+                fvals.extend(float(x) for x in
+                             onp.frombuffer(v, dtype="<f4"))
+            else:
+                fvals.append(struct.unpack(
+                    "<f", struct.pack("<I", v & 0xFFFFFFFF))[0])
+        return name, fvals
     if at == _AT_TENSOR:
         return name, _decode_tensor(f[5][0])
     return name, None
@@ -478,7 +495,13 @@ def _import_graph(gbuf: bytes):
 
         def pads2(default=(0, 0)):
             p = attrs.get("pads")
-            return tuple(p[:2]) if p else default
+            if not p:
+                return default
+            n2 = len(p) // 2
+            if tuple(p[:n2]) != tuple(p[n2:]):
+                raise MXNetError(
+                    f"asymmetric ONNX pads {p} are not supported")
+            return tuple(p[:2])
 
         if op == "Conv":
             out = mx.sym.Convolution(
@@ -496,6 +519,10 @@ def _import_graph(gbuf: bytes):
         elif op == "Gemm":
             if attrs.get("transB", 0) != 1:
                 raise MXNetError("Gemm without transB=1 unsupported")
+            if attrs.get("transA", 0) != 0:
+                raise MXNetError("Gemm with transA=1 unsupported")
+            if attrs.get("alpha", 1.0) != 1.0 or                     attrs.get("beta", 1.0) != 1.0:
+                raise MXNetError("Gemm with alpha/beta != 1 unsupported")
             out = mx.sym.FullyConnected(*x, num_hidden=0,
                                         no_bias=len(x) < 3, flatten=False)
         elif op == "MatMul":
@@ -516,17 +543,18 @@ def _import_graph(gbuf: bytes):
                 *x, global_pool=True,
                 pool_type="max" if op == "GlobalMaxPool" else "avg")
         elif op in ("Relu", "Sigmoid", "Tanh", "Softsign", "Elu", "Selu",
-                    "Gelu", "LeakyRelu"):
+                    "LeakyRelu"):
             table = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
                      "Softsign": "softsign"}
             if op in table:
                 out = mx.sym.Activation(*x, act_type=table[op])
             else:
-                kind = {"Elu": "elu", "Selu": "selu", "Gelu": "gelu",
+                kind = {"Elu": "elu", "Selu": "selu",
                         "LeakyRelu": "leaky"}[op]
+                default = 1.0 if op == "Elu" else 0.01 if op == "LeakyRelu"                     else 0.25
                 out = mx.sym.LeakyReLU(
                     *x, act_type=kind,
-                    slope=float(attrs.get("alpha", 0.25)))
+                    slope=float(attrs.get("alpha", default)))
         elif op in ("Softmax", "LogSoftmax"):
             fn = mx.sym.softmax if op == "Softmax" else mx.sym.log_softmax
             out = fn(*x, axis=int(attrs.get("axis", -1)))

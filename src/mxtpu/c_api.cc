@@ -9,6 +9,7 @@
 #include <string>
 
 #include "engine.h"
+#include "registry.h"
 
 namespace mxtpu {
 void* StorageAlloc(size_t size);
@@ -183,6 +184,59 @@ int64_t MXTPURecordIOReaderTell(void* r) {
 
 void MXTPURecordIOReaderClose(void* r) {
   mxtpu::ReaderClose(static_cast<mxtpu::RecordIOReader*>(r));
+}
+
+// -- PackedFunc registry (registry.cc; ref src/runtime/registry.cc) ---------
+
+int MXTPUFuncRegister(const char* name, mxtpu::PackedCFn fn, void* ctx,
+                      int override_existing) {
+  if (mxtpu::RegistryRegister(name, fn, ctx, override_existing) != 0)
+    return Fail(std::string("function already registered: ") + name);
+  return 0;
+}
+
+int MXTPUFuncRemove(const char* name) {
+  if (mxtpu::RegistryRemove(name) != 0)
+    return Fail(std::string("no such function: ") + name);
+  return 0;
+}
+
+// returns an opaque handle (the registry entry) or NULL
+const void* MXTPUFuncGet(const char* name) {
+  const mxtpu::Entry* e = mxtpu::RegistryGet(name);
+  if (e == nullptr) Fail(std::string("no such function: ") + name);
+  return e;
+}
+
+void MXTPUSetLastError(const char* msg) { last_error = msg ? msg : ""; }
+
+int MXTPUFuncCall(const void* handle, const mxtpu::FFIValue* args,
+                  const int* type_codes, int num_args,
+                  mxtpu::FFIValue* ret, int* ret_type) {
+  const auto* e = static_cast<const mxtpu::Entry*>(handle);
+  if (e == nullptr) return Fail("null function handle");
+  if (e->fn == nullptr)
+    return Fail("function handle is stale (removed or overridden)");
+  *ret_type = mxtpu::kNull;
+  try {
+    if (e->fn(args, type_codes, num_args, ret, ret_type, e->ctx) != 0)
+      return -1;  // handler set the error
+    return 0;
+  } catch (const std::exception& ex) {
+    return Fail(ex.what());
+  }
+}
+
+// caller provides out array of char* of size max_names; returns count
+int MXTPUFuncListNames(const char** out, int max_names) {
+  auto names = mxtpu::RegistryList();
+  mxtpu::BeginListIntern();
+  int n = 0;
+  for (const auto& s : names) {
+    if (n >= max_names) break;
+    out[n++] = mxtpu::InternListStr(s);
+  }
+  return static_cast<int>(names.size());
 }
 
 }  // extern "C"

@@ -1,0 +1,153 @@
+// PackedFunc registry — the new-FFI runtime analogue.
+//
+// Counterpart of the reference's TVM-style function registry
+// (src/runtime/registry.cc:40-74, c_runtime_api.cc:52-64): named functions
+// callable through ONE uniform C calling convention, registrable from both
+// C++ and the language binding (Python callbacks), discoverable by name.
+// The reference routes every modern `_npi.*` op through this; here the op
+// corpus rides jax, so the registry serves the same role the reference's
+// does for *runtime services*: native entry points (storage stats, engine
+// info) and user extension functions share one dispatch surface.
+//
+// Value convention (MXTPUValue): tagged union of int64/double/ptr/c-str.
+// Handlers receive (args, type_codes, n, ret_value, ret_type, ctx) and
+// return 0 or -1 with the thread-local error set.
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "registry.h"
+
+namespace mxtpu {
+
+namespace {
+std::mutex reg_mu;
+// values are owning raw pointers, intentionally leaked on remove/override
+// so handed-out handles never dangle (see Entry doc in registry.h)
+std::map<std::string, Entry*>& Table() {
+  // heap-allocated and never destructed: the map's exit-time destructor
+  // would orphan the Entry pointers right before LSAN's leak check
+  static auto* table = new std::map<std::string, Entry*>();
+  return *table;
+}
+// tombstoned entries stay rooted here so (a) stale handles never dangle
+// and (b) LSAN sees them as reachable, not leaked
+std::vector<Entry*>& Graveyard() {
+  static auto* g = new std::vector<Entry*>();
+  return *g;
+}
+// interned return-string storage: FFI string returns must outlive the call
+thread_local std::string ret_str_buf;
+}  // namespace
+
+int RegistryRegister(const char* name, PackedCFn fn, void* ctx,
+                     int override_existing) {
+  std::lock_guard<std::mutex> lk(reg_mu);
+  auto& t = Table();
+  auto it = t.find(name);
+  if (it != t.end()) {
+    if (!override_existing) return -1;
+    it->second->fn = nullptr;  // tombstone the old entry for stale handles
+    Graveyard().push_back(it->second);
+    it->second = new Entry{fn, ctx};
+    return 0;
+  }
+  t[name] = new Entry{fn, ctx};
+  return 0;
+}
+
+int RegistryRemove(const char* name) {
+  std::lock_guard<std::mutex> lk(reg_mu);
+  auto& t = Table();
+  auto it = t.find(name);
+  if (it == t.end()) return -1;
+  it->second->fn = nullptr;  // tombstone; entry stays alive for old handles
+  Graveyard().push_back(it->second);
+  t.erase(it);
+  return 0;
+}
+
+const Entry* RegistryGet(const char* name) {
+  std::lock_guard<std::mutex> lk(reg_mu);
+  auto& t = Table();
+  auto it = t.find(name);
+  return it == t.end() ? nullptr : it->second;
+}
+
+std::vector<std::string> RegistryList() {
+  std::lock_guard<std::mutex> lk(reg_mu);
+  std::vector<std::string> names;
+  for (auto& kv : Table()) names.push_back(kv.first);
+  return names;
+}
+
+void RegistrySetError(const char* msg);  // defined in c_api.cc
+
+const char* InternRetStr(const std::string& s) {
+  ret_str_buf = s;
+  return ret_str_buf.c_str();
+}
+
+// list-return interning: each call to BeginListIntern resets the arena;
+// pointers stay valid until the next Begin on the same thread
+namespace {
+thread_local std::vector<std::string> list_arena;
+}
+
+void BeginListIntern() { list_arena.clear(); }
+
+const char* InternListStr(const std::string& s) {
+  list_arena.push_back(s);
+  return list_arena.back().c_str();
+}
+
+// -- built-in registered functions ------------------------------------------
+
+void StorageStats(int64_t* used, int64_t* pooled, int64_t* allocs,
+                  int64_t* hits);
+
+namespace {
+
+int BuiltinStoragePooledBytes(const FFIValue*, const int*, int,
+                              FFIValue* ret, int* ret_type, void*) {
+  int64_t used, pooled, allocs, hits;
+  StorageStats(&used, &pooled, &allocs, &hits);
+  ret->v_int = pooled;
+  *ret_type = kInt;
+  return 0;
+}
+
+int BuiltinRuntimeVersion(const FFIValue*, const int*, int, FFIValue* ret,
+                          int* ret_type, void*) {
+  ret->v_str = InternRetStr("mxtpu-2.0");
+  *ret_type = kStr;
+  return 0;
+}
+
+int BuiltinEcho(const FFIValue* args, const int* type_codes, int num_args,
+                FFIValue* ret, int* ret_type, void*) {
+  // identity on the first arg — the calling-convention conformance probe
+  if (num_args < 1) {
+    ret->v_int = 0;
+    *ret_type = kNull;
+    return 0;
+  }
+  *ret = args[0];
+  *ret_type = type_codes[0];
+  return 0;
+}
+
+struct BuiltinInit {
+  BuiltinInit() {
+    RegistryRegister("runtime.StoragePooledBytes", BuiltinStoragePooledBytes,
+                     nullptr, 1);
+    RegistryRegister("runtime.Version", BuiltinRuntimeVersion, nullptr, 1);
+    RegistryRegister("testing.Echo", BuiltinEcho, nullptr, 1);
+  }
+} builtin_init;
+
+}  // namespace
+}  // namespace mxtpu

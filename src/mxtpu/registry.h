@@ -1,0 +1,51 @@
+// PackedFunc registry interface — see registry.cc for the design notes
+// (ref src/runtime/registry.cc, c_runtime_api.cc).
+#ifndef MXTPU_REGISTRY_H_
+#define MXTPU_REGISTRY_H_
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+// type codes (mirror a minimal TVMArgTypeCode set)
+enum : int {
+  kInt = 0,
+  kFloat = 1,
+  kHandle = 2,
+  kStr = 3,
+  kNull = 4,
+};
+
+union FFIValue {
+  int64_t v_int;
+  double v_float;
+  void* v_handle;
+  const char* v_str;
+};
+
+typedef int (*PackedCFn)(const FFIValue* args, const int* type_codes,
+                         int num_args, FFIValue* ret, int* ret_type,
+                         void* ctx);
+
+// Entries are heap-allocated and NEVER freed: handles returned to language
+// bindings stay valid forever. Remove/override tombstones the old entry
+// (fn=nullptr) so a stale handle fails cleanly instead of use-after-free.
+struct Entry {
+  PackedCFn fn;
+  void* ctx;
+};
+
+int RegistryRegister(const char* name, PackedCFn fn, void* ctx,
+                     int override_existing);
+int RegistryRemove(const char* name);
+const Entry* RegistryGet(const char* name);
+std::vector<std::string> RegistryList();
+const char* InternRetStr(const std::string& s);
+void BeginListIntern();
+const char* InternListStr(const std::string& s);
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_REGISTRY_H_

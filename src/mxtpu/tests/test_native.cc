@@ -16,6 +16,7 @@
 #include <vector>
 
 #include "../engine.h"
+#include "../registry.h"
 
 namespace mxtpu {
 void* StorageAlloc(size_t size);
@@ -243,7 +244,42 @@ static void TestRecordIORoundtrip() {
   mxtpu::StorageReleaseAll();
 }
 
+static int AddFn(const mxtpu::FFIValue* args, const int* codes, int n,
+                 mxtpu::FFIValue* ret, int* ret_type, void* ctx) {
+  (void)codes;
+  (void)ctx;
+  int64_t acc = 0;
+  for (int i = 0; i < n; ++i) acc += args[i].v_int;
+  ret->v_int = acc;
+  *ret_type = mxtpu::kInt;
+  return 0;
+}
+
+static void TestPackedFuncRegistry() {
+  CHECK_TRUE(mxtpu::RegistryGet("runtime.Version") != nullptr,
+             "builtin registered");
+  CHECK_TRUE(mxtpu::RegistryRegister("t.add", AddFn, nullptr, 0) == 0,
+             "register ok");
+  CHECK_TRUE(mxtpu::RegistryRegister("t.add", AddFn, nullptr, 0) != 0,
+             "duplicate register refused");
+  const mxtpu::Entry* e = mxtpu::RegistryGet("t.add");
+  CHECK_TRUE(e != nullptr, "lookup finds it");
+  mxtpu::FFIValue args[3];
+  int codes[3] = {mxtpu::kInt, mxtpu::kInt, mxtpu::kInt};
+  args[0].v_int = 1;
+  args[1].v_int = 2;
+  args[2].v_int = 39;
+  mxtpu::FFIValue ret;
+  int rt = mxtpu::kNull;
+  CHECK_TRUE(e->fn(args, codes, 3, &ret, &rt, e->ctx) == 0, "call ok");
+  CHECK_TRUE(rt == mxtpu::kInt && ret.v_int == 42, "sum correct");
+  CHECK_TRUE(mxtpu::RegistryRemove("t.add") == 0, "remove ok");
+  CHECK_TRUE(mxtpu::RegistryGet("t.add") == nullptr, "gone after remove");
+  CHECK_TRUE(!mxtpu::RegistryList().empty(), "list non-empty");
+}
+
 int main() {
+  TestPackedFuncRegistry();
   TestDependencyOrdering();
   TestParallelIndependentOps();
   TestErrorPropagationAndSkip();

@@ -1,0 +1,109 @@
+"""Packed-function FFI registry: native builtins, Python registration,
+error propagation, threading.
+
+Reference: the new-FFI runtime tests implied by python/mxnet/_ffi/
+function.py + src/runtime/registry.cc (registry register/get/list).
+"""
+import threading
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _ffi
+from mxnet_tpu.base import MXNetError
+
+
+def test_native_builtins():
+    names = _ffi.list_global_func_names()
+    assert "runtime.Version" in names
+    assert "runtime.StoragePooledBytes" in names
+    assert _ffi.get_global_func("runtime.Version")() == "mxtpu-2.0"
+    assert isinstance(_ffi.get_global_func("runtime.StoragePooledBytes")(),
+                      int)
+
+
+def test_echo_conformance():
+    echo = _ffi.get_global_func("testing.Echo")
+    assert echo(42) == 42
+    assert echo(-1) == -1
+    assert abs(echo(3.25) - 3.25) < 1e-12
+    assert echo("tpu") == "tpu"
+    assert echo(None) is None
+    assert echo() is None
+
+
+def test_missing_function():
+    with pytest.raises(MXNetError, match="no such"):
+        _ffi.get_global_func("definitely.not.there")
+    assert _ffi.get_global_func("definitely.not.there",
+                                allow_missing=True) is None
+
+
+def test_python_registration_roundtrip():
+    @_ffi.register_func("test.mul")
+    def mul(a, b):
+        return a * b
+
+    f = _ffi.get_global_func("test.mul")
+    assert f(6, 7) == 42
+    assert abs(f(2.0, 1.5) - 3.0) < 1e-12
+    assert "test.mul" in _ffi.list_global_func_names()
+    _ffi.remove_global_func("test.mul")
+    assert _ffi.get_global_func("test.mul", allow_missing=True) is None
+    with pytest.raises(MXNetError):
+        _ffi.remove_global_func("test.mul")
+
+
+def test_python_error_propagates():
+    @_ffi.register_func("test.boom")
+    def boom():
+        raise RuntimeError("inner failure")
+
+    try:
+        with pytest.raises(MXNetError):
+            _ffi.get_global_func("test.boom")()
+    finally:
+        _ffi.remove_global_func("test.boom")
+
+
+def test_register_no_override():
+    @_ffi.register_func("test.once")
+    def once():
+        return 1
+
+    try:
+        with pytest.raises(MXNetError, match="already registered"):
+            _ffi.register_func("test.once", lambda: 2, override=False)
+        # override=True replaces
+        _ffi.register_func("test.once", lambda: 3)
+        assert _ffi.get_global_func("test.once")() == 3
+    finally:
+        _ffi.remove_global_func("test.once")
+
+
+def test_concurrent_calls():
+    @_ffi.register_func("test.sq")
+    def sq(x):
+        return x * x
+
+    try:
+        f = _ffi.get_global_func("test.sq")
+        out = [None] * 16
+        errs = []
+
+        def work(i):
+            try:
+                for _ in range(50):
+                    out[i] = f(i)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=work, args=(i,)) for i in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        assert out == [i * i for i in range(16)]
+    finally:
+        _ffi.remove_global_func("test.sq")
