@@ -14,6 +14,7 @@ carrying the step-to-step dependency.
 from __future__ import annotations
 
 import json
+import os
 import time
 import traceback
 
@@ -69,7 +70,12 @@ def bench_resnet50(on_tpu):
     layout = "NHWC" if on_tpu else "NCHW"
 
     mx.random.seed(0)
-    net = mx.gluon.model_zoo.get_model("resnet50_v1", layout=layout)
+    # MXNET_BENCH_STEM=s2d selects the space-to-depth stem variant
+    # (MXU-friendly 3->12 channel packing; PERF.md) — a model variant, so
+    # opt-in; the default row stays the reference-architecture number
+    stem = os.environ.get("MXNET_BENCH_STEM", "default")
+    net = mx.gluon.model_zoo.get_model("resnet50_v1", layout=layout,
+                                       stem_type=stem)
     net.initialize(mx.init.Xavier())
     shape = ((2, image, image, 3) if layout == "NHWC"
              else (2, 3, image, image))
@@ -80,8 +86,6 @@ def bench_resnet50(on_tpu):
     # default (the TPU-native analog of the reference's fp16 rows), fp16
     # with in-step dynamic loss scaling via MXNET_BENCH_DTYPE=fp16; the
     # fp32 baseline row stays the comparison denominator, conservatively.
-    import os
-
     dt = os.environ.get("MXNET_BENCH_DTYPE", "bf16").lower()
     dtypes = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
               "fp16": jnp.float16, "float16": jnp.float16,

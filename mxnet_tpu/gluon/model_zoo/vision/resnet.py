@@ -135,17 +135,53 @@ class BottleneckV2(HybridBlock):
         return x + residual
 
 
+class _SpaceToDepthStem(HybridBlock):
+    """MXU-friendly stem: space_to_depth(2) packs the 3-channel input into
+    12 channels before the first conv, so the stem convolution feeds the
+    128-lane MXU tile instead of running at C=3 occupancy (the MLPerf
+    ResNet trick; see PERF.md). A 5x5/s1 pad2 conv on the packed
+    112x112x12 map (symmetric padding; MLPerf's 4x4 needs an asymmetric
+    (1,2) pad pair) keeps the output shape with a ~10x10 effective
+    receptive field vs the reference 7x7/s2 stem — a variant model, not
+    weight-compatible."""
+
+    def __init__(self, channels, layout, **kw):
+        super().__init__(**kw)
+        self._layout = layout
+        ax = _bn_axis(layout)
+        # 5x5/s1 pad2 keeps symmetric padding (4x4 'same' would need the
+        # (1,2) asymmetric pair); ~10x10 effective receptive field
+        self.conv = nn.Conv2D(channels, 5, 1, 2, use_bias=False,
+                              layout=layout)
+        self.bn = nn.BatchNorm(axis=ax)
+        self.pool = nn.MaxPool2D(3, 2, 1, layout=layout)
+
+    def forward(self, x):
+        from .... import numpy_extension as npx
+
+        x = npx.space_to_depth(x, 2, layout=self._layout)
+        return self.pool(self.bn(self.conv(x)).relu())
+
+
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", **kw):
+                 layout="NCHW", stem_type="default", **kw):
         super().__init__(**kw)
         if len(channels) != len(layers) + 1:
             raise MXNetError("channels must have len(layers)+1 entries")
         self._layout = layout
         ax = _bn_axis(layout)
+        if stem_type not in ("default", "s2d"):
+            raise MXNetError(f"unknown stem_type '{stem_type}'")
         self.features = nn.HybridSequential()
         if thumbnail:
+            if stem_type != "default":
+                raise MXNetError(
+                    "thumbnail=True uses the CIFAR 3x3 stem; stem_type "
+                    f"'{stem_type}' would be silently ignored")
             self.features.add(_conv3x3(channels[0], 1, 0, layout))
+        elif stem_type == "s2d":
+            self.features.add(_SpaceToDepthStem(channels[0], layout))
         else:
             self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False,
                                         layout=layout),

@@ -88,6 +88,24 @@ def flatten(a):
     return call(lambda x: x.reshape(x.shape[0], -1), (a,), {}, name="flatten")
 
 
+def space_to_depth(data, block_size, layout="NCHW"):
+    """Ref src/operator/tensor/matrix_op.cc:1042 (ONNX SpaceToDepth)."""
+    from ..ops import nn as _nn
+
+    return call(lambda x: _nn.space_to_depth(x, block_size, layout),
+                (data,), {}, name="space_to_depth",
+                attrs={"block_size": block_size, "layout": layout})
+
+
+def depth_to_space(data, block_size, layout="NCHW"):
+    """Ref src/operator/tensor/matrix_op.cc:985 (ONNX DepthToSpace)."""
+    from ..ops import nn as _nn
+
+    return call(lambda x: _nn.depth_to_space(x, block_size, layout),
+                (data,), {}, name="depth_to_space",
+                attrs={"block_size": block_size, "layout": layout})
+
+
 def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype=None):
     return call(lambda i: jax.nn.one_hot(i, depth, dtype=jnp.dtype(dtype) if dtype else jnp.float32)
                 * (on_value - off_value) + off_value, (indices,), {}, name="one_hot")

@@ -31,7 +31,8 @@ __all__ = [
     "group_norm", "instance_norm", "lrn", "dropout", "softmax", "log_softmax",
     "masked_softmax", "masked_log_softmax", "softmax_cross_entropy",
     "embedding", "one_hot", "pick", "topk", "sequence_mask", "sequence_last",
-    "sequence_reverse", "rnn", "gamma", "gammaln", "erf", "erfinv", "digamma",
+    "sequence_reverse", "space_to_depth", "depth_to_space", "rnn",
+    "gamma", "gammaln", "erf", "erfinv", "digamma",
     "reshape_like", "slice_like", "broadcast_like", "shape_array", "batch_dot",
     "arange_like", "gather_nd", "scatter_nd", "index_update", "index_add",
     "smooth_l1", "all_finite", "multi_sum_sq", "clip_by_global_norm",
@@ -273,6 +274,20 @@ def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0,
                     name="sequence_last")
     return call(lambda x, l: _nn.sequence_last(x, l, True, axis),
                 (data, sequence_length), {}, name="sequence_last")
+
+
+def space_to_depth(data, block_size, layout="NCHW", **kw):
+    """Ref src/operator/tensor/matrix_op.cc:1042."""
+    return call(lambda x: _nn.space_to_depth(x, block_size, layout),
+                (data,), {}, name="space_to_depth",
+                attrs={"block_size": block_size, "layout": layout})
+
+
+def depth_to_space(data, block_size, layout="NCHW", **kw):
+    """Ref src/operator/tensor/matrix_op.cc:985."""
+    return call(lambda x: _nn.depth_to_space(x, block_size, layout),
+                (data,), {}, name="depth_to_space",
+                attrs={"block_size": block_size, "layout": layout})
 
 
 def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0, **kw):

@@ -340,6 +340,52 @@ def instance_norm(x, gamma, beta, eps: float = 1e-5):
     return out * gamma.reshape(shape) + beta.reshape(shape)
 
 
+def space_to_depth(x, block_size: int, layout: str = "NCHW"):
+    """Move spatial blocks into channels (ref src/operator/tensor/
+    matrix_op.cc space_to_depth, ONNX SpaceToDepth formula:
+    reshape -> transpose [0,3,5,1,2,4] -> reshape).
+
+    layout='NHWC' is the TPU-native variant (channel-last blocks) used by
+    the s2d ResNet stem."""
+    b = int(block_size)
+    if layout == "NCHW":
+        n, c, h, w = x.shape
+        if h % b or w % b:
+            raise MXNetError(f"H/W {h}x{w} not divisible by block {b}")
+        x = x.reshape(n, c, h // b, b, w // b, b)
+        x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+        return x.reshape(n, c * b * b, h // b, w // b)
+    if layout == "NHWC":
+        n, h, w, c = x.shape
+        if h % b or w % b:
+            raise MXNetError(f"H/W {h}x{w} not divisible by block {b}")
+        x = x.reshape(n, h // b, b, w // b, b, c)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        return x.reshape(n, h // b, w // b, b * b * c)
+    raise MXNetError(f"space_to_depth: unsupported layout {layout}")
+
+
+def depth_to_space(x, block_size: int, layout: str = "NCHW"):
+    """Inverse of space_to_depth (ref matrix_op.cc depth_to_space:
+    reshape -> transpose [0,3,4,1,5,2] -> reshape)."""
+    b = int(block_size)
+    if layout == "NCHW":
+        n, c, h, w = x.shape
+        if c % (b * b):
+            raise MXNetError(f"C={c} not divisible by block^2={b*b}")
+        x = x.reshape(n, b, b, c // (b * b), h, w)
+        x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+        return x.reshape(n, c // (b * b), h * b, w * b)
+    if layout == "NHWC":
+        n, h, w, c = x.shape
+        if c % (b * b):
+            raise MXNetError(f"C={c} not divisible by block^2={b*b}")
+        x = x.reshape(n, h, w, b, b, c // (b * b))
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        return x.reshape(n, h * b, w * b, c // (b * b))
+    raise MXNetError(f"depth_to_space: unsupported layout {layout}")
+
+
 def lrn(x, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
     """Local response norm across channels (ref: src/operator/nn/lrn.cc)."""
     sq = jnp.square(x)

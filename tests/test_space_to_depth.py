@@ -1,0 +1,86 @@
+"""space_to_depth / depth_to_space ops + the s2d ResNet stem variant.
+
+Reference: src/operator/tensor/matrix_op.cc:985-1090 (ONNX
+SpaceToDepth/DepthToSpace semantics, doc examples reproduced exactly).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def test_reference_doc_example():
+    x = mx.nd.array([[[[0, 6, 1, 7, 2, 8],
+                       [12, 18, 13, 19, 14, 20],
+                       [3, 9, 4, 10, 5, 11],
+                       [15, 21, 16, 22, 17, 23]]]])
+    y = mx.nd.space_to_depth(x, 2)
+    assert onp.array_equal(y.asnumpy(),
+                           onp.arange(24).reshape(1, 4, 2, 3))
+    assert onp.array_equal(mx.nd.depth_to_space(y, 2).asnumpy(),
+                           x.asnumpy())
+
+
+def test_roundtrips_both_layouts():
+    rng = onp.random.RandomState(0)
+    a = mx.nd.array(rng.rand(2, 8, 6, 4).astype("f4"))
+    for b in (1, 2):
+        r = mx.nd.depth_to_space(mx.nd.space_to_depth(a, b), b)
+        assert onp.allclose(r.asnumpy(), a.asnumpy())
+    nhwc = mx.nd.array(rng.rand(2, 6, 4, 8).astype("f4"))
+    r = mx.nd.depth_to_space(mx.nd.space_to_depth(nhwc, 2, layout="NHWC"),
+                             2, layout="NHWC")
+    assert onp.allclose(r.asnumpy(), nhwc.asnumpy())
+    # npx aliases
+    y = mx.npx.space_to_depth(nhwc, 2, layout="NHWC")
+    assert y.shape == (2, 3, 2, 32)
+
+
+def test_validation():
+    a = mx.nd.zeros((1, 3, 5, 4))
+    with pytest.raises(MXNetError):
+        mx.nd.space_to_depth(a, 2)  # 5 not divisible
+    with pytest.raises(MXNetError):
+        mx.nd.depth_to_space(a, 2)  # 3 not divisible by 4
+    with pytest.raises(MXNetError):
+        mx.nd.space_to_depth(a, 1, layout="NCWH")
+
+
+def test_gradient_is_permutation():
+    rng = onp.random.RandomState(1)
+    check_numeric_gradient(lambda x: mx.nd.space_to_depth(x, 2),
+                           [rng.rand(1, 2, 4, 4).astype("f4")])
+    check_numeric_gradient(lambda x: mx.nd.depth_to_space(x, 2),
+                           [rng.rand(1, 4, 2, 2).astype("f4")])
+
+
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+def test_s2d_resnet_stem(layout):
+    net = mx.gluon.model_zoo.get_model("resnet18_v1", stem_type="s2d",
+                                       layout=layout, classes=5)
+    net.initialize(mx.init.Xavier())
+    shape = (2, 3, 32, 32) if layout == "NCHW" else (2, 32, 32, 3)
+    x = mx.nd.array(onp.random.RandomState(0).rand(*shape).astype("f4"))
+    net.hybridize()
+    out = net(x)
+    assert out.shape == (2, 5)
+    # same spatial geometry as the default stem all the way through
+    ref = mx.gluon.model_zoo.get_model("resnet18_v1", layout=layout,
+                                       classes=5)
+    ref.initialize(mx.init.Xavier())
+    assert ref(x).shape == out.shape
+    # trains
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1})
+    with mx.autograd.record():
+        loss = loss_fn(net(x), mx.nd.array([0, 1]))
+    loss.backward()
+    tr.step(2)
+
+
+def test_unknown_stem_raises():
+    with pytest.raises(MXNetError):
+        mx.gluon.model_zoo.get_model("resnet18_v1", stem_type="bogus")
