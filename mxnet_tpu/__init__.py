@@ -18,6 +18,17 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("MXNET_INT64_TENSOR_SIZE", "").strip().lower() in (
+        "1", "true", "on", "yes"):
+    # the reference's large-tensor/int64 build flag (libinfo
+    # INT64_TENSOR_SIZE); here it maps to jax 64-bit mode, which must be
+    # set before the first jax import touches the backend
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+
 from .base import MXNetError, get_env
 from .context import (Context, cpu, tpu, gpu, cpu_pinned, current_context,
                       num_gpus, num_tpus, device)

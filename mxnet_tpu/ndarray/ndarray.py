@@ -62,6 +62,17 @@ def _dtype_of(obj, dtype):
     return None
 
 
+def _host(x):
+    """Recursively coerce NDArrays to host numpy (dispatch fallbacks)."""
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    if isinstance(x, (list, tuple)):
+        return type(x)(_host(e) for e in x)
+    if isinstance(x, dict):
+        return {k: _host(v) for k, v in x.items()}
+    return x
+
+
 class NDArray:
     """See module docstring. API mirrors mx.np.ndarray + mx.nd.NDArray."""
 
@@ -163,6 +174,48 @@ class NDArray:
     def __array__(self, dtype=None):
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
+
+    # -- NumPy dispatch protocols (ref numpy_dispatch_protocol.py:
+    # __array_ufunc__/__array_function__ interop so onp.exp(mx_arr) and
+    # onp.concatenate([mx_arr, ...]) stay IN the framework, on device,
+    # returning NDArray). Anything the framework doesn't map falls back
+    # to host numpy on coerced arrays — the pre-protocol behavior — so no
+    # previously-working call starts raising. Real errors (shape
+    # mismatches etc.) propagate; only missing mappings / unsupported
+    # kwargs take the fallback. ------------------------------------------
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method == "__call__" and kwargs.get("out") is None:
+            from .. import numpy as mnp
+
+            fn = getattr(mnp, ufunc.__name__, None)
+            if fn is not None:
+                try:
+                    return fn(*inputs, **kwargs)
+                except TypeError:
+                    pass  # kwargs the mx op doesn't take -> host fallback
+        return getattr(ufunc, method)(*_host(inputs), **_host(kwargs))
+
+    def __array_function__(self, func, types, args, kwargs):
+        from .. import numpy as mnp
+
+        # func.__name__ is bare (numpy 2 linalg.trace -> 'trace'); resolve
+        # the namespace from __module__ so linalg/fft/random functions
+        # don't silently hit a same-named top-level op with different
+        # semantics
+        mod = getattr(func, "__module__", "") or ""
+        ns = mnp
+        for sub in ("linalg", "fft", "random"):
+            if mod.endswith(sub):
+                ns = getattr(mnp, sub, None)
+                break
+        fn = getattr(ns, func.__name__, None) if ns is not None else None
+        if fn is not None:
+            try:
+                return fn(*args, **kwargs)
+            except TypeError:
+                pass
+        impl = getattr(func, "_implementation", None) or func
+        return impl(*_host(args), **_host(kwargs))
 
     def item(self):
         return self.asnumpy().item()

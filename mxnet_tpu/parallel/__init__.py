@@ -17,4 +17,5 @@ from .mesh import (make_mesh, default_mesh, data_parallel_spec,
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
                           broadcast_from, barrier)
 from .trainer import ShardedTrainer, make_train_step, shard_params
+from .preemption import PreemptionGuard
 from . import ring

@@ -431,7 +431,12 @@ class ShardedTrainer:
     def _put(self, v):
         """Shard a batch value (or tuple tree of them) per batch_spec; the
         spec is truncated for lower-rank leaves. Benchmarks drive the raw
-        step function with values placed by this same helper."""
+        step function with values placed by this same helper.
+
+        Multi-process: each process passes its LOCAL portion of the global
+        batch (the usual per-host data pipeline); the pieces are assembled
+        into one global sharded array. device_put would instead demand the
+        identical global value on every process."""
         if isinstance(v, (tuple, list)):
             return tuple(self._put(e) for e in v)
         if isinstance(v, NDArray):
@@ -439,7 +444,13 @@ class ShardedTrainer:
         spec = self._batch_spec
         if getattr(v, "ndim", 1) < len(spec):
             spec = P(*spec[:v.ndim])
-        return jax.device_put(v, NamedSharding(self.mesh, spec))
+        sharding = NamedSharding(self.mesh, spec)
+        if jax.process_count() > 1 and any(s is not None for s in spec):
+            import numpy as onp
+
+            return jax.make_array_from_process_local_data(
+                sharding, onp.asarray(v))
+        return jax.device_put(v, sharding)
 
     def _write_back_params(self):
         params = self._params

@@ -75,6 +75,67 @@ def main():
             gathered[0], gathered[r],
             err_msg=f"rank {r} params diverged from rank 0")
 
+    # -- ShardedTrainer with per-rank LOCAL batches --------------------------
+    # each rank feeds its own slice of the global batch; _put assembles a
+    # global sharded array (make_array_from_process_local_data) and the
+    # psum keeps params bit-identical
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import ShardedTrainer
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    def ce(pred, y):
+        logp = jax.nn.log_softmax(pred.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+
+    mx.random.seed(11)
+    net2 = mx.gluon.nn.HybridSequential()
+    net2.add(mx.gluon.nn.Dense(16, activation="relu"),
+             mx.gluon.nn.Dense(4))
+    net2.initialize(mx.init.Xavier())
+    net2(mx.np.zeros((2, 8)))
+    st = ShardedTrainer(net2, ce, mesh=make_mesh({"dp": -1}),
+                        optimizer="sgd", learning_rate=0.1)
+    for step in range(5):
+        rs2 = onp.random.RandomState(step * nw + rank)  # disjoint per rank
+        x = rs2.rand(4, 8).astype("float32")
+        y = rs2.randint(0, 4, size=(4,)).astype("int32")
+        st.step(x, y)
+    flat2 = onp.concatenate([onp.asarray(v).ravel() for v in st.pvals])
+    gathered2 = onp.asarray(dist.allgather_host(flat2))
+    for r in range(nw):
+        onp.testing.assert_array_equal(
+            gathered2[0], gathered2[r],
+            err_msg=f"rank {r} sharded-trainer params diverged")
+
+    # -- preemption agreement: SIGTERM lands on ONE rank only; every rank
+    # must checkpoint/exit at the same step (PreemptionGuard allgather) ----
+    import signal as _signal
+    import tempfile
+
+    from mxnet_tpu.parallel import PreemptionGuard
+
+    ckpt = os.path.join(tempfile.gettempdir(),
+                        f"dist_preempt_{os.environ['MXNET_DIST_COORDINATOR'].split(':')[-1]}.npz")
+    guard = PreemptionGuard(st, ckpt)
+    exit_step = None
+    for step in range(6):
+        rs3 = onp.random.RandomState(step * nw + rank)
+        st.step(rs3.rand(4, 8).astype("float32"),
+                rs3.randint(0, 4, size=(4,)).astype("int32"))
+        if step == 2 and rank == nw - 1:  # only the LAST rank is signaled
+            os.kill(os.getpid(), _signal.SIGTERM)
+        if guard.step():
+            exit_step = step
+            break
+    assert exit_step == 2, f"rank {rank} exited at {exit_step}"
+    steps = onp.asarray(dist.allgather_host(onp.asarray([exit_step])))
+    assert (steps == 2).all(), steps
+    if rank == 0:
+        assert os.path.exists(ckpt)
+        os.remove(ckpt)
+    guard.restore()
+
     dist.barrier()
     print(f"DIST-OK rank {rank}", flush=True)
 

@@ -1,0 +1,261 @@
+"""Seeded fuzz sweep: mx.np vs NumPy across the shared op surface.
+
+The reference's test_numpy_op.py (~30k LoC) fuzzes each op over random
+shapes/axes/dtypes with a recorded seed; this sweep applies the same
+strategy table-driven — every op gets randomized shapes (broadcasting
+pairs for binaries, random axes for reductions), integer and float
+dtypes where sensible, plus an indexing fuzz over mixed basic/advanced
+index expressions. Failures print the reproducing seed via conftest.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+RNG = onp.random.RandomState(20260730)
+
+UNARY_ANY = ["negative", "abs", "sign", "floor", "ceil", "trunc", "rint",
+             "square", "sinh", "cosh", "tanh", "arcsinh", "arctan", "sin",
+             "cos", "tan", "exp", "expm1", "cbrt", "degrees", "radians",
+             "fix", "reciprocal"]
+UNARY_POS = ["log", "log2", "log10", "log1p", "sqrt", "arccosh"]
+UNARY_UNIT = ["arcsin", "arccos", "arctanh"]
+BINARY = ["add", "subtract", "multiply", "divide", "true_divide", "power",
+          "maximum", "minimum", "fmax", "fmin", "arctan2", "hypot",
+          "copysign", "logaddexp", "fmod", "mod", "remainder"]
+COMPARE = ["equal", "not_equal", "greater", "greater_equal", "less",
+           "less_equal", "logical_and", "logical_or", "logical_xor"]
+REDUCE = ["sum", "mean", "max", "min", "prod", "std", "var", "argmax",
+          "argmin", "nansum", "nanprod", "amax", "amin"]
+INT_UNARY = ["abs", "negative", "sign", "square"]
+ACCUM = ["cumsum", "cumprod"]
+
+
+def _rand_shape(rng, max_rank=4, max_dim=6):
+    rank = rng.randint(0, max_rank + 1)
+    return tuple(int(rng.randint(1, max_dim + 1)) for _ in range(rank))
+
+
+def _bcast_pair(rng):
+    """Two shapes that numpy-broadcast together."""
+    base = _rand_shape(rng, 3)
+    a = list(base)
+    b = list(base)
+    for i in range(len(base)):
+        r = rng.rand()
+        if r < 0.25:
+            a[i] = 1
+        elif r < 0.5:
+            b[i] = 1
+    cut = rng.randint(0, len(b) + 1)
+    return tuple(a), tuple(b[cut:])
+
+
+@pytest.mark.parametrize("name", sorted(set(
+    UNARY_ANY + UNARY_POS + UNARY_UNIT)))
+def test_fuzz_unary(name):
+    rng = onp.random.RandomState(abs(hash(name)) % (2**31))
+    for _ in range(4):
+        shape = _rand_shape(rng)
+        if name in UNARY_POS:
+            x = rng.uniform(1.001, 3.0, shape).astype(onp.float32)
+        elif name in UNARY_UNIT:
+            x = rng.uniform(-0.99, 0.99, shape).astype(onp.float32)
+        else:
+            x = rng.uniform(-2.0, 2.0, shape).astype(onp.float32)
+        got = getattr(mx.np, name)(mx.np.array(x))
+        want = getattr(onp, name)(x)
+        assert_almost_equal(got, want, rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", BINARY)
+def test_fuzz_binary_broadcast(name):
+    rng = onp.random.RandomState(abs(hash("b" + name)) % (2**31))
+    for _ in range(4):
+        sa, sb = _bcast_pair(rng)
+        a = rng.uniform(0.5, 2.0, sa).astype(onp.float32)
+        b = rng.uniform(0.5, 2.0, sb).astype(onp.float32)
+        got = getattr(mx.np, name)(mx.np.array(a), mx.np.array(b))
+        want = getattr(onp, name)(a, b)
+        assert_almost_equal(got, want, rtol=2e-4, atol=1e-5)
+        # scalar rhs path
+        got = getattr(mx.np, name)(mx.np.array(a), 1.5)
+        want = getattr(onp, name)(a, onp.float32(1.5))
+        assert_almost_equal(got, want, rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", COMPARE)
+def test_fuzz_compare(name):
+    rng = onp.random.RandomState(abs(hash("c" + name)) % (2**31))
+    for _ in range(4):
+        sa, sb = _bcast_pair(rng)
+        a = rng.randint(0, 3, sa).astype(onp.float32)
+        b = rng.randint(0, 3, sb).astype(onp.float32)
+        got = getattr(mx.np, name)(mx.np.array(a), mx.np.array(b))
+        want = getattr(onp, name)(a, b)
+        assert onp.array_equal(onp.asarray(got.asnumpy(), bool), want)
+
+
+@pytest.mark.parametrize("name", REDUCE)
+def test_fuzz_reduce_axes(name):
+    rng = onp.random.RandomState(abs(hash("r" + name)) % (2**31))
+    for _ in range(4):
+        shape = _rand_shape(rng, 4)
+        if not shape:
+            shape = (3,)
+        x = rng.uniform(0.1, 2.0, shape).astype(onp.float32)
+        choices = [None] + list(range(len(shape)))
+        axis = choices[rng.randint(0, len(choices))]
+        kw = {}
+        if name.startswith("arg"):
+            if axis is None and rng.rand() < 0.5:
+                pass
+            got = getattr(mx.np, name)(mx.np.array(x), axis=axis)
+            want = getattr(onp, name)(x, axis=axis)
+            assert onp.array_equal(onp.asarray(got.asnumpy()), want)
+            continue
+        if rng.rand() < 0.5:
+            kw["keepdims"] = True
+        got = getattr(mx.np, name)(mx.np.array(x), axis=axis, **kw)
+        want = getattr(onp, name)(x, axis=axis, **kw)
+        assert_almost_equal(got, want, rtol=3e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ACCUM)
+def test_fuzz_accumulations(name):
+    rng = onp.random.RandomState(abs(hash("a" + name)) % (2**31))
+    for _ in range(4):
+        shape = _rand_shape(rng, 3) or (4,)
+        x = rng.uniform(0.5, 1.5, shape).astype(onp.float32)
+        axis = rng.randint(0, len(shape)) if shape and rng.rand() < 0.7 \
+            else None
+        got = getattr(mx.np, name)(mx.np.array(x), axis=axis)
+        want = getattr(onp, name)(x, axis=axis)
+        assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", INT_UNARY)
+def test_fuzz_integer_dtypes(name):
+    # int64 narrows to int32 unless MXNET_INT64_TENSOR_SIZE enables jax
+    # 64-bit mode (the reference's INT64_TENSOR_SIZE build flag analogue;
+    # tested in test_int64_flag_subprocess) — here exercise int32
+    rng = onp.random.RandomState(abs(hash("i" + name)) % (2**31))
+    x = rng.randint(-5, 6, (3, 4)).astype("int32")
+    got = getattr(mx.np, name)(mx.np.array(x))
+    want = getattr(onp, name)(x)
+    assert onp.array_equal(onp.asarray(got.asnumpy()), want)
+    assert str(got.dtype) == "int32", (name, got.dtype)
+
+
+def test_int64_flag_subprocess():
+    """MXNET_INT64_TENSOR_SIZE=1 turns on 64-bit tensors (fresh process —
+    jax x64 must be configured before backend init)."""
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "MXNET_INT64_TENSOR_SIZE": "1",
+           "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    code = (
+        "import mxnet_tpu as mx\n"
+        "import numpy as onp\n"
+        "x = mx.np.array(onp.array([1, 2], 'int64'))\n"
+        "assert str(x.dtype) == 'int64', x.dtype\n"
+        "y = mx.np.array(onp.array([1.0], 'float64'))\n"
+        "assert str(y.dtype) == 'float64', y.dtype\n"
+        "print('OK')\n")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "OK" in out.stdout
+
+
+def test_fuzz_basic_indexing():
+    rng = onp.random.RandomState(11)
+    x = rng.rand(5, 6, 7).astype(onp.float32)
+    mxx = mx.np.array(x)
+    exprs = [
+        (slice(1, 4),),
+        (slice(None), slice(2, 5)),
+        (2, slice(None, None, 2)),
+        (Ellipsis, 3),
+        (slice(None), None, slice(1, 3)),
+        (slice(4, 1, -1), Ellipsis),
+        (-1, -2),
+        (slice(None), slice(None), slice(None, None, 3)),
+    ]
+    for e in exprs:
+        assert onp.allclose(mxx[e].asnumpy(), x[e]), e
+
+
+def test_fuzz_advanced_indexing():
+    rng = onp.random.RandomState(13)
+    x = rng.rand(6, 5).astype(onp.float32)
+    mxx = mx.np.array(x)
+    idx = rng.randint(0, 6, (4,))
+    assert onp.allclose(mxx[mx.np.array(idx, dtype="int32")].asnumpy(),
+                        x[idx])
+    rows = rng.randint(0, 6, (3,))
+    cols = rng.randint(0, 5, (3,))
+    assert onp.allclose(
+        mxx[mx.np.array(rows, dtype="int32"),
+            mx.np.array(cols, dtype="int32")].asnumpy(),
+        x[rows, cols])
+    # boolean mask (eager path — dynamic shape is allowed outside jit)
+    mask = x[:, 0] > 0.5
+    assert onp.allclose(mxx[mx.np.array(mask)].asnumpy(), x[mask])
+
+
+def test_fuzz_setitem():
+    rng = onp.random.RandomState(17)
+    for _ in range(4):
+        x = rng.rand(5, 6).astype(onp.float32)
+        mxx = mx.np.array(x.copy())
+        val = rng.rand(3).astype(onp.float32)
+        x[1, 2:5] = val
+        mxx[1, 2:5] = mx.np.array(val)
+        assert onp.allclose(mxx.asnumpy(), x)
+        x[:, 0] = 7.0
+        mxx[:, 0] = 7.0
+        assert onp.allclose(mxx.asnumpy(), x)
+
+
+def test_fuzz_dtype_promotion():
+    a32 = mx.np.array(onp.ones((2, 2), onp.float32))
+    i32 = mx.np.array(onp.ones((2, 2), onp.int32))
+    assert str((a32 + i32).dtype) == "float32"
+    assert str((i32 + i32).dtype) == "int32"
+    assert str((a32 + 1).dtype) == "float32"
+    assert str((i32 * 2).dtype) == "int32"
+
+
+def test_fuzz_tail_ops_vs_numpy():
+    rng = onp.random.RandomState(19)
+    x = rng.rand(4, 5).astype(onp.float32)
+    v = rng.rand(7).astype(onp.float32)
+    mxx, mxv = mx.np.array(x), mx.np.array(v)
+    assert_almost_equal(mx.np.percentile(mxv, 30), onp.percentile(v, 30),
+                        rtol=1e-4)
+    assert_almost_equal(mx.np.quantile(mxv, 0.4), onp.quantile(v, 0.4),
+                        rtol=1e-4)
+    assert_almost_equal(mx.np.diff(mxv), onp.diff(v), rtol=1e-4)
+    assert_almost_equal(mx.np.ediff1d(mxv), onp.ediff1d(v), rtol=1e-4)
+    assert_almost_equal(mx.np.trace(mxx), onp.trace(x), rtol=1e-4)
+    assert_almost_equal(mx.np.diag(mxx), onp.diag(x), rtol=1e-4)
+    assert_almost_equal(mx.np.ravel(mxx), onp.ravel(x), rtol=1e-4)
+    assert_almost_equal(mx.np.atleast_2d(mxv), onp.atleast_2d(v), rtol=1e-4)
+    got = mx.np.histogram(mxv, bins=4, range=(0.0, 1.0))
+    want = onp.histogram(v, bins=4, range=(0.0, 1.0))
+    assert onp.array_equal(onp.asarray(got[0].asnumpy()), want[0])
+    assert_almost_equal(mx.np.interp(mx.np.array([0.5]),
+                                     mx.np.arange(7).astype("float32"), mxv),
+                        onp.interp([0.5], onp.arange(7), v), rtol=1e-4)
+    assert_almost_equal(mx.np.cross(mx.np.array([1., 0., 0.]),
+                                    mx.np.array([0., 1., 0.])),
+                        onp.array([0., 0., 1.]), rtol=1e-6)
+    assert_almost_equal(mx.np.outer(mxv, mxv), onp.outer(v, v), rtol=1e-4)
+    assert_almost_equal(mx.np.kron(mx.np.array([1., 2.]),
+                                   mx.np.array([3., 4.])),
+                        onp.kron([1., 2.], [3., 4.]), rtol=1e-6)

@@ -1,0 +1,62 @@
+"""NumPy dispatch protocol interop (__array_ufunc__/__array_function__).
+
+Reference: python/mxnet/numpy_dispatch_protocol.py + the interop tests in
+test_numpy_interoperability.py — calling numpy functions on mx arrays
+stays in-framework and returns mx arrays.
+"""
+import numpy as onp
+
+import mxnet_tpu as mx
+
+
+def _arr(shape=(2, 3), seed=0):
+    return mx.np.array(onp.random.RandomState(seed).rand(*shape)
+                       .astype("f4"))
+
+
+def test_ufunc_dispatch_returns_ndarray():
+    a = _arr()
+    for f in (onp.exp, onp.sqrt, onp.tanh, onp.negative, onp.abs):
+        out = f(a)
+        assert isinstance(out, mx.nd.NDArray), f
+        assert onp.allclose(out.asnumpy(), f(a.asnumpy()), atol=1e-5)
+
+
+def test_binary_ufunc_mixed_operands():
+    a = _arr()
+    b = onp.ones((2, 3), "f4")
+    for f in (onp.add, onp.multiply, onp.maximum):
+        out = f(a, b)
+        assert isinstance(out, mx.nd.NDArray)
+        assert onp.allclose(out.asnumpy(), f(a.asnumpy(), b), atol=1e-5)
+    out = onp.add(b, a)  # __array_priority__ puts NDArray in charge
+    assert isinstance(out, mx.nd.NDArray)
+
+
+def test_array_function_dispatch():
+    a = _arr()
+    out = onp.concatenate([a, a], axis=0)
+    assert isinstance(out, mx.nd.NDArray) and out.shape == (4, 3)
+    out = onp.stack([a, a])
+    assert isinstance(out, mx.nd.NDArray) and out.shape == (2, 2, 3)
+    out = onp.mean(a, axis=1)
+    assert isinstance(out, mx.nd.NDArray)
+    assert onp.allclose(out.asnumpy(), a.asnumpy().mean(axis=1), atol=1e-6)
+    out = onp.transpose(a)
+    assert isinstance(out, mx.nd.NDArray) and out.shape == (3, 2)
+
+
+def test_coercion_paths_unchanged():
+    a = _arr()
+    assert isinstance(onp.asarray(a), onp.ndarray)
+    assert isinstance(a.asnumpy(), onp.ndarray)
+    assert float(onp.asarray(a.sum())) > 0
+
+
+def test_autograd_flows_through_dispatch():
+    a = _arr()
+    a.attach_grad()
+    with mx.autograd.record():
+        loss = onp.exp(a).sum()  # numpy call, mx tape
+    loss.backward()
+    assert onp.allclose(a.grad.asnumpy(), onp.exp(a.asnumpy()), atol=1e-5)
