@@ -101,6 +101,9 @@ def main():
                     break
             print(f"epoch {epoch}: loss {loss:.4f} "
                   f"({time.time() - t0:.1f}s)")
+        if args.checkpoint:
+            trainer.save_states(args.checkpoint)
+            print("saved", args.checkpoint)
     else:
         trainer = mx.gluon.Trainer(net.collect_params(), args.optimizer,
                                    {"learning_rate": args.lr})

@@ -111,6 +111,234 @@ def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype=None):
                 * (on_value - off_value) + off_value, (indices,), {}, name="one_hot")
 
 
+# -- legacy tensor-op tail (ref src/operator/tensor/matrix_op.cc etc.) -------
+
+def slice(data, begin, end, step=None):  # noqa: A001 — reference op name
+    """Ref matrix_op.cc `slice`: None entries mean full range."""
+    import builtins as _bi
+
+    def f(x):
+        sl = []
+        for i in range(x.ndim):
+            b = begin[i] if i < len(begin) else None
+            e = end[i] if i < len(end) else None
+            st = (step[i] if step and i < len(step) else None)
+            sl.append(_bi.slice(b, e, st))
+        return x[tuple(sl)]
+    return call(f, (data,), {}, name="slice",
+                attrs={"begin": list(begin), "end": list(end)})
+
+
+def slice_axis(data, axis, begin, end):
+    """Ref matrix_op.cc `slice_axis`."""
+    def f(x):
+        ax = axis % x.ndim
+        e = x.shape[ax] if end is None else end
+        return jax.lax.slice_in_dim(x, begin, e, axis=ax)
+    return call(f, (data,), {}, name="slice_axis",
+                attrs={"axis": axis, "begin": begin, "end": end})
+
+
+def reverse(data, axis=0):
+    """Ref matrix_op.cc `reverse` (flip along axes)."""
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return call(lambda x: jnp.flip(x, axes), (data,), {}, name="reverse",
+                attrs={"axis": list(axes)})
+
+
+def add_n(*args):
+    """Ref elemwise_sum.cc `add_n`: sum of N arrays."""
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+
+    def f(*xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+    return call(f, args, {}, name="add_n")
+
+
+def swapaxes(data, dim1=0, dim2=1):
+    """Ref matrix_op.cc `SwapAxis`."""
+    return call(lambda x: jnp.swapaxes(x, dim1, dim2), (data,), {},
+                name="swapaxes", attrs={"dim1": dim1, "dim2": dim2})
+
+
+SwapAxis = swapaxes
+
+
+def cast(data, dtype):
+    """Ref elemwise_unary_op_basic.cc `Cast`."""
+    return call(lambda x: x.astype(jnp.dtype(dtype)), (data,), {},
+                name="cast", attrs={"dtype": str(dtype)})
+
+
+Cast = cast
+
+
+def softmin(data, axis=-1):
+    """Ref softmax.cc `softmin` = softmax(-x)."""
+    return call(lambda x: jax.nn.softmax(-x, axis=axis), (data,), {},
+                name="softmin", attrs={"axis": axis})
+
+
+def moments(data, axes=None, keepdims=False):
+    """Ref nn/moments.cc: returns (mean, var)."""
+    def f(x):
+        m = jnp.mean(x, axis=axes, keepdims=keepdims)
+        v = jnp.var(x, axis=axes, keepdims=keepdims)
+        return m, v
+    return call(f, (data,), {}, name="moments")
+
+
+def batch_take(a, indices):
+    """Ref indexing_op.cc `batch_take`: out[i] = a[i, indices[i]]."""
+    return call(lambda x, i: jnp.take_along_axis(
+        x, i.astype(jnp.int32)[:, None], axis=1)[:, 0],
+        (a, indices), {}, name="batch_take")
+
+
+def argmax_channel(data):
+    """Ref broadcast_reduce_op_index.cc `argmax_channel`: argmax over
+    axis 1, float output like the reference."""
+    return call(lambda x: jnp.argmax(x, axis=1).astype(x.dtype), (data,),
+                {}, name="argmax_channel")
+
+
+def size_array(data):
+    """Ref tensor/elemwise_unary_op_basic.cc `size_array`; int64 under the
+    MXNET_INT64_TENSOR_SIZE / jax x64 large-tensor mode."""
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return call(lambda x: jnp.asarray([x.size], dt), (data,), {},
+                name="size_array")
+
+
+def im2col(data, kernel, stride=1, dilate=1, pad=0):
+    """Ref nn/im2col.cc: unfold conv patches to columns
+    (N, C*prod(kernel), L)."""
+    import builtins as _bi
+    import itertools
+
+    def f(x):
+        n = x.ndim - 2
+        k = kernel if isinstance(kernel, (tuple, list)) else (kernel,) * n
+        st = stride if isinstance(stride, (tuple, list)) else (stride,) * n
+        d = dilate if isinstance(dilate, (tuple, list)) else (dilate,) * n
+        p = pad if isinstance(pad, (tuple, list)) else (pad,) * n
+        xp = jnp.pad(x, ((0, 0), (0, 0)) + tuple((pp, pp) for pp in p))
+        N, C = x.shape[:2]
+        out_sp = [(xp.shape[2 + i] - (d[i] * (k[i] - 1) + 1)) // st[i] + 1
+                  for i in range(n)]
+        patches = []
+        for off in itertools.product(*[range(kk) for kk in k]):
+            sl = [_bi.slice(None), _bi.slice(None)]
+            for i in range(n):
+                start = off[i] * d[i]
+                stop = start + st[i] * (out_sp[i] - 1) + 1
+                sl.append(_bi.slice(start, stop, st[i]))
+            patches.append(xp[tuple(sl)])
+        stk = jnp.stack(patches, axis=2)  # (N, C, K, *out)
+        return stk.reshape(N, C * stk.shape[2], -1)
+
+    return call(f, (data,), {}, name="im2col")
+
+
+# -- optimizer update ops (ref src/operator/optimizer_op.cc:313-398) --------
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, out=None):
+    def f(w, g):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        return w - lr * (g + wd * w)
+    return call(f, (weight, grad), {}, name="sgd_update", out=out)
+
+
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    def f(w, g, m):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        m2 = momentum * m - lr * (g + wd * w)
+        return w + m2, m2
+    res = call(f, (weight, grad, mom), {}, name="sgd_mom_update")
+    new_w, new_m = res
+    mom._set_data(new_m._data)
+    if out is not None:
+        out._set_data(new_w._data)
+        return out
+    return new_w
+
+
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                out=None):
+    def f(w, g, m, v):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * w
+        m2 = beta1 * m + (1 - beta1) * g
+        v2 = beta2 * v + (1 - beta2) * g * g
+        return w - lr * m2 / (jnp.sqrt(v2) + epsilon), m2, v2
+    new_w, new_m, new_v = call(f, (weight, grad, mean, var), {},
+                               name="adam_update")
+    mean._set_data(new_m._data)
+    var._set_data(new_v._data)
+    if out is not None:
+        out._set_data(new_w._data)
+        return out
+    return new_w
+
+
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    def f(w, g, nn):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * w
+        n2 = gamma1 * nn + (1 - gamma1) * g * g
+        return w - lr * g / jnp.sqrt(n2 + epsilon), n2
+    new_w, new_n = call(f, (weight, grad, n), {}, name="rmsprop_update")
+    n._set_data(new_n._data)
+    if out is not None:
+        out._set_data(new_w._data)
+        return out
+    return new_w
+
+
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, out=None):
+    def f(w, g):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        return w - lr * (jnp.sign(g) + wd * w)
+    return call(f, (weight, grad), {}, name="signsgd_update", out=out)
+
+
+def nag_mom_update(weight, grad, mom, lr, momentum=0.9, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    def f(w, g, m):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * w
+        m2 = momentum * m + g
+        return w - lr * (g + momentum * m2), m2
+    new_w, new_m = call(f, (weight, grad, mom), {}, name="nag_mom_update")
+    mom._set_data(new_m._data)
+    if out is not None:
+        out._set_data(new_w._data)
+        return out
+    return new_w
+
+
 from . import random  # noqa: E402
+from . import linalg  # noqa: E402
 from .utils import save, load  # noqa: E402
 from . import sparse  # noqa: E402

@@ -1,0 +1,178 @@
+"""``mx.nd.linalg`` — the legacy BLAS/LAPACK operator namespace.
+
+Reference: src/operator/tensor/la_op.cc (`_linalg_gemm/gemm2/potrf/potri/
+trmm/trsm/syrk/syevd/gelqf/sumlogdiag/extractdiag/makediag/extracttrian/
+maketrian/inverse/det/slogdet`) exposed as ``mx.nd.linalg.*``. All lower
+onto XLA's native triangular/cholesky/eig paths; batched inputs batch over
+leading dims exactly like the reference ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import call
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "syrk",
+           "syevd", "gelqf", "sumlogdiag", "extractdiag", "makediag",
+           "extracttrian", "maketrian", "inverse", "det", "slogdet"]
+
+
+def _t(x, do):
+    return jnp.swapaxes(x, -1, -2) if do else x
+
+
+def gemm(A, B, C, alpha=1.0, beta=1.0, transpose_a=False, transpose_b=False,
+         **kw):
+    """C' = alpha * op(A) op(B) + beta * C (ref la_op.cc _linalg_gemm)."""
+    return call(lambda a, b, c: alpha * jnp.matmul(_t(a, transpose_a),
+                                                   _t(b, transpose_b))
+                + beta * c, (A, B, C), {}, name="linalg_gemm")
+
+
+def gemm2(A, B, alpha=1.0, transpose_a=False, transpose_b=False, **kw):
+    """alpha * op(A) op(B) (ref _linalg_gemm2)."""
+    return call(lambda a, b: alpha * jnp.matmul(_t(a, transpose_a),
+                                                _t(b, transpose_b)),
+                (A, B), {}, name="linalg_gemm2")
+
+
+def potrf(A, **kw):
+    """Lower Cholesky factor (ref _linalg_potrf)."""
+    return call(jnp.linalg.cholesky, (A,), {}, name="linalg_potrf")
+
+
+def potri(A, **kw):
+    """Inverse from a Cholesky factor L: (L L^T)^-1 (ref _linalg_potri)."""
+    def f(L):
+        eye = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype),
+                               L.shape)
+        Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+        return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+    return call(f, (A,), {}, name="linalg_potri")
+
+
+def trmm(A, B, alpha=1.0, transpose=False, rightside=False, lower=True,
+         **kw):
+    """Triangular matrix product (ref _linalg_trmm)."""
+    def f(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        tri = _t(tri, transpose)
+        return alpha * (jnp.matmul(b, tri) if rightside
+                        else jnp.matmul(tri, b))
+    return call(f, (A, B), {}, name="linalg_trmm")
+
+
+def trsm(A, B, alpha=1.0, transpose=False, rightside=False, lower=True,
+         **kw):
+    """Solve op(tri(A)) X = alpha B (ref _linalg_trsm)."""
+    def f(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        low = lower != transpose
+        if rightside:
+            # X op(A) = aB  <=>  op(A)^T X^T = a B^T
+            y = jax.scipy.linalg.solve_triangular(
+                _t(tri, not transpose), _t(alpha * b, True), lower=not low)
+            return _t(y, True)
+        return jax.scipy.linalg.solve_triangular(
+            _t(tri, transpose), alpha * b, lower=low)
+    return call(f, (A, B), {}, name="linalg_trsm")
+
+
+def syrk(A, alpha=1.0, transpose=False, **kw):
+    """alpha op(A) op(A)^T (ref _linalg_syrk)."""
+    return call(lambda a: alpha * jnp.matmul(_t(a, transpose),
+                                             _t(a, not transpose)),
+                (A,), {}, name="linalg_syrk")
+
+
+def syevd(A, **kw):
+    """Symmetric eigendecomposition; returns (U, L) with rows of U the
+    eigenvectors, matching ``A = U^T diag(L) U`` (ref _linalg_syevd)."""
+    def f(a):
+        w, v = jnp.linalg.eigh(a)
+        return jnp.swapaxes(v, -1, -2), w
+    return call(f, (A,), {}, name="linalg_syevd")
+
+
+def gelqf(A, **kw):
+    """LQ factorization A = L Q with Q row-orthonormal (ref _linalg_gelqf)."""
+    def f(a):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+        return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+    return call(f, (A,), {}, name="linalg_gelqf")
+
+
+def sumlogdiag(A, **kw):
+    """sum(log(diag(A))) (ref _linalg_sumlogdiag)."""
+    return call(lambda a: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2,
+                                                       axis2=-1)), -1),
+                (A,), {}, name="linalg_sumlogdiag")
+
+
+def extractdiag(A, offset=0, **kw):
+    return call(lambda a: jnp.diagonal(a, offset=offset, axis1=-2,
+                                       axis2=-1),
+                (A,), {}, name="linalg_extractdiag")
+
+
+def makediag(a, offset=0, **kw):
+    def f(x):
+        n = x.shape[-1] + abs(offset)
+        out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+        idx = jnp.arange(x.shape[-1])
+        r = idx + max(0, -offset)
+        c = idx + max(0, offset)
+        return out.at[..., r, c].set(x)
+    return call(f, (a,), {}, name="linalg_makediag")
+
+
+def extracttrian(A, offset=0, lower=True, **kw):
+    """Flatten one triangle into packed rows (ref _linalg_extracttrian)."""
+    def f(a):
+        n = a.shape[-1]
+        import numpy as onp
+
+        rs, cs = [], []
+        for i in range(n):
+            for j in range(n):
+                if (lower and j <= i + offset) or \
+                        (not lower and j >= i + offset):
+                    rs.append(i)
+                    cs.append(j)
+        return a[..., onp.array(rs), onp.array(cs)]
+    return call(f, (A,), {}, name="linalg_extracttrian")
+
+
+def maketrian(a, offset=0, lower=True, **kw):
+    """Inverse of extracttrian for square targets (ref _linalg_maketrian)."""
+    def f(x):
+        import numpy as onp
+
+        k = x.shape[-1]
+        # packed length k = n(n+1)/2 + adjustment; solve n for offset 0
+        n = int((onp.sqrt(8 * k + 1) - 1) / 2) if offset == 0 else None
+        if n is None or n * (n + 1) // 2 != k:
+            raise ValueError("maketrian supports offset=0 packed triangles")
+        rs, cs = [], []
+        for i in range(n):
+            for j in range(n):
+                if (lower and j <= i) or (not lower and j >= i):
+                    rs.append(i)
+                    cs.append(j)
+        out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+        return out.at[..., onp.array(rs), onp.array(cs)].set(x)
+    return call(f, (a,), {}, name="linalg_maketrian")
+
+
+def inverse(A, **kw):
+    return call(jnp.linalg.inv, (A,), {}, name="linalg_inverse")
+
+
+def det(A, **kw):
+    return call(jnp.linalg.det, (A,), {}, name="linalg_det")
+
+
+def slogdet(A, **kw):
+    return call(lambda a: tuple(jnp.linalg.slogdet(a)), (A,), {},
+                name="linalg_slogdet")

@@ -184,15 +184,29 @@ class NDArray:
     # mismatches etc.) propagate; only missing mappings / unsupported
     # kwargs take the fallback. ------------------------------------------
     def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
-        if method == "__call__" and kwargs.get("out") is None:
+        if method == "__call__":
             from .. import numpy as mnp
 
+            out = kwargs.pop("out", None)
+            if isinstance(out, tuple) and len(out) == 1:
+                out = out[0]
             fn = getattr(mnp, ufunc.__name__, None)
             if fn is not None:
                 try:
-                    return fn(*inputs, **kwargs)
+                    res = fn(*inputs, **kwargs)
                 except TypeError:
-                    pass  # kwargs the mx op doesn't take -> host fallback
+                    res = None  # kwargs the mx op doesn't take
+                if res is not None:
+                    if out is None:
+                        return res
+                    if isinstance(out, NDArray):
+                        # in-place semantics: write back into the caller's
+                        # buffer (a host-copy fallback would silently
+                        # discard the result)
+                        out._set_data(res._data.astype(out._data.dtype))
+                        return out
+            if out is not None:
+                kwargs["out"] = out
         return getattr(ufunc, method)(*_host(inputs), **_host(kwargs))
 
     def __array_function__(self, func, types, args, kwargs):

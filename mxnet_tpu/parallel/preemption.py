@@ -4,9 +4,10 @@ The reference has no failure-detection/elastic story (SURVEY.md §5:
 "Absent... recovery story = checkpoint/resume"); this module exceeds it
 with the piece cloud TPU training actually needs: when the host receives
 a preemption signal (SIGTERM — what GCE/GKE sends before reclaiming a
-spot/preemptible VM), finish the in-flight step, write a full
-ShardedTrainer checkpoint, then re-raise the default handler so the
-process still terminates promptly.
+spot/preemptible VM), finish the in-flight step and write a full
+ShardedTrainer checkpoint at the next ``step()`` boundary; the training
+loop then exits on the True return (the handler never kills the process
+itself — checkpointing must come first).
 
 Usage::
 
@@ -96,13 +97,24 @@ class PreemptionGuard:
 
         rank = getattr(jax, "process_index", lambda: 0)()
         if not self._save_on_rank0_only or rank == 0:
-            d = os.path.dirname(os.path.abspath(self.path))
-            os.makedirs(d, exist_ok=True)
-            tmp = f"{self.path}.tmp.{os.getpid()}"
-            self.trainer.save_states(tmp)
-            os.replace(tmp, self.path)  # atomic: never a torn checkpoint
-            logging.warning("preemption checkpoint written to %s (step %d)",
-                            self.path, self.trainer._t)
+            try:
+                d = os.path.dirname(os.path.abspath(self.path))
+                os.makedirs(d, exist_ok=True)
+                tmp = f"{self.path}.tmp.{os.getpid()}"
+                self.trainer.save_states(tmp)
+                os.replace(tmp, self.path)  # atomic: no torn checkpoint
+                logging.warning(
+                    "preemption checkpoint written to %s (step %d)",
+                    self.path, self.trainer._t)
+            except Exception:
+                # params sharded across non-addressable devices (e.g. tp
+                # across hosts) cannot be gathered by save_states; log
+                # loudly — the preempted run exits either way, but the
+                # operator must know there is NO checkpoint
+                logging.exception(
+                    "preemption checkpoint FAILED (params not "
+                    "process-addressable? see save_states); exiting "
+                    "WITHOUT a checkpoint")
         self._saved = True
         return True
 

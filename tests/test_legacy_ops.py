@@ -1,0 +1,176 @@
+"""Legacy tensor-op tail, mx.nd.linalg, and optimizer update ops.
+
+References: src/operator/tensor/la_op.cc (linalg namespace),
+src/operator/tensor/matrix_op.cc (slice/slice_axis/reverse/SwapAxis),
+src/operator/optimizer_op.cc:313-398 (update kernels),
+src/operator/nn/im2col.cc, src/operator/nn/moments.cc.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+
+@pytest.fixture()
+def rng():
+    return onp.random.RandomState(0)
+
+
+# ---------------------------------------------------------------------------
+# mx.nd.linalg
+# ---------------------------------------------------------------------------
+
+def test_linalg_gemm_family(rng):
+    A = rng.rand(4, 4).astype("f4")
+    B = rng.rand(4, 3).astype("f4")
+    C = rng.rand(4, 3).astype("f4")
+    out = mx.nd.linalg.gemm(mx.nd.array(A), mx.nd.array(B), mx.nd.array(C),
+                            alpha=2.0, beta=0.5)
+    assert onp.allclose(out.asnumpy(), 2 * A @ B + 0.5 * C, atol=1e-4)
+    out = mx.nd.linalg.gemm2(mx.nd.array(A), mx.nd.array(B))
+    assert onp.allclose(out.asnumpy(), A @ B, atol=1e-4)
+    out = mx.nd.linalg.gemm2(mx.nd.array(A), mx.nd.array(B.T),
+                             transpose_b=True)
+    assert onp.allclose(out.asnumpy(), A @ B, atol=1e-4)
+
+
+def test_linalg_cholesky_family(rng):
+    A = rng.rand(4, 4).astype("f4")
+    SPD = A @ A.T + 4 * onp.eye(4, dtype="f4")
+    L = mx.nd.linalg.potrf(mx.nd.array(SPD))
+    assert onp.allclose(L.asnumpy() @ L.asnumpy().T, SPD, atol=1e-3)
+    inv = mx.nd.linalg.potri(L)
+    assert onp.allclose(inv.asnumpy(), onp.linalg.inv(SPD), atol=1e-2)
+    assert onp.allclose(mx.nd.linalg.sumlogdiag(mx.nd.array(SPD)).asnumpy(),
+                        onp.sum(onp.log(onp.diag(SPD))), atol=1e-4)
+
+
+def test_linalg_triangular(rng):
+    A = rng.rand(4, 4).astype("f4")
+    SPD = A @ A.T + 4 * onp.eye(4, dtype="f4")
+    L = onp.linalg.cholesky(SPD).astype("f4")
+    B = rng.rand(4, 3).astype("f4")
+    X = mx.nd.linalg.trsm(mx.nd.array(L), mx.nd.array(B))
+    assert onp.allclose(L @ X.asnumpy(), B, atol=1e-4)
+    X2 = mx.nd.linalg.trsm(mx.nd.array(L), mx.nd.array(B.T), rightside=True)
+    assert onp.allclose(X2.asnumpy() @ L, B.T, atol=1e-4)
+    X3 = mx.nd.linalg.trsm(mx.nd.array(L), mx.nd.array(B), transpose=True)
+    assert onp.allclose(L.T @ X3.asnumpy(), B, atol=1e-3)
+    assert onp.allclose(mx.nd.linalg.trmm(mx.nd.array(L),
+                                          mx.nd.array(B)).asnumpy(),
+                        L @ B, atol=1e-4)
+    assert onp.allclose(mx.nd.linalg.syrk(mx.nd.array(A)).asnumpy(),
+                        A @ A.T, atol=1e-4)
+
+
+def test_linalg_factorizations(rng):
+    A = rng.rand(4, 4).astype("f4")
+    SPD = A @ A.T + 4 * onp.eye(4, dtype="f4")
+    U, lam = mx.nd.linalg.syevd(mx.nd.array(SPD))
+    recon = U.asnumpy().T @ onp.diag(lam.asnumpy()) @ U.asnumpy()
+    assert onp.allclose(recon, SPD, atol=1e-2)
+    B = rng.rand(3, 4).astype("f4")
+    Lq, Q = mx.nd.linalg.gelqf(mx.nd.array(B))
+    assert onp.allclose(Lq.asnumpy() @ Q.asnumpy(), B, atol=1e-4)
+    assert onp.allclose(Q.asnumpy() @ Q.asnumpy().T, onp.eye(3), atol=1e-4)
+    d = mx.nd.linalg.extractdiag(mx.nd.array(SPD))
+    assert onp.allclose(d.asnumpy(), onp.diag(SPD))
+    M = mx.nd.linalg.makediag(d)
+    assert onp.allclose(M.asnumpy(), onp.diag(onp.diag(SPD)))
+    packed = mx.nd.linalg.extracttrian(mx.nd.array(SPD))
+    back = mx.nd.linalg.maketrian(packed)
+    assert onp.allclose(onp.tril(back.asnumpy()), onp.tril(SPD))
+    sign, logdet = mx.nd.linalg.slogdet(mx.nd.array(SPD))
+    assert onp.allclose(float(sign.asnumpy()) * onp.exp(float(
+        logdet.asnumpy())), onp.linalg.det(SPD), rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# legacy tensor tail
+# ---------------------------------------------------------------------------
+
+def test_slice_family():
+    x = mx.nd.array(onp.arange(24, dtype="f4").reshape(2, 3, 4))
+    assert onp.allclose(mx.nd.slice(x, (0, 1), (2, 3)).asnumpy(),
+                        x.asnumpy()[0:2, 1:3])
+    assert onp.allclose(
+        mx.nd.slice(x, (0,), (2,), step=(1,)).asnumpy(), x.asnumpy())
+    assert onp.allclose(mx.nd.slice_axis(x, 2, 1, 3).asnumpy(),
+                        x.asnumpy()[:, :, 1:3])
+    assert onp.allclose(mx.nd.slice_axis(x, -1, 0, 2).asnumpy(),
+                        x.asnumpy()[..., :2])
+    assert onp.allclose(mx.nd.reverse(x, 1).asnumpy(), x.asnumpy()[:, ::-1])
+
+
+def test_misc_legacy_ops(rng):
+    x = mx.nd.array(onp.arange(24, dtype="f4").reshape(2, 3, 4))
+    assert onp.allclose(mx.nd.add_n(x, x, x).asnumpy(), 3 * x.asnumpy())
+    assert onp.allclose(mx.nd.add_n([x, x]).asnumpy(), 2 * x.asnumpy())
+    assert onp.allclose(mx.nd.SwapAxis(x, 0, 2).asnumpy(),
+                        x.asnumpy().swapaxes(0, 2))
+    assert str(mx.nd.Cast(x, "int32").dtype) == "int32"
+    m, v = mx.nd.moments(x, axes=(0, 2))
+    assert onp.allclose(m.asnumpy(), x.asnumpy().mean((0, 2)), atol=1e-5)
+    assert onp.allclose(v.asnumpy(), x.asnumpy().var((0, 2)), atol=1e-4)
+    a = mx.nd.array(rng.rand(3, 5).astype("f4"))
+    idx = onp.array([4, 0, 2])
+    bt = mx.nd.batch_take(a, mx.nd.array(idx))
+    assert onp.allclose(bt.asnumpy(),
+                        a.asnumpy()[onp.arange(3), idx])
+    am = mx.nd.argmax_channel(a)
+    assert onp.allclose(am.asnumpy(), a.asnumpy().argmax(1))
+    sm = mx.nd.softmin(mx.nd.array(onp.array([[1., 2.]], "f4")))
+    assert sm.asnumpy()[0, 0] > sm.asnumpy()[0, 1]
+    assert int(mx.nd.size_array(x).asnumpy()[0]) == 24
+
+
+def test_im2col_matches_conv(rng):
+    """im2col columns dotted with flattened weights == convolution."""
+    x = rng.rand(1, 2, 5, 5).astype("f4")
+    w = rng.rand(3, 2, 2, 2).astype("f4")
+    cols = mx.nd.im2col(mx.nd.array(x), kernel=(2, 2))
+    out = w.reshape(3, -1) @ cols.asnumpy()[0]  # (3, L)
+    conv = mx.npx.convolution(mx.nd.array(x), mx.nd.array(w),
+                              kernel=(2, 2), num_filter=3, no_bias=True)
+    assert onp.allclose(out.reshape(conv.shape[1:]), conv.asnumpy()[0],
+                        atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# optimizer update ops
+# ---------------------------------------------------------------------------
+
+def test_sgd_updates():
+    w = mx.nd.array(onp.ones(4, "f4"))
+    g = mx.nd.array(onp.full(4, 0.5, "f4"))
+    assert onp.allclose(mx.nd.sgd_update(w, g, lr=0.1).asnumpy(), 0.95)
+    assert onp.allclose(
+        mx.nd.sgd_update(w, g, lr=0.1, wd=0.1).asnumpy(), 1 - 0.06)
+    # clip_gradient
+    big = mx.nd.array(onp.full(4, 100.0, "f4"))
+    assert onp.allclose(
+        mx.nd.sgd_update(w, big, lr=0.1, clip_gradient=1.0).asnumpy(), 0.9)
+    mom = mx.nd.zeros((4,))
+    out = mx.nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    assert onp.allclose(mom.asnumpy(), -0.05)  # state mutated in place
+    assert onp.allclose(out.asnumpy(), 0.95)
+    out2 = mx.nd.sgd_mom_update(out, g, mom, lr=0.1, momentum=0.9)
+    assert onp.allclose(mom.asnumpy(), 0.9 * -0.05 - 0.05, atol=1e-6)
+
+
+def test_adam_rmsprop_signsgd_nag():
+    w = mx.nd.array(onp.ones(4, "f4"))
+    g = mx.nd.array(onp.full(4, 0.5, "f4"))
+    mean_s, var_s = mx.nd.zeros((4,)), mx.nd.zeros((4,))
+    out = mx.nd.adam_update(w, g, mean_s, var_s, lr=0.01)
+    assert out.asnumpy().max() < 1.0
+    assert onp.abs(mean_s.asnumpy()).max() > 0  # states updated
+    n = mx.nd.zeros((4,))
+    out = mx.nd.rmsprop_update(w, g, n, lr=0.1)
+    expect = 1 - 0.1 * 0.5 / onp.sqrt(0.05 * 0.25 + 1e-8)
+    assert onp.allclose(out.asnumpy(), expect, atol=1e-3)
+    assert onp.allclose(mx.nd.signsgd_update(w, g, lr=0.1).asnumpy(), 0.9)
+    nmom = mx.nd.zeros((4,))
+    out = mx.nd.nag_mom_update(w, g, nmom, lr=0.1, momentum=0.9)
+    assert onp.allclose(nmom.asnumpy(), 0.5)
+    assert onp.allclose(out.asnumpy(), 1 - 0.1 * (0.5 + 0.45), atol=1e-6)

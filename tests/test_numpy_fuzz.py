@@ -7,13 +7,13 @@ pairs for binaries, random axes for reductions), integer and float
 dtypes where sensible, plus an indexing fuzz over mixed basic/advanced
 index expressions. Failures print the reproducing seed via conftest.
 """
+import zlib
+
 import numpy as onp
 import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.test_utils import assert_almost_equal
-
-RNG = onp.random.RandomState(20260730)
 
 UNARY_ANY = ["negative", "abs", "sign", "floor", "ceil", "trunc", "rint",
              "square", "sinh", "cosh", "tanh", "arcsinh", "arctan", "sin",
@@ -55,7 +55,7 @@ def _bcast_pair(rng):
 @pytest.mark.parametrize("name", sorted(set(
     UNARY_ANY + UNARY_POS + UNARY_UNIT)))
 def test_fuzz_unary(name):
-    rng = onp.random.RandomState(abs(hash(name)) % (2**31))
+    rng = onp.random.RandomState(zlib.crc32(name.encode()))
     for _ in range(4):
         shape = _rand_shape(rng)
         if name in UNARY_POS:
@@ -71,7 +71,7 @@ def test_fuzz_unary(name):
 
 @pytest.mark.parametrize("name", BINARY)
 def test_fuzz_binary_broadcast(name):
-    rng = onp.random.RandomState(abs(hash("b" + name)) % (2**31))
+    rng = onp.random.RandomState(zlib.crc32(("b" + name).encode()))
     for _ in range(4):
         sa, sb = _bcast_pair(rng)
         a = rng.uniform(0.5, 2.0, sa).astype(onp.float32)
@@ -87,7 +87,7 @@ def test_fuzz_binary_broadcast(name):
 
 @pytest.mark.parametrize("name", COMPARE)
 def test_fuzz_compare(name):
-    rng = onp.random.RandomState(abs(hash("c" + name)) % (2**31))
+    rng = onp.random.RandomState(zlib.crc32(("c" + name).encode()))
     for _ in range(4):
         sa, sb = _bcast_pair(rng)
         a = rng.randint(0, 3, sa).astype(onp.float32)
@@ -99,7 +99,7 @@ def test_fuzz_compare(name):
 
 @pytest.mark.parametrize("name", REDUCE)
 def test_fuzz_reduce_axes(name):
-    rng = onp.random.RandomState(abs(hash("r" + name)) % (2**31))
+    rng = onp.random.RandomState(zlib.crc32(("r" + name).encode()))
     for _ in range(4):
         shape = _rand_shape(rng, 4)
         if not shape:
@@ -109,8 +109,6 @@ def test_fuzz_reduce_axes(name):
         axis = choices[rng.randint(0, len(choices))]
         kw = {}
         if name.startswith("arg"):
-            if axis is None and rng.rand() < 0.5:
-                pass
             got = getattr(mx.np, name)(mx.np.array(x), axis=axis)
             want = getattr(onp, name)(x, axis=axis)
             assert onp.array_equal(onp.asarray(got.asnumpy()), want)
@@ -124,7 +122,7 @@ def test_fuzz_reduce_axes(name):
 
 @pytest.mark.parametrize("name", ACCUM)
 def test_fuzz_accumulations(name):
-    rng = onp.random.RandomState(abs(hash("a" + name)) % (2**31))
+    rng = onp.random.RandomState(zlib.crc32(("a" + name).encode()))
     for _ in range(4):
         shape = _rand_shape(rng, 3) or (4,)
         x = rng.uniform(0.5, 1.5, shape).astype(onp.float32)
@@ -140,7 +138,7 @@ def test_fuzz_integer_dtypes(name):
     # int64 narrows to int32 unless MXNET_INT64_TENSOR_SIZE enables jax
     # 64-bit mode (the reference's INT64_TENSOR_SIZE build flag analogue;
     # tested in test_int64_flag_subprocess) — here exercise int32
-    rng = onp.random.RandomState(abs(hash("i" + name)) % (2**31))
+    rng = onp.random.RandomState(zlib.crc32(("i" + name).encode()))
     x = rng.randint(-5, 6, (3, 4)).astype("int32")
     got = getattr(mx.np, name)(mx.np.array(x))
     want = getattr(onp, name)(x)
