@@ -342,3 +342,91 @@ from . import random  # noqa: E402
 from . import linalg  # noqa: E402
 from .utils import save, load  # noqa: E402
 from . import sparse  # noqa: E402
+
+
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    """Ref optimizer_op-inl.h:2087 FtrlUpdateKernel."""
+    def f(w, g, zz, nn):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        z2 = zz + g - (jnp.sqrt(nn + g * g) - jnp.sqrt(nn)) * w / lr
+        n2 = nn + g * g
+        d = -jnp.sign(z2) * jnp.maximum(jnp.abs(z2) - lamda1, 0.0)
+        return d / ((beta + jnp.sqrt(n2)) / lr + wd), z2, n2
+    new_w, new_z, new_n = call(f, (weight, grad, z, n), {},
+                               name="ftrl_update")
+    z._set_data(new_z._data)
+    n._set_data(new_n._data)
+    if out is not None:
+        out._set_data(new_w._data)
+        return out
+    return new_w
+
+
+def lamb_update_phase1(weight, grad, mean, var, t, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, bias_correction=True, out=None):
+    """Ref optimizer_op-inl.h:1573 LambUpdatePhaseOneKernel: returns the
+    raw update direction g; mean/var updated in place."""
+    b1t, b2t = beta1 ** t, beta2 ** t
+
+    def f(w, g, m, v):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        m2 = beta1 * m + (1 - beta1) * g
+        v2 = beta2 * v + (1 - beta2) * g * g
+        if bias_correction:
+            mh, vh = m2 / (1 - b1t), v2 / (1 - b2t)
+            upd = mh / (jnp.sqrt(vh) + epsilon) + wd * w
+        else:
+            upd = m2 / (jnp.sqrt(v2) + epsilon) + wd * w
+        return upd, m2, v2
+    upd, new_m, new_v = call(f, (weight, grad, mean, var), {},
+                             name="lamb_update_phase1")
+    mean._set_data(new_m._data)
+    var._set_data(new_v._data)
+    if out is not None:
+        out._set_data(upd._data)
+        return out
+    return upd
+
+
+def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0, out=None):
+    """Ref optimizer_op-inl.h:1657 LambUpdatePhaseTwoKernel: trust-ratio
+    scaled apply. r1 = ||w||, r2 = ||g||, scalars (1,)."""
+    def f(w, gg, a, b):
+        nr1 = a[0]
+        if lower_bound >= 0:
+            nr1 = jnp.maximum(nr1, lower_bound)
+        if upper_bound >= 0:
+            nr1 = jnp.minimum(nr1, upper_bound)
+        ratio = jnp.where((nr1 == 0.0) | (b[0] == 0.0), 1.0, nr1 / b[0])
+        return w - lr * ratio * gg
+    return call(f, (weight, g, r1, r2), {}, name="lamb_update_phase2",
+                out=out)
+
+
+def group_adagrad_update(weight, grad, history, lr, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5, out=None):
+    """Ref contrib/optimizer_op.cc _contrib_group_adagrad_update: per-row
+    accumulated squared-gradient norms."""
+    def f(w, g, h):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        h2 = h + jnp.mean(g * g, axis=tuple(range(1, g.ndim)),
+                          keepdims=True) if g.ndim > 1 else h + g * g
+        shape = h2.reshape(h2.shape[0], *([1] * (g.ndim - 1))) \
+            if g.ndim > 1 else h2
+        return w - lr * g / (jnp.sqrt(shape) + epsilon), h2
+    new_w, new_h = call(f, (weight, grad, history), {},
+                        name="group_adagrad_update")
+    history._set_data(new_h._data)
+    if out is not None:
+        out._set_data(new_w._data)
+        return out
+    return new_w

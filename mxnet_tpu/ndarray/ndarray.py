@@ -206,6 +206,14 @@ class NDArray:
                         out._set_data(res._data.astype(out._data.dtype))
                         return out
             if out is not None:
+                if isinstance(out, NDArray):
+                    # host fallback with an NDArray out: compute on host,
+                    # then write back (passing a coerced copy to numpy
+                    # would silently drop the result)
+                    res = getattr(ufunc, method)(*_host(inputs),
+                                                 **_host(kwargs))
+                    out._set_data(jnp.asarray(res, out._data.dtype))
+                    return out
                 kwargs["out"] = out
         return getattr(ufunc, method)(*_host(inputs), **_host(kwargs))
 

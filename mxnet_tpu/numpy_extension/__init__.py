@@ -32,6 +32,13 @@ __all__ = [
     "masked_softmax", "masked_log_softmax", "softmax_cross_entropy",
     "embedding", "one_hot", "pick", "topk", "sequence_mask", "sequence_last",
     "sequence_reverse", "space_to_depth", "depth_to_space", "rnn",
+    "div_sqrt_dim", "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt", "interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_valatt", "sldwin_atten_score",
+    "sldwin_atten_mask_like", "sldwin_atten_context", "box_encode",
+    "box_decode", "bipartite_matching", "quadratic", "index_copy",
+    "index_array", "edge_id", "getnnz", "batch_norm_with_relu",
+    "dynamic_reshape", "col2im",
     "gamma", "gammaln", "erf", "erfinv", "digamma",
     "reshape_like", "slice_like", "broadcast_like", "shape_array", "batch_dot",
     "arange_like", "gather_nd", "scatter_nd", "index_update", "index_add",
@@ -665,3 +672,209 @@ def unique_padded(data, size=None, fill_value=0, out=None):
         return vals, _jnp.sum(distinct).astype(_jnp.int32)
 
     return call(f, (data,), {}, name="unique_padded", out=out)
+
+
+# -- transformer helpers (ref src/operator/contrib/transformer.cc) -----------
+
+def div_sqrt_dim(data, **kw):
+    from ..ops import transformer as _tr
+
+    return call(_tr.div_sqrt_dim, (data,), {}, name="div_sqrt_dim")
+
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads, **kw):
+    from ..ops import transformer as _tr
+
+    return call(lambda x: _tr.interleaved_matmul_selfatt_qk(x, heads),
+                (queries_keys_values,), {},
+                name="interleaved_matmul_selfatt_qk",
+                attrs={"heads": heads})
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads,
+                                      **kw):
+    from ..ops import transformer as _tr
+
+    return call(lambda x, a: _tr.interleaved_matmul_selfatt_valatt(
+        x, a, heads), (queries_keys_values, attention), {},
+        name="interleaved_matmul_selfatt_valatt", attrs={"heads": heads})
+
+
+def interleaved_matmul_encdec_qk(queries, keys_values, heads, **kw):
+    from ..ops import transformer as _tr
+
+    return call(lambda q, kv: _tr.interleaved_matmul_encdec_qk(q, kv, heads),
+                (queries, keys_values), {},
+                name="interleaved_matmul_encdec_qk", attrs={"heads": heads})
+
+
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads, **kw):
+    from ..ops import transformer as _tr
+
+    return call(lambda kv, a: _tr.interleaved_matmul_encdec_valatt(
+        kv, a, heads), (keys_values, attention), {},
+        name="interleaved_matmul_encdec_valatt", attrs={"heads": heads})
+
+
+def sldwin_atten_score(query, key, dilation, w, symmetric=True, **kw):
+    from ..ops import transformer as _tr
+
+    return call(lambda q, k, d: _tr.sldwin_atten_score(q, k, d, w, symmetric),
+                (query, key, dilation), {}, name="sldwin_atten_score",
+                attrs={"w": w, "symmetric": symmetric})
+
+
+def sldwin_atten_mask_like(score, dilation, valid_length, w, symmetric=True,
+                           **kw):
+    from ..ops import transformer as _tr
+
+    return call(lambda s, d, v: _tr.sldwin_atten_mask_like(
+        s, d, v, w, symmetric), (score, dilation, valid_length), {},
+        name="sldwin_atten_mask_like", attrs={"w": w, "symmetric": symmetric})
+
+
+def sldwin_atten_context(score, value, dilation, w, symmetric=True, **kw):
+    from ..ops import transformer as _tr
+
+    return call(lambda s, v, d: _tr.sldwin_atten_context(
+        s, v, d, w, symmetric), (score, value, dilation), {},
+        name="sldwin_atten_context", attrs={"w": w, "symmetric": symmetric})
+
+
+# -- contrib tail (ref src/operator/contrib/) --------------------------------
+
+def box_encode(samples, matches, anchors, refs, means=None, stds=None, **kw):
+    from ..ops import boxes as _bx
+
+    return call(lambda s, m, a, r: _bx.box_encode(s, m, a, r, means, stds),
+                (samples, matches, anchors, refs), {}, name="box_encode")
+
+
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner", **kw):  # noqa: A002
+    from ..ops import boxes as _bx
+
+    return call(lambda d, a: _bx.box_decode(d, a, std0, std1, std2, std3,
+                                            clip, format),
+                (data, anchors), {}, name="box_decode")
+
+
+def bipartite_matching(score, threshold=1e-12, is_ascend=False, topk=-1,
+                       **kw):
+    from ..ops import boxes as _bx
+
+    return call(lambda s: _bx.bipartite_matching(s, threshold, is_ascend,
+                                                 topk),
+                (score,), {}, name="bipartite_matching",
+                attrs={"threshold": threshold, "is_ascend": is_ascend,
+                       "topk": topk})
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0, **kw):
+    """f(x) = a x^2 + b x + c (ref contrib/quadratic_op.cc — the tutorial
+    custom-op example, kept for parity)."""
+    return call(lambda x: a * x * x + b * x + c, (data,), {},
+                name="quadratic", attrs={"a": a, "b": b, "c": c})
+
+
+def index_copy(old_tensor, index_vector, new_tensor, **kw):
+    """Copy new_tensor rows into old_tensor at index positions
+    (ref contrib/index_copy.cc:166)."""
+    return call(lambda o, i, n: o.at[i.astype(jnp.int32)].set(n),
+                (old_tensor, index_vector, new_tensor), {},
+                name="index_copy")
+
+
+def index_array(data, axes=None, **kw):
+    """Per-element N-D index tensor (ref contrib/index_array.cc): output
+    (\\*data.shape, len(axes) or ndim) of int64 coordinates."""
+    def f(x):
+        idx = jnp.stack(jnp.meshgrid(
+            *[jnp.arange(d) for d in x.shape], indexing="ij"), axis=-1)
+        if axes is not None:
+            idx = idx[..., tuple(axes)]
+        return idx.astype(jnp.int32)
+    return call(f, (data,), {}, name="index_array")
+
+
+def edge_id(data, u, v, **kw):
+    """CSR edge-id lookup (ref contrib/dgl_graph.cc _contrib_edge_id
+    semantics): data is a CSRNDArray adjacency; returns data[u[i], v[i]]
+    per pair, -1 where absent."""
+    from ..ndarray.sparse import CSRNDArray
+
+    if not isinstance(data, CSRNDArray):
+        raise MXNetError("edge_id expects a CSRNDArray adjacency")
+    dense = data.todense()
+    def f(dd, uu, vv):
+        vals = dd[uu.astype(jnp.int32), vv.astype(jnp.int32)]
+        return jnp.where(vals != 0, vals, -1.0)
+    return call(f, (dense, u, v), {}, name="edge_id")
+
+
+def getnnz(data, axis=None, **kw):
+    """Number of stored values in a sparse matrix (ref
+    contrib/nnz.cc _contrib_getnnz)."""
+    from ..ndarray.sparse import CSRNDArray
+
+    if isinstance(data, CSRNDArray):
+        if axis is None:
+            return int(data.data.shape[0])
+        dense = data.todense()
+    else:
+        dense = data
+    def f(x):
+        nz = (x != 0)
+        return jnp.sum(nz, axis=axis).astype(jnp.int32) if axis is not None \
+            else jnp.sum(nz).astype(jnp.int32)
+    return call(f, (dense,), {}, name="getnnz")
+
+
+def batch_norm_with_relu(x, gamma, beta, running_mean, running_var, **kw):
+    """BatchNorm fused with ReLU (ref contrib/batch_norm_relu.cc — under
+    XLA the fusion is automatic; the surface is kept for parity)."""
+    return relu(batch_norm(x, gamma, beta, running_mean, running_var, **kw))
+
+
+def dynamic_reshape(data, shape_like, **kw):
+    """Reshape data to shape_like's shape (ref contrib/dynamic_reshape).
+    Under jit, shapes are static at trace time, so this is reshape_like."""
+    return reshape_like(data, shape_like)
+
+
+def col2im(data, output_size, kernel, stride=1, dilate=1, pad=0, **kw):
+    """Fold im2col columns back to an image, summing overlaps
+    (ref src/operator/nn/im2col.cc col2im)."""
+    import itertools
+
+    def f(x):
+        n_sp = len(kernel) if isinstance(kernel, (tuple, list)) else 2
+        k = kernel if isinstance(kernel, (tuple, list)) else (kernel,) * n_sp
+        st = stride if isinstance(stride, (tuple, list)) else (stride,) * n_sp
+        d = dilate if isinstance(dilate, (tuple, list)) else (dilate,) * n_sp
+        p = pad if isinstance(pad, (tuple, list)) else (pad,) * n_sp
+        out_size = (output_size if isinstance(output_size, (tuple, list))
+                    else (output_size,) * n_sp)
+        N = x.shape[0]
+        import numpy as _np
+
+        kprod = 1
+        for kk in k:
+            kprod *= kk
+        C = x.shape[1] // kprod
+        padded = [out_size[i] + 2 * p[i] for i in range(n_sp)]
+        col_sp = [(padded[i] - (d[i] * (k[i] - 1) + 1)) // st[i] + 1
+                  for i in range(n_sp)]
+        img = jnp.zeros((N, C) + tuple(padded), x.dtype)
+        cols = x.reshape((N, C, kprod) + tuple(col_sp))
+        for ki, off in enumerate(itertools.product(*[range(kk) for kk in k])):
+            sl = [slice(None), slice(None)]
+            for i in range(n_sp):
+                start = off[i] * d[i]
+                stop = start + st[i] * (col_sp[i] - 1) + 1
+                sl.append(slice(start, stop, st[i]))
+            img = img.at[tuple(sl)].add(cols[:, :, ki])
+        unpad = [slice(None), slice(None)] + \
+            [slice(p[i], p[i] + out_size[i]) for i in range(n_sp)]
+        return img[tuple(unpad)]
+    return call(f, (data,), {}, name="col2im")

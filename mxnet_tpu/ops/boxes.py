@@ -272,3 +272,100 @@ def multibox_detection(cls_prob, loc_pred, anchors,
     return box_nms(rows, overlap_thresh=nms_threshold,
                    valid_thresh=threshold, topk=nms_topk,
                    coord_start=2, score_index=1, id_index=0)
+
+
+def box_encode(samples, matches, anchors, refs, means=None, stds=None):
+    """Encode matched boxes to normalized center-offset targets
+    (ref src/operator/contrib/bounding_box-inl.h:847 box_encode).
+
+    samples/matches: (B, N); anchors: (B, N, 4) corner; refs: (B, M, 4)
+    corner; means/stds: (4,). Returns (targets (B, N, 4), masks (B, N, 4)).
+    """
+    means = jnp.asarray([0.0, 0.0, 0.0, 0.0] if means is None else means)
+    stds = jnp.asarray([0.1, 0.1, 0.2, 0.2] if stds is None else stds)
+    m_idx = matches.astype(jnp.int32)
+    ref = jnp.take_along_axis(refs, m_idx[..., None].repeat(4, -1), axis=1)
+    ref_w = ref[..., 2] - ref[..., 0]
+    ref_h = ref[..., 3] - ref[..., 1]
+    ref_x = ref[..., 0] + ref_w * 0.5
+    ref_y = ref[..., 1] + ref_h * 0.5
+    a_w = anchors[..., 2] - anchors[..., 0]
+    a_h = anchors[..., 3] - anchors[..., 1]
+    a_x = anchors[..., 0] + a_w * 0.5
+    a_y = anchors[..., 1] + a_h * 0.5
+    valid = (samples > 0.5)
+    t = jnp.stack([((ref_x - a_x) / a_w - means[0]) / stds[0],
+                   ((ref_y - a_y) / a_h - means[1]) / stds[1],
+                   (jnp.log(ref_w / a_w) - means[2]) / stds[2],
+                   (jnp.log(ref_h / a_h) - means[3]) / stds[3]], axis=-1)
+    masks = jnp.broadcast_to(valid[..., None], t.shape).astype(t.dtype)
+    targets = jnp.where(valid[..., None], t, 0.0)
+    return targets, masks
+
+
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner"):  # noqa: A002
+    """Decode center-offset predictions back to corner boxes
+    (ref bounding_box-inl.h:992 box_decode). data: (B, N, 4),
+    anchors: (1 or B, N, 4)."""
+    a = anchors
+    if format == "corner":
+        a_w = a[..., 2] - a[..., 0]
+        a_h = a[..., 3] - a[..., 1]
+        a_x = a[..., 0] + a_w * 0.5
+        a_y = a[..., 1] + a_h * 0.5
+    else:
+        a_x, a_y, a_w, a_h = (a[..., 0], a[..., 1], a[..., 2], a[..., 3])
+    ox = data[..., 0] * std0 * a_w + a_x
+    oy = data[..., 1] * std1 * a_h + a_y
+    dw = data[..., 2] * std2
+    dh = data[..., 3] * std3
+    if clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    ow = jnp.exp(dw) * a_w * 0.5
+    oh = jnp.exp(dh) * a_h * 0.5
+    return jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+
+
+def bipartite_matching(score, threshold=1e-12, is_ascend=False, topk=-1):
+    """Greedy bipartite matching over a (B, N, M) score matrix
+    (ref src/operator/contrib/bounding_box.cc _contrib_bipartite_matching).
+    Returns (row_match (B, N), col_match (B, M)): row_match[b, i] = matched
+    column or -1; col_match[b, j] = matched row or -1."""
+    b, n, m = score.shape
+    k = n if topk < 0 else min(topk, n)
+
+    sign = 1.0 if is_ascend else -1.0
+    big = jnp.asarray(jnp.inf, score.dtype)
+
+    def body(carry, _):
+        sc, rowm, colm = carry
+        flat = jnp.argmin(sign * sc.reshape(b, -1), axis=-1)
+        i, j = flat // m, flat % m
+        val = jnp.take_along_axis(
+            sc.reshape(b, -1), flat[:, None], axis=1)[:, 0]
+        # ref bounding_box.cc: valid while score > thresh (descend) /
+        # score < thresh (ascend)
+        ok = (val < threshold) if is_ascend else (val > threshold)
+        rowm = rowm.at[jnp.arange(b), i].set(
+            jnp.where(ok, j, rowm[jnp.arange(b), i]))
+        colm = colm.at[jnp.arange(b), j].set(
+            jnp.where(ok, i, colm[jnp.arange(b), j]))
+        # retire matched row+col
+        sc = jnp.where(ok[:, None, None],
+                       sc.at[jnp.arange(b), i, :].set(sign * big)
+                       .at[jnp.arange(b), :, j].set(sign * big), sc)
+        return (sc, rowm, colm), None
+
+    rowm = jnp.full((b, n), -1.0, score.dtype)
+    colm = jnp.full((b, m), -1.0, score.dtype)
+    (_, rowm, colm) = _scan_fixed(body, (score, rowm, colm), k)
+    return rowm, colm
+
+
+def _scan_fixed(body, carry, k):
+    from jax import lax
+
+    (carry, _) = lax.scan(body, carry, None, length=k)
+    return carry

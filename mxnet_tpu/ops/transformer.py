@@ -1,0 +1,163 @@
+"""Transformer helper ops: interleaved-projection attention matmuls and
+Longformer sliding-window attention.
+
+Reference: src/operator/contrib/transformer.cc (interleaved_matmul_* at
+650-835, div_sqrt_dim at 836, sldwin_atten_* at 849+). The interleaved
+layout — one (S, B, H*D*3) tensor carrying Q/K/V projections — lets the
+in-projection run as a single matmul; these ops unpack it straight into
+batched attention matmuls without materializing separate Q/K/V, which on
+TPU keeps everything as two MXU batch-matmuls per attention layer.
+
+Sliding-window (Longformer) attention computes only the (2w+1)-banded
+scores — O(S·w) instead of O(S²) — with per-head dilation; the TPU
+implementation gathers the banded keys once and runs dense einsums over
+the band dimension (static shapes, jit-friendly).
+
+All functions take/return raw jax arrays; npx wrappers lift to NDArray.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["div_sqrt_dim", "interleaved_matmul_selfatt_qk",
+           "interleaved_matmul_selfatt_valatt",
+           "interleaved_matmul_encdec_qk",
+           "interleaved_matmul_encdec_valatt",
+           "sldwin_atten_score", "sldwin_atten_mask_like",
+           "sldwin_atten_context"]
+
+
+def div_sqrt_dim(x):
+    """x / sqrt(last dim) (ref transformer.cc:836 _contrib_div_sqrt_dim)."""
+    return x / jnp.sqrt(jnp.asarray(x.shape[-1], x.dtype))
+
+
+def _split_selfatt(qkv, heads: int):
+    """(S, B, H*D*3) -> three (B*H, S, D) projections."""
+    s, b, hd3 = qkv.shape
+    d = hd3 // (heads * 3)
+    tmp = qkv.reshape(s, b, heads, 3, d)
+    def proj(i):
+        p = jnp.transpose(tmp[:, :, :, i, :], (1, 2, 0, 3))  # (B, H, S, D)
+        return p.reshape(b * heads, s, d)
+    return proj(0), proj(1), proj(2)
+
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads: int):
+    """(S, B, H*D*3) -> scaled QK^T scores (B*H, S, S)
+    (ref transformer.cc:650)."""
+    q, k, _ = _split_selfatt(queries_keys_values, heads)
+    q = div_sqrt_dim(q)
+    return jnp.matmul(q, jnp.swapaxes(k, -1, -2))
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads: int):
+    """attention (B*H, S, S) x V -> (S, B, H*D) (ref transformer.cc:694)."""
+    s, b, hd3 = queries_keys_values.shape
+    d = hd3 // (heads * 3)
+    _, _, v = _split_selfatt(queries_keys_values, heads)
+    out = jnp.matmul(attention, v)               # (B*H, S, D)
+    out = out.reshape(b, heads, s, d)
+    out = jnp.transpose(out, (2, 0, 1, 3))       # (S, B, H, D)
+    return out.reshape(s, b, heads * d)
+
+
+def interleaved_matmul_encdec_qk(queries, keys_values, heads: int):
+    """queries (Sq, B, H*D), keys_values (Sk, B, H*D*2) -> (B*H, Sq, Sk)
+    (ref transformer.cc:741)."""
+    sq, b, hd = queries.shape
+    d = hd // heads
+    sk = keys_values.shape[0]
+    q = jnp.transpose(queries.reshape(sq, b, heads, d), (1, 2, 0, 3))
+    q = div_sqrt_dim(q.reshape(b * heads, sq, d))
+    kv = keys_values.reshape(sk, b, heads, 2, d)
+    k = jnp.transpose(kv[:, :, :, 0, :], (1, 2, 0, 3)).reshape(
+        b * heads, sk, d)
+    return jnp.matmul(q, jnp.swapaxes(k, -1, -2))
+
+
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads: int):
+    """keys_values (Sk, B, H*D*2), attention (B*H, Sq, Sk) -> (Sq, B, H*D)
+    (ref transformer.cc:787)."""
+    sk, b, hd2 = keys_values.shape
+    d = hd2 // (heads * 2)
+    sq = attention.shape[1]
+    kv = keys_values.reshape(sk, b, heads, 2, d)
+    v = jnp.transpose(kv[:, :, :, 1, :], (1, 2, 0, 3)).reshape(
+        b * heads, sk, d)
+    out = jnp.matmul(attention, v)               # (B*H, Sq, D)
+    out = out.reshape(b, heads, sq, d)
+    out = jnp.transpose(out, (2, 0, 1, 3))
+    return out.reshape(sq, b, heads * d)
+
+
+# ---------------------------------------------------------------------------
+# Longformer sliding-window attention (ref transformer.cc sldwin_atten_*)
+# ---------------------------------------------------------------------------
+
+def _band_offsets(w: int, symmetric: bool):
+    """Relative key offsets per band slot: [-w..w] or [-w..0]."""
+    if symmetric:
+        return onp.arange(-w, w + 1)
+    return onp.arange(-w, 1)
+
+
+def _band_positions(seq_len: int, dilation, w: int, symmetric: bool):
+    """(H, S, K) absolute key positions + validity mask for each band slot."""
+    offs = jnp.asarray(_band_offsets(w, symmetric))          # (K,)
+    dil = jnp.asarray(dilation).astype(jnp.int32)            # (H,)
+    pos = (jnp.arange(seq_len)[None, :, None]
+           + dil[:, None, None] * offs[None, None, :])       # (H, S, K)
+    inside = (pos >= 0) & (pos < seq_len)
+    return jnp.clip(pos, 0, seq_len - 1), inside
+
+
+def sldwin_atten_score(query, key, dilation, w: int, symmetric: bool = True):
+    """Banded QK^T scores (ref _contrib_sldwin_atten_score).
+
+    query/key: (B, S, H, D); dilation: (H,). Returns (B, S, H, K) with
+    K = 2w+1 (symmetric) or w+1; out-of-range slots are 0."""
+    b, s, h, d = query.shape
+    pos, inside = _band_positions(s, dilation, w, symmetric)  # (H, S, K)
+    # gather banded keys: kb[b, s, h, k, d] = key[b, pos[h, s, k], h, d]
+    kh = jnp.transpose(key, (0, 2, 1, 3))                     # (B, H, S, D)
+    kb = kh[:, jnp.arange(h)[:, None, None], pos, :]          # (B, H, S, K, D)
+    qh = jnp.transpose(query, (0, 2, 1, 3))                   # (B, H, S, D)
+    score = jnp.einsum("bhsd,bhskd->bhsk", qh, kb)
+    score = score * inside[None]
+    return jnp.transpose(score, (0, 2, 1, 3))                 # (B, S, H, K)
+
+
+def sldwin_atten_mask_like(score, dilation, valid_length, w: int,
+                           symmetric: bool = True):
+    """1/0 mask marking in-window, in-valid-length slots
+    (ref _contrib_sldwin_atten_mask_like)."""
+    b, s, h, k = score.shape
+    pos, inside = _band_positions(s, dilation, w, symmetric)  # (H, S, K)
+    vl = jnp.asarray(valid_length).astype(jnp.int32)          # (B,)
+    valid_key = pos[None] < vl[:, None, None, None]           # (B, H, S, K)
+    valid_query = (jnp.arange(s)[None, None, :, None]
+                   < vl[:, None, None, None])
+    mask = inside[None] & valid_key & valid_query
+    return jnp.transpose(mask, (0, 2, 1, 3)).astype(score.dtype)
+
+
+def sldwin_atten_context(score, value, dilation, w: int,
+                         symmetric: bool = True):
+    """Banded attention-weighted value sum
+    (ref _contrib_sldwin_atten_context). score: (B, S, H, K),
+    value: (B, S, H, D) -> (B, S, H, D)."""
+    b, s, h, k = score.shape
+    exp_k = (2 * w + 1) if symmetric else (w + 1)
+    if k != exp_k:
+        raise MXNetError(f"score band dim {k} != expected {exp_k}")
+    pos, inside = _band_positions(s, dilation, w, symmetric)
+    vh = jnp.transpose(value, (0, 2, 1, 3))                   # (B, H, S, D)
+    vb = vh[:, jnp.arange(h)[:, None, None], pos, :]          # (B, H, S, K, D)
+    sc = jnp.transpose(score, (0, 2, 1, 3)) * inside[None]    # (B, H, S, K)
+    out = jnp.einsum("bhsk,bhskd->bhsd", sc, vb)
+    return jnp.transpose(out, (0, 2, 1, 3))

@@ -1,0 +1,246 @@
+"""Contrib op tail: transformer interleaved matmuls, sliding-window
+attention, box encode/decode, bipartite matching, misc.
+
+References: src/operator/contrib/transformer.cc (650-960),
+bounding_box-inl.h:847/992, bounding_box.cc bipartite_matching,
+index_copy.cc, index_array.cc, quadratic_op.cc, nn/im2col.cc.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _softmax(x, axis=-1):
+    e = onp.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# interleaved attention matmuls: must reproduce standard MHA exactly
+# ---------------------------------------------------------------------------
+
+def test_interleaved_selfatt_matches_reference_mha():
+    rng = onp.random.RandomState(0)
+    S, B, H, D = 5, 2, 3, 4
+    qkv = rng.randn(S, B, H * D * 3).astype("f4")
+    scores = mx.npx.interleaved_matmul_selfatt_qk(mx.nd.array(qkv), heads=H)
+    assert scores.shape == (B * H, S, S)
+
+    # independent reference: unpack per the documented layout
+    tmp = qkv.reshape(S, B, H, 3, D)
+    q = tmp[:, :, :, 0, :].transpose(1, 2, 0, 3).reshape(B * H, S, D)
+    k = tmp[:, :, :, 1, :].transpose(1, 2, 0, 3).reshape(B * H, S, D)
+    v = tmp[:, :, :, 2, :].transpose(1, 2, 0, 3).reshape(B * H, S, D)
+    want = (q / onp.sqrt(D)) @ k.transpose(0, 2, 1)
+    assert onp.allclose(scores.asnumpy(), want, atol=1e-5)
+
+    att = _softmax(want).astype("f4")
+    out = mx.npx.interleaved_matmul_selfatt_valatt(
+        mx.nd.array(qkv), mx.nd.array(att), heads=H)
+    assert out.shape == (S, B, H * D)
+    ref = (att @ v).reshape(B, H, S, D).transpose(2, 0, 1, 3) \
+        .reshape(S, B, H * D)
+    assert onp.allclose(out.asnumpy(), ref, atol=1e-5)
+
+
+def test_interleaved_encdec_matches_reference():
+    rng = onp.random.RandomState(1)
+    Sq, Sk, B, H, D = 4, 6, 2, 2, 3
+    q = rng.randn(Sq, B, H * D).astype("f4")
+    kv = rng.randn(Sk, B, H * D * 2).astype("f4")
+    scores = mx.npx.interleaved_matmul_encdec_qk(
+        mx.nd.array(q), mx.nd.array(kv), heads=H)
+    assert scores.shape == (B * H, Sq, Sk)
+    qp = q.reshape(Sq, B, H, D).transpose(1, 2, 0, 3).reshape(B * H, Sq, D)
+    tmp = kv.reshape(Sk, B, H, 2, D)
+    kp = tmp[:, :, :, 0, :].transpose(1, 2, 0, 3).reshape(B * H, Sk, D)
+    vp = tmp[:, :, :, 1, :].transpose(1, 2, 0, 3).reshape(B * H, Sk, D)
+    want = (qp / onp.sqrt(D)) @ kp.transpose(0, 2, 1)
+    assert onp.allclose(scores.asnumpy(), want, atol=1e-5)
+    att = _softmax(want).astype("f4")
+    out = mx.npx.interleaved_matmul_encdec_valatt(
+        mx.nd.array(kv), mx.nd.array(att), heads=H)
+    ref = (att @ vp).reshape(B, H, Sq, D).transpose(2, 0, 1, 3) \
+        .reshape(Sq, B, H * D)
+    assert onp.allclose(out.asnumpy(), ref, atol=1e-5)
+
+
+def test_div_sqrt_dim():
+    x = onp.ones((2, 9), "f4")
+    out = mx.npx.div_sqrt_dim(mx.nd.array(x))
+    assert onp.allclose(out.asnumpy(), 1.0 / 3.0)
+
+
+# ---------------------------------------------------------------------------
+# sliding-window attention vs dense banded attention
+# ---------------------------------------------------------------------------
+
+def _dense_band_reference(q, k, v, w, dilation, symmetric):
+    """O(S^2) dense attention with a banded mask, as ground truth."""
+    B, S, H, D = q.shape
+    scores = onp.zeros((B, S, H, S), "f4")
+    for h in range(H):
+        qk = onp.einsum("bsd,btd->bst", q[:, :, h], k[:, :, h])
+        scores[:, :, h, :] = qk
+    mask = onp.zeros((H, S, S), bool)
+    offs = range(-w, w + 1) if symmetric else range(-w, 1)
+    for h in range(H):
+        for i in range(S):
+            for o in offs:
+                j = i + o * int(dilation[h])
+                if 0 <= j < S:
+                    mask[h, i, j] = True
+    out = onp.zeros_like(q)
+    banded = scores * mask.transpose(1, 0, 2)[None]
+    for h in range(H):
+        out[:, :, h] = onp.einsum("bst,btd->bsd", banded[:, :, h],
+                                  v[:, :, h])
+    return banded, out
+
+
+@pytest.mark.parametrize("symmetric", [True, False])
+def test_sldwin_atten_ops(symmetric):
+    rng = onp.random.RandomState(2)
+    B, S, H, D, w = 2, 7, 2, 3, 2
+    q = rng.randn(B, S, H, D).astype("f4")
+    k = rng.randn(B, S, H, D).astype("f4")
+    v = rng.randn(B, S, H, D).astype("f4")
+    dil = onp.array([1, 2], "i4")
+
+    score = mx.npx.sldwin_atten_score(mx.nd.array(q), mx.nd.array(k),
+                                      mx.nd.array(dil), w=w,
+                                      symmetric=symmetric)
+    K = 2 * w + 1 if symmetric else w + 1
+    assert score.shape == (B, S, H, K)
+    banded_ref, ctx_ref = _dense_band_reference(q, k, v, w, dil, symmetric)
+    # compare band slots against the dense banded matrix
+    offs = list(range(-w, w + 1)) if symmetric else list(range(-w, 1))
+    sc = score.asnumpy()
+    for h in range(H):
+        for i in range(S):
+            for sidx, o in enumerate(offs):
+                j = i + o * int(dil[h])
+                want = banded_ref[:, i, h, j] if 0 <= j < S else 0.0
+                assert onp.allclose(sc[:, i, h, sidx], want, atol=1e-5), \
+                    (h, i, o)
+
+    ctx = mx.npx.sldwin_atten_context(score, mx.nd.array(v),
+                                      mx.nd.array(dil), w=w,
+                                      symmetric=symmetric)
+    assert onp.allclose(ctx.asnumpy(), ctx_ref, atol=1e-4)
+
+    vl = onp.array([S, S - 2], "i4")
+    mask = mx.npx.sldwin_atten_mask_like(score, mx.nd.array(dil),
+                                         mx.nd.array(vl), w=w,
+                                         symmetric=symmetric)
+    mk = mask.asnumpy()
+    assert mk.shape == sc.shape
+    # batch 1: positions >= S-2 masked out everywhere
+    for h in range(H):
+        for i in range(S):
+            for sidx, o in enumerate(offs):
+                j = i + o * int(dil[h])
+                expect = (0 <= j < S) and j < vl[1] and i < vl[1]
+                assert bool(mk[1, i, h, sidx]) == expect, (h, i, o)
+
+
+# ---------------------------------------------------------------------------
+# boxes
+# ---------------------------------------------------------------------------
+
+def test_box_encode_decode_roundtrip():
+    rng = onp.random.RandomState(3)
+    B, N, M = 2, 5, 3
+    anchors = onp.sort(rng.rand(B, N, 2, 2), axis=2).reshape(B, N, 4) \
+        .astype("f4")
+    refs = onp.sort(rng.rand(B, M, 2, 2), axis=2).reshape(B, M, 4) \
+        .astype("f4")
+    matches = rng.randint(0, M, (B, N)).astype("f4")
+    samples = onp.ones((B, N), "f4")
+    t, m = mx.npx.box_encode(mx.nd.array(samples), mx.nd.array(matches),
+                             mx.nd.array(anchors), mx.nd.array(refs))
+    assert m.asnumpy().min() == 1.0
+    # decode the targets back: must reproduce the matched refs
+    dec = mx.npx.box_decode(t, mx.nd.array(anchors))
+    want = onp.take_along_axis(refs, matches.astype(int)[..., None]
+                               .repeat(4, -1), axis=1)
+    assert onp.allclose(dec.asnumpy(), want, atol=1e-4)
+    # negative samples are masked out
+    samples0 = onp.zeros((B, N), "f4")
+    t0, m0 = mx.npx.box_encode(mx.nd.array(samples0), mx.nd.array(matches),
+                               mx.nd.array(anchors), mx.nd.array(refs))
+    assert onp.allclose(t0.asnumpy(), 0) and onp.allclose(m0.asnumpy(), 0)
+
+
+def test_bipartite_matching():
+    score = onp.array([[[0.9, 0.1], [0.8, 0.7], [0.2, 0.6]]], "f4")
+    row, col = mx.npx.bipartite_matching(mx.nd.array(score), topk=2)
+    # greedy: (0,0) at 0.9 first, then (1,1) at 0.7
+    assert row.asnumpy()[0].tolist() == [0.0, 1.0, -1.0]
+    assert col.asnumpy()[0].tolist() == [0.0, 1.0]
+
+
+# ---------------------------------------------------------------------------
+# misc contrib
+# ---------------------------------------------------------------------------
+
+def test_quadratic():
+    x = mx.nd.array(onp.array([[1., 2.], [3., 4.]], "f4"))
+    out = mx.npx.quadratic(x, a=1.0, b=2.0, c=3.0)
+    assert onp.allclose(out.asnumpy(), [[6., 11.], [18., 27.]])
+
+
+def test_index_copy():
+    old = mx.nd.array(onp.zeros((4, 3), "f4"))
+    new = mx.nd.array(onp.ones((2, 3), "f4") * 7)
+    idx = mx.nd.array(onp.array([3, 1], "i4"))
+    out = mx.npx.index_copy(old, idx, new)
+    got = out.asnumpy()
+    assert onp.allclose(got[3], 7) and onp.allclose(got[1], 7)
+    assert onp.allclose(got[0], 0) and onp.allclose(got[2], 0)
+
+
+def test_index_array():
+    x = mx.nd.zeros((2, 3))
+    idx = mx.npx.index_array(x)
+    assert idx.shape == (2, 3, 2)
+    assert idx.asnumpy()[1, 2].tolist() == [1, 2]
+    idx0 = mx.npx.index_array(x, axes=(1,))
+    assert idx0.shape == (2, 3, 1)
+    assert idx0.asnumpy()[1, 2, 0] == 2
+
+
+def test_getnnz_and_edge_id():
+    import mxnet_tpu.ndarray.sparse as sp
+
+    dense = mx.nd.array(onp.array([[0., 2., 0.], [3., 0., 4.]], "f4"))
+    csr = sp.csr_matrix(dense)
+    assert mx.npx.getnnz(csr) == 3
+    per_col = mx.npx.getnnz(dense, axis=0)
+    assert per_col.asnumpy().tolist() == [1, 1, 1]
+    eid = mx.npx.edge_id(csr, mx.nd.array(onp.array([0, 1, 0], "i4")),
+                         mx.nd.array(onp.array([1, 0, 0], "i4")))
+    assert eid.asnumpy().tolist() == [2.0, 3.0, -1.0]
+
+
+def test_batch_norm_with_relu():
+    x = mx.nd.array(onp.array([[-1.0, 2.0]], "f4").repeat(4, 0))
+    gamma = mx.nd.ones((2,))
+    beta = mx.nd.zeros((2,))
+    rm, rv = mx.nd.zeros((2,)), mx.nd.ones((2,))
+    out = mx.npx.batch_norm_with_relu(x, gamma, beta, rm, rv, axis=-1)
+    assert out.asnumpy().min() >= 0.0
+
+
+def test_col2im_inverts_im2col_counts():
+    rng = onp.random.RandomState(5)
+    x = rng.rand(1, 2, 4, 4).astype("f4")
+    cols = mx.nd.im2col(mx.nd.array(x), kernel=(2, 2))
+    back = mx.npx.col2im(cols, output_size=(4, 4), kernel=(2, 2))
+    # each pixel is summed once per window covering it
+    counts = onp.zeros((4, 4), "f4")
+    for i in range(3):
+        for j in range(3):
+            counts[i:i + 2, j:j + 2] += 1
+    assert onp.allclose(back.asnumpy(), x * counts[None, None], atol=1e-5)

@@ -158,6 +158,38 @@ def test_sgd_updates():
     assert onp.allclose(mom.asnumpy(), 0.9 * -0.05 - 0.05, atol=1e-6)
 
 
+def test_ftrl_lamb_group_adagrad():
+    w = mx.nd.array(onp.ones(4, "f4"))
+    g = mx.nd.array(onp.full(4, 0.5, "f4"))
+    z, n = mx.nd.zeros((4,)), mx.nd.zeros((4,))
+    w2 = mx.nd.ftrl_update(w, g, z, n, lr=0.1)
+    # z = 0.5 - (sqrt(0.25)-0)*1/0.1 = -4.5 ; n = 0.25
+    assert onp.allclose(z.asnumpy(), -4.5)
+    assert onp.allclose(n.asnumpy(), 0.25)
+    expect = (4.5 - 0.01) / ((1.0 + 0.5) / 0.1)
+    assert onp.allclose(w2.asnumpy(), expect, atol=1e-5)
+
+    m, v = mx.nd.zeros((4,)), mx.nd.zeros((4,))
+    upd = mx.nd.lamb_update_phase1(w, g, m, v, t=1)
+    assert onp.allclose(upd.asnumpy(), 1.0, atol=1e-3)  # mh/sqrt(vh) = 1
+    r1 = mx.nd.array(onp.array([2.0], "f4"))
+    r2 = mx.nd.array(onp.array([4.0], "f4"))
+    w3 = mx.nd.lamb_update_phase2(w, upd, r1, r2, lr=0.1)
+    assert onp.allclose(w3.asnumpy(), 1 - 0.1 * 0.5, atol=1e-3)
+    # zero norms -> trust ratio 1
+    zero = mx.nd.array(onp.array([0.0], "f4"))
+    w4 = mx.nd.lamb_update_phase2(w, upd, zero, r2, lr=0.1)
+    assert onp.allclose(w4.asnumpy(), 1 - 0.1, atol=1e-3)
+
+    wm = mx.nd.array(onp.ones((3, 4), "f4"))
+    gm = mx.nd.array(onp.full((3, 4), 0.2, "f4"))
+    h = mx.nd.zeros((3, 1))
+    w5 = mx.nd.group_adagrad_update(wm, gm, h, lr=0.1)
+    assert onp.allclose(h.asnumpy(), 0.04, atol=1e-6)
+    assert onp.allclose(w5.asnumpy(), 1 - 0.1 * 0.2 / (0.2 + 1e-5),
+                        atol=1e-4)
+
+
 def test_adam_rmsprop_signsgd_nag():
     w = mx.nd.array(onp.ones(4, "f4"))
     g = mx.nd.array(onp.full(4, 0.5, "f4"))

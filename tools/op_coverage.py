@@ -54,7 +54,7 @@ def reference_ops(root: str):
             continue
         if any(s in n for s in _SKIP_SUBSTR):
             continue
-        if "##" in n or n.endswith("$"):  # macro-expanded registration
+        if "##" in n or "$" in n or n == "name":  # macro params/tokens
             continue
         public.add(n)
     return public
