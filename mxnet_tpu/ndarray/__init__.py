@@ -430,3 +430,335 @@ def group_adagrad_update(weight, grad, history, lr, rescale_grad=1.0,
         out._set_data(new_w._data)
         return out
     return new_w
+
+
+def ftml_update(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0,
+                out=None):
+    """Ref optimizer_op-inl.h:1159 FTMLKernel; d/v/z states mutate."""
+    b1t, b2t = beta1 ** t, beta2 ** t
+
+    def f(w, g, dd, vv, zz):
+        g = g * rescale_grad
+        if clip_grad > 0:
+            g = jnp.clip(g, -clip_grad, clip_grad)
+        g = g + wd * w
+        v2 = beta2 * vv + (1 - beta2) * g * g
+        d_t = (1 - b1t) / lr * (jnp.sqrt(v2 / (1 - b2t)) + epsilon)
+        z2 = beta1 * zz + (1 - beta1) * g - (d_t - beta1 * dd) * w
+        return -z2 / d_t, d_t, v2, z2
+    new_w, new_d, new_v, new_z = call(f, (weight, grad, d, v, z), {},
+                                      name="ftml_update")
+    d._set_data(new_d._data)
+    v._set_data(new_v._data)
+    z._set_data(new_z._data)
+    if out is not None:
+        out._set_data(new_w._data)
+        return out
+    return new_w
+
+
+def signum_update(weight, grad, mom, lr, momentum=0.9, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0,
+                  out=None):
+    """Ref optimizer_op-inl.h:2363 SignumKernel (sign of the momentum)."""
+    def f(w, g, m):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * w
+        m2 = momentum * m - (1 - momentum) * g
+        return w * (1 - lr * wd_lh) + lr * jnp.sign(m2), m2
+    new_w, new_m = call(f, (weight, grad, mom), {}, name="signum_update")
+    mom._set_data(new_m._data)
+    if out is not None:
+        out._set_data(new_w._data)
+        return out
+    return new_w
+
+
+def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, out=None):
+    """Ref optimizer_op.cc rmspropalex_update (Graves' RMSProp with
+    centered second moment + momentum)."""
+    def f(w, gr, nn, gg, dd):
+        gr = gr * rescale_grad
+        if clip_gradient > 0:
+            gr = jnp.clip(gr, -clip_gradient, clip_gradient)
+        gr = gr + wd * w
+        n2 = gamma1 * nn + (1 - gamma1) * gr * gr
+        g2 = gamma1 * gg + (1 - gamma1) * gr
+        d2 = gamma2 * dd - lr * gr / jnp.sqrt(n2 - g2 * g2 + epsilon)
+        return w + d2, n2, g2, d2
+    new_w, new_n, new_g, new_d = call(f, (weight, grad, n, g, delta), {},
+                                      name="rmspropalex_update")
+    n._set_data(new_n._data)
+    g._set_data(new_g._data)
+    delta._set_data(new_d._data)
+    if out is not None:
+        out._set_data(new_w._data)
+        return out
+    return new_w
+
+
+def adamw_update(weight, grad, mean, var, lr, eta=1.0, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0, out=None):
+    """Ref contrib/adamw-inl.h:117: decoupled weight decay,
+    w -= eta * (lr * m/(sqrt(v)+eps) + wd * w) — lr scales only the
+    adaptive term, NOT the decay."""
+    def f(w, g, m, v):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        m2 = beta1 * m + (1 - beta1) * g
+        v2 = beta2 * v + (1 - beta2) * g * g
+        return w - eta * (lr * m2 / (jnp.sqrt(v2) + epsilon) + wd * w), \
+            m2, v2
+    new_w, new_m, new_v = call(f, (weight, grad, mean, var), {},
+                               name="adamw_update")
+    mean._set_data(new_m._data)
+    var._set_data(new_v._data)
+    if out is not None:
+        out._set_data(new_w._data)
+        return out
+    return new_w
+
+
+def _multi_apply(update_fn, weights, grads, states_list, **kw):
+    """Aggregated multi-tensor update (ref multi_sgd_* family,
+    optimizer_op.cc:313-398): one Python loop, each update a fused jit op.
+    states_list: per-weight tuple of state NDArrays."""
+    outs = []
+    for i, (w, g) in enumerate(zip(weights, grads)):
+        st = states_list[i] if states_list else ()
+        outs.append(update_fn(w, g, *st, **kw))
+    return outs
+
+
+def multi_sgd_update(weights, grads, lr, wd=0.0, rescale_grad=1.0,
+                     clip_gradient=-1.0):
+    """Ref optimizer_op.cc multi_sgd_update."""
+    return _multi_apply(sgd_update, weights, grads, None, lr=lr, wd=wd,
+                        rescale_grad=rescale_grad,
+                        clip_gradient=clip_gradient)
+
+
+def multi_sgd_mom_update(weights, grads, moms, lr, momentum=0.9, wd=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    """Ref optimizer_op.cc multi_sgd_mom_update."""
+    return _multi_apply(sgd_mom_update, weights, grads,
+                        [(m,) for m in moms], lr=lr, momentum=momentum,
+                        wd=wd, rescale_grad=rescale_grad,
+                        clip_gradient=clip_gradient)
+
+
+# mixed-precision (mp_*) variants keep an fp32 master copy alongside fp16
+# weights (ref optimizer_op.cc mp_sgd_update etc.)
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, out=None):
+    new32 = sgd_update(weight32, grad, lr=lr, wd=wd,
+                       rescale_grad=rescale_grad,
+                       clip_gradient=clip_gradient)
+    weight32._set_data(new32._data)
+    low = cast(new32, weight.dtype)
+    if out is not None:
+        out._set_data(low._data)
+        return out
+    return low
+
+
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.9,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      out=None):
+    new32 = sgd_mom_update(weight32, grad, mom, lr=lr, momentum=momentum,
+                           wd=wd, rescale_grad=rescale_grad,
+                           clip_gradient=clip_gradient)
+    weight32._set_data(new32._data)
+    low = cast(new32, weight.dtype)
+    if out is not None:
+        out._set_data(low._data)
+        return out
+    return low
+
+
+def reset_arrays(arrays, **kw):
+    """Zero a list of arrays in place (ref contrib reset_arrays.cc)."""
+    for a in arrays:
+        a._set_data(jnp.zeros_like(a._data))
+
+
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0):
+    """Ref contrib multi_lars.cc: layer-wise LR scaling from precomputed
+    ||w||^2 and ||g||^2 vectors."""
+    def f(lr, wsq, gsq, wd):
+        wn = jnp.sqrt(wsq)
+        gn = jnp.sqrt(gsq) * rescale_grad
+        ratio = eta * wn / (gn + wd * wn + eps)
+        return lr * jnp.where(wn > 0, jnp.where(gn > 0, ratio, 1.0), 1.0)
+    return call(f, (lrs, weights_sum_sq, grads_sum_sq, wds), {},
+                name="multi_lars")
+
+
+def amp_cast(data, dtype):
+    """Ref amp_cast.cc: dtype cast inserted by AMP graph rewrites."""
+    return cast(data, dtype)
+
+
+def amp_multicast(*data, num_outputs=None, cast_narrow=False):
+    """Ref amp_cast.cc amp_multicast: cast all inputs to their widest
+    (or narrowest) common dtype."""
+    import builtins as _bi
+
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    dts = [jnp.dtype(d.dtype) for d in data]
+    pick = _bi.min if cast_narrow else _bi.max  # module max/min are ops
+    target = pick(dts, key=lambda d: d.itemsize)
+    return [cast(d, target) for d in data]
+
+
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.9,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      out=None):
+    """Mixed-precision NAG (ref optimizer_op.cc mp_nag_mom_update)."""
+    new32 = nag_mom_update(weight32, grad, mom, lr=lr, momentum=momentum,
+                           wd=wd, rescale_grad=rescale_grad,
+                           clip_gradient=clip_gradient)
+    weight32._set_data(new32._data)
+    low = cast(new32, weight.dtype)
+    if out is not None:
+        out._set_data(low._data)
+        return out
+    return low
+
+
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, t, **kw):
+    """Mixed-precision LAMB phase 1 (ref contrib/adamw.cc): the update
+    direction is computed against the fp32 master weights."""
+    return lamb_update_phase1(weight32, grad, mean, var, t, **kw)
+
+
+def mp_lamb_update_phase2(weight, g, r1, r2, weight32, lr, **kw):
+    """Mixed-precision LAMB phase 2: apply to the master, emit low."""
+    new32 = lamb_update_phase2(weight32, g, r1, r2, lr, **kw)
+    weight32._set_data(new32._data)
+    return cast(new32, weight.dtype)
+
+
+def multi_mp_sgd_update(weights, grads, weights32, lr, **kw):
+    """Ref optimizer_op.cc multi_mp_sgd_update."""
+    return [mp_sgd_update(w, g, w32, lr=lr, **kw)
+            for w, g, w32 in zip(weights, grads, weights32)]
+
+
+def multi_mp_sgd_mom_update(weights, grads, moms, weights32, lr, **kw):
+    """Ref optimizer_op.cc multi_mp_sgd_mom_update."""
+    return [mp_sgd_mom_update(w, g, m, w32, lr=lr, **kw)
+            for w, g, m, w32 in zip(weights, grads, moms, weights32)]
+
+
+def multi_adamw_update(weights, grads, means, vars_, lr, **kw):
+    """Ref contrib/adamw.cc _multi_adamw_update."""
+    return [adamw_update(w, g, m, v, lr=lr, **kw)
+            for w, g, m, v in zip(weights, grads, means, vars_)]
+
+
+def multi_lamb_update(weights, grads, means, vars_, lr, t=1, **kw):
+    """Ref contrib/multi_lamb.cc: full LAMB (phase1 + trust-ratio apply)
+    over a weight list."""
+    outs = []
+    for w, g, m, v in zip(weights, grads, means, vars_):
+        upd = lamb_update_phase1(w, g, m, v, t, **kw)
+        r1 = norm(w).reshape((1,))
+        r2 = norm(upd).reshape((1,))
+        outs.append(lamb_update_phase2(w, upd, r1, r2, lr))
+    return outs
+
+
+# preloaded_* variants take lrs/wds as device arrays (ref optimizer_op.cc
+# preloaded_multi_sgd_*); same math, per-tensor scalar reads
+def preloaded_multi_sgd_update(weights, grads, lrs, wds, **kw):
+    lv, wv = lrs.asnumpy(), wds.asnumpy()  # one D2H pair, not per-tensor
+    return [sgd_update(w, g, lr=float(lv[i]), wd=float(wv[i]), **kw)
+            for i, (w, g) in enumerate(zip(weights, grads))]
+
+
+def preloaded_multi_sgd_mom_update(weights, grads, moms, lrs, wds, **kw):
+    lv, wv = lrs.asnumpy(), wds.asnumpy()
+    return [sgd_mom_update(w, g, m, lr=float(lv[i]), wd=float(wv[i]), **kw)
+            for i, (w, g, m) in enumerate(zip(weights, grads, moms))]
+
+
+def preloaded_multi_mp_sgd_update(weights, grads, weights32, lrs, wds,
+                                  **kw):
+    lv, wv = lrs.asnumpy(), wds.asnumpy()
+    return [mp_sgd_update(w, g, w32, lr=float(lv[i]), wd=float(wv[i]),
+                          **kw)
+            for i, (w, g, w32) in enumerate(zip(weights, grads, weights32))]
+
+
+def preloaded_multi_mp_sgd_mom_update(weights, grads, moms, weights32,
+                                      lrs, wds, **kw):
+    lv, wv = lrs.asnumpy(), wds.asnumpy()
+    return [mp_sgd_mom_update(w, g, m, w32, lr=float(lv[i]),
+                              wd=float(wv[i]), **kw)
+            for i, (w, g, m, w32) in enumerate(
+                zip(weights, grads, moms, weights32))]
+
+
+def multi_mp_adamw_update(weights, grads, means, vars_, weights32, lr,
+                          **kw):
+    """Ref contrib/adamw.cc _multi_mp_adamw_update."""
+    outs = []
+    for w, g, m, v, w32 in zip(weights, grads, means, vars_, weights32):
+        new32 = adamw_update(w32, g, m, v, lr=lr, **kw)
+        w32._set_data(new32._data)
+        outs.append(cast(new32, w.dtype))
+    return outs
+
+
+def multi_lans_update(weights, grads, means, vars_, lr, t=1, **kw):
+    """Ref contrib/multi_lans.cc: LAMB with the gradient pre-normalized
+    by its own L2 norm (LANS)."""
+    outs = []
+    for w, g, m, v in zip(weights, grads, means, vars_):
+        gn = norm(g).reshape((1,))
+        g_unit = divide(g, maximum(gn, full((1,), 1e-12)))
+        upd = lamb_update_phase1(w, g_unit, m, v, t, **kw)
+        r1 = norm(w).reshape((1,))
+        r2 = norm(upd).reshape((1,))
+        outs.append(lamb_update_phase2(w, upd, r1, r2, lr))
+    return outs
+
+
+def multi_mp_lamb_update(weights, grads, means, vars_, weights32, lr,
+                         t=1, **kw):
+    """Ref contrib/multi_lamb.cc mixed-precision variant."""
+    outs = []
+    for w, g, m, v, w32 in zip(weights, grads, means, vars_, weights32):
+        upd = lamb_update_phase1(w32, g, m, v, t, **kw)
+        r1 = norm(w32).reshape((1,))
+        r2 = norm(upd).reshape((1,))
+        new32 = lamb_update_phase2(w32, upd, r1, r2, lr)
+        w32._set_data(new32._data)
+        outs.append(cast(new32, w.dtype))
+    return outs
+
+
+def multi_mp_lans_update(weights, grads, means, vars_, weights32, lr,
+                         t=1, **kw):
+    """Ref contrib/multi_lans.cc mixed-precision variant."""
+    outs = []
+    for w, g, m, v, w32 in zip(weights, grads, means, vars_, weights32):
+        gn = norm(g).reshape((1,))
+        g_unit = divide(g, maximum(gn, full((1,), 1e-12)))
+        upd = lamb_update_phase1(w32, g_unit, m, v, t, **kw)
+        r1 = norm(w32).reshape((1,))
+        r2 = norm(upd).reshape((1,))
+        new32 = lamb_update_phase2(w32, upd, r1, r2, lr)
+        w32._set_data(new32._data)
+        outs.append(cast(new32, w.dtype))
+    return outs

@@ -206,16 +206,26 @@ class NDArray:
                         out._set_data(res._data.astype(out._data.dtype))
                         return out
             if out is not None:
-                if isinstance(out, NDArray):
-                    # host fallback with an NDArray out: compute on host,
-                    # then write back (passing a coerced copy to numpy
-                    # would silently drop the result)
-                    res = getattr(ufunc, method)(*_host(inputs),
-                                                 **_host(kwargs))
-                    out._set_data(jnp.asarray(res, out._data.dtype))
-                    return out
                 kwargs["out"] = out
-        return getattr(ufunc, method)(*_host(inputs), **_host(kwargs))
+        # host fallback for every remaining case (unmapped ufunc, reduce/
+        # accumulate/outer methods, multi-output): compute on host, then
+        # write back into any NDArray outs — a coerced out copy would
+        # silently drop the result
+        outs = kwargs.pop("out", None)
+        res = getattr(ufunc, method)(*_host(inputs), **_host(kwargs))
+        if outs is None:
+            return res
+        outs_t = outs if isinstance(outs, tuple) else (outs,)
+        res_t = res if isinstance(res, tuple) else (res,)
+        written = []
+        for o, r in zip(outs_t, res_t):
+            if isinstance(o, NDArray):
+                o._set_data(jnp.asarray(r, o._data.dtype))
+                written.append(o)
+            else:
+                _onp.copyto(o, r)
+                written.append(o)
+        return written[0] if len(written) == 1 else tuple(written)
 
     def __array_function__(self, func, types, args, kwargs):
         from .. import numpy as mnp

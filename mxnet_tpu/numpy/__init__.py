@@ -311,3 +311,71 @@ from . import random  # noqa: E402
 from . import fft  # noqa: E402
 
 __all__ = [n for n in _g if not n.startswith("_")]
+
+
+def tri(N, M=None, k=0, dtype=None):
+    """Lower-triangular ones matrix (ref _npi_tri)."""
+    import jax.numpy as _jnp
+
+    from ..ops.dispatch import call as _call
+
+    return _call(lambda: _jnp.tri(N, M, k,
+                                  dtype=_jnp.dtype(dtype)
+                                  if dtype else _jnp.float32),
+                 (), {}, name="tri")
+
+
+def fill_diagonal(a, val, wrap=False):
+    """In-place diagonal fill with numpy semantics (ref
+    _npi_fill_diagonal): 2-D fills the main diagonal (wrap=True restarts
+    the diagonal after each n-column block in tall matrices); ndim>2
+    requires all-equal dims and fills a[i, i, ..., i]. Mutates ``a`` via
+    the functional-update rebind (visible to jit tracing)."""
+    import builtins as _bi
+
+    import jax.numpy as _jnp
+
+    from ..base import MXNetError as _Err
+
+    if a.ndim == 2:
+        rows, cols = a.shape
+        if wrap and rows > cols:
+            # numpy wrap: diagonal restarts every cols+1 rows
+            r = _jnp.arange(rows)
+            keep = (r % (cols + 1)) != cols
+            rr = r[keep]
+            cc = rr % (cols + 1)
+            keep2 = cc < cols
+            new = a._data.at[rr[keep2], cc[keep2]].set(val)
+        else:
+            n = _bi.min(a.shape)
+            idx = _jnp.arange(n)
+            new = a._data.at[idx, idx].set(val)
+    elif a.ndim > 2:
+        if len(set(a.shape)) != 1:
+            raise _Err("fill_diagonal: all dimensions of a.ndim > 2 input "
+                       "must be equal (numpy semantics)")
+        idx = _jnp.arange(a.shape[0])
+        new = a._data.at[tuple([idx] * a.ndim)].set(val)
+    else:
+        new = a._data.at[_jnp.arange(a.shape[0])].set(val)
+    a._set_data(new)
+    return a
+
+
+def constraint_check(data, msg="Constraint violated"):
+    """All-true check returning 1.0, raising otherwise
+    (ref _npx_constraint_check; eager-mode validation op used by
+    gluon.probability)."""
+    import jax.numpy as _jnp
+
+    from ..base import MXNetError as _Err
+    from ..ops.dispatch import call as _call
+
+    ok = bool(_jnp.all(data._data))
+    if not ok:
+        raise _Err(msg)
+    return _call(lambda x: _jnp.ones((), _jnp.float32), (data,), {},
+                 name="constraint_check")
+
+__all__ = list(__all__) + ["tri", "fill_diagonal", "constraint_check"]

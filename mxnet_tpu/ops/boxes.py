@@ -185,8 +185,9 @@ def multibox_prior(feat_shape: Tuple[int, int],
 _VARIANCES = (0.1, 0.1, 0.2, 0.2)
 
 
-def box_encode(anchors, gt, variances=_VARIANCES):
-    """Corner gt vs corner anchors -> (dx, dy, dw, dh) regression targets."""
+def _offset_encode(anchors, gt, variances=_VARIANCES):
+    """Corner gt vs corner anchors -> (dx, dy, dw, dh) regression targets
+    (multibox-internal; the public reference-parity box_encode is below)."""
     aw = anchors[..., 2] - anchors[..., 0]
     ah = anchors[..., 3] - anchors[..., 1]
     ax = (anchors[..., 0] + anchors[..., 2]) / 2
@@ -201,7 +202,7 @@ def box_encode(anchors, gt, variances=_VARIANCES):
                       jnp.log(gh / ah) / variances[3]], -1)
 
 
-def box_decode(anchors, deltas, variances=_VARIANCES):
+def _offset_decode(anchors, deltas, variances=_VARIANCES):
     aw = anchors[..., 2] - anchors[..., 0]
     ah = anchors[..., 3] - anchors[..., 1]
     ax = (anchors[..., 0] + anchors[..., 2]) / 2
@@ -242,7 +243,7 @@ def multibox_target(anchors, labels, iou_thresh: float = 0.5,
         pos = jnp.logical_or(pos, forced_gt >= 0)
         tgt_boxes = gt_boxes[matched_gt]
         tgt_cls = lab[:, 0][matched_gt]
-        box_t = box_encode(anchors, tgt_boxes, variances)
+        box_t = _offset_encode(anchors, tgt_boxes, variances)
         box_t = jnp.where(pos[:, None], box_t, 0.0)
         mask = jnp.where(pos[:, None],
                          jnp.ones_like(box_t), jnp.zeros_like(box_t))
@@ -263,7 +264,7 @@ def multibox_detection(cls_prob, loc_pred, anchors,
     Returns (B, A, 6) rows [cls_id, score, x1, y1, x2, y2], invalid -1."""
     b, num_cls_p1, a = cls_prob.shape
     deltas = loc_pred.reshape(b, a, 4)
-    boxes = box_decode(anchors[None], deltas, variances)   # (B, A, 4)
+    boxes = _offset_decode(anchors[None], deltas, variances)  # (B, A, 4)
     scores = cls_prob[:, 1:, :]                            # (B, C, A)
     cls_id = jnp.argmax(scores, 1).astype(jnp.float32)     # (B, A)
     score = jnp.max(scores, 1)

@@ -76,14 +76,14 @@ def test_multibox_prior():
                                      cx + hw, cy + hh], atol=1e-6)
 
 
-def test_box_encode_decode_roundtrip():
+def test_offset_encode_decode_roundtrip():
     rs = onp.random.RandomState(0)
     anchors = jnp.asarray(rs.rand(10, 2), jnp.float32)
     anchors = jnp.concatenate([anchors, anchors + 0.3], -1)
     gt = jnp.asarray(rs.rand(10, 2), jnp.float32)
     gt = jnp.concatenate([gt, gt + 0.4], -1)
-    deltas = bx.box_encode(anchors, gt)
-    back = bx.box_decode(anchors, deltas)
+    deltas = bx._offset_encode(anchors, gt)
+    back = bx._offset_decode(anchors, deltas)
     assert onp.allclose(back, gt, atol=1e-5)
 
 

@@ -190,6 +190,104 @@ def test_ftrl_lamb_group_adagrad():
                         atol=1e-4)
 
 
+def test_ftml_signum_rmspropalex_adamw():
+    w = mx.nd.array(onp.ones(4, "f4"))
+    g = mx.nd.array(onp.full(4, 0.5, "f4"))
+    d, v, z = mx.nd.zeros((4,)), mx.nd.zeros((4,)), mx.nd.zeros((4,))
+    out = mx.nd.ftml_update(w, g, d, v, z, lr=0.1, t=1)
+    # v=0.00025/(1-b2)... manual: g=0.5, v2=(1-.999)*.25=2.5e-4,
+    # d_t=(1-.6)/.1*(sqrt(2.5e-4/(1-.999))+eps)=4*(0.5+e)=2.0
+    assert onp.allclose(d.asnumpy(), 2.0, atol=1e-3)
+    # z2 = 0.4*0.5 - 2.0*1 = -1.8 ; out = 1.8/2.0 = 0.9
+    assert onp.allclose(out.asnumpy(), 0.9, atol=1e-3)
+
+    mom = mx.nd.zeros((4,))
+    out = mx.nd.signum_update(w, g, mom, lr=0.1)
+    # m2 = -(1-.9)*0.5 = -0.05 -> w + 0.1*sign(-0.05) = 0.9
+    assert onp.allclose(out.asnumpy(), 0.9, atol=1e-6)
+
+    n, gs, delta = mx.nd.zeros((4,)), mx.nd.zeros((4,)), mx.nd.zeros((4,))
+    out = mx.nd.rmspropalex_update(w, g, n, gs, delta, lr=0.1)
+    # n=0.0125, g=0.025, delta=-0.1*0.5/sqrt(0.0125-0.000625+eps)
+    expect = 1 - 0.1 * 0.5 / onp.sqrt(0.0125 - 0.025 ** 2 + 1e-8)
+    assert onp.allclose(out.asnumpy(), expect, atol=1e-4)
+
+    m2, v2 = mx.nd.zeros((4,)), mx.nd.zeros((4,))
+    out = mx.nd.adamw_update(w, g, m2, v2, lr=0.01, wd=0.1)
+    # ref adamw-inl.h:117: w - eta*(lr*m/(sqrt(v)+eps) + wd*w) —
+    # lr scales only the adaptive term, NOT the decay
+    manual = 1 - (0.01 * 0.05 / (onp.sqrt(2.5e-4) + 1e-8) + 0.1)
+    assert onp.allclose(out.asnumpy(), manual, atol=1e-4)
+
+
+def test_mp_and_multi_variants():
+    import jax.numpy as jnp
+
+    w16 = mx.nd.array(onp.ones(4, "f4")).astype("float16")
+    w32 = mx.nd.array(onp.ones(4, "f4"))
+    g = mx.nd.array(onp.full(4, 0.5, "f4"))
+    out = mx.nd.mp_sgd_update(w16, g, w32, lr=0.1)
+    assert str(out.dtype) == "float16"
+    assert onp.allclose(w32.asnumpy(), 0.95)  # master updated in fp32
+
+    ws = [mx.nd.array(onp.ones(3, "f4")) for _ in range(2)]
+    gs = [mx.nd.array(onp.full(3, 0.5, "f4")) for _ in range(2)]
+    outs = mx.nd.multi_sgd_update(ws, gs, lr=0.1)
+    for o in outs:
+        assert onp.allclose(o.asnumpy(), 0.95)
+
+    lrs = mx.nd.array(onp.array([0.1, 0.2], "f4"))
+    wds = mx.nd.array(onp.array([0.0, 0.0], "f4"))
+    outs = mx.nd.preloaded_multi_sgd_update(ws, gs, lrs, wds)
+    assert onp.allclose(outs[0].asnumpy(), 0.95)
+    assert onp.allclose(outs[1].asnumpy(), 0.90)
+
+    means = [mx.nd.zeros((3,)) for _ in range(2)]
+    vars_ = [mx.nd.zeros((3,)) for _ in range(2)]
+    outs = mx.nd.multi_lans_update(ws, gs, means, vars_, lr=0.01)
+    assert all(o.asnumpy().max() < 1.0 for o in outs)
+
+    arrs = [mx.nd.array(onp.ones(3, "f4")) for _ in range(2)]
+    mx.nd.reset_arrays(arrs)
+    for a in arrs:
+        assert onp.allclose(a.asnumpy(), 0.0)
+
+
+def test_amp_cast_ops():
+    x = mx.nd.array(onp.ones((2, 2), "f4"))
+    assert str(mx.nd.amp_cast(x, "float16").dtype) == "float16"
+    y16 = x.astype("float16")
+    outs = mx.nd.amp_multicast(x, y16)
+    assert all(str(o.dtype) == "float32" for o in outs)
+    outs = mx.nd.amp_multicast(x, y16, cast_narrow=True)
+    assert all(str(o.dtype) == "float16" for o in outs)
+
+
+def test_np_tail_tri_fill_diagonal_constraint():
+    t = mx.np.tri(3, k=0)
+    assert onp.allclose(t.asnumpy(), onp.tri(3))
+    a = mx.np.array(onp.zeros((3, 3), "f4"))
+    mx.np.fill_diagonal(a, 7.0)
+    assert onp.allclose(onp.diag(a.asnumpy()), 7.0)
+    ok = mx.np.constraint_check(mx.np.array(onp.array([1, 1], "i4")))
+    assert float(ok.asnumpy()) == 1.0
+    import pytest as _pt
+
+    from mxnet_tpu.base import MXNetError as _E
+    with _pt.raises(_E, match="Constraint"):
+        mx.np.constraint_check(mx.np.array(onp.array([1, 0], "i4")))
+
+
+def test_multi_lars():
+    lrs = mx.nd.array(onp.array([0.1, 0.1], "f4"))
+    wsq = mx.nd.array(onp.array([4.0, 0.0], "f4"))
+    gsq = mx.nd.array(onp.array([1.0, 1.0], "f4"))
+    wds = mx.nd.array(onp.array([0.0, 0.0], "f4"))
+    out = mx.nd.multi_lars(lrs, wsq, gsq, wds, eta=0.5)
+    # layer 0: ratio = 0.5*2/1 = 1.0 -> lr 0.1 ; layer 1: ||w||=0 -> 1x
+    assert onp.allclose(out.asnumpy(), [0.1, 0.1], atol=1e-5)
+
+
 def test_adam_rmsprop_signsgd_nag():
     w = mx.nd.array(onp.ones(4, "f4"))
     g = mx.nd.array(onp.full(4, 0.5, "f4"))

@@ -60,3 +60,43 @@ def test_autograd_flows_through_dispatch():
         loss = onp.exp(a).sum()  # numpy call, mx tape
     loss.backward()
     assert onp.allclose(a.grad.asnumpy(), onp.exp(a.asnumpy()), atol=1e-5)
+
+
+def test_ufunc_out_and_methods():
+    a = _arr()
+    target = mx.np.zeros((2, 3))
+    r = onp.exp(a, out=target)
+    assert r is target
+    assert onp.allclose(target.asnumpy(), onp.exp(a.asnumpy()), atol=1e-5)
+    # reduce method with NDArray out (host-fallback path)
+    col = mx.np.zeros((3,))
+    r = onp.add.reduce(a, axis=0, out=col)
+    assert r is col
+    assert onp.allclose(col.asnumpy(), a.asnumpy().sum(0), atol=1e-5)
+    # unmapped multi-output ufunc with tuple out
+    o1, o2 = mx.np.zeros((2, 3)), mx.np.zeros((2, 3))
+    r1, r2 = onp.divmod(a * 3, 2.0, out=(o1, o2))
+    assert r1 is o1 and r2 is o2
+    q, rem = onp.divmod(a.asnumpy() * 3, 2.0)
+    assert onp.allclose(o1.asnumpy(), q, atol=1e-5)
+    assert onp.allclose(o2.asnumpy(), rem, atol=1e-5)
+
+
+def test_fill_diagonal_numpy_semantics():
+    # tall matrix with wrap
+    a = mx.np.array(onp.zeros((6, 3), "f4"))
+    mx.np.fill_diagonal(a, 5.0, wrap=True)
+    ref = onp.zeros((6, 3), "f4")
+    onp.fill_diagonal(ref, 5.0, wrap=True)
+    assert onp.allclose(a.asnumpy(), ref)
+    # ndim > 2: main hyper-diagonal only
+    b = mx.np.array(onp.zeros((3, 3, 3), "f4"))
+    mx.np.fill_diagonal(b, 2.0)
+    ref3 = onp.zeros((3, 3, 3), "f4")
+    onp.fill_diagonal(ref3, 2.0)
+    assert onp.allclose(b.asnumpy(), ref3)
+    import pytest as _pt
+
+    from mxnet_tpu.base import MXNetError
+    with _pt.raises(MXNetError):
+        mx.np.fill_diagonal(mx.np.zeros((2, 3, 4)), 1.0)

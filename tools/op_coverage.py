@@ -29,7 +29,12 @@ _SKIP_PREFIXES = ("_backward", "_contrib_backward", "_image_backward",
                   "_CachedOp", "_NoGradient", "_copyto", "_cond", "_foreach",
                   "_while_loop", "_identity_with_attr", "_set_value",
                   "CuDNN", "_CustomFunction", "_mp_", "_sg_", "_FusedOp",
-                  "_TensorRT", "_sparse_adagrad", "_quantized_reshape")
+                  "_TensorRT", "_sparse_adagrad", "_quantized_reshape",
+                  "_scatter_set_nd", "_slice_assign", "_split_v2_backward",
+                  "_zeros_without_dtype", "_npi_advanced_indexing",
+                  "_npi_boolean_mask_assign", "_npi_hsplit_backward",
+                  "_npi_rollaxis_backward", "_npi_share_memory",
+                  "IdentityAttachKLSparseReg")
 _SKIP_SUBSTR = ("_quantized_", "quantized_", "_requantize", "_calibrate",
                 "mkldnn", "intgemm", "_tvm", "khatri_rao", "_sample_unique",
                 "_dgl", "dgl_", "_rnn_param_concat", "stes")
@@ -105,6 +110,18 @@ _SEMANTIC = {
     "_image_resize": "imresize", "_image_flip_left_right":
     "HorizontalFlipAug",
     "LeakyReLU": "leaky_relu", "CTCLoss": "ctc_loss",
+    "_contrib_BatchNormWithReLU": "batch_norm_with_relu",
+    "_contrib_quantize": "quantize", "_contrib_quantize_v2": "quantize",
+    "_contrib_dequantize": "dequantize",
+    "Custom": "CustomOp",
+    "_npi_insert_slice": "insert", "_npi_insert_tensor": "insert",
+    "_npi_where_lscalar": "where", "_npi_where_rscalar": "where",
+    "_npi_tensordot_int_axes": "tensordot",
+    "_npi_matrix_rank_none_tol": "matrix_rank",
+    "_npi_pinv_scalar_rcond": "pinv",
+    "_npi_normal_n": "normal", "_npi_uniform_n": "uniform",
+    "_npi_repeats": "repeat", "_npi_powerd": "power",
+    "_adamw_update": "adamw_update",
     "UpSampling": "deconvolution", "SliceChannel": "split",
     "ROIPooling": "roi_align", "amp_cast": "amp_cast",
     "_split_v2": "split", "reverse": "reverse",
@@ -146,9 +163,15 @@ def covered_by(mx, name: str) -> bool:
     from mxnet_tpu.gluon.data.vision import transforms as T
     from mxnet_tpu.gluon import nn as gnn
     from mxnet_tpu.ops import spatial as SP
+    from mxnet_tpu.ops import boxes as BX
+    from mxnet_tpu.ops import ctc as CT
+    from mxnet_tpu.ops import nn as ON
+    from mxnet_tpu import contrib as CB
+    from mxnet_tpu import operator as OP
 
     spaces = [mx.np, mx.npx, mx.nd, L, R, mx.nd.linalg, mx.image, T, gnn,
-              SP, getattr(mx.nd, "sparse", None), getattr(mx, "sym", None)]
+              SP, BX, CT, ON, CB.quantization, OP,
+              getattr(mx.nd, "sparse", None), getattr(mx, "sym", None)]
     for cand in _strip(name):
         for sp in spaces:
             if sp is not None and hasattr(sp, cand):
