@@ -83,6 +83,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.MXTPUEnginePush.argtypes = [
         c.c_void_p, OP_FN, c.c_void_p, c.POINTER(c.c_void_p), c.c_int,
         c.POINTER(c.c_void_p), c.c_int, c.c_int]
+    lib.MXTPUEnginePushNamed.restype = c.c_int
+    lib.MXTPUEnginePushNamed.argtypes = [
+        c.c_void_p, OP_FN, c.c_void_p, c.POINTER(c.c_void_p), c.c_int,
+        c.POINTER(c.c_void_p), c.c_int, c.c_int, c.c_char_p]
+    lib.MXTPUEngineProfileStart.argtypes = [c.c_void_p]
+    lib.MXTPUEngineProfileStop.argtypes = [c.c_void_p]
+    lib.MXTPUEngineProfileDump.restype = c.c_int64
+    lib.MXTPUEngineProfileDump.argtypes = [c.c_void_p, c.c_char_p,
+                                           c.c_int64]
     lib.MXTPUEngineWaitForVar.restype = c.c_int
     lib.MXTPUEngineWaitForVar.argtypes = [c.c_void_p, c.c_void_p]
     lib.MXTPUEngineWaitForAll.restype = c.c_int

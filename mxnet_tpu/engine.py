@@ -44,7 +44,7 @@ class Engine:
         raise NotImplementedError
 
     def push(self, fn: Callable[[], None], read: Sequence[Var] = (),
-             write: Sequence[Var] = (), priority: int = 0):
+             write: Sequence[Var] = (), priority: int = 0, name=None):
         raise NotImplementedError
 
     def wait_for_var(self, var: Var):
@@ -70,7 +70,7 @@ class NaiveEngine(Engine):
     def delete_var(self, var: Var):
         self._errs.pop(var._handle, None)
 
-    def push(self, fn, read=(), write=(), priority=0):
+    def push(self, fn, read=(), write=(), priority=0, name=None):
         # same contract as the native engine: only READ deps propagate
         # poison; a successful write supersedes a poisoned value
         for v in read:
@@ -149,7 +149,7 @@ class NativeEngine(Engine):
         self._lib.MXTPUEngineDeleteVar(self._handle, var._handle)
         var._handle = None
 
-    def push(self, fn, read=(), write=(), priority=0):
+    def push(self, fn, read=(), write=(), priority=0, name=None):
         global _op_counter
         with _op_lock:
             _op_counter += 1
@@ -160,12 +160,34 @@ class NativeEngine(Engine):
             *[v._handle for v in read] or [None])
         w_arr = (ctypes.c_void_p * max(1, n_w))(
             *[v._handle for v in write] or [None])
-        rc = self._lib.MXTPUEnginePush(self._handle, _STATIC_CB, op_id,
-                                       r_arr, n_r, w_arr, n_w, int(priority))
+        rc = self._lib.MXTPUEnginePushNamed(
+            self._handle, _STATIC_CB, op_id, r_arr, n_r, w_arr, n_w,
+            int(priority), name.encode() if name else None)
         if rc != 0:
             with _op_lock:
                 _op_registry.pop(op_id, None)
             raise MXNetError(self._lib.MXTPUGetLastError().decode())
+
+    # -- profiling (chrome://tracing events, ref src/profiler/) ----------
+    def profile_start(self):
+        self._lib.MXTPUEngineProfileStart(self._handle)
+
+    def profile_stop(self):
+        self._lib.MXTPUEngineProfileStop(self._handle)
+
+    def profile_dump(self) -> str:
+        """Drain recorded events as comma-separated chrome-trace JSON
+        objects ('' when none). Two-phase: ask the C side for the exact
+        byte count, then fetch — no truncation at any trace size."""
+        needed = self._lib.MXTPUEngineProfileDump(self._handle, None, 0)
+        if needed <= 1:
+            # still fetch to clear the (empty) cache
+            buf = ctypes.create_string_buffer(2)
+            self._lib.MXTPUEngineProfileDump(self._handle, buf, 2)
+            return ""
+        buf = ctypes.create_string_buffer(int(needed))
+        self._lib.MXTPUEngineProfileDump(self._handle, buf, needed)
+        return buf.value.decode()
 
     def wait_for_var(self, var: Var):
         if self._lib.MXTPUEngineWaitForVar(self._handle, var._handle) != 0:
@@ -206,8 +228,8 @@ def delete_var(var: Var):
     get().delete_var(var)
 
 
-def push(fn, read=(), write=(), priority=0):
-    get().push(fn, read=read, write=write, priority=priority)
+def push(fn, read=(), write=(), priority=0, name=None):
+    get().push(fn, read=read, write=write, priority=priority, name=name)
 
 
 def wait_for_var(var: Var):

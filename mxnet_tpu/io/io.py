@@ -412,7 +412,8 @@ class ImageRecordIter(DataIter):
                 pad = self.batch_size - len(idx)
                 idx = _onp.concatenate([idx, _onp.resize(self._order, pad)])
             var = self._engine.new_var()
-            self._engine.push(self._load_batch(bi, idx, pad), write=(var,))
+            self._engine.push(self._load_batch(bi, idx, pad), write=(var,),
+                              name=f"imagerec_decode_batch{bi}")
             self._vars[bi] = var
             self._scheduled += 1
 
@@ -515,7 +516,8 @@ class PrefetchingIter(DataIter):
     def _kick(self):
         self._var = self._engine.new_var()
         self._slot = {}
-        self._engine.push(self._fetch, write=(self._var,))
+        self._engine.push(self._fetch, write=(self._var,),
+                          name="prefetch_batch")
 
     def reset(self):
         self._engine.wait_for_var(self._var)

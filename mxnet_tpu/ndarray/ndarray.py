@@ -205,21 +205,23 @@ class NDArray:
                         # discard the result)
                         out._set_data(res._data.astype(out._data.dtype))
                         return out
-            if out is not None:
-                kwargs["out"] = out
+        else:
+            out = kwargs.pop("out", None)
         # host fallback for every remaining case (unmapped ufunc, reduce/
         # accumulate/outer methods, multi-output): compute on host, then
         # write back into any NDArray outs — a coerced out copy would
-        # silently drop the result
-        outs = kwargs.pop("out", None)
+        # silently drop the result. None slots in an out tuple are the
+        # numpy "allocate this one" convention.
         res = getattr(ufunc, method)(*_host(inputs), **_host(kwargs))
-        if outs is None:
+        if out is None:
             return res
-        outs_t = outs if isinstance(outs, tuple) else (outs,)
+        outs_t = out if isinstance(out, tuple) else (out,)
         res_t = res if isinstance(res, tuple) else (res,)
         written = []
         for o, r in zip(outs_t, res_t):
-            if isinstance(o, NDArray):
+            if o is None:
+                written.append(r)
+            elif isinstance(o, NDArray):
                 o._set_data(jnp.asarray(r, o._data.dtype))
                 written.append(o)
             else:

@@ -358,7 +358,8 @@ def fill_diagonal(a, val, wrap=False):
         idx = _jnp.arange(a.shape[0])
         new = a._data.at[tuple([idx] * a.ndim)].set(val)
     else:
-        new = a._data.at[_jnp.arange(a.shape[0])].set(val)
+        raise _Err("fill_diagonal: array must be at least 2-d "
+                   "(numpy semantics)")
     a._set_data(new)
     return a
 

@@ -33,14 +33,44 @@ def set_config(**kwargs):
 
 
 def set_state(state_name: str = "stop", profile_process: str = "worker"):
+    from . import engine as _engine
+
     if state_name == "run" and not _state["running"]:
         logdir = os.path.splitext(_config.get("filename", "profile.json"))[0] + "_xprof"
         os.makedirs(logdir, exist_ok=True)
         jax.profiler.start_trace(logdir)
+        eng = _engine.get()
+        if hasattr(eng, "profile_start"):
+            eng.profile_start()  # host-side engine ops join the trace
         _state.update(running=True, dir=logdir)
     elif state_name == "stop" and _state["running"]:
         jax.profiler.stop_trace()
+        eng = _engine.get()
+        if hasattr(eng, "profile_stop"):
+            eng.profile_stop()
+            try:
+                eng.wait_for_all()  # in-flight ops finish recording first
+            except Exception:
+                # wait_for_all rethrows the engine's sticky first-error,
+                # which may belong to ops long before this profiling
+                # session; quiescing is all the profiler needs
+                pass
+            _dump_engine_chrome_trace(eng)
         _state.update(running=False)
+
+
+def _dump_engine_chrome_trace(eng):
+    """Write the native engine's op records as a chrome://tracing file
+    next to the configured filename (ref src/profiler dumps chrome JSON;
+    open in chrome://tracing or Perfetto)."""
+    events = eng.profile_dump() if hasattr(eng, "profile_dump") else ""
+    if not events:
+        return
+    path = os.path.splitext(_config.get("filename", "profile.json"))[0] \
+        + "_engine.json"
+    with open(path, "w") as f:
+        f.write('{"traceEvents":[' + events + "]}")
+    _state["engine_trace"] = path
 
 
 def state() -> str:

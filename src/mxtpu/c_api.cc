@@ -74,29 +74,15 @@ void MXTPUEngineDeleteVar(void* engine, void* var) {
       static_cast<mxtpu::Var*>(var));
 }
 
+int MXTPUEnginePushNamed(void* engine, MXTPUOpFn fn, void* ctx,
+                         void** read_vars, int n_read, void** write_vars,
+                         int n_write, int priority, const char* name);
+
 int MXTPUEnginePush(void* engine, MXTPUOpFn fn, void* ctx, void** read_vars,
                     int n_read, void** write_vars, int n_write,
                     int priority) {
-  try {
-    std::vector<mxtpu::Var*> reads(n_read), writes(n_write);
-    for (int i = 0; i < n_read; ++i)
-      reads[i] = static_cast<mxtpu::Var*>(read_vars[i]);
-    for (int i = 0; i < n_write; ++i)
-      writes[i] = static_cast<mxtpu::Var*>(write_vars[i]);
-    static_cast<mxtpu::Engine*>(engine)->Push(
-        [fn, ctx](bool skipped) -> std::string {
-          char buf[4096];
-          buf[0] = '\0';
-          int rc = fn(ctx, buf, sizeof(buf), skipped ? 1 : 0);
-          if (rc == 0) return "";
-          return buf[0] != '\0' ? std::string(buf)
-                                 : std::string("engine op failed");
-        },
-        std::move(reads), std::move(writes), priority);
-    return 0;
-  } catch (const std::exception& e) {
-    return Fail(e.what());
-  }
+  return MXTPUEnginePushNamed(engine, fn, ctx, read_vars, n_read,
+                              write_vars, n_write, priority, nullptr);
 }
 
 int MXTPUEngineWaitForVar(void* engine, void* var) {
@@ -114,6 +100,65 @@ int MXTPUEngineWaitForAll(void* engine) {
 
 int64_t MXTPUEngineOutstanding(void* engine) {
   return static_cast<mxtpu::Engine*>(engine)->num_outstanding();
+}
+
+// named push (profiling); name may be NULL
+int MXTPUEnginePushNamed(void* engine, MXTPUOpFn fn, void* ctx,
+                         void** read_vars, int n_read, void** write_vars,
+                         int n_write, int priority, const char* name) {
+  try {
+    std::vector<mxtpu::Var*> reads(n_read), writes(n_write);
+    for (int i = 0; i < n_read; ++i)
+      reads[i] = static_cast<mxtpu::Var*>(read_vars[i]);
+    for (int i = 0; i < n_write; ++i)
+      writes[i] = static_cast<mxtpu::Var*>(write_vars[i]);
+    static_cast<mxtpu::Engine*>(engine)->Push(
+        [fn, ctx](bool skipped) -> std::string {
+          char buf[4096];
+          buf[0] = '\0';
+          int rc = fn(ctx, buf, sizeof(buf), skipped ? 1 : 0);
+          if (rc == 0) return "";
+          return buf[0] != '\0' ? std::string(buf)
+                                 : std::string("engine op failed");
+        },
+        std::move(reads), std::move(writes), priority, false, name);
+    return 0;
+  } catch (const std::exception& e) {
+    return Fail(e.what());
+  }
+}
+
+void MXTPUEngineProfileStart(void* engine) {
+  static_cast<mxtpu::Engine*>(engine)->ProfileStart();
+}
+
+void MXTPUEngineProfileStop(void* engine) {
+  static_cast<mxtpu::Engine*>(engine)->ProfileStop();
+}
+
+// Two-phase drain: call with buf=NULL to drain the event buffer into a
+// per-thread cache and learn the required byte count (incl. NUL); then
+// call with a buffer of at least that size to copy + clear the cache.
+// Returns bytes required (phase 1) / bytes written (phase 2).
+int64_t MXTPUEngineProfileDump(void* engine, char* buf, int64_t buf_len) {
+  thread_local std::string cache;
+  thread_local void* cache_owner = nullptr;
+  if (buf == nullptr) {
+    static_cast<mxtpu::Engine*>(engine)->ProfileDumpJson(&cache);
+    cache_owner = engine;
+    return static_cast<int64_t>(cache.size()) + 1;
+  }
+  if (cache_owner != engine) {
+    static_cast<mxtpu::Engine*>(engine)->ProfileDumpJson(&cache);
+    cache_owner = engine;
+  }
+  size_t m = cache.size() < static_cast<size_t>(buf_len - 1)
+                 ? cache.size() : static_cast<size_t>(buf_len - 1);
+  std::memcpy(buf, cache.data(), m);
+  buf[m] = '\0';
+  cache.clear();
+  cache_owner = nullptr;
+  return static_cast<int64_t>(m);
 }
 
 // ---------------------------------------------------------------- storage

@@ -6,6 +6,9 @@
 // mutex+deque representation.
 #include "engine.h"
 
+#include <chrono>
+#include <sstream>
+
 namespace mxtpu {
 
 // ---------------------------------------------------------------- ThreadPool
@@ -88,9 +91,10 @@ void Engine::DeleteVar(Var* var) {
 
 void Engine::Push(std::function<std::string(bool)> fn,
                   std::vector<Var*> reads, std::vector<Var*> writes,
-                  int priority, bool always_run) {
+                  int priority, bool always_run, const char* name) {
   auto* op = new Opr();
   op->fn = std::move(fn);
+  if (name != nullptr) op->name = name;
   // Dedupe: repeated vars would deadlock (an op's own read grant blocks
   // its write grant); a var in both lists is a write (ref
   // imperative_utils.h:318 SetDependency does the same dedup).
@@ -169,12 +173,26 @@ void Engine::ExecuteOpr(Opr* op) {
   }
   bool skipped = (dep_err != nullptr) && !op->always_run;
   std::string err;
+  const bool prof = profiling_.load(std::memory_order_relaxed);
+  int64_t t0 = 0;
+  if (prof) {
+    t0 = std::chrono::duration_cast<std::chrono::microseconds>(
+        std::chrono::steady_clock::now().time_since_epoch()).count();
+  }
   try {
     err = op->fn(skipped);
   } catch (const std::exception& e) {
     err = e.what();
   } catch (...) {
     err = "unknown C++ exception in engine op";
+  }
+  if (prof) {
+    int64_t t1 = std::chrono::duration_cast<std::chrono::microseconds>(
+        std::chrono::steady_clock::now().time_since_epoch()).count();
+    std::lock_guard<std::mutex> lk(prof_mu_);
+    prof_events_.push_back(ProfileEvent{
+        op->name.empty() ? std::string("engine_op") : op->name, t0, t1,
+        std::hash<std::thread::id>()(std::this_thread::get_id())});
   }
   if (skipped) err = *dep_err;  // propagate regardless of cleanup result
   if (!err.empty()) {
@@ -252,6 +270,51 @@ std::string Engine::WaitForVar(Var* var) {
   std::unique_lock<std::mutex> lk(st->m);
   st->cv.wait(lk, [&] { return st->done; });
   return st->err;
+}
+
+void Engine::ProfileStart() { profiling_.store(true); }
+
+void Engine::ProfileStop() { profiling_.store(false); }
+
+namespace {
+void JsonEscapeInto(std::ostringstream& os, const std::string& s) {
+  for (char c : s) {
+    switch (c) {
+      case '"': os << "\\\""; break;
+      case '\\': os << "\\\\"; break;
+      case '\n': os << "\\n"; break;
+      case '\t': os << "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          os << buf;
+        } else {
+          os << c;
+        }
+    }
+  }
+}
+}  // namespace
+
+int Engine::ProfileDumpJson(std::string* out) {
+  std::vector<ProfileEvent> events;
+  {
+    std::lock_guard<std::mutex> lk(prof_mu_);
+    events.swap(prof_events_);
+  }
+  std::ostringstream os;
+  for (size_t i = 0; i < events.size(); ++i) {
+    const auto& e = events[i];
+    if (i) os << ",";
+    os << "{\"name\":\"";
+    JsonEscapeInto(os, e.name);
+    os << "\",\"ph\":\"X\",\"ts\":"
+       << e.start_us << ",\"dur\":" << (e.end_us - e.start_us)
+       << ",\"pid\":0,\"tid\":" << (e.tid % 100000) << "}";
+  }
+  *out = os.str();
+  return static_cast<int>(events.size());
 }
 
 std::string Engine::WaitForAll() {

@@ -54,6 +54,7 @@ struct Opr {
   // the call still happens so language bindings can release per-op
   // resources (the Python closure registry).
   std::function<std::string(bool)> fn;
+  std::string name;  // for the profiler; empty = unnamed
   std::vector<Var*> reads;
   std::vector<Var*> writes;
   std::atomic<int> pending{0};  // un-granted var requests
@@ -100,7 +101,21 @@ class Engine {
   void DeleteVar(Var* var);
   void Push(std::function<std::string(bool)> fn, std::vector<Var*> reads,
             std::vector<Var*> writes, int priority,
-            bool always_run = false);
+            bool always_run = false, const char* name = nullptr);
+
+  // -- profiling (ref src/profiler/profiler.h ProfileOperator records;
+  // dumped as chrome://tracing JSON like the reference's dump files) ----
+  struct ProfileEvent {
+    std::string name;
+    int64_t start_us;
+    int64_t end_us;
+    uint64_t tid;
+  };
+  void ProfileStart();
+  void ProfileStop();
+  // Appends events as chrome-trace JSON objects into *out and clears the
+  // buffer. Returns the number of events.
+  int ProfileDumpJson(std::string* out);
   // Returns error string ("" if clean) once all prior ops on var finished.
   std::string WaitForVar(Var* var);
   std::string WaitForAll();
@@ -123,6 +138,9 @@ class Engine {
   std::condition_variable done_cv_;
   std::mutex err_mu_;
   std::string first_error_;
+  std::atomic<bool> profiling_{false};
+  std::mutex prof_mu_;
+  std::vector<ProfileEvent> prof_events_;
 };
 
 }  // namespace mxtpu

@@ -222,3 +222,81 @@ def test_naive_engine_write_supersedes_poison():
     e.wait_for_var(v)                  # must NOT raise
     with pytest.raises(ValueError):
         e.wait_for_all()               # first error still reported once
+
+
+def test_engine_profiling_chrome_trace(tmp_path):
+    """Native engine op profiling -> chrome://tracing JSON merged by
+    mx.profiler (ref src/profiler dumps chrome JSON)."""
+    import json
+    import time
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine
+
+    eng = engine.get()
+    if not hasattr(eng, "profile_start"):
+        import pytest
+
+        pytest.skip("native engine unavailable")
+    mx.profiler.set_config(filename=str(tmp_path / "prof.json"))
+    mx.profiler.set_state("run")
+    var = eng.new_var()
+    for i in range(4):
+        eng.push(lambda: time.sleep(0.001), write=[var], name=f"op{i}")
+    eng.wait_for_var(var)
+    eng.delete_var(var)
+    mx.profiler.set_state("stop")
+    trace = tmp_path / "prof_engine.json"
+    assert trace.exists()
+    doc = json.loads(trace.read_text())
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"op0", "op1", "op2", "op3"} <= names
+    for e in doc["traceEvents"]:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+def test_engine_profiling_off_by_default():
+    import time
+
+    from mxnet_tpu import engine
+
+    eng = engine.get()
+    if not hasattr(eng, "profile_dump"):
+        import pytest
+
+        pytest.skip("native engine unavailable")
+    eng.profile_dump()  # drain anything left over
+    var = eng.new_var()
+    eng.push(lambda: time.sleep(0.001), write=[var], name="untracked")
+    eng.wait_for_var(var)
+    eng.delete_var(var)
+    assert eng.profile_dump() == ""  # not recording unless started
+
+
+def test_engine_profile_dump_large_and_escaped(tmp_path):
+    """No truncation on large traces; op names JSON-escape correctly."""
+    import json
+
+    from mxnet_tpu import engine
+
+    eng = engine.get()
+    if not hasattr(eng, "profile_start"):
+        import pytest
+
+        pytest.skip("native engine unavailable")
+    eng.profile_dump()
+    eng.profile_start()
+    var = eng.new_var()
+    for i in range(3000):
+        eng.push(lambda: None, write=[var],
+                 name=f'op "quoted"\\{i}' if i % 2 else f"plain_{i}")
+    eng.wait_for_var(var)
+    eng.delete_var(var)
+    eng.profile_stop()
+    eng.wait_for_all()
+    events = eng.profile_dump()
+    doc = json.loads('{"traceEvents":[' + events + "]}")
+    assert len(doc["traceEvents"]) >= 3000
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert 'op "quoted"\\1' in names
+    assert eng.profile_dump() == ""  # drained

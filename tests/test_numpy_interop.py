@@ -100,3 +100,14 @@ def test_fill_diagonal_numpy_semantics():
     from mxnet_tpu.base import MXNetError
     with _pt.raises(MXNetError):
         mx.np.fill_diagonal(mx.np.zeros((2, 3, 4)), 1.0)
+
+
+def test_ufunc_out_tuple_with_none_slot():
+    a = _arr()
+    o2 = mx.np.zeros((2, 3))
+    r1, r2 = onp.divmod(a * 3, 2.0, out=(None, o2))
+    assert isinstance(r1, onp.ndarray)  # allocated by numpy
+    assert r2 is o2
+    q, rem = onp.divmod(a.asnumpy() * 3, 2.0)
+    assert onp.allclose(r1, q, atol=1e-5)
+    assert onp.allclose(o2.asnumpy(), rem, atol=1e-5)
