@@ -205,6 +205,9 @@ class NDArray:
                         # discard the result)
                         out._set_data(res._data.astype(out._data.dtype))
                         return out
+                    if isinstance(out, _onp.ndarray):
+                        _onp.copyto(out, res.asnumpy())
+                        return out
         else:
             out = kwargs.pop("out", None)
         # host fallback for every remaining case (unmapped ufunc, reduce/

@@ -38,7 +38,7 @@ __all__ = [
     "sldwin_atten_mask_like", "sldwin_atten_context", "box_encode",
     "box_decode", "bipartite_matching", "quadratic", "index_copy",
     "index_array", "edge_id", "getnnz", "batch_norm_with_relu",
-    "dynamic_reshape", "col2im",
+    "dynamic_reshape", "col2im", "hawkesll",
     "gamma", "gammaln", "erf", "erfinv", "digamma",
     "reshape_like", "slice_like", "broadcast_like", "shape_array", "batch_dot",
     "arange_like", "gather_nd", "scatter_nd", "index_update", "index_add",
@@ -878,3 +878,14 @@ def col2im(data, output_size, kernel, stride=1, dilate=1, pad=0, **kw):
             [slice(p[i], p[i] + out_size[i]) for i in range(n_sp)]
         return img[tuple(unpad)]
     return call(f, (data,), {}, name="col2im")
+
+
+def hawkesll(mu, alpha, beta, state, lags, marks, valid_length, max_time,
+             **kw):
+    """Marked Hawkes process log-likelihood
+    (ref contrib/hawkes_ll-inl.h _contrib_hawkesll); lax.scan over events."""
+    from ..ops import hawkes as _hk
+
+    return call(_hk.hawkesll,
+                (mu, alpha, beta, state, lags, marks, valid_length,
+                 max_time), {}, name="hawkesll")

@@ -152,8 +152,16 @@ int64_t MXTPUEngineProfileDump(void* engine, char* buf, int64_t buf_len) {
     static_cast<mxtpu::Engine*>(engine)->ProfileDumpJson(&cache);
     cache_owner = engine;
   }
-  size_t m = cache.size() < static_cast<size_t>(buf_len - 1)
-                 ? cache.size() : static_cast<size_t>(buf_len - 1);
+  if (buf_len < 1) {
+    // undersized call: report the required size, keep the cache intact
+    return static_cast<int64_t>(cache.size()) + 1;
+  }
+  if (static_cast<size_t>(buf_len) < cache.size() + 1) {
+    // too small to hold everything: don't truncate-and-lose — keep the
+    // cache for a properly-sized retry
+    return static_cast<int64_t>(cache.size()) + 1;
+  }
+  size_t m = cache.size();
   std::memcpy(buf, cache.data(), m);
   buf[m] = '\0';
   cache.clear();

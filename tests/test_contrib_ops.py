@@ -244,3 +244,48 @@ def test_col2im_inverts_im2col_counts():
         for j in range(3):
             counts[i:i + 2, j:j + 2] += 1
     assert onp.allclose(back.asnumpy(), x * counts[None, None], atol=1e-5)
+
+
+def test_hawkesll_against_python_reference():
+    """lax.scan implementation vs a literal port of the reference's C loop
+    (hawkes_ll-inl.h:113-190)."""
+    rng = onp.random.RandomState(7)
+    N, K, T = 3, 2, 6
+    mu = rng.rand(N, K).astype("f4") * 0.5 + 0.1
+    alpha = rng.rand(K).astype("f4") * 0.5
+    beta = rng.rand(K).astype("f4") + 0.5
+    state = rng.rand(N, K).astype("f4")
+    lags = rng.rand(N, T).astype("f4") * 0.5
+    marks = rng.randint(0, K, (N, T)).astype("i4")
+    valid_length = onp.array([6, 4, 0], "f4")
+    max_time = (lags.sum(1) + 1.0).astype("f4")
+
+    # literal reference loop
+    ll_ref = onp.zeros(N, "f4")
+    st_ref = state.copy()
+    last = onp.zeros((N, K), "f4")
+    for i in range(N):
+        t = 0.0
+        for j in range(int(valid_length[i])):
+            ci = marks[i, j]
+            t += lags[i, j]
+            d = t - last[i, ci]
+            ed = onp.exp(-beta[ci] * d)
+            lda = mu[i, ci] + alpha[ci] * beta[ci] * st_ref[i, ci] * ed
+            comp = mu[i, ci] * d + alpha[ci] * st_ref[i, ci] * (1 - ed)
+            ll_ref[i] += onp.log(lda) - comp
+            st_ref[i, ci] = 1 + st_ref[i, ci] * ed
+            last[i, ci] = t
+        for m in range(K):
+            d = max_time[i] - last[i, m]
+            ed = onp.exp(-beta[m] * d)
+            ll_ref[i] -= mu[i, m] * d + alpha[m] * st_ref[i, m] * (1 - ed)
+            st_ref[i, m] = ed * st_ref[i, m]
+
+    ll, st = mx.npx.hawkesll(
+        mx.nd.array(mu), mx.nd.array(alpha), mx.nd.array(beta),
+        mx.nd.array(state), mx.nd.array(lags), mx.nd.array(marks),
+        mx.nd.array(valid_length), mx.nd.array(max_time))
+    assert onp.allclose(ll.asnumpy(), ll_ref, atol=1e-3), \
+        (ll.asnumpy(), ll_ref)
+    assert onp.allclose(st.asnumpy(), st_ref, atol=1e-4)
