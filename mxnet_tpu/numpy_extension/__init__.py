@@ -38,7 +38,7 @@ __all__ = [
     "sldwin_atten_mask_like", "sldwin_atten_context", "box_encode",
     "box_decode", "bipartite_matching", "quadratic", "index_copy",
     "index_array", "edge_id", "getnnz", "batch_norm_with_relu",
-    "dynamic_reshape", "col2im", "hawkesll",
+    "dynamic_reshape", "col2im", "hawkesll", "rroi_align",
     "gamma", "gammaln", "erf", "erfinv", "digamma",
     "reshape_like", "slice_like", "broadcast_like", "shape_array", "batch_dot",
     "arange_like", "gather_nd", "scatter_nd", "index_update", "index_add",
@@ -889,3 +889,39 @@ def hawkesll(mu, alpha, beta, state, lags, marks, valid_length, max_time,
     return call(_hk.hawkesll,
                 (mu, alpha, beta, state, lags, marks, valid_length,
                  max_time), {}, name="hawkesll")
+
+
+def rroi_align(data, rois, pooled_size, spatial_scale=1.0,
+               sampling_ratio=-1, **kw):
+    """Rotated ROI align (ref contrib/rroi_align.cc _contrib_RROIAlign)."""
+    import builtins as _bi
+    import math as _math
+
+    import numpy as _np_host
+
+    from ..ops import spatial as _sp
+
+    grid_sizes = None
+    if sampling_ratio <= 0:
+        # reference grids depend on concrete roi sizes: read them eagerly
+        # HERE (outside any trace) so the traced fn stays differentiable
+        ph_, pw_ = (pooled_size if isinstance(pooled_size, (tuple, list))
+                    else (pooled_size, pooled_size))
+        rois_h = _np_host.asarray(
+            rois.asnumpy() if isinstance(rois, NDArray) else rois)
+        grid_sizes = [
+            (_bi.max(int(_math.ceil(_bi.max(r[4] * spatial_scale, 1.0)
+                                    / ph_)), 1),
+             _bi.max(int(_math.ceil(_bi.max(r[3] * spatial_scale, 1.0)
+                                    / pw_)), 1))
+            for r in rois_h]
+
+    return call(lambda d, r: _sp.rroi_align(d, r, pooled_size,
+                                            spatial_scale, sampling_ratio,
+                                            _grid_sizes=grid_sizes),
+                (data, rois), {}, name="rroi_align",
+                attrs={"pooled_size": list(pooled_size)
+                       if isinstance(pooled_size, (tuple, list))
+                       else pooled_size,
+                       "spatial_scale": spatial_scale,
+                       "sampling_ratio": sampling_ratio})

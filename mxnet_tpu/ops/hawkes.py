@@ -33,10 +33,12 @@ def hawkesll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
     marks = jnp.asarray(marks).astype(jnp.int32)
     rows = jnp.arange(n)
 
+    vl = jnp.floor(jnp.asarray(valid_length)).astype(jnp.int32)
+
     def step(carry, inputs):
         t, last, st, ll = carry
         lag_j, mark_j, j = inputs
-        active = (j < valid_length)
+        active = (j < vl)  # reference truncates fractional valid_length
         t2 = t + lag_j
         d = t2 - last[rows, mark_j]
         ed = jnp.exp(-beta[mark_j] * d)

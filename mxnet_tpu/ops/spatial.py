@@ -207,3 +207,75 @@ def adaptive_avg_pool3d(x, output_size):
             rows.append(jnp.stack(cols, axis=-1))
         out.append(jnp.stack(rows, axis=-2))
     return jnp.stack(out, axis=-3)
+
+
+def rroi_align(data, rois, pooled_size, spatial_scale=1.0,
+               sampling_ratio=-1, _grid_sizes=None):
+    """Rotated ROI align (ref src/operator/contrib/rroi_align.cc
+    _contrib_RROIAlign, RRPN-style).
+
+    data: (N, C, H, W); rois: (R, 6) rows
+    [batch_idx, cx, cy, w, h, theta_degrees] in image coords (scaled by
+    spatial_scale). Returns (R, C, PH, PW) by averaging bilinear samples
+    of the rotated bin grid. sampling_ratio > 0 gives a static grid (one
+    fused jit-able computation, the TPU path); <= 0 reproduces the
+    reference's per-ROI ceil(roi/pool) grids with a host loop (eager).
+    """
+    ph_, pw_ = (pooled_size if isinstance(pooled_size, (tuple, list))
+                else (pooled_size, pooled_size))
+    n, c, h, w = data.shape
+
+    def pooled_for(roi, gh, gw):
+        batch = roi[0].astype(jnp.int32)
+        cx = roi[1] * spatial_scale
+        cy = roi[2] * spatial_scale
+        rw = jnp.maximum(roi[3] * spatial_scale, 1.0)
+        rh = jnp.maximum(roi[4] * spatial_scale, 1.0)
+        theta = roi[5] * (jnp.pi / 180.0)
+        start_h, start_w = -rh / 2.0, -rw / 2.0
+        bsh, bsw = rh / ph_, rw / pw_
+        ct, st = jnp.cos(theta), jnp.sin(theta)
+        iy = (jnp.arange(gh) + 0.5) / gh
+        ix = (jnp.arange(gw) + 0.5) / gw
+        yy = (start_h + jnp.arange(ph_)[:, None] * bsh
+              + iy[None, :] * bsh)                       # (PH, gh)
+        xx = (start_w + jnp.arange(pw_)[:, None] * bsw
+              + ix[None, :] * bsw)                       # (PW, gw)
+        # rotate each (xx, yy) pair around the roi center (ref formula)
+        X = (xx[None, :, None, :] * ct + yy[:, None, :, None] * st + cx)
+        Y = (yy[:, None, :, None] * ct - xx[None, :, None, :] * st + cy)
+        # X/Y: (PH, PW, gh, gw)
+        empty = (Y < -1.0) | (Y > h) | (X < -1.0) | (X > w)
+        y = jnp.clip(Y, 0.0, h - 1)
+        x = jnp.clip(X, 0.0, w - 1)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        ly, lx = y - y0, x - x0
+        hy, hx = 1.0 - ly, 1.0 - lx
+        img = data[batch]                                # (C, H, W)
+        def gather(yi, xi):
+            return img[:, yi, xi]                        # (C, PH, PW, gh, gw)
+        val = (gather(y0, x0) * (hy * hx)[None]
+               + gather(y0, x1) * (hy * lx)[None]
+               + gather(y1, x0) * (ly * hx)[None]
+               + gather(y1, x1) * (ly * lx)[None])
+        val = jnp.where(empty[None], 0.0, val)
+        return jnp.mean(val, axis=(-2, -1))              # (C, PH, PW)
+
+    if sampling_ratio > 0:
+        g = int(sampling_ratio)
+        return jax.vmap(lambda r: pooled_for(r, g, g))(rois)
+    # reference data-dependent grids: grid counts must be CONCRETE ints
+    # (they set shapes), so they are supplied by the caller via
+    # grid_sizes — computed eagerly in the npx facade, never from traced
+    # values (a host conversion inside the traced fn would break vjp and
+    # silently zero gradients)
+    if _grid_sizes is None:
+        raise MXNetError(
+            "rroi_align with sampling_ratio<=0 needs eager grid sizes; "
+            "call through npx.rroi_align")
+    outs = [pooled_for(rois[r], gh, gw)
+            for r, (gh, gw) in enumerate(_grid_sizes)]
+    return jnp.stack(outs)

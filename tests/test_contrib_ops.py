@@ -289,3 +289,106 @@ def test_hawkesll_against_python_reference():
     assert onp.allclose(ll.asnumpy(), ll_ref, atol=1e-3), \
         (ll.asnumpy(), ll_ref)
     assert onp.allclose(st.asnumpy(), st_ref, atol=1e-4)
+
+
+def test_rroi_align_matches_naive():
+    """jnp RROIAlign vs a literal python port of the reference loop
+    (rroi_align.cc pre_calc + pooled average), both grid modes."""
+    rng = onp.random.RandomState(9)
+    N, C, H, W = 2, 3, 12, 14
+    data = rng.rand(N, C, H, W).astype("f4")
+    rois = onp.array([[0, 6.0, 5.0, 8.0, 6.0, 30.0],
+                      [1, 7.0, 6.0, 10.0, 4.0, -45.0],
+                      [0, 2.0, 2.0, 3.0, 3.0, 0.0]], "f4")
+    PH, PW, SR = 2, 3, 2
+
+    def naive(data, rois, ph_, pw_, scale, sr):
+        R = rois.shape[0]
+        out = onp.zeros((R, C, ph_, pw_), "f4")
+        for r in range(R):
+            b = int(rois[r, 0])
+            cx, cy = rois[r, 1] * scale, rois[r, 2] * scale
+            rw = max(rois[r, 3] * scale, 1.0)
+            rh = max(rois[r, 4] * scale, 1.0)
+            th = rois[r, 5] * onp.pi / 180.0
+            ct, st = onp.cos(th), onp.sin(th)
+            sh, sw = -rh / 2.0, -rw / 2.0
+            bsh, bsw = rh / ph_, rw / pw_
+            gh = sr if sr > 0 else max(int(onp.ceil(rh / ph_)), 1)
+            gw = sr if sr > 0 else max(int(onp.ceil(rw / pw_)), 1)
+            for p in range(ph_):
+                for q in range(pw_):
+                    acc = onp.zeros(C, "f4")
+                    for iy in range(gh):
+                        yy = sh + p * bsh + (iy + 0.5) * bsh / gh
+                        for ix in range(gw):
+                            xx = sw + q * bsw + (ix + 0.5) * bsw / gw
+                            x = xx * ct + yy * st + cx
+                            y = yy * ct - xx * st + cy
+                            if y < -1.0 or y > H or x < -1.0 or x > W:
+                                continue
+                            y_, x_ = max(y, 0.0), max(x, 0.0)
+                            y0, x0 = int(y_), int(x_)
+                            y1 = min(y0 + 1, H - 1)
+                            x1 = min(x0 + 1, W - 1)
+                            if y0 >= H - 1:
+                                y0 = y1 = H - 1
+                                y_ = float(y0)
+                            if x0 >= W - 1:
+                                x0 = x1 = W - 1
+                                x_ = float(x0)
+                            ly, lx = y_ - y0, x_ - x0
+                            acc += (data[b, :, y0, x0] * (1 - ly) * (1 - lx)
+                                    + data[b, :, y0, x1] * (1 - ly) * lx
+                                    + data[b, :, y1, x0] * ly * (1 - lx)
+                                    + data[b, :, y1, x1] * ly * lx)
+                    out[r, :, p, q] = acc / (gh * gw)
+        return out
+
+    for sr in (SR, -1):
+        got = mx.npx.rroi_align(mx.nd.array(data), mx.nd.array(rois),
+                                pooled_size=(PH, PW), spatial_scale=1.0,
+                                sampling_ratio=sr)
+        want = naive(data, rois, PH, PW, 1.0, sr)
+        assert got.shape == (3, C, PH, PW)
+        assert onp.allclose(got.asnumpy(), want, atol=1e-4), \
+            onp.abs(got.asnumpy() - want).max()
+
+
+def test_rroi_align_gradients_both_modes():
+    """Backward through the rotated pooling must be nonzero in BOTH grid
+    modes (the dynamic-grid mode once silently zeroed gradients)."""
+    rng = onp.random.RandomState(11)
+    data = mx.nd.array(rng.rand(1, 2, 10, 10).astype("f4"))
+    rois = mx.nd.array(onp.array([[0, 5.0, 5.0, 6.0, 4.0, 20.0]], "f4"))
+    for sr in (2, -1):
+        data.attach_grad()
+        with mx.autograd.record():
+            out = mx.npx.rroi_align(data, rois, pooled_size=(2, 2),
+                                    sampling_ratio=sr)
+            loss = out.sum()
+        loss.backward()
+        g = data.grad.asnumpy()
+        assert onp.abs(g).max() > 0, f"zero grads in mode sr={sr}"
+
+
+def test_hawkesll_fractional_valid_length():
+    """Fractional valid_length truncates like the reference int cast."""
+    rng = onp.random.RandomState(3)
+    N, K, T = 1, 2, 4
+    mu = rng.rand(N, K).astype("f4") + 0.1
+    alpha = rng.rand(K).astype("f4") * 0.3
+    beta = rng.rand(K).astype("f4") + 0.5
+    state = onp.zeros((N, K), "f4")
+    lags = rng.rand(N, T).astype("f4")
+    marks = rng.randint(0, K, (N, T)).astype("i4")
+    mt = onp.array([5.0], "f4")
+    ll_frac, _ = mx.npx.hawkesll(
+        mx.nd.array(mu), mx.nd.array(alpha), mx.nd.array(beta),
+        mx.nd.array(state), mx.nd.array(lags), mx.nd.array(marks),
+        mx.nd.array(onp.array([2.7], "f4")), mx.nd.array(mt))
+    ll_int, _ = mx.npx.hawkesll(
+        mx.nd.array(mu), mx.nd.array(alpha), mx.nd.array(beta),
+        mx.nd.array(state), mx.nd.array(lags), mx.nd.array(marks),
+        mx.nd.array(onp.array([2.0], "f4")), mx.nd.array(mt))
+    assert onp.allclose(ll_frac.asnumpy(), ll_int.asnumpy(), atol=1e-5)

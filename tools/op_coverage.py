@@ -104,6 +104,7 @@ _SEMANTIC = {
     "_contrib_DeformableConvolution": "deformable_convolution",
     "_contrib_count_sketch": "count_sketch",
     "_contrib_BilinearResize2D": "imresize",
+    "_contrib_RROIAlign": "rroi_align",
     "_image_crop": "fixed_crop", "_image_random_crop": "random_crop",
     "_image_random_resized_crop": "random_size_crop",
     "_image_normalize": "color_normalize", "_image_to_tensor": "ToTensor",
