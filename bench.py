@@ -10,13 +10,22 @@ Each config times the raw jitted SPMD step (fwd+bwd+optimizer as one XLA
 computation) end to end with a device sync; host-side write-backs are
 excluded by driving the step function directly, with the param chain
 carrying the step-to-step dependency.
+
+Crash-proofing (the TPU relay in this environment wedges for hours and a
+wedged relay hangs ``import jax`` itself): the parent process NEVER imports
+jax.  It first probes the backend in a killable subprocess (bounded
+retries), then runs every config in its own subprocess with a hard
+timeout.  A dead relay, a mid-run wedge, or a crashing config each degrade
+to a JSON field (``skipped``/``error``) — the script always prints exactly
+one parseable JSON line and exits 0.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
-import traceback
 
 
 def _timed_raw_steps(trainer, xd, yd, n_steps):
@@ -331,22 +340,125 @@ def bench_ssd(on_tpu):
             "vs_baseline": None, "image_size": image}
 
 
-def main():
+_CONFIGS = {
+    "resnet50": bench_resnet50,
+    "bert_base": bench_bert_base,
+    "lenet": bench_lenet,
+    "lstm_lm": bench_lstm_lm,
+    "ssd": bench_ssd,
+}
+
+# canonical metric names, so failure rows keep the same identity the
+# success path emits (artifact consumers key on these)
+_METRIC_NAMES = {
+    "resnet50": "resnet50_train_imgs_per_sec_per_chip",
+    "bert_base": "bert_base_pretrain_samples_per_sec_per_chip",
+    "lenet": "lenet_train_imgs_per_sec_per_chip",
+    "lstm_lm": "lstm_lm_tokens_per_sec_per_chip",
+    "ssd": "ssd_resnet50_train_imgs_per_sec_per_chip",
+}
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp\n"
+    "d = jax.devices()[0]\n"
+    "x = jnp.ones((128, 128), jnp.bfloat16)\n"
+    "(x @ x).block_until_ready()\n"
+    "print('PROBE_OK', d.platform)\n"
+)
+
+
+def _cpu_env():
+    """Environment that cannot touch the relay (strips the axon pool)."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _probe_backend(attempts=3, timeout=75):
+    """Probe the accelerator in a killable subprocess.
+
+    Returns (platform, error): platform is "tpu"/"cpu"/... on success, or
+    None with the last failure string.  Bounded: <= attempts*timeout plus
+    short backoffs (~3 min worst case), per the round-2 verdict.
+    """
+    err = "no attempt made"
+    for i in range(attempts):
+        if i:
+            time.sleep(10 * i)
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC], timeout=timeout,
+                capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            err = f"probe hung >{timeout}s (relay wedged?)"
+            continue
+        for line in out.stdout.splitlines():
+            if line.startswith("PROBE_OK"):
+                return line.split()[1], None
+        err = (out.stderr.strip().splitlines() or ["probe failed"])[-1]
+    return None, err
+
+
+def _run_config(name, env, timeout):
+    """Run one benchmark config in a subprocess; never raises."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--config", name],
+            timeout=timeout, capture_output=True, text=True, env=env)
+    except subprocess.TimeoutExpired:
+        return {"metric": _METRIC_NAMES[name], "value": None,
+                "error": f"timed out after {timeout}s"}
+    for line in reversed(out.stdout.splitlines()):
+        try:
+            return json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+    tail = (out.stderr.strip().splitlines() or [f"rc={out.returncode}"])[-1]
+    return {"metric": _METRIC_NAMES[name], "value": None, "error": tail}
+
+
+def _child(name):
+    """Child mode: run one config in-process and print its JSON line."""
     import jax
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    result = bench_resnet50(on_tpu)
-    extras = []
-    for fn in (bench_bert_base, bench_lenet, bench_lstm_lm, bench_ssd):
-        try:
-            extras.append(fn(on_tpu))
-        except Exception:
-            extras.append({"metric": fn.__name__, "value": None,
-                           "error": traceback.format_exc(limit=2)
-                           .splitlines()[-1]})
-    result["extra_metrics"] = extras
+    print(json.dumps(_CONFIGS[name](on_tpu)))
+
+
+def main():
+    if len(sys.argv) == 3 and sys.argv[1] == "--config":
+        return _child(sys.argv[2])
+
+    platform, err = _probe_backend()
+    if platform is None:
+        # Relay dead: the perf number is unmeasurable, but the artifact
+        # must still parse.  Prove the code path on CPU so "skipped" is a
+        # relay statement, not a bug shield.
+        smoke = _run_config("lenet", _cpu_env(), timeout=600)
+        print(json.dumps({
+            "metric": "resnet50_train_imgs_per_sec_per_chip",
+            "value": None, "unit": "images/sec", "vs_baseline": None,
+            "skipped": True, "error": f"TPU backend unavailable: {err}",
+            "cpu_smoke": smoke, "extra_metrics": []}))
+        return 0
+
+    env = dict(os.environ) if platform == "tpu" else _cpu_env()
+    # First compile of the ResNet-50 train step is the long pole; the rest
+    # reuse a warm persistent cache at most.
+    timeouts = {"resnet50": 1800, "bert_base": 1200, "lenet": 600,
+                "lstm_lm": 900, "ssd": 1500}
+    result = _run_config("resnet50", env, timeouts["resnet50"])
+    if "unit" not in result:
+        result.setdefault("unit", "images/sec")
+        result.setdefault("vs_baseline", None)
+    result["platform"] = platform
+    result["extra_metrics"] = [
+        _run_config(name, env, timeouts[name])
+        for name in ("bert_base", "lenet", "lstm_lm", "ssd")]
     print(json.dumps(result))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -146,3 +146,45 @@ class environment:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+def train_mlp_to_params(mesh, spec_fn, steps=4, batch=16):
+    """Shared multi-chip numerics harness: train one fixed seeded MLP (with
+    BatchNorm aux state) for ``steps`` full-batch SGD steps on ``mesh`` and
+    return ({param_name: ndarray}, {aux_name: ndarray}, last_loss).
+
+    Used by tests/test_parallel.py and __graft_entry__.dryrun_multichip to
+    hold the pjit path to the reference's nightly bar — numeric equality of
+    an n-device sharded run against a 1-device run of the same global batch
+    (ref tests/nightly/dist_sync_kvstore.py:102-419)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from jax.sharding import PartitionSpec as P
+
+    import mxnet_tpu as mx
+    from .gluon import nn
+    from .parallel.trainer import ShardedTrainer
+
+    def ce(pred, y):
+        logp = jax.nn.log_softmax(pred.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+
+    mx.random.seed(11)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.BatchNorm(axis=-1),
+            nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((2, 16)))
+    tr = ShardedTrainer(net, ce, mesh=mesh, optimizer="sgd",
+                        learning_rate=0.05, momentum=0.9, spec_fn=spec_fn,
+                        batch_spec=P("dp"))
+    rs = onp.random.RandomState(5)
+    loss = None
+    for _ in range(steps):
+        x = rs.rand(batch, 16).astype("float32")
+        y = rs.randint(0, 8, size=(batch,)).astype("int32")
+        loss = tr.step(x, y)
+    params = {n: onp.asarray(v) for n, v in zip(tr.train_names, tr.pvals)}
+    aux = {n: onp.asarray(v) for n, v in zip(tr.aux_names, tr.avals)}
+    return params, aux, loss

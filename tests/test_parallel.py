@@ -134,6 +134,38 @@ def test_blockwise_attention_matches_reference():
         onp.testing.assert_allclose(onp.array(out), onp.array(ref), atol=2e-5)
 
 
+from mxnet_tpu.test_utils import train_mlp_to_params as _train_to_params
+
+
+@pytest.mark.parametrize("axes", ["dp", "dp_tp", "fsdp"])
+def test_multichip_matches_single_chip(axes):
+    """The nightly bar the reference holds its dist kvstore to
+    (tests/nightly/dist_sync_kvstore.py:102-419), on the pjit path: an
+    8-device sharded training run must produce the SAME trained parameters
+    and BatchNorm statistics as a 1-device run of the identical global
+    batch, for dp, dp×tp, and fsdp shardings."""
+    if axes == "dp":
+        mesh = make_mesh({"dp": -1})
+        spec_fn = replicated_spec_fn
+    elif axes == "dp_tp":
+        mesh = make_mesh({"dp": -1, "tp": 2})
+        spec_fn = fsdp_spec_fn("tp", min_size=64)
+    else:
+        mesh = make_mesh({"dp": -1})
+        spec_fn = fsdp_spec_fn("dp", min_size=64)
+    ref_mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    ref_p, ref_a, ref_loss = _train_to_params(ref_mesh, replicated_spec_fn)
+    got_p, got_a, got_loss = _train_to_params(mesh, spec_fn)
+    assert set(got_p) == set(ref_p) and set(got_a) == set(ref_a)
+    onp.testing.assert_allclose(got_loss, ref_loss, rtol=1e-5)
+    for n in sorted(ref_p):
+        onp.testing.assert_allclose(got_p[n], ref_p[n], rtol=1e-5,
+                                    atol=1e-5, err_msg=n)
+    for n in sorted(ref_a):
+        onp.testing.assert_allclose(got_a[n], ref_a[n], rtol=1e-5,
+                                    atol=1e-5, err_msg=n)
+
+
 def test_sharded_trainer_bf16_compute():
     """compute_dtype=bfloat16: fp32 master params, bf16 forward; must
     still converge and keep param/aux dtypes fp32 across steps."""
