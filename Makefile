@@ -15,7 +15,7 @@ SRC      := $(wildcard src/mxtpu/*.cc)
 TESTSRC  := src/mxtpu/tests/test_native.cc
 BUILD    := build
 
-.PHONY: native native-test asan tsan test ci clean
+.PHONY: native native-test asan tsan test test-slow test-all ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -47,9 +47,15 @@ tsan: $(BUILD)/test_native_tsan
 	$(BUILD)/test_native_tsan
 
 test:
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -q -m "not slow"
+
+test-slow:
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -q -m slow
+
+test-all:
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -q
 
-ci: native native-test asan tsan test
+ci: native native-test asan tsan test test-slow
 
 clean:
 	rm -rf $(BUILD)

@@ -436,11 +436,17 @@ def main():
         # must still parse.  Prove the code path on CPU so "skipped" is a
         # relay statement, not a bug shield.
         smoke = _run_config("lenet", _cpu_env(), timeout=600)
+        reason = f"TPU backend unavailable: {err}"
         print(json.dumps({
             "metric": "resnet50_train_imgs_per_sec_per_chip",
             "value": None, "unit": "images/sec", "vs_baseline": None,
-            "skipped": True, "error": f"TPU backend unavailable: {err}",
-            "cpu_smoke": smoke, "extra_metrics": []}))
+            "skipped": True, "error": reason, "cpu_smoke": smoke,
+            # every config keeps its metric identity in the artifact even
+            # when skipped — absence would read as "benchmark removed"
+            "extra_metrics": [
+                {"metric": _METRIC_NAMES[n], "value": None,
+                 "skipped": True, "error": reason}
+                for n in ("bert_base", "lenet", "lstm_lm", "ssd")]}))
         return 0
 
     env = dict(os.environ) if platform == "tpu" else _cpu_env()

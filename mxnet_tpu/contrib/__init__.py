@@ -1,5 +1,10 @@
-"""mx.contrib (ref: python/mxnet/contrib/): quantization, ONNX export."""
+"""mx.contrib (ref: python/mxnet/contrib/): quantization, ONNX export,
+DGL graph sampling."""
 from . import quantization
 from . import onnx
 from . import tensorboard
+from . import dgl
 from .quantization import quantize_net
+from .dgl import (dgl_adjacency, dgl_subgraph, dgl_graph_compact,
+                  dgl_csr_neighbor_uniform_sample,
+                  dgl_csr_neighbor_non_uniform_sample)

@@ -1,0 +1,258 @@
+"""DGL graph-sampling op family (ref src/operator/contrib/dgl_graph.cc).
+
+Host-side eager ops by design: their outputs are data-dependent CSR
+structures (sampled neighborhoods, compacted subgraphs) that cannot have
+static shapes, so — like the reference, which runs them on CPU threads —
+they run on host numpy against ``CSRNDArray`` storage and only the dense
+tensors they feed (embeddings, messages) go to the TPU.
+
+Contract notes (matching dgl_graph.cc):
+- neighbor sampling returns, per seed array: a padded vertex array of
+  length ``max_num_vertices + 1`` whose LAST element is the true count;
+  a sampled-edge CSR whose row i belongs to the i-th SORTED sampled
+  vertex, whose columns are ORIGINAL vertex ids and whose data are the
+  original edge ids; (non-uniform only) the per-sampled-vertex
+  probability; and the BFS layer per sampled vertex.
+- dgl_subgraph induces a subgraph on given vertices with edges renumbered
+  0..E-1 in CSR order (dgl_graph.cc GetSubgraph ``sub_eids[i] = i``; the
+  reference's docstring example showing 1-based ids is stale vs its code),
+  plus the original-eid matrix when return_mapping.
+- dgl_graph_compact drops the padding rows/cols of a sampled CSR,
+  renumbering vertices by their position in the sampled-vertex array.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ndarray.sparse import CSRNDArray
+
+__all__ = ["dgl_adjacency", "dgl_subgraph", "dgl_graph_compact",
+           "dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample"]
+
+
+def _csr_parts(csr: CSRNDArray):
+    data = onp.asarray(csr.data._data)
+    indices = onp.asarray(csr.indices._data).astype(onp.int64)
+    indptr = onp.asarray(csr.indptr._data).astype(onp.int64)
+    return data, indices, indptr, csr.shape
+
+
+def _make_csr(data, indices, indptr, shape) -> CSRNDArray:
+    import jax.numpy as jnp
+
+    return CSRNDArray(NDArray(jnp.asarray(data)),
+                      NDArray(jnp.asarray(indices)),
+                      NDArray(jnp.asarray(indptr)), shape)
+
+
+def dgl_adjacency(csr: CSRNDArray) -> CSRNDArray:
+    """Edge-id CSR -> adjacency CSR with float32 ones
+    (ref _contrib_dgl_adjacency)."""
+    data, indices, indptr, shape = _csr_parts(csr)
+    return _make_csr(onp.ones(len(data), onp.float32), indices, indptr,
+                     shape)
+
+
+def dgl_subgraph(graph: CSRNDArray, *vertex_sets, return_mapping=False):
+    """Induced subgraph per vertex set (ref _contrib_dgl_subgraph): new
+    edge ids are 0..E-1 in output CSR order; with return_mapping a second
+    CSR carries the ORIGINAL edge ids."""
+    data, indices, indptr, _ = _csr_parts(graph)
+    outs: List[CSRNDArray] = []
+    maps: List[CSRNDArray] = []
+    for vs in vertex_sets:
+        v = onp.asarray(vs._data if isinstance(vs, NDArray) else vs,
+                        onp.int64)
+        pos = {int(x): i for i, x in enumerate(v)}
+        new_indptr = onp.zeros(len(v) + 1, onp.int64)
+        new_cols: List[int] = []
+        orig_eids: List[int] = []
+        for r, vid in enumerate(v):
+            for j in range(indptr[vid], indptr[vid + 1]):
+                c = int(indices[j])
+                if c in pos:
+                    new_cols.append(pos[c])
+                    orig_eids.append(int(data[j]))
+            new_indptr[r + 1] = len(new_cols)
+        new_eids = onp.arange(len(new_cols), dtype=onp.int64)
+        shape = (len(v), len(v))
+        outs.append(_make_csr(new_eids, onp.asarray(new_cols, onp.int64),
+                              new_indptr, shape))
+        if return_mapping:
+            maps.append(_make_csr(onp.asarray(orig_eids, onp.int64),
+                                  onp.asarray(new_cols, onp.int64),
+                                  new_indptr, shape))
+    if return_mapping:
+        return outs + maps
+    return outs if len(outs) > 1 else outs[0]
+
+
+def _sample_one(data, indices, indptr, seeds, num_hops, num_neighbor,
+                max_num_vertices, prob, rs):
+    """BFS-sample around ``seeds``; returns (padded vertex ids, csr parts,
+    per-vertex prob or None, layers)."""
+    if len(seeds) > max_num_vertices:
+        raise MXNetError("max_num_vertices smaller than the seed set")
+    layer_of = {}
+    queue: List[int] = []
+    for s in seeds:
+        s = int(s)
+        if s not in layer_of:
+            layer_of[s] = 0
+            queue.append(s)
+    sampled: dict = {}          # vertex -> (cols, eids)
+    idx = 0
+    truncated = False
+    while idx < len(queue):
+        v = queue[idx]
+        idx += 1
+        if layer_of[v] >= num_hops:
+            continue
+        lo, hi = int(indptr[v]), int(indptr[v + 1])
+        deg = hi - lo
+        if deg == 0:
+            continue
+        take = min(num_neighbor, deg)
+        if prob is None:
+            sel = (onp.arange(lo, hi) if take == deg
+                   else lo + rs.choice(deg, size=take, replace=False))
+        else:
+            # zero-probability neighbors are unsampleable (the reference's
+            # weighted heap never draws weight-0 entries); cap the draw at
+            # the nonzero support instead of crashing rs.choice
+            p = prob[indices[lo:hi]].astype(onp.float64)
+            support = int((p > 0).sum())
+            take = min(take, support)
+            if take == 0:
+                continue
+            sel = lo + rs.choice(deg, size=take, replace=False, p=p / p.sum())
+        sel.sort()
+        sampled[v] = (indices[sel].copy(), data[sel].copy())
+        for c in indices[sel]:
+            c = int(c)
+            if c not in layer_of:
+                if len(layer_of) >= max_num_vertices:
+                    truncated = True
+                    continue
+                layer_of[c] = layer_of[v] + 1
+                queue.append(c)
+    if truncated:
+        import warnings
+
+        warnings.warn("dgl neighbor sampling truncated at max_num_vertices")
+    verts = onp.sort(onp.fromiter(layer_of, onp.int64, len(layer_of)))
+    n = len(verts)
+    out_v = onp.zeros(max_num_vertices + 1, onp.int64)
+    out_v[:n] = verts
+    out_v[max_num_vertices] = n
+    layers = onp.zeros(max_num_vertices, onp.int64)
+    layers[:n] = [layer_of[int(v)] for v in verts]
+    sub_indptr = onp.zeros(max_num_vertices + 1, onp.int64)
+    cols: List[int] = []
+    eids: List[int] = []
+    for i, v in enumerate(verts):
+        cs, es = sampled.get(int(v), (onp.empty(0, onp.int64),) * 2)
+        cols.extend(int(c) for c in cs)
+        eids.extend(int(e) for e in es)
+        sub_indptr[i + 1] = len(cols)
+    sub_indptr[n + 1:] = sub_indptr[n]
+    probs = None
+    if prob is not None:
+        probs = onp.zeros(max_num_vertices, onp.float32)
+        probs[:n] = prob[verts]
+    return out_v, (onp.asarray(eids, onp.int64),
+                   onp.asarray(cols, onp.int64), sub_indptr), probs, layers
+
+
+def _neighbor_sample(csr, seeds_list, num_hops, num_neighbor,
+                     max_num_vertices, prob=None):
+    from ..random import next_key
+
+    data, indices, indptr, shape = _csr_parts(csr)
+    pr = None if prob is None else onp.asarray(
+        prob._data if isinstance(prob, NDArray) else prob, onp.float32)
+    import jax.random as _jr
+
+    rs = onp.random.RandomState(
+        int(_jr.randint(next_key(), (), 0, 2 ** 31 - 1)))
+    v_out, csr_out, p_out, l_out = [], [], [], []
+    for seeds in seeds_list:
+        sv = onp.asarray(seeds._data if isinstance(seeds, NDArray)
+                         else seeds, onp.int64).ravel()
+        out_v, (eids, cols, sp), probs, layers = _sample_one(
+            data, indices, indptr, sv, num_hops, num_neighbor,
+            max_num_vertices, pr, rs)
+        v_out.append(NDArray(out_v))
+        csr_out.append(_make_csr(eids, cols, sp,
+                                 (max_num_vertices, shape[1])))
+        p_out.append(None if probs is None else NDArray(probs))
+        l_out.append(NDArray(layers))
+    if prob is None:
+        return v_out + csr_out + l_out
+    return v_out + csr_out + p_out + l_out
+
+
+def dgl_csr_neighbor_uniform_sample(csr, *seed_arrays, num_args=None,
+                                    num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100):
+    """(ref _contrib_dgl_csr_neighbor_uniform_sample) — outputs
+    [vertices...] + [sampled csr...] + [layers...]."""
+    return _neighbor_sample(csr, seed_arrays, num_hops, num_neighbor,
+                            max_num_vertices)
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, prob, *seed_arrays,
+                                        num_args=None, num_hops=1,
+                                        num_neighbor=2,
+                                        max_num_vertices=100):
+    """(ref _contrib_dgl_csr_neighbor_non_uniform_sample) — outputs
+    [vertices...] + [sampled csr...] + [probs...] + [layers...]."""
+    return _neighbor_sample(csr, seed_arrays, num_hops, num_neighbor,
+                            max_num_vertices, prob=prob)
+
+
+def dgl_graph_compact(*args, graph_sizes=None, return_mapping=False):
+    """Compact sampled CSRs (ref _contrib_dgl_graph_compact): args are N
+    sampled graphs followed by their N sampled-vertex arrays;
+    ``graph_sizes`` gives the true vertex count per graph. Rows/cols are
+    renumbered by position in the vertex array; padding rows/cols drop."""
+    n = len(args) // 2
+    graphs, vsets = args[:n], args[n:]
+    if graph_sizes is None:
+        raise MXNetError("graph_sizes is required")
+    sizes = ([int(graph_sizes)] if onp.isscalar(graph_sizes)
+             else [int(s) for s in graph_sizes])
+    outs, maps = [], []
+    for g, vs, size in zip(graphs, vsets, sizes):
+        data, indices, indptr, _ = _csr_parts(g)
+        v = onp.asarray(vs._data if isinstance(vs, NDArray) else vs,
+                        onp.int64).ravel()[:size]
+        pos = {int(x): i for i, x in enumerate(v)}
+        new_indptr = onp.zeros(size + 1, onp.int64)
+        cols: List[int] = []
+        orig: List[int] = []
+        for r in range(size):
+            for j in range(indptr[r], indptr[r + 1]):
+                c = int(indices[j])
+                if c in pos:
+                    cols.append(pos[c])
+                    orig.append(int(data[j]))
+            new_indptr[r + 1] = len(cols)
+        shape = (size, size)
+        # ref CompactSubgraph: data becomes sequential new edge ids
+        # (sub_eids[i] = i); the mapping matrix carries the originals
+        outs.append(_make_csr(onp.arange(len(cols), dtype=onp.int64),
+                              onp.asarray(cols, onp.int64), new_indptr,
+                              shape))
+        if return_mapping:
+            maps.append(_make_csr(onp.asarray(orig, onp.int64),
+                                  onp.asarray(cols, onp.int64), new_indptr,
+                                  shape))
+    if return_mapping:
+        return outs + maps
+    return outs if len(outs) > 1 else outs[0]

@@ -96,31 +96,9 @@ def optimal_threshold_kl(arr: _onp.ndarray, num_bins: int = 2048,
     if amax == 0.0:
         return 1e-8
     hist, edges = _onp.histogram(a, bins=num_bins, range=(0, amax))
-    best_kl, best_t = _onp.inf, amax
-    # scan thresholds from num_quantized_bins..num_bins
-    for i in range(num_quantized_bins, num_bins + 1, 8):
-        t = edges[i] if i < len(edges) else amax
-        sliced = hist[:i].astype(_onp.float64)
-        if sliced.size == 0 or sliced.sum() == 0:
-            continue
-        # p: clipped distribution — outlier mass folded into the edge bin
-        p = sliced.copy()
-        p[-1] += hist[i:].sum()
-        # q: int8-quantized version of the UN-inflated slice; clipping is
-        # penalized because p's inflated edge bin has no counterpart in q
-        factor = sliced.size / num_quantized_bins
-        q = _onp.zeros_like(sliced)
-        for j in range(num_quantized_bins):
-            start = int(j * factor)
-            stop = max(int((j + 1) * factor), start + 1)
-            chunk = sliced[start:stop]
-            nz = (chunk > 0).sum()
-            if nz:
-                q[start:stop] = _onp.where(chunk > 0, chunk.sum() / nz, 0)
-        kl = _kl_divergence(p, q)
-        if kl < best_kl:
-            best_kl, best_t = kl, float(t)
-    return best_t
+    # one KL scan implementation: delegate to the histogram form
+    _, t = calibrate_entropy(hist, edges, num_quantized_bins)
+    return float(t)
 
 
 class CalibrationCollector:
@@ -450,3 +428,202 @@ def quantize_symbol(sym, excluded_sym_names=(), excluded_op_names=(),
                      attrs, new_inputs, None, 1)
 
     return sym.rewrite(pass_fn), skipped
+
+
+# -- op-level quantized kernel family ----------------------------------------
+#
+# The reference exposes these as user-callable ops with explicit min/max
+# range tensors (src/operator/quantization/quantized_conv.cc,
+# quantized_fully_connected.cc, quantized_pooling.cc, ...): int8 payloads
+# travel WITH their float calibration ranges, every op returns
+# (out, min_out, max_out). On TPU the int8xint8->int32 contractions hit the
+# MXU via preferred_element_type; range arithmetic follows
+# quantization_utils.h QuantizationRangeForMultiplication (all_sign int8:
+# one quantized level = MaxAbs(range)/127; int32 output range =
+# level_a * level_b * 2147483647).
+
+_INT32_RANGE = 2147483647.0
+
+
+def _level(mn, mx, bits=_INT8_RANGE):
+    """Float value of one quantized level for a symmetric range."""
+    return jnp.maximum(jnp.abs(jnp.float32(mn)), jnp.abs(jnp.float32(mx))) \
+        / bits
+
+
+def quantized_conv(data, weight, bias=None, min_data=None, max_data=None,
+                   min_weight=None, max_weight=None, min_bias=None,
+                   max_bias=None, kernel=None, stride=(1, 1), pad=(0, 0),
+                   dilate=(1, 1), num_filter=0, num_group=1):
+    """int8 conv with int32 accumulation (ref quantized_conv.cc).
+
+    data (N,C,H,W) int8, weight (O,C/g,kh,kw) int8, optional int8 bias;
+    min/max_* are the float calibration ranges. Returns
+    (out int32, min_out, max_out)."""
+    from ..ops.nn import _tuple as _t
+
+    acc = jax.lax.conv_general_dilated(
+        data, weight, window_strides=_t(stride, 2),
+        padding=[(p, p) for p in _t(pad, 2)], rhs_dilation=_t(dilate, 2),
+        feature_group_count=num_group, preferred_element_type=jnp.int32)
+    out_level = _level(min_data, max_data) * _level(min_weight, max_weight)
+    if bias is not None:
+        bias_level = _level(min_bias, max_bias)
+        scaled = jnp.round(bias.astype(jnp.float32) *
+                           (bias_level / out_level)).astype(jnp.int32)
+        acc = acc + scaled.reshape(1, -1, 1, 1)
+    max_out = out_level * _INT32_RANGE
+    return acc, -max_out, max_out
+
+
+def quantized_fully_connected(data, weight, bias=None, min_data=None,
+                              max_data=None, min_weight=None, max_weight=None,
+                              min_bias=None, max_bias=None, num_hidden=0,
+                              flatten=True):
+    """int8 FC with int32 accumulation (ref quantized_fully_connected.cc)."""
+    flat = data.reshape(data.shape[0], -1) if flatten else data
+    acc = jax.lax.dot_general(
+        flat, weight.T, (((flat.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out_level = _level(min_data, max_data) * _level(min_weight, max_weight)
+    if bias is not None:
+        bias_level = _level(min_bias, max_bias)
+        acc = acc + jnp.round(bias.astype(jnp.float32) *
+                              (bias_level / out_level)).astype(jnp.int32)
+    max_out = out_level * _INT32_RANGE
+    return acc, -max_out, max_out
+
+
+def quantized_pooling(data, min_data, max_data, kernel=1, pool_type="max",
+                      stride=None, pad=0, global_pool=False,
+                      pooling_convention="valid"):
+    """int8 pooling, ranges pass through (ref quantized_pooling.cc: int8 in,
+    int8 out, same thresholds)."""
+    from ..ops.nn import pooling as _pooling
+
+    if pool_type == "max":
+        out = _pooling(data, kernel=kernel, pool_type="max", stride=stride,
+                       pad=pad, global_pool=global_pool,
+                       pooling_convention=pooling_convention)
+    else:
+        f = _pooling(data.astype(jnp.float32), kernel=kernel,
+                     pool_type=pool_type, stride=stride, pad=pad,
+                     global_pool=global_pool,
+                     pooling_convention=pooling_convention)
+        out = jnp.clip(jnp.round(f), -128, 127).astype(jnp.int8)
+    return out, jnp.float32(min_data), jnp.float32(max_data)
+
+
+def quantized_act(data, min_data, max_data, act_type="relu"):
+    """int8 activation (ref quantized_act.cc; relu only — zero point is 0
+    for symmetric int8 so relu is a max with 0 in the integer domain)."""
+    if act_type != "relu":
+        raise MXNetError("only act_type='relu' has int8 semantics")
+    return (jnp.maximum(data, 0), jnp.float32(min_data),
+            jnp.float32(max_data))
+
+
+def quantized_flatten(data, min_data, max_data):
+    """(ref quantized_flatten.cc) — reshape, ranges unchanged."""
+    return (data.reshape(data.shape[0], -1), jnp.float32(min_data),
+            jnp.float32(max_data))
+
+
+def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """int8 + int8 -> int32 (ref quantized_elemwise_add.cc): both operands
+    rescaled onto the common output grid whose range is the sum of the
+    operand ranges."""
+    la, lb = _level(lhs_min, lhs_max), _level(rhs_min, rhs_max)
+    max_out = jnp.maximum(jnp.abs(jnp.float32(lhs_min)),
+                          jnp.abs(jnp.float32(lhs_max))) + \
+        jnp.maximum(jnp.abs(jnp.float32(rhs_min)),
+                    jnp.abs(jnp.float32(rhs_max)))
+    out_level = max_out / _INT32_RANGE
+    acc = jnp.round(lhs.astype(jnp.float32) * (la / out_level) +
+                    rhs.astype(jnp.float32) * (lb / out_level))
+    return acc.astype(jnp.int32), -max_out, max_out
+
+
+def quantized_elemwise_mul(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """int8 * int8 -> int32 (ref quantized_elemwise_mul.cc)."""
+    acc = lhs.astype(jnp.int32) * rhs.astype(jnp.int32)
+    out_level = _level(lhs_min, lhs_max) * _level(rhs_min, rhs_max)
+    max_out = out_level * _INT32_RANGE
+    return acc, -max_out, max_out
+
+
+def quantized_concat(*args, dim=1):
+    """Concat n int8 inputs (ref quantized_concat.cc): args are
+    (d0..dn-1, min0, max0, ..., minn-1, maxn-1); every input is rescaled
+    onto the widest input's grid so one int8 code means one float value
+    across the output."""
+    n = len(args) // 3
+    data, mins, maxs = args[:n], args[n::2], args[n + 1::2]
+    levels = [_level(mn, mx) for mn, mx in zip(mins, maxs)]
+    out_level = levels[0]
+    for lv in levels[1:]:
+        out_level = jnp.maximum(out_level, lv)
+    scaled = [jnp.clip(jnp.round(d.astype(jnp.float32) * (lv / out_level)),
+                       -127, 127).astype(jnp.int8)
+              for d, lv in zip(data, levels)]
+    max_out = out_level * _INT8_RANGE
+    return jnp.concatenate(scaled, axis=dim), -max_out, max_out
+
+
+def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         min_data, max_data, min_calib_range, max_calib_range,
+                         eps=1e-3):
+    """int8 BatchNorm (ref quantized_batch_norm.cc): BN folded to one
+    per-channel affine in the dequantized domain, re-quantized onto the
+    calibrated output range. int8 in -> int8 out."""
+    in_level = _level(min_data, max_data)
+    out_amax = jnp.maximum(jnp.abs(jnp.float32(min_calib_range)),
+                           jnp.abs(jnp.float32(max_calib_range)))
+    inv_std = 1.0 / jnp.sqrt(moving_var.astype(jnp.float32) + eps)
+    a = gamma.astype(jnp.float32) * inv_std                  # scale
+    b = beta.astype(jnp.float32) - a * moving_mean.astype(jnp.float32)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    f = data.astype(jnp.float32) * in_level * a.reshape(shape) \
+        + b.reshape(shape)
+    q = jnp.clip(jnp.round(f * (_INT8_RANGE / out_amax)),
+                 -127, 127).astype(jnp.int8)
+    return q, -out_amax, out_amax
+
+
+def quantized_embedding(data, weight, min_weight, max_weight,
+                        input_dim=0, output_dim=0):
+    """int8 embedding lookup (ref quantized_embedding.cc): a gather over
+    the int8 table; ranges pass through."""
+    out = jnp.take(weight, data.astype(jnp.int32), axis=0)
+    return out, jnp.float32(min_weight), jnp.float32(max_weight)
+
+
+def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """KL-optimal threshold from an |activation| histogram (ref
+    calibrate.cc _contrib_calibrate_entropy): scans candidate clips over
+    the given bins, returns (min_threshold, max_threshold). Same search as
+    optimal_threshold_kl but over a precomputed histogram."""
+    h = _onp.asarray(hist, dtype=_onp.float64)
+    edges = _onp.asarray(hist_edges, dtype=_onp.float64)
+    amax = float(_onp.max(_onp.abs(edges))) or 1e-8
+    best_kl, best_t = _onp.inf, amax
+    for i in range(num_quantized_bins, len(h) + 1, 8):
+        t = edges[i] if i < len(edges) else amax
+        sliced = h[:i]
+        if sliced.size == 0 or sliced.sum() == 0:
+            continue
+        p = sliced.copy()
+        p[-1] += h[i:].sum()
+        factor = sliced.size / num_quantized_bins
+        q = _onp.zeros_like(sliced)
+        for j in range(num_quantized_bins):
+            start = int(j * factor)
+            stop = max(int((j + 1) * factor), start + 1)
+            chunk = sliced[start:stop]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[start:stop] = _onp.where(chunk > 0, chunk.sum() / nz, 0)
+        kl = _kl_divergence(p, q)
+        if kl < best_kl:
+            best_kl, best_t = kl, float(t)
+    return _onp.float32(-best_t), _onp.float32(best_t)

@@ -38,7 +38,8 @@ __all__ = [
     "sldwin_atten_mask_like", "sldwin_atten_context", "box_encode",
     "box_decode", "bipartite_matching", "quadratic", "index_copy",
     "index_array", "edge_id", "getnnz", "batch_norm_with_relu",
-    "dynamic_reshape", "col2im", "hawkesll", "rroi_align",
+    "dynamic_reshape", "col2im", "hawkesll", "rroi_align", "roi_pooling",
+    "upsampling", "khatri_rao", "sample_unique_zipfian",
     "gamma", "gammaln", "erf", "erfinv", "digamma",
     "reshape_like", "slice_like", "broadcast_like", "shape_array", "batch_dot",
     "arange_like", "gather_nd", "scatter_nd", "index_update", "index_add",
@@ -593,6 +594,86 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
             num_deformable_group=num_deformable_group)
 
     return call(f, args, {}, name="deformable_convolution", out=out)
+
+
+def roi_pooling(data, rois, pooled_size, spatial_scale=1.0, out=None):
+    """Max-pool ROI pooling (ref src/operator/roi_pooling.cc ROIPooling —
+    not ROIAlign: rounded bounds, hard max bins)."""
+    from ..ops import spatial as _sp
+
+    ps = (pooled_size if isinstance(pooled_size, (tuple, list))
+          else (pooled_size, pooled_size))
+    return call(lambda d, r: _sp.roi_pooling(
+        d, r, tuple(ps), spatial_scale=spatial_scale), (data, rois), {},
+        name="roi_pooling", out=out)
+
+
+def upsampling(*data, scale, sample_type="nearest", num_filter=0,
+               multi_input_mode="concat", num_args=1, out=None):
+    """UpSampling (ref src/operator/nn/upsampling.cc): nearest repeat or
+    bilinear-deconvolution."""
+    from ..ops import spatial as _sp
+
+    return call(lambda *ds: _sp.upsampling(
+        *ds, scale=int(scale), sample_type=sample_type,
+        num_filter=num_filter, multi_input_mode=multi_input_mode,
+        num_args=num_args), data, {}, name="upsampling", out=out)
+
+
+def khatri_rao(*args, out=None):
+    """Column-wise Khatri-Rao product (ref src/operator/contrib/krprod.cc
+    khatri_rao): inputs (M_i, N) -> (prod M_i, N), column k is the
+    Kronecker product of the k-th columns. One einsum per factor — XLA
+    fuses the chain."""
+    import jax.numpy as _jnp
+
+    def f(*ms):
+        acc = ms[0]
+        for m in ms[1:]:
+            acc = _jnp.einsum("ik,jk->ijk", acc, m).reshape(
+                acc.shape[0] * m.shape[0], acc.shape[1])
+        return acc
+
+    return call(f, args, {}, name="khatri_rao", out=out)
+
+
+def sample_unique_zipfian(range_max, shape=None, out=None):
+    """Sample WITHOUT replacement from an approximate Zipfian (log-uniform)
+    distribution over [0, range_max) (ref src/operator/random/
+    unique_sample_op.cc _sample_unique_zipfian; the sampled-softmax
+    helper). Returns (samples int64 (batch, n), num_tries int64 (batch,)).
+    Host-side eager op — rejection counts are data-dependent."""
+    import numpy as _onp
+    from ..ndarray import NDArray as _ND
+    from ..random import next_key
+
+    if shape is None:
+        raise MXNetError("sample_unique_zipfian requires shape=(batch, n)")
+    batch, n = (shape if isinstance(shape, (tuple, list)) else (1, shape))
+    if n > range_max:
+        raise MXNetError(
+            f"cannot draw {n} unique values from range_max={range_max}")
+    # fold the global generator state into a host seed (stateful draw)
+    import jax.random as _jr
+
+    rs = _onp.random.RandomState(
+        int(_jr.randint(next_key(), (), 0, 2 ** 31 - 1)))
+    log_range = _onp.log(range_max + 1)
+    samples = _onp.zeros((batch, n), _onp.int64)
+    tries = _onp.zeros((batch,), _onp.int64)
+    for b in range(batch):
+        seen = set()
+        cnt = 0
+        while len(seen) < n:
+            v = int(_onp.exp(rs.rand() * log_range)) - 1
+            cnt += 1
+            if 0 <= v < range_max and v not in seen:
+                seen.add(v)
+        samples[b] = _onp.fromiter(seen, _onp.int64, len(seen))
+        tries[b] = cnt
+    import jax.numpy as _jnp
+
+    return _ND(_jnp.asarray(samples)), _ND(_jnp.asarray(tries))
 
 
 def count_sketch(data, h, s, out_dim, out=None):

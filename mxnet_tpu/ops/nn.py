@@ -197,7 +197,15 @@ def pooling(x, kernel=1, pool_type: str = "max", stride=None, pad=0,
         sp = tuple((p, p) for p in pads)
     padding = ((0, 0),) + sp + ((0, 0),) if last else ((0, 0), (0, 0)) + sp
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        # float init stays the -inf PYTHON literal: jax pattern-matches it
+        # into reduce_window_max (the primitive with a vjp rule) — a jnp
+        # array init would fall back to generic reduce_window and kill
+        # autodiff. int pooling (the quantized int8 path) needs the init
+        # as a numpy scalar of the exact dtype or it weak-types to int32.
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            init = -jnp.inf
+        else:
+            init = x.dtype.type(jnp.iinfo(x.dtype).min)
         return lax.reduce_window(x, init, lax.max, window, strides_f, padding)
     if pool_type in ("avg", "sum"):
         s = lax.reduce_window(x, 0.0, lax.add, window, strides_f, padding)

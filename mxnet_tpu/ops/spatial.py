@@ -209,6 +209,99 @@ def adaptive_avg_pool3d(x, output_size):
     return jnp.stack(out, axis=-3)
 
 
+def roi_pooling(data, rois, pooled_size, spatial_scale=1.0):
+    """Max-pooled ROI pooling (ref src/operator/roi_pooling.cc ROIPooling
+    — a DIFFERENT op from ROIAlign: integer-rounded roi bounds, floor/ceil
+    bin partitioning, hard max per bin, empty bins and invalid batch
+    indices produce 0).
+
+    data: (N, C, H, W); rois: (R, 5) rows [batch_idx, x1, y1, x2, y2] in
+    image coords (scaled by spatial_scale, then rounded). Returns
+    (R, C, PH, PW). The bin max is a masked reduction over the full
+    feature map — one fused gather-free XLA computation per ROI (vmap),
+    trading FLOPs for static shapes the TPU can tile."""
+    ph_, pw_ = _tuple(pooled_size, 2)
+    n, c, h, w = data.shape
+    neg = jnp.asarray(-jnp.inf, data.dtype)
+
+    def pool_one(roi):
+        batch = roi[0].astype(jnp.int32)
+        sw = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        sh = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        ew = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        eh = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        # bin index arithmetic stays fp32 regardless of data dtype — in
+        # bf16 the floor/ceil products misplace boundaries on large ROIs
+        rh = jnp.maximum(eh - sh + 1, 1).astype(jnp.float32)
+        rw = jnp.maximum(ew - sw + 1, 1).astype(jnp.float32)
+        ph = jnp.arange(ph_, dtype=jnp.float32)
+        pw = jnp.arange(pw_, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(ph * rh / ph_).astype(jnp.int32) + sh,
+                          0, h)
+        hend = jnp.clip(jnp.ceil((ph + 1) * rh / ph_).astype(jnp.int32) + sh,
+                        0, h)
+        wstart = jnp.clip(jnp.floor(pw * rw / pw_).astype(jnp.int32) + sw,
+                          0, w)
+        wend = jnp.clip(jnp.ceil((pw + 1) * rw / pw_).astype(jnp.int32) + sw,
+                        0, w)
+        hh = jnp.arange(h)
+        ww = jnp.arange(w)
+        mh = (hh[None] >= hstart[:, None]) & (hh[None] < hend[:, None])
+        mw = (ww[None] >= wstart[:, None]) & (ww[None] < wend[:, None])
+        mask = mh[:, None, :, None] & mw[None, :, None, :]  # (PH, PW, H, W)
+        img = data[jnp.clip(batch, 0, n - 1)]               # (C, H, W)
+        val = jnp.where(mask[None], img[:, None, None], neg).max((-2, -1))
+        empty = (hend <= hstart)[:, None] | (wend <= wstart)[None, :]
+        bad = (batch < 0) | (batch >= n)
+        return jnp.where(empty[None] | bad, jnp.zeros((), data.dtype), val)
+
+    return jax.vmap(pool_one)(rois)
+
+
+def upsampling(*data, scale: int, sample_type: str = "nearest",
+               num_filter: int = 0, multi_input_mode: str = "concat",
+               num_args: int = 1):
+    """UpSampling (ref src/operator/nn/upsampling.cc). nearest: integer
+    nearest-neighbor repeat; every input is upsampled to scale x the FIRST
+    input's spatial shape, then concatenated on channels (or summed).
+    bilinear: exactly the reference's lowering — a transposed convolution
+    with kernel 2*scale - scale%2, stride scale, pad ceil((scale-1)/2) and
+    num_group == num_filter (upsampling-inl.h GetDeconvolutionParam); the
+    (weight) second input is trainable."""
+    import math
+
+    if sample_type == "nearest":
+        h0, w0 = data[0].shape[2], data[0].shape[3]
+        th, tw = h0 * scale, w0 * scale
+        outs = []
+        for d in data:
+            s = th // d.shape[2]
+            if d.shape[2] * s != th or d.shape[3] * s != tw:
+                raise MXNetError(
+                    f"input {d.shape} cannot be integer-upsampled to "
+                    f"({th}, {tw})")
+            outs.append(jnp.repeat(jnp.repeat(d, s, axis=2), s, axis=3))
+        if multi_input_mode == "sum":
+            out = outs[0]
+            for o in outs[1:]:
+                out = out + o
+            return out
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+    if sample_type == "bilinear":
+        if len(data) != 2:
+            raise MXNetError("bilinear UpSampling takes (data, weight)")
+        from .nn import deconvolution
+
+        x, weight = data
+        kernel = 2 * scale - scale % 2
+        pad = int(math.ceil((scale - 1) / 2.0))
+        nf = num_filter or x.shape[1]
+        return deconvolution(x, weight, None, kernel=(kernel, kernel),
+                             stride=(scale, scale), pad=(pad, pad),
+                             num_filter=nf, num_group=nf, no_bias=True)
+    raise MXNetError(f"unknown sample_type {sample_type!r}")
+
+
 def rroi_align(data, rois, pooled_size, spatial_scale=1.0,
                sampling_ratio=-1, _grid_sizes=None):
     """Rotated ROI align (ref src/operator/contrib/rroi_align.cc

@@ -112,6 +112,7 @@ def test_bert_padding_invariance(tiny_bert):
                         onp.asarray(s2._data)[:, :6], atol=1e-5)
 
 
+@pytest.mark.slow
 def test_bert_pretrain_loss_decreases(tiny_bert):
     """End-to-end MLM+NSP training on random data overfits a tiny batch."""
     from mxnet_tpu.parallel.mesh import make_mesh

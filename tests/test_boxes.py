@@ -129,6 +129,7 @@ def tiny_ssd():
     return net
 
 
+@pytest.mark.slow
 def test_ssd_forward_and_train_step(tiny_ssd):
     from mxnet_tpu.gluon.model_zoo.ssd import training_targets, detections
     from mxnet_tpu import autograd
@@ -166,6 +167,7 @@ def test_ssd_forward_and_train_step(tiny_ssd):
     assert dets.shape == (2, A, 6)
 
 
+@pytest.mark.slow
 def test_ssd_resnet50_constructs():
     net = mx.gluon.model_zoo.get_model("ssd_512_resnet50_v1", classes=20)
     net.initialize(mx.init.Xavier())

@@ -105,6 +105,7 @@ def test_bad_layout_raises():
         c(mx.np.zeros((1, 2, 8, 8)))
 
 
+@pytest.mark.slow
 def test_resnet18_nhwc_matches_nchw():
     mx.random.seed(1)
     n1 = mx.gluon.model_zoo.get_model("resnet18_v1", classes=10)

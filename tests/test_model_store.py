@@ -147,6 +147,7 @@ def test_purge(tmp_path):
     model_store.purge(root=str(tmp_path / "absent"))  # no-op, no raise
 
 
+@pytest.mark.slow
 def test_pretrained_zoo_model(local_repo):
     repo, cache = local_repo
     ref = mx.gluon.model_zoo.get_model("squeezenet1.0", classes=4)
@@ -161,6 +162,7 @@ def test_pretrained_zoo_model(local_repo):
     model_store.register_model("squeezenet1.0", None)
 
 
+@pytest.mark.slow
 def test_pretrained_resnet(local_repo):
     repo, cache = local_repo
     ref = mx.gluon.model_zoo.get_model("resnet18_v1", classes=3)

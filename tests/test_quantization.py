@@ -152,3 +152,162 @@ def test_quantize_net_none_mode_dynamic_ranges(float_net):
     qnet.hybridize()
     out2 = qnet(x).asnumpy()
     assert onp.allclose(out, out2, atol=1e-5)
+
+
+class TestQuantizedOpFamily:
+    """Op-level quantized_* ops with explicit min/max ranges (ref
+    src/operator/quantization/quantized_conv.cc,
+    quantized_fully_connected.cc, quantized_pooling.cc, ...): int8
+    payloads travel with float calibration ranges, outputs are
+    (out, min_out, max_out)."""
+
+    @staticmethod
+    def _q(x, amax):
+        return onp.clip(onp.round(x * 127.0 / amax), -127, 127).astype("int8")
+
+    def test_quantized_fully_connected(self):
+        from mxnet_tpu.contrib.quantization import quantized_fully_connected
+
+        rs = onp.random.RandomState(0)
+        x = rs.uniform(-2, 2, (4, 8)).astype("float32")
+        w = rs.uniform(-1, 1, (5, 8)).astype("float32")
+        xq, wq = self._q(x, 2.0), self._q(w, 1.0)
+        import jax.numpy as jnp
+
+        out, mn, mx_ = quantized_fully_connected(
+            jnp.asarray(xq), jnp.asarray(wq), min_data=-2.0, max_data=2.0,
+            min_weight=-1.0, max_weight=1.0, num_hidden=5)
+        assert out.dtype == jnp.int32
+        # dequantized int32 result tracks the float matmul to quant error
+        level = (2.0 / 127) * (1.0 / 127)
+        back = onp.asarray(out, "float32") * level
+        ref = x @ w.T
+        assert onp.abs(back - ref).max() < 8 * (2.0 / 127 + 1.0 / 127)
+        assert float(mx_) == pytest.approx(level * 2147483647.0)
+        assert float(mn) == -float(mx_)
+
+    def test_quantized_conv_with_bias(self):
+        from mxnet_tpu.contrib.quantization import quantized_conv
+
+        import jax.numpy as jnp
+
+        rs = onp.random.RandomState(1)
+        x = rs.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+        w = rs.uniform(-1, 1, (4, 3, 3, 3)).astype("float32")
+        b = rs.uniform(-1, 1, (4,)).astype("float32")
+        out, mn, mx_ = quantized_conv(
+            jnp.asarray(self._q(x, 1.0)), jnp.asarray(self._q(w, 1.0)),
+            jnp.asarray(self._q(b, 1.0)), min_data=-1.0, max_data=1.0,
+            min_weight=-1.0, max_weight=1.0, min_bias=-1.0, max_bias=1.0,
+            kernel=(3, 3), num_filter=4)
+        assert out.shape == (2, 4, 6, 6) and out.dtype == jnp.int32
+        level = (1.0 / 127) ** 2
+        back = onp.asarray(out, "float32") * level
+        import jax
+
+        ref = onp.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)]))
+        ref = ref + b.reshape(1, -1, 1, 1)
+        assert onp.abs(back - ref).max() < 27 * 3 * (1.0 / 127)
+
+    def test_quantized_pooling_passthrough_ranges(self):
+        from mxnet_tpu.contrib.quantization import quantized_pooling
+
+        import jax.numpy as jnp
+
+        x = onp.arange(-8, 8, dtype="int8").reshape(1, 1, 4, 4)
+        out, mn, mx_ = quantized_pooling(jnp.asarray(x), -0.5, 0.5,
+                                         kernel=(2, 2), stride=(2, 2))
+        ref = onp.array([[[[-3, -1], [5, 7]]]], "int8")
+        onp.testing.assert_array_equal(onp.asarray(out), ref)
+        assert (float(mn), float(mx_)) == (-0.5, 0.5)
+        # avg pooling stays int8
+        out2, _, _ = quantized_pooling(jnp.asarray(x), -0.5, 0.5,
+                                       kernel=(2, 2), stride=(2, 2),
+                                       pool_type="avg")
+        assert out2.dtype == jnp.int8
+
+    def test_quantized_elemwise_and_act_and_flatten(self):
+        from mxnet_tpu.contrib.quantization import (
+            quantized_act, quantized_elemwise_add, quantized_elemwise_mul,
+            quantized_flatten)
+
+        import jax.numpy as jnp
+
+        rs = onp.random.RandomState(2)
+        a = rs.uniform(-1, 1, (3, 4)).astype("float32")
+        b = rs.uniform(-2, 2, (3, 4)).astype("float32")
+        qa, qb = jnp.asarray(self._q(a, 1.0)), jnp.asarray(self._q(b, 2.0))
+        out, mn, mx_ = quantized_elemwise_add(qa, qb, -1.0, 1.0, -2.0, 2.0)
+        back = onp.asarray(out, "float32") * (float(mx_) / 2147483647.0)
+        assert onp.abs(back - (a + b)).max() < 3 * (3.0 / 127)
+        assert float(mx_) == pytest.approx(3.0)
+
+        out, mn, mx_ = quantized_elemwise_mul(qa, qb, -1.0, 1.0, -2.0, 2.0)
+        back = onp.asarray(out, "float32") * ((1.0 / 127) * (2.0 / 127))
+        assert onp.abs(back - a * b).max() < 4 * (2.0 / 127)
+
+        r, mn, mx_ = quantized_act(qa, -1.0, 1.0)
+        assert (onp.asarray(r) >= 0).all() and float(mx_) == 1.0
+        f, _, _ = quantized_flatten(jnp.asarray(self._q(
+            rs.uniform(-1, 1, (2, 3, 4)).astype("float32"), 1.0)), -1, 1)
+        assert f.shape == (2, 12)
+
+    def test_quantized_concat_rescales_to_common_grid(self):
+        from mxnet_tpu.contrib.quantization import quantized_concat
+
+        import jax.numpy as jnp
+
+        a = onp.array([[1.0, -0.5]], "float32")
+        b = onp.array([[3.0, -4.0]], "float32")
+        out, mn, mx_ = quantized_concat(
+            jnp.asarray(self._q(a, 1.0)), jnp.asarray(self._q(b, 4.0)),
+            -1.0, 1.0, -4.0, 4.0)
+        assert float(mx_) == pytest.approx(4.0)
+        back = onp.asarray(out, "float32") * (4.0 / 127)
+        onp.testing.assert_allclose(back, onp.concatenate([a, b], 1),
+                                    atol=4.0 / 127)
+
+    def test_quantized_batch_norm(self):
+        from mxnet_tpu.contrib.quantization import quantized_batch_norm
+
+        import jax.numpy as jnp
+
+        rs = onp.random.RandomState(3)
+        x = rs.uniform(-1, 1, (2, 3, 4, 4)).astype("float32")
+        gamma = onp.array([1.0, 2.0, 0.5], "float32")
+        beta = onp.array([0.1, -0.2, 0.0], "float32")
+        mean = onp.array([0.1, -0.1, 0.0], "float32")
+        var = onp.array([1.0, 0.5, 2.0], "float32")
+        out, mn, mx_ = quantized_batch_norm(
+            jnp.asarray(self._q(x, 1.0)), jnp.asarray(gamma),
+            jnp.asarray(beta), jnp.asarray(mean), jnp.asarray(var),
+            -1.0, 1.0, -3.0, 3.0, eps=1e-3)
+        assert out.dtype == jnp.int8
+        ref = (x - mean.reshape(1, -1, 1, 1)) / onp.sqrt(
+            var.reshape(1, -1, 1, 1) + 1e-3) * gamma.reshape(1, -1, 1, 1) \
+            + beta.reshape(1, -1, 1, 1)
+        back = onp.asarray(out, "float32") * (3.0 / 127)
+        assert onp.abs(back - ref).max() < 3 * (3.0 / 127) + 2 * (1.0 / 127)
+
+    def test_quantized_embedding_and_calibrate_entropy(self):
+        from mxnet_tpu.contrib.quantization import (calibrate_entropy,
+                                                    quantized_embedding)
+
+        import jax.numpy as jnp
+
+        rs = onp.random.RandomState(4)
+        table = rs.uniform(-1, 1, (10, 4)).astype("float32")
+        tq = self._q(table, 1.0)
+        idx = onp.array([1, 3, 7], "int32")
+        out, mn, mx_ = quantized_embedding(jnp.asarray(idx),
+                                           jnp.asarray(tq), -1.0, 1.0)
+        onp.testing.assert_array_equal(onp.asarray(out), tq[idx])
+
+        # entropy calibration: a gaussian histogram with a far outlier bin
+        # should clip below the outlier
+        samples = onp.abs(rs.randn(20000)).astype("float32")
+        samples[0] = 40.0
+        hist, edges = onp.histogram(samples, bins=512, range=(0, 40.0))
+        mn_t, mx_t = calibrate_entropy(hist, edges)
+        assert 0 < mx_t < 40.0 and mn_t == -mx_t

@@ -64,6 +64,7 @@ def test_imageiter_over_im2rec_output(rec_prefix):
     assert labels == {0.0, 1.0}
 
 
+@pytest.mark.slow
 def test_finetune_pretrained_on_real_images(rec_prefix, tmp_path,
                                             monkeypatch):
     """Publish base weights to a local file:// repo, load them via

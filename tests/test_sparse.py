@@ -148,3 +148,125 @@ def test_row_sparse_unsorted_indices_sorted_on_construction():
     assert onp.allclose(kept[3], [3., 3.]) and onp.allclose(kept[1], 0)
     with pytest.raises(MXNetError, match="unique"):
         mxs.row_sparse_array((data, [2, 2]), shape=(4, 2))
+
+
+class TestDGLGraphOps:
+    """DGL graph-sampling op family (ref src/operator/contrib/dgl_graph.cc
+    _contrib_dgl_*): host-side eager CSR ops by design."""
+
+    @staticmethod
+    def _k5():
+        # the reference docstring's K5 example graph: 5 vertices, complete,
+        # edge ids 1..20
+        data = onp.arange(1, 21, dtype=onp.int64)
+        indices = onp.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                             0, 1, 2, 4, 0, 1, 2, 3], onp.int64)
+        indptr = onp.array([0, 4, 8, 12, 16, 20], onp.int64)
+        return mxs.csr_matrix((data, indices, indptr), shape=(5, 5),
+                              dtype=onp.int64)
+
+    def test_dgl_adjacency(self):
+        from mxnet_tpu.contrib import dgl_adjacency
+
+        adj = dgl_adjacency(self._k5())
+        d = adj.todense().asnumpy()
+        assert d.dtype == onp.float32
+        ref = onp.ones((5, 5), "float32") - onp.eye(5, dtype="float32")
+        onp.testing.assert_array_equal(d, ref)
+
+    def test_uniform_sample_contract(self):
+        from mxnet_tpu.contrib import dgl_csr_neighbor_uniform_sample
+
+        g = self._k5()
+        seed = mx.np.array(onp.array([0, 1, 2, 3, 4], onp.int64))
+        verts, sub, layers = dgl_csr_neighbor_uniform_sample(
+            g, seed, num_args=2, num_hops=1, num_neighbor=2,
+            max_num_vertices=5)
+        v = verts.asnumpy()
+        assert v.shape == (6,)
+        assert v[-1] == 5                       # actual vertex count
+        onp.testing.assert_array_equal(onp.sort(v[:5]), onp.arange(5))
+        d = sub.todense().asnumpy()
+        assert d.shape == (5, 5)
+        # every row sampled exactly num_neighbor=2 edges, with the
+        # original edge ids as data
+        full = self._k5().todense().asnumpy()
+        for r in range(5):
+            nz = onp.nonzero(d[r])[0]
+            assert len(nz) == 2
+            onp.testing.assert_array_equal(d[r, nz], full[r, nz])
+        assert (layers.asnumpy()[:5] == 0).all()  # all are seeds
+
+    def test_uniform_sample_expands_frontier(self):
+        from mxnet_tpu.contrib import dgl_csr_neighbor_uniform_sample
+
+        g = self._k5()
+        seed = mx.np.array(onp.array([0], onp.int64))
+        verts, sub, layers = dgl_csr_neighbor_uniform_sample(
+            g, seed, num_args=2, num_hops=2, num_neighbor=2,
+            max_num_vertices=5)
+        v = verts.asnumpy()
+        n = int(v[-1])
+        assert n >= 3                     # seed + 2 sampled + their hops
+        lay = layers.asnumpy()[:n]
+        assert lay[list(v[:n]).index(0)] == 0
+        assert set(lay) <= {0, 1, 2}
+
+    def test_non_uniform_sample_prob_output(self):
+        from mxnet_tpu.contrib import dgl_csr_neighbor_non_uniform_sample
+
+        g = self._k5()
+        prob = mx.np.array(onp.array([0.9, 0.8, 0.2, 0.4, 0.1], "float32"))
+        seed = mx.np.array(onp.array([0, 1, 2, 3, 4], onp.int64))
+        verts, sub, probs, layers = dgl_csr_neighbor_non_uniform_sample(
+            g, prob, seed, num_args=3, num_hops=1, num_neighbor=2,
+            max_num_vertices=5)
+        v = verts.asnumpy()
+        assert v[-1] == 5
+        onp.testing.assert_allclose(
+            probs.asnumpy(), onp.array([0.9, 0.8, 0.2, 0.4, 0.1], "float32"))
+
+    def test_subgraph_and_mapping(self):
+        from mxnet_tpu.contrib import dgl_subgraph
+
+        # the reference docstring example graph
+        x = onp.array([[1, 0, 0, 2],
+                       [3, 0, 4, 0],
+                       [0, 5, 0, 0],
+                       [0, 6, 7, 0]], onp.int64)
+        g = mxs.csr_matrix(x, dtype=onp.int64)
+        sub, mapping = dgl_subgraph(g, mx.np.array(
+            onp.array([0, 1, 2], onp.int64)), return_mapping=True)
+        # original edges among {0,1,2}: (0,0)=1, (1,0)=3, (1,2)=4, (2,1)=5
+        onp.testing.assert_array_equal(
+            mapping.todense().asnumpy(),
+            onp.array([[1, 0, 0], [3, 0, 4], [0, 5, 0]], onp.int64))
+        # new ids are sequential 0..E-1 in CSR order (GetSubgraph
+        # sub_eids[i]=i); id 0 is invisible in the dense view
+        onp.testing.assert_array_equal(
+            sub.todense().asnumpy(),
+            onp.array([[0, 0, 0], [1, 0, 2], [0, 3, 0]], onp.int64))
+
+    def test_graph_compact(self):
+        from mxnet_tpu.contrib import (dgl_csr_neighbor_uniform_sample,
+                                       dgl_graph_compact)
+
+        g = self._k5()
+        seed = mx.np.array(onp.array([0, 1, 2], onp.int64))
+        verts, sub, layers = dgl_csr_neighbor_uniform_sample(
+            g, seed, num_args=2, num_hops=1, num_neighbor=2,
+            max_num_vertices=6)
+        n = int(verts.asnumpy()[-1])
+        compact, mapping = dgl_graph_compact(
+            sub, verts, graph_sizes=(n,), return_mapping=True)
+        assert compact.shape == (n, n)
+        cd = compact.todense().asnumpy()
+        md = mapping.todense().asnumpy()
+        # compacted graph has the same structure; data renumbered 0..E-1,
+        # mapping carries original edge ids at the same positions
+        assert (cd != 0).sum() <= (md != 0).sum()
+        full = self._k5().todense().asnumpy()
+        v = verts.asnumpy()[:n]
+        for r in range(n):
+            for c in onp.nonzero(md[r])[0]:
+                assert md[r, c] == full[v[r], v[c]]

@@ -70,6 +70,7 @@ class TestDeformableConvolution:
         assert out.shape == (1, 2, 4, 4)
         assert onp.isfinite(out.asnumpy()).all()
 
+    @pytest.mark.slow
     def test_gradients(self):
         x = mx.np.array(_rand(1, 2, 5, 5, seed=9))
         w = mx.np.array(_rand(2, 2, 3, 3, seed=10) - 0.5)
@@ -269,3 +270,126 @@ class TestDynamicShapeRecipes:
         vals, cnt = jax.jit(f)(onp.array([5, 5, 7, 7], "float32"))
         assert vals.shape == (4,)
         assert int(cnt) == 2
+
+
+class TestROIPooling:
+    """Real ROIPooling (ref src/operator/roi_pooling.cc) — NOT roi_align:
+    rounded roi bounds, floor/ceil integer bins, hard max."""
+
+    @staticmethod
+    def _np_roi_pool(data, rois, ph_, pw_, scale):
+        import math
+
+        n, c, h, w = data.shape
+        out = onp.zeros((len(rois), c, ph_, pw_), "float32")
+        for r, roi in enumerate(rois):
+            b = int(roi[0])
+            if b < 0 or b >= n:
+                continue
+            sw = int(round(roi[1] * scale))
+            sh = int(round(roi[2] * scale))
+            ew = int(round(roi[3] * scale))
+            eh = int(round(roi[4] * scale))
+            rh = max(eh - sh + 1, 1)
+            rw = max(ew - sw + 1, 1)
+            for ph in range(ph_):
+                for pw in range(pw_):
+                    h0 = min(max(int(math.floor(ph * rh / ph_)) + sh, 0), h)
+                    h1 = min(max(int(math.ceil((ph + 1) * rh / ph_)) + sh,
+                                 0), h)
+                    w0 = min(max(int(math.floor(pw * rw / pw_)) + sw, 0), w)
+                    w1 = min(max(int(math.ceil((pw + 1) * rw / pw_)) + sw,
+                                 0), w)
+                    if h1 <= h0 or w1 <= w0:
+                        continue
+                    out[r, :, ph, pw] = data[b, :, h0:h1, w0:w1].max((1, 2))
+        return out
+
+    def test_matches_numpy_reference(self):
+        data = _rand(2, 3, 12, 10, seed=7) - 0.5  # negatives exercise max
+        rois = onp.array([[0, 0, 0, 7, 7],
+                          [1, 2, 3, 9, 11],
+                          [0, 4, 4, 4, 4],       # degenerate 1x1 roi
+                          [1, 1.4, 2.6, 8.4, 6.6]], "float32")
+        for scale in (1.0, 0.5):
+            got = mx.npx.roi_pooling(mx.np.array(data), mx.np.array(rois),
+                                     pooled_size=(3, 3),
+                                     spatial_scale=scale).asnumpy()
+            ref = self._np_roi_pool(data, rois, 3, 3, scale)
+            onp.testing.assert_allclose(got, ref, rtol=1e-6, err_msg=scale)
+
+    def test_invalid_batch_index_gives_zero(self):
+        data = _rand(1, 2, 6, 6, seed=3)
+        rois = onp.array([[5, 0, 0, 3, 3]], "float32")  # batch 5 invalid
+        out = mx.npx.roi_pooling(mx.np.array(data), mx.np.array(rois),
+                                 pooled_size=(2, 2)).asnumpy()
+        assert (out == 0).all()
+
+    def test_gradient_flows_to_argmax(self):
+        import jax
+        import jax.numpy as jnp
+        from mxnet_tpu.ops.spatial import roi_pooling
+
+        data = jnp.asarray(_rand(1, 1, 6, 6, seed=9))
+        rois = jnp.asarray(onp.array([[0, 0, 0, 5, 5]], "float32"))
+        g = jax.grad(lambda d: roi_pooling(d, rois, (2, 2)).sum())(data)
+        # each of the 4 bins contributes gradient 1 at its argmax
+        assert float(g.sum()) == 4.0
+        assert int((onp.asarray(g) != 0).sum()) == 4
+
+
+class TestUpSampling:
+    def test_nearest_single(self):
+        x = _rand(2, 3, 4, 5, seed=11)
+        out = mx.npx.upsampling(mx.np.array(x), scale=2,
+                                sample_type="nearest").asnumpy()
+        ref = x.repeat(2, axis=2).repeat(2, axis=3)
+        onp.testing.assert_array_equal(out, ref)
+
+    def test_nearest_multi_concat_and_sum(self):
+        # second input at half resolution is upsampled 2x as far (ref
+        # upsampling.cc multi-input contract: everything reaches
+        # scale * shape(first))
+        a = _rand(1, 2, 4, 4, seed=12)
+        b = _rand(1, 3, 2, 2, seed=13)
+        out = mx.npx.upsampling(mx.np.array(a), mx.np.array(b), scale=2,
+                                sample_type="nearest",
+                                multi_input_mode="concat").asnumpy()
+        assert out.shape == (1, 5, 8, 8)
+        onp.testing.assert_array_equal(out[:, :2],
+                                       a.repeat(2, 2).repeat(2, 3))
+        onp.testing.assert_array_equal(out[:, 2:],
+                                       b.repeat(4, 2).repeat(4, 3))
+        s = mx.npx.upsampling(mx.np.array(a), mx.np.array(a), scale=2,
+                              sample_type="nearest",
+                              multi_input_mode="sum").asnumpy()
+        onp.testing.assert_allclose(s, 2 * a.repeat(2, 2).repeat(2, 3))
+
+    def test_bilinear_identity_kernel(self):
+        # scale=2 bilinear deconv with the standard bilinear kernel must
+        # reproduce input values at the even grid points
+        import math
+
+        scale, c = 2, 2
+        k = 2 * scale - scale % 2
+        f = math.ceil(k / 2.0)
+        cc = (2 * f - 1 - f % 2) / (2.0 * f)
+        wy = onp.array([1 - abs(i / f - cc) for i in range(k)])
+        kern = onp.outer(wy, wy).astype("float32")
+        w = onp.zeros((c, 1, k, k), "float32")
+        for i in range(c):
+            w[i, 0] = kern
+        # bilinear interpolation of a linear ramp is a linear ramp: the
+        # interior of the upsampled output must have constant slope 1/scale
+        x = onp.broadcast_to(onp.arange(5, dtype="float32")[:, None],
+                             (1, c, 5, 5)).copy()
+        out = mx.npx.upsampling(mx.np.array(x), mx.np.array(w), scale=scale,
+                                sample_type="bilinear", num_filter=c,
+                                num_args=1).asnumpy()
+        assert out.shape == (1, c, 10, 10)
+        interior = out[:, :, 2:-2, 2:-2]
+        dh = onp.diff(interior, axis=2)
+        onp.testing.assert_allclose(dh, onp.full_like(dh, 1.0 / scale),
+                                    rtol=1e-5)
+        dw = onp.diff(interior, axis=3)
+        onp.testing.assert_allclose(dw, onp.zeros_like(dw), atol=1e-6)
