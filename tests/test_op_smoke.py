@@ -1,0 +1,29 @@
+"""Executable op coverage: every reference-registry op must actually RUN.
+
+Round-2 verdict weak #4: OP_COVERAGE's "100%" was attested by hasattr, not
+execution. This test invokes every public reference registration on small
+concrete inputs via tools/op_smoke.py; a name that resolves but cannot
+execute is a failure, listed by name.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+@pytest.mark.slow
+def test_every_registry_op_executes():
+    import op_smoke
+
+    try:
+        results = op_smoke.run_smoke()
+    except FileNotFoundError as e:
+        pytest.skip(str(e))
+    bad = {k: v for k, v in results.items() if v is not True}
+    assert not bad, (
+        f"{len(bad)}/{len(results)} registry ops failed to execute: "
+        + "; ".join(f"{k}: {str(v)[:80]}" for k, v in sorted(bad.items())))
+    assert len(results) >= 330  # the registry denominator must not shrink
