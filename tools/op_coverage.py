@@ -186,8 +186,9 @@ def resolution_spaces():
 
 
 def covered_by(mx, name: str) -> bool:
+    spaces = resolution_spaces()
     for cand in _strip(name):
-        for sp in resolution_spaces():
+        for sp in spaces:
             if sp is not None and hasattr(sp, cand):
                 return True
     # symbolic alias table (FullyConnected etc.)

@@ -426,7 +426,7 @@ def _build_overrides(fx):
         "_npi_kron": lambda: np_.kron(S, S),
         "_npi_rot90": lambda: np_.rot90(A),
         "_npi_insert_scalar": lambda: np_.insert(V, 1, 9.0),
-        "_npi_insert_slice": lambda: np_.insert(V, 1, 9.0),
+        "_npi_insert_slice": lambda: np_.insert(V, slice(1, 2), 9.0),
         "_npi_insert_tensor": lambda: np_.insert(
             V, np_.array(onp.array([1], "int64")), np_.ones((1,))),
         "_npi_delete": lambda: np_.delete(V, 1),
@@ -575,8 +575,9 @@ def resolve_callable(name):
     op_coverage.covered_by uses (op_coverage.resolution_spaces)."""
     import op_coverage as oc
 
+    spaces = oc.resolution_spaces()
     for cand in oc._strip(name):
-        for sp in oc.resolution_spaces():
+        for sp in spaces:
             if sp is not None and hasattr(sp, cand):
                 return getattr(sp, cand)
     return None
