@@ -227,7 +227,7 @@ class _Exporter:
             self.add("Flatten", in_names, out_name, self.uid("Flatten"),
                      [_attr_int("axis", 1)])
         elif op == "reshape":
-            shape = a.get("newshape") or a.get("shape") or a.get("__arg1")
+            shape = a.get("newshape") or a.get("__newshape") or a.get("shape") or a.get("__arg1")
             if shape is None:
                 raise MXNetError(
                     f"reshape node '{node.name}' lacks a recorded shape")
@@ -239,7 +239,7 @@ class _Exporter:
             self.add("Reshape", [in_names[0], sname], out_name,
                      self.uid("Reshape"))
         elif op == "transpose":
-            axes = a.get("axes") or a.get("__arg1")
+            axes = a.get("axes") or a.get("__axes") or a.get("__arg1")
             attrs = [_attr_ints("perm", [int(x) for x in axes])] if axes \
                 else []
             self.add("Transpose", in_names, out_name,

@@ -112,12 +112,10 @@ class SSD(HybridBlock):
 
     @staticmethod
     def _flatten_pred(p, last_dim):
-        # (B, A*D, H, W) -> (B, H*W*A, D)
-        def f(x):
-            b, c, h, w = x.shape
-            return x.transpose(0, 2, 3, 1).reshape(b, h * w * (c // last_dim),
-                                                   last_dim)
-        return call(f, (p,), {}, name="flatten_pred")
+        # (B, A*D, H, W) -> (B, H*W*A, D), recorded as registry transpose +
+        # reshape nodes so exported symbol-json reloads
+        b = p.shape[0]
+        return p.transpose(0, 2, 3, 1).reshape(b, -1, last_dim)
 
 
 def training_targets(anchors, labels, cls_preds=None, iou_thresh=0.5):

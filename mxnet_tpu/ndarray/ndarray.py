@@ -376,7 +376,14 @@ class NDArray:
         nd_in = [self]
         if isinstance(key, NDArray):
             return call(lambda x, k: x[k], (self, key), {}, name="take")
-        return call(lambda x: x[ckey], (self,), {}, name="getitem")
+        try:
+            # serializable form so symbol-json traces of indexing reload
+            # (symbol.symbol registers 'getitem' decoding this)
+            attrs = {"key": encode_index_key(ckey)}
+        except TypeError:
+            attrs = None  # exotic key -> node stays a traced closure
+        return call(lambda x: x[ckey], (self,), {}, name="getitem",
+                    attrs=attrs)
 
     def __setitem__(self, key, value):
         ckey = self._clean_key(key)
@@ -414,9 +421,19 @@ class NDArray:
             a, b = (other, self) if reverse else (self, other)
             return call(jfn, (a, b), {}, name=name)
         if isinstance(other, numeric_types) or isinstance(other, _onp.ndarray) or _onp.isscalar(other):
+            # scalar operand rides as a pos_args literal so symbol-json
+            # traces of `x + 2` reload (python scalars stay weak-typed)
+            lit = (other.item() if isinstance(other, _onp.generic)
+                   else other)
+            attrs = None
+            if isinstance(lit, (bool, int, float)):
+                attrs = {"pos_args": ([lit, None] if reverse
+                                      else [None, lit])}
             if reverse:
-                return call(lambda x: jfn(other, x), (self,), {}, name=name)
-            return call(lambda x: jfn(x, other), (self,), {}, name=name)
+                return call(lambda x: jfn(other, x), (self,), {}, name=name,
+                            attrs=attrs)
+            return call(lambda x: jfn(x, other), (self,), {}, name=name,
+                        attrs=attrs)
         return NotImplemented
 
     def __add__(self, o):
@@ -528,18 +545,27 @@ class NDArray:
     def reshape(self, *shape, **kwargs):
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
-        return self._unary_method(lambda x: jnp.reshape(x, shape), "reshape",
-                                  _attrs={"newshape": list(shape)})
+        return self._unary_method(
+            lambda x: jnp.reshape(x, shape), "reshape",
+            # __newshape is read by the ONNX exporter (in-memory only —
+            # json drops "__" attrs); pos_args is the re-execution
+            # template for symbol-json reload
+            _attrs={"__newshape": list(shape),
+                    "pos_args": [None, list(shape)]})
 
     def transpose(self, *axes):
         if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
             axes = tuple(axes[0])
         ax = axes if axes else None
-        return self._unary_method(lambda x: jnp.transpose(x, ax), "transpose",
-                                  _attrs={"axes": list(ax) if ax else None})
+        return self._unary_method(
+            lambda x: jnp.transpose(x, ax), "transpose",
+            _attrs={"__axes": list(ax) if ax else None,
+                    "pos_args": ([None, list(ax)] if ax else [None])})
 
     def swapaxes(self, a1, a2):
-        return self._unary_method(lambda x: jnp.swapaxes(x, a1, a2), "swapaxes")
+        return self._unary_method(
+            lambda x: jnp.swapaxes(x, a1, a2), "swapaxes",
+            _attrs={"pos_args": [None, a1, a2]})
 
     def flatten(self):
         return self._unary_method(lambda x: jnp.reshape(x, (-1,)), "flatten")
@@ -740,7 +766,9 @@ def split(ary: NDArray, indices_or_sections, axis=0):
     from ..ops.dispatch import call
 
     return call(lambda x: tuple(jnp.split(x, indices_or_sections, axis=axis)),
-                (ary,), {}, name="split")
+                (ary,), {}, name="split",
+                attrs={"pos_args": [None, indices_or_sections],
+                       "axis": axis})
 
 
 def waitall():
@@ -750,3 +778,43 @@ def waitall():
         jax.effects_barrier()
     except Exception:
         pass
+
+
+def encode_index_key(key):
+    """JSON-able encoding of a basic-indexing key (ints, slices, Ellipsis,
+    None, tuples, int lists) — the symbol-json form of NDArray.__getitem__.
+    Raises TypeError for keys that cannot be represented."""
+    if isinstance(key, tuple):
+        return ["tuple", [encode_index_key(k) for k in key]]
+    if isinstance(key, slice):
+        return ["slice", key.start, key.stop, key.step]
+    if key is Ellipsis:
+        return ["ellipsis"]
+    if key is None:
+        return ["newaxis"]
+    if isinstance(key, bool):
+        raise TypeError("bool index")
+    if isinstance(key, (int, _onp.integer)):
+        return ["int", int(key)]
+    if isinstance(key, list) and all(
+            isinstance(k, (int, _onp.integer)) for k in key):
+        return ["list", [int(k) for k in key]]
+    raise TypeError(f"unencodable index key {type(key)}")
+
+
+def decode_index_key(enc):
+    """Inverse of encode_index_key."""
+    tag = enc[0]
+    if tag == "tuple":
+        return tuple(decode_index_key(e) for e in enc[1])
+    if tag == "slice":
+        return slice(enc[1], enc[2], enc[3])
+    if tag == "ellipsis":
+        return Ellipsis
+    if tag == "newaxis":
+        return None
+    if tag == "int":
+        return enc[1]
+    if tag == "list":
+        return list(enc[1])
+    raise TypeError(f"bad encoded key {enc!r}")

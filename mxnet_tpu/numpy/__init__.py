@@ -257,12 +257,23 @@ def shares_memory(a, b):
 
 def _seq_op(jfn, name):
     """Ops taking a *sequence* of arrays (concatenate family) — each element
-    becomes a differentiable input."""
+    becomes a differentiable input. ``seq_input`` marks the node so a
+    symbol-json reload regroups the graph inputs into one list argument
+    (Symbol._interpret)."""
 
     def op(arrays, *args, **kwargs):
         arrays = list(arrays)
         nd = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a)) for a in arrays]
-        return invoke(lambda *xs: jfn(list(xs), *args, **kwargs), nd, name=name)
+        attrs = {"seq_input": True}
+        if args or "axis" in kwargs:   # only when the CALLER passed one —
+            # vstack & co. take no axis kwarg at all
+            axis = args[0] if args else kwargs["axis"]
+            if axis is None or isinstance(axis, int):
+                # None is meaningful (concatenate axis=None flattens) —
+                # record it, or reload would replay the wrapper default
+                attrs["axis"] = axis
+        return invoke(lambda *xs: jfn(list(xs), *args, **kwargs), nd,
+                      name=name, attrs=attrs)
 
     op.__name__ = name
     return op
@@ -283,13 +294,15 @@ def expand_dims(a, axis):  # noqa: F811 — ensure method-consistent version
 
 def split(ary, indices_or_sections, axis=0):  # noqa: F811 — returns list like numpy
     res = call(lambda x: tuple(jnp.split(x, indices_or_sections, axis=axis)),
-               (ary,), {}, name="split")
+               (ary,), {}, name="split",
+               attrs={"pos_args": [None, indices_or_sections], "axis": axis})
     return list(res) if isinstance(res, tuple) else [res]
 
 
 def array_split(ary, indices_or_sections, axis=0):  # noqa: F811
     res = call(lambda x: tuple(jnp.array_split(x, indices_or_sections, axis=axis)),
-               (ary,), {}, name="array_split")
+               (ary,), {}, name="array_split",
+               attrs={"pos_args": [None, indices_or_sections], "axis": axis})
     return list(res) if isinstance(res, tuple) else [res]
 
 

@@ -59,8 +59,10 @@ from ..ndarray.utils import load, save  # noqa: E402
 # -- activations -------------------------------------------------------------
 
 def activation(data, act_type: str = "relu", **kw):
+    # op name must stay the registry name "activation" (act_type is an
+    # attr) so exported symbol-json reloads via resolve_op
     return call(lambda x: _nn.activation(x, act_type), (data,), {},
-                name=f"activation_{act_type}", attrs={"act_type": act_type})
+                name="activation", attrs={"act_type": act_type})
 
 
 def leaky_relu(data, gamma=None, act_type: str = "leaky", slope: float = 0.25,
@@ -75,7 +77,7 @@ def leaky_relu(data, gamma=None, act_type: str = "leaky", slope: float = 0.25,
                               lower_bound=lower_bound, upper_bound=upper_bound,
                               rng_key=key)
 
-    return call(f, args, {}, name=f"leaky_relu_{act_type}",
+    return call(f, args, {}, name="leaky_relu",
                 attrs={"act_type": act_type, "slope": slope})
 
 
@@ -144,7 +146,7 @@ def pooling(data, kernel=1, pool_type="max", stride=None, pad=0,
                                       count_include_pad=count_include_pad,
                                       pooling_convention=pooling_convention,
                                       layout=layout),
-                (data,), {}, name=f"pooling_{pool_type}",
+                (data,), {}, name="pooling",
                 attrs={"kernel": kernel, "pool_type": pool_type,
                        "stride": stride, "pad": pad,
                        "global_pool": global_pool,
@@ -449,7 +451,13 @@ def rnn(data, parameters, state, state_cell=None, mode="lstm",
                               use_sequence_length=use_sequence_length,
                               dropout_key=key)
 
-    res = call(f, tuple(inputs), {}, name=f"rnn_{mode}")
+    res = call(f, tuple(inputs), {}, name="rnn",
+               attrs={"mode": mode, "state_size": state_size,
+                      "num_layers": num_layers,
+                      "bidirectional": bidirectional, "p": p,
+                      "projection_size": projection_size,
+                      "use_sequence_length": use_sequence_length,
+                      "state_outputs": True})
     if not state_outputs:
         return res[0]
     return res
@@ -509,7 +517,10 @@ def multi_head_attention(query, key, value, num_heads, mask=None,
         return o.transpose(0, 2, 1, 3).reshape(b, tq, emb)
 
     return call(f, (query, key, value) + tuple(extras), {},
-                name="multi_head_attention", out=out)
+                name="multi_head_attention", out=out,
+                attrs={"num_heads": num_heads, "causal": causal,
+                       "scale": scale, "has_mask": has_mask,
+                       "has_valid_length": valid_length is not None})
 
 
 # -- control flow ------------------------------------------------------------

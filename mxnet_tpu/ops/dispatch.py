@@ -163,8 +163,18 @@ def call(fn: Callable, args: Tuple, kwargs: dict, name: str = "op", out=None,
     if is_deferred_compute():  # attrs are only read by symbol tracing;
         # building them on eager dispatch would tax the op hot path
         auto = {k: v for k, v in kwargs.items() if _jsonable(v)}
-        auto.update({f"__arg{i}": a for i, a in enumerate(args)
-                     if not isinstance(a, NDArray) and _jsonable(a)})
+        non_nd = [(i, a) for i, a in enumerate(args)
+                  if not isinstance(a, NDArray)]
+        auto.update({f"__arg{i}": a for i, a in non_nd if _jsonable(a)})
+        # a full positional template lets the node re-execute from JSON
+        # (Symbol._interpret pos_args): None slots take graph inputs in
+        # order, literals ride verbatim. Only when every non-ND positional
+        # is JSON-able and no NDArray hides in kwargs (those append to the
+        # input list in an order the template couldn't express).
+        if non_nd and all(_jsonable(a) for _, a in non_nd) and \
+                not any(isinstance(v, NDArray) for v in kwargs.values()):
+            auto["pos_args"] = [None if isinstance(a, NDArray) else a
+                                for a in args]
         if attrs:
             auto.update({k: v for k, v in attrs.items() if _jsonable(v)})
         attrs = auto

@@ -294,8 +294,9 @@ class _FusedOptAdapter(_OptAdapter):
             stack = lambda vs: jnp.stack(vs, axis=0)  # noqa: E731
             ws = stack([pvals[i] for i in idxs])
             gs = stack([grads[i].astype(pvals[i].dtype) for i in idxs])
-            leaf_stacks = [stack([self._flatten(states[i])[k] for i in idxs])
-                           for k in range(len(self._flatten(states[i0])))]
+            flat = [self._flatten(states[i]) for i in idxs]
+            leaf_stacks = [stack([fl[k] for fl in flat])
+                           for k in range(len(flat[0]))]
 
             def one(w, g, *ls):
                 st = self._rebuild(self._tree[i0], iter(ls))

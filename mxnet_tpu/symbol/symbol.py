@@ -86,6 +86,78 @@ def register_op(name: str, fn: Callable) -> None:
     _OP_REGISTRY[name] = fn
 
 
+def _const_op(value=None, dtype=None):
+    """Captured-constant node rebuilt from its serialized value (tojson
+    embeds constants <= 64k elements so exported json reloads). With no
+    recorded dtype the value keeps numpy's natural type (ints stay
+    integral — a float32 default would silently promote index/mask
+    arithmetic after a round-trip)."""
+    from ..ndarray import NDArray
+
+    return NDArray(jnp.asarray(value) if dtype is None
+                   else jnp.asarray(value, dtype))
+
+
+register_op("_const", _const_op)
+
+
+def _getitem_op(data, key=None):
+    """NDArray.__getitem__ rebuilt from its serialized index key."""
+    from ..ndarray.ndarray import decode_index_key
+
+    return data[decode_index_key(key)]
+
+
+register_op("getitem", _getitem_op)
+
+
+def _mha_reload(*args, num_heads=None, causal=False, scale=None,
+                has_mask=False, has_valid_length=False, **_ignored):
+    """Reload shim for fused multi-head attention: the traced node's
+    inputs are (q, k, v[, mask][, valid_length]); attrs say which extras
+    are present so they route to the right keyword."""
+    from ..numpy_extension import multi_head_attention
+
+    q, k, v = args[:3]
+    rest = list(args[3:])
+    mask = rest.pop(0) if has_mask else None
+    vl = rest.pop(0) if has_valid_length else None
+    return multi_head_attention(q, k, v, num_heads, mask=mask,
+                                valid_length=vl, causal=causal, scale=scale)
+
+
+register_op("multi_head_attention", _mha_reload)
+
+
+def _rnn_reload(*args, mode="lstm", use_sequence_length=False,
+                state_outputs=True, **kw):
+    """Reload shim for the fused rnn node: inputs are
+    (data, parameters, state[, state_cell][, sequence_length]) — route the
+    optional tail by mode/use_sequence_length instead of positionally."""
+    from ..numpy_extension import rnn
+
+    data, parameters, state = args[:3]
+    rest = list(args[3:])
+    state_cell = rest.pop(0) if mode == "lstm" else None
+    seq = rest.pop(0) if use_sequence_length else None
+    return rnn(data=data, parameters=parameters, state=state,
+               state_cell=state_cell, mode=mode,
+               sequence_length=seq, use_sequence_length=use_sequence_length,
+               state_outputs=state_outputs, **kw)
+
+
+register_op("rnn", _rnn_reload)
+
+# ops whose reload is only possible when specific attrs survived
+# serialization — tojson falls back to __traced__ when they are missing
+# (e.g. an unencodable getitem key, a non-JSON-able split section array)
+_REQUIRED_RELOAD_ATTRS = {
+    "getitem": ("key",),
+    "split": ("pos_args",),
+    "array_split": ("pos_args",),
+}
+
+
 def _load_namespaces() -> None:
     global _NAMESPACES_LOADED
     if _NAMESPACES_LOADED:
@@ -270,9 +342,19 @@ class Symbol:
                           if not k.startswith("__")}
                     kw.pop("num_outputs", None)  # graph metadata
                     pos_template = kw.pop("pos_args", None)
-                    if pos_template is not None:
+                    if kw.pop("seq_input", None):
+                        # concatenate-family: all graph inputs regroup
+                        # into the single sequence argument
+                        res = f(ins, **kw)
+                    elif pos_template is not None:
                         # *args-style op: None slots take Symbol inputs in
-                        # order, literals ride along verbatim
+                        # order, literals ride along verbatim; leftover
+                        # attrs pass only if the op's signature takes them
+                        # (duplicate config may ride in both forms)
+                        allowed = _kw_filter(f)
+                        if allowed is not None:
+                            kw = {k: v for k, v in kw.items()
+                                  if k in allowed}
                         it = iter(ins)
                         call_args = [next(it) if slot is None else slot
                                      for slot in pos_template]
@@ -421,7 +503,33 @@ class Symbol:
             attrs = {k: (v if isinstance(v, str) else json.dumps(v))
                      for k, v in n.attrs.items() if not k.startswith("__")}
             if n.fn is not None and not n.is_var():
-                attrs["__traced__"] = "true"
+                # a traced node is re-executable from JSON when its op
+                # resolves in the registry (attrs carry the config —
+                # dispatch.call records kwargs + a pos_args template).
+                # Captured constants serialize by value. Only closures
+                # over non-registry code keep the __traced__ marker, the
+                # reference contract being that exported json always
+                # reloads (ref python/mxnet/gluon/block.py:1716).
+                if n.op == "_const" and "value" not in attrs:
+                    val = n.fn()
+                    if getattr(val, "size", 1 << 62) <= (1 << 16):
+                        import numpy as _onp
+
+                        v = _onp.asarray(val)
+                        attrs["value"] = json.dumps(v.tolist())
+                        attrs["dtype"] = str(v.dtype)
+                    else:
+                        attrs["__traced__"] = "true"
+                elif any(req not in n.attrs
+                         for req in _REQUIRED_RELOAD_ATTRS.get(n.op, ())):
+                    attrs["__traced__"] = "true"
+                else:
+                    try:
+                        resolve_op(n.op)
+                    except MXNetError:
+                        attrs["__traced__"] = "true"
+            if n.n_out > 1 and "num_outputs" not in attrs:
+                attrs["num_outputs"] = json.dumps(n.n_out)
             if attrs:
                 entry["attrs"] = attrs
             nodes.append(entry)

@@ -1,0 +1,163 @@
+"""Symbol JSON round-trip across the model zoo (round-2 verdict missing #3).
+
+The reference contract: `export` -> {path}-symbol.json always reloads
+(ref python/mxnet/gluon/block.py:1514,1716). Here every zoo family's
+forward must record registry-resolvable ops so the traced Symbol survives
+tojson -> fromjson (NO StableHLO, no Python closures) and evaluates to the
+same outputs.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.symbol.symbol import fromjson
+
+
+def _roundtrip_check(net, *inputs, atol=1e-5):
+    out = net(*inputs)
+    outs = out if isinstance(out, tuple) else (out,)
+    sym = net.symbolize(*inputs)
+    sym2 = fromjson(sym.tojson())      # reload purely from JSON
+    bindings = {}
+    for i, v in enumerate(inputs):
+        bindings["data" if i == 0 else f"data{i}"] = v
+    for k, p in net.collect_params().items():
+        if p._data is not None:
+            bindings[k] = p.data()
+    got = sym2._interpret(bindings)
+    assert len(got) == len(outs)
+    for g, o in zip(got, outs):
+        onp.testing.assert_allclose(g.asnumpy(), o.asnumpy(), atol=atol,
+                                    rtol=1e-4)
+
+
+@pytest.mark.parametrize("name,shape", [
+    ("lenet", (1, 1, 28, 28)),
+    ("resnet18_v1", (1, 3, 32, 32)),
+    ("resnet18_v2", (1, 3, 32, 32)),
+    ("vgg11", (1, 3, 32, 32)),
+    ("alexnet", (1, 3, 224, 224)),
+    ("densenet121", (1, 3, 32, 32)),
+    ("squeezenet1.0", (1, 3, 224, 224)),
+    ("inceptionv3", (1, 3, 299, 299)),
+    ("mobilenet0.25", (1, 3, 32, 32)),
+    ("mobilenetv2_0.25", (1, 3, 32, 32)),
+])
+def test_zoo_json_roundtrip(name, shape):
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.get_model(name, classes=10)
+    net.initialize(mx.init.Xavier())
+    x = mx.np.array(onp.random.RandomState(0).rand(*shape).astype("float32"))
+    net(x)
+    _roundtrip_check(net, x)
+
+
+@pytest.mark.slow
+def test_ssd_json_roundtrip():
+    from mxnet_tpu.gluon.model_zoo.ssd import SSD
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    backbone = nn.HybridSequential()
+    backbone.add(nn.Conv2D(8, 3, strides=2, padding=1, activation="relu"),
+                 nn.Conv2D(16, 3, strides=2, padding=1, activation="relu"))
+    net = SSD([backbone], num_classes=3,
+              sizes=[[0.2, 0.272]] * 4, ratios=[[1, 2, 0.5]] * 4)
+    net.initialize(mx.init.Xavier())
+    x = mx.np.array(onp.random.RandomState(1).rand(1, 3, 64, 64)
+                    .astype("float32"))
+    net(x)
+    _roundtrip_check(net, x)
+
+
+def test_bert_json_roundtrip():
+    from mxnet_tpu.gluon.model_zoo.bert import get_bert
+
+    mx.random.seed(0)
+    net = get_bert("bert_12_768_12", vocab_size=100, max_length=32,
+                   num_layers=2, units=32, hidden_size=64, num_heads=2)
+    net.initialize(mx.init.Xavier())
+    rs = onp.random.RandomState(2)
+    tokens = mx.np.array(rs.randint(0, 100, size=(2, 16)).astype("int32"))
+    segs = mx.np.array(onp.zeros((2, 16), "int32"))
+    vlen = mx.np.array(onp.full((2,), 16, "int32"))
+    net(tokens, segs, vlen)
+    _roundtrip_check(net, tokens, segs, vlen)
+
+
+class TestRoundtripEdgeCases:
+    """Regressions for reload hazards found in review: every case either
+    round-trips exactly or refuses at export (stays __traced__) — never
+    silently computes different numbers."""
+
+    @staticmethod
+    def _rt(fn, *inputs):
+        from mxnet_tpu.symbol import trace
+
+        out = fn(*inputs)
+        sym = trace(fn, list(inputs))
+        sym2 = fromjson(sym.tojson())
+        bindings = {("data" if i == 0 else f"data{i}"): v
+                    for i, v in enumerate(inputs)}
+        got = sym2._interpret(bindings)[0]
+        return got, out
+
+    def test_rnn_sequence_length_roundtrip(self):
+        rs = onp.random.RandomState(0)
+        x = mx.np.array(rs.rand(5, 2, 3).astype("float32"))
+        params = mx.np.array(rs.rand(144).astype("float32") * 0.1)
+        h0 = mx.np.zeros((1, 2, 4))
+        c0 = mx.np.zeros((1, 2, 4))
+        sl = mx.np.array(onp.array([3, 5], "float32"))
+
+        def fn(xx, pp, hh, cc, ss):
+            return mx.npx.rnn(data=xx, parameters=pp, state=hh,
+                              state_cell=cc, mode="lstm", state_size=4,
+                              num_layers=1, sequence_length=ss,
+                              use_sequence_length=True)[0]
+
+        got, out = self._rt(fn, x, params, h0, c0, sl)
+        onp.testing.assert_allclose(got.asnumpy(), out.asnumpy(), atol=1e-6)
+
+    def test_concatenate_axis_none_roundtrip(self):
+        a = mx.np.array(onp.arange(4, dtype="float32").reshape(2, 2))
+        b = mx.np.array(onp.arange(4, 8, dtype="float32").reshape(2, 2))
+        got, out = self._rt(lambda x, y: mx.np.concatenate([x, y],
+                                                           axis=None), a, b)
+        assert got.shape == out.shape == (8,)
+        onp.testing.assert_array_equal(got.asnumpy(), out.asnumpy())
+
+    def test_int_const_keeps_dtype(self):
+        a = mx.np.array(onp.array([1, 2, 3], "int32"))
+        got, out = self._rt(lambda x: x + 2, a)
+        assert out.dtype == onp.int32
+        assert got.dtype == onp.int32
+        onp.testing.assert_array_equal(got.asnumpy(), out.asnumpy())
+
+    def test_unencodable_getitem_refuses_not_corrupts(self):
+        from mxnet_tpu.base import MXNetError
+        from mxnet_tpu.symbol import trace
+
+        a = mx.np.array(onp.arange(12, dtype="float32").reshape(3, 4))
+        idx = onp.array([0, 2])
+
+        def fn(x):
+            return x[idx, :]   # tuple containing an array: unencodable
+
+        sym = trace(fn, [a])
+        with pytest.raises(MXNetError, match="traced closure"):
+            fromjson(sym.tojson())
+
+    def test_split_array_sections_refuses_not_crashes(self):
+        from mxnet_tpu.base import MXNetError
+        from mxnet_tpu.symbol import trace
+
+        a = mx.np.array(onp.arange(6, dtype="float32"))
+        sections = onp.array([2, 4])
+
+        def fn(x):
+            return mx.np.split(x, sections)[0]
+
+        sym = trace(fn, [a])
+        with pytest.raises(MXNetError, match="traced closure"):
+            fromjson(sym.tojson())
