@@ -112,10 +112,15 @@ class SSD(HybridBlock):
 
     @staticmethod
     def _flatten_pred(p, last_dim):
-        # (B, A*D, H, W) -> (B, H*W*A, D), recorded as registry transpose +
-        # reshape nodes so exported symbol-json reloads
-        b = p.shape[0]
-        return p.transpose(0, 2, 3, 1).reshape(b, -1, last_dim)
+        # (B, A*D, H, W) -> (B, H*W*A, D). Recorded as the registered
+        # 'flatten_pred' op (symbol.symbol._flatten_pred_op) so a json
+        # reload re-executes batch-polymorphically — an inline reshape
+        # would bake the traced batch size into the graph.
+        from ...symbol.symbol import _flatten_pred_op
+
+        return call(lambda x: _flatten_pred_op(NDArray(x), last_dim)._data,
+                    (p,), {}, name="flatten_pred",
+                    attrs={"last_dim": last_dim})
 
 
 def training_targets(anchors, labels, cls_preds=None, iou_thresh=0.5):

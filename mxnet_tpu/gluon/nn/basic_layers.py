@@ -152,8 +152,15 @@ class Embedding(HybridBlock):
         super().__init__(**kwargs)
         self._input_dim = input_dim
         self._output_dim = output_dim
-        self.weight = Parameter(shape=(input_dim, output_dim), dtype=dtype,
-                                init=weight_initializer, name="weight")
+        # sparse_grad: gradients surface as RowSparseNDArray (only touched
+        # rows), feeding the optimizers' lazy row-wise kernels and kvstore
+        # row_sparse_pull — ref basic_layers.py Embedding(sparse_grad) /
+        # kvstore_dist.h:518. See ndarray/sparse.py for the TPU divergence
+        # notes (the backward itself is a dense XLA scatter).
+        self.weight = Parameter(
+            shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer, name="weight",
+            grad_stype="row_sparse" if sparse_grad else "default")
 
     def forward(self, x):
         return npx.embedding(x, self.weight.data(), input_dim=self._input_dim,

@@ -179,10 +179,34 @@ class Parameter:
             raise MXNetError(
                 f"Cannot get gradient array for Parameter '{self.name}' "
                 "because grad_req='null'")
+        if self._grad_stype == "row_sparse":
+            # the backward ran as a dense XLA scatter; surface it sparse
+            # (rows with any nonzero entry) so lazy optimizers and kvstore
+            # row_sparse_pull see the reference's row_sparse gradient —
+            # divergence notes in ndarray/sparse.py. Cached per backward
+            # (the grad buffer rebinds on every backward, so identity of
+            # the raw array keys the cache — the conversion syncs to host).
+            from ..ndarray.sparse import _dense_to_row_sparse
+
+            cache = getattr(self, "_rsp_grad_cache", None)
+            if cache is not None and cache[0] is d._grad._data:
+                return cache[1]
+            rsp = _dense_to_row_sparse(d._grad._data)
+            self._rsp_grad_cache = (d._grad._data, rsp)
+            return rsp
         return d._grad
 
     def list_grad(self) -> List[NDArray]:
-        return [self.grad()]
+        # ALWAYS the dense underlying buffers: this feeds cross-replica /
+        # cross-process reduction (Trainer.allreduce_grads -> kvstore
+        # pushpull, which is dense — see ndarray/sparse.py notes) and the
+        # reduction must land in the real buffer BEFORE grad() sparsifies.
+        d = self.data()
+        if d._grad is None:
+            raise MXNetError(
+                f"Cannot get gradient array for Parameter '{self.name}' "
+                "because grad_req='null'")
+        return [d._grad]
 
     def list_ctx(self):
         return [self._ctx or current_context()]

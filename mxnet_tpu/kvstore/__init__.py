@@ -147,6 +147,38 @@ class KVStore(KVStoreBase):
             for oo in _as_list(o):
                 oo._set_data(jax.device_put(self._store[k]._data, oo.ctx.jax_device()))
 
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows of a row_sparse value (ref
+        kvstore_dist.h:518 PullRowSparse / python kvstore.py
+        row_sparse_pull): ``out`` becomes a RowSparseNDArray holding
+        exactly ``row_ids`` (sorted unique), gathered from the stored
+        dense or row_sparse value."""
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        if isinstance(key, (list, tuple)):
+            outs = out if out is not None else [None] * len(keys)
+            rids = row_ids
+        else:
+            outs, rids = [out], [row_ids]
+        results = []
+        for k, o, r in zip(keys, outs, rids):
+            rows = jnp.unique(jnp.asarray(
+                r._data if isinstance(r, NDArray) else r).astype(jnp.int32)
+                .ravel())
+            stored = self._store[k]
+            dense = stored.todense()._data \
+                if isinstance(stored, RowSparseNDArray) else stored._data
+            res = RowSparseNDArray(NDArray(dense[rows]), NDArray(rows),
+                                   tuple(dense.shape))
+            if isinstance(o, RowSparseNDArray):
+                o.data = res.data
+                o.indices = res.indices
+            results.append(res)
+        return results if isinstance(key, (list, tuple)) else results[0]
+
     # -- optimizer-on-store -------------------------------------------------
     def set_optimizer(self, optimizer):
         from ..optimizer import Updater

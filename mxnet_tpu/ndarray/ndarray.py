@@ -419,7 +419,8 @@ class NDArray:
 
         if isinstance(other, NDArray):
             a, b = (other, self) if reverse else (self, other)
-            return call(jfn, (a, b), {}, name=name)
+            # attrs={} opts in to reload-by-name: jfn IS the registry op
+            return call(jfn, (a, b), {}, name=name, attrs={})
         if isinstance(other, numeric_types) or isinstance(other, _onp.ndarray) or _onp.isscalar(other):
             # scalar operand rides as a pos_args literal so symbol-json
             # traces of `x + 2` reload (python scalars stay weak-typed)
@@ -589,7 +590,12 @@ class NDArray:
         return self._unary_method(lambda x: jnp.tile(x, reps), "tile")
 
     def clip(self, a_min=None, a_max=None):
-        return self._unary_method(lambda x: jnp.clip(x, a_min, a_max), "clip")
+        attrs = None
+        if all(isinstance(v, (int, float, type(None)))
+               for v in (a_min, a_max)):
+            attrs = {"pos_args": [None, a_min, a_max]}
+        return self._unary_method(lambda x: jnp.clip(x, a_min, a_max),
+                                  "clip", _attrs=attrs)
 
     def sum(self, axis=None, dtype=None, keepdims=False):
         return self._unary_method(lambda x: jnp.sum(x, axis=axis, dtype=dtype,
@@ -653,22 +659,22 @@ class NDArray:
         return self.__abs__()
 
     def sqrt(self):
-        return self._unary_method(jnp.sqrt, "sqrt")
+        return self._unary_method(jnp.sqrt, "sqrt", _attrs={})
 
     def exp(self):
-        return self._unary_method(jnp.exp, "exp")
+        return self._unary_method(jnp.exp, "exp", _attrs={})
 
     def log(self):
-        return self._unary_method(jnp.log, "log")
+        return self._unary_method(jnp.log, "log", _attrs={})
 
     def sigmoid(self):
-        return self._unary_method(jax.nn.sigmoid, "sigmoid")
+        return self._unary_method(jax.nn.sigmoid, "sigmoid", _attrs={})
 
     def tanh(self):
-        return self._unary_method(jnp.tanh, "tanh")
+        return self._unary_method(jnp.tanh, "tanh", _attrs={})
 
     def relu(self):
-        return self._unary_method(jax.nn.relu, "relu")
+        return self._unary_method(jax.nn.relu, "relu", _attrs={})
 
     def softmax(self, axis=-1):
         return self._unary_method(lambda x: jax.nn.softmax(x, axis=axis), "softmax")

@@ -12,6 +12,26 @@ surface is the one that matters in practice (sparse embedding gradients,
 csr feature matrices): construction, dense round-trip, retain, sparse
 dot, elementwise add, save/load. Everything else raises, loudly, instead
 of silently densifying.
+
+row_sparse GRADIENT path (Embedding(sparse_grad=True) -> Parameter.grad
+-> optimizer lazy update / kvstore.row_sparse_pull) — intentional
+divergences from the reference, documented per round-2 verdict #9:
+
+* The backward itself runs as a DENSE XLA scatter-add (static shapes;
+  the MXU-friendly form). Sparsity is recovered at the Parameter.grad()
+  boundary by selecting rows with any nonzero entry — so a row whose
+  gradient is EXACTLY zero (e.g. two lookups that cancel) is dropped,
+  where the reference would keep the touched row with zero values.
+  Consequence: identical numerics for sgd (a zero-grad lazy row update
+  is a no-op), but a momentum/wd decay the reference would apply to such
+  a row is skipped. This matches the reference's own lazy_update=True
+  semantics, which is the default for sparse sgd.
+* Gradient memory is O(vocab) during the backward (dense scatter), not
+  O(touched rows); the sparse representation saves optimizer-state
+  traffic and cross-process push bytes, not backward memory.
+* dist kvstore push of row_sparse values densifies before the
+  collective (XLA collectives are dense); row_sparse_pull gathers the
+  requested rows after.
 """
 from __future__ import annotations
 
@@ -167,7 +187,9 @@ def row_sparse_array(arg, shape: Optional[Tuple[int, ...]] = None,
 
 def _dense_to_row_sparse(dense: jnp.ndarray) -> RowSparseNDArray:
     flat = dense.reshape(dense.shape[0], -1)
-    nz = _onp.nonzero(_onp.asarray((jnp.abs(flat) > 0).any(axis=1)))[0]
+    # != 0 (not abs > 0): NaN != 0 is True, so an all-NaN gradient row is
+    # KEPT and the blow-up stays visible instead of being silently dropped
+    nz = _onp.nonzero(_onp.asarray((flat != 0).any(axis=1)))[0]
     idx = jnp.asarray(nz, jnp.int32)
     return RowSparseNDArray(NDArray(dense[idx]), NDArray(idx), dense.shape)
 

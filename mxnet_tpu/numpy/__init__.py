@@ -264,14 +264,21 @@ def _seq_op(jfn, name):
     def op(arrays, *args, **kwargs):
         arrays = list(arrays)
         nd = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a)) for a in arrays]
-        attrs = {"seq_input": True}
+        import numbers
+
+        attrs = {"seq_input": True, "__reloadable__": True}
         if args or "axis" in kwargs:   # only when the CALLER passed one —
             # vstack & co. take no axis kwarg at all
             axis = args[0] if args else kwargs["axis"]
-            if axis is None or isinstance(axis, int):
+            if axis is None:
                 # None is meaningful (concatenate axis=None flattens) —
                 # record it, or reload would replay the wrapper default
-                attrs["axis"] = axis
+                attrs["axis"] = None
+            elif isinstance(axis, numbers.Integral):
+                attrs["axis"] = int(axis)
+            else:
+                # unrecordable axis: refuse at reload, don't mis-execute
+                del attrs["__reloadable__"]
         return invoke(lambda *xs: jfn(list(xs), *args, **kwargs), nd,
                       name=name, attrs=attrs)
 

@@ -151,17 +151,27 @@ def _jsonable(v) -> bool:
 
 
 def call(fn: Callable, args: Tuple, kwargs: dict, name: str = "op", out=None,
-         attrs: Optional[dict] = None):
+         attrs: Optional[dict] = None, reload_by_name: bool = False):
     """Invoke ``fn`` on a mixed arg list: NDArrays become differentiable
     inputs, everything else is closed over (the analogue of dmlc::Parameter
     op params, SURVEY.md §2.2). JSON-able kwargs (plus scalar positionals,
     plus any explicit ``attrs`` from wrappers that close over their config)
     ride along as graph attrs so deferred-compute traces keep op
-    parameters — the Symbol/ONNX layers read them back."""
+    parameters — the Symbol/ONNX layers read them back.
+
+    Reload contract (symbol tojson): a recorded node may be re-executed
+    from JSON via ``resolve_op(name)`` ONLY when its recorder vouched for
+    it — either by passing explicit ``attrs`` (the wrapper asserts
+    name+attrs+inputs reproduce the call) or via ``reload_by_name=True``
+    (wrap_op: the record IS the public op invocation) when every non-array
+    argument was captured. Anything else stays a __traced__ closure:
+    a name that happens to resolve is NOT evidence the registry op has the
+    same semantics as the recorded lambda."""
     from ..ndarray import NDArray
 
     if is_deferred_compute():  # attrs are only read by symbol tracing;
         # building them on eager dispatch would tax the op hot path
+        explicit = attrs is not None
         auto = {k: v for k, v in kwargs.items() if _jsonable(v)}
         non_nd = [(i, a) for i, a in enumerate(args)
                   if not isinstance(a, NDArray)]
@@ -171,10 +181,16 @@ def call(fn: Callable, args: Tuple, kwargs: dict, name: str = "op", out=None,
         # order, literals ride verbatim. Only when every non-ND positional
         # is JSON-able and no NDArray hides in kwargs (those append to the
         # input list in an order the template couldn't express).
+        nd_in_kwargs = any(isinstance(v, NDArray) for v in kwargs.values())
+        complete = (all(_jsonable(a) for _, a in non_nd) and
+                    not nd_in_kwargs and
+                    all(_jsonable(v) for v in kwargs.values()))
         if non_nd and all(_jsonable(a) for _, a in non_nd) and \
-                not any(isinstance(v, NDArray) for v in kwargs.values()):
+                not nd_in_kwargs:
             auto["pos_args"] = [None if isinstance(a, NDArray) else a
                                 for a in args]
+        if explicit or (reload_by_name and complete):
+            auto["__reloadable__"] = True
         if attrs:
             auto.update({k: v for k, v in attrs.items() if _jsonable(v)})
         attrs = auto
@@ -217,7 +233,9 @@ def wrap_op(jfn: Callable, name: Optional[str] = None):
 
     def op(*args, **kwargs):
         out = kwargs.pop("out", None)
-        return call(jfn, args, kwargs, name=opname, out=out)
+        # the record IS the public op call -> sound to reload by name
+        return call(jfn, args, kwargs, name=opname, out=out,
+                    reload_by_name=True)
 
     op.__name__ = opname
     op.__doc__ = getattr(jfn, "__doc__", None)

@@ -148,6 +148,19 @@ def _rnn_reload(*args, mode="lstm", use_sequence_length=False,
 
 register_op("rnn", _rnn_reload)
 
+
+def _flatten_pred_op(p, last_dim=None):
+    """(B, A*D, H, W) -> (B, H*W*A, D): interleaved detection-head
+    predictions flattened per anchor (SSD). Registered so the op stays
+    batch-POLYMORPHIC after a json reload — shapes come from the input at
+    every execution, never baked at trace time."""
+    b, c, h, w = p.shape
+    return p.transpose(0, 2, 3, 1).reshape(b, h * w * (c // last_dim),
+                                           last_dim)
+
+
+register_op("flatten_pred", _flatten_pred_op)
+
 # ops whose reload is only possible when specific attrs survived
 # serialization — tojson falls back to __traced__ when they are missing
 # (e.g. an unencodable getitem key, a non-JSON-able split section array)
@@ -520,6 +533,11 @@ class Symbol:
                         attrs["dtype"] = str(v.dtype)
                     else:
                         attrs["__traced__"] = "true"
+                elif not n.attrs.get("__reloadable__"):
+                    # the recorder did not vouch that name+attrs+inputs
+                    # reproduce this call — a name that happens to resolve
+                    # is NOT evidence of same semantics (dispatch.call)
+                    attrs["__traced__"] = "true"
                 elif any(req not in n.attrs
                          for req in _REQUIRED_RELOAD_ATTRS.get(n.op, ())):
                     attrs["__traced__"] = "true"

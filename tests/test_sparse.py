@@ -270,3 +270,81 @@ class TestDGLGraphOps:
         for r in range(n):
             for c in onp.nonzero(md[r])[0]:
                 assert md[r, c] == full[v[r], v[c]]
+
+
+class TestRowSparseTraining:
+    """row_sparse gradient end-to-end (round-2 verdict #9): an Embedding
+    with sparse_grad=True trains via gluon.Trainer, the gradient flows as
+    a RowSparseNDArray, and the optimizer's lazy row-wise kernel leaves
+    untouched rows bit-identical."""
+
+    def test_embedding_sparse_grad_flows(self):
+        from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+        mx.random.seed(0)
+        emb = mx.gluon.nn.Embedding(10, 4, sparse_grad=True)
+        emb.initialize(mx.init.Xavier())
+        assert emb.weight.grad_stype == "row_sparse"
+        x = mx.np.array(onp.array([1, 3, 3], "int32"))
+        with mx.autograd.record():
+            loss = emb(x).sum()
+        loss.backward()
+        g = emb.weight.grad()
+        assert isinstance(g, RowSparseNDArray)
+        onp.testing.assert_array_equal(onp.sort(g.indices.asnumpy()), [1, 3])
+        dense = g.todense().asnumpy()
+        onp.testing.assert_allclose(dense[1], onp.ones(4))
+        onp.testing.assert_allclose(dense[3], 2 * onp.ones(4))  # used twice
+
+    def test_trainer_lazy_update_touches_only_used_rows(self):
+        mx.random.seed(1)
+        emb = mx.gluon.nn.Embedding(10, 4, sparse_grad=True)
+        emb.initialize(mx.init.Xavier())
+        w0 = emb.weight.data().asnumpy().copy()
+        trainer = mx.gluon.Trainer(
+            emb.collect_params(), "sgd",
+            {"learning_rate": 0.5, "momentum": 0.9, "wd": 0.1})
+        x = mx.np.array(onp.array([2, 5], "int32"))
+        for _ in range(3):
+            with mx.autograd.record():
+                loss = (emb(x) ** 2).sum()
+            loss.backward()
+            trainer.step(1)
+        w1 = emb.weight.data().asnumpy()
+        # untouched rows: bit-identical (lazy update skips momentum AND wd)
+        untouched = [i for i in range(10) if i not in (2, 5)]
+        onp.testing.assert_array_equal(w1[untouched], w0[untouched])
+        # touched rows actually moved
+        assert onp.abs(w1[[2, 5]] - w0[[2, 5]]).max() > 1e-4
+
+    def test_sparse_training_matches_dense(self):
+        """Same data, sparse_grad=True vs False (momentum-less sgd, no wd):
+        touched-row trajectories must agree."""
+        def run(sparse):
+            mx.random.seed(7)
+            emb = mx.gluon.nn.Embedding(8, 3, sparse_grad=sparse)
+            emb.initialize(mx.init.Xavier())
+            tr = mx.gluon.Trainer(emb.collect_params(), "sgd",
+                                  {"learning_rate": 0.2})
+            x = mx.np.array(onp.array([0, 4, 7], "int32"))
+            for _ in range(4):
+                with mx.autograd.record():
+                    loss = (emb(x) ** 2).sum()
+                loss.backward()
+                tr.step(1)
+            return emb.weight.data().asnumpy()
+
+        onp.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+    def test_kvstore_row_sparse_pull(self):
+        from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+        kv = mx.kvstore.create("local")
+        val = mx.np.array(onp.arange(20, dtype="float32").reshape(5, 4))
+        kv.init("emb", val)
+        out = kv.row_sparse_pull(
+            "emb", row_ids=mx.np.array(onp.array([3, 1, 3], "int64")))
+        assert isinstance(out, RowSparseNDArray)
+        onp.testing.assert_array_equal(out.indices.asnumpy(), [1, 3])
+        onp.testing.assert_allclose(
+            out.data.asnumpy(), val.asnumpy()[[1, 3]])
