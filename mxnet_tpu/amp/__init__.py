@@ -93,8 +93,12 @@ class scale_loss:
 
     def __exit__(self, *exc):
         if self._scaler is not None:
-            grads = [p.grad() for p in self._trainer._params
-                     if p.grad_req != "null" and p._data is not None]
+            # dense underlying buffers: row_sparse params surface grads
+            # sparsely via grad(), but scaling/finiteness act on the real
+            # dense buffer BEFORE sparsification (list_grad is dense)
+            grads = [g for p in self._trainer._params
+                     if p.grad_req != "null" and p._data is not None
+                     for g in p.list_grad()]
             self._scaler.post_backward(grads)
 
 
@@ -105,8 +109,8 @@ def unscale(trainer):
     inv = 1.0 / scaler.loss_scale
     for p in trainer._params:
         if p.grad_req != "null" and p._data is not None:
-            g = p.grad()
-            g._set_data(g._data * inv)
+            for g in p.list_grad():
+                g._set_data(g._data * inv)
 
 
 def _cast_params(block, dtype, keep_fp32_patterns=("gamma", "beta", "running_",

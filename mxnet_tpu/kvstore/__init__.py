@@ -160,7 +160,10 @@ class KVStore(KVStoreBase):
         keys = key if isinstance(key, (list, tuple)) else [key]
         if isinstance(key, (list, tuple)):
             outs = out if out is not None else [None] * len(keys)
-            rids = row_ids
+            # a single id array broadcasts to every key (ref kvstore.py
+            # row_sparse_pull row_ids broadcast)
+            rids = row_ids if isinstance(row_ids, (list, tuple)) \
+                else [row_ids] * len(keys)
         else:
             outs, rids = [out], [row_ids]
         results = []
@@ -173,9 +176,13 @@ class KVStore(KVStoreBase):
                 if isinstance(stored, RowSparseNDArray) else stored._data
             res = RowSparseNDArray(NDArray(dense[rows]), NDArray(rows),
                                    tuple(dense.shape))
-            if isinstance(o, RowSparseNDArray):
+            if o is not None:
+                if not isinstance(o, RowSparseNDArray):
+                    raise MXNetError(
+                        "row_sparse_pull out= must be a RowSparseNDArray")
                 o.data = res.data
                 o.indices = res.indices
+                o._shape = res._shape
             results.append(res)
         return results if isinstance(key, (list, tuple)) else results[0]
 

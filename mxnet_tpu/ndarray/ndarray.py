@@ -590,10 +590,12 @@ class NDArray:
         return self._unary_method(lambda x: jnp.tile(x, reps), "tile")
 
     def clip(self, a_min=None, a_max=None):
+        # bounds ride as kwargs (NOT pos_args: the template cannot hold a
+        # literal None — it means "input slot" to the reload interpreter)
         attrs = None
         if all(isinstance(v, (int, float, type(None)))
                for v in (a_min, a_max)):
-            attrs = {"pos_args": [None, a_min, a_max]}
+            attrs = {"a_min": a_min, "a_max": a_max}
         return self._unary_method(lambda x: jnp.clip(x, a_min, a_max),
                                   "clip", _attrs=attrs)
 

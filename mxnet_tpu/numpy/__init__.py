@@ -266,9 +266,11 @@ def _seq_op(jfn, name):
         nd = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a)) for a in arrays]
         import numbers
 
-        attrs = {"seq_input": True, "__reloadable__": True}
-        if args or "axis" in kwargs:   # only when the CALLER passed one —
-            # vstack & co. take no axis kwarg at all
+        attrs = {"seq_input": True}
+        # vouch reloadable only when the WHOLE call is captured: at most
+        # an axis argument, nothing else in the closure
+        captured = len(args) <= 1 and set(kwargs) <= {"axis"}
+        if args or "axis" in kwargs:
             axis = args[0] if args else kwargs["axis"]
             if axis is None:
                 # None is meaningful (concatenate axis=None flattens) —
@@ -277,8 +279,9 @@ def _seq_op(jfn, name):
             elif isinstance(axis, numbers.Integral):
                 attrs["axis"] = int(axis)
             else:
-                # unrecordable axis: refuse at reload, don't mis-execute
-                del attrs["__reloadable__"]
+                captured = False   # unrecordable axis: refuse at reload
+        if captured:
+            attrs["__reloadable__"] = True
         return invoke(lambda *xs: jfn(list(xs), *args, **kwargs), nd,
                       name=name, attrs=attrs)
 

@@ -19,4 +19,14 @@ for _name in _NAMES:
     if _j is not None:
         _g[_name] = wrap_op(_j, f"linalg.{_name}")
 
+# namedtuple-returning decompositions break jax.vjp's pytree matching in
+# the dispatcher (SlogdetResult vs tuple) — normalize to plain tuples
+slogdet = wrap_op(lambda a: tuple(jnp.linalg.slogdet(a)), "linalg.slogdet")
+svd = wrap_op(lambda a, full_matrices=True, compute_uv=True:
+              (tuple(jnp.linalg.svd(a, full_matrices=full_matrices))
+               if compute_uv else jnp.linalg.svd(a, compute_uv=False)),
+              "linalg.svd")
+eigh = wrap_op(lambda a: tuple(jnp.linalg.eigh(a)), "linalg.eigh")
+qr = wrap_op(lambda a: tuple(jnp.linalg.qr(a)), "linalg.qr")
+
 __all__ = [n for n in _NAMES if n in _g]

@@ -43,7 +43,8 @@ __all__ = [
     "gamma", "gammaln", "erf", "erfinv", "digamma",
     "reshape_like", "slice_like", "broadcast_like", "shape_array", "batch_dot",
     "arange_like", "gather_nd", "scatter_nd", "index_update", "index_add",
-    "smooth_l1", "all_finite", "multi_sum_sq", "clip_by_global_norm",
+    "smooth_l1", "l2_normalization", "all_finite", "multi_sum_sq",
+    "clip_by_global_norm",
     "multi_head_attention", "flash_attention",
     "foreach", "while_loop", "cond",
     "box_iou", "box_nms", "roi_align",
@@ -370,6 +371,25 @@ def index_update(data, indices, val, **kw):
 def index_add(data, indices, val, **kw):
     return call(lambda x, i, v: x.at[tuple(i.astype(jnp.int32)[k] for k in range(i.shape[0]))].add(v),
                 (data, indices, val), {}, name="index_add")
+
+
+def l2_normalization(data, eps=1e-10, mode="instance", out=None):
+    """L2-normalize (ref src/operator/l2_normalization.cc): 'instance'
+    divides by the norm over all non-batch axes, 'channel' over axis 1,
+    'spatial' over axes >= 2."""
+    def f(x):
+        if mode == "instance":
+            axes = tuple(range(1, x.ndim))
+        elif mode == "channel":
+            axes = (1,)
+        elif mode == "spatial":
+            axes = tuple(range(2, x.ndim))
+        else:
+            raise MXNetError(f"unknown l2_normalization mode {mode!r}")
+        return x / jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + eps)
+
+    return call(f, (data,), {}, name="l2_normalization", out=out,
+                attrs={"eps": eps, "mode": mode})
 
 
 def smooth_l1(data, scalar=1.0, **kw):

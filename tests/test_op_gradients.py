@@ -193,3 +193,158 @@ def test_batch_norm_inference_gradient():
         lambda xx, gg, bb: mx.npx.batch_norm(xx, gg, bb, rm, rv,
                                              use_global_stats=True),
         [x, g, b], rtol=3e-2, atol=3e-2)
+
+
+# -- round-3 extension (verdict #6): every differentiable catalog op gets
+# an FD-checked backward -----------------------------------------------------
+
+STRUCTURAL = [
+    ("getitem_slice", lambda a: a[1:3, ::2], lambda: _sym(4, 6)),
+    ("getitem_int", lambda a: a[2], lambda: _sym(4, 3)),
+    ("broadcast_to", lambda a: mx.np.broadcast_to(a, (4, 3)),
+     lambda: _sym(1, 3)),
+    ("concatenate", lambda a: mx.np.concatenate([a, a * 2.0], axis=0),
+     lambda: _sym(2, 3)),
+    ("stack", lambda a: mx.np.stack([a, a * 0.5]), lambda: _sym(2, 3)),
+    ("vstack", lambda a: mx.np.vstack((a, a)), lambda: _sym(2, 3)),
+    ("hstack", lambda a: mx.np.hstack((a, a)), lambda: _sym(2, 3)),
+    ("split_head", lambda a: mx.np.split(a, 2, axis=1)[0],
+     lambda: _sym(3, 4)),
+    ("swapaxes", lambda a: a.swapaxes(0, 1), lambda: _sym(3, 4)),
+    ("moveaxis", lambda a: mx.np.moveaxis(a, 0, 1), lambda: _sym(3, 4)),
+    ("rot90", lambda a: mx.np.rot90(a), lambda: _sym(3, 4)),
+    ("atleast2d", lambda a: mx.np.atleast_2d(a) * 2.0, lambda: _sym(4,)),
+    ("ravel", lambda a: mx.np.ravel(a), lambda: _sym(3, 4)),
+    ("flipud", lambda a: mx.np.flipud(a), lambda: _sym(3, 4)),
+    ("fliplr", lambda a: mx.np.fliplr(a), lambda: _sym(3, 4)),
+    ("diag_vec", lambda a: mx.np.diag(a), lambda: _sym(4,)),
+    ("tril_k", lambda a: mx.np.tril(a, k=1), lambda: _sym(3, 3)),
+    ("gather_nd", lambda a: mx.npx.gather_nd(
+        a, mx.np.array(onp.array([[0, 1], [1, 2]], "int64")).T),
+     lambda: _sym(3, 4)),
+    ("pick", lambda a: mx.npx.pick(
+        a, mx.np.array(onp.array([0, 2, 1], "int64"))),
+     lambda: _sym(3, 4)),
+    ("one_hot_dot", lambda a: mx.np.dot(
+        mx.npx.one_hot(mx.np.array(onp.array([0, 2], "int64")), 3), a),
+     lambda: _sym(3, 4)),
+    ("slice_like", lambda a: mx.npx.slice_like(a, mx.np.zeros((2, 3))),
+     lambda: _sym(4, 5)),
+    ("reshape_like", lambda a: mx.npx.reshape_like(a, mx.np.zeros((6, 2))),
+     lambda: _sym(3, 4)),
+    ("where3", lambda a: mx.np.where(
+        mx.np.array(onp.array([[True, False, True]])), a, a * 3.0),
+     lambda: _sym(2, 3)),
+]
+
+NN_EXTRA = [
+    ("leaky_relu", lambda a: mx.npx.leaky_relu(a, act_type="leaky",
+                                               slope=0.3),
+     lambda: _sym(3, 4)),
+    ("elu", lambda a: mx.npx.leaky_relu(a, act_type="elu", slope=0.4),
+     lambda: _sym(3, 4)),
+    ("gelu", lambda a: mx.npx.leaky_relu(a, act_type="gelu"),
+     lambda: _sym(3, 4)),
+    ("softsign", lambda a: mx.npx.activation(a, "softsign"),
+     lambda: _sym(3, 4)),
+    ("softrelu", lambda a: mx.npx.activation(a, "softrelu"),
+     lambda: _sym(3, 4)),
+    ("masked_softmax", lambda a: mx.npx.masked_softmax(
+        a, mx.np.array(onp.array([[True, True, False, True]] * 3))),
+     lambda: _sym(3, 4)),
+    ("group_norm", lambda a: mx.npx.group_norm(
+        a, mx.np.ones((2,)), mx.np.zeros((2,)), num_groups=2),
+     lambda: _sym(2, 2, 4, 4)),
+    ("instance_norm", lambda a: mx.npx.instance_norm(
+        a, mx.np.ones((3,)), mx.np.zeros((3,))),
+     lambda: _sym(2, 3, 5)),
+    ("lrn", lambda a: mx.npx.lrn(a, nsize=3), lambda: _pos(1, 4, 3, 3)),
+    ("l2_normalization", lambda a: mx.npx.l2_normalization(a),
+     lambda: _pos(3, 4)),
+    ("smooth_l1", lambda a: mx.npx.smooth_l1(a), lambda: _sym(3, 4)),
+    ("batch_dot", lambda a: mx.npx.batch_dot(a, a), lambda: _sym(2, 3, 3)),
+    ("div_sqrt_dim", lambda a: mx.npx.div_sqrt_dim(a), lambda: _sym(2, 4)),
+    ("sequence_mask_g", lambda a: mx.npx.sequence_mask(
+        a, mx.np.array(onp.array([2.0, 3.0])), use_sequence_length=True),
+     lambda: _sym(4, 2, 3)),
+    ("space_to_depth", lambda a: mx.npx.space_to_depth(a, 2),
+     lambda: _sym(1, 2, 4, 4)),
+    ("depth_to_space", lambda a: mx.npx.depth_to_space(a, 2),
+     lambda: _sym(1, 4, 2, 2)),
+    ("dropout_p0", lambda a: mx.npx.dropout(a, p=0.0),  # p=0 -> identity
+     lambda: _sym(3, 4)),
+]
+
+LINALG = [
+    ("cholesky_sum", lambda a: mx.np.linalg.cholesky(
+        mx.np.matmul(a, a.T) + 3.0 * mx.np.array(onp.eye(3, dtype="float32"))),
+     lambda: _sym(3, 3)),
+    ("inv", lambda a: mx.np.linalg.inv(
+        a + 3.0 * mx.np.array(onp.eye(3, dtype="float32"))),
+     lambda: _sym(3, 3, scale=0.3)),
+    ("det", lambda a: mx.np.linalg.det(
+        a + 3.0 * mx.np.array(onp.eye(3, dtype="float32"))),
+     lambda: _sym(3, 3, scale=0.3)),
+    ("slogdet1", lambda a: mx.np.linalg.slogdet(
+        a + 3.0 * mx.np.array(onp.eye(3, dtype="float32")))[1],
+     lambda: _sym(3, 3, scale=0.3)),
+    ("solve_vec", lambda a: mx.np.linalg.solve(
+        a + 3.0 * mx.np.array(onp.eye(3, dtype="float32")),
+        mx.np.array(onp.array([1.0, 2.0, 3.0], "float32"))),
+     lambda: _sym(3, 3, scale=0.3)),
+    ("einsum_g", lambda a: mx.np.einsum("ij,jk->ik", a, a),
+     lambda: _sym(3, 3)),
+]
+
+ATTENTION = [
+    ("selfatt_qk", lambda a: mx.npx.interleaved_matmul_selfatt_qk(a, heads=2),
+     lambda: _sym(4, 2, 12)),
+    ("multi_head_attention", lambda a: mx.npx.multi_head_attention(
+        a, a, a, 2), lambda: _sym(2, 4, 8)),
+]
+
+
+@pytest.mark.parametrize(
+    "name,fn,builder", STRUCTURAL + NN_EXTRA + LINALG + ATTENTION,
+    ids=[c[0] for c in STRUCTURAL + NN_EXTRA + LINALG + ATTENTION])
+def test_extended_gradient(name, fn, builder):
+    check_numeric_gradient(fn, [builder()], rtol=3e-2, atol=3e-2)
+
+
+def test_scatter_nd_gradient():
+    idx = mx.np.array(onp.array([[0, 1], [1, 2]], "int64"))
+    v = _sym(2, seed=31)
+    check_numeric_gradient(
+        lambda vv: mx.npx.scatter_nd(vv, idx, (2, 3)), [v],
+        rtol=3e-2, atol=3e-2)
+
+
+def test_rnn_cells_gradient():
+    """Fused rnn backward vs FD for all three modes."""
+    rs = onp.random.RandomState(33)
+    x = mx.np.array((rs.rand(3, 2, 3) - 0.5).astype("float32"))
+    sizes = {"rnn_tanh": 12 + 16 + 8, "gru": 3 * (12 + 16 + 8),
+             "lstm": 4 * (12 + 16 + 8)}
+    for mode, n in sizes.items():
+        params = mx.np.array((rs.rand(n) * 0.2 - 0.1).astype("float32"))
+        h0 = mx.np.zeros((1, 2, 4))
+        if mode == "lstm":
+            fn = lambda p: mx.npx.rnn(  # noqa: E731
+                data=x, parameters=p, state=h0, state_cell=mx.np.zeros(
+                    (1, 2, 4)), mode="lstm", state_size=4, num_layers=1)[0]
+        else:
+            fn = lambda p, m=mode: mx.npx.rnn(  # noqa: E731
+                data=x, parameters=p, state=h0, mode=m, state_size=4,
+                num_layers=1)[0]
+        check_numeric_gradient(fn, [params], rtol=4e-2, atol=4e-2)
+
+
+def test_ctc_loss_gradient():
+    rs = onp.random.RandomState(35)
+    pred = mx.np.array((rs.rand(2, 5, 4) - 0.5).astype("float32"))
+    labels = mx.np.array(onp.array([[1, 2], [2, 3]], "int32"))
+    from mxnet_tpu.ops import ctc as CT
+    from mxnet_tpu.ops.dispatch import call
+    check_numeric_gradient(
+        lambda p: call(CT.ctc_loss, (p, labels), {}, name="ctc_loss"),
+        [pred], rtol=4e-2, atol=4e-2)
