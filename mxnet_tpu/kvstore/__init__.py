@@ -361,6 +361,8 @@ def create(name: str = "tpu") -> KVStoreBase:
     if name in ("tpu", "dist_tpu", "dist", "dist_sync", "dist_async",
                 "dist_device_sync", "dist_sync_device"):
         return TPUKVStore(name)
+    if name in ("horovod", "byteps"):
+        from . import horovod  # noqa: F401 — registers the plugins
     if name in _REG:
         return _REG.get(name)()
     raise MXNetError(f"unknown kvstore type '{name}'")

@@ -595,7 +595,9 @@ class NDArray:
         attrs = None
         if all(isinstance(v, (int, float, type(None)))
                for v in (a_min, a_max)):
-            attrs = {"a_min": a_min, "a_max": a_max}
+            # record the modern jnp.clip kwarg spelling (min/max) — the
+            # a_min/a_max aliases are deprecated and will stop reloading
+            attrs = {"min": a_min, "max": a_max}
         return self._unary_method(lambda x: jnp.clip(x, a_min, a_max),
                                   "clip", _attrs=attrs)
 

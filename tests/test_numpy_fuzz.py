@@ -392,6 +392,8 @@ def test_fuzz_out_and_where_unsupported_dont_corrupt():
     """out= with dtype mismatch must CAST into the out buffer (reference
     semantics), never silently drop the write."""
     x = mx.np.array(onp.array([1.9, 2.2], onp.float32))
-    out = mx.np.zeros((2,))
+    out = mx.np.zeros((2,), dtype="float16")   # dtype-mismatch: must CAST
     res = mx.np.exp(x, out=out)
-    assert res is out and float(out[0]) != 0.0
+    assert res is out and str(out.dtype) == "float16"
+    assert float(out[0]) == pytest.approx(onp.exp(onp.float32(1.9)),
+                                          rel=1e-2)
