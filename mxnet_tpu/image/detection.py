@@ -430,6 +430,13 @@ class ImageDetIter(ImageIter):
                  shuffle=False, part_index=0, num_parts=1, aug_list=None,
                  imglist=None, data_name="data", label_name="label",
                  last_batch_handle="pad", **kwargs):
+        if kwargs.pop("prefetch", False):
+            from ..base import MXNetError
+
+            raise MXNetError(
+                "ImageDetIter does not support prefetch=True (its next() "
+                "does label repacking outside the producer); use the "
+                "default synchronous path")
         super().__init__(batch_size=batch_size, data_shape=data_shape,
                          path_imgrec=path_imgrec, path_imglist=path_imglist,
                          path_root=path_root, path_imgidx=path_imgidx,

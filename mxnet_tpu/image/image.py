@@ -779,6 +779,13 @@ class ImageIter:
         assert path_imgrec or path_imglist or isinstance(imglist, list)
         assert dtype in ("int32", "float32", "int64", "float64"), \
             dtype + " label not supported"
+        # OPT-IN one-batch engine lookahead. Off by default: the producer
+        # runs on an engine thread, so (a) global-RNG augmenter draws
+        # interleave with the caller's draws (seeded runs lose exact
+        # reproducibility), (b) the sample-level API (next_sample) must
+        # not be mixed with it, and (c) driving next() from inside another
+        # engine op (PrefetchingIter) could starve a 1-worker pool.
+        prefetch = bool(kwargs.pop("prefetch", False))
         if path_imgrec:
             if path_imgidx:
                 self.imgrec = recordio.MXIndexedRecordIO(path_imgidx,
@@ -855,10 +862,18 @@ class ImageIter:
         self._cache_data = None
         self._cache_label = None
         self._cache_idx = None
+        # one-batch lookahead on the native engine (opt-in; see the
+        # prefetch pop above and _schedule_prefetch)
+        self._prefetch = prefetch
+        self._pf_var = None
+        self._pf_result = None
         self.reset()
 
     # -- epoch control ------------------------------------------------------
     def reset(self):
+        # an in-flight prefetched batch belongs to the pre-reset sequence
+        if getattr(self, "_pf_var", None) is not None:
+            self._drain_prefetch()
         if self.seq is not None and self.shuffle:
             random.shuffle(self.seq)
         if self.last_batch_handle != "roll_over" or self._cache_data is None:
@@ -868,6 +883,8 @@ class ImageIter:
             self._allow_read = True
 
     def hard_reset(self):
+        if getattr(self, "_pf_var", None) is not None:
+            self._drain_prefetch()
         if self.seq is not None and self.shuffle:
             random.shuffle(self.seq)
         if self.imgrec is not None:
@@ -934,22 +951,66 @@ class ImageIter:
                 raise StopIteration
         return i
 
-    def next(self):
-        """Return the next DataBatch (device NDArrays, pad count set)."""
-        from ..io.io import DataBatch
-
+    def _produce(self):
+        """Decode + augment one batch (host work; runs on the native
+        engine when prefetching). Returns (batch_data, batch_label, i)."""
         batch_size = self.batch_size
         c, h, w = self.data_shape
         if self._cache_data is not None:
             assert self._cache_label is not None
             assert self._cache_idx is not None
-            batch_data = self._cache_data
-            batch_label = self._cache_label
-            i = self._cache_idx
-        else:
-            batch_data = np.zeros((batch_size, c, h, w), np.float32)
-            batch_label = np.empty(self.provide_label[0].shape, np.float32)
-            i = self._batchify(batch_data, batch_label)
+            return self._cache_data, self._cache_label, self._cache_idx
+        batch_data = np.zeros((batch_size, c, h, w), np.float32)
+        batch_label = np.empty(self.provide_label[0].shape, np.float32)
+        i = self._batchify(batch_data, batch_label)
+        return batch_data, batch_label, i
+
+    def _drain_prefetch(self):
+        """Wait out an in-flight decode and return its result/exception."""
+        if self._pf_var is None:
+            return None
+        from .. import engine as _engine
+
+        eng = _engine.get()
+        eng.wait_for_var(self._pf_var)
+        eng.delete_var(self._pf_var)
+        self._pf_var = None
+        res, self._pf_result = self._pf_result, None
+        return res
+
+    def _schedule_prefetch(self):
+        """One-batch lookahead on the native dependency engine (the same
+        consumer contract as io.ImageRecordIter): the NEXT batch's decode
+        + augmentation overlaps the caller's training step. Exactly one
+        producer is in flight, so iterator state is race-free — next()
+        always drains before touching it."""
+        if not self._prefetch or self._allow_read is False:
+            return
+        from .. import engine as _engine
+
+        eng = _engine.get()
+        var = eng.new_var()
+
+        def work():
+            try:
+                self._pf_result = self._produce()
+            except BaseException as e:  # noqa: BLE001 — incl. StopIteration
+                self._pf_result = e
+
+        eng.push(work, write=(var,), name="imageiter_decode")
+        self._pf_var = var
+
+    def next(self):
+        """Return the next DataBatch (device NDArrays, pad count set)."""
+        from ..io.io import DataBatch
+
+        batch_size = self.batch_size
+        res = self._drain_prefetch()
+        if res is None:
+            res = self._produce()
+        if isinstance(res, BaseException):
+            raise res
+        batch_data, batch_label, i = res
         pad = batch_size - i
         if pad != 0:
             if self.last_batch_handle == "discard":
@@ -967,6 +1028,7 @@ class ImageIter:
                 self._cache_data = None
                 self._cache_label = None
                 self._cache_idx = None
+        self._schedule_prefetch()
         # single per-batch host->device put
         return DataBatch([nd.array(batch_data)], [nd.array(batch_label)],
                          pad=pad)

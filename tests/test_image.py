@@ -428,3 +428,55 @@ def test_det_parse_label_errors(img_dir):
     with pytest.raises(RuntimeError):
         # no valid box (xmax <= xmin)
         it._parse_label(onp.array([2.0, 5.0, 0.0, 0.9, 0.1, 0.1, 0.7]))
+
+
+class TestImageIterEnginePrefetch:
+    """ImageIter's one-batch lookahead on the native dependency engine
+    (second production consumer of mx.engine besides io.ImageRecordIter):
+    prefetch on/off must yield IDENTICAL batch streams across epochs,
+    including the pad tail and mid-epoch reset."""
+
+    @staticmethod
+    def _collect(img_dir, prefetch, epochs=2):
+        imglist = [[float(i % 2), f"i{i}.png"] for i in range(8)]
+        it = mx.image.ImageIter(
+            batch_size=3, data_shape=(3, 32, 32), imglist=imglist,
+            path_root=str(img_dir), shuffle=False, prefetch=prefetch,
+            last_batch_handle="pad")
+        out = []
+        for e in range(epochs):
+            if e:
+                it.reset()
+            for batch in it:
+                out.append((batch.data[0].asnumpy().copy(),
+                            batch.label[0].asnumpy().copy(), batch.pad))
+        return out
+
+    def test_prefetch_stream_identical(self, img_dir):
+        a = self._collect(img_dir, prefetch=False)
+        b = self._collect(img_dir, prefetch=True)
+        assert len(a) == len(b) and len(a) > 0
+        for (da, la, pa), (db, lb, pb) in zip(a, b):
+            onp.testing.assert_array_equal(da, db)
+            onp.testing.assert_array_equal(la, lb)
+            assert pa == pb
+
+    def test_reset_mid_epoch_with_inflight_prefetch(self, img_dir):
+        imglist = [[float(i % 2), f"i{i}.png"] for i in range(8)]
+        it = mx.image.ImageIter(
+            batch_size=3, data_shape=(3, 32, 32), imglist=imglist,
+            path_root=str(img_dir), shuffle=False, prefetch=True)
+        next(it)          # schedules lookahead for batch 2
+        it.reset()        # must drain the in-flight producer safely
+        batches = list(it)
+        assert len(batches) >= 2
+
+
+def test_detiter_rejects_prefetch(img_dir):
+    import json as _json
+
+    lst = [[float(0), _json.dumps([2, 5, 0, 0.1, 0.1, 0.5, 0.5]), "i0.png"]]
+    with pytest.raises(mx.MXNetError, match="prefetch"):
+        mx.image.ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                              imglist=lst, path_root=str(img_dir),
+                              prefetch=True)
