@@ -430,20 +430,15 @@ class ImageDetIter(ImageIter):
                  shuffle=False, part_index=0, num_parts=1, aug_list=None,
                  imglist=None, data_name="data", label_name="label",
                  last_batch_handle="pad", **kwargs):
-        if kwargs.pop("prefetch", False):
-            from ..base import MXNetError
-
-            raise MXNetError(
-                "ImageDetIter does not support prefetch=True (its next() "
-                "does label repacking outside the producer); use the "
-                "default synchronous path")
+        prefetch = kwargs.pop("prefetch", False)
         super().__init__(batch_size=batch_size, data_shape=data_shape,
                          path_imgrec=path_imgrec, path_imglist=path_imglist,
                          path_root=path_root, path_imgidx=path_imgidx,
                          shuffle=shuffle, part_index=part_index,
                          num_parts=num_parts, aug_list=[], imglist=imglist,
                          data_name=data_name, label_name=label_name,
-                         last_batch_handle=last_batch_handle)
+                         last_batch_handle=last_batch_handle,
+                         prefetch=prefetch)
         from ..io.io import DataDesc
 
         if aug_list is None:
@@ -543,41 +538,10 @@ class ImageDetIter(ImageIter):
                 raise StopIteration
         return i
 
-    def next(self):
-        from ..io.io import DataBatch
-
-        batch_size = self.batch_size
-        c, h, w = self.data_shape
-        if self._cache_data is not None:
-            assert self._cache_label is not None
-            assert self._cache_idx is not None
-            batch_data = self._cache_data
-            batch_label = self._cache_label
-            i = self._cache_idx
-        else:
-            batch_data = np.zeros((batch_size, c, h, w), np.float32)
-            batch_label = np.full(self.provide_label[0].shape, -1.0,
-                                  np.float32)
-            i = self._batchify(batch_data, batch_label)
-        pad = batch_size - i
-        if pad != 0:
-            if self.last_batch_handle == "discard":
-                raise StopIteration
-            if (self.last_batch_handle == "roll_over"
-                    and self._cache_data is None):
-                self._cache_data = batch_data
-                self._cache_label = batch_label
-                self._cache_idx = i
-                raise StopIteration
-            _ = self._batchify(batch_data, batch_label, i)
-            if self.last_batch_handle == "pad":
-                self._allow_read = False
-            else:
-                self._cache_data = None
-                self._cache_label = None
-                self._cache_idx = None
-        return DataBatch([nd.array(batch_data)], [nd.array(batch_label)],
-                         pad=pad)
+    def _empty_label(self):
+        # padded object rows are -1 (ref detection.py:625); batch assembly
+        # itself (incl. the engine lookahead) is inherited from ImageIter
+        return np.full(self.provide_label[0].shape, -1.0, np.float32)
 
     def augmentation_transform(self, data, label):  # pylint: disable=arguments-differ
         for aug in self.auglist:

@@ -961,9 +961,16 @@ class ImageIter:
             assert self._cache_idx is not None
             return self._cache_data, self._cache_label, self._cache_idx
         batch_data = np.zeros((batch_size, c, h, w), np.float32)
-        batch_label = np.empty(self.provide_label[0].shape, np.float32)
+        batch_label = self._empty_label()
         i = self._batchify(batch_data, batch_label)
         return batch_data, batch_label, i
+
+    def _empty_label(self):
+        """Fresh label array for one batch; ImageDetIter overrides with a
+        -1 fill (padded object rows), which is the ONLY difference between
+        the two iterators' batch assembly — everything else (pad/roll_over
+        tails, caching, engine lookahead) is shared here."""
+        return np.empty(self.provide_label[0].shape, np.float32)
 
     def _drain_prefetch(self):
         """Wait out an in-flight decode and return its result/exception."""

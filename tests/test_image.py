@@ -469,14 +469,23 @@ class TestImageIterEnginePrefetch:
         next(it)          # schedules lookahead for batch 2
         it.reset()        # must drain the in-flight producer safely
         batches = list(it)
-        assert len(batches) >= 2
+        # a full post-reset epoch: 8 imgs / bs 3, pad tail -> EXACTLY 3
+        assert len(batches) == 3
 
 
-def test_detiter_rejects_prefetch(img_dir):
-    import json as _json
+def test_detiter_prefetch_stream_identical(img_dir):
+    lst = _det_imglist(5)
 
-    lst = [[float(0), _json.dumps([2, 5, 0, 0.1, 0.1, 0.5, 0.5]), "i0.png"]]
-    with pytest.raises(mx.MXNetError, match="prefetch"):
-        mx.image.ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
-                              imglist=lst, path_root=str(img_dir),
-                              prefetch=True)
+    def collect(prefetch):
+        it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                                   imglist=lst, path_root=str(img_dir),
+                                   shuffle=False, prefetch=prefetch)
+        return [(b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy(),
+                 b.pad) for b in it]
+
+    a, b = collect(False), collect(True)
+    assert len(a) == len(b) > 0
+    for (da, la, pa), (db, lb, pb) in zip(a, b):
+        onp.testing.assert_array_equal(da, db)
+        onp.testing.assert_array_equal(la, lb)
+        assert pa == pb

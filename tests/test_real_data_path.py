@@ -73,6 +73,15 @@ def test_finetune_pretrained_on_real_images(rec_prefix, tmp_path,
     repo.mkdir(parents=True)
     monkeypatch.setenv("MXNET_GLUON_REPO", f"file://{tmp_path}/repo")
 
+    # convergence-threshold test: pin the seed (the conftest draws a
+    # random one per test, and an unlucky init/shuffle can miss the 0.7x
+    # loss-drop bar in 2 short epochs — observed once in a full-suite run)
+    import random as _pyrandom
+
+    _pyrandom.seed(7)
+    onp.random.seed(7)
+    mx.random.seed(7)
+
     base = mx.gluon.model_zoo.get_model("resnet18_v1", classes=2)
     base.initialize(mx.init.Xavier())
     base(mx.nd.zeros((1, 3, 32, 32)))
