@@ -43,7 +43,8 @@ __all__ = [
     "gamma", "gammaln", "erf", "erfinv", "digamma",
     "reshape_like", "slice_like", "broadcast_like", "shape_array", "batch_dot",
     "arange_like", "gather_nd", "scatter_nd", "index_update", "index_add",
-    "smooth_l1", "l2_normalization", "all_finite", "multi_sum_sq",
+    "smooth_l1", "l2_normalization", "ctc_loss", "all_finite",
+    "multi_sum_sq",
     "clip_by_global_norm",
     "multi_head_attention", "flash_attention",
     "foreach", "while_loop", "cond",
@@ -371,6 +372,23 @@ def index_update(data, indices, val, **kw):
 def index_add(data, indices, val, **kw):
     return call(lambda x, i, v: x.at[tuple(i.astype(jnp.int32)[k] for k in range(i.shape[0]))].add(v),
                 (data, indices, val), {}, name="index_add")
+
+
+def ctc_loss(pred, labels, pred_lengths=None, label_lengths=None, out=None):
+    """Connectionist temporal classification loss (ref CTCLoss,
+    src/operator/nn/ctc_loss.cc -> ops.ctc lax.scan forward-algorithm).
+    pred: (N, T, C) logits; labels: (N, L) ints, 0 = blank/padding."""
+    from ..ops import ctc as _ctc
+
+    args = [pred, labels] + [x for x in (pred_lengths, label_lengths)
+                             if x is not None]
+
+    def f(p, lab, *rest):
+        pl = rest[0] if pred_lengths is not None else None
+        ll = rest[-1] if label_lengths is not None else None
+        return _ctc.ctc_loss(p, lab, pred_lengths=pl, label_lengths=ll)
+
+    return call(f, tuple(args), {}, name="ctc_loss", out=out, attrs={})
 
 
 def l2_normalization(data, eps=1e-10, mode="instance", out=None):
