@@ -54,3 +54,21 @@ def pytest_runtest_makereport(item, call):
     outcome = yield
     rep = outcome.get_result()
     setattr(item, "rep_" + rep.when, rep)
+
+
+def run_in_x64_subprocess(code: str, timeout: int = 900):
+    """Run python code in a FRESH process with MXNET_INT64_TENSOR_SIZE=1
+    (jax x64 must be configured before backend init) and the TPU-tunnel
+    trigger stripped (PALLAS_AXON_POOL_IPS makes sitecustomize import jax
+    at interpreter start — see the module docstring). Returns the
+    CompletedProcess; asserts rc 0."""
+    import subprocess
+    import sys
+
+    env = {**os.environ, "MXNET_INT64_TENSOR_SIZE": "1",
+           "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    assert out.returncode == 0, out.stderr[-1500:]
+    return out

@@ -1,0 +1,46 @@
+"""Large-tensor smoke: arrays past the int32 index boundary.
+
+The reference gates >2^31-element support behind the INT64_TENSOR_SIZE
+build flag and exercises it in tests/nightly/test_np_large_array.py;
+here the analogue env flag (MXNET_INT64_TENSOR_SIZE=1 -> jax x64) is
+enabled in a fresh subprocess and indexing/reduction/argmax must be
+correct beyond the 2^31 element mark. int8 keeps each buffer ~2.1 GB.
+"""
+import pytest
+
+from conftest import run_in_x64_subprocess
+
+
+@pytest.mark.slow
+def test_indexing_and_reduction_past_int32_boundary():
+    code = r"""
+import numpy as onp
+import mxnet_tpu as mx
+
+N = 2**31 + 16
+x = mx.np.zeros((N,), dtype="int8")
+assert x.size == N, x.size
+assert x.shape == (N,)
+
+# write + read at an index beyond int32 range
+x[N - 3] = 7
+assert int(x[N - 3]) == 7
+assert int(x[2**31 + 1]) == 0
+
+# argmax lands past the boundary
+am = int(mx.np.argmax(x))
+assert am == N - 3, am
+
+# reduction counts every element: int64 ACCUMULATOR, not an int64 COPY
+# (astype would materialize a 17 GB buffer)
+x[0] = 1
+s = int(mx.np.sum(x, dtype="int64"))
+assert s == 8, s
+
+# slice across the boundary
+sl = x[2**31 - 2:2**31 + 2]
+assert sl.shape == (4,)
+print("LARGE-OK")
+"""
+    out = run_in_x64_subprocess(code)
+    assert "LARGE-OK" in out.stdout

@@ -149,13 +149,8 @@ def test_fuzz_integer_dtypes(name):
 def test_int64_flag_subprocess():
     """MXNET_INT64_TENSOR_SIZE=1 turns on 64-bit tensors (fresh process —
     jax x64 must be configured before backend init)."""
-    import os
-    import subprocess
-    import sys
+    from conftest import run_in_x64_subprocess
 
-    env = {**os.environ, "MXNET_INT64_TENSOR_SIZE": "1",
-           "JAX_PLATFORMS": "cpu"}
-    env.pop("PALLAS_AXON_POOL_IPS", None)
     code = (
         "import mxnet_tpu as mx\n"
         "import numpy as onp\n"
@@ -164,9 +159,7 @@ def test_int64_flag_subprocess():
         "y = mx.np.array(onp.array([1.0], 'float64'))\n"
         "assert str(y.dtype) == 'float64', y.dtype\n"
         "print('OK')\n")
-    out = subprocess.run([sys.executable, "-c", code], env=env,
-                         capture_output=True, text=True, timeout=240)
-    assert out.returncode == 0, out.stderr[-800:]
+    out = run_in_x64_subprocess(code, timeout=240)
     assert "OK" in out.stdout
 
 
