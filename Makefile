@@ -15,7 +15,7 @@ SRC      := $(wildcard src/mxtpu/*.cc)
 TESTSRC  := src/mxtpu/tests/test_native.cc
 BUILD    := build
 
-.PHONY: native native-test asan tsan test test-slow test-all ci clean
+.PHONY: native native-test asan tsan test test-par test-slow test-all ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -48,6 +48,12 @@ tsan: $(BUILD)/test_native_tsan
 
 test:
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -q -m "not slow"
+
+test-par:
+	# multi-core boxes: same fast suite, one worker per core, file-level
+	# isolation (verified green under xdist loadfile)
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -q -m "not slow" \
+		-n auto --dist loadfile
 
 test-slow:
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -q -m slow
