@@ -16,7 +16,6 @@ import json
 import logging
 import random
 import warnings
-from math import sqrt
 from numbers import Number
 
 import numpy as np
@@ -25,13 +24,31 @@ from .. import ndarray as nd
 from .image import (Augmenter, CastAug, ColorJitterAug, ColorNormalizeAug,
                     ForceResizeAug, HueJitterAug, ImageIter, LightingAug,
                     RandomGrayAug, ResizeAug, copyMakeBorder, fixed_crop,
-                    _to_host, _wrap)
+                    _imagenet_stats, _PCA_EIGVAL, _PCA_EIGVEC, _to_host,
+                    _wrap)
 
 __all__ = [
     "DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
     "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
     "CreateMultiRandCropAugmenter", "CreateDetAugmenter", "ImageDetIter",
 ]
+
+
+def _span(v):
+    """Normalize a scalar-or-pair range parameter to a (lo, hi) tuple."""
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+def _bad_ranges(area_range, aspect_ratio_range, area_floor):
+    """Validate (area, aspect) range pairs; returns a reason string or ''.
+    ``area_floor`` is the exclusive lower bound on the area ceiling (crop
+    allows any positive area; pad needs expansion, i.e. > 1)."""
+    if area_range[1] <= area_floor or area_range[0] > area_range[1]:
+        return f"invalid area_range {area_range}"
+    if aspect_ratio_range[0] <= 0 \
+            or aspect_ratio_range[0] > aspect_ratio_range[1]:
+        return f"invalid aspect_ratio_range {aspect_ratio_range}"
+    return ""
 
 
 class DetAugmenter:
@@ -77,15 +94,12 @@ class DetRandomSelectAug(DetAugmenter):
 
     def __init__(self, aug_list, skip_prob=0):
         super().__init__(skip_prob=skip_prob)
-        if not isinstance(aug_list, (list, tuple)):
-            aug_list = [aug_list]
-        for aug in aug_list:
-            if not isinstance(aug, DetAugmenter):
-                raise ValueError("Allow DetAugmenter in list only")
-        if not aug_list:
-            skip_prob = 1
+        aug_list = (list(aug_list) if isinstance(aug_list, (list, tuple))
+                    else [aug_list])
+        if any(not isinstance(a, DetAugmenter) for a in aug_list):
+            raise ValueError("Allow DetAugmenter in list only")
         self.aug_list = aug_list
-        self.skip_prob = skip_prob
+        self.skip_prob = skip_prob if aug_list else 1
 
     def dumps(self):
         return [self.__class__.__name__.lower(),
@@ -123,141 +137,118 @@ class DetRandomCropAug(DetAugmenter):
     def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75, 1.33),
                  area_range=(0.05, 1.0), min_eject_coverage=0.3,
                  max_attempts=50):
-        if not isinstance(aspect_ratio_range, (tuple, list)):
-            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
-        if not isinstance(area_range, (tuple, list)):
-            area_range = (area_range, area_range)
-        super().__init__(min_object_covered=min_object_covered,
-                         aspect_ratio_range=aspect_ratio_range,
-                         area_range=area_range,
-                         min_eject_coverage=min_eject_coverage,
-                         max_attempts=max_attempts)
         self.min_object_covered = min_object_covered
         self.min_eject_coverage = min_eject_coverage
         self.max_attempts = max_attempts
-        self.aspect_ratio_range = aspect_ratio_range
-        self.area_range = area_range
-        self.enabled = False
-        if area_range[1] <= 0 or area_range[0] > area_range[1]:
-            warnings.warn("Skip DetRandomCropAug due to invalid area_range: "
-                          f"{area_range}")
-        elif (aspect_ratio_range[0] > aspect_ratio_range[1]
-              or aspect_ratio_range[0] <= 0):
-            warnings.warn("Skip DetRandomCropAug due to invalid "
-                          f"aspect_ratio_range: {aspect_ratio_range}")
-        else:
-            self.enabled = True
+        self.aspect_ratio_range = _span(aspect_ratio_range)
+        self.area_range = _span(area_range)
+        super().__init__(**{k: getattr(self, k) for k in (
+            "min_object_covered", "aspect_ratio_range", "area_range",
+            "min_eject_coverage", "max_attempts")})
+        bad = _bad_ranges(self.area_range, self.aspect_ratio_range,
+                          area_floor=0.0)
+        if bad:
+            warnings.warn(f"DetRandomCropAug disabled: {bad}")
+        self.enabled = not bad
 
     def __call__(self, src, label):
-        crop = self._random_crop_proposal(label, src.shape[0], src.shape[1])
-        if crop:
-            x, y, w, h, label = crop
+        found = self._sample_crop(label, src.shape[0], src.shape[1])
+        if found is not None:
+            x, y, w, h, label = found
             src = fixed_crop(src, x, y, w, h, None)
         return src, label
 
     @staticmethod
-    def _calculate_areas(label):
-        heights = np.maximum(0, label[:, 3] - label[:, 1])
-        widths = np.maximum(0, label[:, 2] - label[:, 0])
-        return heights * widths
+    def _box_areas(boxes):
+        """Areas of (N, 4) xyxy boxes; degenerate boxes count as 0."""
+        wh = np.clip(boxes[:, 2:4] - boxes[:, 0:2], 0, None)
+        return wh[:, 0] * wh[:, 1]
 
-    @staticmethod
-    def _intersect(label, xmin, ymin, xmax, ymax):
-        left = np.maximum(label[:, 0], xmin)
-        right = np.minimum(label[:, 2], xmax)
-        top = np.maximum(label[:, 1], ymin)
-        bot = np.minimum(label[:, 3], ymax)
-        invalid = np.where(np.logical_or(left >= right, top >= bot))[0]
-        out = label.copy()
-        out[:, 0] = left
-        out[:, 1] = top
-        out[:, 2] = right
-        out[:, 3] = bot
-        out[invalid, :] = 0
+    @classmethod
+    def _coverages(cls, boxes, windows):
+        """(K, N) fraction of each object's area inside each window.
+        ``boxes`` (N, 4) and ``windows`` (K, 4) are normalized xyxy."""
+        lo = np.maximum(windows[:, None, 0:2], boxes[None, :, 0:2])
+        hi = np.minimum(windows[:, None, 2:4], boxes[None, :, 2:4])
+        inter = np.clip(hi - lo, 0, None)
+        areas = cls._box_areas(boxes)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            cov = inter[..., 0] * inter[..., 1] / areas[None, :]
+        return np.nan_to_num(cov, nan=0.0, posinf=0.0)
+
+    def _labels_in_crop(self, label, x, y, w, h, height, width):
+        """Re-express labels in the crop frame, clipping to the window and
+        ejecting boxes that kept <= min_eject_coverage of their area.
+        Returns the surviving rows, or None when nothing survives."""
+        boxes = label[:, 1:5]
+        orig = self._box_areas(boxes)
+        scale = np.array([w / width, h / height] * 2)
+        shift = np.array([x / width, y / height] * 2)
+        moved = np.clip((boxes - shift) / scale, 0.0, 1.0)
+        kept = self._box_areas(moved) * scale[0] * scale[1]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            frac = np.where(orig > 0, kept / orig, 0.0)
+        alive = ((moved[:, 2] > moved[:, 0]) & (moved[:, 3] > moved[:, 1])
+                 & (frac > self.min_eject_coverage))
+        if not alive.any():
+            return None
+        out = label[alive].copy()
+        out[:, 1:5] = moved[alive]
         return out
 
-    def _check_satisfy_constraints(self, label, xmin, ymin, xmax, ymax,
-                                   width, height):
-        if (xmax - xmin) * (ymax - ymin) < 2:
-            return False
-        x1 = float(xmin) / width
-        y1 = float(ymin) / height
-        x2 = float(xmax) / width
-        y2 = float(ymax) / height
-        object_areas = self._calculate_areas(label[:, 1:])
-        valid_objects = np.where(object_areas * width * height > 2)[0]
-        if valid_objects.size < 1:
-            return False
-        intersects = self._intersect(label[valid_objects, 1:], x1, y1, x2, y2)
-        coverages = self._calculate_areas(intersects) \
-            / object_areas[valid_objects]
-        coverages = coverages[np.where(coverages > 0)[0]]
-        return (coverages.size > 0
-                and np.amin(coverages) > self.min_object_covered)
+    def _sample_crop(self, label, height, width):
+        """Vectorized constrained-crop search.
 
-    def _update_labels(self, label, crop_box, height, width):
-        xmin = float(crop_box[0]) / width
-        ymin = float(crop_box[1]) / height
-        w = float(crop_box[2]) / width
-        h = float(crop_box[3]) / height
-        out = label.copy()
-        out[:, (1, 3)] -= xmin
-        out[:, (2, 4)] -= ymin
-        out[:, (1, 3)] /= w
-        out[:, (2, 4)] /= h
-        out[:, 1:5] = np.maximum(0, out[:, 1:5])
-        out[:, 1:5] = np.minimum(1, out[:, 1:5])
-        coverage = self._calculate_areas(out[:, 1:]) * w * h \
-            / self._calculate_areas(label[:, 1:])
-        valid = np.logical_and(out[:, 3] > out[:, 1], out[:, 4] > out[:, 2])
-        valid = np.logical_and(valid, coverage > self.min_eject_coverage)
-        valid = np.where(valid)[0]
-        if valid.size < 1:
-            return None
-        return out[valid, :]
-
-    def _random_crop_proposal(self, label, height, width):
+        Instead of the reference's scalar retry loop (semantics per ref
+        detection.py:153-323), every candidate geometry is drawn up front:
+        ``max_attempts`` aspect ratios, each paired with a pixel area
+        sampled uniformly from the interval that keeps the crop inside
+        both ``area_range`` and the image.  Feasibility, the
+        min-object-coverage test, and box ejection are then evaluated as
+        array masks, and the first candidate passing all three wins.
+        Returns (x, y, w, h, new_label) or None."""
         if not self.enabled or height <= 0 or width <= 0:
-            return ()
-        min_area = self.area_range[0] * height * width
-        max_area = self.area_range[1] * height * width
-        for _ in range(self.max_attempts):
-            ratio = random.uniform(*self.aspect_ratio_range)
-            if ratio <= 0:
-                continue
-            h = int(round(sqrt(min_area / ratio)))
-            max_h = int(round(sqrt(max_area / ratio)))
-            if round(max_h * ratio) > width:
-                max_h = int((width + 0.4999999) / ratio)
-            if max_h > height:
-                max_h = height
-            if h > max_h:
-                h = max_h
-            if h < max_h:
-                h = random.randint(h, max_h)
-            w = int(round(h * ratio))
-            assert w <= width
-            area = w * h
-            if area < min_area:
-                h += 1
-                w = int(round(h * ratio))
-                area = w * h
-            if area > max_area:
-                h -= 1
-                w = int(round(h * ratio))
-                area = w * h
-            if not (min_area <= area <= max_area
-                    and 0 <= w <= width and 0 <= h <= height):
-                continue
-            y = random.randint(0, max(0, height - h))
-            x = random.randint(0, max(0, width - w))
-            if self._check_satisfy_constraints(label, x, y, x + w, y + h,
-                                               width, height):
-                new_label = self._update_labels(label, (x, y, w, h),
-                                                height, width)
-                if new_label is not None:
-                    return (x, y, w, h, new_label)
-        return ()
+            return None
+        k = self.max_attempts
+        total = float(width * height)
+        draw = lambda: np.array([random.random() for _ in range(k)])  # noqa: E731
+        lo_r, hi_r = self.aspect_ratio_range
+        r = lo_r + draw() * (hi_r - lo_r)  # aspect = w / h
+        # w = sqrt(A*r), h = sqrt(A/r); fitting inside the image bounds the
+        # sampleable pixel area by W^2/r and H^2*r
+        a_lo = self.area_range[0] * total
+        a_hi = np.minimum(self.area_range[1] * total,
+                          np.minimum(width ** 2 / r, height ** 2 * r))
+        ok = a_hi >= a_lo
+        area = a_lo + draw() * np.maximum(a_hi - a_lo, 0.0)
+        w = np.clip(np.round(np.sqrt(area * r)), 1, width).astype(int)
+        h = np.clip(np.round(np.sqrt(area / r)), 1, height).astype(int)
+        # rounding can nudge w*h past either bound: re-check exactly, and
+        # insist on >= 2 px so a degenerate sliver never wins
+        ok &= ((w * h >= max(a_lo, 2.0))
+               & (w * h <= self.area_range[1] * total))
+        x = np.floor(draw() * (width - w + 1)).astype(int)
+        y = np.floor(draw() * (height - h + 1)).astype(int)
+
+        windows = np.stack([x / width, y / height, (x + w) / width,
+                            (y + h) / height], axis=1)
+        boxes = label[:, 1:5]
+        sized = self._box_areas(boxes) * total > 2  # ignore sub-2px boxes
+        if not sized.any():
+            return None
+        cov = self._coverages(boxes[sized], windows)
+        hit = cov > 0
+        # every object the window touches must be covered enough, and the
+        # window must touch at least one
+        ok &= (hit.any(axis=1)
+               & (np.where(hit, cov, np.inf).min(axis=1)
+                  > self.min_object_covered))
+        for i in np.nonzero(ok)[0]:
+            new = self._labels_in_crop(label, x[i], y[i], w[i], h[i],
+                                       height, width)
+            if new is not None:
+                return int(x[i]), int(y[i]), int(w[i]), int(h[i]), new
+        return None
 
 
 class DetRandomPadAug(DetAugmenter):
@@ -269,71 +260,70 @@ class DetRandomPadAug(DetAugmenter):
         if not isinstance(pad_val, (list, tuple)):
             assert isinstance(pad_val, Number)
             pad_val = (pad_val,)
-        if not isinstance(aspect_ratio_range, (list, tuple)):
-            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
-        if not isinstance(area_range, (tuple, list)):
-            area_range = (area_range, area_range)
-        super().__init__(aspect_ratio_range=aspect_ratio_range,
-                         area_range=area_range, max_attempts=max_attempts,
-                         pad_val=pad_val)
         self.pad_val = pad_val
-        self.aspect_ratio_range = aspect_ratio_range
-        self.area_range = area_range
+        self.aspect_ratio_range = _span(aspect_ratio_range)
+        self.area_range = _span(area_range)
         self.max_attempts = max_attempts
-        self.enabled = False
-        if area_range[1] <= 1.0 or area_range[0] > area_range[1]:
-            warnings.warn("Skip DetRandomPadAug due to invalid parameters: "
-                          f"{area_range}")
-        elif (aspect_ratio_range[0] <= 0
-              or aspect_ratio_range[0] > aspect_ratio_range[1]):
-            warnings.warn("Skip DetRandomPadAug due to invalid "
-                          f"aspect_ratio_range: {aspect_ratio_range}")
-        else:
-            self.enabled = True
+        super().__init__(**{k: getattr(self, k) for k in (
+            "aspect_ratio_range", "area_range", "max_attempts", "pad_val")})
+        # expansion needs area ceiling > 1 (a pad that cannot grow the
+        # canvas is a no-op)
+        bad = _bad_ranges(self.area_range, self.aspect_ratio_range,
+                          area_floor=1.0)
+        if bad:
+            warnings.warn(f"DetRandomPadAug disabled: {bad}")
+        self.enabled = not bad
 
     def __call__(self, src, label):
         height, width = src.shape[:2]
-        pad = self._random_pad_proposal(label, height, width)
-        if pad:
-            x, y, w, h, label = pad
+        found = self._sample_pad(label, height, width)
+        if found is not None:
+            x, y, w, h, label = found
             src = copyMakeBorder(src, y, h - y - height, x, w - x - width,
                                  type=0, values=self.pad_val)
         return src, label
 
-    @staticmethod
-    def _update_labels(label, pad_box, height, width):
-        out = label.copy()
-        out[:, (1, 3)] = (out[:, (1, 3)] * width + pad_box[0]) / pad_box[2]
-        out[:, (2, 4)] = (out[:, (2, 4)] * height + pad_box[1]) / pad_box[3]
-        return out
+    def _sample_pad(self, label, height, width):
+        """Vectorized expansion-canvas search (semantics per ref
+        detection.py:324-417; implementation shares the candidate-mask
+        design of DetRandomCropAug._sample_crop).
 
-    def _random_pad_proposal(self, label, height, width):
+        Canvas constraints: aspect in ``aspect_ratio_range``, area in
+        ``area_range`` x image area, and the canvas must exceed the image
+        by >= 2 px on each axis (a no-op expansion is pointless).  The
+        image lands uniformly inside the first feasible canvas and labels
+        are re-normalized to it.  Returns (x, y, canvas_w, canvas_h,
+        new_label) or None."""
         if not self.enabled or height <= 0 or width <= 0:
-            return ()
-        min_area = self.area_range[0] * height * width
-        max_area = self.area_range[1] * height * width
-        for _ in range(self.max_attempts):
-            ratio = random.uniform(*self.aspect_ratio_range)
-            if ratio <= 0:
-                continue
-            h = int(round(sqrt(min_area / ratio)))
-            max_h = int(round(sqrt(max_area / ratio)))
-            if round(h * ratio) < width:
-                h = int((width + 0.499999) / ratio)
-            if h < height:
-                h = height
-            if h > max_h:
-                h = max_h
-            if h < max_h:
-                h = random.randint(h, max_h)
-            w = int(round(h * ratio))
-            if (h - height) < 2 or (w - width) < 2:
-                continue
-            y = random.randint(0, max(0, h - height))
-            x = random.randint(0, max(0, w - width))
-            new_label = self._update_labels(label, (x, y, w, h), height, width)
-            return (x, y, w, h, new_label)
-        return ()
+            return None
+        k = self.max_attempts
+        total = float(width * height)
+        draw = lambda: np.array([random.random() for _ in range(k)])  # noqa: E731
+        lo_r, hi_r = self.aspect_ratio_range
+        r = lo_r + draw() * (hi_r - lo_r)  # canvas aspect = w / h
+        # canvas_w = sqrt(A*r) >= width+2 and canvas_h = sqrt(A/r) >=
+        # height+2 put a ratio-dependent floor under the sampleable area
+        a_lo = np.maximum(self.area_range[0] * total,
+                          np.maximum((width + 2) ** 2 / r,
+                                     (height + 2) ** 2 * r))
+        a_hi = self.area_range[1] * total
+        ok = a_hi >= a_lo
+        area = a_lo + draw() * np.maximum(a_hi - a_lo, 0.0)
+        cw = np.maximum(np.round(np.sqrt(area * r)), width + 2).astype(int)
+        ch = np.maximum(np.round(np.sqrt(area / r)), height + 2).astype(int)
+        ok &= cw * ch <= a_hi  # rounding slack, same re-check as the crop
+        x = np.floor(draw() * (cw - width + 1)).astype(int)
+        y = np.floor(draw() * (ch - height + 1)).astype(int)
+        idx = np.nonzero(ok)[0]
+        if idx.size == 0:
+            return None
+        i = idx[0]
+        canvas = np.array([cw[i], ch[i]] * 2, np.float64)
+        offset = np.array([x[i], y[i]] * 2, np.float64)
+        size = np.array([width, height] * 2, np.float64)
+        out = label.copy()
+        out[:, 1:5] = (label[:, 1:5] * size + offset) / canvas
+        return int(x[i]), int(y[i]), int(cw[i]), int(ch[i]), out
 
 
 def CreateMultiRandCropAugmenter(min_object_covered=0.1,
@@ -343,26 +333,14 @@ def CreateMultiRandCropAugmenter(min_object_covered=0.1,
                                  skip_prob=0):
     """Broadcast scalar/list params into N crop augmenters under one random
     selector (ref detection.py:418-482)."""
-    def align_parameters(params):
-        out_params = []
-        num = 1
-        for p in params:
-            if not isinstance(p, list):
-                p = [p]
-            out_params.append(p)
-            num = max(num, len(p))
-        for k, p in enumerate(out_params):
-            if len(p) != num:
-                assert len(p) == 1
-                out_params[k] = p * num
-        return out_params
-
-    aligned = align_parameters([min_object_covered, aspect_ratio_range,
-                                area_range, min_eject_coverage, max_attempts])
-    augs = [DetRandomCropAug(min_object_covered=moc, aspect_ratio_range=arr,
-                             area_range=ar, min_eject_coverage=mec,
-                             max_attempts=ma)
-            for moc, arr, ar, mec, ma in zip(*aligned)]
+    cols = [p if isinstance(p, list) else [p]
+            for p in (min_object_covered, aspect_ratio_range, area_range,
+                      min_eject_coverage, max_attempts)]
+    n = max(len(c) for c in cols)
+    assert all(len(c) in (1, n) for c in cols), \
+        "list parameters must share one length"
+    augs = [DetRandomCropAug(*(c[i % len(c)] for c in cols))
+            for i in range(n)]
     return DetRandomSelectAug(augs, skip_prob=skip_prob)
 
 
@@ -375,49 +353,38 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
                        pad_val=(127, 127, 127)):
     """Standard SSD-style detection augmentation chain
     (ref detection.py:483-624)."""
-    auglist = []
-    if resize > 0:
-        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
-    if rand_crop > 0:
-        auglist.append(CreateMultiRandCropAugmenter(
-            min_object_covered, aspect_ratio_range, area_range,
-            min_eject_coverage, max_attempts, skip_prob=(1 - rand_crop)))
-    if rand_mirror > 0:
-        auglist.append(DetHorizontalFlipAug(0.5))
-    if rand_pad > 0:
-        pad_aug = DetRandomPadAug(aspect_ratio_range, (1.0, area_range[1]),
-                                  max_attempts, pad_val)
-        auglist.append(DetRandomSelectAug([pad_aug], 1 - rand_pad))
-    auglist.append(DetBorrowAug(ForceResizeAug((data_shape[2], data_shape[1]),
-                                               inter_method)))
-    auglist.append(DetBorrowAug(CastAug()))
-    if brightness or contrast or saturation:
-        auglist.append(DetBorrowAug(ColorJitterAug(brightness, contrast,
-                                                   saturation)))
-    if hue:
-        auglist.append(DetBorrowAug(HueJitterAug(hue)))
-    if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
-    if rand_gray > 0:
-        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    chain = []
 
-    if mean is True:
-        mean = np.array([123.68, 116.28, 103.53], np.float32)
-    elif mean is not None:
-        mean = np.asarray(mean)
-        assert mean.shape[0] in (1, 3)
-    if std is True:
-        std = np.array([58.395, 57.12, 57.375], np.float32)
-    elif std is not None:
-        std = np.asarray(std)
-        assert std.shape[0] in (1, 3)
+    def borrow(aug):
+        chain.append(DetBorrowAug(aug))
+
+    if resize > 0:
+        borrow(ResizeAug(resize, inter_method))
+    if rand_crop > 0:
+        chain.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range, area_range,
+            min_eject_coverage, max_attempts, skip_prob=1 - rand_crop))
+    if rand_mirror > 0:
+        chain.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        chain.append(DetRandomSelectAug(
+            [DetRandomPadAug(aspect_ratio_range, (1.0, area_range[1]),
+                             max_attempts, pad_val)], 1 - rand_pad))
+    borrow(ForceResizeAug((data_shape[2], data_shape[1]), inter_method))
+    borrow(CastAug())
+    if brightness or contrast or saturation:
+        borrow(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        borrow(HueJitterAug(hue))
+    if pca_noise > 0:
+        borrow(LightingAug(pca_noise, _PCA_EIGVAL, _PCA_EIGVEC))
+    if rand_gray > 0:
+        borrow(RandomGrayAug(rand_gray))
+    mean = _imagenet_stats(mean, (123.68, 116.28, 103.53))
+    std = _imagenet_stats(std, (58.395, 57.12, 57.375))
     if mean is not None or std is not None:
-        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
-    return auglist
+        borrow(ColorNormalizeAug(mean, std))
+    return chain
 
 
 class ImageDetIter(ImageIter):
@@ -451,50 +418,48 @@ class ImageDetIter(ImageIter):
         self.label_shape = label_shape
 
     def _check_valid_label(self, label):
-        if len(label.shape) != 2 or label.shape[1] < 5:
+        if label.ndim != 2 or label.shape[1] < 5:
             raise RuntimeError(
-                "Label with shape (1+, 5+) required, %s received."
-                % str(label))
-        valid = np.where(np.logical_and(
-            label[:, 0] >= 0,
-            np.logical_and(label[:, 3] > label[:, 1],
-                           label[:, 4] > label[:, 2])))[0]
-        if valid.size < 1:
+                f"Label with shape (1+, 5+) required, {label} received.")
+        ok = ((label[:, 0] >= 0) & (label[:, 3] > label[:, 1])
+              & (label[:, 4] > label[:, 2]))
+        if not ok.any():
             raise RuntimeError("Invalid label occurs.")
 
     def _estimate_label_shape(self):
-        max_count, label = 0, None
+        """One full pass over the source to size the static label pad:
+        (max object count, object width)."""
+        widest, ncols = 0, 5
         self.reset()
         try:
             while True:
-                label, _ = self.next_sample()
-                label = self._parse_label(label)
-                max_count = max(max_count, label.shape[0])
+                raw, _ = self.next_sample()
+                objs = self._parse_label(raw)
+                widest = max(widest, objs.shape[0])
+                ncols = objs.shape[1]
         except StopIteration:
             pass
         self.reset()
-        return (max_count, label.shape[1] if label is not None else 5)
+        return (widest, ncols)
 
     def _parse_label(self, label):
-        """Parse [hdr_w, obj_w, ...hdr..., (id x1 y1 x2 y2 ...)*] raw labels
+        """Decode a flat [hdr_w, obj_w, ...header..., (cls x1 y1 x2 y2
+        ...)*] record into an (N, obj_w) array of its valid objects
         (ref detection.py:716-739)."""
         if isinstance(label, nd.NDArray):
             label = label.asnumpy()
-        raw = np.asarray(label, np.float32).ravel()
-        if raw.size < 7:
-            raise RuntimeError("Label shape is invalid: " + str(raw.shape))
-        header_width = int(raw[0])
-        obj_width = int(raw[1])
-        if (raw.size - header_width) % obj_width != 0:
-            raise RuntimeError(
-                "Label shape %s inconsistent with annotation width %d."
-                % (str(raw.shape), obj_width))
-        out = np.reshape(raw[header_width:], (-1, obj_width))
-        valid = np.where(np.logical_and(out[:, 3] > out[:, 1],
-                                        out[:, 4] > out[:, 2]))[0]
-        if valid.size < 1:
+        flat = np.asarray(label, np.float32).ravel()
+        if flat.size < 7:
+            raise RuntimeError(f"Label shape is invalid: {flat.shape}")
+        hdr, ow = int(flat[0]), int(flat[1])
+        if (flat.size - hdr) % ow:
+            raise RuntimeError(f"Label shape {flat.shape} inconsistent "
+                               f"with annotation width {ow}.")
+        objs = flat[hdr:].reshape(-1, ow)
+        keep = (objs[:, 3] > objs[:, 1]) & (objs[:, 4] > objs[:, 2])
+        if not keep.any():
             raise RuntimeError("Encounter sample with no valid label.")
-        return out[valid, :]
+        return objs[keep]
 
     def reshape(self, data_shape=None, label_shape=None):
         from ..io.io import DataDesc
@@ -511,32 +476,29 @@ class ImageDetIter(ImageIter):
             self.label_shape = label_shape
 
     def _batchify(self, batch_data, batch_label, start=0):
-        i = start
-        batch_size = self.batch_size
+        filled = start
         try:
-            while i < batch_size:
-                label, s = self.next_sample()
-                data = self.imdecode(s)
+            while filled < self.batch_size:
+                raw, s = self.next_sample()
+                img = self.imdecode(s)
                 try:
-                    self.check_valid_image([data])
-                    label = self._parse_label(label)
-                    data, label = self.augmentation_transform(data, label)
-                    self._check_valid_label(label)
+                    self.check_valid_image([img])
+                    objs = self._parse_label(raw)
+                    img, objs = self.augmentation_transform(img, objs)
+                    self._check_valid_label(objs)
                 except RuntimeError as e:
                     logging.debug("Invalid image, skipping: %s", str(e))
                     continue
-                assert i < batch_size, \
-                    "Batch size must be multiples of augmenter output length"
-                batch_data[i] = self.postprocess_data(data)
-                num_object = label.shape[0]
-                batch_label[i][:num_object] = label[:, :batch_label.shape[2]]
-                if num_object < batch_label[i].shape[0]:
-                    batch_label[i][num_object:] = -1
-                i += 1
+                batch_data[filled] = self.postprocess_data(img)
+                row = batch_label[filled]
+                # an undersized label pad must fail loudly, not drop boxes
+                row[:objs.shape[0]] = objs[:, :row.shape[1]]
+                row[objs.shape[0]:] = -1
+                filled += 1
         except StopIteration:
-            if not i:
-                raise StopIteration
-        return i
+            if not filled:
+                raise
+        return filled
 
     def _empty_label(self):
         # padded object rows are -1 (ref detection.py:625); batch assembly
@@ -566,71 +528,57 @@ class ImageDetIter(ImageIter):
         (ref detection.py:draw_next; PIL drawing replaces cv2)."""
         from PIL import ImageDraw, Image
 
-        count = 0
-        try:
-            while True:
-                label, s = self.next_sample()
-                data = self.imdecode(s)
-                try:
-                    self.check_valid_image([data])
-                    label = self._parse_label(label)
-                except RuntimeError as e:
-                    logging.debug("Invalid image, skipping: %s", str(e))
-                    continue
-                count += 1
-                data, label = self.augmentation_transform(data, label)
-                image = np.asarray(_to_host(data)[0], np.float32)
-                if std is True:
-                    std = np.array([58.395, 57.12, 57.375])
-                if std is not None:
-                    image = image * np.asarray(std)
-                if mean is True:
-                    mean = np.array([123.68, 116.28, 103.53])
-                if mean is not None:
-                    image = image + np.asarray(mean)
-                if clip:
-                    image = np.clip(image, 0, 255)
-                image = image.astype(np.uint8)
-                pil = Image.fromarray(image)
-                drw = ImageDraw.Draw(pil)
-                height, width = image.shape[:2]
-                for i in range(label.shape[0]):
-                    x1 = int(label[i, 1] * width)
-                    if x1 < 0:
-                        continue
-                    y1 = int(label[i, 2] * height)
-                    x2 = int(label[i, 3] * width)
-                    y2 = int(label[i, 4] * height)
-                    bc = tuple(int(v) for v in (
-                        np.random.rand(3) * 255 if not color else color))
-                    drw.rectangle([x1, y1, x2, y2], outline=bc,
-                                  width=thickness)
-                    if id2labels is not None:
-                        cls_id = int(label[i, 0])
-                        if cls_id in id2labels:
-                            drw.text((x1 + 5, y1 + 5),
-                                     str(id2labels[cls_id]), fill=bc)
-                yield np.asarray(pil)
-        except StopIteration:
-            if not count:
+        if std is True:
+            std = np.array([58.395, 57.12, 57.375])
+        if mean is True:
+            mean = np.array([123.68, 116.28, 103.53])
+        while True:
+            try:
+                raw, s = self.next_sample()
+            except StopIteration:
                 return
+            img = self.imdecode(s)
+            try:
+                self.check_valid_image([img])
+                objs = self._parse_label(raw)
+            except RuntimeError as e:
+                logging.debug("Invalid image, skipping: %s", str(e))
+                continue
+            img, objs = self.augmentation_transform(img, objs)
+            pixels = np.asarray(_to_host(img)[0], np.float32)
+            if std is not None:
+                pixels = pixels * np.asarray(std)
+            if mean is not None:
+                pixels = pixels + np.asarray(mean)
+            if clip:
+                pixels = np.clip(pixels, 0, 255)
+            canvas = Image.fromarray(pixels.astype(np.uint8))
+            drw = ImageDraw.Draw(canvas)
+            height, width = pixels.shape[:2]
+            scale = np.array([width, height, width, height], np.float32)
+            for cls_id, *corners in objs[:, :5]:
+                x1, y1, x2, y2 = (np.asarray(corners) * scale).astype(int)
+                if x1 < 0:
+                    continue
+                bc = tuple(int(v) for v in (
+                    color if color else np.random.rand(3) * 255))
+                drw.rectangle([x1, y1, x2, y2], outline=bc, width=thickness)
+                if id2labels and int(cls_id) in id2labels:
+                    drw.text((x1 + 5, y1 + 5), str(id2labels[int(cls_id)]),
+                             fill=bc)
+            yield np.asarray(canvas)
 
     def sync_label_shape(self, it, verbose=False):
         """Grow both iterators' label pad to the common max
         (ref detection.py:sync_label_shape)."""
         assert isinstance(it, ImageDetIter), \
             "Synchronize with invalid iterator."
-        train_label_shape = self.label_shape
-        val_label_shape = it.label_shape
-        assert train_label_shape[1] == val_label_shape[1], \
-            "object width mismatch."
-        max_count = max(train_label_shape[0], val_label_shape[0])
-        if max_count > train_label_shape[0]:
-            self.reshape(None, (max_count, train_label_shape[1]))
-        if max_count > val_label_shape[0]:
-            it.reshape(None, (max_count, val_label_shape[1]))
-        if verbose and max_count > min(train_label_shape[0],
-                                       val_label_shape[0]):
-            logging.info("Resized label_shape to (%d, %d).",
-                         max_count, train_label_shape[1])
+        mine, theirs = self.label_shape, it.label_shape
+        assert mine[1] == theirs[1], "object width mismatch."
+        rows = max(mine[0], theirs[0])
+        for target, shape in ((self, mine), (it, theirs)):
+            if rows > shape[0]:
+                target.reshape(None, (rows, shape[1]))
+        if verbose and rows > min(mine[0], theirs[0]):
+            logging.info("Resized label_shape to (%d, %d).", rows, mine[1])
         return it

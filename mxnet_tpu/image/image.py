@@ -95,21 +95,18 @@ def _pil_interp(code):
 def _get_interp_method(interp, sizes=()):
     """Resolve interp code 9 (auto by size) / 10 (random) to a concrete
     method 0-4 (ref image.py:302-356 semantics)."""
-    if interp == 9:
-        if sizes:
-            assert len(sizes) == 4
-            oh, ow, nh, nw = sizes
-            if nh > oh and nw > ow:
-                return 2
-            if nh < oh and nw < ow:
-                return 3
-            return 1
-        return 2
     if interp == 10:
         return random.randint(0, 4)
-    if interp not in (0, 1, 2, 3, 4):
-        raise ValueError("Unknown interp method %d" % interp)
-    return interp
+    if interp == 9:
+        if not sizes:
+            return 2
+        assert len(sizes) == 4
+        oh, ow, nh, nw = sizes
+        growing, shrinking = (nh > oh and nw > ow), (nh < oh and nw < ow)
+        return 2 if growing else 3 if shrinking else 1
+    if interp in (0, 1, 2, 3, 4):
+        return interp
+    raise ValueError(f"Unknown interp method {interp}")
 
 
 def imdecode(buf, flag=1, to_rgb=True, out_type="ndarray"):
@@ -182,14 +179,17 @@ def imresize(src, w, h, interp=2):
 
 
 def scale_down(src_size, size):
-    """Shrink crop (w, h) to fit inside src (w, h), keeping aspect
-    (ref image.py:214-247)."""
-    w, h = size
+    """Shrink a requested crop (w, h) to fit inside the source (w, h)
+    without changing its aspect ratio (ref image.py:214-247).  Each axis
+    is fitted in turn, pinning the binding axis to the source extent
+    exactly (a single uniform factor would lose a pixel to float
+    truncation on the pinned axis)."""
     sw, sh = src_size
-    if sh < h:
-        w, h = float(w * sh) / h, sh
-    if sw < w:
-        w, h = sw, float(h * sw) / w
+    w, h = size
+    if h > sh:
+        w, h = w * sh / h, sh
+    if w > sw:
+        w, h = sw, h * sw / w
     return int(w), int(h)
 
 
@@ -235,10 +235,9 @@ def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
     (ref image.py:419-450)."""
     arr, was_nd = _to_host(src)
     out = arr[y0:y0 + h, x0:x0 + w]
-    if size is not None and (w, h) != size:
-        sizes = (h, w, size[1], size[0])
-        out, _ = _to_host(imresize(out, *size,
-                                   interp=_get_interp_method(interp, sizes)))
+    if size is not None and tuple(size) != (w, h):
+        method = _get_interp_method(interp, (h, w, size[1], size[0]))
+        out, _ = _to_host(imresize(out, *size, interp=method))
     return _wrap(out, was_nd)
 
 
@@ -288,17 +287,21 @@ def random_size_crop(src, size, area, ratio, interp=2, **kwargs):
     assert not kwargs, "unexpected keyword arguments for `random_size_crop`."
     if isinstance(area, numbers.Number):
         area = (area, 1.0)
-    for _ in range(10):
-        target_area = random.uniform(area[0], area[1]) * src_area
-        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
-        new_ratio = np.exp(random.uniform(*log_ratio))
-        new_w = int(round(np.sqrt(target_area * new_ratio)))
-        new_h = int(round(np.sqrt(target_area / new_ratio)))
-        if new_w <= w and new_h <= h:
-            x0 = random.randint(0, w - new_w)
-            y0 = random.randint(0, h - new_h)
-            out = fixed_crop(arr, x0, y0, new_w, new_h, size, interp)
-            return _wrap(out, was_nd), (x0, y0, new_w, new_h)
+    # draw every candidate geometry up front (log-uniform aspect, uniform
+    # area fraction) and take the first that fits; degrade to center_crop
+    # when none does — same candidate-mask idiom as detection._sample_crop
+    k = 10
+    frac = np.array([random.uniform(area[0], area[1]) for _ in range(k)])
+    logr = (np.log(ratio[0]), np.log(ratio[1]))
+    aspect = np.exp([random.uniform(*logr) for _ in range(k)])
+    cands_w = np.round(np.sqrt(src_area * frac * aspect)).astype(int)
+    cands_h = np.round(np.sqrt(src_area * frac / aspect)).astype(int)
+    for i in np.nonzero((cands_w <= w) & (cands_h <= h))[0]:
+        new_w, new_h = int(cands_w[i]), int(cands_h[i])
+        x0 = random.randint(0, w - new_w)
+        y0 = random.randint(0, h - new_h)
+        out = fixed_crop(arr, x0, y0, new_w, new_h, size, interp)
+        return _wrap(out, was_nd), (x0, y0, new_w, new_h)
     out, box = center_crop(arr, size, interp)
     return _wrap(_to_host(out)[0], was_nd), box
 
@@ -499,12 +502,11 @@ class RandomSizedCropAug(Augmenter):
     def __init__(self, size, area, ratio, interp=2, **kwargs):
         super().__init__(size=size, area=area, ratio=ratio, interp=interp)
         self.size = size
-        if "min_area" in kwargs:
-            area = kwargs.pop("min_area")
-        self.area = area
+        self.area = kwargs.pop("min_area", area)
         self.ratio = ratio
         self.interp = interp
-        assert not kwargs, "unexpected keyword arguments for `RandomSizedCropAug`."
+        assert not kwargs, \
+            "unexpected keyword arguments for `RandomSizedCropAug`."
 
     def __call__(self, src):
         return random_size_crop(src, self.size, self.area, self.ratio,
@@ -601,13 +603,12 @@ class HueJitterAug(Augmenter):
 
     def __call__(self, src):
         arr, was_nd = _to_host(src)
-        alpha = random.uniform(-self.hue, self.hue)
-        u = np.cos(alpha * np.pi)
-        w = np.sin(alpha * np.pi)
-        bt = np.array([[1.0, 0.0, 0.0],
-                       [0.0, u, -w],
-                       [0.0, w, u]], np.float32)
-        t = (self._ITYIQ @ bt @ self._TYIQ).T
+        theta = random.uniform(-self.hue, self.hue) * np.pi
+        c, s = np.cos(theta), np.sin(theta)
+        rot = np.array([[1.0, 0.0, 0.0],
+                        [0.0, c, -s],
+                        [0.0, s, c]], np.float32)
+        t = (self._ITYIQ @ rot @ self._TYIQ).T
         return _wrap(arr.astype(np.float32) @ t, was_nd)
 
 
@@ -615,14 +616,10 @@ class ColorJitterAug(RandomOrderAug):
     """Brightness+contrast+saturation in random order (ref image.py:1049-1071)."""
 
     def __init__(self, brightness, contrast, saturation):
-        ts = []
-        if brightness > 0:
-            ts.append(BrightnessJitterAug(brightness))
-        if contrast > 0:
-            ts.append(ContrastJitterAug(contrast))
-        if saturation > 0:
-            ts.append(SaturationJitterAug(saturation))
-        super().__init__(ts)
+        kinds = ((brightness, BrightnessJitterAug),
+                 (contrast, ContrastJitterAug),
+                 (saturation, SaturationJitterAug))
+        super().__init__([cls(v) for v, cls in kinds if v > 0])
 
 
 class LightingAug(Augmenter):
@@ -698,6 +695,24 @@ class CastAug(Augmenter):
         return np.asarray(src).astype(self.typ)
 
 
+# AlexNet PCA lighting statistics (ImageNet RGB eigen-decomposition)
+_PCA_EIGVAL = np.array([55.46, 4.794, 1.148])
+_PCA_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]])
+
+
+def _imagenet_stats(v, default):
+    """mean/std argument: True selects the ImageNet constants; arrays are
+    validated and passed through; None stays None."""
+    if v is True:
+        return np.array(default, np.float32)
+    if v is not None:
+        v = _to_host(v)[0]
+        assert v.shape[0] in (1, 3)
+    return v
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
@@ -705,53 +720,32 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     """Build the standard augmenter list (ref image.py:1171-1284):
     resize → crop → mirror → cast → color jitter → hue → pca → gray →
     normalize."""
-    auglist = []
-    if resize > 0:
-        auglist.append(ResizeAug(resize, inter_method))
-
     crop_size = (data_shape[2], data_shape[1])
     if rand_resize:
         assert rand_crop
-        auglist.append(RandomSizedCropAug(crop_size, 0.08,
-                                          (3.0 / 4.0, 4.0 / 3.0),
-                                          inter_method))
+        cropper = RandomSizedCropAug(crop_size, 0.08, (3.0 / 4.0, 4.0 / 3.0),
+                                     inter_method)
     elif rand_crop:
-        auglist.append(RandomCropAug(crop_size, inter_method))
+        cropper = RandomCropAug(crop_size, inter_method)
     else:
-        auglist.append(CenterCropAug(crop_size, inter_method))
-
-    if rand_mirror:
-        auglist.append(HorizontalFlipAug(0.5))
-
-    auglist.append(CastAug())
-
+        cropper = CenterCropAug(crop_size, inter_method)
+    chain = ([ResizeAug(resize, inter_method)] if resize > 0 else []) \
+        + [cropper] \
+        + ([HorizontalFlipAug(0.5)] if rand_mirror else []) \
+        + [CastAug()]
     if brightness or contrast or saturation:
-        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+        chain.append(ColorJitterAug(brightness, contrast, saturation))
     if hue:
-        auglist.append(HueJitterAug(hue))
+        chain.append(HueJitterAug(hue))
     if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+        chain.append(LightingAug(pca_noise, _PCA_EIGVAL, _PCA_EIGVEC))
     if rand_gray > 0:
-        auglist.append(RandomGrayAug(rand_gray))
-
-    if mean is True:
-        mean = np.array([123.68, 116.28, 103.53], np.float32)
-    elif mean is not None:
-        mean = _to_host(mean)[0]
-        assert mean.shape[0] in (1, 3)
-    if std is True:
-        std = np.array([58.395, 57.12, 57.375], np.float32)
-    elif std is not None:
-        std = _to_host(std)[0]
-        assert std.shape[0] in (1, 3)
+        chain.append(RandomGrayAug(rand_gray))
+    mean = _imagenet_stats(mean, (123.68, 116.28, 103.53))
+    std = _imagenet_stats(std, (58.395, 57.12, 57.375))
     if mean is not None or std is not None:
-        auglist.append(ColorNormalizeAug(mean, std))
-
-    return auglist
+        chain.append(ColorNormalizeAug(mean, std))
+    return chain
 
 
 # ---------------------------------------------------------------------------
@@ -786,60 +780,49 @@ class ImageIter:
         # not be mixed with it, and (c) driving next() from inside another
         # engine op (PrefetchingIter) could starve a 1-worker pool.
         prefetch = bool(kwargs.pop("prefetch", False))
+        self.imgrec = self.imgidx = None
         if path_imgrec:
             if path_imgidx:
-                self.imgrec = recordio.MXIndexedRecordIO(path_imgidx,
-                                                         path_imgrec, "r")
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    path_imgidx, path_imgrec, "r")
                 self.imgidx = list(self.imgrec.keys)
             else:
                 self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
-                self.imgidx = None
-        else:
-            self.imgrec = None
 
+        entries, order = {}, []
         if path_imglist:
             logging.info("ImageIter: loading image list %s...", path_imglist)
             with open(path_imglist) as fin:
-                imglist = {}
-                imgkeys = []
-                for line in iter(fin.readline, ""):
-                    line = line.strip().split("\t")
-                    label = np.array(line[1:-1], dtype=dtype)
-                    key = int(line[0])
-                    imglist[key] = (label, line[-1])
-                    imgkeys.append(key)
-                self.imglist = imglist
+                for line in fin:
+                    cols = line.strip().split("\t")
+                    key = int(cols[0])
+                    entries[key] = (np.array(cols[1:-1], dtype=dtype),
+                                    cols[-1])
+                    order.append(key)
+            self.imglist = entries
         elif isinstance(imglist, list):
-            result = {}
-            imgkeys = []
-            for index, img in enumerate(imglist, 1):
-                key = str(index)
-                if len(img) > 2:
-                    label = np.array(img[:-1], dtype=dtype)
-                elif isinstance(img[0], numbers.Number):
-                    label = np.array([img[0]], dtype=dtype)
-                else:
-                    label = np.array(img[0], dtype=dtype)
-                result[key] = (label, img[-1])
-                imgkeys.append(str(key))
-            self.imglist = result
+            for index, item in enumerate(imglist, 1):
+                raw = (item[:-1] if len(item) > 2
+                       else [item[0]] if isinstance(item[0], numbers.Number)
+                       else item[0])
+                entries[str(index)] = (np.array(raw, dtype=dtype), item[-1])
+                order.append(str(index))
+            self.imglist = entries
         else:
             self.imglist = None
         self.path_root = path_root
 
         self.check_data_shape(data_shape)
-        self.provide_data = [DataDesc(data_name, (batch_size,) + data_shape)]
-        if label_width > 1:
-            self.provide_label = [DataDesc(label_name,
-                                           (batch_size, label_width))]
-        else:
-            self.provide_label = [DataDesc(label_name, (batch_size,))]
         self.batch_size = batch_size
         self.data_shape = data_shape
         self.label_width = label_width
         self.shuffle = shuffle
+        self.provide_data = [DataDesc(data_name, (batch_size,) + data_shape)]
+        lshape = ((batch_size, label_width) if label_width > 1
+                  else (batch_size,))
+        self.provide_label = [DataDesc(label_name, lshape)]
         if self.imgrec is None:
-            self.seq = imgkeys
+            self.seq = order
         elif shuffle or num_parts > 1 or path_imgidx:
             assert self.imgidx is not None
             self.seq = self.imgidx
@@ -848,20 +831,15 @@ class ImageIter:
 
         if num_parts > 1:
             assert part_index < num_parts
-            N = len(self.seq)
-            C = N // num_parts
-            self.seq = self.seq[part_index * C:(part_index + 1) * C]
-        if aug_list is None:
-            self.auglist = CreateAugmenter(data_shape, **kwargs)
-        else:
-            self.auglist = aug_list
+            per = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * per:][:per]
+        self.auglist = (CreateAugmenter(data_shape, **kwargs)
+                        if aug_list is None else aug_list)
         self.cur = 0
         self._allow_read = True
         self.last_batch_handle = last_batch_handle
         self.num_image = len(self.seq) if self.seq is not None else None
-        self._cache_data = None
-        self._cache_label = None
-        self._cache_idx = None
+        self._cache_data = self._cache_label = self._cache_idx = None
         # one-batch lookahead on the native engine (opt-in; see the
         # prefetch pop above and _schedule_prefetch)
         self._prefetch = prefetch
@@ -874,9 +852,13 @@ class ImageIter:
         # an in-flight prefetched batch belongs to the pre-reset sequence
         if getattr(self, "_pf_var", None) is not None:
             self._drain_prefetch()
-        if self.seq is not None and self.shuffle:
+        if self.shuffle and self.seq is not None:
             random.shuffle(self.seq)
-        if self.last_batch_handle != "roll_over" or self._cache_data is None:
+        # a cached roll_over tail survives the reset; rewinding would
+        # duplicate its samples
+        keep_tail = (self.last_batch_handle == "roll_over"
+                     and self._cache_data is not None)
+        if not keep_tail:
             if self.imgrec is not None:
                 self.imgrec.reset()
             self.cur = 0
@@ -885,71 +867,65 @@ class ImageIter:
     def hard_reset(self):
         if getattr(self, "_pf_var", None) is not None:
             self._drain_prefetch()
-        if self.seq is not None and self.shuffle:
+        if self.shuffle and self.seq is not None:
             random.shuffle(self.seq)
         if self.imgrec is not None:
             self.imgrec.reset()
         self.cur = 0
         self._allow_read = True
-        self._cache_data = None
-        self._cache_label = None
-        self._cache_idx = None
+        self._cache_data = self._cache_label = self._cache_idx = None
 
     # -- sample level -------------------------------------------------------
     def next_sample(self):
         """Return (label, raw image bytes) for the next sample."""
         from ..io import recordio
 
-        if self._allow_read is False:
+        if not self._allow_read:
             raise StopIteration
-        if self.seq is not None:
-            if self.cur < self.num_image:
-                idx = self.seq[self.cur]
-            else:
+        if self.seq is None:
+            # pure sequential record stream, no index
+            rec = self.imgrec.read()
+            if rec is None:
                 if self.last_batch_handle != "discard":
-                    self.cur = 0
+                    self.imgrec.reset()
                 raise StopIteration
-            self.cur += 1
-            if self.imgrec is not None:
-                s = self.imgrec.read_idx(idx)
-                header, img = recordio.unpack(s)
-                if self.imglist is None:
-                    return header.label, img
-                return self.imglist[idx][0], img
-            label, fname = self.imglist[idx]
-            return label, self.read_image(fname)
-        s = self.imgrec.read()
-        if s is None:
+            header, img = recordio.unpack(rec)
+            return header.label, img
+        if self.cur >= self.num_image:
             if self.last_batch_handle != "discard":
-                self.imgrec.reset()
+                self.cur = 0
             raise StopIteration
-        header, img = recordio.unpack(s)
-        return header.label, img
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is not None:
+            header, img = recordio.unpack(self.imgrec.read_idx(idx))
+            label = (header.label if self.imglist is None
+                     else self.imglist[idx][0])
+            return label, img
+        label, fname = self.imglist[idx]
+        return label, self.read_image(fname)
 
     def _batchify(self, batch_data, batch_label, start=0):
-        i = start
-        batch_size = self.batch_size
+        filled = start
         try:
-            while i < batch_size:
+            while filled < self.batch_size:
                 label, s = self.next_sample()
-                data = self.imdecode(s)
+                img = self.imdecode(s)
                 try:
-                    self.check_valid_image(data)
+                    self.check_valid_image(img)
                 except RuntimeError as e:
                     logging.debug("Invalid image, skipping: %s", str(e))
                     continue
-                data = self.augmentation_transform(data)
-                assert i < batch_size, \
-                    "Batch size must be multiples of augmenter output length"
-                batch_data[i] = self.postprocess_data(data)
-                lab = np.asarray(label, np.float32).reshape(-1)
-                batch_label[i] = lab[0] if batch_label.ndim == 1 \
-                    else lab[:batch_label.shape[1]]
-                i += 1
+                batch_data[filled] = self.postprocess_data(
+                    self.augmentation_transform(img))
+                row = np.asarray(label, np.float32).reshape(-1)
+                batch_label[filled] = (row[0] if batch_label.ndim == 1
+                                       else row[:batch_label.shape[1]])
+                filled += 1
         except StopIteration:
-            if not i:
-                raise StopIteration
-        return i
+            if not filled:
+                raise
+        return filled
 
     def _produce(self):
         """Decode + augment one batch (host work; runs on the native
