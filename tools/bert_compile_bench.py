@@ -1,0 +1,142 @@
+"""BERT-base ShardedTrainer compile-cost breakdown (round-3 verdict weak #4).
+
+Times the three host-side phases of bringing up the one-jit LAMB train
+step — trace (``jit.lower``), XLA compile, first device step — plus the
+steady-state step time, on whatever backend is live.  On TPU this answers
+"is a 40-60s compile acceptable on the real chip"; on CPU it is the
+x-check that keeps the measurement comparable across rounds (PERF.md
+round-3 table).
+
+Usage: python tools/bert_compile_bench.py [--full] [--optimizer lamb]
+       [--multi-tensor] [--json out.json]
+--full forces BERT-base 12x768 even on CPU (slow; the default downsizes
+off-TPU the same way bench.py does).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true")
+    ap.add_argument("--optimizer", default="lamb")
+    ap.add_argument("--multi-tensor", action="store_true")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.bert import BERTForPretrain, get_bert
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    full = args.full or on_tpu
+    if full:
+        batch, seq, npred = 32, 128, 20
+        bert = get_bert("bert_12_768_12", vocab_size=30522, max_length=512)
+    else:
+        batch, seq, npred = 4, 32, 4
+        bert = get_bert("bert_12_768_12", vocab_size=1000, max_length=64,
+                        num_layers=2, units=64, hidden_size=128,
+                        num_heads=2)
+
+    mx.random.seed(0)
+    net = BERTForPretrain(bert)
+    net.initialize(mx.init.Xavier())
+    vocab = net._vocab_size
+    rs = onp.random.RandomState(0)
+    tokens = rs.randint(0, vocab, size=(2, seq)).astype("int32")
+    net(mx.np.array(tokens), mx.np.array(onp.zeros((2, seq), "int32")),
+        mx.np.array(onp.full((2,), seq, "int32")),
+        mx.np.array(rs.randint(0, seq, size=(2, npred)).astype("int32")))
+
+    def loss_fn(pred, y):
+        mlm_scores, nsp_scores = pred
+        mlm_y, nsp_y = y
+        lp = jax.nn.log_softmax(mlm_scores.astype(jnp.float32), -1)
+        mlm = -jnp.take_along_axis(lp, mlm_y[..., None], -1)[..., 0]
+        lp2 = jax.nn.log_softmax(nsp_scores.astype(jnp.float32), -1)
+        nsp = -jnp.take_along_axis(lp2, nsp_y[:, None], -1)[:, 0]
+        return jnp.mean(mlm, axis=-1) + nsp
+
+    mesh = make_mesh({"dp": -1}, devices=jax.devices()[:1])
+    trainer = ShardedTrainer(
+        net, loss_fn, mesh=mesh, optimizer=args.optimizer,
+        learning_rate=1e-4, weight_decay=0.01,
+        compute_dtype=jnp.bfloat16 if on_tpu else None,
+        multi_tensor=args.multi_tensor)
+
+    x = (rs.randint(0, vocab, size=(batch, seq)).astype("int32"),
+         onp.zeros((batch, seq), "int32"),
+         onp.full((batch,), seq, "int32"),
+         rs.randint(0, seq, size=(batch, npred)).astype("int32"))
+    y = (rs.randint(0, vocab, size=(batch, npred)).astype("int32"),
+         rs.randint(0, 2, size=(batch,)).astype("int32"))
+
+    xd, yd = trainer._put(x), trainer._put(y)
+    lr = jnp.float32(trainer.learning_rate)
+    sargs = (trainer.pvals, trainer.avals, trainer._key, trainer.opt_state,
+             trainer._t + 1, lr, trainer._scale_state, xd, yd)
+
+    t0 = time.perf_counter()
+    lowered = trainer._step_fn.lower(*sargs)
+    t_trace = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t0
+
+    # donated args: rebuild fresh state for execution
+    t0 = time.perf_counter()
+    out = compiled(*sargs)
+    float(out[-1])
+    t_first = time.perf_counter() - t0
+
+    pvals, mutated, opt_state, scale, loss = out
+    t, avals, key = trainer._t + 1, trainer.avals, trainer._key
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        t += 1
+        pvals, mutated, opt_state, scale, loss = compiled(
+            pvals, avals, key, opt_state, t, lr, scale, xd, yd)
+    float(loss)
+    t_step = (time.perf_counter() - t0) / args.steps
+
+    nparams = len(trainer.pvals)
+    flops = None
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        flops = ca.get("flops")
+    except Exception:
+        pass
+    res = {"backend": dev.platform, "device": dev.device_kind,
+           "model": "bert_12_768_12" if full else "bert_tiny",
+           "optimizer": args.optimizer,
+           "multi_tensor": args.multi_tensor, "n_params": nparams,
+           "batch": batch, "seq": seq,
+           "trace_s": round(t_trace, 2), "compile_s": round(t_compile, 2),
+           "first_step_s": round(t_first, 2),
+           "step_s": round(t_step, 4),
+           "samples_per_sec": round(batch / t_step, 2),
+           "xla_gflop_per_step": round(flops / 1e9, 1) if flops else None,
+           "verdict": ("compile>60s: investigate scan-over-layers/remat"
+                       if t_compile > 60 else "compile cost acceptable")}
+    print(json.dumps(res))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

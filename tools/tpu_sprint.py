@@ -1,0 +1,94 @@
+"""The TPU measurement sprint (round-4 verdict item #1).
+
+Run the moment the relay lives (tools/relay_watch.sh does this
+automatically).  Captures, in strict priority order — the relay has died
+mid-round twice, so the most valuable numbers come first:
+
+  1. all five BASELINE configs      (bench.py default run)
+  2. ResNet-50 b256                 (PERF.md lever 1)
+  3. ResNet-50 s2d stem             (PERF.md lever 2)
+  4. ResNet-50 b256 + s2d           (levers combined)
+  5. per-conv utilization table     (tools/convbench.py)
+  6. BERT LAMB compile/step costs   (tools/bert_compile_bench.py)
+
+Each stage runs in its own subprocess with a hard timeout and its result
+is flushed to sprint_results/ immediately, so a mid-sprint wedge keeps
+everything already measured.  Exit 0 iff stage 1 produced a non-null TPU
+resnet50 number.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(ROOT, "sprint_results")
+
+
+def run(name, cmd, timeout, env=None):
+    os.makedirs(OUT, exist_ok=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=ROOT, timeout=timeout,
+                           capture_output=True, text=True, env=env)
+        rec = {"stage": name, "rc": p.returncode,
+               "secs": round(time.time() - t0, 1),
+               "stdout_tail": p.stdout[-4000:],
+               "stderr_tail": p.stderr[-1500:]}
+    except subprocess.TimeoutExpired:
+        rec = {"stage": name, "rc": None, "secs": round(time.time() - t0, 1),
+               "error": f"timeout after {timeout}s"}
+    with open(os.path.join(OUT, f"{name}.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+    print(f"[sprint] {name}: rc={rec.get('rc')} in {rec['secs']}s",
+          flush=True)
+    return rec
+
+
+def last_json(rec):
+    for line in reversed(rec.get("stdout_tail", "").splitlines()):
+        try:
+            return json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return None
+
+
+def main():
+    py = sys.executable
+    env = dict(os.environ)
+
+    r1 = run("bench_all", [py, "bench.py"], timeout=7200)
+    j = last_json(r1)
+    got_tpu = bool(j and j.get("value") is not None
+                   and not j.get("skipped"))
+    if j:
+        with open(os.path.join(OUT, "BENCH_live.json"), "w") as f:
+            json.dump(j, f, indent=1)
+    if not got_tpu:
+        print("[sprint] stage 1 produced no TPU number; continuing "
+              "anyway (partial credit)", flush=True)
+
+    e = dict(env, MXNET_BENCH_BATCH="256")
+    run("resnet_b256", [py, "bench.py", "--config", "resnet50"],
+        timeout=2400, env=e)
+    e = dict(env, MXNET_BENCH_STEM="s2d")
+    run("resnet_s2d", [py, "bench.py", "--config", "resnet50"],
+        timeout=2400, env=e)
+    e = dict(env, MXNET_BENCH_BATCH="256", MXNET_BENCH_STEM="s2d")
+    run("resnet_b256_s2d", [py, "bench.py", "--config", "resnet50"],
+        timeout=2400, env=e)
+    run("convbench", [py, "tools/convbench.py", "--json",
+                      os.path.join(OUT, "convbench_table.json")],
+        timeout=3600)
+    run("bert_compile", [py, "tools/bert_compile_bench.py", "--json",
+                         os.path.join(OUT, "bert_compile.json")],
+        timeout=3600)
+    return 0 if got_tpu else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
