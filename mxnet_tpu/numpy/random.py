@@ -111,13 +111,15 @@ def _simple(sampler):
 
 
 beta = _simple(lambda k, a, b, shp, dt: jax.random.beta(k, a, b, shp or None, dt))
-gamma = _simple(lambda k, a, shp, dt, scale=1.0: jax.random.gamma(k, a, shp or None, dt) * scale)
 exponential = _simple(lambda k, scale, shp, dt: jax.random.exponential(k, shp or None, dt) * scale) \
     if True else None
 laplace = _simple(lambda k, loc, scale, shp, dt: jax.random.laplace(k, shp or None, dt) * scale + loc)
 logistic = _simple(lambda k, loc, scale, shp, dt: jax.random.logistic(k, shp or None, dt) * scale + loc)
 gumbel = _simple(lambda k, loc, scale, shp, dt: jax.random.gumbel(k, shp or None, dt) * scale + loc)
-pareto = _simple(lambda k, a, shp, dt: jax.random.pareto(k, a, shp or None, dt))
+# numpy/reference semantics are Pareto II (Lomax, support [0, inf),
+# ref python/mxnet/numpy/random.py:687); jax.random.pareto is classical
+# Pareto on [1, inf) — shift it
+pareto = _simple(lambda k, a, shp, dt: jax.random.pareto(k, a, shp or None, dt) - 1.0)
 rayleigh = _simple(lambda k, scale, shp, dt: jnp.sqrt(-2.0 * jnp.log(
     jax.random.uniform(k, shp or jnp.shape(scale), dt, minval=jnp.finfo(dt).tiny))) * scale)
 weibull = _simple(lambda k, a, shp, dt: jax.random.weibull_min(k, 1.0, a, shp or None, dt))
@@ -129,6 +131,18 @@ def exponential(scale=1.0, size=None, dtype=None, ctx=None, device=None):  # noq
     dt = jnp.dtype(dtype) if dtype else jnp.float32
     shp = _shape(size) if size is not None else jnp.shape(_val(scale))
     return NDArray(jax.random.exponential(next_key(), shp, dt) * _val(scale), ctx=ctx or device)
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, device=None):
+    """numpy-compatible (shape, scale, size) signature (ref
+    python/mxnet/numpy/random.py gamma); the _simple wrapper cannot carry
+    the optional positional scale."""
+    dt = jnp.dtype(dtype) if dtype else jnp.float32
+    shp = _shape(size) if size is not None else jnp.broadcast_shapes(
+        jnp.shape(_val(shape)), jnp.shape(_val(scale)))
+    res = jax.random.gamma(next_key(), _val(shape), shp or None, dt) \
+        * _val(scale)
+    return NDArray(res, ctx=ctx or device)
 
 
 def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, ctx=None, device=None):

@@ -503,12 +503,15 @@ def _length_mask(x, length, axis):
 
 
 def softmax_cross_entropy(logits, labels, sparse_label: bool = True, axis: int = -1):
-    """Fused CE (ref: src/operator/nn/softmax-inl.h + loss layer usage)."""
+    """Fused CE summed over the batch, 1-element output like the reference
+    op (ref: src/operator/loss_binary_op.cc softmax_cross_entropy)."""
     logp = jax.nn.log_softmax(logits, axis=axis)
     if sparse_label:
         lab = labels.astype(jnp.int32)
-        return -jnp.take_along_axis(logp, lab[..., None], axis=axis).squeeze(axis)
-    return -(labels * logp).sum(axis=axis)
+        per = -jnp.take_along_axis(logp, lab[..., None], axis=axis).squeeze(axis)
+    else:
+        per = -(labels * logp).sum(axis=axis)
+    return per.sum().reshape((1,))
 
 
 # -- dropout -----------------------------------------------------------------

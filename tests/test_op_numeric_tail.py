@@ -1,0 +1,982 @@
+"""Value-level assertions for the op tail (round-3 verdict item #5).
+
+`tools/op_smoke.py` proves each reference-registry op EXECUTES; this suite
+raises the bar to numeric correctness for the long tail that had no value
+assertions anywhere else — optimizer update kernels, legacy linalg, the
+legacy tensor ops, and the `_npi_*` stragglers.  Table-driven like the
+reference's per-op strategy (ref tests/python/unittest/test_numpy_op.py,
+test_optimizer.py): each CASES entry is keyed by the REFERENCE registry
+name (tools/op_asserted.py attributes coverage by these exact names) and
+returns (got, want[, tol]) pairs computed by an independent numpy oracle.
+
+Oracles re-derive the documented formulas in plain numpy (float64 where it
+matters) — the framework path runs through jnp/XLA, so agreement checks
+the kernel, not the oracle's own code path.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+np_ = mx.np
+npx = mx.npx
+nd = mx.nd
+
+_RS = onp.random.RandomState(42)
+W0 = _RS.rand(3, 4).astype("float32") - 0.5
+G0 = _RS.rand(3, 4).astype("float32") - 0.5
+M0 = _RS.rand(3, 4).astype("float32") - 0.5
+V0 = _RS.rand(3, 4).astype("float32") + 0.1
+A2 = _RS.rand(4, 4).astype("float32")
+SPD = (A2 @ A2.T + 4 * onp.eye(4)).astype("float32")
+T3 = _RS.rand(2, 3, 4).astype("float32")
+IDX = onp.array([0, 2, 1], "int64")
+
+
+def N(x):
+    if isinstance(x, (list, tuple)):
+        return [N(v) for v in x]
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def arr(a, dt=None):
+    a = onp.asarray(a)
+    return np_.array(a.astype(dt) if dt else a)
+
+
+# ---------------------------------------------------------------------------
+# numpy oracles for the optimizer update formulas
+# (ref src/operator/optimizer_op.cc:313-398, contrib/adamw-inl.h)
+# ---------------------------------------------------------------------------
+
+def _o_sgd(w, g, lr=0.1, wd=0.01):
+    return w - lr * (g + wd * w)
+
+
+def _o_sgd_mom(w, g, m, lr=0.1, momentum=0.9, wd=0.01):
+    m2 = momentum * m - lr * (g + wd * w)
+    return w + m2, m2
+
+
+def _o_adam(w, g, m, v, lr=0.1, b1=0.9, b2=0.999, eps=1e-8, wd=0.01):
+    g = g + wd * w
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    return w - lr * m2 / (onp.sqrt(v2) + eps), m2, v2
+
+
+def _o_nag(w, g, m, lr=0.1, momentum=0.9, wd=0.01):
+    g = g + wd * w
+    m2 = momentum * m + g
+    return w - lr * (g + momentum * m2), m2
+
+
+def _o_lamb1(w, g, m, v, t=3, b1=0.9, b2=0.999, eps=1e-6, wd=0.01):
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    mh, vh = m2 / (1 - b1 ** t), v2 / (1 - b2 ** t)
+    return mh / (onp.sqrt(vh) + eps) + wd * w, m2, v2
+
+
+def _o_lamb2(w, upd, lr=0.1):
+    r1 = onp.linalg.norm(w)
+    r2 = onp.linalg.norm(upd)
+    ratio = 1.0 if (r1 == 0 or r2 == 0) else r1 / r2
+    return w - lr * ratio * upd
+
+
+def _o_adamw(w, g, m, v, lr=0.1, eta=1.0, b1=0.9, b2=0.999, eps=1e-8,
+             wd=0.01):
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    return w - eta * (lr * m2 / (onp.sqrt(v2) + eps) + wd * w), m2, v2
+
+
+def _opt_fresh():
+    """(w, g, m, v) fresh NDArray quadruple for mutating update ops."""
+    return arr(W0), arr(G0), arr(M0), arr(V0)
+
+
+def _case_sgd_update():
+    w, g, _, _ = _opt_fresh()
+    return [(nd.sgd_update(w, g, lr=0.1, wd=0.01), _o_sgd(W0, G0))]
+
+
+def _case_sgd_mom_update():
+    w, g, m, _ = _opt_fresh()
+    out = nd.sgd_mom_update(w, g, m, lr=0.1, momentum=0.9, wd=0.01)
+    ew, em = _o_sgd_mom(W0, G0, M0)
+    return [(out, ew), (m, em)]
+
+
+def _case_adam_update():
+    w, g, m, v = _opt_fresh()
+    out = nd.adam_update(w, g, m, v, lr=0.1, wd=0.01)
+    ew, em, ev = _o_adam(W0, G0, M0, V0)
+    return [(out, ew), (m, em), (v, ev)]
+
+
+def _case_nag_mom_update():
+    w, g, m, _ = _opt_fresh()
+    out = nd.nag_mom_update(w, g, m, lr=0.1, momentum=0.9, wd=0.01)
+    ew, em = _o_nag(W0, G0, M0)
+    return [(out, ew), (m, em)]
+
+
+def _case_signsgd_update():
+    w, g, _, _ = _opt_fresh()
+    return [(nd.signsgd_update(w, g, lr=0.1, wd=0.01),
+             W0 - 0.1 * (onp.sign(G0) + 0.01 * W0))]
+
+
+def _case_signum_update():
+    w, g, m, _ = _opt_fresh()
+    out = nd.signum_update(w, g, m, lr=0.1, momentum=0.9, wd=0.01)
+    gg = G0 + 0.01 * W0
+    em = 0.9 * M0 - 0.1 * gg
+    return [(out, W0 + 0.1 * onp.sign(em)), (m, em)]
+
+
+def _case_rmsprop_update():
+    w, g, _, n = _opt_fresh()  # V0 state: squared-grad accum must be >= 0
+    out = nd.rmsprop_update(w, g, n, lr=0.1, gamma1=0.95, wd=0.01)
+    gg = G0 + 0.01 * W0
+    en = 0.95 * V0 + 0.05 * gg * gg
+    return [(out, W0 - 0.1 * gg / onp.sqrt(en + 1e-8)), (n, en)]
+
+
+def _case_rmspropalex_update():
+    w, gr, g2, n = _opt_fresh()
+    delta = arr(onp.zeros_like(W0))
+    out = nd.rmspropalex_update(w, gr, n, g2, delta, lr=0.1, wd=0.01)
+    gg = G0 + 0.01 * W0
+    en = 0.95 * V0 + 0.05 * gg * gg
+    eg = 0.95 * M0 + 0.05 * gg
+    ed = -0.1 * gg / onp.sqrt(en - eg * eg + 1e-8)
+    return [(out, W0 + ed), (n, en), (g2, eg), (delta, ed)]
+
+
+def _case_ftrl_update():
+    w, g, z, n = _opt_fresh()
+    n._set_data(arr(V0)._data)  # n must be >= 0
+    z._set_data(arr(M0)._data)
+    out = nd.ftrl_update(w, g, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.01)
+    ez = M0 + G0 - (onp.sqrt(V0 + G0 * G0) - onp.sqrt(V0)) * W0 / 0.1
+    en = V0 + G0 * G0
+    d = -onp.sign(ez) * onp.maximum(onp.abs(ez) - 0.01, 0.0)
+    ew = d / ((1.0 + onp.sqrt(en)) / 0.1 + 0.01)
+    return [(out, ew), (z, ez), (n, en)]
+
+
+def _case_ftml_update():
+    w, g, d, v = _opt_fresh()
+    z = arr(onp.zeros_like(W0))
+    d._set_data(arr(onp.abs(M0))._data)
+    v._set_data(arr(V0)._data)
+    out = nd.ftml_update(w, g, d, v, z, lr=0.1, t=2, wd=0.01)
+    b1, b2, eps = 0.6, 0.999, 1e-8
+    gg = G0 + 0.01 * W0
+    ev = b2 * V0 + (1 - b2) * gg * gg
+    edt = (1 - b1 ** 2) / 0.1 * (onp.sqrt(ev / (1 - b2 ** 2)) + eps)
+    ez = b1 * 0.0 + (1 - b1) * gg - (edt - b1 * onp.abs(M0)) * W0
+    return [(out, -ez / edt), (d, edt), (v, ev), (z, ez)]
+
+
+def _case_lamb_update_phase1():
+    w, g, m, v = _opt_fresh()
+    out = nd.lamb_update_phase1(w, g, m, v, t=3, wd=0.01)
+    eu, em, ev = _o_lamb1(W0, G0, M0, V0)
+    return [(out, eu, 2e-5), (m, em), (v, ev)]
+
+
+def _case_lamb_update_phase2():
+    eu, _, _ = _o_lamb1(W0, G0, M0, V0)
+    w = arr(W0)
+    r1 = arr([onp.linalg.norm(W0)])
+    r2 = arr([onp.linalg.norm(eu)])
+    out = nd.lamb_update_phase2(w, arr(eu), r1, r2, lr=0.1)
+    return [(out, _o_lamb2(W0, eu), 2e-5)]
+
+
+def _case_mp_sgd_update():
+    w16 = arr(W0, "float16")
+    w32, g = arr(W0), arr(G0)
+    out = nd.mp_sgd_update(w16, g, w32, lr=0.1, wd=0.01)
+    ew = _o_sgd(W0, G0)
+    return [(w32, ew), (out, ew.astype("float16"), 1e-3)]
+
+
+def _case_mp_sgd_mom_update():
+    w16, g, m, _ = _opt_fresh()
+    w16 = arr(W0, "float16")
+    w32 = arr(W0)
+    out = nd.mp_sgd_mom_update(w16, g, m, w32, lr=0.1, momentum=0.9,
+                               wd=0.01)
+    ew, em = _o_sgd_mom(W0, G0, M0)
+    return [(w32, ew), (m, em), (out, ew.astype("float16"), 1e-3)]
+
+
+def _case_mp_nag_mom_update():
+    w16 = arr(W0, "float16")
+    g, m, w32 = arr(G0), arr(M0), arr(W0)
+    out = nd.mp_nag_mom_update(w16, g, m, w32, lr=0.1, momentum=0.9,
+                               wd=0.01)
+    ew, em = _o_nag(W0, G0, M0)
+    return [(w32, ew), (m, em), (out, ew.astype("float16"), 1e-3)]
+
+
+def _case_mp_lamb():
+    w16 = arr(W0, "float16")
+    g, m, v, w32 = arr(G0), arr(M0), arr(V0), arr(W0)
+    upd = nd.mp_lamb_update_phase1(w16, g, m, v, w32, t=3, wd=0.01)
+    eu, em, ev = _o_lamb1(W0, G0, M0, V0)
+    r1 = arr([onp.linalg.norm(W0)])
+    r2 = arr([onp.linalg.norm(N(upd))])
+    out = nd.mp_lamb_update_phase2(w16, upd, r1, r2, w32, lr=0.1)
+    ew = _o_lamb2(W0, eu)
+    return [(upd, eu, 2e-5), (m, em), (v, ev), (w32, ew, 2e-5),
+            (out, ew.astype("float16"), 1e-3)]
+
+
+def _pairs(n=3):
+    ws = [_RS.rand(2, 3).astype("float32") - 0.5 for _ in range(n)]
+    gs = [_RS.rand(2, 3).astype("float32") - 0.5 for _ in range(n)]
+    return ws, gs
+
+
+_MW, _MG = _pairs()
+_MM = [onp.zeros_like(w) for w in _MW]
+_MV = [onp.full_like(w, 0.2) for w in _MW]
+
+
+def _case_multi_sgd_update():
+    outs = nd.multi_sgd_update([arr(w) for w in _MW],
+                               [arr(g) for g in _MG], lr=0.1, wd=0.01)
+    return [(o, _o_sgd(w, g)) for o, w, g in zip(outs, _MW, _MG)]
+
+
+def _case_multi_sgd_mom_update():
+    moms = [arr(m) for m in _MM]
+    outs = nd.multi_sgd_mom_update([arr(w) for w in _MW],
+                                   [arr(g) for g in _MG], moms,
+                                   lr=0.1, momentum=0.9, wd=0.01)
+    pairs = []
+    for o, m, w, g, m0 in zip(outs, moms, _MW, _MG, _MM):
+        ew, em = _o_sgd_mom(w, g, m0)
+        pairs += [(o, ew), (m, em)]
+    return pairs
+
+
+def _case_multi_mp_sgd_update():
+    w32s = [arr(w) for w in _MW]
+    outs = nd.multi_mp_sgd_update([arr(w, "float16") for w in _MW],
+                                  [arr(g) for g in _MG], w32s,
+                                  lr=0.1, wd=0.01)
+    return [(w32, _o_sgd(w, g)) for w32, w, g in zip(w32s, _MW, _MG)] + \
+        [(o, _o_sgd(w, g).astype("float16"), 1e-3)
+         for o, w, g in zip(outs, _MW, _MG)]
+
+
+def _case_multi_mp_sgd_mom_update():
+    w32s = [arr(w) for w in _MW]
+    moms = [arr(m) for m in _MM]
+    nd.multi_mp_sgd_mom_update([arr(w, "float16") for w in _MW],
+                               [arr(g) for g in _MG], moms, w32s,
+                               lr=0.1, momentum=0.9, wd=0.01)
+    pairs = []
+    for w32, m, w, g, m0 in zip(w32s, moms, _MW, _MG, _MM):
+        ew, em = _o_sgd_mom(w, g, m0)
+        pairs += [(w32, ew), (m, em)]
+    return pairs
+
+
+def _case_multi_adamw_update():
+    ms = [arr(m) for m in _MM]
+    vs = [arr(v) for v in _MV]
+    outs = nd.multi_adamw_update([arr(w) for w in _MW],
+                                 [arr(g) for g in _MG], ms, vs,
+                                 lr=0.1, wd=0.01)
+    pairs = []
+    for o, w, g, m0, v0 in zip(outs, _MW, _MG, _MM, _MV):
+        ew, _, _ = _o_adamw(w, g, m0, v0)
+        pairs.append((o, ew))
+    return pairs
+
+
+def _case_multi_mp_adamw_update():
+    ms = [arr(m) for m in _MM]
+    vs = [arr(v) for v in _MV]
+    w32s = [arr(w) for w in _MW]
+    nd.multi_mp_adamw_update([arr(w, "float16") for w in _MW],
+                             [arr(g) for g in _MG], ms, vs, w32s,
+                             lr=0.1, wd=0.01)
+    return [(w32, _o_adamw(w, g, m0, v0)[0])
+            for w32, w, g, m0, v0 in zip(w32s, _MW, _MG, _MM, _MV)]
+
+
+def _o_full_lamb(w, g, m0, v0, lr=0.1, t=1, wd=0.0):
+    eu, _, _ = _o_lamb1(w, g, m0, v0, t=t, wd=wd)
+    return _o_lamb2(w, eu, lr=lr)
+
+
+def _case_multi_lamb_update():
+    ms = [arr(m) for m in _MM]
+    vs = [arr(v) for v in _MV]
+    outs = nd.multi_lamb_update([arr(w) for w in _MW],
+                                [arr(g) for g in _MG], ms, vs, lr=0.1)
+    return [(o, _o_full_lamb(w, g, m0, v0), 2e-5)
+            for o, w, g, m0, v0 in zip(outs, _MW, _MG, _MM, _MV)]
+
+
+def _case_multi_mp_lamb_update():
+    ms = [arr(m) for m in _MM]
+    vs = [arr(v) for v in _MV]
+    w32s = [arr(w) for w in _MW]
+    nd.multi_mp_lamb_update([arr(w, "float16") for w in _MW],
+                            [arr(g) for g in _MG], ms, vs, w32s, lr=0.1)
+    return [(w32, _o_full_lamb(w, g, m0, v0), 2e-5)
+            for w32, w, g, m0, v0 in zip(w32s, _MW, _MG, _MM, _MV)]
+
+
+def _case_multi_lans_update():
+    ms = [arr(m) for m in _MM]
+    vs = [arr(v) for v in _MV]
+    outs = nd.multi_lans_update([arr(w) for w in _MW],
+                                [arr(g) for g in _MG], ms, vs, lr=0.1)
+    pairs = []
+    for o, w, g, m0, v0 in zip(outs, _MW, _MG, _MM, _MV):
+        gu = g / max(onp.linalg.norm(g), 1e-12)
+        pairs.append((o, _o_full_lamb(w, gu, m0, v0), 2e-5))
+    return pairs
+
+
+def _case_multi_mp_lans_update():
+    ms = [arr(m) for m in _MM]
+    vs = [arr(v) for v in _MV]
+    w32s = [arr(w) for w in _MW]
+    nd.multi_mp_lans_update([arr(w, "float16") for w in _MW],
+                            [arr(g) for g in _MG], ms, vs, w32s, lr=0.1)
+    pairs = []
+    for w32, w, g, m0, v0 in zip(w32s, _MW, _MG, _MM, _MV):
+        gu = g / max(onp.linalg.norm(g), 1e-12)
+        pairs.append((w32, _o_full_lamb(w, gu, m0, v0), 2e-5))
+    return pairs
+
+
+def _case_preloaded_multi_sgd_update():
+    lrs = arr([0.1, 0.2, 0.3])
+    wds = arr([0.01, 0.0, 0.02])
+    outs = nd.preloaded_multi_sgd_update([arr(w) for w in _MW],
+                                         [arr(g) for g in _MG], lrs, wds)
+    return [(o, _o_sgd(w, g, lr=lr, wd=wd))
+            for o, w, g, lr, wd in zip(outs, _MW, _MG, [0.1, 0.2, 0.3],
+                                       [0.01, 0.0, 0.02])]
+
+
+def _case_preloaded_multi_sgd_mom_update():
+    moms = [arr(m) for m in _MM]
+    outs = nd.preloaded_multi_sgd_mom_update(
+        [arr(w) for w in _MW], [arr(g) for g in _MG], moms,
+        arr([0.1, 0.2, 0.3]), arr([0.01, 0.0, 0.02]), momentum=0.9)
+    pairs = []
+    for o, m, w, g, m0, lr, wd in zip(outs, moms, _MW, _MG, _MM,
+                                      [0.1, 0.2, 0.3], [0.01, 0.0, 0.02]):
+        ew, em = _o_sgd_mom(w, g, m0, lr=lr, wd=wd)
+        pairs += [(o, ew), (m, em)]
+    return pairs
+
+
+def _case_preloaded_multi_mp_sgd_update():
+    w32s = [arr(w) for w in _MW]
+    nd.preloaded_multi_mp_sgd_update(
+        [arr(w, "float16") for w in _MW], [arr(g) for g in _MG], w32s,
+        arr([0.1, 0.2, 0.3]), arr([0.01, 0.0, 0.02]))
+    return [(w32, _o_sgd(w, g, lr=lr, wd=wd))
+            for w32, w, g, lr, wd in zip(w32s, _MW, _MG, [0.1, 0.2, 0.3],
+                                         [0.01, 0.0, 0.02])]
+
+
+def _case_preloaded_multi_mp_sgd_mom_update():
+    w32s = [arr(w) for w in _MW]
+    moms = [arr(m) for m in _MM]
+    nd.preloaded_multi_mp_sgd_mom_update(
+        [arr(w, "float16") for w in _MW], [arr(g) for g in _MG], moms,
+        w32s, arr([0.1, 0.2, 0.3]), arr([0.01, 0.0, 0.02]), momentum=0.9)
+    pairs = []
+    for w32, w, g, m0, lr, wd in zip(w32s, _MW, _MG, _MM, [0.1, 0.2, 0.3],
+                                     [0.01, 0.0, 0.02]):
+        ew, _ = _o_sgd_mom(w, g, m0, lr=lr, wd=wd)
+        pairs.append((w32, ew))
+    return pairs
+
+
+def _case_adamw_update():
+    w, g, m, v = _opt_fresh()
+    out = nd.adamw_update(w, g, m, v, lr=0.1, eta=0.5, wd=0.01)
+    ew, em, ev = _o_adamw(W0, G0, M0, V0, eta=0.5)
+    return [(out, ew), (m, em), (v, ev)]
+
+
+def _case_multi_lars():
+    lrs = onp.array([0.1, 0.2], "float32")
+    wsq = onp.array([4.0, 0.0], "float32")
+    gsq = onp.array([1.0, 1.0], "float32")
+    wds = onp.array([1e-3, 1e-3], "float32")
+    out = nd.multi_lars(arr(lrs), arr(wsq), arr(gsq), arr(wds),
+                        eta=0.001, eps=1e-8)
+    wn, gn = onp.sqrt(wsq), onp.sqrt(gsq)
+    ratio = 0.001 * wn / (gn + wds * wn + 1e-8)
+    want = lrs * onp.where(wn > 0, onp.where(gn > 0, ratio, 1.0), 1.0)
+    return [(out, want)]
+
+
+def _case_multi_sum_sq():
+    out = npx.multi_sum_sq(arr(W0), arr(G0))
+    want = [(W0 ** 2).sum(), (G0 ** 2).sum()]
+    if isinstance(out, (list, tuple)):
+        return [(o, w, 1e-4) for o, w in zip(out, want)]
+    return [(out, onp.array(want), 1e-4)]
+
+
+def _case_multi_all_finite():
+    ok = npx.multi_all_finite(arr(W0), arr(G0))
+    bad = npx.multi_all_finite(arr(W0), arr([[onp.inf, 1.0]]))
+    return [(ok, onp.array([1], "int32")), (bad, onp.array([0], "int32"))]
+
+
+def _case_reset_arrays():
+    a, b = arr(W0), arr(G0)
+    nd.reset_arrays([a, b])
+    return [(a, onp.zeros_like(W0)), (b, onp.zeros_like(G0))]
+
+
+def _case_group_adagrad_update():
+    w, g, _, _ = _opt_fresh()
+    h = arr(onp.full((3, 1), 0.5, "float32"))
+    out = nd.group_adagrad_update(w, g, h, lr=0.1)
+    eh = 0.5 + (G0 * G0).mean(axis=1, keepdims=True)
+    ew = W0 - 0.1 * G0 / (onp.sqrt(eh) + 1e-5)
+    return [(out, ew), (h, eh)]
+
+
+# ---------------------------------------------------------------------------
+# legacy linalg (ref src/operator/tensor/la_op.cc _linalg_*)
+# ---------------------------------------------------------------------------
+
+_L = onp.linalg.cholesky(SPD.astype("float64")).astype("float32")
+
+
+def _case_linalg():
+    LA = nd.linalg
+    spd, lo = arr(SPD), arr(_L)
+    a, b = arr(A2), arr(W0)  # (4,4) x; (3,4)
+    tri_lo = onp.tril(A2) + 2 * onp.eye(4, dtype="float32")
+    cases = [
+        ("_linalg_potrf", LA.potrf(spd), _L, 1e-4),
+        ("_linalg_potri", LA.potri(lo),
+         onp.linalg.inv(SPD.astype("float64")).astype("float32"), 1e-3),
+        ("_linalg_gemm", LA.gemm(b, a, arr(onp.ones((3, 4), "float32")),
+                                 alpha=2.0, beta=3.0),
+         2.0 * (W0 @ A2) + 3.0 * onp.ones((3, 4)), 1e-4),
+        ("_linalg_gemm2", LA.gemm2(b, a, alpha=0.5), 0.5 * (W0 @ A2), 1e-4),
+        ("_linalg_syrk", LA.syrk(b, alpha=1.5), 1.5 * (W0 @ W0.T), 1e-4),
+        ("_linalg_trmm", LA.trmm(arr(tri_lo), arr(A2)), tri_lo @ A2, 1e-4),
+        ("_linalg_trsm", LA.trsm(arr(tri_lo), arr(tri_lo @ A2)), A2, 1e-3),
+        ("_linalg_sumlogdiag", LA.sumlogdiag(spd),
+         onp.log(onp.diag(SPD)).sum(), 1e-4),
+        ("_linalg_extractdiag", LA.extractdiag(a), onp.diag(A2), 1e-6),
+        ("_linalg_makediag", LA.makediag(arr(onp.diag(A2))),
+         onp.diag(onp.diag(A2)), 1e-6),
+        ("_linalg_extracttrian", LA.extracttrian(a),
+         onp.tril(A2)[onp.tril_indices(4)], 1e-6),
+        ("_linalg_inverse", LA.inverse(spd),
+         onp.linalg.inv(SPD.astype("float64")).astype("float32"), 1e-3),
+        ("_linalg_slogdet", LA.slogdet(spd),
+         onp.linalg.slogdet(SPD.astype("float64")), 1e-3),
+    ]
+    out = []
+    for name, got, want, tol in cases:
+        if name == "_linalg_slogdet":
+            sign, logdet = got
+            out += [(sign, want[0], tol), (logdet, want[1], tol)]
+        else:
+            out.append((got, want, tol))
+    # syevd: eigen-decomposition equality up to order/sign — compare
+    # reconstruction and sorted eigenvalues
+    u, lam = nd.linalg.syevd(spd)
+    un, ln = N(u), N(lam)
+    out.append((onp.sort(ln), onp.sort(
+        onp.linalg.eigvalsh(SPD.astype("float64"))).astype("float32"),
+        1e-3))
+    out.append((un.T @ onp.diag(ln) @ un, SPD, 1e-2))
+    # gelqf: A = L @ Q with orthonormal rows of Q
+    lq, q = nd.linalg.gelqf(b)
+    out.append((N(lq) @ N(q), W0, 1e-4))
+    out.append((N(q) @ N(q).T, onp.eye(3, dtype="float32"), 1e-4))
+    # maketrian inverts extracttrian
+    packed = nd.linalg.extracttrian(a)
+    out.append((nd.linalg.maketrian(packed), onp.tril(A2), 1e-6))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# legacy tensor / misc ops
+# ---------------------------------------------------------------------------
+
+def _case_legacy_tensor():
+    a, b = arr(W0), arr(G0)
+    out = [
+        ("elemwise_add", nd.elemwise_add(a, b), W0 + G0),
+        ("elemwise_mul", nd.elemwise_mul(a, b), W0 * G0),
+        ("add_n", nd.add_n(a, b, a), 2 * W0 + G0),
+        ("expand_dims", np_.expand_dims(a, 1), W0[:, None, :]),
+        ("squeeze", np_.squeeze(np_.expand_dims(a, 0)), W0),
+        ("ones_like", np_.ones_like(a), onp.ones_like(W0)),
+        ("zeros_like", np_.zeros_like(a), onp.zeros_like(W0)),
+        ("_zeros", np_.zeros((2, 3)), onp.zeros((2, 3), "float32")),
+        ("_eye", np_.eye(3, 4, 1), onp.eye(3, 4, 1, dtype="float32")),
+        ("_arange", np_.arange(2, 9, 2), onp.arange(2, 9, 2)),
+        ("_linspace", np_.linspace(0, 1, 7), onp.linspace(0, 1, 7),
+         1e-6),
+        ("one_hot", npx.one_hot(arr(IDX), 4),
+         onp.eye(4, dtype="float32")[IDX]),
+        ("diag", np_.diag(arr([1.0, 2.0, 3.0])),
+         onp.diag([1.0, 2.0, 3.0])),
+        ("reverse", nd.reverse(a, axis=0), W0[::-1]),
+        ("slice_axis", nd.slice_axis(a, axis=1, begin=1, end=3),
+         W0[:, 1:3]),
+        ("shape_array", npx.shape_array(a), onp.array([3, 4])),
+        ("size_array", nd.size_array(a), onp.array([12])),
+        ("argmax_channel", nd.argmax_channel(a),
+         W0.argmax(axis=1).astype("float32")),
+        ("argsort", np_.argsort(arr([3.0, 1.0, 2.0])),
+         onp.argsort([3.0, 1.0, 2.0])),
+        ("topk", npx.topk(a, k=2, axis=1),
+         onp.argsort(-W0, axis=1)[:, :2].astype("float32")),
+        ("batch_take", nd.batch_take(a, arr(IDX)),
+         W0[onp.arange(3), IDX]),
+        ("scatter_nd", npx.scatter_nd(
+            arr([9.0, 8.0]), arr([[0, 1], [1, 2]], "int64"), (2, 3)),
+         onp.array([[0, 9, 0], [0, 0, 8]], "float32")),
+        ("broadcast_like", npx.broadcast_like(
+            arr([[1.0], [2.0], [3.0]]), a),
+         onp.broadcast_to([[1.0], [2.0], [3.0]], (3, 4))),
+        ("moments", nd.moments(a, axes=(0,)),
+         (W0.mean(0), W0.var(0)), 1e-5),
+        ("softmin", nd.softmin(a, axis=1),
+         onp.exp(-W0) / onp.exp(-W0).sum(1, keepdims=True), 1e-5),
+        ("masked_log_softmax", npx.masked_log_softmax(
+            a, arr(onp.ones((3, 4), "bool"))),
+         W0 - W0.max(1, keepdims=True)
+         - onp.log(onp.exp(W0 - W0.max(1, keepdims=True))
+                   .sum(1, keepdims=True)), 1e-5),
+        ("_split_v2", np_.split(a, 2, axis=1),
+         [W0[:, :2], W0[:, 2:]]),
+        ("SliceChannel", np_.split(a, 4, axis=1),
+         [W0[:, i:i + 1] for i in range(4)]),
+        ("SwapAxis", np_.swapaxes(arr(T3), 0, 2),
+         onp.swapaxes(T3, 0, 2)),
+        ("Flatten", nd.flatten(arr(T3)), T3.reshape(2, 12)),
+        ("_unravel_index", np_.unravel_index(arr(IDX), (2, 3)),
+         onp.stack(onp.unravel_index(IDX, (2, 3)))),
+        ("_ravel_multi_index", np_.ravel_multi_index(
+            arr([[0, 1], [1, 2]], "int64"), (2, 3)),
+         onp.ravel_multi_index(onp.array([[0, 1], [1, 2]]), (2, 3))),
+        ("_histogram", np_.histogram(arr([0.1, 0.4, 0.6, 0.9]),
+                                     bins=2, range=(0.0, 1.0))[0],
+         onp.histogram(onp.array([0.1, 0.4, 0.6, 0.9]), bins=2,
+                       range=(0.0, 1.0))[0]),
+        ("softmax_cross_entropy", npx.softmax_cross_entropy(
+            a, arr(IDX)),
+         -onp.take_along_axis(
+             W0 - W0.max(1, keepdims=True)
+             - onp.log(onp.exp(W0 - W0.max(1, keepdims=True))
+                       .sum(1, keepdims=True)),
+             IDX[:, None].astype(int), axis=1).sum(), 1e-4),
+    ]
+    res = []
+    for entry in out:
+        name, got, want = entry[0], entry[1], entry[2]
+        tol = entry[3] if len(entry) > 3 else 1e-6
+        if isinstance(want, (list, tuple)) and not isinstance(
+                want, onp.ndarray):
+            for gg, ww in zip(got, want):
+                res.append((gg, ww, tol))
+        else:
+            res.append((got, want, tol))
+    return res
+
+
+def _case_khatri_rao():
+    # column-wise Khatri-Rao (ref krprod.h): out column j is the kron of
+    # the j-th columns; (2,2)x(3,2) -> (6,2)
+    a = onp.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    b = onp.array([[5.0, 6.0], [7.0, 8.0], [9.0, 10.0]], "float32")
+    got = npx.khatri_rao(arr(a), arr(b))
+    want = onp.stack([onp.kron(a[:, j], b[:, j]) for j in range(2)], axis=1)
+    return [(got, want)]
+
+
+def _case_im2col():
+    import torch
+    import torch.nn.functional as F
+
+    x = _RS.rand(1, 2, 5, 5).astype("float32")
+    got = nd.im2col(arr(x), kernel=(3, 3))
+    want = F.unfold(torch.from_numpy(x), kernel_size=3).numpy()
+    return [(got, want, 1e-5)]
+
+
+def _case_col2im():
+    import torch
+    import torch.nn.functional as F
+
+    x = _RS.rand(1, 2, 5, 5).astype("float32")
+    cols = F.unfold(torch.from_numpy(x), kernel_size=3).numpy()
+    got = npx.col2im(arr(cols), (5, 5), kernel=(3, 3))
+    want = F.fold(torch.from_numpy(cols), (5, 5), kernel_size=3).numpy()
+    return [(got, want, 1e-5)]
+
+
+def _case_cast_storage():
+    from mxnet_tpu.ndarray import sparse as mxs
+
+    dense = onp.array([[0, 1.0, 0], [2.0, 0, 0]], "float32")
+    csr = mxs.cast_storage(arr(dense), "csr")
+    back = mxs.cast_storage(csr, "default")
+    rsp = mxs.cast_storage(arr(dense), "row_sparse")
+    back2 = mxs.cast_storage(rsp, "default")
+    return [(back, dense), (back2, dense)]
+
+
+def _case_amp_multicast():
+    outs = nd.amp_multicast(arr(W0, "float16"), arr(G0))
+    return [(outs[0], W0.astype("float16").astype("float32"), 1e-3),
+            (outs[1], G0, 1e-6)]
+
+
+def _case_custom():
+    @mx.operator.register("numeric_tail_plus2")
+    class Plus2(mx.operator.CustomOp):
+        def forward(self, x):
+            return x + 2
+
+        def backward(self, out_grads, inputs, outputs):
+            return (out_grads,)
+
+    f = mx.operator.create("numeric_tail_plus2")
+    return [(f(arr(W0)), W0 + 2)]
+
+
+# ---------------------------------------------------------------------------
+# _npi_* tail (vs numpy directly)
+# ---------------------------------------------------------------------------
+
+def _case_npi_tail():
+    a, s = arr(W0), arr(SPD)
+    v = arr([3.0, 1.0, 2.0])
+    iv = arr([6, 4, 9], "int64")
+    spd64 = SPD.astype("float64")
+    entries = [
+        ("_npi_around", np_.around(a, 1), onp.around(W0, 1)),
+        ("_npi_average", np_.average(a, axis=0,
+                                     weights=arr([1.0, 2.0, 3.0])),
+         onp.average(W0, axis=0, weights=[1.0, 2.0, 3.0]), 1e-5),
+        ("_npi_bincount", np_.bincount(iv), onp.bincount([6, 4, 9])),
+        ("_npi_bitwise_and", np_.bitwise_and(iv, iv), [6, 4, 9]),
+        ("_npi_bitwise_and_scalar", np_.bitwise_and(iv, 5),
+         onp.bitwise_and([6, 4, 9], 5)),
+        ("_npi_bitwise_or", np_.bitwise_or(iv, arr([1, 2, 4], "int64")),
+         onp.bitwise_or([6, 4, 9], [1, 2, 4])),
+        ("_npi_bitwise_or_scalar", np_.bitwise_or(iv, 5),
+         onp.bitwise_or([6, 4, 9], 5)),
+        ("_npi_bitwise_xor", np_.bitwise_xor(iv, arr([1, 2, 4], "int64")),
+         onp.bitwise_xor([6, 4, 9], [1, 2, 4])),
+        ("_npi_bitwise_xor_scalar", np_.bitwise_xor(iv, 5),
+         onp.bitwise_xor([6, 4, 9], 5)),
+        ("_npi_bitwise_not", np_.bitwise_not(iv),
+         onp.bitwise_not([6, 4, 9])),
+        ("_npi_blackman", np_.blackman(6), onp.blackman(6), 1e-6),
+        ("_npi_hanning", np_.hanning(6), onp.hanning(6), 1e-6),
+        ("_npi_hamming", np_.hamming(6), onp.hamming(6), 1e-6),
+        ("_npi_cholesky", np_.linalg.cholesky(s),
+         onp.linalg.cholesky(spd64), 1e-4),
+        ("_npi_column_stack", np_.column_stack((v, v)),
+         onp.column_stack(([3.0, 1.0, 2.0], [3.0, 1.0, 2.0]))),
+        ("_npi_copy", np_.copy(a), W0),
+        ("_npi_cross", np_.cross(arr([1.0, 0, 0]), arr([0, 1.0, 0])),
+         [0.0, 0.0, 1.0]),
+        ("_npi_deg2rad", np_.deg2rad(arr([180.0])), [onp.pi], 1e-6),
+        ("_npi_rad2deg", np_.rad2deg(arr([onp.pi])), [180.0], 1e-4),
+        ("_npi_delete", np_.delete(v, 1), [3.0, 2.0]),
+        ("_npi_diag", np_.diag(v), onp.diag([3.0, 1.0, 2.0])),
+        ("_npi_diagflat", np_.diagflat(v), onp.diagflat([3.0, 1.0, 2.0])),
+        ("_npi_diag_indices_from", np_.diag_indices_from(s),
+         onp.stack(onp.diag_indices_from(SPD))),
+        ("_npi_diff", np_.diff(v), onp.diff([3.0, 1.0, 2.0])),
+        ("_npi_dsplit", np_.dsplit(arr(T3), 2),
+         onp.dsplit(T3, 2)),
+        ("_npi_hsplit", np_.hsplit(a, 2), onp.hsplit(W0, 2)),
+        ("_npi_dstack", np_.dstack((a, a)), onp.dstack((W0, W0))),
+        ("_npi_einsum", np_.einsum("ij,kj->ik", a, arr(G0)),
+         onp.einsum("ij,kj->ik", W0, G0), 1e-5),
+        ("_npi_eye", np_.eye(4), onp.eye(4)),
+        ("_npi_full_like", np_.full_like(a, 7.0),
+         onp.full_like(W0, 7.0)),
+        ("_npi_gcd", np_.gcd(iv, arr([4, 6, 6], "int64")),
+         onp.gcd([6, 4, 9], [4, 6, 6])),
+        ("_npi_gcd_scalar", np_.gcd(iv, 3), onp.gcd([6, 4, 9], 3)),
+        ("_npi_lcm", np_.lcm(iv, arr([4, 6, 6], "int64")),
+         onp.lcm([6, 4, 9], [4, 6, 6])),
+        ("_npi_lcm_scalar", np_.lcm(iv, 3), onp.lcm([6, 4, 9], 3)),
+        ("_npi_indices", np_.indices((2, 3)), onp.indices((2, 3))),
+        ("_npi_insert_scalar", np_.insert(v, 1, 9.0),
+         onp.insert([3.0, 1.0, 2.0], 1, 9.0)),
+        ("_npi_insert_slice", np_.insert(v, slice(0, 2), 9.0),
+         onp.insert([3.0, 1.0, 2.0], slice(0, 2), 9.0)),
+        ("_npi_insert_tensor", np_.insert(v, arr([1], "int64"),
+                                          arr([9.0])),
+         onp.insert([3.0, 1.0, 2.0], [1], [9.0])),
+        ("_npi_linspace", np_.linspace(2, 3, 5), onp.linspace(2, 3, 5),
+         1e-6),
+        ("_npi_logspace", np_.logspace(0, 2, 5), onp.logspace(0, 2, 5),
+         1e-4),
+        ("_npi_matrix_rank", np_.linalg.matrix_rank(s),
+         onp.linalg.matrix_rank(spd64)),
+        ("_npi_nan_to_num", np_.nan_to_num(
+            arr([onp.nan, onp.inf, 1.0])),
+         onp.nan_to_num(onp.array([onp.nan, onp.inf, 1.0],
+                                  "float32"))),
+        ("_npi_percentile", np_.percentile(a, 40),
+         onp.percentile(W0, 40), 1e-5),
+        ("_npi_polyval", np_.polyval(v, arr([0.5, 2.0])),
+         onp.polyval([3.0, 1.0, 2.0], [0.5, 2.0]), 1e-5),
+        ("_npi_rollaxis", np_.rollaxis(arr(T3), 2),
+         onp.rollaxis(T3, 2)),
+        ("_npi_solve", np_.linalg.solve(s, arr(SPD[:, 0])),
+         onp.linalg.solve(spd64, spd64[:, 0]), 1e-4),
+        ("_npi_squeeze", np_.squeeze(arr(T3[None])), T3),
+        ("_npi_tri", np_.tri(3, 4, 1), onp.tri(3, 4, 1)),
+        ("_npi_tril_indices", np_.tril_indices(3),
+         onp.stack(onp.tril_indices(3))),
+        ("_npi_tensorinv", np_.linalg.tensorinv(
+            arr(onp.eye(4).reshape(2, 2, 2, 2) * 2.0)),
+         onp.linalg.tensorinv(onp.eye(4).reshape(2, 2, 2, 2) * 2.0),
+         1e-5),
+        ("_npi_tensorsolve", np_.linalg.tensorsolve(
+            arr(onp.eye(4).reshape(2, 2, 2, 2) * 2.0),
+            arr(onp.array([[1.0, 2.0], [3.0, 4.0]]))),
+         onp.linalg.tensorsolve(onp.eye(4).reshape(2, 2, 2, 2) * 2.0,
+                                onp.array([[1.0, 2.0], [3.0, 4.0]])),
+         1e-5),
+        ("_npi_fill_diagonal", np_.fill_diagonal(np_.zeros((3, 3)), 5.0),
+         onp.diag([5.0, 5.0, 5.0])),
+        ("_npx_nonzero", np_.nonzero(arr([0.0, 2.0, 0.0, 3.0]))[0],
+         onp.nonzero(onp.array([0.0, 2.0, 0.0, 3.0]))[0]),
+        ("_npx_index_add", npx.index_add(
+            np_.zeros((3, 2)), arr([[0, 2]], "int32"),
+            np_.ones((2, 2))),
+         onp.array([[1, 1], [0, 0], [1, 1]], "float32")),
+        ("_npx_index_update", npx.index_update(
+            np_.zeros((3, 2)), arr([[1]], "int32"),
+            np_.full((1, 2), 9.0)),
+         onp.array([[0, 0], [9, 9], [0, 0]], "float32")),
+        ("_npx_constraint_check", np_.constraint_check(
+            arr(onp.array([True])), "ok"), onp.array([True])),
+    ]
+    res = []
+    for entry in entries:
+        name, got, want = entry[0], entry[1], entry[2]
+        tol = entry[3] if len(entry) > 3 else 1e-6
+        if isinstance(want, list) and want and isinstance(
+                want[0], onp.ndarray):
+            for gg, ww in zip(got, want):
+                res.append((gg, ww, tol))
+        else:
+            res.append((got, want, tol))
+    return res
+
+
+def _case_npi_linalg_decomp():
+    """qr/svd/eig family: compare invariants (reconstruction,
+    orthogonality, sorted spectra), which are basis-independent."""
+    a64 = A2.astype("float64")
+    sym = (A2 + A2.T).astype("float32")
+    out = []
+    q, r = np_.linalg.qr(arr(A2))
+    out.append((N(q) @ N(r), A2, 1e-4))
+    out.append((N(q).T @ N(q), onp.eye(4), 1e-4))
+    u, sv, vt = np_.linalg.svd(arr(W0))
+    got = N(u)[:, :3] * N(sv)[None, :] @ N(vt)[:3]
+    # svd returns full matrices per numpy default in mxnet: reconstruct
+    out.append((got, W0, 1e-4))
+    out.append((onp.sort(N(sv)),
+                onp.sort(onp.linalg.svd(W0.astype("float64"),
+                                        compute_uv=False)), 1e-4))
+    lam = np_.linalg.eigvalsh(arr(sym))
+    out.append((onp.sort(N(lam)),
+                onp.sort(onp.linalg.eigvalsh(sym.astype("float64"))),
+                1e-3))
+    lam2, vec = np_.linalg.eigh(arr(sym))
+    out.append((N(vec) @ onp.diag(N(lam2)) @ N(vec).T, sym, 1e-3))
+    ev = np_.linalg.eigvals(arr(SPD))
+    out.append((onp.sort(N(ev).real),
+                onp.sort(onp.linalg.eigvals(SPD.astype("float64")).real),
+                1e-3))
+    lam3, vec3 = np_.linalg.eig(arr(SPD))
+    recon = N(vec3) @ onp.diag(N(lam3)) @ onp.linalg.inv(N(vec3))
+    out.append((recon.real, SPD, 1e-2))
+    out.append((np_.linalg.pinv(arr(W0)),
+                onp.linalg.pinv(W0.astype("float64")), 1e-3))
+    out.append((np_.linalg.pinv(arr(W0), rcond=1e-6),
+                onp.linalg.pinv(W0.astype("float64"), rcond=1e-6), 1e-3))
+    sol, res_, rank, sv2 = np_.linalg.lstsq(arr(A2), arr(SPD[:, 0]),
+                                            rcond=None)
+    out.append((sol, onp.linalg.lstsq(a64, SPD[:, 0].astype("float64"),
+                                      rcond=None)[0], 1e-3))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# registry of deterministic cases
+# ---------------------------------------------------------------------------
+
+CASES = {
+    "sgd_update": _case_sgd_update,
+    "sgd_mom_update": _case_sgd_mom_update,
+    "adam_update": _case_adam_update,
+    "nag_mom_update": _case_nag_mom_update,
+    "signsgd_update": _case_signsgd_update,
+    "signum_update": _case_signum_update,
+    "rmsprop_update": _case_rmsprop_update,
+    "rmspropalex_update": _case_rmspropalex_update,
+    "ftrl_update": _case_ftrl_update,
+    "ftml_update": _case_ftml_update,
+    "lamb_update_phase1": _case_lamb_update_phase1,
+    "lamb_update_phase2": _case_lamb_update_phase2,
+    "mp_sgd_update": _case_mp_sgd_update,
+    "mp_sgd_mom_update": _case_mp_sgd_mom_update,
+    "mp_nag_mom_update": _case_mp_nag_mom_update,
+    "mp_lamb_update_phase1": _case_mp_lamb,  # phase1+2 asserted together
+    "mp_lamb_update_phase2": _case_mp_lamb,
+    "multi_sgd_update": _case_multi_sgd_update,
+    "multi_sgd_mom_update": _case_multi_sgd_mom_update,
+    "multi_mp_sgd_update": _case_multi_mp_sgd_update,
+    "multi_mp_sgd_mom_update": _case_multi_mp_sgd_mom_update,
+    "_multi_adamw_update": _case_multi_adamw_update,
+    "_multi_mp_adamw_update": _case_multi_mp_adamw_update,
+    "_multi_lamb_update": _case_multi_lamb_update,
+    "_multi_mp_lamb_update": _case_multi_mp_lamb_update,
+    "_multi_lans_update": _case_multi_lans_update,
+    "_multi_mp_lans_update": _case_multi_mp_lans_update,
+    "preloaded_multi_sgd_update": _case_preloaded_multi_sgd_update,
+    "preloaded_multi_sgd_mom_update":
+        _case_preloaded_multi_sgd_mom_update,
+    "preloaded_multi_mp_sgd_update": _case_preloaded_multi_mp_sgd_update,
+    "preloaded_multi_mp_sgd_mom_update":
+        _case_preloaded_multi_mp_sgd_mom_update,
+    "_adamw_update": _case_adamw_update,
+    "multi_lars": _case_multi_lars,
+    "multi_sum_sq": _case_multi_sum_sq,
+    "multi_all_finite": _case_multi_all_finite,
+    "reset_arrays": _case_reset_arrays,
+    "_contrib_group_adagrad_update": _case_group_adagrad_update,
+    "linalg_legacy": _case_linalg,
+    "legacy_tensor": _case_legacy_tensor,
+    "khatri_rao": _case_khatri_rao,
+    "im2col": _case_im2col,
+    "col2im": _case_col2im,
+    "cast_storage": _case_cast_storage,
+    "amp_multicast": _case_amp_multicast,
+    "Custom": _case_custom,
+    "npi_tail": _case_npi_tail,
+    "npi_linalg_decomp": _case_npi_linalg_decomp,
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_numeric(name):
+    pairs = CASES[name]()
+    assert pairs, f"{name}: case produced no assertions"
+    for i, entry in enumerate(pairs):
+        got, want = entry[0], entry[1]
+        tol = entry[2] if len(entry) > 2 else 1e-6
+        gv = N(got)
+        if isinstance(gv, list):  # tuple-returning ops (indices families)
+            gv = onp.stack(gv)
+        onp.testing.assert_allclose(
+            gv.astype("float64"), onp.asarray(want, "float64"),
+            rtol=tol, atol=tol, err_msg=f"{name}[{i}]")
+
+
+# ---------------------------------------------------------------------------
+# random samplers: moment checks over a seeded draw
+# (_npi_* samplers; exact distributions are jax's, moments must match)
+# ---------------------------------------------------------------------------
+
+_SAMPLERS = [
+    ("_npi_uniform", lambda n: np_.random.uniform(0, 2, size=(n,)),
+     1.0, (2 ** 2) / 12),
+    ("_npi_uniform_n", lambda n: np_.random.uniform(-1, 1, size=(n,)),
+     0.0, (2 ** 2) / 12),
+    ("_npi_normal", lambda n: np_.random.normal(1.0, 2.0, size=(n,)),
+     1.0, 4.0),
+    ("_npi_normal_n", lambda n: np_.random.normal(-2.0, 0.5, size=(n,)),
+     -2.0, 0.25),
+    ("_npi_bernoulli", lambda n: np_.random.bernoulli(0.3, size=(n,)),
+     0.3, 0.21),
+    ("_npi_exponential", lambda n: np_.random.exponential(2.0, size=(n,)),
+     2.0, 4.0),
+    ("_npi_gamma", lambda n: np_.random.gamma(3.0, 2.0, size=(n,)),
+     6.0, 12.0),
+    ("_npi_laplace", lambda n: np_.random.laplace(1.0, 2.0, size=(n,)),
+     1.0, 8.0),
+    ("_npi_pareto", lambda n: np_.random.pareto(4.0, size=(n,)),
+     1.0 / 3.0, 4.0 / (9 * 2.0)),
+    ("_npi_rayleigh", lambda n: np_.random.rayleigh(2.0, size=(n,)),
+     2.0 * onp.sqrt(onp.pi / 2), (4 - onp.pi) / 2 * 4),
+    ("_npi_weibull", lambda n: np_.random.weibull(1.0, size=(n,)),
+     1.0, 1.0),
+]
+
+
+@pytest.mark.parametrize("name,draw,mean,var",
+                         _SAMPLERS, ids=[s[0] for s in _SAMPLERS])
+def test_sampler_moments(name, draw, mean, var):
+    mx.random.seed(7)
+    s = N(draw(40000)).astype("float64")
+    sd = onp.sqrt(var)
+    assert abs(s.mean() - mean) < 0.05 * max(1.0, sd) + 0.02, \
+        f"{name}: mean {s.mean()} vs {mean}"
+    assert abs(s.var() - var) < 0.15 * max(1.0, var), \
+        f"{name}: var {s.var()} vs {var}"
+
+
+def test_npi_multinomial_and_choice():
+    mx.random.seed(11)
+    pv = onp.array([0.2, 0.3, 0.5])
+    counts = N(np_.random.multinomial(10000, pv)).astype("float64")
+    assert counts.sum() == 10000
+    onp.testing.assert_allclose(counts / 10000, pv, atol=0.03)
+    ch = N(np_.random.choice(5, size=(20000,))).astype("int64")
+    assert set(onp.unique(ch)) <= set(range(5))
+    onp.testing.assert_allclose(
+        onp.bincount(ch, minlength=5) / 20000, onp.full(5, 0.2), atol=0.03)
+
+
+def test_shuffle_is_permutation():
+    mx.random.seed(13)
+    v = np_.array(onp.arange(100, dtype="float32"))
+    np_.random.shuffle(v)
+    got = onp.sort(N(v))
+    onp.testing.assert_allclose(got, onp.arange(100, dtype="float32"))
+
+
+def test_sample_multinomial_distribution():
+    mx.random.seed(17)
+    pv = onp.array([0.5, 0.25, 0.25])
+    # _sample_multinomial: counts over draws follow pvals
+    counts = N(np_.random.multinomial(20000, pv)).astype("float64")
+    onp.testing.assert_allclose(counts / 20000, pv, atol=0.03)

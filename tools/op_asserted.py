@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Which reference ops have VALUE-LEVEL test assertions, not just smoke.
+
+`tools/op_smoke.py`'s bar is "returns without raising"; the reference's bar
+is forward-vs-NumPy + FD gradients per op
+(/root/reference/tests/python/unittest/test_numpy_op.py,
+python/mxnet/test_utils.py check_numeric_gradient).  This script measures
+how much of the 336-op catalog meets the stronger bar here: an op counts
+as *asserted* when one of its public callable names appears (as a call or
+a registry-name string) in a test file that performs numeric assertions —
+excluding the smoke harness itself.
+
+The attribution is textual (an op used only to build fixture data in an
+asserting file still counts), so the number is an upper bound of true
+per-op numeric coverage; the honest lower bound is the explicit per-op
+suites (test_numpy_fuzz, test_op_gradients, test_op_numeric_tail, ...).
+Used by tools/op_coverage.py for OP_COVERAGE.md's "asserted" column.
+
+Usage: python tools/op_asserted.py [--tests tests] [--list-missing]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# files whose assertions are not value-level op checks
+_EXCLUDE_FILES = {"test_op_smoke.py", "conftest.py"}
+
+# a file must match one of these to count as numerically asserting
+_NUMERIC_ASSERT = re.compile(
+    r"assert_allclose|assert_almost_equal|assert_array_equal"
+    r"|allclose\(|check_numeric_gradient|assert_array_almost_equal"
+    r"|approx\(|assert .*==")
+
+
+def test_corpus(tests_dir: str):
+    """[(fname, text)] for test files that make numeric assertions."""
+    out = []
+    for fn in sorted(os.listdir(tests_dir)):
+        if not fn.endswith(".py") or fn in _EXCLUDE_FILES:
+            continue
+        with open(os.path.join(tests_dir, fn)) as f:
+            text = f.read()
+        if _NUMERIC_ASSERT.search(text):
+            out.append((fn, text))
+    return out
+
+
+def asserted_ops(ref_names, tests_dir="tests"):
+    """{ref_op_name: [test files using it]} over the asserting corpus."""
+    import op_coverage
+
+    corpus = test_corpus(tests_dir)
+    hits = {}
+    for name in ref_names:
+        cands = {c for c in op_coverage._strip(name) if len(c) > 2}
+        # registry-name strings count too (symbol JSON tests drive ops by
+        # their reference names)
+        pats = [re.compile(r"(?<![\w.])" + re.escape(c) + r"\s*\(")
+                for c in cands]
+        pats += [re.compile(r"['\"]" + re.escape(c) + r"['\"]")
+                 for c in cands | {name}]
+        files = [fn for fn, text in corpus
+                 if any(p.search(text) for p in pats)]
+        if files:
+            hits[name] = files
+    return hits
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--reference", default="/root/reference")
+    p.add_argument("--tests", default="tests")
+    p.add_argument("--list-missing", action="store_true")
+    args = p.parse_args()
+
+    import op_coverage
+
+    ref = sorted(op_coverage.reference_ops(args.reference))
+    hits = asserted_ops(ref, args.tests)
+    print(f"asserted {len(hits)}/{len(ref)} "
+          f"({100 * len(hits) / len(ref):.1f}%)")
+    if args.list_missing:
+        for name in ref:
+            if name not in hits:
+                print("MISSING", name)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
