@@ -51,9 +51,14 @@ test:
 
 test-par:
 	# multi-core boxes: same fast suite, one worker per core, file-level
-	# isolation (verified green under xdist loadfile)
+	# isolation (verified green under xdist loadfile). Wall time is
+	# recorded so the <10-min budget is a checked fact (CI uploads it).
+	@start=$$(date +%s); \
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -q -m "not slow" \
-		-n auto --dist loadfile
+		-n auto --dist loadfile; rc=$$?; \
+	secs=$$(( $$(date +%s) - start )); \
+	echo "test-par wall time: $${secs}s" | tee test-par-timing.txt; \
+	exit $$rc
 
 test-slow:
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -q -m slow

@@ -1,0 +1,177 @@
+"""DataLoader worker failure paths (ref gluon/data/dataloader.py worker
+loop + reference's error propagation through ConcurrentBatchifier;
+round-3 verdict item #7).
+
+Contract under test: a raising dataset/transform surfaces the ORIGINAL
+exception to the training loop (not a hang, not a silent skip); a
+hard-killed worker degrades to a bounded TimeoutError; the loader stays
+usable after an error; worker processes never touch jax (fork safety).
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.data import DataLoader
+from mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+
+class ExplodingDataset:
+    """Raises on one specific index."""
+
+    def __init__(self, n=32, bad_index=17, exc=ValueError):
+        self.n = n
+        self.bad = bad_index
+        self.exc = exc
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i == self.bad:
+            raise self.exc(f"poisoned sample {i}")
+        return onp.full((3,), i, "float32"), onp.int32(i % 2)
+
+
+class HangingDataset:
+    """One index blocks forever (simulates a stuck decode)."""
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            time.sleep(3600)
+        return onp.zeros((2,), "float32")
+
+
+class KillerDataset:
+    """One index hard-exits the worker process (simulates OOM-kill)."""
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5 and multiprocessing.parent_process() is not None:
+            os._exit(1)
+        return onp.zeros((2,), "float32")
+
+
+def _drain(loader):
+    return [b for b in loader]
+
+
+def test_exception_propagates_num_workers0():
+    loader = DataLoader(ExplodingDataset(), batch_size=4)
+    with pytest.raises(ValueError, match="poisoned sample 17"):
+        _drain(loader)
+
+
+@pytest.mark.parametrize("thread_pool", [False, True],
+                         ids=["process", "thread"])
+def test_exception_propagates_workers(thread_pool):
+    loader = DataLoader(ExplodingDataset(), batch_size=4, num_workers=2,
+                        thread_pool=thread_pool, timeout=30)
+    with pytest.raises(ValueError, match="poisoned sample 17"):
+        _drain(loader)
+
+
+def test_loader_usable_after_worker_exception():
+    """After a worker exception the SAME loader must keep serving (no
+    deadlocked pool): re-iterating raises the same clean error again, and
+    a fresh loader over a healthy dataset completes.  (Workers hold a
+    fork-time snapshot of the dataset, so un-poisoning the parent's copy
+    does not reach them — the reference has the same property.)"""
+    ds = ExplodingDataset(n=16, bad_index=13)
+    loader = DataLoader(ds, batch_size=4, num_workers=2, timeout=30)
+    with pytest.raises(ValueError):
+        _drain(loader)
+    with pytest.raises(ValueError):  # again: error, not a hang
+        _drain(loader)
+    good = DataLoader(ExplodingDataset(n=16, bad_index=10 ** 9),
+                      batch_size=4, num_workers=2, timeout=30)
+    batches = _drain(good)
+    assert len(batches) == 4
+    xs = onp.concatenate([N(b[0]) for b in batches])
+    onp.testing.assert_allclose(onp.sort(xs[:, 0]),
+                                onp.arange(16, dtype="float32"))
+
+
+def N(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def test_hanging_worker_bounded_by_timeout():
+    loader = DataLoader(HangingDataset(), batch_size=4, num_workers=2,
+                        timeout=3)
+    t0 = time.time()
+    with pytest.raises(multiprocessing.TimeoutError):
+        _drain(loader)
+    assert time.time() - t0 < 30, "timeout must bound a stuck worker"
+
+
+def test_killed_worker_does_not_hang_forever():
+    loader = DataLoader(KillerDataset(), batch_size=4, num_workers=2,
+                        timeout=5)
+    t0 = time.time()
+    with pytest.raises(Exception):  # TimeoutError or pool-broken error
+        _drain(loader)
+    assert time.time() - t0 < 60
+
+
+def test_error_in_batchify_fn_propagates():
+    def bad_batchify(samples):
+        raise RuntimeError("batchify exploded")
+
+    data = ArrayDataset(onp.zeros((8, 2), "float32"))
+    loader = DataLoader(data, batch_size=4, num_workers=2,
+                        batchify_fn=bad_batchify, timeout=30)
+    with pytest.raises(RuntimeError, match="batchify exploded"):
+        _drain(loader)
+
+
+def test_batches_cross_process_boundary_as_numpy():
+    """Fork safety (SURVEY aux: process init): worker results cross the
+    process boundary as plain numpy — device placement happens only in
+    the parent (the TPU-native replacement for the reference's
+    pthread_atfork engine teardown, src/initialize.cc:71-163)."""
+    from mxnet_tpu.gluon.data.dataloader import default_mp_batchify_fn
+
+    class TypeProbeDataset:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if multiprocessing.parent_process() is not None:
+                # running in a worker: returning numpy is the contract
+                assert isinstance(default_mp_batchify_fn(
+                    [onp.zeros((2,), "float32")]), onp.ndarray)
+            return onp.full((2,), i, "float32")
+
+    loader = DataLoader(TypeProbeDataset(), batch_size=4, num_workers=2,
+                        timeout=30)
+    batches = _drain(loader)
+    assert len(batches) == 2
+    assert all(isinstance(b, mx.nd.NDArray) for b in batches)
+
+
+def test_clean_epoch_after_crash_suite():
+    """End-to-end sanity: a normal multiprocess epoch still yields device
+    NDArrays with correct content after all the failure scenarios above
+    ran in this process."""
+    x = onp.arange(24, dtype="float32").reshape(12, 2)
+    y = onp.arange(12, dtype="int32")
+    loader = DataLoader(ArrayDataset(x, y), batch_size=3, num_workers=2,
+                        timeout=30)
+    got_x, got_y = [], []
+    for bx, by in loader:
+        assert isinstance(bx, mx.nd.NDArray)
+        got_x.append(N(bx))
+        got_y.append(N(by))
+    onp.testing.assert_allclose(onp.concatenate(got_x), x)
+    onp.testing.assert_allclose(onp.concatenate(got_y), y)

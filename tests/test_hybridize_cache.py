@@ -1,0 +1,220 @@
+"""Hybridize/_CachedOp cache-invalidation edges
+(ref tests/python/unittest/test_deferred_compute.py + CachedOp semantics,
+src/imperative/cached_op.cc; round-3 verdict item #7).
+
+The risk area: the jit cache must be keyed by everything that changes the
+compiled graph (shape, dtype, train/eval mode) and must NOT bake in
+anything that legitimately changes between calls (parameter VALUES,
+RNG key, BatchNorm running stats).  Each test pins one edge.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+
+np_ = mx.np
+
+
+def N(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def _dense_net(units=3, in_units=4):
+    net = nn.Dense(units)
+    net.initialize(mx.init.Xavier())
+    net(np_.ones((1, in_units)))  # shape-dependent deferred init
+    return net
+
+
+def _warm(net, *args):
+    """First call after hybridize() runs eagerly (deferred-init warmup,
+    block.py __call__); drive it so later calls hit the _CachedOp path."""
+    net(*args)
+    return net
+
+
+def test_dtype_change_creates_new_entry_and_correct_output():
+    net = _dense_net()
+    net.hybridize()
+    x32 = onp.random.RandomState(0).rand(2, 4).astype("float32")
+    _warm(net, np_.array(x32))
+    out32 = N(net(np_.array(x32)))
+    before = len(net._cached_op._traced)
+    out16 = N(net(np_.array(x32.astype("float16"))))
+    assert len(net._cached_op._traced) == before + 1, \
+        "dtype change must be a new jit signature"
+    onp.testing.assert_allclose(out16.astype("float32"), out32,
+                                rtol=2e-2, atol=2e-2)
+
+
+def test_shape_change_reuses_params_not_graph():
+    net = _dense_net()
+    net.hybridize()
+    w = N(net.weight.data())
+    b = N(net.bias.data())
+    for rows in (1, 2, 7):
+        x = onp.random.RandomState(rows).rand(rows, 4).astype("float32")
+        out = N(net(np_.array(x)))
+        onp.testing.assert_allclose(out, x @ w.T + b, rtol=1e-5, atol=1e-5)
+
+
+def test_param_value_update_without_retrace():
+    """set_data between calls: the compiled graph takes params as INPUTS,
+    so new values flow through with zero retraces."""
+    net = _dense_net()
+    net.hybridize()
+    x = onp.random.RandomState(1).rand(2, 4).astype("float32")
+    _warm(net, np_.array(x))
+    N(net(np_.array(x)))
+    sigs = len(net._cached_op._traced)
+    new_w = onp.full((3, 4), 0.5, "float32")
+    new_b = onp.zeros(3, "float32")
+    net.weight.set_data(np_.array(new_w))
+    net.bias.set_data(np_.array(new_b))
+    out = N(net(np_.array(x)))
+    assert len(net._cached_op._traced) == sigs, "set_data must not retrace"
+    onp.testing.assert_allclose(out, x @ new_w.T + new_b, rtol=1e-6)
+
+
+def test_force_reinit_then_forward():
+    net = _dense_net()
+    net.hybridize()
+    x = np_.ones((2, 4))
+    a = N(net(x))
+    mx.random.seed(99)
+    net.initialize(mx.init.Xavier(), force_reinit=True)
+    b = N(net(x))
+    assert not onp.allclose(a, b), "reinit must change hybridized outputs"
+    onp.testing.assert_allclose(
+        b, onp.ones((2, 4)) @ N(net.weight.data()).T + N(net.bias.data()),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_rehybridize_clears_cache():
+    net = _dense_net()
+    net.hybridize()
+    _warm(net, np_.ones((2, 4)))
+    net(np_.ones((2, 4)))
+    cached = net._cached_op
+    assert cached._traced
+    net.hybridize()  # re-activation clears the executor state
+    assert net._cached_op is None or not net._cached_op._traced
+    out = N(net(np_.ones((2, 4))))
+    onp.testing.assert_allclose(
+        out, onp.ones((2, 4)) @ N(net.weight.data()).T + N(net.bias.data()),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_hybridize_off_matches_on():
+    net = _dense_net()
+    x = onp.random.RandomState(2).rand(3, 4).astype("float32")
+    eager = N(net(np_.array(x)))
+    net.hybridize()
+    jitted = N(net(np_.array(x)))
+    net.hybridize(False)
+    eager2 = N(net(np_.array(x)))
+    onp.testing.assert_allclose(eager, jitted, rtol=1e-6)
+    onp.testing.assert_allclose(eager, eager2, rtol=1e-6)
+
+
+def test_train_eval_mode_are_distinct_signatures():
+    """Dropout must mask under record() and be identity in inference —
+    the two modes are separate compiled graphs."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16), nn.Dropout(0.5))
+    net.initialize()
+    net.hybridize()
+    x = np_.ones((4, 8))
+    _warm(net, x)
+    infer = N(net(x))
+    with mx.autograd.record(train_mode=True):
+        train = N(net(x))
+    # inference: no masking; training: ~half the activations zeroed
+    assert (infer != 0).all()
+    assert (train == 0).any()
+    sigs = {k[0] for k in net._cached_op._traced}
+    assert len(sigs) == 2, "train and eval must compile separately"
+
+
+def test_batchnorm_running_stats_mutate_through_cache():
+    net = nn.BatchNorm()
+    net.initialize()
+    net(np_.ones((2, 5)))
+    net.hybridize()
+    before = N(net.running_mean.data()).copy()
+    rs = onp.random.RandomState(5)
+    with mx.autograd.record(train_mode=True):
+        for _ in range(3):
+            net(np_.array(rs.rand(8, 5).astype("float32") + 2.0))
+    after = N(net.running_mean.data())
+    assert not onp.allclose(before, after), \
+        "running stats must update through the jitted path"
+    assert (after > 0.1).all()  # moved toward the +2 mean
+
+
+def test_save_load_parameters_through_hybridized_net(tmp_path):
+    net = _dense_net()
+    net.hybridize()
+    x = onp.random.RandomState(7).rand(2, 4).astype("float32")
+    want = N(net(np_.array(x)))
+    p = str(tmp_path / "dense.params")
+    net.save_parameters(p)
+
+    net2 = nn.Dense(3)
+    net2.initialize()
+    net2(np_.ones((1, 4)))
+    net2.hybridize()
+    _warm(net2, np_.array(x))
+    N(net2(np_.array(x)))  # trace with old params first
+    net2.load_parameters(p)
+    got = N(net2(np_.array(x)))  # must reflect loaded params, no retrace
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_child_block_replacement_recomputes_param_set():
+    """Swapping a child after hybridize: the param cache must not serve
+    the old structure (reference CachedOp rebuilds on structural change)."""
+    class Outer(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.body = nn.Dense(3)
+
+        def forward(self, x):
+            return self.body(x)
+
+    net = Outer()
+    net.initialize()
+    net(np_.ones((1, 4)))
+    net.hybridize()
+    N(net(np_.ones((2, 4))))
+    net.body = nn.Dense(5)
+    net.body.initialize()
+    net.body(np_.ones((1, 4)))
+    net.hybridize()  # structural change requires re-hybridize; cache resets
+    out = net(np_.ones((2, 4)))
+    assert out.shape == (2, 5)
+
+
+def test_kwargs_in_hybrid_forward_raise():
+    net = _dense_net()
+    net.hybridize()
+    _warm(net, np_.ones((2, 4)))
+    net(np_.ones((2, 4)))
+    with pytest.raises(mx.MXNetError):
+        net._cached_op((np_.ones((2, 4)),), {"extra": 1})
+
+
+def test_concurrent_shapes_interleaved():
+    """Alternating signatures call-to-call: holders must not cross-talk."""
+    net = _dense_net()
+    net.hybridize()
+    w, b = N(net.weight.data()), N(net.bias.data())
+    xs = {s: onp.random.RandomState(s).rand(s, 4).astype("float32")
+          for s in (1, 4)}
+    for _ in range(4):
+        for s, x in xs.items():
+            onp.testing.assert_allclose(N(net(np_.array(x))),
+                                        x @ w.T + b, rtol=1e-5, atol=1e-5)
