@@ -50,6 +50,7 @@ from . import optimizer
 from .lr_scheduler import LRScheduler
 from . import lr_scheduler
 from . import kvstore
+from . import kvstore as kv  # ref python/mxnet/__init__.py alias
 from . import gluon
 from . import engine
 from . import storage

@@ -37,7 +37,10 @@ class Context:
 
     _default_stack = threading.local()
 
-    def __init__(self, device_type: str, device_id: int = 0):
+    def __init__(self, device_type, device_id: int = 0):
+        if isinstance(device_type, Context):  # copy-construction, ref ctx.py
+            device_type, device_id = (device_type.device_type,
+                                      device_type.device_id)
         if device_type not in _DEVTYPE_ALIASES:
             raise MXNetError(f"unknown device type '{device_type}'")
         self.device_type = device_type

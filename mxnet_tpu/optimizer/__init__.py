@@ -47,6 +47,15 @@ class Optimizer:
                  clip_gradient=None, learning_rate=None, lr_scheduler=None,
                  multi_precision=False, param_dict=None, aggregate_num=None,
                  use_fused_step=True, **extra):
+        # compat-only kwargs the reference accepts are consumed by named
+        # params above; anything left is a typo'd hyperparameter — silence
+        # here would train with defaults, the worst failure mode
+        known_compat = {"sym", "begin_num_update", "allow_np_array"}
+        junk = set(extra) - known_compat
+        if junk:
+            raise TypeError(
+                f"{type(self).__name__} got unknown hyperparameters "
+                f"{sorted(junk)}")
         self.rescale_grad = rescale_grad
         self.lr = learning_rate if learning_rate is not None else 0.01
         self.lr_scheduler = lr_scheduler
@@ -60,8 +69,11 @@ class Optimizer:
         self.lr_mult: Dict[Any, float] = {}
         self.wd_mult: Dict[Any, float] = {}
         self._index_update_count: Dict[Any, int] = {}
-        self.num_update = 0
-        self.begin_num_update = 0
+        # resume-from-checkpoint step offset (ref optimizer.py
+        # begin_num_update): seeds _index_update_count so bias correction
+        # and update-count lr schedules continue, not restart
+        self.begin_num_update = int(extra.get("begin_num_update", 0))
+        self.num_update = self.begin_num_update
 
     # -- bookkeeping (ref optimizer.py _update_count / learning rates) ------
     def _update_count(self, index):

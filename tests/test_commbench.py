@@ -1,0 +1,21 @@
+"""tools/commbench.py sanity on the virtual mesh (the tool that would
+localize an ICI scaling miss — ref tools/bandwidth/measure.py analogue)."""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+
+def test_commbench_runs_all_collectives():
+    import commbench
+
+    res = commbench.run(ndev=4, sizes_mib=[0.25], steps=2)
+    assert res["n_devices"] == 4
+    assert res["virtual"] is True
+    names = {r["collective"] for r in res["rows"]}
+    assert names == {"psum", "all_gather", "psum_scatter", "ppermute"}
+    for r in res["rows"]:
+        assert r["ms"] > 0 and r["algo_gbps"] > 0, r

@@ -657,6 +657,41 @@ def _case_amp_multicast():
             (outs[1], G0, 1e-6)]
 
 
+def _case_adaptive_avg_pool2d():
+    import torch
+    import torch.nn.functional as F
+
+    from mxnet_tpu.ops import nn as ops_nn
+
+    x = _RS.rand(1, 2, 6, 6).astype("float32")
+    got = ops_nn.adaptive_avg_pool2d(x, (3, 3))
+    want = F.adaptive_avg_pool2d(torch.from_numpy(x), (3, 3)).numpy()
+    return [(got, want, 1e-5)]
+
+
+def _case_allclose_and_reductions():
+    a = arr(W0)
+    return [
+        (np_.allclose(a, a), onp.array(True)),              # _contrib_allclose
+        (np_.allclose(a, a + 1.0), onp.array(False)),
+        (np_.all(arr([True, False])), onp.array(False)),    # _npi_all
+        (np_.all(arr([True, True])), onp.array(True)),
+        (np_.any(arr([False, False])), onp.array(False)),   # _npi_any
+        (np_.any(arr([False, True])), onp.array(True)),
+        (np_.all(arr(W0) < 10, axis=0), onp.ones(4, bool)),
+        (np_.any(arr(W0) > 10, axis=0), onp.zeros(4, bool)),
+    ]
+
+
+def _case_to_tensor():
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    img = (_RS.rand(5, 4, 3) * 255).astype("uint8")
+    got = transforms.ToTensor()(np_.array(img))  # _image_to_tensor
+    want = img.transpose(2, 0, 1).astype("float32") / 255.0
+    return [(got, want, 1e-6)]
+
+
 def _case_custom():
     @mx.operator.register("numeric_tail_plus2")
     class Plus2(mx.operator.CustomOp):
@@ -890,6 +925,9 @@ CASES = {
     "col2im": _case_col2im,
     "cast_storage": _case_cast_storage,
     "amp_multicast": _case_amp_multicast,
+    "_contrib_AdaptiveAvgPooling2D": _case_adaptive_avg_pool2d,
+    "allclose_all_any": _case_allclose_and_reductions,
+    "_image_to_tensor": _case_to_tensor,
     "Custom": _case_custom,
     "npi_tail": _case_npi_tail,
     "npi_linalg_decomp": _case_npi_linalg_decomp,

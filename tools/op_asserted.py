@@ -51,22 +51,79 @@ def test_corpus(tests_dir: str):
     return out
 
 
-def asserted_ops(ref_names, tests_dir="tests"):
-    """{ref_op_name: [test files using it]} over the asserting corpus."""
+# module aliases whose attribute calls are ORACLE/helper code, not
+# framework ops (tests import numpy as onp/_onp by convention; torch is
+# the oracle for im2col/col2im; stdlib random/math/os and self methods
+# are never framework ops)
+_ORACLE_PREFIXES = {"onp", "_onp", "numpy", "torch", "F", "testing",
+                    "random", "math", "os", "self", "onnx",
+                    # raw-jax / scipy / RandomState-instance oracle calls
+                    "jnp", "jax", "lax", "scipy", "rs", "_rs", "rng",
+                    "rstate"}
+
+
+def _uses_op(text, cand):
+    """True if ``text`` calls ``cand`` through a framework namespace (or
+    bare), ignoring numpy/torch/stdlib oracle calls."""
+    for m in re.finditer(r"(?:(\w+)\.)?" + re.escape(cand) + r"\s*\(",
+                         text):
+        prefix = m.group(1)
+        start = m.start()
+        if prefix is None:
+            # bare call; very short names are too collision-prone
+            if len(cand) <= 3:
+                continue
+            if start > 0 and (text[start - 1].isalnum()
+                              or text[start - 1] in "._"):
+                continue
+            return True
+        # one level further back disambiguates `np_.random.choice(`
+        # (framework) from `random.choice(` (stdlib) and `onp.linalg.qr(`
+        # (oracle) from `np_.linalg.qr(` (framework)
+        root = re.search(r"(\w+)\.$", text[:start])
+        if root is not None:
+            if root.group(1) in _ORACLE_PREFIXES:
+                continue
+            return True
+        if prefix in _ORACLE_PREFIXES:
+            continue
+        return True
+    return False
+
+
+# files that exist specifically to assert per-op numeric behavior: a
+# direct call there is a value assertion by construction, so these anchor
+# the STRICT count (the dedicated tables enumerate their ops by name)
+_DEDICATED_FILES = {"test_op_numeric_tail.py", "test_numpy_fuzz.py",
+                    "test_op_gradients.py", "test_legacy_ops.py",
+                    "test_spatial_ops.py", "test_contrib_ops.py",
+                    "test_boxes.py", "test_quantization.py"}
+
+
+def asserted_ops(ref_names, tests_dir="tests", strict=False):
+    """{ref_op_name: [test files using it]} over the asserting corpus.
+
+    strict=False (upper bound): any framework-namespace call or registry-
+    name string in a numerically-asserting file counts — this includes
+    fixture-building uses whose result is never compared.
+    strict=True (lower bound): only hits in the dedicated per-op suites
+    (_DEDICATED_FILES) count, where calls exist to be value-checked.
+    """
     import op_coverage
 
     corpus = test_corpus(tests_dir)
+    if strict:
+        corpus = [(fn, t) for fn, t in corpus if fn in _DEDICATED_FILES]
     hits = {}
     for name in ref_names:
-        cands = {c for c in op_coverage._strip(name) if len(c) > 2}
+        cands = {c for c in op_coverage._strip(name) if len(c) >= 2}
         # registry-name strings count too (symbol JSON tests drive ops by
         # their reference names)
-        pats = [re.compile(r"(?<![\w.])" + re.escape(c) + r"\s*\(")
-                for c in cands]
-        pats += [re.compile(r"['\"]" + re.escape(c) + r"['\"]")
-                 for c in cands | {name}]
+        strpats = [re.compile(r"['\"]" + re.escape(c) + r"['\"]")
+                   for c in cands | {name}]
         files = [fn for fn, text in corpus
-                 if any(p.search(text) for p in pats)]
+                 if any(_uses_op(text, c) for c in cands)
+                 or any(p.search(text) for p in strpats)]
         if files:
             hits[name] = files
     return hits
@@ -83,12 +140,18 @@ def main():
 
     ref = sorted(op_coverage.reference_ops(args.reference))
     hits = asserted_ops(ref, args.tests)
+    strict = asserted_ops(ref, args.tests, strict=True)
     print(f"asserted {len(hits)}/{len(ref)} "
-          f"({100 * len(hits) / len(ref):.1f}%)")
+          f"({100 * len(hits) / len(ref):.1f}%) upper bound; "
+          f"{len(strict)}/{len(ref)} "
+          f"({100 * len(strict) / len(ref):.1f}%) in dedicated per-op "
+          f"suites")
     if args.list_missing:
         for name in ref:
             if name not in hits:
                 print("MISSING", name)
+            elif name not in strict:
+                print("WEAK", name, hits[name])
     return 0
 
 
