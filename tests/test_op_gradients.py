@@ -348,3 +348,146 @@ def test_ctc_loss_gradient():
     check_numeric_gradient(
         lambda p: call(CT.ctc_loss, (p, labels), {}, name="ctc_loss"),
         [pred], rtol=4e-2, atol=4e-2)
+
+
+# ---------------------------------------------------------------------------
+# legacy-linalg gradients (ref tests/python/unittest/test_operator.py
+# la_op section). Ops whose jnp.linalg VJP is already FD-checked by the
+# existing LINALG list (cholesky/inv/det/slogdet/solve via the np
+# frontend) are not re-listed — both frontends dispatch to the same
+# kernels; this table adds the la_op-specific lowerings.
+# ---------------------------------------------------------------------------
+
+def _spd(a):
+    """Map a free (n, n) parameter to a well-conditioned SPD matrix so FD
+    perturbations stay inside the valid domain (chain rule covers the
+    construction identically on both paths)."""
+    eye = mx.np.array(onp.eye(a.shape[0], dtype="float32"))
+    return mx.np.matmul(a, a.T) * 0.25 + eye * 2.0
+
+
+LINALG_GRADS = [
+    ("potrf", lambda a: mx.nd.linalg.potrf(_spd(a)).sum()),
+    ("potri", lambda a: mx.nd.linalg.potri(
+        mx.nd.linalg.potrf(_spd(a))).sum()),
+    ("sumlogdiag", lambda a: mx.nd.linalg.sumlogdiag(_spd(a))),
+    ("gemm", lambda a: mx.nd.linalg.gemm(
+        a, a.T, mx.np.ones((3, 3)), alpha=0.5, beta=2.0).sum()),
+    ("gemm2", lambda a: mx.nd.linalg.gemm2(a, a.T, alpha=0.5).sum()),
+    ("syrk", lambda a: mx.nd.linalg.syrk(a, alpha=1.5).sum()),
+    ("trmm", lambda a: mx.nd.linalg.trmm(
+        mx.np.tril(a) + mx.np.array(onp.eye(3, dtype="float32") * 2), a)
+        .sum()),
+    ("trsm", lambda a: mx.nd.linalg.trsm(
+        mx.np.tril(a) * 0.2 + mx.np.array(onp.eye(3, dtype="float32") * 2),
+        a).sum()),
+    ("syevd_vals", lambda a: mx.nd.linalg.syevd(_spd(a))[1].sum()),
+    ("gelqf_l", lambda a: mx.nd.linalg.gelqf(a)[0].sum()),
+    ("extractdiag", lambda a: mx.nd.linalg.extractdiag(a).sum()),
+    ("makediag", lambda a: mx.nd.linalg.makediag(
+        mx.nd.linalg.extractdiag(a)).sum()),
+    ("extracttrian", lambda a: mx.nd.linalg.extracttrian(a).sum()),
+    ("maketrian", lambda a: mx.nd.linalg.maketrian(
+        mx.nd.linalg.extracttrian(a)).sum()),
+    ("np_pinv", lambda a: mx.np.linalg.pinv(_spd(a)).sum()),
+    ("np_svdvals", lambda a: mx.np.linalg.svd(_spd(a))[1].sum()),
+    ("np_eigvalsh", lambda a: mx.np.linalg.eigvalsh(_spd(a)).sum()),
+]
+
+
+@pytest.mark.parametrize("name,fn", LINALG_GRADS,
+                         ids=[c[0] for c in LINALG_GRADS])
+def test_linalg_gradient(name, fn):
+    a = _sym(3, 3, seed=41)
+    check_numeric_gradient(fn, [a], rtol=4e-2, atol=4e-2)
+
+
+# ---------------------------------------------------------------------------
+# attention gradients: the pallas flash custom-VJP backward vs FD (the
+# reference FD-checks interleaved_matmul_* the same way)
+# ---------------------------------------------------------------------------
+
+def test_flash_attention_gradient_causal():
+    from mxnet_tpu.ops.attention import flash_attention
+    from mxnet_tpu.ops.dispatch import call
+
+    rs = onp.random.RandomState(43)
+    q, k, v = (mx.np.array((rs.rand(1, 2, 8, 4) - 0.5).astype("float32"))
+               for _ in range(3))
+    check_numeric_gradient(
+        lambda q_, k_, v_: call(
+            lambda a, b, c: flash_attention(a, b, c, causal=True),
+            (q_, k_, v_), {}, name="flash_attention"),
+        [q, k, v], rtol=4e-2, atol=4e-2)
+
+
+def test_flash_attention_gradient_kv_len():
+    from mxnet_tpu.ops.attention import flash_attention
+    from mxnet_tpu.ops.dispatch import call
+
+    rs = onp.random.RandomState(44)
+    q, k, v = (mx.np.array((rs.rand(1, 2, 8, 4) - 0.5).astype("float32"))
+               for _ in range(3))
+    lens = mx.np.array(onp.array([5], "int32"))
+    check_numeric_gradient(
+        lambda q_, k_, v_: call(
+            lambda a, b, c: flash_attention(a, b, c,
+                                            kv_valid_length=lens._data),
+            (q_, k_, v_), {}, name="flash_attention"),
+        [q, k, v], rtol=4e-2, atol=4e-2)
+
+
+def test_interleaved_selfatt_gradient():
+    rs = onp.random.RandomState(45)
+    qkv = mx.np.array((rs.rand(4, 2, 24) - 0.5).astype("float32"))
+
+    def fn(x):
+        s = mx.npx.interleaved_matmul_selfatt_qk(x, heads=2)
+        w = mx.npx.softmax(s)
+        return mx.npx.interleaved_matmul_selfatt_valatt(x, w, heads=2)
+
+    check_numeric_gradient(fn, [qkv], rtol=4e-2, atol=4e-2)
+
+
+# ---------------------------------------------------------------------------
+# spatial op gradients
+# ---------------------------------------------------------------------------
+
+def test_roi_align_gradient():
+    rs = onp.random.RandomState(46)
+    x = mx.np.array((rs.rand(1, 2, 6, 6) - 0.5).astype("float32"))
+    rois = mx.np.array(onp.array([[0, 0.5, 0.5, 4.5, 4.5]], "float32"))
+    check_numeric_gradient(
+        lambda d: mx.npx.roi_align(d, rois, (2, 2)), [x],
+        rtol=4e-2, atol=4e-2)
+
+
+def test_upsampling_nearest_gradient():
+    rs = onp.random.RandomState(47)
+    x = mx.np.array((rs.rand(1, 2, 3, 3) - 0.5).astype("float32"))
+    check_numeric_gradient(
+        lambda d: mx.npx.upsampling(d, scale=2, sample_type="nearest"),
+        [x], rtol=4e-2, atol=4e-2)
+
+
+def test_upsampling_bilinear_gradient():
+    """Bilinear path = transposed conv with a TRAINABLE weight
+    (ops/spatial.py:290): FD-check both the data and weight grads."""
+    rs = onp.random.RandomState(50)
+    x = mx.np.array((rs.rand(1, 2, 3, 3) - 0.5).astype("float32"))
+    # kernel 2*scale - scale%2 = 4, shape (C, 1, 4, 4) with num_group=C
+    w = mx.np.array((rs.rand(2, 1, 4, 4) * 0.25).astype("float32"))
+    check_numeric_gradient(
+        lambda d, ww: mx.npx.upsampling(
+            d, ww, scale=2, sample_type="bilinear", num_filter=2,
+            num_args=2),
+        [x, w], rtol=4e-2, atol=4e-2)
+
+
+def test_softmax_cross_entropy_gradient():
+    rs = onp.random.RandomState(48)
+    logits = mx.np.array((rs.rand(3, 5) - 0.5).astype("float32"))
+    labels = mx.np.array(onp.array([0, 2, 4], "int32"))
+    check_numeric_gradient(
+        lambda lg: mx.npx.softmax_cross_entropy(lg, labels), [logits],
+        rtol=4e-2, atol=4e-2)
