@@ -1,0 +1,140 @@
+"""Mixture-of-Experts with expert parallelism over an 'ep' mesh axis.
+
+No reference counterpart (the reference's distributed story is
+kvstore data parallelism only); built per the framework charter —
+expert parallelism is a first-class sharding dimension next to
+dp/fsdp/tp/sp.  The algorithm is the Mesh-TensorFlow/Switch dispatch:
+
+  1. gate: token -> top-k experts (softmax over E logits)
+  2. capacity-bounded dispatch tensor (tokens, E, C) built from a
+     position-in-expert cumsum — static shapes, jit-safe
+  3. lax.all_to_all over 'ep' routes each expert's token slots to the
+     device that owns it (E = ep_size * experts_per_device)
+  4. local experts run their FFN on (E_local, ep*C, d)
+  5. reverse all_to_all + combine weights scatter results back to tokens
+
+``moe_ffn`` is valid inside shard_map/pjit with an 'ep' axis;
+``moe_reference`` is the dense single-device semantics used by tests and
+the eager fallback.  The auxiliary load-balancing loss follows the
+Switch-Transformer formula (mean gate prob x mean dispatch fraction x E).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["moe_ffn", "moe_reference", "gate_topk", "aux_load_balance"]
+
+
+def gate_topk(logits, k: int):
+    """Top-k gating: returns (weights (n, k), indices (n, k)) with the
+    selected probabilities renormalized to sum to 1 per token."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    vals, idx = lax.top_k(probs, k)
+    vals = vals / jnp.maximum(vals.sum(-1, keepdims=True), 1e-9)
+    return vals, idx
+
+
+def aux_load_balance(probs, dispatch_frac):
+    """Switch aux loss: E * mean_e(gate prob) . mean_e(token fraction)."""
+    e = probs.shape[-1]
+    return e * jnp.sum(probs.mean(0) * dispatch_frac)
+
+
+def _dispatch_tensors(logits, num_experts: int, capacity: int, k: int):
+    """Build (dispatch (n,E,C) bool, combine (n,E,C) f32, aux scalar)."""
+    n = logits.shape[0]
+    weights, idx = gate_topk(logits, k)             # (n,k)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    dispatch = jnp.zeros((n, num_experts, capacity), jnp.bool_)
+    combine = jnp.zeros((n, num_experts, capacity), jnp.float32)
+    # experts fill slots in token order, k-th choices after (k-1)-th:
+    # running per-expert counts thread through the selection loop
+    counts = jnp.zeros((num_experts,), jnp.int32)
+    frac = jnp.zeros((num_experts,), jnp.float32)
+    for j in range(k):
+        sel = jax.nn.one_hot(idx[:, j], num_experts, dtype=jnp.int32)  # (n,E)
+        pos = counts[None, :] + jnp.cumsum(sel, axis=0) - sel          # (n,E)
+        keep = sel.astype(bool) & (pos < capacity)
+        slot = jax.nn.one_hot(jnp.where(keep.any(-1), pos[jnp.arange(n),
+                                                         idx[:, j]], 0),
+                              capacity, dtype=jnp.float32)             # (n,C)
+        token_keep = keep[jnp.arange(n), idx[:, j]]                    # (n,)
+        d_j = (sel.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+               * token_keep[:, None, None])
+        dispatch = dispatch | d_j.astype(bool)
+        combine = combine + d_j * weights[:, j][:, None, None]
+        counts = counts + (sel * token_keep[:, None]).sum(0)
+        frac = frac + sel.astype(jnp.float32).mean(0)
+    aux = aux_load_balance(probs, frac / k)
+    return dispatch, combine, aux
+
+
+def moe_reference(x, gate_w, w_up, w_down, k: int = 2,
+                  capacity_factor: float = 1.5,
+                  activation=jax.nn.gelu):
+    """Dense single-device MoE semantics (all experts local).
+
+    x: (n, d); gate_w: (d, E); w_up: (E, d, h); w_down: (E, h, d).
+    Returns (out (n, d), aux_loss scalar)."""
+    n, d = x.shape
+    e = gate_w.shape[1]
+    capacity = max(1, math.ceil(n * k * capacity_factor / e))
+    logits = x @ gate_w.astype(x.dtype)
+    dispatch, combine, aux = _dispatch_tensors(logits, e, capacity, k)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+    h = activation(jnp.einsum("ecd,edh->ech", expert_in, w_up))
+    expert_out = jnp.einsum("ech,ehd->ecd", h, w_down)
+    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
+    return out.astype(x.dtype), aux
+
+
+def moe_ffn(x, gate_w, w_up_local, w_down_local, axis_name: str = "ep",
+            k: int = 2, capacity_factor: float = 1.5,
+            activation=jax.nn.gelu):
+    """Expert-parallel MoE FFN — call inside shard_map over 'ep'.
+
+    Per-device views:
+      x:            (n_local, d)  token shard
+      gate_w:       (d, E)        replicated gate, E = ep * E_local
+      w_up_local:   (E_local, d, h)  this device's experts
+      w_down_local: (E_local, h, d)
+    Returns (out (n_local, d), aux_loss scalar — psum-mean over the axis).
+    """
+    ep = lax.axis_size(axis_name)
+    n, d = x.shape
+    e_local = w_up_local.shape[0]
+    e = ep * e_local
+    capacity = max(1, math.ceil(n * k * capacity_factor / e))
+
+    logits = x @ gate_w.astype(x.dtype)
+    dispatch, combine, aux = _dispatch_tensors(logits, e, capacity, k)
+
+    # (n, E, C) -> (E, C, d) token slots, grouped by owning device:
+    # axis 0 of the (ep, e_local, C, d) view indexes the DESTINATION
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+    expert_in = expert_in.reshape(ep, e_local, capacity, d)
+    # after the exchange axis 0 indexes the SOURCE device; each device
+    # now holds every peer's slots for ITS local experts
+    routed = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                            concat_axis=0)          # (ep_src, e_local, C, d)
+    routed = routed.transpose(1, 0, 2, 3).reshape(e_local,
+                                                  ep * capacity, d)
+
+    h = activation(jnp.einsum("ecd,edh->ech", routed, w_up_local))
+    out_slots = jnp.einsum("ech,ehd->ecd", h, w_down_local)
+
+    # reverse route: regroup by source device and send each slice home
+    out_slots = out_slots.reshape(e_local, ep, capacity, d)
+    out_slots = out_slots.transpose(1, 0, 2, 3)     # (ep_dst, e_local, C, d)
+    returned = lax.all_to_all(out_slots, axis_name, split_axis=0,
+                              concat_axis=0)        # (ep_owner, e_local, C, d)
+    returned = returned.reshape(e, capacity, d)     # expert-major, as sent
+    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), returned)
+    aux = lax.pmean(aux, axis_name)
+    return out.astype(x.dtype), aux

@@ -1,0 +1,102 @@
+"""Pipeline parallelism over a 'pp' mesh axis (GPipe schedule).
+
+No reference counterpart (SURVEY.md §5: the reference scales via kvstore
+data parallelism only); built per the framework charter — 'pp' joins
+dp/fsdp/tp/sp/ep as a first-class axis.
+
+Model: the network is a chain of S identical-signature stages; device p
+of the 'pp' axis holds ONLY stage p's parameters (stack the per-stage
+pytrees on a leading axis and shard it over 'pp').  ``pipeline_apply``
+runs the microbatched GPipe schedule inside shard_map:
+
+  step t in [0, M + S - 1):
+    every device shifts its activation to the next device (ppermute),
+    device 0 injects microbatch t (or a dead bubble), every device
+    applies its stage, the last device banks finished microbatches.
+
+All shapes are static (bubbles are computed and masked), so the whole
+schedule jits to one XLA while/scan program; the per-step neighbor
+exchange rides ICI.  Backward comes for free: the schedule is pure lax
+control flow, so jax.grad differentiates it (activation rematerialization
+can be layered with jax.checkpoint around stage_fn).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["pipeline_apply", "pipeline_reference"]
+
+
+def pipeline_reference(stage_fn: Callable, stacked_params, x):
+    """Sequential semantics: fold x through every stage on one device.
+    stacked_params: pytree with a leading stage axis S."""
+    s = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+
+    def body(h, i):
+        p = jax.tree.map(lambda a: a[i], stacked_params)
+        return stage_fn(p, h), None
+
+    out, _ = lax.scan(body, x, jnp.arange(s))
+    return out
+
+
+def pipeline_apply(stage_fn: Callable, local_params, x,
+                   axis_name: str = "pp", n_microbatch: int = None):
+    """GPipe pipeline — call inside shard_map over 'pp'.
+
+    stage_fn(params, h) -> h with h of constant shape across stages.
+    local_params: THIS device's stage parameters (leading stage axis
+        already sharded away by shard_map in_specs).
+    x: (M, mb, ...) microbatched input, replicated across the axis
+        (device 0 consumes it; n_microbatch defaults to M).
+    Returns (M, mb, ...) final-stage outputs, identical on every device
+    (psum-broadcast from the last stage).
+    """
+    s = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    # the stacking contract: params carry a leading stage axis sharded
+    # over 'pp'; shard_map leaves it as size 1 locally — strip it here so
+    # stage_fn sees the per-stage pytree
+    def _strip(a):
+        if a.ndim == 0 or a.shape[0] != 1:
+            raise ValueError(
+                "pipeline_apply expects params stacked on a leading "
+                f"stage axis sharded over {axis_name!r} (local size 1); "
+                f"got leaf shape {a.shape}")
+        return a[0]
+
+    local_params = jax.tree.map(_strip, local_params)
+    m = x.shape[0] if n_microbatch is None else n_microbatch
+    mb_shape = x.shape[1:]
+    steps = m + s - 1
+    fwd = [(i, (i + 1) % s) for i in range(s)]  # ring shift; wraparound
+    # from the last stage back to 0 carries only dead values
+
+    def step(carry, t):
+        h, out = carry
+        # previous device's activation arrives; stage 0's slot is fed
+        # with microbatch t (or a bubble past the end)
+        h_in = lax.ppermute(h, axis_name, fwd)
+        idx = jnp.minimum(t, m - 1)
+        feed = lax.dynamic_index_in_dim(x, idx, axis=0, keepdims=False)
+        h_in = jnp.where(rank == 0, feed, h_in)
+        h_out = stage_fn(local_params, h_in)
+        # device s-1 finishes microbatch t-(s-1) at step t; a where-form
+        # update (not cond) keeps the predicate free to vary per device
+        done = t - (s - 1)
+        bank = (rank == s - 1) & (done >= 0)
+        updated = lax.dynamic_update_index_in_dim(
+            out, h_out, jnp.maximum(done, 0), axis=0)
+        out = jnp.where(bank, updated, out)
+        return (h_out, out), None
+
+    h0 = jnp.zeros(mb_shape, x.dtype)
+    out0 = jnp.zeros((m,) + mb_shape, x.dtype)
+    (_, out), _ = lax.scan(step, (h0, out0), jnp.arange(steps))
+    # broadcast the last device's bank to every member of the axis
+    out = jnp.where(rank == s - 1, out, jnp.zeros_like(out))
+    return lax.psum(out, axis_name)

@@ -1,0 +1,166 @@
+"""Pipeline ('pp') and expert ('ep') parallelism correctness on the
+virtual mesh — the same equality bar the dp/fsdp/tp specs are held to
+(n-device run must reproduce the single-device reference semantics).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from mxnet_tpu.parallel.mesh import make_mesh
+from mxnet_tpu.parallel.moe import moe_ffn, moe_reference
+from mxnet_tpu.parallel.pipeline import pipeline_apply, pipeline_reference
+
+
+def _stage_fn(params, h):
+    w, b = params
+    return jnp.tanh(h @ w + b)
+
+
+def _stack_stages(s, d, seed=0):
+    rs = onp.random.RandomState(seed)
+    w = jnp.asarray(rs.rand(s, d, d).astype("float32") * 0.5 - 0.25)
+    b = jnp.asarray(rs.rand(s, d).astype("float32") * 0.1)
+    return (w, b)
+
+
+@pytest.mark.parametrize("pp,m", [(4, 8), (8, 8), (2, 3)])
+def test_pipeline_matches_sequential(pp, m):
+    mesh = make_mesh({"pp": pp}, devices=jax.devices()[:pp])
+    d, mb = 6, 3
+    params = _stack_stages(pp, d)
+    rs = onp.random.RandomState(1)
+    x = jnp.asarray(rs.rand(m, mb, d).astype("float32"))
+
+    want = pipeline_reference(_stage_fn, params, x)
+
+    piped = shard_map(
+        lambda p, xx: pipeline_apply(_stage_fn, p, xx, axis_name="pp"),
+        mesh=mesh,
+        in_specs=((P("pp"), P("pp")), P()),
+        out_specs=P(),
+        check_rep=False)
+    # shard_map splits the stage axis: device i holds stage i's params
+    got = jax.jit(piped)((params[0], params[1]), x)
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(want),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_is_differentiable():
+    pp, m, mb, d = 4, 4, 2, 4
+    mesh = make_mesh({"pp": pp}, devices=jax.devices()[:pp])
+    params = _stack_stages(pp, d, seed=2)
+    rs = onp.random.RandomState(3)
+    x = jnp.asarray(rs.rand(m, mb, d).astype("float32"))
+
+    piped = shard_map(
+        lambda p, xx: pipeline_apply(_stage_fn, p, xx, axis_name="pp"),
+        mesh=mesh, in_specs=((P("pp"), P("pp")), P()), out_specs=P(),
+        check_rep=False)
+
+    def loss_pipe(p):
+        return (piped(p, x) ** 2).sum()
+
+    def loss_ref(p):
+        return (pipeline_reference(_stage_fn, p, x) ** 2).sum()
+
+    g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+    g_ref = jax.grad(loss_ref)(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_ref)):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=2e-4, atol=2e-4)
+
+
+def _moe_weights(e, d, h, seed=0):
+    rs = onp.random.RandomState(seed)
+    gate = jnp.asarray(rs.rand(d, e).astype("float32") - 0.5)
+    up = jnp.asarray((rs.rand(e, d, h).astype("float32") - 0.5) * 0.4)
+    down = jnp.asarray((rs.rand(e, h, d).astype("float32") - 0.5) * 0.4)
+    return gate, up, down
+
+
+@pytest.mark.parametrize("ep,e_local,k", [(4, 1, 2), (4, 2, 2), (2, 2, 1)])
+def test_moe_expert_parallel_matches_dense(ep, e_local, k):
+    """ep-sharded MoE == dense all-local reference, token shards and all.
+
+    High capacity_factor so no token is dropped — dropping order is the
+    only legitimately implementation-defined part."""
+    e, d, h = ep * e_local, 8, 16
+    n_per, cf = 6, 8.0
+    mesh = make_mesh({"ep": ep}, devices=jax.devices()[:ep])
+    gate, up, down = _moe_weights(e, d, h)
+    rs = onp.random.RandomState(5)
+    x = jnp.asarray(rs.rand(ep * n_per, d).astype("float32") - 0.5)
+
+    sharded = shard_map(
+        lambda xx, g, u, dn: moe_ffn(xx, g, u, dn, axis_name="ep", k=k,
+                                     capacity_factor=cf),
+        mesh=mesh,
+        in_specs=(P("ep"), P(), P("ep"), P("ep")),
+        out_specs=(P("ep"), P()),
+        check_rep=False)
+    got, aux = jax.jit(sharded)(x, gate, up, down)
+
+    # dense reference must use the same per-shard capacity computation:
+    # run it shard by shard with all experts local
+    outs = []
+    for p in range(ep):
+        xs = x[p * n_per:(p + 1) * n_per]
+        o, _ = moe_reference(xs, gate, up, down, k=k, capacity_factor=cf
+                             * 1.0 / ep * ep)
+        outs.append(o)
+    # NOTE: reference capacity uses n*k*cf/e with n = shard size — match
+    want = jnp.concatenate(outs)
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(want),
+                                rtol=2e-4, atol=2e-4)
+    assert onp.isfinite(float(aux))
+
+
+def test_moe_capacity_drops_are_bounded():
+    """With tiny capacity some tokens drop (output rows ~0 after combine
+    normalization) — never NaN, and aux loss stays finite."""
+    ep, e_local, d, h = 4, 1, 8, 16
+    e = ep * e_local
+    mesh = make_mesh({"ep": ep}, devices=jax.devices()[:ep])
+    gate, up, down = _moe_weights(e, d, h, seed=7)
+    rs = onp.random.RandomState(8)
+    x = jnp.asarray(rs.rand(ep * 8, d).astype("float32") - 0.5)
+
+    sharded = shard_map(
+        lambda xx, g, u, dn: moe_ffn(xx, g, u, dn, axis_name="ep", k=1,
+                                     capacity_factor=0.25),
+        mesh=mesh, in_specs=(P("ep"), P(), P("ep"), P("ep")),
+        out_specs=(P("ep"), P()))
+    out, aux = jax.jit(sharded)(x, gate, up, down)
+    assert onp.isfinite(onp.asarray(out)).all()
+    assert onp.isfinite(float(aux))
+
+
+def test_moe_gradients_flow():
+    ep, e_local, d, h = 2, 2, 6, 8
+    e = ep * e_local
+    mesh = make_mesh({"ep": ep}, devices=jax.devices()[:ep])
+    gate, up, down = _moe_weights(e, d, h, seed=9)
+    rs = onp.random.RandomState(10)
+    x = jnp.asarray(rs.rand(ep * 4, d).astype("float32") - 0.5)
+
+    sharded = shard_map(
+        lambda xx, g, u, dn: moe_ffn(xx, g, u, dn, axis_name="ep", k=2,
+                                     capacity_factor=4.0),
+        mesh=mesh, in_specs=(P("ep"), P(), P("ep"), P("ep")),
+        out_specs=(P("ep"), P()))
+
+    def loss(g, u, dn):
+        out, aux = sharded(x, g, u, dn)
+        return (out ** 2).sum() + 0.01 * aux
+
+    gg, gu, gd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(gate, up, down)
+    for g in (gg, gu, gd):
+        arr = onp.asarray(g)
+        assert onp.isfinite(arr).all()
+        assert (arr != 0).any(), "gradient vanished entirely"
