@@ -64,6 +64,12 @@ from . import profiler
 from . import runtime
 from . import amp
 from . import symbol
+from . import callback
+from . import dlpack
+from . import error
+from . import name
+from . import attribute
+from .attribute import AttrScope
 from . import symbol as sym
 from . import visualization
 from . import visualization as viz

@@ -1,0 +1,46 @@
+"""Shared thread-local scope-stack machinery for NameManager and
+AttrScope (ref name.py/attribute.py both hand-roll the same pattern)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["ThreadLocalScope"]
+
+
+class ThreadLocalScope:
+    """``with``-stackable scope with a per-thread stack and a default
+    bottom element.  Subclasses may override ``_entered`` to transform
+    the instance pushed on entry (AttrScope pushes a merged scope)."""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        # each DIRECT subclass family gets its own stack; nested
+        # subclasses (Prefix < NameManager) share their parent's
+        root = cls
+        while ThreadLocalScope not in root.__bases__:
+            root = root.__mro__[1]
+        if root is cls:
+            cls._tls = threading.local()
+        cls._scope_root = root
+
+    @classmethod
+    def _stack(cls):
+        stack = getattr(cls._scope_root._tls, "stack", None)
+        if not stack:
+            stack = cls._scope_root._tls.stack = [cls._scope_root()]
+        return stack
+
+    @classmethod
+    def current(cls):
+        return cls._stack()[-1]
+
+    def _entered(self):
+        """The instance actually pushed; default: self."""
+        return self
+
+    def __enter__(self):
+        self._stack().append(self._entered())
+        return self
+
+    def __exit__(self, *exc):
+        self._stack().pop()

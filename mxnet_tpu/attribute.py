@@ -1,0 +1,44 @@
+"""Attribute scopes for symbol construction (ref python/mxnet/attribute.py).
+
+``with mx.AttrScope(group='fc'):`` stamps every symbol node created in
+the block with the given attributes (surviving JSON round-trip under the
+``__scope_*`` keys the nnvm-style writer serializes).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ._scope import ThreadLocalScope
+from .base import MXNetError
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope(ThreadLocalScope):
+    """Thread-local scoped attribute stamping (ref attribute.py)."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise MXNetError(
+                    "Attributes need to be string; the reference enforces "
+                    f"this too (got {type(v).__name__})")
+        self._attrs: Dict[str, str] = kwargs
+
+    def get(self, attrs: Dict[str, str] = None) -> Dict[str, str]:
+        """Scope attrs merged under explicitly-passed ones
+        (ref attribute.py AttrScope.get)."""
+        out = dict(self._attrs)
+        if attrs:
+            out.update(attrs)
+        return out
+
+    def _entered(self):
+        # nested scopes see the union of enclosing attrs
+        merged = AttrScope()
+        merged._attrs = {**AttrScope.current()._attrs, **self._attrs}
+        return merged
+
+
+def current() -> AttrScope:
+    return AttrScope.current()

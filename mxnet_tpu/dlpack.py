@@ -1,0 +1,71 @@
+"""DLPack interop (ref python/mxnet/dlpack.py).
+
+Zero-copy exchange with any DLPack consumer/producer (torch, numpy,
+cupy).  The backing store is an immutable ``jax.Array``, so BOTH export
+flavors hand out the same read-only view; `to_dlpack_for_write`'s
+mutation contract cannot be honored and is documented as read-only here
+(docs/divergences.md: copy-not-view NDArray semantics).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["to_dlpack_for_read", "to_dlpack_for_write", "from_dlpack"]
+
+
+def _capsule(data: NDArray):
+    # a bare capsule carries no device tag, so only CPU-backed arrays may
+    # round-trip through one (see _CapsuleShim); accelerator arrays pass
+    # the NDArray itself — it implements __dlpack__/__dlpack_device__
+    dev_kind = data._data.__dlpack_device__()[0]
+    if dev_kind not in (1, 2):  # kDLCPU / kDLCPUPinned
+        raise MXNetError(
+            "to_dlpack_for_*: raw capsules lose the device tag; for "
+            "accelerator-resident arrays hand the NDArray itself to the "
+            "consumer (it implements the DLPack producer protocol)")
+    return data._data.__dlpack__()
+
+
+def to_dlpack_for_read(data: NDArray):
+    """Export as a DLPack capsule (ref dlpack.py ndarray_to_dlpack_for_read).
+
+    ``torch.utils.dlpack.from_dlpack`` accepts the result directly; the
+    jax buffer is exported read-only.  CPU-backed arrays only — see
+    :func:`_capsule`."""
+    return _capsule(data)
+
+
+def to_dlpack_for_write(data: NDArray):
+    """Same capsule as :func:`to_dlpack_for_read` — writes through the
+    capsule are NOT reflected (immutable XLA buffer; divergence)."""
+    return _capsule(data)
+
+
+class _CapsuleShim:
+    """Adapter for legacy raw-capsule ingestion: modern consumers (jax
+    included) take a PRODUCER object with __dlpack__/__dlpack_device__,
+    not a bare capsule.  A capsule does not carry its device, so this
+    shim declares kDLCPU — the only cross-framework capsule source in
+    practice (torch-CPU / numpy); accelerator arrays arrive as producer
+    objects and never hit this path."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, *, stream=None):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # (kDLCPU, 0)
+
+
+def from_dlpack(ext):
+    """Import a DLPack capsule or any object with ``__dlpack__``
+    (ref dlpack.py ndarray_from_dlpack); zero-copy when the producer's
+    device/layout allows, else one host copy."""
+    import jax.numpy as jnp
+
+    if not hasattr(ext, "__dlpack__"):  # legacy raw capsule
+        ext = _CapsuleShim(ext)
+    return NDArray(jnp.from_dlpack(ext))

@@ -1,0 +1,43 @@
+"""Symbol auto-naming scopes (ref python/mxnet/name.py).
+
+``NameManager`` controls how anonymous symbols are named; ``Prefix``
+prepends a fixed prefix inside its scope.  The symbol layer's `_unique`
+consults the innermost active manager, so
+``with mx.name.Prefix('enc_'):`` names every op created inside the block
+``enc_<op><n>`` exactly like the reference.
+"""
+from __future__ import annotations
+
+from ._scope import ThreadLocalScope
+
+__all__ = ["NameManager", "Prefix"]
+
+
+class NameManager(ThreadLocalScope):
+    """Thread-local scoped auto-namer (ref name.py NameManager)."""
+
+    def __init__(self):
+        self._counter: dict = {}
+
+    def get(self, name, hint: str):
+        """Return ``name`` if given, else generate from ``hint``
+        (ref name.py NameManager.get)."""
+        if name:
+            return name
+        self._counter.setdefault(hint, 0)
+        out = f"{hint}{self._counter[hint]}"
+        self._counter[hint] += 1
+        return out
+
+
+class Prefix(NameManager):
+    """Prepend ``prefix`` to every auto-generated name
+    (ref name.py Prefix)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint: str):
+        name = super().get(name, hint)
+        return self._prefix + name

@@ -342,6 +342,8 @@ from . import random  # noqa: E402
 from . import linalg  # noqa: E402
 from .utils import save, load  # noqa: E402
 from . import sparse  # noqa: E402
+from ..dlpack import (to_dlpack_for_read, to_dlpack_for_write,  # noqa: E402
+                      from_dlpack)
 
 
 def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,

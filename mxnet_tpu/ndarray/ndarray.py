@@ -175,6 +175,14 @@ class NDArray:
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 
+    # DLPack producer protocol (ref python/mxnet/dlpack.py): lets
+    # torch.from_dlpack / onp.from_dlpack consume NDArrays zero-copy
+    def __dlpack__(self, *, stream=None):
+        return self._data.__dlpack__(stream=stream)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
     # -- NumPy dispatch protocols (ref numpy_dispatch_protocol.py:
     # __array_ufunc__/__array_function__ interop so onp.exp(mx_arr) and
     # onp.concatenate([mx_arr, ...]) stay IN the framework, on device,

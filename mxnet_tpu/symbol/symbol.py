@@ -195,12 +195,14 @@ def resolve_op(name: str) -> Callable:
     return _OP_REGISTRY[name]
 
 
-_UID = [0]
-
-
 def _unique(prefix: str) -> str:
-    _UID[0] += 1
-    return f"{prefix}{_UID[0]}"
+    """Auto-name via the active NameManager scope (ref name.py
+    NameManager/Prefix semantics: per-hint counters, thread-local
+    scoping), so ``with mx.name.Prefix('enc_')`` shapes symbol names
+    exactly like the reference."""
+    from ..name import NameManager
+
+    return NameManager.current().get(None, prefix)
 
 
 _KW_FILTER_CACHE: Dict[int, Optional[frozenset]] = {}
@@ -271,6 +273,29 @@ class Symbol:
         return [f"{node.name}_output{idx}" if node.n_out > 1
                 else f"{node.name}_output"
                 for node, idx in self._outputs]
+
+    def attr(self, key: str):
+        """This symbol's attribute ``key`` (ref symbol.py Symbol.attr):
+        explicit attrs first, then AttrScope-stamped ones."""
+        n = self._outputs[0][0]
+        v = n.attrs.get(key)
+        if v is None:
+            v = n.attrs.get(f"__scope_{key}")
+        return v if isinstance(v, str) else None
+
+    def list_attr(self) -> Dict[str, str]:
+        """String attributes of this node (ref symbol.py list_attr),
+        AttrScope-stamped keys included (unprefixed)."""
+        n = self._outputs[0][0]
+        out = {}
+        for k, v in n.attrs.items():
+            if not isinstance(v, str):
+                continue
+            if k.startswith("__scope_"):
+                out[k[len("__scope_"):]] = v
+            elif not k.startswith("__"):
+                out[k] = v
+        return out
 
     def get_internals(self) -> "Symbol":
         """Every node as an output (ref symbol.py get_internals)."""
@@ -514,7 +539,9 @@ class Symbol:
                 "inputs": [[index[id(s)], i, 0] for s, i in n.inputs],
             }
             attrs = {k: (v if isinstance(v, str) else json.dumps(v))
-                     for k, v in n.attrs.items() if not k.startswith("__")}
+                     for k, v in n.attrs.items()
+                     if not k.startswith("__")
+                     or k.startswith("__scope_")}  # user attrs survive
             if n.fn is not None and not n.is_var():
                 # a traced node is re-executable from JSON when its op
                 # resolves in the registry (attrs carry the config —
@@ -625,15 +652,29 @@ def _apply_op(opname: str, sym_args: Sequence[Symbol],
     # multi-output composed ops declare arity via num_outputs (reference
     # split/SliceChannel convention); the interpreter enforces the match
     n_out = int(attrs.get("num_outputs", 1))
-    node = _Node(name or _unique(opname.lower() + ""),
-                 opname, dict(attrs),
+    stamped = _scope_attrs()
+    stamped.update(attrs)
+    node = _Node(name or _unique(opname.lower()),
+                 opname, stamped,
                  [s._outputs[0] for s in sym_args], n_out=n_out)
     return Symbol([(node, i) for i in range(n_out)])
 
 
+def _scope_attrs() -> Dict[str, Any]:
+    """Active AttrScope attrs under execution-inert ``__scope_`` keys
+    (the executor passes plain attrs as op kwargs; scope metadata must
+    never reach the kernel)."""
+    from ..attribute import AttrScope
+
+    return {f"__scope_{k}": v
+            for k, v in AttrScope.current().get(None).items()}
+
+
 def Variable(name: str, **attrs) -> Symbol:
-    """Ref symbol.py var/Variable."""
-    return Symbol([(_Node(name, None, dict(attrs), []), 0)])
+    """Ref symbol.py var/Variable (AttrScope attrs stamp variables too)."""
+    stamped = _scope_attrs()
+    stamped.update(attrs)
+    return Symbol([(_Node(name, None, stamped, []), 0)])
 
 
 var = Variable
@@ -656,6 +697,11 @@ def fromjson(text: str) -> Symbol:
         raw_attrs = entry.get("attrs", {})
         attrs = {}
         for k, v in raw_attrs.items():
+            if k.startswith("__scope_"):
+                # user/AttrScope attrs are strings by contract; parsing
+                # '0.1' to a float here would drop them from list_attr
+                attrs[k] = v
+                continue
             try:
                 attrs[k] = json.loads(v) if isinstance(v, str) else v
             except (json.JSONDecodeError, TypeError):

@@ -1,0 +1,185 @@
+"""mx.callback / mx.dlpack / mx.error / mx.name / mx.AttrScope parity
+(ref python/mxnet/{callback,dlpack,error,name,attribute}.py)."""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+np_ = mx.np
+
+
+# ---------------------------------------------------------------------------
+# dlpack
+# ---------------------------------------------------------------------------
+
+def test_dlpack_roundtrip_numpy():
+    # numpy -> mx via the producer protocol (numpy's own from_dlpack
+    # refuses readonly buffers, so the mx->numpy leg goes through torch
+    # in test_dlpack_torch_interop instead)
+    src = onp.arange(6, dtype="float32").reshape(2, 3)
+    a = mx.nd.from_dlpack(src)
+    onp.testing.assert_allclose(a.asnumpy(), src)
+    assert mx.nd.array(src).__dlpack_device__()[0] in (1, 2)  # CPU kinds
+
+
+def test_dlpack_torch_interop():
+    import torch
+
+    a = mx.nd.array(onp.arange(4, dtype="float32"))
+    t = torch.from_dlpack(a)
+    onp.testing.assert_allclose(t.numpy(), a.asnumpy())
+    # torch -> mx
+    src = torch.arange(5, dtype=torch.float32)
+    b = mx.nd.from_dlpack(src)
+    onp.testing.assert_allclose(b.asnumpy(), src.numpy())
+
+
+def test_dlpack_capsule_api():
+    a = mx.nd.array(onp.ones((3,), "float32"))
+    cap = mx.nd.to_dlpack_for_read(a)
+    b = mx.nd.from_dlpack(cap)
+    onp.testing.assert_allclose(b.asnumpy(), onp.ones(3))
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy
+# ---------------------------------------------------------------------------
+
+def test_error_distill_known_and_unknown():
+    e = mx.error.distill_error("ValueError: bad axis")
+    assert isinstance(e, ValueError) and "bad axis" in str(e)
+    e = mx.error.distill_error("SomethingWeird: boom")
+    assert isinstance(e, mx.MXNetError)
+
+
+def test_error_internal_hint():
+    e = mx.error.InternalError("engine corrupted")
+    assert "MXNet hint" in str(e)
+    assert isinstance(e, mx.MXNetError)
+
+
+def test_error_register_custom():
+    @mx.error.register
+    class CartError(mx.MXNetError):
+        pass
+
+    e = mx.error.distill_error("CartError: off the rails")
+    assert isinstance(e, CartError)
+
+
+# ---------------------------------------------------------------------------
+# callbacks
+# ---------------------------------------------------------------------------
+
+class _FakeMetric:
+    def __init__(self):
+        self.resets = 0
+
+    def get_name_value(self):
+        return [("acc", 0.5)]
+
+    def reset(self):
+        self.resets += 1
+
+
+def test_speedometer_logs_and_resets(caplog):
+    sm = mx.callback.Speedometer(batch_size=4, frequent=2, auto_reset=True)
+    metric = _FakeMetric()
+    with caplog.at_level(logging.INFO):
+        for nb in range(5):
+            sm(mx.callback.BatchEndParam(epoch=0, nbatch=nb,
+                                         eval_metric=metric, locals=None))
+    assert any("samples/sec" in r.message for r in caplog.records)
+    assert metric.resets >= 1
+
+
+def test_log_train_metric(caplog):
+    cb = mx.callback.log_train_metric(period=1, auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        cb(mx.callback.BatchEndParam(epoch=1, nbatch=3,
+                                     eval_metric=_FakeMetric(),
+                                     locals=None))
+    assert any("Train-acc" in r.message for r in caplog.records)
+
+
+def test_do_checkpoint_saves(tmp_path):
+    x = mx.sym.var("data")
+    net = mx.sym.FullyConnected(x, num_hidden=3, name="fc")
+    cb = mx.callback.do_checkpoint(str(tmp_path / "m"), period=2)
+    args = {"fc_weight": mx.nd.array(onp.ones((3, 4), "float32")),
+            "fc_bias": mx.nd.array(onp.zeros(3, "float32"))}
+    cb(0, net, args, {})   # epoch 1: period 2 -> no file yet
+    cb(1, net, args, {})   # epoch 2: saves
+    assert (tmp_path / "m-symbol.json").exists()
+    assert (tmp_path / "m-0002.params").exists()
+
+
+def test_validation_metrics_callback(caplog):
+    cb = mx.callback.LogValidationMetricsCallback()
+    with caplog.at_level(logging.INFO):
+        cb(mx.callback.BatchEndParam(epoch=2, nbatch=0,
+                                     eval_metric=_FakeMetric(),
+                                     locals=None))
+    assert any("Validation-acc" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# name / attribute scopes
+# ---------------------------------------------------------------------------
+
+def test_prefix_scope_shapes_symbol_names():
+    with mx.name.Prefix("enc_"):
+        s = mx.sym.FullyConnected(mx.sym.var("x"), num_hidden=2)
+    assert s._outputs[0][0].name.startswith("enc_")
+    t = mx.sym.FullyConnected(mx.sym.var("y"), num_hidden=2)
+    assert not t._outputs[0][0].name.startswith("enc_")
+
+
+def test_name_manager_counts_per_hint():
+    m = mx.name.NameManager()
+    assert m.get(None, "fc") == "fc0"
+    assert m.get(None, "fc") == "fc1"
+    assert m.get(None, "conv") == "conv0"
+    assert m.get("explicit", "fc") == "explicit"
+
+
+def test_attr_scope_stamps_and_survives_json():
+    with mx.AttrScope(group="encoder", lr_mult="0.1"):
+        s = mx.sym.FullyConnected(mx.sym.var("d"), num_hidden=2,
+                                  name="fca")
+    assert s.attr("group") == "encoder"
+    assert s.list_attr()["lr_mult"] == "0.1"
+    # survives the nnvm-json round trip
+    js = s.tojson()
+    assert "__scope_group" in js
+    # outside the scope: no stamping
+    t = mx.sym.FullyConnected(mx.sym.var("d2"), num_hidden=2)
+    assert t.attr("group") is None
+
+
+def test_attr_scope_nesting_merges():
+    with mx.AttrScope(a="1"):
+        with mx.AttrScope(b="2"):
+            s = mx.sym.var("v")
+    attrs = s.list_attr()
+    assert attrs["a"] == "1" and attrs["b"] == "2"
+
+
+def test_attr_scope_rejects_non_string():
+    with pytest.raises(mx.MXNetError):
+        mx.AttrScope(group=3)
+
+
+def test_symbol_execution_unaffected_by_scope_attrs():
+    with mx.AttrScope(group="g"):
+        x = mx.sym.var("data")
+        y = mx.sym.FullyConnected(x, num_hidden=3, name="fcx")
+    out = y.eval(data=mx.nd.array(onp.ones((2, 4), "float32")),
+                 fcx_weight=mx.nd.array(onp.ones((3, 4), "float32")),
+                 fcx_bias=mx.nd.array(onp.zeros(3, "float32")))
+    res = out[0] if isinstance(out, (list, tuple)) else out
+    onp.testing.assert_allclose(res.asnumpy(), onp.full((2, 3), 4.0))
