@@ -54,15 +54,21 @@ class InternalError(MXNetError):
         super().__init__(msg)
 
 
-for _name, _cls in (("ValueError", ValueError), ("TypeError", TypeError),
-                    ("AttributeError", AttributeError),
-                    ("IndexError", IndexError),
-                    ("NotImplementedError", NotImplementedError),
-                    ("IOError", IOError),
-                    ("FloatingPointError", FloatingPointError),
-                    ("RuntimeError", RuntimeError),
-                    ("KeyError", KeyError)):
-    register_error(_name, _cls)
+# the reference defines each known type as BOTH an MXNetError and the
+# matching builtin (python/mxnet/error.py `class ValueError(MXNetError)`),
+# so `except MXNetError` still catches typed native errors AND
+# `except ValueError` works — dual inheritance gives exactly that
+for _builtin in (ValueError, TypeError, AttributeError, IndexError,
+                 NotImplementedError, IOError, FloatingPointError,
+                 RuntimeError, KeyError):
+    _typed = type(_builtin.__name__, (MXNetError, _builtin), {
+        "__module__": __name__,
+        "__doc__": f"{_builtin.__name__} raised from the native layer "
+                   "(also an MXNetError).",
+    })
+    register_error(_builtin.__name__, _typed)
+    globals()[_builtin.__name__] = _typed
+    __all__.append(_builtin.__name__)
 
 
 def distill_error(msg: str) -> Exception:

@@ -188,11 +188,139 @@ class F1(EvalMetric):
             self._fn += int(((p == 0) & (l == 1)).sum())
             self.num_inst += len(l)
 
+    def _fbeta(self, beta: float) -> float:
+        """F-beta from the running binary counters; F1 is beta=1."""
+        prec = self._tp / (self._tp + self._fp) if self._tp + self._fp \
+            else 0.0
+        rec = self._tp / (self._tp + self._fn) if self._tp + self._fn \
+            else 0.0
+        b2 = beta * beta
+        denom = b2 * prec + rec
+        return (1 + b2) * prec * rec / denom if denom else 0.0
+
     def get(self):
-        prec = self._tp / (self._tp + self._fp) if self._tp + self._fp else 0.0
-        rec = self._tp / (self._tp + self._fn) if self._tp + self._fn else 0.0
-        f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
-        return (self.name, f1)
+        return (self.name, self._fbeta(1.0))
+
+
+@register
+class Fbeta(F1):
+    """F-beta: (1+b^2) P R / (b^2 P + R) over the same binary counters
+    (ref metric.py Fbeta)."""
+
+    def __init__(self, name="fbeta", beta=1, average="macro", **kwargs):
+        super().__init__(name=name, average=average, **kwargs)
+        self.beta = float(beta)
+
+    def get(self):
+        return (self.name, self._fbeta(self.beta))
+
+
+@register
+class BinaryAccuracy(EvalMetric):
+    """Accuracy of thresholded probabilities (ref metric.py
+    BinaryAccuracy)."""
+
+    def __init__(self, name="binary_accuracy", threshold=0.5, **kwargs):
+        super().__init__(name, **kwargs)
+        self.threshold = threshold
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p = (_np(pred).reshape(-1) > self.threshold).astype("int32")
+            l = _np(label).astype("int32").reshape(-1)
+            self.sum_metric += float((p == l).sum())
+            self.num_inst += len(l)
+
+
+@register
+class MeanPairwiseDistance(EvalMetric):
+    """Mean p-norm distance between pred and label rows (ref metric.py
+    MeanPairwiseDistance)."""
+
+    def __init__(self, name="mpd", p=2, **kwargs):
+        super().__init__(name, **kwargs)
+        self.p = p
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            l = _np(label).astype("float64")
+            p = _np(pred).astype("float64").reshape(l.shape)
+            d = (_onp.abs(l - p) ** self.p).sum(axis=-1) ** (1.0 / self.p)
+            self.sum_metric += float(d.sum())
+            self.num_inst += d.size
+
+
+@register
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis (ref metric.py
+    MeanCosineSimilarity)."""
+
+    def __init__(self, name="cos_sim", eps=1e-8, **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            l = _np(label).astype("float64")
+            p = _np(pred).astype("float64").reshape(l.shape)
+            num = (l * p).sum(axis=-1)
+            den = _onp.maximum(
+                _onp.linalg.norm(l, axis=-1) * _onp.linalg.norm(p, axis=-1),
+                self.eps)
+            sim = num / den
+            self.sum_metric += float(sim.sum())
+            self.num_inst += sim.size
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson/Matthews correlation via the running confusion
+    matrix (ref metric.py PCC)."""
+
+    def __init__(self, name="pcc", **kwargs):
+        self._conf = _onp.zeros((0, 0), "int64")
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self._conf = _onp.zeros((0, 0), "int64")
+
+    def _grow(self, k):
+        if k > self._conf.shape[0]:
+            new = _onp.zeros((k, k), "int64")
+            old = self._conf.shape[0]
+            new[:old, :old] = self._conf
+            self._conf = new
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            p = _np(pred)
+            if p.ndim > 1 and p.shape[-1] > 1:
+                p = p.argmax(-1)
+            else:
+                p = (p.reshape(-1) > 0.5).astype("int64")
+            p = p.astype("int64").reshape(-1)
+            l = _np(label).astype("int64").reshape(-1)
+            self._grow(int(max(p.max(initial=0), l.max(initial=0))) + 1)
+            _onp.add.at(self._conf, (l, p), 1)
+            self.num_inst += len(l)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        c = self._conf.astype("float64")
+        s = c.sum()
+        correct = _onp.trace(c)
+        t_k = c.sum(axis=1)          # true counts
+        p_k = c.sum(axis=0)          # predicted counts
+        cov_tp = correct * s - (t_k * p_k).sum()
+        denom = math.sqrt((s * s - (p_k * p_k).sum())
+                          * (s * s - (t_k * t_k).sum()))
+        return (self.name, cov_tp / denom if denom else 0.0)
 
 
 @register

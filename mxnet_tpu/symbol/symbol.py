@@ -251,7 +251,20 @@ class Symbol:
         return order
 
     def _variables(self) -> List[_Node]:
-        return [n for n in self._topo() if n.is_var()]
+        out = [n for n in self._topo() if n.is_var()]
+        # two DISTINCT nodes sharing a name would bind to one array at
+        # eval/save time (silent weight sharing) — possible when separate
+        # NameManager scopes restart their per-hint counters (reference
+        # semantics); fail loudly instead
+        seen: Dict[str, _Node] = {}
+        for n in out:
+            other = seen.setdefault(n.name, n)
+            if other is not n:
+                raise MXNetError(
+                    f"duplicate variable name {n.name!r} from distinct "
+                    "nodes in one graph; name layers explicitly or use "
+                    "distinct mx.name.Prefix scopes")
+        return out
 
     # -- reference API ------------------------------------------------------
     @property
@@ -696,10 +709,13 @@ def fromjson(text: str) -> Symbol:
     for entry in data["nodes"]:
         raw_attrs = entry.get("attrs", {})
         attrs = {}
+        is_var = entry["op"] == "null"
         for k, v in raw_attrs.items():
-            if k.startswith("__scope_"):
-                # user/AttrScope attrs are strings by contract; parsing
-                # '0.1' to a float here would drop them from list_attr
+            if is_var or k.startswith("__scope_"):
+                # variable attrs and AttrScope stamps are USER strings by
+                # contract (lr_mult='0.1'); parsing them to numbers here
+                # would drop them from attr()/list_attr().  Op-node attrs
+                # are recorded kwargs and do need the json decode.
                 attrs[k] = v
                 continue
             try:

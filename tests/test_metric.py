@@ -1,0 +1,196 @@
+"""Per-metric value checks (ref tests/python/unittest/test_metric.py):
+every metric's math verified against an independent numpy computation,
+plus streaming (multi-update) equivalence and reset semantics."""
+from __future__ import annotations
+
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import metric as M
+
+np_ = mx.np
+
+LAB = onp.array([0, 1, 1, 0, 1], "int64")
+PRED = onp.array([[0.8, 0.2], [0.3, 0.7], [0.6, 0.4],
+                  [0.9, 0.1], [0.2, 0.8]], "float32")  # argmax 0,1,0,0,1
+PROB1 = PRED[:, 1]
+
+
+def _nd(a):
+    return mx.nd.array(onp.asarray(a))
+
+
+def test_accuracy():
+    m = M.Accuracy()
+    m.update([_nd(LAB)], [_nd(PRED)])
+    assert m.get()[1] == pytest.approx(4 / 5)
+
+
+def test_top_k_accuracy():
+    m = M.TopKAccuracy(top_k=2)
+    m.update([_nd(LAB)], [_nd(PRED)])
+    assert m.get()[1] == pytest.approx(1.0)  # 2 classes: top-2 always hits
+
+
+def test_f1_and_fbeta():
+    m = M.F1()
+    m.update([_nd(LAB)], [_nd(PRED)])
+    # preds (argmax): [0,1,0,0,1]; labels [0,1,1,0,1]
+    tp, fp, fn = 2, 0, 1
+    prec, rec = tp / (tp + fp), tp / (tp + fn)
+    assert m.get()[1] == pytest.approx(2 * prec * rec / (prec + rec))
+
+    fb = M.Fbeta(beta=2)
+    fb.update([_nd(LAB)], [_nd(PRED)])
+    b2 = 4.0
+    want = (1 + b2) * prec * rec / (b2 * prec + rec)
+    assert fb.get()[1] == pytest.approx(want)
+
+
+def test_binary_accuracy_threshold():
+    m = M.BinaryAccuracy(threshold=0.6)
+    m.update([_nd(LAB)], [_nd(PROB1)])
+    p = (PROB1 > 0.6).astype(int)  # [0,1,0,0,1]
+    assert m.get()[1] == pytest.approx((p == LAB).mean())
+
+
+def test_mcc_binary():
+    m = M.MCC()
+    m.update([_nd(LAB)], [_nd(PRED)])
+    tp, fp, tn, fn = 2, 0, 2, 1
+    want = (tp * tn - fp * fn) / math.sqrt(
+        (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    assert m.get()[1] == pytest.approx(want)
+
+
+def test_pcc_matches_mcc_for_binary():
+    m1, m2 = M.PCC(), M.MCC()
+    for m in (m1, m2):
+        m.update([_nd(LAB)], [_nd(PRED)])
+    assert m1.get()[1] == pytest.approx(m2.get()[1])
+
+
+def test_pcc_multiclass_vs_sklearn_formula():
+    lab = onp.array([0, 1, 2, 2, 1, 0, 2], "int64")
+    pred = onp.eye(3, dtype="float32")[onp.array([0, 2, 2, 1, 1, 0, 2])]
+    m = M.PCC()
+    m.update([_nd(lab)], [_nd(pred)])
+    # independent multiclass MCC computation from the confusion matrix
+    p = pred.argmax(-1)
+    k = 3
+    conf = onp.zeros((k, k))
+    for li, pi in zip(lab, p):
+        conf[li, pi] += 1
+    s, c = conf.sum(), onp.trace(conf)
+    t_k, p_k = conf.sum(1), conf.sum(0)
+    want = (c * s - (t_k * p_k).sum()) / math.sqrt(
+        (s * s - (p_k * p_k).sum()) * (s * s - (t_k * t_k).sum()))
+    assert m.get()[1] == pytest.approx(want)
+
+
+def test_regression_metrics():
+    l = onp.array([1.0, 2.0, 3.0, 4.0], "float32")
+    p = onp.array([1.5, 1.5, 3.5, 3.0], "float32")
+    mae = M.MAE()
+    mae.update([_nd(l)], [_nd(p)])
+    assert mae.get()[1] == pytest.approx(onp.abs(l - p).mean())
+    mse = M.MSE()
+    mse.update([_nd(l)], [_nd(p)])
+    assert mse.get()[1] == pytest.approx(((l - p) ** 2).mean())
+    rmse = M.RMSE()
+    rmse.update([_nd(l)], [_nd(p)])
+    assert rmse.get()[1] == pytest.approx(
+        math.sqrt(((l - p) ** 2).mean()))
+
+
+def test_mean_pairwise_distance():
+    l = onp.array([[0.0, 0.0], [1.0, 1.0]], "float32")
+    p = onp.array([[3.0, 4.0], [1.0, 2.0]], "float32")
+    m = M.MeanPairwiseDistance()
+    m.update([_nd(l)], [_nd(p)])
+    assert m.get()[1] == pytest.approx((5.0 + 1.0) / 2)
+    m1 = M.MeanPairwiseDistance(p=1)
+    m1.update([_nd(l)], [_nd(p)])
+    assert m1.get()[1] == pytest.approx((7.0 + 1.0) / 2)
+
+
+def test_mean_cosine_similarity():
+    l = onp.array([[1.0, 0.0], [1.0, 1.0]], "float32")
+    p = onp.array([[1.0, 0.0], [1.0, 0.0]], "float32")
+    m = M.MeanCosineSimilarity()
+    m.update([_nd(l)], [_nd(p)])
+    want = (1.0 + 1.0 / math.sqrt(2)) / 2
+    assert m.get()[1] == pytest.approx(want, rel=1e-6)
+
+
+def test_cross_entropy_and_perplexity():
+    m = M.CrossEntropy()
+    m.update([_nd(LAB)], [_nd(PRED)])
+    want = -onp.log(PRED[onp.arange(5), LAB] + 1e-12).mean()
+    assert m.get()[1] == pytest.approx(want, rel=1e-6)
+    px = M.Perplexity(ignore_label=None)
+    px.update([_nd(LAB)], [_nd(PRED)])
+    assert px.get()[1] == pytest.approx(math.exp(want), rel=1e-6)
+    pxi = M.Perplexity(ignore_label=0)
+    pxi.update([_nd(LAB)], [_nd(PRED)])
+    keep = LAB != 0
+    want_i = -onp.log(PRED[onp.arange(5), LAB][keep] + 1e-12).mean()
+    assert pxi.get()[1] == pytest.approx(math.exp(want_i), rel=1e-6)
+
+
+def test_pearson():
+    l = onp.array([1.0, 2.0, 3.0, 4.0])
+    p = onp.array([1.1, 1.9, 3.2, 3.9])
+    m = M.PearsonCorrelation()
+    m.update([_nd(l)], [_nd(p)])
+    assert m.get()[1] == pytest.approx(onp.corrcoef(l, p)[0, 1])
+
+
+def test_streaming_equals_single_batch():
+    """Metric over two updates == one concatenated update."""
+    for make in (M.Accuracy, M.MAE, M.MCC, M.PCC, M.CrossEntropy):
+        a, b = make(), make()
+        if isinstance(a, (M.MAE,)):
+            l1, p1 = LAB[:2].astype("float32"), PROB1[:2]
+            l2, p2 = LAB[2:].astype("float32"), PROB1[2:]
+            lf, pf = LAB.astype("float32"), PROB1
+        else:
+            l1, p1 = LAB[:2], PRED[:2]
+            l2, p2 = LAB[2:], PRED[2:]
+            lf, pf = LAB, PRED
+        a.update([_nd(l1)], [_nd(p1)])
+        a.update([_nd(l2)], [_nd(p2)])
+        b.update([_nd(lf)], [_nd(pf)])
+        assert a.get()[1] == pytest.approx(b.get()[1]), type(a).__name__
+
+
+def test_reset_and_nan_empty():
+    m = M.Accuracy()
+    assert math.isnan(m.get()[1])
+    m.update([_nd(LAB)], [_nd(PRED)])
+    m.reset()
+    assert math.isnan(m.get()[1])
+    assert m.num_inst == 0
+
+
+def test_composite_and_create():
+    comp = M.CompositeEvalMetric()
+    comp.add(M.Accuracy())
+    comp.add("mae")
+    comp.update([_nd(LAB.astype("float32"))], [_nd(PROB1)])
+    names, values = comp.get()
+    assert "accuracy" in names[0] and len(values) == 2
+
+    created = M.create("fbeta", beta=0.5)
+    assert isinstance(created, M.Fbeta)
+    created2 = M.create("pcc")
+    assert isinstance(created2, M.PCC)
+
+
+def test_custom_metric():
+    cm = M.np(lambda l, p: float(onp.abs(l - p).sum()), name="absum")
+    cm.update([_nd(onp.ones(3))], [_nd(onp.zeros(3))])
+    assert cm.get()[1] == pytest.approx(3.0)
