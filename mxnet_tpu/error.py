@@ -65,6 +65,8 @@ for _builtin in (ValueError, TypeError, AttributeError, IndexError,
         "__module__": __name__,
         "__doc__": f"{_builtin.__name__} raised from the native layer "
                    "(also an MXNetError).",
+        # KeyError.__str__ repr-quotes the message; plain rendering wins
+        "__str__": Exception.__str__,
     })
     register_error(_builtin.__name__, _typed)
     globals()[_builtin.__name__] = _typed

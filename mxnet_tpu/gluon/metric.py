@@ -10,8 +10,10 @@ from ..base import MXNetError, Registry
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
-           "F1", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy", "Perplexity",
-           "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
+           "F1", "Fbeta", "BinaryAccuracy", "MCC", "PCC", "MAE", "MSE",
+           "RMSE", "MeanPairwiseDistance", "MeanCosineSimilarity",
+           "CrossEntropy", "Perplexity", "NegativeLogLikelihood",
+           "PearsonCorrelation", "Loss", "Torch",
            "create", "check_label_shapes"]
 
 _REG: Registry = Registry("metric")
@@ -305,6 +307,8 @@ class PCC(EvalMetric):
                 p = (p.reshape(-1) > 0.5).astype("int64")
             p = p.astype("int64").reshape(-1)
             l = _np(label).astype("int64").reshape(-1)
+            keep = (l >= 0) & (p >= 0)  # -1 padding/ignore convention:
+            l, p = l[keep], p[keep]     # drop, never wrap to the last row
             self._grow(int(max(p.max(initial=0), l.max(initial=0))) + 1)
             _onp.add.at(self._conf, (l, p), 1)
             self.num_inst += len(l)

@@ -65,6 +65,9 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     i = pl.program_id(1)
+    # hoisted out of _step: program_id inside a pl.when body does not
+    # survive interpret mode, and one SMEM read per step is enough
+    cur_len = len_ref[pl.program_id(0), 0] if has_len else None
 
     def _step():
         q = q_ref[0].astype(jnp.float32)           # (bq, d)
@@ -77,7 +80,7 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
             qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
         if has_len:
-            s = jnp.where(kpos < len_ref[pl.program_id(0), 0], s, _NEG_INF)
+            s = jnp.where(kpos < cur_len, s, _NEG_INF)
         m_prev = m_ref[:, :1]                      # (bq, 1)
         cur = s.max(axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, cur)
@@ -99,7 +102,7 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         run = jnp.logical_and(run, j * bk <= i * bq + (bq - 1))
     if has_len:
         # skip kv blocks entirely past the row's valid length
-        run = jnp.logical_and(run, j * bk < len_ref[pl.program_id(0), 0])
+        run = jnp.logical_and(run, j * bk < cur_len)
     pl.when(run)(_step)
 
     @pl.when(j == nk - 1)
@@ -109,9 +112,12 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
                          jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
 
 
-def _flash_forward_pallas(q, k, v, causal: bool, scale: float, kv_len=None):
+def _flash_forward_pallas(q, k, v, causal: bool, scale: float, kv_len=None,
+                          interpret: bool = False):
     """(B, H, T, D) flash attention via pallas_call; returns (B, H, T, D).
-    ``kv_len``: optional (B,) int32 per-row valid key length."""
+    ``kv_len``: optional (B,) int32 per-row valid key length.
+    ``interpret=True`` runs the kernel under the pallas interpreter on any
+    backend — how tests validate the KERNEL itself without a TPU."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -147,6 +153,7 @@ def _flash_forward_pallas(q, k, v, causal: bool, scale: float, kv_len=None):
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
         scratch_shapes=[_vmem((bq, d)), _vmem((bq, 128)), _vmem((bq, 128))],
         compiler_params=_tpu_params(),
+        interpret=interpret,
     )(lens, qr, kr, vr)
     return out.reshape(b, h, tq, d)
 
