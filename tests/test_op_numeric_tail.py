@@ -692,6 +692,47 @@ def _case_to_tensor():
     return [(got, want, 1e-6)]
 
 
+def _case_image_ops():
+    """The _image_* op family vs direct numpy semantics
+    (ref src/operator/image/image_random.cc + crop.cc)."""
+    img = (_RS.rand(10, 8, 3) * 255).astype("uint8")
+    out = []
+    # _image_crop == plain slicing
+    got = mx.image.fixed_crop(np_.array(img), 2, 1, 5, 6)
+    out.append((got, img[1:7, 2:7], 0))
+    # _image_normalize == (x - mean) / std
+    x = img.astype("float32")
+    got = mx.image.color_normalize(np_.array(x), 127.0, 64.0)
+    out.append((got, (x - 127.0) / 64.0, 1e-5))
+    mean = onp.array([1.0, 2.0, 3.0], "float32")
+    std = onp.array([4.0, 5.0, 6.0], "float32")
+    got = mx.image.color_normalize(np_.array(x), np_.array(mean),
+                                   np_.array(std))
+    out.append((got, (x - mean) / std, 1e-5))
+    # _image_resize: constant image stays constant at any size; exact
+    # 2x nearest upsample of a ramp doubles each pixel
+    const = onp.full((4, 4, 3), 77, "uint8")
+    got = mx.image.imresize(np_.array(const), 9, 7)
+    out.append((got, onp.full((7, 9, 3), 77, "uint8"), 0))
+    ramp = onp.arange(16, dtype="uint8").reshape(4, 4, 1) * 10
+    got = mx.image.imresize(np_.array(ramp), 8, 8, interp=0)  # nearest
+    out.append((got, onp.repeat(onp.repeat(ramp, 2, 0), 2, 1), 0))
+    # _image_random_crop: output is a contiguous window of the source
+    import random as _random
+
+    _random.seed(4)
+    crop, (x0, y0, w, h) = mx.image.random_crop(np_.array(img), (5, 6))
+    out.append((crop, img[y0:y0 + h, x0:x0 + w], 0))
+    # _image_random_resized_crop: crop box geometry honors the contract
+    _random.seed(5)
+    rc, (x0, y0, w, h) = mx.image.random_size_crop(
+        np_.array(img), (6, 6), area=(0.4, 1.0), ratio=(0.8, 1.25))
+    assert 0 <= x0 <= 8 - w and 0 <= y0 <= 10 - h
+    assert 0.4 * 80 <= w * h <= 80 + 1e-6
+    out.append((np_.array(onp.asarray(rc).shape[:2]), (6, 6), 0))
+    return out
+
+
 def _case_custom():
     @mx.operator.register("numeric_tail_plus2")
     class Plus2(mx.operator.CustomOp):
@@ -928,6 +969,8 @@ CASES = {
     "_contrib_AdaptiveAvgPooling2D": _case_adaptive_avg_pool2d,
     "allclose_all_any": _case_allclose_and_reductions,
     "_image_to_tensor": _case_to_tensor,
+    "image_ops": _case_image_ops,  # _image_crop/_image_normalize/
+    # _image_resize/_image_random_crop/_image_random_resized_crop
     "Custom": _case_custom,
     "npi_tail": _case_npi_tail,
     "npi_linalg_decomp": _case_npi_linalg_decomp,
