@@ -403,3 +403,32 @@ def constraint_check(data, msg="Constraint violated"):
                  name="constraint_check")
 
 __all__ = list(__all__) + ["tri", "fill_diagonal", "constraint_check"]
+
+
+def round_(x, decimals=0, out=None, **kwargs):
+    """Legacy alias of round (ref numpy/multiarray.py round_)."""
+    return round(x, decimals, out=out, **kwargs)
+
+
+def triu_indices_from(arr, k=0):
+    """Ref numpy/multiarray.py triu_indices_from."""
+    if arr.ndim != 2:
+        raise ValueError("input array must be 2-d")
+    return triu_indices(arr.shape[-2], k=k, m=arr.shape[-1])
+
+
+def set_printoptions(*args, **kwargs):
+    """Printing config (ref numpy/arrayprint.py set_printoptions):
+    NDArray repr renders through host numpy, so numpy's own options
+    govern it directly."""
+    return _onp.set_printoptions(*args, **kwargs)
+
+
+def genfromtxt(*args, **kwargs):
+    """Text loading on host then device placement (ref numpy/io.py
+    genfromtxt wraps the official numpy one the same way)."""
+    return from_jax(jnp.asarray(_onp.genfromtxt(*args, **kwargs)))
+
+
+__all__ = list(__all__) + ["round_", "triu_indices_from",
+                           "set_printoptions", "genfromtxt"]

@@ -1055,3 +1055,105 @@ def rroi_align(data, rois, pooled_size, spatial_scale=1.0,
                        else pooled_size,
                        "spatial_scale": spatial_scale,
                        "sampling_ratio": sampling_ratio})
+
+
+# ---------------------------------------------------------------------------
+# npx utility surface (ref python/mxnet/numpy_extension/utils.py + random.py
+# + __init__.py re-exports)
+# ---------------------------------------------------------------------------
+
+def seed(seed_value):
+    """Seed the global PRNG (ref numpy_extension/random.py seed)."""
+    from .. import random as _random
+
+    _random.seed(seed_value)
+
+
+def from_numpy(ndarray, zero_copy=True):
+    """Wrap a host numpy array as an NDArray (ref utils.py from_numpy;
+    the device copy makes zero_copy advisory here)."""
+    return NDArray(jnp.asarray(ndarray))
+
+
+def from_dlpack(ext):
+    """Ref utils.py from_dlpack."""
+    from ..dlpack import from_dlpack as _impl
+
+    return _impl(ext)
+
+
+def to_dlpack_for_read(data):
+    """Ref utils.py to_dlpack_for_read."""
+    from ..dlpack import to_dlpack_for_read as _impl
+
+    return _impl(data)
+
+
+def to_dlpack_for_write(data):
+    """Ref utils.py to_dlpack_for_write."""
+    from ..dlpack import to_dlpack_for_write as _impl
+
+    return _impl(data)
+
+
+def savez(file, *args, **kwds):
+    """Save arrays into an .npz (ref utils.py savez/save compat): NDArray
+    values are converted to host numpy first."""
+    import numpy as _onp
+
+    def host(v):
+        return v.asnumpy() if isinstance(v, NDArray) else _onp.asarray(v)
+
+    _onp.savez(file, *[host(a) for a in args],
+               **{k: host(v) for k, v in kwds.items()})
+
+
+def _batch_tuple(batch_shape):
+    """int-or-tuple batch_shape normalizer (same contract as
+    numpy/random.py _shape)."""
+    if batch_shape is None:
+        return ()
+    if isinstance(batch_shape, int):
+        return (batch_shape,)
+    return tuple(batch_shape)
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None, device=None,
+              out=None):
+    """Ref numpy_extension/random.py bernoulli (prob XOR logit)."""
+    from ..numpy import random as _nprandom
+
+    if (prob is None) == (logit is None):
+        raise MXNetError("bernoulli: exactly one of prob/logit required")
+    res = _nprandom.bernoulli(prob, size=size, dtype=dtype, logit=logit,
+                              device=device)
+    if out is not None:
+        out._set_data(res._data)
+        return out
+    return res
+
+
+def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype=None, device=None):
+    """Ref numpy_extension/random.py normal_n: batch_shape PREPENDS the
+    broadcast parameter shape."""
+    from ..numpy import random as _nprandom
+
+    shape = _batch_tuple(batch_shape) + jnp.broadcast_shapes(
+        jnp.shape(loc), jnp.shape(scale))
+    return _nprandom.normal(loc, scale, size=shape, dtype=dtype,
+                            device=device)
+
+
+def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype=None, device=None):
+    """Ref numpy_extension/random.py uniform_n."""
+    from ..numpy import random as _nprandom
+
+    shape = _batch_tuple(batch_shape) + jnp.broadcast_shapes(
+        jnp.shape(low), jnp.shape(high))
+    return _nprandom.uniform(low, high, size=shape, dtype=dtype,
+                             device=device)
+
+
+__all__ += ["seed", "from_numpy", "from_dlpack", "to_dlpack_for_read",
+            "to_dlpack_for_write", "savez", "bernoulli", "normal_n",
+            "uniform_n"]

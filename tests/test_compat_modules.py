@@ -183,3 +183,42 @@ def test_symbol_execution_unaffected_by_scope_attrs():
                  fcx_bias=mx.nd.array(onp.zeros(3, "float32")))
     res = out[0] if isinstance(out, (list, tuple)) else out
     onp.testing.assert_allclose(res.asnumpy(), onp.full((2, 3), 4.0))
+
+
+# ---------------------------------------------------------------------------
+# np/npx surface completions (ref numpy/multiarray.py round_/
+# triu_indices_from, numpy_extension/utils.py + random.py)
+# ---------------------------------------------------------------------------
+
+def test_np_surface_completions():
+    import io
+
+    onp.testing.assert_allclose(
+        mx.np.round_(mx.np.array([1.26]), 1).asnumpy(), [1.3], rtol=1e-5)
+    r, c = mx.np.triu_indices_from(mx.np.ones((3, 3)), k=1)
+    onp.testing.assert_array_equal(onp.asarray(r),
+                                   onp.triu_indices(3, 1)[0])
+    onp.testing.assert_array_equal(onp.asarray(c),
+                                   onp.triu_indices(3, 1)[1])
+    g = mx.np.genfromtxt(io.StringIO("1,2\n3,4"), delimiter=",")
+    onp.testing.assert_allclose(g.asnumpy(), [[1.0, 2.0], [3.0, 4.0]])
+    with pytest.raises(ValueError):
+        mx.np.triu_indices_from(mx.np.ones((2, 2, 2)))
+
+
+def test_npx_utils_surface(tmp_path):
+    mx.npx.seed(3)
+    a = mx.npx.bernoulli(0.5, size=(100,))
+    assert set(onp.unique(a.asnumpy())) <= {0.0, 1.0}
+    with pytest.raises(mx.MXNetError):
+        mx.npx.bernoulli(0.5, logit=0.1)
+    assert mx.npx.normal_n(0.0, 1.0, batch_shape=(4, 2)).shape == (4, 2)
+    assert mx.npx.uniform_n(onp.zeros(3), 1.0,
+                            batch_shape=(5,)).shape == (5, 3)
+    d = mx.npx.from_numpy(onp.eye(2))
+    f = str(tmp_path / "z.npz")
+    mx.npx.savez(f, x=d, y=onp.ones(3))
+    loaded = onp.load(f)
+    assert loaded["x"].shape == (2, 2) and loaded["y"].shape == (3,)
+    e = mx.npx.from_dlpack(mx.npx.to_dlpack_for_read(d))
+    onp.testing.assert_allclose(e.asnumpy(), onp.eye(2))
