@@ -2,4 +2,5 @@
 from .activations import *
 from .basic_layers import *
 from .conv_layers import *
+from .extended_layers import *
 from ..block import Block, HybridBlock

@@ -1,0 +1,177 @@
+"""Remaining reference gluon.nn layers: pixel shuffles, fused BN+ReLU,
+deformable convolutions (ref python/mxnet/gluon/nn/conv_layers.py
+PixelShuffle*, basic_layers.py BatchNormReLU, contrib/cnn
+DeformableConvolution / ModulatedDeformableConvolution)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import numpy_extension as npx
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .basic_layers import BatchNorm
+
+__all__ = ["PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D",
+           "BatchNormReLU", "DeformableConvolution",
+           "ModulatedDeformableConvolution"]
+
+
+def _tupn(v, n):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,) * n
+
+
+class _PixelShuffle(HybridBlock):
+    """Rearrange channel blocks into spatial positions
+    (ref conv_layers.py PixelShuffle1D/2D/3D, channel-first layout)."""
+
+    _ndim = 0
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factors = _tupn(factor, self._ndim)
+
+    def forward(self, x):
+        f = self._factors
+        n, c = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        block = 1
+        for v in f:
+            block *= v
+        if c % block:
+            raise MXNetError(
+                f"channels {c} not divisible by prod(factor) {block}")
+        cout = c // block
+        # (N, Cout, f1..fk, s1..sk) -> interleave (si, fi) pairs
+        data = x.reshape((n, cout) + f + spatial)
+        perm = [0, 1]
+        for i in range(self._ndim):
+            perm += [2 + self._ndim + i, 2 + i]
+        data = data.transpose(perm)
+        out_spatial = tuple(s * v for s, v in zip(spatial, f))
+        return data.reshape((n, cout) + out_spatial)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(factor={self._factors})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C*f, W) -> (N, C, W*f) (ref conv_layers.py PixelShuffle1D)."""
+    _ndim = 1
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C*fh*fw, H, W) -> (N, C, H*fh, W*fw)."""
+    _ndim = 2
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C*fd*fh*fw, D, H, W) -> (N, C, D*fd, H*fh, W*fw)."""
+    _ndim = 3
+
+
+class BatchNormReLU(BatchNorm):
+    """Fused BatchNorm+ReLU (ref basic_layers.py BatchNormReLU →
+    _contrib_BatchNormWithReLU): identical statistics handling, relu on
+    the normalized output."""
+
+    def forward(self, x):
+        return npx.relu(super().forward(x))
+
+
+class DeformableConvolution(HybridBlock):
+    """Deformable conv v1 block (ref contrib/cnn/conv_layers.py
+    DeformableConvolution): a regular conv predicts per-tap offsets, the
+    deformable conv samples with them.  Channel-first NCHW."""
+
+    _use_mask = False
+
+    def __init__(self, channels, kernel_size=(3, 3), strides=(1, 1),
+                 padding=(1, 1), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, use_bias=True, in_channels=0,
+                 activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        self._kernel = _tupn(kernel_size, 2)
+        self._strides = _tupn(strides, 2)
+        self._padding = _tupn(padding, 2)
+        self._dilation = _tupn(dilation, 2)
+        self._groups = groups
+        self._dg = num_deformable_group
+        self._act = activation
+        k = self._kernel[0] * self._kernel[1]
+        # offsets (+ masks for v2) come from one regular conv over x
+        self._offset_ch = (2 + self._use_mask) * self._dg * k
+        self.offset_weight = Parameter(
+            shape=(self._offset_ch, in_channels) + self._kernel,
+            init=offset_weight_initializer, allow_deferred_init=True,
+            name="offset_weight")
+        self.offset_bias = Parameter(shape=(self._offset_ch,),
+                                     init=offset_bias_initializer,
+                                     allow_deferred_init=True,
+                                     name="offset_bias")
+        self.weight = Parameter(
+            shape=(channels, in_channels // groups if in_channels else 0)
+            + self._kernel,
+            init=weight_initializer, allow_deferred_init=True,
+            name="weight")
+        self.bias = Parameter(shape=(channels,), init=bias_initializer,
+                              allow_deferred_init=True,
+                              name="bias") if use_bias else None
+
+    def infer_shape(self, x, *args):
+        c_in = x.shape[1]
+        self.offset_weight.shape = (self._offset_ch, c_in) + self._kernel
+        self.weight.shape = (self._channels,
+                             c_in // self._groups) + self._kernel
+
+    def forward(self, x):
+        from ...ops import spatial as _sp
+        from ...ops.dispatch import call
+
+        pred = npx.convolution(
+            x, self.offset_weight.data(), self.offset_bias.data(),
+            kernel=self._kernel, stride=self._strides, pad=self._padding,
+            dilate=self._dilation, num_filter=self._offset_ch)
+        k = self._kernel[0] * self._kernel[1]
+        n_off = 2 * self._dg * k
+        if self._use_mask:
+            offset = pred[:, :n_off]
+            mask = pred[:, n_off:].sigmoid()
+        else:
+            offset, mask = pred, None
+
+        b = self.bias.data() if self.bias is not None else None
+        args = [x, offset, self.weight.data()]
+        has_bias, has_mask = b is not None, mask is not None
+        if has_bias:
+            args.append(b)
+        if has_mask:
+            args.append(mask)
+
+        def f(xx, off, w, *rest):
+            rest = list(rest)
+            bb = rest.pop(0) if has_bias else None
+            mm = rest.pop(0) if has_mask else None
+            return _sp.deformable_convolution(
+                xx, off, w, bb, kernel=self._kernel, stride=self._strides,
+                pad=self._padding, dilate=self._dilation,
+                num_group=self._groups, num_deformable_group=self._dg,
+                mask=mm)
+
+        out = call(f, tuple(args), {}, name="deformable_convolution"
+                   if not self._use_mask else
+                   "modulated_deformable_convolution")
+        if self._act is not None:
+            out = npx.activation(out, act_type=self._act)
+        return out
+
+
+class ModulatedDeformableConvolution(DeformableConvolution):
+    """Deformable conv v2: per-tap sigmoid modulation masks on top of the
+    offsets (ref contrib/cnn ModulatedDeformableConvolution)."""
+
+    _use_mask = True

@@ -2,4 +2,9 @@
 from .rnn_layer import RNN, LSTM, GRU
 from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,
                        GRUCell, SequentialRNNCell, DropoutCell, ResidualCell,
-                       BidirectionalCell, ZoneoutCell)
+                       BidirectionalCell, ZoneoutCell, ModifierCell,
+                       VariationalDropoutCell, LSTMPCell,
+                       HybridSequentialRNNCell,
+                       Conv1DRNNCell, Conv2DRNNCell, Conv3DRNNCell,
+                       Conv1DLSTMCell, Conv2DLSTMCell, Conv3DLSTMCell,
+                       Conv1DGRUCell, Conv2DGRUCell, Conv3DGRUCell)

@@ -254,8 +254,9 @@ class DropoutCell(RecurrentCell):
         return npx.dropout(inputs, p=self._rate), states
 
 
-class ResidualCell(RecurrentCell):
-    """Adds the input to the base cell's output (ref ResidualCell)."""
+class ModifierCell(RecurrentCell):
+    """Base for cells that decorate another cell (ref rnn_cell.py
+    ModifierCell): state handling delegates to base_cell."""
 
     def __init__(self, base_cell, **kwargs):
         super().__init__(**kwargs)
@@ -268,27 +269,33 @@ class ResidualCell(RecurrentCell):
         return self.base_cell.begin_state(**kwargs)
 
     def forward(self, inputs, states):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.base_cell!r})"
+
+
+class ResidualCell(ModifierCell):
+    """Adds the input to the base cell's output (ref ResidualCell)."""
+
+    def forward(self, inputs, states):
         out, states = self.base_cell(inputs, states)
         return out + inputs, states
 
 
-class ZoneoutCell(RecurrentCell):
+class ZoneoutCell(ModifierCell):
     """Zoneout regularization: randomly keep previous state entries (ref
     ZoneoutCell)."""
 
     def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
                  **kwargs):
-        super().__init__(**kwargs)
-        self.base_cell = base_cell
+        super().__init__(base_cell, **kwargs)
         self._zo, self._zs = zoneout_outputs, zoneout_states
         self._prev_out = None
 
     def reset(self):
         super().reset()
         self._prev_out = None
-
-    def state_info(self, batch_size=0):
-        return self.base_cell.state_info(batch_size)
 
     def begin_state(self, **kwargs):
         self._prev_out = None
@@ -370,3 +377,259 @@ class BidirectionalCell(RecurrentCell):
         if merge_outputs is False:
             return outputs, states
         return _np.stack(outputs, axis=layout.find("T")), states
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (per-sequence) dropout around a cell (ref rnn_cell.py
+    VariationalDropoutCell): ONE mask per sequence for each of inputs /
+    states / outputs, resampled by reset()."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._di, self._ds, self._do = drop_inputs, drop_states, drop_outputs
+        self._mask_i = self._mask_s = self._mask_o = None
+
+    def reset(self):
+        super().reset()
+        self._mask_i = self._mask_s = self._mask_o = None
+
+    @staticmethod
+    def _mask(p, like):
+        return npx.dropout(_np.ones_like(like), p=p, mode="always")
+
+    def forward(self, inputs, states):
+        from ... import autograd
+
+        if autograd.is_training():
+            if self._di > 0.0:
+                if self._mask_i is None:
+                    self._mask_i = self._mask(self._di, inputs)
+                inputs = inputs * self._mask_i
+            if self._ds > 0.0:
+                if self._mask_s is None:
+                    self._mask_s = self._mask(self._ds, states[0])
+                states = [states[0] * self._mask_s] + list(states[1:])
+        out, next_states = self.base_cell(inputs, states)
+        if autograd.is_training() and self._do > 0.0:
+            if self._mask_o is None:
+                self._mask_o = self._mask(self._do, out)
+            out = out * self._mask_o
+        return out, next_states
+
+
+class LSTMPCell(_GatedCell):
+    """LSTM with a hidden-state projection (ref rnn_cell.py LSTMPCell:
+    the recurrent state is r = W_r·h, dimension projection_size)."""
+    _num_gates = 4
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 h2r_weight_initializer=None, h2h_weight_initializer=None,
+                 dtype=jnp.float32, **kwargs):
+        super().__init__(hidden_size, input_size=input_size,
+                         h2h_weight_initializer=h2h_weight_initializer,
+                         dtype=dtype, **kwargs)
+        self._projection_size = projection_size
+        # h2h operates on the PROJECTED state: replace the base parameter
+        self.h2h_weight = Parameter(
+            shape=(self._num_gates * hidden_size, projection_size),
+            init=h2h_weight_initializer, dtype=dtype,
+            allow_deferred_init=True, name="h2h_weight")
+        self.h2r_weight = Parameter(
+            shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer, dtype=dtype,
+            allow_deferred_init=True, name="h2r_weight")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._proj(inputs, states)
+        g = i2h + h2h
+        h = self._hidden_size
+        i, f, gg, o = (g[:, :h], g[:, h:2 * h], g[:, 2 * h:3 * h],
+                       g[:, 3 * h:])
+        c = i.sigmoid() * gg.tanh() + f.sigmoid() * states[1]
+        hidden = o.sigmoid() * c.tanh()
+        r = npx.fully_connected(hidden, self.h2r_weight.data(), None,
+                                num_hidden=self._projection_size,
+                                no_bias=True)
+        return r, [r, c]
+
+
+HybridSequentialRNNCell = SequentialRNNCell  # ref alias: all cells hybridize
+
+
+class _ConvGatedCell(RecurrentCell):
+    """Shared machinery for the Conv{1,2,3}D RNN/LSTM/GRU cells (ref
+    conv_rnn_cell.py _ConvRNNCellBase): gates are convolutions over
+    channel-first inputs; input_shape = (C, *spatial) is required up
+    front, as in the reference."""
+
+    _num_gates = 1
+    _ndim = 0
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                 h2h_kernel=3, i2h_pad=None, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        nd = self._ndim
+
+        def tup(v):
+            return tuple(v) if isinstance(v, (tuple, list)) else (v,) * nd
+
+        self._input_shape = tuple(input_shape)   # (C, *spatial)
+        self._hc = hidden_channels
+        self._ik = tup(i2h_kernel)
+        self._hk = tup(h2h_kernel)
+        for k in self._hk:
+            if k % 2 == 0:
+                raise MXNetError("h2h_kernel must be odd to preserve the "
+                                 "state's spatial shape")
+        self._ip = tup(i2h_pad) if i2h_pad is not None else tuple(
+            k // 2 for k in self._ik)
+        self._hp = tuple(k // 2 for k in self._hk)
+        self._activation = activation
+        ng = self._num_gates
+        cin = self._input_shape[0]
+        self.i2h_weight = Parameter(shape=(ng * hidden_channels, cin)
+                                    + self._ik,
+                                    init=i2h_weight_initializer,
+                                    name="i2h_weight")
+        self.h2h_weight = Parameter(shape=(ng * hidden_channels,
+                                           hidden_channels) + self._hk,
+                                    init=h2h_weight_initializer,
+                                    name="h2h_weight")
+        self.i2h_bias = Parameter(shape=(ng * hidden_channels,),
+                                  init=i2h_bias_initializer, name="i2h_bias")
+        self.h2h_bias = Parameter(shape=(ng * hidden_channels,),
+                                  init=h2h_bias_initializer, name="h2h_bias")
+        # i2h output spatial must match the state's (= input) spatial dims
+        spatial = self._input_shape[1:]
+        out_sp = tuple((s + 2 * p - k) + 1
+                       for s, p, k in zip(spatial, self._ip, self._ik))
+        if out_sp != spatial:
+            raise MXNetError(
+                f"i2h conv maps spatial {spatial} -> {out_sp}; pick "
+                "i2h_kernel/i2h_pad that preserve the shape")
+
+    def _state_shape(self, batch_size):
+        return (batch_size, self._hc) + self._input_shape[1:]
+
+    def _convs(self, inputs, state):
+        ng = self._num_gates
+        i2h = npx.convolution(inputs, self.i2h_weight.data(),
+                              self.i2h_bias.data(), kernel=self._ik,
+                              pad=self._ip, num_filter=ng * self._hc)
+        h2h = npx.convolution(state, self.h2h_weight.data(),
+                              self.h2h_bias.data(), kernel=self._hk,
+                              pad=self._hp, num_filter=ng * self._hc)
+        return i2h, h2h
+
+    def _split(self, g, n):
+        return [g[:, i * self._hc:(i + 1) * self._hc] for i in range(n)]
+
+
+class _ConvRNNCell(_ConvGatedCell):
+    _num_gates = 1
+
+    def state_info(self, batch_size=0):
+        return [{"shape": self._state_shape(batch_size),
+                 "__layout__": "NC" + "DHW"[3 - self._ndim:]}]
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._convs(inputs, states[0])
+        out = npx.activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class _ConvLSTMCell(_ConvGatedCell):
+    _num_gates = 4
+
+    def state_info(self, batch_size=0):
+        s = {"shape": self._state_shape(batch_size),
+             "__layout__": "NC" + "DHW"[3 - self._ndim:]}
+        return [dict(s), dict(s)]
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._convs(inputs, states[0])
+        i, f, g, o = self._split(i2h + h2h, 4)
+        c = i.sigmoid() * npx.activation(g, act_type=self._activation) \
+            + f.sigmoid() * states[1]
+        out = o.sigmoid() * npx.activation(c, act_type=self._activation)
+        return out, [out, c]
+
+
+class _ConvGRUCell(_ConvGatedCell):
+    _num_gates = 3
+
+    def state_info(self, batch_size=0):
+        return [{"shape": self._state_shape(batch_size),
+                 "__layout__": "NC" + "DHW"[3 - self._ndim:]}]
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._convs(inputs, states[0])
+        xr, xz, xn = self._split(i2h, 3)
+        hr, hz, hn = self._split(h2h, 3)
+        r = (xr + hr).sigmoid()
+        z = (xz + hz).sigmoid()
+        n = npx.activation(xn + r * hn, act_type=self._activation)
+        out = (1.0 - z) * n + z * states[0]
+        return out, [out]
+
+
+class Conv1DRNNCell(_ConvRNNCell):
+    """1-D conv RNN cell (ref conv_rnn_cell.py Conv1DRNNCell, NCW)."""
+    _ndim = 1
+
+
+class Conv2DRNNCell(_ConvRNNCell):
+    """2-D conv RNN cell (NCHW)."""
+    _ndim = 2
+
+
+class Conv3DRNNCell(_ConvRNNCell):
+    """3-D conv RNN cell (NCDHW)."""
+    _ndim = 3
+
+
+class Conv1DLSTMCell(_ConvLSTMCell):
+    """1-D ConvLSTM (ref conv_rnn_cell.py; Shi et al. 2015)."""
+    _ndim = 1
+
+
+class Conv2DLSTMCell(_ConvLSTMCell):
+    """2-D ConvLSTM."""
+    _ndim = 2
+
+
+class Conv3DLSTMCell(_ConvLSTMCell):
+    """3-D ConvLSTM."""
+    _ndim = 3
+
+
+class Conv1DGRUCell(_ConvGRUCell):
+    """1-D conv GRU."""
+    _ndim = 1
+
+
+class Conv2DGRUCell(_ConvGRUCell):
+    """2-D conv GRU."""
+    _ndim = 2
+
+
+class Conv3DGRUCell(_ConvGRUCell):
+    """3-D conv GRU."""
+    _ndim = 3
+
+
+__all__ += ["ModifierCell", "VariationalDropoutCell", "LSTMPCell",
+            "HybridSequentialRNNCell",
+            "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+            "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+            "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]

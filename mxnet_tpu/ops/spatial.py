@@ -95,12 +95,13 @@ def deformable_convolution(x, offset, weight, bias=None, kernel=(3, 3),
                            stride=(1, 1), pad=(0, 0), dilate=(1, 1),
                            num_filter: Optional[int] = None,
                            num_group: int = 1,
-                           num_deformable_group: int = 1):
+                           num_deformable_group: int = 1, mask=None):
     """Deformable convolution v1 (ref: src/operator/contrib/
     deformable_convolution.cc, deformable_im2col.h). offset has
     2*num_deformable_group*kh*kw channels laid out (dg, tap, (y, x)) like
     the reference's deformable_im2col indexing; sampling is bilinear with
-    zero padding outside the input."""
+    zero padding outside the input.  ``mask`` (N, dg*kh*kw, Ho, Wo)
+    enables v2 modulation (ref modulated_deformable_convolution.cc)."""
     N, C, H, W = x.shape
     kh, kw = _tuple(kernel, 2)
     sh, sw = _tuple(stride, 2)
@@ -116,6 +117,9 @@ def deformable_convolution(x, offset, weight, bias=None, kernel=(3, 3),
     if offset.shape != (N, 2 * dg * K, Ho, Wo):
         raise MXNetError(
             f"offset shape {offset.shape} != {(N, 2 * dg * K, Ho, Wo)}")
+    if mask is not None and mask.shape != (N, dg * K, Ho, Wo):
+        raise MXNetError(
+            f"mask shape {mask.shape} != {(N, dg * K, Ho, Wo)}")
 
     ky, kx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw,
                           indexing="ij")
@@ -134,6 +138,9 @@ def deformable_convolution(x, offset, weight, bias=None, kernel=(3, 3),
     for g in range(dg):
         samp = bilinear_gather(x[:, g * Cg:(g + 1) * Cg],
                                ys[:, g], xs[:, g])   # (N, Cg, Ho, Wo, K)
+        if mask is not None:                          # v2 modulation
+            m = mask.reshape(N, dg, K, Ho, Wo)[:, g].transpose(0, 2, 3, 1)
+            samp = samp * m[:, None]
         patches.append(samp)
     patches = jnp.concatenate(patches, axis=1)        # (N, C, Ho, Wo, K)
 

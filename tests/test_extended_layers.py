@@ -1,0 +1,184 @@
+"""The remaining reference gluon.nn/rnn layer surface (ref
+gluon/nn/conv_layers.py PixelShuffle*, contrib/cnn deformable convs,
+gluon/rnn/conv_rnn_cell.py, rnn_cell.py LSTMPCell/ModifierCell/
+VariationalDropoutCell): value-checked against torch where an oracle
+exists, shape/contract-checked otherwise."""
+from __future__ import annotations
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn, rnn
+
+np_ = mx.np
+
+
+def test_pixel_shuffle_2d_vs_torch():
+    import torch
+    import torch.nn.functional as F
+
+    x = onp.random.RandomState(0).rand(2, 8, 3, 4).astype("float32")
+    got = nn.PixelShuffle2D(2)(np_.array(x)).asnumpy()
+    want = F.pixel_shuffle(torch.from_numpy(x), 2).numpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_pixel_shuffle_1d_3d_shapes_and_error():
+    assert nn.PixelShuffle1D(3)(np_.zeros((1, 6, 5))).shape == (1, 2, 15)
+    out = nn.PixelShuffle3D((1, 2, 2))(np_.zeros((1, 8, 2, 3, 3)))
+    assert out.shape == (1, 2, 2, 6, 6)
+    with pytest.raises(mx.MXNetError):
+        nn.PixelShuffle2D(3)(np_.zeros((1, 8, 2, 2)))  # 8 % 9 != 0
+
+
+def test_pixel_shuffle_1d_values():
+    # channel blocks interleave into width: explicit tiny case
+    x = onp.arange(12, dtype="float32").reshape(1, 4, 3)
+    got = nn.PixelShuffle1D(2)(np_.array(x)).asnumpy()
+    # out[c, w*2+i] = x[c*2+i, w]
+    want = onp.zeros((1, 2, 6), "float32")
+    for c in range(2):
+        for w in range(3):
+            for i in range(2):
+                want[0, c, w * 2 + i] = x[0, c * 2 + i, w]
+    onp.testing.assert_allclose(got, want)
+
+
+def test_batch_norm_relu():
+    bn = nn.BatchNormReLU()
+    bn.initialize()
+    x = onp.random.RandomState(1).randn(6, 3).astype("float32")
+    with mx.autograd.record(train_mode=True):
+        out = bn(np_.array(x))
+    a = out.asnumpy()
+    assert (a >= 0).all() and (a == 0).any(), "relu applied post-BN"
+
+
+def test_deformable_conv_zero_offset_is_plain_conv():
+    dc = nn.DeformableConvolution(4, kernel_size=3, padding=1)
+    dc.initialize(mx.init.Xavier())
+    x = np_.array(onp.random.RandomState(2).rand(1, 2, 6, 6)
+                  .astype("float32"))
+    out = dc(x)  # offset conv weights init to zeros -> v1 == plain conv
+    want = mx.npx.convolution(x, dc.weight.data(), dc.bias.data(),
+                              kernel=(3, 3), pad=(1, 1), num_filter=4)
+    onp.testing.assert_allclose(out.asnumpy(), want.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_modulated_deformable_conv_zero_offset():
+    mdc = nn.ModulatedDeformableConvolution(4, kernel_size=3, padding=1)
+    mdc.initialize(mx.init.Xavier())
+    x = np_.array(onp.random.RandomState(3).rand(1, 2, 6, 6)
+                  .astype("float32"))
+    out = mdc(x)
+    # zero offset/mask logits -> sigmoid(0)=0.5 modulation of a plain conv
+    plain = mx.npx.convolution(x, mdc.weight.data(), None, kernel=(3, 3),
+                               pad=(1, 1), num_filter=4)
+    want = plain * 0.5 + mdc.bias.data().reshape(1, -1, 1, 1)
+    onp.testing.assert_allclose(out.asnumpy(), want.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_gradients():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    dc = nn.DeformableConvolution(2, kernel_size=3, padding=1,
+                                  num_deformable_group=1)
+    dc.initialize(mx.init.Xavier())
+    x = np_.array(onp.random.RandomState(5).rand(1, 2, 5, 5)
+                  .astype("float32"))
+    dc(x)  # deferred shape inference
+    # make offsets nontrivial so the bilinear-sampling grads are exercised
+    dc.offset_weight.set_data(np_.array(
+        onp.random.RandomState(4).rand(*dc.offset_weight.shape)
+        .astype("float32") * 0.1))
+    check_numeric_gradient(lambda d: dc(d), [x], rtol=4e-2, atol=4e-2)
+
+
+def test_lstmp_cell_shapes_and_unroll():
+    cell = rnn.LSTMPCell(8, 3)
+    cell.initialize(mx.init.Xavier())
+    out, states = cell(np_.ones((2, 5)), None)
+    assert out.shape == (2, 3)
+    assert states[0].shape == (2, 3) and states[1].shape == (2, 8)
+    outs, st = cell.unroll(4, np_.ones((2, 4, 5)))
+    assert outs.shape == (2, 4, 3)
+    assert onp.isfinite(outs.asnumpy()).all()
+
+
+@pytest.mark.parametrize("dim,shape", [(1, (2, 3, 8)), (2, (2, 3, 6, 6)),
+                                       (3, (2, 3, 4, 4, 4))],
+                         ids=["1d", "2d", "3d"])
+@pytest.mark.parametrize("kind", ["RNN", "LSTM", "GRU"])
+def test_conv_cells_step_and_unroll(dim, shape, kind):
+    cls = getattr(rnn, f"Conv{dim}D{kind}Cell")
+    cell = cls(shape[1:], 5, i2h_kernel=3)
+    cell.initialize(mx.init.Xavier())
+    x = np_.array(onp.random.RandomState(dim).rand(*shape)
+                  .astype("float32"))
+    out, states = cell(x, None)
+    assert out.shape == (shape[0], 5) + shape[2:]
+    for s in states:
+        assert s.shape == (shape[0], 5) + shape[2:]
+    # recurrence actually depends on the state
+    out2, _ = cell(x, states)
+    assert not onp.allclose(out.asnumpy(), out2.asnumpy())
+
+
+def test_conv_cell_rejects_even_h2h_kernel():
+    with pytest.raises(mx.MXNetError):
+        rnn.Conv2DRNNCell((3, 6, 6), 5, h2h_kernel=2)
+
+
+def test_conv_lstm_matches_dense_lstm_on_1x1():
+    """A Conv cell with 1x1 kernels over 1x1 spatial IS a dense cell —
+    cross-validate the gate math against LSTMCell."""
+    conv = rnn.Conv2DLSTMCell((4, 1, 1), 6, i2h_kernel=1, h2h_kernel=1)
+    dense = rnn.LSTMCell(6, input_size=4)
+    conv.initialize(mx.init.Xavier())
+    dense.initialize(mx.init.Xavier())
+    dense.i2h_weight.set_data(
+        conv.i2h_weight.data().reshape((24, 4)))
+    dense.h2h_weight.set_data(
+        conv.h2h_weight.data().reshape((24, 6)))
+    x = onp.random.RandomState(7).rand(2, 4).astype("float32")
+    oc, sc = conv(np_.array(x).reshape((2, 4, 1, 1)), None)
+    od, sd = dense(np_.array(x), None)
+    onp.testing.assert_allclose(oc.asnumpy().reshape(2, 6), od.asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_variational_dropout_masks_fixed_per_sequence():
+    base = rnn.LSTMCell(6)
+    vd = rnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                    drop_outputs=0.5)
+    vd.initialize(mx.init.Xavier())
+    with mx.autograd.record(train_mode=True):
+        o1, s = vd(np_.ones((2, 4)), None)
+        m1 = vd._mask_o.asnumpy()
+        o2, s = vd(np_.ones((2, 4)), s)
+        assert (vd._mask_o.asnumpy() == m1).all(), "mask must persist"
+    vd.reset()
+    assert vd._mask_o is None
+    # inference applies no dropout
+    o3, _ = vd(np_.ones((2, 4)), None)
+    assert onp.isfinite(o3.asnumpy()).all()
+
+
+def test_modifier_cell_delegates_state():
+    base = rnn.GRUCell(5)
+
+    class Twice(rnn.ModifierCell):
+        def forward(self, inputs, states):
+            out, st = self.base_cell(inputs, states)
+            return out * 2, st
+
+    t = Twice(base)
+    t.initialize(mx.init.Xavier())
+    out, st = t(np_.ones((2, 3)), None)
+    want, _ = base(np_.ones((2, 3)), t.begin_state(batch_size=2))
+    onp.testing.assert_allclose(out.asnumpy(), 2 * want.asnumpy(),
+                                rtol=1e-6)
+    assert rnn.HybridSequentialRNNCell is rnn.SequentialRNNCell
