@@ -645,18 +645,48 @@ def main():
         return 0
 
     env = dict(os.environ) if platform == "tpu" else _cpu_env()
-    # First compile of the ResNet-50 train step is the long pole; the rest
-    # reuse a warm persistent cache at most.
-    timeouts = {"resnet50": 1800, "bert_base": 1200, "lenet": 600,
-                "lstm_lm": 900, "ssd": 1500}
+    # Persistent compilation cache: a repeat run (the round-end driver
+    # run after a measurement sprint) should pay the relay's 10-25 min
+    # compile at most once per graph.  Harmless if the PJRT backend
+    # declines executable serialization — jax then just skips caching.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".jax_cache"))
+    # Compile over the relay tunnel dominates each config's wall time and
+    # has been observed at 10-25 MINUTES per graph on a live-but-slow
+    # relay (round 4: every config except resnet timed out at the old
+    # 600-1500s caps while the chip itself ran at full speed).  The caps
+    # exist to bound a WEDGED child, not to police a slow compile, so
+    # they are generous; the headline config runs first and every
+    # result is flushed to bench_partial.jsonl immediately, so an
+    # external kill keeps whatever was already measured.
+    timeouts = {"resnet50": 3600, "bert_base": 3600, "lenet": 2400,
+                "lstm_lm": 3000, "ssd": 3600}
+    partial = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_partial.jsonl")
+
+    def _flush(row):
+        try:
+            with open(partial, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        except OSError:
+            pass
+
+    try:
+        os.unlink(partial)
+    except OSError:
+        pass
     result = _run_config("resnet50", env, timeouts["resnet50"])
     if "unit" not in result:
         result.setdefault("unit", "images/sec")
         result.setdefault("vs_baseline", None)
     result["platform"] = platform
-    result["extra_metrics"] = [
-        _run_config(name, env, timeouts[name])
-        for name in ("bert_base", "lenet", "lstm_lm", "ssd")]
+    _flush(result)
+    result["extra_metrics"] = []
+    for name in ("bert_base", "lenet", "lstm_lm", "ssd"):
+        row = _run_config(name, env, timeouts[name])
+        _flush(row)
+        result["extra_metrics"].append(row)
     print(json.dumps(result))
     return 0
 

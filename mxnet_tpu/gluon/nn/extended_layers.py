@@ -4,10 +4,9 @@ PixelShuffle*, basic_layers.py BatchNormReLU, contrib/cnn
 DeformableConvolution / ModulatedDeformableConvolution)."""
 from __future__ import annotations
 
-import jax.numpy as jnp
-
 from ... import numpy_extension as npx
 from ...base import MXNetError
+from ...ops.nn import _tuple as _tupn
 from ..block import HybridBlock
 from ..parameter import Parameter
 from .basic_layers import BatchNorm
@@ -15,10 +14,6 @@ from .basic_layers import BatchNorm
 __all__ = ["PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D",
            "BatchNormReLU", "DeformableConvolution",
            "ModulatedDeformableConvolution"]
-
-
-def _tupn(v, n):
-    return tuple(v) if isinstance(v, (tuple, list)) else (v,) * n
 
 
 class _PixelShuffle(HybridBlock):
@@ -129,9 +124,6 @@ class DeformableConvolution(HybridBlock):
                              c_in // self._groups) + self._kernel
 
     def forward(self, x):
-        from ...ops import spatial as _sp
-        from ...ops.dispatch import call
-
         pred = npx.convolution(
             x, self.offset_weight.data(), self.offset_bias.data(),
             kernel=self._kernel, stride=self._strides, pad=self._padding,
@@ -144,27 +136,12 @@ class DeformableConvolution(HybridBlock):
         else:
             offset, mask = pred, None
 
-        b = self.bias.data() if self.bias is not None else None
-        args = [x, offset, self.weight.data()]
-        has_bias, has_mask = b is not None, mask is not None
-        if has_bias:
-            args.append(b)
-        if has_mask:
-            args.append(mask)
-
-        def f(xx, off, w, *rest):
-            rest = list(rest)
-            bb = rest.pop(0) if has_bias else None
-            mm = rest.pop(0) if has_mask else None
-            return _sp.deformable_convolution(
-                xx, off, w, bb, kernel=self._kernel, stride=self._strides,
-                pad=self._padding, dilate=self._dilation,
-                num_group=self._groups, num_deformable_group=self._dg,
-                mask=mm)
-
-        out = call(f, tuple(args), {}, name="deformable_convolution"
-                   if not self._use_mask else
-                   "modulated_deformable_convolution")
+        out = npx.deformable_convolution(
+            x, offset, self.weight.data(),
+            self.bias.data() if self.bias is not None else None,
+            kernel=self._kernel, stride=self._strides, pad=self._padding,
+            dilate=self._dilation, num_group=self._groups,
+            num_deformable_group=self._dg, mask=mask)
         if self._act is not None:
             out = npx.activation(out, act_type=self._act)
         return out

@@ -479,9 +479,10 @@ class _ConvGatedCell(RecurrentCell):
                  **kwargs):
         super().__init__(**kwargs)
         nd = self._ndim
+        from ...ops.nn import _tuple
 
         def tup(v):
-            return tuple(v) if isinstance(v, (tuple, list)) else (v,) * nd
+            return _tuple(v, nd)
 
         self._input_shape = tuple(input_shape)   # (C, *spatial)
         self._hc = hidden_channels

@@ -630,19 +630,31 @@ def spatial_transformer(data, loc, target_shape, transform_type="affine",
 def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
                            stride=(1, 1), pad=(0, 0), dilate=(1, 1),
                            num_filter=None, num_group=1,
-                           num_deformable_group=1, no_bias=False, out=None):
+                           num_deformable_group=1, no_bias=False,
+                           mask=None, out=None):
+    """v1 (ref contrib deformable_convolution) and, with ``mask``, the v2
+    modulated variant — one wrapper so the gluon layers and npx agree."""
     from ..ops import spatial as _sp
 
-    args = (data, offset, weight) if bias is None or no_bias \
-        else (data, offset, weight, bias)
+    has_bias = bias is not None and not no_bias
+    args = [data, offset, weight]
+    if has_bias:
+        args.append(bias)
+    if mask is not None:
+        args.append(mask)
 
-    def f(d, o, w, b=None):
+    def f(d, o, w, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if has_bias else None
+        m = rest.pop(0) if mask is not None else None
         return _sp.deformable_convolution(
             d, o, w, b, kernel=kernel, stride=stride, pad=pad,
             dilate=dilate, num_filter=num_filter, num_group=num_group,
-            num_deformable_group=num_deformable_group)
+            num_deformable_group=num_deformable_group, mask=m)
 
-    return call(f, args, {}, name="deformable_convolution", out=out)
+    return call(f, tuple(args), {},
+                name="deformable_convolution" if mask is None
+                else "modulated_deformable_convolution", out=out)
 
 
 def roi_pooling(data, rois, pooled_size, spatial_scale=1.0, out=None):

@@ -125,6 +125,15 @@ def test_conv_cells_step_and_unroll(dim, shape, kind):
     # recurrence actually depends on the state
     out2, _ = cell(x, states)
     assert not onp.allclose(out.asnumpy(), out2.asnumpy())
+    # unroll over time threads the (N, C, *spatial) states correctly and
+    # step 0 of the unrolled sequence equals a fresh single step
+    seq = np_.stack([x, x * 0.5, x * 0.25], axis=1)   # (N, T, C, *sp)
+    outs, st = cell.unroll(3, seq, merge_outputs=True)
+    assert outs.shape == (shape[0], 3, 5) + shape[2:]
+    for s in st:
+        assert s.shape == (shape[0], 5) + shape[2:]
+    onp.testing.assert_allclose(outs.asnumpy()[:, 0], out.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
 
 
 def test_conv_cell_rejects_even_h2h_kernel():
