@@ -1,0 +1,6 @@
+"""gluon.contrib — experimental training utilities
+(ref python/mxnet/gluon/contrib/__init__.py: estimator + data)."""
+from . import estimator
+from . import data
+
+__all__ = ["estimator", "data"]

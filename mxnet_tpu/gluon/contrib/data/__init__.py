@@ -1,0 +1,4 @@
+"""gluon.contrib.data (ref python/mxnet/gluon/contrib/data/__init__.py)."""
+from . import vision
+
+__all__ = ["vision"]

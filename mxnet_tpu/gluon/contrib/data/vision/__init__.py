@@ -1,0 +1,218 @@
+"""Contrib vision dataloaders (ref gluon/contrib/data/vision/
+dataloader.py): augmentation-pipeline builders plus DataLoader wrappers
+over record/.lst/in-memory image sources.
+
+TPU-first data flow: augmentation runs host-side (numpy/PIL) inside
+DataLoader workers; ONE batched NCHW array crosses to the device — no
+per-sample device ops (same stance as image.ImageIter).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from mxnet_tpu import image as _image
+from mxnet_tpu.gluon.data import DataLoader
+from mxnet_tpu.gluon.data.vision.datasets import (ImageListDataset,
+                                                  ImageRecordDataset)
+
+from . import transforms
+
+__all__ = ["create_image_augment", "create_bbox_augment",
+           "ImageDataLoader", "ImageBboxDataLoader", "BboxLabelTransform",
+           "transforms"]
+
+
+def create_image_augment(data_shape, resize=0, rand_crop=False,
+                         rand_resize=False, rand_mirror=False, mean=None,
+                         std=None, brightness=0, contrast=0, saturation=0,
+                         hue=0, pca_noise=0, rand_gray=0, inter_method=2,
+                         dtype="float32"):
+    """Classification augment pipeline as ONE callable ``img -> CHW
+    tensor`` (ref dataloader.py create_image_augment, which returns a
+    HybridSequential; here augmenters are host-side functions)."""
+    chain = _image.CreateAugmenter(
+        data_shape, resize=resize, rand_crop=rand_crop,
+        rand_resize=rand_resize, rand_mirror=rand_mirror, mean=mean,
+        std=std, brightness=brightness, contrast=contrast,
+        saturation=saturation, hue=hue, pca_noise=pca_noise,
+        rand_gray=rand_gray, inter_method=inter_method)
+
+    def augment(img):
+        for aug in chain:
+            img = aug(img)
+        out = img.asnumpy() if hasattr(img, "asnumpy") else onp.asarray(img)
+        return onp.ascontiguousarray(
+            out.transpose(2, 0, 1).astype(dtype))
+
+    return augment
+
+
+def create_bbox_augment(data_shape, rand_crop=0, rand_pad=0, rand_gray=0,
+                        rand_mirror=False, mean=None, std=None,
+                        brightness=0, contrast=0, saturation=0,
+                        pca_noise=0, hue=0, inter_method=2,
+                        max_aspect_ratio=2, area_range=(0.3, 3.0),
+                        max_attempts=50, pad_val=(127, 127, 127),
+                        dtype="float32"):
+    """Detection augment pipeline as ONE callable ``(img, bbox_label) ->
+    (CHW tensor, label)`` (ref create_bbox_augment); boxes are
+    normalized corner coords as in image.CreateDetAugmenter."""
+    chain = _image.CreateDetAugmenter(
+        data_shape, rand_crop=rand_crop, rand_pad=rand_pad,
+        rand_gray=rand_gray, rand_mirror=rand_mirror, mean=mean, std=std,
+        brightness=brightness, contrast=contrast, saturation=saturation,
+        pca_noise=pca_noise, hue=hue, inter_method=inter_method,
+        aspect_ratio_range=(1 / max_aspect_ratio, max_aspect_ratio),
+        area_range=area_range, max_attempts=max_attempts,
+        pad_val=pad_val)
+
+    def augment(img, label):
+        label = onp.asarray(label, onp.float32)
+        for aug in chain:
+            img, label = aug(img, label)
+        out = img.asnumpy() if hasattr(img, "asnumpy") else onp.asarray(img)
+        return onp.ascontiguousarray(
+            out.transpose(2, 0, 1).astype(dtype)), label
+
+    return augment
+
+
+class BboxLabelTransform:
+    """Reshape a flat .lst label row to ``(N, 5)`` [id, xmin, ymin, xmax,
+    ymax] boxes (ref dataloader.py BboxLabelTransform); with
+    ``coord_normalized=False`` coordinates are divided by image size into
+    the normalized frame the det augmenters expect."""
+
+    def __init__(self, coord_normalized=True):
+        self._normalized = coord_normalized
+
+    def __call__(self, img, label):
+        label = onp.asarray(label, onp.float32).reshape(-1, 5)
+        if not self._normalized:
+            a = img.asnumpy() if hasattr(img, "asnumpy") else img
+            h, w = a.shape[0], a.shape[1]
+            label = label.copy()
+            label[:, 1::2] /= w
+            label[:, 2::2] /= h
+        return img, label
+
+
+def _make_dataset(cls_name, path_imgrec, path_imglist, path_root, imglist):
+    if path_imgrec:
+        logging.info("%s: loading recordio %s...", cls_name, path_imgrec)
+        return ImageRecordDataset(path_imgrec, flag=1)
+    if path_imglist:
+        logging.info("%s: loading image list %s...", cls_name, path_imglist)
+        return ImageListDataset(path_root, path_imglist, flag=1)
+    if isinstance(imglist, list):
+        logging.info("%s: loading in-memory image list...", cls_name)
+        return ImageListDataset(path_root, imglist, flag=1)
+    raise ValueError(
+        "one of path_imgrec, path_imglist or imglist is required")
+
+
+class ImageDataLoader:
+    """Classification DataLoader over .rec / .lst / in-memory lists with
+    the standard augment pipeline (ref dataloader.py ImageDataLoader)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=".", part_index=0,
+                 num_parts=1, aug_list=None, imglist=None, dtype="float32",
+                 shuffle=False, sampler=None, last_batch=None,
+                 batch_sampler=None, batchify_fn=None, num_workers=0,
+                 **kwargs):
+        dataset = _make_dataset(type(self).__name__, path_imgrec,
+                                path_imglist, path_root, imglist)
+        if num_parts > 1:
+            dataset = dataset.shard(num_parts, part_index)
+        if aug_list is None:
+            augment = create_image_augment(data_shape, dtype=dtype,
+                                           **kwargs)
+        elif callable(aug_list):
+            augment = aug_list
+        elif isinstance(aug_list, list):
+            def augment(img, _chain=aug_list):
+                for aug in _chain:
+                    img = aug(img)
+                return img
+        else:
+            raise ValueError("aug_list must be a callable or a list of "
+                             "augmenters")
+        self._iter = DataLoader(
+            dataset.transform_first(augment), batch_size=batch_size,
+            shuffle=shuffle, sampler=sampler, last_batch=last_batch,
+            batch_sampler=batch_sampler, batchify_fn=batchify_fn,
+            num_workers=num_workers)
+
+    def __iter__(self):
+        return iter(self._iter)
+
+    def __len__(self):
+        return len(self._iter)
+
+
+class ImageBboxDataLoader:
+    """Detection DataLoader: augments (img, boxes) jointly and pads each
+    batch's labels to one static ``(B, max_objects, 5)`` block with -1
+    rows so downstream SSD target building stays jittable (ref
+    dataloader.py ImageBboxDataLoader; padding stance of ImageDetIter)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=".", part_index=0,
+                 num_parts=1, aug_list=None, imglist=None,
+                 coord_normalized=True, dtype="float32", shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, max_objects=16,
+                 **kwargs):
+        dataset = _make_dataset(type(self).__name__, path_imgrec,
+                                path_imglist, path_root, imglist)
+        if num_parts > 1:
+            dataset = dataset.shard(num_parts, part_index)
+        if aug_list is None:
+            augment = create_bbox_augment(data_shape, dtype=dtype,
+                                          **kwargs)
+        elif callable(aug_list):
+            augment = aug_list
+        elif isinstance(aug_list, list):
+            def augment(img, label, _chain=aug_list):
+                for aug in _chain:
+                    img, label = aug(img, label)
+                return img, label
+        else:
+            raise ValueError("aug_list must be a callable or a list of "
+                             "det augmenters")
+        to_bbox = BboxLabelTransform(coord_normalized)
+        self._max_objects = max_objects
+
+        def transform(item):                  # Dataset.transform passes
+            img, label = item                 # the whole (img, label)
+            img, label = to_bbox(img, label)
+            return augment(img, label)
+
+        if batchify_fn is None:
+            batchify_fn = self._pad_batchify
+        self._iter = DataLoader(
+            dataset.transform(transform), batch_size=batch_size,
+            shuffle=shuffle, sampler=sampler, last_batch=last_batch,
+            batch_sampler=batch_sampler, batchify_fn=batchify_fn,
+            num_workers=num_workers)
+
+    def _pad_batchify(self, samples):
+        imgs = onp.stack([onp.asarray(s[0]) for s in samples])
+        labels = onp.full((len(samples), self._max_objects, 5), -1.0,
+                          onp.float32)
+        for i, s in enumerate(samples):
+            lab = onp.asarray(s[1], onp.float32).reshape(-1, 5)
+            n = min(len(lab), self._max_objects)
+            labels[i, :n] = lab[:n]
+        from mxnet_tpu import np as _np
+
+        return _np.array(imgs), _np.array(labels)
+
+    def __iter__(self):
+        return iter(self._iter)
+
+    def __len__(self):
+        return len(self._iter)

@@ -1,0 +1,4 @@
+"""Contrib vision transforms (ref gluon/contrib/data/vision/transforms)."""
+from . import bbox
+
+__all__ = ["bbox"]

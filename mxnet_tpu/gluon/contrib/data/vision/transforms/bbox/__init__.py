@@ -1,0 +1,14 @@
+"""Bbox transforms (ref gluon/contrib/data/vision/transforms/bbox)."""
+from .bbox import (ImageBboxCrop, ImageBboxRandomCropWithConstraints,
+                   ImageBboxRandomExpand, ImageBboxRandomFlipLeftRight,
+                   ImageBboxResize)
+from .utils import (bbox_clip_xyxy, bbox_crop, bbox_flip, bbox_iou,
+                    bbox_random_crop_with_constraints, bbox_resize,
+                    bbox_translate, bbox_xywh_to_xyxy, bbox_xyxy_to_xywh)
+
+__all__ = ["ImageBboxCrop", "ImageBboxRandomCropWithConstraints",
+           "ImageBboxRandomExpand", "ImageBboxRandomFlipLeftRight",
+           "ImageBboxResize", "bbox_crop", "bbox_flip", "bbox_resize",
+           "bbox_translate", "bbox_iou", "bbox_xywh_to_xyxy",
+           "bbox_xyxy_to_xywh", "bbox_clip_xyxy",
+           "bbox_random_crop_with_constraints"]

@@ -1,0 +1,135 @@
+"""Joint image+bbox transform blocks (ref gluon/contrib/data/vision/
+transforms/bbox/bbox.py).
+
+Each block's ``forward(img, bbox)`` returns the transformed pair; images
+are HWC host arrays (NDArray or numpy), boxes are ``(N, 4+)`` corner
+format.  All geometry delegates to ``utils``; image work delegates to
+``mxnet_tpu.image``.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as onp
+
+from mxnet_tpu.gluon.block import Block
+from mxnet_tpu.image import image as _img
+
+from .utils import (bbox_crop, bbox_flip, bbox_random_crop_with_constraints,
+                    bbox_resize, bbox_translate)
+
+__all__ = ["ImageBboxRandomFlipLeftRight", "ImageBboxCrop",
+           "ImageBboxRandomCropWithConstraints", "ImageBboxRandomExpand",
+           "ImageBboxResize"]
+
+
+def _host(img):
+    return img.asnumpy() if hasattr(img, "asnumpy") else onp.asarray(img)
+
+
+def _wrap_like(arr, ref):
+    if hasattr(ref, "asnumpy"):
+        from mxnet_tpu import np as _np
+
+        return _np.array(arr)
+    return arr
+
+
+class ImageBboxRandomFlipLeftRight(Block):
+    """Mirror image and boxes horizontally with probability ``p``."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, img, bbox):
+        if random.random() >= self.p:
+            return img, bbox
+        a = _host(img)
+        return _wrap_like(a[:, ::-1].copy(), img), \
+            bbox_flip(bbox, (a.shape[1], a.shape[0]), flip_x=True)
+
+
+class ImageBboxCrop(Block):
+    """Fixed crop ``(x, y, w, h)`` of image and boxes."""
+
+    def __init__(self, crop, allow_outside_center=False):
+        super().__init__()
+        if len(crop) != 4:
+            raise ValueError("crop must be (x, y, w, h)")
+        self._crop = tuple(int(v) for v in crop)
+        self._allow = allow_outside_center
+
+    def forward(self, img, bbox):
+        x, y, w, h = self._crop
+        a = _host(img)
+        if not (0 <= x and 0 <= y and x + w <= a.shape[1]
+                and y + h <= a.shape[0]):
+            raise ValueError(
+                f"crop {self._crop} exceeds image {a.shape[:2][::-1]}")
+        return _wrap_like(a[y:y + h, x:x + w].copy(), img), \
+            bbox_crop(bbox, self._crop, self._allow)
+
+
+class ImageBboxRandomCropWithConstraints(Block):
+    """SSD-style min-IoU constrained random crop with probability ``p``."""
+
+    def __init__(self, p=0.5, min_scale=0.3, max_scale=1.0,
+                 max_aspect_ratio=2.0, constraints=None, max_trial=50):
+        super().__init__()
+        self.p = p
+        self._kw = dict(min_scale=min_scale, max_scale=max_scale,
+                        max_aspect_ratio=max_aspect_ratio,
+                        constraints=constraints, max_trial=max_trial)
+
+    def forward(self, img, bbox):
+        if random.random() >= self.p:
+            return img, bbox
+        a = _host(img)
+        new_bbox, (x, y, w, h) = bbox_random_crop_with_constraints(
+            onp.asarray(bbox, onp.float32), (a.shape[1], a.shape[0]),
+            **self._kw)
+        return _wrap_like(a[y:y + h, x:x + w].copy(), img), new_bbox
+
+
+class ImageBboxRandomExpand(Block):
+    """Place the image at a random spot on a larger ``fill`` canvas (the
+    zoom-out half of SSD augmentation), translating boxes to match."""
+
+    def __init__(self, p=0.5, max_ratio=4, fill=0, keep_ratio=True):
+        super().__init__()
+        self.p = p
+        self._max_ratio = max_ratio
+        self._fill = fill
+        self._keep_ratio = keep_ratio
+
+    def forward(self, img, bbox):
+        if self._max_ratio <= 1 or random.random() >= self.p:
+            return img, bbox
+        a = _host(img)
+        h, w, c = a.shape
+        rx = 1.0 + random.random() * (self._max_ratio - 1)
+        ry = rx if self._keep_ratio else \
+            1.0 + random.random() * (self._max_ratio - 1)
+        oh, ow = int(h * ry), int(w * rx)
+        x = random.randint(0, ow - w)
+        y = random.randint(0, oh - h)
+        canvas = onp.full((oh, ow, c), self._fill, a.dtype)
+        canvas[y:y + h, x:x + w] = a
+        return _wrap_like(canvas, img), bbox_translate(bbox, x, y)
+
+
+class ImageBboxResize(Block):
+    """Resize image to ``(width, height)`` and rescale boxes."""
+
+    def __init__(self, width, height, interpolation=1):
+        super().__init__()
+        self._size = (int(width), int(height))
+        self._interp = interpolation
+
+    def forward(self, img, bbox):
+        a = _host(img)
+        out = _img.imresize(a, self._size[0], self._size[1],
+                            interp=self._interp)
+        return _wrap_like(_host(out), img), bbox_resize(
+            bbox, (a.shape[1], a.shape[0]), self._size)

@@ -1,0 +1,192 @@
+"""Bounding-box geometry for data transforms (ref gluon/contrib/data/
+vision/transforms/bbox/utils.py).
+
+Host-side, vectorized numpy: these run in the input pipeline before data
+reaches the device, like every augmenter in ``mxnet_tpu.image``.  Boxes
+are ``(N, 4+)`` arrays in corner format ``xmin, ymin, xmax, ymax`` unless
+a function says otherwise; extra columns (class ids, difficulty flags)
+ride along untouched.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as onp
+
+__all__ = ["bbox_crop", "bbox_flip", "bbox_resize", "bbox_translate",
+           "bbox_iou", "bbox_xywh_to_xyxy", "bbox_xyxy_to_xywh",
+           "bbox_clip_xyxy", "bbox_random_crop_with_constraints"]
+
+
+def _check(bbox):
+    bbox = onp.asarray(bbox, onp.float32)
+    if bbox.ndim != 2 or bbox.shape[1] < 4:
+        raise ValueError(f"bbox must be (N, 4+), got shape {bbox.shape}")
+    return bbox
+
+
+def bbox_crop(bbox, crop_box=None, allow_outside_center=True):
+    """Translate boxes into the ``crop_box=(x, y, w, h)`` frame, clip to
+    it, and drop degenerate boxes (and, unless ``allow_outside_center``,
+    boxes whose center left the crop)."""
+    bbox = _check(bbox).copy()
+    if crop_box is None:
+        return bbox
+    if len(crop_box) != 4:
+        raise ValueError("crop_box must be (x, y, w, h)")
+    cx, cy, cw, ch = (float(v) for v in crop_box)
+    if allow_outside_center:
+        keep = onp.ones(len(bbox), bool)
+    else:
+        centers = (bbox[:, :2] + bbox[:, 2:4]) / 2
+        keep = ((centers >= (cx, cy)) & (centers <= (cx + cw, cy + ch))) \
+            .all(axis=1)
+    bbox[:, 0::2] = onp.clip(bbox[:, 0::2] - cx, 0, cw)
+    bbox[:, 1::2] = onp.clip(bbox[:, 1::2] - cy, 0, ch)
+    keep &= (bbox[:, 2] > bbox[:, 0]) & (bbox[:, 3] > bbox[:, 1])
+    return bbox[keep]
+
+
+def bbox_flip(bbox, size, flip_x=False, flip_y=False):
+    """Mirror boxes inside an image of ``size=(w, h)``."""
+    if not len(size) == 2:
+        raise ValueError("size must be (width, height)")
+    bbox = _check(bbox).copy()
+    w, h = (float(v) for v in size)
+    if flip_x:
+        bbox[:, [0, 2]] = w - bbox[:, [2, 0]]
+    if flip_y:
+        bbox[:, [1, 3]] = h - bbox[:, [3, 1]]
+    return bbox
+
+
+def bbox_resize(bbox, in_size, out_size):
+    """Rescale boxes from image ``in_size=(w, h)`` to ``out_size``."""
+    bbox = _check(bbox).copy()
+    if len(in_size) != 2 or len(out_size) != 2:
+        raise ValueError("in_size and out_size must be (width, height)")
+    sx = out_size[0] / in_size[0]
+    sy = out_size[1] / in_size[1]
+    bbox[:, 0::2] *= sx
+    bbox[:, 1::2] *= sy
+    return bbox
+
+
+def bbox_translate(bbox, x_offset=0, y_offset=0):
+    bbox = _check(bbox).copy()
+    bbox[:, 0::2] += float(x_offset)
+    bbox[:, 1::2] += float(y_offset)
+    return bbox
+
+
+def bbox_iou(bbox_a, bbox_b, offset=0):
+    """Pairwise IoU matrix ``(len(a), len(b))`` of corner-format boxes."""
+    a, b = _check(bbox_a), _check(bbox_b)
+    tl = onp.maximum(a[:, None, :2], b[None, :, :2])
+    br = onp.minimum(a[:, None, 2:4], b[None, :, 2:4])
+    inter = onp.prod(onp.clip(br - tl + offset, 0, None), axis=2) * \
+        (tl < br).all(axis=2)
+    area_a = onp.prod(a[:, 2:4] - a[:, :2] + offset, axis=1)
+    area_b = onp.prod(b[:, 2:4] - b[:, :2] + offset, axis=1)
+    return inter / (area_a[:, None] + area_b[None, :] - inter)
+
+
+def bbox_xywh_to_xyxy(xywh):
+    """(x, y, w, h) -> (xmin, ymin, xmax, ymax); tuple in, tuple out."""
+    if isinstance(xywh, (tuple, list)):
+        if len(xywh) != 4:
+            raise IndexError(f"expected length-4 box, got {len(xywh)}")
+        x, y, w, h = xywh
+        return (x, y, x + max(w - 1, 0), y + max(h - 1, 0))
+    xywh = onp.asarray(xywh)
+    if xywh.ndim != 2 or xywh.shape[1] < 4:
+        raise IndexError(f"expected (N, 4+) array, got {xywh.shape}")
+    out = xywh.copy()
+    out[:, 2:4] = xywh[:, :2] + onp.maximum(xywh[:, 2:4] - 1, 0)
+    return out
+
+
+def bbox_xyxy_to_xywh(xyxy):
+    """(xmin, ymin, xmax, ymax) -> (x, y, w, h); tuple in, tuple out."""
+    if isinstance(xyxy, (tuple, list)):
+        if len(xyxy) != 4:
+            raise IndexError(f"expected length-4 box, got {len(xyxy)}")
+        x0, y0, x1, y1 = xyxy
+        return (x0, y0, x1 - x0 + 1, y1 - y0 + 1)
+    xyxy = onp.asarray(xyxy)
+    if xyxy.ndim != 2 or xyxy.shape[1] < 4:
+        raise IndexError(f"expected (N, 4+) array, got {xyxy.shape}")
+    out = xyxy.copy()
+    out[:, 2:4] = xyxy[:, 2:4] - xyxy[:, :2] + 1
+    return out
+
+
+def bbox_clip_xyxy(xyxy, width, height):
+    """Clip corner boxes into ``[0, width-1] x [0, height-1]``."""
+    if isinstance(xyxy, (tuple, list)):
+        if len(xyxy) != 4:
+            raise IndexError(f"expected length-4 box, got {len(xyxy)}")
+        x0 = min(max(xyxy[0], 0), width - 1)
+        y0 = min(max(xyxy[1], 0), height - 1)
+        x1 = min(max(xyxy[2], 0), width - 1)
+        y1 = min(max(xyxy[3], 0), height - 1)
+        return (x0, y0, x1, y1)
+    xyxy = onp.asarray(xyxy)
+    if xyxy.ndim != 2 or xyxy.shape[1] < 4:
+        raise IndexError(f"expected (N, 4+) array, got {xyxy.shape}")
+    out = xyxy.copy()
+    out[:, 0::2] = onp.clip(xyxy[:, 0::2], 0, width - 1)
+    out[:, 1::2] = onp.clip(xyxy[:, 1::2], 0, height - 1)
+    return out
+
+
+def bbox_random_crop_with_constraints(bbox, size, min_scale=0.3,
+                                      max_scale=1.0, max_aspect_ratio=2.0,
+                                      constraints=None, max_trial=50):
+    """SSD-style constrained random crop (ref utils.py
+    bbox_random_crop_with_constraints; Liu et al. 2016).
+
+    Draws all ``max_trial`` candidate geometries per IoU constraint AT
+    ONCE (vectorized, like image/detection.py's samplers), keeps the
+    first candidate whose min-IoU against the boxes satisfies the
+    constraint, then picks one satisfying crop at random.  Returns
+    ``(new_bbox, (x, y, w, h))``; the full image when nothing satisfies.
+    """
+    bbox = _check(bbox)
+    w, h = int(size[0]), int(size[1])
+    if constraints is None:
+        constraints = ((0.1, None), (0.3, None), (0.5, None), (0.7, None),
+                       (0.9, None), (None, 1.0))
+    candidates = []
+    rs = onp.random
+    for min_iou, max_iou in constraints:
+        lo = -onp.inf if min_iou is None else min_iou
+        hi = onp.inf if max_iou is None else max_iou
+        scale = rs.uniform(min_scale, max_scale, size=max_trial)
+        ratio = onp.exp(rs.uniform(
+            -onp.log(max_aspect_ratio), onp.log(max_aspect_ratio),
+            size=max_trial))
+        cw = onp.round(onp.sqrt(scale * ratio) * w).astype(int)
+        ch = onp.round(onp.sqrt(scale / ratio) * h).astype(int)
+        ok = (cw <= w) & (ch <= h) & (cw > 0) & (ch > 0)
+        cx = (rs.uniform(size=max_trial) *
+              onp.maximum(w - cw, 0)).astype(int)
+        cy = (rs.uniform(size=max_trial) *
+              onp.maximum(h - ch, 0)).astype(int)
+        crops = onp.stack([cx, cy, cx + cw, cy + ch], axis=1) \
+            .astype(onp.float32)
+        if len(bbox):
+            iou = bbox_iou(crops, bbox)
+            worst = iou.min(axis=1)
+            ok &= (worst >= lo) & (worst <= hi)
+        hit = onp.nonzero(ok)[0]
+        if len(hit):
+            i = int(hit[0])
+            candidates.append((int(cx[i]), int(cy[i]), int(cw[i]),
+                               int(ch[i])))
+    while candidates:
+        crop = candidates.pop(int(random.random() * len(candidates)))
+        new_bbox = bbox_crop(bbox, crop, allow_outside_center=False)
+        if len(new_bbox):
+            return new_bbox, crop
+    return bbox, (0, 0, w, h)

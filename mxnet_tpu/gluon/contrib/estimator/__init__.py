@@ -1,0 +1,14 @@
+"""Estimator fit-loop API (ref gluon/contrib/estimator/__init__.py)."""
+from .batch_processor import BatchProcessor
+from .estimator import Estimator
+from .event_handler import (BatchBegin, BatchEnd, CheckpointHandler,
+                            EarlyStoppingHandler, EpochBegin, EpochEnd,
+                            EventHandler, GradientUpdateHandler,
+                            LoggingHandler, MetricHandler, StoppingHandler,
+                            TrainBegin, TrainEnd, ValidationHandler)
+
+__all__ = ["Estimator", "BatchProcessor", "EventHandler", "TrainBegin",
+           "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin", "BatchEnd",
+           "StoppingHandler", "MetricHandler", "ValidationHandler",
+           "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler",
+           "GradientUpdateHandler"]

@@ -1,0 +1,43 @@
+"""Per-batch train/eval hooks (ref gluon/contrib/estimator/batch_processor.py).
+
+TPU-first divergence from the reference: the reference splits every batch
+into per-GPU shards with ``split_and_load`` and runs a Python list of
+forward passes; here ONE global batch flows through the (hybridized →
+jitted) net and device placement/sharding belongs to jit / the mesh, so
+``pred`` and ``loss`` are single arrays, not shard lists.  The hook
+signatures and return structure are kept so custom processors port over.
+"""
+from __future__ import annotations
+
+from .... import autograd
+
+__all__ = ["BatchProcessor"]
+
+
+class BatchProcessor:
+    """Overridable fit_batch / evaluate_batch used by ``Estimator``."""
+
+    def _get_data_and_label(self, batch, batch_axis=0):
+        data, label = batch[0], batch[1]
+        return data, label
+
+    def evaluate_batch(self, estimator, val_batch, batch_axis=0):
+        """Forward + loss on one validation batch; no gradient."""
+        data, label = self._get_data_and_label(val_batch, batch_axis)
+        pred = estimator.val_net(data)
+        loss = estimator.val_loss(pred, label)
+        return data, label, pred, loss
+
+    def fit_batch(self, estimator, train_batch, batch_axis=0):
+        """Forward + loss + backward on one training batch.
+
+        The optimizer step is NOT taken here — ``GradientUpdateHandler``
+        applies it at batch end, so handlers with higher priority can
+        inspect/modify gradients first (ref estimator semantics).
+        """
+        data, label = self._get_data_and_label(train_batch, batch_axis)
+        with autograd.record():
+            pred = estimator.net(data)
+            loss = estimator.loss(pred, label)
+        loss.backward()
+        return data, label, pred, loss

@@ -1,0 +1,44 @@
+"""Estimator helper checks (ref gluon/contrib/estimator/utils.py)."""
+from __future__ import annotations
+
+from ...loss import SoftmaxCrossEntropyLoss
+from ...metric import Accuracy, CompositeEvalMetric, EvalMetric
+
+
+def _check_metrics(metrics):
+    """Normalize to a flat list of EvalMetric (composites are unpacked)."""
+    if isinstance(metrics, CompositeEvalMetric):
+        out = []
+        for m in metrics.metrics:
+            out.extend(_check_metrics(m))
+        return out
+    if isinstance(metrics, EvalMetric):
+        return [metrics]
+    metrics = list(metrics or [])
+    if not all(isinstance(m, EvalMetric) for m in metrics):
+        raise ValueError("metrics must be a Metric or a list of Metric, "
+                         f"got {metrics!r}")
+    return metrics
+
+
+def _check_handler_metric_ref(handler, known_metrics):
+    """Handlers must monitor metric OBJECTS owned by the estimator —
+    a handler holding a private metric instance would silently read
+    never-updated values (ref utils.py _check_handler_metric_ref)."""
+    for attr in dir(handler):
+        if "metric" not in attr and "monitor" not in attr:
+            continue
+        ref = getattr(handler, attr)
+        for m in (ref if isinstance(ref, list) else [ref]):
+            if isinstance(m, EvalMetric) and m not in known_metrics:
+                raise ValueError(
+                    f"Event handler {type(handler).__name__} refers to a "
+                    f"metric instance {m.name!r} outside the estimator's "
+                    "train/val metrics; use estimator.train_metrics / "
+                    "estimator.val_metrics")
+
+
+def _suggest_metric_for_loss(loss):
+    if isinstance(loss, SoftmaxCrossEntropyLoss):
+        return Accuracy()
+    return None

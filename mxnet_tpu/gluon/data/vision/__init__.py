@@ -1,3 +1,5 @@
 """gluon.data.vision (ref: python/mxnet/gluon/data/vision/)."""
-from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageFolderDataset, ImageListDataset,
+                       ImageRecordDataset)
 from . import transforms

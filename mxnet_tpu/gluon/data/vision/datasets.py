@@ -16,9 +16,10 @@ from typing import Optional
 
 import numpy as _onp
 
-from ..dataset import ArrayDataset
+from ..dataset import ArrayDataset, Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100"]
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset", "ImageListDataset"]
 
 
 def _data_root():
@@ -169,3 +170,164 @@ class CIFAR100(CIFAR10):
         label = rec[:, 1].astype(_onp.int32)  # fine label
         data = rec[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
         return data, label
+
+
+# ---------------------------------------------------------------------------
+# path-backed datasets (ref datasets.py ImageRecordDataset /
+# ImageFolderDataset; ImageListDataset ref gluon/contrib usage) — lazy
+# decode on __getitem__ so DataLoader workers parallelize the decoding
+# ---------------------------------------------------------------------------
+
+class ImageRecordDataset(Dataset):
+    """Images + labels from a RecordIO pack (ref ImageRecordDataset).
+
+    ``filename.rec`` is read through the indexed reader when
+    ``filename.idx`` exists (as written by tools/im2rec.py), else the
+    index is built by one sequential scan at construction.
+    """
+
+    def __init__(self, filename: str, flag: int = 1, transform=None):
+        from ....io.recordio import MXIndexedRecordIO, MXRecordIO
+
+        self._flag = flag
+        self._transform = transform
+        self._filename = filename
+        self._idx_path = os.path.splitext(filename)[0] + ".idx"
+        if os.path.exists(self._idx_path):
+            rec = MXIndexedRecordIO(self._idx_path, filename, "r")
+            self._offsets = dict(rec.idx)
+            rec.close()
+        else:  # build the offset table ourselves: header-only scan
+            reader = MXRecordIO(filename, "r")
+            self._offsets = {}
+            pos = 0
+            while True:
+                tell = reader.tell()
+                if not reader.skip_record():
+                    break
+                self._offsets[pos] = tell
+                pos += 1
+            reader.close()
+        self._keys = sorted(self._offsets)
+        if not self._keys:
+            raise ValueError(f"empty record file {filename}")
+        import threading
+
+        self._local = threading.local()
+
+    def _reader(self):
+        """Per-worker reader handle.  DataLoader workers start AFTER
+        __init__ — forked processes would share one file offset, and
+        ThreadPool workers share the whole object — so each (pid,
+        thread) gets its own handle: seek_pos+read is not atomic on a
+        shared one."""
+        rec = getattr(self._local, "rec", None)
+        if rec is None or getattr(self._local, "pid", None) != os.getpid():
+            from ....io.recordio import MXRecordIO
+
+            rec = MXRecordIO(self._filename, "r")
+            self._local.rec = rec
+            self._local.pid = os.getpid()
+        return rec
+
+    def __len__(self):
+        return len(self._keys)
+
+    def __getitem__(self, idx):
+        from ....image import imdecode
+        from ....io.recordio import unpack
+
+        reader = self._reader()
+        reader.seek_pos(self._offsets[self._keys[idx]])
+        header, blob = unpack(reader.read())
+        img = imdecode(blob, flag=self._flag)
+        label = _onp.float32(header.label) if _onp.ndim(header.label) == 0 \
+            else _onp.asarray(header.label, _onp.float32)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """``root/<class-name>/xxx.jpg`` layout (ref ImageFolderDataset);
+    classes are the sorted sub-directory names, exposed as ``synsets``."""
+
+    _EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+    def __init__(self, root: str, flag: int = 1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if os.path.splitext(fname)[1].lower() in self._EXTS:
+                    self.items.append((os.path.join(path, fname), label))
+        if not self.items:
+            raise ValueError(f"no images found under {root}")
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from ....image import imread
+
+        path, label = self.items[idx]
+        img = imread(path, flag=self._flag)
+        label = _onp.int32(label)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageListDataset(Dataset):
+    """Images listed in a tab-separated ``.lst`` file (index, label(s),
+    relative path — the tools/im2rec.py format) or an in-memory list of
+    ``[label, path]`` entries, rooted at ``root``."""
+
+    def __init__(self, root: str = ".", imglist=None, flag: int = 1):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._items = []
+        if isinstance(imglist, str):
+            with open(imglist) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        raise ValueError(
+                            f"malformed .lst line: {line!r} (want "
+                            "index<TAB>label...<TAB>path)")
+                    label = _onp.asarray([float(v) for v in parts[1:-1]],
+                                         _onp.float32)
+                    self._items.append((parts[-1], label))
+        elif isinstance(imglist, (list, tuple)):
+            for entry in imglist:
+                label, path = entry[0], entry[-1]
+                label = _onp.asarray(
+                    label if isinstance(label, (list, tuple))
+                    else [label], _onp.float32)
+                self._items.append((path, label))
+        else:
+            raise ValueError("imglist must be a .lst path or a list of "
+                             "[label, path]")
+        if not self._items:
+            raise ValueError("empty image list")
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, idx):
+        from ....image import imread
+
+        path, label = self._items[idx]
+        img = imread(os.path.join(self._root, path), flag=self._flag)
+        label = label if len(label) > 1 else _onp.float32(label[0])
+        return img, label

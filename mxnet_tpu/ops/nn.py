@@ -30,8 +30,10 @@ IntOrTuple = Union[int, Tuple[int, ...]]
 
 
 def _tuple(v: IntOrTuple, n: int) -> Tuple[int, ...]:
-    if isinstance(v, int):
-        return (v,) * n
+    import numbers
+
+    if isinstance(v, numbers.Integral):  # incl. numpy integer scalars
+        return (int(v),) * n
     t = tuple(v)
     if len(t) == 1:
         return t * n

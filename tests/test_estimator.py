@@ -1,0 +1,328 @@
+"""gluon.contrib.estimator fit-loop tests (ref tests/python/unittest/
+test_gluon_estimator.py, test_gluon_event_handler.py,
+test_gluon_batch_processor.py scenarios, on the TPU-first single-batch
+estimator)."""
+import logging
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib.estimator import (BatchProcessor,
+                                               CheckpointHandler,
+                                               EarlyStoppingHandler,
+                                               Estimator,
+                                               GradientUpdateHandler,
+                                               LoggingHandler, MetricHandler,
+                                               StoppingHandler,
+                                               ValidationHandler)
+from mxnet_tpu.gluon.contrib.estimator.event_handler import (BatchEnd,
+                                                             EpochEnd,
+                                                             TrainBegin,
+                                                             TrainEnd)
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+from mxnet_tpu.gluon.loss import L2Loss, SoftmaxCrossEntropyLoss
+from mxnet_tpu.gluon.metric import Accuracy
+
+_RS = onp.random.RandomState(0)
+
+
+def _net(units=4):
+    net = nn.Dense(units)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _loader(n=16, dim=3, classes=4, batch=8, seed=0):
+    rs = onp.random.RandomState(seed)
+    x = rs.rand(n, dim).astype("float32")
+    y = rs.randint(0, classes, size=(n,)).astype("int32")
+    return DataLoader(ArrayDataset(x, y), batch_size=batch)
+
+
+def _estimator(net=None, loss=None, trainer_lr=0.05):
+    net = net or _net()
+    loss = loss or SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": trainer_lr})
+    est = Estimator(net=net, loss=loss, trainer=trainer)
+    est.logger.handlers = []          # keep pytest output clean
+    return est
+
+
+def test_fit_by_epochs_trains_and_updates_metrics():
+    est = _estimator()
+    est.fit(train_data=_loader(), epochs=3)
+    names = [m.name for m in est.train_metrics]
+    assert any("training accuracy" in n for n in names)
+    assert any("softmaxcrossentropyloss" in n.lower() for n in names)
+    for m in est.train_metrics:
+        assert not onp.isnan(m.get()[1]), m.name
+
+
+def test_fit_actually_learns():
+    # linearly separable 2-class problem: accuracy must beat chance
+    rs = onp.random.RandomState(3)
+    x = rs.rand(64, 2).astype("float32")
+    y = (x[:, 0] > x[:, 1]).astype("int32")
+    loader = DataLoader(ArrayDataset(x, y), batch_size=16)
+    est = _estimator(net=_net(2), trainer_lr=0.5)
+    est.fit(train_data=loader, epochs=20)
+    acc = [m for m in est.train_metrics if "accuracy" in m.name][0]
+    assert acc.get()[1] > 0.8
+
+
+def test_fit_by_batches_stops_mid_epoch():
+    est = _estimator()
+
+    class Counter(BatchEnd):
+        n = 0
+
+        def batch_end(self, estimator, *args, **kwargs):
+            self.n += 1
+
+    counter = Counter()
+    est.fit(train_data=_loader(n=80, batch=8), batches=3,
+            event_handlers=[counter])
+    assert counter.n == 3
+
+
+def test_fit_requires_exactly_one_iteration_kind():
+    est = _estimator()
+    with pytest.raises(ValueError):
+        est.fit(train_data=_loader(), epochs=2, batches=2)
+    with pytest.raises(ValueError):
+        est.fit(train_data=_loader())
+    with pytest.raises(ValueError):
+        est.fit(train_data=[1, 2, 3], epochs=1)  # not a DataLoader
+
+
+def test_constructor_validation():
+    net = _net()
+    with pytest.raises(ValueError):
+        Estimator(net=net, loss="not a loss")
+    with pytest.raises(ValueError):
+        Estimator(net=net, loss=L2Loss(), trainer="not a trainer")
+    with pytest.warns(UserWarning):  # default trainer warning
+        est = Estimator(net=net, loss=L2Loss())
+    assert est.trainer is not None
+    with pytest.raises(ValueError):
+        Estimator(net=net, loss=L2Loss(), train_metrics="accuracy")
+
+
+def test_evaluate_updates_val_metrics():
+    est = _estimator()
+    est.evaluate(val_data=_loader(seed=5))
+    for m in est.val_metrics:
+        assert not onp.isnan(m.get()[1]), m.name
+        assert m.name.startswith("validation")
+
+
+def test_custom_batch_processor_is_used():
+    calls = []
+
+    class Recording(BatchProcessor):
+        def fit_batch(self, estimator, batch, batch_axis=0):
+            calls.append("fit")
+            return super().fit_batch(estimator, batch, batch_axis)
+
+        def evaluate_batch(self, estimator, batch, batch_axis=0):
+            calls.append("eval")
+            return super().evaluate_batch(estimator, batch, batch_axis)
+
+    net = _net()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.05})
+    est = Estimator(net=net, loss=SoftmaxCrossEntropyLoss(),
+                    trainer=trainer, batch_processor=Recording())
+    est.logger.handlers = []
+    est.fit(train_data=_loader(), val_data=_loader(seed=2), epochs=1)
+    assert "fit" in calls and "eval" in calls
+
+    with pytest.raises(ValueError):
+        Estimator(net=net, loss=SoftmaxCrossEntropyLoss(),
+                  batch_processor=object())
+
+
+def test_handler_priority_ordering():
+    est = _estimator()
+    order = []
+
+    class Probe(BatchEnd):
+        def __init__(self, tag, priority):
+            self.tag = tag
+            self.priority = priority
+
+        def batch_end(self, estimator, *args, **kwargs):
+            order.append(self.tag)
+
+    handlers = est._default_handlers(
+        None, [Probe("late", 10), Probe("early", -3000)])
+    kinds = [getattr(h, "priority", 0) for h in handlers]
+    assert kinds == sorted(kinds)
+    est.fit(train_data=_loader(n=8, batch=8), epochs=1,
+            event_handlers=[Probe("late", 10), Probe("early", -3000)])
+    assert order[0] == "early" and order[-1] == "late"
+
+
+def test_foreign_metric_rejected_when_mixing_handlers():
+    est = _estimator()
+    foreign = MetricHandler(metrics=[Accuracy()])  # not estimator-owned
+    with pytest.raises(ValueError):
+        est.fit(train_data=_loader(), epochs=1, event_handlers=[foreign])
+
+
+def test_validation_handler_batch_period():
+    est = _estimator()
+    runs = []
+    orig = est.evaluate
+
+    def spy(**kwargs):
+        runs.append(1)
+        return orig(**kwargs)
+
+    handler = ValidationHandler(val_data=_loader(seed=7), eval_fn=spy,
+                                epoch_period=None, batch_period=2)
+    est.fit(train_data=_loader(n=32, batch=8), epochs=1,
+            event_handlers=[handler])
+    assert len(runs) == 2  # 4 batches / period 2
+
+
+def test_logging_handler_messages():
+    est = _estimator()
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    est.logger.addHandler(Capture())
+    est.fit(train_data=_loader(), epochs=1,
+            event_handlers=[LoggingHandler(metrics=est.train_metrics)])
+    text = "\n".join(records)
+    assert "Training begin" in text
+    assert "Train for 1 epochs." in text
+    assert "[Epoch 0] Begin" in text
+    assert "Train finished" in text
+
+
+def test_early_stopping_unreachable_baseline():
+    est = _estimator()
+    acc = [m for m in est.train_metrics if "accuracy" in m.name][0]
+    stopper = EarlyStoppingHandler(monitor=acc, baseline=1.1, patience=2)
+    est.fit(train_data=_loader(), epochs=50, event_handlers=[stopper])
+    assert stopper.stop_training
+    assert stopper.current_epoch == 2  # wait hits patience=2 on epoch 1
+
+
+def test_early_stopping_mode_auto_resolves_by_name():
+    est = _estimator()
+    acc = [m for m in est.train_metrics if "accuracy" in m.name][0]
+    greater = EarlyStoppingHandler(monitor=acc, mode="auto")
+    assert greater.monitor_op(2, 1) and not greater.monitor_op(1, 2)
+    lossm = [m for m in est.train_metrics if "loss" in m.name.lower()][0]
+    less = EarlyStoppingHandler(monitor=lossm, mode="auto")
+    assert less.monitor_op(1, 2) and not less.monitor_op(2, 1)
+
+
+def test_checkpoint_save_rotate_and_best(tmp_path):
+    est = _estimator()
+    lossm = [m for m in est.train_metrics if "loss" in m.name.lower()][0]
+    ckpt = CheckpointHandler(model_dir=str(tmp_path), monitor=lossm,
+                             save_best=True, max_checkpoints=2)
+    est.fit(train_data=_loader(), epochs=5, event_handlers=[ckpt])
+    files = sorted(os.listdir(tmp_path))
+    params = [f for f in files if f.endswith(".params")
+              and "best" not in f]
+    assert len(params) == 2, files                  # rotation kept last 2
+    assert "model-best.params" in files             # loss improves
+    assert "model-epoch4batch0.params" in params[-1] or \
+        any("epoch4" in f for f in params)
+    states = [f for f in files if f.endswith(".states")]
+    assert len(states) >= 2
+
+
+def test_checkpoint_resume(tmp_path):
+    net = _net()
+    est = _estimator(net=net)
+    ckpt = CheckpointHandler(model_dir=str(tmp_path))
+    est.fit(train_data=_loader(), epochs=2, event_handlers=[ckpt])
+    # fresh estimator resumes: trains only the remaining 2 of 4 epochs
+    est2 = _estimator(net=_net())
+    resume = CheckpointHandler(model_dir=str(tmp_path),
+                               resume_from_checkpoint=True)
+
+    class EpochCount(EpochEnd):
+        n = 0
+
+        def epoch_end(self, estimator, *args, **kwargs):
+            self.n += 1
+
+    counter = EpochCount()
+    est2.fit(train_data=_loader(), epochs=4,
+             event_handlers=[resume, counter])
+    assert counter.n == 2
+    # checkpoint numbering continues from the resumed epoch
+    assert any("epoch3" in f for f in os.listdir(tmp_path))
+
+
+def test_checkpoint_resume_at_max_raises(tmp_path):
+    est = _estimator()
+    est.fit(train_data=_loader(), epochs=2, event_handlers=[
+        CheckpointHandler(model_dir=str(tmp_path))])
+    est2 = _estimator()
+    with pytest.raises(ValueError):
+        est2.fit(train_data=_loader(), epochs=2, event_handlers=[
+            CheckpointHandler(model_dir=str(tmp_path),
+                              resume_from_checkpoint=True)])
+
+
+def test_gradient_update_handler_updates_params():
+    net = _net()
+    net(mx.np.zeros((1, 3)))          # materialize deferred shapes
+    est = _estimator(net=net)
+    before = net.weight.data().asnumpy().copy()
+    est.fit(train_data=_loader(), epochs=1)
+    after = net.weight.data().asnumpy()
+    assert not onp.allclose(before, after)
+
+
+def test_custom_gradient_handler_replaces_default():
+    """A user GradientUpdateHandler suppresses the default one — with a
+    no-op updater, parameters must stay frozen."""
+
+    class Frozen(GradientUpdateHandler):
+        def batch_end(self, estimator, *args, **kwargs):
+            pass
+
+    net = _net()
+    net(mx.np.zeros((1, 3)))          # materialize deferred shapes
+    est = _estimator(net=net)
+    before = net.weight.data().asnumpy().copy()
+    est.fit(train_data=_loader(), epochs=1, event_handlers=[Frozen()])
+    onp.testing.assert_allclose(before, net.weight.data().asnumpy())
+
+
+def test_train_begin_end_hooks_fire():
+    est = _estimator()
+    seen = []
+
+    class Hook(TrainBegin, TrainEnd):
+        def train_begin(self, estimator, *args, **kwargs):
+            seen.append("begin")
+
+        def train_end(self, estimator, *args, **kwargs):
+            seen.append("end")
+
+    est.fit(train_data=_loader(), epochs=1, event_handlers=[Hook()])
+    assert seen == ["begin", "end"]
+
+
+def test_stopping_handler_counts():
+    est = _estimator()
+    est.fit(train_data=_loader(n=16, batch=8), epochs=2)
+    stop = StoppingHandler()
+    stop.train_begin(est)
+    assert stop.max_epoch == 2 and stop.current_epoch == 0
