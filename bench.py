@@ -474,6 +474,128 @@ def _child(name):
 
 
 # ---------------------------------------------------------------------------
+# inference ("scoring") mode — the reference's headline tables are mostly
+# inference (BASELINE.md perf.md:72-211, measured by
+# example/image-classification/benchmark_score.py).  `bench.py --infer`
+# sweeps the published configs; each row reports img/s and vs_baseline
+# against the best published V100 number for that model+batch (fp16 rows
+# compared against our bf16, fp32 rows against fp32-dominant models where
+# the reference never published fp16).
+# ---------------------------------------------------------------------------
+
+# name -> (zoo model, batch, image, V100 baseline img/s, baseline precision)
+_INFER_CONFIGS = {
+    "resnet50_b32": ("resnet50_v1", 32, 224, 2085.51, "fp16"),
+    "resnet50_b128": ("resnet50_v1", 128, 224, 2355.04, "fp16"),
+    "resnet152_b32": ("resnet152_v1", 32, 224, 887.34, "fp16"),
+    "inceptionv3_b32": ("inceptionv3", 32, 299, 1512.08, "fp16"),
+    "vgg16_b32": ("vgg16", 32, 224, 708.43, "fp32"),
+    "alexnet_b32": ("alexnet", 32, 224, 7906.09, "fp32"),
+}
+
+
+def _infer_child(name):
+    """One scoring config: forward-only jit over the param pytree."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.trainer import _functional_apply
+
+    model, batch, image, baseline, base_prec = _INFER_CONFIGS[name]
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        # inception's tail pooling is sized for exactly 299^2 inputs
+        batch, image = (1, 299) if model == "inceptionv3" else (2, 64)
+
+    mx.random.seed(0)
+    layout = "NHWC" if (on_tpu and model.startswith("resnet")) else "NCHW"
+    kwargs = {"layout": layout} if model.startswith("resnet") else {}
+    net = mx.gluon.model_zoo.get_model(model, **kwargs)
+    net.initialize(mx.init.Xavier())
+    shape = ((2, image, image, 3) if layout == "NHWC"
+             else (2, 3, image, image))
+    net(mx.np.zeros(shape))
+
+    names = sorted(n for n, p in net.collect_params().items()
+                   if p._data is not None)
+    fn, _arrs, _holder = _functional_apply(net, names, training=False)
+    params = net.collect_params()
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    pvals = [params[n].data()._data.astype(dt)
+             if jnp.issubdtype(params[n].data()._data.dtype,
+                               jnp.floating)
+             else params[n].data()._data for n in names]
+
+    @jax.jit
+    def score(pvals, x):
+        outs, _mut = fn(pvals, x)
+        # scoring reads one scalar per batch to force materialization
+        return jnp.sum(outs[0].astype(jnp.float32))
+
+    rs = onp.random.RandomState(0)
+    xshape = ((batch, image, image, 3) if layout == "NHWC"
+              else (batch, 3, image, image))
+    x = jnp.asarray(rs.rand(*xshape).astype(onp.float32)).astype(dt)
+    float(score(pvals, x))                      # compile
+    n_steps = 50 if on_tpu else 3
+    t0 = time.perf_counter()
+    acc = None
+    for _ in range(n_steps):
+        acc = score(pvals, x)
+    float(acc)                                  # D2H read drains pipeline
+    dtime = time.perf_counter() - t0
+    ips = batch * n_steps / dtime
+    print(json.dumps({
+        "metric": f"infer_{name}_imgs_per_sec", "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / baseline, 4) if on_tpu else None,
+        "baseline_precision": base_prec, "batch": batch,
+        "platform": "tpu" if on_tpu else "cpu"}))
+
+
+def _infer_sweep():
+    """Parent: probe, then run each scoring config in a subprocess.
+
+    Per-child cap 1100s keeps the 6-config worst case (~6600s) inside
+    the sprint's 7200s stage budget, and every row is printed AND
+    flushed to bench_partial.jsonl the moment it lands so a stage
+    timeout loses only the in-flight config.
+    """
+    platform, err = _probe_backend()
+    env = dict(os.environ) if platform == "tpu" else _cpu_env()
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".jax_cache"))
+    partial = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_partial.jsonl")
+    rows = []
+    for name in _INFER_CONFIGS:
+        if platform is None:
+            row = {"metric": f"infer_{name}_imgs_per_sec",
+                   "value": None, "skipped": True,
+                   "error": f"TPU backend unavailable: {err}"}
+        else:
+            row = _run_child(["--infer-child", name], env, 1100,
+                             f"infer_{name}_imgs_per_sec")
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        try:
+            with open(partial, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        except OSError:
+            pass
+    head = rows[0] if rows else {}
+    out = {"metric": "inference_sweep",
+           "value": head.get("value"), "unit": "images/sec",
+           "vs_baseline": head.get("vs_baseline"),
+           "platform": platform, "rows": rows}
+    print(json.dumps(out))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # multichip scaling mode (BASELINE target: 8->64-chip scaling efficiency).
 # `bench.py --multichip n` measures the ResNet + BERT SPMD step on a 1-device
 # and an n-device dp mesh and reports per-device throughput + scaling
@@ -617,6 +739,10 @@ def _multichip(n):
 def main():
     if len(sys.argv) == 3 and sys.argv[1] == "--config":
         return _child(sys.argv[2])
+    if len(sys.argv) == 2 and sys.argv[1] == "--infer":
+        return _infer_sweep()
+    if len(sys.argv) == 3 and sys.argv[1] == "--infer-child":
+        return _infer_child(sys.argv[2])
     if len(sys.argv) == 3 and sys.argv[1] == "--multichip":
         return _multichip(int(sys.argv[2]))
     if len(sys.argv) == 3 and sys.argv[1] == "--multichip-child":

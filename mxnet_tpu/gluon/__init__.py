@@ -10,3 +10,18 @@ from . import data
 from . import model_zoo
 from . import probability
 from .utils import split_and_load, clip_global_norm, split_data
+
+
+def __getattr__(name):
+    # contrib pulls in image/dataloader machinery; lazy (PEP 562) so the
+    # root package import stays cycle-free and cheap (ref gluon exposes
+    # mxnet.gluon.contrib as an on-demand subpackage).  importlib, not
+    # `from . import`: the latter re-enters this __getattr__ through
+    # _handle_fromlist and recurses.
+    if name == "contrib":
+        import importlib
+
+        mod = importlib.import_module(".contrib", __name__)
+        globals()["contrib"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

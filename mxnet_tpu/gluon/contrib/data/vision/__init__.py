@@ -200,6 +200,9 @@ class ImageBboxDataLoader:
             num_workers=num_workers)
 
     def _pad_batchify(self, samples):
+        # numpy in, numpy out: this runs inside forked pool workers where
+        # touching jax is forbidden (dataloader.py worker contract); the
+        # parent-side _to_device wraps the arrays after the pool
         imgs = onp.stack([onp.asarray(s[0]) for s in samples])
         labels = onp.full((len(samples), self._max_objects, 5), -1.0,
                           onp.float32)
@@ -207,9 +210,7 @@ class ImageBboxDataLoader:
             lab = onp.asarray(s[1], onp.float32).reshape(-1, 5)
             n = min(len(lab), self._max_objects)
             labels[i, :n] = lab[:n]
-        from mxnet_tpu import np as _np
-
-        return _np.array(imgs), _np.array(labels)
+        return imgs, labels
 
     def __iter__(self):
         return iter(self._iter)

@@ -292,32 +292,15 @@ class ImageListDataset(Dataset):
     ``[label, path]`` entries, rooted at ``root``."""
 
     def __init__(self, root: str = ".", imglist=None, flag: int = 1):
+        from ....image.image import parse_imglist
+
         self._root = os.path.expanduser(root)
         self._flag = flag
-        self._items = []
-        if isinstance(imglist, str):
-            with open(imglist) as f:
-                for line in f:
-                    if not line.strip():
-                        continue
-                    parts = line.strip().split("\t")
-                    if len(parts) < 3:
-                        raise ValueError(
-                            f"malformed .lst line: {line!r} (want "
-                            "index<TAB>label...<TAB>path)")
-                    label = _onp.asarray([float(v) for v in parts[1:-1]],
-                                         _onp.float32)
-                    self._items.append((parts[-1], label))
-        elif isinstance(imglist, (list, tuple)):
-            for entry in imglist:
-                label, path = entry[0], entry[-1]
-                label = _onp.asarray(
-                    label if isinstance(label, (list, tuple))
-                    else [label], _onp.float32)
-                self._items.append((path, label))
-        else:
-            raise ValueError("imglist must be a .lst path or a list of "
-                             "[label, path]")
+        parsed = parse_imglist(
+            path_imglist=imglist if isinstance(imglist, str) else None,
+            imglist=imglist if not isinstance(imglist, str) else None)
+        self._items = [(path, _onp.atleast_1d(label))
+                       for _key, label, path in parsed]
         if not self._items:
             raise ValueError("empty image list")
 

@@ -713,6 +713,37 @@ def _imagenet_stats(v, default):
     return v
 
 
+def parse_imglist(path_imglist=None, imglist=None, dtype="float32"):
+    """``[(key, label ndarray, relpath)]`` from a tab-separated .lst file
+    (index, label(s), path — the tools/im2rec.py format) or an in-memory
+    ``[label(s), path]`` list; single parser shared by ImageIter and
+    gluon.data ImageListDataset.  Blank lines skip; malformed rows raise.
+    """
+    out = []
+    if path_imglist:
+        with open(path_imglist) as fin:
+            for line in fin:
+                if not line.strip():
+                    continue
+                cols = line.strip().split("\t")
+                if len(cols) < 3:
+                    raise ValueError(
+                        f"malformed .lst line: {line!r} (want "
+                        "index<TAB>label...<TAB>path)")
+                out.append((int(cols[0]),
+                            np.array(cols[1:-1], dtype=dtype), cols[-1]))
+    elif isinstance(imglist, (list, tuple)):
+        for index, item in enumerate(imglist, 1):
+            raw = (item[:-1] if len(item) > 2
+                   else [item[0]] if isinstance(item[0], numbers.Number)
+                   else item[0])
+            out.append((index, np.array(raw, dtype=dtype), item[-1]))
+    else:
+        raise ValueError("need path_imglist or an imglist of "
+                         "[label, path] entries")
+    return out
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
@@ -792,21 +823,16 @@ class ImageIter:
         entries, order = {}, []
         if path_imglist:
             logging.info("ImageIter: loading image list %s...", path_imglist)
-            with open(path_imglist) as fin:
-                for line in fin:
-                    cols = line.strip().split("\t")
-                    key = int(cols[0])
-                    entries[key] = (np.array(cols[1:-1], dtype=dtype),
-                                    cols[-1])
-                    order.append(key)
+            for key, label, path in parse_imglist(path_imglist=path_imglist,
+                                                  dtype=dtype):
+                entries[key] = (label, path)
+                order.append(key)
             self.imglist = entries
         elif isinstance(imglist, list):
-            for index, item in enumerate(imglist, 1):
-                raw = (item[:-1] if len(item) > 2
-                       else [item[0]] if isinstance(item[0], numbers.Number)
-                       else item[0])
-                entries[str(index)] = (np.array(raw, dtype=dtype), item[-1])
-                order.append(str(index))
+            for key, label, path in parse_imglist(imglist=imglist,
+                                                  dtype=dtype):
+                entries[str(key)] = (label, path)
+                order.append(str(key))
             self.imglist = entries
         else:
             self.imglist = None

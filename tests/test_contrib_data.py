@@ -240,13 +240,15 @@ def test_image_dataloader(image_tree):
     assert y.shape[0] == 3
 
 
-def test_image_bbox_dataloader(image_tree):
+@pytest.mark.parametrize("workers", [0, 2])
+def test_image_bbox_dataloader(image_tree, workers):
     # one normalized box per image: [cls, xmin, ymin, xmax, ymax]
     lst = [[[float(i % 2), 0.1, 0.2, 0.6, 0.7], f"cat/cat{i}.jpg"]
            for i in range(3)]
     loader = ImageBboxDataLoader(batch_size=3, data_shape=(3, 16, 16),
                                  path_root=str(image_tree), imglist=lst,
-                                 max_objects=4, rand_mirror=True)
+                                 max_objects=4, rand_mirror=True,
+                                 num_workers=workers)
     x, y = next(iter(loader))
     assert tuple(x.shape) == (3, 3, 16, 16)
     assert tuple(y.shape) == (3, 4, 5)        # padded to max_objects

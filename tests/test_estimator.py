@@ -244,6 +244,24 @@ def test_checkpoint_save_rotate_and_best(tmp_path):
     assert len(states) >= 2
 
 
+def test_checkpoint_rotation_dot_anchored(tmp_path):
+    """Rotating out epoch0batch2 must NOT delete epoch0batch20 (prefix
+    collision; review finding round 4)."""
+    est = _estimator()
+    ckpt = CheckpointHandler(model_dir=str(tmp_path), epoch_period=None,
+                             batch_period=2, max_checkpoints=10)
+    # 30-batch loader, stop at 24: all saves inside epoch 0, so the
+    # rotated-out 'epoch0batch1' prefix collides with epoch0batch11..19
+    est.fit(train_data=_loader(n=240, batch=8), batches=24,
+            event_handlers=[ckpt])          # saves at batch 1,3,...,23
+    files = set(os.listdir(tmp_path))
+    assert "model-epoch0batch1.params" not in files      # rotated out
+    assert not any(f.startswith("model-epoch0batch3.") for f in files)
+    assert "model-epoch0batch11.params" in files         # NOT collateral
+    assert "model-epoch0batch13.params" in files
+    assert "model-epoch0batch23.params" in files
+
+
 def test_checkpoint_resume(tmp_path):
     net = _net()
     est = _estimator(net=net)

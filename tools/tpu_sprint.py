@@ -8,8 +8,9 @@ mid-round twice, so the most valuable numbers come first:
   2. ResNet-50 b256                 (PERF.md lever 1)
   3. ResNet-50 s2d stem             (PERF.md lever 2)
   4. ResNet-50 b256 + s2d           (levers combined)
-  5. per-conv utilization table     (tools/convbench.py)
-  6. BERT LAMB compile/step costs   (tools/bert_compile_bench.py)
+  5. inference scoring sweep        (bench.py --infer; perf.md:72-211)
+  6. per-conv utilization table     (tools/convbench.py)
+  7. BERT LAMB compile/step costs   (tools/bert_compile_bench.py)
 
 Each stage runs in its own subprocess with a hard timeout and its result
 is flushed to sprint_results/ immediately, so a mid-sprint wedge keeps
@@ -81,6 +82,7 @@ def main():
     e = dict(env, MXNET_BENCH_BATCH="256", MXNET_BENCH_STEM="s2d")
     run("resnet_b256_s2d", [py, "bench.py", "--config", "resnet50"],
         timeout=2400, env=e)
+    run("infer_sweep", [py, "bench.py", "--infer"], timeout=7200)
     run("convbench", [py, "tools/convbench.py", "--json",
                       os.path.join(OUT, "convbench_table.json")],
         timeout=3600)
