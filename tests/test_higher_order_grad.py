@@ -1,0 +1,205 @@
+"""Higher-order autograd gradients (ref tests/python/unittest/
+test_higher_order_grad.py strategy): for each unary op, chain
+``autograd.grad(..., create_graph=True)`` n times with random cotangents
+and compare against the analytic n-th derivative times the product of
+cotangents.  The tape's vjp-of-vjp path (autograd/__init__.py
+create_graph) is the code under test.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+np_ = mx.np
+npx = mx.npx
+
+_RS = onp.random.RandomState(19)
+
+
+def _nth_order_check(x, fn, grad_fns, orders, rtol=1e-4, atol=1e-5):
+    """Chain grad() to max(orders); each listed order's result must equal
+    the analytic derivative scaled by all cotangents applied so far."""
+    if isinstance(orders, int):
+        orders, grad_fns = [orders], [grad_fns]
+    assert orders == sorted(set(orders))
+    xa = np_.array(x)
+    autograd.mark_variables([xa], [np_.zeros_like(xa)])
+    expected = [g(x) for g in grad_fns]
+    computed = []
+    heads = []
+    with autograd.record():
+        y = fn(xa)
+        for order in range(1, max(orders) + 1):
+            h = _RS.rand(*x.shape).astype("float32") + 0.2
+            y = autograd.grad([y], [xa], head_grads=[np_.array(h)],
+                              create_graph=True, retain_graph=True)[0]
+            heads.append(h)
+            if order in orders:
+                computed.append((order, y.asnumpy()))
+    for (order, got), want in zip(computed, expected):
+        scale = onp.ones_like(want)
+        for h in heads[:order]:
+            scale = scale * h
+        onp.testing.assert_allclose(got, want * scale, rtol=rtol,
+                                    atol=atol, err_msg=f"order {order}")
+
+
+def _x(lo, hi, shape=(3, 4)):
+    return (lo + (hi - lo) * _RS.rand(*shape)).astype("float32")
+
+
+# op name -> (framework fn, analytic f'', input domain)
+SECOND_ORDER = {
+    "sin": (lambda x: np_.sin(x), lambda x: -onp.sin(x), (-2, 2)),
+    "cos": (lambda x: np_.cos(x), lambda x: -onp.cos(x), (-2, 2)),
+    "tan": (lambda x: np_.tan(x),
+            lambda x: 2 * onp.tan(x) / onp.cos(x) ** 2, (-1, 1)),
+    "sinh": (lambda x: np_.sinh(x), lambda x: onp.sinh(x), (-1.5, 1.5)),
+    "cosh": (lambda x: np_.cosh(x), lambda x: onp.cosh(x), (-1.5, 1.5)),
+    "tanh": (lambda x: np_.tanh(x),
+             lambda x: -2 * onp.tanh(x) / onp.cosh(x) ** 2, (-1.5, 1.5)),
+    "arcsin": (lambda x: np_.arcsin(x),
+               lambda x: x / (1 - x ** 2) ** 1.5, (-0.8, 0.8)),
+    "arccos": (lambda x: np_.arccos(x),
+               lambda x: -x / (1 - x ** 2) ** 1.5, (-0.8, 0.8)),
+    "arctan": (lambda x: np_.arctan(x),
+               lambda x: -2 * x / (1 + x ** 2) ** 2, (-2, 2)),
+    "arcsinh": (lambda x: np_.arcsinh(x),
+                lambda x: -x / (x ** 2 + 1) ** 1.5, (-2, 2)),
+    "arccosh": (lambda x: np_.arccosh(x),
+                lambda x: -x / (x ** 2 - 1) ** 1.5, (1.3, 3)),
+    "arctanh": (lambda x: np_.arctanh(x),
+                lambda x: 2 * x / (1 - x ** 2) ** 2, (-0.7, 0.7)),
+    "radians": (lambda x: np_.radians(x),
+                lambda x: onp.zeros_like(x), (-90, 90)),
+    "log": (lambda x: np_.log(x), lambda x: -1 / x ** 2, (0.3, 3)),
+    "log2": (lambda x: np_.log2(x),
+             lambda x: -1 / (x ** 2 * onp.log(2)), (0.3, 3)),
+    "log10": (lambda x: np_.log10(x),
+              lambda x: -1 / (x ** 2 * onp.log(10)), (0.3, 3)),
+    "log1p": (lambda x: np_.log1p(x),
+              lambda x: -1 / (1 + x) ** 2, (-0.5, 2)),
+    "expm1": (lambda x: np_.expm1(x), lambda x: onp.exp(x), (-1.5, 1.5)),
+    "square": (lambda x: np_.square(x),
+               lambda x: onp.full_like(x, 2.0), (-2, 2)),
+    "reciprocal": (lambda x: np_.reciprocal(x),
+                   lambda x: 2 / x ** 3, (0.4, 2)),
+    "sqrt": (lambda x: np_.sqrt(x),
+             lambda x: -0.25 * x ** -1.5, (0.3, 3)),
+    "cbrt": (lambda x: np_.cbrt(x),
+             lambda x: -(2 / 9) * x ** (-5 / 3), (0.3, 3)),
+    "rsqrt": (lambda x: 1 / np_.sqrt(x),
+              lambda x: 0.75 * x ** -2.5, (0.4, 3)),
+    "rcbrt": (lambda x: 1 / np_.cbrt(x),
+              lambda x: (4 / 9) * x ** (-7 / 3), (0.4, 3)),
+    "sigmoid": (lambda x: npx.sigmoid(x),
+                lambda x: (lambda s: s * (1 - s) * (1 - 2 * s))(
+                    1 / (1 + onp.exp(-x))), (-2, 2)),
+    "power3": (lambda x: x ** 3, lambda x: 6 * x, (-2, 2)),
+    "exp": (lambda x: np_.exp(x), lambda x: onp.exp(x), (-1.5, 1.5)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SECOND_ORDER))
+def test_second_order(name):
+    fn, d2, (lo, hi) = SECOND_ORDER[name]
+    _nth_order_check(_x(lo, hi), fn, d2, 2, rtol=2e-3, atol=2e-4)
+
+
+# piecewise-linear ops: f'' == 0 away from kinks
+@pytest.mark.parametrize("name,fn,lo,hi", [
+    ("relu", lambda x: npx.relu(x), 0.2, 2.0),         # strictly positive
+    ("relu_neg", lambda x: npx.relu(x), -2.0, -0.2),   # strictly negative
+    ("abs", lambda x: np_.abs(x), 0.2, 2.0),
+    ("clip_inside", lambda x: np_.clip(x, -5, 5), -2.0, 2.0),
+    ("clip_outside", lambda x: np_.clip(x, -0.1, 0.1), 0.3, 2.0),
+])
+def test_second_order_piecewise_zero(name, fn, lo, hi):
+    _nth_order_check(_x(lo, hi), fn, lambda x: onp.zeros_like(x), 2)
+
+
+def test_third_order_sin_and_log():
+    _nth_order_check(
+        _x(-2, 2), lambda x: np_.sin(x),
+        [lambda x: onp.cos(x), lambda x: -onp.sin(x),
+         lambda x: -onp.cos(x)], [1, 2, 3], rtol=3e-3, atol=3e-4)
+    _nth_order_check(
+        _x(0.4, 3), lambda x: np_.log(x),
+        [lambda x: 1 / x, lambda x: -1 / x ** 2, lambda x: 2 / x ** 3],
+        [1, 2, 3], rtol=3e-3, atol=3e-4)
+
+
+def test_third_order_sigmoid():
+    def d1(x):
+        s = 1 / (1 + onp.exp(-x))
+        return s * (1 - s)
+
+    def d2(x):
+        s = 1 / (1 + onp.exp(-x))
+        return s * (1 - s) * (1 - 2 * s)
+
+    def d3(x):
+        s = 1 / (1 + onp.exp(-x))
+        return s * (1 - s) * (1 - 6 * s + 6 * s ** 2)
+
+    _nth_order_check(_x(-2, 2), lambda x: npx.sigmoid(x),
+                     [d1, d2, d3], [1, 2, 3], rtol=3e-3, atol=3e-4)
+
+
+def test_dense_second_order_wrt_input():
+    """Dense (flatten and non-flatten): grad-of-grad of (dense(x)^2).sum()
+    w.r.t. x has the closed form 2 * h @ (W W^T)."""
+    from mxnet_tpu.gluon import nn
+
+    for flatten, shape in ((True, (5, 3)), (False, (2, 5, 3))):
+        net = nn.Dense(4, flatten=flatten)
+        net.initialize(mx.init.Xavier())
+        x = _RS.rand(*shape).astype("float32")
+        net(np_.array(x))
+        w = net.weight.data().asnumpy()        # (4, 3)
+        xa = np_.array(x)
+        autograd.mark_variables([xa], [np_.zeros_like(xa)])
+        h = _RS.rand(*shape).astype("float32")
+        with autograd.record():
+            y = (net(xa) ** 2).sum()
+            g = autograd.grad([y], [xa], create_graph=True,
+                              retain_graph=True)[0]     # 2 x W^T W
+            gg = autograd.grad([g], [xa], head_grads=[np_.array(h)],
+                               create_graph=False, retain_graph=True)[0]
+        want = 2 * h.reshape(-1, 3) @ (w.T @ w)
+        onp.testing.assert_allclose(gg.asnumpy().reshape(-1, 3), want,
+                                    rtol=1e-4, atol=1e-4)
+
+
+def test_grad_grad_matches_finite_difference():
+    """Cross-check the tape's second derivative against FD of the first
+    derivative for a composite expression (no analytic shortcut)."""
+    def f(x):
+        return np_.sin(x) * np_.exp(-x * 0.5) + x ** 2 * 0.3
+
+    def first(xv):
+        xa = np_.array(xv.astype("float32"))
+        autograd.mark_variables([xa], [np_.zeros_like(xa)])
+        with autograd.record():
+            y = f(xa).sum()
+        g = autograd.grad([y], [xa], create_graph=False,
+                          retain_graph=False)[0]
+        return g.asnumpy().astype("float64")
+
+    x = _x(-1, 1, shape=(2, 3)).astype("float64")
+    xa = np_.array(x.astype("float32"))
+    autograd.mark_variables([xa], [np_.zeros_like(xa)])
+    with autograd.record():
+        y = f(xa).sum()
+        g = autograd.grad([y], [xa], create_graph=True,
+                          retain_graph=True)[0]
+        gg = autograd.grad([g.sum()], [xa])[0].asnumpy()
+    eps = 1e-3
+    fd = onp.zeros_like(x)
+    for i in onp.ndindex(*x.shape):
+        xp, xm = x.copy(), x.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        fd[i] = (first(xp).sum() - first(xm).sum()) / (2 * eps)
+    onp.testing.assert_allclose(gg, fd, rtol=2e-2, atol=2e-3)
