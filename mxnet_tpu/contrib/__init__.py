@@ -1,9 +1,11 @@
 """mx.contrib (ref: python/mxnet/contrib/): quantization, ONNX export,
-DGL graph sampling."""
+DGL graph sampling, text embeddings, gluon-loader DataIter bridge."""
 from . import quantization
 from . import onnx
 from . import tensorboard
 from . import dgl
+from . import io
+from . import text
 from .quantization import quantize_net
 from .dgl import (dgl_adjacency, dgl_subgraph, dgl_graph_compact,
                   dgl_csr_neighbor_uniform_sample,

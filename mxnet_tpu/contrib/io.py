@@ -1,0 +1,56 @@
+"""Contrib data iterators (ref python/mxnet/contrib/io.py).
+
+``DataLoaderIter`` adapts a ``gluon.data.DataLoader`` to the legacy
+``DataIter`` interface (provide_data/provide_label/next) so code written
+against ``mx.io`` pipelines can consume gluon datasets unchanged.
+"""
+from __future__ import annotations
+
+from ..io.io import DataBatch, DataDesc, DataIter
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a gluon DataLoader as a DataIter (ref io.py DataLoaderIter)."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        self._dtype = dtype
+        self._data_name = data_name
+        self._label_name = label_name
+        # peek one batch for the descriptors; it is stashed in _first and
+        # served as the first next() so nothing is lost
+        first = next(self._iter)
+        data, label = first[0], first[1]
+        self.batch_size = data.shape[0]
+        self._provide_data = [DataDesc(data_name, tuple(data.shape), dtype)]
+        # the label keeps its own dtype (class indices are usually ints);
+        # the descriptor must describe what next() actually returns
+        self._provide_label = [DataDesc(label_name, tuple(label.shape),
+                                        str(label.dtype))]
+        self._first = first
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def reset(self):
+        self._first = None
+        self._iter = iter(self._loader)
+
+    def next(self):
+        if self._first is not None:
+            batch, self._first = self._first, None
+        else:
+            batch = next(self._iter)
+        data, label = batch[0], batch[1]
+        return DataBatch(data=[data.astype(self._dtype)], label=[label],
+                         pad=0)

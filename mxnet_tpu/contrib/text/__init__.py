@@ -1,0 +1,6 @@
+"""Text utilities (ref python/mxnet/contrib/text/__init__.py)."""
+from . import embedding
+from . import utils
+from . import vocab
+
+__all__ = ["embedding", "utils", "vocab"]

@@ -1,0 +1,292 @@
+"""Pretrained token embeddings (ref python/mxnet/contrib/text/
+embedding.py).
+
+API parity: ``register``/``create``/``get_pretrained_file_names``, the
+``_TokenEmbedding`` base extending ``Vocabulary`` with ``idx_to_vec`` /
+``get_vecs_by_tokens`` / ``update_token_vectors``, the GloVe/FastText
+registries, ``CustomEmbedding`` and ``CompositeEmbedding``.
+
+Offline stance (same as gluon model_store/datasets): this environment has
+no egress, so GloVe/FastText read their files from ``embedding_root``
+(default ``$MXNET_HOME/embedding/<cls>/``) and raise a clear error when
+the file is absent instead of downloading.  ``CustomEmbedding`` loads any
+local word-vector text file.
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+import warnings
+
+import numpy as onp
+
+from ...base import MXNetError, data_dir
+from ...ndarray import NDArray
+from . import vocab as _vocab
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
+           "CompositeEmbedding"]
+
+_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Class decorator adding an embedding to the ``create`` registry."""
+    name = embedding_cls.__name__.lower()
+    _REGISTRY[name] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding by (case-insensitive) name."""
+    key = embedding_name.lower()
+    if key not in _REGISTRY:
+        raise KeyError(
+            f"unknown embedding {embedding_name!r}; registered: "
+            f"{sorted(_REGISTRY)}")
+    return _REGISTRY[key](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names, per embedding or for all."""
+    if embedding_name is not None:
+        key = embedding_name.lower()
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown embedding {embedding_name!r}")
+        return list(_REGISTRY[key].pretrained_file_names)
+    return {name: list(cls.pretrained_file_names)
+            for name, cls in _REGISTRY.items()}
+
+
+class TokenEmbedding(_vocab.Vocabulary):
+    """Vocabulary + a vector per index (``idx_to_vec``); index 0 carries
+    the unknown vector."""
+
+    pretrained_file_names: tuple = ()
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    # -- loading -----------------------------------------------------------
+
+    @classmethod
+    def _pretrained_path(cls, embedding_root, pretrained_file_name):
+        root = os.path.expanduser(embedding_root) if embedding_root else \
+            os.path.join(data_dir(), "embedding", cls.__name__.lower())
+        path = os.path.join(root, pretrained_file_name)
+        if not os.path.exists(path):
+            raise MXNetError(
+                f"pretrained embedding file {path} not found; this "
+                "environment does not download — place the file there "
+                "or use CustomEmbedding with a local path")
+        return path
+
+    def _load_embedding(self, path, elem_delim=" ",
+                        init_unknown_vec=onp.zeros, encoding="utf8"):
+        """Parse 'token v1 .. vN' lines; malformed lines warn and skip;
+        later duplicates of a token are ignored (ref
+        embedding.py:232-306)."""
+        vectors = []
+        loaded_unknown = None
+        with io.open(path, encoding=encoding) as f:
+            for line_num, line in enumerate(f, 1):
+                row = line.rstrip().split(elem_delim)
+                if line_num == 1 and len(row) == 2 and \
+                        all(v.isdigit() for v in row):
+                    continue                # fastText '<count> <dim>' header
+                if len(row) < 2:
+                    warnings.warn(f"line {line_num} of {path} is "
+                                  "malformed; skipped")
+                    continue
+                token, elems = row[0], row[1:]
+                try:
+                    vec = onp.asarray([float(v) for v in elems],
+                                      onp.float32)
+                except ValueError:
+                    warnings.warn(f"line {line_num} of {path} has "
+                                  "non-numeric elements; skipped")
+                    continue
+                if token == self._unknown_token:
+                    # the file supplies the unknown vector for index 0
+                    # (ref embedding.py loaded_unknown_vec)
+                    loaded_unknown = vec
+                    continue
+                if token in self._token_to_idx:
+                    warnings.warn(f"duplicate token {token!r} at line "
+                                  f"{line_num} of {path}; first "
+                                  "occurrence kept")
+                    continue
+                if self._vec_len == 0:
+                    self._vec_len = len(vec)
+                elif len(vec) != self._vec_len:
+                    warnings.warn(f"line {line_num} of {path} has "
+                                  f"{len(vec)} dims, want {self._vec_len};"
+                                  " skipped")
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vectors.append(vec)
+        if not vectors:
+            raise MXNetError(f"no vectors loaded from {path}")
+        table = onp.empty((len(self._idx_to_token), self._vec_len),
+                          onp.float32)
+        n_special = len(self._idx_to_token) - len(vectors)
+        unk = (loaded_unknown if loaded_unknown is not None
+               else onp.asarray(init_unknown_vec(self._vec_len),
+                                onp.float32))
+        table[:n_special] = unk                 # <unk> + reserved
+        table[n_special:] = onp.stack(vectors)
+        self._idx_to_vec = NDArray(table)
+
+    def _build_for_vocabulary(self, vocabulary, source_embeddings):
+        """CompositeEmbedding path: vocabulary's own index order, vectors
+        concatenated across source embeddings (unknowns contribute their
+        unknown vector)."""
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = [e.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+                 for e in source_embeddings]
+        table = onp.concatenate(parts, axis=1)
+        self._vec_len = table.shape[1]
+        self._idx_to_vec = NDArray(table.astype(onp.float32))
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); unknown tokens get the unknown vector;
+        with ``lower_case_backup`` a miss retries the lowercased token."""
+        single = not isinstance(tokens, list)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            idxs = [self._token_to_idx.get(
+                t, self._token_to_idx.get(t.lower(), _vocab.UNKNOWN_IDX))
+                for t in toks]
+        else:
+            idxs = [self._token_to_idx.get(t, _vocab.UNKNOWN_IDX)
+                    for t in toks]
+        table = self._idx_to_vec.asnumpy()
+        out = table[onp.asarray(idxs, onp.int64)]
+        return NDArray(out[0] if single else out)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite rows for known tokens; unknown tokens raise."""
+        if self._idx_to_vec is None:
+            raise MXNetError("embedding has no vectors to update")
+        single = not isinstance(tokens, list)
+        toks = [tokens] if single else tokens
+        vals = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else onp.asarray(new_vectors, onp.float32)
+        vals = vals.reshape(len(toks), -1)
+        idxs = []
+        for t in toks:
+            if t not in self._token_to_idx:
+                raise ValueError(
+                    f"token {t!r} is unknown; only tokens in the "
+                    "embedding vocabulary can be updated")
+            idxs.append(self._token_to_idx[t])
+        table = self._idx_to_vec.asnumpy().copy()
+        table[onp.asarray(idxs, onp.int64)] = vals
+        self._idx_to_vec = NDArray(table)
+
+    @classmethod
+    def _check_pretrained_file_names(cls, pretrained_file_name):
+        if pretrained_file_name not in cls.pretrained_file_names:
+            raise KeyError(
+                f"cannot find pretrained file {pretrained_file_name!r} "
+                f"for {cls.__name__}; choices: "
+                f"{sorted(cls.pretrained_file_names)}")
+
+
+# keep the reference's public alias
+_TokenEmbedding = TokenEmbedding
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe word vectors (ref embedding.py:480-551); files read from
+    ``embedding_root`` (no downloads in this environment)."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=None, init_unknown_vec=onp.zeros,
+                 vocabulary=None, **kwargs):
+        self._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = self._pretrained_path(embedding_root, pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._build_for_vocabulary(vocabulary, [self])
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText word vectors (ref embedding.py:552-634)."""
+
+    pretrained_file_names = (
+        "wiki.en.vec", "wiki.simple.vec", "wiki.zh.vec", "wiki.fr.vec",
+        "wiki.de.vec", "wiki.es.vec", "wiki.ru.vec", "wiki.ar.vec",
+        "wiki.multi.en.vec", "crawl-300d-2M.vec")
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=None, init_unknown_vec=onp.zeros,
+                 vocabulary=None, **kwargs):
+        self._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = self._pretrained_path(embedding_root, pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._build_for_vocabulary(vocabulary, [self])
+
+
+class CustomEmbedding(TokenEmbedding):
+    """Word vectors from any local 'token v1 .. vN' text file
+    (ref embedding.py:635-676)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", init_unknown_vec=onp.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        if not os.path.exists(pretrained_file_path):
+            raise MXNetError(f"{pretrained_file_path} does not exist")
+        logging.info("loading custom embedding from %s",
+                     pretrained_file_path)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        if vocabulary is not None:
+            self._build_for_vocabulary(vocabulary, [self])
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (ref embedding.py:677-717)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(vocabulary, _vocab.Vocabulary):
+            raise TypeError("vocabulary must be a text.vocab.Vocabulary")
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        for e in token_embeddings:
+            if not isinstance(e, TokenEmbedding):
+                raise TypeError("token_embeddings must be TokenEmbedding "
+                                "instances")
+        super().__init__()
+        self._build_for_vocabulary(vocabulary, token_embeddings)

@@ -1,0 +1,23 @@
+"""Token counting helpers (ref python/mxnet/contrib/text/utils.py)."""
+from __future__ import annotations
+
+import collections
+import re
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens in ``source_str`` split by the ``token_delim`` /
+    ``seq_delim`` regular expressions; update and return
+    ``counter_to_update`` when given, else a fresh Counter
+    (ref utils.py:26-83)."""
+    tokens = [t for t in re.split(f"{token_delim}|{seq_delim}", source_str)
+              if t]
+    if to_lower:
+        tokens = [t.lower() for t in tokens]
+    if counter_to_update is None:
+        return collections.Counter(tokens)
+    counter_to_update.update(tokens)
+    return counter_to_update

@@ -1,0 +1,92 @@
+"""Text token indexing (ref python/mxnet/contrib/text/vocab.py).
+
+Index layout contract (ref vocab.py:92-133): the unknown token is ALWAYS
+index 0, reserved tokens follow, then counter keys by descending
+frequency with ties broken alphabetically, subject to ``most_freq_count``
+and ``min_freq``.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Vocabulary"]
+
+UNKNOWN_IDX = 0
+
+
+class Vocabulary:
+    """Token <-> index mapping for text pipelines."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            rset = set(reserved_tokens)
+            if unknown_token in rset:
+                raise ValueError("unknown_token must not appear in "
+                                 "reserved_tokens")
+            if len(rset) != len(reserved_tokens):
+                raise ValueError("reserved_tokens must not contain "
+                                 "duplicates")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens is not None else None)
+        self._idx_to_token = [unknown_token] + (
+            list(reserved_tokens) if reserved_tokens is not None else [])
+        self._token_to_idx = {t: i
+                              for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter(counter, most_freq_count, min_freq)
+
+    def _index_counter(self, counter, most_freq_count, min_freq):
+        if not isinstance(counter, collections.Counter):
+            raise TypeError("counter must be a collections.Counter")
+        special = set(self._token_to_idx)
+        # frequency desc, alphabetical among ties
+        ordered = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        cap = len(special) + (len(counter) if most_freq_count is None
+                              else most_freq_count)
+        for token, freq in ordered:
+            if freq < min_freq or len(self._idx_to_token) == cap:
+                break
+            if token not in special:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index/indices; unknown tokens map to index 0."""
+        single = not isinstance(tokens, list)
+        out = [self._token_to_idx.get(t, UNKNOWN_IDX)
+               for t in ([tokens] if single else tokens)]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        """Index/indices -> token(s); out-of-range indices raise."""
+        single = not isinstance(indices, list)
+        out = []
+        for idx in [indices] if single else indices:
+            if not isinstance(idx, int) or not \
+                    0 <= idx < len(self._idx_to_token):
+                raise ValueError(f"token index {idx} is invalid")
+            out.append(self._idx_to_token[idx])
+        return out[0] if single else out

@@ -1,0 +1,176 @@
+"""contrib.text vocabulary/embedding tests (ref tests/python/unittest/
+test_contrib_text.py scenarios) + the contrib.io DataLoaderIter bridge."""
+import collections
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+from mxnet_tpu.contrib.io import DataLoaderIter
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+Counter = collections.Counter
+
+
+def test_count_tokens_from_str():
+    s = " Life is great ! \n life is good . \n"
+    c = text.utils.count_tokens_from_str(s, " ", "\n", to_lower=True)
+    assert c == Counter({"life": 2, "is": 2, "great": 1, "!": 1,
+                         "good": 1, ".": 1})
+    s2 = "*Life*is*great*!*\n*life*is*good*.*\n"
+    c2 = text.utils.count_tokens_from_str(s2, r"\*", "\n", to_lower=True)
+    assert c2 == c
+    base = Counter({"life": 5})
+    out = text.utils.count_tokens_from_str(s, counter_to_update=base)
+    assert out is base and base["life"] == 6  # case-sensitive: 'life' x1?
+
+
+def test_vocabulary_index_contract():
+    counter = Counter({"b": 3, "a": 3, "c": 2, "rare": 1})
+    v = text.vocab.Vocabulary(counter, min_freq=2,
+                              reserved_tokens=["<pad>"])
+    # unk at 0, reserved next, then freq desc with alphabetic ties
+    assert v.idx_to_token == ["<unk>", "<pad>", "a", "b", "c"]
+    assert v.to_indices("a") == 2
+    assert v.to_indices(["missing", "c"]) == [0, 4]
+    assert v.to_tokens([0, 4]) == ["<unk>", "c"]
+    assert len(v) == 5
+    assert v.unknown_token == "<unk>" and v.reserved_tokens == ["<pad>"]
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+
+
+def test_vocabulary_most_freq_count():
+    counter = Counter({"a": 5, "b": 4, "c": 3, "d": 2})
+    v = text.vocab.Vocabulary(counter, most_freq_count=2)
+    assert v.idx_to_token == ["<unk>", "a", "b"]
+    with pytest.raises(ValueError):
+        text.vocab.Vocabulary(counter, min_freq=0)
+    with pytest.raises(ValueError):
+        text.vocab.Vocabulary(reserved_tokens=["<unk>"])
+
+
+@pytest.fixture()
+def vec_file(tmp_path):
+    p = tmp_path / "vecs.txt"
+    p.write_text("hello 1.0 2.0 3.0\n"
+                 "world 4.0 5.0 6.0\n"
+                 "hello 9.0 9.0 9.0\n"      # duplicate: kept first
+                 "badline only\n"           # malformed: skipped
+                 "deep 7.0 8.0 9.0\n")
+    return str(p)
+
+
+def test_custom_embedding_load_and_query(vec_file):
+    with pytest.warns(UserWarning):
+        emb = text.embedding.CustomEmbedding(vec_file)
+    assert emb.vec_len == 3
+    assert len(emb) == 4                    # <unk> + 3 unique tokens
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+    got = emb.get_vecs_by_tokens(["world", "nope"]).asnumpy()
+    onp.testing.assert_allclose(got[0], [4, 5, 6])
+    onp.testing.assert_allclose(got[1], [0, 0, 0])   # unknown vector
+    # lower_case_backup
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["HELLO"],
+                               lower_case_backup=True).asnumpy()[0],
+        [1, 2, 3])
+
+
+def test_custom_embedding_update(vec_file):
+    with pytest.warns(UserWarning):
+        emb = text.embedding.CustomEmbedding(vec_file)
+    emb.update_token_vectors("deep", mx.np.array([[1.0, 1.0, 1.0]]))
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("deep").asnumpy(), [1, 1, 1])
+    with pytest.raises(ValueError):
+        emb.update_token_vectors("nope", mx.np.array([[1.0, 1.0, 1.0]]))
+
+
+def test_composite_embedding(vec_file, tmp_path):
+    p2 = tmp_path / "vecs2.txt"
+    p2.write_text("hello 10.0 20.0\nmars 30.0 40.0\n")
+    with pytest.warns(UserWarning):
+        e1 = text.embedding.CustomEmbedding(vec_file)
+    e2 = text.embedding.CustomEmbedding(str(p2))
+    vocab = text.vocab.Vocabulary(Counter({"hello": 2, "mars": 1,
+                                           "unseen": 1}))
+    comp = text.embedding.CompositeEmbedding(vocab, [e1, e2])
+    assert comp.vec_len == 5
+    got = comp.get_vecs_by_tokens("hello").asnumpy()
+    onp.testing.assert_allclose(got, [1, 2, 3, 10, 20])
+    got = comp.get_vecs_by_tokens("mars").asnumpy()
+    onp.testing.assert_allclose(got, [0, 0, 0, 30, 40])  # miss in e1
+
+
+def test_registry_and_create(vec_file):
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    assert "glove.6B.50d.txt" in \
+        text.embedding.get_pretrained_file_names("glove")
+    with pytest.raises(KeyError):
+        text.embedding.create("nosuch")
+    with pytest.raises(KeyError):
+        text.embedding.create("glove", pretrained_file_name="bogus.txt")
+    # offline: a valid name but absent file raises the clear error
+    with pytest.raises(mx.MXNetError):
+        text.embedding.create("glove",
+                              pretrained_file_name="glove.6B.50d.txt")
+
+
+def test_fasttext_header_skip(tmp_path):
+    p = tmp_path / "wiki.simple.vec"
+    p.write_text("2 3\nalpha 1 2 3\nbeta 4 5 6\n")
+    emb = text.embedding.create("fasttext",
+                                pretrained_file_name="wiki.simple.vec",
+                                embedding_root=str(tmp_path))
+    assert emb.vec_len == 3 and len(emb) == 3
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("beta").asnumpy(), [4, 5, 6])
+
+
+def test_embedding_file_supplies_unknown_vector(tmp_path):
+    p = tmp_path / "unk.txt"
+    p.write_text("<unk> 9.0 8.0 7.0\nhello 1.0 2.0 3.0\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("never-seen").asnumpy(), [9, 8, 7])
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+
+
+def test_one_dimensional_embedding_loads(tmp_path):
+    p = tmp_path / "one_d.txt"
+    p.write_text("hello 1.5\nworld 2.5\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    assert emb.vec_len == 1
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["hello", "world"]).asnumpy(),
+        [[1.5], [2.5]])
+
+
+def test_dataloader_iter_label_dtype():
+    x = onp.arange(8, dtype="float32").reshape(4, 2)
+    y = onp.arange(4, dtype="int32")
+    it = DataLoaderIter(DataLoader(ArrayDataset(x, y), batch_size=2))
+    assert "int" in it.provide_label[0].dtype
+    batch = it.next()
+    assert "int" in str(batch.label[0].dtype)
+
+
+def test_dataloader_iter_bridge():
+    x = onp.arange(24, dtype="float32").reshape(12, 2)
+    y = onp.arange(12, dtype="float32")
+    loader = DataLoader(ArrayDataset(x, y), batch_size=4)
+    it = DataLoaderIter(loader)
+    assert it.provide_data[0].shape == (4, 2)
+    assert it.provide_label[0].shape == (4,)
+    batches = list(it)
+    assert len(batches) == 3
+    onp.testing.assert_allclose(batches[0].data[0].asnumpy(), x[:4])
+    it.reset()
+    again = list(it)
+    assert len(again) == 3
+    onp.testing.assert_allclose(again[-1].label[0].asnumpy(), y[8:])
