@@ -60,7 +60,11 @@ from . import io
 from . import recordio  # legacy alias: mx.recordio (ref python/mxnet/recordio.py)
 from . import image
 from . import image as img  # legacy alias: mx.img (ref python/mxnet/__init__.py)
+from . import executor
+from . import libinfo
+from . import log
 from . import profiler
+from . import registry
 from . import runtime
 from . import amp
 from . import symbol

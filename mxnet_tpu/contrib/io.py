@@ -52,5 +52,21 @@ class DataLoaderIter(DataIter):
         else:
             batch = next(self._iter)
         data, label = batch[0], batch[1]
+        pad = self.batch_size - data.shape[0]
+        if pad:
+            # legacy DataIter contract: batches keep the advertised
+            # batch_size shape and `pad` marks the trailing filler rows
+            # (a short last batch would contradict provide_data)
+            import numpy as onp
+
+            def _fill(arr):
+                a = arr.asnumpy()
+                filler = onp.repeat(a[-1:], pad, axis=0)
+                return onp.concatenate([a, filler], axis=0)
+
+            from .. import np as _np
+
+            data = _np.array(_fill(data))
+            label = _np.array(_fill(label))
         return DataBatch(data=[data.astype(self._dtype)], label=[label],
-                         pad=0)
+                         pad=pad)

@@ -21,6 +21,7 @@ import warnings
 
 import numpy as onp
 
+from ... import registry as _registry
 from ...base import MXNetError, data_dir
 from ...ndarray import NDArray
 from . import vocab as _vocab
@@ -28,36 +29,6 @@ from . import vocab as _vocab
 __all__ = ["register", "create", "get_pretrained_file_names",
            "TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
            "CompositeEmbedding"]
-
-_REGISTRY = {}
-
-
-def register(embedding_cls):
-    """Class decorator adding an embedding to the ``create`` registry."""
-    name = embedding_cls.__name__.lower()
-    _REGISTRY[name] = embedding_cls
-    return embedding_cls
-
-
-def create(embedding_name, **kwargs):
-    """Instantiate a registered embedding by (case-insensitive) name."""
-    key = embedding_name.lower()
-    if key not in _REGISTRY:
-        raise KeyError(
-            f"unknown embedding {embedding_name!r}; registered: "
-            f"{sorted(_REGISTRY)}")
-    return _REGISTRY[key](**kwargs)
-
-
-def get_pretrained_file_names(embedding_name=None):
-    """Known pretrained file names, per embedding or for all."""
-    if embedding_name is not None:
-        key = embedding_name.lower()
-        if key not in _REGISTRY:
-            raise KeyError(f"unknown embedding {embedding_name!r}")
-        return list(_REGISTRY[key].pretrained_file_names)
-    return {name: list(cls.pretrained_file_names)
-            for name, cls in _REGISTRY.items()}
 
 
 class TokenEmbedding(_vocab.Vocabulary):
@@ -70,6 +41,7 @@ class TokenEmbedding(_vocab.Vocabulary):
         super().__init__(**kwargs)
         self._vec_len = 0
         self._idx_to_vec = None
+        self._table = None
 
     # -- loading -----------------------------------------------------------
 
@@ -140,23 +112,34 @@ class TokenEmbedding(_vocab.Vocabulary):
                                 onp.float32))
         table[:n_special] = unk                 # <unk> + reserved
         table[n_special:] = onp.stack(vectors)
-        self._idx_to_vec = NDArray(table)
+        self._set_table(table)
 
     def _build_for_vocabulary(self, vocabulary, source_embeddings):
-        """CompositeEmbedding path: vocabulary's own index order, vectors
+        """Rebuild over the vocabulary's own index order, vectors
         concatenated across source embeddings (unknowns contribute their
-        unknown vector)."""
+        unknown vector).  Vectors are gathered BEFORE the token maps are
+        replaced — when a source embedding is ``self`` (the
+        ``vocabulary=`` constructor path), lookups must still hit the
+        file-ordered table."""
+        tokens = list(vocabulary.idx_to_token)
+        parts = [e.get_vecs_by_tokens(tokens).asnumpy()
+                 for e in source_embeddings]
         self._unknown_token = vocabulary.unknown_token
         self._reserved_tokens = vocabulary.reserved_tokens
-        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._idx_to_token = tokens
         self._token_to_idx = dict(vocabulary.token_to_idx)
-        parts = [e.get_vecs_by_tokens(self._idx_to_token).asnumpy()
-                 for e in source_embeddings]
         table = onp.concatenate(parts, axis=1)
         self._vec_len = table.shape[1]
-        self._idx_to_vec = NDArray(table.astype(onp.float32))
+        self._set_table(table.astype(onp.float32))
 
     # -- queries -----------------------------------------------------------
+
+    def _set_table(self, table):
+        """Keep a host-side numpy view alongside the NDArray so lookups
+        never round-trip the whole table through the device (a 2M-token
+        fastText table is ~2.4 GB per asnumpy())."""
+        self._table = table
+        self._idx_to_vec = NDArray(table)
 
     @property
     def vec_len(self):
@@ -178,8 +161,7 @@ class TokenEmbedding(_vocab.Vocabulary):
         else:
             idxs = [self._token_to_idx.get(t, _vocab.UNKNOWN_IDX)
                     for t in toks]
-        table = self._idx_to_vec.asnumpy()
-        out = table[onp.asarray(idxs, onp.int64)]
+        out = self._table[onp.asarray(idxs, onp.int64)]
         return NDArray(out[0] if single else out)
 
     def update_token_vectors(self, tokens, new_vectors):
@@ -198,9 +180,8 @@ class TokenEmbedding(_vocab.Vocabulary):
                     f"token {t!r} is unknown; only tokens in the "
                     "embedding vocabulary can be updated")
             idxs.append(self._token_to_idx[t])
-        table = self._idx_to_vec.asnumpy().copy()
-        table[onp.asarray(idxs, onp.int64)] = vals
-        self._idx_to_vec = NDArray(table)
+        self._table[onp.asarray(idxs, onp.int64)] = vals
+        self._idx_to_vec = NDArray(self._table)
 
     @classmethod
     def _check_pretrained_file_names(cls, pretrained_file_name):
@@ -213,6 +194,23 @@ class TokenEmbedding(_vocab.Vocabulary):
 
 # keep the reference's public alias
 _TokenEmbedding = TokenEmbedding
+
+# registry machinery shared with the rest of the framework
+# (ref embedding.py builds its registry via mxnet.registry the same way)
+register = _registry.get_register_func(TokenEmbedding, "token embedding")
+create = _registry.get_create_func(TokenEmbedding, "token embedding")
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names, per embedding or for all."""
+    reg = _registry.get_registry(TokenEmbedding)
+    if embedding_name is not None:
+        key = embedding_name.lower()
+        if key not in reg:
+            raise KeyError(f"unknown embedding {embedding_name!r}")
+        return list(reg[key].pretrained_file_names)
+    return {name: list(cls.pretrained_file_names)
+            for name, cls in reg.items()}
 
 
 @register

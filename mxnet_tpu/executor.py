@@ -1,0 +1,197 @@
+"""Legacy Executor API (ref python/mxnet/executor.py).
+
+The reference 2.x Executor is a thin wrapper over CachedOp: bound
+argument/aux arrays, ``forward(is_train)``, ``backward(out_grads)`` into
+per-argument gradient buffers honoring ``grad_req``
+(write/add/null), and dict views over the bound state.  Here the
+compiled path is the Symbol interpreter (jitted per shape by XLA) and
+the backward pass rides the autograd tape — ``forward(is_train=True)``
+records, ``backward`` replays into the bound gradient arrays.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from . import autograd
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+def _as_nd(v):
+    from . import np as _np
+
+    return v if isinstance(v, NDArray) else _np.array(v)
+
+
+class Executor:
+    """Bound computation of one Symbol (ref executor.py Executor)."""
+
+    def __init__(self, sym, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._sym = sym
+        self._ctx = ctx
+        arg_names = sym.list_arguments()
+        aux_names = sym.list_auxiliary_states()
+        self._arg_dict = self._bind_group(args, arg_names, "args")
+        self._aux_dict = self._bind_group(aux_states, aux_names,
+                                          "aux_states", allow_none=True)
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            if len(grad_req) != len(arg_names):
+                raise MXNetError(
+                    f"grad_req list length {len(grad_req)} != "
+                    f"{len(arg_names)} arguments")
+            self._grad_req = dict(zip(arg_names, grad_req))
+        elif isinstance(grad_req, dict):
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in arg_names}
+        else:
+            raise MXNetError(f"invalid grad_req {grad_req!r}")
+        bad = {r for r in self._grad_req.values()
+               if r not in ("write", "add", "null")}
+        if bad:
+            raise MXNetError(f"invalid grad_req values {sorted(bad)}")
+        # legacy positional convention: an args_grad LIST aligns with the
+        # FULL list_arguments() order (None entries allowed); only the
+        # non-null subset is kept
+        if isinstance(args_grad, (list, tuple)):
+            if len(args_grad) != len(arg_names):
+                raise MXNetError(
+                    f"args_grad list length {len(args_grad)} != "
+                    f"{len(arg_names)} arguments")
+            args_grad = {n: g for n, g in zip(arg_names, args_grad)
+                         if g is not None}
+        self._grad_dict = self._bind_group(
+            {n: g for n, g in (args_grad or {}).items()
+             if self._grad_req.get(n, "null") != "null"},
+            [n for n in arg_names if self._grad_req[n] != "null"],
+            "args_grad", allow_none=True)
+        self.outputs: List[NDArray] = []
+        self._recorded_heads: Optional[List[NDArray]] = None
+
+    @staticmethod
+    def _bind_group(values, names, what, allow_none=False):
+        if values is None:
+            if allow_none:
+                return {}
+            raise MXNetError(f"{what} is required to bind an executor")
+        if isinstance(values, dict):
+            out = {n: _as_nd(v) for n, v in values.items()}
+            missing = [n for n in names if n not in out]
+        else:
+            vals = list(values)
+            if len(vals) != len(names):
+                raise MXNetError(
+                    f"{what} list length {len(vals)} != {len(names)}")
+            out = {n: _as_nd(v) for n, v in zip(names, vals)}
+            missing = []
+        if missing and not allow_none:
+            raise MXNetError(f"{what} missing values for {missing}")
+        return out
+
+    # -- execution ---------------------------------------------------------
+
+    def forward(self, is_train=False, **kwargs):
+        """Run the graph on the bound arrays; kwargs overwrite bound
+        argument values first (ref executor.py:137-188)."""
+        for n, v in kwargs.items():
+            if n not in self._arg_dict:
+                raise MXNetError(f"unknown argument {n!r}")
+            self._arg_dict[n] = _as_nd(v)
+        bound = dict(self._arg_dict)
+        bound.update(self._aux_dict)
+        if is_train:
+            tracked = [n for n in self._sym.list_arguments()
+                       if self._grad_req[n] != "null"]
+            for n in tracked:
+                if n not in self._grad_dict:
+                    from . import np as _np
+
+                    self._grad_dict[n] = _np.zeros(
+                        self._arg_dict[n].shape)
+            autograd.mark_variables(
+                [self._arg_dict[n] for n in tracked],
+                [self._grad_dict[n] for n in tracked],
+                grad_reqs=[self._grad_req[n] for n in tracked])
+            with autograd.record():
+                self.outputs = list(self._sym._interpret(bound))
+            self._recorded_heads = list(self.outputs)
+        else:
+            with autograd.pause():
+                self.outputs = list(self._sym._interpret(bound))
+            self._recorded_heads = None
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """Accumulate gradients of the last ``forward(is_train=True)``
+        into the bound gradient arrays (ref executor.py:189-231)."""
+        if self._recorded_heads is None:
+            raise MXNetError(
+                "backward requires a prior forward(is_train=True)")
+        heads = self._recorded_heads
+        if out_grads is not None:
+            if isinstance(out_grads, (list, tuple)):
+                out_grads = [_as_nd(g) for g in out_grads]
+            else:
+                out_grads = [_as_nd(out_grads)]
+            if len(out_grads) != len(heads):
+                raise MXNetError(
+                    f"{len(out_grads)} head gradients for "
+                    f"{len(heads)} outputs")
+        autograd.backward(heads, head_grads=out_grads)
+        self._recorded_heads = None
+
+    # -- views (ref executor.py:232-341) -----------------------------------
+
+    @property
+    def arg_dict(self) -> Dict[str, NDArray]:
+        return self._arg_dict
+
+    @property
+    def grad_dict(self) -> Dict[str, NDArray]:
+        return self._grad_dict
+
+    @property
+    def aux_dict(self) -> Dict[str, NDArray]:
+        return self._aux_dict
+
+    @property
+    def output_dict(self) -> Dict[str, NDArray]:
+        names = self._sym.list_outputs()
+        return dict(zip(names, self.outputs))
+
+    @property
+    def arg_arrays(self) -> List[NDArray]:
+        return [self._arg_dict[n] for n in self._sym.list_arguments()]
+
+    @property
+    def grad_arrays(self) -> List[Optional[NDArray]]:
+        return [self._grad_dict.get(n)
+                for n in self._sym.list_arguments()]
+
+    @property
+    def aux_arrays(self) -> List[NDArray]:
+        return [self._aux_dict[n]
+                for n in self._sym.list_auxiliary_states()]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """Overwrite bound values from name->array dicts
+        (ref executor.py:342-380)."""
+        for name, arr in arg_params.items():
+            if name in self._arg_dict:
+                self._arg_dict[name] = _as_nd(arr)
+            elif not allow_extra_params:
+                raise ValueError(
+                    f"Found name {name!r} that is not in the arguments")
+        for name, arr in (aux_params or {}).items():
+            if name in self._aux_dict or name in \
+                    self._sym.list_auxiliary_states():
+                self._aux_dict[name] = _as_nd(arr)
+            elif not allow_extra_params:
+                raise ValueError(
+                    f"Found name {name!r} that is not in the auxiliary "
+                    "states")

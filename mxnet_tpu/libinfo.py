@@ -1,0 +1,38 @@
+"""Library locator + version (ref python/mxnet/libinfo.py).
+
+The reference locates a prebuilt ``libmxnet.so``; this build's native
+runtime is ``libmxtpu.so`` compiled on demand (``_native``), so
+``find_lib_path`` returns that artifact (building it first if needed)
+and ``find_include_path`` points at the native sources.
+"""
+from __future__ import annotations
+
+import os
+
+from . import __version__  # noqa: F401  (re-exported like the reference)
+
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+
+def find_lib_path():
+    """[path] of the native runtime library (ref libinfo.py
+    find_lib_path; raises when the toolchain cannot produce it)."""
+    from . import _native
+
+    _native.get_lib()                     # ensure built
+    path = _native._SO
+    if not os.path.exists(path):
+        raise RuntimeError(
+            "native runtime library not found and could not be built "
+            f"(expected {path})")
+    return [path]
+
+
+def find_include_path():
+    """Path of the native runtime headers/sources (ref libinfo.py
+    find_include_path)."""
+    src = os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "src", "mxtpu"))
+    if not os.path.isdir(src):
+        raise RuntimeError(f"native source directory not found: {src}")
+    return src

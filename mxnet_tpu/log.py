@@ -1,0 +1,60 @@
+"""Logging helpers (ref python/mxnet/log.py).
+
+``get_logger`` configures a named logger once with either a file or a
+colored stderr handler; the level-colored single-letter labels match the
+reference formatter's output shape.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING", "ERROR",
+           "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+_COLORS = {logging.WARNING: "\x1b[0;33m", logging.ERROR: "\x1b[0;31m",
+           logging.FATAL: "\x1b[0;35m"}
+_LABELS = {logging.DEBUG: "D", logging.INFO: "I", logging.WARNING: "W",
+           logging.ERROR: "E", logging.FATAL: "C"}
+
+
+class _Formatter(logging.Formatter):
+    """Level-lettered, optionally colored (tty only) record prefix."""
+
+    def __init__(self, colored=True):
+        super().__init__(datefmt="%m%d %H:%M:%S")
+        self._colored = colored
+
+    def format(self, record):
+        label = _LABELS.get(record.levelno, "U")
+        head = f"{label}{self.formatTime(record, self.datefmt)}"
+        if self._colored and record.levelno in _COLORS:
+            head = _COLORS[record.levelno] + head + "\x1b[0m"
+        return f"{head} {record.getMessage()}"
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Named logger with one mxnet-style handler (ref log.py:84-139);
+    repeat calls only adjust the level."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxnet_tpu_handler", None) is None:
+        if filename:
+            handler = logging.FileHandler(filename, filemode or "a")
+            handler.setFormatter(_Formatter(colored=False))
+        else:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(_Formatter(
+                colored=getattr(sys.stderr, "isatty", lambda: False)()))
+        logger.addHandler(handler)
+        logger._mxnet_tpu_handler = handler
+    logger.setLevel(level)
+    return logger
+
+
+getLogger = get_logger

@@ -1,0 +1,93 @@
+"""Generic class registries (ref python/mxnet/registry.py).
+
+Factory functions building register/alias/create closures for any base
+class, keyed case-insensitively — the machinery behind
+``mx.optimizer.register`` / ``mx.init.register``-style registries, also
+usable for user class families.
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+from .base import MXNetError
+
+__all__ = ["get_registry", "get_register_func", "get_alias_func",
+           "get_create_func"]
+
+_REGISTRIES: dict = {}
+
+
+def get_registry(base_class):
+    """A copy of the name->class registry for ``base_class``."""
+    return dict(_REGISTRIES.get(base_class, {}))
+
+
+def get_register_func(base_class, nickname):
+    """Build ``register(klass, name=None)`` for the class family."""
+    registry = _REGISTRIES.setdefault(base_class, {})
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise MXNetError(
+                f"can only register subclasses of "
+                f"{base_class.__name__}, got {klass!r}")
+        key = (name or klass.__name__).lower()
+        if key in registry and registry[key] is not klass:
+            warnings.warn(
+                f"new {nickname} {klass.__name__} registered under "
+                f"{key!r} overrides {registry[key].__name__}")
+        registry[key] = klass
+        return klass
+
+    register.__doc__ = f"Register a new {nickname} under its class name."
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Build an ``alias(*names)`` class decorator for the family."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Build ``create(spec, *args, **kwargs)``: spec is an instance
+    (returned as-is), a registered name, or a JSON-encoded
+    ``[name, kwargs]`` pair (the reference's serialized form)."""
+    registry = _REGISTRIES.setdefault(base_class, {})
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            if len(args) > 1 or kwargs:
+                raise MXNetError(
+                    f"{nickname} instance given; no further arguments "
+                    "allowed")
+            return args[0]
+        if not args or not isinstance(args[0], str):
+            raise MXNetError(
+                f"create expects a {nickname} name or instance first")
+        name, args = args[0], args[1:]
+        if name.startswith("["):
+            if args or kwargs:
+                raise MXNetError(
+                    "JSON spec carries its own kwargs; no further "
+                    "arguments allowed")
+            name, kwargs = json.loads(name)
+        key = name.lower()
+        if key not in registry:
+            raise MXNetError(
+                f"{name!r} is not a registered {nickname}; known: "
+                f"{sorted(registry)}")
+        return registry[key](*args, **kwargs)
+
+    create.__doc__ = f"Create a {nickname} instance by name or spec."
+    return create

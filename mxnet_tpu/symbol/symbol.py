@@ -433,24 +433,31 @@ class Symbol:
         return self._interpret(kwargs)
 
     def bind(self, ctx=None, args: Optional[Dict[str, Any]] = None,
+             args_grad=None, grad_req="write",
              aux_states: Optional[Dict[str, Any]] = None):
-        """Minimal Executor (ref executor.py is a CachedOp wrapper; here the
-        compiled path is jax.jit around the interpreter)."""
-        sym = self
-        bound = dict(args or {})
-        bound.update(aux_states or {})
+        """Bind arrays to this graph → ``mx.executor.Executor`` with
+        forward/backward/grad buffers (ref symbol.py bind +
+        executor.py)."""
+        from ..executor import Executor
 
-        class Executor:
-            def __init__(self):
-                self.arg_dict = bound
+        return Executor(self, ctx=ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
 
-            def forward(self, **kw):
-                b = dict(self.arg_dict)
-                b.update(kw)
-                self.outputs = sym._interpret(b)
-                return self.outputs
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        """Allocate zeroed argument/aux/grad arrays from inferred shapes
+        and bind (ref symbol.py simple_bind).  Divergence: shapes for ALL
+        arguments are required — the interpreter has no partial shape
+        inference (traced graphs already know their shapes)."""
+        from .. import np as _np
+        from ..executor import Executor
 
-        return Executor()
+        arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
+        args = {n: _np.zeros(s) for n, s in
+                zip(self.list_arguments(), arg_shapes)}
+        aux = {n: _np.zeros(s) for n, s in
+               zip(self.list_auxiliary_states(), aux_shapes)}
+        return Executor(self, ctx=ctx, args=args, grad_req=grad_req,
+                        aux_states=aux)
 
     # -- inference ----------------------------------------------------------
     def infer_shape(self, **kwargs):

@@ -110,7 +110,7 @@ def test_registry_and_create(vec_file):
     assert "glove" in names and "fasttext" in names
     assert "glove.6B.50d.txt" in \
         text.embedding.get_pretrained_file_names("glove")
-    with pytest.raises(KeyError):
+    with pytest.raises(mx.MXNetError):
         text.embedding.create("nosuch")
     with pytest.raises(KeyError):
         text.embedding.create("glove", pretrained_file_name="bogus.txt")
@@ -158,6 +158,36 @@ def test_dataloader_iter_label_dtype():
     assert "int" in it.provide_label[0].dtype
     batch = it.next()
     assert "int" in str(batch.label[0].dtype)
+
+
+def test_embedding_with_vocabulary_reorders_correctly(tmp_path):
+    """vocabulary= rebuilds indices in the vocab's order; vectors must
+    follow their tokens (review finding round 4)."""
+    p = tmp_path / "v.txt"
+    p.write_text("hello 1 1 1\nworld 2 2 2\nzed 3 3 3\n")
+    vocab = text.vocab.Vocabulary(Counter({"zed": 9, "world": 5,
+                                           "hello": 2, "extra": 1}))
+    emb = text.embedding.CustomEmbedding(str(p), vocabulary=vocab)
+    assert emb.to_indices("zed") == 1       # vocab frequency order
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("zed").asnumpy(), [3, 3, 3])
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 1, 1])
+    # vocab token absent from the file gets the unknown vector
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("extra").asnumpy(), [0, 0, 0])
+
+
+def test_dataloader_iter_pads_short_last_batch():
+    x = onp.arange(24, dtype="float32").reshape(12, 2)
+    y = onp.arange(12, dtype="float32")
+    it = DataLoaderIter(DataLoader(ArrayDataset(x, y), batch_size=5))
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].pad == 0 and batches[2].pad == 3
+    # padded batch keeps the advertised shape
+    assert batches[2].data[0].shape == (5, 2)
+    onp.testing.assert_allclose(batches[2].data[0].asnumpy()[:2], x[10:])
 
 
 def test_dataloader_iter_bridge():

@@ -1,0 +1,200 @@
+"""mx.executor Executor + registry/log/libinfo modules (ref
+tests/python/unittest/test_executor.py scenarios on the 2.x
+CachedOp-backed Executor; here the interpreter+tape implementation)."""
+import logging
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+_RS = onp.random.RandomState(3)
+
+
+def _dot_sym():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    return mx.sym.dot(a, b, name="out")
+
+
+def _bind_dot(grad_req="write", **kw):
+    a = _RS.rand(3, 4).astype("float32")
+    b = _RS.rand(4, 2).astype("float32")
+    exe = _dot_sym().bind(args={"a": mx.np.array(a), "b": mx.np.array(b)},
+                          grad_req=grad_req, **kw)
+    return exe, a, b
+
+
+def test_forward_matches_numpy():
+    exe, a, b = _bind_dot()
+    out = exe.forward()
+    onp.testing.assert_allclose(out[0].asnumpy(), a @ b, rtol=1e-5)
+    assert exe.output_dict["out_output"] is out[0]
+    # kwargs overwrite bound args
+    a2 = onp.ones_like(a)
+    out = exe.forward(a=mx.np.array(a2))
+    onp.testing.assert_allclose(out[0].asnumpy(), a2 @ b, rtol=1e-5)
+
+
+def test_backward_writes_gradients():
+    exe, a, b = _bind_dot()
+    exe.forward(is_train=True)
+    head = onp.ones((3, 2), "float32")
+    exe.backward(out_grads=mx.np.array(head))
+    onp.testing.assert_allclose(exe.grad_dict["a"].asnumpy(),
+                                head @ b.T, rtol=1e-5)
+    onp.testing.assert_allclose(exe.grad_dict["b"].asnumpy(),
+                                a.T @ head, rtol=1e-5)
+    # arrays also visible positionally, in list_arguments order
+    ga, gb = exe.grad_arrays
+    onp.testing.assert_allclose(ga.asnumpy(), head @ b.T, rtol=1e-5)
+
+
+def test_grad_req_null_and_dict():
+    exe, a, b = _bind_dot(grad_req={"a": "write"})   # b defaults to null
+    exe.forward(is_train=True)
+    exe.backward(out_grads=mx.np.ones((3, 2)))
+    assert "a" in exe.grad_dict and "b" not in exe.grad_dict
+    assert exe.grad_arrays[1] is None
+
+    exe2, _, _ = _bind_dot(grad_req="null")
+    exe2.forward(is_train=True)
+
+
+def test_grad_req_add_accumulates():
+    exe, a, b = _bind_dot(grad_req="add")
+    for _ in range(2):
+        exe.forward(is_train=True)
+        exe.backward(out_grads=mx.np.ones((3, 2)))
+    onp.testing.assert_allclose(exe.grad_dict["a"].asnumpy(),
+                                2 * onp.ones((3, 2)) @ b.T, rtol=1e-5)
+
+
+def test_args_grad_positional_list():
+    """args_grad as a list aligns with list_arguments() even when some
+    entries are null/None (legacy convention; review finding round 4)."""
+    a = _RS.rand(3, 4).astype("float32")
+    b = _RS.rand(4, 2).astype("float32")
+    gb = mx.np.zeros((4, 2))
+    exe = _dot_sym().bind(
+        args={"a": mx.np.array(a), "b": mx.np.array(b)},
+        grad_req=["null", "write"], args_grad=[None, gb])
+    exe.forward(is_train=True)
+    exe.backward(out_grads=mx.np.ones((3, 2)))
+    onp.testing.assert_allclose(exe.grad_dict["b"].asnumpy(),
+                                a.T @ onp.ones((3, 2)), rtol=1e-5)
+    assert exe.grad_arrays[0] is None
+
+
+def test_backward_requires_train_forward():
+    exe, _, _ = _bind_dot()
+    exe.forward(is_train=False)
+    with pytest.raises(MXNetError):
+        exe.backward()
+
+
+def test_bind_validation():
+    sym = _dot_sym()
+    with pytest.raises(MXNetError):
+        sym.bind(args={"a": mx.np.ones((3, 4))})    # missing b
+    with pytest.raises(MXNetError):
+        sym.bind(args=[mx.np.ones((3, 4))])          # wrong list length
+    with pytest.raises(MXNetError):
+        sym.bind(args={"a": mx.np.ones((3, 4)),
+                       "b": mx.np.ones((4, 2))}, grad_req="bogus")
+
+
+def test_copy_params_from():
+    exe, a, b = _bind_dot()
+    exe.copy_params_from({"a": onp.zeros((3, 4), "float32")})
+    out = exe.forward()
+    onp.testing.assert_allclose(out[0].asnumpy(), onp.zeros((3, 2)),
+                                atol=1e-6)
+    with pytest.raises(ValueError):
+        exe.copy_params_from({"nope": onp.zeros(1)})
+    exe.copy_params_from({"nope": onp.zeros(1)}, allow_extra_params=True)
+
+
+def test_simple_bind_mlp_trains():
+    x = mx.sym.Variable("x")
+    fc = mx.sym.FullyConnected(data=x, num_hidden=2, name="fc")
+    exe = fc.simple_bind(x=(5, 3), fc_weight=(2, 3), fc_bias=(2,))
+    assert exe.arg_dict["fc_weight"].shape == (2, 3)
+    exe.arg_dict["fc_weight"][:] = mx.np.array(
+        _RS.rand(2, 3).astype("float32"))
+    exe.forward(is_train=True, x=mx.np.array(
+        _RS.rand(5, 3).astype("float32")))
+    exe.backward(out_grads=mx.np.ones((5, 2)))
+    assert exe.grad_dict["fc_weight"].shape == (2, 3)
+    assert onp.abs(exe.grad_dict["fc_weight"].asnumpy()).sum() > 0
+
+
+# -- mx.registry ------------------------------------------------------------
+
+class _Base:
+    pass
+
+
+def test_registry_register_create_alias():
+    from mxnet_tpu import registry
+
+    reg = registry.get_register_func(_Base, "thing")
+    alias = registry.get_alias_func(_Base, "thing")
+    create = registry.get_create_func(_Base, "thing")
+
+    @alias("alpha", "first")
+    class A(_Base):
+        def __init__(self, v=1):
+            self.v = v
+
+    reg(A)                                   # class-name registration
+
+    assert registry.get_registry(_Base)["alpha"] is A
+    assert isinstance(create("A"), A)
+    assert create("first", v=5).v == 5
+    assert create('["alpha", {"v": 7}]').v == 7
+    inst = A()
+    assert create(inst) is inst
+    with pytest.raises(MXNetError):
+        create("missing")
+    with pytest.raises(MXNetError):
+        create(inst, 1)
+    with pytest.raises(MXNetError):
+        reg(int)                             # not a subclass
+
+    class B(_Base):
+        pass
+
+    with pytest.warns(UserWarning):          # name collision warns
+        reg(B, "alpha")
+
+
+# -- mx.log / mx.libinfo ----------------------------------------------------
+
+def test_log_get_logger(tmp_path):
+    from mxnet_tpu import log
+
+    path = str(tmp_path / "out.log")
+    logger = log.get_logger("mxtpu-test-file", filename=path,
+                            level=log.INFO)
+    logger.info("hello %s", "world")
+    logger.handlers[0].flush()
+    text = open(path).read()
+    assert "hello world" in text and text.startswith("I")
+    # repeat call reuses the handler, adjusts level
+    again = log.get_logger("mxtpu-test-file", level=log.ERROR)
+    assert again is logger and logger.level == logging.ERROR
+    assert len(logger.handlers) == 1
+
+
+def test_libinfo_paths():
+    from mxnet_tpu import libinfo
+
+    assert libinfo.__version__ == mx.__version__
+    inc = libinfo.find_include_path()
+    assert os.path.isdir(inc) and "mxtpu" in inc
+    libs = libinfo.find_lib_path()
+    assert len(libs) == 1 and libs[0].endswith("libmxtpu.so")
+    assert os.path.exists(libs[0])
