@@ -510,9 +510,10 @@ def _infer_child(name):
         batch, image = (1, 299) if model == "inceptionv3" else (2, 64)
 
     mx.random.seed(0)
-    layout = "NHWC" if (on_tpu and model.startswith("resnet")) else "NCHW"
-    kwargs = {"layout": layout} if model.startswith("resnet") else {}
-    net = mx.gluon.model_zoo.get_model(model, **kwargs)
+    # all swept models thread layout; channel-last keeps convs on the
+    # MXU minor tile without transpose pairs (PERF.md)
+    layout = "NHWC" if on_tpu else "NCHW"
+    net = mx.gluon.model_zoo.get_model(model, layout=layout)
     net.initialize(mx.init.Xavier())
     shape = ((2, image, image, 3) if layout == "NHWC"
              else (2, 3, image, image))

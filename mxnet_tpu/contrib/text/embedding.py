@@ -135,11 +135,12 @@ class TokenEmbedding(_vocab.Vocabulary):
     # -- queries -----------------------------------------------------------
 
     def _set_table(self, table):
-        """Keep a host-side numpy view alongside the NDArray so lookups
-        never round-trip the whole table through the device (a 2M-token
-        fastText table is ~2.4 GB per asnumpy())."""
+        """The host numpy table is the source of truth; the NDArray view
+        is built lazily by ``idx_to_vec`` (a 2M-token fastText table is
+        ~2.4 GB — holding host + device copies up front would double the
+        footprint for users who never read idx_to_vec)."""
         self._table = table
-        self._idx_to_vec = NDArray(table)
+        self._idx_to_vec = None
 
     @property
     def vec_len(self):
@@ -147,6 +148,8 @@ class TokenEmbedding(_vocab.Vocabulary):
 
     @property
     def idx_to_vec(self):
+        if self._idx_to_vec is None and self._table is not None:
+            self._idx_to_vec = NDArray(self._table)
         return self._idx_to_vec
 
     def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
@@ -166,7 +169,7 @@ class TokenEmbedding(_vocab.Vocabulary):
 
     def update_token_vectors(self, tokens, new_vectors):
         """Overwrite rows for known tokens; unknown tokens raise."""
-        if self._idx_to_vec is None:
+        if self._table is None:
             raise MXNetError("embedding has no vectors to update")
         single = not isinstance(tokens, list)
         toks = [tokens] if single else tokens
@@ -181,7 +184,7 @@ class TokenEmbedding(_vocab.Vocabulary):
                     "embedding vocabulary can be updated")
             idxs.append(self._token_to_idx[t])
         self._table[onp.asarray(idxs, onp.int64)] = vals
-        self._idx_to_vec = NDArray(self._table)
+        self._idx_to_vec = None                 # device view invalidated
 
     @classmethod
     def _check_pretrained_file_names(cls, pretrained_file_name):

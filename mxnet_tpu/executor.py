@@ -96,11 +96,14 @@ class Executor:
 
     def forward(self, is_train=False, **kwargs):
         """Run the graph on the bound arrays; kwargs overwrite bound
-        argument values first (ref executor.py:137-188)."""
+        argument values first (ref executor.py:137-188).  Values are
+        copied INTO the bound arrays (ref copyto semantics) so aliases a
+        caller captured from arg_arrays/arg_dict keep observing — and
+        feeding — the executor's state."""
         for n, v in kwargs.items():
             if n not in self._arg_dict:
                 raise MXNetError(f"unknown argument {n!r}")
-            self._arg_dict[n] = _as_nd(v)
+            self._arg_dict[n][:] = _as_nd(v)
         bound = dict(self._arg_dict)
         bound.update(self._aux_dict)
         if is_train:
@@ -179,17 +182,19 @@ class Executor:
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
-        """Overwrite bound values from name->array dicts
-        (ref executor.py:342-380)."""
+        """Copy values INTO the bound arrays from name->array dicts —
+        in place, preserving caller-held aliases (ref
+        executor.py:342-380 copyto)."""
         for name, arr in arg_params.items():
             if name in self._arg_dict:
-                self._arg_dict[name] = _as_nd(arr)
+                self._arg_dict[name][:] = _as_nd(arr)
             elif not allow_extra_params:
                 raise ValueError(
                     f"Found name {name!r} that is not in the arguments")
         for name, arr in (aux_params or {}).items():
-            if name in self._aux_dict or name in \
-                    self._sym.list_auxiliary_states():
+            if name in self._aux_dict:
+                self._aux_dict[name][:] = _as_nd(arr)
+            elif name in self._sym.list_auxiliary_states():
                 self._aux_dict[name] = _as_nd(arr)
             elif not allow_extra_params:
                 raise ValueError(

@@ -1,0 +1,8 @@
+"""Shared layout helpers for the vision zoo."""
+from __future__ import annotations
+
+
+def bn_axis(layout: str) -> int:
+    """Channel axis for a data layout string: 1 for channel-first
+    (NC...), -1 for channel-last (...C)."""
+    return 1 if layout.startswith("NC") else -1

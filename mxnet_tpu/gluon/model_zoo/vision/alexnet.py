@@ -8,18 +8,18 @@ __all__ = ["AlexNet", "alexnet"]
 
 
 class AlexNet(HybridBlock):
-    def __init__(self, classes=1000, **kw):
+    def __init__(self, classes=1000, layout="NCHW", **kw):
         super().__init__(**kw)
         self.features = nn.HybridSequential()
         self.features.add(
-            nn.Conv2D(64, 11, 4, 2, activation="relu"),
-            nn.MaxPool2D(3, 2),
-            nn.Conv2D(192, 5, padding=2, activation="relu"),
-            nn.MaxPool2D(3, 2),
-            nn.Conv2D(384, 3, padding=1, activation="relu"),
-            nn.Conv2D(256, 3, padding=1, activation="relu"),
-            nn.Conv2D(256, 3, padding=1, activation="relu"),
-            nn.MaxPool2D(3, 2),
+            nn.Conv2D(64, 11, 4, 2, activation="relu", layout=layout),
+            nn.MaxPool2D(3, 2, layout=layout),
+            nn.Conv2D(192, 5, padding=2, activation="relu", layout=layout),
+            nn.MaxPool2D(3, 2, layout=layout),
+            nn.Conv2D(384, 3, padding=1, activation="relu", layout=layout),
+            nn.Conv2D(256, 3, padding=1, activation="relu", layout=layout),
+            nn.Conv2D(256, 3, padding=1, activation="relu", layout=layout),
+            nn.MaxPool2D(3, 2, layout=layout),
             nn.Flatten(),
             nn.Dense(4096, activation="relu"), nn.Dropout(0.5),
             nn.Dense(4096, activation="relu"), nn.Dropout(0.5))

@@ -1,28 +1,40 @@
-"""Inception V3 (ref: python/mxnet/gluon/model_zoo/vision/inception.py)."""
+"""Inception V3 (ref: python/mxnet/gluon/model_zoo/vision/inception.py).
+
+``layout="NHWC"`` threads the channel-last layout through every conv,
+pool, BN axis and concat axis — on TPU this keeps channels on the
+128-lane minor tile with no transpose pairs (same stance as resnet.py).
+"""
 from __future__ import annotations
 
 from ....numpy import concatenate
 from ... import nn
 from ...block import HybridBlock
+from ._common import bn_axis as _ax
 
 __all__ = ["Inception3", "inception_v3"]
 
 
-def _conv(channels, kernel, stride=1, pad=0):
+
+
+def _conv(channels, kernel, stride=1, pad=0, layout="NCHW"):
     out = nn.HybridSequential()
-    out.add(nn.Conv2D(channels, kernel, stride, pad, use_bias=False),
-            nn.BatchNorm(epsilon=0.001), nn.Activation("relu"))
+    out.add(nn.Conv2D(channels, kernel, stride, pad, use_bias=False,
+                      layout=layout),
+            nn.BatchNorm(epsilon=0.001, axis=_ax(layout)),
+            nn.Activation("relu"))
     return out
 
 
 class _Branches(HybridBlock):
-    def __init__(self, branches, **kw):
+    def __init__(self, branches, axis=1, **kw):
         super().__init__(**kw)
+        self._axis = axis
         for i, b in enumerate(branches):
             self.register_child(b, str(i))
 
     def forward(self, x):
-        return concatenate([b(x) for b in self._children.values()], axis=1)
+        return concatenate([b(x) for b in self._children.values()],
+                           axis=self._axis)
 
 
 def _seq(*blocks):
@@ -31,77 +43,95 @@ def _seq(*blocks):
     return s
 
 
-def _make_A(pool_features):
+def _make_A(pool_features, lo):
     return _Branches([
-        _conv(64, 1),
-        _seq(_conv(48, 1), _conv(64, 5, pad=2)),
-        _seq(_conv(64, 1), _conv(96, 3, pad=1), _conv(96, 3, pad=1)),
-        _seq(nn.AvgPool2D(3, 1, 1), _conv(pool_features, 1)),
-    ])
+        _conv(64, 1, layout=lo),
+        _seq(_conv(48, 1, layout=lo), _conv(64, 5, pad=2, layout=lo)),
+        _seq(_conv(64, 1, layout=lo), _conv(96, 3, pad=1, layout=lo),
+             _conv(96, 3, pad=1, layout=lo)),
+        _seq(nn.AvgPool2D(3, 1, 1, layout=lo),
+             _conv(pool_features, 1, layout=lo)),
+    ], axis=_ax(lo))
 
 
-def _make_B():
+def _make_B(lo):
     return _Branches([
-        _conv(384, 3, 2),
-        _seq(_conv(64, 1), _conv(96, 3, pad=1), _conv(96, 3, 2)),
-        _seq(nn.MaxPool2D(3, 2)),
-    ])
+        _conv(384, 3, 2, layout=lo),
+        _seq(_conv(64, 1, layout=lo), _conv(96, 3, pad=1, layout=lo),
+             _conv(96, 3, 2, layout=lo)),
+        _seq(nn.MaxPool2D(3, 2, layout=lo)),
+    ], axis=_ax(lo))
 
 
-def _make_C(channels_7x7):
+def _make_C(channels_7x7, lo):
     c = channels_7x7
     return _Branches([
-        _conv(192, 1),
-        _seq(_conv(c, 1), _conv(c, (1, 7), pad=(0, 3)), _conv(192, (7, 1), pad=(3, 0))),
-        _seq(_conv(c, 1), _conv(c, (7, 1), pad=(3, 0)), _conv(c, (1, 7), pad=(0, 3)),
-             _conv(c, (7, 1), pad=(3, 0)), _conv(192, (1, 7), pad=(0, 3))),
-        _seq(nn.AvgPool2D(3, 1, 1), _conv(192, 1)),
-    ])
+        _conv(192, 1, layout=lo),
+        _seq(_conv(c, 1, layout=lo), _conv(c, (1, 7), pad=(0, 3), layout=lo),
+             _conv(192, (7, 1), pad=(3, 0), layout=lo)),
+        _seq(_conv(c, 1, layout=lo), _conv(c, (7, 1), pad=(3, 0), layout=lo),
+             _conv(c, (1, 7), pad=(0, 3), layout=lo),
+             _conv(c, (7, 1), pad=(3, 0), layout=lo),
+             _conv(192, (1, 7), pad=(0, 3), layout=lo)),
+        _seq(nn.AvgPool2D(3, 1, 1, layout=lo), _conv(192, 1, layout=lo)),
+    ], axis=_ax(lo))
 
 
-def _make_D():
+def _make_D(lo):
     return _Branches([
-        _seq(_conv(192, 1), _conv(320, 3, 2)),
-        _seq(_conv(192, 1), _conv(192, (1, 7), pad=(0, 3)),
-             _conv(192, (7, 1), pad=(3, 0)), _conv(192, 3, 2)),
-        _seq(nn.MaxPool2D(3, 2)),
-    ])
+        _seq(_conv(192, 1, layout=lo), _conv(320, 3, 2, layout=lo)),
+        _seq(_conv(192, 1, layout=lo), _conv(192, (1, 7), pad=(0, 3),
+                                             layout=lo),
+             _conv(192, (7, 1), pad=(3, 0), layout=lo),
+             _conv(192, 3, 2, layout=lo)),
+        _seq(nn.MaxPool2D(3, 2, layout=lo)),
+    ], axis=_ax(lo))
 
 
 class _BlockE(HybridBlock):
-    def __init__(self, **kw):
+    def __init__(self, layout="NCHW", **kw):
         super().__init__(**kw)
-        self.b0 = _conv(320, 1)
-        self.b1_stem = _conv(384, 1)
-        self.b1a = _conv(384, (1, 3), pad=(0, 1))
-        self.b1b = _conv(384, (3, 1), pad=(1, 0))
-        self.b2_stem = _seq(_conv(448, 1), _conv(384, 3, pad=1))
-        self.b2a = _conv(384, (1, 3), pad=(0, 1))
-        self.b2b = _conv(384, (3, 1), pad=(1, 0))
-        self.b3 = _seq(nn.AvgPool2D(3, 1, 1), _conv(192, 1))
+        lo = layout
+        self._axis = _ax(lo)
+        self.b0 = _conv(320, 1, layout=lo)
+        self.b1_stem = _conv(384, 1, layout=lo)
+        self.b1a = _conv(384, (1, 3), pad=(0, 1), layout=lo)
+        self.b1b = _conv(384, (3, 1), pad=(1, 0), layout=lo)
+        self.b2_stem = _seq(_conv(448, 1, layout=lo),
+                            _conv(384, 3, pad=1, layout=lo))
+        self.b2a = _conv(384, (1, 3), pad=(0, 1), layout=lo)
+        self.b2b = _conv(384, (3, 1), pad=(1, 0), layout=lo)
+        self.b3 = _seq(nn.AvgPool2D(3, 1, 1, layout=lo),
+                       _conv(192, 1, layout=lo))
 
     def forward(self, x):
+        ax = self._axis
         o0 = self.b0(x)
         s1 = self.b1_stem(x)
-        o1 = concatenate([self.b1a(s1), self.b1b(s1)], axis=1)
+        o1 = concatenate([self.b1a(s1), self.b1b(s1)], axis=ax)
         s2 = self.b2_stem(x)
-        o2 = concatenate([self.b2a(s2), self.b2b(s2)], axis=1)
-        return concatenate([o0, o1, o2, self.b3(x)], axis=1)
+        o2 = concatenate([self.b2a(s2), self.b2b(s2)], axis=ax)
+        return concatenate([o0, o1, o2, self.b3(x)], axis=ax)
 
 
 class Inception3(HybridBlock):
-    def __init__(self, classes=1000, **kw):
+    def __init__(self, classes=1000, layout="NCHW", **kw):
         super().__init__(**kw)
+        lo = layout
         self.features = nn.HybridSequential()
-        self.features.add(_conv(32, 3, 2), _conv(32, 3), _conv(64, 3, pad=1),
-                          nn.MaxPool2D(3, 2), _conv(80, 1), _conv(192, 3),
-                          nn.MaxPool2D(3, 2),
-                          _make_A(32), _make_A(64), _make_A(64),
-                          _make_B(),
-                          _make_C(128), _make_C(160), _make_C(160), _make_C(192),
-                          _make_D(),
-                          _BlockE(), _BlockE(),
-                          nn.AvgPool2D(8), nn.Dropout(0.5), nn.Flatten())
+        self.features.add(_conv(32, 3, 2, layout=lo), _conv(32, 3, layout=lo),
+                          _conv(64, 3, pad=1, layout=lo),
+                          nn.MaxPool2D(3, 2, layout=lo),
+                          _conv(80, 1, layout=lo), _conv(192, 3, layout=lo),
+                          nn.MaxPool2D(3, 2, layout=lo),
+                          _make_A(32, lo), _make_A(64, lo), _make_A(64, lo),
+                          _make_B(lo),
+                          _make_C(128, lo), _make_C(160, lo),
+                          _make_C(160, lo), _make_C(192, lo),
+                          _make_D(lo),
+                          _BlockE(lo), _BlockE(lo),
+                          nn.AvgPool2D(8, layout=lo), nn.Dropout(0.5),
+                          nn.Flatten())
         self.output = nn.Dense(classes)
 
     def forward(self, x):

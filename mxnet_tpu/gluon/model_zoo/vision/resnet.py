@@ -22,8 +22,7 @@ def _conv3x3(channels, stride, in_channels=0, layout="NCHW"):
                      use_bias=False, in_channels=in_channels, layout=layout)
 
 
-def _bn_axis(layout):
-    return 1 if layout.startswith("NC") else -1
+from ._common import bn_axis as _bn_axis
 
 
 class BasicBlockV1(HybridBlock):

@@ -4,6 +4,7 @@ from __future__ import annotations
 from ....base import MXNetError
 from ... import nn
 from ...block import HybridBlock
+from ._common import bn_axis as _bn_axis
 
 __all__ = ["VGG", "get_vgg", "vgg11", "vgg13", "vgg16", "vgg19",
            "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn"]
@@ -15,16 +16,19 @@ _SPEC = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
 
 
 class VGG(HybridBlock):
-    def __init__(self, layers, filters, classes=1000, batch_norm=False, **kw):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 layout="NCHW", **kw):
         super().__init__(**kw)
+        ax = _bn_axis(layout)
         self.features = nn.HybridSequential()
         for num, f in zip(layers, filters):
             for _ in range(num):
-                self.features.add(nn.Conv2D(f, 3, padding=1))
+                self.features.add(nn.Conv2D(f, 3, padding=1,
+                                            layout=layout))
                 if batch_norm:
-                    self.features.add(nn.BatchNorm())
+                    self.features.add(nn.BatchNorm(axis=ax))
                 self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(2, 2))
+            self.features.add(nn.MaxPool2D(2, 2, layout=layout))
         self.features.add(nn.Flatten(),
                           nn.Dense(4096, activation="relu"), nn.Dropout(0.5),
                           nn.Dense(4096, activation="relu"), nn.Dropout(0.5))

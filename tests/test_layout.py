@@ -137,3 +137,63 @@ def test_resnet_v2_nhwc_forward_shape():
     net.initialize(mx.init.Xavier())
     out = net(mx.np.zeros((2, 32, 32, 3)))
     assert out.shape == (2, 7)
+
+
+def _clone_params_to_nhwc(n1, n2):
+    """Copy NCHW params into the NHWC clone.  EVERY 4-D weight is a conv
+    kernel in these zoo models and needs OIHW->OHWI, including the
+    shape-colliding case in_channels == kernel size (vgg's 3x3x3 stem)
+    where a shape comparison cannot detect the permutation."""
+    p1d = dict(n1.collect_params().items())
+    p2d = dict(n2.collect_params().items())
+    assert set(p1d) == set(p2d)
+    for k, p in p1d.items():
+        v = p.data().asnumpy()
+        tgt = p2d[k]
+        if v.ndim == 4:
+            v = onp.transpose(v, (0, 2, 3, 1))
+        assert tuple(tgt.shape) == tuple(v.shape), k
+        tgt.set_data(mx.np.array(v))
+
+
+@pytest.mark.parametrize("model,size", [
+    ("vgg11", 32),       # 5 pool halvings: 32 -> 1x1 before Flatten
+    ("alexnet", 79),     # conv/pool chain lands on 1x1 at this size
+])
+def test_zoo_nhwc_matches_nchw(model, size):
+    """vgg/alexnet NHWC parity (round 4: layout threaded through the
+    whole zoo for the inference sweep).  Inputs collapse the final
+    spatial extent to 1x1 so Flatten ordering is layout-agnostic."""
+    mx.random.seed(2)
+    n1 = mx.gluon.model_zoo.get_model(model, classes=10)
+    n1.initialize(mx.init.Xavier())
+    n1(mx.np.zeros((2, 3, size, size)))
+    mx.random.seed(2)
+    n2 = mx.gluon.model_zoo.get_model(model, classes=10, layout="NHWC")
+    n2.initialize(mx.init.Xavier())
+    n2(mx.np.zeros((2, size, size, 3)))
+    _clone_params_to_nhwc(n1, n2)
+    x = _rand(2, 3, size, size, seed=5)
+    o1 = n1(mx.np.array(x)).asnumpy()
+    o2 = n2(mx.np.array(onp.transpose(x, (0, 2, 3, 1)))).asnumpy()
+    onp.testing.assert_allclose(o1, o2, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_inception_nhwc_matches_nchw():
+    """Inception v3 NHWC parity at its fixed 299 input (AvgPool(8)
+    collapses to 1x1 before Flatten, so ordering is layout-agnostic)."""
+    mx.random.seed(4)
+    n1 = mx.gluon.model_zoo.get_model("inceptionv3", classes=5)
+    n1.initialize(mx.init.Xavier())
+    n1(mx.np.zeros((1, 3, 299, 299)))
+    mx.random.seed(4)
+    n2 = mx.gluon.model_zoo.get_model("inceptionv3", classes=5,
+                                      layout="NHWC")
+    n2.initialize(mx.init.Xavier())
+    n2(mx.np.zeros((1, 299, 299, 3)))
+    _clone_params_to_nhwc(n1, n2)
+    x = _rand(1, 3, 299, 299, seed=6)
+    o1 = n1(mx.np.array(x)).asnumpy()
+    o2 = n2(mx.np.array(onp.transpose(x, (0, 2, 3, 1)))).asnumpy()
+    onp.testing.assert_allclose(o1, o2, rtol=5e-4, atol=5e-4)
