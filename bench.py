@@ -718,6 +718,11 @@ def _multichip_child(n):
                       "virtual_devices": virtual,
                       "vs_baseline": None if virtual else round(eff / 0.90,
                                                                 4),
+                      # n virtual devices share ONE physical core, so the
+                      # measurable efficiency ceiling is ~1/n — the number
+                      # validates the harness, not ICI scaling
+                      "virtual_efficiency_ceiling": (round(1.0 / n, 4)
+                                                     if virtual else None),
                       "configs": configs}))
     return 0
 

@@ -15,7 +15,11 @@ from ....base import MXNetError
 
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomCrop", "RandomFlipLeftRight",
-           "RandomFlipTopBottom", "Cast", "RandomBrightness", "RandomContrast"]
+           "RandomFlipTopBottom", "Cast", "RandomBrightness",
+           "RandomContrast", "RandomSaturation", "RandomHue",
+           "RandomColorJitter", "RandomGray", "RandomLighting", "Rotate",
+           "RandomRotation", "CropResize", "RandomApply", "HybridCompose",
+           "HybridRandomApply"]
 
 
 class Transform:
@@ -177,9 +181,10 @@ class RandomBrightness(Transform):
         self._b = brightness
 
     def __call__(self, x):
+        ceil = _value_ceiling(x)
         x = _onp.asarray(x, _onp.float32)
         f = 1.0 + _onp.random.uniform(-self._b, self._b)
-        return _onp.clip(x * f, 0, 255 if x.max() > 1.1 else 1.0)
+        return _onp.clip(x * f, 0, ceil)
 
 
 class RandomContrast(Transform):
@@ -187,7 +192,251 @@ class RandomContrast(Transform):
         self._c = contrast
 
     def __call__(self, x):
+        ceil = _value_ceiling(x)
         x = _onp.asarray(x, _onp.float32)
         f = 1.0 + _onp.random.uniform(-self._c, self._c)
         mean = x.mean()
-        return _onp.clip((x - mean) * f + mean, 0, 255 if x.max() > 1.1 else 1.0)
+        return _onp.clip((x - mean) * f + mean, 0, ceil)
+
+
+def _value_ceiling(ref):
+    """255 for uint8-origin images regardless of content (a near-black
+    uint8 frame must not be mistaken for a [0,1] float image), else the
+    value-range heuristic for floats."""
+    ref = _onp.asarray(ref)
+    if ref.dtype == _onp.uint8:
+        return 255.0
+    return 255.0 if float(ref.max()) > 1.1 else 1.0
+
+
+class RandomSaturation(Transform):
+    """Blend with per-pixel gray by a random factor 1±s
+    (ref transforms RandomSaturation)."""
+
+    _GRAY = _onp.array([0.299, 0.587, 0.114], _onp.float32)
+
+    def __init__(self, saturation: float):
+        self._s = saturation
+
+    def __call__(self, x):
+        ceil = _value_ceiling(x)
+        x = _onp.asarray(x, _onp.float32)
+        f = 1.0 + _onp.random.uniform(-self._s, self._s)
+        gray = (x[..., :3] @ self._GRAY)[..., None]
+        return _onp.clip(gray + (x - gray) * f, 0, ceil)
+
+
+class RandomHue(Transform):
+    """Rotate the hue by a random angle scaled by ``hue`` via the YIQ
+    rotation matrix (ref transforms RandomHue / image.HueJitterAug)."""
+
+    _T_YIQ = _onp.array([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], _onp.float32)
+    _T_RGB = _onp.linalg.inv(_T_YIQ).astype(_onp.float32)
+
+    def __init__(self, hue: float):
+        self._h = hue
+
+    def __call__(self, x):
+        ceil = _value_ceiling(x)
+        x = _onp.asarray(x, _onp.float32)
+        alpha = _onp.random.uniform(-self._h, self._h) * _onp.pi
+        c, s = _onp.cos(alpha), _onp.sin(alpha)
+        rot = _onp.array([[1, 0, 0], [0, c, -s], [0, s, c]], _onp.float32)
+        m = self._T_RGB @ rot @ self._T_YIQ
+        return _onp.clip(x @ m.T, 0, ceil)
+
+
+class RandomColorJitter(Transform):
+    """Brightness/contrast/saturation/hue jitter in random order
+    (ref transforms RandomColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def __call__(self, x):
+        order = _onp.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x
+
+
+class RandomGray(Transform):
+    """With probability p replace RGB with 3-channel luminance
+    (ref transforms RandomGray)."""
+
+    def __init__(self, p=0.5):
+        self._p = p
+
+    def __call__(self, x):
+        x = _onp.asarray(x)
+        if _onp.random.rand() >= self._p:
+            return x
+        gray = (x[..., :3].astype(_onp.float32)
+                @ RandomSaturation._GRAY)[..., None]
+        out = _onp.repeat(gray, 3, axis=-1)
+        return out.astype(x.dtype) if x.dtype == _onp.uint8 else out
+
+
+# ImageNet PCA lighting statistics (Krizhevsky et al. 2012)
+_PCA_EIGVAL = _onp.array([55.46, 4.794, 1.148], _onp.float32)
+_PCA_EIGVEC = _onp.array([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]], _onp.float32)
+
+
+class RandomLighting(Transform):
+    """AlexNet-style PCA color noise with stddev ``alpha``
+    (ref transforms RandomLighting)."""
+
+    def __init__(self, alpha: float):
+        self._alpha = alpha
+
+    def __call__(self, x):
+        ceil = _value_ceiling(x)
+        x = _onp.asarray(x, _onp.float32)
+        a = _onp.random.normal(0, self._alpha, size=3).astype(_onp.float32)
+        noise = _PCA_EIGVEC @ (a * _PCA_EIGVAL)
+        return _onp.clip(x + noise, 0, ceil)
+
+
+def _rotate_hwc(img, degrees, zoom_in=False, zoom_out=False):
+    """Bilinear rotation about the image center (numpy; the reference
+    rotates via the nd BilinearSampler — same math, host-side).  zoom_in
+    scales so no corner padding shows; zoom_out so the full rotated
+    frame fits."""
+    if zoom_in and zoom_out:
+        raise MXNetError("zoom_in and zoom_out are mutually exclusive")
+    img = _onp.asarray(img)
+    squeeze = img.ndim == 2
+    if squeeze:
+        img = img[:, :, None]
+    h, w = img.shape[:2]
+    rad = _onp.deg2rad(degrees)
+    c, s = _onp.cos(rad), _onp.sin(rad)
+    scale = 1.0
+    if zoom_in:
+        # magnify so only the inscribed same-aspect rectangle of the
+        # rotated frame is sampled — no corner padding can show; the
+        # inverse map samples a region of size out/scale, so zoom-IN
+        # needs scale > 1
+        scale = max(abs(c) + abs(s) * h / w, abs(c) + abs(s) * w / h)
+    elif zoom_out:
+        # shrink so the whole rotated bounding box fits in the frame
+        scale = min(w / (abs(c) * w + abs(s) * h),
+                    h / (abs(s) * w + abs(c) * h))
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    ys, xs = _onp.meshgrid(_onp.arange(h), _onp.arange(w), indexing="ij")
+    # inverse map: output pixel -> source location
+    dx = (xs - cx) / scale
+    dy = (ys - cy) / scale
+    sx = c * dx + s * dy + cx
+    sy = -s * dx + c * dy + cy
+    x0 = _onp.floor(sx).astype(int)
+    y0 = _onp.floor(sy).astype(int)
+    wx = (sx - x0)[..., None]
+    wy = (sy - y0)[..., None]
+    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+    x0c = _onp.clip(x0, 0, w - 1)
+    y0c = _onp.clip(y0, 0, h - 1)
+    x1c = _onp.clip(x0 + 1, 0, w - 1)
+    y1c = _onp.clip(y0 + 1, 0, h - 1)
+    f = img.astype(_onp.float32)
+    out = (f[y0c, x0c] * (1 - wx) * (1 - wy) + f[y0c, x1c] * wx * (1 - wy)
+           + f[y1c, x0c] * (1 - wx) * wy + f[y1c, x1c] * wx * wy)
+    out = _onp.where(valid[..., None], out, 0.0)
+    if img.dtype == _onp.uint8:
+        out = _onp.clip(out, 0, 255).astype(_onp.uint8)
+    if squeeze:
+        out = out[:, :, 0]
+    return out
+
+
+class Rotate(Transform):
+    """Fixed-angle rotation (ref transforms Rotate)."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        self._deg = rotation_degrees
+        self._zi = zoom_in
+        self._zo = zoom_out
+
+    def __call__(self, x):
+        return _rotate_hwc(x, self._deg, self._zi, self._zo)
+
+
+class RandomRotation(Transform):
+    """Random rotation inside ``angle_limits`` applied with probability
+    ``rotate_with_proba`` (ref transforms RandomRotation)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        if not 0.0 <= rotate_with_proba <= 1.0:
+            raise ValueError("rotate_with_proba must be in [0, 1]")
+        lo, hi = angle_limits
+        if lo >= hi:
+            raise ValueError("angle_limits must be (lower, upper) with "
+                             "lower < upper")
+        self._limits = (lo, hi)
+        self._zi = zoom_in
+        self._zo = zoom_out
+        self._p = rotate_with_proba
+
+    def __call__(self, x):
+        if _onp.random.rand() >= self._p:
+            return _onp.asarray(x)
+        deg = _onp.random.uniform(*self._limits)
+        return _rotate_hwc(x, deg, self._zi, self._zo)
+
+
+class CropResize(Transform):
+    """Fixed crop (x, y, w, h) then optional resize (ref transforms
+    CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        self._box = (int(x), int(y), int(width), int(height))
+        self._size = ((size, size) if isinstance(size, int)
+                      else tuple(size) if size is not None else None)
+
+    def __call__(self, img):
+        img = _onp.asarray(img)
+        x, y, w, h = self._box
+        if x < 0 or y < 0 or w <= 0 or h <= 0 or \
+                y + h > img.shape[0] or x + w > img.shape[1]:
+            raise MXNetError(
+                f"crop box {self._box} out of bounds for image "
+                f"{img.shape[1]}x{img.shape[0]}")
+        out = img[y:y + h, x:x + w]
+        if self._size is not None:
+            out = _resize_hwc(out, self._size)
+        return out
+
+
+class RandomApply(Transform):
+    """Apply a transform (or Compose of them) with probability ``p``
+    (ref transforms RandomApply)."""
+
+    def __init__(self, transforms, p=0.5):
+        self._t = (Compose(transforms)
+                   if isinstance(transforms, (list, tuple)) else transforms)
+        self._p = p
+
+    def __call__(self, x):
+        if _onp.random.rand() < self._p:
+            return self._t(x)
+        return _onp.asarray(x)
+
+
+# In this stack every transform is a host-side numpy callable — there is
+# no separate symbolic path to hybridize, so the Hybrid* names are the
+# same classes (ref keeps two parallel hierarchies over nd/sym).
+HybridCompose = Compose
+HybridRandomApply = RandomApply

@@ -1,0 +1,200 @@
+"""gluon.data.vision.transforms — the full reference transform set
+(ref tests/python/unittest/test_gluon_data_vision.py scenarios)."""
+import numpy as onp
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.data.vision import transforms as T
+
+_RS = onp.random.RandomState(11)
+
+
+def _img(h=12, w=10, dtype="uint8"):
+    img = _RS.randint(0, 255, (h, w, 3))
+    return img.astype(dtype)
+
+
+def test_to_tensor_and_normalize():
+    x = _img()
+    t = T.ToTensor()(x)
+    assert t.shape == (3, 12, 10) and t.dtype == onp.float32
+    assert t.max() <= 1.0
+    n = T.Normalize(mean=(0.5, 0.5, 0.5), std=(0.25, 0.5, 1.0))(t)
+    onp.testing.assert_allclose(n[0], (t[0] - 0.5) / 0.25, rtol=1e-6)
+
+
+def test_saturation_zero_is_identity():
+    onp.random.seed(0)
+    x = _img().astype("float32")
+    out = T.RandomSaturation(0.0)(x)
+    onp.testing.assert_allclose(out, x, atol=1e-3)
+
+
+def test_saturation_full_desaturation_matches_gray():
+    x = _img().astype("float32")
+
+    class Fixed(T.RandomSaturation):
+        def __call__(self, img):  # force factor 0 (full desaturate)
+            gray = (img[..., :3] @ self._GRAY)[..., None]
+            return gray + (img - gray) * 0.0
+
+    out = Fixed(1.0)(x)
+    want = onp.repeat((x @ [0.299, 0.587, 0.114])[..., None], 3, -1)
+    onp.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_hue_zero_is_identity():
+    onp.random.seed(0)
+    x = _img().astype("float32")
+    out = T.RandomHue(0.0)(x)
+    onp.testing.assert_allclose(out, x, atol=1e-2)
+
+
+def test_random_gray():
+    x = _img()
+    out = T.RandomGray(p=1.0)(x)
+    assert out.shape == x.shape
+    onp.testing.assert_array_equal(out[..., 0], out[..., 1])
+    onp.testing.assert_array_equal(out[..., 1], out[..., 2])
+    onp.testing.assert_array_equal(T.RandomGray(p=0.0)(x), x)
+
+
+def test_random_lighting_shifts_channels_uniformly():
+    onp.random.seed(3)
+    x = onp.full((6, 6, 3), 100.0, "float32")
+    out = T.RandomLighting(0.5)(x)
+    # PCA noise is a per-channel constant shift
+    for ch in range(3):
+        vals = out[..., ch]
+        assert onp.allclose(vals, vals[0, 0])
+    assert not onp.allclose(out, x)
+
+
+def test_rotate_identity_and_180():
+    x = _img(9, 9).astype("float32")
+    out0 = T.Rotate(0)(x)
+    onp.testing.assert_allclose(out0, x, atol=1e-4)
+    out180 = T.Rotate(180)(x)
+    onp.testing.assert_allclose(out180[1:-1, 1:-1], x[::-1, ::-1][1:-1, 1:-1],
+                                atol=1e-3)
+
+
+def test_rotate_90_matches_rot90():
+    x = _img(9, 9).astype("float32")
+    out = T.Rotate(90)(x)
+    onp.testing.assert_allclose(out[1:-1, 1:-1],
+                                onp.rot90(x, k=-1)[1:-1, 1:-1], atol=1e-3)
+
+
+def test_rotate_zoom_flags():
+    with pytest.raises(MXNetError):
+        T.Rotate(30, zoom_in=True, zoom_out=True)(_img())
+    # zoom variants still produce the input shape
+    assert T.Rotate(30, zoom_in=True)(_img()).shape == (12, 10, 3)
+    assert T.Rotate(30, zoom_out=True)(_img()).shape == (12, 10, 3)
+
+
+def test_rotate_zoom_in_shows_no_padding():
+    """zoom_in's contract: no rotation padding in the output (review
+    finding round 4: the scale was inverted and padding leaked)."""
+    x = onp.full((40, 40, 3), 255, "uint8")
+    out = T.Rotate(30, zoom_in=True)(x)
+    assert (out > 0).all(), f"{(out == 0).sum()} padding pixels leaked"
+    # plain rotation by contrast DOES pad corners
+    assert (T.Rotate(30)(x) == 0).any()
+
+
+def test_rotate_zoom_out_keeps_all_content():
+    """zoom_out shrinks so every source pixel lands inside the frame:
+    total mass is preserved up to interpolation loss."""
+    x = onp.zeros((30, 30, 1), "float32")
+    x[13:17, 13:17] = 100.0                  # center blob survives exactly
+    out = T.Rotate(45, zoom_out=True)(x)
+    # 45-degree zoom_out scales lengths by 1/sqrt(2): area (and thus
+    # integrated intensity) halves
+    assert out.sum() > 0.4 * x.sum()
+    # corners of the ORIGINAL frame stay visible: place mass at a corner
+    x2 = onp.zeros((30, 30, 1), "float32")
+    x2[:3, :3] = 100.0
+    out2 = T.Rotate(45, zoom_out=True)(x2)
+    assert out2.sum() > 0.3 * x2.sum()       # not rotated out of frame
+
+
+def test_dark_uint8_image_keeps_255_range():
+    """A near-black uint8 frame must still clip against 255, not 1.0
+    (review finding round 4)."""
+    onp.random.seed(5)
+    x = onp.ones((8, 8, 3), "uint8")         # max value 1 but uint8
+    out = T.RandomLighting(0.5)(x)
+    assert out.max() > 1.0 or not onp.allclose(out, 1.0)
+    out2 = T.RandomBrightness(0.4)(x.astype("uint8"))
+    assert out2.max() <= 255.0
+    # and genuinely-[0,1] float inputs still clip at 1.0
+    xf = onp.random.rand(8, 8, 3).astype("float32") * 0.5
+    outf = T.RandomBrightness(0.9)(xf)
+    assert outf.max() <= 1.0
+
+
+def test_crop_resize_rejects_negative_origin():
+    with pytest.raises(MXNetError):
+        T.CropResize(-5, 0, 4, 4)(_img(20, 16))
+    with pytest.raises(MXNetError):
+        T.CropResize(0, -1, 4, 4)(_img(20, 16))
+    with pytest.raises(MXNetError):
+        T.CropResize(0, 0, 0, 4)(_img(20, 16))
+
+
+def test_random_rotation_validation_and_proba():
+    with pytest.raises(ValueError):
+        T.RandomRotation((30, 10))
+    with pytest.raises(ValueError):
+        T.RandomRotation((-10, 10), rotate_with_proba=1.5)
+    x = _img()
+    onp.testing.assert_array_equal(
+        T.RandomRotation((-10, 10), rotate_with_proba=0.0)(x), x)
+    out = T.RandomRotation((-30, 30))(x)
+    assert out.shape == x.shape
+
+
+def test_crop_resize():
+    x = _img(20, 16)
+    out = T.CropResize(2, 3, 8, 10)(x)
+    onp.testing.assert_array_equal(out, x[3:13, 2:10])
+    out2 = T.CropResize(2, 3, 8, 10, size=(4, 5))(x)
+    assert out2.shape == (5, 4, 3)
+    with pytest.raises(MXNetError):
+        T.CropResize(10, 10, 10, 20)(x)
+
+
+def test_random_apply_and_color_jitter():
+    x = _img()
+    marker = []
+
+    class Probe(T.Transform):
+        def __call__(self, img):
+            marker.append(1)
+            return img
+
+    T.RandomApply([Probe()], p=1.0)(x)
+    assert marker == [1]
+    T.RandomApply(Probe(), p=0.0)(x)
+    assert marker == [1]
+
+    out = T.RandomColorJitter(brightness=0.3, contrast=0.3,
+                              saturation=0.3, hue=0.1)(x)
+    assert out.shape == x.shape and out.dtype == onp.float32
+    assert (out >= 0).all() and (out <= 255).all()
+
+
+def test_hybrid_aliases():
+    assert T.HybridCompose is T.Compose
+    assert T.HybridRandomApply is T.RandomApply
+
+
+def test_compose_chain_end_to_end():
+    chain = T.Compose([T.Resize(8), T.CenterCrop(6),
+                       T.RandomColorJitter(brightness=0.2),
+                       T.Cast("uint8"), T.RandomGray(p=1.0),
+                       T.ToTensor()])
+    out = chain(_img(32, 24))
+    assert out.shape == (3, 6, 6) and out.dtype == onp.float32
