@@ -199,6 +199,12 @@ class RandomContrast(Transform):
         return _onp.clip((x - mean) * f + mean, 0, ceil)
 
 
+def _is_gray(x):
+    """2-D images or single-channel HWC have no color to transform."""
+    x = _onp.asarray(x)
+    return x.ndim == 2 or (x.ndim == 3 and x.shape[-1] == 1)
+
+
 def _value_ceiling(ref):
     """255 for uint8-origin images regardless of content (a near-black
     uint8 frame must not be mistaken for a [0,1] float image), else the
@@ -219,6 +225,8 @@ class RandomSaturation(Transform):
         self._s = saturation
 
     def __call__(self, x):
+        if _is_gray(x):
+            return _onp.asarray(x)           # saturation of gray is gray
         ceil = _value_ceiling(x)
         x = _onp.asarray(x, _onp.float32)
         f = 1.0 + _onp.random.uniform(-self._s, self._s)
@@ -239,6 +247,8 @@ class RandomHue(Transform):
         self._h = hue
 
     def __call__(self, x):
+        if _is_gray(x):
+            return _onp.asarray(x)           # hue of gray is gray
         ceil = _value_ceiling(x)
         x = _onp.asarray(x, _onp.float32)
         alpha = _onp.random.uniform(-self._h, self._h) * _onp.pi
@@ -279,7 +289,7 @@ class RandomGray(Transform):
 
     def __call__(self, x):
         x = _onp.asarray(x)
-        if _onp.random.rand() >= self._p:
+        if _is_gray(x) or _onp.random.rand() >= self._p:
             return x
         gray = (x[..., :3].astype(_onp.float32)
                 @ RandomSaturation._GRAY)[..., None]
@@ -324,16 +334,20 @@ def _rotate_hwc(img, degrees, zoom_in=False, zoom_out=False):
     rad = _onp.deg2rad(degrees)
     c, s = _onp.cos(rad), _onp.sin(rad)
     scale = 1.0
+    # extents are pixel-center spans (w-1, h-1): the sampling grid runs
+    # 0..w-1, so a w/h-based scale under-magnifies and leaks corner
+    # padding on non-square images
+    we, he = max(w - 1, 1), max(h - 1, 1)
     if zoom_in:
         # magnify so only the inscribed same-aspect rectangle of the
         # rotated frame is sampled — no corner padding can show; the
         # inverse map samples a region of size out/scale, so zoom-IN
         # needs scale > 1
-        scale = max(abs(c) + abs(s) * h / w, abs(c) + abs(s) * w / h)
+        scale = max(abs(c) + abs(s) * he / we, abs(c) + abs(s) * we / he)
     elif zoom_out:
         # shrink so the whole rotated bounding box fits in the frame
-        scale = min(w / (abs(c) * w + abs(s) * h),
-                    h / (abs(s) * w + abs(c) * h))
+        scale = min(we / (abs(c) * we + abs(s) * he),
+                    he / (abs(s) * we + abs(c) * he))
     cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
     ys, xs = _onp.meshgrid(_onp.arange(h), _onp.arange(w), indexing="ij")
     # inverse map: output pixel -> source location

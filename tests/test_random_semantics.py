@@ -1,0 +1,151 @@
+"""Seed/determinism semantics of mx.random (ref tests/python/unittest/
+test_random.py: test_random_seed_setting, test_with_random_seed,
+generator bucket tests).  The divergence from per-device Philox streams
+(one global threaded key) is documented in docs/divergences.md; these
+tests pin the contract that IS promised: seeding is deterministic,
+state advances, and the jitted path keeps randomness live."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+
+np_ = mx.np
+
+
+def test_seed_reproduces_draws():
+    mx.random.seed(123)
+    a = np_.random.uniform(size=(50,)).asnumpy()
+    b = np_.random.uniform(size=(50,)).asnumpy()
+    mx.random.seed(123)
+    a2 = np_.random.uniform(size=(50,)).asnumpy()
+    b2 = np_.random.uniform(size=(50,)).asnumpy()
+    onp.testing.assert_array_equal(a, a2)
+    onp.testing.assert_array_equal(b, b2)
+    assert not onp.allclose(a, b)            # state advances between draws
+
+
+def test_different_seeds_differ():
+    mx.random.seed(1)
+    a = np_.random.normal(size=(64,)).asnumpy()
+    mx.random.seed(2)
+    b = np_.random.normal(size=(64,)).asnumpy()
+    assert not onp.allclose(a, b)
+
+
+def test_seed_spans_distributions():
+    """One seed pins the whole sequence across different samplers."""
+    mx.random.seed(7)
+    seq1 = [np_.random.uniform(size=(8,)).asnumpy(),
+            np_.random.normal(size=(8,)).asnumpy(),
+            np_.random.randint(0, 100, size=(8,)).asnumpy()]
+    mx.random.seed(7)
+    seq2 = [np_.random.uniform(size=(8,)).asnumpy(),
+            np_.random.normal(size=(8,)).asnumpy(),
+            np_.random.randint(0, 100, size=(8,)).asnumpy()]
+    for x, y in zip(seq1, seq2):
+        onp.testing.assert_array_equal(x, y)
+
+
+def test_seeded_initialization_is_reproducible():
+    def build():
+        net = nn.Dense(16, in_units=8)
+        net.initialize(mx.init.Xavier())
+        return net.weight.data().asnumpy()
+
+    mx.random.seed(42)
+    w1 = build()
+    mx.random.seed(42)
+    w2 = build()
+    onp.testing.assert_array_equal(w1, w2)
+    w3 = build()                              # no reseed: different draw
+    assert not onp.allclose(w1, w3)
+
+
+def test_dropout_stays_live_under_hybridize():
+    """The RNG key is a traced input of the jitted forward (gluon/block.py
+    docstring): repeated calls must sample fresh masks, and reseeding
+    must reproduce the mask SEQUENCE."""
+    from mxnet_tpu import autograd
+
+    net = nn.Dropout(0.5)
+    net.initialize()
+    net.hybridize()
+    x = np_.ones((4, 64))
+    mx.random.seed(9)
+    with autograd.record(train_mode=True):
+        m1 = net(x).asnumpy()
+        m2 = net(x).asnumpy()
+    assert not onp.allclose(m1, m2), "mask baked into the jit"
+    mx.random.seed(9)
+    with autograd.record(train_mode=True):
+        r1 = net(x).asnumpy()
+        r2 = net(x).asnumpy()
+    onp.testing.assert_array_equal(m1, r1)
+    onp.testing.assert_array_equal(m2, r2)
+
+
+def test_randint_bounds_and_dtype():
+    mx.random.seed(0)
+    draws = np_.random.randint(5, 11, size=(500,)).asnumpy()
+    assert draws.min() >= 5 and draws.max() <= 10
+    assert set(onp.unique(draws)) == set(range(5, 11))
+
+
+def _bucket_chi2(draws, cdf_buckets, probs):
+    """Chi-square statistic of draws against expected bucket probs
+    (ref test_random.py generator-test strategy)."""
+    counts, _ = onp.histogram(draws, bins=cdf_buckets)
+    n = len(draws)
+    expected = onp.asarray(probs) * n
+    return ((counts - expected) ** 2 / expected).sum()
+
+
+def test_uniform_generator_buckets():
+    mx.random.seed(5)
+    draws = np_.random.uniform(0, 1, size=(20000,)).asnumpy()
+    edges = onp.linspace(0, 1, 11)
+    chi2 = _bucket_chi2(draws, edges, onp.full(10, 0.1))
+    assert chi2 < 30, chi2                   # df=9, p~1e-3 cutoff
+
+
+def test_normal_generator_buckets():
+    special = pytest.importorskip("scipy.special")
+
+    mx.random.seed(6)
+    mu, sigma = 1.5, 2.0
+    draws = np_.random.normal(mu, sigma, size=(20000,)).asnumpy()
+    # quantile edges from the error function
+    qs = onp.linspace(0.1, 0.9, 9)
+    edges = mu + sigma * onp.sqrt(2) * special.erfinv(2 * qs - 1)
+    edges = onp.concatenate([[-onp.inf], edges, [onp.inf]])
+    chi2 = _bucket_chi2(draws, edges, onp.full(10, 0.1))
+    assert chi2 < 30, chi2
+
+
+def test_poisson_gamma_exponential_moments():
+    mx.random.seed(8)
+    n = 20000
+    p = np_.random.poisson(4.0, size=(n,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.1 and abs(p.var() - 4.0) < 0.3
+    g = np_.random.gamma(3.0, 2.0, size=(n,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.15 and abs(g.var() - 12.0) < 1.2
+    e = np_.random.exponential(0.5, size=(n,)).asnumpy()
+    assert abs(e.mean() - 0.5) < 0.02
+
+
+def test_multinomial_generator_frequencies():
+    mx.random.seed(10)
+    probs = onp.array([0.1, 0.2, 0.3, 0.4], "float32")
+    draws = np_.random.multinomial(1, probs, size=20000).asnumpy()
+    freq = draws.mean(axis=0)
+    onp.testing.assert_allclose(freq, probs, atol=0.02)
+
+
+def test_shuffle_reseeded_reproducible():
+    mx.random.seed(3)
+    a = np_.random.permutation(np_.arange(100)).asnumpy()
+    mx.random.seed(3)
+    b = np_.random.permutation(np_.arange(100)).asnumpy()
+    onp.testing.assert_array_equal(a, b)
+    assert sorted(a.tolist()) == list(range(100))

@@ -94,14 +94,30 @@ def test_rotate_zoom_flags():
     assert T.Rotate(30, zoom_out=True)(_img()).shape == (12, 10, 3)
 
 
-def test_rotate_zoom_in_shows_no_padding():
-    """zoom_in's contract: no rotation padding in the output (review
-    finding round 4: the scale was inverted and padding leaked)."""
-    x = onp.full((40, 40, 3), 255, "uint8")
-    out = T.Rotate(30, zoom_in=True)(x)
-    assert (out > 0).all(), f"{(out == 0).sum()} padding pixels leaked"
+@pytest.mark.parametrize("shape", [(40, 40, 3), (40, 20, 3), (17, 41, 3)])
+def test_rotate_zoom_in_shows_no_padding(shape):
+    """zoom_in's contract: no rotation padding in the output — square
+    AND non-square (review findings round 4: inverted scale; then
+    w/h-vs-pixel-extent off-by-one leaking on non-square images)."""
+    x = onp.full(shape, 255, "uint8")
+    for deg in (30, -75, 120):
+        out = T.Rotate(deg, zoom_in=True)(x)
+        assert (out > 0).all(), \
+            f"{(out == 0).sum()} padding pixels leaked at {deg} {shape}"
     # plain rotation by contrast DOES pad corners
     assert (T.Rotate(30)(x) == 0).any()
+
+
+def test_gray_transforms_pass_through_grayscale():
+    """2-D and single-channel images must not be column-sliced as RGB
+    (review finding round 4)."""
+    g2 = _RS.randint(0, 255, (8, 6)).astype("uint8")
+    onp.testing.assert_array_equal(T.RandomGray(p=1.0)(g2), g2)
+    onp.testing.assert_array_equal(T.RandomSaturation(0.9)(g2), g2)
+    onp.testing.assert_array_equal(T.RandomHue(0.5)(g2), g2)
+    g3 = g2[:, :, None]
+    assert T.RandomGray(p=1.0)(g3).shape == g3.shape
+    onp.testing.assert_array_equal(T.RandomSaturation(0.9)(g3), g3)
 
 
 def test_rotate_zoom_out_keeps_all_content():
