@@ -340,6 +340,8 @@ def nag_mom_update(weight, grad, mom, lr, momentum=0.9, wd=0.0,
 
 from . import random  # noqa: E402
 from . import linalg  # noqa: E402
+from . import image  # noqa: E402
+from . import contrib  # noqa: E402
 from .utils import save, load  # noqa: E402
 from . import sparse  # noqa: E402
 from ..dlpack import (to_dlpack_for_read, to_dlpack_for_write,  # noqa: E402

@@ -1,0 +1,204 @@
+"""Device-side image ops — the ``mx.nd.image`` namespace (ref
+python/mxnet/ndarray/image.py over src/operator/image/image_random.cc,
+crop.cc, resize.cc).
+
+Unlike ``mx.image`` (host-side PIL/numpy augmenters for the data
+pipeline), these run as jnp kernels on device arrays; the deterministic
+ops are jit/trace-safe.  The random variants draw their factors from the
+global mx RNG key EAGERLY (host-side, per call) — use them imperatively;
+inside a hybridized forward the drawn factor would bake into the trace
+(use the layer-level random ops, e.g. Dropout, whose keys thread through
+jit — gluon/block.py).  Images are HWC or NHWC, uint8 or float.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops.dispatch import call
+from . import NDArray
+
+__all__ = ["to_tensor", "normalize", "imresize", "resize", "crop",
+           "random_crop", "flip_left_right", "random_flip_left_right",
+           "flip_top_bottom", "random_flip_top_bottom",
+           "random_brightness", "random_contrast", "random_saturation"]
+
+
+def _hwc_axes(x):
+    """(h_axis, w_axis, c_axis) for HWC or NHWC input."""
+    if x.ndim == 3:
+        return 0, 1, 2
+    if x.ndim == 4:
+        return 1, 2, 3
+    raise MXNetError(f"expected HWC or NHWC image, got ndim={x.ndim}")
+
+
+def to_tensor(data):
+    """HWC/NHWC uint8 [0,255] -> CHW/NCHW float32 [0,1]
+    (ref _image_to_tensor)."""
+    def f(x):
+        h, w, c = _hwc_axes(x)
+        perm = ((2, 0, 1) if x.ndim == 3 else (0, 3, 1, 2))
+        return jnp.transpose(x.astype(jnp.float32) / 255.0, perm)
+
+    return call(f, (data,), {}, name="to_tensor")
+
+
+def normalize(data, mean, std=None):
+    """Channel-wise (x - mean) / std on CHW/NCHW float tensors
+    (ref _image_normalize)."""
+    def f(x):
+        m = jnp.asarray(mean, jnp.float32)
+        s = jnp.asarray(1.0 if std is None else std, jnp.float32)
+        shape = (-1,) + (1,) * (2)
+        return (x - m.reshape(shape)) / s.reshape(shape)
+
+    return call(f, (data,), {}, name="normalize")
+
+
+def resize(data, size, keep_ratio=False, interp=1):
+    """Bilinear (interp=1) or nearest (interp=0) resize of HWC/NHWC
+    images to ``size=(w, h)`` or square int (ref _image_resize)."""
+    out_w, out_h = (size, size) if isinstance(size, int) else tuple(size)
+
+    def f(x):
+        ha, wa, _ = _hwc_axes(x)
+        h, w = x.shape[ha], x.shape[wa]
+        tw, th = out_w, out_h
+        if keep_ratio:
+            s = min(tw / w, th / h)
+            tw, th = max(1, int(w * s)), max(1, int(h * s))
+        shape = list(x.shape)
+        shape[ha], shape[wa] = th, tw
+        method = "nearest" if interp == 0 else "linear"
+        out = jax.image.resize(x.astype(jnp.float32), shape, method=method)
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            out = jnp.clip(jnp.round(out), 0, 255).astype(x.dtype)
+        return out
+
+    return call(f, (data,), {}, name="resize")
+
+
+def imresize(src, w, h, interp=1):
+    """Positional (src, w, h) signature matching mx.image.imresize —
+    NOT an alias of ``resize`` whose second argument is a (w, h) pair."""
+    return resize(src, (int(w), int(h)), interp=interp)
+
+
+def crop(data, x, y, width, height):
+    """Fixed crop at (x, y) of size (width, height) (ref _image_crop)."""
+    def f(img):
+        ha, wa, _ = _hwc_axes(img)
+        if x < 0 or y < 0 or width <= 0 or height <= 0 or \
+                y + height > img.shape[ha] or x + width > img.shape[wa]:
+            raise MXNetError(
+                f"crop box ({x},{y},{width},{height}) out of bounds for "
+                f"image {img.shape}")
+        sl = [slice(None)] * img.ndim
+        sl[ha] = slice(y, y + height)
+        sl[wa] = slice(x, x + width)
+        return img[tuple(sl)]
+
+    return call(f, (data,), {}, name="crop")
+
+
+def _rand_ints(maxvals):
+    from ..random import next_key
+
+    key = next_key()
+    ks = jax.random.split(key, len(maxvals))
+    return [int(jax.random.randint(k, (), 0, m + 1))
+            for k, m in zip(ks, maxvals)]
+
+
+def random_crop(data, size):
+    """Random (w, h) crop; returns (cropped, (x, y, w, h)) like
+    mx.image.random_crop (ref _image_random_crop)."""
+    w, h = (size, size) if isinstance(size, int) else tuple(size)
+    arr = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
+    ha, wa, _ = _hwc_axes(arr._data)
+    ih, iw = arr.shape[ha], arr.shape[wa]
+    if w > iw or h > ih:
+        raise MXNetError(f"crop size {(w, h)} exceeds image {(iw, ih)}")
+    x0, y0 = _rand_ints([iw - w, ih - h])
+    return crop(arr, x0, y0, w, h), (x0, y0, w, h)
+
+
+def _flip(data, axis_sel):
+    def f(x):
+        ha, wa, _ = _hwc_axes(x)
+        return jnp.flip(x, axis=(wa if axis_sel == "lr" else ha))
+
+    return call(f, (data,), {}, name=f"flip_{axis_sel}")
+
+
+def flip_left_right(data):
+    return _flip(data, "lr")
+
+
+def flip_top_bottom(data):
+    return _flip(data, "tb")
+
+
+def _coin(p):
+    from ..random import next_key
+
+    return bool(jax.random.bernoulli(next_key(), p))
+
+
+def random_flip_left_right(data, p=0.5):
+    return _flip(data, "lr") if _coin(p) else \
+        (data if isinstance(data, NDArray) else NDArray(jnp.asarray(data)))
+
+
+def random_flip_top_bottom(data, p=0.5):
+    return _flip(data, "tb") if _coin(p) else \
+        (data if isinstance(data, NDArray) else NDArray(jnp.asarray(data)))
+
+
+def _jitter(data, lo, hi, fn):
+    from ..random import next_key
+
+    f = float(jax.random.uniform(next_key(), (), minval=lo, maxval=hi))
+
+    def g(x):
+        xf = x.astype(jnp.float32)
+        out = fn(xf, f)
+        ceil = 255.0 if jnp.issubdtype(x.dtype, jnp.integer) else None
+        if ceil is not None:
+            out = jnp.clip(out, 0, ceil).astype(x.dtype)
+        return out
+
+    return call(g, (data,), {}, name="color_jitter")
+
+
+def random_brightness(data, min_factor, max_factor):
+    """Scale by a random factor in [min, max] (ref
+    _image_random_brightness)."""
+    return _jitter(data, min_factor, max_factor, lambda x, f: x * f)
+
+
+def random_contrast(data, min_factor, max_factor):
+    """Blend with the mean by a random factor (ref
+    _image_random_contrast)."""
+    return _jitter(data, min_factor, max_factor,
+                   lambda x, f: (x - x.mean()) * f + x.mean())
+
+
+_GRAY = jnp.array([0.299, 0.587, 0.114], jnp.float32)
+
+
+def random_saturation(data, min_factor, max_factor):
+    """Blend with per-pixel luminance by a random factor (ref
+    _image_random_saturation).  Grayscale (C==1) passes through —
+    saturation of gray is gray."""
+    arr = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    if arr.shape[-1] == 1:
+        return data if isinstance(data, NDArray) else NDArray(arr)
+
+    def sat(x, f):
+        gray = (x[..., :3] @ _GRAY)[..., None]
+        return gray + (x - gray) * f
+
+    return _jitter(data, min_factor, max_factor, sat)

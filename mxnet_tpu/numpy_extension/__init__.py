@@ -1169,3 +1169,7 @@ def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype=None, device=None):
 __all__ += ["seed", "from_numpy", "from_dlpack", "to_dlpack_for_read",
             "to_dlpack_for_write", "savez", "bernoulli", "normal_n",
             "uniform_n"]
+
+from . import random  # noqa: E402  (npx.random namespace, ref npx/random.py)
+
+__all__ += ["random"]

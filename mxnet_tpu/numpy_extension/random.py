@@ -1,0 +1,10 @@
+"""npx.random namespace (ref python/mxnet/numpy_extension/random.py).
+
+Thin namespace over the sampler functions that already live on npx
+directly (``npx.bernoulli`` etc. — both spellings exist in the
+reference too)."""
+from __future__ import annotations
+
+from . import bernoulli, normal_n, seed, uniform_n
+
+__all__ = ["seed", "bernoulli", "uniform_n", "normal_n"]
