@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Train with the Estimator fit-loop — handlers do the bookkeeping.
+
+Counterpart of ref example usage of gluon.contrib.estimator: one
+Estimator.fit call wires gradient updates, metrics, validation,
+logging, checkpointing (with best-model tracking) and early stopping.
+
+Smoke run (CPU):
+  JAX_PLATFORMS=cpu python example/estimator_train.py --batches 60
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                               EarlyStoppingHandler,
+                                               Estimator)
+from mxnet_tpu.gluon.data import DataLoader
+from mxnet_tpu.gluon.data.vision import MNIST, transforms
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="lenet")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batches", type=int, default=None)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--ckpt-dir", default="/tmp/estimator_ckpt")
+    p.add_argument("--patience", type=int, default=3)
+    args = p.parse_args()
+    if not args.epochs and not args.batches:
+        args.epochs = 2
+
+    mx.random.seed(42)
+    train = DataLoader(
+        MNIST(train=True).transform_first(transforms.ToTensor()),
+        batch_size=args.batch_size, shuffle=True)
+    val = DataLoader(
+        MNIST(train=False).transform_first(transforms.ToTensor()),
+        batch_size=256)
+
+    net = mx.gluon.model_zoo.get_model(args.model)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": args.lr, "momentum": 0.9})
+    est = Estimator(net=net, loss=mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=trainer)
+
+    val_acc = [m for m in est.val_metrics if "accuracy" in m.name][0]
+    handlers = [
+        CheckpointHandler(model_dir=args.ckpt_dir, monitor=val_acc,
+                          save_best=True, max_checkpoints=2),
+        EarlyStoppingHandler(monitor=val_acc, patience=args.patience),
+    ]
+    est.fit(train_data=train, val_data=val, epochs=args.epochs,
+            batches=args.batches, event_handlers=handlers)
+    print("final:", dict(m.get() for m in est.val_metrics))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,14 +59,21 @@ def normalize(data, mean, std=None):
 
 def resize(data, size, keep_ratio=False, interp=1):
     """Bilinear (interp=1) or nearest (interp=0) resize of HWC/NHWC
-    images to ``size=(w, h)`` or square int (ref _image_resize)."""
+    images to ``size=(w, h)`` or int (ref _image_resize).  With
+    ``keep_ratio`` an int size scales the SHORT edge to ``size`` (the
+    reference's resize-short semantics); a (w, h) pair fits the image
+    inside that box."""
+    short_edge = isinstance(size, int) and keep_ratio
     out_w, out_h = (size, size) if isinstance(size, int) else tuple(size)
 
     def f(x):
         ha, wa, _ = _hwc_axes(x)
         h, w = x.shape[ha], x.shape[wa]
         tw, th = out_w, out_h
-        if keep_ratio:
+        if short_edge:
+            s = out_w / min(w, h)
+            tw, th = max(1, round(w * s)), max(1, round(h * s))
+        elif keep_ratio:
             s = min(tw / w, th / h)
             tw, th = max(1, int(w * s)), max(1, int(h * s))
         shape = list(x.shape)
@@ -179,14 +186,27 @@ def random_brightness(data, min_factor, max_factor):
     return _jitter(data, min_factor, max_factor, lambda x, f: x * f)
 
 
+_GRAY = (0.299, 0.587, 0.114)   # luminance weights; host constant so
+# importing the module never touches a device
+
+
+def _lum(x):
+    return x[..., :3] @ jnp.asarray(_GRAY, jnp.float32)
+
+
 def random_contrast(data, min_factor, max_factor):
-    """Blend with the mean by a random factor (ref
-    _image_random_contrast)."""
-    return _jitter(data, min_factor, max_factor,
-                   lambda x, f: (x - x.mean()) * f + x.mean())
+    """Blend toward the PER-IMAGE luminance mean by a random factor
+    (ref _image_random_contrast): batched inputs must not share one
+    batch-wide mean."""
+    def ctr(x, f):
+        lum = _lum(x)                  # (H, W) or (N, H, W)
+        if x.ndim == 4:
+            gray = lum.mean(axis=(1, 2))[:, None, None, None]
+        else:
+            gray = lum.mean()
+        return (x - gray) * f + gray
 
-
-_GRAY = jnp.array([0.299, 0.587, 0.114], jnp.float32)
+    return _jitter(data, min_factor, max_factor, ctr)
 
 
 def random_saturation(data, min_factor, max_factor):
@@ -198,7 +218,7 @@ def random_saturation(data, min_factor, max_factor):
         return data if isinstance(data, NDArray) else NDArray(arr)
 
     def sat(x, f):
-        gray = (x[..., :3] @ _GRAY)[..., None]
+        gray = _lum(x)[..., None]
         return gray + (x - gray) * f
 
     return _jitter(data, min_factor, max_factor, sat)

@@ -1171,5 +1171,6 @@ __all__ += ["seed", "from_numpy", "from_dlpack", "to_dlpack_for_read",
             "uniform_n"]
 
 from . import random  # noqa: E402  (npx.random namespace, ref npx/random.py)
+from . import image  # noqa: E402  (npx.image namespace, ref npx/image.py)
 
-__all__ += ["random"]
+__all__ += ["random", "image"]

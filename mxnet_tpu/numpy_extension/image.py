@@ -1,0 +1,17 @@
+"""npx.image namespace (ref python/mxnet/numpy_extension/image.py, which
+re-exports the image op surface).  The device-side kernels live in
+``mxnet_tpu.ndarray.image``; this namespace makes them reachable from
+npx like the reference."""
+from __future__ import annotations
+
+from ..ndarray.image import (crop, flip_left_right, flip_top_bottom,
+                             imresize, normalize, random_brightness,
+                             random_contrast, random_crop,
+                             random_flip_left_right,
+                             random_flip_top_bottom, random_saturation,
+                             resize, to_tensor)
+
+__all__ = ["to_tensor", "normalize", "imresize", "resize", "crop",
+           "random_crop", "flip_left_right", "random_flip_left_right",
+           "flip_top_bottom", "random_flip_top_bottom",
+           "random_brightness", "random_contrast", "random_saturation"]

@@ -54,6 +54,30 @@ def test_image_resize_semantics():
         near.asnumpy(), onp.repeat(onp.repeat(ramp, 2, 0), 2, 1))
 
 
+def test_image_resize_short_edge_semantics():
+    """keep_ratio with an int scales the SHORT edge (reference
+    resize-short; review finding round 4)."""
+    x = onp.zeros((4, 8, 3), "uint8")
+    out = mx.nd.image.resize(np_.array(x), 6, keep_ratio=True)
+    assert out.shape == (6, 12, 3)          # short edge 4 -> 6
+    # tuple size keeps fit-inside semantics
+    out2 = mx.nd.image.resize(np_.array(x), (6, 6), keep_ratio=True)
+    assert out2.shape == (3, 6, 3)
+
+
+def test_image_random_contrast_per_image_mean():
+    """Batched contrast must use each image's own luminance mean, not a
+    batch-wide mean (review finding round 4)."""
+    dark = onp.full((4, 4, 3), 20.0, "float32")
+    bright = onp.full((4, 4, 3), 230.0, "float32")
+    batch = onp.stack([dark, bright])
+    mx.random.seed(6)
+    out = mx.nd.image.random_contrast(np_.array(batch), 0.0, 0.0).asnumpy()
+    # factor 0 collapses each image to ITS OWN mean
+    onp.testing.assert_allclose(out[0], dark, atol=1e-3)
+    onp.testing.assert_allclose(out[1], bright, atol=1e-3)
+
+
 def test_image_flips():
     x = _img()
     lr = mx.nd.image.flip_left_right(np_.array(x))
