@@ -71,8 +71,10 @@ def resize(data, size, keep_ratio=False, interp=1):
         h, w = x.shape[ha], x.shape[wa]
         tw, th = out_w, out_h
         if short_edge:
+            # truncating int() like the reference kernel (and the
+            # fit-inside branch below) — round() drifts dims by 1
             s = out_w / min(w, h)
-            tw, th = max(1, round(w * s)), max(1, round(h * s))
+            tw, th = max(1, int(w * s)), max(1, int(h * s))
         elif keep_ratio:
             s = min(tw / w, th / h)
             tw, th = max(1, int(w * s)), max(1, int(h * s))

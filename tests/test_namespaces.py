@@ -161,6 +161,16 @@ def test_contrib_op_executes():
 
 # -- npx.random -------------------------------------------------------------
 
+def test_npx_image_namespace():
+    assert mx.npx.image.resize is mx.nd.image.resize
+    assert mx.npx.image.to_tensor is mx.nd.image.to_tensor
+    assert mx.npx.image.random_saturation is mx.nd.image.random_saturation
+    # short-edge resize truncates dims like the reference kernel
+    x = onp.zeros((3, 5, 3), "uint8")
+    out = mx.npx.image.resize(np_.array(x), 4, keep_ratio=True)
+    assert out.shape == (4, 6, 3)            # int(5*4/3) == 6, not 7
+
+
 def test_npx_random_namespace():
     assert mx.npx.random.bernoulli is mx.npx.bernoulli
     mx.npx.random.seed(5)

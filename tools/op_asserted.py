@@ -157,3 +157,29 @@ def main():
 
 if __name__ == "__main__":
     sys.exit(main())
+
+
+def gradient_ops(ref_names, tests_dir="tests"):
+    """{ref_op_name: True} for ops appearing in gradient-exercising test
+    files (check_numeric_gradient / backward() / autograd.grad corpus) —
+    textual attribution like asserted_ops, so an upper bound."""
+    import op_coverage
+
+    corpus = []
+    for fn in sorted(os.listdir(tests_dir)):
+        if not fn.endswith(".py") or fn in _EXCLUDE_FILES:
+            continue
+        with open(os.path.join(tests_dir, fn)) as f:
+            text = f.read()
+        if ("check_numeric_gradient" in text or "backward()" in text
+                or "autograd.grad" in text):
+            corpus.append(text)
+    hits = {}
+    for name in ref_names:
+        cands = {c for c in op_coverage._strip(name) if len(c) >= 2}
+        strpats = [re.compile(r"['\"]" + re.escape(c) + r"['\"]")
+                   for c in cands | {name}]
+        if any(any(_uses_op(t, c) for c in cands)
+               or any(p.search(t) for p in strpats) for t in corpus):
+            hits[name] = True
+    return hits

@@ -220,6 +220,7 @@ def main():
     executed = op_smoke.run_smoke(sorted(ref))
     upper = op_asserted.asserted_ops(sorted(ref))
     asserted = op_asserted.asserted_ops(sorted(ref), strict=True)
+    grads = op_asserted.gradient_ops(sorted(ref))
     by_cat = defaultdict(lambda: [0, 0, [], 0, [], 0, []])
     for name in sorted(ref):
         cat = categorize(name)
@@ -269,6 +270,13 @@ def main():
              f"(includes fixture-building uses) gives the upper bound "
              f"{len(upper)}/{total} ({100 * len(upper) / total:.1f}%). "
              f"Both by tools/op_asserted.py.", "",
+             f"**Gradient-exercised: {len(grads)}/{total} "
+             f"({100 * len(grads) / total:.1f}%)** — op appears in a "
+             f"gradient-checking file (FD sweeps in test_op_gradients/"
+             f"test_numpy_op, tape tests); the remainder is dominated by "
+             f"non-differentiable surface (optimizer update kernels, "
+             f"init/shape/int ops, samplers), which the reference does "
+             f"not FD-check either.", "",
              "| category | covered | executed | asserted | total | pct |",
              "|---|---|---|---|---|---|"]
     for cat in sorted(by_cat):
