@@ -71,10 +71,14 @@ def resize(data, size, keep_ratio=False, interp=1):
         h, w = x.shape[ha], x.shape[wa]
         tw, th = out_w, out_h
         if short_edge:
-            # truncating int() like the reference kernel (and the
-            # fit-inside branch below) — round() drifts dims by 1
-            s = out_w / min(w, h)
-            tw, th = max(1, int(w * s)), max(1, int(h * s))
+            # reference kernel semantics (resize-inl.h GetHeightAndWidth):
+            # the SHORT edge lands on exactly `size`; the long edge is
+            # integer-scaled, long * size // short
+            size = out_w
+            if w <= h:
+                tw, th = size, max(1, h * size // w)
+            else:
+                tw, th = max(1, w * size // h), size
         elif keep_ratio:
             s = min(tw / w, th / h)
             tw, th = max(1, int(w * s)), max(1, int(h * s))

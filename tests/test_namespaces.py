@@ -165,10 +165,19 @@ def test_npx_image_namespace():
     assert mx.npx.image.resize is mx.nd.image.resize
     assert mx.npx.image.to_tensor is mx.nd.image.to_tensor
     assert mx.npx.image.random_saturation is mx.nd.image.random_saturation
-    # short-edge resize truncates dims like the reference kernel
+    # short-edge resize: short edge EXACTLY size, long edge integer-
+    # scaled long*size//short (ref resize-inl.h GetHeightAndWidth)
     x = onp.zeros((3, 5, 3), "uint8")
     out = mx.npx.image.resize(np_.array(x), 4, keep_ratio=True)
-    assert out.shape == (4, 6, 3)            # int(5*4/3) == 6, not 7
+    assert out.shape == (4, 6, 3)            # 5*4//3 == 6
+    for (h, w, size) in ((7, 100, 61), (5, 15, 41), (100, 7, 61)):
+        out = mx.npx.image.resize(
+            np_.array(onp.zeros((h, w, 1), "uint8")), size,
+            keep_ratio=True)
+        oh, ow = out.shape[:2]
+        assert min(oh, ow) == size, (h, w, size, out.shape)
+        long_in, long_out = max(h, w), max(oh, ow)
+        assert long_out == long_in * size // min(h, w), out.shape
 
 
 def test_npx_random_namespace():

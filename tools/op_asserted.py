@@ -109,21 +109,14 @@ def asserted_ops(ref_names, tests_dir="tests", strict=False):
     strict=True (lower bound): only hits in the dedicated per-op suites
     (_DEDICATED_FILES) count, where calls exist to be value-checked.
     """
-    import op_coverage
-
     corpus = test_corpus(tests_dir)
     if strict:
         corpus = [(fn, t) for fn, t in corpus if fn in _DEDICATED_FILES]
     hits = {}
     for name in ref_names:
-        cands = {c for c in op_coverage._strip(name) if len(c) >= 2}
         # registry-name strings count too (symbol JSON tests drive ops by
-        # their reference names)
-        strpats = [re.compile(r"['\"]" + re.escape(c) + r"['\"]")
-                   for c in cands | {name}]
-        files = [fn for fn, text in corpus
-                 if any(_uses_op(text, c) for c in cands)
-                 or any(p.search(text) for p in strpats)]
+        # their reference names) — _matches covers both spellings
+        files = [fn for fn, text in corpus if _matches(name, [text])]
         if files:
             hits[name] = files
     return hits
@@ -155,31 +148,27 @@ def main():
     return 0
 
 
-if __name__ == "__main__":
-    sys.exit(main())
+def _matches(name, texts):
+    """Shared name-attribution used by asserted_ops and gradient_ops:
+    framework-namespace calls or quoted registry-name strings."""
+    import op_coverage
+
+    cands = {c for c in op_coverage._strip(name) if len(c) >= 2}
+    strpats = [re.compile(r"['\"]" + re.escape(c) + r"['\"]")
+               for c in cands | {name}]
+    return any(any(_uses_op(t, c) for c in cands)
+               or any(p.search(t) for p in strpats) for t in texts)
 
 
 def gradient_ops(ref_names, tests_dir="tests"):
     """{ref_op_name: True} for ops appearing in gradient-exercising test
     files (check_numeric_gradient / backward() / autograd.grad corpus) —
     textual attribution like asserted_ops, so an upper bound."""
-    import op_coverage
+    corpus = [t for _fn, t in test_corpus(tests_dir)
+              if ("check_numeric_gradient" in t or "backward()" in t
+                  or "autograd.grad" in t)]
+    return {name: True for name in ref_names if _matches(name, corpus)}
 
-    corpus = []
-    for fn in sorted(os.listdir(tests_dir)):
-        if not fn.endswith(".py") or fn in _EXCLUDE_FILES:
-            continue
-        with open(os.path.join(tests_dir, fn)) as f:
-            text = f.read()
-        if ("check_numeric_gradient" in text or "backward()" in text
-                or "autograd.grad" in text):
-            corpus.append(text)
-    hits = {}
-    for name in ref_names:
-        cands = {c for c in op_coverage._strip(name) if len(c) >= 2}
-        strpats = [re.compile(r"['\"]" + re.escape(c) + r"['\"]")
-                   for c in cands | {name}]
-        if any(any(_uses_op(t, c) for c in cands)
-               or any(p.search(t) for p in strpats) for t in corpus):
-            hits[name] = True
-    return hits
+
+if __name__ == "__main__":
+    sys.exit(main())
