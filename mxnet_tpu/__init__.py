@@ -63,6 +63,7 @@ from . import image as img  # legacy alias: mx.img (ref python/mxnet/__init__.py
 from . import executor
 from . import libinfo
 from . import log
+from . import notebook
 from . import profiler
 from . import registry
 from . import runtime

@@ -1,0 +1,4 @@
+"""Notebook helpers (ref python/mxnet/notebook/__init__.py)."""
+from . import callback
+
+__all__ = ["callback"]

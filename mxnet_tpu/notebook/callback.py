@@ -1,0 +1,124 @@
+"""Notebook training-progress callbacks (ref python/mxnet/notebook/
+callback.py).
+
+``PandasLogger`` accumulates train/eval metric rows into pandas
+DataFrames through the ``BatchEndParam`` callback protocol
+(mx.callback); the Live*Chart classes need bokeh, which this
+environment does not ship, so they raise a clear ImportError at
+construction instead of failing deep inside a plotting call.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["PandasLogger", "LiveBokehChart", "LiveTimeSeries",
+           "LiveLearningCurve", "args_wrapper"]
+
+
+def _require_pandas():
+    try:
+        import pandas as pd
+    except ImportError as e:  # pragma: no cover - env always has pandas
+        raise ImportError("PandasLogger needs pandas") from e
+    return pd
+
+
+class PandasLogger:
+    """Collect metric values per batch/epoch into DataFrames
+    (ref notebook/callback.py PandasLogger).
+
+    Use ``.train_cb(frequent)`` as a batch-end callback and
+    ``.epoch_cb()`` at epoch end; ``.append_metrics(dict, 'eval')``
+    records validation rows.  ``.train_df`` / ``.eval_df`` are pandas
+    DataFrames, one row per recorded observation.
+    """
+
+    def __init__(self, batch_size=None, frequent=50):
+        self._pd = _require_pandas()
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self._dataframes = {"train": self._pd.DataFrame(),
+                            "eval": self._pd.DataFrame()}
+        self._start = time.time()
+        self.last_time = self._start
+
+    @property
+    def train_df(self):
+        return self._dataframes["train"]
+
+    @property
+    def eval_df(self):
+        return self._dataframes["eval"]
+
+    def append_metrics(self, metrics, df_name):
+        """Append one observation row (dict of column -> value)."""
+        row = dict(metrics)
+        row.setdefault("elapsed", time.time() - self._start)
+        df = self._dataframes[df_name]
+        self._dataframes[df_name] = self._pd.concat(
+            [df, self._pd.DataFrame([row])], ignore_index=True)
+
+    def train_cb(self, param):
+        """Batch-end callback: records every ``frequent`` batches."""
+        if param.nbatch % max(1, self.frequent) != 0:
+            return
+        if param.eval_metric is None:
+            return
+        metrics = dict(param.eval_metric.get_name_value())
+        metrics["epoch"] = param.epoch
+        metrics["nbatch"] = param.nbatch
+        if self.batch_size:
+            now = time.time()
+            dt = max(now - self.last_time, 1e-9)
+            metrics["samples_per_sec"] = (self.frequent *
+                                          self.batch_size) / dt
+            self.last_time = now
+        self.append_metrics(metrics, "train")
+
+    def epoch_cb(self):
+        """Epoch-end hook: stamps a timing row into the train frame."""
+        self.append_metrics({"epoch_elapsed":
+                             time.time() - self._start}, "train")
+
+    def eval_cb(self, param):
+        """Eval batch-end callback: records validation metric values."""
+        if param.eval_metric is None:
+            return
+        metrics = dict(param.eval_metric.get_name_value())
+        metrics["epoch"] = param.epoch
+        self.append_metrics(metrics, "eval")
+
+
+class LiveBokehChart:
+    """Live-updating chart base — requires bokeh, which is not available
+    in this environment (ref notebook/callback.py LiveBokehChart)."""
+
+    def __init__(self, *args, **kwargs):
+        raise ImportError(
+            "Live charts need the 'bokeh' package, which is not "
+            "installed in this environment; use PandasLogger and plot "
+            "its train_df/eval_df with any available plotting library")
+
+
+class LiveTimeSeries(LiveBokehChart):
+    pass
+
+
+class LiveLearningCurve(LiveBokehChart):
+    pass
+
+
+def args_wrapper(*callbacks):
+    """Bundle several loggers into (batch_end, eval_end) callback pairs
+    (ref notebook/callback.py args_wrapper)."""
+    def batch_end(param):
+        for cb in callbacks:
+            if hasattr(cb, "train_cb"):
+                cb.train_cb(param)
+
+    def eval_end(param):
+        for cb in callbacks:
+            if hasattr(cb, "eval_cb"):
+                cb.eval_cb(param)
+
+    return batch_end, eval_end

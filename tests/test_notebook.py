@@ -1,0 +1,49 @@
+"""mx.notebook.callback (ref python/mxnet/notebook/callback.py):
+PandasLogger dataframe accumulation + gated live charts."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.callback import BatchEndParam
+from mxnet_tpu.gluon.metric import Accuracy
+
+
+def _param(epoch=0, nbatch=1):
+    acc = Accuracy()
+    acc.update(mx.np.array(onp.array([1, 0])),
+               mx.np.array(onp.eye(2, dtype="float32")[[1, 0]]))
+    return BatchEndParam(epoch=epoch, nbatch=nbatch, eval_metric=acc,
+                         locals=None)
+
+
+def test_pandas_logger_accumulates_rows():
+    pd = pytest.importorskip("pandas")
+    log = mx.notebook.callback.PandasLogger(batch_size=8, frequent=2)
+    log.train_cb(_param(nbatch=2))
+    log.train_cb(_param(nbatch=3))          # off-frequency: skipped
+    log.train_cb(_param(epoch=1, nbatch=4))
+    log.eval_cb(_param(epoch=1))
+    log.epoch_cb()
+    assert isinstance(log.train_df, pd.DataFrame)
+    assert len(log.train_df) == 3           # 2 train rows + epoch stamp
+    assert len(log.eval_df) == 1
+    assert "accuracy" in log.train_df.columns
+    assert (log.train_df["accuracy"].dropna() == 1.0).all()
+    assert "samples_per_sec" in log.train_df.columns
+    assert "elapsed" in log.eval_df.columns
+
+
+def test_live_charts_are_gated():
+    for cls in (mx.notebook.callback.LiveBokehChart,
+                mx.notebook.callback.LiveTimeSeries,
+                mx.notebook.callback.LiveLearningCurve):
+        with pytest.raises(ImportError):
+            cls()
+
+
+def test_args_wrapper_bundles_callbacks():
+    log = mx.notebook.callback.PandasLogger(frequent=1)
+    batch_end, eval_end = mx.notebook.callback.args_wrapper(log)
+    batch_end(_param())
+    eval_end(_param())
+    assert len(log.train_df) == 1 and len(log.eval_df) == 1

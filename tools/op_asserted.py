@@ -115,8 +115,9 @@ def asserted_ops(ref_names, tests_dir="tests", strict=False):
     hits = {}
     for name in ref_names:
         # registry-name strings count too (symbol JSON tests drive ops by
-        # their reference names) — _matches covers both spellings
-        files = [fn for fn, text in corpus if _matches(name, [text])]
+        # their reference names) — the predicate covers both spellings
+        pred = _matcher(name)
+        files = [fn for fn, text in corpus if pred(text)]
         if files:
             hits[name] = files
     return hits
@@ -148,26 +149,38 @@ def main():
     return 0
 
 
-def _matches(name, texts):
-    """Shared name-attribution used by asserted_ops and gradient_ops:
-    framework-namespace calls or quoted registry-name strings."""
+def _matcher(name):
+    """Per-name attribution predicate shared by asserted_ops and
+    gradient_ops: framework-namespace calls or quoted registry-name
+    strings.  Built ONCE per name — the candidate set and compiled
+    regexes are reused across every file checked."""
     import op_coverage
 
     cands = {c for c in op_coverage._strip(name) if len(c) >= 2}
     strpats = [re.compile(r"['\"]" + re.escape(c) + r"['\"]")
                for c in cands | {name}]
-    return any(any(_uses_op(t, c) for c in cands)
-               or any(p.search(t) for p in strpats) for t in texts)
+
+    def pred(text):
+        return any(_uses_op(text, c) for c in cands) or \
+            any(p.search(text) for p in strpats)
+
+    return pred
 
 
 def gradient_ops(ref_names, tests_dir="tests"):
-    """{ref_op_name: True} for ops appearing in gradient-exercising test
-    files (check_numeric_gradient / backward() / autograd.grad corpus) —
+    """{ref_op_name: True} for ops appearing in gradient-exercising
+    files of the numerically-asserting corpus (test_corpus) that also
+    contain check_numeric_gradient / backward() / autograd.grad —
     textual attribution like asserted_ops, so an upper bound."""
     corpus = [t for _fn, t in test_corpus(tests_dir)
               if ("check_numeric_gradient" in t or "backward()" in t
                   or "autograd.grad" in t)]
-    return {name: True for name in ref_names if _matches(name, corpus)}
+    out = {}
+    for name in ref_names:
+        pred = _matcher(name)
+        if any(pred(t) for t in corpus):
+            out[name] = True
+    return out
 
 
 if __name__ == "__main__":
