@@ -213,6 +213,66 @@ def build_catalog(mx):
             {"input_dim": 1000, "output_dim": 256}),
     }
 
+    # fill gaps in the EXISTING categories rather than duplicating them
+    # (manipulation/sort_search already time the common rearrange ops)
+    cat["manipulation"]["broadcast_to"] = (
+        np_op("broadcast_to"), [_arr((1, 1024))], {"shape": (512, 1024)})
+    cat["manipulation"]["pad"] = (
+        lambda a: np_.pad(a, ((8, 8), (8, 8))), [_arr((512, 512))], {})
+    cat["manipulation"]["depth_to_space"] = (
+        getattr(npx, "depth_to_space", None),
+        [_arr((32, 64, 28, 28))], {"block_size": 2})
+    cat["manipulation"]["space_to_depth"] = (
+        getattr(npx, "space_to_depth", None),
+        [_arr((32, 16, 56, 56))], {"block_size": 2})
+    cat["sort_search"]["argmax"] = (np_op("argmax"), [_arr((64, 4096))],
+                                    {"axis": -1})
+    cat["sort_search"]["argmin"] = (np_op("argmin"), [_arr((64, 4096))],
+                                    {"axis": -1})
+
+    cat["indexing"] = {
+        "take": (np_op("take"), [_arr((1024, 256)),
+                                 _iarr((512,), hi=1024)], {"axis": 0}),
+        "one_hot": (getattr(npx, "one_hot", None),
+                    [_iarr((4096,), hi=1000)], {"depth": 1000}),
+        "pick": (getattr(npx, "pick", None),
+                 [_arr((4096, 1000)), _iarr((4096,), hi=1000)], {}),
+        "gather_nd": (getattr(npx, "gather_nd", None),
+                      [_arr((512, 512)), _iarr((2, 1024), hi=512)], {}),
+        "boolean_mask": (getattr(npx, "boolean_mask", None),
+                         [_arr((1024, 256)), _iarr((1024,), hi=2)], {}),
+    }
+
+    cat["nn_loss"] = {
+        "softmax_cross_entropy": (
+            getattr(npx, "softmax_cross_entropy", None),
+            [_arr((512, 1000)), _iarr((512,), hi=1000)], {}),
+        "smooth_l1": (getattr(npx, "smooth_l1", None),
+                      [_arr((512, 1000))], {"scalar": 1.0}),
+        "l2_normalization": (getattr(npx, "l2_normalization", None),
+                             [_arr((512, 1000))], {}),
+    }
+
+    def nd_op(name):
+        return getattr(mx.nd, name, None)
+
+    _w, _g = (256, 1024), (256, 1024)
+    cat["nn_optimizer"] = {
+        "sgd_update": (nd_op("sgd_update"), [_arr(_w), _arr(_g)],
+                       {"lr": 0.1}),
+        "sgd_mom_update": (nd_op("sgd_mom_update"),
+                           [_arr(_w), _arr(_g), _arr(_w)],
+                           {"lr": 0.1, "momentum": 0.9}),
+        "adam_update": (nd_op("adam_update"),
+                        [_arr(_w), _arr(_g), _arr(_w),
+                         _arr(_w, positive=True)], {"lr": 0.001}),
+        "rmsprop_update": (nd_op("rmsprop_update"),
+                           [_arr(_w), _arr(_g), _arr(_w, positive=True)],
+                           {"lr": 0.001}),
+        "signsgd_update": (nd_op("signsgd_update"), [_arr(_w), _arr(_g)],
+                           {"lr": 0.01}),
+    }
+
     cat["nn_conv"] = {
         "convolution": (
             getattr(npx, "convolution", None),
