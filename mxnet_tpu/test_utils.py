@@ -117,6 +117,76 @@ def check_symbolic_forward(fn, inputs, expected, rtol=1e-5, atol=1e-20):
         assert_almost_equal(o, e, rtol=rtol, atol=atol)
 
 
+def check_symbolic_backward(fn, inputs, out_grads, expected, rtol=1e-5,
+                            atol=1e-20):
+    """Tape gradients of ``fn`` w.r.t. every input vs ``expected``
+    (ref test_utils check_symbolic_backward; executor semantics)."""
+    from . import autograd
+    from . import np as _np
+
+    arrs = [x if isinstance(x, NDArray) else _np.array(x) for x in inputs]
+    grads = [_np.zeros(a.shape) for a in arrs]
+    autograd.mark_variables(arrs, grads)
+    with autograd.record():
+        out = fn(*arrs)
+    heads = list(out) if isinstance(out, (list, tuple)) else [out]
+    hg = None
+    if out_grads is not None:
+        hg = [g if isinstance(g, NDArray) else _np.array(g)
+              for g in (out_grads if isinstance(out_grads, (list, tuple))
+                        else [out_grads])]
+    autograd.backward(heads, head_grads=hg)
+    for g, e in zip(grads, expected):
+        if e is None:
+            continue
+        assert_almost_equal(g, e, rtol=rtol, atol=atol)
+    return grads
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """``f(*args, **kwargs)`` must raise ``exception_type``
+    (ref test_utils assert_exception)."""
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(
+        f"did not raise {exception_type.__name__}")
+
+
+def same_array(arr1, arr2) -> bool:
+    """True when two NDArray handles are backed by the same buffer.
+
+    Divergence from the reference probe (bump one, observe the other):
+    on this backend ``__setitem__`` functionally REBINDS the handle's
+    device array (immutability of jax.Array), so a mutation through one
+    wrapper is never observable through another — buffer identity is
+    the correct aliasing test here (docs/divergences.md copy-not-view).
+    """
+    return arr1 is arr2 or arr1._data is arr2._data
+
+
+def rand_sparse_ndarray(shape, stype, density=0.5, dtype=_onp.float32,
+                        rng=None):
+    """(sparse_nd, dense_numpy) with the requested density
+    (ref test_utils rand_sparse_ndarray).  Draws from the GLOBAL numpy
+    RNG by default so the suite's seed machinery governs the data and
+    repeated calls differ; pass ``rng`` for an isolated stream."""
+    from .ndarray import sparse as _sparse
+
+    rs = rng if rng is not None else _onp.random
+    dense = rs.rand(*shape).astype(dtype)
+    if stype == "row_sparse":
+        keep = rs.rand(shape[0]) < density
+        dense[~keep] = 0
+        return _sparse.row_sparse_array(dense, dtype=dtype), dense
+    if stype == "csr":
+        mask = rs.rand(*shape) < density
+        dense = dense * mask
+        return _sparse.csr_matrix(dense, dtype=dtype), dense
+    raise ValueError(f"unknown stype {stype!r}")
+
+
 def discard_stderr(fn):
     return fn
 

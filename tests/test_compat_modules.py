@@ -222,3 +222,57 @@ def test_npx_utils_surface(tmp_path):
     assert loaded["x"].shape == (2, 2) and loaded["y"].shape == (3,)
     e = mx.npx.from_dlpack(mx.npx.to_dlpack_for_read(d))
     onp.testing.assert_allclose(e.asnumpy(), onp.eye(2))
+
+
+# -- test_utils completions (ref python/mxnet/test_utils.py) ----------------
+
+def test_check_symbolic_backward_dot():
+    import numpy as onp
+    from mxnet_tpu import test_utils as tu
+
+    a = onp.random.RandomState(0).rand(3, 4).astype("float32")
+    b = onp.random.RandomState(1).rand(4, 2).astype("float32")
+    og = onp.ones((3, 2), "float32")
+    grads = tu.check_symbolic_backward(
+        lambda x, y: mx.np.dot(x, y), [a, b], og,
+        [og @ b.T, a.T @ og], rtol=1e-4, atol=1e-5)
+    assert len(grads) == 2
+
+
+def test_assert_exception_and_same_array():
+    import numpy as onp
+    import pytest
+    from mxnet_tpu import test_utils as tu
+
+    tu.assert_exception(lambda: 1 / 0, ZeroDivisionError)
+    with pytest.raises(AssertionError):
+        tu.assert_exception(lambda: None, ValueError)
+    x = mx.np.array(onp.ones((2, 2), "float32"))
+    assert tu.same_array(x, x)
+    assert tu.same_array(x, x.detach())     # second wrapper, same buffer
+    assert not tu.same_array(x, mx.np.array(onp.ones((2, 2), "float32")))
+    # probe is identity-based: no value disturbance at all
+    assert float(x.asnumpy().sum()) == 4.0
+
+
+def test_rand_sparse_ndarray_roundtrip():
+    import numpy as onp
+    from mxnet_tpu import test_utils as tu
+
+    rsp, dense = tu.rand_sparse_ndarray((6, 4), "row_sparse", density=0.5)
+    onp.testing.assert_allclose(rsp.todense().asnumpy(), dense, rtol=1e-6)
+    csr, dense2 = tu.rand_sparse_ndarray((5, 7), "csr", density=0.3)
+    onp.testing.assert_allclose(csr.todense().asnumpy(), dense2,
+                                rtol=1e-6)
+    assert (dense2 == 0).any()              # density actually applied
+    # fresh draws differ call to call (global RNG, not a pinned seed)
+    a, _ = tu.rand_sparse_ndarray((8, 8), "csr")
+    b, _ = tu.rand_sparse_ndarray((8, 8), "csr")
+    assert not onp.allclose(a.todense().asnumpy(),
+                            b.todense().asnumpy())
+    # isolated stream when requested
+    r1, d1 = tu.rand_sparse_ndarray((4, 4), "csr",
+                                    rng=onp.random.RandomState(3))
+    r2, d2 = tu.rand_sparse_ndarray((4, 4), "csr",
+                                    rng=onp.random.RandomState(3))
+    onp.testing.assert_allclose(d1, d2)
