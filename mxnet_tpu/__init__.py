@@ -42,6 +42,7 @@ from . import numpy_extension
 from . import numpy_extension as npx
 from . import autograd
 from . import random
+from . import random as rnd  # ref alias mx.rnd
 from .ndarray.ndarray import NDArray
 from .util import set_np, reset_np, use_np, is_np_array, is_np_shape, np_shape
 
@@ -66,6 +67,7 @@ from . import log
 from . import notebook
 from . import profiler
 from . import registry
+from . import rtc
 from . import runtime
 from . import amp
 from . import symbol

@@ -18,7 +18,8 @@ import jax
 from .base import get_env
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
-           "resume", "Scope", "Task", "Frame", "Event", "Counter", "Marker"]
+           "resume", "Scope", "Domain", "Task", "Frame", "Event",
+           "Counter", "Marker"]
 
 _config = {"filename": "profile.json", "profile_all": False, "aggregate_stats": False}
 _state = {"running": False, "dir": None}
@@ -117,11 +118,44 @@ class Scope:
         self._ctx.__exit__(*exc)
 
 
+class Domain:
+    """Category grouping for profiling sub-objects (ref profiler.py
+    Domain — part of 'categories' in chrome://tracing output).  Child
+    objects carry ``domain.name`` as a prefix in the trace."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __str__(self):
+        return self.name
+
+    def new_task(self, name="task"):
+        return Task(self, name)
+
+    def new_frame(self, name="frame"):
+        return Frame(self, name)
+
+    def new_event(self, name="event"):
+        return Event(self, name)
+
+    def new_counter(self, name="counter", value=0):
+        return Counter(self, name, value)
+
+    def new_marker(self, name="marker"):
+        return Marker(self, name)
+
+
+def _domain_name(domain, name):
+    """Children prefix their domain whether built via Domain.new_* or
+    constructed directly (ref allows both paths interchangeably)."""
+    return f"{domain.name}::{name}" if domain is not None else name
+
+
 class Task:
     """Ref profiler.py Task — host-side duration."""
 
     def __init__(self, domain=None, name: str = "task"):
-        self.name = name
+        self.name = _domain_name(domain, name)
         self._start = None
 
     def start(self):
@@ -144,8 +178,8 @@ class Counter:
     """Ref profiler.py Counter."""
 
     def __init__(self, domain=None, name: str = "counter", value: int = 0):
-        self.name = name
-        _counters[name] = value
+        self.name = _domain_name(domain, name)
+        _counters[self.name] = value
 
     def set_value(self, v):
         _counters[self.name] = v
@@ -159,7 +193,7 @@ class Counter:
 
 class Marker:
     def __init__(self, domain=None, name: str = "marker"):
-        self.name = name
+        self.name = _domain_name(domain, name)
 
     def mark(self, scope="process"):
         _counters[f"marker:{self.name}"] = time.monotonic()

@@ -1,0 +1,28 @@
+"""CUDA runtime kernel compilation — not available on a TPU build (ref
+python/mxnet/rtc.py compiles CUDA source via NVRTC).
+
+The TPU-native equivalent of runtime kernel authoring is a Pallas
+kernel (``mxnet_tpu.ops.attention`` shows the pattern) or a C-ABI
+custom op loaded via ``mx.library.load``; both integrate with jit.
+Every entry point here raises a clear error instead of surfacing an
+AttributeError deep inside user code.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["CudaModule", "CudaKernel"]
+
+_MSG = ("mx.rtc compiles CUDA source with NVRTC; this build is TPU-native "
+        "and has no CUDA. Write a Pallas kernel (see ops/attention.py) or "
+        "load a C-ABI custom op via mx.library.load instead.")
+
+
+class CudaModule:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)
+
+
+class CudaKernel:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)

@@ -125,6 +125,10 @@ def check_symbolic_backward(fn, inputs, out_grads, expected, rtol=1e-5,
     from . import np as _np
 
     arrs = [x if isinstance(x, NDArray) else _np.array(x) for x in inputs]
+    if len(expected) != len(arrs):
+        raise AssertionError(
+            f"{len(expected)} expected gradients for {len(arrs)} inputs "
+            "(zip would silently drop the mismatch)")
     grads = [_np.zeros(a.shape) for a in arrs]
     autograd.mark_variables(arrs, grads)
     with autograd.record():
@@ -175,13 +179,14 @@ def rand_sparse_ndarray(shape, stype, density=0.5, dtype=_onp.float32,
     from .ndarray import sparse as _sparse
 
     rs = rng if rng is not None else _onp.random
-    dense = rs.rand(*shape).astype(dtype)
+    # .random(shape) exists on RandomState, Generator, and the module
+    dense = rs.random(shape).astype(dtype)
     if stype == "row_sparse":
-        keep = rs.rand(shape[0]) < density
+        keep = rs.random(shape[0]) < density
         dense[~keep] = 0
         return _sparse.row_sparse_array(dense, dtype=dtype), dense
     if stype == "csr":
-        mask = rs.rand(*shape) < density
+        mask = rs.random(shape) < density
         dense = dense * mask
         return _sparse.csr_matrix(dense, dtype=dtype), dense
     raise ValueError(f"unknown stype {stype!r}")

@@ -276,3 +276,54 @@ def test_rand_sparse_ndarray_roundtrip():
     r2, d2 = tu.rand_sparse_ndarray((4, 4), "csr",
                                     rng=onp.random.RandomState(3))
     onp.testing.assert_allclose(d1, d2)
+
+
+def test_profiler_domain_and_rtc_gate():
+    """mx.profiler.Domain factories (ref profiler.py Domain) and the
+    CUDA-only mx.rtc surface raising a clear error."""
+    d = mx.profiler.Domain("net")
+    t = d.new_task("fwd")
+    t.start(); t.stop()
+    c = d.new_counter("steps")
+    c.increment(2); c.decrement()
+    d.new_marker("ckpt").mark()
+    f = d.new_frame("f0")
+    f.start(); f.stop()
+    text = mx.profiler.dumps(reset=True)
+    assert "net::fwd" in text and "net::steps" in text
+    assert mx.profiler.Frame is mx.profiler.Task
+
+    assert mx.rnd is mx.random
+    import pytest
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.CudaModule("__global__ void k() {}")
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.CudaKernel(None, "k")
+
+
+def test_profiler_direct_construction_carries_domain():
+    """Task(domain, name) built directly prefixes the domain exactly
+    like Domain.new_task (review finding round 4)."""
+    d = mx.profiler.Domain("trainer")
+    direct = mx.profiler.Task(d, "step")
+    via_factory = d.new_task("step")
+    assert direct.name == via_factory.name == "trainer::step"
+    c = mx.profiler.Counter(d, "n")
+    assert c.name == "trainer::n"
+
+
+def test_rand_sparse_accepts_generator():
+    from mxnet_tpu import test_utils as tu
+
+    g = onp.random.default_rng(7)
+    csr, dense = tu.rand_sparse_ndarray((4, 6), "csr", rng=g)
+    onp.testing.assert_allclose(csr.todense().asnumpy(), dense, rtol=1e-6)
+
+
+def test_check_symbolic_backward_length_guard():
+    from mxnet_tpu import test_utils as tu
+
+    with pytest.raises(AssertionError):
+        tu.check_symbolic_backward(lambda x: x * 2.0,
+                                   [onp.ones((2,), "float32")], None,
+                                   [onp.ones(2), onp.ones(2)])
