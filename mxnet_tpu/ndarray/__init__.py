@@ -71,6 +71,154 @@ elemwise_div = divide
 Concat = concatenate
 concat = concatenate
 
+# comparison / logic legacy spellings.  The reference's legacy compare ops
+# (elemwise_binary_broadcast_op_logic.cc) return 0.0/1.0 in the LHS dtype,
+# not bool — keep that so ported scripts' arithmetic on masks works.
+
+
+def _cmp_op(fn, name):
+    def op(lhs, rhs):
+        def f(x, y):
+            dt = x.dtype if hasattr(x, "dtype") else jnp.float32
+            return fn(x, y).astype(dt)
+        return call(f, (lhs, rhs), {}, name=name)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp_op(jnp.equal, "equal")
+not_equal = _cmp_op(jnp.not_equal, "not_equal")
+greater = _cmp_op(jnp.greater, "greater")
+greater_equal = _cmp_op(jnp.greater_equal, "greater_equal")
+lesser = _cmp_op(jnp.less, "lesser")
+lesser_equal = _cmp_op(jnp.less_equal, "lesser_equal")
+logical_and = _cmp_op(jnp.logical_and, "logical_and")
+logical_or = _cmp_op(jnp.logical_or, "logical_or")
+logical_xor = _cmp_op(jnp.logical_xor, "logical_xor")
+
+# the broadcast_* registry spellings (elemwise_binary_broadcast_op_*.cc)
+# are the same kernels — jnp broadcasts by default
+broadcast_equal = equal
+broadcast_not_equal = not_equal
+broadcast_greater = greater
+broadcast_greater_equal = greater_equal
+broadcast_lesser = lesser
+broadcast_lesser_equal = lesser_equal
+broadcast_logical_and = logical_and
+broadcast_logical_or = logical_or
+broadcast_logical_xor = logical_xor
+broadcast_maximum = maximum
+broadcast_minimum = minimum
+broadcast_power = power
+broadcast_mod = wrap_op(jnp.mod, "broadcast_mod")
+broadcast_hypot = wrap_op(jnp.hypot, "broadcast_hypot")
+mod = broadcast_mod
+hypot = broadcast_hypot
+
+# unary tail (elemwise_unary_op_basic.cc / trig .cc)
+rsqrt = wrap_op(jax.lax.rsqrt, "rsqrt")
+rcbrt = wrap_op(lambda x: 1.0 / jnp.cbrt(x), "rcbrt")
+cbrt = wrap_op(jnp.cbrt, "cbrt")
+softsign = wrap_op(lambda x: x / (1.0 + jnp.abs(x)), "softsign")
+
+
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    """Ref elemwise_unary_op_basic.cc `hard_sigmoid`:
+    clip(alpha*x + beta, 0, 1)."""
+    return call(lambda x: jnp.clip(alpha * x + beta, 0.0, 1.0), (data,),
+                {}, name="hard_sigmoid",
+                attrs={"alpha": alpha, "beta": beta})
+
+
+def BlockGrad(data):  # noqa: N802 — reference registry spelling
+    """Ref elemwise_unary_op_basic.cc:297: identity forward, zero
+    gradient (the legacy CamelCase of stop_gradient)."""
+    return call(jax.lax.stop_gradient, (data,), {}, name="BlockGrad")
+
+
+stop_gradient = BlockGrad
+
+
+def make_loss(data, grad_scale=1.0):
+    """Ref elemwise_unary_op_basic.cc `make_loss`: identity forward; the
+    backward seeds grad_scale * ones (the node is a loss head, so the
+    incoming head gradient is ignored)."""
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (jnp.full(g.shape, grad_scale, g.dtype),)
+
+    f.defvjp(fwd, bwd)
+    return call(f, (data,), {}, name="make_loss",
+                attrs={"grad_scale": grad_scale})
+
+
+MakeLoss = make_loss
+
+
+def broadcast_axis(data, axis=None, size=None):
+    """Ref broadcast_reduce_op_value.cc `broadcast_axis`: tile the listed
+    size-1 axes out to the given sizes."""
+    axes = (axis,) if isinstance(axis, int) else tuple(axis or ())
+    sizes = (size,) if isinstance(size, int) else tuple(size or ())
+    if len(axes) != len(sizes):
+        raise ValueError(
+            f"broadcast_axis: axis {axes} and size {sizes} must have the "
+            f"same length")
+
+    def f(x):
+        shape = list(x.shape)
+        for ax, s in zip(axes, sizes):
+            if shape[ax % x.ndim] != 1:
+                raise ValueError("broadcast_axis: axis %d is not size-1"
+                                 % ax)
+            shape[ax % x.ndim] = s
+        return jnp.broadcast_to(x, tuple(shape))
+    return call(f, (data,), {}, name="broadcast_axis",
+                attrs={"axis": list(axes), "size": list(sizes)})
+
+
+broadcast_axes = broadcast_axis
+
+# internal scalar-operand registry spellings (_plus_scalar family,
+# elemwise_binary_scalar_op_basic.cc) — exposed verbatim because ported
+# code reaches them through mx.nd._internal; scalar is a python number
+
+
+def _scalar_op(fn, name):
+    def op(data, scalar, **kw):
+        return call(lambda x: fn(x, scalar), (data,), {}, name=name,
+                    attrs={"scalar": scalar})
+    op.__name__ = name
+    return op
+
+
+_plus_scalar = _scalar_op(lambda x, s: x + s, "_plus_scalar")
+_minus_scalar = _scalar_op(lambda x, s: x - s, "_minus_scalar")
+_rminus_scalar = _scalar_op(lambda x, s: s - x, "_rminus_scalar")
+_mul_scalar = _scalar_op(lambda x, s: x * s, "_mul_scalar")
+_div_scalar = _scalar_op(lambda x, s: x / s, "_div_scalar")
+_rdiv_scalar = _scalar_op(lambda x, s: s / x, "_rdiv_scalar")
+_mod_scalar = _scalar_op(lambda x, s: jnp.mod(x, s), "_mod_scalar")
+_rmod_scalar = _scalar_op(lambda x, s: jnp.mod(s, x), "_rmod_scalar")
+_power_scalar = _scalar_op(lambda x, s: jnp.power(x, s), "_power_scalar")
+_rpower_scalar = _scalar_op(lambda x, s: jnp.power(s, x), "_rpower_scalar")
+_maximum_scalar = _scalar_op(jnp.maximum, "_maximum_scalar")
+_minimum_scalar = _scalar_op(jnp.minimum, "_minimum_scalar")
+
+# reversed-scalar numpy internals (_npi_r*_scalar, np_elemwise_broadcast_op
+# _extended.cc): scalar becomes the LEFT operand
+rsubtract = _scalar_op(lambda x, s: s - x, "rsubtract")
+rarctan2 = _scalar_op(lambda x, s: jnp.arctan2(s, x), "rarctan2")
+rcopysign = _scalar_op(lambda x, s: jnp.copysign(s, x), "rcopysign")
+rfmod = _scalar_op(lambda x, s: jnp.fmod(s, x), "rfmod")
+rldexp = _scalar_op(lambda x, s: s * jnp.exp2(x), "rldexp")
+
 
 def batch_dot(a, b, transpose_a=False, transpose_b=False):
     """Ref: src/operator/tensor/dot.cc batch_dot — batched matmul on the MXU."""
@@ -157,6 +305,9 @@ def add_n(*args):
             out = out + x
         return out
     return call(f, args, {}, name="add_n")
+
+
+ElementWiseSum = add_n  # legacy CamelCase registry spelling (elemwise_sum.cc)
 
 
 def swapaxes(data, dim1=0, dim2=1):

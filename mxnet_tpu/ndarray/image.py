@@ -228,3 +228,90 @@ def random_saturation(data, min_factor, max_factor):
         return gray + (x - gray) * f
 
     return _jitter(data, min_factor, max_factor, sat)
+
+
+# hue rotation in YIQ space (ref src/operator/image/image_random-inl.h
+# RandomHue: the kernel applies the same U/V rotation matrix)
+def _hue(x, factor):
+    u, w = jnp.cos(factor * jnp.pi), jnp.sin(factor * jnp.pi)
+    m = jnp.asarray([[0.299 + 0.701 * u + 0.168 * w,
+                      0.587 - 0.587 * u + 0.330 * w,
+                      0.114 - 0.114 * u - 0.497 * w],
+                     [0.299 - 0.299 * u - 0.328 * w,
+                      0.587 + 0.413 * u + 0.035 * w,
+                      0.114 - 0.114 * u + 0.292 * w],
+                     [0.299 - 0.300 * u + 1.250 * w,
+                      0.587 - 0.588 * u - 1.050 * w,
+                      0.114 + 0.886 * u - 0.203 * w]], jnp.float32)
+    return x[..., :3] @ m.T
+
+
+def random_hue(data, min_factor, max_factor):
+    """Ref _image_random_hue (image_random.cc)."""
+    return _jitter(data, min_factor, max_factor, _hue)
+
+
+def random_color_jitter(data, brightness=0.0, contrast=0.0, saturation=0.0,
+                        hue=0.0):
+    """Ref _image_random_color_jitter: brightness/contrast/saturation/hue
+    applied in random order, each with factor U[max(0,1-v), 1+v] (hue:
+    U[-v, v])."""
+    from ..random import next_key
+
+    ops = []
+    if brightness > 0:
+        ops.append(lambda d: random_brightness(
+            d, max(0.0, 1 - brightness), 1 + brightness))
+    if contrast > 0:
+        ops.append(lambda d: random_contrast(
+            d, max(0.0, 1 - contrast), 1 + contrast))
+    if saturation > 0:
+        ops.append(lambda d: random_saturation(
+            d, max(0.0, 1 - saturation), 1 + saturation))
+    if hue > 0:
+        ops.append(lambda d: random_hue(d, -hue, hue))
+    if not ops:
+        return data if isinstance(data, NDArray) else NDArray(
+            jnp.asarray(data))
+    order = jax.random.permutation(next_key(), len(ops))
+    for i in [int(j) for j in order]:
+        data = ops[i](data)
+    return data
+
+
+def adjust_lighting(data, alpha):
+    """Ref _image_adjust_lighting: AlexNet-style PCA lighting noise —
+    adds eig_vec @ (alpha * eig_val) per channel; alpha is the per-
+    component strength triple."""
+    vec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                       [-0.5808, -0.0045, -0.8140],
+                       [-0.5836, -0.6948, 0.4203]], jnp.float32)
+    val = jnp.asarray([0.2175, 0.0188, 0.0045], jnp.float32)
+    a = jnp.asarray(alpha._data if isinstance(alpha, NDArray) else alpha,
+                    jnp.float32)
+
+    def f(x):
+        # the reference kernel's eigvalues are pre-multiplied by 255
+        # (image_random-inl.h AdjustLightingImpl: 55.46/4.794/1.148 =
+        # 255*val) for EVERY dtype — images are 0-255 scale here, float
+        # included, so the delta is 255-scaled unconditionally
+        delta = (vec @ (a * val)) * 255.0      # (3,)
+        xf = x.astype(jnp.float32) + delta
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            xf = jnp.clip(xf, 0, 255).astype(x.dtype)
+        return xf
+
+    return call(f, (data,), {}, name="adjust_lighting")
+
+
+def random_lighting(data, alpha_std=0.05):
+    """Ref _image_random_lighting: adjust_lighting with
+    alpha ~ N(0, alpha_std)."""
+    from ..random import next_key
+
+    a = jax.random.normal(next_key(), (3,)) * alpha_std
+    return adjust_lighting(data, NDArray(a))
+
+
+__all__ += ["random_hue", "random_color_jitter", "adjust_lighting",
+            "random_lighting"]

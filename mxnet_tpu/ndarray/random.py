@@ -1,7 +1,12 @@
 """``mx.nd.random`` — legacy random namespace (ref python/mxnet/ndarray/random.py).
 
 Same samplers as mx.np.random but with the legacy argument spellings
-(shape= instead of size=).
+(shape= instead of size=), plus the tails the numpy namespace doesn't
+carry: negative-binomial family (ref src/operator/random/sample_op.cc),
+``*_like`` variants (shape from a prototype array), and the
+``pdf_*`` density ops (ref src/operator/random/pdf_op.{h,cc} — formulas
+transcribed from the PDF_* kernels, including the limit/prob
+reparameterization of the generalized NB at pdf_op.h:289).
 """
 from __future__ import annotations
 
@@ -9,7 +14,14 @@ from ..numpy import random as _npr
 from ..random import seed  # noqa: F401
 
 __all__ = ["seed", "uniform", "normal", "randn", "randint", "exponential",
-           "gamma", "poisson", "shuffle", "multinomial"]
+           "gamma", "poisson", "shuffle", "multinomial",
+           "negative_binomial", "generalized_negative_binomial",
+           "uniform_like", "normal_like", "exponential_like", "gamma_like",
+           "poisson_like", "negative_binomial_like",
+           "generalized_negative_binomial_like",
+           "pdf_uniform", "pdf_normal", "pdf_gamma", "pdf_exponential",
+           "pdf_poisson", "pdf_negative_binomial",
+           "pdf_generalized_negative_binomial", "pdf_dirichlet"]
 
 
 def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
@@ -42,6 +54,191 @@ def poisson(lam=1.0, shape=None, dtype=None, ctx=None, **kw):
 
 def shuffle(x):
     return _npr.shuffle(x)
+
+
+def _nb_sample(k, p, shape, dtype):
+    """NB(k, p) via the gamma–Poisson mixture (ref sample_op.h
+    NegativeBinomialSampler): lam ~ Gamma(k, (1-p)/p), x ~ Poisson(lam).
+    ``p`` is the SUCCESS probability, counting failures."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..random import next_key
+
+    k = jnp.asarray(k, jnp.float32)
+    p = jnp.asarray(p, jnp.float32)
+    shp = shape if shape is not None else jnp.broadcast_shapes(k.shape,
+                                                               p.shape)
+    shp = (shp,) if isinstance(shp, int) else tuple(shp)
+    lam = jax.random.gamma(next_key(), jnp.broadcast_to(k, shp)) \
+        * (1.0 - p) / p
+    out = jax.random.poisson(next_key(), lam, shape=shp)
+    return out.astype(jnp.dtype(dtype) if dtype else jnp.float32)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype=None, ctx=None, **kw):
+    """Ref _random_negative_binomial (sample_op.cc)."""
+    from .ndarray import NDArray
+
+    return NDArray(_nb_sample(k, p, shape, dtype))
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None,
+                                  ctx=None, **kw):
+    """Ref _random_generalized_negative_binomial: mean mu, dispersion
+    alpha — NB with limit 1/alpha, success prob 1/(mu*alpha+1)."""
+    import jax.numpy as jnp
+
+    from .ndarray import NDArray
+
+    mu = jnp.asarray(mu, jnp.float32)
+    alpha = jnp.asarray(alpha, jnp.float32)
+    return NDArray(_nb_sample(1.0 / alpha, 1.0 / (mu * alpha + 1.0),
+                              shape, dtype))
+
+
+# --- *_like variants: sample in the prototype's shape (sample_op.cc) ----
+
+def uniform_like(data, low=0.0, high=1.0, **kw):
+    return uniform(low, high, shape=data.shape)
+
+
+def normal_like(data, loc=0.0, scale=1.0, **kw):
+    return normal(loc, scale, shape=data.shape)
+
+
+def exponential_like(data, lam=1.0, **kw):
+    return exponential(1.0 / lam, shape=data.shape)
+
+
+def gamma_like(data, alpha=1.0, beta=1.0, **kw):
+    return gamma(alpha, beta, shape=data.shape)
+
+
+def poisson_like(data, lam=1.0, **kw):
+    return poisson(lam, shape=data.shape)
+
+
+def negative_binomial_like(data, k=1, p=1.0, **kw):
+    return negative_binomial(k, p, shape=data.shape)
+
+
+def generalized_negative_binomial_like(data, mu=1.0, alpha=1.0, **kw):
+    return generalized_negative_binomial(mu, alpha, shape=data.shape)
+
+
+# --- pdf_* density ops (pdf_op.h PDF_* kernels) --------------------------
+# sample shape = param shape + trailing draw dims; params broadcast over
+# the trailing dims exactly like the kernels' start/sample_size indexing.
+
+def _pdf(fn, sample, params, is_log, name):
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import call
+    from .ndarray import NDArray
+
+    nds = [p if isinstance(p, NDArray) else NDArray(jnp.asarray(
+        p, jnp.float32)) for p in params]
+    sample = sample if isinstance(sample, NDArray) else NDArray(
+        jnp.asarray(sample, jnp.float32))
+
+    def f(x, *ps):
+        extra = x.ndim - ps[0].ndim
+        ps = [p.reshape(p.shape + (1,) * extra) for p in ps]
+        lpdf = fn(x, *ps)
+        return lpdf if is_log else jnp.exp(lpdf)
+    return call(f, (sample, *nds), {}, name=name,
+                attrs={"is_log": bool(is_log)})
+
+
+def pdf_uniform(sample, low, high, is_log=False):
+    import jax.numpy as jnp
+
+    return _pdf(lambda x, lo, hi: jnp.where(
+        (x >= lo) & (x <= hi), -jnp.log(hi - lo), -jnp.inf),
+        sample, (low, high), is_log, "pdf_uniform")
+
+
+def pdf_normal(sample, mu, sigma, is_log=False):
+    import math
+
+    import jax.numpy as jnp
+
+    return _pdf(lambda x, m, s: -0.5 * jnp.square((x - m) / s)
+                - jnp.log(s) - 0.5 * math.log(2 * math.pi),
+                sample, (mu, sigma), is_log, "pdf_normal")
+
+
+def pdf_gamma(sample, alpha, beta, is_log=False):
+    """beta is a RATE (pdf_op.h:126: a*log(b) + (a-1)log x - b x - lgamma a)."""
+    import jax
+    import jax.numpy as jnp
+
+    return _pdf(lambda x, a, b: a * jnp.log(b) + (a - 1) * jnp.log(x)
+                - b * x - jax.lax.lgamma(a),
+                sample, (alpha, beta), is_log, "pdf_gamma")
+
+
+def pdf_exponential(sample, lam, is_log=False):
+    import jax.numpy as jnp
+
+    return _pdf(lambda x, l: jnp.log(l) - l * x, sample, (lam,), is_log,
+                "pdf_exponential")
+
+
+def pdf_poisson(sample, lam, is_log=False):
+    import jax
+    import jax.numpy as jnp
+
+    return _pdf(lambda x, l: x * jnp.log(l) - jax.lax.lgamma(x + 1.0) - l,
+                sample, (lam,), is_log, "pdf_poisson")
+
+
+def _nb_lpdf(x, l, p):
+    """pdf_op.h:246 LPDF — here ``p`` is the failure probability, as the
+    kernel's own comment warns."""
+    import jax
+
+    lg = jax.lax.lgamma
+    return (lg(x + l) - lg(x + 1.0) - lg(l)) + l * jax.numpy.log(p) \
+        + x * jax.numpy.log(1.0 - p)
+
+
+def pdf_negative_binomial(sample, limit, prob, is_log=False):
+    return _pdf(lambda x, l, p: _nb_lpdf(x, l, p), sample, (limit, prob),
+                is_log, "pdf_negative_binomial")
+
+
+def pdf_generalized_negative_binomial(sample, mu, alpha, is_log=False):
+    """pdf_op.h:289: limit = 1/alpha, prob = 1/(mu*alpha + 1)."""
+    return _pdf(lambda x, m, a: _nb_lpdf(x, 1.0 / a,
+                                         1.0 / (m * a + 1.0)),
+                sample, (mu, alpha), is_log,
+                "pdf_generalized_negative_binomial")
+
+
+def pdf_dirichlet(sample, alpha, is_log=False):
+    """alpha (..., k); sample (..., [m,] k) on the simplex."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import call
+    from .ndarray import NDArray
+
+    alpha = alpha if isinstance(alpha, NDArray) else NDArray(
+        jnp.asarray(alpha, jnp.float32))
+    sample = sample if isinstance(sample, NDArray) else NDArray(
+        jnp.asarray(sample, jnp.float32))
+
+    def f(x, a):
+        extra = x.ndim - a.ndim
+        a = a.reshape(a.shape[:-1] + (1,) * extra + a.shape[-1:])
+        lg = jax.lax.lgamma
+        lpdf = jnp.sum((a - 1.0) * jnp.log(x), axis=-1) \
+            - jnp.sum(lg(a), axis=-1) + lg(jnp.sum(a, axis=-1))
+        return lpdf if is_log else jnp.exp(lpdf)
+    return call(f, (sample, alpha), {}, name="pdf_dirichlet",
+                attrs={"is_log": bool(is_log)})
 
 
 def multinomial(data, shape=1, get_prob=False, dtype="int32", **kw):

@@ -46,7 +46,8 @@ from .ndarray import NDArray
 
 __all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
            "row_sparse_array", "csr_matrix", "cast_storage", "retain",
-           "dot", "add"]
+           "dot", "add", "square_sum", "adagrad_update", "sgd_update",
+           "sgd_mom_update"]
 
 
 class BaseSparseNDArray:
@@ -283,6 +284,150 @@ def dot(lhs, rhs, transpose_a: bool = False) -> NDArray:
             return NDArray(jnp.einsum("kd,km->dm", lhs.data._data, sel))
         return NDArray(lhs.todense()._data @ dense)
     raise MXNetError("dot: unsupported sparse operand combination")
+
+
+def square_sum(data, axis=None, keepdims=False):
+    """Ref src/operator/tensor/square_sum{-inl.h,.cc} ``_square_sum``:
+    sum(data**2) computed on the STORED rows only — the row_sparse
+    gradient-norm primitive (O(nnz), never densifies).  axis=1 with
+    keepdims returns row_sparse like the reference; other reductions
+    return dense."""
+    if not isinstance(data, RowSparseNDArray):
+        x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        return NDArray(jnp.sum(jnp.square(x), axis=axis,
+                               keepdims=keepdims))
+    vals = data.data._data
+    if axis is None:
+        out = jnp.sum(jnp.square(vals))
+        return NDArray(out.reshape((1,) * len(data.shape))
+                       if keepdims else out)
+    ndim = len(data.shape)
+    ax = (axis if isinstance(axis, int) else axis[0]) % ndim
+    if ax == 0:
+        # over rows -> dense trailing-shape result via scatter of squares
+        out = jnp.sum(jnp.square(vals), axis=0)
+        if keepdims:
+            out = out[None]
+        return NDArray(out)
+    if ax == 1 and ndim == 2:
+        # per-stored-row sum of squares; keepdims stays row_sparse like
+        # the reference's _square_sum rsp output
+        red = jnp.sum(jnp.square(vals), axis=1)
+        if keepdims:
+            return RowSparseNDArray(NDArray(red[:, None]),
+                                    NDArray(data.indices._data),
+                                    (data.shape[0], 1))
+        return NDArray(jnp.zeros((data.shape[0],), vals.dtype)
+                       .at[data.indices._data.astype(jnp.int32)].set(red))
+    # general trailing axis (ndim > 2): reduce exactly that axis of the
+    # stored values, scatter by row id — never all-trailing-dims at once
+    red = jnp.sum(jnp.square(vals), axis=ax)
+    if keepdims:
+        red = jnp.expand_dims(red, ax)
+        out_shape = data.shape[:ax] + (1,) + data.shape[ax + 1:]
+    else:
+        out_shape = data.shape[:ax] + data.shape[ax + 1:]
+    return NDArray(jnp.zeros(out_shape, vals.dtype)
+                   .at[data.indices._data.astype(jnp.int32)].set(red))
+
+
+@jax.jit
+def _adagrad_rows_kernel(w_r, g, h_r, lr, wd, rescale, clip, eps):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -jnp.abs(clip), jnp.abs(clip)), g)
+    g = g + wd * w_r
+    h2 = h_r + jnp.square(g)
+    return w_r - lr * g / (jnp.sqrt(h2) + eps), h2
+
+
+def adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    """Ref src/operator/optimizer_op.cc:888 ``_sparse_adagrad_update``:
+    lazy row-wise AdaGrad — weight and history advance ONLY on the
+    gradient's stored rows; untouched rows are bit-identical afterward.
+    Dense grads fall through to the dense formula (same kernel on all
+    rows)."""
+    if isinstance(grad, RowSparseNDArray):
+        rows = grad.indices._data.astype(jnp.int32)
+        w_r, h_r = _adagrad_rows_kernel(
+            weight._data[rows], grad.data._data, history._data[rows],
+            lr, wd, rescale_grad, clip_gradient, epsilon)
+        weight._set_data(weight._data.at[rows].set(w_r))
+        history._set_data(history._data.at[rows].set(h_r))
+    else:
+        g = grad._data if isinstance(grad, NDArray) else jnp.asarray(grad)
+        w, h = _adagrad_rows_kernel(weight._data, g, history._data, lr, wd,
+                                    rescale_grad, clip_gradient, epsilon)
+        weight._set_data(w)
+        history._set_data(h)
+    if out is not None:
+        out._set_data(weight._data)
+        return out
+    return weight
+
+
+@jax.jit
+def _sgd_rows_kernel(w_r, g, lr, wd, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -jnp.abs(clip), jnp.abs(clip)), g)
+    return w_r - lr * (g + wd * w_r)
+
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True, out=None):
+    """Row_sparse sgd_update (ref optimizer_op.cc SGDUpdateRspImpl):
+    lazy by default — only stored rows move."""
+    if isinstance(grad, RowSparseNDArray) and lazy_update:
+        rows = grad.indices._data.astype(jnp.int32)
+        w_r = _sgd_rows_kernel(weight._data[rows], grad.data._data, lr, wd,
+                               rescale_grad, clip_gradient)
+        weight._set_data(weight._data.at[rows].set(w_r))
+    else:
+        g = grad.todense()._data if isinstance(grad, BaseSparseNDArray) \
+            else (grad._data if isinstance(grad, NDArray)
+                  else jnp.asarray(grad))
+        weight._set_data(_sgd_rows_kernel(weight._data, g, lr, wd,
+                                          rescale_grad, clip_gradient))
+    if out is not None:
+        out._set_data(weight._data)
+        return out
+    return weight
+
+
+@jax.jit
+def _sgd_mom_rows_kernel(w_r, g, m_r, lr, mom, wd, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -jnp.abs(clip), jnp.abs(clip)), g)
+    m2 = mom * m_r - lr * (g + wd * w_r)
+    return w_r + m2, m2
+
+
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.9, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True,
+                   out=None):
+    """Row_sparse sgd_mom_update: lazy momentum — stored rows only (the
+    reference's lazy_update=True default for sparse grads; see module
+    docstring for the zero-row divergence note)."""
+    if isinstance(grad, RowSparseNDArray) and lazy_update:
+        rows = grad.indices._data.astype(jnp.int32)
+        w_r, m_r = _sgd_mom_rows_kernel(
+            weight._data[rows], grad.data._data, mom._data[rows], lr,
+            momentum, wd, rescale_grad, clip_gradient)
+        weight._set_data(weight._data.at[rows].set(w_r))
+        mom._set_data(mom._data.at[rows].set(m_r))
+    else:
+        g = grad.todense()._data if isinstance(grad, BaseSparseNDArray) \
+            else (grad._data if isinstance(grad, NDArray)
+                  else jnp.asarray(grad))
+        w, m = _sgd_mom_rows_kernel(weight._data, g, mom._data, lr,
+                                    momentum, wd, rescale_grad,
+                                    clip_gradient)
+        weight._set_data(w)
+        mom._set_data(m)
+    if out is not None:
+        out._set_data(weight._data)
+        return out
+    return weight
 
 
 def add(a, b):

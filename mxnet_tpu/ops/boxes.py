@@ -370,3 +370,92 @@ def _scan_fixed(body, carry, k):
 
     (carry, _) = lax.scan(body, carry, None, length=k)
     return carry
+
+
+def mrcnn_mask_target(rois, gt_masks, matches, cls_targets,
+                      num_rois=None, num_classes=1, mask_size=(14, 14),
+                      sample_ratio=2, aligned=False):
+    """Mask-RCNN training-target generator (ref
+    src/operator/contrib/mrcnn_mask_target.cu:273 + -inl.h).
+
+    rois (B, N, 4) corner boxes in gt-mask pixel coords; gt_masks
+    (B, M, H, W); matches (B, N) gt index per roi; cls_targets (B, N)
+    class id per roi.  Returns (mask_targets, mask_cls), both
+    (B, N, C, h, w): the matched gt mask ROIAlign-resampled into the roi
+    window (replicated over C, as in the kernel), and the one-hot class
+    mask.  sample_ratio must be > 0 here (the adaptive -1 mode needs
+    data-dependent grid sizes; same static-shape stance as rroi_align).
+    """
+    if sample_ratio <= 0:
+        raise ValueError("mrcnn_mask_target needs sample_ratio > 0 on TPU "
+                         "(static sampling grid)")
+    h, w = (mask_size if isinstance(mask_size, (tuple, list))
+            else (mask_size, mask_size))
+    g = int(sample_ratio)
+
+    def f(rois, gt_masks, matches, cls_targets):
+        B, N = rois.shape[:2]
+        M, H, W = gt_masks.shape[1:]
+        off = 0.5 if aligned else 0.0
+        x0 = rois[..., 0] - off
+        y0 = rois[..., 1] - off
+        x1 = rois[..., 2] - off
+        y1 = rois[..., 3] - off
+        rw, rh = x1 - x0, y1 - y0
+        if not aligned:  # force malformed rois to 1x1 (kernel behavior)
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bh, bw = rh / h, rw / w                        # bin sizes (B, N)
+        # sampling points: y = y0 + ph*bh + (iy+.5)*bh/g  -> (B, N, h*g)
+        iy = (jnp.arange(h * g) // g)[None, None, :]
+        fy = ((jnp.arange(h * g) % g) + 0.5)[None, None, :] / g
+        ys = y0[..., None] + (iy + fy) * bh[..., None]
+        ix = (jnp.arange(w * g) // g)[None, None, :]
+        fx = ((jnp.arange(w * g) % g) + 0.5)[None, None, :] / g
+        xs = x0[..., None] + (ix + fx) * bw[..., None]
+
+        # matched masks (B, N, H, W)
+        sel = jnp.take_along_axis(
+            gt_masks, matches.astype(jnp.int32)[..., None, None]
+            .clip(0, M - 1), axis=1)
+
+        def bilinear(img, ys, xs):
+            """img (H, W); ys (h*g,), xs (w*g,) -> (h*g, w*g); taps
+            outside [-1, len] contribute 0 (kernel bilinear_interpolate)."""
+            yok = (ys >= -1.0) & (ys <= H)
+            xok = (xs >= -1.0) & (xs <= W)
+            y = jnp.clip(ys, 0.0, H - 1)
+            x = jnp.clip(xs, 0.0, W - 1)
+            ylo = jnp.floor(y).astype(jnp.int32)
+            xlo = jnp.floor(x).astype(jnp.int32)
+            yhi = jnp.minimum(ylo + 1, H - 1)
+            xhi = jnp.minimum(xlo + 1, W - 1)
+            wy = (y - ylo)[:, None]
+            wx = (x - xlo)[None, :]
+            v = (img[ylo][:, xlo] * (1 - wy) * (1 - wx) +
+                 img[ylo][:, xhi] * (1 - wy) * wx +
+                 img[yhi][:, xlo] * wy * (1 - wx) +
+                 img[yhi][:, xhi] * wy * wx)
+            return v * yok[:, None] * xok[None, :]
+
+        samp = jax.vmap(jax.vmap(bilinear))(sel, ys, xs)   # (B,N,h*g,w*g)
+        pooled = samp.reshape(B, N, h, g, w, g).mean(axis=(3, 5))
+        masks = jnp.broadcast_to(pooled[:, :, None], (B, N, num_classes,
+                                                      h, w))
+        cls = (cls_targets[..., None].astype(jnp.int32) ==
+               jnp.arange(num_classes)[None, None, :])
+        mask_cls = jnp.broadcast_to(
+            cls[..., None, None].astype(pooled.dtype),
+            (B, N, num_classes, h, w))
+        return masks, mask_cls
+
+    from .dispatch import call
+
+    return call(f, (rois, gt_masks, matches, cls_targets), {},
+                name="mrcnn_mask_target",
+                attrs={"num_classes": num_classes,
+                       "mask_size": [h, w], "sample_ratio": g,
+                       "aligned": bool(aligned)})
+
+
+__all__ += ["mrcnn_mask_target"]

@@ -103,6 +103,14 @@ def invoke(fn: Callable, inputs: Sequence, name: str = "op",
         except TypeError:
             # fn not differentiable (e.g. integer outputs only) — run plain
             out_raw, vjp_fn = fn(*raw), None
+            _chk = [out_raw] if not isinstance(out_raw, (tuple, list)) \
+                else list(out_raw)
+            if _chk and all(_is_inexact(o) for o in _chk):
+                # every output is float: the op claimed differentiability,
+                # so the TypeError is a real defect in fn — swallowing it
+                # would record silent zero grads (seen with a bad
+                # custom_vjp residual), which is worse than raising
+                raise
     else:
         out_raw, vjp_fn = fn(*raw), None
 

@@ -455,8 +455,18 @@ class AdaGrad(Optimizer):
         return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray, adagrad_update
+
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        if isinstance(grad, RowSparseNDArray):
+            # lazy row-wise AdaGrad (ref _sparse_adagrad_update,
+            # optimizer_op.cc:888): only the gradient's stored rows move
+            adagrad_update(weight, grad, state, lr, epsilon=self.epsilon,
+                           wd=wd, rescale_grad=self.rescale_grad,
+                           clip_gradient=clip)
+            return
         g = self._prep_grad(grad._data) + wd * weight._data
         hh = state._data + jnp.square(g)
         weight._set_data(weight._data - lr * g / (jnp.sqrt(hh) + self.epsilon))

@@ -330,7 +330,7 @@ def make_train_step(net, loss_fn, names: List[str],
                     weight_decay: float = 0.0, momentum: float = 0.9,
                     donate: bool = True, compute_dtype=None,
                     loss_scale_growth_interval: int = 2000,
-                    multi_tensor: bool = False):
+                    multi_tensor: bool = False, shardings_box=None):
     """Build the jitted SPMD train machinery. Returns
     (step, grad_fn, apply_fn, adapter, holder):
 
@@ -432,6 +432,29 @@ def make_train_step(net, loss_fn, names: List[str],
             tvals, avals, key_val, scale_state[0], x, y)
         new_p, new_state, scale_state = apply_update(
             tvals, opt_state, t, lr, scale_state, grads)
+        # pin loop-carried state to its input placement: without output
+        # constraints XLA may emit a different sharding for a small param
+        # (observed: a [64] BN bias coming back 'tp'-sharded), making every
+        # step pay a reshard when outputs feed the next step — and making
+        # the AOT-compiled step (dryrun/bench) reject its own outputs.
+        # shardings_box is filled by ShardedTrainer AFTER this builder
+        # returns (the train/aux split comes from the holder); the box is
+        # read here at TRACE time, which happens strictly later.
+        psh = (shardings_box or {}).get("params")
+        if psh is not None:
+            wsc = jax.lax.with_sharding_constraint
+            new_p = [wsc(p, s) for p, s in zip(new_p, psh)]
+            # optimizer state follows its owning param when same-shaped
+            # (the ZeRO placement chosen at init), else replicated
+            repl = NamedSharding(psh[0].mesh, P())
+            new_state = [
+                wsc(s, psh[pi]) if s.shape == new_p[pi].shape
+                else wsc(s, repl)
+                for s, pi in zip(new_state, adapter.leaf_param_ix)]
+        ash = (shardings_box or {}).get("aux")
+        if ash is not None:
+            wsc = jax.lax.with_sharding_constraint
+            mutated = [wsc(m, s) for m, s in zip(mutated, ash)]
         return new_p, mutated, new_state, scale_state, loss
 
     jitted = jax.jit(step, donate_argnums=(0, 3) if donate else ())
@@ -466,13 +489,22 @@ class ShardedTrainer:
         self.net = net
         self.mesh = mesh if mesh is not None else default_mesh()
         self.names, allvals, self.specs = shard_params(net, self.mesh, spec_fn)
+        shardings_box = {}
         (self._step_fn, self._grad_fn, self._apply_fn, self._adapter,
          self._holder) = make_train_step(
             net, loss_fn, self.names, optimizer, learning_rate,
             weight_decay, momentum, compute_dtype=compute_dtype,
-            multi_tensor=multi_tensor)
+            multi_tensor=multi_tensor, shardings_box=shardings_box)
         self.pvals = [allvals[i] for i in self._holder["train_ix"]]
         self.avals = [allvals[i] for i in self._holder["aux_ix"]]
+        # loop-carried outputs keep their input placements (read by the
+        # step at trace time — see make_train_step)
+        shardings_box["params"] = [
+            NamedSharding(self.mesh, self.specs[i])
+            for i in self._holder["train_ix"]]
+        shardings_box["aux"] = [
+            NamedSharding(self.mesh, self.specs[i])
+            for i in self._holder["aux_ix"]]
         self._params = net.collect_params()
         self.train_names = [self.names[i] for i in self._holder["train_ix"]]
         self.aux_names = [self.names[i] for i in self._holder["aux_ix"]]

@@ -203,3 +203,31 @@ def test_multibox_prior_reference_anchor_order():
     h = onp.asarray(anchors[:, 3] - anchors[:, 1])
     expect = [(0.5, 0.5), (0.25, 0.25), (0.5 * 2, 0.5 / 2)]
     assert onp.allclose(list(zip(w, h)), expect, atol=1e-6)
+
+
+def test_mrcnn_mask_target_values():
+    """_contrib_mrcnn_mask_target (ref mrcnn_mask_target.cu:273): matched
+    gt masks ROIAlign-resampled into roi windows + one-hot class masks."""
+    from mxnet_tpu.ops.boxes import mrcnn_mask_target
+
+    B, N, M, H, W = 1, 2, 2, 16, 16
+    gt = onp.zeros((B, M, H, W), "f4")
+    gt[:, 0, :, :8] = 1.0            # mask 0: left half
+    gt[:, 1, 4:12, 4:12] = 1.0       # mask 1: center square
+    rois = onp.array([[[0, 0, 15, 15], [4, 4, 11, 11]]], "f4")
+    matches = onp.array([[0, 1]], "f4")
+    cls_t = onp.array([[2, 0]], "f4")
+    m, c = mrcnn_mask_target(mx.nd.array(rois), mx.nd.array(gt),
+                             mx.nd.array(matches), mx.nd.array(cls_t),
+                             num_rois=N, num_classes=3, mask_size=(8, 8))
+    m, c = m.asnumpy(), c.asnumpy()
+    assert m.shape == (1, 2, 3, 8, 8) and c.shape == (1, 2, 3, 8, 8)
+    # roi 0 spans mask 0 -> left half ~1, right half ~0
+    assert m[0, 0, 0, :, :3].mean() > 0.9
+    assert m[0, 0, 0, :, 5:].mean() < 0.1
+    # roi 1 sits inside mask 1's ones-square
+    assert m[0, 1, 0].mean() > 0.85
+    # mask replicated over classes (kernel samples ignore c)
+    assert (m[0, 0, 0] == m[0, 0, 1]).all()
+    # one-hot class planes
+    assert c[0, 0, 2].all() and not c[0, 0, 0].any() and c[0, 1, 0].all()

@@ -277,3 +277,29 @@ def test_create_bbox_augment_shapes():
     img, lab = aug(_img(), label)
     assert img.shape == (3, 20, 20)
     assert lab.shape[1] == 5
+
+
+def test_bbox_random_crop_max_iou_bounds_best_overlap():
+    """max_iou constrains iou.max(), not the per-candidate min (round-4
+    advisor finding #1): with (None, 0.3) no returned crop may overlap
+    any box by more than ~0.3."""
+    import numpy as onp
+
+    from mxnet_tpu.gluon.contrib.data.vision.transforms.bbox.utils import \
+        bbox_iou, bbox_random_crop_with_constraints
+
+    onp.random.seed(0)
+    boxes = onp.array([[10.0, 10.0, 60.0, 60.0]], "f4")
+    hits = 0
+    for _ in range(20):
+        new, crop = bbox_random_crop_with_constraints(
+            boxes.copy(), (100, 100), constraints=((None, 0.3),),
+            max_trial=50)
+        x, y, w, h = crop
+        if (x, y, w, h) == (0, 0, 100, 100):
+            continue  # no satisfying crop found -> full image fallback
+        hits += 1
+        crop_box = onp.array([[x, y, x + w, y + h]], "f4")
+        iou = bbox_iou(crop_box, boxes)
+        assert iou.max() <= 0.3 + 1e-6, iou
+    assert hits > 0  # the constraint is satisfiable; some crop must land

@@ -392,3 +392,37 @@ def test_hawkesll_fractional_valid_length():
         mx.nd.array(state), mx.nd.array(lags), mx.nd.array(marks),
         mx.nd.array(onp.array([2.0], "f4")), mx.nd.array(mt))
     assert onp.allclose(ll_frac.asnumpy(), ll_int.asnumpy(), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# round-5: QAT straight-through ops + gradient multiplier
+# (ref stes_op.cc:34, gradient_multiplier_op.cu:32)
+# ---------------------------------------------------------------------------
+
+def test_round_ste_sign_ste_gradients():
+    x = mx.nd.array(onp.array([0.3, 1.7, -0.2], "f4"))
+    x.attach_grad()
+    with mx.autograd.record():
+        out = mx.contrib.round_ste(mx.nd.multiply(x, x))
+    out.backward()
+    assert out.asnumpy().tolist() == [0.0, 3.0, 0.0]   # round(x^2)
+    # straight-through: grad == d(x^2)/dx == 2x, as if round were identity
+    assert onp.allclose(x.grad.asnumpy(),
+                        2 * onp.array([0.3, 1.7, -0.2]), atol=1e-6)
+    s = mx.nd.array(onp.array([-3.0, 4.0], "f4"))
+    s.attach_grad()
+    with mx.autograd.record():
+        o = mx.contrib.sign_ste(s)
+    o.backward()
+    assert o.asnumpy().tolist() == [-1.0, 1.0]
+    assert s.grad.asnumpy().tolist() == [1.0, 1.0]
+
+
+def test_gradientmultiplier_scales_backward_only():
+    y = mx.nd.array(onp.array([2.0], "f4"))
+    y.attach_grad()
+    with mx.autograd.record():
+        o = mx.contrib.gradientmultiplier(mx.nd.square(y), scalar=-0.5)
+    o.backward()
+    assert float(o.asnumpy()[0]) == 4.0                 # identity forward
+    assert abs(float(y.grad.asnumpy()[0]) - (-2.0)) < 1e-6  # -0.5 * 2y

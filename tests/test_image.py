@@ -489,3 +489,24 @@ def test_detiter_prefetch_stream_identical(img_dir):
         onp.testing.assert_array_equal(da, db)
         onp.testing.assert_array_equal(la, lb)
         assert pa == pb
+
+
+def test_crop_resize_interpolation_modes():
+    """CropResize honors nearest vs bilinear and rejects unknown interp
+    codes (round-4 advisor finding #2)."""
+    import numpy as onp
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.gluon.data.vision.transforms import CropResize
+
+    img = onp.zeros((4, 4, 1), "uint8")
+    img[:2, :2] = 100  # top-left quadrant
+    nearest = CropResize(0, 0, 4, 4, size=2, interpolation=0)(img)
+    assert nearest.dtype == onp.uint8
+    # nearest keeps exact source values (no blending)
+    assert set(onp.unique(nearest)) <= {0, 100}
+    bilinear = CropResize(0, 0, 4, 4, size=3, interpolation=1)(img)
+    assert ((0 < bilinear) & (bilinear < 100)).any()  # blended edge
+    with pytest.raises(MXNetError):
+        CropResize(0, 0, 4, 4, size=2, interpolation=3)

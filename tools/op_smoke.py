@@ -243,6 +243,14 @@ def _build_overrides(fx):
         "_image_resize": lambda: mx.image.imresize(IMG, 5, 4),
         "_image_to_tensor": lambda:
             mx.gluon.data.vision.transforms.ToTensor()(IMG),
+        "_image_random_brightness": lambda:
+            mx.nd.image.random_brightness(IMG.astype("float32"), 0.5, 1.5),
+        "_image_random_contrast": lambda:
+            mx.nd.image.random_contrast(IMG.astype("float32"), 0.5, 1.5),
+        "_image_random_saturation": lambda:
+            mx.nd.image.random_saturation(IMG.astype("float32"), 0.5, 1.5),
+        "_image_random_hue": lambda:
+            mx.nd.image.random_hue(IMG.astype("float32"), -0.1, 0.1),
         "_contrib_BilinearResize2D": lambda: mx.image.imresize(IMG, 5, 4),
         # -- boxes / detection -------------------------------------------
         "_contrib_MultiBoxPrior": lambda: BX.multibox_prior(
@@ -251,6 +259,21 @@ def _build_overrides(fx):
             anchors, label),
         "_contrib_MultiBoxDetection": lambda: BX.multibox_detection(
             cls_preds.asnumpy(), loc_preds.asnumpy(), anchors),
+        "_contrib_mrcnn_mask_target": lambda: BX.mrcnn_mask_target(
+            np_.array(onp.array([[[0, 0, 7, 7]]], "float32")),
+            np_.array(onp.zeros((1, 1, 8, 8), "float32")),
+            np_.array(onp.zeros((1, 1), "float32")),
+            np_.array(onp.zeros((1, 1), "float32")),
+            num_rois=1, num_classes=2, mask_size=(4, 4)),
+        "_random_pdf_gamma": lambda: mx.nd.random.pdf_gamma(
+            np_.array(onp.array([0.5, 1.5], "float32")),
+            onp.array([2.0], "float32"), onp.array([1.5], "float32")),
+        "_random_pdf_negative_binomial": lambda:
+            mx.nd.random.pdf_negative_binomial(
+                np_.array(onp.array([0.0, 1.0], "float32")),
+                onp.array([4.0], "float32"), onp.array([0.5], "float32")),
+        "_sample_unique_zipfian": lambda: npx.sample_unique_zipfian(
+            1000, shape=(2, 5)),
         "_contrib_box_iou": lambda: npx.box_iou(
             np_.array(onp.array([[0, 0, 1, 1]], "float32")),
             np_.array(onp.array([[0.5, 0.5, 1.5, 1.5]], "float32"))),

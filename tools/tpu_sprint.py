@@ -1,21 +1,28 @@
-"""The TPU measurement sprint (round-4 verdict item #1).
+"""The TPU measurement sprint (round-4 verdict item #1, breadth-first).
 
 Run the moment the relay lives (tools/relay_watch.sh does this
-automatically).  Captures, in strict priority order — the relay has died
-mid-round twice, so the most valuable numbers come first:
+automatically).  The relay has died mid-round three times; the round-4
+post-mortem (VERDICT weak #5) showed the old depth-first order banked ONE
+number in a ~90-minute window because every later stage sat behind a
+full-scale compile.  So:
 
-  1. all five BASELINE configs      (bench.py default run)
-  2. ResNet-50 b256                 (PERF.md lever 1)
-  3. ResNet-50 s2d stem             (PERF.md lever 2)
-  4. ResNet-50 b256 + s2d           (levers combined)
-  5. inference scoring sweep        (bench.py --infer; perf.md:72-211)
-  6. per-conv utilization table     (tools/convbench.py)
-  7. BERT LAMB compile/step costs   (tools/bert_compile_bench.py)
+  pass 1 (breadth — minutes per stage):
+    ONE tiny jitted step per BASELINE config (bench.py --config X with
+    MXNET_BENCH_QUICK=1).  Five non-null TPU rows banked to
+    bench_partial.jsonl in roughly 15 relay-minutes, and the XLA
+    compile cache warmed with the small graphs.
+  pass 2 (depth — the comparable numbers, headline first):
+    full bench.py (resnet50 b128 first, then the other four configs),
+    then the PERF.md levers (b256, s2d stem, both), the inference
+    scoring sweep, the per-conv utilization table, and the BERT
+    compile/step split.
 
 Each stage runs in its own subprocess with a hard timeout and its result
-is flushed to sprint_results/ immediately, so a mid-sprint wedge keeps
-everything already measured.  Exit 0 iff stage 1 produced a non-null TPU
-resnet50 number.
+is flushed to sprint_results/ immediately; every bench child also banks
+its row to bench_partial.jsonl itself, so a mid-sprint wedge keeps
+everything already measured and the round artifact merges the freshest
+banked rows (bench.py dead-relay path).  Exit 0 iff all five quick rows
+or the full resnet row produced a non-null TPU number.
 """
 from __future__ import annotations
 
@@ -27,6 +34,8 @@ import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(ROOT, "sprint_results")
+
+CONFIGS = ("resnet50", "lenet", "bert_base", "lstm_lm", "ssd")
 
 
 def run(name, cmd, timeout, env=None):
@@ -61,8 +70,41 @@ def last_json(rec):
 def main():
     py = sys.executable
     env = dict(os.environ)
+    # persistent compile cache: quick-pass graphs and any graph compiled
+    # in an earlier window are reused, so a fresh window spends its
+    # minutes stepping (bench.py main() sets this for its own children;
+    # --config children invoked directly need it here)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(ROOT, ".jax_cache"))
 
-    r1 = run("bench_all", [py, "bench.py"], timeout=7200)
+    # A relay that died between the watcher's probe and now must not
+    # burn 5 x 1200 s of quick-child hangs: probe once in a killable
+    # subprocess (bench.py's machinery), and on failure skip straight to
+    # bench.py, whose dead-relay path smokes on CPU and merges the bank.
+    sys.path.insert(0, ROOT)
+    import bench as _bench
+
+    platform, err = _bench._probe_backend(attempts=1, timeout=75)
+    if platform != "tpu":
+        print(f"[sprint] backend probe failed ({err}); skipping quick "
+              "pass, running bench.py dead-relay path", flush=True)
+        run("bench_all", [py, "bench.py"], timeout=2400, env=env)
+        return 1
+
+    # ---- pass 1: breadth — bank a non-null TPU row per config fast ----
+    quick_ok = 0
+    qenv = dict(env, MXNET_BENCH_QUICK="1")
+    for name in CONFIGS:
+        rec = run(f"quick_{name}", [py, "bench.py", "--config", name],
+                  timeout=1200, env=qenv)
+        j = last_json(rec)
+        if j and j.get("value") is not None and j.get("platform") == "tpu":
+            quick_ok += 1
+    print(f"[sprint] pass 1: {quick_ok}/5 quick TPU rows banked",
+          flush=True)
+
+    # ---- pass 2: depth — the comparable numbers, headline first ----
+    r1 = run("bench_all", [py, "bench.py"], timeout=10800, env=env)
     j = last_json(r1)
     got_tpu = bool(j and j.get("value") is not None
                    and not j.get("skipped"))
@@ -70,8 +112,8 @@ def main():
         with open(os.path.join(OUT, "BENCH_live.json"), "w") as f:
             json.dump(j, f, indent=1)
     if not got_tpu:
-        print("[sprint] stage 1 produced no TPU number; continuing "
-              "anyway (partial credit)", flush=True)
+        print("[sprint] full bench produced no live TPU headline; "
+              "continuing (quick rows are already banked)", flush=True)
 
     e = dict(env, MXNET_BENCH_BATCH="256")
     run("resnet_b256", [py, "bench.py", "--config", "resnet50"],
@@ -82,14 +124,14 @@ def main():
     e = dict(env, MXNET_BENCH_BATCH="256", MXNET_BENCH_STEM="s2d")
     run("resnet_b256_s2d", [py, "bench.py", "--config", "resnet50"],
         timeout=2400, env=e)
-    run("infer_sweep", [py, "bench.py", "--infer"], timeout=7200)
+    run("infer_sweep", [py, "bench.py", "--infer"], timeout=7200, env=env)
     run("convbench", [py, "tools/convbench.py", "--json",
                       os.path.join(OUT, "convbench_table.json")],
-        timeout=3600)
+        timeout=3600, env=env)
     run("bert_compile", [py, "tools/bert_compile_bench.py", "--json",
                          os.path.join(OUT, "bert_compile.json")],
-        timeout=3600)
-    return 0 if got_tpu else 1
+        timeout=3600, env=env)
+    return 0 if (quick_ok == 5 or got_tpu) else 1
 
 
 if __name__ == "__main__":
