@@ -1,0 +1,85 @@
+"""User-authored Pallas kernels registered as framework ops.
+
+The TPU analog of the reference's runtime-compiled user kernels
+(python/mxnet/rtc.py + example/extensions/ lib_api REGISTER_OP,
+include/mxnet/lib_api.h:751-771): load with
+
+    mx.library.load("example/extensions/pallas_ops.py")
+
+after which ``mx.npx.pallas_squared_relu`` and ``mx.npx.pallas_axpb``
+dispatch like built-in ops — tape-recorded, jit-fusable, hybridize-safe.
+
+Two ops demonstrate both gradient paths:
+  * ``pallas_axpb``      — Pallas forward with a one-line ``grad=``
+                           (a ``pallas_call`` has no built-in VJP, so a
+                           Pallas op that must train always passes one).
+  * ``pallas_squared_relu`` — forward AND backward both hand-written
+                           Pallas kernels, via ``mx.rtc.register(grad=)``.
+
+Kernels run under the Pallas interpreter off-TPU (same pattern the
+built-in flash kernel uses for CPU tests, ops/attention.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# --- squared ReLU: y = max(x, 0)^2 -----------------------------------------
+
+def _sqrelu_fwd_kernel(x_ref, o_ref):
+    x = x_ref[...]
+    r = jnp.maximum(x, 0.0)
+    o_ref[...] = r * r
+
+
+def _sqrelu_bwd_kernel(g_ref, x_ref, o_ref):
+    x = x_ref[...]
+    o_ref[...] = g_ref[...] * 2.0 * jnp.maximum(x, 0.0)
+
+
+def _sqrelu(x):
+    return pl.pallas_call(
+        _sqrelu_fwd_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_interpret())(x)
+
+
+def _sqrelu_grad(g, x):
+    return pl.pallas_call(
+        _sqrelu_bwd_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_interpret())(g, x)
+
+
+# --- a*x + b with scalar config params -------------------------------------
+
+def _axpb_kernel(x_ref, o_ref, *, a, b):
+    o_ref[...] = x_ref[...] * a + b
+
+
+def _axpb(x, a=1.0, b=0.0):
+    return pl.pallas_call(
+        functools.partial(_axpb_kernel, a=a, b=b),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_interpret())(x)
+
+
+def register_ops(mx):
+    """mx.library.load entry point."""
+    ops = {
+        "pallas_squared_relu": mx.rtc.register(
+            "pallas_squared_relu", _sqrelu, grad=_sqrelu_grad,
+            attach_npx=False),
+        "pallas_axpb": mx.rtc.register(
+            "pallas_axpb", _axpb,
+            grad=lambda g, x, a=1.0, b=0.0: g * a, attach_npx=False),
+    }
+    return ops

@@ -547,7 +547,8 @@ class SymbolBlock(HybridBlock):
     def imports(symbol_file, input_names=None, param_file=None, ctx=None):
         from .symbol_block import import_exported
 
-        return import_exported(symbol_file, param_file, ctx)
+        return import_exported(symbol_file, param_file, ctx,
+                               input_names=input_names)
 
     def forward(self, *args):
         from ..ops.dispatch import invoke

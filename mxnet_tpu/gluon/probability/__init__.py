@@ -11,7 +11,10 @@ from .distributions import (Distribution, Normal, LogNormal, HalfNormal,
                             Beta, Dirichlet, Poisson, Bernoulli, Binomial,
                             Geometric, Categorical, OneHotCategorical,
                             MultivariateNormal, StudentT, Gumbel,
-                            kl_divergence, register_kl)
+                            Chi2, FisherSnedecor, HalfCauchy, Independent,
+                            Multinomial, NegativeBinomial, Pareto,
+                            RelaxedBernoulli, RelaxedOneHotCategorical,
+                            Weibull, kl_divergence, register_kl)
 from .transformation import (Transformation, AffineTransformation,
                              ExpTransformation, SigmoidTransformation,
                              ComposeTransformation, TransformedDistribution)

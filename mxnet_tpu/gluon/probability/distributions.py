@@ -22,6 +22,9 @@ __all__ = ["Distribution", "Normal", "LogNormal", "HalfNormal", "Laplace",
            "Cauchy", "Uniform", "Exponential", "Gamma", "Beta", "Dirichlet",
            "Poisson", "Bernoulli", "Binomial", "Geometric", "Categorical",
            "OneHotCategorical", "MultivariateNormal", "StudentT", "Gumbel",
+           "Chi2", "FisherSnedecor", "HalfCauchy", "Independent",
+           "Multinomial", "NegativeBinomial", "Pareto", "RelaxedBernoulli",
+           "RelaxedOneHotCategorical", "Weibull",
            "kl_divergence", "register_kl"]
 
 
@@ -752,6 +755,466 @@ class Gumbel(Distribution):
         return _nd_op(f, self.loc, self.scale, name="gumbel_sample")
 
 
+class Chi2(Gamma):
+    """Chi-squared with df degrees of freedom = Gamma(df/2, scale=2)
+    (ref distributions/chi2.py:27)."""
+
+    def __init__(self, df=1.0, **kw):
+        self.df = df
+        d = _raw(df)
+        super().__init__(shape=NDArray(d * 0.5), scale=NDArray(
+            jnp.full(d.shape, 2.0, d.dtype) if d.shape else
+            jnp.asarray(2.0, d.dtype)))
+        self._params = {"df": df}
+
+
+class FisherSnedecor(Distribution):
+    """F-distribution with df1/df2 degrees of freedom (ref
+    distributions/fishersnedecor.py:30: ratio of two scaled Gammas)."""
+
+    def __init__(self, df1=1.0, df2=1.0, **kw):
+        super().__init__(df1=df1, df2=df2)
+        self.df1, self.df2 = df1, df2
+
+    def log_prob(self, value):
+        def f(v, d1, d2):
+            lb = (jax.lax.lgamma(d1 / 2) + jax.lax.lgamma(d2 / 2)
+                  - jax.lax.lgamma((d1 + d2) / 2))
+            return ((d1 / 2) * jnp.log(d1 / d2)
+                    + (d1 / 2 - 1) * jnp.log(v)
+                    - ((d1 + d2) / 2) * jnp.log1p(d1 * v / d2) - lb)
+        return _nd_op(f, value, self.df1, self.df2, name="f_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda d1, d2: jnp.where(
+            d2 > 2, d2 / (d2 - 2), jnp.nan), self.df1, self.df2,
+            name="mean")
+
+    @property
+    def variance(self):
+        def f(d1, d2):
+            num = 2 * d2 ** 2 * (d1 + d2 - 2)
+            den = d1 * (d2 - 2) ** 2 * (d2 - 4)
+            return jnp.where(d2 > 4, num / den, jnp.nan)
+        return _nd_op(f, self.df1, self.df2, name="variance")
+
+    def _sample_impl(self, size):
+        k1, k2 = next_key(), next_key()
+        shape = size + self._batch_shape(self.df1, self.df2)
+
+        def f(d1, d2):
+            # X_i ~ Gamma(df_i/2, scale 2/df_i) are chi2_i/df_i
+            x1 = jax.random.gamma(k1, jnp.broadcast_to(d1 / 2, shape)) \
+                * 2.0 / d1
+            x2 = jax.random.gamma(k2, jnp.broadcast_to(d2 / 2, shape)) \
+                * 2.0 / d2
+            return x1 / x2
+
+        return _nd_op(f, self.df1, self.df2, name="f_sample")
+
+
+class HalfCauchy(Distribution):
+    """|Cauchy(0, scale)| (ref distributions/half_cauchy.py:31)."""
+
+    has_grad = True
+
+    def __init__(self, scale=1.0, **kw):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def log_prob(self, value):
+        def f(v, s):
+            lp = (math.log(2 / math.pi) - jnp.log(s)
+                  - jnp.log1p((v / s) ** 2))
+            return jnp.where(v < 0, -jnp.inf, lp)
+        return _nd_op(f, value, self.scale, name="halfcauchy_logp")
+
+    def cdf(self, value):
+        return _nd_op(lambda v, s: jnp.where(
+            v < 0, 0.0, 2 / math.pi * jnp.arctan(v / s)),
+            value, self.scale, name="cdf")
+
+    def icdf(self, value):
+        return _nd_op(lambda v, s: s * jnp.tan(math.pi * v / 2),
+                      value, self.scale, name="icdf")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.scale)
+
+        def f(s):
+            u = jax.random.uniform(key, shape, minval=1e-7, maxval=1.0)
+            return s * jnp.abs(jnp.tan(math.pi * (u - 0.5)))
+
+        return _nd_op(f, self.scale, name="halfcauchy_sample")
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost batch dims of a base distribution as
+    event dims: log_prob sums over them (ref
+    distributions/independent.py:28)."""
+
+    def __init__(self, base_distribution, reinterpreted_batch_ndims,
+                 **kw):
+        super().__init__()
+        self.base_dist = base_distribution
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+        self.event_dim = (getattr(base_distribution, "event_dim", 0)
+                          + self.reinterpreted_batch_ndims)
+        self.has_grad = base_distribution.has_grad
+
+    def broadcast_to(self, shape):
+        # broadcast the base distribution; the reinterpreted dims ride
+        # along (ref independent.py:46)
+        return Independent(self.base_dist.broadcast_to(shape),
+                           self.reinterpreted_batch_ndims)
+
+    def log_prob(self, value):
+        lp = self.base_dist.log_prob(value)
+        n = self.reinterpreted_batch_ndims
+
+        def f(x):
+            return jnp.sum(x, axis=tuple(range(-n, 0))) if n else x
+        return _nd_op(f, lp, name="independent_logp")
+
+    @property
+    def mean(self):
+        return self.base_dist.mean
+
+    @property
+    def variance(self):
+        return self.base_dist.variance
+
+    def entropy(self):
+        h = self.base_dist.entropy()
+        n = self.reinterpreted_batch_ndims
+
+        def f(x):
+            return jnp.sum(x, axis=tuple(range(-n, 0))) if n else x
+        return _nd_op(f, h, name="independent_entropy")
+
+    def _sample_impl(self, size):
+        return self.base_dist._sample_impl(size)
+
+    def sample(self, size=()):
+        return self.base_dist.sample(size)
+
+    def rsample(self, size=()):
+        return self.base_dist.rsample(size)
+
+
+class Multinomial(Distribution):
+    """Counts over num_events categories from total_count draws (ref
+    distributions/multinomial.py:30)."""
+
+    event_dim = 1
+
+    def __init__(self, num_events=None, prob=None, logit=None,
+                 total_count=1, **kw):
+        if (prob is None) == (logit is None):
+            raise MXNetError("exactly one of prob/logit required")
+        super().__init__(prob=prob, logit=logit)
+        self._prob, self._logit = prob, logit
+        self.total_count = int(total_count)
+        self.num_events = num_events or _raw(
+            prob if prob is not None else logit).shape[-1]
+
+    def broadcast_to(self, shape):
+        # int config (num_events/total_count) must survive broadcasting;
+        # only the prob/logit tensor broadcasts (``shape`` includes the
+        # trailing event dim, matching Categorical.broadcast_to)
+        bcast = {k: (v if v is None else _nd_op(
+            lambda a: jnp.broadcast_to(a, tuple(shape)), v,
+            name="broadcast"))
+            for k, v in (("prob", self._prob), ("logit", self._logit))}
+        return type(self)(num_events=self.num_events,
+                          total_count=self.total_count, **bcast)
+
+    @property
+    def prob_param(self):
+        if self._prob is not None:
+            return self._prob
+        return _nd_op(lambda lg: jax.nn.softmax(lg, -1), self._logit,
+                      name="softmax")
+
+    @property
+    def mean(self):
+        n = self.total_count
+        return _nd_op(lambda p: n * p, self.prob_param, name="mean")
+
+    @property
+    def variance(self):
+        n = self.total_count
+        return _nd_op(lambda p: n * p * (1 - p), self.prob_param,
+                      name="variance")
+
+    def log_prob(self, value):
+        n = float(self.total_count)
+
+        def f(v, p):
+            lg = jax.lax.lgamma
+            lp = (lg(jnp.asarray(n + 1.0)) - jnp.sum(lg(v + 1.0), -1)
+                  + jnp.sum(v * jnp.log(p), -1))
+            # counts that don't sum to total_count are impossible
+            return jnp.where(jnp.sum(v, -1) == n, lp, -jnp.inf)
+        return _nd_op(f, value, self.prob_param, name="multinomial_logp")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        n, k = self.total_count, self.num_events
+
+        def f(p):
+            lg = jnp.log(jnp.clip(p, 1e-30, None))
+            idx = jax.random.categorical(
+                key, lg, axis=-1, shape=(n,) + size + lg.shape[:-1])
+            return jnp.sum(jax.nn.one_hot(idx, k), axis=0)
+
+        return _nd_op(f, self.prob_param, name="multinomial_sample")
+
+
+class NegativeBinomial(Distribution):
+    """Number of successes before n failures at success prob ``prob``
+    (ref distributions/negative_binomial.py:31: mean = n*p/(1-p))."""
+
+    def __init__(self, n=1.0, prob=None, logit=None, **kw):
+        if (prob is None) == (logit is None):
+            raise MXNetError("exactly one of prob/logit required")
+        super().__init__(n=n, prob=prob, logit=logit)
+        self.n = n
+        self._prob, self._logit = prob, logit
+
+    @property
+    def prob_param(self):
+        if self._prob is not None:
+            return self._prob
+        return _nd_op(jax.nn.sigmoid, self._logit, name="sigmoid")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda n, p: n * p / (1 - p), self.n,
+                      self.prob_param, name="mean")
+
+    @property
+    def variance(self):
+        return _nd_op(lambda n, p: n * p / (1 - p) ** 2, self.n,
+                      self.prob_param, name="variance")
+
+    def log_prob(self, value):
+        def f(v, n, p):
+            lg = jax.lax.lgamma
+            return (lg(v + n) - lg(v + 1.0) - lg(n)
+                    + n * jnp.log1p(-p) + v * jnp.log(p))
+        return _nd_op(f, value, self.n, self.prob_param, name="nb_logp")
+
+    def _sample_impl(self, size):
+        k1, k2 = next_key(), next_key()
+
+        def f(n, p):
+            shape = size + jnp.broadcast_shapes(n.shape, p.shape)
+            lam = jax.random.gamma(k1, jnp.broadcast_to(n, shape)) \
+                * p / (1 - p)
+            return jax.random.poisson(k2, lam).astype(jnp.float32)
+
+        return _nd_op(f, self.n, self.prob_param, name="nb_sample")
+
+
+class Pareto(Distribution):
+    """Pareto Type I: support [scale, inf), shape alpha (ref
+    distributions/pareto.py:30)."""
+
+    has_grad = True
+
+    def __init__(self, alpha=1.0, scale=1.0, **kw):
+        super().__init__(alpha=alpha, scale=scale)
+        self.alpha, self.scale = alpha, scale
+
+    def log_prob(self, value):
+        def f(v, a, s):
+            lp = jnp.log(a) + a * jnp.log(s) - (a + 1) * jnp.log(v)
+            return jnp.where(v < s, -jnp.inf, lp)
+        return _nd_op(f, value, self.alpha, self.scale, name="pareto_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda a, s: jnp.where(a > 1, a * s / (a - 1),
+                                             jnp.inf),
+                      self.alpha, self.scale, name="mean")
+
+    @property
+    def variance(self):
+        def f(a, s):
+            var = s ** 2 * a / ((a - 1) ** 2 * (a - 2))
+            return jnp.where(a > 2, var, jnp.inf)
+        return _nd_op(f, self.alpha, self.scale, name="variance")
+
+    def cdf(self, value):
+        return _nd_op(lambda v, a, s: jnp.where(
+            v < s, 0.0, 1 - (s / jnp.maximum(v, s)) ** a),
+            value, self.alpha, self.scale, name="cdf")
+
+    def icdf(self, value):
+        return _nd_op(lambda v, a, s: s * (1 - v) ** (-1 / a), value,
+                      self.alpha, self.scale, name="icdf")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.alpha, self.scale)
+
+        def f(a, s):
+            u = jax.random.uniform(key, shape, minval=1e-7, maxval=1.0)
+            return s * u ** (-1.0 / a)
+
+        return _nd_op(f, self.alpha, self.scale, name="pareto_sample")
+
+
+class Weibull(Distribution):
+    """Weibull(concentration k, scale lambda) (ref
+    distributions/weibull.py:32)."""
+
+    has_grad = True
+
+    def __init__(self, concentration=1.0, scale=1.0, **kw):
+        super().__init__(concentration=concentration, scale=scale)
+        self.concentration, self.scale = concentration, scale
+
+    def log_prob(self, value):
+        def f(v, k, s):
+            z = v / s
+            return (jnp.log(k / s) + (k - 1) * jnp.log(z) - z ** k)
+        return _nd_op(f, value, self.concentration, self.scale,
+                      name="weibull_logp")
+
+    @property
+    def mean(self):
+        return _nd_op(lambda k, s: s * jnp.exp(jax.lax.lgamma(1 + 1 / k)),
+                      self.concentration, self.scale, name="mean")
+
+    @property
+    def variance(self):
+        def f(k, s):
+            g1 = jnp.exp(jax.lax.lgamma(1 + 1 / k))
+            g2 = jnp.exp(jax.lax.lgamma(1 + 2 / k))
+            return s ** 2 * (g2 - g1 ** 2)
+        return _nd_op(f, self.concentration, self.scale, name="variance")
+
+    def cdf(self, value):
+        return _nd_op(lambda v, k, s: 1 - jnp.exp(-((v / s) ** k)), value,
+                      self.concentration, self.scale, name="cdf")
+
+    def _sample_impl(self, size):
+        key = next_key()
+        shape = size + self._batch_shape(self.concentration, self.scale)
+
+        def f(k, s):
+            u = jax.random.uniform(key, shape, minval=1e-7,
+                                   maxval=1.0 - 1e-7)
+            return s * (-jnp.log1p(-u)) ** (1.0 / k)
+
+        return _nd_op(f, self.concentration, self.scale,
+                      name="weibull_sample")
+
+
+class RelaxedBernoulli(Distribution):
+    """Concrete/Gumbel-sigmoid relaxation of Bernoulli at temperature T
+    (ref distributions/relaxed_bernoulli.py:30; density of the
+    BinConcrete(alpha=exp(logit), T) distribution)."""
+
+    has_grad = True
+
+    def __init__(self, T=1.0, prob=None, logit=None, **kw):
+        if (prob is None) == (logit is None):
+            raise MXNetError("exactly one of prob/logit required")
+        super().__init__(T=T, prob=prob, logit=logit)
+        self.T = T
+        self._prob, self._logit = prob, logit
+
+    @property
+    def logit_param(self):
+        if self._logit is not None:
+            return self._logit
+        return _nd_op(lambda p: jnp.log(p) - jnp.log1p(-p), self._prob,
+                      name="logit")
+
+    def log_prob(self, value):
+        def f(v, t, lg):
+            logit_y = jnp.log(v) - jnp.log1p(-v)
+            diff = lg - t * logit_y
+            return (jnp.log(t) + diff - 2 * jax.nn.softplus(diff)
+                    - jnp.log(v * (1 - v)))
+        return _nd_op(f, value, self.T, self.logit_param,
+                      name="relaxed_bernoulli_logp")
+
+    def _sample_impl(self, size):
+        key = next_key()
+
+        def f(t, lg):
+            shape = size + jnp.broadcast_shapes(t.shape, lg.shape)
+            u = jax.random.uniform(key, shape, minval=1e-7,
+                                   maxval=1.0 - 1e-7)
+            logistic = jnp.log(u) - jnp.log1p(-u)
+            return jax.nn.sigmoid((lg + logistic) / t)
+
+        return _nd_op(f, self.T, self.logit_param,
+                      name="relaxed_bernoulli_sample")
+
+
+class RelaxedOneHotCategorical(Distribution):
+    """Gumbel-softmax / Concrete relaxation over num_events classes at
+    temperature T (ref distributions/relaxed_one_hot_categorical.py:31;
+    Maddison et al.'s Concrete density)."""
+
+    has_grad = True
+    event_dim = 1
+
+    def __init__(self, T=1.0, num_events=None, prob=None, logit=None,
+                 **kw):
+        if (prob is None) == (logit is None):
+            raise MXNetError("exactly one of prob/logit required")
+        super().__init__(T=T, prob=prob, logit=logit)
+        self.T = T
+        self._prob, self._logit = prob, logit
+        self.num_events = num_events or _raw(
+            prob if prob is not None else logit).shape[-1]
+
+    def broadcast_to(self, shape):
+        bcast = {k: (v if v is None else _nd_op(
+            lambda a: jnp.broadcast_to(a, tuple(shape)), v,
+            name="broadcast"))
+            for k, v in (("prob", self._prob), ("logit", self._logit))}
+        return type(self)(T=self.T, num_events=self.num_events, **bcast)
+
+    @property
+    def logit_param(self):
+        if self._logit is not None:
+            return self._logit
+        return _nd_op(jnp.log, self._prob, name="log")
+
+    def log_prob(self, value):
+        k = self.num_events
+
+        def f(v, t, lg):
+            score = lg - t * jnp.log(v)
+            return (jax.lax.lgamma(jnp.asarray(float(k)))
+                    + (k - 1) * jnp.log(t)
+                    - k * jax.scipy.special.logsumexp(score, -1)
+                    + jnp.sum(score - jnp.log(v), -1))
+        return _nd_op(f, value, self.T, self.logit_param,
+                      name="relaxed_onehot_logp")
+
+    def _sample_impl(self, size):
+        key = next_key()
+
+        def f(t, lg):
+            shape = size + jnp.broadcast_shapes(
+                t.shape + (1,) * (lg.ndim - t.ndim), lg.shape)
+            g = jax.random.gumbel(key, shape)
+            return jax.nn.softmax((lg + g) / t, -1)
+
+        return _nd_op(f, self.T, self.logit_param,
+                      name="relaxed_onehot_sample")
+
+
 # ------------------------------------------------------------ KL registry
 _KL_REGISTRY: Dict[Tuple[type, type], Callable] = {}
 
@@ -822,3 +1285,13 @@ def _kl_gamma_gamma(p, q):
                 + pa * (ps / qs - 1))
     return _nd_op(f, p.shape_param, p.scale, q.shape_param, q.scale,
                   name="kl_gamma")
+
+
+@register_kl(Pareto, Pareto)
+def _kl_pareto_pareto(p, q):
+    """Ref divergence.py:218: defined only when p's support lies inside
+    q's (p.scale >= q.scale), NaN otherwise like the reference."""
+    def f(pa, ps, qa, qs):
+        res = qa * jnp.log(ps / qs) - jnp.log(qa / pa) + qa / pa - 1
+        return jnp.where(ps < qs, jnp.nan, res)
+    return _nd_op(f, p.alpha, p.scale, q.alpha, q.scale, name="kl_pareto")

@@ -71,19 +71,67 @@ def export_hybrid(block, path: str, epoch: int = 0):
     return sym_file, param_file
 
 
-def import_exported(symbol_file: str, param_file: Optional[str] = None, ctx=None):
-    """Rebuild a runnable block from exported artifacts."""
+def _find_params(base: str):
+    cand = [p for p in os.listdir(os.path.dirname(base) or ".")
+            if p.startswith(os.path.basename(base))
+            and p.endswith(".params")]
+    if not cand:
+        raise MXNetError("no params file found next to symbol file")
+    return os.path.join(os.path.dirname(base) or ".", sorted(cand)[-1])
+
+
+def import_symbol_json(symbol_file: str,
+                       param_file: Optional[str] = None,
+                       input_names=None):
+    """Rebuild a runnable block from the nnvm-style ``-symbol.json`` +
+    params pair — the reference's SymbolBlock.imports convention
+    (block.py:1716), kept working so ported deploy scripts don't need to
+    know about the StableHLO artifact.  Free graph variables not found in
+    the params file are the data inputs, bound positionally in
+    ``input_names`` order."""
+    from .. import symbol as sym_mod
     from .block import SymbolBlock
 
+    sym = sym_mod.load(symbol_file)
+    base = symbol_file.replace("-symbol.json", "")
+    if param_file is None:
+        param_file = _find_params(base)
+    params = nd_load(param_file)
+    free = [n for n in (sym.list_arguments()
+                        + sym.list_auxiliary_states())
+            if n not in params]
+    names = list(input_names) if input_names else free
+    missing = [n for n in free if n not in names]
+    if missing:
+        raise MXNetError(
+            f"symbol has unbound inputs {missing}; pass input_names")
+
+    def runner(*xs):
+        bindings = dict(params)
+        bindings.update({n: NDArray(x) for n, x in zip(names, xs)})
+        outs = sym._interpret(bindings)
+        if len(outs) == 1:
+            return outs[0]._data
+        return tuple(o._data for o in outs)
+
+    blk = SymbolBlock(outputs=runner)
+    blk._imported_params = params
+    return blk
+
+
+def import_exported(symbol_file: str, param_file: Optional[str] = None,
+                    ctx=None, input_names=None):
+    """Rebuild a runnable block from exported artifacts (StableHLO, or
+    the reference-style symbol-json via import_symbol_json)."""
+    from .block import SymbolBlock
+
+    if symbol_file.endswith(".json"):
+        return import_symbol_json(symbol_file, param_file, input_names)
     base = symbol_file.replace("-symbol.stablehlo", "")
     with open(symbol_file, "rb") as f:
         exported = jax.export.deserialize(f.read())
     if param_file is None:
-        cand = [p for p in os.listdir(os.path.dirname(base) or ".")
-                if p.startswith(os.path.basename(base)) and p.endswith(".params")]
-        if not cand:
-            raise MXNetError("no params file found next to symbol file")
-        param_file = os.path.join(os.path.dirname(base) or ".", sorted(cand)[-1])
+        param_file = _find_params(base)
     with open(base + "-meta.json") as f:
         meta = json.load(f)
     params = nd_load(param_file)

@@ -1,21 +1,109 @@
-"""CUDA runtime kernel compilation — not available on a TPU build (ref
-python/mxnet/rtc.py compiles CUDA source via NVRTC).
+"""Runtime kernel authoring — the TPU-native ``mx.rtc`` analog.
 
-The TPU-native equivalent of runtime kernel authoring is a Pallas
-kernel (``mxnet_tpu.ops.attention`` shows the pattern) or a C-ABI
-custom op loaded via ``mx.library.load``; both integrate with jit.
-Every entry point here raises a clear error instead of surfacing an
-AttributeError deep inside user code.
+The reference compiles CUDA source at runtime (python/mxnet/rtc.py:
+``CudaModule(source).get_kernel(name, signature)`` over NVRTC,
+src/common/rtc.cc:35-70).  On TPU the runtime-kernel story is Pallas: a
+user writes a ``pallas_call`` (or any jax-traceable function) and
+registers it as a framework op with :func:`register` — it then dispatches
+through the autograd tape, records under hybridize/symbol tracing, and
+fuses under jit exactly like built-in ops (the seam the built-in flash
+kernel uses, ops/attention.py).
+
+    import jax.experimental.pallas as pl
+
+    def scale_kernel(x_ref, o_ref, *, alpha):
+        o_ref[...] = x_ref[...] * alpha
+
+    def scale(x, alpha=2.0):
+        return pl.pallas_call(functools.partial(scale_kernel, alpha=alpha),
+                              out_shape=jax.ShapeDtypeStruct(x.shape,
+                                                             x.dtype))(x)
+
+    op = mx.rtc.register("my_scale", scale)       # also on mx.npx
+    y = op(nd_x, alpha=3.0)                       # tape-recorded
+
+Gradient support: a plain-jnp kernel is jax-differentiable as-is — the
+tape uses ``jax.vjp``.  A ``pallas_call`` has NO built-in VJP, so a
+Pallas op that must train passes ``grad=``: a callable
+``grad(cotangent, *inputs, **config) -> tuple_of_input_cotangents``
+(itself free to be another Pallas kernel), installed as a
+``jax.custom_vjp``.
+
+``CudaModule``/``CudaKernel`` remain as loud errors: CUDA source cannot
+target a TPU, and silently accepting it would be worse than failing.
 """
 from __future__ import annotations
 
+from typing import Callable, Dict, Optional
+
+import jax
+
 from .base import MXNetError
 
-__all__ = ["CudaModule", "CudaKernel"]
+__all__ = ["register", "kernels", "CudaModule", "CudaKernel"]
+
+_KERNELS: Dict[str, Callable] = {}
+
+
+def kernels() -> Dict[str, Callable]:
+    """name -> registered op callable."""
+    return dict(_KERNELS)
+
+
+def register(name: str, fn: Callable, grad: Optional[Callable] = None,
+             attach_npx: bool = True) -> Callable:
+    """Register a jax-traceable (e.g. Pallas) kernel as a framework op.
+
+    fn(*raw_arrays, **config) -> raw array (or tuple).  NDArray arguments
+    of the returned op become differentiable inputs; everything else is
+    config closed over per call.  With ``grad``,
+    ``grad(cotangent, *inputs)`` must return one cotangent per array
+    input (use a tuple; a single array is accepted for 1-input kernels).
+    """
+    if not callable(fn):
+        raise MXNetError("rtc.register needs a callable kernel")
+    if name in _KERNELS:
+        raise MXNetError(f"kernel '{name}' already registered")
+
+    from .ops.dispatch import call
+
+    def op(*args, out=None, **config):
+        if grad is None:
+            kfn = lambda *xs: fn(*xs, **config)  # noqa: E731
+        else:
+            @jax.custom_vjp
+            def kfn(*xs):
+                return fn(*xs, **config)
+
+            def fwd(*xs):
+                return fn(*xs, **config), xs
+
+            def bwd(xs, g):
+                cots = grad(g, *xs, **config)
+                if not isinstance(cots, (tuple, list)):
+                    cots = (cots,)
+                return tuple(cots)
+
+            kfn.defvjp(fwd, bwd)
+        return call(kfn, args, {}, name=name, out=out)
+
+    op.__name__ = name
+    if attach_npx:
+        # collision check BEFORE touching the registry: a failed attach
+        # must not leave a half-registered name behind
+        from . import numpy_extension as npx
+
+        if hasattr(npx, name):
+            raise MXNetError(f"op '{name}' already exists in npx")
+        setattr(npx, name, op)
+    _KERNELS[name] = op
+    return op
+
 
 _MSG = ("mx.rtc compiles CUDA source with NVRTC; this build is TPU-native "
-        "and has no CUDA. Write a Pallas kernel (see ops/attention.py) or "
-        "load a C-ABI custom op via mx.library.load instead.")
+        "and has no CUDA. Register a Pallas/jax kernel via "
+        "mx.rtc.register (see example/extensions/pallas_ops.py) or load "
+        "an extension via mx.library.load instead.")
 
 
 class CudaModule:

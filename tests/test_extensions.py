@@ -115,3 +115,60 @@ def test_onnx_unmapped_op_raises():
     with pytest.raises(MXNetError, match="no ONNX mapping"):
         monnx.export_model(out, {}, [(2, 2)],
                            onnx_file_path="/tmp/never.onnx")
+
+
+# ---------------------------------------------------------------------------
+# round-5: user-registered Pallas kernels through mx.rtc (verdict #8 —
+# the mx.rtc analog: runtime kernel authoring on TPU is Pallas, not NVRTC)
+# ---------------------------------------------------------------------------
+
+def test_rtc_register_pallas_ops_with_gradients():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    mx.library.load("example/extensions/pallas_ops.py")
+    assert hasattr(mx.npx, "pallas_squared_relu")
+    x = onp.array([-2.0, 0.5, 3.0], "f4")
+    nd_x = mx.nd.array(x)
+    got = mx.npx.pallas_squared_relu(nd_x).asnumpy()
+    want = onp.maximum(x, 0) ** 2
+    assert onp.allclose(got, want, atol=1e-6)
+
+    # hand-written Pallas backward through the tape
+    nd_x.attach_grad()
+    with autograd.record():
+        y = mx.npx.pallas_squared_relu(nd_x)
+        loss = mx.nd.sum(y)
+    loss.backward()
+    assert onp.allclose(nd_x.grad.asnumpy(), 2 * onp.maximum(x, 0),
+                        atol=1e-6)
+
+    # forward-only kernel: tape differentiates the pallas_call itself
+    z = mx.nd.array(x)
+    z.attach_grad()
+    with autograd.record():
+        loss = mx.nd.sum(mx.npx.pallas_axpb(z, a=3.0, b=1.0))
+    loss.backward()
+    assert onp.allclose(loss.asnumpy(), (3 * x + 1).sum(), atol=1e-5)
+    assert onp.allclose(z.grad.asnumpy(), onp.full(3, 3.0), atol=1e-6)
+
+    # registered ops work inside a hybridized block (jit path)
+    class Net(mx.gluon.HybridBlock):
+        def forward(self, v):
+            return mx.npx.pallas_squared_relu(v)
+
+    net = Net()
+    net.hybridize()
+    out = net(mx.nd.array(x))
+    assert onp.allclose(out.asnumpy(), want, atol=1e-6)
+
+    # duplicate registration is refused loudly
+    import pytest as _pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    with _pytest.raises(MXNetError):
+        mx.rtc.register("pallas_axpb", lambda v: v)
+    # CUDA entry points still refuse clearly
+    with _pytest.raises(MXNetError):
+        mx.rtc.CudaModule("__global__ void k() {}")

@@ -179,3 +179,251 @@ def test_broadcast_to_with_dual_params():
     assert b.mean.shape == (3,)
     c = mgp.Categorical(logit=_nd([[0.0, 1.0]])).broadcast_to((3, 2))
     assert c.prob_param.shape == (3, 2)
+
+
+# ---------------------------------------------------------------------------
+# round-5: the 10 distributions the round-4 verdict found missing
+# (Chi2, FisherSnedecor, HalfCauchy, Independent, Multinomial,
+# NegativeBinomial, Pareto, RelaxedBernoulli, RelaxedOneHotCategorical,
+# Weibull) — each held to an independent scipy/numpy oracle.
+# ---------------------------------------------------------------------------
+
+def test_chi2_vs_scipy():
+    x = onp.array([0.5, 1.5, 4.0], "f4")
+    d = mgp.Chi2(df=_nd([3.0]))
+    assert onp.allclose(d.log_prob(_nd(x)).asnumpy(),
+                        ss.chi2.logpdf(x, 3.0), atol=1e-4)
+    assert abs(float(d.mean.asnumpy()) - 3.0) < 1e-5
+    assert abs(float(d.variance.asnumpy()) - 6.0) < 1e-5
+    mx.random.seed(0)
+    s = d.sample((20000,)).asnumpy()
+    assert abs(s.mean() - 3.0) < 0.15
+
+
+def test_fisher_snedecor_vs_scipy():
+    x = onp.array([0.5, 1.0, 2.5], "f4")
+    d1, d2 = 5.0, 8.0
+    d = mgp.FisherSnedecor(df1=_nd([d1]), df2=_nd([d2]))
+    assert onp.allclose(d.log_prob(_nd(x)).asnumpy(),
+                        ss.f.logpdf(x, d1, d2), atol=1e-4)
+    assert abs(float(d.mean.asnumpy()) - d2 / (d2 - 2)) < 1e-5
+    want_var = 2 * d2 ** 2 * (d1 + d2 - 2) / (d1 * (d2 - 2) ** 2
+                                              * (d2 - 4))
+    assert abs(float(d.variance.asnumpy()) - want_var) < 1e-5
+    mx.random.seed(1)
+    s = d.sample((40000,)).asnumpy()
+    assert abs(s.mean() - d2 / (d2 - 2)) < 0.08
+
+
+def test_half_cauchy_vs_scipy():
+    x = onp.array([0.1, 1.0, 3.0], "f4")
+    d = mgp.HalfCauchy(scale=_nd([2.0]))
+    assert onp.allclose(d.log_prob(_nd(x)).asnumpy(),
+                        ss.halfcauchy.logpdf(x, scale=2.0), atol=1e-5)
+    assert onp.allclose(d.cdf(_nd(x)).asnumpy(),
+                        ss.halfcauchy.cdf(x, scale=2.0), atol=1e-5)
+    # negative support is -inf
+    assert d.log_prob(_nd(onp.array([-1.0], "f4"))).asnumpy()[0] == -onp.inf
+    # icdf round-trips cdf
+    u = onp.array([0.1, 0.5, 0.9], "f4")
+    assert onp.allclose(d.cdf(d.icdf(_nd(u))).asnumpy(), u, atol=1e-5)
+    # rsample carries gradient
+    s = _nd([2.0])
+    s.attach_grad()
+    with autograd.record():
+        y = mgp.HalfCauchy(scale=s).rsample((64,))
+        loss = mx.np.sum(y)
+    loss.backward()
+    assert float(abs(s.grad.asnumpy()).sum()) > 0
+
+
+def test_independent_sums_trailing_dims():
+    loc = onp.zeros((3, 4), "f4")
+    base = mgp.Normal(loc=_nd(loc), scale=_nd(onp.ones((3, 4), "f4")))
+    ind = mgp.Independent(base, 1)
+    v = onp.random.RandomState(0).randn(3, 4).astype("f4")
+    got = ind.log_prob(_nd(v)).asnumpy()
+    want = ss.norm.logpdf(v).sum(-1)
+    assert got.shape == (3,)
+    assert onp.allclose(got, want, atol=1e-4)
+    assert ind.event_dim == 1
+    ent = ind.entropy().asnumpy()
+    assert onp.allclose(ent, ss.norm.entropy() * onp.ones(3) * 4,
+                        atol=1e-4)
+
+
+def test_multinomial_vs_scipy():
+    p = onp.array([0.2, 0.5, 0.3], "f4")
+    d = mgp.Multinomial(num_events=3, prob=_nd(p), total_count=6)
+    v = onp.array([1.0, 3.0, 2.0], "f4")
+    got = float(d.log_prob(_nd(v)).asnumpy())
+    want = ss.multinomial.logpmf([1, 3, 2], 6, p.astype("f8") / p.sum())
+    assert abs(got - want) < 1e-4
+    assert onp.allclose(d.mean.asnumpy(), 6 * p, atol=1e-6)
+    assert onp.allclose(d.variance.asnumpy(), 6 * p * (1 - p), atol=1e-6)
+    mx.random.seed(2)
+    s = d.sample((2000,)).asnumpy()
+    assert s.shape == (2000, 3)
+    assert (s.sum(-1) == 6).all()
+    assert onp.allclose(s.mean(0), 6 * p, atol=0.15)
+
+
+def test_negative_binomial_vs_scipy():
+    n, p = 4.0, 0.3         # p = success prob; mean = n p/(1-p)
+    d = mgp.NegativeBinomial(n=_nd([n]), prob=_nd([p]))
+    k = onp.array([0.0, 2.0, 5.0], "f4")
+    # scipy nbinom(n, q) counts successes before n failures w/ success
+    # prob 1-q... its pmf(k; n, q) = C(k+n-1, k) q^n (1-q)^k matches ours
+    # with q = 1-p
+    want = ss.nbinom.logpmf(k, n, 1 - p)
+    assert onp.allclose(d.log_prob(_nd(k)).asnumpy(), want, atol=1e-4)
+    assert abs(float(d.mean.asnumpy()) - n * p / (1 - p)) < 1e-5
+    assert abs(float(d.variance.asnumpy()) - n * p / (1 - p) ** 2) < 1e-4
+    mx.random.seed(3)
+    s = d.sample((40000,)).asnumpy()
+    assert abs(s.mean() - n * p / (1 - p)) < 0.1
+    # logit parameterization agrees
+    logit = math.log(p / (1 - p))
+    d2 = mgp.NegativeBinomial(n=_nd([n]), logit=_nd([logit]))
+    assert onp.allclose(d2.log_prob(_nd(k)).asnumpy(), want, atol=1e-4)
+
+
+def test_pareto_vs_scipy():
+    a, s = 3.0, 2.0
+    d = mgp.Pareto(alpha=_nd([a]), scale=_nd([s]))
+    x = onp.array([2.5, 4.0, 9.0], "f4")
+    assert onp.allclose(d.log_prob(_nd(x)).asnumpy(),
+                        ss.pareto.logpdf(x, a, scale=s), atol=1e-5)
+    assert d.log_prob(_nd(onp.array([1.5], "f4"))).asnumpy()[0] == -onp.inf
+    assert abs(float(d.mean.asnumpy()) - a * s / (a - 1)) < 1e-5
+    assert onp.allclose(d.cdf(_nd(x)).asnumpy(),
+                        ss.pareto.cdf(x, a, scale=s), atol=1e-5)
+    mx.random.seed(4)
+    smp = d.sample((40000,)).asnumpy()
+    assert abs(smp.mean() - a * s / (a - 1)) < 0.05
+    # KL(p||q) matches the reference closed form; NaN when unsupported
+    q = mgp.Pareto(alpha=_nd([2.0]), scale=_nd([1.0]))
+    kl = float(mgp.kl_divergence(d, q).asnumpy())
+    want = 2.0 * math.log(2.0 / 1.0) - math.log(2.0 / 3.0) + 2.0 / 3.0 - 1
+    assert abs(kl - want) < 1e-5
+    assert onp.isnan(mgp.kl_divergence(q, d).asnumpy()).all()
+
+
+def test_weibull_vs_scipy():
+    k, lam = 1.7, 2.5
+    d = mgp.Weibull(concentration=_nd([k]), scale=_nd([lam]))
+    x = onp.array([0.5, 2.0, 4.0], "f4")
+    assert onp.allclose(d.log_prob(_nd(x)).asnumpy(),
+                        ss.weibull_min.logpdf(x, k, scale=lam), atol=1e-4)
+    assert onp.allclose(d.cdf(_nd(x)).asnumpy(),
+                        ss.weibull_min.cdf(x, k, scale=lam), atol=1e-5)
+    assert abs(float(d.mean.asnumpy())
+               - ss.weibull_min.mean(k, scale=lam)) < 1e-4
+    assert abs(float(d.variance.asnumpy())
+               - ss.weibull_min.var(k, scale=lam)) < 1e-4
+    mx.random.seed(5)
+    s = d.sample((40000,)).asnumpy()
+    assert abs(s.mean() - ss.weibull_min.mean(k, scale=lam)) < 0.03
+    # rsample flows gradient through scale
+    sc = _nd([lam])
+    sc.attach_grad()
+    with autograd.record():
+        y = mgp.Weibull(concentration=_nd([k]), scale=sc).rsample((64,))
+        loss = mx.np.sum(y)
+    loss.backward()
+    assert float(abs(sc.grad.asnumpy()).sum()) > 0
+
+
+def test_relaxed_bernoulli_density_and_rsample():
+    from scipy.integrate import quad
+
+    T, p = 0.7, 0.3
+    d = mgp.RelaxedBernoulli(T=_nd([T]), prob=_nd([p]))
+    # the BinConcrete density must integrate to 1 on (0, 1)
+    total, _err = quad(
+        lambda y: float(onp.exp(d.log_prob(
+            _nd(onp.array([y], "f4"))).asnumpy()[0])), 1e-4, 1 - 1e-4)
+    assert abs(total - 1.0) < 5e-3, total
+    # rsample in (0,1), gradient flows to the logit
+    lg = _nd([math.log(p / (1 - p))])
+    lg.attach_grad()
+    mx.random.seed(6)
+    with autograd.record():
+        y = mgp.RelaxedBernoulli(T=_nd([T]), logit=lg).rsample((256,))
+        loss = mx.np.sum(y)
+    loss.backward()
+    s = y.asnumpy()
+    assert ((s > 0) & (s < 1)).all()
+    assert float(abs(lg.grad.asnumpy()).sum()) > 0
+    # as T -> 0 samples approach {0, 1} with P(y>0.5) ~ p
+    mx.random.seed(7)
+    hard = mgp.RelaxedBernoulli(T=_nd([0.05]),
+                                prob=_nd([p])).sample((8000,)).asnumpy()
+    assert abs((hard > 0.5).mean() - p) < 0.03
+
+
+def test_relaxed_one_hot_categorical_density_and_rsample():
+    from scipy.integrate import quad
+
+    T = 0.8
+    p = onp.array([0.4, 0.6], "f4")
+    d = mgp.RelaxedOneHotCategorical(T=_nd([T]), num_events=2,
+                                     prob=_nd(p))
+    # K=2 Concrete density over the simplex edge must integrate to 1
+    total, _err = quad(
+        lambda y: float(onp.exp(d.log_prob(_nd(
+            onp.array([y, 1 - y], "f4"))).asnumpy())), 1e-4, 1 - 1e-4)
+    assert abs(total - 1.0) < 5e-3, total
+    mx.random.seed(8)
+    s = d.sample((4000,)).asnumpy()
+    assert s.shape == (4000, 2)
+    assert onp.allclose(s.sum(-1), 1.0, atol=1e-5)
+    # low temperature recovers categorical frequencies
+    mx.random.seed(9)
+    hard = mgp.RelaxedOneHotCategorical(
+        T=_nd([0.05]), num_events=2, prob=_nd(p)).sample((8000,)).asnumpy()
+    assert abs((hard[:, 1] > 0.5).mean() - 0.6) < 0.03
+    # rsample flows gradient to logits
+    lg = _nd(onp.log(p))
+    lg.attach_grad()
+    with autograd.record():
+        y = mgp.RelaxedOneHotCategorical(T=_nd([T]), num_events=2,
+                                         logit=lg).rsample((128,))
+        loss = mx.np.sum(y * y)
+    loss.backward()
+    assert float(abs(lg.grad.asnumpy()).sum()) > 0
+
+
+def test_new_distributions_broadcast_and_support_edges():
+    """Round-5 review regressions: broadcast_to on int-config classes,
+    off-support cdf, total_count-aware multinomial log_prob."""
+    # Multinomial/RelaxedOneHotCategorical broadcast keeps int config
+    m = mgp.Multinomial(num_events=3, prob=_nd([[0.2, 0.5, 0.3]]),
+                        total_count=6).broadcast_to((4, 3))
+    assert m.total_count == 6 and m.num_events == 3
+    assert m.prob_param.shape == (4, 3)
+    r = mgp.RelaxedOneHotCategorical(
+        T=0.5, num_events=2, prob=_nd([[0.4, 0.6]])).broadcast_to((3, 2))
+    assert r.num_events == 2 and r.logit_param.shape == (3, 2)
+    # Independent broadcasts its base
+    ind = mgp.Independent(mgp.Normal(loc=_nd([0.0]), scale=_nd([1.0])), 1)
+    ind2 = ind.broadcast_to((5,))
+    assert ind2.reinterpreted_batch_ndims == 1
+    assert ind2.base_dist.mean.shape == (5,)
+    # off-support cdf is 0, not negative/inf
+    par = mgp.Pareto(alpha=_nd([3.0]), scale=_nd([2.0]))
+    assert float(par.cdf(_nd([1.0])).asnumpy()) == 0.0
+    assert float(par.cdf(_nd([0.0])).asnumpy()) == 0.0
+    hc = mgp.HalfCauchy(scale=_nd([1.0]))
+    assert float(hc.cdf(_nd([-2.0])).asnumpy()) == 0.0
+    # multinomial counts must sum to total_count
+    mm = mgp.Multinomial(num_events=3, prob=_nd([0.2, 0.5, 0.3]),
+                         total_count=6)
+    assert float(mm.log_prob(_nd([1.0, 1.0, 1.0])).asnumpy()) == -onp.inf
+    # rtc: failed attach leaves no registry residue
+    import mxnet_tpu as mx
+    with pytest.raises(MXNetError):
+        mx.rtc.register("softmax", lambda v: v)   # exists on npx
+    assert "softmax" not in mx.rtc.kernels()
+    op = mx.rtc.register("softmax", lambda v: v, attach_npx=False)
+    assert "softmax" in mx.rtc.kernels()
