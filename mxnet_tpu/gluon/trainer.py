@@ -41,6 +41,7 @@ class Trainer:
         optimizer_params = optimizer_params or {}
         self._init_optimizer(optimizer, optimizer_params)
         self._kv_name = kvstore
+        self._compression_params = compression_params
         self._kvstore: Optional[KVStoreBase] = None
         self._kv_initialized = False
         self._update_on_kvstore = update_on_kvstore if update_on_kvstore is not None else False
@@ -70,6 +71,10 @@ class Trainer:
         else:
             kv = self._kv_name if isinstance(self._kv_name, KVStoreBase) else \
                 kv_create(self._kv_name)
+            if self._compression_params:
+                # ref trainer.py:188: compression_params flow to the
+                # store so the allreduce wire actually compresses
+                kv.set_gradient_compression(self._compression_params)
             self._kvstore = kv
         self._kv_initialized = True
 

@@ -262,7 +262,12 @@ class TPUKVStore(KVStore):
                     "mxnet_tpu.parallel.dist.init() before any jax API use"
                 ) from e
 
-    def _global_sum(self, x):
+    def _global_sum(self, x, key=None):
+        if self._compression is not None and key is not None:
+            # compression engages regardless of process count — the
+            # quantize/error-feedback semantics must not silently change
+            # between a 1-proc dev run and the N-proc job
+            return self._compressed_global_sum(x, key)
         if self.num_workers > 1:
             # process_count>1 implies the group is joined (jax can't see
             # remote processes otherwise)
@@ -270,6 +275,33 @@ class TPUKVStore(KVStore):
 
             return dist.allreduce_host(x)
         return x
+
+    def _compressed_global_sum(self, x, key):
+        """The reference's dist compression wire (gradient_compression.h:
+        43-132): each worker quantizes its locally-reduced gradient with
+        error feedback, ships the 2-BIT PACKED codes (1/16 the fp32
+        bytes), and every receiver unpacks + accumulates — the server's
+        decompress-and-merge, symmetrized.  Single process: the quantize
+        (with error feedback) still applies, so 1-proc and N-proc runs of
+        the same script follow the same compressed-update semantics."""
+        q = self._compression.compress(key, -1, NDArray(x))._data
+        if self.num_workers == 1:
+            return q
+        return self._wire_sum_packed(q, x.shape, x.dtype)
+
+    def _wire_sum_packed(self, q, shape, dtype):
+        """allgather the packed codes of an already-quantized array and
+        accumulate the decoded per-rank values."""
+        from ..parallel import dist
+        from .gradient_compression import pack_2bit, unpack_2bit
+
+        gathered = dist.allgather_host(pack_2bit(q))   # (nproc, nbytes)
+        t = self._compression.threshold
+        total = None
+        for r in range(gathered.shape[0]):
+            dec = unpack_2bit(gathered[r], shape, t, dtype)
+            total = dec if total is None else total + dec
+        return total
 
     def broadcast(self, key, value, out, priority=0):
         vals = _as_list(value)
@@ -288,7 +320,7 @@ class TPUKVStore(KVStore):
             reduced = vals[0]._data
         else:
             reduced = jnp.sum(jnp.stack([v._data for v in vals]), axis=0)
-        reduced = self._global_sum(reduced)
+        reduced = self._global_sum(reduced, key=key)
         if self._updater is not None:
             if key not in self._store:
                 raise MXNetError(f"key {key} must be init'd (broadcast) "
@@ -321,11 +353,37 @@ class TPUKVStore(KVStore):
             vs = _as_list(vals)
             reduced.append(vs[0]._data if len(vs) == 1 else
                            jnp.sum(jnp.stack([v._data for v in vs]), axis=0))
+        if self._compression is not None:
+            # the Trainer's fused allreduce path must compress too (the
+            # per-key wire alone would leave the MAIN dist path dense):
+            # quantize each key with its own residual, then ship ONE
+            # packed buffer for the whole float group
+            fp = [i for i, r in enumerate(reduced)
+                  if jnp.issubdtype(r.dtype, jnp.floating)]
+            for i in fp:
+                reduced[i] = self._compression.compress(
+                    keys[i], -1, NDArray(reduced[i]))._data
+            if self.num_workers > 1 and fp:
+                flat = jnp.concatenate([reduced[i].ravel().astype(
+                    jnp.float32) for i in fp])
+                summed = self._wire_sum_packed(flat, flat.shape,
+                                               jnp.float32)
+                off = 0
+                for i in fp:
+                    n = reduced[i].size
+                    reduced[i] = summed[off:off + n].reshape(
+                        reduced[i].shape).astype(reduced[i].dtype)
+                    off += n
         if self.num_workers > 1:
             from ..parallel import dist
 
             by_dtype: Dict[Any, List[int]] = {}
+            skip = set() if self._compression is None else {
+                i for i, r in enumerate(reduced)
+                if jnp.issubdtype(r.dtype, jnp.floating)}
             for i, r in enumerate(reduced):
+                if i in skip:
+                    continue  # already wire-summed packed above
                 by_dtype.setdefault(jnp.dtype(r.dtype), []).append(i)
             for dt, idxs in by_dtype.items():
                 flat = jnp.concatenate([reduced[i].ravel() for i in idxs])

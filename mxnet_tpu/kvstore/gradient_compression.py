@@ -1,16 +1,19 @@
-"""2-bit gradient compression with error feedback.
+"""2-bit gradient compression with error feedback AND the 2-bit wire
+format.
 
-Reference: src/kvstore/gradient_compression.cc (GradientCompression2Bit:
-quantize each gradient element to {-threshold, 0, +threshold}, keep the
-quantization error in a per-gradient residual that is added back before
-the next quantization) and python/mxnet/kvstore/kvstore.py
-set_gradient_compression.
+Reference: src/kvstore/gradient_compression.{h,cc}
+(GradientCompression2Bit: quantize each gradient element to
+{-threshold, 0, +threshold}, keep the quantization error in a
+per-gradient residual added back before the next quantization, and pack
+the ternary codes 16-per-float32 for the ZPush wire —
+gradient_compression.h:43-132).
 
-TPU-native shape: the quantize step is one jitted element-wise kernel
-(XLA fuses the residual add + 3-way select); the "2-bit wire format" of
-the reference is a CPU-cluster bandwidth trick — here the value of the
-scheme is the *semantics* (sparsified, error-fed-back updates), so the
-quantized tensor stays a dense array of the three levels.
+TPU-native shape: quantize is one jitted element-wise kernel (XLA fuses
+the residual add + 3-way select).  The wire format here packs 4 ternary
+codes per uint8 (00 zero / 01 +threshold / 10 -threshold) — a 16x byte
+reduction vs fp32 — and is what the dist kvstore actually allgathers
+across processes (TPUKVStore pushpull); each receiver unpacks and
+accumulates, mirroring the reference server's decompress-and-merge.
 """
 from __future__ import annotations
 
@@ -22,7 +25,7 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 
-__all__ = ["GradientCompression"]
+__all__ = ["GradientCompression", "pack_2bit", "unpack_2bit"]
 
 
 @jax.jit
@@ -32,6 +35,40 @@ def _quantize_2bit(grad, residual, threshold):
                   jnp.where(acc <= -threshold, -threshold,
                             jnp.zeros_like(acc)))
     return q, acc - q
+
+
+@jax.jit
+def _pack_codes(q):
+    """Ternary quantized values -> uint8, 4 codes per byte."""
+    codes = jnp.where(q > 0, jnp.uint8(1),
+                      jnp.where(q < 0, jnp.uint8(2), jnp.uint8(0)))
+    flat = codes.reshape(-1)
+    pad = (-flat.shape[0]) % 4
+    flat = jnp.pad(flat, (0, pad))
+    quads = flat.reshape(-1, 4)
+    return (quads[:, 0] | (quads[:, 1] << 2) | (quads[:, 2] << 4)
+            | (quads[:, 3] << 6)).astype(jnp.uint8)
+
+
+def pack_2bit(q: jnp.ndarray) -> jnp.ndarray:
+    """Pack a {-t, 0, +t} array into the 2-bit wire format (uint8,
+    ceil(n/4) bytes — 1/16 the bytes of the fp32 gradient)."""
+    return _pack_codes(q)
+
+
+def unpack_2bit(packed: jnp.ndarray, shape, threshold,
+                dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of pack_2bit: bytes -> {-threshold, 0, +threshold}."""
+    n = 1
+    for s in shape:
+        n *= int(s)
+    b = packed.astype(jnp.uint8)
+    codes = jnp.stack([b & 3, (b >> 2) & 3, (b >> 4) & 3, (b >> 6) & 3],
+                      axis=1).reshape(-1)[:n]
+    t = jnp.asarray(threshold, dtype)
+    vals = jnp.where(codes == 1, t, jnp.where(codes == 2, -t,
+                                              jnp.zeros((), dtype)))
+    return vals.reshape(shape)
 
 
 class GradientCompression:

@@ -39,6 +39,26 @@ def main():
     expect = float(sum(range(1, nw + 1)))
     onp.testing.assert_allclose(out.asnumpy(), onp.full((3, 4), expect))
 
+    # -- 2-bit compressed pushpull: the wire carries PACKED codes -----------
+    # (ref dist_sync_kvstore.py compressed rows + gradient_compression.h
+    # wire format). Each rank pushes rank-dependent gradients; the result
+    # must equal the sum of per-rank quantized values.
+    kvc = mx.kvstore.create("dist_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    g = mx.np.full((4, 4), 0.6 if rank % 2 == 0 else -0.6)
+    outc = mx.np.zeros((4, 4))
+    kvc.pushpull("ck", g, out=outc)
+    n_pos = (nw + 1) // 2
+    expect_c = 0.5 * n_pos - 0.5 * (nw - n_pos)
+    onp.testing.assert_allclose(outc.asnumpy(),
+                                onp.full((4, 4), expect_c), atol=1e-6)
+    # error feedback: the dropped 0.1 accumulates and ships next round
+    outc2 = mx.np.zeros((4, 4))
+    kvc.pushpull("ck", mx.np.zeros((4, 4)), out=outc2)
+    # residual 0.1*round1 + 0.0 < threshold on every rank -> all zeros now
+    onp.testing.assert_allclose(outc2.asnumpy(), onp.zeros((4, 4)),
+                                atol=1e-6)
+
     # broadcast: every rank ends with rank 0's value
     b = mx.np.full((2, 2), float(rank + 5))
     o = mx.np.zeros((2, 2))
