@@ -633,3 +633,76 @@ def test_sync_batch_norm_input_gradient():
             return net(a)
 
     check_numeric_gradient(fwd, [x], rtol=4e-2, atol=4e-2)
+
+
+def test_pdf_family_parameter_gradients():
+    """The reference registers PDF_*_Grad kernels (pdf_op.h) — the pdf
+    ops are differentiable w.r.t. their distribution parameters; FD via
+    the shared check_numeric_gradient harness."""
+    import mxnet_tpu as mx
+
+    nd = mx.nd
+    x = nd.array(onp.array([0.5, 1.5, 2.5], "f4"))
+    k = nd.array(onp.array([0.0, 1.0, 2.0], "f4"))
+    beta = nd.array(onp.array([1.5], "f4"))
+    sigma = nd.array(onp.array([0.7], "f4"))
+    cases = [
+        (lambda p: nd.random.pdf_gamma(x, p, beta), 2.0),
+        (lambda p: nd.random.pdf_normal(x, p, sigma), 1.0),
+        (lambda p: nd.random.pdf_exponential(x, p), 1.3),
+        (lambda p: nd.random.pdf_poisson(k, p), 1.7),
+    ]
+    for f, p0 in cases:
+        check_numeric_gradient(f, [onp.array([p0], "f4")],
+                               rtol=3e-2, atol=3e-3)
+
+
+def test_elementwise_differentiable_remainder_fd():
+    """FD gradients for the last differentiable ops outside any gradient
+    file: degrees, fmax/fmin, fmod/mod, copysign, nansum, nanprod (the
+    other non-exercised names are comparisons/rounding/arg ops whose
+    gradient is 0 or undefined — the reference FD-checks none of them).
+    Inputs straddle the branch points: a wins fmax on some lanes and
+    loses on others, b carries mixed signs for copysign, and the nan*
+    reductions see an actual NaN lane."""
+    import mxnet_tpu as mx
+
+    np_ = mx.np
+    # away from kinks (|a|,|b|,|a-b| > 0.1; fmod operands off multiples)
+    a0 = onp.array([-1.5, 0.8, 2.4, -0.6, 1.9, 0.3], "f4")
+    b0 = onp.array([1.0, -1.2, 1.1, -2.0, 0.5, 0.9], "f4")
+    b = mx.nd.array(b0)
+    for f in (lambda a: np_.degrees(a),
+              lambda a: np_.fmax(a, b),
+              lambda a: np_.fmin(a, b),
+              lambda a: np_.fmod(a, b),
+              lambda a: np_.mod(a, b),
+              lambda a: np_.copysign(a, b)):
+        check_numeric_gradient(f, [a0], rtol=5e-2, atol=5e-3)
+    # nansum: the NaN lane must contribute zero gradient
+    b_nan = b0.copy()
+    b_nan[2] = onp.nan
+    bn = mx.nd.array(b_nan)
+    x = mx.nd.array(a0.copy())
+    x.attach_grad()
+    with mx.autograd.record():
+        loss = np_.nansum(x * bn)
+    loss.backward()
+    g = x.grad.asnumpy()
+    ok = ~onp.isnan(b_nan)
+    assert onp.allclose(g[ok], b_nan[ok], atol=1e-6)
+    # through a NaN *operand* the chain rule yields 0*nan = nan (same as
+    # jax/torch); only a NaN in the reduced value itself is masked to 0
+    assert onp.isnan(g[~ok]).all()
+    # nanprod over an input with a NaN lane: grad = prod of the others
+    a_nan = a0.copy()
+    a_nan[4] = onp.nan
+    y = mx.nd.array(a_nan)
+    y.attach_grad()
+    with mx.autograd.record():
+        loss = np_.nanprod(y)
+    loss.backward()
+    others = onp.prod(a_nan[~onp.isnan(a_nan)])
+    g = y.grad.asnumpy()
+    assert abs(g[4]) < 1e-6                      # NaN lane: masked
+    assert abs(g[0] - others / a_nan[0]) < 1e-4
