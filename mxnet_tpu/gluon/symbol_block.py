@@ -54,9 +54,50 @@ def export_hybrid(block, path: str, epoch: int = 0):
             for arr, v in saved:
                 arr._data = v
 
-    example = [jax.ShapeDtypeStruct(s, d) for (s, d) in leaf_specs]
-    exported = jax.export.export(jax.jit(fn))(
-        [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in pvals], *example)
+    pspecs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in pvals]
+    # batch-polymorphic export first (jax.export symbolic dims): every
+    # input whose leading dim equals the example batch gets the shared
+    # symbol 'b', so the deployed artifact serves ANY batch size — the
+    # reference's executor re-binds shapes freely and this keeps that
+    # property.  The guess is VALIDATED by executing the artifact at an
+    # unseen batch and comparing against the eager forward — a model
+    # whose trace hard-codes the batch, whose leading dim is not the
+    # batch (TNC sequence axes), or whose aux input was wrongly tied to
+    # 'b' falls back to the static export instead of shipping a
+    # dynamic_batch promise it cannot keep.
+    exported = None
+    dynamic = False
+    batch = next((s[0] for s, _ in leaf_specs if len(s) >= 1), None)
+    if batch is not None and batch > 0:
+        try:
+            scope = jax.export.SymbolicScope()
+            example = []
+            for s, d in leaf_specs:
+                if s and s[0] == batch:
+                    shp = jax.export.symbolic_shape(
+                        ", ".join(["b"] + [str(x) for x in s[1:]]),
+                        scope=scope)
+                else:
+                    shp = s
+                example.append(jax.ShapeDtypeStruct(shp, d))
+            cand = jax.export.export(jax.jit(fn))(pspecs, *example)
+            vb = batch + 1
+            probe = [jnp.zeros((vb,) + tuple(s[1:]), d)
+                     if (s and s[0] == batch)
+                     else jnp.zeros(s, d) for s, d in leaf_specs]
+            got = cand.call(pvals, *probe)
+            want = fn(pvals, *probe)
+            gl = got if isinstance(got, (tuple, list)) else [got]
+            wl = want if isinstance(want, (tuple, list)) else [want]
+            if all(g.shape == w.shape
+                   and bool(jnp.allclose(g, w, atol=1e-4, rtol=1e-4))
+                   for g, w in zip(gl, wl)):
+                exported, dynamic = cand, True
+        except Exception:  # noqa: BLE001 — symbolic export is best-effort
+            exported = None
+    if exported is None:
+        example = [jax.ShapeDtypeStruct(s, d) for (s, d) in leaf_specs]
+        exported = jax.export.export(jax.jit(fn))(pspecs, *example)
     blob = exported.serialize()
 
     sym_file = f"{path}-symbol.stablehlo"
@@ -66,6 +107,7 @@ def export_hybrid(block, path: str, epoch: int = 0):
     nd_save(param_file, {n: NDArray(v) for n, v in zip(names, pvals)})
     with open(f"{path}-meta.json", "w") as f:
         json.dump({"param_names": names,
+                   "dynamic_batch": dynamic,
                    "input_specs": [[list(s), str(jnp.dtype(d))]
                                    for s, d in leaf_specs]}, f)
     return sym_file, param_file

@@ -87,3 +87,28 @@ def test_threads_with_mixed_signatures_and_symbolblock(tmp_path):
                 assert onp.allclose(got, want_small, atol=1e-4)
 
     _run_threads(5, worker)
+
+
+def test_export_serves_any_batch_size(tmp_path):
+    """StableHLO export is batch-polymorphic (jax.export symbolic 'b'):
+    the deployed artifact serves batch sizes it was never traced at —
+    the reference executor's free re-bind property."""
+    import json
+
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.get_model("lenet")
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((2, 1, 28, 28)))          # traced at batch 2
+    path = str(tmp_path / "lenet")
+    net.export(path)
+    meta = json.load(open(path + "-meta.json"))
+    assert meta["dynamic_batch"] is True
+    sym = mx.gluon.SymbolBlock.imports(path + "-symbol.stablehlo",
+                                       ["data"],
+                                       path + "-0000.params")
+    for b in (1, 5, 9):
+        xb = onp.random.RandomState(b).rand(b, 1, 28, 28).astype("f4")
+        got = sym(mx.nd.array(xb)).asnumpy()
+        want = net(mx.nd.array(xb)).asnumpy()
+        assert got.shape == (b, 10)
+        assert onp.allclose(got, want, atol=1e-5), b

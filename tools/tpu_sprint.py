@@ -102,6 +102,13 @@ def main():
             quick_ok += 1
     print(f"[sprint] pass 1: {quick_ok}/5 quick TPU rows banked",
           flush=True)
+    # quick inference rows: 6 more non-null TPU rows + cache warm, still
+    # tiny shapes (the full sweep runs in pass 2).  Budget covers the
+    # sweep's own worst case (6 children x 1100 s per-child cap) so a
+    # mid-sweep relay hang can't kill the stage before the later
+    # children get their turn.
+    run("quick_infer", [py, "bench.py", "--infer"], timeout=7200,
+        env=qenv)
 
     # ---- pass 2: depth — the comparable numbers, headline first ----
     r1 = run("bench_all", [py, "bench.py"], timeout=10800, env=env)
