@@ -67,6 +67,19 @@ def last_json(rec):
     return None
 
 
+def _is_live_tpu(j):
+    """A LIVE TPU capture: non-null, not skipped, not a bank merge, and
+    actually measured on the tpu platform (a clean CPU fallback run must
+    not clobber the last real TPU headline)."""
+    return bool(j and j.get("value") is not None and not j.get("skipped")
+                and j.get("live", True) and j.get("platform") == "tpu")
+
+
+def _write_live(j):
+    with open(os.path.join(OUT, "BENCH_live.json"), "w") as f:
+        json.dump(j, f, indent=1)
+
+
 def main():
     py = sys.executable
     env = dict(os.environ)
@@ -86,9 +99,20 @@ def main():
 
     platform, err = _bench._probe_backend(attempts=1, timeout=75)
     if platform != "tpu":
+        # generous cap on purpose: if this single probe false-negatived
+        # on a slow-but-alive relay, bench.py's own 3-attempt probe gets
+        # to disagree and run the full measurement; a genuinely dead
+        # relay exits via the CPU-smoke path in ~20 min regardless
         print(f"[sprint] backend probe failed ({err}); skipping quick "
-              "pass, running bench.py dead-relay path", flush=True)
-        run("bench_all", [py, "bench.py"], timeout=2400, env=env)
+              "pass, bench.py decides from here", flush=True)
+        rec = run("bench_all", [py, "bench.py"], timeout=10800, env=env)
+        j = last_json(rec)
+        if _is_live_tpu(j):
+            # bench.py's 3-attempt probe disagreed with ours and landed
+            # a real capture — honor the exit contract (0 = headline
+            # measured) so the watcher applies its 2 h re-fire throttle
+            _write_live(j)
+            return 0
         return 1
 
     # ---- pass 1: breadth — bank a non-null TPU row per config fast ----
@@ -113,11 +137,9 @@ def main():
     # ---- pass 2: depth — the comparable numbers, headline first ----
     r1 = run("bench_all", [py, "bench.py"], timeout=10800, env=env)
     j = last_json(r1)
-    got_tpu = bool(j and j.get("value") is not None
-                   and not j.get("skipped"))
-    if j:
-        with open(os.path.join(OUT, "BENCH_live.json"), "w") as f:
-            json.dump(j, f, indent=1)
+    got_tpu = _is_live_tpu(j)
+    if got_tpu:  # live TPU captures only
+        _write_live(j)
     if not got_tpu:
         print("[sprint] full bench produced no live TPU headline; "
               "continuing (quick rows are already banked)", flush=True)
