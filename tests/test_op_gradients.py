@@ -706,3 +706,29 @@ def test_elementwise_differentiable_remainder_fd():
     g = y.grad.asnumpy()
     assert abs(g[4]) < 1e-6                      # NaN lane: masked
     assert abs(g[0] - others / a_nan[0]) < 1e-4
+
+
+def test_zero_gradient_ops_are_zero_not_errors():
+    """Comparisons and rounding ops carry ZERO gradient (the reference
+    registers them with zero-like FGradient); the tape must produce
+    exact zeros through them, not raise and not leak NaNs."""
+    import mxnet_tpu as mx
+
+    np_ = mx.np
+    x0 = onp.array([0.3, -1.2, 2.7], "f4")
+    y = mx.nd.array(onp.array([0.5, -1.2, 2.0], "f4"))
+    for f in (lambda a: np_.greater(a, y), lambda a: np_.less_equal(a, y),
+              lambda a: np_.not_equal(a, y),
+              lambda a: np_.logical_and(a, y),
+              lambda a: np_.logical_xor(a, y),
+              lambda a: np_.rint(a), lambda a: np_.trunc(a),
+              lambda a: np_.fix(a), lambda a: np_.floor(a),
+              lambda a: np_.sign(a)):
+        x = mx.nd.array(x0.copy())
+        x.attach_grad()
+        with mx.autograd.record():
+            out = f(x)
+            loss = mx.np.sum(out.astype("float32") * 2.0)
+        loss.backward()
+        g = x.grad.asnumpy()
+        assert (g == 0).all(), (f, g)

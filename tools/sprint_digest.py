@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Digest of everything the TPU sprint has banked so far.
+
+Reads ``bench_partial.jsonl`` (the measurement bank) and
+``sprint_results/*.json`` (per-stage records) and prints one table:
+per metric, the LATEST full-scale TPU row, the latest quick row, and
+warm-vs-cold compile evidence — the summary a human (or the next
+session) needs after a relay window, without spelunking JSON by hand.
+
+Usage: python tools/sprint_digest.py [--all]   (--all: include CPU rows)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--all", action="store_true",
+                   help="include CPU rows in the bank table")
+    args = p.parse_args()
+
+    rows = []
+    try:
+        with open(os.path.join(ROOT, "bench_partial.jsonl")) as f:
+            for line in f:
+                try:
+                    rows.append(json.loads(line))
+                except (json.JSONDecodeError, ValueError):
+                    continue
+    except OSError:
+        pass
+
+    # per metric: best full row + freshest quick row
+    best = {}
+    for r in rows:
+        if r.get("value") is None:
+            continue
+        if not args.all and r.get("platform") != "tpu":
+            continue
+        m = r.get("metric")
+        if not m:
+            continue
+        slot = "quick" if r.get("quick") else "full"
+        prev = best.setdefault(m, {})
+        if slot not in prev or r.get("ts", 0) >= prev[slot].get("ts", 0):
+            prev[slot] = r
+
+    if not best:
+        print("bank: no TPU rows yet"
+              + ("" if not args.all else " (and no rows at all)"))
+    else:
+        print(f"{'metric':<44} {'full':>12} {'quick':>10} "
+              f"{'vs_base':>8} {'warm_s':>7}  measured")
+        for m in sorted(best):
+            fr = best[m].get("full", {})
+            qr = best[m].get("quick", {})
+            ts = fr.get("ts") or qr.get("ts")
+            when = time.strftime("%m-%d %H:%M", time.localtime(ts)) \
+                if ts else "-"
+            vs = fr.get("vs_baseline")
+            warm = fr.get("warmup_secs", qr.get("warmup_secs"))
+            print(f"{m:<44} {fr.get('value', '-'):>12} "
+                  f"{qr.get('value', '-'):>10} "
+                  f"{vs if vs is not None else '-':>8} "
+                  f"{warm if warm is not None else '-':>7}  {when}")
+
+    out = os.path.join(ROOT, "sprint_results")
+    if os.path.isdir(out):
+        print("\nstages:")
+        for fn in sorted(os.listdir(out)):
+            if not fn.endswith(".json") or fn == "BENCH_live.json":
+                continue
+            try:
+                with open(os.path.join(out, fn)) as f:
+                    rec = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if "stage" in rec:
+                print(f"  {rec['stage']:<24} rc={rec.get('rc')} "
+                      f"{rec.get('secs', '-')}s "
+                      f"{rec.get('error', '')}")
+    # warm-cache evidence pair, if both quick resnet stages ran
+    qs = {}
+    for tag in ("quick_resnet50", "quick_resnet50_warm"):
+        path = os.path.join(out, f"{tag}.json")
+        if os.path.exists(path):
+            try:
+                rec = json.load(open(path))
+                for line in reversed(
+                        rec.get("stdout_tail", "").splitlines()):
+                    try:
+                        row = json.loads(line)
+                    except (json.JSONDecodeError, ValueError):
+                        continue
+                    if "warmup_secs" in row:
+                        qs[tag] = row["warmup_secs"]
+                        break
+            except (OSError, json.JSONDecodeError):
+                pass
+    if len(qs) == 2 and all(v is not None for v in qs.values()):
+        cold, warm = qs["quick_resnet50"], qs["quick_resnet50_warm"]
+        print(f"\ncompile cache: cold warmup {cold}s -> warm {warm}s "
+              f"({'HIT' if warm < cold / 2 else 'no clear hit'})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
