@@ -1,0 +1,91 @@
+"""The measurement bank + dead-relay artifact merge (round-4 verdict
+weak #3: the official round artifact must never lose a TPU number).
+
+Unit-level: bench.py's banking/ranking helpers against a synthetic
+bench_partial.jsonl — no jax import, no relay.
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _with_bank(bench, rows):
+    tmp = tempfile.NamedTemporaryFile("w", delete=False, suffix=".jsonl")
+    for r in rows:
+        tmp.write(json.dumps(r) + "\n")
+    tmp.close()
+    bench._PARTIAL = tmp.name
+    return tmp.name
+
+
+def test_banked_rows_prefer_full_over_fresher_quick():
+    bench = _load_bench()
+    path = _with_bank(bench, [
+        {"metric": "m", "value": 100.0, "platform": "tpu", "ts": 1.0},
+        {"metric": "m", "value": 5.0, "platform": "tpu", "ts": 9.0,
+         "quick": True},
+        {"metric": "q", "value": 7.0, "platform": "tpu", "ts": 2.0,
+         "quick": True},
+        {"metric": "m", "value": 90.0, "platform": "cpu", "ts": 99.0},
+        {"metric": "m", "value": None, "platform": "tpu", "ts": 99.0},
+    ])
+    try:
+        best = bench._banked_tpu_rows()
+        # full outranks the newer quick row; CPU and null rows ignored
+        assert best["m"]["value"] == 100.0
+        # quick row used when nothing better exists
+        assert best["q"]["value"] == 7.0
+    finally:
+        os.unlink(path)
+
+
+def test_banked_rows_freshest_within_tier():
+    bench = _load_bench()
+    path = _with_bank(bench, [
+        {"metric": "m", "value": 100.0, "platform": "tpu", "ts": 1.0},
+        {"metric": "m", "value": 120.0, "platform": "tpu", "ts": 5.0},
+        {"metric": "m", "value": 110.0, "platform": "tpu", "ts": 3.0},
+    ])
+    try:
+        assert bench._banked_tpu_rows()["m"]["value"] == 120.0
+    finally:
+        os.unlink(path)
+
+
+def test_bank_survives_corrupt_lines_and_missing_file():
+    bench = _load_bench()
+    path = _with_bank(bench, [])
+    with open(path, "a") as f:
+        f.write("not json at all\n{broken\n")
+        f.write(json.dumps({"metric": "m", "value": 1.0,
+                            "platform": "tpu", "ts": 1.0}) + "\n")
+    try:
+        assert bench._banked_tpu_rows()["m"]["value"] == 1.0
+    finally:
+        os.unlink(path)
+    bench._PARTIAL = "/nonexistent/никогда.jsonl"
+    assert bench._banked_tpu_rows() == {}
+
+
+def test_bank_append_and_roundtrip():
+    bench = _load_bench()
+    path = _with_bank(bench, [])
+    try:
+        bench._bank({"metric": "m", "value": 3.0, "platform": "tpu",
+                     "ts": 4.0})
+        assert bench._banked_tpu_rows()["m"]["value"] == 3.0
+    finally:
+        os.unlink(path)
