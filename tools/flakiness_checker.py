@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Flakiness checker: run a test many times under different seeds.
+
+Analog of the reference's ``tools/flakiness_checker.py`` (SURVEY.md §4:
+the reproducibility fixtures log ``MXNET_TEST_SEED=N`` per test; this
+tool drives that hook in a loop to hunt seed-dependent failures).
+
+Usage:
+  python tools/flakiness_checker.py tests/test_foo.py::test_bar [-n 30]
+  python tools/flakiness_checker.py test_foo.test_bar -n 100 --seed 7
+
+Accepts pytest node ids or the reference's ``module.test_name`` spelling.
+Each trial runs in its own pytest subprocess with MXNET_TEST_SEED set
+(sequential seeds from --seed, or random ones with --random-seeds), the
+environment scrubbed the same way the suite runs (PALLAS_AXON_POOL_IPS
+stripped, CPU platform).  Exit 0 iff every trial passed; failures print
+the exact MXNET_TEST_SEED to reproduce.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def to_nodeid(spec: str) -> str:
+    """'test_module.test_name' -> 'tests/test_module.py::test_name';
+    pytest node ids pass through."""
+    if "::" in spec or spec.endswith(".py") or os.path.exists(spec):
+        return spec
+    if "." in spec:
+        mod, _, name = spec.rpartition(".")
+        cand = os.path.join("tests", mod.replace(".", os.sep) + ".py")
+        if os.path.exists(os.path.join(ROOT, cand)):
+            return f"{cand}::{name}"
+    return spec
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("test", help="pytest node id or module.test_name")
+    p.add_argument("-n", "--trials", type=int, default=30)
+    p.add_argument("--seed", type=int, default=0,
+                   help="first seed (sequential from here)")
+    p.add_argument("--random-seeds", action="store_true",
+                   help="draw seeds at random instead of sequentially")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="stream pytest output for failing trials")
+    args = p.parse_args()
+
+    nodeid = to_nodeid(args.test)
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    rng = random.Random(args.seed)
+    failures = []
+    for i in range(args.trials):
+        seed = rng.randrange(2 ** 31) if args.random_seeds \
+            else args.seed + i
+        env["MXNET_TEST_SEED"] = str(seed)
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", nodeid, "-q", "-x",
+             "--no-header", "-p", "no:cacheprovider"],
+            cwd=ROOT, env=env, capture_output=True, text=True)
+        if r.returncode in (2, 3, 4, 5):
+            # collection/import error, internal error, usage error, or
+            # nothing collected — seed-independent; reporting these as
+            # "flaky" would mask that the test never ran
+            print(f"error: pytest could not run {nodeid!r} "
+                  f"(rc={r.returncode}):")
+            print((r.stdout + r.stderr)[-1500:])
+            return 2
+        ok = r.returncode == 0
+        print(f"trial {i + 1}/{args.trials} seed={seed}: "
+              f"{'PASS' if ok else 'FAIL'}", flush=True)
+        if not ok:
+            failures.append(seed)
+            if args.verbose:
+                print(r.stdout[-3000:])
+                print(r.stderr[-1000:])
+    if failures:
+        print(f"\nFLAKY: {len(failures)}/{args.trials} trials failed; "
+              "reproduce with:")
+        for s in failures[:10]:
+            print(f"  MXNET_TEST_SEED={s} python -m pytest {nodeid}")
+        return 1
+    print(f"\nstable: {args.trials}/{args.trials} trials passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
