@@ -25,6 +25,11 @@ _SO = os.path.join(_BUILD, "libmxtpu.so")
 
 
 def _needs_build() -> bool:
+    if not os.path.isdir(_SRC):
+        # no C++ tree (bare wheel, or source removed): a previously
+        # built .so is still perfectly loadable — never rebuild, and
+        # only "need" a build (which will fail gracefully) if no .so
+        return not os.path.exists(_SO)
     if not os.path.exists(_SO):
         return True
     so_mtime = os.path.getmtime(_SO)
@@ -41,6 +46,13 @@ def _build() -> bool:
     workers) must never load a half-written .so."""
     import fcntl
 
+    if not os.path.isdir(_SRC):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "native runtime source (src/mxtpu) not present in this "
+            "install; using Python fallbacks")
+        return False
     os.makedirs(_BUILD, exist_ok=True)
     lock_path = os.path.join(_BUILD, ".mxtpu_build.lock")
     with open(lock_path, "w") as lock_fp:
