@@ -81,6 +81,7 @@ from . import symbol as sym
 from . import visualization
 from . import visualization as viz
 from . import model
+from . import misc
 from . import _ffi
 from . import contrib
 from . import parallel

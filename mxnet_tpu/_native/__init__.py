@@ -158,7 +158,10 @@ def get_lib():
             if _needs_build() and not _build():
                 return None
             _lib = _bind(ctypes.CDLL(_SO))
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale prebuilt .so (kept when src/ is
+            # absent) may predate a symbol _bind expects — fall back to
+            # the Python implementations rather than crash at setup
             _lib = None
         return _lib
 

@@ -327,3 +327,22 @@ def test_check_symbolic_backward_length_guard():
         tu.check_symbolic_backward(lambda x: x * 2.0,
                                    [onp.ones((2,), "float32")], None,
                                    [onp.ones(2), onp.ones(2)])
+
+
+def test_misc_legacy_scheduler():
+    """mx.misc legacy scheduler API (ref python/mxnet/misc.py)."""
+    import pytest
+
+    import mxnet_tpu as mx
+
+    s = mx.misc.FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(0) == 1.0
+    assert s(10) == 0.5
+    assert s(25) == 0.25
+    with pytest.raises(ValueError):
+        mx.misc.FactorScheduler(step=0)
+    with pytest.raises(ValueError):
+        mx.misc.FactorScheduler(step=5, factor=1.5)
+    with pytest.raises(NotImplementedError):
+        mx.misc.LearningRateScheduler()(1)
