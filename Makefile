@@ -15,7 +15,8 @@ SRC      := $(wildcard src/mxtpu/*.cc)
 TESTSRC  := src/mxtpu/tests/test_native.cc
 BUILD    := build
 
-.PHONY: native native-test asan tsan test test-par test-slow test-all ci clean
+.PHONY: native native-test asan tsan test test-par test-slow test-all \
+	telemetry-smoke ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -66,7 +67,13 @@ test-slow:
 test-all:
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -q
 
-ci: native native-test asan tsan test test-slow
+telemetry-smoke:
+	# 20 instrumented LeNet train steps; fails unless the core telemetry
+	# metrics tick and land in telemetry.json (docs/telemetry.md)
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		python tools/telemetry_smoke.py
+
+ci: native native-test asan tsan test test-slow telemetry-smoke
 
 clean:
 	rm -rf $(BUILD)

@@ -562,6 +562,18 @@ def _banked_tpu_rows():
     return best
 
 
+def _telemetry_snapshot():
+    """The run's telemetry aggregates (None when disabled/empty/broken) —
+    each BENCH row carries the evidence needed to EXPLAIN its number:
+    compile seconds, input wait, sync stalls, collective bytes."""
+    try:
+        from mxnet_tpu import telemetry
+
+        return telemetry.snapshot() or None
+    except Exception:
+        return None
+
+
 def _child(name):
     """Child mode: run one config in-process, bank + print its JSON line."""
     import jax
@@ -570,6 +582,7 @@ def _child(name):
     row = _CONFIGS[name](platform == "tpu")
     row["platform"] = platform
     row["ts"] = round(time.time(), 1)
+    row["telemetry"] = _telemetry_snapshot()
     _bank(row)
     print(json.dumps(row))
 
@@ -660,6 +673,7 @@ def _infer_child(name):
         "platform": "tpu" if on_tpu else "cpu",
         "ts": round(time.time(), 1),
         **_row_extras(on_tpu, full, warm)}
+    row["telemetry"] = _telemetry_snapshot()
     _bank(row)
     print(json.dumps(row))
 

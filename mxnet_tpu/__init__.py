@@ -66,6 +66,8 @@ from . import libinfo
 from . import log
 from . import notebook
 from . import profiler
+from . import telemetry
+from . import monitor
 from . import registry
 from . import rtc
 from . import runtime

@@ -15,9 +15,11 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time as _time
 from typing import Callable, Optional, Sequence
 
 from . import _native
+from . import telemetry as _tel
 from .base import MXNetError
 
 __all__ = ["Engine", "NativeEngine", "NaiveEngine", "get", "push",
@@ -71,6 +73,8 @@ class NaiveEngine(Engine):
         self._errs.pop(var._handle, None)
 
     def push(self, fn, read=(), write=(), priority=0, name=None):
+        if _tel._ENABLED:
+            _tel.inc("engine.ops_pushed")
         # same contract as the native engine: only READ deps propagate
         # poison; a successful write supersedes a poisoned value
         for v in read:
@@ -90,11 +94,17 @@ class NaiveEngine(Engine):
                 self._first_err = e
 
     def wait_for_var(self, var: Var):
+        if _tel._ENABLED:
+            # inline execution means waits never block; record the count
+            # so Naive-vs-Threaded runs stay comparable in the table
+            _tel.observe("engine.wait_for_var_seconds", 0.0)
         err = self._errs.get(var._handle)
         if err is not None:
             raise err
 
     def wait_for_all(self):
+        if _tel._ENABLED:
+            _tel.observe("engine.wait_for_all_seconds", 0.0)
         err, self._first_err = self._first_err, None
         if err is not None:
             raise err
@@ -141,6 +151,7 @@ class NativeEngine(Engine):
         self._handle = lib.MXTPUEngineCreate(int(nthreads))
         if not self._handle:
             raise MXNetError("engine creation failed")
+        self._depth_sample = 0
 
     def new_var(self) -> Var:
         return Var(self._lib.MXTPUEngineNewVar(self._handle), self)
@@ -167,6 +178,15 @@ class NativeEngine(Engine):
             with _op_lock:
                 _op_registry.pop(op_id, None)
             raise MXNetError(self._lib.MXTPUGetLastError().decode())
+        if _tel._ENABLED:
+            _tel.inc("engine.ops_pushed")
+            # queue depth needs an extra FFI round-trip, so sample it
+            # (every 16th push) instead of perturbing the hottest host
+            # path on every op; the gauge's max still catches backlogs
+            self._depth_sample += 1
+            if self._depth_sample >= 16:
+                self._depth_sample = 0
+                _tel.set_gauge("engine.queue_depth", self.num_outstanding)
 
     # -- profiling (chrome://tracing events, ref src/profiler/) ----------
     def profile_start(self):
@@ -190,12 +210,25 @@ class NativeEngine(Engine):
         return buf.value.decode()
 
     def wait_for_var(self, var: Var):
-        if self._lib.MXTPUEngineWaitForVar(self._handle, var._handle) != 0:
-            raise MXNetError(self._lib.MXTPUGetLastError().decode())
+        t0 = _time.perf_counter() if _tel._ENABLED else None
+        try:
+            if self._lib.MXTPUEngineWaitForVar(self._handle,
+                                               var._handle) != 0:
+                raise MXNetError(self._lib.MXTPUGetLastError().decode())
+        finally:
+            if t0 is not None:
+                _tel.observe("engine.wait_for_var_seconds",
+                             _time.perf_counter() - t0)
 
     def wait_for_all(self):
-        if self._lib.MXTPUEngineWaitForAll(self._handle) != 0:
-            raise MXNetError(self._lib.MXTPUGetLastError().decode())
+        t0 = _time.perf_counter() if _tel._ENABLED else None
+        try:
+            if self._lib.MXTPUEngineWaitForAll(self._handle) != 0:
+                raise MXNetError(self._lib.MXTPUGetLastError().decode())
+        finally:
+            if t0 is not None:
+                _tel.observe("engine.wait_for_all_seconds",
+                             _time.perf_counter() - t0)
 
     @property
     def num_outstanding(self) -> int:

@@ -27,6 +27,7 @@ because each child handles its own.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import threading
@@ -34,6 +35,7 @@ import threading
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry as _tel
 from ..base import DeferredInitializationError, MXNetError
 from ..context import Context, current_context
 from ..ndarray.ndarray import NDArray, _mutation_scope
@@ -383,11 +385,32 @@ class _CachedOp:
         name = f"cached_op_{type(block).__name__}"
         sig = (key, tuple((x.shape, str(x._data.dtype)) for x in inputs))
         if sig in self._traced:
+            if _tel._ENABLED:
+                _tel.inc("hybridize.cache_hits")
             res = invoke(jit_fn, inputs, name=name)
         else:
             with self._trace_lock:
-                res = invoke(jit_fn, inputs, name=name)
-                self._traced.add(sig)
+                if sig in self._traced:
+                    # another thread traced this sig while we waited on
+                    # the lock: a hit — timing it would bill the OTHER
+                    # thread's compile to this (instant) call
+                    if _tel._ENABLED:
+                        _tel.inc("hybridize.cache_hits")
+                    res = invoke(jit_fn, inputs, name=name)
+                elif _tel._ENABLED:
+                    # first call for this signature pays trace + XLA
+                    # compile — the #1 silent cost on TPU;
+                    # hybridize.compile_seconds is the timer every perf
+                    # investigation reads first
+                    t0 = _time.perf_counter()
+                    res = invoke(jit_fn, inputs, name=name)
+                    _tel.observe("hybridize.compile_seconds",
+                                 _time.perf_counter() - t0)
+                    _tel.inc("hybridize.cache_misses")
+                    self._traced.add(sig)
+                else:
+                    res = invoke(jit_fn, inputs, name=name)
+                    self._traced.add(sig)
         if isinstance(res, NDArray):
             res = (res,)
         n_out = holder["n_out"]

@@ -13,10 +13,12 @@ design makes unnecessary.
 from __future__ import annotations
 
 import multiprocessing as mp
+import time as _time
 from typing import Callable, List, Optional
 
 import numpy as _onp
 
+from ... import telemetry as _tel
 from ...base import MXNetError, get_env
 from ...ndarray.ndarray import NDArray
 from .dataset import Dataset
@@ -132,7 +134,17 @@ class DataLoader:
         if self._num_workers == 0:
             batchify = self._batchify_fn or default_batchify_fn
             for indices in self._batch_sampler:
-                yield _to_device(batchify([self._dataset[i] for i in indices]))
+                # single-process: the whole fetch+batchify runs inline, so
+                # ALL of it is time the training loop spends waiting
+                if _tel._ENABLED:
+                    t0 = _time.perf_counter()
+                    batch = batchify([self._dataset[i] for i in indices])
+                    _tel.observe("dataloader.wait_seconds",
+                                 _time.perf_counter() - t0)
+                    _tel.inc("dataloader.batches")
+                else:
+                    batch = batchify([self._dataset[i] for i in indices])
+                yield _to_device(batch)
             return
 
         pool = self._get_pool()
@@ -144,7 +156,18 @@ class DataLoader:
             while idx < len(batches) and len(pending) < window:
                 pending.append(pool.apply_async(_worker_fn, (batches[idx],)))
                 idx += 1
-            res = pending.pop(0).get(self._timeout)
+            if _tel._ENABLED:
+                # occupancy BEFORE the blocking get: a window that is
+                # persistently < prefetch means workers can't keep up
+                _tel.set_gauge("dataloader.prefetch_occupancy",
+                               sum(1 for p in pending if p.ready()))
+                t0 = _time.perf_counter()
+                res = pending.pop(0).get(self._timeout)
+                _tel.observe("dataloader.wait_seconds",
+                             _time.perf_counter() - t0)
+                _tel.inc("dataloader.batches")
+            else:
+                res = pending.pop(0).get(self._timeout)
             yield _to_device(res)
 
     def __del__(self):

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from .. import telemetry as _tel
 from ..base import MXNetError
 from .. import optimizer as opt_mod
 from ..kvstore import KVStoreBase, create as kv_create
@@ -103,11 +104,12 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + update (ref trainer.py:334)."""
-        if not self._kv_initialized:
-            self._init_kvstore()
-        self._optimizer.rescale_grad = self._rescale(batch_size)
-        self.allreduce_grads()
-        self.update(batch_size, ignore_stale_grad)
+        with _tel.timer("trainer.step_seconds"):
+            if not self._kv_initialized:
+                self._init_kvstore()
+            self._optimizer.rescale_grad = self._rescale(batch_size)
+            self.allreduce_grads()
+            self.update(batch_size, ignore_stale_grad)
 
     def allreduce_grads(self):
         """Ref trainer.py:363. Single process with one logical copy per
@@ -128,14 +130,20 @@ class Trainer:
                 pending.append((i, grads))
         if not pending:
             return
-        group = getattr(self._kvstore, "pushpull_group", None)
-        if multi_process and group is not None and \
-                getattr(self._kvstore, "_updater", None) is None:
-            # one fused collective for all grads instead of one per param
-            group([i for i, _ in pending], [g for _, g in pending])
-        else:
-            for i, grads in pending:
-                self._kvstore.pushpull(i, grads, out=grads)
+        if _tel._ENABLED:
+            _tel.inc("trainer.allreduce_calls")
+            _tel.inc("trainer.allreduce_bytes",
+                     sum(g._data.size * g._data.dtype.itemsize
+                         for _, grads in pending for g in grads))
+        with _tel.timer("trainer.allreduce_seconds"):
+            group = getattr(self._kvstore, "pushpull_group", None)
+            if multi_process and group is not None and \
+                    getattr(self._kvstore, "_updater", None) is None:
+                # one fused collective for all grads instead of one per param
+                group([i for i, _ in pending], [g for _, g in pending])
+            else:
+                for i, grads in pending:
+                    self._kvstore.pushpull(i, grads, out=grads)
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Ref trainer.py:411 — local fused updates."""

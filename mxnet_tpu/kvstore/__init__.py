@@ -21,12 +21,24 @@ from typing import Any, Dict, List, Optional, Union
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry as _tel
 from ..base import MXNetError, Registry
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["KVStoreBase", "KVStore", "TPUKVStore", "create"]
 
 _REG: Registry = Registry("kvstore")
+
+
+def _note_pushpull(value):
+    """Count one pushpull + its wire-relevant bytes (sum over the pushed
+    copies — what a dense cross-device reduction would move)."""
+    if not _tel._ENABLED:
+        return
+    vals = value if isinstance(value, (list, tuple)) else [value]
+    _tel.inc("kvstore.pushpull_calls")
+    _tel.inc("kvstore.pushpull_bytes",
+             sum(v._data.size * v._data.dtype.itemsize for v in vals))
 
 
 class KVStoreBase:
@@ -96,6 +108,8 @@ class KVStore(KVStoreBase):
 
     # -- modern API ---------------------------------------------------------
     def broadcast(self, key, value, out, priority=0):
+        if _tel._ENABLED:
+            _tel.inc("kvstore.broadcast_calls")
         vals = _as_list(value)
         src = vals[0]
         self._store[key] = NDArray(src._data)
@@ -103,6 +117,11 @@ class KVStore(KVStoreBase):
             o._set_data(jax.device_put(src._data, o.ctx.jax_device()))
 
     def pushpull(self, key, value, out=None, priority=0):
+        _note_pushpull(value)
+        with _tel.timer("kvstore.pushpull_seconds"):
+            self._pushpull(key, value, out, priority)
+
+    def _pushpull(self, key, value, out, priority):
         vals = self._maybe_compress(key, _as_list(value))
         if len(vals) == 1:
             reduced = vals[0]._data
@@ -304,6 +323,8 @@ class TPUKVStore(KVStore):
         return total
 
     def broadcast(self, key, value, out, priority=0):
+        if _tel._ENABLED:
+            _tel.inc("kvstore.broadcast_calls")
         vals = _as_list(value)
         src = vals[0]._data
         if self.num_workers > 1:
@@ -314,7 +335,9 @@ class TPUKVStore(KVStore):
         for o in _as_list(out):
             o._set_data(jax.device_put(src, o.ctx.jax_device()))
 
-    def pushpull(self, key, value, out=None, priority=0):
+    # pushpull() inherits KVStore's instrumented wrapper; only the
+    # reduction body differs
+    def _pushpull(self, key, value, out, priority):
         vals = _as_list(value)
         if len(vals) == 1:
             reduced = vals[0]._data
@@ -347,6 +370,11 @@ class TPUKVStore(KVStore):
         if self._updater is not None:
             raise MXNetError("pushpull_group does not support "
                              "optimizer-on-store; use per-key pushpull")
+        if _tel._ENABLED:
+            _tel.inc("kvstore.pushpull_calls")
+            _tel.inc("kvstore.pushpull_bytes",
+                     sum(v._data.size * v._data.dtype.itemsize
+                         for vals in values for v in _as_list(vals)))
         outs = values if outs is None else outs
         reduced = []
         for vals in values:

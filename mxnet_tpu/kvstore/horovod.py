@@ -57,7 +57,10 @@ class Horovod(KVStoreBase):
 
     def broadcast(self, key, value, out, priority=0):
         from . import _as_list
+        from .. import telemetry as _tel
 
+        if _tel._ENABLED:
+            _tel.inc("kvstore.broadcast_calls")
         src = _as_list(value)[0]
         v = self._hvd.broadcast(src, root_rank=0, name=str(key),
                                 priority=priority)
@@ -65,12 +68,16 @@ class Horovod(KVStoreBase):
             o._set_data(v._data if hasattr(v, "_data") else v)
 
     def pushpull(self, key, value, out=None, priority=0):
-        from . import _as_list
+        from . import _as_list, _note_pushpull
+        from .. import telemetry as _tel
 
-        v = self._hvd.allreduce(self._reduce_local(value), average=False,
-                                name=str(key), priority=priority)
-        for o in _as_list(out if out is not None else value):
-            o._set_data(v._data if hasattr(v, "_data") else v)
+        _note_pushpull(value)
+        with _tel.timer("kvstore.pushpull_seconds"):
+            v = self._hvd.allreduce(self._reduce_local(value),
+                                    average=False, name=str(key),
+                                    priority=priority)
+            for o in _as_list(out if out is not None else value):
+                o._set_data(v._data if hasattr(v, "_data") else v)
 
     @staticmethod
     def is_capable(capability: str) -> bool:
@@ -97,19 +104,25 @@ class BytePS(KVStoreBase):
 
     def broadcast(self, key, value, out, priority=0):
         from . import _as_list
+        from .. import telemetry as _tel
 
+        if _tel._ENABLED:
+            _tel.inc("kvstore.broadcast_calls")
         src = _as_list(value)[0]
         self._bps.broadcast_parameters({str(key): src}, root_rank=0)
         for o in _as_list(out):
             o._set_data(src._data)
 
     def pushpull(self, key, value, out=None, priority=0):
-        from . import _as_list
+        from . import _as_list, _note_pushpull
+        from .. import telemetry as _tel
 
-        v = Horovod._reduce_local(value)
-        self._bps.byteps_push_pull(v, name=str(key), is_average=False)
-        for o in _as_list(out if out is not None else value):
-            o._set_data(v._data)
+        _note_pushpull(value)
+        with _tel.timer("kvstore.pushpull_seconds"):
+            v = Horovod._reduce_local(value)
+            self._bps.byteps_push_pull(v, name=str(key), is_average=False)
+            for o in _as_list(out if out is not None else value):
+                o._set_data(v._data)
 
     @staticmethod
     def is_capable(capability: str) -> bool:

@@ -1,0 +1,140 @@
+"""mx.monitor — per-step tensor statistics tap (ref python/mxnet/monitor.py).
+
+The reference Monitor installs an executor callback that captures every
+op's outputs between ``tic()`` and ``toc()`` and reduces each through
+``stat_func`` (default ``|x|`` mean-style norm).  Gluon-era adaptation:
+``install(block)`` registers forward hooks across the block tree, so the
+same tic/collect/toc rhythm taps layer outputs.  Hybridized nets: only
+hooks OUTSIDE the jitted region see real values — the hybridized root's
+hooks fire around the compiled call, while inlined children either don't
+run Python at all (steady state) or produce jit tracers (during the
+trace), which the hooks skip rather than capture.  For per-layer stats
+on a hybridized model, run a diagnostic step with ``hybridize(False)``
+or install on the child blocks of interest directly.
+
+Built on the telemetry registry: every stat collected by ``toc()`` is also
+written as a ``monitor.<name>`` gauge, so ``telemetry.dump_json``/
+``profiler.dumps()`` carry the latest tensor-health readings alongside the
+timing metrics (NaN hunts and exploding-activation hunts read one file).
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import numpy as _onp
+
+from . import telemetry as _tel
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+def _asum_stat(x: _onp.ndarray) -> float:
+    """Default stat (ref monitor.py asum_stat): ||x|| / sqrt(x.size)."""
+    size = x.size or 1
+    return float(_onp.linalg.norm(x.astype(_onp.float64, copy=False))
+                 / math.sqrt(size))
+
+
+class Monitor:
+    """Collect per-layer output statistics each step.
+
+    Parameters mirror the reference (monitor.py:35): ``interval`` — steps
+    between collections; ``stat_func`` — numpy array → scalar (default
+    norm/sqrt(size)); ``pattern`` — regex over layer names selecting what
+    to tap; ``sort`` — sort ``toc()`` results by name.
+
+    Usage::
+
+        mon = mx.monitor.Monitor(interval=1, pattern=".*dense.*")
+        mon.install(net)
+        for step in range(n):
+            mon.tic()
+            loss = train_step(...)
+            for _step, name, value in mon.toc():
+                ...
+    """
+
+    def __init__(self, interval: int = 1,
+                 stat_func: Optional[Callable[[_onp.ndarray], float]] = None,
+                 pattern: str = ".*", sort: bool = False):
+        if interval < 1:
+            raise MXNetError("Monitor interval must be >= 1")
+        self.interval = interval
+        self.stat_func = stat_func or _asum_stat
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue: List[Tuple[int, str, NDArray]] = []
+        self._handles: list = []
+
+    # -- wiring ------------------------------------------------------------
+    def _hook(self, name: str):
+        def hook(block, args, out):
+            if not self.activated:
+                return
+            leaves = out if isinstance(out, (list, tuple)) else (out,)
+            for i, leaf in enumerate(leaves):
+                if not isinstance(leaf, NDArray):
+                    continue
+                if isinstance(leaf._data, jax.core.Tracer):
+                    # hook fired inside a jit trace (hybridize/_CachedOp):
+                    # tracers carry no values — toc() would crash reading
+                    # them, and the trace must stay effect-free
+                    continue
+                tag = f"{name}_output{i if len(leaves) > 1 else ''}"
+                self.queue.append((self.step, tag, leaf))
+        return hook
+
+    def install(self, block, root: str = "") -> "Monitor":
+        """Tap ``block`` and every descendant whose structured name matches
+        ``pattern`` (≈ ref install via executor monitor callback)."""
+        name = root or type(block).__name__.lower()
+        if self.re_pattern.match(name):
+            self._handles.append(
+                block.register_forward_hook(self._hook(name)))
+        for cname, child in block._children.items():
+            self.install(child, f"{name}.{cname}")
+        return self
+
+    def uninstall(self):
+        for h in self._handles:
+            h.detach()
+        self._handles = []
+
+    # -- the step rhythm (ref monitor.py tic/toc/toc_print) ----------------
+    def tic(self):
+        """Start collecting for this step (every ``interval`` steps)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self) -> List[Tuple[int, str, float]]:
+        """Stop collecting; reduce every tapped tensor through
+        ``stat_func``.  Each stat is mirrored to the telemetry registry as
+        gauge ``monitor.<name>``."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        for step, name, arr in self.queue:
+            stat = self.stat_func(arr.asnumpy())
+            res.append((step, name, stat))
+            _tel.set_gauge(f"monitor.{name}", stat)
+        self.queue = []
+        if self.sort:
+            res.sort(key=lambda t: t[1])
+        if _tel._ENABLED and res:
+            _tel.inc("monitor.collections")
+        return res
+
+    def toc_print(self):
+        """toc() + print, the reference's logging form."""
+        for step, name, stat in self.toc():
+            print(f"Batch: {step:7d} {name:30s} {stat:.8f}")

@@ -16,12 +16,14 @@ exceptions surface there like the reference engine's rethrow-at-wait
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as _onp
 
+from .. import telemetry as _tel
 from ..base import MXNetError, numeric_types
 from ..context import Context, cpu, current_context, tpu
 
@@ -87,6 +89,10 @@ class NDArray:
             data = data._data
         if not isinstance(data, jax.Array):
             data = jnp.asarray(data, dtype=_dtype_of(data, dtype))
+            if _tel._ENABLED:
+                # host-sourced construction = the H2D seam (device-side
+                # results enter through the jax.Array branch and cost 0)
+                _tel.inc("ndarray.h2d_bytes", data.nbytes)
         elif dtype is not None and data.dtype != jnp.dtype(dtype):
             data = data.astype(jnp.dtype(dtype))
         if ctx is not None:
@@ -169,7 +175,16 @@ class NDArray:
     # -- host interop ------------------------------------------------------
     def asnumpy(self) -> _onp.ndarray:
         """Blocking device→host copy (ref ndarray.h SyncCopyToCPU)."""
-        return _onp.asarray(self._data)
+        if not _tel._ENABLED:
+            return _onp.asarray(self._data)
+        t0 = _time.perf_counter()
+        try:  # a rethrown async error still spent this blocked time
+            out = _onp.asarray(self._data)
+        finally:
+            _tel.observe("ndarray.asnumpy_seconds",
+                         _time.perf_counter() - t0)
+        _tel.inc("ndarray.d2h_bytes", out.nbytes)
+        return out
 
     def __array__(self, dtype=None):
         a = self.asnumpy()
@@ -304,11 +319,27 @@ class NDArray:
     def wait_to_read(self):
         """Block until value ready; async errors rethrow here
         (ref src/engine/threaded_engine.h:463)."""
-        jax.block_until_ready(self._data)
+        if not _tel._ENABLED:
+            jax.block_until_ready(self._data)
+            return self
+        t0 = _time.perf_counter()
+        try:  # a rethrown async error still spent this blocked time
+            jax.block_until_ready(self._data)
+        finally:
+            _tel.observe("ndarray.wait_to_read_seconds",
+                         _time.perf_counter() - t0)
         return self
 
     def wait_to_write(self):
-        jax.block_until_ready(self._data)
+        if not _tel._ENABLED:
+            jax.block_until_ready(self._data)
+            return self
+        t0 = _time.perf_counter()
+        try:
+            jax.block_until_ready(self._data)
+        finally:
+            _tel.observe("ndarray.wait_to_read_seconds",
+                         _time.perf_counter() - t0)
         return self
 
     # -- device / dtype movement ------------------------------------------

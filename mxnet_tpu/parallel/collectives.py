@@ -13,14 +13,36 @@ from typing import Optional, Sequence, Union
 import jax
 from jax import lax
 
+from .. import telemetry as _tel
+
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "ppermute",
            "broadcast_from", "barrier", "axis_index", "axis_size"]
 
 AxisName = Union[str, Sequence[str]]
 
 
+def _note(op: str, x):
+    """Per-collective call + byte accounting.  These helpers run inside
+    shard_map/pjit TRACES, so the counters tick once per (re)trace, not
+    once per executed step — they answer "which collectives does this
+    graph contain and how big are they", the input the sharding PRs
+    (PAPERS: cross-replica weight-update sharding) steer by."""
+    if not _tel._ENABLED:
+        return
+    try:
+        n = 1
+        for d in x.shape:
+            n *= int(d)
+        nbytes = n * x.dtype.itemsize
+    except (AttributeError, TypeError):
+        nbytes = 0
+    _tel.inc(f"collectives.{op}_calls")
+    _tel.inc(f"collectives.{op}_bytes", nbytes)
+
+
 def all_reduce(x, axis_name: AxisName = "dp", op: str = "sum"):
     """≈ ncclAllReduce (src/kvstore/kvstore_nccl.h)."""
+    _note("all_reduce", x)
     if op == "sum":
         return lax.psum(x, axis_name)
     if op == "mean":
@@ -33,20 +55,24 @@ def all_reduce(x, axis_name: AxisName = "dp", op: str = "sum"):
 
 
 def all_gather(x, axis_name: AxisName = "dp", axis: int = 0, tiled: bool = True):
+    _note("all_gather", x)
     return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name: AxisName = "dp", axis: int = 0):
+    _note("reduce_scatter", x)
     return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
 
 
 def ppermute(x, perm, axis_name: AxisName = "sp"):
     """Neighbor exchange — the ring-attention building block."""
+    _note("ppermute", x)
     return lax.ppermute(x, axis_name, perm)
 
 
 def broadcast_from(x, axis_name: AxisName = "dp", src: int = 0):
     """≈ KVStore broadcast (comm.h Broadcast): take src's value everywhere."""
+    _note("broadcast_from", x)
     idx = lax.axis_index(axis_name)
     masked = jax.numpy.where(idx == src, x, jax.numpy.zeros_like(x))
     return lax.psum(masked, axis_name)
@@ -54,6 +80,8 @@ def broadcast_from(x, axis_name: AxisName = "dp", src: int = 0):
 
 def barrier(axis_name: AxisName = "dp"):
     """Synchronization fence (≈ engine WaitForAll across ranks)."""
+    if _tel._ENABLED:
+        _tel.inc("collectives.barrier_calls")
     return lax.psum(jax.numpy.ones(()), axis_name)
 
 

@@ -19,8 +19,10 @@ Env vars (set by tools/launch.py; DMLC_* aliases accepted for parity):
 from __future__ import annotations
 
 import os
+import time as _time
 from typing import Optional
 
+from .. import telemetry as _tel
 from ..base import MXNetError
 
 _initialized = False
@@ -74,6 +76,7 @@ def init(coordinator_address: Optional[str] = None,
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:
         pass
+    t0 = _time.perf_counter()
     try:
         jax.distributed.initialize(coordinator_address,
                                    num_processes=num_processes,
@@ -85,6 +88,12 @@ def init(coordinator_address: Optional[str] = None,
         if "already initialized" not in str(e).lower():
             raise
     _initialized = True
+    if _tel._ENABLED:
+        # per-rank join latency: a straggler here is a slow host or a DNS/
+        # coordination problem, not a training problem — separate timers
+        _tel.observe("dist.init_seconds", _time.perf_counter() - t0)
+        _tel.set_gauge("dist.rank", jax.process_index())
+        _tel.set_gauge("dist.num_processes", jax.process_count())
 
 
 def initialized() -> bool:
@@ -123,7 +132,18 @@ def allgather_host(x):
     new leading axis (world_size, *x.shape), identical on all ranks."""
     from jax.experimental import multihost_utils
 
-    return multihost_utils.process_allgather(x)
+    if not _tel._ENABLED:
+        return multihost_utils.process_allgather(x)
+    try:
+        nbytes = x.size * x.dtype.itemsize
+    except AttributeError:
+        nbytes = 0
+    _tel.inc("dist.allgather_calls")
+    _tel.inc("dist.allgather_bytes", nbytes)
+    t0 = _time.perf_counter()
+    out = multihost_utils.process_allgather(x)
+    _tel.observe("dist.allgather_seconds", _time.perf_counter() - t0)
+    return out
 
 
 def allreduce_host(x, average: bool = False):
@@ -159,4 +179,10 @@ def barrier(name: str = "mx_barrier") -> None:
         return
     from jax.experimental import multihost_utils
 
+    if not _tel._ENABLED:
+        multihost_utils.sync_global_devices(name)
+        return
+    t0 = _time.perf_counter()
     multihost_utils.sync_global_devices(name)
+    # per-rank barrier wait ≈ how far this rank ran ahead of the slowest
+    _tel.observe("dist.barrier_seconds", _time.perf_counter() - t0)

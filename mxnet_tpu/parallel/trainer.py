@@ -10,12 +10,14 @@ HybridBlock's cached op, so BatchNorm stats and the RNG advance correctly.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry as _tel
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _mutation_scope
 from .. import autograd as _autograd
@@ -603,6 +605,30 @@ class ShardedTrainer:
         k-th call applies the averaged accumulated gradient (the k-1 other
         calls only accumulate — ref gradient-accumulation idiom over
         grad_req='add')."""
+        with _tel.timer("trainer.step_seconds"):
+            return self._step(x, y)
+
+    @staticmethod
+    def _jit_call(fn, *args):
+        """Invoke a jitted step function; when its jit cache grows the
+        call traced + XLA-compiled synchronously, so book that wall time
+        under the same compile timer the hybridize cache uses — one
+        metric answers "how much of this run was compilation" for both
+        paths, including per-shape recompiles and the grad-accum fns."""
+        if not _tel._ENABLED:
+            return fn(*args)
+        cache_size = getattr(fn, "_cache_size", None)
+        if cache_size is None:  # jit internals changed: skip attribution
+            return fn(*args)
+        n0 = cache_size()
+        t0 = _time.perf_counter()
+        out = fn(*args)
+        if cache_size() > n0:
+            _tel.observe("hybridize.compile_seconds",
+                         _time.perf_counter() - t0)
+        return out
+
+    def _step(self, x, y) -> float:
         xb, yb = self._put(x), self._put(y)
         if self.grad_accum <= 1:
             self._t += 1
@@ -611,12 +637,13 @@ class ShardedTrainer:
             # before _get_lr)
             lr = jnp.float32(self.learning_rate)
             (self.pvals, mutated, self.opt_state, self._scale_state,
-             loss) = self._step_fn(self.pvals, self.avals, self._key,
-                                   self.opt_state, self._t, lr,
-                                   self._scale_state, xb, yb)
+             loss) = self._jit_call(self._step_fn, self.pvals, self.avals,
+                                    self._key, self.opt_state, self._t, lr,
+                                    self._scale_state, xb, yb)
             self._write_back(mutated)
             return float(loss)
-        grads, mutated, loss = self._grad_fn(
+        grads, mutated, loss = self._jit_call(
+            self._grad_fn,
             self.pvals, self.avals, self._key, self._scale_state[0], xb, yb)
         self._accum = grads if self._accum is None else \
             [a + g for a, g in zip(self._accum, grads)]
@@ -626,9 +653,9 @@ class ShardedTrainer:
             self._t += 1
             lr = jnp.float32(self.learning_rate)
             avg = [g / self.grad_accum for g in self._accum]
-            (self.pvals, self.opt_state, self._scale_state) = self._apply_fn(
-                self.pvals, self.opt_state, self._t, lr, self._scale_state,
-                avg)
+            (self.pvals, self.opt_state, self._scale_state) = self._jit_call(
+                self._apply_fn, self.pvals, self.opt_state, self._t, lr,
+                self._scale_state, avg)
             self._accum, self._micro = None, 0
             self._write_back_params()
         return float(loss)

@@ -92,13 +92,19 @@ def dump(finished: bool = True, profile_process: str = "worker"):
 
 
 def dumps(reset: bool = False, format: str = "table") -> str:
-    """Aggregate-stats text (ref profiler.py dumps). Counter table only —
-    kernel-level stats live in the XProf trace."""
+    """Aggregate-stats text (ref profiler.py dumps). Profiler counters +
+    the telemetry registry's aggregate table (one call shows both); kernel-
+    level stats live in the XProf trace."""
+    from . import telemetry
+
     lines = ["Profile Statistics:"]
     for name, v in _counters.items():
         lines.append(f"  {name}: {v}")
     if reset:
         _counters.clear()
+    tel = telemetry.dumps(reset=reset)
+    if tel:
+        lines.append(tel)
     return "\n".join(lines)
 
 

@@ -89,3 +89,21 @@ def test_bank_append_and_roundtrip():
         assert bench._banked_tpu_rows()["m"]["value"] == 3.0
     finally:
         os.unlink(path)
+
+
+def test_child_rows_embed_telemetry_snapshot():
+    """Every BENCH row carries the run's telemetry aggregates (ISSUE 1):
+    the helper returns the live snapshot, or None when nothing ticked."""
+    bench = _load_bench()
+    from mxnet_tpu import telemetry
+
+    prev = telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        assert bench._telemetry_snapshot() is None  # empty registry
+        telemetry.inc("bench.test_counter", 2)
+        snap = bench._telemetry_snapshot()
+        assert snap["bench.test_counter"]["value"] == 2
+    finally:
+        telemetry.reset()
+        telemetry.set_enabled(prev)

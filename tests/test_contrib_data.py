@@ -303,3 +303,30 @@ def test_bbox_random_crop_max_iou_bounds_best_overlap():
         iou = bbox_iou(crop_box, boxes)
         assert iou.max() <= 0.3 + 1e-6, iou
     assert hits > 0  # the constraint is satisfiable; some crop must land
+
+
+def test_bbox_random_crop_max_iou_half_bound():
+    """The ISSUE-1 satellite case: a pure max-IoU constraint (None, 0.5)
+    must bound the BEST per-box overlap of every accepted crop by 0.5 —
+    the pre-fix code bounded the per-candidate min instead, accepting
+    crops that overlapped some box almost completely."""
+    import numpy as onp
+
+    from mxnet_tpu.gluon.contrib.data.vision.transforms.bbox.utils import \
+        bbox_iou, bbox_random_crop_with_constraints
+
+    onp.random.seed(1)
+    boxes = onp.array([[20.0, 20.0, 70.0, 70.0],
+                       [30.0, 30.0, 90.0, 90.0]], "f4")
+    hits = 0
+    for _ in range(30):
+        new, crop = bbox_random_crop_with_constraints(
+            boxes.copy(), (120, 120), constraints=((None, 0.5),),
+            max_trial=50)
+        x, y, w, h = crop
+        if (x, y, w, h) == (0, 0, 120, 120):
+            continue  # fallback: nothing satisfied this draw
+        hits += 1
+        crop_box = onp.array([[x, y, x + w, y + h]], "f4")
+        assert bbox_iou(crop_box, boxes).max() <= 0.5 + 1e-6
+    assert hits > 0

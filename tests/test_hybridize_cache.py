@@ -218,3 +218,35 @@ def test_concurrent_shapes_interleaved():
         for s, x in xs.items():
             onp.testing.assert_allclose(N(net(np_.array(x))),
                                         x @ w.T + b, rtol=1e-5, atol=1e-5)
+
+
+def test_telemetry_compile_and_hit_counters_tick():
+    """The jit cache is the #1 silent TPU cost: every trace must add
+    compile seconds, every reuse must count as a hit (ISSUE 1 wiring)."""
+    from mxnet_tpu import telemetry as tel
+
+    prev = tel.set_enabled(True)
+    tel.reset()
+    try:
+        net = _dense_net()
+        net.hybridize()
+        x = np_.ones((2, 4))
+        _warm(net, x)
+        N(net(x))                      # trace + compile (miss #1)
+        snap = tel.snapshot()
+        assert snap["hybridize.cache_misses"]["value"] == 1
+        assert snap["hybridize.compile_seconds"]["count"] == 1
+        assert snap["hybridize.compile_seconds"]["total"] > 0
+        hits0 = snap.get("hybridize.cache_hits", {}).get("value", 0)
+        for _ in range(3):
+            N(net(x))                  # same signature: hits only
+        snap = tel.snapshot()
+        assert snap["hybridize.cache_hits"]["value"] == hits0 + 3
+        assert snap["hybridize.cache_misses"]["value"] == 1
+        N(net(np_.ones((5, 4))))       # new shape: one more miss
+        snap = tel.snapshot()
+        assert snap["hybridize.cache_misses"]["value"] == 2
+        assert snap["hybridize.compile_seconds"]["count"] == 2
+    finally:
+        tel.reset()
+        tel.set_enabled(prev)
