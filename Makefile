@@ -16,7 +16,7 @@ TESTSRC  := src/mxtpu/tests/test_native.cc
 BUILD    := build
 
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
-	telemetry-smoke ci clean
+	telemetry-smoke lint-hybrid ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -73,7 +73,15 @@ telemetry-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		python tools/telemetry_smoke.py
 
-ci: native native-test asan tsan test test-slow telemetry-smoke
+lint-hybrid:
+	# hybridize-safety static analysis (docs/analysis.md). The committed
+	# baseline makes legacy suppressions explicit; NEW violations fail.
+	# mxlint loads mx.analysis standalone (no jax import): sub-second.
+	python tools/mxlint.py --format=json \
+		--baseline tools/mxlint_baseline.json \
+		mxnet_tpu example benchmark
+
+ci: native native-test asan tsan lint-hybrid test test-slow telemetry-smoke
 
 clean:
 	rm -rf $(BUILD)

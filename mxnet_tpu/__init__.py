@@ -72,6 +72,7 @@ from . import registry
 from . import rtc
 from . import runtime
 from . import amp
+from . import analysis
 from . import symbol
 from . import callback
 from . import dlpack

@@ -1,0 +1,29 @@
+"""mx.analysis — static + runtime staging-hazard analysis.
+
+Three layers, one diagnostic shape (``diagnostics.Diagnostic``):
+
+* :mod:`~mxnet_tpu.analysis.hybrid_lint` — AST hybridize-safety linter
+  (rules H001..H010 on HybridBlock forwards, L101 on training loops).
+  CLI: ``tools/mxlint.py``; CI gate: ``make lint-hybrid``.
+* :mod:`~mxnet_tpu.analysis.engine_check` — runtime engine dependency
+  checker (``MXNET_ENGINE_CHECK=1``): verifies each push's actual
+  NDArray accesses against its declared read/write vars (E001/E002)
+  and flags wait-inside-push deadlock patterns (E003).
+* :mod:`~mxnet_tpu.analysis.retrace` — retrace guard over the jit
+  cache: J001 when one block's signature count grows past
+  ``MXNET_RETRACE_WARN_LIMIT``, pointing at the varying input.
+
+Rule catalog: ``diagnostics.RULES`` / docs/analysis.md.  This package is
+stdlib-only at import so the linter runs without loading jax.
+"""
+from . import diagnostics
+from . import engine_check
+from . import hybrid_lint
+from . import retrace
+from .diagnostics import Diagnostic, RULES, rule_doc, to_json
+from .hybrid_lint import lint_file, lint_paths, lint_source
+from .retrace import report as retrace_report
+
+__all__ = ["diagnostics", "engine_check", "hybrid_lint", "retrace",
+           "Diagnostic", "RULES", "rule_doc", "to_json",
+           "lint_source", "lint_file", "lint_paths", "retrace_report"]

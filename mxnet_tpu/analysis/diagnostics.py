@@ -1,0 +1,242 @@
+"""Structured diagnostics shared by every mx.analysis producer.
+
+One diagnostic shape serves four tools — the hybridize-safety linter
+(``hybrid_lint``, H/L rules), the runtime engine dependency checker
+(``engine_check``, E rules), the retrace guard (``retrace``, J rules)
+and ``tools/flakiness_checker.py`` (F rules) — so CI consumes a single
+JSON stream regardless of which layer found the problem.  The catalog
+below is the source of truth for rule codes; docs/analysis.md renders
+from the same data (``mxlint --rules``).
+
+This module is intentionally stdlib-only: ``tools/mxlint.py`` loads the
+``analysis`` package standalone (no jax, no framework import) so linting
+stays sub-second in CI.
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+__all__ = ["Diagnostic", "RULES", "rule_doc", "to_json",
+           "parse_suppressions", "is_suppressed"]
+
+JSON_VERSION = 1
+
+# code -> (title, rationale, fix recipe).  Keep entries one-line-ish;
+# docs/analysis.md carries the long-form discussion.
+RULES: Dict[str, tuple] = {
+    # -- static hybridize-safety (AST) rules --------------------------------
+    "H001": (
+        "eager-sync-in-forward",
+        ".asnumpy()/.item()/.asscalar()/.tolist() inside a HybridBlock "
+        "forward forces a device->host sync and either breaks the jit "
+        "trace (ConcretizationTypeError on a tracer) or silently "
+        "serializes every step",
+        "move host-side consumption outside forward; keep forward a pure "
+        "tensor->tensor function"),
+    "H002": (
+        "tensor-scalar-cast",
+        "float()/int()/bool() on a traced array concretizes it: under "
+        "jit this raises, and in eager mode it is a hidden blocking sync",
+        "keep the value as a tensor (mx.np ops) or compute the scalar "
+        "outside forward"),
+    "H003": (
+        "tensor-dependent-branch",
+        "Python if/while on a tensor value needs the concrete value at "
+        "trace time — the branch is baked into the compiled graph (or "
+        "the trace fails), so the other arm silently never runs",
+        "use mx.np.where / jnp.where or lax.cond-style select instead of "
+        "Python control flow on data"),
+    "H004": (
+        "tensor-assert",
+        "assert on a tensor value concretizes it at trace time; the "
+        "check runs once during tracing, never per step",
+        "assert on static metadata (shapes/dtypes) or validate outside "
+        "forward"),
+    "H005": (
+        "dynamic-shape-op",
+        "nonzero()/boolean-mask indexing/1-arg where() produce data-"
+        "dependent output shapes: every new mask population recompiles "
+        "the graph (compile storm) or fails to stage",
+        "use mx.np.where(cond, a, b) with a static shape, or mask by "
+        "multiplication instead of selection"),
+    "H006": (
+        "impure-call-in-forward",
+        "np.random/random/time/os.environ reads inside traced code are "
+        "evaluated ONCE at trace time and baked in as constants — every "
+        "later call replays the same 'random' value",
+        "draw randomness through mx.np.random (the RNG key is a lifted "
+        "jit input) and read clocks/env outside forward"),
+    "H007": (
+        "input-mutation",
+        "in-place mutation of a forward argument (x[...] = v, x += v) "
+        "aliases caller-visible state into the trace; under the "
+        "mutation-watcher protocol this is caller-surprising and defeats "
+        "XLA's functional aliasing",
+        "operate out-of-place and return the new value"),
+    "H008": (
+        "unstable-kwarg",
+        "passing mutable literals (list/dict/set) or **kwargs into a "
+        "child-block call creates a fresh object per call: the _CachedOp "
+        "cache key never repeats, so every step re-traces",
+        "hoist structural options to __init__ / self attributes, or pass "
+        "hashable scalars/tuples"),
+    "H009": (
+        "mutable-default-arg",
+        "a mutable (list/dict/set/call) default in a forward signature "
+        "is a fresh-or-shared object that destabilizes the jit cache "
+        "signature and is a classic Python aliasing trap",
+        "default to None and normalize inside forward (to a tuple)"),
+    "H010": (
+        "print-in-forward",
+        "print() inside traced code fires once at trace time (showing a "
+        "tracer, not values) and never again — it is always a leftover "
+        "debug statement or a misunderstanding of tracing",
+        "use mx.monitor.Monitor or jax.debug.print for per-step values"),
+    # -- hot-loop (script-level) rules --------------------------------------
+    "L101": (
+        "sync-in-train-loop",
+        "a per-step .asnumpy()/.item()/.asscalar() in a training loop "
+        "blocks the host on the device every iteration, collapsing the "
+        "async dispatch pipeline the engine exists to keep full",
+        "log every N steps from one batched sync, or keep metrics on "
+        "device and sync once per epoch"),
+    # -- runtime engine checker rules ---------------------------------------
+    "E001": (
+        "undeclared-read",
+        "an engine op read an NDArray owned by a var it did not declare "
+        "in read= — the scheduler cannot order it against the writer, "
+        "so the read races",
+        "declare the dependency: push(fn, read=[owner_var], ...)"),
+    "E002": (
+        "undeclared-write",
+        "an engine op wrote an NDArray owned by a var it did not declare "
+        "in write= — concurrent ops on that var are not serialized "
+        "against this write",
+        "declare ownership: push(fn, write=[owner_var], ...)"),
+    "E003": (
+        "wait-inside-push",
+        "an engine op called wait_for_var/wait_for_all from inside a "
+        "pushed fn: on the threaded engine this occupies a worker while "
+        "waiting on work that may need that worker — a deadlock pattern",
+        "restructure as two pushes with a read/write var dependency "
+        "instead of blocking inside the op"),
+    # -- retrace guard ------------------------------------------------------
+    "J001": (
+        "retrace-storm",
+        "one block accumulated an unbounded number of distinct jit "
+        "signatures — each new signature pays a full trace + XLA "
+        "compile, so steady-state throughput never materializes",
+        "pad/bucket the offending argument to a fixed set of shapes "
+        "(see the diagnostic for which input slot varies)"),
+    # -- tool errors --------------------------------------------------------
+    "X000": (
+        "analysis-error",
+        "the tool could not analyze the target at all (syntax error in "
+        "the linted file, or pytest could not collect/run the test) — "
+        "NOT a clean result",
+        "fix the underlying parse/collection error; the message carries "
+        "the tool's output"),
+    # -- flakiness checker --------------------------------------------------
+    "F001": (
+        "flaky-test",
+        "the test fails under some seeds and passes under others — a "
+        "seed-dependent tolerance or ordering assumption",
+        "reproduce with the reported MXNET_TEST_SEED and widen the "
+        "tolerance or fix the ordering assumption"),
+}
+
+
+def rule_doc(code: str) -> str:
+    """Human one-pager for a rule code (CLI --explain)."""
+    if code not in RULES:
+        return f"unknown rule code {code!r}"
+    title, why, fix = RULES[code]
+    return (f"{code} ({title})\n  why: {why}\n  fix: {fix}\n"
+            f"  suppress: append  # mxlint: disable={code}")
+
+
+class Diagnostic:
+    """One finding: where, which rule, what to do about it."""
+
+    __slots__ = ("path", "line", "col", "code", "message", "symbol",
+                 "source")
+
+    def __init__(self, path: str, line: int, code: str, message: str,
+                 col: int = 0, symbol: str = "", source: str = "mxlint"):
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.code = code
+        self.message = message
+        self.symbol = symbol
+        self.source = source
+
+    def fingerprint(self) -> str:
+        """Stable identity for baselining: line numbers drift, the
+        (file, enclosing symbol, rule) triple rarely does."""
+        return f"{self.path}::{self.symbol}::{self.code}"
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "code": self.code, "symbol": self.symbol,
+                "message": self.message, "source": self.source}
+
+    def format(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{where}: {self.code} {self.message}{sym}"
+
+    def __repr__(self):
+        return f"Diagnostic({self.format()!r})"
+
+
+def to_json(diags: Iterable[Diagnostic], tool: str = "mxlint",
+            **extra) -> dict:
+    """The one JSON shape every producer emits (tools consume this)."""
+    doc = {"version": JSON_VERSION, "tool": tool,
+           "diagnostics": [d.to_dict() for d in diags]}
+    doc.update(extra)
+    return doc
+
+
+def dumps_json(diags: Iterable[Diagnostic], tool: str = "mxlint",
+               **extra) -> str:
+    return json.dumps(to_json(diags, tool=tool, **extra), indent=2,
+                      sort_keys=True) + "\n"
+
+
+# -- inline suppression -------------------------------------------------------
+#
+#   x = y.asnumpy()  # mxlint: disable=H001
+#   x = y.asnumpy()  # mxlint: disable=H001,L101
+#   # mxlint: disable-file=H006        (anywhere in the file, whole file)
+#
+# Same-line only (pylint style); 'all' silences every rule on that line.
+
+_SUPPRESS_RE = re.compile(r"#\s*mxlint:\s*disable=([A-Za-z0-9,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*mxlint:\s*disable-file=([A-Za-z0-9,\s]+)")
+
+
+def parse_suppressions(source: str):
+    """-> (line_no -> set(codes), file-wide set(codes)). 'all' allowed."""
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    for i, raw in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if m:
+            codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+            per_line.setdefault(i, set()).update(codes)
+        m = _SUPPRESS_FILE_RE.search(raw)
+        if m:
+            file_wide.update(c.strip() for c in m.group(1).split(",")
+                             if c.strip())
+    return per_line, file_wide
+
+
+def is_suppressed(diag: Diagnostic, per_line, file_wide) -> bool:
+    if "all" in file_wide or diag.code in file_wide:
+        return True
+    codes = per_line.get(diag.line, ())
+    return "all" in codes or diag.code in codes

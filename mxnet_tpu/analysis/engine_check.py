@@ -1,0 +1,308 @@
+"""Runtime engine dependency checker (``MXNET_ENGINE_CHECK=1``).
+
+The dependency engine serializes ops through *declared* read/write vars
+(ref engine.h PushAsync const_vars/mutable_vars); nothing verifies that
+an op's **actual** NDArray accesses match its declaration — an
+undeclared dependency runs unordered against its producer, i.e. a race
+that only loses under load.  This module is the checking mode:
+
+* :class:`CheckingEngine` wraps any engine.  Each push runs its fn under
+  a thread-local *push context* carrying the declared var sets.
+* NDArray seams report into the active context — reads from
+  ``asnumpy``/``wait_to_read`` and the op-dispatch funnel, writes from
+  ``_set_data`` (every mutation funnels through it).  Arrays become
+  *owned* by a var either explicitly (:func:`bind`) or automatically:
+  the first write inside a single-write-var push binds the array to that
+  var.
+* Violations are recorded as structured diagnostics: **E001**
+  undeclared read, **E002** undeclared write, **E003**
+  wait-inside-push (the threaded-engine deadlock pattern — a worker
+  blocking on engine work that may need that worker).
+
+Overhead contract mirrors telemetry: every hook guards on the module
+flag ``_ACTIVE`` (one global read when disabled); enabled cost is one
+thread-local read plus a dict probe per NDArray access.
+``MXNET_ENGINE_CHECK=raise`` escalates violations to exceptions at the
+access site (tests); the default mode records + logs a warning once per
+unique (push-name, rule) pair.
+
+Import-light on purpose (stdlib only): ndarray.py imports this module at
+startup, and ``tools/mxlint.py`` loads the analysis package standalone.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import weakref
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .diagnostics import Diagnostic
+
+__all__ = ["CheckingEngine", "install", "uninstall", "enabled", "bind",
+           "unbind", "diagnostics", "clear", "on_read", "on_write",
+           "env_mode"]
+
+# The one flag NDArray / dispatch hot paths read.
+_ACTIVE: bool = False
+_RAISE: bool = False
+
+_TLS = threading.local()  # .ctx: innermost _PushCtx or None
+
+_LOCK = threading.Lock()
+_DIAGS: List[Diagnostic] = []
+_MAX_DIAGS = 1000    # long checked runs must not accumulate unboundedly
+_DROPPED = 0         # violations beyond the cap (still logged/counted)
+_WARNED: Set[Tuple[str, str]] = set()
+# id(nd) -> (weakref(nd), owner Var).  The Var is held STRONGLY so its
+# id can never be reused while an array claims it as owner (Var has no
+# __weakref__ slot); entries are pruned by the nd finalizer and by
+# CheckingEngine.delete_var.
+_OWNERS: Dict[int, Tuple[weakref.ref, object]] = {}
+
+_LOG = logging.getLogger(__name__)
+
+
+def env_mode() -> str:
+    """'': disabled; 'warn': record+log; 'raise': escalate."""
+    v = os.environ.get("MXNET_ENGINE_CHECK", "").strip().lower()
+    if v in ("", "0", "off", "false"):
+        return ""
+    return "raise" if v == "raise" else "warn"
+
+
+class _PushCtx:
+    __slots__ = ("read_vars", "write_vars", "read_ids", "write_ids",
+                 "name")
+
+    def __init__(self, read, write, name):
+        # hold the declared Var objects for the push's duration: the id
+        # sets stay valid (no gc/reuse while the ctx lives) and auto-bind
+        # needs the actual object to store as owner
+        self.read_vars = tuple(read)
+        self.write_vars = tuple(write)
+        self.read_ids = {id(v) for v in self.read_vars}
+        self.write_ids = {id(v) for v in self.write_vars}
+        self.name = name or "<unnamed>"
+
+
+class EngineCheckError(RuntimeError):
+    """Raised at the access site under MXNET_ENGINE_CHECK=raise."""
+
+
+def _record(code: str, message: str, push_name: str):
+    global _DROPPED
+    d = Diagnostic(path="<engine>", line=0, code=code, message=message,
+                   symbol=push_name, source="engine-check")
+    with _LOCK:
+        if len(_DIAGS) < _MAX_DIAGS:
+            _DIAGS.append(d)
+        else:  # bounded retention; the counter below still ticks
+            _DROPPED += 1
+        key = (push_name, code)
+        warn = key not in _WARNED
+        if warn:
+            _WARNED.add(key)
+    try:  # telemetry is optional here: the checker must work standalone
+        from mxnet_tpu import telemetry as _tel
+
+        _tel.inc("engine.check_violations")
+    except Exception:
+        pass
+    if _RAISE:
+        raise EngineCheckError(f"{code}: {message}")
+    if warn:
+        _LOG.warning("engine-check %s in push '%s': %s", code, push_name,
+                     message)
+
+
+def _discard_owner(key: int):
+    with _LOCK:
+        _OWNERS.pop(key, None)
+
+
+def bind(nd, var):
+    """Declare ``var`` the owner of ``nd``: any engine op touching ``nd``
+    must declare ``var`` in its read (reads) or write (writes) set."""
+    key = id(nd)
+    with _LOCK:
+        if key not in _OWNERS:
+            weakref.finalize(nd, _discard_owner, key)
+        _OWNERS[key] = (weakref.ref(nd), var)
+
+
+def unbind(nd):
+    with _LOCK:
+        _OWNERS.pop(id(nd), None)
+
+
+def _owner_of(nd) -> Optional[int]:
+    """id of the owning Var, stable because the Var is held strongly."""
+    ent = _OWNERS.get(id(nd))
+    if ent is None:
+        return None
+    ref, var = ent
+    if ref() is not nd:  # id reuse after gc; entry is stale
+        return None
+    return id(var)
+
+
+def on_read(nd):
+    """NDArray read seam (asnumpy / wait_to_read / op-dispatch inputs)."""
+    ctx = getattr(_TLS, "ctx", None)
+    if ctx is None:
+        return
+    var_id = _owner_of(nd)
+    if var_id is None:
+        return
+    if var_id not in ctx.read_ids and var_id not in ctx.write_ids:
+        _record("E001",
+                f"read of NDArray(shape={getattr(nd, 'shape', '?')}) "
+                f"owned by var {var_id:#x} without declaring it in "
+                "read= — the scheduler cannot order this against the "
+                "writer", ctx.name)
+
+
+def on_write(nd):
+    """NDArray write seam (_set_data funnels every mutation)."""
+    ctx = getattr(_TLS, "ctx", None)
+    if ctx is None:
+        return
+    var_id = _owner_of(nd)
+    if var_id is not None:
+        if var_id not in ctx.write_ids:
+            _record("E002",
+                    f"write to NDArray(shape={getattr(nd, 'shape', '?')}) "
+                    f"owned by var {var_id:#x} without declaring it in "
+                    "write= — concurrent ops are not serialized against "
+                    "this", ctx.name)
+        return
+    # first write inside a single-write-var push establishes ownership
+    if len(ctx.write_vars) == 1:
+        key = id(nd)
+        (var,) = ctx.write_vars
+        with _LOCK:
+            if key not in _OWNERS:
+                weakref.finalize(nd, _discard_owner, key)
+            _OWNERS[key] = (weakref.ref(nd), var)
+
+
+def diagnostics() -> List[Diagnostic]:
+    with _LOCK:
+        return list(_DIAGS)
+
+
+def clear():
+    global _DROPPED
+    with _LOCK:
+        _DIAGS.clear()
+        _WARNED.clear()
+        _OWNERS.clear()
+        _DROPPED = 0
+
+
+class CheckingEngine:
+    """Duck-typed engine wrapper: delegates everything, instruments push
+    bodies with a push context and flags waits issued from inside one."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    # expose the wrapped engine for introspection / tests
+    @property
+    def inner(self):
+        return self._inner
+
+    def new_var(self):
+        return self._inner.new_var()
+
+    def delete_var(self, var):
+        with _LOCK:
+            stale = [k for k, (_, v) in _OWNERS.items() if v is var]
+            for k in stale:
+                _OWNERS.pop(k, None)
+        return self._inner.delete_var(var)
+
+    def push(self, fn, read=(), write=(), priority=0, name=None):
+        ctx = _PushCtx(read, write, name)
+
+        def checked():
+            prev = getattr(_TLS, "ctx", None)
+            _TLS.ctx = ctx
+            try:
+                fn()
+            finally:
+                _TLS.ctx = prev
+
+        return self._inner.push(checked, read=read, write=write,
+                                priority=priority, name=name)
+
+    def wait_for_var(self, var):
+        ctx = getattr(_TLS, "ctx", None)
+        if ctx is not None:
+            _record("E003",
+                    "wait_for_var called from inside an engine op "
+                    "occupies a worker while blocking on engine work — "
+                    "a deadlock pattern on the threaded engine",
+                    ctx.name)
+            if id(var) in ctx.write_ids or id(var) in ctx.read_ids:
+                # the waited var is serialized behind THIS op: delegating
+                # would deadlock for real — the diagnostic replaces the
+                # hang
+                return None
+        return self._inner.wait_for_var(var)
+
+    def wait_for_all(self):
+        ctx = getattr(_TLS, "ctx", None)
+        if ctx is not None:
+            _record("E003",
+                    "wait_for_all called from inside an engine op waits "
+                    "on the op itself — a guaranteed deadlock on the "
+                    "threaded engine", ctx.name)
+            # wait_for_all includes the current op: never delegate
+            return None
+        return self._inner.wait_for_all()
+
+    def __getattr__(self, name):  # profiling etc. pass through
+        return getattr(self._inner, name)
+
+
+def enabled() -> bool:
+    return _ACTIVE
+
+
+def install(engine=None, raise_on_violation: Optional[bool] = None):
+    """Wrap the process-global engine (or ``engine``) and activate the
+    hooks; returns the :class:`CheckingEngine`.  Idempotent."""
+    global _ACTIVE, _RAISE
+    import mxnet_tpu.engine as _eng_mod
+
+    if engine is None:
+        _eng_mod.get()  # ensure the global engine exists (takes the lock)
+        with _eng_mod._engine_lock:
+            cur = _eng_mod._engine
+            wrapper = cur if isinstance(cur, CheckingEngine) \
+                else CheckingEngine(cur)
+            _eng_mod._engine = wrapper
+    else:
+        wrapper = engine if isinstance(engine, CheckingEngine) \
+            else CheckingEngine(engine)
+    if raise_on_violation is not None:
+        _RAISE = bool(raise_on_violation)
+    else:
+        _RAISE = env_mode() == "raise"
+    _ACTIVE = True
+    return wrapper
+
+
+def uninstall():
+    """Deactivate hooks and unwrap the global engine."""
+    global _ACTIVE, _RAISE
+    import mxnet_tpu.engine as _eng_mod
+
+    _ACTIVE = False
+    _RAISE = False
+    with _eng_mod._engine_lock:
+        if isinstance(_eng_mod._engine, CheckingEngine):
+            _eng_mod._engine = _eng_mod._engine.inner
+    clear()

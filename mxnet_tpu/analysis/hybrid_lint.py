@@ -1,0 +1,466 @@
+"""AST hybridize-safety linter (the static half of mx.analysis).
+
+Walks Python source for the staging hazards that make hybridized
+subgraphs fall back to eager or recompile every step — the obstacle
+class the Julia-to-TPU work names as the blocker for full-program XLA
+compilation (arXiv:1810.09868) and whose cost the XLA fusion study
+measures as recompile churn (arXiv:2301.13062).  Two analyses:
+
+* **hybrid-forward rules (H001..H010)** — every ``forward`` /
+  ``hybrid_forward`` of a class that (transitively, within the module)
+  subclasses HybridBlock is checked under a taint analysis: the forward's
+  tensor arguments are tainted, taint propagates through assignments /
+  arithmetic / method calls, and rules fire on tainted values reaching
+  Python-land (branches, casts, asserts) or on always-wrong constructs
+  (device syncs, impure calls, dynamic-shape ops).
+
+  Static metadata reads are deliberately *untainted*: ``x.shape`` /
+  ``x.ndim`` / ``x.dtype`` / ``len(x)`` are compile-time constants under
+  jit, so ``if x.ndim == 2:`` stays clean — only *data*-dependent
+  staging hazards fire.
+
+* **hot-loop rule (L101)** — any loop that trains (contains
+  ``.backward()`` / ``autograd.record()`` / ``trainer.step()``) must not
+  sync the device per iteration (``.asnumpy()``/``.item()``); the linter
+  flags those so logging moves behind a gate or batches into one sync.
+
+Suppression: trailing ``# mxlint: disable=CODE`` (see diagnostics.py).
+Stdlib-only on purpose — ``tools/mxlint.py`` runs this without importing
+the framework (no jax), so CI linting is sub-second.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .diagnostics import Diagnostic, is_suppressed, parse_suppressions
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "iter_python_files"]
+
+# Classes whose subclasses get forward() linted.
+_HYBRID_BASES = {"HybridBlock", "HybridSequential", "SymbolBlock"}
+
+# Attribute reads that yield static (trace-time constant) metadata.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "nbytes",
+                 "ctx", "context", "device", "stype"}
+
+# Builtins whose result is not a tensor (len(x) is static under jit).
+_UNTAINT_FUNCS = {"len", "range", "enumerate", "isinstance", "issubclass",
+                  "hasattr", "getattr", "type", "id", "str", "repr",
+                  "format", "sorted", "reversed", "zip", "print"}
+
+_SYNC_METHODS = {"asnumpy", "item", "asscalar", "tolist"}
+_CAST_FUNCS = {"float", "int", "bool", "complex"}
+
+# Dotted-name prefixes that are impure / trace-time-frozen (H006).
+_IMPURE_PREFIXES = (
+    "np.random.", "numpy.random.", "onp.random.", "random.",
+    "time.", "datetime.", "os.environ", "os.getenv", "os.urandom",
+    "uuid.", "secrets.",
+)
+
+_TRAIN_LOOP_MARKS = {"backward", "record", "step"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _base_tail(base: ast.AST) -> str:
+    """Last component of a base-class expression (mx.gluon.HybridBlock ->
+    'HybridBlock'); call bases (metaclass factories) yield ''."""
+    d = _dotted(base)
+    return d.rsplit(".", 1)[-1] if d else ""
+
+
+def _hybrid_classes(tree: ast.Module) -> List[ast.ClassDef]:
+    """Classes that are (transitively, within this module) HybridBlocks."""
+    classes = {n.name: n for n in tree.body if isinstance(n, ast.ClassDef)}
+    hybrid: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, node in classes.items():
+            if name in hybrid:
+                continue
+            for b in node.bases:
+                tail = _base_tail(b)
+                if tail in _HYBRID_BASES or tail in hybrid:
+                    hybrid.add(name)
+                    changed = True
+                    break
+    return [classes[n] for n in sorted(hybrid)]
+
+
+class _Taint:
+    """Flow-insensitive-ish taint over one function: names derived from
+    the tensor arguments.  Two fixpoint passes cover loop-carried
+    assignments without a full dataflow lattice."""
+
+    def __init__(self, fn: ast.FunctionDef, skip_args: Set[str]):
+        self.names: Set[str] = set()
+        args = fn.args
+        every = (args.posonlyargs + args.args + args.kwonlyargs)
+        for a in every:
+            if a.arg not in skip_args:
+                self.names.add(a.arg)
+        if args.vararg:
+            self.names.add(args.vararg.arg)
+        for _ in range(2):  # fixpoint for loop-carried taint
+            before = len(self.names)
+            for node in ast.walk(fn):
+                self._stmt(node)
+            if len(self.names) == before:
+                break
+
+    def _stmt(self, node: ast.AST):
+        if isinstance(node, ast.Assign):
+            if self.tainted(node.value):
+                for t in node.targets:
+                    self._mark_target(t)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if self.tainted(node.value):
+                self._mark_target(node.target)
+        elif isinstance(node, ast.AugAssign):
+            if self.tainted(node.value) or self.tainted(node.target):
+                self._mark_target(node.target)
+        elif isinstance(node, ast.NamedExpr):
+            if self.tainted(node.value):
+                self._mark_target(node.target)
+        elif isinstance(node, ast.For):
+            if self.tainted(node.iter):
+                self._mark_target(node.target)
+        elif isinstance(node, ast.comprehension):
+            if self.tainted(node.iter):
+                self._mark_target(node.target)
+
+    def _mark_target(self, t: ast.AST):
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._mark_target(e)
+        elif isinstance(t, ast.Starred):
+            self._mark_target(t.value)
+        # Subscript/Attribute targets mutate containers; the container
+        # name keeps whatever taint it had.
+
+    def tainted(self, node: Optional[ast.AST]) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.tainted(node.value)
+        if isinstance(node, ast.Call):
+            fname = node.func.id if isinstance(node.func, ast.Name) else ""
+            if fname in _UNTAINT_FUNCS or fname in _CAST_FUNCS:
+                return False
+            if isinstance(node.func, ast.Attribute):
+                # static-metadata method results stay static
+                if node.func.attr in _SYNC_METHODS:
+                    return False  # host value (and flagged by H001 anyway)
+                if self.tainted(node.func.value):
+                    return True
+            return (any(self.tainted(a) for a in node.args)
+                    or any(self.tainted(k.value) for k in node.keywords))
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            # `x is None` is a structural check: the arg tree specializes
+            # on None-ness, so the branch is trace-stable, not data-
+            # dependent
+            return False
+        if isinstance(node, (ast.BinOp, ast.UnaryOp, ast.Compare,
+                             ast.BoolOp, ast.IfExp, ast.Subscript,
+                             ast.Starred, ast.Tuple, ast.List, ast.Set,
+                             ast.JoinedStr, ast.FormattedValue)):
+            return any(self.tainted(c) for c in ast.iter_child_nodes(node)
+                       if not isinstance(c, (ast.cmpop, ast.operator,
+                                             ast.boolop, ast.unaryop,
+                                             ast.expr_context)))
+        if isinstance(node, ast.Dict):
+            return (any(self.tainted(v) for v in node.values)
+                    or any(k is not None and self.tainted(k)
+                           for k in node.keys))
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return (self.tainted(node.elt)
+                    or any(self.tainted(g.iter) for g in node.generators))
+        if isinstance(node, ast.DictComp):
+            return (self.tainted(node.key) or self.tainted(node.value)
+                    or any(self.tainted(g.iter) for g in node.generators))
+        if isinstance(node, ast.Slice):
+            return any(self.tainted(c) for c in
+                       (node.lower, node.upper, node.step))
+        if isinstance(node, ast.Await):
+            return self.tainted(node.value)
+        return False
+
+
+def _has_compare(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Compare) for n in ast.walk(node))
+
+
+class _ForwardLinter:
+    """Applies H001..H010 to one hybrid forward."""
+
+    def __init__(self, path: str, cls: ast.ClassDef, fn: ast.FunctionDef,
+                 add):
+        self.path = path
+        self.fn = fn
+        self.symbol = f"{cls.name}.{fn.name}"
+        self.add = add
+        skip = {"self"}
+        # reference hybrid_forward(self, F, x, ...) convention: F is the
+        # op namespace, not a tensor
+        if fn.name == "hybrid_forward":
+            skip.add("F")
+        self.taint = _Taint(fn, skip_args=skip)
+        every = (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs)
+        self.arg_names = {a.arg for a in every} - skip
+
+    def _diag(self, node: ast.AST, code: str, msg: str,
+              anchor: Optional[ast.AST] = None):
+        # `anchor` pins multi-line calls to the physical line of the
+        # offending attribute, so same-line suppressions match
+        line = (getattr(anchor, "end_lineno", None) if anchor is not None
+                else None) or getattr(node, "lineno", 1)
+        self.add(Diagnostic(self.path, line, code, msg,
+                            col=getattr(node, "col_offset", 0),
+                            symbol=self.symbol))
+
+    def run(self):
+        # H009: mutable defaults in the signature itself
+        args = self.fn.args
+        for d in list(args.defaults) + [d for d in args.kw_defaults if d]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set, ast.Call,
+                              ast.ListComp, ast.DictComp, ast.SetComp)):
+                self._diag(d, "H009",
+                           "mutable/constructed default argument in "
+                           "forward signature destabilizes the jit cache "
+                           "signature")
+        for node in ast.walk(self.fn):
+            self._check(node)
+
+    def _check(self, node: ast.AST):
+        t = self.taint
+        if isinstance(node, ast.Call):
+            self._check_call(node)
+        elif isinstance(node, (ast.If, ast.While)):
+            if t.tainted(node.test):
+                self._diag(node.test, "H003",
+                           f"Python {type(node).__name__.lower()} on a "
+                           "tensor value is baked in at trace time — use "
+                           "mx.np.where / lax.cond instead")
+        elif isinstance(node, ast.IfExp):
+            if t.tainted(node.test):
+                self._diag(node.test, "H003",
+                           "conditional expression on a tensor value is "
+                           "baked in at trace time — use mx.np.where")
+        elif isinstance(node, ast.Assert):
+            if t.tainted(node.test):
+                self._diag(node, "H004",
+                           "assert on a tensor value only runs at trace "
+                           "time — validate shapes/dtypes instead")
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                self._check_mutation(tgt)
+        elif isinstance(node, ast.AugAssign):
+            self._check_mutation(node.target, aug=True)
+        elif isinstance(node, ast.Subscript):
+            # H005: boolean-mask selection => data-dependent result shape
+            if isinstance(node.ctx, ast.Load) and t.tainted(node.slice) \
+                    and _has_compare(node.slice):
+                self._diag(node, "H005",
+                           "boolean-mask indexing produces a data-"
+                           "dependent shape (recompile per mask "
+                           "population) — mask by multiplication or "
+                           "mx.np.where")
+
+    def _check_call(self, node: ast.Call):
+        t = self.taint
+        func = node.func
+        fname = func.id if isinstance(func, ast.Name) else ""
+        dotted = _dotted(func)
+        if isinstance(func, ast.Attribute):
+            if func.attr in _SYNC_METHODS:
+                self._diag(node, "H001",
+                           f".{func.attr}() in a hybrid forward forces a "
+                           "device sync / breaks tracing", anchor=func)
+                return
+            if func.attr == "nonzero":
+                self._diag(node, "H005",
+                           ".nonzero() has a data-dependent output shape "
+                           "— it cannot stage under jit with a stable "
+                           "signature")
+            if func.attr == "where" and len(node.args) == 1:
+                self._diag(node, "H005",
+                           "1-argument where() returns data-dependent-"
+                           "shape indices — use the 3-argument form")
+        if fname in _CAST_FUNCS and node.args \
+                and t.tainted(node.args[0]):
+            self._diag(node, "H002",
+                       f"{fname}() on a tensor value concretizes it "
+                       "(sync in eager, error under jit)")
+        if fname == "print" and (any(t.tainted(a) for a in node.args)
+                                 or any(t.tainted(k.value)
+                                        for k in node.keywords)):
+            self._diag(node, "H010",
+                       "print() of a tensor inside forward fires once at "
+                       "trace time (showing a tracer) — use "
+                       "jax.debug.print or mx.monitor")
+        for pref in _IMPURE_PREFIXES:
+            if dotted.startswith(pref) or dotted == pref.rstrip("."):
+                self._diag(node, "H006",
+                           f"'{dotted}' inside traced code is evaluated "
+                           "once at trace time and baked in as a "
+                           "constant")
+                break
+        # H008: unstable kwargs into a child-block / tensor-callee call
+        callee_is_child = (isinstance(func, ast.Attribute)
+                           and _dotted(func).startswith("self.")) \
+            or t.tainted(func)
+        if callee_is_child:
+            for kw in node.keywords:
+                if kw.arg is None:  # **kwargs splat
+                    self._diag(node, "H008",
+                               "**kwargs into a child-block call defeats "
+                               "the _CachedOp cache key (fresh dict per "
+                               "call)")
+                elif isinstance(kw.value, (ast.List, ast.Dict, ast.Set,
+                                           ast.ListComp, ast.DictComp,
+                                           ast.SetComp)):
+                    self._diag(kw.value, "H008",
+                               f"mutable literal for kwarg '{kw.arg}' is "
+                               "a fresh object per call — the jit cache "
+                               "key never repeats")
+
+    def _check_mutation(self, target: ast.AST, aug: bool = False):
+        """H007: in-place mutation of a forward argument."""
+        base = target
+        via_index = False
+        while isinstance(base, ast.Subscript):
+            base = base.value
+            via_index = True
+        if isinstance(base, ast.Name) and base.id in self.arg_names \
+                and (via_index or aug):
+            how = "x[...] = v" if via_index else "augmented assignment"
+            self._diag(target, "H007",
+                       f"in-place mutation of forward argument "
+                       f"'{base.id}' ({how}) aliases caller state into "
+                       "the trace — operate out-of-place")
+
+
+# -- L101: per-step sync inside training loops --------------------------------
+
+def _is_train_loop(loop: ast.AST) -> bool:
+    for n in ast.walk(loop):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _TRAIN_LOOP_MARKS:
+            return True
+    return False
+
+
+def _enclosing_symbols(tree: ast.Module) -> Dict[int, str]:
+    """line -> qualified enclosing def/class name (for fingerprints)."""
+    out: Dict[int, str] = {}
+
+    def rec(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{prefix}{child.name}"
+                end = getattr(child, "end_lineno", child.lineno)
+                for ln in range(child.lineno, end + 1):
+                    out[ln] = q
+                rec(child, q + ".")
+            else:
+                rec(child, prefix)
+
+    rec(tree, "")
+    return out
+
+
+def _lint_loops(tree: ast.Module, path: str, add, symbols):
+    seen: Set[int] = set()  # a sync flagged once even in nested loops
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        if not _is_train_loop(node):
+            continue
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in _SYNC_METHODS:
+                key = (n.lineno, n.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                # anchor at the sync attribute itself, so a trailing
+                # suppression on that physical line matches even when
+                # the call spans multiple lines
+                line = getattr(n.func, "end_lineno", None) or n.lineno
+                add(Diagnostic(
+                    path, line, "L101",
+                    f".{n.func.attr}() inside a training loop syncs the "
+                    "device every step — batch the sync or gate it",
+                    col=n.col_offset,
+                    symbol=symbols.get(n.lineno, "<module>")))
+
+
+# -- entry points -------------------------------------------------------------
+
+def lint_source(source: str, path: str = "<string>") -> List[Diagnostic]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Diagnostic(path, e.lineno or 1, "X000",
+                           f"syntax error: {e.msg}", symbol="<parse>")]
+    diags: List[Diagnostic] = []
+    add = diags.append
+    for cls in _hybrid_classes(tree):
+        for item in cls.body:
+            if isinstance(item, ast.FunctionDef) \
+                    and item.name in ("forward", "hybrid_forward"):
+                _ForwardLinter(path, cls, item, add).run()
+    _lint_loops(tree, path, add, _enclosing_symbols(tree))
+    per_line, file_wide = parse_suppressions(source)
+    kept = [d for d in diags if not is_suppressed(d, per_line, file_wide)]
+    kept.sort(key=lambda d: (d.path, d.line, d.col, d.code))
+    return kept
+
+
+def lint_file(path: str) -> List[Diagnostic]:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return lint_source(f.read(), path)
+
+
+def iter_python_files(paths: Iterable[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git",
+                                              "build", ".ipynb_checkpoints"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_paths(paths: Iterable[str]) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    for f in iter_python_files(paths):
+        out.extend(lint_file(f))
+    return out

@@ -1,0 +1,115 @@
+"""Retrace guard: flag unbounded jit-signature growth per HybridBlock.
+
+PR 1's telemetry *counts* compiles (``hybridize.cache_misses`` /
+``compile_seconds``); this guard turns the count into an actionable
+diagnostic.  ``_CachedOp`` reports every newly traced signature here;
+when one block crosses ``MXNET_RETRACE_WARN_LIMIT`` distinct signatures
+(default 8) the guard diffs the accumulated signatures, points at the
+input slot that varies — distinguishing parameter/state slots from the
+caller's argument leaves — and emits a **J001** diagnostic plus a
+``hybridize.retrace_warnings`` telemetry tick, once per block type.
+
+A signature is ``(cache_key, ((shape, dtype), ...))`` where
+``cache_key = (training, arg_tree_repr, n_state)`` and the leading
+``n_state`` input slots are lifted parameters + the RNG key (see
+gluon/block.py).  Varying *argument* slots mean the caller feeds
+unbucketed shapes (pad or bucket them); varying *state* slots mean
+parameters changed shape/dtype between calls (usually re-init).
+
+Stdlib-only at import; telemetry/logging engage lazily.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Iterable, List, Set, Tuple
+
+from .diagnostics import Diagnostic
+
+__all__ = ["on_trace", "report", "reset", "set_limit", "get_limit"]
+
+_LOG = logging.getLogger(__name__)
+
+_LOCK = threading.Lock()
+_LIMIT = int(os.environ.get("MXNET_RETRACE_WARN_LIMIT", "8"))
+_warned: Set[str] = set()
+_DIAGS: List[Diagnostic] = []
+
+
+def set_limit(n: int) -> int:
+    """Set the distinct-signature threshold; returns the previous one."""
+    global _LIMIT
+    prev, _LIMIT = _LIMIT, int(n)
+    return prev
+
+
+def get_limit() -> int:
+    return _LIMIT
+
+
+def _varying_slots(sigs: List[tuple]) -> List[Tuple[int, Set[tuple]]]:
+    """Input slots whose (shape, dtype) differs across signatures."""
+    seen: Dict[int, Set[tuple]] = {}
+    for _, leaves in sigs:
+        for i, spec in enumerate(leaves):
+            seen.setdefault(i, set()).add(tuple(spec))
+    return [(i, specs) for i, specs in sorted(seen.items())
+            if len(specs) > 1]
+
+
+def on_trace(block_label: str, sig: tuple, traced: Iterable[tuple]):
+    """Called by _CachedOp after adding a newly traced signature."""
+    sigs = list(traced)
+    if len(sigs) < _LIMIT:
+        return
+    with _LOCK:
+        if block_label in _warned:
+            return
+        _warned.add(block_label)
+    n_state = 0
+    key = sig[0]
+    if isinstance(key, tuple) and len(key) >= 3 \
+            and isinstance(key[2], int):
+        n_state = key[2]
+    varying = _varying_slots(sigs)
+    if varying:
+        parts = []
+        for i, specs in varying[:4]:
+            what = (f"state/param slot #{i}" if i < n_state
+                    else f"argument leaf #{i - n_state}")
+            shapes = sorted(str(s[0]) for s in specs)
+            shown = ", ".join(shapes[:5])
+            if len(shapes) > 5:
+                shown += f", … ({len(shapes)} shapes)"
+            parts.append(f"{what} varies: {shown}")
+        culprit = "; ".join(parts)
+    else:
+        keys = {s[0] for s in sigs}
+        culprit = (f"{len(keys)} distinct cache keys (argument structure "
+                   "or train/eval mode flips per call)")
+    msg = (f"{block_label} accumulated {len(sigs)} distinct jit "
+           f"signatures (limit {_LIMIT}) — every new one pays trace + "
+           f"XLA compile; {culprit}")
+    d = Diagnostic(path="<retrace>", line=0, code="J001", message=msg,
+                   symbol=block_label, source="retrace")
+    with _LOCK:
+        _DIAGS.append(d)
+    try:
+        from mxnet_tpu import telemetry as _tel
+
+        _tel.inc("hybridize.retrace_warnings")
+    except Exception:
+        pass
+    _LOG.warning("retrace-guard J001: %s", msg)
+
+
+def report() -> List[Diagnostic]:
+    with _LOCK:
+        return list(_DIAGS)
+
+
+def reset():
+    with _LOCK:
+        _warned.clear()
+        _DIAGS.clear()

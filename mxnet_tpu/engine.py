@@ -60,7 +60,15 @@ class NaiveEngine(Engine):
     """Synchronous engine: every push runs inline (ref NaiveEngine,
     src/engine/naive_engine.cc). Deterministic; used for debugging and as
     the no-native fallback. Error semantics preserved: a failed op poisons
-    its write vars, later ops on them are skipped, waits rethrow."""
+    its write vars, later ops on them are skipped, waits rethrow.
+
+    Error propagation is ALIGNED with NativeEngine (asserted in
+    tests/test_exc_and_threads.py): the native C marshal can only carry a
+    formatted string, so a raising callback surfaces at wait as
+    ``MXNetError("TypeName: message")`` under BOTH engines — the original
+    exception rides along as ``__cause__`` here, which the native engine
+    cannot offer.  Tools like the engine checker therefore report
+    identically regardless of MXNET_ENGINE_TYPE."""
 
     def __init__(self):
         self._errs = {}
@@ -88,10 +96,19 @@ class NaiveEngine(Engine):
             for w in write:
                 self._errs.pop(w._handle, None)
         except BaseException as e:  # noqa: BLE001 — poison + rethrow later
+            # same wire format as the native trampoline (_static_trampoline
+            # marshals "TypeName: msg" through the C error buffer)
+            err = MXNetError(f"{type(e).__name__}: {e}")
+            err.__cause__ = e
             for w in write:
-                self._errs[w._handle] = e
+                self._errs[w._handle] = err
             if self._first_err is None:
-                self._first_err = e
+                self._first_err = err
+            if not isinstance(e, Exception):
+                # KeyboardInterrupt/SystemExit must keep their type: this
+                # engine runs inline on the caller thread, so re-raise NOW
+                # (the poison above still marks the vars for later waits)
+                raise
 
     def wait_for_var(self, var: Var):
         if _tel._ENABLED:
@@ -250,6 +267,14 @@ def get() -> Engine:
                 _engine = NativeEngine()
             else:
                 _engine = NaiveEngine()
+            # MXNET_ENGINE_CHECK=1|warn|raise: wrap with the dependency
+            # checker (mx.analysis.engine_check) — verifies each push's
+            # actual NDArray accesses against its declared read/write
+            # vars and flags wait-inside-push deadlock patterns
+            from .analysis import engine_check as _echk
+
+            if _echk.env_mode():
+                _engine = _echk.install(_engine)
         return _engine
 
 

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry as _tel
+from ..analysis import retrace as _retrace
 from ..base import DeferredInitializationError, MXNetError
 from ..context import Context, current_context
 from ..ndarray.ndarray import NDArray, _mutation_scope
@@ -319,6 +320,13 @@ class _CachedOp:
         self._traced.clear()
         self._param_cache = None
 
+    def _note_trace(self, sig):
+        """Record a newly traced signature and let the retrace guard
+        (mx.analysis.retrace) flag unbounded signature growth — J001
+        names the input slot whose shape keeps changing."""
+        self._traced.add(sig)
+        _retrace.on_trace(type(self.block).__name__, sig, self._traced)
+
     def __call__(self, args, kwargs):
         from ..random import key_holder
 
@@ -407,10 +415,10 @@ class _CachedOp:
                     _tel.observe("hybridize.compile_seconds",
                                  _time.perf_counter() - t0)
                     _tel.inc("hybridize.cache_misses")
-                    self._traced.add(sig)
+                    self._note_trace(sig)
                 else:
                     res = invoke(jit_fn, inputs, name=name)
-                    self._traced.add(sig)
+                    self._note_trace(sig)
         if isinstance(res, NDArray):
             res = (res,)
         n_out = holder["n_out"]

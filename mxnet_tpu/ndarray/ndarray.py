@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as _onp
 
 from .. import telemetry as _tel
+from ..analysis import engine_check as _echk
 from ..base import MXNetError, numeric_types
 from ..context import Context, cpu, current_context, tpu
 
@@ -111,10 +112,13 @@ class NDArray:
     # -- mutation ----------------------------------------------------------
     def _set_data(self, new_data):
         """All rebinding funnels through here so jit tracing can observe
-        mutations (see _mutation_scope)."""
+        mutations (see _mutation_scope) and the engine checker can verify
+        writes against declared vars (MXNET_ENGINE_CHECK)."""
         for w in _MUTATION_WATCHERS:
             if id(self) not in w.mutated:
                 w.mutated[id(self)] = (self, self._data)
+        if _echk._ACTIVE:
+            _echk.on_write(self)
         self._data = new_data
 
     # -- basic properties --------------------------------------------------
@@ -175,6 +179,8 @@ class NDArray:
     # -- host interop ------------------------------------------------------
     def asnumpy(self) -> _onp.ndarray:
         """Blocking device→host copy (ref ndarray.h SyncCopyToCPU)."""
+        if _echk._ACTIVE:
+            _echk.on_read(self)
         if not _tel._ENABLED:
             return _onp.asarray(self._data)
         t0 = _time.perf_counter()
@@ -319,6 +325,8 @@ class NDArray:
     def wait_to_read(self):
         """Block until value ready; async errors rethrow here
         (ref src/engine/threaded_engine.h:463)."""
+        if _echk._ACTIVE:
+            _echk.on_read(self)
         if not _tel._ENABLED:
             jax.block_until_ready(self._data)
             return self

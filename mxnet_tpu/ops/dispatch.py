@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as _onp
 
+from ..analysis import engine_check as _echk
 from ..base import MXNetError
 
 __all__ = ["invoke", "call", "infer_shape", "wrap_op", "deferred_compute",
@@ -94,6 +95,12 @@ def invoke(fn: Callable, inputs: Sequence, name: str = "op",
     from .. import autograd
     from ..ndarray import NDArray
 
+    if _echk._ACTIVE:
+        # engine checking mode: an op dispatched from inside an engine
+        # push reads its inputs — verify them against the push's
+        # declared vars (undeclared dependency = race)
+        for x in inputs:
+            _echk.on_read(x)
     raw = [x._data for x in inputs]
     recording = autograd.is_recording() and any(_is_inexact(r) for r in raw)
 

@@ -88,6 +88,46 @@ def test_multiple_waits_after_engine_error():
     assert done == [1]
 
 
+def test_engine_error_contract_identical_across_engines():
+    """ISSUE 2 satellite: NaiveEngine is ALIGNED with NativeEngine for
+    raising callbacks — both rethrow MXNetError('TypeName: message') at
+    every wait on the poisoned var, so the engine checker (and any other
+    consumer) reports identically under MXNET_ENGINE_TYPE=NaiveEngine.
+    Naive additionally chains the original exception as __cause__ (the
+    C marshal cannot)."""
+    from mxnet_tpu import _native, engine
+
+    engines = [engine.NaiveEngine()]
+    if _native.native_available():
+        engines.append(engine.NativeEngine())
+    messages = []
+    for eng in engines:
+        v = eng.new_var()
+
+        def boom():
+            raise ValueError("identical-contract")
+
+        eng.push(boom, write=[v])
+        with pytest.raises(MXNetError) as ei:
+            eng.wait_for_var(v)
+        messages.append(str(ei.value))    # the ACTUAL per-engine message
+        with pytest.raises(MXNetError):   # rethrows at EVERY wait
+            eng.wait_for_var(v)
+        with pytest.raises(MXNetError, match="ValueError: identical-contract"):
+            eng.wait_for_all()            # first-error report, then clears
+        eng.wait_for_all()                # ...so the next wait is clean
+        eng.delete_var(v)
+    assert len(set(messages)) == 1, messages   # byte-identical across engines
+    assert "ValueError: identical-contract" in messages[0]
+    # naive preserves the original exception object as the cause
+    naive = engine.NaiveEngine()
+    v = naive.new_var()
+    naive.push(boom, write=[v])
+    with pytest.raises(MXNetError) as ei:
+        naive.wait_for_var(v)
+    assert isinstance(ei.value.__cause__, ValueError)
+
+
 def test_broken_record_identifies_itself(tmp_path):
     """ImageIter raises with the offending index/filename in the message
     (ref image.py ImageIter.imdecode locate())."""

@@ -113,8 +113,12 @@ def test_naive_engine_same_contract():
     e.push(lambda: out.append(1), write=(v,))
     e.push(lambda: (_ for _ in ()).throw(ValueError("bad")), write=(v,))
     e.push(lambda: out.append(2), read=(v,))  # skipped: poisoned
-    with pytest.raises(ValueError):
+    # error propagation is ALIGNED with the native engine: the wait
+    # rethrows MXNetError("TypeName: msg") (the C marshal wire format),
+    # with the original exception chained as __cause__
+    with pytest.raises(MXNetError, match="ValueError: bad") as ei:
         e.wait_for_var(v)
+    assert isinstance(ei.value.__cause__, ValueError)
     assert out == [1]
 
 
@@ -210,6 +214,24 @@ def test_engine_read_write_same_var_no_deadlock(eng):
     eng.delete_var(v)
 
 
+def test_naive_engine_interrupt_keeps_its_type():
+    """KeyboardInterrupt/SystemExit must NOT be laundered into MXNetError:
+    the naive engine runs inline, so the interrupt re-raises immediately
+    with its real type (the write vars are still poisoned for later
+    waits)."""
+    e = engine.NaiveEngine()
+    v = e.new_var()
+
+    def interrupt():
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        e.push(interrupt, write=(v,))
+    with pytest.raises(MXNetError, match="KeyboardInterrupt"):
+        e.wait_for_var(v)
+    e.delete_var(v)
+
+
 def test_naive_engine_write_supersedes_poison():
     e = engine.NaiveEngine()
     v = e.new_var()
@@ -220,7 +242,7 @@ def test_naive_engine_write_supersedes_poison():
     e.push(bad, write=(v,))
     e.push(lambda: None, write=(v,))   # fresh write clears poison
     e.wait_for_var(v)                  # must NOT raise
-    with pytest.raises(ValueError):
+    with pytest.raises(MXNetError, match="ValueError: boom"):
         e.wait_for_all()               # first error still reported once
 
 

@@ -278,6 +278,82 @@ def test_multi_tensor_respects_per_index_multipliers():
                                     err_msg=n)
 
 
+def test_engine_check_no_false_positive_on_parallel_workloads():
+    """ISSUE 2 acceptance: with the engine dependency checker active
+    (MXNET_ENGINE_CHECK semantics via install()), correctly-declared
+    concurrent engine work — disjoint writers from many threads plus a
+    fan-out of declared read/read consumers over one shared array — and
+    a real sharded training step must produce ZERO diagnostics, while a
+    seeded under-declared push in the same session is still caught."""
+    import threading
+
+    from mxnet_tpu import engine
+    from mxnet_tpu.analysis import engine_check as echk
+
+    eng = echk.install()
+    echk.clear()
+    try:
+        try:  # drain any first-error left by earlier exception tests on
+            # the shared process-global engine (first error reports once)
+            eng.wait_for_all()
+        except mx.MXNetError:
+            pass
+        # disjoint-var writers from 16 threads (the existing
+        # test_concurrent_engine_pushes pattern, now under checking)
+        out = [0] * 16
+
+        def work(i):
+            var = eng.new_var()
+            eng.push(lambda j=i: out.__setitem__(j, j * j), write=[var],
+                     name=f"disjoint{i}")
+            eng.wait_for_var(var)
+            eng.delete_var(var)
+
+        ts = [threading.Thread(target=work, args=(i,)) for i in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert out == [i * i for i in range(16)]
+
+        # declared read/read fan-out over one shared, owned array
+        owner = eng.new_var()
+        shared = mx.nd.array(onp.arange(16, dtype="f4"))
+        echk.bind(shared, owner)
+        sums = []
+        vars_ = []
+        for i in range(8):
+            v = eng.new_var()
+            vars_.append(v)
+            eng.push(lambda: sums.append(float(shared.asnumpy().sum())),
+                     read=[owner], write=[v], name=f"fanout{i}")
+        eng.wait_for_all()
+        assert sums == [120.0] * 8
+
+        # a real SPMD training step under checking stays silent too
+        net = nn.Dense(4)
+        net.initialize()
+        net(mx.np.zeros((2, 8)))
+        tr = ShardedTrainer(net, _ce, mesh=default_mesh(), optimizer="sgd",
+                            learning_rate=0.1)
+        rs = onp.random.RandomState(0)
+        tr.step(rs.rand(16, 8).astype("float32"),
+                rs.randint(0, 4, size=(16,)).astype("int32"))
+
+        assert echk.diagnostics() == [], echk.diagnostics()
+
+        # ...and the checker is still live: a seeded under-declared read
+        # in the same session is caught
+        rogue = eng.new_var()
+        eng.push(lambda: shared.asnumpy(), write=[rogue], name="rogue")
+        eng.wait_for_var(rogue)
+        assert [d.code for d in echk.diagnostics()] == ["E001"]
+        for v in [owner, rogue] + vars_:
+            eng.delete_var(v)
+    finally:
+        echk.uninstall()
+
+
 def test_telemetry_sharded_trainer_and_collectives_tick():
     """ISSUE 1 wiring: a real SPMD run must leave step timings and
     collective call/byte counts in the registry."""

@@ -15,6 +15,10 @@ Each trial runs in its own pytest subprocess with MXNET_TEST_SEED set
 environment scrubbed the same way the suite runs (PALLAS_AXON_POOL_IPS
 stripped, CPU platform).  Exit 0 iff every trial passed; failures print
 the exact MXNET_TEST_SEED to reproduce.
+
+``--format=json`` emits findings in the mx.analysis diagnostic shape
+(rule F001, same JSON stream tools/mxlint.py produces) so CI consumes
+lint + flakiness results uniformly; trial progress moves to stderr.
 """
 from __future__ import annotations
 
@@ -25,6 +29,9 @@ import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mxlint import load_analysis  # noqa: E402 — stdlib-only loader
 
 
 def to_nodeid(spec: str) -> str:
@@ -52,8 +59,14 @@ def main():
                    help="draw seeds at random instead of sequentially")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="stream pytest output for failing trials")
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   help="json: mx.analysis diagnostic stream (F001)")
     args = p.parse_args()
 
+    say = print if args.format == "text" else \
+        (lambda *a, **k: print(*a, file=sys.stderr,
+                               **{k_: v for k_, v in k.items()
+                                  if k_ != "file"}))
     nodeid = to_nodeid(args.test)
     env = {k: v for k, v in os.environ.items()
            if k != "PALLAS_AXON_POOL_IPS"}
@@ -73,18 +86,47 @@ def main():
             # collection/import error, internal error, usage error, or
             # nothing collected — seed-independent; reporting these as
             # "flaky" would mask that the test never ran
-            print(f"error: pytest could not run {nodeid!r} "
-                  f"(rc={r.returncode}):")
-            print((r.stdout + r.stderr)[-1500:])
+            say(f"error: pytest could not run {nodeid!r} "
+                f"(rc={r.returncode}):")
+            say((r.stdout + r.stderr)[-1500:])
+            if args.format == "json":
+                # consumers of the stream still get a well-formed doc
+                # (X000 = tool could not analyze, docs/analysis.md)
+                ana = load_analysis()
+                sys.stdout.write(ana.diagnostics.dumps_json(
+                    [ana.Diagnostic(
+                        path=nodeid.split("::", 1)[0], line=0,
+                        code="X000",
+                        message=(f"pytest could not run {nodeid!r} "
+                                 f"(rc={r.returncode}): "
+                                 + (r.stdout + r.stderr)[-800:]),
+                        symbol=nodeid, source="flakiness-checker")],
+                    tool="flakiness_checker", trials=args.trials,
+                    failed=0))
             return 2
         ok = r.returncode == 0
-        print(f"trial {i + 1}/{args.trials} seed={seed}: "
-              f"{'PASS' if ok else 'FAIL'}", flush=True)
+        say(f"trial {i + 1}/{args.trials} seed={seed}: "
+            f"{'PASS' if ok else 'FAIL'}", flush=True)
         if not ok:
             failures.append(seed)
             if args.verbose:
-                print(r.stdout[-3000:])
-                print(r.stderr[-1000:])
+                say(r.stdout[-3000:])
+                say(r.stderr[-1000:])
+    if args.format == "json":
+        ana = load_analysis()
+        path = nodeid.split("::", 1)[0]
+        diags = [ana.Diagnostic(
+            path=path, line=0, code="F001",
+            message=(f"failed under MXNET_TEST_SEED={s} "
+                     f"({len(failures)}/{args.trials} trials failed); "
+                     f"reproduce: MXNET_TEST_SEED={s} python -m pytest "
+                     f"{nodeid}"),
+            symbol=nodeid, source="flakiness-checker")
+            for s in failures]
+        sys.stdout.write(ana.diagnostics.dumps_json(
+            diags, tool="flakiness_checker", trials=args.trials,
+            failed=len(failures)))
+        return 1 if failures else 0
     if failures:
         print(f"\nFLAKY: {len(failures)}/{args.trials} trials failed; "
               "reproduce with:")
