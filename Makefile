@@ -16,7 +16,7 @@ TESTSRC  := src/mxtpu/tests/test_native.cc
 BUILD    := build
 
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
-	telemetry-smoke lint-hybrid ci clean
+	telemetry-smoke pipeline-smoke lint-hybrid ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -73,6 +73,13 @@ telemetry-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		python tools/telemetry_smoke.py
 
+pipeline-smoke:
+	# 20 LeNet steps through DataLoader -> DevicePrefetcher ->
+	# ShardedTrainer; fails unless dataloader.wait_seconds p50 beats the
+	# synchronous baseline and in-flight depth exceeds 1 (docs/pipeline.md)
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		python tools/pipeline_smoke.py
+
 lint-hybrid:
 	# hybridize-safety static analysis (docs/analysis.md). The committed
 	# baseline makes legacy suppressions explicit; NEW violations fail.
@@ -81,7 +88,8 @@ lint-hybrid:
 		--baseline tools/mxlint_baseline.json \
 		mxnet_tpu example benchmark
 
-ci: native native-test asan tsan lint-hybrid test test-slow telemetry-smoke
+ci: native native-test asan tsan lint-hybrid test test-slow telemetry-smoke \
+	pipeline-smoke
 
 clean:
 	rm -rf $(BUILD)

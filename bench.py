@@ -29,8 +29,16 @@ import time
 
 
 def _timed_raw_steps(trainer, xd, yd, n_steps):
-    """Drive trainer._step_fn directly; returns seconds for n_steps."""
+    """Drive trainer._step_fn directly; returns seconds for n_steps.
+
+    Dispatch rides the async step pipeline: each step's loss handle goes
+    through an engine.InflightQueue (MXNET_MAX_INFLIGHT_STEPS, default 2)
+    so the dispatch queue stays bounded exactly like a real training loop
+    — the row's telemetry snapshot then carries engine.inflight_steps /
+    pipeline.stall_seconds alongside the throughput it explains."""
     import jax.numpy as jnp
+
+    from mxnet_tpu.engine import InflightQueue
 
     step = trainer._step_fn
     pvals, avals, key = trainer.pvals, trainer.avals, trainer._key
@@ -44,11 +52,13 @@ def _timed_raw_steps(trainer, xd, yd, n_steps):
     pvals, mutated, opt_state, scale, loss = step(
         pvals, avals, key, opt_state, t, lr, scale, xd, yd)
     float(loss)  # absorb residual compile before the timed region
+    inflight = InflightQueue()
     t0 = time.perf_counter()
     for _ in range(n_steps):
         t += 1
         pvals, mutated, opt_state, scale, loss = step(
             pvals, avals, key, opt_state, t, lr, scale, xd, yd)
+        inflight.push(loss)
     float(loss)  # scalar D2H read drains the pipeline (a relay can report
     # block_until_ready early; a host transfer cannot lie)
     return time.perf_counter() - t0

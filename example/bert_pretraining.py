@@ -98,6 +98,9 @@ def main():
     t0 = time.time()
     for step in range(args.steps):
         x, y = sample_batch(args.batch_size)
+        # non-blocking: loss is a lazy NDArray (async dispatch, bounded
+        # by MXNET_MAX_INFLIGHT_STEPS); the gated f-string format below
+        # is the only D2H read — once per 10 steps, not per step
         loss = trainer.step(x, y)
         if step % 10 == 0 or step == args.steps - 1:
             dt = time.time() - t0

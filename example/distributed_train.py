@@ -64,6 +64,9 @@ def main():
         rng = onp.random.RandomState(step * world + rank)
         y = rng.randint(0, 10, local_b).astype("i4")
         x = templates[y] + rng.randn(local_b, 1, 28, 28).astype("f4") * 0.2
+        # non-blocking: loss is a lazy NDArray; only rank 0 reads it, and
+        # only at gated steps (the loss is replicated, so the read is
+        # local — the other ranks keep dispatching)
         loss = trainer.step(x, y)
         if rank == 0 and (step % 5 == 0 or step == args.steps - 1):
             print(f"step {step}: loss {loss:.4f}")

@@ -115,7 +115,7 @@ def main():
                 # separate .asnumpy() stalls, flagged by mxlint L101);
                 # the remaining gated sync is intentional logging
                 lt, lc, lb = mx.nd.stack(
-                    [loss.mean(), l_cls.mean(), l_box.mean()]).asnumpy()  # mxlint: disable=L101
+                    [loss.mean(), l_cls.mean(), l_box.mean()]).asnumpy()  # mxlint: disable=L101,L102
                 print(f"step {step}: loss {lt:.4f}"
                       f" (cls {lc:.4f} box {lb:.4f})")
             if step >= args.steps:

@@ -85,18 +85,25 @@ def main():
         trainer = ShardedTrainer(net, ce, mesh=make_mesh({"dp": -1}),
                                  optimizer=args.optimizer,
                                  learning_rate=args.lr)
+        # the async step pipeline (docs/pipeline.md): a background thread
+        # places the next batches on device pre-sharded per the trainer's
+        # batch_spec, so host->HBM transfer overlaps the current step
+        from mxnet_tpu.gluon.data import DevicePrefetcher
+
+        train_dev = DevicePrefetcher(train, placement=trainer)
         guard = PreemptionGuard(trainer, args.checkpoint or "ckpt/run.npz")
         step = 0
         for epoch in range(args.epochs):
             t0 = time.time()
-            for i, (x, y) in enumerate(train):
-                # NDArrays go straight in: ShardedTrainer._put unwraps
-                # them on device — an .asnumpy() here would sync D2H and
-                # re-upload every step (mxlint L101 caught exactly that)
+            for i, (x, y) in enumerate(train_dev):
+                # non-blocking: loss is a lazy NDArray riding async
+                # dispatch (bounded by MXNET_MAX_INFLIGHT_STEPS); reading
+                # it every step would stall the pipe (mxlint L102)
                 loss = trainer.step(x, y)
                 step += 1
                 if writer and step % 50 == 0:
-                    writer.add_scalar("train/loss", loss, step)
+                    # gated to 1 sync per 50 steps — intentional
+                    writer.add_scalar("train/loss", float(loss), step)  # mxlint: disable=L102
                 if guard.step():
                     print("preempted; checkpoint cut, exiting")
                     return
@@ -125,7 +132,7 @@ def main():
                 if writer and step % 50 == 0:
                     # gated to 1 sync per 50 steps — intentional
                     writer.add_scalar("train/loss",
-                                      float(loss.asnumpy().mean()), step)  # mxlint: disable=L101
+                                      float(loss.asnumpy().mean()), step)  # mxlint: disable=L101,L102
                 if args.max_batches and i + 1 >= args.max_batches:
                     break
             name, train_acc = metric.get()

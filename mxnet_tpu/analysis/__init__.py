@@ -3,7 +3,8 @@
 Three layers, one diagnostic shape (``diagnostics.Diagnostic``):
 
 * :mod:`~mxnet_tpu.analysis.hybrid_lint` — AST hybridize-safety linter
-  (rules H001..H010 on HybridBlock forwards, L101 on training loops).
+  (rules H001..H010 on HybridBlock forwards, L101/L102 on training
+  loops).
   CLI: ``tools/mxlint.py``; CI gate: ``make lint-hybrid``.
 * :mod:`~mxnet_tpu.analysis.engine_check` — runtime engine dependency
   checker (``MXNET_ENGINE_CHECK=1``): verifies each push's actual

@@ -102,6 +102,15 @@ RULES: Dict[str, tuple] = {
         "async dispatch pipeline the engine exists to keep full",
         "log every N steps from one batched sync, or keep metrics on "
         "device and sync once per epoch"),
+    "L102": (
+        "blocking-loss-sync-in-train-loop",
+        "float(loss)/int(loss)/loss.asnumpy() on the loss every training "
+        "iteration blocks the host on that step's full fwd+bwd+update, "
+        "collapsing the async step pipeline to in-flight depth 1 — the "
+        "TPU idles at the edge of every step",
+        "keep the loss lazy (step() returns an async NDArray); read it "
+        "with loss.item() only behind a logging gate, or accumulate and "
+        "sync once per epoch (docs/pipeline.md)"),
     # -- runtime engine checker rules ---------------------------------------
     "E001": (
         "undeclared-read",

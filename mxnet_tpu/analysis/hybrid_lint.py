@@ -19,10 +19,13 @@ measures as recompile churn (arXiv:2301.13062).  Two analyses:
   jit, so ``if x.ndim == 2:`` stays clean — only *data*-dependent
   staging hazards fire.
 
-* **hot-loop rule (L101)** — any loop that trains (contains
+* **hot-loop rules (L101/L102)** — any loop that trains (contains
   ``.backward()`` / ``autograd.record()`` / ``trainer.step()``) must not
   sync the device per iteration (``.asnumpy()``/``.item()``); the linter
   flags those so logging moves behind a gate or batches into one sync.
+  L102 is the loss-specific form (``float(loss)`` / ``loss.asnumpy()``
+  per step): it blocks the host on that step's full fwd+bwd+update and
+  collapses the async step pipeline (docs/pipeline.md) to depth 1.
 
 Suppression: trailing ``# mxlint: disable=CODE`` (see diagnostics.py).
 Stdlib-only on purpose — ``tools/mxlint.py`` runs this without importing
@@ -391,30 +394,77 @@ def _enclosing_symbols(tree: ast.Module) -> Dict[int, str]:
     return out
 
 
+def _loss_names(loop: ast.AST) -> Set[str]:
+    """Names in a train loop that hold a loss: bound whole from a
+    trainer's ``step(...)`` call (the lazy loss ShardedTrainer returns)
+    or simply named like one.  The ``step`` capture is deliberately
+    narrow — single-name target, trainer-looking receiver — so
+    ``obs, r, done, info = env.step(a)``-style calls (RL loops, which
+    contain ``.backward()`` too) don't taint host values as losses."""
+    names: Set[str] = set()
+    for n in ast.walk(loop):
+        if isinstance(n, ast.Name) and "loss" in n.id.lower():
+            names.add(n.id)
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and isinstance(n.value, ast.Call) \
+                and isinstance(n.value.func, ast.Attribute) \
+                and n.value.func.attr == "step":
+            recv = _dotted(n.value.func.value).lower()
+            if "trainer" in recv or recv.rsplit(".", 1)[-1] == "tr":
+                names.add(n.targets[0].id)
+    return names
+
+
+def _mentions(node: ast.AST, names: Set[str]) -> bool:
+    return any(isinstance(n, ast.Name) and n.id in names
+               for n in ast.walk(node))
+
+
 def _lint_loops(tree: ast.Module, path: str, add, symbols):
-    seen: Set[int] = set()  # a sync flagged once even in nested loops
+    seen: Set[tuple] = set()  # a sync flagged once even in nested loops
+
+    def diag(n, anchor, code, msg):
+        key = (n.lineno, n.col_offset, code)
+        if key in seen:
+            return
+        seen.add(key)
+        # anchor at the sync attribute itself, so a trailing suppression
+        # on that physical line matches even for multi-line calls
+        line = getattr(anchor, "end_lineno", None) or n.lineno
+        add(Diagnostic(path, line, code, msg, col=n.col_offset,
+                       symbol=symbols.get(n.lineno, "<module>")))
+
     for node in ast.walk(tree):
         if not isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
             continue
         if not _is_train_loop(node):
             continue
+        losses = _loss_names(node)
         for n in ast.walk(node):
-            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+            if not isinstance(n, ast.Call):
+                continue
+            if isinstance(n.func, ast.Attribute) \
                     and n.func.attr in _SYNC_METHODS:
-                key = (n.lineno, n.col_offset)
-                if key in seen:
-                    continue
-                seen.add(key)
-                # anchor at the sync attribute itself, so a trailing
-                # suppression on that physical line matches even when
-                # the call spans multiple lines
-                line = getattr(n.func, "end_lineno", None) or n.lineno
-                add(Diagnostic(
-                    path, line, "L101",
-                    f".{n.func.attr}() inside a training loop syncs the "
-                    "device every step — batch the sync or gate it",
-                    col=n.col_offset,
-                    symbol=symbols.get(n.lineno, "<module>")))
+                diag(n, n.func, "L101",
+                     f".{n.func.attr}() inside a training loop syncs the "
+                     "device every step — batch the sync or gate it")
+                # L102: the same sync ON THE LOSS also collapses the
+                # async step pipeline to depth 1
+                if losses and _mentions(n.func.value, losses):
+                    diag(n, n.func, "L102",
+                         f"per-step .{n.func.attr}() on the loss blocks "
+                         "the host on every step's fwd+bwd+update — keep "
+                         "the loss lazy and read it behind a logging "
+                         "gate (docs/pipeline.md)")
+            elif isinstance(n.func, ast.Name) \
+                    and n.func.id in ("float", "int") and n.args \
+                    and losses and _mentions(n.args[0], losses):
+                diag(n, n, "L102",
+                     f"per-step {n.func.id}(loss) blocks the host on "
+                     "every step's fwd+bwd+update — keep the loss lazy "
+                     "and read it behind a logging gate "
+                     "(docs/pipeline.md)")
 
 
 # -- entry points -------------------------------------------------------------

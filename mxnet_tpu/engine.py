@@ -16,14 +16,15 @@ import ctypes
 import os
 import threading
 import time as _time
+from collections import deque
 from typing import Callable, Optional, Sequence
 
 from . import _native
 from . import telemetry as _tel
-from .base import MXNetError
+from .base import MXNetError, get_env
 
-__all__ = ["Engine", "NativeEngine", "NaiveEngine", "get", "push",
-           "wait_for_var", "wait_for_all", "new_var", "delete_var"]
+__all__ = ["Engine", "NativeEngine", "NaiveEngine", "InflightQueue", "get",
+           "push", "wait_for_var", "wait_for_all", "new_var", "delete_var"]
 
 
 class Var:
@@ -54,6 +55,84 @@ class Engine:
 
     def wait_for_all(self):
         raise NotImplementedError
+
+
+class InflightQueue:
+    """Bounded async-dispatch window — the backpressure half of the step
+    pipeline (docs/pipeline.md).
+
+    ``push(handle)`` records one dispatched step's output handle (anything
+    with a ``block_until_ready`` method — a ``jax.Array`` — or a tuple of
+    them) and, once more than ``limit`` steps are in flight, blocks on the
+    OLDEST one: the step-(t-K) sync that keeps the device dispatch queue K
+    deep instead of unbounded (K+1 generations of live step buffers, OOM)
+    or depth-1 (the per-step ``float(loss)`` lockstep this replaces).
+    ``limit`` defaults to ``MXNET_MAX_INFLIGHT_STEPS`` (2).
+
+    Only push NON-donated outputs (the loss, an aux value): a handle that
+    a later dispatch donates is deleted under the queue and the eventual
+    wait would raise. Telemetry: gauge ``engine.inflight_steps`` is the
+    window occupancy after each push (its max is the run's high-water
+    mark — >1 proves dispatch ran ahead of retirement); timer
+    ``pipeline.stall_seconds`` is host time blocked here by backpressure.
+    """
+
+    __slots__ = ("limit", "_handles")
+
+    def __init__(self, limit: Optional[int] = None):
+        if limit is None:
+            limit = get_env("MXNET_MAX_INFLIGHT_STEPS", 2, int)
+        self.limit = max(1, int(limit))
+        self._handles: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self._handles)
+
+    @staticmethod
+    def _block(handle):
+        bur = getattr(handle, "block_until_ready", None)
+        if bur is not None:
+            bur()
+            return
+        wtr = getattr(handle, "wait_to_read", None)  # NDArray losses
+        if wtr is not None:
+            wtr()
+            return
+        if isinstance(handle, (tuple, list)):
+            for h in handle:
+                InflightQueue._block(h)
+            return
+        # an un-waitable handle would silently disable backpressure —
+        # the exact unbounded dispatch this queue exists to prevent
+        raise MXNetError(
+            f"InflightQueue cannot wait on {type(handle).__name__}: push "
+            "a jax.Array, an NDArray, or a tuple of them")
+
+    def _wait(self, handle):
+        if not _tel._ENABLED:
+            self._block(handle)
+            return
+        t0 = _time.perf_counter()
+        try:
+            self._block(handle)
+        finally:
+            _tel.observe("pipeline.stall_seconds",
+                         _time.perf_counter() - t0)
+
+    def push(self, handle):
+        """Record a dispatched step; block on step t-K once over-limit."""
+        self._handles.append(handle)
+        while len(self._handles) > self.limit:
+            self._wait(self._handles.popleft())
+        if _tel._ENABLED:
+            _tel.set_gauge("engine.inflight_steps", len(self._handles))
+
+    def drain(self):
+        """Retire every in-flight step (checkpoint/eval boundaries)."""
+        while self._handles:
+            self._wait(self._handles.popleft())
+        if _tel._ENABLED:
+            _tel.set_gauge("engine.inflight_steps", 0)
 
 
 class NaiveEngine(Engine):

@@ -3,4 +3,5 @@ from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
 from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
                       FilterSampler, IntervalSampler)
 from .dataloader import DataLoader, default_batchify_fn
+from .prefetch import DevicePrefetcher
 from . import vision

@@ -74,7 +74,20 @@ def _to_device(batch):
 
 
 class DataLoader:
-    """Ref dataloader.py DataLoader; same constructor surface."""
+    """Ref dataloader.py DataLoader; same constructor surface, plus the
+    async-pipeline extensions (docs/pipeline.md):
+
+    * ``prefetch_to_device=`` composes a :class:`DevicePrefetcher` over
+      this loader — a background thread places the next K batches on
+      device (``MXNET_PREFETCH_DEPTH``, default 2) so host→HBM transfer
+      overlaps the current step.  Accepts ``True`` (default device), a
+      ``Context``, a ``jax.sharding.Sharding``, a ``ShardedTrainer``
+      (batches land pre-sharded per its ``batch_spec``), or a callable.
+    * ``pin_memory=True`` (previously ignored) stages host batches as
+      C-contiguous buffers on the prefetch thread before transfer.
+    * ``close()`` / ``with DataLoader(...) as loader:`` reclaim the
+      worker pool deterministically instead of waiting for ``__del__``.
+    """
 
     def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
                  shuffle: bool = False, sampler: Optional[Sampler] = None,
@@ -84,7 +97,8 @@ class DataLoader:
                  num_workers: int = 0, pin_memory: bool = False,
                  pin_device_id: int = 0, prefetch: Optional[int] = None,
                  thread_pool: bool = False, timeout: int = 120,
-                 try_nopython: Optional[bool] = None):
+                 try_nopython: Optional[bool] = None,
+                 prefetch_to_device=None):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -108,6 +122,9 @@ class DataLoader:
         self._thread_pool = thread_pool
         self._timeout = timeout
         self._pool = None
+        self._pin_memory = bool(pin_memory)
+        self._prefetch_to_device = prefetch_to_device
+        self._prefetcher = None
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -131,12 +148,42 @@ class DataLoader:
         return default_mp_batchify_fn
 
     def __iter__(self):
+        # truthiness, not an is-None check: False means "prefetch off"
+        # (the CLI-boolean spelling), and every real placement — Context,
+        # Sharding, trainer, callable — is truthy
+        if self._prefetch_to_device:
+            if self._prefetcher is None:
+                from .prefetch import DevicePrefetcher
+
+                self._prefetcher = DevicePrefetcher(
+                    _HostBatches(self), placement=self._prefetch_to_device,
+                    pin_memory=self._pin_memory)
+            return iter(self._prefetcher)
+        return self._iter_batches(to_device=True)
+
+    def _iter_batches(self, to_device: bool = True):
+        """Host-side batch production. ``to_device=True`` is the classic
+        synchronous contract (NDArray leaves, H2D paid inline at use
+        time); the device-prefetch path iterates with ``to_device=False``
+        so batches stay numpy and placement + byte accounting happen
+        exactly once, on the prefetch thread.  Loop-wait metrics are
+        recorded only when the TRAINING LOOP is the consumer — a
+        prefetch-thread driver records its own producer-side metrics
+        (pipeline.fetch_seconds), so dataloader.wait_seconds stays "time
+        the loop actually waited"."""
+        from .prefetch import on_prefetch_thread
+
+        record = _tel._ENABLED and not on_prefetch_thread()
         if self._num_workers == 0:
-            batchify = self._batchify_fn or default_batchify_fn
+            if self._batchify_fn is not None:
+                batchify = self._batchify_fn
+            else:
+                batchify = (default_batchify_fn if to_device
+                            else default_mp_batchify_fn)
             for indices in self._batch_sampler:
                 # single-process: the whole fetch+batchify runs inline, so
-                # ALL of it is time the training loop spends waiting
-                if _tel._ENABLED:
+                # ALL of it is time the consumer spends waiting
+                if record:
                     t0 = _time.perf_counter()
                     batch = batchify([self._dataset[i] for i in indices])
                     _tel.observe("dataloader.wait_seconds",
@@ -144,7 +191,7 @@ class DataLoader:
                     _tel.inc("dataloader.batches")
                 else:
                     batch = batchify([self._dataset[i] for i in indices])
-                yield _to_device(batch)
+                yield _to_device(batch) if to_device else batch
             return
 
         pool = self._get_pool()
@@ -156,9 +203,12 @@ class DataLoader:
             while idx < len(batches) and len(pending) < window:
                 pending.append(pool.apply_async(_worker_fn, (batches[idx],)))
                 idx += 1
-            if _tel._ENABLED:
+            if record:
                 # occupancy BEFORE the blocking get: a window that is
-                # persistently < prefetch means workers can't keep up
+                # persistently < prefetch means workers can't keep up.
+                # Gated like wait/batches: under a DevicePrefetcher the
+                # gauge belongs to the device queue (prefetch.py), and
+                # pool-side writes would interleave two unrelated depths
                 _tel.set_gauge("dataloader.prefetch_occupancy",
                                sum(1 for p in pending if p.ready()))
                 t0 = _time.perf_counter()
@@ -168,11 +218,49 @@ class DataLoader:
                 _tel.inc("dataloader.batches")
             else:
                 res = pending.pop(0).get(self._timeout)
-            yield _to_device(res)
+            yield _to_device(res) if to_device else res
 
-    def __del__(self):
+    def close(self):
+        """Reclaim resources deterministically: stop the device-prefetch
+        thread and terminate+join the worker pool (previously only
+        ``__del__`` terminated it, so pools leaked until GC).  The loader
+        stays usable — the next ``__iter__`` rebuilds both lazily."""
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
         if self._pool is not None:
             try:
                 self._pool.terminate()
+                self._pool.join()
             except Exception:
                 pass
+            self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _HostBatches:
+    """Re-iterable host-batch view of a DataLoader — the source a
+    composed DevicePrefetcher iterates each epoch."""
+
+    __slots__ = ("_loader",)
+
+    def __init__(self, loader):
+        self._loader = loader
+
+    def __iter__(self):
+        return self._loader._iter_batches(to_device=False)
+
+    def __len__(self):
+        return len(self._loader)

@@ -1,0 +1,316 @@
+"""Device prefetch: overlap host→HBM transfer with the current step.
+
+The reference's loader ends at host memory (shared-memory batches,
+dataloader.py) and every consumer pays the H2D copy synchronously at use
+time — the input-side half of the per-step stall PERF.md attributes to
+the host loop.  :class:`DevicePrefetcher` closes that seam: a background
+thread pulls batches from ANY iterator/iterable (a ``DataLoader``
+included) and places the next K on device ahead of consumption —
+``jax.device_put`` with the trainer's ``NamedSharding`` when a mesh is
+active — so the transfer for batch t+1..t+K rides under batch t's
+compute.  PJRT transfers are async and thread-safe, so the main loop
+only ever pays a queue pop for a batch whose buffers are already (or
+nearly) resident.
+
+``DataLoader(prefetch_to_device=...)`` composes this automatically; use
+the class directly to wrap custom iterators.  Placement accepts:
+
+  * ``True``                — default device, unsharded
+  * a :class:`~mxnet_tpu.context.Context`
+  * a ``jax.sharding.Sharding`` (e.g. ``NamedSharding(mesh, P('dp'))``)
+  * a ``ShardedTrainer`` (uses its ``device_put`` → ``batch_spec``)
+  * any callable ``batch -> placed batch``
+
+Telemetry (all produced off the main thread; the registry is
+thread-safe, so byte accounting stays truthful when transfers move off
+the training loop): ``pipeline.h2d_overlap_seconds`` (device_put wall
+time that overlapped compute), ``ndarray.h2d_bytes`` (host-sourced leaf
+bytes), ``pipeline.fetch_seconds`` (producer-side batch fetch, what
+``dataloader.wait_seconds`` would have been inline).  The consumer-side
+``dataloader.wait_seconds`` / ``dataloader.batches`` are recorded at the
+queue pop — the time the training loop ACTUALLY waited.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time as _time
+from typing import Any, Callable, Optional
+
+import numpy as _onp
+
+from ... import telemetry as _tel
+from ...base import MXNetError, get_env
+from ...context import Context
+from ...ndarray.ndarray import NDArray
+
+__all__ = ["DevicePrefetcher", "on_prefetch_thread"]
+
+# Producer threads mark themselves here so a wrapped DataLoader can tell
+# "the training loop is waiting on me" (record dataloader.wait_seconds)
+# from "the prefetch thread is fetching ahead" (pipeline.fetch_seconds).
+_TLS = threading.local()
+
+
+def on_prefetch_thread() -> bool:
+    """True on a DevicePrefetcher producer thread (metric redirection)."""
+    return getattr(_TLS, "active", False)
+
+
+def _resolve_put(placement) -> Callable[[Any], Any]:
+    """Normalize a placement spec to ``batch -> placed batch``."""
+    if callable(getattr(placement, "device_put", None)):  # ShardedTrainer
+        return placement.device_put
+    if isinstance(placement, Context):
+        dev = placement.jax_device()
+        return lambda batch: _tree_put(batch, device=dev)
+    if placement is True or placement is None:
+        return lambda batch: _tree_put(batch, device=None)
+    if callable(placement):
+        return placement
+    # duck-type jax shardings without importing jax at module scope
+    if hasattr(placement, "devices") or hasattr(placement, "device_set") \
+            or type(placement).__name__.endswith("Sharding"):
+        return lambda batch: _tree_put(batch, device=placement)
+    raise MXNetError(
+        f"prefetch placement must be True, a Context, a Sharding, a "
+        f"trainer with .device_put, or a callable; got {type(placement)}")
+
+
+def _tree_put(batch, device):
+    import jax
+
+    if isinstance(batch, (tuple, list)):
+        return tuple(_tree_put(b, device) for b in batch)
+    if isinstance(batch, NDArray):
+        batch = batch._data
+    if device is None:
+        return jax.device_put(batch)
+    return jax.device_put(batch, device)
+
+
+def _host_bytes(batch) -> int:
+    """Bytes of host-resident leaves about to cross the H2D seam."""
+    if isinstance(batch, (tuple, list)):
+        return sum(_host_bytes(b) for b in batch)
+    if isinstance(batch, NDArray):
+        return 0  # already device-resident; constructor billed any H2D
+    if isinstance(batch, (_onp.ndarray, _onp.generic)):
+        return batch.nbytes
+    return 0
+
+
+def _pin(batch):
+    """C-contiguous staging copies (the TPU-native reading of pin_memory:
+    one DMA-friendly buffer per leaf instead of a gather from strided
+    pages; done on the prefetch thread, so the copy also overlaps)."""
+    if isinstance(batch, (tuple, list)):
+        return tuple(_pin(b) for b in batch)
+    if isinstance(batch, _onp.ndarray):
+        return _onp.ascontiguousarray(batch)
+    return batch
+
+
+def _wrap_nd(batch):
+    """Device leaves -> NDArray, preserving tuple structure (keeps the
+    DataLoader contract: consumers always see NDArrays)."""
+    if isinstance(batch, (tuple, list)):
+        return tuple(_wrap_nd(b) for b in batch)
+    if isinstance(batch, NDArray):
+        return batch
+    return NDArray(batch)
+
+
+_SENTINEL = object()
+
+
+class _Err:
+    """Producer-side exception, rethrown at the consumer's next()."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class _Epoch:
+    """One iteration pass: producer thread + bounded queue."""
+
+    def __init__(self, it, put, depth: int, pin_memory: bool):
+        self._it = it
+        self._put = put
+        self._pin = pin_memory
+        self._q: _queue.Queue = _queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._produce,
+                                        name="mx-device-prefetch",
+                                        daemon=True)
+        self._thread.start()
+
+    def _produce(self):
+        _TLS.active = True
+        try:
+            while not self._stop.is_set():
+                try:
+                    if _tel._ENABLED:
+                        t0 = _time.perf_counter()
+                        batch = next(self._it)
+                        _tel.observe("pipeline.fetch_seconds",
+                                     _time.perf_counter() - t0)
+                    else:
+                        batch = next(self._it)
+                except StopIteration:
+                    self._offer(_SENTINEL)
+                    return
+                except BaseException as e:  # noqa: BLE001 — rethrow at get
+                    self._offer(_Err(e))
+                    return
+                # placement failures (a batch the sharding rejects, a bad
+                # pin) must ALSO surface at the consumer — a bare thread
+                # death would leave the loop blocked on the queue forever
+                try:
+                    if self._pin:
+                        batch = _pin(batch)
+                    nbytes = _host_bytes(batch)
+                    if _tel._ENABLED:
+                        t0 = _time.perf_counter()
+                        placed = _wrap_nd(self._put(batch))
+                        _tel.observe("pipeline.h2d_overlap_seconds",
+                                     _time.perf_counter() - t0)
+                        if nbytes:
+                            _tel.inc("ndarray.h2d_bytes", nbytes)
+                    else:
+                        placed = _wrap_nd(self._put(batch))
+                except BaseException as e:  # noqa: BLE001 — rethrow at get
+                    self._offer(_Err(e))
+                    return
+                if not self._offer(placed):
+                    return
+        finally:
+            _TLS.active = False
+
+    def _offer(self, item) -> bool:
+        """Bounded put that stays responsive to shutdown."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if _tel._ENABLED:
+            _tel.set_gauge("dataloader.prefetch_occupancy", self._q.qsize())
+            t0 = _time.perf_counter()
+            item = self._q.get()
+            _tel.observe("dataloader.wait_seconds",
+                         _time.perf_counter() - t0)
+        else:
+            item = self._q.get()
+        if item is _SENTINEL:
+            self._thread.join()
+            raise StopIteration
+        if isinstance(item, _Err):
+            self._thread.join()
+            raise item.exc
+        if _tel._ENABLED:
+            _tel.inc("dataloader.batches")
+        return item
+
+    def _drain_and_offer_sentinel(self):
+        while True:
+            try:
+                self._q.get_nowait()
+            except _queue.Empty:
+                break
+        try:
+            self._q.put_nowait(_SENTINEL)
+        except _queue.Full:
+            pass
+
+    def close(self):
+        self._stop.set()
+        # unblock a producer parked on a full queue AND a consumer parked
+        # on an empty one (a watchdog thread closing mid-epoch): the
+        # stopped producer will never enqueue the sentinel itself
+        self._drain_and_offer_sentinel()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        # a producer that was already inside its bounded put() when _stop
+        # was set may have landed ONE more batch after the drain above,
+        # stealing the sentinel's slot (depth=1).  After the stop flag no
+        # further puts happen, so a second drain+offer is definitive —
+        # the consumer is guaranteed to find a sentinel
+        self._drain_and_offer_sentinel()
+
+
+class DevicePrefetcher:
+    """Wrap a batch iterable; yield the same batches, already on device.
+
+    Ordering and values are identical to the wrapped iterable — only the
+    residency (and the thread that paid for the transfer) changes.  The
+    window of K in-flight device batches is also what makes input
+    donation safe downstream: the consumer's current batch and the
+    prefetched next batches are distinct buffers (double-buffering), so
+    a trainer step never reads a buffer the pipeline is overwriting.
+
+    Parameters
+    ----------
+    source : iterable or iterator of batches (leaves: numpy / NDArray)
+    placement : see module docstring (default: framework default device)
+    depth : in-flight device batches, default ``MXNET_PREFETCH_DEPTH`` (2)
+    pin_memory : stage host leaves as C-contiguous buffers first
+    owns_source : close() also closes ``source`` (DataLoader composition)
+    """
+
+    def __init__(self, source, placement=None, depth: Optional[int] = None,
+                 pin_memory: bool = False, owns_source: bool = False):
+        self._source = source
+        self._put = _resolve_put(placement)
+        if depth is None:
+            depth = get_env("MXNET_PREFETCH_DEPTH", 2, int)
+        self._depth = max(1, int(depth))
+        self._pin_memory = bool(pin_memory)
+        self._owns_source = owns_source
+        self._epochs: list = []
+
+    def __iter__(self):
+        it = iter(self._source)
+        epoch = _Epoch(it, self._put, self._depth, self._pin_memory)
+        self._epochs.append(epoch)
+        try:
+            yield from epoch
+        finally:
+            epoch.close()
+            if epoch in self._epochs:
+                self._epochs.remove(epoch)
+
+    def __len__(self):
+        return len(self._source)
+
+    def close(self):
+        """Stop producer threads; close an owned source (worker pools)."""
+        for epoch in self._epochs[:]:
+            epoch.close()
+        self._epochs.clear()
+        if self._owns_source:
+            close = getattr(self._source, "close", None)
+            if close is not None:
+                close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from .. import engine as _engine
 from .. import telemetry as _tel
 from ..base import MXNetError
 from .. import optimizer as opt_mod
@@ -47,6 +48,11 @@ class Trainer:
         self._kv_initialized = False
         self._update_on_kvstore = update_on_kvstore if update_on_kvstore is not None else False
         self._states_to_init = False
+        # bounded in-flight dispatch (MXNET_MAX_INFLIGHT_STEPS): the eager
+        # step never syncs, so without a bound a fast host could queue an
+        # unbounded run of update dispatches; step() pushes one updated-
+        # param handle per call and blocks on the step-(t-K) one
+        self._inflight = _engine.InflightQueue()
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -103,13 +109,25 @@ class Trainer:
         return self._scale / (batch_size * nw)
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """allreduce + update (ref trainer.py:334)."""
+        """allreduce + update (ref trainer.py:334).  Non-blocking: the
+        updates ride JAX async dispatch; in-flight depth is bounded by
+        ``MXNET_MAX_INFLIGHT_STEPS`` (docs/pipeline.md) via a handle on
+        the last updated parameter (the eager kernels never donate, so
+        the handle stays valid under the queue)."""
         with _tel.timer("trainer.step_seconds"):
             if not self._kv_initialized:
                 self._init_kvstore()
             self._optimizer.rescale_grad = self._rescale(batch_size)
             self.allreduce_grads()
             self.update(batch_size, ignore_stale_grad)
+            for p in reversed(self._params):
+                if p.grad_req != "null" and p._data is not None:
+                    self._inflight.push(p.data()._data)
+                    break
+
+    def drain(self):
+        """Retire every in-flight step (checkpoint/eval boundaries)."""
+        self._inflight.drain()
 
     def allreduce_grads(self):
         """Ref trainer.py:363. Single process with one logical copy per
@@ -162,6 +180,7 @@ class Trainer:
 
     # -- state persistence (ref trainer.py:482,511) -------------------------
     def save_states(self, fname):
+        self.drain()
         with open(fname, "wb") as f:
             f.write(self._updaters[0].get_states(dump_optimizer=False))
 

@@ -321,6 +321,18 @@ class NDArray:
             vals = f"<unmaterialized {self._data}>"
         return f"array({vals}, ctx={self.ctx})"
 
+    def __format__(self, spec):
+        """f-string support for scalar arrays: ``f"loss {loss:.4f}"`` on
+        the lazy loss a non-blocking ``step()`` returns.  A non-empty
+        spec on a size-1 array reads the value (one D2H sync, billed to
+        the usual telemetry counters) — keep it behind a logging gate."""
+        if not spec:
+            return str(self)
+        if self.size != 1:
+            raise TypeError(
+                f"format spec {spec!r} on a non-scalar NDArray {self.shape}")
+        return format(self.item(), spec)
+
     # -- async / engine semantics -----------------------------------------
     def wait_to_read(self):
         """Block until value ready; async errors rethrow here

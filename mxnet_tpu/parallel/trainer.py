@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import engine as _engine
 from .. import telemetry as _tel
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _mutation_scope
@@ -485,7 +486,8 @@ class ShardedTrainer:
                  batch_spec: P = P("dp"), compute_dtype=None,
                  lr_scheduler=None, grad_accum: int = 1,
                  init_loss_scale: float = 2.0 ** 16,
-                 multi_tensor: bool = False):
+                 multi_tensor: bool = False,
+                 max_inflight: Optional[int] = None):
         from .mesh import default_mesh
 
         self.net = net
@@ -535,6 +537,10 @@ class ShardedTrainer:
         self._scale_state = (
             jnp.float32(init_loss_scale if self._dynamic_scaling else 1.0),
             jnp.int32(0))
+        # bounded in-flight dispatch (MXNET_MAX_INFLIGHT_STEPS, default 2):
+        # step() rides JAX async dispatch, blocking only on the step-(t-K)
+        # loss handle — the queue stays K deep, never unbounded or depth-1
+        self._inflight = _engine.InflightQueue(max_inflight)
         from ..random import key_holder
 
         self._key = key_holder()._data
@@ -577,12 +583,23 @@ class ShardedTrainer:
         if getattr(v, "ndim", 1) < len(spec):
             spec = P(*spec[:v.ndim])
         sharding = NamedSharding(self.mesh, spec)
+        if isinstance(v, jax.Array) and v.sharding == sharding:
+            # already placed (the DevicePrefetcher path): no relayout, no
+            # host round-trip — the transfer was paid off the main thread
+            return v
         if jax.process_count() > 1 and any(s is not None for s in spec):
             import numpy as onp
 
             return jax.make_array_from_process_local_data(
                 sharding, onp.asarray(v))
         return jax.device_put(v, sharding)
+
+    def device_put(self, batch):
+        """Place a host batch (or tuple tree) onto the mesh per
+        ``batch_spec`` — the placement hook ``DevicePrefetcher`` /
+        ``DataLoader(prefetch_to_device=trainer)`` call so prefetched
+        batches arrive pre-sharded and ``step`` skips its own put."""
+        return self._put(batch)
 
     def _write_back_params(self):
         params = self._params
@@ -600,13 +617,31 @@ class ShardedTrainer:
 
         self._key = key_holder()._data
 
-    def step(self, x, y) -> float:
-        """One SPMD step; returns scalar loss. With grad_accum=k, every
-        k-th call applies the averaged accumulated gradient (the k-1 other
-        calls only accumulate — ref gradient-accumulation idiom over
-        grad_req='add')."""
+    def step(self, x, y, block: bool = False):
+        """One SPMD step.  By default the loss comes back as a LAZY
+        scalar ``NDArray`` riding JAX async dispatch — no host sync per
+        iteration; read it at gated points with ``loss.item()`` /
+        ``float(loss)``.  In-flight depth is bounded by
+        ``MXNET_MAX_INFLIGHT_STEPS`` (default 2): dispatching step t
+        blocks on step t-K's loss handle, so the device queue stays K
+        deep (docs/pipeline.md).  ``block=True`` restores the old
+        synchronous contract (drain the pipeline, return ``float``).
+
+        With grad_accum=k, every k-th call applies the averaged
+        accumulated gradient (the k-1 other calls only accumulate — ref
+        gradient-accumulation idiom over grad_req='add')."""
         with _tel.timer("trainer.step_seconds"):
-            return self._step(x, y)
+            loss = self._step(x, y)
+        if block:
+            self.drain()
+            return float(loss)
+        return loss
+
+    def drain(self):
+        """Retire every in-flight step (block until the device queue is
+        empty).  Call at checkpoint/eval boundaries; ``save_states`` and
+        ``step(block=True)`` call it for you."""
+        self._inflight.drain()
 
     @staticmethod
     def _jit_call(fn, *args):
@@ -628,7 +663,7 @@ class ShardedTrainer:
                          _time.perf_counter() - t0)
         return out
 
-    def _step(self, x, y) -> float:
+    def _step(self, x, y) -> NDArray:
         xb, yb = self._put(x), self._put(y)
         if self.grad_accum <= 1:
             self._t += 1
@@ -641,7 +676,11 @@ class ShardedTrainer:
                                     self._key, self.opt_state, self._t, lr,
                                     self._scale_state, xb, yb)
             self._write_back(mutated)
-            return float(loss)
+            # the loss depends on the whole fwd+bwd+update, is never fed
+            # back into a donating call, and is tiny — the one safe handle
+            # to bound the dispatch queue on
+            self._inflight.push(loss)
+            return NDArray(loss)
         grads, mutated, loss = self._jit_call(
             self._grad_fn,
             self.pvals, self.avals, self._key, self._scale_state[0], xb, yb)
@@ -658,7 +697,10 @@ class ShardedTrainer:
                 self._scale_state, avg)
             self._accum, self._micro = None, 0
             self._write_back_params()
-        return float(loss)
+        # micro-step losses chain to the last apply through pvals, so
+        # bounding on them transitively bounds the applies too
+        self._inflight.push(loss)
+        return NDArray(loss)
 
     # -- checkpoint (ref Trainer.save_states/load_states) -------------------
     def save_states(self, fname: str):
@@ -674,6 +716,7 @@ class ShardedTrainer:
                 f"save_states called mid gradient-accumulation window "
                 f"({self._micro}/{self.grad_accum} micro-batches pending); "
                 f"step to a window boundary first")
+        self.drain()  # retire in-flight steps before snapshotting state
         blob: Dict[str, Any] = {}
         for n, v in zip(self.train_names, self.pvals):
             blob[f"param/{n}"] = onp.asarray(v)

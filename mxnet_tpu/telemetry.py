@@ -20,7 +20,11 @@ Overhead contract: every instrumented call site guards on the single
 module flag ``_ENABLED`` (``MXNET_TELEMETRY=0`` disables), so a disabled
 build pays one global read per event — no locks, no allocation.  Enabled,
 each event is one per-metric lock plus a few float ops; events fire per
-batch/step/sync, never per element.  Site convention: per-batch/step
+batch/step/sync, never per element.  The registry is shared across
+threads by design: pipeline producers (the DevicePrefetcher transfer
+thread, engine workers) report into the same metrics, so byte/time
+accounting stays truthful when work moves off the main thread
+(docs/pipeline.md).  Site convention: per-batch/step
 seams (trainer, kvstore) use the ``with timer(name):`` scope; per-op hot
 seams (ndarray sync, engine push/wait) hand-roll the
 ``if _ENABLED: t0 = perf_counter() ... observe()`` pattern to skip the

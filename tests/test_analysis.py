@@ -92,6 +92,21 @@ _RULE_CASES = [
                 losses.append(trainer.step(x, y))
             print(sum(losses))
         """)),
+    ("L102",
+     textwrap.dedent("""\
+        def train(trainer, batches):
+            for x, y in batches:
+                loss = trainer.step(x, y)
+                log(float(loss))
+        """),
+     # the non-blocking idiom: the lazy loss rides async dispatch and is
+     # read ONCE, after the loop
+     textwrap.dedent("""\
+        def train(trainer, batches):
+            for x, y in batches:
+                loss = trainer.step(x, y)
+            return float(loss)
+        """)),
 ]
 
 
@@ -109,6 +124,28 @@ def test_rule_codes_all_documented():
         assert code in RULES
     for code in ("E001", "E002", "E003", "J001", "F001"):
         assert code in RULES  # runtime + flakiness rules share the catalog
+
+
+def test_l102_ignores_non_trainer_step_results():
+    """RL-style loops call env.step() and .backward() in the same loop;
+    host-side reads of env.step results must not be reported as loss
+    syncs (the capture is restricted to trainer-like receivers)."""
+    src = textwrap.dedent("""\
+        def train(agent, env):
+            for ep in range(10):
+                obs, reward, done, info = env.step(agent.act())
+                log(float(reward))
+                agent.objective.backward()
+        """)
+    assert not lint_source(src, "rl.py")
+    mixed = textwrap.dedent("""\
+        def train(trainer, env, batches):
+            for x, y in batches:
+                obs = env.step(x)
+                loss = trainer.step(x, y)
+                log(float(loss), float(obs))
+        """)
+    assert [d.code for d in lint_source(mixed, "m.py")] == ["L102"]
 
 
 def test_is_none_branches_are_trace_stable():
