@@ -16,7 +16,7 @@ TESTSRC  := src/mxtpu/tests/test_native.cc
 BUILD    := build
 
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
-	telemetry-smoke pipeline-smoke lint-hybrid ci clean
+	telemetry-smoke pipeline-smoke chaos-smoke lint-hybrid ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -80,6 +80,13 @@ pipeline-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		python tools/pipeline_smoke.py
 
+chaos-smoke:
+	# short LeNet loop under MXNET_FAULT_INJECT: barrier + dataloader +
+	# checkpoint faults injected; fails unless every recovery path holds
+	# and the crash->resume run matches bit-for-bit (docs/resilience.md)
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		python tools/chaos_smoke.py
+
 lint-hybrid:
 	# hybridize-safety static analysis (docs/analysis.md). The committed
 	# baseline makes legacy suppressions explicit; NEW violations fail.
@@ -89,7 +96,7 @@ lint-hybrid:
 		mxnet_tpu example benchmark
 
 ci: native native-test asan tsan lint-hybrid test test-slow telemetry-smoke \
-	pipeline-smoke
+	pipeline-smoke chaos-smoke
 
 clean:
 	rm -rf $(BUILD)

@@ -22,6 +22,7 @@ from typing import Callable, Optional, Sequence
 from . import _native
 from . import telemetry as _tel
 from .base import MXNetError, get_env
+from .resilience import chaos as _chaos
 
 __all__ = ["Engine", "NativeEngine", "NaiveEngine", "InflightQueue", "get",
            "push", "wait_for_var", "wait_for_all", "new_var", "delete_var"]
@@ -162,6 +163,10 @@ class NaiveEngine(Engine):
     def push(self, fn, read=(), write=(), priority=0, name=None):
         if _tel._ENABLED:
             _tel.inc("engine.ops_pushed")
+        if _chaos._ACTIVE:
+            # fault fires INSIDE the op: an injected failure poisons the
+            # write vars and rethrows at wait, like any real op failure
+            fn = _chaos.wrap("engine.push", fn)
         # same contract as the native engine: only READ deps propagate
         # poison; a successful write supersedes a poisoned value
         for v in read:
@@ -257,6 +262,11 @@ class NativeEngine(Engine):
         var._handle = None
 
     def push(self, fn, read=(), write=(), priority=0, name=None):
+        if _chaos._ACTIVE:
+            # same seam as NaiveEngine: the fault runs on the worker
+            # thread inside the op and marshals through the C error
+            # buffer to the next wait
+            fn = _chaos.wrap("engine.push", fn)
         global _op_counter
         with _op_lock:
             _op_counter += 1

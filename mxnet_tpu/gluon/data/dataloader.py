@@ -20,6 +20,7 @@ import numpy as _onp
 
 from ... import telemetry as _tel
 from ...base import MXNetError, get_env
+from ...resilience import chaos as _chaos
 from ...ndarray.ndarray import NDArray
 from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
@@ -62,6 +63,12 @@ def _worker_init(dataset, batchify_fn):
 
 
 def _worker_fn(indices: List[int]):
+    # fault-injection seam (site "dataloader.getitem"): forked workers
+    # inherit the parsed MXNET_FAULT_INJECT spec; an injected ChaosError
+    # crosses the pool boundary and surfaces at the consumer's next(),
+    # exactly like a real __getitem__ failure (decode error, lost shard)
+    if _chaos._ACTIVE:
+        _chaos.maybe_fail("dataloader.getitem")
     return _worker_batchify([_worker_dataset[i] for i in indices])
 
 
@@ -181,6 +188,9 @@ class DataLoader:
                 batchify = (default_batchify_fn if to_device
                             else default_mp_batchify_fn)
             for indices in self._batch_sampler:
+                # same fault seam as _worker_fn, inline flavor
+                if _chaos._ACTIVE:
+                    _chaos.maybe_fail("dataloader.getitem")
                 # single-process: the whole fetch+batchify runs inline, so
                 # ALL of it is time the consumer spends waiting
                 if record:

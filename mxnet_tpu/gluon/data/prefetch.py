@@ -32,6 +32,7 @@ queue pop — the time the training loop ACTUALLY waited.
 """
 from __future__ import annotations
 
+import logging
 import queue as _queue
 import threading
 import time as _time
@@ -239,7 +240,20 @@ class _Epoch:
         # stopped producer will never enqueue the sentinel itself
         self._drain_and_offer_sentinel()
         if self._thread.is_alive():
-            self._thread.join(timeout=5.0)
+            timeout = get_env("MXNET_PREFETCH_JOIN_TIMEOUT", 5.0, float)
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # the producer is wedged inside next(self._it) — a hung
+                # data source the stop flag cannot interrupt.  The thread
+                # is daemonic so it cannot block process exit, but a
+                # silent leak here hides the hang: say so, and tick the
+                # counter train loops / watchdogs can alert on
+                logging.warning(
+                    "DevicePrefetcher producer thread did not stop "
+                    "within %.1fs (data source hung in next()?); "
+                    "leaking daemon thread %s", timeout,
+                    self._thread.name)
+                _tel.inc("pipeline.prefetch_leaked_threads")
         # a producer that was already inside its bounded put() when _stop
         # was set may have landed ONE more batch after the drain above,
         # stealing the sentinel's slot (depth=1).  After the stop flag no

@@ -180,9 +180,14 @@ class Trainer:
 
     # -- state persistence (ref trainer.py:482,511) -------------------------
     def save_states(self, fname):
+        """Durable: the payload lands via the shared atomic-write helper
+        (tmp + fsync + ``os.replace``, docs/resilience.md) — a crash
+        mid-write leaves the previous file intact, never a torn one."""
         self.drain()
-        with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=False))
+        from ..resilience.checkpoint import write_payload
+
+        write_payload(fname,
+                      self._updaters[0].get_states(dump_optimizer=False))
 
     def load_states(self, fname):
         if not self._kv_initialized:

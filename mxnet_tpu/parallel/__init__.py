@@ -11,6 +11,10 @@ ICI/DCN collectives. Modules:
   collectives — psum/all_gather/ppermute wrappers (the NCCL-API analogue)
   trainer     — SPMD train-step builder (dp + tp + sp composable)
   ring        — ring attention (sequence parallelism over the sp axis)
+  dist        — process-group lifecycle (hardened bring-up: bounded
+                retry/backoff, collective deadlines — docs/resilience.md)
+  preemption  — SIGTERM-driven checkpoint-and-exit (PreemptionGuard,
+                durable via mx.resilience)
 """
 from .mesh import (make_mesh, default_mesh, data_parallel_spec,
                    MeshConfig, with_sharding)

@@ -15,15 +15,30 @@ Env vars (set by tools/launch.py; DMLC_* aliases accepted for parity):
   MXNET_DIST_COORDINATOR    host:port of process 0's coordinator
   MXNET_DIST_NUM_PROCESSES  world size
   MXNET_DIST_PROCESS_ID     this process's rank
+
+Hardened bring-up (docs/resilience.md): coordinator-not-up-yet is the
+NORMAL state while a pod's VMs come up in arbitrary order, so ``init``
+retries with exponential backoff + jitter instead of dying on the first
+connect failure (``MXNET_DIST_INIT_RETRIES``, default 5;
+``MXNET_DIST_INIT_TIMEOUT`` caps the whole attempt in seconds).
+``barrier``/``allgather_host`` accept an optional deadline
+(``timeout=`` / ``MXNET_DIST_BARRIER_TIMEOUT``) that converts an
+infinite multi-host hang — one rank died, everyone else waits forever —
+into an ``MXNetError`` naming the collective and the elapsed time.
+Both seams are fault-injectable (``resilience.chaos`` sites
+``dist.init`` / ``dist.barrier`` / ``dist.allgather``).
 """
 from __future__ import annotations
 
 import os
+import random as _random
+import threading
 import time as _time
-from typing import Optional
+from typing import Callable, Optional
 
 from .. import telemetry as _tel
-from ..base import MXNetError
+from ..base import MXNetError, get_env
+from ..resilience import chaos as _chaos
 
 _initialized = False
 
@@ -76,17 +91,68 @@ def init(coordinator_address: Optional[str] = None,
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:
         pass
+    # Bounded retry with exponential backoff + jitter: during pod
+    # bring-up the coordinator (process 0) is routinely the LAST VM to
+    # come up, so a connect failure here is the expected state, not an
+    # error.  Retries are capped (MXNET_DIST_INIT_RETRIES) and the whole
+    # attempt is optionally deadlined (MXNET_DIST_INIT_TIMEOUT seconds)
+    # so a permanently absent coordinator still fails loudly instead of
+    # spinning forever.
+    retries = get_env("MXNET_DIST_INIT_RETRIES", 5, int)
+    deadline = get_env("MXNET_DIST_INIT_TIMEOUT", None, float)
+    pass_timeout = False
+    if deadline is not None:
+        # jax's initialize blocks internally (default 300s) — the
+        # wall-clock cap must bound THAT, not just the gaps between
+        # attempts, so thread the remaining budget through when the
+        # installed jax accepts it
+        import inspect
+
+        pass_timeout = "initialization_timeout" in inspect.signature(
+            jax.distributed.initialize).parameters
     t0 = _time.perf_counter()
-    try:
-        jax.distributed.initialize(coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id,
-                                   local_device_ids=local_device_ids)
-    except RuntimeError as e:
-        # user already called jax.distributed.initialize() directly —
-        # standard JAX practice on pods; adopt their group rather than fail
-        if "already initialized" not in str(e).lower():
-            raise
+    attempt = 0
+    while True:
+        try:
+            if _chaos._ACTIVE:
+                _chaos.maybe_fail("dist.init")
+            kwargs = {}
+            if pass_timeout:
+                remaining = deadline - (_time.perf_counter() - t0)
+                kwargs["initialization_timeout"] = max(1, int(remaining))
+            jax.distributed.initialize(coordinator_address,
+                                       num_processes=num_processes,
+                                       process_id=process_id,
+                                       local_device_ids=local_device_ids,
+                                       **kwargs)
+            break
+        except (TypeError, ValueError):
+            raise  # caller bug (bad address/rank), retrying cannot help
+        except Exception as e:  # noqa: BLE001 — connect-ish: retry
+            if isinstance(e, RuntimeError) and \
+                    "already initialized" in str(e).lower():
+                # user already called jax.distributed.initialize()
+                # directly — standard JAX practice on pods; adopt their
+                # group rather than fail
+                break
+            attempt += 1
+            elapsed = _time.perf_counter() - t0
+            if attempt > retries or \
+                    (deadline is not None and elapsed >= deadline):
+                raise MXNetError(
+                    f"dist.init: could not join coordinator "
+                    f"{coordinator_address!r} after {attempt} attempt(s) "
+                    f"over {elapsed:.1f}s (MXNET_DIST_INIT_RETRIES="
+                    f"{retries}, MXNET_DIST_INIT_TIMEOUT={deadline}); "
+                    f"last error: {e}") from e
+            _tel.inc("dist.init_retries")
+            # exponential backoff, 0.5s base, 10s cap, +0..25% jitter so
+            # a whole pod retrying in lockstep doesn't hammer process 0
+            delay = min(0.5 * (2.0 ** (attempt - 1)), 10.0)
+            delay *= 1.0 + 0.25 * _random.random()
+            if deadline is not None:
+                delay = min(delay, max(0.0, deadline - elapsed))
+            _time.sleep(delay)
     _initialized = True
     if _tel._ENABLED:
         # per-rank join latency: a straggler here is a slow host or a DNS/
@@ -127,13 +193,63 @@ def num_workers() -> int:
 # resident training state never goes through here; it is psum'd inside the
 # jitted SPMD step (parallel/trainer.py) where XLA owns the collective.
 
-def allgather_host(x):
+
+def _with_deadline(fn: Callable, what: str, timeout: Optional[float]):
+    """Run a blocking collective with an optional deadline.  The
+    underlying jax host collectives have no timeout of their own, so one
+    dead rank turns every other rank into an infinite hang; this wrapper
+    converts that into an ``MXNetError`` naming the collective and the
+    elapsed time.  ``timeout=None`` keeps the plain inline call (no
+    thread, no overhead).  On timeout the daemon worker thread is leaked
+    by design — the collective is unjoinable precisely because a peer is
+    gone, and the process is expected to abort/re-init."""
+    if timeout is None:
+        return fn()
+    box = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["out"] = fn()
+        except BaseException as e:  # noqa: BLE001 — rethrown below
+            box["err"] = e
+        finally:
+            done.set()
+
+    t0 = _time.perf_counter()
+    th = threading.Thread(target=run, name=f"mx-dist-{what}", daemon=True)
+    th.start()
+    if not done.wait(timeout):
+        _tel.inc("dist.deadline_exceeded")
+        raise MXNetError(
+            f"collective {what!r} did not complete within {timeout:.1f}s "
+            f"(elapsed {_time.perf_counter() - t0:.1f}s): a peer rank is "
+            "likely dead or wedged; aborting instead of hanging forever")
+    if "err" in box:
+        raise box["err"]
+    return box.get("out")
+
+
+def allgather_host(x, timeout: Optional[float] = None):
     """Gather a same-shaped host value from every process → stacked along a
-    new leading axis (world_size, *x.shape), identical on all ranks."""
-    from jax.experimental import multihost_utils
+    new leading axis (world_size, *x.shape), identical on all ranks.
+
+    ``timeout`` (seconds, default ``MXNET_DIST_BARRIER_TIMEOUT`` or
+    none) bounds the wait — see :func:`_with_deadline`."""
+    if timeout is None:
+        timeout = get_env("MXNET_DIST_BARRIER_TIMEOUT", None, float)
+
+    def gather():
+        # chaos INSIDE the deadline: an injected "delay" stands in for
+        # the slow/dead peer the deadline exists to catch
+        if _chaos._ACTIVE:
+            _chaos.maybe_fail("dist.allgather")
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(x)
 
     if not _tel._ENABLED:
-        return multihost_utils.process_allgather(x)
+        return _with_deadline(gather, "allgather_host", timeout)
     try:
         nbytes = x.size * x.dtype.itemsize
     except AttributeError:
@@ -141,7 +257,7 @@ def allgather_host(x):
     _tel.inc("dist.allgather_calls")
     _tel.inc("dist.allgather_bytes", nbytes)
     t0 = _time.perf_counter()
-    out = multihost_utils.process_allgather(x)
+    out = _with_deadline(gather, "allgather_host", timeout)
     _tel.observe("dist.allgather_seconds", _time.perf_counter() - t0)
     return out
 
@@ -171,18 +287,47 @@ def broadcast_host(x, root: int = 0):
     return multihost_utils.broadcast_one_to_all(x)
 
 
-def barrier(name: str = "mx_barrier") -> None:
-    """Block until every process reaches this point (ref ps-lite Barrier)."""
-    import jax
+def barrier(name: str = "mx_barrier",
+            timeout: Optional[float] = None) -> None:
+    """Block until every process reaches this point (ref ps-lite Barrier).
 
-    if jax.process_count() == 1:
-        return
-    from jax.experimental import multihost_utils
+    ``timeout`` (seconds, default ``MXNET_DIST_BARRIER_TIMEOUT`` or
+    none) converts a hang — a peer rank that will never arrive — into an
+    ``MXNetError`` naming this barrier and the elapsed time."""
+    if not _chaos._ACTIVE:
+        # single-process fast path: nothing can hang and nothing is
+        # injectable — return before the deadline machinery so a
+        # fleet-wide MXNET_DIST_BARRIER_TIMEOUT costs single-host runs
+        # no thread spawn per barrier
+        import jax
+
+        if jax.process_count() == 1:
+            return
+    if timeout is None:
+        timeout = get_env("MXNET_DIST_BARRIER_TIMEOUT", None, float)
+
+    def sync() -> bool:
+        # chaos ahead of the single-process short-circuit (recovery
+        # paths run on one CPU host — make chaos-smoke) but INSIDE the
+        # deadline, so an injected "delay" exercises the timeout the
+        # way a wedged peer rank would
+        if _chaos._ACTIVE:
+            _chaos.maybe_fail("dist.barrier")
+        import jax
+
+        if jax.process_count() == 1:
+            return False
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+        return True
 
     if not _tel._ENABLED:
-        multihost_utils.sync_global_devices(name)
+        _with_deadline(sync, f"barrier:{name}", timeout)
         return
     t0 = _time.perf_counter()
-    multihost_utils.sync_global_devices(name)
-    # per-rank barrier wait ≈ how far this rank ran ahead of the slowest
-    _tel.observe("dist.barrier_seconds", _time.perf_counter() - t0)
+    multi = _with_deadline(sync, f"barrier:{name}", timeout)
+    if multi:
+        # per-rank barrier wait ≈ how far this rank ran ahead of the
+        # slowest (single-process short-circuits stay un-timed)
+        _tel.observe("dist.barrier_seconds", _time.perf_counter() - t0)

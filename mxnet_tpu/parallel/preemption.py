@@ -17,6 +17,11 @@ Usage::
         if guard.step():          # returns True once the checkpoint is cut
             break                  # exit cleanly; resume with load_states
 
+or, with rolling versioned checkpoints (docs/resilience.md)::
+
+    mgr = resilience.CheckpointManager("ckpt/run1", trainer)
+    guard = PreemptionGuard(trainer, manager=mgr)
+
 Design notes (TPU-first): the signal handler itself only sets a flag —
 checkpointing from inside a signal handler would race the jit step's
 donated buffers; the write happens at the next step() boundary, where
@@ -29,8 +34,19 @@ Multi-process SPMD: preemption notices are per-VM — one host may be
 signaled while the others are not. ``step()`` agrees on the flag across
 processes (an allgather) so EVERY rank checkpoints and exits at the same
 step boundary; otherwise the unsignaled ranks would block forever in the
-next collective. Rank 0 writes the file (save_states gathers a
-global view).
+next collective. Rank 0 writes (save_states gathers a global view), and
+every rank joins a durability barrier before ``step()`` returns True —
+a non-zero rank must not exit (and get its VM reclaimed) while rank 0
+is still writing, which was exactly the hole the pre-resilience version
+had.
+
+Durability: the file write itself is atomic (the shared
+``resilience.atomic_write`` tmp+fsync+rename primitive inside
+``save_states``; this module no longer hand-rolls its own tmp+rename),
+so a second preemption DURING the checkpoint write leaves the previous
+file intact.  A failed write is loud: ``ckpt.save_failures`` ticks and
+the exception is kept on ``guard.save_error`` so train loops and tests
+can assert on it instead of grepping logs.
 """
 from __future__ import annotations
 
@@ -40,14 +56,27 @@ import signal
 import threading
 from typing import Optional
 
+from .. import telemetry as _tel
+
 __all__ = ["PreemptionGuard"]
 
 
 class PreemptionGuard:
-    def __init__(self, trainer, path: str, signals=(signal.SIGTERM,),
-                 save_on_rank0_only: bool = True, check_every: int = 1):
+    def __init__(self, trainer, path: Optional[str] = None,
+                 signals=(signal.SIGTERM,),
+                 save_on_rank0_only: bool = True, check_every: int = 1,
+                 manager=None):
+        from ..base import MXNetError
+
+        if path is None and manager is None:
+            raise MXNetError(
+                "PreemptionGuard needs a checkpoint path or a "
+                "resilience.CheckpointManager (manager=)")
         self.trainer = trainer
         self.path = path
+        self.manager = manager
+        #: the exception of a failed preemption checkpoint (None = clean)
+        self.save_error: Optional[BaseException] = None
         self._flag = threading.Event()
         self._saved = False
         self._save_on_rank0_only = save_on_rank0_only
@@ -71,7 +100,10 @@ class PreemptionGuard:
     # -- step-boundary side --------------------------------------------------
     def step(self) -> bool:
         """Call once per training step, after trainer.step(). Returns True
-        when a preemption checkpoint was written (train loop should exit)."""
+        when a preemption checkpoint was written (train loop should exit).
+        On a failed write it STILL returns True (the run is being
+        reclaimed either way) with the exception on ``save_error`` and a
+        ``ckpt.save_failures`` tick."""
         if self._saved:
             return True
         import jax
@@ -95,26 +127,61 @@ class PreemptionGuard:
         elif not self._flag.is_set():
             return False
 
+        if self.manager is not None:
+            # rolling versioned checkpoint: the manager does the rank-0
+            # gating, the atomic commit, AND the all-rank durability
+            # barrier (and ticks ckpt.save_failures itself on error)
+            try:
+                step = getattr(self.trainer, "_t", self._step_count)
+                self.manager.save(step, trainer=self.trainer)
+                # an async_save manager returns with the write pending;
+                # a preemption exit must not outrun its own checkpoint
+                self.manager.wait()
+                logging.warning(
+                    "preemption checkpoint written under %s (step %d)",
+                    self.manager.directory, step)
+            except Exception as e:
+                self.save_error = e
+                logging.exception(
+                    "preemption checkpoint FAILED; exiting WITHOUT a "
+                    "new checkpoint version (older intact versions, if "
+                    "any, remain restorable)")
+            self._saved = True
+            return True
+
         rank = getattr(jax, "process_index", lambda: 0)()
         if not self._save_on_rank0_only or rank == 0:
             try:
-                d = os.path.dirname(os.path.abspath(self.path))
-                os.makedirs(d, exist_ok=True)
-                tmp = f"{self.path}.tmp.{os.getpid()}"
-                self.trainer.save_states(tmp)
-                os.replace(tmp, self.path)  # atomic: no torn checkpoint
+                from ..resilience.checkpoint import atomic_replace
+
+                # atomic at THIS level too (the stack's trainers are
+                # already atomic inside save_states, but the guard
+                # accepts any duck-typed trainer — one that writes the
+                # path directly must not tear the checkpoint when the
+                # grace period expires mid-write)
+                with atomic_replace(os.path.abspath(self.path)) as tmp:
+                    self.trainer.save_states(tmp)
                 logging.warning(
                     "preemption checkpoint written to %s (step %d)",
                     self.path, self.trainer._t)
-            except Exception:
+            except Exception as e:
                 # params sharded across non-addressable devices (e.g. tp
-                # across hosts) cannot be gathered by save_states; log
-                # loudly — the preempted run exits either way, but the
-                # operator must know there is NO checkpoint
+                # across hosts) cannot be gathered by save_states; be
+                # loud AND assertable — the preempted run exits either
+                # way, but the operator must know there is NO checkpoint
+                self.save_error = e
+                _tel.inc("ckpt.save_failures")
                 logging.exception(
                     "preemption checkpoint FAILED (params not "
                     "process-addressable? see save_states); exiting "
                     "WITHOUT a checkpoint")
+        if jax.process_count() > 1:
+            # durability barrier: non-zero ranks used to return True (and
+            # potentially exit, taking their VM) while rank 0 was still
+            # writing — every rank now waits for the write to finish
+            from . import dist
+
+            dist.barrier("mx_preemption_ckpt")
         self._saved = True
         return True
 

@@ -728,8 +728,11 @@ class ShardedTrainer:
         blob["meta/key"] = onp.asarray(self._key)
         blob["meta/scale"] = onp.asarray(self._scale_state[0])
         blob["meta/good"] = onp.asarray(self._scale_state[1])
-        with open(fname, "wb") as f:
-            onp.savez(f, **blob)
+        from ..resilience.checkpoint import write_payload
+
+        # atomic (tmp + fsync + os.replace, docs/resilience.md): a
+        # preempted VM mid-write must not tear the only checkpoint
+        write_payload(fname, lambda f: onp.savez(f, **blob))
 
     def load_states(self, fname: str):
         """Restore a save_states checkpoint onto THIS trainer's mesh: each

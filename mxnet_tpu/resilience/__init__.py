@@ -1,0 +1,33 @@
+"""mx.resilience — surviving the machine being unkind.
+
+The reference's only recovery story is "checkpoint/resume" (SURVEY.md
+§5) with non-atomic writes and no failure detection.  This subsystem is
+the production counterpart (docs/resilience.md):
+
+  checkpoint — :func:`atomic_write` / :func:`atomic_replace` (the one
+               shared tmp+fsync+rename primitive every checkpoint path
+               uses), :func:`write_payload` (durable checkpoint writes:
+               fault-injectable, counted), and :class:`CheckpointManager`
+               (versioned rolling ``step-N/`` checkpoints with CRC32
+               manifests, torn-write recovery, async saves, and a
+               multi-process durability barrier).
+  chaos      — deterministic fault injection at named seams
+               (``MXNET_FAULT_INJECT="site:kind:prob[:after]"``): engine
+               push, dataloader fetch, host collectives, dist init,
+               checkpoint writes — so every recovery path is testable on
+               one CPU host (``make chaos-smoke``).
+
+Hardened distributed bring-up lives where bring-up lives
+(``parallel/dist.py``): bounded ``dist.init`` retry with exponential
+backoff (``MXNET_DIST_INIT_RETRIES``/``MXNET_DIST_INIT_TIMEOUT``) and
+optional deadlines on ``barrier``/``allgather_host`` that convert an
+infinite multi-host hang into an ``MXNetError`` naming the barrier.
+"""
+from . import chaos
+from . import checkpoint
+from .chaos import ChaosError
+from .checkpoint import (CheckpointManager, atomic_replace, atomic_write,
+                         write_payload)
+
+__all__ = ["chaos", "checkpoint", "ChaosError", "CheckpointManager",
+           "atomic_replace", "atomic_write", "write_payload"]

@@ -1,0 +1,579 @@
+"""Durable checkpoints: atomic writes + versioned rolling CheckpointManager.
+
+The reference's recovery story is "checkpoint/resume" (SURVEY.md §5) and
+its writes are plain ``open(...).write(...)`` — a preempted VM half-way
+through leaves a torn file that ``load_states`` feeds straight into
+``set_states``.  This module is the durability layer under every
+checkpoint path in the stack:
+
+  * :func:`atomic_replace` / :func:`atomic_write` — the one shared
+    tmp + fsync + ``os.replace`` primitive (``gluon.Trainer``,
+    ``ShardedTrainer``, the estimator ``CheckpointHandler`` and
+    ``PreemptionGuard`` all write through it; nobody hand-rolls
+    tmp-rename anymore).
+  * :func:`write_payload` — :func:`atomic_write` plus the ``ckpt.write``
+    fault-injection site and the ``ckpt.saves`` counter: the seam every
+    durable *checkpoint* write crosses.
+  * :class:`CheckpointManager` — versioned rolling checkpoints
+    (``ckpt_dir/step-N/``, keep-last-K via ``MXNET_CKPT_KEEP``) over the
+    existing ``save_states``/``load_states`` payloads, with a
+    per-checkpoint CRC32 manifest, torn/corrupt detection on restore,
+    optional background-thread saves, and multi-process rank-0 writes
+    with an all-rank durability barrier.
+
+Checkpoint layout (docs/resilience.md)::
+
+    ckpt_dir/
+      step-40/
+        payload.bin        # exactly what trainer.save_states wrote
+        manifest.json      # commit record, written after payload fsync
+      step-44/ ...
+      .tmp-step-48-<pid>-<seq>/   # in-progress; invisible to restore
+
+``manifest.json``::
+
+    {"version": 1, "step": 44, "time": 1722800000.0,
+     "files": {"payload.bin": {"crc32": 3735928559, "bytes": 81920}}}
+
+Crash safety: the payload is written and fsynced inside a ``.tmp-*``
+directory, the manifest is written (atomically) after it, the directory
+is fsynced, and only then is the directory renamed to ``step-N`` — the
+rename is the commit point, so a kill at ANY moment leaves either the
+previous intact versions plus an ignored ``.tmp-*``, or a fully
+committed new version.  CRC32 in the manifest catches the remaining
+case (storage that acknowledged writes it lost): ``restore_latest``
+skips torn/mismatched/unloadable versions with a loud warning and falls
+back to the newest intact one.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import queue as _queue
+import shutil
+import sys
+import threading
+import time as _time
+import zlib
+from contextlib import contextmanager
+from typing import Any, Callable, List, Optional, Union
+
+from .. import telemetry as _tel
+from ..base import MXNetError, get_env
+from . import chaos as _chaos
+
+__all__ = ["atomic_replace", "atomic_write", "write_payload",
+           "CheckpointManager", "MANIFEST_NAME", "PAYLOAD_NAME"]
+
+MANIFEST_NAME = "manifest.json"
+PAYLOAD_NAME = "payload.bin"
+_MANIFEST_VERSION = 1
+_STEP_PREFIX = "step-"
+_TMP_PREFIX = ".tmp-"
+_SEQ = itertools.count()
+
+log = logging.getLogger(__name__)
+
+
+# -- fsync plumbing -----------------------------------------------------------
+
+def _fsync_path(path: str):
+    """fsync an already-written file by path (content durability)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str):
+    """fsync a directory (entry durability — the rename itself). Best
+    effort: some filesystems refuse O_RDONLY fsync on dirs."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _apply_write_fault(kind: Optional[str], path: str, what: str):
+    """Act on a drawn ``ckpt.write`` fault against the just-written file
+    — the ONE definition of the injection semantics, shared by
+    :func:`atomic_write` and the manager's commit point: ``torn``
+    truncates the file to half (lying storage), ``delay`` sleeps (slow
+    disk), anything else raises :class:`~.chaos.ChaosError` (the kill
+    before the commit)."""
+    if kind is None:
+        return
+    if kind == "torn":
+        size = os.path.getsize(path)
+        with open(path, "rb+") as f:
+            f.truncate(max(1, size // 2))
+        return
+    if kind == "delay":
+        _time.sleep(get_env("MXNET_FAULT_DELAY", 0.05, float))
+        return
+    raise _chaos.ChaosError(f"injected fault at 'ckpt.write' ({what})")
+
+
+# -- the shared atomic-write primitive ---------------------------------------
+
+@contextmanager
+def atomic_replace(path: str, _presynced: bool = False):
+    """Context manager yielding a temp path; on clean exit the temp file
+    is fsynced and atomically renamed over ``path`` (and the parent
+    directory fsynced).  On error the temp file is removed and ``path``
+    is untouched.  For writers that take a *filename* rather than a file
+    object (``net.save_parameters``)::
+
+        with atomic_replace(final) as tmp:
+            net.save_parameters(tmp)
+
+    ``_presynced``: the writer already fsynced the temp file's content
+    (``atomic_write`` does) — skip the redundant reopen+fsync."""
+    path = os.path.abspath(path)
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_SEQ)}"
+    try:
+        yield tmp
+        if not _presynced:
+            _fsync_path(tmp)
+        os.replace(tmp, path)
+        _fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write(path: str, data: Union[bytes, Callable],
+                 fault_site: Optional[str] = None):
+    """Write ``data`` (bytes, or a callable taking the open binary file)
+    to ``path`` atomically: tmp file + flush + fsync + ``os.replace`` +
+    parent-dir fsync.  A crash at any point leaves the previous content
+    of ``path`` intact — never a torn file.
+
+    ``fault_site`` names a chaos seam drawn at the commit point
+    (``resilience.chaos``): kind ``error`` aborts before the rename (the
+    destination is untouched, like a kill mid-write under this very
+    primitive), kind ``torn`` commits a half-truncated file (storage
+    that lied about durability — the case only a checksum catches)."""
+    with atomic_replace(path, _presynced=True) as tmp:
+        with open(tmp, "wb") as f:
+            if callable(data):
+                data(f)
+            else:
+                f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        if fault_site is not None and _chaos.active():
+            _apply_write_fault(_chaos.draw(fault_site), tmp,
+                               "write aborted before commit")
+
+
+_TLS = threading.local()
+
+
+def write_payload(path: str, data: Union[bytes, Callable]):
+    """A durable *checkpoint* write: :func:`atomic_write` under the
+    ``ckpt.write`` fault site, counted as ``ckpt.saves``.  Every
+    ``save_states`` payload in the stack (both trainers, the estimator's
+    ``.states``, CheckpointManager versions) lands through here; the
+    estimator's ``.params`` artifact uses :func:`atomic_replace`
+    directly (atomic, but outside this counter/fault seam — its writer
+    only takes a filename).
+
+    Inside a :class:`CheckpointManager` commit the fault draw is
+    deferred to the manager's own commit point (one draw per logical
+    checkpoint, and its ``torn`` lands AFTER the manifest CRC is
+    computed — so the torn version actually exercises the CRC
+    detector, not just the load-failure fallback)."""
+    in_commit = getattr(_TLS, "in_commit", False)
+    site = None if in_commit else "ckpt.write"
+    if _tel._ENABLED:
+        t0 = _time.perf_counter()
+        atomic_write(path, data, fault_site=site)
+        _tel.observe("ckpt.write_seconds", _time.perf_counter() - t0)
+        _tel.inc("ckpt.saves")
+    else:
+        atomic_write(path, data, fault_site=site)
+
+
+# -- process-group helpers (no hard jax dependency) ---------------------------
+
+def _world() -> tuple:
+    """(process_count, process_index) — (1, 0) when jax was never even
+    imported (host-only tooling must not pay a jax import)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 1, 0
+    return jax.process_count(), jax.process_index()
+
+
+def _barrier(name: str):
+    from ..parallel import dist
+
+    dist.barrier(name)
+
+
+# -- manifest / verification --------------------------------------------------
+
+def _crc32_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _step_of(dirname: str) -> Optional[int]:
+    if not dirname.startswith(_STEP_PREFIX):
+        return None
+    try:
+        return int(dirname[len(_STEP_PREFIX):])
+    except ValueError:
+        return None
+
+
+class CheckpointManager:
+    """Versioned rolling checkpoints with torn-write recovery.
+
+    ::
+
+        mgr = CheckpointManager("ckpt/run1", trainer, keep=3)
+        for step, (x, y) in enumerate(data):
+            trainer.step(x, y)
+            if step % 100 == 0:
+                mgr.save(step)           # ckpt/run1/step-<N>/
+        ...
+        step = mgr.restore_latest()      # newest INTACT version (or None)
+
+    Parameters
+    ----------
+    directory : checkpoint root; one ``step-N/`` subdirectory per version.
+    trainer : default payload owner — anything with
+        ``save_states(path)`` / ``load_states(path)`` (``gluon.Trainer``,
+        ``ShardedTrainer``); individual calls may override.
+    keep : retain the newest K versions (default ``MXNET_CKPT_KEEP``, 3);
+        older ones are deleted after each successful commit.
+    async_save : run the write+commit (and the multi-process durability
+        barrier) on a background thread so the save overlaps training.
+        The *state capture* (``save_states``) still runs on the save
+        thread inside the job — callers that need a consistent snapshot
+        while training mutates state should pass ``payload=`` bytes
+        captured synchronously, or call :meth:`wait` before mutating.
+        ``wait()`` drains pending saves and re-raises the first failure.
+
+    Multi-process: rank 0 writes (``save_states`` gathers the global
+    view), then EVERY rank joins a barrier keyed on the step before
+    ``save`` returns — no rank can exit (and get its VM reclaimed)
+    before the checkpoint is durable on rank 0's disk.
+
+    Telemetry: ``ckpt.saves`` / ``ckpt.save_failures`` /
+    ``ckpt.restores`` / ``ckpt.corrupt_skipped`` counters,
+    ``ckpt.save_seconds`` / ``ckpt.restore_seconds`` timers,
+    ``ckpt.last_step`` gauge (docs/telemetry.md)."""
+
+    def __init__(self, directory: str, trainer=None,
+                 keep: Optional[int] = None, async_save: bool = False):
+        self.directory = os.path.abspath(directory)
+        self._trainer = trainer
+        if keep is None:
+            keep = get_env("MXNET_CKPT_KEEP", 3, int)
+        self.keep = max(1, int(keep))
+        self.async_save = bool(async_save)
+        self._errors: List[BaseException] = []
+        self._err_lock = threading.Lock()
+        self._q: Optional[_queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
+        os.makedirs(self.directory, exist_ok=True)
+        if _world()[1] == 0:
+            self._sweep_stale_tmp()
+
+    # -- introspection -------------------------------------------------------
+    def steps(self) -> List[int]:
+        """Committed version steps, ascending (intactness not checked)."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        return sorted(s for s in (_step_of(n) for n in names)
+                      if s is not None)
+
+    def path_of(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
+
+    def payload_path(self, step: int) -> str:
+        return os.path.join(self.path_of(step), PAYLOAD_NAME)
+
+    @property
+    def save_error(self) -> Optional[BaseException]:
+        """First unraised async-save failure (None when clean)."""
+        with self._err_lock:
+            return self._errors[0] if self._errors else None
+
+    # -- save ---------------------------------------------------------------
+    def save(self, step: Optional[int] = None, trainer=None,
+             payload: Optional[bytes] = None) -> Optional[str]:
+        """Write one version.  ``step`` defaults to the trainer's step
+        counter (``trainer._t``).  ``payload`` bytes override the
+        trainer's ``save_states`` (a pre-captured snapshot — the safe
+        input for ``async_save``).  Returns the committed directory on
+        the writing rank (sync mode), else None."""
+        trainer = trainer if trainer is not None else self._trainer
+        if step is None:
+            t = getattr(trainer, "_t", None)
+            if t is None:
+                raise MXNetError(
+                    "save() needs an explicit step= (trainer has no "
+                    "step counter)")
+            step = int(t)
+        if trainer is None and payload is None:
+            raise MXNetError("save() needs a trainer or payload= bytes")
+        if self.async_save:
+            self._enqueue(lambda: self._save_now(step, trainer, payload))
+            return None
+        return self._save_now(step, trainer, payload)
+
+    def _save_now(self, step: int, trainer, payload) -> Optional[str]:
+        world, rank = _world()
+        final = None
+        err: Optional[BaseException] = None
+        if rank == 0:
+            try:
+                if _tel._ENABLED:
+                    with _tel.timer("ckpt.save_seconds"):
+                        final = self._commit(step, trainer, payload)
+                else:
+                    final = self._commit(step, trainer, payload)
+                _tel.set_gauge("ckpt.last_step", step)
+            except BaseException as e:  # noqa: BLE001 — barrier first
+                err = e
+        # the durability barrier: EVERY rank blocks here until rank 0's
+        # version is on disk (or its write definitively failed) — a rank
+        # returning early could exit and take its VM before the
+        # checkpoint exists.  Rank-0 failure still releases the group;
+        # the error is raised locally after.
+        if world > 1:
+            _barrier(f"mx_ckpt_step_{step}")
+        if err is not None:
+            _tel.inc("ckpt.save_failures")
+            raise err
+        return final
+
+    def _commit(self, step: int, trainer, payload) -> str:
+        tmpdir = os.path.join(
+            self.directory,
+            f"{_TMP_PREFIX}{_STEP_PREFIX}{step}-{os.getpid()}-{next(_SEQ)}")
+        os.makedirs(tmpdir)
+        try:
+            ppath = os.path.join(tmpdir, PAYLOAD_NAME)
+            _TLS.in_commit = True  # defer the ckpt.write fault draw
+            try:
+                if payload is not None:
+                    write_payload(ppath, payload)
+                else:
+                    trainer.save_states(ppath)
+                    if not os.path.exists(ppath):
+                        raise MXNetError(
+                            f"save_states wrote nothing at {ppath}")
+            finally:
+                _TLS.in_commit = False
+            files = {}
+            for name in sorted(os.listdir(tmpdir)):
+                p = os.path.join(tmpdir, name)
+                if os.path.isfile(p):
+                    files[name] = {"crc32": _crc32_file(p),
+                                   "bytes": os.path.getsize(p)}
+            manifest = {"version": _MANIFEST_VERSION, "step": step,
+                        "time": round(_time.time(), 3), "files": files}
+            # manifest last: its presence marks "every file above is
+            # complete"; atomic_write fsyncs it before the dir fsync
+            atomic_write(os.path.join(tmpdir, MANIFEST_NAME),
+                         (json.dumps(manifest, indent=2, sort_keys=True)
+                          + "\n").encode())
+            if _chaos.active():
+                # the manager's one ckpt.write draw, at ITS commit
+                # point: "error" = kill before the rename (no new
+                # version); "torn" = truncate the payload AFTER its CRC
+                # went into the manifest, committing exactly the
+                # mismatch the restore scanner's checksum must catch
+                _apply_write_fault(
+                    _chaos.draw("ckpt.write"), ppath,
+                    f"version step-{step} aborted before commit")
+            _fsync_dir(tmpdir)
+            final = self.path_of(step)
+            aside = None
+            if os.path.isdir(final):
+                # re-saving an existing step: MOVE the committed version
+                # aside (one rename) rather than rmtree'ing it before
+                # the commit — deleting first would open a long crash
+                # window with NO version at this step; two renames
+                # shrink that window to microseconds, and a crash
+                # between them leaves the old version on disk under the
+                # aside name (sweepable, manually recoverable)
+                aside = os.path.join(
+                    self.directory,
+                    f"{_TMP_PREFIX}old-{_STEP_PREFIX}{step}-"
+                    f"{os.getpid()}-{next(_SEQ)}")
+                os.replace(final, aside)
+            os.replace(tmpdir, final)  # THE commit point
+            _fsync_dir(self.directory)
+            if aside is not None:
+                shutil.rmtree(aside, ignore_errors=True)
+        except BaseException:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+            raise
+        self._gc()
+        return final
+
+    def _gc(self):
+        for s in sorted(self.steps(), reverse=True)[self.keep:]:
+            shutil.rmtree(self.path_of(s), ignore_errors=True)
+
+    def _sweep_stale_tmp(self):
+        """Remove ``.tmp-*`` debris from crashed writers (never visible
+        to restore, but they hold disk)."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for n in names:
+            if n.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(self.directory, n),
+                              ignore_errors=True)
+
+    # -- async plumbing ------------------------------------------------------
+    def _enqueue(self, job: Callable[[], Any]):
+        if self._worker is None:
+            self._q = _queue.Queue()
+            self._worker = threading.Thread(
+                target=self._run_worker, name="mx-ckpt-save", daemon=True)
+            self._worker.start()
+        self._q.put(job)
+
+    def _run_worker(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                self._q.task_done()
+                return
+            try:
+                job()
+            except BaseException as e:  # noqa: BLE001 — surfaced at wait()
+                # (_save_now already ticked ckpt.save_failures)
+                log.exception("async checkpoint save failed")
+                with self._err_lock:
+                    self._errors.append(e)
+            finally:
+                self._q.task_done()
+
+    def wait(self):
+        """Drain pending async saves; re-raise the first failure."""
+        if self._q is not None:
+            self._q.join()
+        with self._err_lock:
+            errs, self._errors = self._errors, []
+        if errs:
+            raise errs[0]
+
+    def close(self):
+        """Drain (raising any pending failure) and stop the worker."""
+        try:
+            self.wait()
+        finally:
+            if self._worker is not None:
+                self._q.put(None)
+                self._worker.join(timeout=10.0)
+                self._worker = None
+                self._q = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- restore -------------------------------------------------------------
+    def verify(self, step: int) -> bool:
+        """True when version ``step`` is intact: manifest present and
+        parseable, every listed file present with matching size and
+        CRC32."""
+        d = self.path_of(step)
+        try:
+            with open(os.path.join(d, MANIFEST_NAME)) as f:
+                manifest = json.load(f)
+            files = manifest["files"]
+        except (OSError, ValueError, KeyError, TypeError):
+            return False
+        if not files:
+            return False
+        for name, meta in files.items():
+            p = os.path.join(d, name)
+            try:
+                if os.path.getsize(p) != meta["bytes"]:
+                    return False
+                if _crc32_file(p) != meta["crc32"]:
+                    return False
+            except (OSError, KeyError, TypeError):
+                return False
+        return True
+
+    def restore_latest(self, trainer=None) -> Optional[int]:
+        """Load the newest INTACT version into the trainer; returns its
+        step, or None when no intact version exists.  Torn manifests,
+        CRC mismatches, and payloads ``load_states`` rejects are each
+        skipped with a loud warning (and a ``ckpt.corrupt_skipped``
+        tick) — the scanner keeps walking back until something loads.
+
+        If a ``load_states`` attempt failed (it may have half-mutated
+        the trainer) and NO older version subsequently loaded, this
+        raises instead of returning None: None means "no checkpoint,
+        trainer untouched — safe to start fresh", and a half-restored
+        trainer must never masquerade as that."""
+        trainer = trainer if trainer is not None else self._trainer
+        if trainer is None:
+            raise MXNetError("restore_latest() needs a trainer")
+        t0 = _time.perf_counter()
+        load_failed_at = None
+        for step in sorted(self.steps(), reverse=True):
+            if not self.verify(step):
+                _tel.inc("ckpt.corrupt_skipped")
+                log.warning(
+                    "checkpoint %s is torn/corrupt (manifest or CRC "
+                    "mismatch); skipping to an older version",
+                    self.path_of(step))
+                continue
+            try:
+                trainer.load_states(self.payload_path(step))
+            except Exception:
+                _tel.inc("ckpt.corrupt_skipped")
+                if load_failed_at is None:
+                    load_failed_at = step
+                log.exception(
+                    "checkpoint %s passed CRC but load_states rejected "
+                    "it; skipping to an older version", self.path_of(step))
+                continue
+            _tel.inc("ckpt.restores")
+            _tel.observe("ckpt.restore_seconds",
+                         _time.perf_counter() - t0)
+            _tel.set_gauge("ckpt.last_step", step)
+            return step
+        if load_failed_at is not None:
+            raise MXNetError(
+                f"restore failed: load_states raised on step-"
+                f"{load_failed_at} (and no older version loaded) after "
+                "possibly half-mutating the trainer; its state is "
+                "undefined — reinitialize the trainer before training")
+        return None

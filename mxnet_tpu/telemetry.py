@@ -16,6 +16,13 @@ Three metric kinds:
     over a bounded reservoir of recent samples (``observe`` /
     ``with timer(name):`` / ``@timed(name)``).
 
+The registry is also the evidence layer for the resilience stack
+(docs/resilience.md): checkpoint durability (``ckpt.{saves,restores,
+corrupt_skipped,save_failures}``), injected faults (``chaos.injected``
+and per-site counters), and bring-up retries (``dist.init_retries``,
+``dist.deadline_exceeded``) all tick here, so "did the recovery path
+actually run" is an assertable fact, not a log grep.
+
 Overhead contract: every instrumented call site guards on the single
 module flag ``_ENABLED`` (``MXNET_TELEMETRY=0`` disables), so a disabled
 build pays one global read per event — no locks, no allocation.  Enabled,

@@ -1,0 +1,216 @@
+"""Chaos smoke gate (`make chaos-smoke`).
+
+A short LeNet training loop run UNDER ``MXNET_FAULT_INJECT``, covering
+the three seam families the resilience stack hardens
+(docs/resilience.md) — and asserting actual RECOVERY, not just that
+faults fired:
+
+  collective    ``dist.barrier`` — an injected barrier failure surfaces
+                as a catchable ChaosError (on a pod this is the
+                infinite-hang case the deadline converts to an error).
+  dataloader    ``dataloader.getitem`` — a mid-epoch fetch fault
+                surfaces at the consumer; a fresh epoch completes.
+  checkpoint    ``ckpt.write`` (kind ``torn``) — a checkpoint COMMITTED
+                with a torn payload (kill-mid-write / lying storage).
+                The scanner must skip it loudly and resume from the
+                newest intact version, and the resumed run must
+                reproduce the uninterrupted run's final parameters
+                BIT-FOR-BIT.
+
+FAILS (exit 1) unless every injected fault fired (telemetry
+``chaos.injected.*``), the torn version was skipped
+(``ckpt.corrupt_skipped``), a restore happened (``ckpt.restores``), and
+the resumed params match the reference run exactly.  Companion gate to
+tools/telemetry_smoke.py and tools/pipeline_smoke.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the whole loop runs under a fault spec, tools/launch.py-style; phases
+# reconfigure via chaos.configure() to sequence the injections
+os.environ.setdefault(
+    "MXNET_FAULT_INJECT",
+    "dist.barrier:error:1.0:1,dataloader.getitem:error:1.0:6,"
+    "ckpt.write:torn:1.0:2")
+os.environ.setdefault("MXNET_FAULT_SEED", "0")
+
+# runnable as `python tools/chaos_smoke.py` from a source checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = 12
+BATCH = 32
+SAVE_EVERY = 3
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    def ce(pred, y):
+        logp = jax.nn.log_softmax(pred.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.get_model("lenet")
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((2, 1, 28, 28)))
+    mesh = make_mesh({"dp": -1}, devices=jax.devices()[:1])
+    return ShardedTrainer(net, ce, mesh=mesh, optimizer="sgd",
+                          learning_rate=0.05, momentum=0.9)
+
+
+def _batch(step):
+    import numpy as onp
+
+    rs = onp.random.RandomState(1000 + step)
+    return (rs.rand(BATCH, 1, 28, 28).astype("float32"),
+            rs.randint(0, 10, size=(BATCH,)).astype("int32"))
+
+
+def main() -> int:
+    import numpy as onp
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.resilience import CheckpointManager, chaos
+
+    if not telemetry.enabled():
+        print("chaos-smoke: MXNET_TELEMETRY=0 — injection counters are "
+              "the gate's evidence; run with telemetry enabled",
+              file=sys.stderr)
+        return 1
+    assert chaos.active(), "MXNET_FAULT_INJECT spec not installed"
+    checks = {}
+
+    # -- collective site: barrier fault is surfaced, not hung ---------------
+    from mxnet_tpu.parallel import dist
+
+    dist.barrier("chaos_smoke_warmup")  # after=1: first call spared
+    try:
+        dist.barrier("chaos_smoke_epoch")
+        checks["barrier_fault_raised"] = False
+    except chaos.ChaosError:
+        checks["barrier_fault_raised"] = True
+
+    # -- dataloader site: fetch fault surfaces, next epoch recovers ---------
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    rs = onp.random.RandomState(0)
+    ds = ArrayDataset(rs.rand(8 * BATCH, 1, 28, 28).astype("float32"),
+                      rs.randint(0, 10, size=(8 * BATCH,)).astype("int32"))
+    loader = DataLoader(ds, batch_size=BATCH)
+    got, fault_seen = 0, False
+    try:
+        for _ in loader:
+            got += 1
+    except chaos.ChaosError:
+        fault_seen = True
+    checks["dataloader_fault_raised"] = fault_seen and got == 6
+    # recovery: clear the loader site (operator fixed the shard), full
+    # epoch completes
+    chaos.configure("ckpt.write:torn:1.0:2")
+    checks["dataloader_recovered"] = sum(1 for _ in loader) == 8
+
+    # -- reference run: uninterrupted ---------------------------------------
+    ref = _build()
+    for s in range(1, STEPS + 1):
+        ref.step(*_batch(s))
+    ref.drain()
+    ref_params = [onp.asarray(v) for v in ref.pvals]
+
+    # -- chaotic run: checkpoint every 3 steps; the third save (step 9)
+    # commits TORN; the process then "dies" at step 9 ------------------------
+    import tempfile
+
+    ckdir = tempfile.mkdtemp(prefix="mx-chaos-smoke-")
+    victim = _build()
+    mgr = CheckpointManager(ckdir, victim, keep=3)
+    for s in range(1, 10):
+        victim.step(*_batch(s))
+        if s % SAVE_EVERY == 0:
+            mgr.save()
+    chaos.reset()
+    del victim  # simulated kill -9
+
+    # -- resume: newest INTACT version, then bit-for-bit equivalence --------
+    survivor = _build()
+    mgr2 = CheckpointManager(ckdir, survivor)
+    restored = mgr2.restore_latest()
+    checks["restored_step"] = restored
+    checks["torn_version_skipped"] = restored == 6  # step-9 was torn
+    if restored is None:
+        # a scanner regression must still produce the diagnostic
+        # artifact below, not a bare TypeError
+        checks["bit_for_bit_resume"] = False
+    else:
+        for s in range(restored + 1, STEPS + 1):
+            survivor.step(*_batch(s))
+        survivor.drain()
+        checks["bit_for_bit_resume"] = all(
+            onp.array_equal(a, onp.asarray(b))
+            for a, b in zip(ref_params, survivor.pvals))
+
+    snap = telemetry.snapshot()
+
+    def count(name):
+        return snap.get(name, {}).get("value", 0)
+
+    checks["chaos.injected"] = count("chaos.injected")
+    checks["chaos.injected.dist.barrier"] = count(
+        "chaos.injected.dist.barrier")
+    checks["chaos.injected.dataloader.getitem"] = count(
+        "chaos.injected.dataloader.getitem")
+    checks["chaos.injected.ckpt.write"] = count("chaos.injected.ckpt.write")
+    checks["ckpt.corrupt_skipped"] = count("ckpt.corrupt_skipped")
+    checks["ckpt.restores"] = count("ckpt.restores")
+    checks["ckpt.saves"] = count("ckpt.saves")
+
+    ok = (checks["barrier_fault_raised"]
+          and checks["dataloader_fault_raised"]
+          and checks["dataloader_recovered"]
+          and checks["torn_version_skipped"]
+          and checks["bit_for_bit_resume"]
+          and checks["chaos.injected.dist.barrier"] >= 1
+          and checks["chaos.injected.dataloader.getitem"] >= 1
+          and checks["chaos.injected.ckpt.write"] >= 1
+          and checks["ckpt.corrupt_skipped"] >= 1
+          and checks["ckpt.restores"] >= 1)
+
+    out_path = os.environ.get("MXNET_CHAOS_JSON") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "chaos_smoke.json")
+    doc = {"steps": STEPS, "batch": BATCH, "ok": ok, "checks": checks,
+           "telemetry": snap}
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True, default=str)
+        f.write("\n")
+
+    print(f"chaos-smoke: {STEPS} steps x batch {BATCH} -> {out_path}")
+    print(f"  faults injected               "
+          f"{checks['chaos.injected']} "
+          f"(barrier {checks['chaos.injected.dist.barrier']}, "
+          f"dataloader {checks['chaos.injected.dataloader.getitem']}, "
+          f"ckpt {checks['chaos.injected.ckpt.write']})")
+    print(f"  torn checkpoint skipped       "
+          f"{checks['torn_version_skipped']} "
+          f"(restored step-{checks['restored_step']}, "
+          f"corrupt_skipped {checks['ckpt.corrupt_skipped']})")
+    print(f"  bit-for-bit resume            {checks['bit_for_bit_resume']}")
+    if not ok:
+        print("chaos-smoke: FAILED — a recovery path regressed "
+              "(docs/resilience.md)", file=sys.stderr)
+        return 1
+    print("chaos-smoke: OK — injected faults fired and every recovery "
+          "path held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
