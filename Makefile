@@ -16,7 +16,8 @@ TESTSRC  := src/mxtpu/tests/test_native.cc
 BUILD    := build
 
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
-	telemetry-smoke pipeline-smoke chaos-smoke lint-hybrid ci clean
+	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke lint-hybrid \
+	ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -87,6 +88,14 @@ chaos-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		python tools/chaos_smoke.py
 
+warmup-smoke:
+	# persistent-compile-cache gate: the same LeNet workload in two fresh
+	# processes sharing one cache dir; fails unless the warm process
+	# compiles in <= 50% of the cold wall time with persistent-cache
+	# hits > 0 (docs/jit.md)
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		python tools/warmup_smoke.py
+
 lint-hybrid:
 	# hybridize-safety static analysis (docs/analysis.md). The committed
 	# baseline makes legacy suppressions explicit; NEW violations fail.
@@ -96,7 +105,7 @@ lint-hybrid:
 		mxnet_tpu example benchmark
 
 ci: native native-test asan tsan lint-hybrid test test-slow telemetry-smoke \
-	pipeline-smoke chaos-smoke
+	pipeline-smoke chaos-smoke warmup-smoke
 
 clean:
 	rm -rf $(BUILD)

@@ -88,6 +88,7 @@ from . import misc
 from . import _ffi
 from . import contrib
 from . import parallel
+from . import jit
 from . import resilience
 from . import test_utils
 

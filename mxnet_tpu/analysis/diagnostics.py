@@ -139,6 +139,16 @@ RULES: Dict[str, tuple] = {
         "compile, so steady-state throughput never materializes",
         "pad/bucket the offending argument to a fixed set of shapes "
         "(see the diagnostic for which input slot varies)"),
+    "J002": (
+        "shape-churn-storm",
+        "a block keeps compiling a NEW jit signature every few calls "
+        "with no ShapeBucketer attached — the shape distribution is "
+        "churning (seq-len stream, partial batches) and the compile "
+        "cost recurs forever instead of amortizing",
+        "attach hybridize(bucketer=mx.jit.ShapeBucketer({axis: "
+        "buckets})) or DataLoader(bucket_spec=...) so drifting shapes "
+        "pad to a bounded bucket set (at most len(buckets) compiles; "
+        "docs/jit.md)"),
     # -- tool errors --------------------------------------------------------
     "X000": (
         "analysis-error",

@@ -94,6 +94,15 @@ class DataLoader:
       C-contiguous buffers on the prefetch thread before transfer.
     * ``close()`` / ``with DataLoader(...) as loader:`` reclaim the
       worker pool deterministically instead of waiting for ``__del__``.
+    * ``bucket_spec=`` routes every batch through a
+      :class:`mxnet_tpu.jit.ShapeBucketer` (or a spec dict, e.g.
+      ``{1: ("pow2", 8, 64)}`` for a seq-len stream): batches are padded
+      **host-side** (numpy, before prefetch/H2D) up to the nearest
+      bucket and the loader yields ``(*batch, mask)`` where ``mask`` is
+      the boolean validity mask — mask your loss with it.  An axis-0
+      bucket at ``batch_size`` is added automatically, so the
+      ``last_batch='keep'`` partial tail pads to a full batch instead of
+      compiling a fresh XLA program every epoch (docs/jit.md).
     """
 
     def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
@@ -105,7 +114,7 @@ class DataLoader:
                  pin_device_id: int = 0, prefetch: Optional[int] = None,
                  thread_pool: bool = False, timeout: int = 120,
                  try_nopython: Optional[bool] = None,
-                 prefetch_to_device=None):
+                 prefetch_to_device=None, bucket_spec=None):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -132,6 +141,20 @@ class DataLoader:
         self._pin_memory = bool(pin_memory)
         self._prefetch_to_device = prefetch_to_device
         self._prefetcher = None
+        self._bucketer = None
+        if bucket_spec is not None:
+            from ...jit.bucketing import ShapeBucketer
+
+            if isinstance(bucket_spec, ShapeBucketer):
+                self._bucketer = bucket_spec  # explicit: respected as-is
+            else:
+                spec = dict(bucket_spec)
+                if 0 not in spec and batch_size is not None:
+                    # partial tails (last_batch='keep') must land on a
+                    # bucket too, or every epoch tail compiles a fresh
+                    # program — the exact stall bucketing exists to kill
+                    spec[0] = [batch_size]
+                self._bucketer = ShapeBucketer(spec)
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -185,7 +208,10 @@ class DataLoader:
             if self._batchify_fn is not None:
                 batchify = self._batchify_fn
             else:
-                batchify = (default_batchify_fn if to_device
+                # a bucketer pads in numpy — keep the batch host-side
+                # until after padding, device conversion happens at yield
+                batchify = (default_batchify_fn
+                            if to_device and self._bucketer is None
                             else default_mp_batchify_fn)
             for indices in self._batch_sampler:
                 # same fault seam as _worker_fn, inline flavor
@@ -201,6 +227,7 @@ class DataLoader:
                     _tel.inc("dataloader.batches")
                 else:
                     batch = batchify([self._dataset[i] for i in indices])
+                batch = self._maybe_pad(batch)
                 yield _to_device(batch) if to_device else batch
             return
 
@@ -228,7 +255,23 @@ class DataLoader:
                 _tel.inc("dataloader.batches")
             else:
                 res = pending.pop(0).get(self._timeout)
+            res = self._maybe_pad(res)
             yield _to_device(res) if to_device else res
+
+    def _maybe_pad(self, batch):
+        """Route a host batch through the bucketer (``bucket_spec``):
+        pad every leaf to its bucket and append the validity mask —
+        the loader then yields ``(*batch, mask)``.  Padding is pure
+        numpy, paid before prefetch/H2D so the device only ever sees
+        bucket shapes."""
+        if self._bucketer is None:
+            return batch
+        padded, mask = self._bucketer.pad_batch(batch)
+        if _tel._ENABLED and not mask.all():
+            _tel.inc("dataloader.padded_batches")
+        if not isinstance(padded, tuple):
+            padded = (padded,)
+        return padded + (mask,)
 
     def close(self):
         """Reclaim resources deterministically: stop the device-prefetch

@@ -13,7 +13,12 @@ only ever pays a queue pop for a batch whose buffers are already (or
 nearly) resident.
 
 ``DataLoader(prefetch_to_device=...)`` composes this automatically; use
-the class directly to wrap custom iterators.  Placement accepts:
+the class directly to wrap custom iterators.  A bucketed loader
+(``bucket_spec=``, docs/jit.md) pads batches **before** this seam, so
+the prefetch thread only ever transfers bucket shapes and the appended
+validity mask rides along as one more (tiny, replicated) leaf — the
+consumer's jit signature set stays bounded end to end.  Placement
+accepts:
 
   * ``True``                — default device, unsharded
   * a :class:`~mxnet_tpu.context.Context`

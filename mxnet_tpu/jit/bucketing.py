@@ -1,0 +1,288 @@
+"""Shape bucketing (mx.jit.ShapeBucketer).
+
+XLA compiles one executable per input-shape signature, so a
+variable-shape workload — a seq-len stream, ``last_batch='keep'``
+partial batches — retriggers compilation mid-run (the J001/J002 retrace
+storms).  The reference framework solved this with BucketingModule: a
+bounded set of bucket shapes, every input padded up to the nearest
+bucket.  :class:`ShapeBucketer` is the TPU-native version of that
+policy, shared by both seams:
+
+  * ``DataLoader(bucket_spec=...)`` pads batches host-side (numpy)
+    before prefetch and appends a validity mask;
+  * ``net.hybridize(bucketer=...)`` pads eager callers' inputs inside
+    ``_CachedOp`` and slices outputs back, so drifting shapes hit a
+    bounded signature set — at most ``len(buckets)`` compiles.
+
+A spec maps axis -> bucketing policy:
+
+  ``{0: [32, 64]}``          explicit bucket sizes (sorted ascending)
+  ``{1: "pow2"}``            round up to the next power of two
+  ``{1: ("pow2", 8, 64)}``   bounded pow2 (lo, hi) — enumerable
+  ``{1: ("linear", 16)}``    round up to a multiple of 16
+  ``{1: ("linear", 16, 16, 128)}``  bounded linear — enumerable
+
+Padding uses ``pad_value`` (default 0) and every :meth:`pad` /
+:meth:`pad_batch` returns a boolean validity mask shaped to broadcast
+against the padded array (size 1 on non-bucketed axes), so a masked
+loss/metric reproduces the unpadded computation exactly — for
+per-sample / per-token models.  Ops that couple samples (BatchNorm in
+training mode, cross-sample reductions) see the padded rows in their
+batch statistics, which no output mask can undo; see the caveat in
+docs/jit.md.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _onp
+
+from ..base import MXNetError
+
+__all__ = ["ShapeBucketer"]
+
+
+class _Policy:
+    """One axis's bucketing rule."""
+
+    __slots__ = ("kind", "buckets", "step", "lo", "hi")
+
+    def __init__(self, raw):
+        self.buckets: Optional[Tuple[int, ...]] = None
+        self.step = self.lo = self.hi = None
+        if isinstance(raw, (list, tuple)) and raw and \
+                all(isinstance(b, int) for b in raw):
+            bs = tuple(sorted(set(int(b) for b in raw)))
+            if any(b <= 0 for b in bs):
+                raise MXNetError(f"bucket sizes must be positive: {raw}")
+            self.kind, self.buckets = "explicit", bs
+            return
+        if raw == "pow2":
+            self.kind = "pow2"
+            return
+        if isinstance(raw, tuple) and len(raw) == 3 and raw[0] == "pow2":
+            self.kind, self.lo, self.hi = "pow2", int(raw[1]), int(raw[2])
+            self._align_lo(raw)
+            return
+        if isinstance(raw, tuple) and raw and raw[0] == "linear":
+            if len(raw) == 2:
+                self.kind, self.step = "linear", int(raw[1])
+            elif len(raw) == 4:
+                self.kind, self.step = "linear", int(raw[1])
+                self.lo, self.hi = int(raw[2]), int(raw[3])
+            else:
+                raise MXNetError(
+                    f"linear policy is ('linear', step[, lo, hi]): {raw!r}")
+            if self.step <= 0:
+                raise MXNetError(f"linear step must be positive: {raw!r}")
+            self._align_lo(raw)
+            return
+        raise MXNetError(
+            f"invalid bucket policy {raw!r}: expected a list of sizes, "
+            "'pow2', ('pow2', lo, hi), or ('linear', step[, lo, hi])")
+
+    def _align_lo(self, raw):
+        """Snap a bounded policy's ``lo`` up onto its own grid (the next
+        power of two / multiple of step).  ``bucket()`` clamps to ``lo``
+        and ``enumerate()`` walks the grid — an off-grid ``lo`` would
+        make them disagree, so the AOT warmup grid (``expand``) would
+        miss bucket shapes real calls produce and compile mid-run."""
+        if self.lo is None:
+            return
+        if self.kind == "pow2":
+            b = 1
+            while b < self.lo:
+                b <<= 1
+            self.lo = b
+        else:
+            self.lo = -(-self.lo // self.step) * self.step
+        if self.hi is not None and self.lo > self.hi:
+            raise MXNetError(
+                f"bucket policy {raw!r} has no buckets: lo rounds up to "
+                f"{self.lo} on the {self.kind} grid, above hi={self.hi}")
+
+    def bucket(self, size: int) -> int:
+        """Smallest bucket >= size."""
+        if self.kind == "explicit":
+            for b in self.buckets:
+                if size <= b:
+                    return b
+            raise MXNetError(
+                f"size {size} exceeds the largest explicit bucket "
+                f"{self.buckets[-1]}; add a larger bucket")
+        if self.kind == "pow2":
+            b = 1
+            while b < size:
+                b <<= 1
+            if self.lo is not None:
+                b = max(b, self.lo)
+            if self.hi is not None and b > self.hi:
+                raise MXNetError(
+                    f"size {size} exceeds pow2 bucket bound {self.hi}")
+            return b
+        # linear
+        b = ((size + self.step - 1) // self.step) * self.step
+        if self.lo is not None:
+            b = max(b, self.lo)
+        if self.hi is not None and b > self.hi:
+            raise MXNetError(
+                f"size {size} exceeds linear bucket bound {self.hi}")
+        return b
+
+    def enumerate(self) -> Optional[List[int]]:
+        """All bucket sizes, or None when the policy is unbounded."""
+        if self.kind == "explicit":
+            return list(self.buckets)
+        if self.lo is None or self.hi is None:
+            return None
+        if self.kind == "pow2":
+            out, b = [], 1
+            while b < self.lo:
+                b <<= 1
+            while b <= self.hi:
+                out.append(b)
+                b <<= 1
+            return out
+        return list(range(self.lo, self.hi + 1, self.step))
+
+
+class ShapeBucketer:
+    """Pad inputs up to a bounded set of bucket shapes (module docstring).
+
+    Parameters
+    ----------
+    spec : dict axis -> policy (see module docstring)
+    pad_value : fill for padded regions (cast to each leaf's dtype)
+    """
+
+    def __init__(self, spec: Dict[int, Any], pad_value=0):
+        if not isinstance(spec, dict) or not spec:
+            raise MXNetError(
+                f"bucket spec must be a non-empty dict axis -> policy, "
+                f"got {spec!r}")
+        self.spec: Dict[int, _Policy] = {}
+        for axis, raw in spec.items():
+            if not isinstance(axis, int) or axis < 0:
+                raise MXNetError(f"bucket axes must be ints >= 0: {axis!r}")
+            self.spec[axis] = _Policy(raw)
+        self.pad_value = pad_value
+
+    # -- shape algebra ------------------------------------------------------
+    def axes(self) -> List[int]:
+        return sorted(self.spec)
+
+    def bucket_shape(self, shape: Sequence[int]) -> Tuple[int, ...]:
+        """The bucketed version of ``shape`` (axes beyond ndim ignored)."""
+        out = list(shape)
+        for axis, pol in self.spec.items():
+            if axis < len(out):
+                out[axis] = pol.bucket(out[axis])
+        return tuple(out)
+
+    def expand(self, shape: Sequence[int]) -> List[Tuple[int, ...]]:
+        """Every bucket combination reachable from ``shape`` — the AOT
+        warmup grid.  Bounded policies enumerate fully; an unbounded
+        policy contributes only ``shape``'s own bucket (warn-free
+        degradation: warmup still covers the observed shape)."""
+        per_axis: List[Tuple[int, List[int]]] = []
+        for axis, pol in sorted(self.spec.items()):
+            if axis >= len(shape):
+                continue
+            sizes = pol.enumerate()
+            if sizes is None:
+                sizes = [pol.bucket(shape[axis])]
+            per_axis.append((axis, sizes))
+        if not per_axis:
+            return [tuple(shape)]
+        shapes = []
+        for combo in itertools.product(*(sizes for _, sizes in per_axis)):
+            s = list(shape)
+            for (axis, _), size in zip(per_axis, combo):
+                s[axis] = size
+            shapes.append(tuple(s))
+        return shapes
+
+    def n_buckets(self, shape: Sequence[int]) -> int:
+        return len(self.expand(shape))
+
+    # -- host-side padding --------------------------------------------------
+    def _pad_np(self, arr: _onp.ndarray) -> _onp.ndarray:
+        """Pad one numpy leaf to its bucket shape — no copy when already
+        at a bucket boundary."""
+        target = self.bucket_shape(arr.shape)
+        if tuple(arr.shape) == target:
+            return arr
+        widths = [(0, t - s) for s, t in zip(arr.shape, target)]
+        return _onp.pad(arr, widths, mode="constant",
+                        constant_values=self.pad_value)
+
+    def mask_for(self, orig_shape: Sequence[int]) -> _onp.ndarray:
+        """Boolean validity mask for a leaf of ``orig_shape`` after
+        padding: True where original data lives.  Shaped with the padded
+        size on bucketed axes and size 1 elsewhere, with rank truncated
+        at the last bucketed axis — ``(B_pad,)`` for batch padding,
+        ``(B_pad, T_pad)`` for batch+seq bucketing — so it aligns
+        positionally with per-sample / per-token losses.  Use
+        ``mask[..., None]`` style expansion to weight higher-rank
+        tensors."""
+        active = [a for a in self.spec if a < len(orig_shape)]
+        if not active:
+            return _onp.ones((), dtype=bool)
+        target = self.bucket_shape(orig_shape)
+        rank = max(active) + 1
+        mshape = [1] * rank
+        for a in active:
+            mshape[a] = target[a]
+        mask = _onp.zeros(tuple(mshape), dtype=bool)
+        sl = [slice(None)] * rank
+        for a in active:
+            sl[a] = slice(0, orig_shape[a])
+        mask[tuple(sl)] = True
+        return mask
+
+    def pad(self, arr) -> Tuple[_onp.ndarray, _onp.ndarray]:
+        """Pad one array (numpy or NDArray) to its bucket; returns
+        ``(padded, mask)`` with ``mask`` per :meth:`mask_for`."""
+        np_arr = arr.asnumpy() if hasattr(arr, "asnumpy") else \
+            _onp.asarray(arr)
+        return self._pad_np(np_arr), self.mask_for(np_arr.shape)
+
+    def pad_batch(self, batch):
+        """Pad a host batch (array or tuple tree of arrays) and return
+        ``(padded_batch, mask)``.
+
+        Every array leaf is padded on the spec's axes that exist for its
+        rank (so with ``{0: [32]}`` both a ``(17, 28, 28)`` image block
+        and its ``(17,)`` label vector pad to 32 rows).  The mask comes
+        from the highest-rank leaf — the data leaf by convention — and
+        broadcasts against per-sample losses."""
+        leaves_shape: List[Sequence[int]] = []
+
+        def rec(b):
+            if isinstance(b, (tuple, list)):
+                return tuple(rec(x) for x in b)
+            np_arr = b.asnumpy() if hasattr(b, "asnumpy") else \
+                _onp.asarray(b)
+            leaves_shape.append(np_arr.shape)
+            return self._pad_np(np_arr)
+
+        padded = rec(batch)
+        if not leaves_shape:
+            raise MXNetError("pad_batch: batch contains no array leaves")
+        ref = max(leaves_shape, key=len)
+        return padded, self.mask_for(ref)
+
+    def __repr__(self):
+        parts = []
+        for axis, pol in sorted(self.spec.items()):
+            if pol.kind == "explicit":
+                parts.append(f"{axis}: {list(pol.buckets)}")
+            elif pol.lo is not None:
+                extra = f", step={pol.step}" if pol.step else ""
+                parts.append(
+                    f"{axis}: {pol.kind}[{pol.lo}..{pol.hi}{extra}]")
+            else:
+                extra = f"(step={pol.step})" if pol.step else ""
+                parts.append(f"{axis}: {pol.kind}{extra}")
+        return f"ShapeBucketer({{{', '.join(parts)}}})"

@@ -1,0 +1,153 @@
+"""Persistent XLA compilation cache (mx.jit.cache).
+
+Every BENCH row pays 17-60s of warmup before its first timed step, and
+on a TPU relay the same graphs have been observed compiling for 10-25
+*minutes* — all of it re-paid by every fresh process.  JAX ships an
+on-disk compilation cache (serialized executables keyed by a hash of
+the HLO + compile options + jaxlib version); this module owns its
+lifecycle for the framework so a second process of the same model
+skips XLA entirely:
+
+  * ``MXNET_COMPILE_CACHE_DIR``   cache directory
+    (default ``~/.mxnet/jit_cache``; ``MXNET_HOME`` honored)
+  * ``MXNET_COMPILE_CACHE=0``     disable the persistent cache
+  * ``MXNET_COMPILE_CACHE_MIN_COMPILE_SECS``  only persist executables
+    whose compile took at least this long (default 0.0: persist all —
+    disk is cheap, recompile stalls are not)
+
+Initialization is **lazy**: nothing touches jax config until the first
+``_CachedOp`` / ``make_train_step`` compile calls :func:`ensure_cache`.
+An explicitly configured jax cache (``JAX_COMPILATION_CACHE_DIR`` env
+or ``jax.config.update("jax_compilation_cache_dir", ...)``) is
+respected and never overridden — we only install the hit listener.
+
+jax memoizes "cache disabled" at the first compile of the process
+(``compilation_cache._cache_checked``), and eager-op dispatch compiles
+tiny programs long before the first hybridize; :func:`ensure_cache`
+therefore calls ``compilation_cache.reset_cache()`` after pointing the
+config at our directory, so the next compile re-reads the config.
+
+Telemetry: a ``jax.monitoring`` listener ticks
+``hybridize.persistent_cache_hits`` whenever an executable is served
+from disk instead of compiled — together with
+``hybridize.cache_misses`` this splits every miss into *cold compile*
+(misses - persistent hits) vs *persistent hit* (trace + deserialize,
+no XLA).  ``hybridize.compile_seconds`` keeps timing both, so the
+cache's win is visible as the timer's total collapsing while the
+counter still ticks.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from .. import telemetry as _tel
+from ..base import get_env
+
+__all__ = ["cache_dir", "enabled", "ensure_cache", "is_active", "reset"]
+
+_LOCK = threading.Lock()
+_STATE = {"initialized": False, "active_dir": None, "listener": False}
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+
+def enabled() -> bool:
+    """Whether the persistent cache is enabled (``MXNET_COMPILE_CACHE``)."""
+    return bool(get_env("MXNET_COMPILE_CACHE", 1, int))
+
+
+def cache_dir() -> str:
+    """Resolved cache directory (not created until :func:`ensure_cache`)."""
+    d = os.environ.get("MXNET_COMPILE_CACHE_DIR")
+    if d:
+        return os.path.expanduser(d)
+    from ..base import data_dir
+
+    try:
+        home = data_dir()
+    except Exception:
+        home = os.path.expanduser(os.path.join("~", ".mxnet"))
+    return os.path.join(home, "jit_cache")
+
+
+def is_active() -> bool:
+    """True once :func:`ensure_cache` has armed the cache this process."""
+    return _STATE["initialized"] and _STATE["active_dir"] is not None
+
+
+def _on_event(name: str, **kwargs):
+    if name == _HIT_EVENT and _STATE["active_dir"] is not None:
+        _tel.inc("hybridize.persistent_cache_hits")
+
+
+def _install_listener():
+    if _STATE["listener"]:
+        return
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        _STATE["listener"] = True
+    except Exception:
+        # monitoring internals moved: the cache still works, only the
+        # hit split degrades — never fail a compile over a counter
+        pass
+
+
+def ensure_cache() -> Optional[str]:
+    """Arm the persistent compilation cache (idempotent, thread-safe).
+
+    Returns the directory in effect, or ``None`` when disabled.  Called
+    by ``_CachedOp`` and ``make_train_step`` right before their first
+    ``jax.jit`` is built; safe to call eagerly (e.g. from tools).
+    """
+    with _LOCK:
+        if _STATE["initialized"]:
+            return _STATE["active_dir"]
+        _STATE["initialized"] = True
+        if not enabled():
+            return None
+        try:
+            import jax
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            configured = os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
+                jax.config.jax_compilation_cache_dir
+            if configured:
+                # the user already routed jax's cache — respect it
+                _STATE["active_dir"] = configured
+                _install_listener()
+                return configured
+            d = cache_dir()
+            os.makedirs(d, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", d)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                get_env("MXNET_COMPILE_CACHE_MIN_COMPILE_SECS", 0.0, float))
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            # eager dispatch compiled tiny programs before we got here and
+            # jax memoized "no cache" at that first compile — reset so the
+            # next compile re-reads the config and opens our directory
+            _cc.reset_cache()
+            _STATE["active_dir"] = d
+            _install_listener()
+            return d
+        except OSError:
+            # unwritable cache dir (read-only HOME, quota): degrade to
+            # uncached compiles rather than failing the model
+            _STATE["active_dir"] = None
+            return None
+        except Exception:
+            _STATE["active_dir"] = None
+            return None
+
+
+def reset():
+    """Forget this process's init state (tests).  Does not clear disk."""
+    with _LOCK:
+        _STATE["initialized"] = False
+        _STATE["active_dir"] = None

@@ -20,6 +20,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import engine as _engine
 from .. import telemetry as _tel
 from ..base import MXNetError
+from ..gluon import block as _blk
+from ..jit import cache as _jit_cache
 from ..ndarray.ndarray import NDArray, _mutation_scope
 from .. import autograd as _autograd
 
@@ -58,13 +60,16 @@ def shard_params(net, mesh: Mesh, spec_fn: Callable = replicated_spec_fn):
     names = sorted(params)
     specs = []
     vals = []
-    for n in names:
-        v = params[n].data()._data
-        spec = spec_fn(n, v.shape)
-        sharded = jax.device_put(v, NamedSharding(mesh, spec))
-        params[n].data()._set_data(sharded)
-        specs.append(spec)
-        vals.append(sharded)
+    # under the trace guard: placing params while a background warmup
+    # trace has them swapped to tracers would device_put a tracer
+    with _blk.trace_guard():
+        for n in names:
+            v = params[n].data()._data
+            spec = spec_fn(n, v.shape)
+            sharded = jax.device_put(v, NamedSharding(mesh, spec))
+            params[n].data()._set_data(sharded)
+            specs.append(spec)
+            vals.append(sharded)
     return names, vals, specs
 
 
@@ -75,7 +80,10 @@ def _functional_apply(net, names: List[str], training: bool):
     from ..random import key_holder
 
     params = net.collect_params()
-    arrs = [params[n].data() for n in names] + [key_holder()]
+    # state capture under the trace guard: a concurrent background
+    # warmup trace (gluon.block) has these arrays swapped to tracers
+    with _blk.trace_guard():
+        arrs = [params[n].data() for n in names] + [key_holder()]
     holder: Dict[str, Any] = {}
 
     def fn(pvals, *xs):
@@ -460,6 +468,20 @@ def make_train_step(net, loss_fn, names: List[str],
             mutated = [wsc(m, s) for m, s in zip(mutated, ash)]
         return new_p, mutated, new_state, scale_state, loss
 
+    # arm the persistent compilation cache before the step jits exist —
+    # their (long) XLA compiles must be able to hit/fill the on-disk
+    # cache so a second process of the same model skips XLA entirely
+    cache_armed = _jit_cache.ensure_cache() is not None
+    if donate and cache_armed and jax.default_backend() == "cpu":
+        # XLA:CPU corrupts donated buffers when the executable comes
+        # back DESERIALIZED from the persistent cache: the stored
+        # input-output aliasing is mishandled, and a resumed trainer's
+        # params silently fill with garbage on its second step
+        # (reproduced on jax 0.4.37: save_states → load_states → step;
+        # tests/test_jit.py::test_resume_with_persistent_cache_*).
+        # TPU executables round-trip aliasing correctly, so only the
+        # CPU backend trades donation's buffer reuse for correctness.
+        donate = False
     jitted = jax.jit(step, donate_argnums=(0, 3) if donate else ())
     grad_fn = jax.jit(compute_grads)
     apply_fn = jax.jit(apply_update, donate_argnums=(0, 1) if donate else ())
@@ -534,6 +556,10 @@ class ShardedTrainer:
         self._micro = 0
         self._dynamic_scaling = compute_dtype is not None and \
             jnp.dtype(compute_dtype) == jnp.float16
+        # AOT-compiled step executables (compile()): slot -> (batch
+        # signature | None, jax compiled).  _step dispatches straight to
+        # a matching executable — no trace, no XLA, no first-step stall.
+        self._aot: Dict[str, Tuple[Optional[tuple], Any]] = {}
         self._scale_state = (
             jnp.float32(init_loss_scale if self._dynamic_scaling else 1.0),
             jnp.int32(0))
@@ -543,7 +569,8 @@ class ShardedTrainer:
         self._inflight = _engine.InflightQueue(max_inflight)
         from ..random import key_holder
 
-        self._key = key_holder()._data
+        with _blk.trace_guard():
+            self._key = key_holder()._data
 
     # -- lr -----------------------------------------------------------------
     @property
@@ -582,6 +609,18 @@ class ShardedTrainer:
         spec = self._batch_spec
         if getattr(v, "ndim", 1) < len(spec):
             spec = P(*spec[:v.ndim])
+        if any(s is not None for s in spec):
+            # replicate SIZE-1 axes instead of sharding them — bucket
+            # validity masks are size 1 on non-bucketed axes (e.g. a
+            # (1, T) seq mask under batch_spec P('dp')), and a hard
+            # error there would make every bucketed pipeline multi-chip
+            # hostile.  Size-1 replication is exactly what the mask's
+            # broadcast semantics want.  Any OTHER non-divisible axis
+            # (a misconfigured batch size) still errors loudly in
+            # device_put — silently replicating a real batch would hide
+            # the config bug behind 8x redundant compute.
+            spec = P(*(None if v.shape[i] == 1 else s
+                       for i, s in enumerate(spec)))
         sharding = NamedSharding(self.mesh, spec)
         if isinstance(v, jax.Array) and v.sharding == sharding:
             # already placed (the DevicePrefetcher path): no relayout, no
@@ -601,6 +640,98 @@ class ShardedTrainer:
         batches arrive pre-sharded and ``step`` skips its own put."""
         return self._put(batch)
 
+    # -- AOT warmup (docs/jit.md) -------------------------------------------
+    @staticmethod
+    def _batch_sig(xb, yb) -> tuple:
+        def leaf(v):
+            if isinstance(v, (tuple, list)):
+                return tuple(leaf(e) for e in v)
+            return (tuple(v.shape), str(v.dtype))
+
+        return (leaf(xb), leaf(yb))
+
+    def _aot_fn(self, slot: str, xb=None, yb=None):
+        ent = self._aot.get(slot)
+        if ent is None:
+            return None
+        sig, compiled = ent
+        if sig is not None and sig != self._batch_sig(xb, yb):
+            return None  # different batch shapes: fall back to the jit path
+        return compiled
+
+    def compile(self, batch, background: bool = False):
+        """AOT-compile the SPMD step for a sample ``(x, y)`` batch via
+        ``jit.lower(...).compile()`` — the first real ``step()`` with
+        matching batch shapes then dispatches straight to the stored
+        executable: no trace, no XLA compile, steady-state speed from
+        step one.  With the persistent cache armed (mx.jit.cache) the
+        lowered compile itself is a disk hit on any later process.
+
+        ``lower()`` only needs shapes, so ``batch`` can be the first
+        real batch or zeros; nothing executes and no buffer is donated.
+        With ``grad_accum > 1`` the grad and apply executables compile
+        instead of the fused step.  ``background=True`` compiles on a
+        daemon thread (overlap with data-pipeline start) and returns a
+        :class:`~mxnet_tpu.gluon.block.WarmupHandle`; call ``wait()``
+        before timing.  Returns the number of executables compiled."""
+        from ..gluon.block import WarmupHandle
+
+        if not isinstance(batch, (tuple, list)) or len(batch) != 2:
+            raise MXNetError("compile() takes a sample (x, y) batch")
+        xb, yb = self._put(batch[0]), self._put(batch[1])
+        lr = jnp.float32(self.learning_rate)
+
+        def timed_compile(lowered):
+            t0 = _time.perf_counter()
+            compiled = lowered.compile()
+            if _tel._ENABLED:
+                _tel.observe("hybridize.compile_seconds",
+                             _time.perf_counter() - t0)
+                _tel.inc("hybridize.warmup_compiles")
+            return compiled
+
+        def run():
+            n = 0
+            with _tel.timer("jit.warmup_seconds"):
+                sig = self._batch_sig(xb, yb)
+                if self.grad_accum <= 1:
+                    if self._aot_fn("step", xb, yb) is None:
+                        # lower() traces the functional step (state swap
+                        # — trace guard); compile() is pure XLA and runs
+                        # outside the lock so stepping/readers overlap it
+                        with _blk.trace_guard():
+                            lowered = self._step_fn.lower(
+                                self.pvals, self.avals, self._key,
+                                self.opt_state, self._t + 1, lr,
+                                self._scale_state, xb, yb)
+                        self._aot["step"] = (sig, timed_compile(lowered))
+                        n += 1
+                else:
+                    if self._aot_fn("grad", xb, yb) is None:
+                        with _blk.trace_guard():
+                            lowered = self._grad_fn.lower(
+                                self.pvals, self.avals, self._key,
+                                self._scale_state[0], xb, yb)
+                        self._aot["grad"] = (sig, timed_compile(lowered))
+                        n += 1
+                    if self._aot_fn("apply") is None:
+                        # grads are always fp32 with the params' shapes
+                        # and placements (compute_grads)
+                        gspec = [jax.ShapeDtypeStruct(
+                            p.shape, jnp.float32, sharding=p.sharding)
+                            for p in self.pvals]
+                        with _blk.trace_guard():
+                            lowered = self._apply_fn.lower(
+                                self.pvals, self.opt_state, self._t + 1,
+                                lr, self._scale_state, gspec)
+                        self._aot["apply"] = (None, timed_compile(lowered))
+                        n += 1
+            return n
+
+        if background:
+            return WarmupHandle(run)
+        return run()
+
     def _write_back_params(self):
         params = self._params
         for n, v in zip(self.train_names, self.pvals):
@@ -608,14 +739,18 @@ class ShardedTrainer:
 
     def _write_back(self, mutated):
         params = self._params
-        self._write_back_params()
-        refs = self._holder.get("mutated_refs", [])
-        for a, v in zip(refs, mutated):
-            a._set_data(v)
-        self.avals = [params[n].data()._data for n in self.aux_names]
         from ..random import key_holder
 
-        self._key = key_holder()._data
+        # under the trace guard: a background warmup trace of this net
+        # would otherwise hand us tracers for aux state / the RNG key,
+        # and our _set_data writes would race its save/restore
+        with _blk.trace_guard():
+            self._write_back_params()
+            refs = self._holder.get("mutated_refs", [])
+            for a, v in zip(refs, mutated):
+                a._set_data(v)
+            self.avals = [params[n].data()._data for n in self.aux_names]
+            self._key = key_holder()._data
 
     def step(self, x, y, block: bool = False):
         """One SPMD step.  By default the loss comes back as a LAZY
@@ -649,15 +784,23 @@ class ShardedTrainer:
         call traced + XLA-compiled synchronously, so book that wall time
         under the same compile timer the hybridize cache uses — one
         metric answers "how much of this run was compilation" for both
-        paths, including per-shape recompiles and the grad-accum fns."""
+        paths, including per-shape recompiles and the grad-accum fns.
+
+        Runs under the global trace guard: a first call traces the
+        functional step, which swaps shared Parameter ._data / the RNG
+        key to tracers (_functional_apply), and that swap must not
+        interleave with a background warmup trace or its readers."""
         if not _tel._ENABLED:
-            return fn(*args)
+            with _blk.trace_guard():
+                return fn(*args)
         cache_size = getattr(fn, "_cache_size", None)
         if cache_size is None:  # jit internals changed: skip attribution
-            return fn(*args)
+            with _blk.trace_guard():
+                return fn(*args)
         n0 = cache_size()
         t0 = _time.perf_counter()
-        out = fn(*args)
+        with _blk.trace_guard():
+            out = fn(*args)
         if cache_size() > n0:
             _tel.observe("hybridize.compile_seconds",
                          _time.perf_counter() - t0)
@@ -671,19 +814,33 @@ class ShardedTrainer:
             # the eager Optimizer path (optimizer/__init__.py _update_count
             # before _get_lr)
             lr = jnp.float32(self.learning_rate)
-            (self.pvals, mutated, self.opt_state, self._scale_state,
-             loss) = self._jit_call(self._step_fn, self.pvals, self.avals,
-                                    self._key, self.opt_state, self._t, lr,
-                                    self._scale_state, xb, yb)
+            aot = self._aot_fn("step", xb, yb) if self._aot else None
+            if aot is not None:
+                (self.pvals, mutated, self.opt_state, self._scale_state,
+                 loss) = aot(self.pvals, self.avals, self._key,
+                             self.opt_state, self._t, lr,
+                             self._scale_state, xb, yb)
+            else:
+                (self.pvals, mutated, self.opt_state, self._scale_state,
+                 loss) = self._jit_call(self._step_fn, self.pvals,
+                                        self.avals, self._key,
+                                        self.opt_state, self._t, lr,
+                                        self._scale_state, xb, yb)
             self._write_back(mutated)
             # the loss depends on the whole fwd+bwd+update, is never fed
             # back into a donating call, and is tiny — the one safe handle
             # to bound the dispatch queue on
             self._inflight.push(loss)
             return NDArray(loss)
-        grads, mutated, loss = self._jit_call(
-            self._grad_fn,
-            self.pvals, self.avals, self._key, self._scale_state[0], xb, yb)
+        aot = self._aot_fn("grad", xb, yb) if self._aot else None
+        if aot is not None:
+            grads, mutated, loss = aot(self.pvals, self.avals, self._key,
+                                       self._scale_state[0], xb, yb)
+        else:
+            grads, mutated, loss = self._jit_call(
+                self._grad_fn,
+                self.pvals, self.avals, self._key, self._scale_state[0],
+                xb, yb)
         self._accum = grads if self._accum is None else \
             [a + g for a, g in zip(self._accum, grads)]
         self._micro += 1
@@ -692,9 +849,16 @@ class ShardedTrainer:
             self._t += 1
             lr = jnp.float32(self.learning_rate)
             avg = [g / self.grad_accum for g in self._accum]
-            (self.pvals, self.opt_state, self._scale_state) = self._jit_call(
-                self._apply_fn, self.pvals, self.opt_state, self._t, lr,
-                self._scale_state, avg)
+            aot = self._aot_fn("apply") if self._aot else None
+            if aot is not None:
+                (self.pvals, self.opt_state, self._scale_state) = aot(
+                    self.pvals, self.opt_state, self._t, lr,
+                    self._scale_state, avg)
+            else:
+                (self.pvals, self.opt_state, self._scale_state) = \
+                    self._jit_call(
+                        self._apply_fn, self.pvals, self.opt_state,
+                        self._t, lr, self._scale_state, avg)
             self._accum, self._micro = None, 0
             self._write_back_params()
         # micro-step losses chain to the last apply through pvals, so

@@ -21,7 +21,13 @@ The registry is also the evidence layer for the resilience stack
 corrupt_skipped,save_failures}``), injected faults (``chaos.injected``
 and per-site counters), and bring-up retries (``dist.init_retries``,
 ``dist.deadline_exceeded``) all tick here, so "did the recovery path
-actually run" is an assertable fact, not a log grep.
+actually run" is an assertable fact, not a log grep.  The compile-cost
+stack (docs/jit.md) reports the same way: ``hybridize.cache_misses``
+split into cold XLA compiles vs ``hybridize.persistent_cache_hits``
+(on-disk cache, fed by a ``jax.monitoring`` listener),
+``hybridize.warmup_compiles``/``jit.warmup_seconds`` for AOT warmup,
+and ``dataloader.padded_batches`` for the bucketing seam — so "did the
+second process actually skip XLA" is a counter, not a hunch.
 
 Overhead contract: every instrumented call site guards on the single
 module flag ``_ENABLED`` (``MXNET_TELEMETRY=0`` disables), so a disabled

@@ -512,6 +512,129 @@ def test_retrace_telemetry_counter_ticks():
 
 
 # ---------------------------------------------------------------------------
+# shape-churn storm (J002): repro + clean twins
+# ---------------------------------------------------------------------------
+
+def test_shape_churn_storm_repro():
+    """Sustained churn — a new signature every call past the
+    MIN*EVERY floor — with no bucketer: J002 fires once, names the
+    churning argument slot, and ticks its counter."""
+    from mxnet_tpu import telemetry as tel
+
+    retrace.reset()
+    prev = retrace.set_churn_params(min_sigs=3, every=2)
+    prev_lim = retrace.set_limit(50)   # keep J001 out of the way
+    prev_en = tel.set_enabled(True)
+    tel.reset()
+    try:
+        net = mx.gluon.nn.Dense(4)
+        net.initialize()
+        net.hybridize()
+        for n in range(1, 10):   # first call warms up eagerly
+            net(mx.nd.array(onp.ones((n, 8), "f4")))
+        codes = [d.code for d in retrace.report()]
+        assert codes == ["J002"]
+        d = retrace.report()[0]
+        assert d.symbol == "Dense"
+        assert "argument leaf #0" in d.message
+        assert "bucketer" in d.message
+        snap = tel.snapshot()
+        assert snap.get("hybridize.shape_churn_warnings",
+                        {}).get("value") == 1
+        # fires once per block type, not per trace
+        net(mx.nd.array(onp.ones((20, 8), "f4")))
+        assert [d.code for d in retrace.report()] == ["J002"]
+    finally:
+        tel.reset()
+        tel.set_enabled(prev_en)
+        retrace.set_limit(prev_lim)
+        retrace.set_churn_params(*prev)
+        retrace.reset()
+
+
+def test_shape_churn_clean_twin_loader_bucketed_stream():
+    """A bounded bucket set discovered in the first calls (what a
+    DataLoader(bucket_spec=...) pipeline produces) then reused for many
+    more: traces stop before the sustained-churn floor — no J002 even
+    though the block itself has no bucketer attached."""
+    retrace.reset()
+    prev = retrace.set_churn_params(min_sigs=3, every=4)
+    try:
+        net = mx.gluon.nn.Dense(4)
+        net.initialize()
+        net.hybridize()
+        buckets = (8, 16, 32, 64)
+        for _ in range(10):
+            for b in buckets:     # all buckets appear in round 1
+                net(mx.nd.array(onp.ones((b, 8), "f4")))
+        assert retrace.report() == []
+    finally:
+        retrace.set_churn_params(*prev)
+        retrace.reset()
+
+
+def test_shape_churn_clean_twin_bucketed():
+    """Same drifting shapes with a bucketer attached: the signature set
+    is bounded by construction, so the guard stays silent."""
+    retrace.reset()
+    prev = retrace.set_churn_params(min_sigs=3, every=4)
+    try:
+        net = mx.gluon.nn.Dense(4)
+        net.initialize()
+        net.hybridize(bucketer={0: [4, 8]})
+        for n in (1, 2, 3, 4, 5, 6):
+            net(mx.nd.array(onp.ones((n, 8), "f4")))
+        assert retrace.report() == []
+        assert len(net._cached_op._traced) <= 2
+    finally:
+        retrace.set_churn_params(*prev)
+        retrace.reset()
+
+
+def test_shape_churn_clean_twin_stable_shapes():
+    """A bounded shape set below MXNET_SHAPE_CHURN_MIN, reused over many
+    calls: the distinct-signature count never reaches the threshold, so
+    no amount of traffic fires J002 (the min exists exactly so small
+    legitimate shape sets stay silent)."""
+    retrace.reset()
+    prev = retrace.set_churn_params(min_sigs=4, every=4)
+    try:
+        net = mx.gluon.nn.Dense(4)
+        net.initialize()
+        net.hybridize()
+        for _ in range(10):
+            for n in (2, 4, 6):
+                net(mx.nd.array(onp.ones((n, 8), "f4")))
+        assert [d.code for d in retrace.report()] == []
+    finally:
+        retrace.set_churn_params(*prev)
+        retrace.reset()
+
+
+def test_shape_churn_warmup_traces_exempt():
+    """warmup() sweeps compile many signatures deliberately (n_calls is
+    unreported); the churn rate must not count them."""
+    retrace.reset()
+    prev = retrace.set_churn_params(min_sigs=2, every=4)
+    try:
+        net = mx.gluon.nn.Dense(4)
+        net.initialize()
+        net(mx.np.ones((1, 8)))
+        net.hybridize()
+        net.warmup([(2, 8), (3, 8), (4, 8), (5, 8)])
+        assert retrace.report() == []
+    finally:
+        retrace.set_churn_params(*prev)
+        retrace.reset()
+
+
+def test_j002_in_rule_catalog():
+    assert "J002" in RULES
+    assert "shape-churn-storm" in mx.analysis.rule_doc("J002")
+    assert "bucket" in mx.analysis.rule_doc("J002")
+
+
+# ---------------------------------------------------------------------------
 # package surface
 # ---------------------------------------------------------------------------
 
