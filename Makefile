@@ -16,8 +16,8 @@ TESTSRC  := src/mxtpu/tests/test_native.cc
 BUILD    := build
 
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
-	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke lint-hybrid \
-	ci clean
+	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
+	lint-hybrid ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -96,6 +96,15 @@ warmup-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		python tools/warmup_smoke.py
 
+spmd-smoke:
+	# 2-D mesh ZeRO-1 gate: LeNet (8x1) zero1 must match replicated to
+	# few ULP over 20 steps with opt-state bytes/device <= replicated/dp
+	# x 1.1, and tiny-BERT must train mp=2 tensor-sharded + zero1 on a
+	# 4x2 mesh matching the replicated run (docs/sharding.md).  Serial —
+	# single-core box, never concurrent with tier-1.
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		python tools/spmd_smoke.py
+
 lint-hybrid:
 	# hybridize-safety static analysis (docs/analysis.md). The committed
 	# baseline makes legacy suppressions explicit; NEW violations fail.
@@ -105,7 +114,7 @@ lint-hybrid:
 		mxnet_tpu example benchmark
 
 ci: native native-test asan tsan lint-hybrid test test-slow telemetry-smoke \
-	pipeline-smoke chaos-smoke warmup-smoke
+	pipeline-smoke chaos-smoke warmup-smoke spmd-smoke
 
 clean:
 	rm -rf $(BUILD)

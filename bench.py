@@ -101,6 +101,19 @@ def _row_extras(on_tpu, full, cold, warm=None):
             "warmup_secs_warm": round(warm, 2) if warm is not None else None}
 
 
+def _trainer_cols(trainer):
+    """Sharding columns every BENCH/MULTICHIP row carries: the mesh
+    shape, the weight-update partition (select zero1 for a whole run via
+    MXNET_PARTITION=zero1 — ShardedTrainer's env default), and the
+    measured per-device optimizer-state bytes, so the ZeRO-1 memory win
+    lands in the perf trajectory even while headlines are banked
+    (docs/sharding.md)."""
+    return {"mesh_shape": dict(trainer.mesh.shape),
+            "partition": trainer.partition,
+            "opt_state_bytes_per_device":
+                trainer.opt_state_bytes_per_device}
+
+
 def _timed_warmup(make_trainer, x, y, n_steps=2):
     """Cold-vs-warm warmup measurement.
 
@@ -204,6 +217,7 @@ def bench_resnet50(on_tpu):
             "layout": layout, "dtype": dt if compute is not None else "fp32",
             "batch": batch,
             "mfu": round(mfu, 4) if mfu is not None else None,
+            **_trainer_cols(trainer),
             **_row_extras(on_tpu, full, cold, warm)}
 
 
@@ -266,6 +280,7 @@ def bench_bert_base(on_tpu):
     return {"metric": "bert_base_pretrain_samples_per_sec_per_chip",
             "value": round(batch * n_steps / secs, 2), "unit": "samples/sec",
             "vs_baseline": None, "seq_len": seq,
+            **_trainer_cols(trainer),
             **_row_extras(on_tpu, full, cold, warm)}
 
 
@@ -295,7 +310,8 @@ def bench_lenet(on_tpu):
     secs = _timed_raw_steps(trainer, x, y, n_steps)
     return {"metric": "lenet_train_imgs_per_sec_per_chip",
             "value": round(batch * n_steps / secs, 2), "unit": "images/sec",
-            "vs_baseline": None, **_row_extras(on_tpu, full, cold, warm)}
+            "vs_baseline": None, **_trainer_cols(trainer),
+            **_row_extras(on_tpu, full, cold, warm)}
 
 
 def bench_lstm_lm(on_tpu):
@@ -350,6 +366,7 @@ def bench_lstm_lm(on_tpu):
     return {"metric": "lstm_lm_tokens_per_sec_per_chip",
             "value": round(toks, 2), "unit": "tokens/sec",
             "vs_baseline": None, "samples_per_sec": round(toks / seq, 2),
+            **_trainer_cols(trainer),
             **_row_extras(on_tpu, full, cold, warm)}
 
 
@@ -419,6 +436,7 @@ def bench_ssd(on_tpu):
     return {"metric": "ssd_resnet50_train_imgs_per_sec_per_chip",
             "value": round(batch * n_steps / secs, 2), "unit": "images/sec",
             "vs_baseline": None, "image_size": image,
+            **_trainer_cols(trainer),
             **_row_extras(on_tpu, full, cold, warm)}
 
 
@@ -841,7 +859,7 @@ def _mc_measure(config, ndev, on_tpu):
         trainer.step(x, y)
     n_steps = 20 if on_tpu else 3
     dt = _timed_raw_steps(trainer, x, y, n_steps)
-    return batch * n_steps / dt / ndev, per
+    return batch * n_steps / dt / ndev, per, _trainer_cols(trainer)
 
 
 def _multichip_child(n):
@@ -856,13 +874,17 @@ def _multichip_child(n):
         return 1
     configs = {}
     for config in ("resnet", "bert"):
-        one, per = _mc_measure(config, 1, on_tpu)
-        many, _ = _mc_measure(config, n, on_tpu)
+        one, per, _cols1 = _mc_measure(config, 1, on_tpu)
+        many, _, cols = _mc_measure(config, n, on_tpu)
         configs[config] = {
             "per_device_batch": per,
             "ips_per_device_1dev": round(one, 2),
             "ips_per_device_ndev": round(many, 2),
-            "scaling_efficiency": round(many / one, 4)}
+            "scaling_efficiency": round(many / one, 4),
+            # sharding columns from the n-device run (docs/sharding.md):
+            # MXNET_PARTITION=zero1 turns the dp-replicated optimizer
+            # state into the sharded layout, measured here
+            **cols}
     # headline value: the weaker of the two efficiencies (a pod is only as
     # scalable as its worst headline model)
     eff = min(c["scaling_efficiency"] for c in configs.values())

@@ -13,6 +13,10 @@ Three layers, one diagnostic shape (``diagnostics.Diagnostic``):
 * :mod:`~mxnet_tpu.analysis.retrace` — retrace guard over the jit
   cache: J001 when one block's signature count grows past
   ``MXNET_RETRACE_WARN_LIMIT``, pointing at the varying input.
+* :mod:`~mxnet_tpu.analysis.spmd_hints` — SPMD partition hints: J003
+  when a ShardedTrainer on a multi-device mesh keeps a big net's
+  optimizer state fully replicated (the "you forgot zero1" footgun,
+  docs/sharding.md).
 
 Rule catalog: ``diagnostics.RULES`` / docs/analysis.md.  This package is
 stdlib-only at import so the linter runs without loading jax.
@@ -21,10 +25,11 @@ from . import diagnostics
 from . import engine_check
 from . import hybrid_lint
 from . import retrace
+from . import spmd_hints
 from .diagnostics import Diagnostic, RULES, rule_doc, to_json
 from .hybrid_lint import lint_file, lint_paths, lint_source
 from .retrace import report as retrace_report
 
 __all__ = ["diagnostics", "engine_check", "hybrid_lint", "retrace",
-           "Diagnostic", "RULES", "rule_doc", "to_json",
+           "spmd_hints", "Diagnostic", "RULES", "rule_doc", "to_json",
            "lint_source", "lint_file", "lint_paths", "retrace_report"]

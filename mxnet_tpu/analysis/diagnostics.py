@@ -149,6 +149,16 @@ RULES: Dict[str, tuple] = {
         "buckets})) or DataLoader(bucket_spec=...) so drifting shapes "
         "pad to a bounded bucket set (at most len(buckets) compiles; "
         "docs/jit.md)"),
+    "J003": (
+        "replicated-optimizer-state",
+        "a ShardedTrainer on a multi-device mesh keeps a >=1M-parameter "
+        "net's optimizer state fully replicated: every device redundantly "
+        "stores AND updates the full state, paying dp-times the optimizer "
+        "memory and update FLOPs for zero benefit",
+        "construct the trainer with partition='zero1' (reduce-scatter "
+        "grads -> shard-local update -> all-gather params, same math — "
+        "docs/sharding.md); tune the trigger threshold with "
+        "MXNET_ZERO1_HINT_MIN_PARAMS"),
     # -- tool errors --------------------------------------------------------
     "X000": (
         "analysis-error",

@@ -1,0 +1,104 @@
+"""SPMD partition hints: flag the "you forgot zero1" footgun (J003).
+
+A ``ShardedTrainer`` on a multi-device mesh with a fully replicated
+optimizer state redundantly stores AND updates the full state on every
+device — dp× the optimizer memory and update FLOPs for zero benefit
+("Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training", PAPERS.md).  Below ~1M parameters the waste is noise; above
+it, it is the difference between fitting the next model size and OOM.
+``ShardedTrainer.__init__`` reports every construction here; when the
+mesh is multi-device, every optimizer-state leaf is replicated and the
+net crosses ``MXNET_ZERO1_HINT_MIN_PARAMS`` (default 1,000,000)
+parameters, a **J003** diagnostic fires once per net type, plus a
+``trainer.zero1_hint_warnings`` telemetry tick.
+
+A zero1/fsdp trainer never fires (its state leaves are sharded), nor
+does a single-device mesh (nothing is replicated ACROSS anything), nor a
+small net.  Stdlib-only at import (mx.analysis contract);
+telemetry/logging engage lazily.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import List, Set
+
+from .diagnostics import Diagnostic
+
+__all__ = ["on_trainer_init", "report", "reset", "set_min_params",
+           "get_min_params"]
+
+_LOG = logging.getLogger(__name__)
+
+_LOCK = threading.Lock()
+_MIN_OVERRIDE = None  # set_min_params wins over the env var
+_warned: Set[str] = set()
+_DIAGS: List[Diagnostic] = []
+
+
+def set_min_params(n) -> int:
+    """Set the parameter-count threshold (None = back to the env var /
+    default); returns the previous effective one."""
+    global _MIN_OVERRIDE
+    prev = get_min_params()
+    _MIN_OVERRIDE = None if n is None else int(n)
+    return prev
+
+
+def get_min_params() -> int:
+    # the env var is read per call (not frozen at import) so tuning it
+    # from a live session works, matching MXNET_ZERO1_MIN_SIZE
+    if _MIN_OVERRIDE is not None:
+        return _MIN_OVERRIDE
+    return int(os.environ.get("MXNET_ZERO1_HINT_MIN_PARAMS", "1000000"))
+
+
+def on_trainer_init(label: str, mesh_devices: int, n_params: int,
+                    opt_state_replicated: bool, partition: str):
+    """Called by ShardedTrainer.__init__ after optimizer-state placement.
+
+    ``opt_state_replicated`` is computed from the ACTUAL placements (all
+    state leaves carry an empty PartitionSpec), so an fsdp spec_fn that
+    already shards the state suppresses the hint even under
+    partition='replicated'."""
+    # partition='zero1' never fires even when every leaf stayed
+    # replicated (all params under MXNET_ZERO1_MIN_SIZE): the user
+    # already opted in — telling them to switch to zero1 would be
+    # self-contradictory
+    if mesh_devices <= 1 or not opt_state_replicated \
+            or partition == "zero1" or n_params < get_min_params():
+        return
+    with _LOCK:
+        if label in _warned:
+            return
+        _warned.add(label)
+    msg = (f"{label}: ShardedTrainer on a {mesh_devices}-device mesh keeps "
+           f"{n_params:,} parameters' optimizer state fully replicated "
+           f"(partition={partition!r}) — every device stores and updates "
+           f"the FULL state, paying {mesh_devices}x the optimizer memory "
+           f"and update FLOPs; construct with partition='zero1' to "
+           f"reduce-scatter grads and shard the update over the data axis "
+           f"(docs/sharding.md)")
+    d = Diagnostic(path="<spmd>", line=0, code="J003", message=msg,
+                   symbol=label, source="spmd")
+    with _LOCK:
+        _DIAGS.append(d)
+    try:
+        from mxnet_tpu import telemetry as _tel
+
+        _tel.inc("trainer.zero1_hint_warnings")
+    except Exception:
+        pass
+    _LOG.warning("spmd-hint J003: %s", msg)
+
+
+def report() -> List[Diagnostic]:
+    with _LOCK:
+        return list(_DIAGS)
+
+
+def reset():
+    with _LOCK:
+        _warned.clear()
+        _DIAGS.clear()

@@ -9,7 +9,9 @@ ICI/DCN collectives. Modules:
 
   mesh        — mesh construction & axis conventions
   collectives — psum/all_gather/ppermute wrappers (the NCCL-API analogue)
-  trainer     — SPMD train-step builder (dp + tp + sp composable)
+  trainer     — SPMD train-step builder (dp + mp/tp + sp composable;
+                ZeRO-1 sharded weight update via partition="zero1" —
+                docs/sharding.md)
   ring        — ring attention (sequence parallelism over the sp axis)
   dist        — process-group lifecycle (hardened bring-up: bounded
                 retry/backoff, collective deadlines — docs/resilience.md)
@@ -20,6 +22,7 @@ from .mesh import (make_mesh, default_mesh, data_parallel_spec,
                    MeshConfig, with_sharding)
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
                           broadcast_from, barrier)
-from .trainer import ShardedTrainer, make_train_step, shard_params
+from .trainer import (ShardedTrainer, make_train_step, shard_params,
+                      replicated_spec_fn, fsdp_spec_fn, mp_spec_fn)
 from .preemption import PreemptionGuard
 from . import ring

@@ -1,9 +1,11 @@
 """Device mesh construction + axis conventions.
 
 Axis names follow the scaling-book convention: 'dp' (data), 'fsdp'
-(parameter shard over data), 'tp' (tensor/model), 'sp' (sequence/context),
-'ep' (expert), 'pp' (pipeline stage). A 1-axis dp mesh reproduces the
-reference's data parallelism (KVStore); everything else is new capability.
+(parameter shard over data), 'mp'/'tp' (tensor/model — 'mp' is the 2-D
+``dp × mp`` SPMD convention of docs/sharding.md, 'tp' kept as an alias
+axis name), 'sp' (sequence/context), 'ep' (expert), 'pp' (pipeline
+stage). A 1-axis dp mesh reproduces the reference's data parallelism
+(KVStore); everything else is new capability.
 """
 from __future__ import annotations
 
@@ -22,18 +24,20 @@ __all__ = ["make_mesh", "default_mesh", "MeshConfig", "data_parallel_spec",
 
 @dataclass
 class MeshConfig:
-    """Named axis sizes; -1 on one axis = fill with remaining devices."""
+    """Named axis sizes; -1 on one axis = fill with remaining devices
+    (a ``dp × mp`` mesh is ``MeshConfig(dp=-1, mp=2)``)."""
 
     dp: int = -1
+    mp: int = 1
     tp: int = 1
     sp: int = 1
     pp: int = 1
     ep: int = 1
 
     def axes(self) -> Dict[str, int]:
-        return {k: v for k, v in (("dp", self.dp), ("tp", self.tp),
-                                  ("sp", self.sp), ("pp", self.pp),
-                                  ("ep", self.ep))}
+        return {k: v for k, v in (("dp", self.dp), ("mp", self.mp),
+                                  ("tp", self.tp), ("sp", self.sp),
+                                  ("pp", self.pp), ("ep", self.ep))}
 
 
 def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None, **kw) -> Mesh:

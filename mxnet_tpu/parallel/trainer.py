@@ -10,8 +10,9 @@ HybridBlock's cached op, so BatchNorm stats and the RNG advance correctly.
 """
 from __future__ import annotations
 
+import os as _os
 import time as _time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +27,16 @@ from ..ndarray.ndarray import NDArray, _mutation_scope
 from .. import autograd as _autograd
 
 __all__ = ["shard_params", "make_train_step", "ShardedTrainer",
-           "fsdp_spec_fn", "replicated_spec_fn"]
+           "fsdp_spec_fn", "replicated_spec_fn", "mp_spec_fn"]
+
+PARTITIONS = ("replicated", "zero1")
+
+
+def _prod(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
 
 
 def replicated_spec_fn(name: str, shape) -> P:
@@ -39,10 +49,7 @@ def fsdp_spec_fn(axis: str = "dp", min_size: int = 2 ** 16):
     (capability beyond the reference — SURVEY.md §5 gap list)."""
 
     def fn(name: str, shape) -> P:
-        size = 1
-        for d in shape:
-            size *= d
-        if not shape or size < min_size:
+        if not shape or _prod(shape) < min_size:
             return P()
         big = max(range(len(shape)), key=lambda i: shape[i])
         spec = [None] * len(shape)
@@ -52,10 +59,61 @@ def fsdp_spec_fn(axis: str = "dp", min_size: int = 2 ** 16):
     return fn
 
 
+def mp_spec_fn(axis: str = "mp", min_size: int = 2 ** 12,
+               row_patterns: Tuple[str, ...] = ("proj", "ffn2", "ffn_2",
+                                                "out", "down")):
+    """Megatron-style tensor model parallelism over mesh axis ``axis``.
+
+    Dense weights are ``(out_units, in_units)``: the default is
+    column-parallel (shard the output dim — QKV projections, FFN-up), and
+    weights whose name matches a ``row_patterns`` substring are
+    row-parallel (shard the input dim — attention output projection,
+    FFN-down), so a column→row pair contracts over the sharded hidden dim
+    and XLA inserts ONE activation psum per pair instead of gathering
+    weights. 1-D params (biases, norms) and small weights stay replicated.
+    Dims the mesh axis cannot divide are replicated by ``shard_params``'s
+    divisibility sanitizer, so this spec_fn is safe on any net."""
+
+    def fn(name: str, shape) -> P:
+        if len(shape) < 2 or _prod(shape) < min_size:
+            return P()
+        j = 1 if any(p in name for p in row_patterns) else 0
+        spec = [None] * len(shape)
+        spec[j] = axis
+        return P(*spec)
+
+    return fn
+
+
+def _axis_size(mesh: Mesh, name) -> int:
+    """Device count behind one PartitionSpec entry (str or tuple of str)."""
+    names = name if isinstance(name, tuple) else (name,)
+    size = 1
+    for n in names:
+        size *= mesh.shape[n]
+    return size
+
+
+def _sanitize_spec(mesh: Mesh, spec: P, shape) -> P:
+    """Drop spec entries the array's dims cannot divide evenly.
+
+    jax (0.4.x) rejects uneven ``device_put`` placements outright, so a
+    heuristic spec_fn (mp/fsdp) meeting an odd-shaped param must degrade
+    to replication on that dim instead of crashing trainer construction."""
+    entries = tuple(spec)[:len(shape)]
+    out = []
+    for i, s in enumerate(entries):
+        if s is not None and shape[i] % _axis_size(mesh, s):
+            s = None
+        out.append(s)
+    return P(*out)
+
+
 def shard_params(net, mesh: Mesh, spec_fn: Callable = replicated_spec_fn):
     """Place a gluon net's parameters onto the mesh per spec_fn.
 
-    Returns (names, param_arrays, specs)."""
+    Returns (names, param_arrays, specs). Specs are sanitized against the
+    mesh (non-divisible dims replicate, see _sanitize_spec)."""
     params = {n: p for n, p in net.collect_params().items() if p._data is not None}
     names = sorted(params)
     specs = []
@@ -65,12 +123,91 @@ def shard_params(net, mesh: Mesh, spec_fn: Callable = replicated_spec_fn):
     with _blk.trace_guard():
         for n in names:
             v = params[n].data()._data
-            spec = spec_fn(n, v.shape)
+            spec = _sanitize_spec(mesh, spec_fn(n, v.shape), v.shape)
             sharded = jax.device_put(v, NamedSharding(mesh, spec))
             params[n].data()._set_data(sharded)
             specs.append(spec)
             vals.append(sharded)
     return names, vals, specs
+
+
+# -- ZeRO-1 sharded weight update ---------------------------------------------
+#
+# "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+# Training" (PAPERS.md): with parameters replicated over the data axis, the
+# optimizer update is redundantly identical on every replica — the update
+# FLOPs and the optimizer state can be divided across 'dp' with no change
+# to the math.  Expressed in GSPMD annotations: the gradient is
+# with_sharding_constraint'd onto a dp-sharded layout (XLA turns the grad
+# AllReduce into ReduceScatter), the optimizer state LIVES dp-sharded
+# (NamedSharding at init — the memory win), the update computes
+# shard-locally, and the output constraint back to the replicated param
+# placement becomes the AllGather ("Memory-efficient array redistribution",
+# PAPERS.md, gives the decomposition).
+#
+# jax 0.4.x only places evenly divisible shards, so each leaf picks one
+# free dim and PADS it up to a multiple of dp inside the step (zeros —
+# padding is invisible to every registry optimizer: elementwise kernels
+# update zeros to zeros, and LAMB/LARS per-tensor norms ignore zero tails).
+# Params keep their true shape at the step boundary; only the persistent
+# optimizer-state leaves are stored padded.
+
+
+class Zero1Info(NamedTuple):
+    """Per-trainable-param ZeRO-1 placement: shard ``axis``-th dim (padded
+    ``size``→``padded``) with ``sharding``; None ⇒ param opted out."""
+
+    axis: int
+    size: int
+    padded: int
+    sharding: NamedSharding
+
+
+def _zero1_infos(mesh: Mesh, dp_axis: str, tspecs: List[P], pvals,
+                 min_size: Optional[int] = None) -> List[Optional[Zero1Info]]:
+    """Choose the ZeRO-1 shard dim per trainable param.
+
+    Prefers the free (un-sharded) dim with the least padding waste;
+    params already sharded over ``dp_axis`` (fsdp) keep their placement
+    (the ZeRO property already holds), and params below ``min_size``
+    elements (MXNET_ZERO1_MIN_SIZE, default 2048) stay replicated — an
+    all-gather per tiny bias costs more latency than it saves memory."""
+    if dp_axis not in mesh.shape:
+        raise MXNetError(f"partition='zero1' needs a {dp_axis!r} mesh axis; "
+                         f"mesh has {tuple(mesh.axis_names)}")
+    if min_size is None:
+        min_size = int(_os.environ.get("MXNET_ZERO1_MIN_SIZE", "2048"))
+    dp = mesh.shape[dp_axis]
+    infos: List[Optional[Zero1Info]] = []
+    for spec, p in zip(tspecs, pvals):
+        entries = list(tuple(spec)) + [None] * (p.ndim - len(tuple(spec)))
+        used = set()
+        for s in entries:
+            if s is not None:
+                used.update(s if isinstance(s, tuple) else (s,))
+        if p.ndim == 0 or dp_axis in used or _prod(p.shape) < min_size:
+            infos.append(None)
+            continue
+        free = [j for j in range(p.ndim) if entries[j] is None]
+        if not free:
+            infos.append(None)
+            continue
+        # least relative padding waste: minimize ceil(d/dp)*dp / d
+        j = min(free, key=lambda k: (-(-p.shape[k] // dp) * dp) / p.shape[k])
+        padded = -(-p.shape[j] // dp) * dp
+        entries[j] = dp_axis
+        infos.append(Zero1Info(j, p.shape[j], padded,
+                               NamedSharding(mesh, P(*entries))))
+    return infos
+
+
+def _pad_dim(v, axis: int, target: int):
+    """Zero-pad ``axis`` up to ``target`` (identity when already there)."""
+    if v.shape[axis] == target:
+        return v
+    pads = [(0, 0)] * v.ndim
+    pads[axis] = (0, target - v.shape[axis])
+    return jnp.pad(v, pads)
 
 
 def _functional_apply(net, names: List[str], training: bool):
@@ -341,7 +478,8 @@ def make_train_step(net, loss_fn, names: List[str],
                     weight_decay: float = 0.0, momentum: float = 0.9,
                     donate: bool = True, compute_dtype=None,
                     loss_scale_growth_interval: int = 2000,
-                    multi_tensor: bool = False, shardings_box=None):
+                    multi_tensor: bool = False, shardings_box=None,
+                    partition: str = "replicated"):
     """Build the jitted SPMD train machinery. Returns
     (step, grad_fn, apply_fn, adapter, holder):
 
@@ -371,7 +509,23 @@ def make_train_step(net, loss_fn, names: List[str],
     Shardings are carried by the committed input arrays (shard_params /
     device_put in the caller); XLA inserts the gradient reduction over 'dp'
     (params replicated / sharded on non-dp axes ⇒ psum over ICI), replacing
-    the reference's KVStore push/pull (trainer.py:363)."""
+    the reference's KVStore push/pull (trainer.py:363).
+
+    ``partition`` selects the weight-update layout: ``"replicated"`` (every
+    replica runs the full update — the reference model) or ``"zero1"``
+    (reduce-scatter grads → shard-local update → all-gather params; the
+    concrete per-param placements arrive via ``shardings_box["zero1"]`` /
+    ``["opt_state"]``, filled by ShardedTrainer before the first trace —
+    see the ZeRO-1 block comment above)."""
+    if partition not in PARTITIONS:
+        raise MXNetError(f"partition={partition!r} unknown; "
+                         f"choose from {PARTITIONS}")
+    if partition == "zero1" and shardings_box is None:
+        raise MXNetError(
+            "partition='zero1' needs a shardings_box dict carrying the "
+            "per-param placements (ShardedTrainer fills ['zero1'] / "
+            "['opt_state'] before the first trace); without one the update "
+            "would silently run fully replicated")
     fn, arrs, holder = _functional_apply(net, names, training=True)
     params = net.collect_params()
     train_ix = [i for i, n in enumerate(names) if params[n].grad_req != "null"]
@@ -420,11 +574,51 @@ def make_train_step(net, loss_fn, names: List[str],
                        if jnp.issubdtype(m.dtype, jnp.floating) else m
                        for m in mutated]
         grads = [g.astype(jnp.float32) / scale for g in grads]
+        # zero1: pin each gradient onto its dp-sharded layout (padded dim,
+        # Zero1Info) — the constraint turns XLA's gradient AllReduce into
+        # ReduceScatter, so no replica ever materializes the full gradient
+        z1 = (shardings_box or {}).get("zero1")
+        if z1:
+            wsc = jax.lax.with_sharding_constraint
+            grads = [g if i is None
+                     else wsc(_pad_dim(g, i.axis, i.padded), i.sharding)
+                     for g, i in zip(grads, z1)]
         return grads, mutated, loss
+
+    def run_update(tvals, grads, opt_state, lr, t):
+        """adapter.update, in the selected partition layout.  zero1 pads
+        param+grad onto the state's dp-sharded layout (zeros are inert
+        for every registry optimizer, incl. LAMB/LARS per-tensor norms),
+        updates shard-locally, and slices the params back to true shape —
+        adapter-agnostic, so _OptAdapter and _FusedOptAdapter both work."""
+        if partition == "zero1" and "zero1" not in shardings_box:
+            # trace-time check: the box is legitimately empty at build
+            # time (ShardedTrainer fills it after make_train_step
+            # returns), but by the first trace the placements must exist
+            raise MXNetError(
+                "partition='zero1' but shardings_box['zero1'] was never "
+                "filled — the update would silently run fully replicated "
+                "(use ShardedTrainer, or fill the box before tracing)")
+        z1 = (shardings_box or {}).get("zero1")
+        if not z1 or all(i is None for i in z1):
+            return adapter.update(tvals, grads, opt_state, lr, t)
+        wsc = jax.lax.with_sharding_constraint
+        pp, gg = [], []
+        for p, g, i in zip(tvals, grads, z1):
+            if i is not None:
+                p = wsc(_pad_dim(p, i.axis, i.padded), i.sharding)
+                g = wsc(_pad_dim(g, i.axis, i.padded), i.sharding)
+            pp.append(p)
+            gg.append(g)
+        new_p, new_state = adapter.update(pp, gg, opt_state, lr, t)
+        new_p = [jax.lax.slice_in_dim(v, 0, i.size, axis=i.axis)
+                 if i is not None and i.padded != i.size else v
+                 for v, i in zip(new_p, z1)]
+        return new_p, new_state
 
     def apply_update(tvals, opt_state, t, lr, scale_state, grads):
         scale, good = scale_state
-        new_p, new_state = adapter.update(tvals, grads, opt_state, lr, t)
+        new_p, new_state = run_update(tvals, grads, opt_state, lr, t)
         if dynamic_scaling:
             ok = all_finite(grads)
             new_p = [jnp.where(ok, n, p) for n, p in zip(new_p, tvals)]
@@ -436,6 +630,32 @@ def make_train_step(net, loss_fn, names: List[str],
                 jnp.maximum(scale * 0.5, 1.0))
             new_good = jnp.where(ok, jnp.where(grown, 0, good + 1), 0)
             scale_state = (new_scale, new_good)
+        # pin loop-carried state to its input placement: without output
+        # constraints XLA may emit a different sharding for a small param
+        # (observed: a [64] BN bias coming back 'tp'-sharded), making every
+        # step pay a reshard when outputs feed the next step — and making
+        # the AOT-compiled step (dryrun/bench) reject its own outputs.
+        # Under zero1 the param constraint IS the AllGather (sharded
+        # update → replicated placement) and the state constraint keeps
+        # the leaves dp-sharded.  shardings_box is filled by
+        # ShardedTrainer AFTER this builder returns (the train/aux split
+        # comes from the holder); the box is read here at TRACE time,
+        # which happens strictly later.
+        psh = (shardings_box or {}).get("params")
+        if psh is not None:
+            wsc = jax.lax.with_sharding_constraint
+            new_p = [wsc(p, s) for p, s in zip(new_p, psh)]
+            ssh = (shardings_box or {}).get("opt_state")
+            if ssh is not None:
+                new_state = [wsc(s, sh) for s, sh in zip(new_state, ssh)]
+            else:
+                # box without per-leaf placements (external callers):
+                # state follows its owning param when same-shaped
+                repl = NamedSharding(psh[0].mesh, P())
+                new_state = [
+                    wsc(s, psh[pi]) if s.shape == new_p[pi].shape
+                    else wsc(s, repl)
+                    for s, pi in zip(new_state, adapter.leaf_param_ix)]
         return new_p, new_state, scale_state
 
     def step(tvals, avals, key_val, opt_state, t, lr, scale_state, x, y):
@@ -443,25 +663,6 @@ def make_train_step(net, loss_fn, names: List[str],
             tvals, avals, key_val, scale_state[0], x, y)
         new_p, new_state, scale_state = apply_update(
             tvals, opt_state, t, lr, scale_state, grads)
-        # pin loop-carried state to its input placement: without output
-        # constraints XLA may emit a different sharding for a small param
-        # (observed: a [64] BN bias coming back 'tp'-sharded), making every
-        # step pay a reshard when outputs feed the next step — and making
-        # the AOT-compiled step (dryrun/bench) reject its own outputs.
-        # shardings_box is filled by ShardedTrainer AFTER this builder
-        # returns (the train/aux split comes from the holder); the box is
-        # read here at TRACE time, which happens strictly later.
-        psh = (shardings_box or {}).get("params")
-        if psh is not None:
-            wsc = jax.lax.with_sharding_constraint
-            new_p = [wsc(p, s) for p, s in zip(new_p, psh)]
-            # optimizer state follows its owning param when same-shaped
-            # (the ZeRO placement chosen at init), else replicated
-            repl = NamedSharding(psh[0].mesh, P())
-            new_state = [
-                wsc(s, psh[pi]) if s.shape == new_p[pi].shape
-                else wsc(s, repl)
-                for s, pi in zip(new_state, adapter.leaf_param_ix)]
         ash = (shardings_box or {}).get("aux")
         if ash is not None:
             wsc = jax.lax.with_sharding_constraint
@@ -499,7 +700,14 @@ class ShardedTrainer:
     (ref Trainer.save_states/load_states, trainer.py:482,511). Multi-host:
     build the mesh from jax.devices() after jax.distributed.initialize() —
     the same code runs, collectives ride ICI within a slice and DCN across
-    (north-star requirement)."""
+    (north-star requirement).
+
+    ``partition`` selects the weight-update layout (docs/sharding.md):
+    ``"replicated"`` (default; env override ``MXNET_PARTITION``) keeps the
+    reference semantics, ``"zero1"`` shards the optimizer state and the
+    update over the data axis (reduce-scatter grads → shard-local update →
+    all-gather params) — same math, 1/dp the optimizer memory and update
+    FLOPs per device."""
 
     def __init__(self, net, loss_fn, mesh: Optional[Mesh] = None,
                  optimizer="sgd", learning_rate: float = 0.01,
@@ -509,9 +717,16 @@ class ShardedTrainer:
                  lr_scheduler=None, grad_accum: int = 1,
                  init_loss_scale: float = 2.0 ** 16,
                  multi_tensor: bool = False,
-                 max_inflight: Optional[int] = None):
+                 max_inflight: Optional[int] = None,
+                 partition: Optional[str] = None):
         from .mesh import default_mesh
 
+        if partition is None:
+            partition = _os.environ.get("MXNET_PARTITION", "replicated")
+        if partition not in PARTITIONS:
+            raise MXNetError(f"partition={partition!r} unknown; "
+                             f"choose from {PARTITIONS}")
+        self.partition = partition
         self.net = net
         self.mesh = mesh if mesh is not None else default_mesh()
         self.names, allvals, self.specs = shard_params(net, self.mesh, spec_fn)
@@ -520,7 +735,8 @@ class ShardedTrainer:
          self._holder) = make_train_step(
             net, loss_fn, self.names, optimizer, learning_rate,
             weight_decay, momentum, compute_dtype=compute_dtype,
-            multi_tensor=multi_tensor, shardings_box=shardings_box)
+            multi_tensor=multi_tensor, shardings_box=shardings_box,
+            partition=partition)
         self.pvals = [allvals[i] for i in self._holder["train_ix"]]
         self.avals = [allvals[i] for i in self._holder["aux_ix"]]
         # loop-carried outputs keep their input placements (read by the
@@ -534,17 +750,50 @@ class ShardedTrainer:
         self._params = net.collect_params()
         self.train_names = [self.names[i] for i in self._holder["train_ix"]]
         self.aux_names = [self.names[i] for i in self._holder["aux_ix"]]
-        self.opt_state = self._adapter.init_state(self.pvals)
-        # momenta etc. share their parameter's placement (FSDP: optimizer
-        # state shards with the param, the ZeRO property)
         tspecs = [self.specs[i] for i in self._holder["train_ix"]]
-        self.opt_state = [
-            jax.device_put(s, NamedSharding(
-                self.mesh, tspecs[pi] if s.shape == self.pvals[pi].shape
-                else P()))
-            for s, pi in zip(self.opt_state, self._adapter.leaf_param_ix)]
-        self._t = 0
         self._batch_spec = batch_spec
+        # ZeRO-1 placement plan (None per param when replicated): the
+        # sharded dim is chosen against the data axis named by batch_spec
+        self._dp_axis = self._data_axis_name()
+        if partition == "zero1":
+            self._zero1 = _zero1_infos(self.mesh, self._dp_axis, tspecs,
+                                       self.pvals)
+        else:
+            self._zero1 = [None] * len(self.pvals)
+        shardings_box["zero1"] = self._zero1
+        # optimizer state: created on the zero1-padded layout (leaves whose
+        # shard dim needs padding are STORED padded — the dp-sharded
+        # placement is what divides optimizer memory across replicas),
+        # replicated/fsdp leaves keep their parameter's placement
+        init_vals = [p if i is None else _pad_dim(p, i.axis, i.padded)
+                     for p, i in zip(self.pvals, self._zero1)]
+        self.opt_state = self._adapter.init_state(init_vals)
+        self._state_shardings: List[NamedSharding] = []
+        self._leaf_unpad: List[Optional[Tuple[int, int]]] = []
+        for s, pi in zip(self.opt_state, self._adapter.leaf_param_ix):
+            info = self._zero1[pi]
+            if info is not None and s.shape == init_vals[pi].shape:
+                self._state_shardings.append(info.sharding)
+                self._leaf_unpad.append(
+                    (info.axis, info.size) if info.padded != info.size
+                    else None)
+            elif s.shape == tuple(self.pvals[pi].shape):
+                # momenta etc. share their parameter's placement (FSDP:
+                # optimizer state shards with the param, the ZeRO property)
+                self._state_shardings.append(
+                    NamedSharding(self.mesh, tspecs[pi]))
+                self._leaf_unpad.append(None)
+            else:
+                self._state_shardings.append(NamedSharding(self.mesh, P()))
+                self._leaf_unpad.append(None)
+        shardings_box["opt_state"] = self._state_shardings
+        self.opt_state = [jax.device_put(s, sh) for s, sh in
+                          zip(self.opt_state, self._state_shardings)]
+        # construction-time storage shapes: load_states re-pads toward
+        # THESE (not the live leaves, which a prior load's replicated
+        # shape-mismatch fallback may have replaced)
+        self._leaf_shapes = [tuple(s.shape) for s in self.opt_state]
+        self._t = 0
         # an Optimizer instance brings its own lr / scheduler — honor them
         # (its update() replays with the trainer-supplied traced lr)
         opt = self._adapter.opt
@@ -556,10 +805,12 @@ class ShardedTrainer:
         self._micro = 0
         self._dynamic_scaling = compute_dtype is not None and \
             jnp.dtype(compute_dtype) == jnp.float16
-        # AOT-compiled step executables (compile()): slot -> (batch
-        # signature | None, jax compiled).  _step dispatches straight to
+        # AOT-compiled step executables (compile()): (slot, batch signature
+        # | None) -> jax compiled.  One executable PER batch signature per
+        # slot (the mesh shape is fixed per trainer, so the key space is
+        # per-(mesh-shape, batch-signature)); _step dispatches straight to
         # a matching executable — no trace, no XLA, no first-step stall.
-        self._aot: Dict[str, Tuple[Optional[tuple], Any]] = {}
+        self._aot: Dict[Tuple[str, Optional[tuple]], Any] = {}
         self._scale_state = (
             jnp.float32(init_loss_scale if self._dynamic_scaling else 1.0),
             jnp.int32(0))
@@ -571,6 +822,80 @@ class ShardedTrainer:
 
         with _blk.trace_guard():
             self._key = key_holder()._data
+        self._publish_layout_gauges()
+        # J003 footgun hint: a big replicated optimizer state on a
+        # multi-device mesh silently pays dp× memory + update FLOPs
+        from ..analysis import spmd_hints
+
+        n_params = sum(int(_prod(p.shape)) for p in self.pvals)
+        # an optimizer WITHOUT state leaves (plain sgd) has nothing to
+        # replicate — all([]) would fire the hint vacuously
+        fully_repl = bool(self._state_shardings) and all(
+            not any(e is not None for e in tuple(sh.spec))
+            for sh in self._state_shardings)
+        spmd_hints.on_trainer_init(
+            type(net).__name__, mesh_devices=self.mesh.size,
+            n_params=n_params, opt_state_replicated=fully_repl,
+            partition=self.partition)
+
+    def _data_axis_name(self) -> str:
+        """The mesh axis the batch shards over: the first named entry of
+        batch_spec (first element when a tuple), else 'dp' when the mesh
+        has one, else the mesh's leading axis."""
+        for s in tuple(self._batch_spec):
+            if s is not None:
+                return s[0] if isinstance(s, tuple) else s
+        return "dp" if "dp" in self.mesh.shape else self.mesh.axis_names[0]
+
+    # -- memory/comms telemetry (docs/sharding.md, docs/telemetry.md) -------
+    def _publish_layout_gauges(self):
+        """(Re-)publish the layout-derived gauges; the layouts can change
+        after construction (load_states may fall back to replicated
+        placements on shape mismatch)."""
+        if _tel._ENABLED:
+            _tel.set_gauge("trainer.opt_state_bytes_per_device",
+                           self.opt_state_bytes_per_device)
+            _tel.set_gauge("trainer.param_gather_bytes",
+                           self.param_gather_bytes)
+
+    @property
+    def opt_state_bytes_per_device(self) -> int:
+        """Bytes of optimizer state resident on EACH device.  Replicated
+        partition: the full state.  zero1: ≈ full/dp (plus padding and
+        any sub-min-size leaves kept replicated) — the measurable ZeRO-1
+        memory win."""
+        total = 0
+        for s in self.opt_state:
+            try:
+                shard = s.sharding.shard_shape(s.shape)
+            except Exception:
+                shard = s.shape
+            total += int(_prod(shard)) * s.dtype.itemsize
+        return total
+
+    @property
+    def param_gather_bytes(self) -> int:
+        """Bytes each device RECEIVES in the per-step param all-gather
+        (zero1: Σ padded_shard_bytes × (dp−1)/dp, where the shard is the
+        device's portion of any mp/fsdp-sharded dims — the gather runs
+        over dp only; replicated: 0 — no gather happens, every replica
+        updated the full params)."""
+        dp = self.mesh.shape.get(self._dp_axis, 1)
+        if dp <= 1:
+            return 0
+        total = 0
+        for p, info in zip(self.pvals, self._zero1):
+            if info is None:
+                continue
+            padded = int(_prod(p.shape)) // max(info.size, 1) \
+                * info.padded
+            # an mp-sharded param stays mp-sharded through the gather:
+            # each device receives only its shard of the non-dp dims
+            for k, e in enumerate(tuple(info.sharding.spec)):
+                if e is not None and k != info.axis:
+                    padded //= _axis_size(self.mesh, e)
+            total += padded * p.dtype.itemsize * (dp - 1) // dp
+        return total
 
     # -- lr -----------------------------------------------------------------
     @property
@@ -615,12 +940,27 @@ class ShardedTrainer:
             # (1, T) seq mask under batch_spec P('dp')), and a hard
             # error there would make every bucketed pipeline multi-chip
             # hostile.  Size-1 replication is exactly what the mask's
-            # broadcast semantics want.  Any OTHER non-divisible axis
-            # (a misconfigured batch size) still errors loudly in
-            # device_put — silently replicating a real batch would hide
-            # the config bug behind 8x redundant compute.
-            spec = P(*(None if v.shape[i] == 1 else s
-                       for i, s in enumerate(spec)))
+            # broadcast semantics want.  On a 2-D mesh, TRAILING dims
+            # the spec shards over the model axis (activation sharding,
+            # batch_spec P('dp','mp')) replicate too when the axis can't
+            # divide them — a seq-len that doesn't divide mp is a data
+            # property, not a config bug, and the old one-axis fallback
+            # made every such batch a hard error.  The BATCH dim (the
+            # first NAMED spec entry — index 1 for a time-major
+            # P(None, 'dp'), matching _data_axis_name) still errors
+            # loudly in device_put: a batch size that doesn't divide dp
+            # IS a config bug, and silently replicating it would hide
+            # 8x redundant compute.
+            batch_ix = next(k for k, s in enumerate(spec) if s is not None)
+            fixed = []
+            for i, s in enumerate(spec):
+                if s is not None and (
+                        v.shape[i] == 1
+                        or (i != batch_ix
+                            and v.shape[i] % _axis_size(self.mesh, s))):
+                    s = None
+                fixed.append(s)
+            spec = P(*fixed)
         sharding = NamedSharding(self.mesh, spec)
         if isinstance(v, jax.Array) and v.sharding == sharding:
             # already placed (the DevicePrefetcher path): no relayout, no
@@ -651,13 +991,11 @@ class ShardedTrainer:
         return (leaf(xb), leaf(yb))
 
     def _aot_fn(self, slot: str, xb=None, yb=None):
-        ent = self._aot.get(slot)
-        if ent is None:
-            return None
-        sig, compiled = ent
-        if sig is not None and sig != self._batch_sig(xb, yb):
-            return None  # different batch shapes: fall back to the jit path
-        return compiled
+        # keyed per batch signature (None for the shape-free apply slot):
+        # several compiled signatures coexist, unmatched shapes fall back
+        # to the jit path
+        sig = self._batch_sig(xb, yb) if xb is not None else None
+        return self._aot.get((slot, sig))
 
     def compile(self, batch, background: bool = False):
         """AOT-compile the SPMD step for a sample ``(x, y)`` batch via
@@ -704,7 +1042,7 @@ class ShardedTrainer:
                                 self.pvals, self.avals, self._key,
                                 self.opt_state, self._t + 1, lr,
                                 self._scale_state, xb, yb)
-                        self._aot["step"] = (sig, timed_compile(lowered))
+                        self._aot[("step", sig)] = timed_compile(lowered)
                         n += 1
                 else:
                     if self._aot_fn("grad", xb, yb) is None:
@@ -712,19 +1050,26 @@ class ShardedTrainer:
                             lowered = self._grad_fn.lower(
                                 self.pvals, self.avals, self._key,
                                 self._scale_state[0], xb, yb)
-                        self._aot["grad"] = (sig, timed_compile(lowered))
+                        self._aot[("grad", sig)] = timed_compile(lowered)
                         n += 1
                     if self._aot_fn("apply") is None:
-                        # grads are always fp32 with the params' shapes
-                        # and placements (compute_grads)
-                        gspec = [jax.ShapeDtypeStruct(
-                            p.shape, jnp.float32, sharding=p.sharding)
-                            for p in self.pvals]
+                        # grads are always fp32; under zero1 they leave
+                        # grad_fn padded onto the dp-sharded layout
+                        # (compute_grads), otherwise they carry the
+                        # params' shapes and placements
+                        gspec = [
+                            jax.ShapeDtypeStruct(
+                                p.shape, jnp.float32, sharding=p.sharding)
+                            if i is None else jax.ShapeDtypeStruct(
+                                tuple(i.padded if a == i.axis else d
+                                      for a, d in enumerate(p.shape)),
+                                jnp.float32, sharding=i.sharding)
+                            for p, i in zip(self.pvals, self._zero1)]
                         with _blk.trace_guard():
                             lowered = self._apply_fn.lower(
                                 self.pvals, self.opt_state, self._t + 1,
                                 lr, self._scale_state, gspec)
-                        self._aot["apply"] = (None, timed_compile(lowered))
+                        self._aot[("apply", None)] = timed_compile(lowered)
                         n += 1
             return n
 
@@ -870,7 +1215,8 @@ class ShardedTrainer:
     def save_states(self, fname: str):
         """Full training state → one .npz: params (train+aux), optimizer
         state leaves, RNG key, step count, loss scale. Arrays are gathered
-        to host unsharded, so the file restores onto ANY mesh shape."""
+        to host unsharded (zero1 leaves with their shard padding stripped),
+        so the file restores onto ANY mesh shape and ANY partition."""
         import numpy as onp
 
         if self._micro != 0:
@@ -887,7 +1233,13 @@ class ShardedTrainer:
         for n, v in zip(self.aux_names, self.avals):
             blob[f"aux/{n}"] = onp.asarray(v)
         for i, s in enumerate(self.opt_state):
-            blob[f"opt/{i}"] = onp.asarray(s)
+            a = onp.asarray(s)
+            up = self._leaf_unpad[i]
+            if up is not None:
+                ax, size = up
+                a = a[tuple(slice(size) if k == ax else slice(None)
+                            for k in range(a.ndim))]
+            blob[f"opt/{i}"] = a
         blob["meta/t"] = onp.asarray(self._t)
         blob["meta/key"] = onp.asarray(self._key)
         blob["meta/scale"] = onp.asarray(self._scale_state[0])
@@ -918,13 +1270,21 @@ class ShardedTrainer:
                     raise MXNetError(f"checkpoint param '{n}' unknown")
         self.pvals = [place(n, blob[f"param/{n}"]) for n in self.train_names]
         self.avals = [place(n, blob[f"aux/{n}"]) for n in self.aux_names]
-        tspecs = [self.specs[i] for i in self._holder["train_ix"]]
-        self.opt_state = [
-            jax.device_put(jnp.asarray(blob[f"opt/{i}"]), NamedSharding(
-                self.mesh,
-                tspecs[pi] if blob[f"opt/{i}"].shape ==
-                tuple(self.pvals[pi].shape) else P()))
-            for i, pi in enumerate(self._adapter.leaf_param_ix)]
+
+        def place_leaf(i):
+            # checkpoints carry UNPADDED leaves (save_states strips the
+            # zero1 shard padding), so they restore across partitions and
+            # mesh shapes; re-pad onto THIS trainer's storage layout
+            v = jnp.asarray(blob[f"opt/{i}"])
+            up = self._leaf_unpad[i]
+            if up is not None and v.shape[up[0]] < self._leaf_shapes[i][up[0]]:
+                v = _pad_dim(v, up[0], self._leaf_shapes[i][up[0]])
+            if v.shape == self._leaf_shapes[i]:
+                return jax.device_put(v, self._state_shardings[i])
+            return jax.device_put(v, NamedSharding(self.mesh, P()))
+
+        self.opt_state = [place_leaf(i)
+                          for i in range(len(self.opt_state))]
         self._t = int(blob["meta/t"])
         self._key = jnp.asarray(blob["meta/key"])
         self._scale_state = (jnp.float32(blob["meta/scale"]),
@@ -938,3 +1298,4 @@ class ShardedTrainer:
 
         key_holder()._set_data(self._key)
         self._accum, self._micro = None, 0
+        self._publish_layout_gauges()

@@ -1,0 +1,180 @@
+"""SPMD smoke gate (`make spmd-smoke`).
+
+Proves the 2-D-mesh ZeRO-1 path end to end on a forced 8-device CPU mesh
+(docs/sharding.md):
+
+  * **LeNet, 8x1 mesh**: 20 SGD+momentum steps under
+    ``partition='zero1'`` must match ``partition='replicated'`` within
+    few-ULP tolerance (same math — reduce-scatter + shard-local update +
+    all-gather), AND the measured
+    ``trainer.opt_state_bytes_per_device`` must be <= (replicated bytes
+    / dp) x 1.1 — the ZeRO-1 memory win as a checked fact, padding
+    overhead included.
+  * **tiny BERT, 4x2 mesh (dp x mp)**: 3 steps with mp=2 tensor-sharded
+    layers (``mp_spec_fn``) + zero1 must match the replicated 8x1 run —
+    tensor parallelism and the sharded update composing on one mesh.
+
+FAILS (exit 1) on any parity or memory miss; emits ``spmd_smoke.json``.
+Runs serially (single-core box — never concurrent with tier-1).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+TOL = 5e-6  # few-ULP on fp32 losses O(1), linear (SGD) update path
+
+
+def _ce():
+    import jax
+    import jax.numpy as jnp
+
+    def ce(pred, y):
+        logp = jax.nn.log_softmax(pred.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+
+    return ce
+
+
+def lenet_case(report):
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    def build():
+        mx.random.seed(0)
+        net = mx.gluon.model_zoo.get_model("lenet")
+        net.initialize(mx.init.Xavier())
+        net(mx.np.zeros((2, 1, 28, 28)))
+        return net
+
+    rs = onp.random.RandomState(0)
+    x = onp.asarray(rs.rand(32, 1, 28, 28), onp.float32)
+    y = onp.asarray(rs.randint(0, 10, size=(32,)), onp.int32)
+    runs = {}
+    for part in ("replicated", "zero1"):
+        tr = ShardedTrainer(build(), _ce(), mesh=make_mesh({"dp": 8}),
+                            optimizer="sgd", learning_rate=0.05,
+                            momentum=0.9, partition=part)
+        losses = [float(tr.step(x, y, block=True)) for _ in range(20)]
+        runs[part] = {"losses": losses,
+                      "opt_state_bytes_per_device":
+                          tr.opt_state_bytes_per_device,
+                      "param_gather_bytes": tr.param_gather_bytes,
+                      "mesh_shape": dict(tr.mesh.shape)}
+    dp = 8
+    max_dloss = max(abs(a - b) / max(abs(a), 1.0) for a, b in
+                    zip(runs["replicated"]["losses"],
+                        runs["zero1"]["losses"]))
+    r_bytes = runs["replicated"]["opt_state_bytes_per_device"]
+    z_bytes = runs["zero1"]["opt_state_bytes_per_device"]
+    ok_parity = max_dloss <= TOL
+    ok_bytes = z_bytes <= r_bytes / dp * 1.1
+    report["lenet_8x1"] = {
+        "steps": 20, "max_rel_dloss": max_dloss, "tol": TOL,
+        "replicated_bytes": r_bytes, "zero1_bytes": z_bytes,
+        "bytes_budget": r_bytes / dp * 1.1,
+        "zero1_parity_ok": ok_parity, "zero1_bytes_ok": ok_bytes,
+        "runs": runs}
+    return ok_parity and ok_bytes
+
+
+def bert_case(report):
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import (ShardedTrainer, mp_spec_fn,
+                                            replicated_spec_fn)
+
+    def build():
+        from mxnet_tpu.gluon.model_zoo.bert import BERTForPretrain, get_bert
+
+        mx.random.seed(0)
+        bert = get_bert("bert_12_768_12", vocab_size=97, max_length=32,
+                        num_layers=2, units=32, hidden_size=64,
+                        num_heads=4, dropout=0.0)
+        net = BERTForPretrain(bert, vocab_size=97)
+        net.initialize(mx.init.Xavier())
+        return net
+
+    B, T, PP = 8, 16, 4
+    rs = onp.random.RandomState(2)
+    x = (rs.randint(0, 97, (B, T)).astype("int32"),
+         onp.zeros((B, T), "int32"), onp.full((B,), T, "int32"),
+         rs.randint(0, T, (B, PP)).astype("int32"))
+    y = (rs.randint(0, 97, (B, PP)).astype("int32"),
+         rs.randint(0, 2, (B,)).astype("int32"))
+    L = gloss.SoftmaxCrossEntropyLoss()
+
+    def loss_fn(preds, yy):
+        (scores, nsp), (mlm_l, nsp_l) = preds, yy
+        a = L(mx.nd.NDArray(scores), mx.nd.NDArray(mlm_l))._data.mean()
+        b = L(mx.nd.NDArray(nsp), mx.nd.NDArray(nsp_l))._data.mean()
+        return a + b
+
+    tr_ref = ShardedTrainer(build(), loss_fn, mesh=make_mesh({"dp": 8}),
+                            optimizer="sgd", learning_rate=0.05,
+                            momentum=0.9, spec_fn=replicated_spec_fn,
+                            partition="replicated")
+    l_ref = [float(tr_ref.step(x, y, block=True)) for _ in range(3)]
+    tr_mp = ShardedTrainer(build(), loss_fn,
+                           mesh=make_mesh({"dp": 4, "mp": 2}),
+                           optimizer="sgd", learning_rate=0.05,
+                           momentum=0.9, spec_fn=mp_spec_fn(min_size=64),
+                           partition="zero1")
+    l_mp = [float(tr_mp.step(x, y, block=True)) for _ in range(3)]
+    n_sharded = sum(1 for s in tr_mp.specs
+                    if any(e is not None for e in tuple(s)))
+    max_dloss = max(abs(a - b) / max(abs(a), 1.0)
+                    for a, b in zip(l_ref, l_mp))
+    ok = max_dloss <= TOL and n_sharded >= 8
+    report["bert_4x2_mp_zero1"] = {
+        "steps": 3, "max_rel_dloss": max_dloss, "tol": TOL,
+        "mp_sharded_params": n_sharded,
+        "replicated_8x1_losses": l_ref, "mp_zero1_4x2_losses": l_mp,
+        "opt_state_bytes_per_device": tr_mp.opt_state_bytes_per_device,
+        "ok": ok}
+    return ok
+
+
+def main() -> int:
+    report = {}
+    ok = lenet_case(report)
+    ok = bert_case(report) and ok
+    report["ok"] = ok
+    out = os.path.join(ROOT, "spmd_smoke.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+    summary = {
+        "ok": ok,
+        "lenet_max_rel_dloss": report["lenet_8x1"]["max_rel_dloss"],
+        "lenet_zero1_bytes": report["lenet_8x1"]["zero1_bytes"],
+        "lenet_replicated_bytes": report["lenet_8x1"]["replicated_bytes"],
+        "bert_max_rel_dloss":
+            report["bert_4x2_mp_zero1"]["max_rel_dloss"],
+        "bert_mp_sharded_params":
+            report["bert_4x2_mp_zero1"]["mp_sharded_params"]}
+    print(json.dumps(summary))
+    if not ok:
+        print("spmd-smoke FAILED — see spmd_smoke.json", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
