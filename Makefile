@@ -17,7 +17,7 @@ BUILD    := build
 
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
-	lint-hybrid ci clean
+	trace-smoke lint-hybrid ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -105,6 +105,15 @@ spmd-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		python tools/spmd_smoke.py
 
+trace-smoke:
+	# mx.trace gate: 20 LeNet steps through the instrumented stack must
+	# export a parseable Perfetto JSON with spans from >=6 subsystems at
+	# <=5% trace-on overhead, and a forced dist.barrier fault must leave
+	# a flight-recorder dump on disk (docs/tracing.md).  Serial —
+	# single-core box, never concurrent with tier-1.
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		MXNET_TRACE=1 python tools/trace_smoke.py
+
 lint-hybrid:
 	# hybridize-safety static analysis (docs/analysis.md). The committed
 	# baseline makes legacy suppressions explicit; NEW violations fail.
@@ -114,7 +123,7 @@ lint-hybrid:
 		mxnet_tpu example benchmark
 
 ci: native native-test asan tsan lint-hybrid test test-slow telemetry-smoke \
-	pipeline-smoke chaos-smoke warmup-smoke spmd-smoke
+	pipeline-smoke chaos-smoke warmup-smoke spmd-smoke trace-smoke
 
 clean:
 	rm -rf $(BUILD)

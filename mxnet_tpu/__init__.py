@@ -65,8 +65,9 @@ from . import executor
 from . import libinfo
 from . import log
 from . import notebook
-from . import profiler
 from . import telemetry
+from . import trace
+from . import profiler
 from . import monitor
 from . import registry
 from . import rtc

@@ -17,15 +17,40 @@ __all__ = [
     "MXNetError",
     "DeferredInitializationError",
     "get_env",
+    "set_error_hook",
     "Registry",
     "numeric_types",
     "integer_types",
     "string_types",
 ]
 
+# Observer called with every constructed MXNetError (the trace flight
+# recorder arms this to dump its span rings at the failure point, even
+# when the error is later caught — docs/tracing.md).  Must never raise
+# into the constructor; failures are swallowed.
+_ERROR_HOOK: Optional[Callable[[BaseException], None]] = None
+
+
+def set_error_hook(hook: Optional[Callable[[BaseException], None]]):
+    """Install (or clear, with None) the MXNetError construction
+    observer; returns the previous hook."""
+    global _ERROR_HOOK
+    prev = _ERROR_HOOK
+    _ERROR_HOOK = hook
+    return prev
+
 
 class MXNetError(RuntimeError):
     """Top-level framework error (ref: include/mxnet/base.h dmlc::Error)."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        hook = _ERROR_HOOK
+        if hook is not None:
+            try:
+                hook(self)
+            except Exception:
+                pass
 
 
 class DeferredInitializationError(MXNetError):

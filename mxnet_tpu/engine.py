@@ -23,6 +23,7 @@ from . import _native
 from . import telemetry as _tel
 from .base import MXNetError, get_env
 from .resilience import chaos as _chaos
+from .trace import recorder as _tr
 
 __all__ = ["Engine", "NativeEngine", "NaiveEngine", "InflightQueue", "get",
            "push", "wait_for_var", "wait_for_all", "new_var", "delete_var"]
@@ -109,20 +110,18 @@ class InflightQueue:
             f"InflightQueue cannot wait on {type(handle).__name__}: push "
             "a jax.Array, an NDArray, or a tuple of them")
 
-    def _wait(self, handle):
-        if not _tel._ENABLED:
+    def _wait(self, item):
+        handle, corr = item
+        # the span carries the PUSHING step's correlation (captured at
+        # push time), not the current thread's: draining step t-K's
+        # handle while dispatching step t must not bill the wait to t
+        with _tr.span("pipeline.stall", timer="pipeline.stall_seconds",
+                      corr=corr, timer_on_error=True):
             self._block(handle)
-            return
-        t0 = _time.perf_counter()
-        try:
-            self._block(handle)
-        finally:
-            _tel.observe("pipeline.stall_seconds",
-                         _time.perf_counter() - t0)
 
     def push(self, handle):
         """Record a dispatched step; block on step t-K once over-limit."""
-        self._handles.append(handle)
+        self._handles.append((handle, _tr.capture()))
         while len(self._handles) > self.limit:
             self._wait(self._handles.popleft())
         if _tel._ENABLED:
@@ -176,7 +175,13 @@ class NaiveEngine(Engine):
                     self._errs[w._handle] = err
                 return
         try:
-            fn()
+            if _tr._ENABLED:
+                t0 = _time.perf_counter()
+                fn()
+                _tr.record_span("engine.op", t0,
+                                _time.perf_counter() - t0, op=name)
+            else:
+                fn()
             for w in write:
                 self._errs.pop(w._handle, None)
         except BaseException as e:  # noqa: BLE001 — poison + rethrow later
@@ -284,6 +289,10 @@ class NativeEngine(Engine):
             with _op_lock:
                 _op_registry.pop(op_id, None)
             raise MXNetError(self._lib.MXTPUGetLastError().decode())
+        if _tr._ENABLED:
+            # the op EXECUTES on a C++ worker (the native profiler times
+            # that side); the submit is a timeline marker on this thread
+            _tr.instant("engine.push", op=name)
         if _tel._ENABLED:
             _tel.inc("engine.ops_pushed")
             # queue depth needs an extra FFI round-trip, so sample it
@@ -316,25 +325,19 @@ class NativeEngine(Engine):
         return buf.value.decode()
 
     def wait_for_var(self, var: Var):
-        t0 = _time.perf_counter() if _tel._ENABLED else None
-        try:
+        with _tr.span("engine.wait_for_var",
+                      timer="engine.wait_for_var_seconds",
+                      timer_on_error=True):
             if self._lib.MXTPUEngineWaitForVar(self._handle,
                                                var._handle) != 0:
                 raise MXNetError(self._lib.MXTPUGetLastError().decode())
-        finally:
-            if t0 is not None:
-                _tel.observe("engine.wait_for_var_seconds",
-                             _time.perf_counter() - t0)
 
     def wait_for_all(self):
-        t0 = _time.perf_counter() if _tel._ENABLED else None
-        try:
+        with _tr.span("engine.wait_for_all",
+                      timer="engine.wait_for_all_seconds",
+                      timer_on_error=True):
             if self._lib.MXTPUEngineWaitForAll(self._handle) != 0:
                 raise MXNetError(self._lib.MXTPUGetLastError().decode())
-        finally:
-            if t0 is not None:
-                _tel.observe("engine.wait_for_all_seconds",
-                             _time.perf_counter() - t0)
 
     @property
     def num_outstanding(self) -> int:

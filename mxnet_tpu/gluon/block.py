@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry as _tel
+from ..trace import recorder as _tr
 from ..analysis import retrace as _retrace
 from ..base import DeferredInitializationError, MXNetError
 from ..context import Context, current_context
@@ -548,6 +549,11 @@ class _CachedOp:
                              _time.perf_counter() - t0)
                 _tel.inc("hybridize.cache_misses")
                 _tel.inc("hybridize.warmup_compiles")
+            if _tr._ENABLED:
+                _tr.record_span("hybridize.compile", t0,
+                                _time.perf_counter() - t0,
+                                block=type(self.block).__name__,
+                                warmup=True)
             # n_calls omitted: warmup traces are deliberate, not churn
             self._note_trace(sig)
         return True
@@ -580,19 +586,18 @@ class _CachedOp:
                     if _tel._ENABLED:
                         _tel.inc("hybridize.cache_hits")
                     res = invoke(jit_fn, inputs, name=name)
-                elif _tel._ENABLED:
+                else:
                     # first call for this signature pays trace + XLA
                     # compile — the #1 silent cost on TPU;
                     # hybridize.compile_seconds is the timer every perf
-                    # investigation reads first
-                    t0 = _time.perf_counter()
-                    res = invoke(jit_fn, inputs, name=name)
-                    _tel.observe("hybridize.compile_seconds",
-                                 _time.perf_counter() - t0)
-                    _tel.inc("hybridize.cache_misses")
-                    self._note_trace(sig, n_calls=self._calls)
-                else:
-                    res = invoke(jit_fn, inputs, name=name)
+                    # investigation reads first (the span carries the
+                    # same wall time onto the timeline)
+                    with _tr.span("hybridize.compile",
+                                  timer="hybridize.compile_seconds",
+                                  block=type(self.block).__name__):
+                        res = invoke(jit_fn, inputs, name=name)
+                    if _tel._ENABLED:
+                        _tel.inc("hybridize.cache_misses")
                     self._note_trace(sig, n_calls=self._calls)
         if isinstance(res, NDArray):
             res = (res,)
@@ -613,11 +618,16 @@ class WarmupHandle:
     def __init__(self, fn):
         self.result = None
         self.error: Optional[BaseException] = None
+        # the spawning thread's correlation context rides onto the
+        # warmup thread, so its compile spans stay attributed to the
+        # owner (docs/tracing.md)
+        self._corr = _tr.capture()
         self._thread = threading.Thread(target=self._run, args=(fn,),
                                         name="mx-jit-warmup", daemon=True)
         self._thread.start()
 
     def _run(self, fn):
+        _tr.attach(self._corr)
         try:
             self.result = fn()
         except BaseException as e:  # noqa: BLE001 — rethrown at wait()
@@ -780,10 +790,17 @@ class HybridBlock(Block):
                 expanded.extend(_expand_sample(self._bucketer, s))
             norm = expanded
         cached_op = self._cached_op
+        # every warmup run gets its own correlation id, so spans it
+        # produces (even on the background thread) answer "which warmup
+        # compiled this" — asserted in tests/test_trace.py
+        wid = _tr.next_id("warmup")
 
         def run():
             n = 0
-            with _tel.timer("jit.warmup_seconds"):
+            with _tr.correlate(warmup=wid), \
+                    _tr.span("jit.warmup", timer="jit.warmup_seconds",
+                             timer_on_error=True,
+                             block=type(self).__name__):
                 for s in norm:
                     if cached_op.warmup(s, training=train_mode):
                         n += 1

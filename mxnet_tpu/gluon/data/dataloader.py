@@ -13,7 +13,6 @@ design makes unnecessary.
 from __future__ import annotations
 
 import multiprocessing as mp
-import time as _time
 from typing import Callable, List, Optional
 
 import numpy as _onp
@@ -22,6 +21,7 @@ from ... import telemetry as _tel
 from ...base import MXNetError, get_env
 from ...resilience import chaos as _chaos
 from ...ndarray.ndarray import NDArray
+from ...trace import recorder as _tr
 from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
 
@@ -203,7 +203,8 @@ class DataLoader:
         the loop actually waited"."""
         from .prefetch import on_prefetch_thread
 
-        record = _tel._ENABLED and not on_prefetch_thread()
+        record = (_tel._ENABLED or _tr._ENABLED) \
+            and not on_prefetch_thread()
         if self._num_workers == 0:
             if self._batchify_fn is not None:
                 batchify = self._batchify_fn
@@ -220,11 +221,12 @@ class DataLoader:
                 # single-process: the whole fetch+batchify runs inline, so
                 # ALL of it is time the consumer spends waiting
                 if record:
-                    t0 = _time.perf_counter()
-                    batch = batchify([self._dataset[i] for i in indices])
-                    _tel.observe("dataloader.wait_seconds",
-                                 _time.perf_counter() - t0)
-                    _tel.inc("dataloader.batches")
+                    with _tr.span("dataloader.fetch",
+                                  timer="dataloader.wait_seconds"):
+                        batch = batchify([self._dataset[i]
+                                          for i in indices])
+                    if _tel._ENABLED:
+                        _tel.inc("dataloader.batches")
                 else:
                     batch = batchify([self._dataset[i] for i in indices])
                 batch = self._maybe_pad(batch)
@@ -246,13 +248,14 @@ class DataLoader:
                 # Gated like wait/batches: under a DevicePrefetcher the
                 # gauge belongs to the device queue (prefetch.py), and
                 # pool-side writes would interleave two unrelated depths
-                _tel.set_gauge("dataloader.prefetch_occupancy",
-                               sum(1 for p in pending if p.ready()))
-                t0 = _time.perf_counter()
-                res = pending.pop(0).get(self._timeout)
-                _tel.observe("dataloader.wait_seconds",
-                             _time.perf_counter() - t0)
-                _tel.inc("dataloader.batches")
+                if _tel._ENABLED:
+                    _tel.set_gauge("dataloader.prefetch_occupancy",
+                                   sum(1 for p in pending if p.ready()))
+                with _tr.span("dataloader.fetch",
+                              timer="dataloader.wait_seconds"):
+                    res = pending.pop(0).get(self._timeout)
+                if _tel._ENABLED:
+                    _tel.inc("dataloader.batches")
             else:
                 res = pending.pop(0).get(self._timeout)
             res = self._maybe_pad(res)

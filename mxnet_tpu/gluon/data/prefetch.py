@@ -40,7 +40,6 @@ from __future__ import annotations
 import logging
 import queue as _queue
 import threading
-import time as _time
 from typing import Any, Callable, Optional
 
 import numpy as _onp
@@ -49,6 +48,7 @@ from ... import telemetry as _tel
 from ...base import MXNetError, get_env
 from ...context import Context
 from ...ndarray.ndarray import NDArray
+from ...trace import recorder as _tr
 
 __all__ = ["DevicePrefetcher", "on_prefetch_thread"]
 
@@ -148,6 +148,11 @@ class _Epoch:
         self._pin = pin_memory
         self._q: _queue.Queue = _queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        # the OWNER's correlation context (captured on the consumer
+        # thread that starts this epoch): producer-side spans must be
+        # attributed to the loop that owns them, not to an anonymous
+        # helper thread (docs/tracing.md)
+        self._corr = _tr.capture()
         self._thread = threading.Thread(target=self._produce,
                                         name="mx-device-prefetch",
                                         daemon=True)
@@ -155,15 +160,14 @@ class _Epoch:
 
     def _produce(self):
         _TLS.active = True
+        _tr.attach(self._corr)
+        seq = 0
         try:
             while not self._stop.is_set():
                 try:
-                    if _tel._ENABLED:
-                        t0 = _time.perf_counter()
-                        batch = next(self._it)
-                        _tel.observe("pipeline.fetch_seconds",
-                                     _time.perf_counter() - t0)
-                    else:
+                    with _tr.span("pipeline.fetch",
+                                  timer="pipeline.fetch_seconds",
+                                  batch=seq):
                         batch = next(self._it)
                 except StopIteration:
                     self._offer(_SENTINEL)
@@ -178,18 +182,16 @@ class _Epoch:
                     if self._pin:
                         batch = _pin(batch)
                     nbytes = _host_bytes(batch)
-                    if _tel._ENABLED:
-                        t0 = _time.perf_counter()
+                    with _tr.span("pipeline.h2d",
+                                  timer="pipeline.h2d_overlap_seconds",
+                                  batch=seq):
                         placed = _wrap_nd(self._put(batch))
-                        _tel.observe("pipeline.h2d_overlap_seconds",
-                                     _time.perf_counter() - t0)
-                        if nbytes:
-                            _tel.inc("ndarray.h2d_bytes", nbytes)
-                    else:
-                        placed = _wrap_nd(self._put(batch))
+                    if nbytes and _tel._ENABLED:
+                        _tel.inc("ndarray.h2d_bytes", nbytes)
                 except BaseException as e:  # noqa: BLE001 — rethrow at get
                     self._offer(_Err(e))
                     return
+                seq += 1
                 if not self._offer(placed):
                     return
         finally:
@@ -211,11 +213,7 @@ class _Epoch:
     def __next__(self):
         if _tel._ENABLED:
             _tel.set_gauge("dataloader.prefetch_occupancy", self._q.qsize())
-            t0 = _time.perf_counter()
-            item = self._q.get()
-            _tel.observe("dataloader.wait_seconds",
-                         _time.perf_counter() - t0)
-        else:
+        with _tr.span("dataloader.wait", timer="dataloader.wait_seconds"):
             item = self._q.get()
         if item is _SENTINEL:
             self._thread.join()

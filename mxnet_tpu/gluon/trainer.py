@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 
 from .. import engine as _engine
 from .. import telemetry as _tel
+from ..trace import recorder as _tr
 from ..base import MXNetError
 from .. import optimizer as opt_mod
 from ..kvstore import KVStoreBase, create as kv_create
@@ -114,7 +115,8 @@ class Trainer:
         ``MXNET_MAX_INFLIGHT_STEPS`` (docs/pipeline.md) via a handle on
         the last updated parameter (the eager kernels never donate, so
         the handle stays valid under the queue)."""
-        with _tel.timer("trainer.step_seconds"):
+        with _tr.span("trainer.step", timer="trainer.step_seconds",
+                      timer_on_error=True):
             if not self._kv_initialized:
                 self._init_kvstore()
             self._optimizer.rescale_grad = self._rescale(batch_size)
@@ -153,7 +155,9 @@ class Trainer:
             _tel.inc("trainer.allreduce_bytes",
                      sum(g._data.size * g._data.dtype.itemsize
                          for _, grads in pending for g in grads))
-        with _tel.timer("trainer.allreduce_seconds"):
+        with _tr.span("trainer.allreduce",
+                      timer="trainer.allreduce_seconds",
+                      timer_on_error=True):
             group = getattr(self._kvstore, "pushpull_group", None)
             if multi_process and group is not None and \
                     getattr(self._kvstore, "_updater", None) is None:

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry as _tel
+from ..trace import recorder as _tr
 from ..base import MXNetError, Registry
 from ..ndarray.ndarray import NDArray
 
@@ -118,7 +119,9 @@ class KVStore(KVStoreBase):
 
     def pushpull(self, key, value, out=None, priority=0):
         _note_pushpull(value)
-        with _tel.timer("kvstore.pushpull_seconds"):
+        with _tr.span("kvstore.pushpull",
+                      timer="kvstore.pushpull_seconds",
+                      timer_on_error=True, key=str(key)):
             self._pushpull(key, value, out, priority)
 
     def _pushpull(self, key, value, out, priority):

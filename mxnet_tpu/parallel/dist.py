@@ -39,6 +39,7 @@ from typing import Callable, Optional
 from .. import telemetry as _tel
 from ..base import MXNetError, get_env
 from ..resilience import chaos as _chaos
+from ..trace import recorder as _tr
 
 _initialized = False
 
@@ -248,18 +249,19 @@ def allgather_host(x, timeout: Optional[float] = None):
 
         return multihost_utils.process_allgather(x)
 
-    if not _tel._ENABLED:
+    if _tel._ENABLED:
+        try:
+            nbytes = x.size * x.dtype.itemsize
+        except AttributeError:
+            nbytes = 0
+        _tel.inc("dist.allgather_calls")
+        _tel.inc("dist.allgather_bytes", nbytes)
+    # phased span (begin/end events): a collective that never returns —
+    # the infinite-hang mode the deadline exists for — still leaves its
+    # begin event in the flight-recorder ring (docs/tracing.md)
+    with _tr.span("dist.allgather", timer="dist.allgather_seconds",
+                  phased=True):
         return _with_deadline(gather, "allgather_host", timeout)
-    try:
-        nbytes = x.size * x.dtype.itemsize
-    except AttributeError:
-        nbytes = 0
-    _tel.inc("dist.allgather_calls")
-    _tel.inc("dist.allgather_bytes", nbytes)
-    t0 = _time.perf_counter()
-    out = _with_deadline(gather, "allgather_host", timeout)
-    _tel.observe("dist.allgather_seconds", _time.perf_counter() - t0)
-    return out
 
 
 def allreduce_host(x, average: bool = False):
@@ -322,12 +324,12 @@ def barrier(name: str = "mx_barrier",
         multihost_utils.sync_global_devices(name)
         return True
 
-    if not _tel._ENABLED:
-        _with_deadline(sync, f"barrier:{name}", timeout)
-        return
     t0 = _time.perf_counter()
-    multi = _with_deadline(sync, f"barrier:{name}", timeout)
-    if multi:
+    # phased span — a wedged barrier's begin event survives into the
+    # flight dump even though the span never closes (docs/tracing.md)
+    with _tr.span("dist.barrier", phased=True, barrier=name):
+        multi = _with_deadline(sync, f"barrier:{name}", timeout)
+    if multi and _tel._ENABLED:
         # per-rank barrier wait ≈ how far this rank ran ahead of the
         # slowest (single-process short-circuits stay un-timed)
         _tel.observe("dist.barrier_seconds", _time.perf_counter() - t0)

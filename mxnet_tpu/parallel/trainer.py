@@ -20,6 +20,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import engine as _engine
 from .. import telemetry as _tel
+from ..trace import cost as _cost
+from ..trace import recorder as _tr
 from ..base import MXNetError
 from ..gluon import block as _blk
 from ..jit import cache as _jit_cache
@@ -1019,18 +1021,27 @@ class ShardedTrainer:
         xb, yb = self._put(batch[0]), self._put(batch[1])
         lr = jnp.float32(self.learning_rate)
 
-        def timed_compile(lowered):
+        def timed_compile(lowered, slot):
             t0 = _time.perf_counter()
             compiled = lowered.compile()
             if _tel._ENABLED:
                 _tel.observe("hybridize.compile_seconds",
                              _time.perf_counter() - t0)
                 _tel.inc("hybridize.warmup_compiles")
+            if _tr._ENABLED:
+                _tr.record_span("hybridize.compile", t0,
+                                _time.perf_counter() - t0,
+                                block=type(self.net).__name__, slot=slot)
             return compiled
+
+        wid = _tr.next_id("warmup")
 
         def run():
             n = 0
-            with _tel.timer("jit.warmup_seconds"):
+            with _tr.correlate(warmup=wid), \
+                    _tr.span("jit.warmup", timer="jit.warmup_seconds",
+                             timer_on_error=True,
+                             block=type(self.net).__name__):
                 sig = self._batch_sig(xb, yb)
                 if self.grad_accum <= 1:
                     if self._aot_fn("step", xb, yb) is None:
@@ -1042,7 +1053,8 @@ class ShardedTrainer:
                                 self.pvals, self.avals, self._key,
                                 self.opt_state, self._t + 1, lr,
                                 self._scale_state, xb, yb)
-                        self._aot[("step", sig)] = timed_compile(lowered)
+                        self._aot[("step", sig)] = timed_compile(lowered,
+                                                                 "step")
                         n += 1
                 else:
                     if self._aot_fn("grad", xb, yb) is None:
@@ -1050,32 +1062,111 @@ class ShardedTrainer:
                             lowered = self._grad_fn.lower(
                                 self.pvals, self.avals, self._key,
                                 self._scale_state[0], xb, yb)
-                        self._aot[("grad", sig)] = timed_compile(lowered)
+                        self._aot[("grad", sig)] = timed_compile(lowered,
+                                                                 "grad")
                         n += 1
                     if self._aot_fn("apply") is None:
-                        # grads are always fp32; under zero1 they leave
-                        # grad_fn padded onto the dp-sharded layout
-                        # (compute_grads), otherwise they carry the
-                        # params' shapes and placements
-                        gspec = [
-                            jax.ShapeDtypeStruct(
-                                p.shape, jnp.float32, sharding=p.sharding)
-                            if i is None else jax.ShapeDtypeStruct(
-                                tuple(i.padded if a == i.axis else d
-                                      for a, d in enumerate(p.shape)),
-                                jnp.float32, sharding=i.sharding)
-                            for p, i in zip(self.pvals, self._zero1)]
                         with _blk.trace_guard():
                             lowered = self._apply_fn.lower(
                                 self.pvals, self.opt_state, self._t + 1,
-                                lr, self._scale_state, gspec)
-                        self._aot[("apply", None)] = timed_compile(lowered)
+                                lr, self._scale_state,
+                                self._grad_specs())
+                        self._aot[("apply", None)] = timed_compile(
+                            lowered, "apply")
                         n += 1
             return n
 
         if background:
             return WarmupHandle(run)
         return run()
+
+    def _grad_specs(self):
+        """ShapeDtypeStructs of the gradients ``apply_fn`` consumes:
+        always fp32; under zero1 they leave grad_fn padded onto the
+        dp-sharded layout (compute_grads), otherwise they carry the
+        params' shapes and placements."""
+        return [jax.ShapeDtypeStruct(p.shape, jnp.float32,
+                                     sharding=p.sharding)
+                if i is None else jax.ShapeDtypeStruct(
+                    tuple(i.padded if a == i.axis else d
+                          for a, d in enumerate(p.shape)),
+                    jnp.float32, sharding=i.sharding)
+                for p, i in zip(self.pvals, self._zero1)]
+
+    # -- XLA cost attribution (trace.cost, docs/tracing.md) ------------------
+    def _cost_key(self, sig) -> tuple:
+        return ("trainer", type(self.net).__name__,
+                "step" if self.grad_accum <= 1 else "grad+apply", sig)
+
+    def xla_cost(self, batch) -> Optional[Dict[str, Any]]:
+        """XLA's own accounting of ONE ``step()`` call for ``batch``'s
+        shapes: ``{"flops": ..., "bytes_accessed": ...}`` from
+        ``compiled.cost_analysis()``.  Under grad_accum=k a step() call
+        executes one grad and 1/k of an apply, so the apply
+        executable's cost is amortized over the window before summing —
+        the figure divides by a measured seconds-per-``step()``-call
+        (what bench.py times).  First call per batch signature lowers +
+        compiles (a disk hit when the persistent cache is warm) and
+        registers the result with ``mx.trace.cost``; later calls read
+        the registry.  Returns None when the backend offers no
+        analysis."""
+        xb, yb = self._put(batch[0]), self._put(batch[1])
+        sig = self._batch_sig(xb, yb)
+        key = self._cost_key(sig)
+        info = _cost.get(key)
+        if info is not None:
+            return info
+        lr = jnp.float32(self.learning_rate)
+        if self.grad_accum <= 1:
+            compiled = self._aot_fn("step", xb, yb)
+            if compiled is None:
+                with _blk.trace_guard():
+                    lowered = self._step_fn.lower(
+                        self.pvals, self.avals, self._key, self.opt_state,
+                        self._t + 1, lr, self._scale_state, xb, yb)
+                compiled = lowered.compile()
+            return _cost.register(key, compiled)
+        compiled = self._aot_fn("grad", xb, yb)
+        if compiled is None:
+            with _blk.trace_guard():
+                lowered = self._grad_fn.lower(
+                    self.pvals, self.avals, self._key,
+                    self._scale_state[0], xb, yb)
+            compiled = lowered.compile()
+        if _cost.register(key, compiled) is None:
+            return None
+        apply_c = self._aot_fn("apply")
+        if apply_c is None:
+            with _blk.trace_guard():
+                lowered = self._apply_fn.lower(
+                    self.pvals, self.opt_state, self._t + 1, lr,
+                    self._scale_state, self._grad_specs())
+            apply_c = lowered.compile()
+        apply_info = _cost.extract(apply_c)
+        if apply_info is not None:
+            # one apply per k micro-steps: amortize so the stored cost
+            # matches what ONE step() call executes
+            k = float(self.grad_accum)
+            _cost.register(key, info={
+                "flops": apply_info["flops"] / k,
+                "bytes_accessed": apply_info["bytes_accessed"] / k,
+            }, accumulate=True)
+        return _cost.get(key)
+
+    def publish_xla_utilization(self, batch, seconds_per_step: float,
+                                prefix: str = "trainer") -> Dict[str, Any]:
+        """Publish the achieved-vs-XLA-counted utilization gauges
+        (``trainer.xla_utilization`` & co, docs/tracing.md) for a
+        measured ``seconds_per_step`` — seconds per ``step()`` CALL
+        (grad-accum included; :meth:`xla_cost` amortizes the apply to
+        match) — on ``batch``'s shapes, and return the row-ready dict
+        bench.py embeds.  Empty dict when the backend offers no cost
+        analysis."""
+        if self.xla_cost(batch) is None:
+            return {}
+        xb, yb = self._put(batch[0]), self._put(batch[1])
+        key = self._cost_key(self._batch_sig(xb, yb))
+        return _cost.publish(key, seconds_per_step, prefix=prefix)
 
     def _write_back_params(self):
         params = self._params
@@ -1110,7 +1201,14 @@ class ShardedTrainer:
         With grad_accum=k, every k-th call applies the averaged
         accumulated gradient (the k-1 other calls only accumulate — ref
         gradient-accumulation idiom over grad_req='add')."""
-        with _tel.timer("trainer.step_seconds"):
+        # correlation: this dispatch belongs to step t+1 (grad-accum
+        # micro-batches all belong to the upcoming apply); every span
+        # recorded below — including on the prefetch thread via
+        # capture(), and the InflightQueue's deferred wait — carries it
+        sid = self._t + 1
+        with _tr.correlate(step=sid), \
+                _tr.span("trainer.step", timer="trainer.step_seconds",
+                         timer_on_error=True):
             loss = self._step(x, y)
         if block:
             self.drain()
@@ -1135,7 +1233,7 @@ class ShardedTrainer:
         functional step, which swaps shared Parameter ._data / the RNG
         key to tracers (_functional_apply), and that swap must not
         interleave with a background warmup trace or its readers."""
-        if not _tel._ENABLED:
+        if not (_tel._ENABLED or _tr._ENABLED):
             with _blk.trace_guard():
                 return fn(*args)
         cache_size = getattr(fn, "_cache_size", None)
@@ -1147,8 +1245,10 @@ class ShardedTrainer:
         with _blk.trace_guard():
             out = fn(*args)
         if cache_size() > n0:
-            _tel.observe("hybridize.compile_seconds",
-                         _time.perf_counter() - t0)
+            dur = _time.perf_counter() - t0
+            if _tel._ENABLED:
+                _tel.observe("hybridize.compile_seconds", dur)
+            _tr.record_span("hybridize.compile", t0, dur, slot="trainer")
         return out
 
     def _step(self, x, y) -> NDArray:
@@ -1160,17 +1260,19 @@ class ShardedTrainer:
             # before _get_lr)
             lr = jnp.float32(self.learning_rate)
             aot = self._aot_fn("step", xb, yb) if self._aot else None
-            if aot is not None:
-                (self.pvals, mutated, self.opt_state, self._scale_state,
-                 loss) = aot(self.pvals, self.avals, self._key,
-                             self.opt_state, self._t, lr,
-                             self._scale_state, xb, yb)
-            else:
-                (self.pvals, mutated, self.opt_state, self._scale_state,
-                 loss) = self._jit_call(self._step_fn, self.pvals,
-                                        self.avals, self._key,
-                                        self.opt_state, self._t, lr,
-                                        self._scale_state, xb, yb)
+            with _tr.span("trainer.dispatch", aot=aot is not None):
+                if aot is not None:
+                    (self.pvals, mutated, self.opt_state,
+                     self._scale_state, loss) = aot(
+                        self.pvals, self.avals, self._key,
+                        self.opt_state, self._t, lr,
+                        self._scale_state, xb, yb)
+                else:
+                    (self.pvals, mutated, self.opt_state,
+                     self._scale_state, loss) = self._jit_call(
+                        self._step_fn, self.pvals, self.avals, self._key,
+                        self.opt_state, self._t, lr,
+                        self._scale_state, xb, yb)
             self._write_back(mutated)
             # the loss depends on the whole fwd+bwd+update, is never fed
             # back into a donating call, and is tiny — the one safe handle
@@ -1178,14 +1280,17 @@ class ShardedTrainer:
             self._inflight.push(loss)
             return NDArray(loss)
         aot = self._aot_fn("grad", xb, yb) if self._aot else None
-        if aot is not None:
-            grads, mutated, loss = aot(self.pvals, self.avals, self._key,
-                                       self._scale_state[0], xb, yb)
-        else:
-            grads, mutated, loss = self._jit_call(
-                self._grad_fn,
-                self.pvals, self.avals, self._key, self._scale_state[0],
-                xb, yb)
+        with _tr.span("trainer.dispatch", aot=aot is not None,
+                      micro=self._micro):
+            if aot is not None:
+                grads, mutated, loss = aot(
+                    self.pvals, self.avals, self._key,
+                    self._scale_state[0], xb, yb)
+            else:
+                grads, mutated, loss = self._jit_call(
+                    self._grad_fn,
+                    self.pvals, self.avals, self._key,
+                    self._scale_state[0], xb, yb)
         self._accum = grads if self._accum is None else \
             [a + g for a, g in zip(self._accum, grads)]
         self._micro += 1
@@ -1195,15 +1300,16 @@ class ShardedTrainer:
             lr = jnp.float32(self.learning_rate)
             avg = [g / self.grad_accum for g in self._accum]
             aot = self._aot_fn("apply") if self._aot else None
-            if aot is not None:
-                (self.pvals, self.opt_state, self._scale_state) = aot(
-                    self.pvals, self.opt_state, self._t, lr,
-                    self._scale_state, avg)
-            else:
-                (self.pvals, self.opt_state, self._scale_state) = \
-                    self._jit_call(
-                        self._apply_fn, self.pvals, self.opt_state,
-                        self._t, lr, self._scale_state, avg)
+            with _tr.span("trainer.apply_update", aot=aot is not None):
+                if aot is not None:
+                    (self.pvals, self.opt_state, self._scale_state) = aot(
+                        self.pvals, self.opt_state, self._t, lr,
+                        self._scale_state, avg)
+                else:
+                    (self.pvals, self.opt_state, self._scale_state) = \
+                        self._jit_call(
+                            self._apply_fn, self.pvals, self.opt_state,
+                            self._t, lr, self._scale_state, avg)
             self._accum, self._micro = None, 0
             self._write_back_params()
         # micro-step losses chain to the last apply through pvals, so
@@ -1227,32 +1333,37 @@ class ShardedTrainer:
                 f"({self._micro}/{self.grad_accum} micro-batches pending); "
                 f"step to a window boundary first")
         self.drain()  # retire in-flight steps before snapshotting state
-        blob: Dict[str, Any] = {}
-        for n, v in zip(self.train_names, self.pvals):
-            blob[f"param/{n}"] = onp.asarray(v)
-        for n, v in zip(self.aux_names, self.avals):
-            blob[f"aux/{n}"] = onp.asarray(v)
-        for i, s in enumerate(self.opt_state):
-            a = onp.asarray(s)
-            up = self._leaf_unpad[i]
-            if up is not None:
-                ax, size = up
-                a = a[tuple(slice(size) if k == ax else slice(None)
-                            for k in range(a.ndim))]
-            blob[f"opt/{i}"] = a
-        blob["meta/t"] = onp.asarray(self._t)
-        blob["meta/key"] = onp.asarray(self._key)
-        blob["meta/scale"] = onp.asarray(self._scale_state[0])
-        blob["meta/good"] = onp.asarray(self._scale_state[1])
-        from ..resilience.checkpoint import write_payload
+        with _tr.span("ckpt.save_states", step=self._t):
+            blob: Dict[str, Any] = {}
+            for n, v in zip(self.train_names, self.pvals):
+                blob[f"param/{n}"] = onp.asarray(v)
+            for n, v in zip(self.aux_names, self.avals):
+                blob[f"aux/{n}"] = onp.asarray(v)
+            for i, s in enumerate(self.opt_state):
+                a = onp.asarray(s)
+                up = self._leaf_unpad[i]
+                if up is not None:
+                    ax, size = up
+                    a = a[tuple(slice(size) if k == ax else slice(None)
+                                for k in range(a.ndim))]
+                blob[f"opt/{i}"] = a
+            blob["meta/t"] = onp.asarray(self._t)
+            blob["meta/key"] = onp.asarray(self._key)
+            blob["meta/scale"] = onp.asarray(self._scale_state[0])
+            blob["meta/good"] = onp.asarray(self._scale_state[1])
+            from ..resilience.checkpoint import write_payload
 
-        # atomic (tmp + fsync + os.replace, docs/resilience.md): a
-        # preempted VM mid-write must not tear the only checkpoint
-        write_payload(fname, lambda f: onp.savez(f, **blob))
+            # atomic (tmp + fsync + os.replace, docs/resilience.md): a
+            # preempted VM mid-write must not tear the only checkpoint
+            write_payload(fname, lambda f: onp.savez(f, **blob))
 
     def load_states(self, fname: str):
         """Restore a save_states checkpoint onto THIS trainer's mesh: each
         array is re-placed per the trainer's sharding specs."""
+        with _tr.span("ckpt.load_states"):
+            self._load_states_impl(fname)
+
+    def _load_states_impl(self, fname: str):
         import numpy as onp
 
         with onp.load(fname) as z:

@@ -1,10 +1,18 @@
-"""mx.profiler — tracing/profiling API over jax.profiler.
+"""mx.profiler — tracing/profiling API over jax.profiler + mx.trace.
 
 Ref: python/mxnet/profiler.py + src/profiler/ (2.9k LoC chrome-tracing
 collector). TPU-native: XProf/perfetto traces come from jax.profiler
 (start_trace/stop_trace, TraceAnnotation ≈ ProfileTask/named scopes);
 set_config/set_state/dumps keep the reference API. Autostart via
 MXNET_PROFILER_AUTOSTART like the reference (env_var.md:246).
+
+The reference's host-side event stream is mx.trace (docs/tracing.md):
+Scope/Domain/Task/Frame/Event/Counter/Marker all record onto the span
+recorder, and ``set_state("stop")`` writes ONE Chrome-trace file —
+host spans + native-engine op records, via the single emitter in
+``trace.export`` — next to the configured filename
+(``<filename minus ext>_trace.json``; open in Perfetto).
+``dumps(format="trace")`` returns the same document as a string.
 """
 from __future__ import annotations
 
@@ -15,6 +23,7 @@ from typing import Optional
 
 import jax
 
+from . import trace as _trace
 from .base import get_env
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
@@ -47,6 +56,7 @@ def set_state(state_name: str = "stop", profile_process: str = "worker"):
     elif state_name == "stop" and _state["running"]:
         jax.profiler.stop_trace()
         eng = _engine.get()
+        engine_events = ""
         if hasattr(eng, "profile_stop"):
             eng.profile_stop()
             try:
@@ -56,22 +66,20 @@ def set_state(state_name: str = "stop", profile_process: str = "worker"):
                 # which may belong to ops long before this profiling
                 # session; quiescing is all the profiler needs
                 pass
-            _dump_engine_chrome_trace(eng)
+            if hasattr(eng, "profile_dump"):
+                engine_events = eng.profile_dump()
+        # ONE Chrome-trace emitter (trace.export): recorder spans +
+        # engine op records (+ any legacy trace.json the device
+        # profiler left under the XProf dir) in a single document
+        path = os.path.splitext(_config.get("filename", "profile.json"))[0] \
+            + "_trace.json"
+        _state["trace"] = _trace.export.write(
+            path, engine_events=engine_events or None,
+            xprof_dir=_state.get("dir"))
+        # back-compat key: callers that looked up the old engine-only
+        # chrome dump find the merged file
+        _state["engine_trace"] = _state["trace"]
         _state.update(running=False)
-
-
-def _dump_engine_chrome_trace(eng):
-    """Write the native engine's op records as a chrome://tracing file
-    next to the configured filename (ref src/profiler dumps chrome JSON;
-    open in chrome://tracing or Perfetto)."""
-    events = eng.profile_dump() if hasattr(eng, "profile_dump") else ""
-    if not events:
-        return
-    path = os.path.splitext(_config.get("filename", "profile.json"))[0] \
-        + "_engine.json"
-    with open(path, "w") as f:
-        f.write('{"traceEvents":[' + events + "]}")
-    _state["engine_trace"] = path
 
 
 def state() -> str:
@@ -92,11 +100,17 @@ def dump(finished: bool = True, profile_process: str = "worker"):
 
 
 def dumps(reset: bool = False, format: str = "table") -> str:
-    """Aggregate-stats text (ref profiler.py dumps). Profiler counters +
-    the telemetry registry's aggregate table (one call shows both); kernel-
-    level stats live in the XProf trace."""
+    """Aggregate-stats text (ref profiler.py dumps): profiler counters +
+    the telemetry registry's aggregate table (one call shows both);
+    kernel-level stats live in the XProf trace.
+
+    ``format="trace"`` instead returns the Chrome-trace/Perfetto JSON of
+    everything the span recorder holds (the same document
+    ``set_state("stop")`` writes) — the passthrough to mx.trace."""
     from . import telemetry
 
+    if format == "trace":
+        return _trace.export.dumps()
     lines = ["Profile Statistics:"]
     for name, v in _counters.items():
         lines.append(f"  {name}: {v}")
@@ -109,18 +123,24 @@ def dumps(reset: bool = False, format: str = "table") -> str:
 
 
 class Scope:
-    """Named scope annotated into the device trace (≈ ProfileOperator)."""
+    """Named scope annotated into BOTH traces: the device timeline
+    (jax.profiler.TraceAnnotation ≈ ProfileOperator) and the host span
+    recorder (mx.trace)."""
 
     def __init__(self, name: str = "<unk>:"):
         self.name = name
         self._ctx = None
+        self._span = None
 
     def __enter__(self):
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
+        self._span = _trace.span(f"profiler.{self.name}")
+        self._span.__enter__()
         return self
 
     def __exit__(self, *exc):
+        self._span.__exit__(*exc)
         self._ctx.__exit__(*exc)
 
 
@@ -158,21 +178,23 @@ def _domain_name(domain, name):
 
 
 class Task:
-    """Ref profiler.py Task — host-side duration."""
+    """Ref profiler.py Task — host-side duration, recorded as a span."""
 
     def __init__(self, domain=None, name: str = "task"):
         self.name = _domain_name(domain, name)
         self._start = None
 
     def start(self):
-        self._start = time.monotonic()
+        self._start = time.perf_counter()
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
 
     def stop(self):
         if self._start is not None:
             self._ann.__exit__(None, None, None)
-            _counters[f"task:{self.name}:sec"] = time.monotonic() - self._start
+            dur = time.perf_counter() - self._start
+            _counters[f"task:{self.name}:sec"] = dur
+            _trace.record_span(f"profiler.{self.name}", self._start, dur)
             self._start = None
 
 
@@ -181,20 +203,25 @@ Event = Task
 
 
 class Counter:
-    """Ref profiler.py Counter."""
+    """Ref profiler.py Counter — every write also lands a Chrome "C"
+    counter sample on the trace timeline."""
 
     def __init__(self, domain=None, name: str = "counter", value: int = 0):
         self.name = _domain_name(domain, name)
-        _counters[self.name] = value
+        self._set(value)
+
+    def _set(self, v):
+        _counters[self.name] = v
+        _trace.counter(f"profiler.{self.name}", v)
 
     def set_value(self, v):
-        _counters[self.name] = v
+        self._set(v)
 
     def increment(self, delta=1):
-        _counters[self.name] = _counters.get(self.name, 0) + delta
+        self._set(_counters.get(self.name, 0) + delta)
 
     def decrement(self, delta=1):
-        _counters[self.name] = _counters.get(self.name, 0) - delta
+        self._set(_counters.get(self.name, 0) - delta)
 
 
 class Marker:
@@ -203,6 +230,7 @@ class Marker:
 
     def mark(self, scope="process"):
         _counters[f"marker:{self.name}"] = time.monotonic()
+        _trace.instant(f"profiler.{self.name}", scope=scope)
 
 
 if get_env("MXNET_PROFILER_AUTOSTART", 0, int):

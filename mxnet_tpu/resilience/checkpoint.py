@@ -62,6 +62,7 @@ from typing import Any, Callable, List, Optional, Union
 
 from .. import telemetry as _tel
 from ..base import MXNetError, get_env
+from ..trace import recorder as _tr
 from . import chaos as _chaos
 
 __all__ = ["atomic_replace", "atomic_write", "write_payload",
@@ -200,13 +201,10 @@ def write_payload(path: str, data: Union[bytes, Callable]):
     detector, not just the load-failure fallback)."""
     in_commit = getattr(_TLS, "in_commit", False)
     site = None if in_commit else "ckpt.write"
+    with _tr.span("ckpt.write", timer="ckpt.write_seconds"):
+        atomic_write(path, data, fault_site=site)
     if _tel._ENABLED:
-        t0 = _time.perf_counter()
-        atomic_write(path, data, fault_site=site)
-        _tel.observe("ckpt.write_seconds", _time.perf_counter() - t0)
         _tel.inc("ckpt.saves")
-    else:
-        atomic_write(path, data, fault_site=site)
 
 
 # -- process-group helpers (no hard jax dependency) ---------------------------
@@ -351,10 +349,8 @@ class CheckpointManager:
         err: Optional[BaseException] = None
         if rank == 0:
             try:
-                if _tel._ENABLED:
-                    with _tel.timer("ckpt.save_seconds"):
-                        final = self._commit(step, trainer, payload)
-                else:
+                with _tr.span("ckpt.save", timer="ckpt.save_seconds",
+                              timer_on_error=True, step=step):
                     final = self._commit(step, trainer, payload)
                 _tel.set_gauge("ckpt.last_step", step)
             except BaseException as e:  # noqa: BLE001 — barrier first
@@ -547,33 +543,40 @@ class CheckpointManager:
             raise MXNetError("restore_latest() needs a trainer")
         t0 = _time.perf_counter()
         load_failed_at = None
-        for step in sorted(self.steps(), reverse=True):
-            if not self.verify(step):
-                _tel.inc("ckpt.corrupt_skipped")
-                log.warning(
-                    "checkpoint %s is torn/corrupt (manifest or CRC "
-                    "mismatch); skipping to an older version",
-                    self.path_of(step))
-                continue
-            try:
-                trainer.load_states(self.payload_path(step))
-            except Exception:
-                _tel.inc("ckpt.corrupt_skipped")
-                if load_failed_at is None:
-                    load_failed_at = step
-                log.exception(
-                    "checkpoint %s passed CRC but load_states rejected "
-                    "it; skipping to an older version", self.path_of(step))
-                continue
-            _tel.inc("ckpt.restores")
-            _tel.observe("ckpt.restore_seconds",
-                         _time.perf_counter() - t0)
-            _tel.set_gauge("ckpt.last_step", step)
-            return step
-        if load_failed_at is not None:
-            raise MXNetError(
-                f"restore failed: load_states raised on step-"
-                f"{load_failed_at} (and no older version loaded) after "
-                "possibly half-mutating the trainer; its state is "
-                "undefined — reinitialize the trainer before training")
-        return None
+        # the span covers the whole scan (skipped versions included),
+        # so a restore that walked back through corrupt checkpoints
+        # shows the walk on the timeline; the telemetry timer keeps its
+        # success-only semantics
+        with _tr.span("ckpt.restore"):
+            for step in sorted(self.steps(), reverse=True):
+                if not self.verify(step):
+                    _tel.inc("ckpt.corrupt_skipped")
+                    log.warning(
+                        "checkpoint %s is torn/corrupt (manifest or CRC "
+                        "mismatch); skipping to an older version",
+                        self.path_of(step))
+                    continue
+                try:
+                    trainer.load_states(self.payload_path(step))
+                except Exception:
+                    _tel.inc("ckpt.corrupt_skipped")
+                    if load_failed_at is None:
+                        load_failed_at = step
+                    log.exception(
+                        "checkpoint %s passed CRC but load_states "
+                        "rejected it; skipping to an older version",
+                        self.path_of(step))
+                    continue
+                _tel.inc("ckpt.restores")
+                _tel.observe("ckpt.restore_seconds",
+                             _time.perf_counter() - t0)
+                _tel.set_gauge("ckpt.last_step", step)
+                return step
+            if load_failed_at is not None:
+                raise MXNetError(
+                    f"restore failed: load_states raised on step-"
+                    f"{load_failed_at} (and no older version loaded) "
+                    "after possibly half-mutating the trainer; its state "
+                    "is undefined — reinitialize the trainer before "
+                    "training")
+            return None

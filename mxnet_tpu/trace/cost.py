@@ -1,0 +1,181 @@
+"""XLA cost attribution — the standing version of PERF.md's analysis.
+
+PERF.md round 2 had to reconstruct "what does the chip actually
+execute" by hand: lower the step, ``compiled.cost_analysis()``, divide
+by wall time, compare against peak.  This module makes that a
+registry: every cached executable the stack compiles can
+:func:`register` its XLA-counted FLOPs / bytes-accessed, and
+:func:`publish` turns a measured seconds-per-execution into standing
+telemetry gauges —
+
+    ``trainer.xla_flops_per_sec``   achieved FLOP/s against XLA's own
+                                    count of the compiled program
+    ``trainer.xla_utilization``     that rate over the chip's peak
+                                    (0.0 when the peak is unknown —
+                                    see :func:`peak_flops`)
+    ``trainer.xla_bytes_per_sec``   cost_analysis "bytes accessed" rate
+    ``trainer.xla_hbm_utilization`` over peak HBM bandwidth (same
+                                    unknown-peak convention)
+
+— so ``bench.py`` rows carry BOTH the paper-FLOP MFU (the external
+comparison number) and the XLA-counted utilization (what fraction of
+the hardware the *compiled program* achieved; PERF.md: ~15% vs ~28% on
+ResNet-50).  Caveat carried over from PERF.md: XLA's "bytes accessed"
+over-counts per-fusion operand reads, so the HBM figure is an upper
+bound on real traffic, not a measurement.
+
+Peaks: known TPU device kinds resolve from a built-in table;
+``MXNET_PEAK_FLOPS`` / ``MXNET_PEAK_HBM_GBPS`` override (and are the
+only way to get a non-zero utilization on CPU hosts, whose peak this
+module does not guess).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from .. import telemetry as _tel
+from ..base import get_env
+
+__all__ = ["extract", "register", "get", "snapshot", "reset",
+           "peak_flops", "peak_hbm_bytes_per_sec", "publish"]
+
+_LOCK = threading.Lock()
+_COSTS: Dict[Any, Dict[str, Any]] = {}
+
+# bf16 peak FLOP/s per chip by device-kind substring (same table bench.py
+# MFU uses) and HBM bytes/s; unknown kinds -> None, never a guess
+_PEAK_FLOPS = {"v5 lite": 197e12, "v5litepod": 197e12, "v4": 275e12,
+               "v5p": 459e12, "v6 lite": 918e12, "v6e": 918e12}
+_PEAK_HBM = {"v5 lite": 819e9, "v5litepod": 819e9, "v4": 1228e9,
+             "v5p": 2765e9, "v6 lite": 1640e9, "v6e": 1640e9}
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+
+        return jax.devices()[0].device_kind.lower()
+    except Exception:
+        return ""
+
+
+def peak_flops() -> Optional[float]:
+    """This host's peak FLOP/s: ``MXNET_PEAK_FLOPS`` override, else the
+    TPU device-kind table, else None (CPU and unknown kinds)."""
+    env = get_env("MXNET_PEAK_FLOPS", None, float)
+    if env:
+        return env
+    kind = _device_kind()
+    return next((v for k, v in _PEAK_FLOPS.items() if k in kind), None)
+
+
+def peak_hbm_bytes_per_sec() -> Optional[float]:
+    """Peak HBM bytes/s: ``MXNET_PEAK_HBM_GBPS`` (GB/s) override, else
+    the device-kind table, else None."""
+    env = get_env("MXNET_PEAK_HBM_GBPS", None, float)
+    if env:
+        return env * 1e9
+    kind = _device_kind()
+    return next((v for k, v in _PEAK_HBM.items() if k in kind), None)
+
+
+def extract(compiled) -> Optional[Dict[str, float]]:
+    """Pull ``cost_analysis()`` off a jax compiled executable →
+    ``{"flops": ..., "bytes_accessed": ...}`` (None when the backend
+    offers no analysis)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = ca.get("flops")
+    nbytes = ca.get("bytes accessed")
+    if flops is None and nbytes is None:
+        return None
+    return {"flops": float(flops or 0.0),
+            "bytes_accessed": float(nbytes or 0.0)}
+
+
+def register(key, compiled=None, info: Optional[dict] = None,
+             accumulate: bool = False) -> Optional[Dict[str, Any]]:
+    """Record the cost of one executable under ``key`` (any hashable —
+    the trainer keys on ``(net type, slot, batch signature)``).  Pass
+    either the compiled executable or a pre-extracted ``info`` dict.
+    ``accumulate=True`` adds onto an existing entry (the grad-accum
+    trainer sums its grad and apply executables into one step cost).
+    Returns the stored entry, or None when nothing was extractable."""
+    if info is None:
+        if compiled is None:
+            return None
+        info = extract(compiled)
+        if info is None:
+            return None
+    with _LOCK:
+        cur = _COSTS.get(key)
+        if cur is not None and accumulate:
+            cur = {"flops": cur["flops"] + info.get("flops", 0.0),
+                   "bytes_accessed": cur["bytes_accessed"]
+                   + info.get("bytes_accessed", 0.0)}
+        else:
+            cur = {"flops": float(info.get("flops", 0.0)),
+                   "bytes_accessed": float(info.get("bytes_accessed",
+                                                    0.0))}
+        _COSTS[key] = cur
+        n = len(_COSTS)
+    if _tel._ENABLED:
+        _tel.set_gauge("trace.cost_executables", n)
+    return dict(cur)
+
+
+def get(key) -> Optional[Dict[str, Any]]:
+    with _LOCK:
+        info = _COSTS.get(key)
+    return dict(info) if info is not None else None
+
+
+def snapshot() -> Dict[str, Dict[str, Any]]:
+    """Every registered executable's cost, keyed by ``str(key)``."""
+    with _LOCK:
+        return {str(k): dict(v) for k, v in _COSTS.items()}
+
+
+def reset():
+    with _LOCK:
+        _COSTS.clear()
+
+
+def publish(key, seconds_per_execution: float,
+            prefix: str = "trainer") -> Dict[str, Any]:
+    """Turn a measured wall time per execution of ``key`` into the
+    utilization gauges + a row-ready dict (bench columns).  Unknown
+    ``key`` → ``{}``; unknown peak → utilization gauges publish 0.0
+    (the documented "peak unknown" sentinel) and the returned dict
+    carries None so artifacts stay honest."""
+    info = get(key)
+    if info is None or seconds_per_execution <= 0.0:
+        return {}
+    fps = info["flops"] / seconds_per_execution
+    bps = info["bytes_accessed"] / seconds_per_execution
+    pf = peak_flops()
+    pb = peak_hbm_bytes_per_sec()
+    util = (fps / pf) if pf else None
+    hbm_util = (bps / pb) if pb else None
+    if _tel._ENABLED:
+        _tel.set_gauge(f"{prefix}.xla_flops_per_sec", round(fps, 3))
+        _tel.set_gauge(f"{prefix}.xla_bytes_per_sec", round(bps, 3))
+        _tel.set_gauge(f"{prefix}.xla_utilization",
+                       round(util, 9) if util is not None else 0.0)
+        _tel.set_gauge(f"{prefix}.xla_hbm_utilization",
+                       round(hbm_util, 9) if hbm_util is not None else 0.0)
+    # 9 decimals: smoke-scale models legitimately measure micro-GFLOPs
+    # and micro-utilizations; coarser rounding would zero them out
+    return {"xla_gflops_per_step": round(info["flops"] / 1e9, 9),
+            "xla_gbytes_per_step": round(info["bytes_accessed"] / 1e9, 9),
+            "xla_flops_per_sec": round(fps, 3),
+            "xla_utilization": round(util, 9) if util is not None else None,
+            "xla_hbm_utilization": (round(hbm_util, 9)
+                                    if hbm_util is not None else None)}

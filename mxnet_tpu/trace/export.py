@@ -1,0 +1,158 @@
+"""Chrome-trace / Perfetto export — the ONE timeline emitter.
+
+Everything that produces a trace file goes through here: the span
+recorder's host events, the native engine's op records
+(``engine.profile_dump`` — already chrome-event JSON objects on the
+same CLOCK_MONOTONIC timebase), optional device-trace events from a
+``jax.profiler`` session directory, and the flight recorder's crash
+dumps.  ``mx.profiler`` used to hand-roll its own engine-event schema
+(``_dump_engine_chrome_trace``); that emitter is gone — it calls
+:func:`write` now.
+
+Output is the Chrome Trace Event Format (load in Perfetto's
+https://ui.perfetto.dev or chrome://tracing)::
+
+    {"displayTimeUnit": "ms",
+     "metadata": {...},            # pid, unix epoch of ts 0, reason
+     "traceEvents": [
+       {"name": "trainer.step", "cat": "trainer", "ph": "X",
+        "ts": <us>, "dur": <us>, "pid": ..., "tid": ...,
+        "args": {"step": 17}},
+       ...]}
+
+``cat`` is the span name's subsystem prefix (the segment before the
+first dot) — the Perfetto query surface ``make trace-smoke`` counts
+subsystem coverage with.  Timestamps stay in the process's
+``perf_counter`` domain (microseconds); ``metadata.epoch_unix_ts``
+maps them back to wall-clock.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from . import recorder as _rec
+
+__all__ = ["chrome_events", "document", "dumps", "write"]
+
+
+def _cat(name: str) -> str:
+    return name.split(".", 1)[0]
+
+
+_PH = {"X": "X", "B": "B", "E": "E", "i": "i", "C": "C"}
+
+
+def chrome_events(engine_events: Optional[str] = None,
+                  xprof_dir: Optional[str] = None) -> List[dict]:
+    """Buffered recorder events (+ optional merges) as chrome dicts.
+
+    ``engine_events`` is the comma-separated chrome-JSON string
+    ``engine.profile_dump()`` returns (the caller drains the engine —
+    this function must not steal events from a live profiling session).
+    ``xprof_dir`` is a ``jax.profiler`` trace directory; any
+    ``*.trace.json[.gz]`` files a TensorFlow-era profiler wrote there
+    are merged in (newer XProf sessions emit ``.xplane.pb`` only — the
+    device timeline then lives in XProf/TensorBoard, not this file)."""
+    pid = os.getpid()
+    out: List[dict] = []
+    threads = {}
+    for e in _rec.events():
+        threads.setdefault(e["tid"], e["thread"])
+        args: Dict[str, Any] = dict(e["corr"])
+        if e["attrs"]:
+            args.update(e["attrs"])
+        ev = {"name": e["name"], "cat": _cat(e["name"]),
+              "ph": _PH.get(e["kind"], "X"), "pid": pid, "tid": e["tid"],
+              "ts": round(e["ts"] * 1e6, 3)}
+        if e["kind"] == "X":
+            ev["dur"] = round(e["dur"] * 1e6, 3)
+        if e["kind"] == "i":
+            ev["s"] = "t"  # instant scope: thread
+        if e["kind"] == "C":
+            ev["args"] = {"value": args.get("value", 0)}
+        elif args:
+            ev["args"] = args
+        out.append(ev)
+    for tid, name in threads.items():
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": name}})
+    if engine_events:
+        try:
+            native = json.loads("[" + engine_events + "]")
+        except ValueError:
+            native = []
+        for ev in native:
+            # engine.cc stamps pid 0; fold its ops into this process's
+            # track (same CLOCK_MONOTONIC microsecond domain) under a
+            # cat of their own
+            ev["pid"] = pid
+            ev.setdefault("cat", "engine")
+            out.append(ev)
+    if xprof_dir:
+        out.extend(_device_events(xprof_dir))
+    return out
+
+
+def _device_events(xprof_dir: str) -> List[dict]:
+    """Best-effort device-trace merge from a jax.profiler session dir."""
+    out: List[dict] = []
+    pats = [os.path.join(xprof_dir, "**", "*.trace.json"),
+            os.path.join(xprof_dir, "**", "*.trace.json.gz")]
+    for pat in pats:
+        for path in glob.glob(pat, recursive=True):
+            try:
+                if path.endswith(".gz"):
+                    with gzip.open(path, "rt") as f:
+                        doc = json.load(f)
+                else:
+                    with open(path) as f:
+                        doc = json.load(f)
+                evs = doc.get("traceEvents", doc) or []
+                if isinstance(evs, list):
+                    out.extend(e for e in evs if isinstance(e, dict))
+            except (OSError, ValueError):
+                continue
+    return out
+
+
+def document(engine_events: Optional[str] = None,
+             xprof_dir: Optional[str] = None,
+             metadata: Optional[dict] = None) -> dict:
+    """The full exportable trace document."""
+    meta = {"pid": os.getpid(),
+            "epoch_unix_ts": round(_rec.EPOCH_OFFSET, 6),
+            "unix_ts": round(time.time(), 3),
+            "trace_enabled": _rec.enabled(),
+            "ring_capacity": _rec.ring_capacity()}
+    if metadata:
+        meta.update(metadata)
+    return {"displayTimeUnit": "ms", "metadata": meta,
+            "traceEvents": chrome_events(engine_events, xprof_dir)}
+
+
+def dumps(engine_events: Optional[str] = None,
+          xprof_dir: Optional[str] = None,
+          metadata: Optional[dict] = None) -> str:
+    """The trace document as a JSON string."""
+    return json.dumps(document(engine_events, xprof_dir, metadata))
+
+
+def write(path: str, engine_events: Optional[str] = None,
+          xprof_dir: Optional[str] = None,
+          metadata: Optional[dict] = None) -> str:
+    """Write the trace document to ``path`` (atomic rename) and return
+    the path — ``mx.profiler.set_state("stop")`` and the flight
+    recorder both land through here."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(document(engine_events, xprof_dir, metadata), f)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path

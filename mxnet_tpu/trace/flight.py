@@ -1,0 +1,165 @@
+"""Flight recorder — dump the span rings at the moment of failure.
+
+The chaos-smoke failure modes (a wedged ``dist.barrier``, a leaked
+prefetch thread, a fault-injection abort) used to die as a stack-less
+timeout or a bare ``ChaosError``; the span rings (``trace.recorder``)
+are an always-on bounded black box of the last N events per thread, and
+this module writes them to disk when something goes wrong:
+
+  * **Error trigger** — arming installs a hook on ``MXNetError``
+    *construction* (``base.set_error_hook``), so the dump happens at
+    the failure point even when the error is later caught and handled
+    (fault-injection ``ChaosError`` s are routinely caught by recovery
+    paths — the timeline of what led up to them is the point).
+    ``DeferredInitializationError`` is exempt (raised/caught as normal
+    control flow by deferred parameter init).
+  * **Hang trigger** — ``MXNET_TRACE_HANG_TIMEOUT=<seconds>`` starts a
+    watchdog thread that dumps once when no span event has been
+    recorded for that long (an instrumented process that stops
+    producing events is wedged: a barrier waiting on a dead peer, a
+    prefetch producer stuck in ``next()``).  It re-arms after new
+    activity.
+
+Dumps are Perfetto-loadable trace documents (``trace.export``) with
+``metadata.flight = {"reason": ..., "seq": ...}``, written to
+``MXNET_TRACE_DIR`` as ``flight-<pid>-<seq>.json`` and capped at
+``MXNET_TRACE_FLIGHT_MAX`` (default 5) per process so an error storm
+cannot fill a disk.
+
+Arming is explicit: set ``MXNET_TRACE_DIR`` (and optionally
+``MXNET_TRACE_HANG_TIMEOUT``) in the environment — ``mxnet_tpu.trace``
+arms itself at import — or call :func:`arm` from code.  Unarmed, this
+module costs nothing: no hook, no thread.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from .. import base as _base
+from ..base import get_env
+from . import export as _export
+from . import recorder as _rec
+
+__all__ = ["arm", "disarm", "armed", "dump", "dump_dir"]
+
+log = logging.getLogger(__name__)
+
+_LOCK = threading.Lock()
+_ARMED = False
+_DIR: Optional[str] = None
+_DUMPED = 0
+_TLS = threading.local()
+_WATCHDOG: Optional[threading.Thread] = None
+_WATCHDOG_STOP = threading.Event()
+
+
+def armed() -> bool:
+    return _ARMED
+
+
+def dump_dir() -> Optional[str]:
+    return _DIR
+
+
+def dump(reason: str = "", path: Optional[str] = None) -> Optional[str]:
+    """Write one flight dump (rate-limited unless ``path`` is given);
+    returns the written path or None when suppressed/failed."""
+    global _DUMPED
+    if path is None:
+        with _LOCK:
+            limit = get_env("MXNET_TRACE_FLIGHT_MAX", 5, int)
+            if _DUMPED >= limit:
+                return None
+            _DUMPED += 1
+            seq = _DUMPED
+        d = _DIR or os.getcwd()
+        path = os.path.join(d, f"flight-{os.getpid()}-{seq}.json")
+    else:
+        seq = _rec.next_id("flight")
+    try:
+        out = _export.write(path, metadata={
+            "flight": {"reason": reason[:500], "seq": seq,
+                       "unix_ts": round(time.time(), 3)}})
+        log.warning("trace flight recorder: dumped span rings to %s (%s)",
+                    out, reason[:200] or "explicit dump")
+        return out
+    except OSError as e:
+        log.warning("trace flight recorder: dump to %s failed: %s",
+                    path, e)
+        return None
+
+
+def _on_error(exc: BaseException):
+    # deferred-init errors are caught control flow, not failures; and a
+    # dump that itself raises MXNetError must not recurse
+    if type(exc).__name__ == "DeferredInitializationError":
+        return
+    if getattr(_TLS, "dumping", False):
+        return
+    _TLS.dumping = True
+    try:
+        dump(reason=f"{type(exc).__name__}: {exc}")
+    finally:
+        _TLS.dumping = False
+
+
+def _watchdog_loop(timeout: float):
+    fired_at = -1.0
+    interval = min(max(timeout / 4.0, 0.05), 2.0)
+    while not _WATCHDOG_STOP.wait(interval):
+        last = _rec.last_event_time()
+        if last <= 0.0:
+            continue  # no activity yet — nothing to be wedged
+        if last == fired_at:
+            continue  # already dumped for this stall; wait for progress
+        stalled = time.perf_counter() - last
+        if stalled >= timeout:
+            dump(reason=f"hang: no span events for {stalled:.1f}s "
+                        f"(MXNET_TRACE_HANG_TIMEOUT={timeout})")
+            fired_at = last
+
+
+def arm(directory: Optional[str] = None,
+        hang_timeout: Optional[float] = None) -> str:
+    """Arm the flight recorder: install the error hook, remember the
+    dump directory, and (when ``hang_timeout`` / the env var is set)
+    start the hang watchdog.  Idempotent; returns the dump dir."""
+    global _ARMED, _DIR, _WATCHDOG
+    with _LOCK:
+        _DIR = os.path.abspath(
+            directory or os.environ.get("MXNET_TRACE_DIR") or os.getcwd())
+        os.makedirs(_DIR, exist_ok=True)
+        if not _ARMED:
+            _base.set_error_hook(_on_error)
+            _ARMED = True
+        if hang_timeout is None:
+            hang_timeout = get_env("MXNET_TRACE_HANG_TIMEOUT", None, float)
+        if hang_timeout and _WATCHDOG is None:
+            _WATCHDOG_STOP.clear()
+            _WATCHDOG = threading.Thread(
+                target=_watchdog_loop, args=(float(hang_timeout),),
+                name="mx-trace-watchdog", daemon=True)
+            _WATCHDOG.start()
+    return _DIR
+
+
+def disarm():
+    """Remove the error hook and stop the watchdog (tests)."""
+    global _ARMED, _WATCHDOG, _DUMPED
+    with _LOCK:
+        if _ARMED:
+            _base.set_error_hook(None)
+            _ARMED = False
+        watchdog, _WATCHDOG = _WATCHDOG, None
+        _WATCHDOG_STOP.set()
+    if watchdog is not None:
+        # join OUTSIDE the lock: a watchdog mid-dump needs _LOCK for its
+        # rate-limit check, so joining while holding it would deadlock
+        # until the timeout and let the dump land after disarm returned
+        watchdog.join(timeout=5.0)
+    with _LOCK:
+        _DUMPED = 0

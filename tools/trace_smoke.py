@@ -1,0 +1,202 @@
+"""Trace smoke gate (`make trace-smoke`).
+
+The observability ISSUE's acceptance run for mx.trace (docs/tracing.md).
+Trains LeNet through the full instrumented stack — DataLoader →
+DevicePrefetcher → ShardedTrainer, plus an engine-backed eval pass, a
+checkpoint save, and a fault-injected dist.barrier — then FAILS
+(exit 1) unless:
+
+  * the Perfetto/Chrome-trace export parses and contains span events
+    from at least ``MIN_SUBSYSTEMS`` (6) distinct subsystems
+    (``cat`` = span-name prefix: trainer, pipeline, dataloader,
+    hybridize, engine, ckpt, dist, ...);
+  * trace-on overhead is ≤5% of step wall time vs ``MXNET_TRACE=0``
+    (min-of-3 alternated timed passes, so a single scheduler hiccup
+    cannot fail the gate);
+  * a forced ``dist.barrier`` fault (``MXNET_FAULT_INJECT``-style
+    ChaosError) leaves a flight-recorder dump on disk, and the dump is
+    itself a parseable trace document naming the error.
+
+Writes ``trace_smoke.json``.  Serial — single-core box, never run
+concurrently with tier-1 (ROADMAP note).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable as `python tools/trace_smoke.py` from a source checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = 20
+BATCH = 32
+MIN_SUBSYSTEMS = 6
+MAX_OVERHEAD = 1.05
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    def ce(pred, y):
+        logp = jax.nn.log_softmax(pred.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.get_model("lenet")
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((2, 1, 28, 28)))
+    mesh = make_mesh({"dp": -1}, devices=jax.devices()[:1])
+    return ShardedTrainer(net, ce, mesh=mesh, optimizer="sgd",
+                          learning_rate=0.05, momentum=0.9)
+
+
+def _timed_steps(trainer, x, y, n) -> float:
+    t0 = time.perf_counter()
+    for _ in range(n):
+        trainer.step(x, y)
+    trainer.drain()
+    return time.perf_counter() - t0
+
+
+def main() -> int:
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry, trace
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    from mxnet_tpu.parallel import dist
+    from mxnet_tpu.resilience import chaos
+    from mxnet_tpu.trace import flight
+
+    if not trace.enabled():
+        print("trace-smoke: MXNET_TRACE=0 — nothing to verify; run with "
+              "tracing enabled", file=sys.stderr)
+        return 1
+    checks = {}
+
+    # -- coverage pass: the instrumented stack end to end -------------------
+    trainer = _build()
+    rs = onp.random.RandomState(0)
+    xs = rs.rand(STEPS * BATCH, 1, 28, 28).astype("float32")
+    ys = rs.randint(0, 10, size=(STEPS * BATCH,)).astype("int32")
+    loader = DataLoader(ArrayDataset(xs, ys), batch_size=BATCH,
+                        prefetch_to_device=trainer)
+    steps = 0
+    for xb, yb in loader:
+        trainer.step(xb, yb)
+        steps += 1
+    trainer.drain()
+    loader.close()
+    checks["steps"] = steps
+    with tempfile.TemporaryDirectory(prefix="mx-trace-smoke-") as td:
+        trainer.save_states(os.path.join(td, "state.npz"))
+
+        # engine-backed input path (engine.push / engine.op spans)
+        it = mx.io.PrefetchingIter(
+            mx.io.NDArrayIter(xs[:2 * BATCH], ys[:2 * BATCH],
+                              batch_size=BATCH))
+        for batch in it:
+            batch.data[0].wait_to_read()
+
+        # -- flight recorder: forced dist.barrier fault ---------------------
+        fdir = os.path.join(td, "flight")
+        flight.arm(fdir)
+        chaos.configure("dist.barrier:error:1.0")
+        barrier_raised = False
+        try:
+            dist.barrier("trace_smoke_fault")
+        except chaos.ChaosError:
+            barrier_raised = True
+        chaos.reset()
+        flight.disarm()
+        checks["barrier_fault_raised"] = barrier_raised
+        dumps = sorted(f for f in os.listdir(fdir)
+                       if f.startswith("flight-")) if \
+            os.path.isdir(fdir) else []
+        checks["flight_dumps"] = len(dumps)
+        flight_ok = False
+        if dumps:
+            with open(os.path.join(fdir, dumps[0])) as f:
+                doc = json.load(f)
+            reason = doc.get("metadata", {}).get("flight", {}).get(
+                "reason", "")
+            flight_ok = bool(doc.get("traceEvents")) and \
+                "ChaosError" in reason
+            checks["flight_reason"] = reason[:120]
+        checks["flight_dump_ok"] = flight_ok
+
+    # -- export gate: one parseable Perfetto document -----------------------
+    doc = json.loads(mx.profiler.dumps(format="trace"))
+    events = doc.get("traceEvents", [])
+    cats = sorted({e.get("cat") for e in events
+                   if e.get("ph") in ("X", "B", "i") and e.get("cat")})
+    checks["span_events"] = sum(1 for e in events
+                                if e.get("ph") in ("X", "B", "i"))
+    checks["subsystems"] = cats
+    checks["subsystem_count"] = len(cats)
+    step_corr = sorted({e.get("args", {}).get("step") for e in events
+                        if isinstance(e.get("args"), dict)
+                        and "step" in e.get("args", {})})
+    checks["step_correlation_seen"] = bool(step_corr)
+
+    # -- overhead: trace ON vs MXNET_TRACE=0, min of 3 alternated passes ----
+    x = xs[:BATCH]
+    y = ys[:BATCH]
+    _timed_steps(trainer, x, y, 3)  # settle any residual compile
+    on_walls, off_walls = [], []
+    for _ in range(3):
+        trace.set_enabled(True)
+        on_walls.append(_timed_steps(trainer, x, y, STEPS))
+        trace.set_enabled(False)
+        off_walls.append(_timed_steps(trainer, x, y, STEPS))
+    trace.set_enabled(True)
+    ratio = min(on_walls) / min(off_walls)
+    checks["overhead_ratio"] = round(ratio, 4)
+    checks["wall_on_secs"] = round(min(on_walls), 4)
+    checks["wall_off_secs"] = round(min(off_walls), 4)
+
+    ok = (steps == STEPS
+          and checks["subsystem_count"] >= MIN_SUBSYSTEMS
+          and checks["span_events"] > 0
+          and checks["step_correlation_seen"]
+          and ratio <= MAX_OVERHEAD
+          and checks["barrier_fault_raised"]
+          and checks["flight_dump_ok"])
+
+    out_path = os.environ.get("MXNET_TRACE_SMOKE_JSON") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "trace_smoke.json")
+    with open(out_path, "w") as f:
+        json.dump({"steps": STEPS, "batch": BATCH, "ok": ok,
+                   "checks": checks,
+                   "telemetry": telemetry.snapshot()}, f, indent=2,
+                  sort_keys=True, default=str)
+        f.write("\n")
+
+    print(f"trace-smoke: {steps} steps x batch {BATCH} -> {out_path}")
+    print(f"  subsystems ({checks['subsystem_count']})      {cats}")
+    print(f"  span events                  {checks['span_events']}")
+    print(f"  overhead (on/off)            {checks['overhead_ratio']} "
+          f"({checks['wall_on_secs']}s / {checks['wall_off_secs']}s)")
+    print(f"  flight dump on barrier fault {checks['flight_dump_ok']}")
+    if not ok:
+        print("trace-smoke: FAILED — a tracing seam regressed "
+              "(docs/tracing.md)", file=sys.stderr)
+        return 1
+    print("trace-smoke: OK — timeline, overhead, and flight recorder all "
+          "held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
