@@ -17,7 +17,7 @@ BUILD    := build
 
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
-	trace-smoke lint-hybrid ci clean
+	trace-smoke kernels-smoke lint-hybrid ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -114,6 +114,16 @@ trace-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		MXNET_TRACE=1 python tools/trace_smoke.py
 
+kernels-smoke:
+	# mx.kernels gate: tiny-BERT must train through the pallas-interpret
+	# flash attention fwd+bwd matching the kernels-off run, the flat-arena
+	# optimizer step HLO must carry no per-leaf concatenate/stack of
+	# params, and a CPU-relative bench delta is recorded to
+	# kernels_smoke.json (docs/kernels.md).  Serial — single-core box,
+	# never concurrent with tier-1.
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		python tools/kernels_smoke.py
+
 lint-hybrid:
 	# hybridize-safety static analysis (docs/analysis.md). The committed
 	# baseline makes legacy suppressions explicit; NEW violations fail.
@@ -123,7 +133,8 @@ lint-hybrid:
 		mxnet_tpu example benchmark
 
 ci: native native-test asan tsan lint-hybrid test test-slow telemetry-smoke \
-	pipeline-smoke chaos-smoke warmup-smoke spmd-smoke trace-smoke
+	pipeline-smoke chaos-smoke warmup-smoke spmd-smoke trace-smoke \
+	kernels-smoke
 
 clean:
 	rm -rf $(BUILD)

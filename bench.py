@@ -120,16 +120,23 @@ def _xla_cols(trainer, x, y, secs, n_steps):
 
 
 def _trainer_cols(trainer):
-    """Sharding columns every BENCH/MULTICHIP row carries: the mesh
-    shape, the weight-update partition (select zero1 for a whole run via
-    MXNET_PARTITION=zero1 — ShardedTrainer's env default), and the
-    measured per-device optimizer-state bytes, so the ZeRO-1 memory win
-    lands in the perf trajectory even while headlines are banked
-    (docs/sharding.md)."""
+    """Sharding + kernel columns every BENCH/MULTICHIP row carries: the
+    mesh shape, the weight-update partition (select zero1 for a whole run
+    via MXNET_PARTITION=zero1 — ShardedTrainer's env default), the
+    measured per-device optimizer-state bytes, and the kernels config
+    (MXNET_KERNELS mode + whether THIS trainer runs the flat-arena
+    optimizer), so kernel-on vs kernel-off runs stay distinguishable in
+    the perf trajectory (docs/sharding.md, docs/kernels.md)."""
+    from mxnet_tpu import kernels as _kern
+    from mxnet_tpu.parallel.trainer import _ArenaOptAdapter
+
     return {"mesh_shape": dict(trainer.mesh.shape),
             "partition": trainer.partition,
             "opt_state_bytes_per_device":
-                trainer.opt_state_bytes_per_device}
+                trainer.opt_state_bytes_per_device,
+            "kernels": _kern.mode(),
+            "fused_opt_arena": isinstance(trainer._adapter,
+                                          _ArenaOptAdapter)}
 
 
 def _timed_warmup(make_trainer, x, y, n_steps=2):
@@ -189,8 +196,13 @@ def bench_resnet50(on_tpu):
     # (MXU-friendly 3->12 channel packing; PERF.md) — a model variant, so
     # opt-in; the default row stays the reference-architecture number
     stem = os.environ.get("MXNET_BENCH_STEM", "default")
+    # MXNET_BENCH_FUSED_BN=1 builds the fused BatchNormReLU zoo variant
+    # (single-pass Pallas BN-stat+relu kernels when MXNET_KERNELS is
+    # active, docs/kernels.md) — like the stem, a model variant, opt-in
+    fused_bn = os.environ.get("MXNET_BENCH_FUSED_BN", "0") == "1"
     net = mx.gluon.model_zoo.get_model("resnet50_v1", layout=layout,
-                                       stem_type=stem)
+                                       stem_type=stem,
+                                       fused_bn_relu=fused_bn)
     net.initialize(mx.init.Xavier())
     shape = ((2, image, image, 3) if layout == "NHWC"
              else (2, 3, image, image))

@@ -90,6 +90,7 @@ from . import _ffi
 from . import contrib
 from . import parallel
 from . import jit
+from . import kernels
 from . import resilience
 from . import test_utils
 

@@ -25,15 +25,28 @@ def _conv3x3(channels, stride, in_channels=0, layout="NCHW"):
 from ._common import bn_axis as _bn_axis
 
 
+def _bn_act(ax, fused):
+    """BN→relu as layer list: the fused ``BatchNormReLU`` (single-pass
+    Pallas statistics+act when the kernels layer is active,
+    docs/kernels.md) or the reference BatchNorm + Activation pair.
+    ``fused_bn_relu=True`` changes child indices (one layer instead of
+    two), so it is an opt-in VARIANT — not weight-compatible with the
+    default structure."""
+    if fused:
+        from ...nn.extended_layers import BatchNormReLU
+
+        return [BatchNormReLU(axis=ax)]
+    return [nn.BatchNorm(axis=ax), nn.Activation("relu")]
+
+
 class BasicBlockV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kw):
+                 layout="NCHW", fused_bn_relu=False, **kw):
         super().__init__(**kw)
         ax = _bn_axis(layout)
         self.body = nn.HybridSequential()
         self.body.add(_conv3x3(channels, stride, in_channels, layout),
-                      nn.BatchNorm(axis=ax),
-                      nn.Activation("relu"),
+                      *_bn_act(ax, fused_bn_relu),
                       _conv3x3(channels, 1, channels, layout),
                       nn.BatchNorm(axis=ax))
         if downsample:
@@ -53,15 +66,15 @@ class BasicBlockV1(HybridBlock):
 
 class BottleneckV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kw):
+                 layout="NCHW", fused_bn_relu=False, **kw):
         super().__init__(**kw)
         ax = _bn_axis(layout)
         self.body = nn.HybridSequential()
         self.body.add(nn.Conv2D(channels // 4, 1, strides=stride,
                                 use_bias=False, layout=layout),
-                      nn.BatchNorm(axis=ax), nn.Activation("relu"),
+                      *_bn_act(ax, fused_bn_relu),
                       _conv3x3(channels // 4, 1, channels // 4, layout),
-                      nn.BatchNorm(axis=ax), nn.Activation("relu"),
+                      *_bn_act(ax, fused_bn_relu),
                       nn.Conv2D(channels, 1, strides=1, use_bias=False,
                                 layout=layout),
                       nn.BatchNorm(axis=ax))
@@ -144,27 +157,30 @@ class _SpaceToDepthStem(HybridBlock):
     receptive field vs the reference 7x7/s2 stem — a variant model, not
     weight-compatible."""
 
-    def __init__(self, channels, layout, **kw):
+    def __init__(self, channels, layout, fused_bn_relu=False, **kw):
         super().__init__(**kw)
         self._layout = layout
+        self._fused = fused_bn_relu
         ax = _bn_axis(layout)
         # 5x5/s1 pad2 keeps symmetric padding (4x4 'same' would need the
         # (1,2) asymmetric pair); ~10x10 effective receptive field
         self.conv = nn.Conv2D(channels, 5, 1, 2, use_bias=False,
                               layout=layout)
-        self.bn = nn.BatchNorm(axis=ax)
+        self.bn = _bn_act(ax, fused_bn_relu)[0]
         self.pool = nn.MaxPool2D(3, 2, 1, layout=layout)
 
     def forward(self, x):
         from .... import numpy_extension as npx
 
         x = npx.space_to_depth(x, 2, layout=self._layout)
-        return self.pool(self.bn(self.conv(x)).relu())
+        x = self.bn(self.conv(x))
+        return self.pool(x if self._fused else x.relu())
 
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", stem_type="default", **kw):
+                 layout="NCHW", stem_type="default", fused_bn_relu=False,
+                 **kw):
         super().__init__(**kw)
         if len(channels) != len(layers) + 1:
             raise MXNetError("channels must have len(layers)+1 entries")
@@ -180,28 +196,30 @@ class ResNetV1(HybridBlock):
                     f"'{stem_type}' would be silently ignored")
             self.features.add(_conv3x3(channels[0], 1, 0, layout))
         elif stem_type == "s2d":
-            self.features.add(_SpaceToDepthStem(channels[0], layout))
+            self.features.add(_SpaceToDepthStem(channels[0], layout,
+                                                fused_bn_relu=fused_bn_relu))
         else:
             self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False,
                                         layout=layout),
-                              nn.BatchNorm(axis=ax), nn.Activation("relu"),
+                              *_bn_act(ax, fused_bn_relu),
                               nn.MaxPool2D(3, 2, 1, layout=layout))
         for i, num_layer in enumerate(layers):
             stride = 1 if i == 0 else 2
             self.features.add(self._make_layer(
                 block, num_layer, channels[i + 1], stride, channels[i],
-                layout=layout))
+                layout=layout, fused_bn_relu=fused_bn_relu))
         self.features.add(nn.GlobalAvgPool2D(layout=layout))
         self.output = nn.Dense(classes)
 
     def _make_layer(self, block, layers, channels, stride, in_channels=0,
-                    layout="NCHW"):
+                    layout="NCHW", fused_bn_relu=False):
         layer = nn.HybridSequential()
         layer.add(block(channels, stride, channels != in_channels,
-                        in_channels=in_channels, layout=layout))
+                        in_channels=in_channels, layout=layout,
+                        fused_bn_relu=fused_bn_relu))
         for _ in range(layers - 1):
             layer.add(block(channels, 1, False, in_channels=channels,
-                            layout=layout))
+                            layout=layout, fused_bn_relu=fused_bn_relu))
         return layer
 
     def forward(self, x):
@@ -269,6 +287,12 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None,
     block_type, layers, channels = _SPEC[num_layers]
     resnet_class, basic, bottleneck = _VERSIONS[version - 1]
     block = basic if block_type == "basic_block" else bottleneck
+    if version == 2:
+        # v2 pre-activation interleaves bn.relu() with residual taps —
+        # no adjacent BN→relu layer pair to fuse structurally.  Pop even
+        # a falsy value: ResNetV2 must not see the kwarg at all
+        if kwargs.pop("fused_bn_relu", False):
+            raise MXNetError("fused_bn_relu is a ResNet-v1 variant")
     net = resnet_class(block, layers, channels, **kwargs)
     if pretrained:
         from ..model_store import load_pretrained

@@ -68,10 +68,19 @@ class PixelShuffle3D(_PixelShuffle):
 class BatchNormReLU(BatchNorm):
     """Fused BatchNorm+ReLU (ref basic_layers.py BatchNormReLU →
     _contrib_BatchNormWithReLU): identical statistics handling, relu on
-    the normalized output."""
+    the normalized output.  Routes through ``npx.batch_norm_with_relu``,
+    which dispatches to the single-pass Pallas statistics+act kernels
+    when the kernels layer is active (docs/kernels.md) and composes the
+    reference ops otherwise — numerics match either way within the
+    documented one-pass-variance tolerance."""
 
     def forward(self, x):
-        return npx.relu(super().forward(x))
+        return npx.batch_norm_with_relu(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis)
 
 
 class DeformableConvolution(HybridBlock):

@@ -1,0 +1,36 @@
+"""mx.kernels — hand-written Pallas TPU kernels behind a dispatch registry.
+
+The reference framework hand-writes its operator hot paths (SURVEY layer
+map: 205k LoC of CUDA/MKL-DNN kernels); this package is the TPU-native
+analogue for the fusions XLA will not do on its own ("Operator Fusion in
+XLA", PAPERS.md): cross-op reductions (BN statistics + activation),
+attention without a score matrix (flash fwd + bwd), and the optimizer
+update as ONE kernel over a flat arena instead of O(#params) fused
+elementwise loops.
+
+Selection: ``MXNET_KERNELS=pallas|interpret|off`` (default: pallas on a
+TPU backend, off elsewhere) plus per-call overrides
+(:func:`registry.override`, ``ShardedTrainer(fused_opt=...)``).  Every
+kernel call site reaches the device through ``ops.dispatch`` like any
+other op, so engine-check, telemetry and ``mx.trace`` see kernels exactly
+as they see reference ops; this package adds the *selection* telemetry on
+top: ``kernels.dispatches[.<name>]`` / ``kernels.fallbacks[.<name>]``
+counters and once-per-reason fallback warnings (docs/kernels.md).
+
+Modules:
+  registry  — mode resolution, selection, fallback observability
+  opt_arena — flat-arena fused optimizer update (sgd/momentum/adam)
+  flash_bwd — flash-attention backward kernels (dq, dk/dv)
+  bn_act    — fused batch-norm statistics + scale/shift + activation
+"""
+from __future__ import annotations
+
+from . import registry
+from .registry import (KERNELS, MODES, dispatched, fallback, mode,  # noqa: F401
+                       override, select)
+from . import opt_arena
+from . import flash_bwd
+from . import bn_act
+
+__all__ = ["registry", "opt_arena", "flash_bwd", "bn_act", "KERNELS",
+           "MODES", "mode", "override", "select", "fallback", "dispatched"]

@@ -972,10 +972,34 @@ def getnnz(data, axis=None, **kw):
     return call(f, (dense,), {}, name="getnnz")
 
 
-def batch_norm_with_relu(x, gamma, beta, running_mean, running_var, **kw):
-    """BatchNorm fused with ReLU (ref contrib/batch_norm_relu.cc — under
-    XLA the fusion is automatic; the surface is kept for parity)."""
-    return relu(batch_norm(x, gamma, beta, running_mean, running_var, **kw))
+def batch_norm_with_relu(x, gamma, beta, running_mean, running_var,
+                         eps=1e-5, momentum=0.9, fix_gamma=False,
+                         use_global_stats=False, axis=1, **kw):
+    """BatchNorm fused with ReLU (ref contrib/batch_norm_relu.cc).
+
+    Training mode dispatches to the single-pass Pallas statistics +
+    normalize+relu kernels (``mx.kernels.bn_act``, docs/kernels.md) when
+    the kernels layer is active; otherwise — and always in inference
+    mode, where XLA fuses the folded affine + relu on its own — the
+    composed reference path runs.  Moving stats update in place like
+    ``batch_norm``."""
+    training = autograd.is_training()
+    if training and not use_global_stats:
+        res = call(lambda xx, g, b, m, v: _nn.batch_norm_act_train(
+            xx, g, b, m, v, eps=eps, momentum=momentum, axis=axis,
+            fix_gamma=fix_gamma, act_type="relu"),
+            (x, gamma, beta, running_mean, running_var), {},
+            name="batch_norm_with_relu",
+            attrs={"eps": eps, "momentum": momentum, "axis": axis,
+                   "fix_gamma": fix_gamma})
+        out, new_mean, new_var = res
+        running_mean._set_data(jax.lax.stop_gradient(new_mean._data))
+        running_var._set_data(jax.lax.stop_gradient(new_var._data))
+        return out
+    return relu(batch_norm(x, gamma, beta, running_mean, running_var,
+                           eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                           use_global_stats=use_global_stats, axis=axis,
+                           **kw))
 
 
 def dynamic_reshape(data, shape_like, **kw):

@@ -7,14 +7,18 @@ online-softmax kernel (flash attention) written in Pallas so the whole
 score/softmax/weighted-sum pipeline stays in VMEM — O(T) memory instead of
 the O(T^2) score matrix, MXU-friendly (bq x d) x (d x bk) tiles.
 
-Dispatch rules:
-  * TPU + (no mask or causal) + tile-able shapes  -> pallas kernel
-  * everything else                               -> attention_reference
-Backward is a hand-written blockwise flash backward (custom VJP): row lse is
-recomputed blockwise, then dq/dk/dv accumulate over (q-block, kv-block)
-pairs inside lax.scan — no O(Tq*Tk) tensor is ever materialized, so training
-memory stays O(T) end to end (the eager fallback forward still builds the
-full score matrix; the pallas forward + this backward never do).
+Dispatch rules (mx.kernels registry, docs/kernels.md):
+  * kernels active (MXNET_KERNELS: pallas on TPU / interpret anywhere) +
+    (no mask or causal/kv_len) + tile-able shapes  -> pallas kernel
+  * everything else                                -> attention_reference
+    (an observable fallback: kernels.fallbacks + once-per-reason warning)
+Backward: when the Pallas forward ran, its saved row lse feeds the Pallas
+backward kernels (mxnet_tpu/kernels/flash_bwd.py — dq then dk/dv, blockwise,
+no score matrix); otherwise a hand-written blockwise jnp flash backward
+(custom VJP) recomputes lse and accumulates dq/dk/dv inside lax.scan.
+Either way no O(Tq*Tk) tensor is ever materialized, so training memory
+stays O(T) end to end (the eager fallback forward still builds the full
+score matrix; the pallas forward + these backwards never do).
 """
 from __future__ import annotations
 
@@ -24,6 +28,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..kernels import registry as _kreg
 
 __all__ = ["flash_attention", "attention_reference"]
 
@@ -45,16 +51,18 @@ def attention_reference(q, k, v, mask=None, scale: Optional[float] = None):
 
 
 def _pick_block(t: int, preferred=(512, 256, 128, 64, 32, 16, 8)) -> int:
-    for b in preferred:
-        if t % b == 0:
-            return b
-    return 0
+    return _kreg.pick_block(t, preferred)
 
 
-def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-                  *, scale: float, causal: bool, has_len: bool, bq: int,
-                  bk: int, nk: int):
+def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *rest,
+                  scale: float, causal: bool, has_len: bool, bq: int,
+                  bk: int, nk: int, with_lse: bool = False):
     import jax.experimental.pallas as pl
+
+    if with_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        lse_ref, (acc_ref, m_ref, l_ref) = None, rest
 
     j = pl.program_id(2)
 
@@ -110,11 +118,18 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         l = l_ref[:, :1]
         o_ref[0, ...] = (acc_ref[...] /
                          jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        if with_lse:
+            # row log-sum-exp for the backward kernels; fully-masked rows
+            # keep m = -inf so their lse is -inf (bwd maps it to p = 0)
+            lse = m_ref[:, :1] + jnp.log(jnp.where(l == 0.0, 1.0, l))
+            lse_ref[0, :] = lse[:, 0]
 
 
 def _flash_forward_pallas(q, k, v, causal: bool, scale: float, kv_len=None,
-                          interpret: bool = False):
-    """(B, H, T, D) flash attention via pallas_call; returns (B, H, T, D).
+                          interpret: bool = False, return_lse: bool = False):
+    """(B, H, T, D) flash attention via pallas_call; returns (B, H, T, D),
+    or ``(out, lse)`` with the (B, H, Tq) f32 row log-sum-exp when
+    ``return_lse=True`` (the residual the Pallas backward consumes).
     ``kv_len``: optional (B,) int32 per-row valid key length.
     ``interpret=True`` runs the kernel under the pallas interpreter on any
     backend — how tests validate the KERNEL itself without a TPU."""
@@ -136,7 +151,17 @@ def _flash_forward_pallas(q, k, v, causal: bool, scale: float, kv_len=None,
         lens = jnp.full((b * h, 1), tk, jnp.int32)
 
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               has_len=has_len, bq=bq, bk=bk, nk=nk)
+                               has_len=has_len, bq=bq, bk=bk, nk=nk,
+                               with_lse=return_lse)
+    o_spec = pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0))
+    o_shape = jax.ShapeDtypeStruct((b * h, tq, d), q.dtype)
+    if return_lse:
+        out_specs = [o_spec,
+                     pl.BlockSpec((1, bq), lambda b_, i, j: (b_, i))]
+        out_shape = [o_shape,
+                     jax.ShapeDtypeStruct((b * h, tq), jnp.float32)]
+    else:
+        out_specs, out_shape = o_spec, o_shape
     out = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
@@ -149,12 +174,15 @@ def _flash_forward_pallas(q, k, v, causal: bool, scale: float, kv_len=None,
             pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[_vmem((bq, d)), _vmem((bq, 128)), _vmem((bq, 128))],
         compiler_params=_tpu_params(),
         interpret=interpret,
     )(lens, qr, kr, vr)
+    if return_lse:
+        o, lse = out
+        return o.reshape(b, h, tq, d), lse.reshape(b, h, tq)
     return out.reshape(b, h, tq, d)
 
 
@@ -165,32 +193,27 @@ def _vmem(shape):
 
 
 def _tpu_params():
-    from jax.experimental.pallas import tpu as pltpu
-
-    try:
-        return pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"))
-    except (AttributeError, TypeError):
-        try:
-            return pltpu.TPUCompilerParams(
-                dimension_semantics=("parallel", "parallel", "arbitrary"))
-        except (AttributeError, TypeError):
-            return None
+    return _kreg.tpu_compiler_params(("parallel", "parallel", "arbitrary"))
 
 
-def _use_pallas(q, k, mask) -> bool:
+def _select_kernel(q, k, mask):
+    """Kernel-mode selection for this call: ``"pallas"``/``"interpret"``
+    when the Pallas kernel should run, else None — with every miss
+    reported through the kernels registry (mask form, tile-ability)."""
+    kmode = _kreg.select("flash_attention")
+    if kmode is None:
+        return None
     if mask is not None:
-        return False
-    try:
-        platform = q.devices().pop().platform if hasattr(q, "devices") \
-            else jax.default_backend()
-    except Exception:
-        platform = jax.default_backend()
-    if platform != "tpu":
-        return False
+        _kreg.fallback("flash_attention", "general boolean mask "
+                       "(only causal/kv_valid_length stay on the kernel)")
+        return None
     tq, tk, d = q.shape[2], k.shape[2], q.shape[-1]
-    return (_pick_block(tq) > 0 and _pick_block(tk) > 0 and d <= 256
-            and d % 8 == 0)
+    if not (_pick_block(tq) > 0 and _pick_block(tk) > 0 and d <= 256
+            and d % 8 == 0):
+        _kreg.fallback("flash_attention",
+                       f"shape not tile-able (tq={tq}, tk={tk}, d={d})")
+        return None
+    return kmode
 
 
 def _merge_mask(mask, kv_len, tq, tk, causal):
@@ -206,38 +229,52 @@ def _merge_mask(mask, kv_len, tq, tk, causal):
     return m
 
 
-_pallas_fallback_warned = False
+def _kernel_failed(e: Exception):
+    """A broken kernel (or VMEM OOM) must not silently become an O(T^2)
+    slowdown: report through the registry (counter + once-per-reason
+    warning), and let MXNET_FLASH_NO_FALLBACK=1 turn the fallback into a
+    hard error."""
+    import os
+
+    if os.environ.get("MXNET_FLASH_NO_FALLBACK"):
+        raise e
+    _kreg.fallback("flash_attention",
+                   f"kernel error: {type(e).__name__}: {e}")
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
 def _flash(q, k, v, mask, kv_len, causal: bool, scale: float):
-    if _use_pallas(q, k, mask):
+    kmode = _select_kernel(q, k, mask)
+    if kmode:
         try:
-            return _flash_forward_pallas(q, k, v, causal, scale,
-                                         kv_len=kv_len)
+            out = _flash_forward_pallas(q, k, v, causal, scale,
+                                        kv_len=kv_len,
+                                        interpret=kmode == "interpret")
+            _kreg.dispatched("flash_attention", kmode)
+            return out
         except Exception as e:  # noqa: BLE001 - any kernel failure degrades
-            # A broken TPU kernel (or VMEM OOM) must not silently become an
-            # O(T^2) slowdown: warn once so regressions are visible, and let
-            # MXNET_FLASH_NO_FALLBACK=1 turn the fallback into a hard error.
-            import os
-            import warnings
-
-            if os.environ.get("MXNET_FLASH_NO_FALLBACK"):
-                raise
-            global _pallas_fallback_warned
-            if not _pallas_fallback_warned:
-                _pallas_fallback_warned = True
-                warnings.warn(
-                    "pallas flash-attention kernel failed; falling back to "
-                    f"the O(T^2) reference path: {type(e).__name__}: {e}",
-                    RuntimeWarning, stacklevel=2)
+            _kernel_failed(e)
     m = _merge_mask(mask, kv_len, q.shape[2], k.shape[2], causal)
     return attention_reference(q, k, v, mask=m, scale=scale)
 
 
 def _flash_fwd(q, k, v, mask, kv_len, causal, scale):
-    out = _flash(q, k, v, mask, kv_len, causal, scale)
-    return out, (q, k, v, mask, kv_len, out)
+    kmode = _select_kernel(q, k, mask)
+    if kmode:
+        try:
+            # the kernel saves the row lse — the residual that lets the
+            # backward run as Pallas kernels instead of the jnp recompute
+            out, lse = _flash_forward_pallas(q, k, v, causal, scale,
+                                             kv_len=kv_len,
+                                             interpret=kmode == "interpret",
+                                             return_lse=True)
+            _kreg.dispatched("flash_attention", kmode)
+            return out, (q, k, v, mask, kv_len, out, lse)
+        except Exception as e:  # noqa: BLE001 - any kernel failure degrades
+            _kernel_failed(e)
+    m = _merge_mask(mask, kv_len, q.shape[2], k.shape[2], causal)
+    out = attention_reference(q, k, v, mask=m, scale=scale)
+    return out, (q, k, v, mask, kv_len, out, None)
 
 
 def _mask_block(mask, qi, kj, bq, bk):
@@ -264,9 +301,14 @@ def _block_logits(q_blk, k_blk, scale, causal, qi, kj, bq, bk, mask):
 
 
 def _flash_bwd(causal, scale, res, g):
-    """Blockwise flash-attention backward: O(T) memory via lse recompute.
+    """Blockwise flash-attention backward: O(T) memory, two routes.
 
-    Standard flash recipe: recompute row lse blockwise, then
+    When the Pallas forward ran (residual carries its row ``lse``), the
+    gradient runs the Pallas backward kernels (kernels/flash_bwd.py) on
+    the same blocks — dq then dk/dv, score matrix never materialized.
+    Otherwise (reference forward, or kernels disabled between fwd and
+    bwd) the jnp route below recomputes lse blockwise and accumulates
+    dq/dk/dv inside lax.scan:
       D_i  = sum(g_i * out_i)
       p_ij = exp(s_ij - lse_i)
       ds   = p * (g @ v^T - D)
@@ -274,7 +316,28 @@ def _flash_bwd(causal, scale, res, g):
       dv_j = sum_i p^T @ g_i
     Only O(T)-sized tensors cross scan steps — never the full (Tq, Tk)
     score matrix."""
-    q, k, v, mask, kv_len, out = res
+    q, k, v, mask, kv_len, out, lse = res
+    if lse is not None:
+        kmode = _kreg.select("flash_attention_bwd")
+        if kmode:
+            from ..kernels.flash_bwd import flash_attention_bwd_pallas
+
+            try:
+                dq, dk, dv = flash_attention_bwd_pallas(
+                    q, k, v, g, out, lse, kv_len, causal, scale,
+                    bq=_pick_block(q.shape[2]), bk=_pick_block(k.shape[2]),
+                    interpret=kmode == "interpret")
+                _kreg.dispatched("flash_attention_bwd", kmode)
+                return dq, dk, dv, None, None
+            except Exception as e:  # noqa: BLE001 - degrade observably
+                import os
+
+                if os.environ.get("MXNET_FLASH_NO_FALLBACK"):
+                    raise
+                _kreg.fallback("flash_attention_bwd",
+                               f"kernel error: {type(e).__name__}: {e}")
+        # select() reported any platform miss; mode "off" between forward
+        # and backward degrades silently to the jnp route below
     b, h, tq, d = q.shape
     tk = k.shape[2]
     if kv_len is not None:

@@ -300,6 +300,69 @@ def batch_norm_train(x, gamma, beta, moving_mean, moving_var,
     return out, new_mean, new_var
 
 
+def batch_norm_act_train(x, gamma, beta, moving_mean, moving_var,
+                         eps: float = 1e-5, momentum: float = 0.9,
+                         axis: int = 1, fix_gamma: bool = False,
+                         use_global_stats: bool = False,
+                         act_type: str = "relu"):
+    """Training-mode BN fused with an activation; returns
+    ``(out, new_moving_mean, new_moving_var)``.
+
+    Dispatches to the single-pass Pallas kernel pair
+    (``mxnet_tpu.kernels.bn_act``: one sweep for sum+sumsq statistics,
+    one fused normalize+act sweep — the cross-op reduction fusion XLA
+    won't form, "Operator Fusion in XLA" / PAPERS.md) when the kernels
+    layer is active, the layout is channel-last and the shape tiles;
+    every miss falls back to ``batch_norm_train`` + ``activation`` with
+    the reason reported through the kernels registry (docs/kernels.md).
+    Kernel-path variance is one-pass E[x²]−mean² (vs the reference's
+    two-pass) — agreement is ~1e-6 relative on O(1) activations, the
+    documented tolerance."""
+    from ..kernels import bn_act as _kbn
+    from ..kernels import registry as _kreg
+
+    axis = axis % x.ndim
+    kmode = None if use_global_stats else _kreg.select("bn_act")
+    if kmode is not None:
+        c = x.shape[axis]
+        rows = _prodl(x.shape) // max(c, 1)
+        if axis != x.ndim - 1:
+            _kreg.fallback("bn_act", "layout not channel-last "
+                           f"(axis={axis}, ndim={x.ndim})")
+        elif not _kbn.supported_act(act_type):
+            _kreg.fallback("bn_act", f"activation {act_type!r} not fused")
+        elif _kbn.pick_row_block(rows) == 0:
+            _kreg.fallback("bn_act",
+                           f"shape not tile-able (rows={rows}, C={c})")
+        else:
+            g = jnp.ones_like(gamma) if fix_gamma else gamma
+            out, mean, var = _kbn.bn_act_train(
+                x, g, beta, eps, act_type,
+                kmode == "interpret")
+            _kreg.dispatched("bn_act", kmode)
+            # moving-stat blend identical to batch_norm_train (running
+            # buffers keep their own dtype — f32 master buffers)
+            new_mean = (moving_mean * momentum
+                        + mean * (1 - momentum)).astype(moving_mean.dtype)
+            new_var = (moving_var * momentum
+                       + var * (1 - momentum)).astype(moving_var.dtype)
+            return out, new_mean, new_var
+    out, new_mean, new_var = batch_norm_train(
+        x, gamma, beta, moving_mean, moving_var, eps=eps,
+        momentum=momentum, axis=axis, fix_gamma=fix_gamma,
+        use_global_stats=use_global_stats)
+    if act_type != "identity":
+        out = activation(out, act_type)
+    return out, new_mean, new_var
+
+
+def _prodl(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
 def batch_norm_infer(x, gamma, beta, moving_mean, moving_var,
                      eps: float = 1e-5, axis: int = 1, fix_gamma: bool = False):
     axis = axis % x.ndim

@@ -464,6 +464,159 @@ class _FusedOptAdapter(_OptAdapter):
         return new_p, new_leaves
 
 
+class _ArenaOptAdapter(_OptAdapter):
+    """Flat-arena fused optimizer update — ONE Pallas kernel per step
+    (mx.kernels.opt_arena, docs/kernels.md).
+
+    The third adapter variant, designed around the round-3 PERF.md
+    refutation of ``_FusedOptAdapter``'s stack-based fusion: parameters
+    are NEVER packed (no per-leaf ``jnp.stack``/concatenate of params in
+    the step HLO — asserted by ``make kernels-smoke``).  The
+    weight-decay/clip fold and the final ``w + delta`` application are
+    per-leaf elementwise ops XLA fuses away; optimizer state lives as
+    persistent flat arenas donated through the step; gradients ravel
+    into one arena (the step's single concatenate) and one elementwise
+    ``pallas_call`` runs the whole update.
+
+    Supports the elementwise optimizers (sgd / momentum+nesterov / adam)
+    with uniform lr/wd multipliers; norm-based or per-leaf-heterogeneous
+    configurations stay on the per-param adapter (observable fallback).
+    Under ``partition='zero1'`` the arenas shard evenly over ``dp`` —
+    shard-local segments need no per-leaf padding because the update is
+    elementwise, so leaf boundaries may fall anywhere."""
+
+    def __init__(self, optimizer, kmode: str):
+        super().__init__(optimizer)
+        self._kmode = kmode
+        self.layout = None
+        self.arena_sharding = None   # set by ShardedTrainer under zero1
+        self._shard_multiple = 1     # dp degree the arena length aligns to
+        name = type(optimizer).__name__
+        if name in ("SGD", "NAG"):
+            self.variant = "momentum" if getattr(optimizer, "momentum",
+                                                 0.0) else "sgd"
+            self._nesterov = name == "NAG"
+        else:
+            self.variant = "adam"
+            self._nesterov = False
+
+    @classmethod
+    def supports(cls, opt) -> Tuple[bool, str]:
+        """Whether ``opt`` can run as a flat-arena update, with the
+        fallback reason when not.  Exact types only: subclasses (AdamW,
+        Signum, ...) change the update math."""
+        from ..optimizer import SGD, NAG, Adam
+
+        if type(opt) not in (SGD, NAG, Adam):
+            return False, (f"optimizer {type(opt).__name__} not "
+                           "arena-fusible (elementwise sgd/momentum/adam "
+                           "only)")
+        if opt.lr_mult or opt.wd_mult:
+            return False, "per-parameter lr/wd multipliers"
+        for p in opt.param_dict.values():
+            if getattr(p, "lr_mult", 1.0) != 1.0 or \
+                    getattr(p, "wd_mult", 1.0) != 1.0:
+                return False, "per-parameter lr/wd multipliers"
+        return True, ""
+
+    def init_state(self, pvals) -> List[Any]:
+        from ..kernels import opt_arena as _oa
+
+        for p in pvals:
+            if jnp.dtype(p.dtype) != jnp.float32:
+                raise MXNetError(
+                    "arena optimizer update expects f32 parameters; got "
+                    f"{p.dtype} (use fused_opt='off')")
+        self.layout = _oa.build_layout(
+            [tuple(p.shape) for p in pvals],
+            shard_multiple=self._shard_multiple)
+        n = _oa.VARIANT_STATES[self.variant]
+        # arena leaves own no single param (leaf_param_ix is per-leaf in
+        # the base adapters); ShardedTrainer special-cases the placement
+        self.leaf_param_ix = [-1] * n
+        self._tree = None
+        return [jnp.zeros((self.layout.padded,), jnp.float32)
+                for _ in range(n)]
+
+    def update(self, pvals, grads, leaves, lr, t):
+        from ..kernels import opt_arena as _oa
+        from ..kernels import registry as _kreg
+
+        opt = self.opt
+        wd = float(opt.wd)
+        clip = float(opt.clip_gradient) if opt.clip_gradient is not None \
+            else -1.0
+        lay = self.layout
+        # per-leaf elementwise fold (reads the param value, which never
+        # enters the arena): same op order as _sgd_kernel/_adam_kernel
+        gs = []
+        for p, g in zip(pvals, grads):
+            g = g.astype(jnp.float32)
+            if clip > 0:
+                g = jnp.clip(g, -abs(clip), abs(clip))
+            if wd:
+                g = g + wd * p
+            gs.append(g.ravel())
+        garena = gs[0] if len(gs) == 1 else jnp.concatenate(gs)
+        if lay.padded != lay.total:
+            garena = jnp.pad(garena, (0, lay.padded - lay.total))
+        if self.arena_sharding is not None:
+            # zero1: pin the grad arena dp-sharded — the constraint turns
+            # the gradient AllReduce into ReduceScatter ahead of the
+            # shard-local kernel (same move as the per-leaf zero1 path)
+            garena = jax.lax.with_sharding_constraint(
+                garena, self.arena_sharding)
+        kw = {}
+        if self.variant == "momentum":
+            kw = dict(momentum=float(opt.momentum),
+                      nesterov=self._nesterov)
+        elif self.variant == "adam":
+            kw = dict(beta1=float(opt.beta1), beta2=float(opt.beta2),
+                      eps=float(opt.epsilon))
+        delta, new_leaves = _oa.arena_update(
+            self.variant, garena, list(leaves), lr, t,
+            interpret=self._kmode == "interpret", **kw)
+        _kreg.dispatched("opt_arena", self._kmode)
+        new_p = [p + jax.lax.slice_in_dim(delta, off, off + size)
+                 .reshape(shape)
+                 for p, off, size, shape in
+                 zip(pvals, lay.offsets, lay.sizes, lay.shapes)]
+        return new_p, new_leaves
+
+
+def _pick_adapter(opt, multi_tensor: bool, fused_opt: Optional[str],
+                  all_f32: bool = True):
+    """Adapter selection (docs/kernels.md): ``fused_opt`` is the per-call
+    override — ``"arena"`` requires the flat-arena path (raises when
+    unavailable), ``"off"`` pins the per-param/vmap adapters, ``None``
+    auto-selects arena whenever the kernels layer is active
+    (``MXNET_KERNELS``) and the optimizer is arena-fusible, except when
+    the caller explicitly asked for ``multi_tensor=True``.  Every
+    auto-path ineligibility — unfusible optimizer, per-leaf multipliers,
+    non-f32 params — is an observable fallback, never an error."""
+    from ..kernels import registry as _kreg
+
+    if fused_opt not in (None, "arena", "off"):
+        raise MXNetError(f"fused_opt={fused_opt!r} unknown; use None, "
+                         "'arena' or 'off'")
+    if fused_opt == "arena" or (fused_opt is None and not multi_tensor):
+        kmode = _kreg.select("opt_arena")
+        ok, reason = _ArenaOptAdapter.supports(opt)
+        if ok and not all_f32:
+            ok, reason = False, ("non-f32 parameters (the f32 arena "
+                                 "would silently change update numerics)")
+        if kmode and ok:
+            return _ArenaOptAdapter(opt, kmode)
+        if fused_opt == "arena":
+            raise MXNetError(
+                "fused_opt='arena' requested but unavailable: "
+                + (reason or "kernels layer inactive (MXNET_KERNELS, "
+                             "platform — see docs/kernels.md)"))
+        if kmode and not ok:
+            _kreg.fallback("opt_arena", reason)
+    return _FusedOptAdapter(opt) if multi_tensor else _OptAdapter(opt)
+
+
 def all_finite(grads):
     """Fused finiteness scan over a gradient list — the reference's
     all_finite op (src/operator/all_finite.cc) that drives dynamic loss
@@ -481,7 +634,8 @@ def make_train_step(net, loss_fn, names: List[str],
                     donate: bool = True, compute_dtype=None,
                     loss_scale_growth_interval: int = 2000,
                     multi_tensor: bool = False, shardings_box=None,
-                    partition: str = "replicated"):
+                    partition: str = "replicated",
+                    fused_opt: Optional[str] = None):
     """Build the jitted SPMD train machinery. Returns
     (step, grad_fn, apply_fn, adapter, holder):
 
@@ -518,7 +672,13 @@ def make_train_step(net, loss_fn, names: List[str],
     (reduce-scatter grads → shard-local update → all-gather params; the
     concrete per-param placements arrive via ``shardings_box["zero1"]`` /
     ``["opt_state"]``, filled by ShardedTrainer before the first trace —
-    see the ZeRO-1 block comment above)."""
+    see the ZeRO-1 block comment above).
+
+    ``fused_opt`` selects the optimizer-update implementation: ``None``
+    auto-picks the flat-arena Pallas kernel when the kernels layer is
+    active (``MXNET_KERNELS``, docs/kernels.md), ``"arena"`` requires it,
+    ``"off"`` keeps the per-param replay (or the vmap adapter under
+    ``multi_tensor=True``)."""
     if partition not in PARTITIONS:
         raise MXNetError(f"partition={partition!r} unknown; "
                          f"choose from {PARTITIONS}")
@@ -533,9 +693,12 @@ def make_train_step(net, loss_fn, names: List[str],
     train_ix = [i for i, n in enumerate(names) if params[n].grad_req != "null"]
     aux_ix = [i for i, n in enumerate(names) if params[n].grad_req == "null"]
     holder["train_ix"], holder["aux_ix"] = train_ix, aux_ix
-    cls = _FusedOptAdapter if multi_tensor else _OptAdapter
-    adapter = cls(_make_opt(optimizer, learning_rate, weight_decay,
-                            momentum))
+    with _blk.trace_guard():
+        all_f32 = all(jnp.dtype(arrs[i]._data.dtype) == jnp.float32
+                      for i in train_ix)
+    adapter = _pick_adapter(
+        _make_opt(optimizer, learning_rate, weight_decay, momentum),
+        multi_tensor, fused_opt, all_f32=all_f32)
     dynamic_scaling = compute_dtype is not None and \
         jnp.dtype(compute_dtype) == jnp.float16
 
@@ -709,7 +872,15 @@ class ShardedTrainer:
     reference semantics, ``"zero1"`` shards the optimizer state and the
     update over the data axis (reduce-scatter grads → shard-local update →
     all-gather params) — same math, 1/dp the optimizer memory and update
-    FLOPs per device."""
+    FLOPs per device.
+
+    ``fused_opt`` picks the optimizer-update implementation
+    (docs/kernels.md): ``None`` auto-selects the flat-arena Pallas kernel
+    when the kernels layer is active and the optimizer is arena-fusible
+    (sgd/momentum/adam, uniform multipliers), ``"arena"`` requires it,
+    ``"off"`` pins the per-param replay.  Under zero1 the arenas shard
+    over dp as flat segments.  Checkpoints record the layout implicitly:
+    restoring across different ``fused_opt``/kernels configs raises."""
 
     def __init__(self, net, loss_fn, mesh: Optional[Mesh] = None,
                  optimizer="sgd", learning_rate: float = 0.01,
@@ -720,7 +891,8 @@ class ShardedTrainer:
                  init_loss_scale: float = 2.0 ** 16,
                  multi_tensor: bool = False,
                  max_inflight: Optional[int] = None,
-                 partition: Optional[str] = None):
+                 partition: Optional[str] = None,
+                 fused_opt: Optional[str] = None):
         from .mesh import default_mesh
 
         if partition is None:
@@ -732,13 +904,32 @@ class ShardedTrainer:
         self.net = net
         self.mesh = mesh if mesh is not None else default_mesh()
         self.names, allvals, self.specs = shard_params(net, self.mesh, spec_fn)
+        if any(any(e is not None for e in tuple(s)) for s in self.specs):
+            # mp/fsdp-sharded params: the arena's grad pack would gather
+            # every sharded gradient replicated, silently undoing the
+            # tensor-MP memory/comms win — the arena stays a pure-DP tool
+            from ..kernels import registry as _kreg
+
+            if fused_opt == "arena":
+                raise MXNetError(
+                    "fused_opt='arena' cannot run with sharded parameters "
+                    "(mp/fsdp spec_fn): packing their gradients into one "
+                    "replicated arena would gather full-model grad bytes "
+                    "per device — use the per-param adapter "
+                    "(docs/kernels.md)")
+            if fused_opt is None and _kreg.mode() != "off":
+                _kreg.fallback(
+                    "opt_arena", "params sharded over mesh axes "
+                    "(mp/fsdp spec_fn): the grad-arena pack would gather "
+                    "them replicated")
+            fused_opt = "off"
         shardings_box = {}
         (self._step_fn, self._grad_fn, self._apply_fn, self._adapter,
          self._holder) = make_train_step(
             net, loss_fn, self.names, optimizer, learning_rate,
             weight_decay, momentum, compute_dtype=compute_dtype,
             multi_tensor=multi_tensor, shardings_box=shardings_box,
-            partition=partition)
+            partition=partition, fused_opt=fused_opt)
         self.pvals = [allvals[i] for i in self._holder["train_ix"]]
         self.avals = [allvals[i] for i in self._holder["aux_ix"]]
         # loop-carried outputs keep their input placements (read by the
@@ -757,7 +948,21 @@ class ShardedTrainer:
         # ZeRO-1 placement plan (None per param when replicated): the
         # sharded dim is chosen against the data axis named by batch_spec
         self._dp_axis = self._data_axis_name()
-        if partition == "zero1":
+        arena = isinstance(self._adapter, _ArenaOptAdapter)
+        if partition == "zero1" and arena:
+            # flat-arena zero1: the 1-D state arenas shard evenly over dp
+            # — shard-local SEGMENTS, no per-leaf padding (the update is
+            # elementwise, so leaf boundaries may fall anywhere); the
+            # per-leaf Zero1Info machinery stays disengaged (all None)
+            if self._dp_axis not in self.mesh.shape:
+                raise MXNetError(
+                    f"partition='zero1' needs a {self._dp_axis!r} mesh "
+                    f"axis; mesh has {tuple(self.mesh.axis_names)}")
+            self._zero1 = [None] * len(self.pvals)
+            self._adapter._shard_multiple = self.mesh.shape[self._dp_axis]
+            self._adapter.arena_sharding = NamedSharding(
+                self.mesh, P(self._dp_axis))
+        elif partition == "zero1":
             self._zero1 = _zero1_infos(self.mesh, self._dp_axis, tspecs,
                                        self.pvals)
         else:
@@ -773,6 +978,20 @@ class ShardedTrainer:
         self._state_shardings: List[NamedSharding] = []
         self._leaf_unpad: List[Optional[Tuple[int, int]]] = []
         for s, pi in zip(self.opt_state, self._adapter.leaf_param_ix):
+            if arena:
+                # arena leaves span every param: dp-sharded under zero1,
+                # replicated otherwise.  Stored padded (inert zeros), but
+                # CHECKPOINTED stripped to layout.total — the pad width
+                # depends on dp (lcm alignment), and save_states promises
+                # restore onto ANY mesh shape; load_states re-pads toward
+                # this trainer's padded length like any zero1 leaf
+                lay = self._adapter.layout
+                self._state_shardings.append(
+                    self._adapter.arena_sharding
+                    or NamedSharding(self.mesh, P()))
+                self._leaf_unpad.append(
+                    (0, lay.total) if lay.padded != lay.total else None)
+                continue
             info = self._zero1[pi]
             if info is not None and s.shape == init_vals[pi].shape:
                 self._state_shardings.append(info.sharding)
@@ -885,6 +1104,13 @@ class ShardedTrainer:
         dp = self.mesh.shape.get(self._dp_axis, 1)
         if dp <= 1:
             return 0
+        if isinstance(self._adapter, _ArenaOptAdapter):
+            # arena zero1: the dp-sharded delta arena is gathered into the
+            # replicated params each step — bill the arena bytes, not the
+            # (disengaged, all-None) per-leaf Zero1Info plan
+            if self._adapter.arena_sharding is None:
+                return 0
+            return self._adapter.layout.padded * 4 * (dp - 1) // dp
         total = 0
         for p, info in zip(self.pvals, self._zero1):
             if info is None:
@@ -1382,6 +1608,21 @@ class ShardedTrainer:
         self.pvals = [place(n, blob[f"param/{n}"]) for n in self.train_names]
         self.avals = [place(n, blob[f"aux/{n}"]) for n in self.aux_names]
 
+        def _layout_mismatch(detail):
+            return MXNetError(
+                f"checkpoint optimizer state does not match this "
+                f"trainer's layout ({detail}): it was saved under a "
+                "different optimizer layout (per-param vs flat-arena) or "
+                "optimizer — rebuild the trainer with the matching "
+                "fused_opt / MXNET_KERNELS setting (docs/kernels.md)")
+
+        n_blob = sum(1 for k in blob if k.startswith("opt/"))
+        if n_blob != len(self.opt_state):
+            # catches BOTH directions of a per-param<->arena mismatch for
+            # multi-param nets (leaf counts differ) before any placement
+            raise _layout_mismatch(
+                f"{n_blob} saved leaves, {len(self.opt_state)} expected")
+
         def place_leaf(i):
             # checkpoints carry UNPADDED leaves (save_states strips the
             # zero1 shard padding), so they restore across partitions and
@@ -1392,6 +1633,22 @@ class ShardedTrainer:
                 v = _pad_dim(v, up[0], self._leaf_shapes[i][up[0]])
             if v.shape == self._leaf_shapes[i]:
                 return jax.device_put(v, self._state_shardings[i])
+            if isinstance(self._adapter, _ArenaOptAdapter):
+                # a per-param-layout checkpoint CANNOT silently feed the
+                # arena kernel (leaf 0 would be one param's momentum, not
+                # the arena) — unlike the mesh-shape fallback below this
+                # is a layout mismatch, not a placement one
+                raise _layout_mismatch(
+                    f"leaf {i} has shape {tuple(v.shape)}, expected arena "
+                    f"shape {self._leaf_shapes[i]}")
+            if v.ndim != len(self._leaf_shapes[i]):
+                # the reverse direction: a flat (padded,) arena leaf must
+                # not silently become one param's replicated momentum.
+                # Legitimate cross-mesh/partition restores only change
+                # SIZES (zero1 padding stripped at save), never rank
+                raise _layout_mismatch(
+                    f"leaf {i} has rank {v.ndim}, expected rank "
+                    f"{len(self._leaf_shapes[i])}")
             return jax.device_put(v, NamedSharding(self.mesh, P()))
 
         self.opt_state = [place_leaf(i)
