@@ -17,7 +17,7 @@ BUILD    := build
 
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
-	trace-smoke kernels-smoke lint-hybrid ci clean
+	trace-smoke kernels-smoke serve-smoke lint-hybrid ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -124,6 +124,16 @@ kernels-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		python tools/kernels_smoke.py
 
+serve-smoke:
+	# mx.serve gate: a LeNet + tiny-BERT registry AOT-warmed over the
+	# bucket grids must serve N concurrent ragged requests with ZERO
+	# compiles, batched throughput >= 2x sequential dispatch, e2e p99
+	# under bound, and a forced queue overflow must shed (503) at least
+	# one request (docs/serving.md).  Serial — single-core box, never
+	# concurrent with tier-1.
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		python tools/serve_smoke.py
+
 lint-hybrid:
 	# hybridize-safety static analysis (docs/analysis.md). The committed
 	# baseline makes legacy suppressions explicit; NEW violations fail.
@@ -134,7 +144,7 @@ lint-hybrid:
 
 ci: native native-test asan tsan lint-hybrid test test-slow telemetry-smoke \
 	pipeline-smoke chaos-smoke warmup-smoke spmd-smoke trace-smoke \
-	kernels-smoke
+	kernels-smoke serve-smoke
 
 clean:
 	rm -rf $(BUILD)

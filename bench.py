@@ -809,6 +809,49 @@ def _infer_sweep():
 
 
 # ---------------------------------------------------------------------------
+# serving mode — the inference tier's perf trajectory (docs/serving.md).
+# `bench.py --serve` reuses the serve-smoke measurement core (LeNet +
+# tiny-BERT registry, mixed ragged load) and reports a bench-shaped row:
+# e2e p50/p99 latency, batched throughput, batched-vs-sequential speedup,
+# and batch occupancy.  CPU-capable: the serving tier is platform-
+# agnostic, so a dead relay degrades to a live CPU row, not a skip.
+# ---------------------------------------------------------------------------
+
+def _serve_child():
+    """One serving measurement in-process; prints + banks its row."""
+    import jax
+
+    # initialize the backend BEFORE importing serve_smoke: its module
+    # level setdefaults JAX_PLATFORMS=cpu (standalone-smoke safety),
+    # which would silently force a TPU child onto CPU if it ran first
+    platform = jax.devices()[0].platform
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import serve_smoke as _sm
+    report = {}
+    reg = _sm.build_registry()
+    ok = _sm.load_phases(reg, report)
+    # ONE row schema, owned by serve_smoke (drift here would desync the
+    # banked bench row from the smoke's report["row"])
+    row = _sm.make_row(report["load"], platform=platform)
+    row.update(vs_baseline=None, gates_ok=bool(ok))
+    row["telemetry"] = _telemetry_snapshot()
+    _bank(row)
+    print(json.dumps(row))
+
+
+def _serve_sweep():
+    """Parent: run the serving row in a killable subprocess."""
+    platform, err = _probe_backend()
+    env = dict(os.environ) if platform == "tpu" else _cpu_env()
+    row = _run_child(["--serve-child"], env, 1800, "serve_mixed_p99_ms")
+    if platform is None:
+        row["relay_note"] = f"TPU backend unavailable: {err}; CPU row"
+    print(json.dumps(row))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # multichip scaling mode (BASELINE target: 8->64-chip scaling efficiency).
 # `bench.py --multichip n` measures the ResNet + BERT SPMD step on a 1-device
 # and an n-device dp mesh and reports per-device throughput + scaling
@@ -965,6 +1008,10 @@ def main():
         return _infer_sweep()
     if len(sys.argv) == 3 and sys.argv[1] == "--infer-child":
         return _infer_child(sys.argv[2])
+    if len(sys.argv) == 2 and sys.argv[1] == "--serve":
+        return _serve_sweep()
+    if len(sys.argv) == 2 and sys.argv[1] == "--serve-child":
+        return _serve_child()
     if len(sys.argv) == 3 and sys.argv[1] == "--multichip":
         return _multichip(int(sys.argv[2]))
     if len(sys.argv) == 3 and sys.argv[1] == "--multichip-child":

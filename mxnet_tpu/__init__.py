@@ -67,6 +67,7 @@ from . import log
 from . import notebook
 from . import telemetry
 from . import trace
+from . import serve
 from . import profiler
 from . import monitor
 from . import registry

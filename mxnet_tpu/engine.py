@@ -25,8 +25,9 @@ from .base import MXNetError, get_env
 from .resilience import chaos as _chaos
 from .trace import recorder as _tr
 
-__all__ = ["Engine", "NativeEngine", "NaiveEngine", "InflightQueue", "get",
-           "push", "wait_for_var", "wait_for_all", "new_var", "delete_var"]
+__all__ = ["Engine", "NativeEngine", "NaiveEngine", "BoundedInflight",
+           "InflightQueue", "get", "push", "wait_for_var", "wait_for_all",
+           "new_var", "delete_var"]
 
 
 class Var:
@@ -59,33 +60,51 @@ class Engine:
         raise NotImplementedError
 
 
-class InflightQueue:
-    """Bounded async-dispatch window — the backpressure half of the step
-    pipeline (docs/pipeline.md).
+class BoundedInflight:
+    """Bounded async-dispatch window — the reusable backpressure core
+    shared by the training step pipeline (:class:`InflightQueue`,
+    docs/pipeline.md) and the serving tier (``mx.serve``'s per-batch
+    dispatch bound, docs/serving.md).
 
-    ``push(handle)`` records one dispatched step's output handle (anything
-    with a ``block_until_ready`` method — a ``jax.Array`` — or a tuple of
-    them) and, once more than ``limit`` steps are in flight, blocks on the
-    OLDEST one: the step-(t-K) sync that keeps the device dispatch queue K
-    deep instead of unbounded (K+1 generations of live step buffers, OOM)
-    or depth-1 (the per-step ``float(loss)`` lockstep this replaces).
-    ``limit`` defaults to ``MXNET_MAX_INFLIGHT_STEPS`` (2).
+    ``push(handle)`` records one dispatched unit's output handle
+    (anything with a ``block_until_ready`` method — a ``jax.Array`` — an
+    NDArray, or a tuple of them) and, once more than ``limit`` units are
+    in flight, blocks on the OLDEST one: the (t-K) sync that keeps the
+    device dispatch queue K deep instead of unbounded (K+1 generations
+    of live buffers, OOM) or depth-1 (a per-unit host sync lockstep).
 
-    Only push NON-donated outputs (the loss, an aux value): a handle that
-    a later dispatch donates is deleted under the queue and the eventual
-    wait would raise. Telemetry: gauge ``engine.inflight_steps`` is the
-    window occupancy after each push (its max is the run's high-water
-    mark — >1 proves dispatch ran ahead of retirement); timer
-    ``pipeline.stall_seconds`` is host time blocked here by backpressure.
+    Only push NON-donated outputs (a loss, an inference output): a
+    handle that a later dispatch donates is deleted under the queue and
+    the eventual wait would raise.
+
+    Telemetry (names are constructor-bound so each consumer reports
+    under its own catalog entry): ``gauge`` is the window occupancy
+    after each push; its ``max`` is the high-water mark of the CURRENT
+    drain window — >1 proves dispatch ran ahead of retirement.  Each
+    ``drain()`` closes the window: the recorded max stays readable
+    until the next ``push``, which resets it so back-to-back phases
+    (warmup vs measurement, one serving burst vs the next) each report
+    their own high water instead of inheriting the largest ever seen.
+    ``timer`` is host time blocked here by backpressure, recorded under
+    the ``span`` trace name with the PUSHING unit's correlation.
     """
 
-    __slots__ = ("limit", "_handles")
+    __slots__ = ("limit", "_handles", "_gauge", "_span", "_timer",
+                 "_window_closed")
 
-    def __init__(self, limit: Optional[int] = None):
+    def __init__(self, limit: Optional[int] = None, *,
+                 env: str = "MXNET_MAX_INFLIGHT_STEPS", default: int = 2,
+                 gauge: str = "engine.inflight_steps",
+                 span: str = "pipeline.stall",
+                 timer: str = "pipeline.stall_seconds"):
         if limit is None:
-            limit = get_env("MXNET_MAX_INFLIGHT_STEPS", 2, int)
+            limit = get_env(env, default, int)
         self.limit = max(1, int(limit))
         self._handles: deque = deque()
+        self._gauge = gauge
+        self._span = span
+        self._timer = timer
+        self._window_closed = False
 
     def __len__(self) -> int:
         return len(self._handles)
@@ -102,37 +121,59 @@ class InflightQueue:
             return
         if isinstance(handle, (tuple, list)):
             for h in handle:
-                InflightQueue._block(h)
+                BoundedInflight._block(h)
             return
         # an un-waitable handle would silently disable backpressure —
         # the exact unbounded dispatch this queue exists to prevent
         raise MXNetError(
-            f"InflightQueue cannot wait on {type(handle).__name__}: push "
-            "a jax.Array, an NDArray, or a tuple of them")
+            f"{BoundedInflight.__name__} cannot wait on "
+            f"{type(handle).__name__}: push a jax.Array, an NDArray, or "
+            "a tuple of them")
 
     def _wait(self, item):
         handle, corr = item
-        # the span carries the PUSHING step's correlation (captured at
+        # the span carries the PUSHING unit's correlation (captured at
         # push time), not the current thread's: draining step t-K's
         # handle while dispatching step t must not bill the wait to t
-        with _tr.span("pipeline.stall", timer="pipeline.stall_seconds",
+        with _tr.span(self._span, timer=self._timer,
                       corr=corr, timer_on_error=True):
             self._block(handle)
 
     def push(self, handle):
-        """Record a dispatched step; block on step t-K once over-limit."""
+        """Record a dispatched unit; block on unit t-K once over-limit."""
         self._handles.append((handle, _tr.capture()))
         while len(self._handles) > self.limit:
             self._wait(self._handles.popleft())
         if _tel._ENABLED:
-            _tel.set_gauge("engine.inflight_steps", len(self._handles))
+            g = _tel.gauge(self._gauge)
+            if self._window_closed:
+                # first push after a drain(): a new high-water window
+                # opens — the previous window's max was readable from
+                # drain until now
+                g.reset_max()
+            g.set(len(self._handles))
+        self._window_closed = False
 
     def drain(self):
-        """Retire every in-flight step (checkpoint/eval boundaries)."""
+        """Retire every in-flight unit (checkpoint/eval boundaries,
+        serve shutdown); closes the current high-water window."""
         while self._handles:
             self._wait(self._handles.popleft())
         if _tel._ENABLED:
-            _tel.set_gauge("engine.inflight_steps", 0)
+            _tel.set_gauge(self._gauge, 0)
+        self._window_closed = True
+
+
+class InflightQueue(BoundedInflight):
+    """The step pipeline's :class:`BoundedInflight` (docs/pipeline.md):
+    ``limit`` defaults to ``MXNET_MAX_INFLIGHT_STEPS`` (2), occupancy
+    lands on gauge ``engine.inflight_steps`` and backpressure stalls on
+    timer ``pipeline.stall_seconds`` / span ``pipeline.stall``."""
+
+    __slots__ = ()
+
+    def __init__(self, limit: Optional[int] = None):
+        super().__init__(limit)
 
 
 class NaiveEngine(Engine):

@@ -13,6 +13,8 @@ import os
 from typing import List, Optional
 
 import jax
+import jax.export  # lazy submodule on jax 0.4.x: attribute access alone
+# raises AttributeError until the submodule is imported once
 import jax.numpy as jnp
 
 from ..base import MXNetError

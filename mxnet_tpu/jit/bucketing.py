@@ -206,6 +206,20 @@ class ShapeBucketer:
     def n_buckets(self, shape: Sequence[int]) -> int:
         return len(self.expand(shape))
 
+    def axis_bound(self, axis: int) -> Optional[int]:
+        """Largest bucket size the policy on ``axis`` can produce, or
+        ``None`` when the axis is unbucketed or its policy is unbounded.
+        The serve coalescer reads ``axis_bound(0)`` to cap batch rows at
+        the largest batch bucket (docs/serving.md).  Note this is the
+        largest GRID bucket, not a bounded policy's raw ``hi`` — an
+        off-grid ``hi`` (``("pow2", 8, 20)`` → buckets 8, 16) admits
+        sizes up to 16 only; 17..20 would raise in ``bucket()``."""
+        pol = self.spec.get(axis)
+        if pol is None:
+            return None
+        sizes = pol.enumerate()
+        return sizes[-1] if sizes else None
+
     # -- host-side padding --------------------------------------------------
     def _pad_np(self, arr: _onp.ndarray) -> _onp.ndarray:
         """Pad one numpy leaf to its bucket shape — no copy when already
@@ -272,6 +286,111 @@ class ShapeBucketer:
             raise MXNetError("pad_batch: batch contains no array leaves")
         ref = max(leaves_shape, key=len)
         return padded, self.mask_for(ref)
+
+    def pad_requests(self, requests, with_mask: bool = True):
+        """Coalesce a list of single-sample requests into ONE bucketed
+        batch — the serve coalescer's growth path (docs/serving.md).
+
+        Each request is one array leaf or a tuple of array leaves with
+        NO batch axis: spec axis 0 is the STACK axis (number of
+        requests) and spec axis ``a >= 1`` governs per-request axis
+        ``a - 1``.  Requests may be ragged on bucketed axes (each leaf
+        pads up to the bucket of the batch-wide max); raggedness on an
+        unbucketed axis raises, since no single batch shape exists.
+
+        Returns ``(batch, mask, slices)``:
+
+        * ``batch`` — numpy, same tree shape as one request (bare array
+          in, bare array out; tuple in, tuple out), every leaf stacked
+          to ``bucket(len(requests))`` rows and padded with
+          ``pad_value``.
+        * ``mask`` — boolean validity in the loss-aligned convention of
+          :meth:`mask_for` (rank truncated at the last bucketed axis,
+          size 1 on unbucketed axes), but per-ROW: row ``i`` is True
+          exactly over request ``i``'s real extent, padding rows are
+          all-False.  ``with_mask=False`` skips its construction and
+          returns ``None`` — the serving hot path, where models consume
+          valid-length leaves instead of a mask.
+        * ``slices`` — per-request index tuples into the reference
+          (highest-rank) leaf: ``batch[slices[i]]`` recovers request
+          ``i``'s leaf bit-for-bit, and the serve completion path uses
+          the same tuples to cut each request's rows out of the batched
+          model output.
+        """
+        if not isinstance(requests, (list, tuple)) or not requests:
+            raise MXNetError(
+                "pad_requests needs a non-empty list of requests")
+
+        def leaves_of(r) -> Tuple[_onp.ndarray, ...]:
+            rr = r if isinstance(r, (tuple, list)) else (r,)
+            return tuple(
+                x.asnumpy() if hasattr(x, "asnumpy") else _onp.asarray(x)
+                for x in rr)
+
+        bare = not isinstance(requests[0], (tuple, list))
+        reqs = [leaves_of(r) for r in requests]
+        nleaf = len(reqs[0])
+        if any(len(r) != nleaf for r in reqs):
+            raise MXNetError(
+                "pad_requests: requests disagree on leaf count "
+                f"({sorted({len(r) for r in reqs})})")
+        n = len(reqs)
+        pol0 = self.spec.get(0)
+        b_pad = pol0.bucket(n) if pol0 is not None else n
+
+        batch_leaves: List[_onp.ndarray] = []
+        for j in range(nleaf):
+            ls = [r[j] for r in reqs]
+            rank = ls[0].ndim
+            if any(l.ndim != rank for l in ls):
+                raise MXNetError(
+                    f"pad_requests: leaf {j} rank differs across requests")
+            dt = ls[0].dtype
+            if any(l.dtype != dt for l in ls):
+                raise MXNetError(
+                    f"pad_requests: leaf {j} dtype differs across requests")
+            target = []
+            for a in range(rank):  # per-request axis a = stacked axis a+1
+                sizes = {l.shape[a] for l in ls}
+                size = max(sizes)
+                pol = self.spec.get(a + 1)
+                if pol is not None:
+                    size = pol.bucket(size)
+                elif len(sizes) > 1:
+                    raise MXNetError(
+                        f"pad_requests: requests are ragged on leaf {j} "
+                        f"axis {a} (sizes {sorted(sizes)}) but stacked "
+                        f"axis {a + 1} has no bucket policy — add one to "
+                        "the spec or pad upstream")
+                target.append(size)
+            out = _onp.full((b_pad, *target), self.pad_value, dtype=dt)
+            for i, l in enumerate(ls):
+                out[(i,) + tuple(slice(0, s) for s in l.shape)] = l
+            batch_leaves.append(out)
+
+        # reference leaf: highest rank after stacking — the data leaf by
+        # convention, same rule as pad_batch
+        ref_j = max(range(nleaf), key=lambda j: reqs[0][j].ndim)
+        ref = batch_leaves[ref_j]
+        mask = None
+        if with_mask:
+            active = [a for a in self.spec if 0 < a < ref.ndim]
+            rank_m = max(active, default=0) + 1
+            mshape = [1] * rank_m
+            mshape[0] = b_pad
+            for a in active:
+                mshape[a] = ref.shape[a]
+            mask = _onp.zeros(tuple(mshape), dtype=bool)
+            for i, r in enumerate(reqs):
+                sl = [slice(None)] * rank_m
+                sl[0] = slice(i, i + 1)
+                for a in active:
+                    sl[a] = slice(0, r[ref_j].shape[a - 1])
+                mask[tuple(sl)] = True
+        slices = [(i,) + tuple(slice(0, s) for s in r[ref_j].shape)
+                  for i, r in enumerate(reqs)]
+        return (batch_leaves[0] if bare else tuple(batch_leaves),
+                mask, slices)
 
     def __repr__(self):
         parts = []

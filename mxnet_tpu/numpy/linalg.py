@@ -22,8 +22,13 @@ for _name in _NAMES:
 # namedtuple-returning decompositions break jax.vjp's pytree matching in
 # the dispatcher (SlogdetResult vs tuple) — normalize to plain tuples
 slogdet = wrap_op(lambda a: tuple(jnp.linalg.slogdet(a)), "linalg.slogdet")
+# square inputs: full and reduced SVD are IDENTICAL (U, S, Vh shapes and
+# values), but jax refuses the JVP purely on the full_matrices flag — so
+# lower the flag when it cannot change the result and gradients work
 svd = wrap_op(lambda a, full_matrices=True, compute_uv=True:
-              (tuple(jnp.linalg.svd(a, full_matrices=full_matrices))
+              (tuple(jnp.linalg.svd(
+                  a, full_matrices=full_matrices
+                  and a.shape[-2] != a.shape[-1]))
                if compute_uv else jnp.linalg.svd(a, compute_uv=False)),
               "linalg.svd")
 eigh = wrap_op(lambda a: tuple(jnp.linalg.eigh(a)), "linalg.eigh")

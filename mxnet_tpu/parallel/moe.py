@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .collectives import axis_size as _axis_size
+
 __all__ = ["moe_ffn", "moe_reference", "gate_topk", "aux_load_balance"]
 
 
@@ -106,7 +108,7 @@ def moe_ffn(x, gate_w, w_up_local, w_down_local, axis_name: str = "ep",
       w_down_local: (E_local, h, d)
     Returns (out (n_local, d), aux_loss scalar — psum-mean over the axis).
     """
-    ep = lax.axis_size(axis_name)
+    ep = _axis_size(axis_name)
     n, d = x.shape
     e_local = w_up_local.shape[0]
     e = ep * e_local

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .collectives import axis_size as _axis_size
+
 __all__ = ["pipeline_apply", "pipeline_reference"]
 
 
@@ -56,7 +58,7 @@ def pipeline_apply(stage_fn: Callable, local_params, x,
     Returns (M, mb, ...) final-stage outputs, identical on every device
     (psum-broadcast from the last stage).
     """
-    s = lax.axis_size(axis_name)
+    s = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     # the stacking contract: params carry a leading stage axis sharded
     # over 'pp'; shard_map leaves it as size 1 locally — strip it here so

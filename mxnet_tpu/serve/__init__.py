@@ -1,0 +1,107 @@
+"""mx.serve — async continuous-batching inference on the jit+bucketing
+substrate (docs/serving.md).
+
+The serving tier the ROADMAP's "millions of users" half asks for,
+assembled from pieces PRs 1-8 already hardened:
+
+* :class:`~mxnet_tpu.jit.ShapeBucketer` bounds the signature set for
+  ragged request shapes and coalesces request lists into padded batches
+  with validity masks (``pad_requests``);
+* AOT ``HybridBlock.warmup()`` + the persistent compile cache make the
+  first real request compile-free and replica cold start a disk replay;
+* :class:`~mxnet_tpu.engine.BoundedInflight` bounds dispatch depth
+  (backpressure), the request queue sheds fail-fast at
+  ``MXNET_SERVE_QUEUE_MAX`` (503-style :class:`RejectedError`);
+* every request is trace-correlated across the queue/dispatch/device
+  hops and the latency/occupancy metrics land in telemetry
+  (docs/telemetry.md Serving section, docs/tracing.md spans).
+
+Quick start::
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve
+
+    net = mx.gluon.model_zoo.get_model("lenet")
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((1, 1, 28, 28)))            # shape discovery
+
+    serve.register("lenet", net,
+                   bucketer={0: [4, 16]},       # batch-row buckets
+                   sample=onp.zeros((1, 28, 28), "float32"))
+
+    fut = serve.submit("lenet", image)          # non-blocking
+    probs = fut.result(timeout=5.0)             # (10,) numpy
+    # or: serve.predict("lenet", image, timeout=5.0)
+
+Module-level calls ride one lazily-created default :class:`Server` over
+the process-global registry; construct :class:`Server` directly for
+custom bounds or an isolated registry.  Env knobs:
+``MXNET_SERVE_MAX_WAIT_MS`` (5), ``MXNET_SERVE_MAX_BATCH`` (32),
+``MXNET_SERVE_QUEUE_MAX`` (1024), ``MXNET_SERVE_MAX_INFLIGHT`` (2).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .coalescer import (ClosedError, RejectedError, Request, RequestQueue,
+                        ServeFuture)
+from .registry import (ModelEntry, Registry, default_registry,
+                       normalize_request)
+from .server import Server
+
+__all__ = ["Server", "Registry", "ModelEntry", "ServeFuture",
+           "RejectedError", "ClosedError", "register", "unregister",
+           "models", "submit", "predict", "shutdown", "default_registry",
+           "default_server"]
+
+_SERVER: Optional[Server] = None
+_LOCK = threading.Lock()
+
+
+def default_server() -> Server:
+    """The lazily-created process-default :class:`Server` (recreated
+    after :func:`shutdown`)."""
+    global _SERVER
+    with _LOCK:
+        if _SERVER is None or _SERVER._closed:
+            _SERVER = Server()
+        return _SERVER
+
+
+def register(name: str, block, bucketer=None, sample=None,
+             warmup: bool = True, background: bool = False) -> ModelEntry:
+    """Register ``block`` under ``name`` in the default registry and
+    AOT-warm its bucket grid (see :meth:`Registry.register`)."""
+    return default_registry().register(name, block, bucketer=bucketer,
+                                       sample=sample, warmup=warmup,
+                                       background=background)
+
+
+def unregister(name: str):
+    default_registry().unregister(name)
+
+
+def models():
+    return default_registry().models()
+
+
+def submit(model: str, *args) -> ServeFuture:
+    """Enqueue one request on the default server (see
+    :meth:`Server.submit`)."""
+    return default_server().submit(model, *args)
+
+
+def predict(model: str, *args, timeout: Optional[float] = None):
+    """Blocking convenience on the default server."""
+    return default_server().predict(model, *args, timeout=timeout)
+
+
+def shutdown(timeout: float = 60.0):
+    """Close the default server (drains accepted requests); the next
+    :func:`submit` starts a fresh one."""
+    global _SERVER
+    with _LOCK:
+        srv, _SERVER = _SERVER, None
+    if srv is not None:
+        srv.close(timeout)

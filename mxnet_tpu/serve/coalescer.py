@@ -1,0 +1,194 @@
+"""Request queue + coalescer — the admission half of mx.serve
+(docs/serving.md).
+
+A request enters through :meth:`RequestQueue.put` (fail-fast load
+shedding at ``MXNET_SERVE_QUEUE_MAX`` — a bounded queue is what keeps
+p99 honest under overload) and leaves through
+:meth:`RequestQueue.take_batch`, the coalescing pop the dispatcher
+thread sits in: it blocks for the first pending request, then keeps
+admitting same-model requests until the OLDEST one's max-wait deadline
+expires or the per-model row bound is reached.  Because the pop returns
+as soon as the deadline/bound trips — never waiting for earlier batches
+to retire — requests arriving while batch t is still executing on the
+device join batch t+1: continuous batching, not static barriers.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Tuple
+
+from .. import telemetry as _tel
+from ..base import MXNetError
+
+__all__ = ["Request", "ServeFuture", "RejectedError", "ClosedError",
+           "RequestQueue"]
+
+
+class RejectedError(MXNetError):
+    """Load-shedding rejection (HTTP-503 analogue): the pending queue is
+    at ``MXNET_SERVE_QUEUE_MAX``.  Fail-fast by design — queueing past
+    the bound only converts an honest rejection into a timeout the
+    client discovers later.  Retry with backoff, or raise the bound /
+    add replicas."""
+
+    status = 503
+
+
+class ClosedError(MXNetError):
+    """The server is shut down; no new requests are admitted."""
+
+    status = 503
+
+
+class Request:
+    """One in-flight inference request (internal; clients hold the
+    :class:`ServeFuture` wrapper)."""
+
+    __slots__ = ("id", "model", "args", "corr", "t_submit", "t_dispatch",
+                 "_event", "_result", "_error")
+
+    def __init__(self, rid: int, model: str, args, corr):
+        self.id = rid
+        self.model = model
+        self.args = args
+        # the submitting thread's trace correlation (request=<id>) —
+        # queue/dispatch/respond spans recorded on the server threads
+        # attach it so the whole lifecycle lines up in one Perfetto row
+        self.corr = corr
+        self.t_submit = time.perf_counter()
+        self.t_dispatch: Optional[float] = None
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def fulfill(self, result):
+        if self._event.is_set():
+            return
+        self._result = result
+        self._event.set()
+
+    def fail(self, err: BaseException):
+        # first resolution wins: a late batch-level failure must not
+        # clobber a result a client may already have read
+        if self._event.is_set():
+            return
+        self._error = err
+        self._event.set()
+
+
+class ServeFuture:
+    """Handle returned by ``submit()``.  ``result(timeout)`` blocks for
+    the response; a failed batch rethrows its error here."""
+
+    __slots__ = ("_req",)
+
+    def __init__(self, req: Request):
+        self._req = req
+
+    @property
+    def id(self) -> int:
+        return self._req.id
+
+    def done(self) -> bool:
+        return self._req._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._req._event.wait(timeout):
+            raise MXNetError(
+                f"serve request {self._req.id} ({self._req.model}) still "
+                f"pending after {timeout}s")
+        if self._req._error is not None:
+            raise self._req._error
+        return self._req._result
+
+
+class RequestQueue:
+    """Bounded FIFO of pending requests + the coalescing pop (module
+    docstring).  All state lives under one condition variable; ``put``
+    never blocks (it sheds instead), only ``take_batch`` waits."""
+
+    def __init__(self, max_depth: int):
+        self.max_depth = max(1, int(max_depth))
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    def put(self, req: Request) -> bool:
+        """Admit a request; returns False (shed) at ``max_depth``."""
+        with self._cond:
+            if self._closed:
+                raise ClosedError("serve: server is closed")
+            if len(self._q) >= self.max_depth:
+                return False
+            self._q.append(req)
+            depth = len(self._q)
+            self._cond.notify()
+        if _tel._ENABLED:
+            _tel.set_gauge("serve.queue_depth", depth)
+        return True
+
+    def _collect(self, model: str, batch: List[Request], max_batch: int):
+        """Move pending requests for ``model`` into ``batch`` (FIFO among
+        that model; other models keep their arrival order).  Caller holds
+        the lock."""
+        kept: deque = deque()
+        while self._q and len(batch) < max_batch:
+            r = self._q.popleft()
+            (batch if r.model == model else kept).append(r)
+        kept.extend(self._q)
+        self._q = kept
+
+    def take_batch(self, max_wait: float,
+                   max_batch_of: Callable[[str], int],
+                   ) -> Optional[Tuple[str, List[Request]]]:
+        """Block until a batch is ready; None when closed and drained.
+
+        The head request pins the model and starts the max-wait clock
+        (time-to-first-dispatch is bounded by ITS submit time, not by
+        when the batch happens to fill); later same-model arrivals are
+        folded in on every wake until the deadline or the row bound.  A
+        closed queue skips the wait entirely — shutdown drains what is
+        left as partial batches.
+        """
+        with self._cond:
+            while not self._q:
+                if self._closed:
+                    return None
+                self._cond.wait()
+            head = self._q[0]
+            model = head.model
+            max_batch = max(1, int(max_batch_of(model)))
+            deadline = head.t_submit + max_wait
+            batch: List[Request] = []
+            self._collect(model, batch, max_batch)
+            while len(batch) < max_batch and not self._closed:
+                now = time.perf_counter()
+                if now >= deadline:
+                    break
+                self._cond.wait(deadline - now)
+                self._collect(model, batch, max_batch)
+            depth = len(self._q)
+        if _tel._ENABLED:
+            _tel.set_gauge("serve.queue_depth", depth)
+        return model, batch
+
+    def close(self):
+        """Stop admissions and wake the dispatcher to drain the rest."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def drain_pending(self) -> List[Request]:
+        """Remove and return everything still queued — the shutdown path
+        for a server whose dispatcher never started (those requests have
+        no thread left to answer them)."""
+        with self._cond:
+            out = list(self._q)
+            self._q.clear()
+        return out

@@ -1,0 +1,272 @@
+"""Multi-model registry — the model half of mx.serve (docs/serving.md).
+
+A :class:`ModelEntry` binds one hybridized :class:`HybridBlock` to the
+:class:`~mxnet_tpu.jit.ShapeBucketer` that bounds its jit-signature set,
+and AOT-warms the FULL bucket grid at registration
+(``HybridBlock.warmup`` over ``bucketer.expand``), so the first real
+request never compiles — the fixed-shape, ahead-of-time XLA program
+model.  With the persistent compile cache armed (mx.jit.cache), a
+replica's cold start replays the grid from disk instead of XLA.
+
+The entry also owns the model-shaped halves of the data path: request
+normalization, batch → NDArray placement, device → host readback, and
+cutting each request's rows back out of the batched output (the inverse
+of ``pad_requests``, same output-axis convention as the hybridize unpad
+path — see the caveat in docs/serving.md).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as _onp
+
+from .. import telemetry as _tel
+from ..base import MXNetError
+from ..jit import ShapeBucketer
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["ModelEntry", "Registry", "default_registry"]
+
+
+def _np_leaf(x) -> _onp.ndarray:
+    return x.asnumpy() if hasattr(x, "asnumpy") else _onp.asarray(x)
+
+
+def normalize_request(args) -> Any:
+    """Normalize ``submit(model, *args)`` leaves to host numpy: a single
+    arg stays a bare leaf, several become a tuple — mirroring the tree
+    shapes ``ShapeBucketer.pad_requests`` stacks."""
+    if not args:
+        raise MXNetError("serve: a request needs at least one array")
+    if len(args) == 1 and not isinstance(args[0], (tuple, list)):
+        return _np_leaf(args[0])
+    if len(args) == 1:
+        return tuple(_np_leaf(x) for x in args[0])
+    return tuple(_np_leaf(x) for x in args)
+
+
+def map_tree(o, fn):
+    """Apply ``fn`` to every non-container leaf of a tuple/list tree."""
+    if isinstance(o, (tuple, list)):
+        return type(o)(map_tree(v, fn) for v in o)
+    return fn(o)
+
+
+class ModelEntry:
+    """One registered model (module docstring)."""
+
+    def __init__(self, name: str, block, bucketer=None, sample=None):
+        from ..gluon.block import HybridBlock
+
+        if not isinstance(block, HybridBlock):
+            raise MXNetError(
+                f"serve.register({name!r}): block must be a HybridBlock "
+                f"(got {type(block).__name__}) — serving dispatches "
+                "compiled executables, not eager forwards")
+        if isinstance(bucketer, dict):
+            bucketer = ShapeBucketer(bucketer)
+        if bucketer is None:
+            bucketer = getattr(block, "_bucketer", None)
+        if bucketer is None:
+            raise MXNetError(
+                f"serve.register({name!r}) needs a ShapeBucketer (or a "
+                "block already hybridized with one): the bucketer is what "
+                "bounds the signature set a ragged request stream compiles")
+        if 0 not in bucketer.spec:
+            raise MXNetError(
+                f"serve.register({name!r}): the bucketer must bucket axis "
+                "0 (the batch axis) — the coalescer's batch size varies "
+                "per tick, and an unbucketed batch axis would compile one "
+                "executable per occupancy")
+        self.name = name
+        self.block = block
+        self.bucketer = bucketer
+        self.sample = (normalize_request((sample,))
+                       if sample is not None else None)
+        # attach the bucketer at the hybridize seam unless it already is:
+        # __call__-side padding makes the entry safe even for callers
+        # that bypass pad_requests
+        if not getattr(block, "_active", False) or \
+                getattr(block, "_bucketer", None) is not bucketer:
+            block.hybridize(bucketer=bucketer)
+        self.max_rows: Optional[int] = bucketer.axis_bound(0)
+        self.compiled: Optional[int] = None
+        self.warmup_handle = None
+
+    # -- warmup -----------------------------------------------------------
+    def warm(self, background: bool = False):
+        """AOT-compile the full bucket grid (inference mode).  Returns
+        the newly-compiled signature count, or a
+        :class:`~mxnet_tpu.gluon.block.WarmupHandle` when
+        ``background=True`` (stored on ``warmup_handle`` too)."""
+        if self.sample is None:
+            raise MXNetError(
+                f"serve.register({self.name!r}): warmup needs a sample "
+                "request (pass sample=..., or warmup=False to compile "
+                "lazily on the first batch)")
+        batch, _mask, _slices = self.bucketer.pad_requests(
+            [self.sample], with_mask=False)
+        args = batch if isinstance(batch, tuple) else (batch,)
+        res = self.block.warmup(tuple(args), train_mode=False,
+                                background=background)
+        if background:
+            self.warmup_handle = res
+            return res
+        self.compiled = res
+        return res
+
+    # -- data path --------------------------------------------------------
+    def validate(self, req):
+        """Cheap admission check against the registration sample (leaf
+        count / rank / dtype, unbucketed axis sizes, bucket bounds) so a
+        malformed request is refused AT SUBMIT — with the error
+        attributed to its sender — instead of poisoning every request
+        in its coalesced batch.  No sample registered ⇒ no check (the
+        batch-level failure path still contains the blast radius)."""
+        if self.sample is None:
+            return
+        s_leaves = self.sample if isinstance(self.sample, tuple) \
+            else (self.sample,)
+        r_leaves = req if isinstance(req, tuple) else (req,)
+        if len(s_leaves) != len(r_leaves):
+            raise MXNetError(
+                f"serve:{self.name}: request has {len(r_leaves)} array "
+                f"leaves, the registered sample has {len(s_leaves)}")
+        for j, (s, r) in enumerate(zip(s_leaves, r_leaves)):
+            if r.ndim != s.ndim:
+                raise MXNetError(
+                    f"serve:{self.name}: leaf {j} rank {r.ndim} != "
+                    f"sample rank {s.ndim} (requests carry NO batch "
+                    "axis — the coalescer stacks them)")
+            if r.dtype != s.dtype:
+                raise MXNetError(
+                    f"serve:{self.name}: leaf {j} dtype {r.dtype} != "
+                    f"sample dtype {s.dtype}")
+            for a in range(r.ndim):
+                pol = self.bucketer.spec.get(a + 1)
+                if pol is None:
+                    if r.shape[a] != s.shape[a]:
+                        raise MXNetError(
+                            f"serve:{self.name}: leaf {j} axis {a} size "
+                            f"{r.shape[a]} != sample size {s.shape[a]} "
+                            f"and stacked axis {a + 1} has no bucket "
+                            "policy — ragged requests need one")
+                else:
+                    pol.bucket(r.shape[a])  # raises past a bounded grid
+
+    def pad_requests(self, requests: List[Any]):
+        # no mask on the serving hot path: models consume valid-length
+        # leaves; occupancy accounting reads shapes, not the mask
+        return self.bucketer.pad_requests(requests, with_mask=False)
+
+    def __call__(self, batch):
+        """Run one coalesced batch through the compiled forward.  H2D
+        happens in the NDArray constructor (billed to
+        ``ndarray.h2d_bytes``); the return is the block's (lazy) output
+        tree."""
+        leaves = batch if isinstance(batch, tuple) else (batch,)
+        return self.block(*(NDArray(l) for l in leaves))
+
+    @staticmethod
+    def to_host(out):
+        """Device→host readback of an output tree (one blocking copy per
+        leaf, billed to ``ndarray.d2h_bytes`` like any asnumpy)."""
+        return map_tree(out, lambda l: l.asnumpy()
+                        if isinstance(l, NDArray) else l)
+
+    @staticmethod
+    def handles(out):
+        """The raw jax arrays of an output tree — what the dispatch
+        bound (BoundedInflight) waits on."""
+        acc: List[Any] = []
+        map_tree(out, lambda l: acc.append(l._data)
+                 if isinstance(l, NDArray) else None)
+        return acc
+
+    @staticmethod
+    def slice_out(np_out, sl: Tuple, ref_shape: Tuple[int, ...]):
+        """Cut request ``sl``'s rows out of a batched host output tree.
+
+        Axis 0 is indexed by the request's row whenever the leaf carries
+        the batch axis (size == padded rows); a later output axis is
+        sliced back to the request's extent only when its size equals
+        the PADDED size of the matching stacked input axis — the same
+        size-match convention the hybridize unpad path uses, with the
+        same ambiguity when an output dimension coincides with a padded
+        input size (docs/serving.md caveat)."""
+        b_pad = ref_shape[0]
+
+        def cut(leaf):
+            if getattr(leaf, "ndim", 0) < 1 or leaf.shape[0] != b_pad:
+                return leaf  # no batch axis: shared across the batch
+            row = leaf[sl[0]]
+            for k in range(1, len(sl)):
+                orig = sl[k]
+                if (row.ndim >= k and orig.stop != ref_shape[k]
+                        and row.shape[k - 1] == ref_shape[k]):
+                    row = row[(slice(None),) * (k - 1) + (orig,)]
+            return row
+
+        return map_tree(np_out, cut)
+
+
+class Registry:
+    """Thread-safe name → :class:`ModelEntry` map."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, ModelEntry] = {}
+
+    def register(self, name: str, block, bucketer=None, sample=None,
+                 warmup: bool = True, background: bool = False
+                 ) -> ModelEntry:
+        """Register (or replace) a model.  ``warmup=True`` (default)
+        AOT-compiles the full bucket grid before the entry goes live —
+        ``background=True`` overlaps it with other startup work; call
+        ``entry.warmup_handle.wait()`` before serving traffic if the
+        zero-compile guarantee matters more than time-to-listen."""
+        entry = ModelEntry(name, block, bucketer, sample)
+        if warmup:
+            entry.warm(background=background)
+        with self._lock:
+            self._entries[name] = entry
+            n = len(self._entries)
+        if _tel._ENABLED:
+            _tel.set_gauge("serve.models", n)
+        return entry
+
+    def unregister(self, name: str):
+        with self._lock:
+            self._entries.pop(name, None)
+            n = len(self._entries)
+        if _tel._ENABLED:
+            _tel.set_gauge("serve.models", n)
+
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            e = self._entries.get(name)
+        if e is None:
+            with self._lock:
+                have = sorted(self._entries)
+            raise MXNetError(
+                f"serve: no model {name!r} registered (have {have})")
+        return e
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+        if _tel._ENABLED:
+            _tel.set_gauge("serve.models", 0)
+
+
+_DEFAULT = Registry()
+
+
+def default_registry() -> Registry:
+    """The process-global registry the module-level serve API uses."""
+    return _DEFAULT

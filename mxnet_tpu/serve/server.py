@@ -1,0 +1,284 @@
+"""Continuous-batching dispatch loop — the engine half of mx.serve
+(docs/serving.md).
+
+Two daemon threads per :class:`Server`:
+
+* the **dispatcher** sits in the coalescing pop
+  (:meth:`RequestQueue.take_batch`), pads each batch onto the model's
+  bucket grid (``pad_requests`` — padded batch + validity mask, the
+  loss-aligned convention), runs the AOT-warmed forward (lazy outputs —
+  the call returns as soon as XLA enqueues the program) and immediately
+  goes back for the next batch.  Dispatch depth is bounded by a
+  :class:`~mxnet_tpu.engine.BoundedInflight` (``MXNET_SERVE_MAX_INFLIGHT``)
+  — the same backpressure primitive the training step pipeline uses —
+  so a slow device stalls the dispatcher instead of growing an unbounded
+  device queue.
+* the **completer** retires batches in dispatch order: device sync +
+  D2H readback, then cuts each request's rows out of the batched output
+  and fulfills its future.  Keeping retirement off the dispatcher thread
+  is what makes the batching *continuous*: batch t+1 is coalesced and
+  dispatched while batch t is still executing.
+
+Load shedding happens at ``submit`` (``RejectedError``, 503-style) when
+the pending queue hits ``MXNET_SERVE_QUEUE_MAX`` — see docs/serving.md
+for the tuning triangle (max-wait vs occupancy vs queue bound).
+
+Observability: every request carries a ``request=<id>`` trace
+correlation from ``submit`` through the queue/dispatch/sync/respond
+spans regardless of which thread records them, and batches carry
+``serve_batch=<id>``; telemetry gauges/timers are cataloged in
+docs/telemetry.md (Serving section).
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Any, List, Optional, Tuple
+
+from .. import telemetry as _tel
+from ..base import MXNetError, get_env
+from ..engine import BoundedInflight
+from ..trace import recorder as _tr
+from .coalescer import (ClosedError, RejectedError, Request, RequestQueue,
+                        ServeFuture)
+from .registry import ModelEntry, Registry, default_registry, \
+    normalize_request
+
+__all__ = ["Server"]
+
+
+class Server:
+    """Async continuous-batching inference server over a model
+    :class:`~mxnet_tpu.serve.registry.Registry`.
+
+    Parameters (each defaults to its env var):
+
+    * ``max_wait_ms`` / ``MXNET_SERVE_MAX_WAIT_MS`` (5): longest a
+      request waits for co-batching before its batch dispatches anyway.
+    * ``max_batch`` / ``MXNET_SERVE_MAX_BATCH`` (32): server-wide row
+      bound; per model it is further capped by the bucketer's largest
+      axis-0 bucket.
+    * ``queue_max`` / ``MXNET_SERVE_QUEUE_MAX`` (1024): pending-queue
+      depth past which ``submit`` sheds (``RejectedError``).
+    * ``max_inflight`` / ``MXNET_SERVE_MAX_INFLIGHT`` (2): dispatched
+      batches allowed in flight before the dispatcher blocks.
+    """
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 max_wait_ms: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 queue_max: Optional[int] = None,
+                 max_inflight: Optional[int] = None):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.max_wait = (get_env("MXNET_SERVE_MAX_WAIT_MS", 5.0, float)
+                         if max_wait_ms is None else float(max_wait_ms)
+                         ) / 1e3
+        self.max_batch = (get_env("MXNET_SERVE_MAX_BATCH", 32, int)
+                          if max_batch is None else int(max_batch))
+        self.queue_max = (get_env("MXNET_SERVE_QUEUE_MAX", 1024, int)
+                          if queue_max is None else int(queue_max))
+        self._queue = RequestQueue(self.queue_max)
+        self._inflight = BoundedInflight(
+            max_inflight, env="MXNET_SERVE_MAX_INFLIGHT",
+            gauge="serve.inflight_batches", span="serve.stall",
+            timer="serve.stall_seconds")
+        self._done: _queue.Queue = _queue.Queue()
+        self._lock = threading.Lock()
+        self._started = False
+        self._closed = False
+        self._dispatcher: Optional[threading.Thread] = None
+        self._completer: Optional[threading.Thread] = None
+
+    # -- client API -------------------------------------------------------
+    def submit(self, model: str, *args) -> ServeFuture:
+        """Enqueue one request (leaves WITHOUT the batch axis — the
+        coalescer stacks them); returns a :class:`ServeFuture`.  Raises
+        :class:`RejectedError` (503) when the queue is at its bound and
+        :class:`ClosedError` after :meth:`close`."""
+        if self._closed:
+            raise ClosedError("serve: server is closed")
+        entry = self.registry.get(model)
+        nargs = normalize_request(args)
+        entry.validate(nargs)  # malformed ⇒ refused here, not in-batch
+        rid = _tr.next_id("serve.request")
+        with _tr.correlate(request=rid):
+            corr = _tr.capture()
+        req = Request(rid, entry.name, nargs, corr)
+        if not self._queue.put(req):
+            if _tel._ENABLED:
+                _tel.inc("serve.rejected")
+            _tr.record_span("serve.shed", req.t_submit, 0.0, corr=corr,
+                            model=entry.name)
+            raise RejectedError(
+                f"serve: pending queue at MXNET_SERVE_QUEUE_MAX="
+                f"{self.queue_max}; request for {entry.name!r} shed "
+                "(503) — retry with backoff, raise the bound, or add "
+                "replicas")
+        if _tel._ENABLED:
+            _tel.inc("serve.requests")
+        self._ensure_threads()
+        return ServeFuture(req)
+
+    def predict(self, model: str, *args, timeout: Optional[float] = None):
+        """Blocking convenience: ``submit(...).result(timeout)``."""
+        return self.submit(model, *args).result(timeout)
+
+    # -- lifecycle --------------------------------------------------------
+    def _ensure_threads(self):
+        if self._started:
+            return
+        with self._lock:
+            if self._started or self._closed:
+                return
+            self._dispatcher = threading.Thread(
+                target=self._dispatch_loop, name="mx-serve-dispatch",
+                daemon=True)
+            self._completer = threading.Thread(
+                target=self._complete_loop, name="mx-serve-complete",
+                daemon=True)
+            self._dispatcher.start()
+            self._completer.start()
+            self._started = True
+
+    def close(self, timeout: float = 60.0):
+        """Stop admissions, drain everything already accepted (pending
+        requests dispatch as final — possibly partial — batches), join
+        the threads.  Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._queue.close()
+        if self._started:
+            self._dispatcher.join(timeout)
+            self._completer.join(timeout)
+            if self._dispatcher.is_alive() or self._completer.is_alive():
+                raise MXNetError(
+                    f"serve: shutdown did not drain within {timeout}s")
+        else:
+            # submit/close race on a never-started server: a request can
+            # be admitted after our _closed check-point but before its
+            # _ensure_threads (which now sees _closed and starts
+            # nothing) — fail it loudly instead of stranding its future
+            for r in self._queue.drain_pending():
+                r.fail(ClosedError(
+                    "serve: server closed before dispatch started"))
+        self._inflight.drain()
+
+    def __enter__(self) -> "Server":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    @staticmethod
+    def _wrap(e: BaseException) -> MXNetError:
+        if isinstance(e, MXNetError):
+            return e
+        err = MXNetError(f"{type(e).__name__}: {e}")
+        err.__cause__ = e
+        return err
+
+    # -- dispatcher thread ------------------------------------------------
+    def _max_rows(self, model: str) -> int:
+        try:
+            bound = self.registry.get(model).max_rows
+        except MXNetError:
+            # model unregistered between submit and dispatch: answer
+            # something harmless here (called inside take_batch, outside
+            # the dispatcher's try) — the guarded _dispatch lookup then
+            # fails THIS batch's futures instead of killing the thread
+            return self.max_batch
+        return self.max_batch if bound is None \
+            else min(self.max_batch, bound)
+
+    def _dispatch_loop(self):
+        while True:
+            got = self._queue.take_batch(self.max_wait, self._max_rows)
+            if got is None:
+                break
+            model, reqs = got
+            if not reqs:
+                continue
+            try:
+                self._dispatch(self.registry.get(model), reqs)
+            except BaseException as e:  # noqa: BLE001 — fail the batch,
+                # keep serving: one poisoned batch must not kill the
+                # dispatcher and wedge every later client.  Same wire
+                # format as the engines: non-MXNetErrors surface as
+                # MXNetError("TypeName: msg") with the original chained.
+                err = self._wrap(e)
+                for r in reqs:
+                    r.fail(err)
+                if _tel._ENABLED:
+                    _tel.inc("serve.errors")
+                if not isinstance(e, Exception):
+                    self._done.put(None)
+                    raise
+        self._done.put(None)
+
+    def _dispatch(self, entry: ModelEntry, reqs: List[Request]):
+        t_disp = time.perf_counter()
+        for r in reqs:
+            r.t_dispatch = t_disp
+            if _tel._ENABLED:
+                _tel.observe("serve.time_to_dispatch_seconds",
+                             t_disp - r.t_submit)
+            # queue residency, attributed to the REQUEST's correlation
+            _tr.record_span("serve.queue", r.t_submit,
+                            t_disp - r.t_submit, corr=r.corr,
+                            model=entry.name)
+        batch, _mask, slices = entry.pad_requests([r.args for r in reqs])
+        leaves = batch if isinstance(batch, tuple) else (batch,)
+        ref_shape = max(leaves, key=lambda l: l.ndim).shape
+        rows, padded = len(reqs), int(ref_shape[0])
+        if _tel._ENABLED:
+            _tel.inc("serve.batches")
+            _tel.inc("serve.rows", rows)
+            _tel.inc("serve.padded_rows", padded)
+            _tel.set_gauge("serve.batch_occupancy", rows / padded)
+        bid = _tr.next_id("serve.batch")
+        with _tr.correlate(serve_batch=bid):
+            with _tr.span("serve.dispatch",
+                          timer="serve.dispatch_seconds",
+                          model=entry.name, rows=rows,
+                          padded_rows=padded):
+                out = entry(batch)
+            self._done.put((bid, entry, reqs, out, slices, ref_shape))
+            # backpressure AFTER handing the batch to the completer, so
+            # retirement proceeds while the dispatcher is stalled here
+            self._inflight.push(entry.handles(out))
+
+    # -- completion thread ------------------------------------------------
+    def _complete_loop(self):
+        while True:
+            item = self._done.get()
+            if item is None:
+                break
+            bid, entry, reqs, out, slices, ref_shape = item
+            try:
+                with _tr.correlate(serve_batch=bid), \
+                        _tr.span("serve.sync", timer="serve.sync_seconds",
+                                 timer_on_error=True, model=entry.name,
+                                 rows=len(reqs)):
+                    np_out = entry.to_host(out)
+                for r, sl in zip(reqs, slices):
+                    r.fulfill(entry.slice_out(np_out, sl, ref_shape))
+                    t_done = time.perf_counter()
+                    if _tel._ENABLED:
+                        _tel.observe("serve.e2e_seconds",
+                                     t_done - r.t_submit)
+                    _tr.record_span("serve.respond", t_done, 0.0,
+                                    corr=r.corr, model=entry.name)
+            except BaseException as e:  # noqa: BLE001 — same contract as
+                # the dispatcher: fail the batch, keep retiring
+                err = self._wrap(e)
+                for r in reqs:
+                    r.fail(err)
+                if _tel._ENABLED:
+                    _tel.inc("serve.errors")
+                if not isinstance(e, Exception):
+                    raise

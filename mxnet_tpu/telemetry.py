@@ -140,6 +140,14 @@ class Gauge:
             if value > self._max:
                 self._max = value
 
+    def reset_max(self):
+        """Collapse the high-water mark to the current value.  Owners of
+        a *windowed* gauge (the inflight queues) call this when a new
+        measurement window opens, so ``max`` answers "since the last
+        drain", not "since process start"."""
+        with self._lock:
+            self._max = self._value
+
     @property
     def value(self):
         return self._value

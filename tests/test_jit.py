@@ -112,6 +112,101 @@ def test_bucketer_unaligned_lo_snaps_to_grid():
 
 
 # ---------------------------------------------------------------------------
+# pad_requests — the serve coalescer's growth path (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+def test_pad_requests_single_leaf_ragged():
+    b = ShapeBucketer({0: [4, 8], 1: ("pow2", 4, 16)})
+    reqs = [onp.arange(1, 4, dtype="f4"), onp.arange(1, 7, dtype="f4"),
+            onp.arange(1, 10, dtype="f4")]
+    batch, mask, slices = b.pad_requests(reqs)
+    assert batch.shape == (4, 16)  # 3 reqs -> 4 rows, max len 9 -> 16
+    assert mask.shape == (4, 16)
+    # slices recover each request bit-for-bit; padding is pad_value
+    for r, sl in zip(reqs, slices):
+        assert onp.array_equal(batch[sl], r)
+    assert batch.sum() == sum(r.sum() for r in reqs)  # zeros elsewhere
+    # mask is per-ROW ragged validity: exactly the real elements
+    assert mask.sum() == sum(len(r) for r in reqs)
+    assert not mask[3].any()                  # padding row all-False
+    assert mask[0, :3].all() and not mask[0, 3:].any()
+
+
+def test_pad_requests_tuple_leaves_and_scalars():
+    """BERT-shaped requests: (tokens (T,), segments (T,), valid ())."""
+    b = ShapeBucketer({0: [2, 4], 1: ("pow2", 8, 8)})
+    reqs = [(onp.full((3,), 7, "int32"), onp.zeros((3,), "int32"),
+             onp.asarray(3, "int32")),
+            (onp.full((5,), 9, "int32"), onp.ones((5,), "int32"),
+             onp.asarray(5, "int32")),
+            (onp.full((8,), 2, "int32"), onp.zeros((8,), "int32"),
+             onp.asarray(8, "int32"))]
+    batch, mask, slices = b.pad_requests(reqs)
+    assert isinstance(batch, tuple) and len(batch) == 3
+    tok, seg, vl = batch
+    assert tok.shape == seg.shape == (4, 8)
+    assert vl.shape == (4,)                    # scalars stack to rows
+    assert vl.tolist() == [3, 5, 8, 0]
+    assert mask.shape == (4, 8)
+    for r, sl in zip(reqs, slices):
+        assert onp.array_equal(tok[sl], r[0])  # slices index the
+        assert len(sl) == 2                    # reference (data) leaf
+
+
+def test_pad_requests_with_mask_false_skips_mask():
+    b = ShapeBucketer({0: [4], 1: ("pow2", 4, 8)})
+    reqs = [onp.ones((3,), "f4"), onp.ones((5,), "f4")]
+    batch, mask, slices = b.pad_requests(reqs, with_mask=False)
+    assert mask is None
+    wb, wm, wsl = b.pad_requests(reqs)  # batch and slices unchanged
+    assert onp.array_equal(batch, wb) and wm is not None
+    assert slices == wsl
+
+
+def test_pad_requests_axis0_only_spec():
+    b = ShapeBucketer({0: [8]})
+    reqs = [onp.full((2, 3), i, "f4") for i in range(3)]
+    batch, mask, slices = b.pad_requests(reqs)
+    assert batch.shape == (8, 2, 3)
+    assert mask.shape == (8,)                  # loss-aligned truncation
+    assert mask.tolist() == [True] * 3 + [False] * 5
+    assert onp.array_equal(batch[slices[1]], reqs[1])
+
+
+def test_pad_requests_errors():
+    b = ShapeBucketer({0: [4]})
+    with pytest.raises(MXNetError, match="non-empty"):
+        b.pad_requests([])
+    with pytest.raises(MXNetError, match="leaf count"):
+        b.pad_requests([(onp.zeros(2),), (onp.zeros(2), onp.zeros(2))])
+    with pytest.raises(MXNetError, match="rank"):
+        b.pad_requests([onp.zeros((2,)), onp.zeros((2, 2))])
+    with pytest.raises(MXNetError, match="dtype"):
+        b.pad_requests([onp.zeros(2, "f4"), onp.zeros(2, "i4")])
+    # ragged on an axis with no bucket policy: no single batch shape
+    with pytest.raises(MXNetError, match="no bucket policy"):
+        b.pad_requests([onp.zeros((2,), "f4"), onp.zeros((3,), "f4")])
+    # beyond the largest batch bucket: the policy's own loud error
+    with pytest.raises(MXNetError, match="exceeds"):
+        b.pad_requests([onp.zeros((2,), "f4")] * 5)
+
+
+def test_axis_bound():
+    b = ShapeBucketer({0: [4, 16], 1: ("pow2", 8, 64), 2: "pow2",
+                       3: ("linear", 16, 16, 48)})
+    assert b.axis_bound(0) == 16     # explicit: largest bucket
+    assert b.axis_bound(1) == 64     # bounded pow2: largest grid bucket
+    assert b.axis_bound(2) is None   # unbounded
+    assert b.axis_bound(3) == 48     # bounded linear: largest bucket
+    assert b.axis_bound(9) is None   # unbucketed axis
+    # off-grid hi: the bound is the largest bucket the GRID holds (a raw
+    # hi of 20 would admit 17..20-row batches that bucket() then rejects)
+    off = ShapeBucketer({0: ("pow2", 8, 20)})
+    assert off.axis_bound(0) == 16
+    off.spec[0].bucket(off.axis_bound(0))  # the bound itself is padabble
+
+
+# ---------------------------------------------------------------------------
 # numeric equivalence: padded+masked == unpadded (the acceptance bar)
 # ---------------------------------------------------------------------------
 

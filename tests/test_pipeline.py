@@ -269,6 +269,31 @@ def test_inflight_queue_orders_and_drains():
     assert len(q) == 0
 
 
+def test_inflight_hwm_resets_per_drain_window():
+    """ISSUE 9 satellite: each drain() closes a high-water window.  The
+    closed window's max stays readable until the NEXT push (so smokes
+    that snapshot after drain keep their number), then resets — a
+    warmup burst no longer inflates every later window's high water."""
+    prev = tel.set_enabled(True)
+    tel.reset()
+    try:
+        q = InflightQueue(limit=4)
+        for i in range(3):
+            q.push(jnp.ones(()) * i)
+        assert tel.snapshot()["engine.inflight_steps"]["max"] == 3
+        q.drain()
+        # still readable after the drain...
+        g = tel.snapshot()["engine.inflight_steps"]
+        assert g["value"] == 0 and g["max"] == 3
+        # ...and the next window starts fresh
+        q.push(jnp.ones(()))
+        g = tel.snapshot()["engine.inflight_steps"]
+        assert g["value"] == 1 and g["max"] == 1
+    finally:
+        tel.reset()
+        tel.set_enabled(prev)
+
+
 def test_inflight_queue_accepts_ndarray_and_rejects_unwaitable():
     """Pushing the NDArray loss step() returns must actually wait (a
     silent no-op would disable backpressure); un-waitable handles raise
