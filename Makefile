@@ -17,7 +17,7 @@ BUILD    := build
 
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
-	trace-smoke kernels-smoke serve-smoke lint-hybrid ci clean
+	trace-smoke kernels-smoke serve-smoke lint-hybrid lint-graph ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -140,11 +140,22 @@ lint-hybrid:
 	# mxlint loads mx.analysis standalone (no jax import): sub-second.
 	python tools/mxlint.py --format=json \
 		--baseline tools/mxlint_baseline.json \
-		mxnet_tpu example benchmark
+		mxnet_tpu example benchmark tools
 
-ci: native native-test asan tsan lint-hybrid test test-slow telemetry-smoke \
-	pipeline-smoke chaos-smoke warmup-smoke spmd-smoke trace-smoke \
-	kernels-smoke serve-smoke
+lint-graph:
+	# XLA executable lint (docs/analysis.md X rules): compiles the
+	# canonical models on CPU and gates their HLO against the per-model
+	# budgets in tools/xlalint_budgets.json (surprise collectives, arena
+	# concatenate bound, zero1 opt-state placement, unaliased donations,
+	# f64 leaks, host callbacks).  Budget drift re-baselines via
+	# tools/xlalint.py --update-budgets.  Serial — single-core box,
+	# never concurrent with tier-1.
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		python tools/xlalint.py
+
+ci: native native-test asan tsan lint-hybrid lint-graph test test-slow \
+	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
+	trace-smoke kernels-smoke serve-smoke
 
 clean:
 	rm -rf $(BUILD)

@@ -1,6 +1,6 @@
 """mx.analysis — static + runtime staging-hazard analysis.
 
-Three layers, one diagnostic shape (``diagnostics.Diagnostic``):
+Four layers, one diagnostic shape (``diagnostics.Diagnostic``):
 
 * :mod:`~mxnet_tpu.analysis.hybrid_lint` — AST hybridize-safety linter
   (rules H001..H010 on HybridBlock forwards, L101/L102 on training
@@ -17,6 +17,12 @@ Three layers, one diagnostic shape (``diagnostics.Diagnostic``):
   when a ShardedTrainer on a multi-device mesh keeps a big net's
   optimizer state fully replicated (the "you forgot zero1" footgun,
   docs/sharding.md).
+* :mod:`~mxnet_tpu.analysis.xla_lint` — executable lint over
+  lowered/compiled XLA programs (X001..X006: replicated opt state under
+  zero1, collective/concatenate budgets, unaliased donations, f64
+  leaks, host callbacks), hooked into every compile seam behind
+  ``MXNET_XLA_LINT=1|raise``.  CLI: ``tools/xlalint.py`` against
+  per-model budgets; CI gate: ``make lint-graph``.
 
 Rule catalog: ``diagnostics.RULES`` / docs/analysis.md.  This package is
 stdlib-only at import so the linter runs without loading jax.
@@ -26,10 +32,12 @@ from . import engine_check
 from . import hybrid_lint
 from . import retrace
 from . import spmd_hints
+from . import xla_lint
 from .diagnostics import Diagnostic, RULES, rule_doc, to_json
 from .hybrid_lint import lint_file, lint_paths, lint_source
 from .retrace import report as retrace_report
 
 __all__ = ["diagnostics", "engine_check", "hybrid_lint", "retrace",
-           "spmd_hints", "Diagnostic", "RULES", "rule_doc", "to_json",
-           "lint_source", "lint_file", "lint_paths", "retrace_report"]
+           "spmd_hints", "xla_lint", "Diagnostic", "RULES", "rule_doc",
+           "to_json", "lint_source", "lint_file", "lint_paths",
+           "retrace_report"]

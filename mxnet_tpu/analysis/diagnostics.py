@@ -159,6 +159,63 @@ RULES: Dict[str, tuple] = {
         "grads -> shard-local update -> all-gather params, same math — "
         "docs/sharding.md); tune the trigger threshold with "
         "MXNET_ZERO1_HINT_MIN_PARAMS"),
+    # -- XLA executable lint (xla_lint, graph-level X rules) ----------------
+    "X001": (
+        "replicated-optimizer-state-buffer",
+        "an optimizer-state input of the compiled step executable is "
+        "fully replicated although partition='zero1' promised a "
+        "dp-sharded placement — every device silently pays the full "
+        "state memory and update FLOPs, undoing the ZeRO-1 win",
+        "make sure ShardedTrainer fills shardings_box['opt_state'] with "
+        "dp-sharded placements and the state arrays are device_put onto "
+        "them before the step compiles (docs/sharding.md)"),
+    "X002": (
+        "collective-over-budget",
+        "the executable carries more (or different) collectives than "
+        "the model's budget — a surprise AllGather/AllReduce on the "
+        "step hot path usually means a lost sharding annotation or an "
+        "accidental cross-replica dependency, and it costs ICI "
+        "bandwidth every step",
+        "inspect compiled.as_text() for the op's origin; fix the "
+        "sharding, or raise the model's budget in "
+        "tools/xlalint_budgets.json if the collective is intended"),
+    "X003": (
+        "concatenate-over-budget",
+        "the executable carries more concatenate ops than the model's "
+        "budget — the flat-arena optimizer invariant is <=2 (one "
+        "grad-arena pack + its AD dual); a per-leaf pack/stack of "
+        "params scales with parameter count and refuses to fuse "
+        "(docs/kernels.md)",
+        "keep params out of packing ops (slice the arena instead), or "
+        "raise the budget if the extra concatenate is a real data op"),
+    "X004": (
+        "donation-not-aliased",
+        "an argument declared donated (donate_argnums) is NOT in the "
+        "executable's input_output_alias table: XLA could not reuse the "
+        "buffer (shape/dtype/layout mismatch with every output), so "
+        "the donation silently bought nothing and input + output are "
+        "live at once — 2x memory on exactly the buffers donation "
+        "exists to save",
+        "match the donated input's shape/dtype to the output it should "
+        "alias, or drop the donation (jax warns 'Some donated buffers "
+        "were not usable' at lower time; this rule catches it in CI)"),
+    "X005": (
+        "f64-in-executable",
+        "f64 ops leaked into a training/serving executable — double "
+        "precision is software-emulated or massively slower on "
+        "accelerators and almost always an accidental promotion "
+        "(python float constant, np.float64 input)",
+        "cast inputs/constants to float32 (or bf16) before the jit "
+        "boundary; set the model budget's allow_f64 if the f64 math is "
+        "intentional"),
+    "X006": (
+        "host-callback-in-jit",
+        "a host callback (pure_callback/io_callback/debug callback) is "
+        "embedded in the jitted program: every execution round-trips "
+        "device->host->device, serializing the step on host Python",
+        "move the host-side consumption outside the jitted function, "
+        "or set the model budget's allow_callbacks if the callback is "
+        "intentional (e.g. a debugging build)"),
     # -- tool errors --------------------------------------------------------
     "X000": (
         "analysis-error",

@@ -1,0 +1,539 @@
+"""xla_lint — static analysis over lowered/compiled XLA programs (X rules).
+
+PR 2's linters stop at the Python/NNVM boundary; every *graph-level*
+invariant landed since (zero1's dp-sharded optimizer state, the arena
+optimizer's ≤2-concatenate bound, donated-buffer aliasing, "no surprise
+collective on the step hot path") lived only as one-off test assertions.
+This pass checks them in the lowered program itself — "Operator Fusion
+in XLA" and the GSPMD weight-update paper both read these properties
+straight out of HLO — so they protect NEW models and call sites, not
+just the tests that first asserted them.
+
+Everything it consumes is obtainable on CPU: the compiled executable's
+HLO text (``compiled.as_text()``: op mix, ``input_output_alias`` header,
+collective types), the lowered StableHLO, ``cost_analysis()`` and the
+executable's input shardings.  No TPU needed.
+
+Rules (shared ``Diagnostic`` shape, catalog in ``diagnostics.RULES``):
+
+* **X001** replicated optimizer-state buffer under ``partition="zero1"``
+* **X002** collective count/type exceeds the model's budget
+* **X003** concatenate/stack count exceeds budget (the arena invariant)
+* **X004** donated argument whose buffer is not actually aliased
+* **X005** f64 ops leaked into a training/serving executable
+* **X006** host callback inside a jitted program
+
+Hooked into the three places executables are born — ``_CachedOp``
+compile/warmup, ``ShardedTrainer.compile()``/AOT, and the serve
+``Registry`` register-time grid warmup — behind ``MXNET_XLA_LINT=1``
+(warn + telemetry) / ``=raise`` (MXNetError).  ``tools/xlalint.py``
+lints the canonical models against per-model budgets
+(``tools/xlalint_budgets.json``); CI gate: ``make lint-graph``.
+
+Stdlib-only at import (mx.analysis contract): parsing is pure regex
+over program text; jax objects are only ever duck-typed (``as_text``,
+``cost_analysis``, ``input_shardings``), telemetry engages lazily.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import re
+import warnings
+from collections import Counter
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .diagnostics import Diagnostic
+
+__all__ = ["ExecutableFacts", "parse_program_text", "run_rules",
+           "lint_compiled", "collect_facts", "default_budget",
+           "merge_budget", "mode", "enabled", "report", "reset_warned",
+           "capture", "trainer_step_facts", "lint_trainer_executable",
+           "check_arena_program", "ARENA_CONCAT_BUDGET",
+           "COLLECTIVE_OPS", "CONCAT_OPS", "CALLBACK_TARGET_HINTS"]
+
+ENV_FLAG = "MXNET_XLA_LINT"
+
+# HLO collective opcodes that can appear on a step/serve hot path.  The
+# ``-start``/``-done`` async pairs count toward their base op.
+COLLECTIVE_OPS = ("all-gather", "all-reduce", "reduce-scatter",
+                  "all-to-all", "collective-permute",
+                  "collective-broadcast")
+# the packing op the arena invariant bounds (jnp.stack lowers to
+# broadcast+concatenate, so one opcode covers both packing idioms)
+CONCAT_OPS = ("concatenate",)
+# substrings identifying a host-callback custom-call target (jax's
+# pure_callback/io_callback/debug.callback lower to these)
+CALLBACK_TARGET_HINTS = ("callback", "py_func", "host_event")
+
+# one compiled-HLO instruction:  %name = <type> opcode(...)
+# <type> is either a space-free token (f32[2,4]{1,0}) or a tuple type
+# ((f32[2,4]{1,0}, s32[])) which contains spaces but no inner parens
+_HLO_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?:\([^)]*\)|\S+)\s+"
+    r"([a-z][a-z0-9\-]*)\(")
+# one StableHLO/MHLO op:  %0 = stablehlo.concatenate %arg0, ...
+_MLIR_INSTR_RE = re.compile(r"=\s*(?:stablehlo|mhlo)\.([a-z_0-9]+)")
+# header entries of input_output_alias={ {out}: (param, {}, may-alias) }
+_ALIAS_RE = re.compile(r"\((\d+),\s*\{[^}]*\},\s*(?:may|must)-alias\)")
+_CUSTOM_CALL_RE = re.compile(r'custom_call_target="([^"]+)"')
+_MLIR_CUSTOM_CALL_RE = re.compile(r"stablehlo\.custom_call\s+@([\w.$-]+)")
+
+
+class ExecutableFacts:
+    """What the linter reads out of one lowered/compiled program."""
+
+    __slots__ = ("name", "op_counts", "aliased_params", "f64_count",
+                 "callback_targets", "dialect", "cost", "lowered_concats")
+
+    def __init__(self, name: str = "", op_counts: Optional[Counter] = None,
+                 aliased_params: Optional[Set[int]] = None,
+                 f64_count: int = 0,
+                 callback_targets: Optional[List[str]] = None,
+                 dialect: str = "hlo",
+                 cost: Optional[Dict[str, float]] = None,
+                 lowered_concats: Optional[int] = None):
+        self.name = name
+        self.op_counts: Counter = op_counts or Counter()
+        self.aliased_params: Set[int] = aliased_params or set()
+        self.f64_count = int(f64_count)
+        self.callback_targets: List[str] = callback_targets or []
+        self.dialect = dialect
+        self.cost = cost
+        # concatenate count of the LOWERED StableHLO when the caller has
+        # it: the program-semantic number (the arena invariant's "grad
+        # pack + AD dual"), stable across backends — the compiled HLO
+        # adds backend-chosen concatenates (padding/layout) on top
+        self.lowered_concats = lowered_concats
+
+    def count(self, *ops: str) -> int:
+        return sum(self.op_counts.get(o, 0) for o in ops)
+
+    @property
+    def concat_count(self) -> int:
+        """The X003 metric: lowered-program count when known, else the
+        compiled program's own."""
+        if self.lowered_concats is not None:
+            return self.lowered_concats
+        return self.count(*CONCAT_OPS)
+
+    @property
+    def collective_counts(self) -> Dict[str, int]:
+        return {o: self.op_counts[o] for o in COLLECTIVE_OPS
+                if self.op_counts.get(o)}
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "dialect": self.dialect,
+                "op_counts": dict(sorted(self.op_counts.items())),
+                "collectives": self.collective_counts,
+                "concatenates": self.concat_count,
+                "compiled_concatenates": self.count(*CONCAT_OPS),
+                "aliased_params": sorted(self.aliased_params),
+                "f64_count": self.f64_count,
+                "callback_targets": list(self.callback_targets),
+                "cost": self.cost}
+
+
+def _normalize_op(op: str) -> str:
+    """StableHLO spells ``all_reduce``; HLO spells ``all-reduce``.  One
+    spelling (the HLO one) keeps budgets dialect-agnostic."""
+    return op.replace("_", "-")
+
+
+def parse_program_text(text: str, name: str = "") -> ExecutableFacts:
+    """Parse compiled HLO *or* lowered StableHLO text into facts.
+
+    The async collective split (``all-reduce-start``/``-done``) counts
+    once toward its base op; ``fusion``/``parameter``/plumbing ops are
+    counted but carry no rule.
+    """
+    mlir = "stablehlo." in text or "mhlo." in text \
+        or text.lstrip().startswith("module @")
+    ops: Counter = Counter()
+    if mlir:
+        for m in _MLIR_INSTR_RE.finditer(text):
+            ops[_normalize_op(m.group(1))] += 1
+        callback_targets = [
+            t for t in _MLIR_CUSTOM_CALL_RE.findall(text)
+            if any(h in t.lower() for h in CALLBACK_TARGET_HINTS)]
+        f64 = len(re.findall(r"xf64>|tensor<f64>", text))
+    else:
+        for line in text.splitlines():
+            m = _HLO_INSTR_RE.match(line)
+            if m:
+                ops[m.group(1)] += 1
+        callback_targets = [
+            t for t in _CUSTOM_CALL_RE.findall(text)
+            if any(h in t.lower() for h in CALLBACK_TARGET_HINTS)]
+        f64 = len(re.findall(r"\bf64\[", text))
+    # fold async starts into the base op (the -done is plumbing)
+    for op in list(ops):
+        if op.endswith("-start"):
+            base = op[:-len("-start")]
+            ops[base] += ops.pop(op)
+            ops.pop(base + "-done", None)
+    aliased: Set[int] = set()
+    head = text.split("\n", 1)[0]
+    if "input_output_alias=" in head:
+        aliased = {int(i) for i in _ALIAS_RE.findall(head)}
+    return ExecutableFacts(name=name, op_counts=ops, aliased_params=aliased,
+                           f64_count=f64, callback_targets=callback_targets,
+                           dialect="stablehlo" if mlir else "hlo")
+
+
+# ---------------------------------------------------------------- budgets
+def default_budget() -> Dict[str, Any]:
+    """The no-manifest budget: structural rules (X001/X004/X005/X006)
+    always apply; count budgets (X002/X003) only when a model budget
+    sets them — a generic executable has no universal collective or
+    concatenate bound."""
+    return {"concatenates": None, "collectives": None,
+            "allow_f64": False, "allow_callbacks": False}
+
+
+def merge_budget(*layers: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Later layers override earlier ones (None layers skipped)."""
+    out = default_budget()
+    for layer in layers:
+        if layer:
+            out.update(layer)
+    return out
+
+
+# ------------------------------------------------------------------ rules
+def run_rules(facts: ExecutableFacts, budget: Optional[Dict[str, Any]] = None,
+              *, path: str = "<xla>", name: str = "",
+              donated_params: Iterable[int] = (),
+              opt_state: Optional[Sequence[Dict[str, Any]]] = None
+              ) -> List[Diagnostic]:
+    """Run every X rule over ``facts``; pure function of its inputs.
+
+    ``donated_params``: flat parameter indices the CALLER declared
+    donated (X004 checks them against the executable's actual
+    input-output aliasing).  ``opt_state``: per-leaf dicts with keys
+    ``label``/``replicated``/``expected_sharded``/``nbytes`` (built by
+    the trainer hook) for X001.
+    """
+    budget = merge_budget(budget)
+    name = name or facts.name
+    diags: List[Diagnostic] = []
+
+    def add(code: str, msg: str):
+        diags.append(Diagnostic(path, 0, code, msg, symbol=name,
+                                source="xla_lint"))
+
+    # X001 — replicated optimizer state under zero1
+    for leaf in opt_state or ():
+        if leaf.get("expected_sharded") and leaf.get("replicated"):
+            add("X001",
+                f"optimizer-state leaf {leaf.get('label', '?')!r} "
+                f"({leaf.get('nbytes', 0)} bytes) is fully replicated in "
+                f"the executable although partition='zero1' promised a "
+                f"dp-sharded placement — every device is paying the full "
+                f"state memory and update")
+
+    # X002 — collective count/type over budget
+    if budget.get("collectives") is not None:
+        allowed = {_normalize_op(k): v
+                   for k, v in budget["collectives"].items()}
+        for op in COLLECTIVE_OPS:
+            n = facts.op_counts.get(op, 0)
+            cap = allowed.get(op, 0)
+            if n > cap:
+                what = (f"{n} > budget {cap}" if op in allowed else
+                        f"{n} not in the budget at all (surprise "
+                        f"collective on the hot path)")
+                add("X002", f"collective {op}: {what}")
+
+    # X003 — concatenate/stack count over budget (the arena invariant)
+    if budget.get("concatenates") is not None:
+        n = facts.concat_count
+        if n > int(budget["concatenates"]):
+            add("X003",
+                f"{n} concatenate op(s) exceed the budget of "
+                f"{budget['concatenates']} — a per-leaf pack/stack of "
+                f"params scales with parameter count")
+
+    # X004 — donated argument not actually aliased
+    missing = sorted(set(int(i) for i in donated_params)
+                     - facts.aliased_params)
+    if missing:
+        add("X004",
+            f"donated argument(s) {missing} are NOT aliased in the "
+            f"executable (input_output_alias) — the donation silently "
+            f"bought nothing and the buffer is live twice (2x memory)")
+
+    # X005 — f64 leaked into the executable
+    if facts.f64_count and not budget.get("allow_f64"):
+        add("X005",
+            f"{facts.f64_count} f64 occurrence(s) in the program — "
+            f"double precision on an accelerator hot path is almost "
+            f"always an accidental promotion (python float / np.float64 "
+            f"constant); set budget allow_f64 if intended")
+
+    # X006 — host callback inside a jitted program
+    if facts.callback_targets and not budget.get("allow_callbacks"):
+        add("X006",
+            f"host callback(s) {sorted(set(facts.callback_targets))} "
+            f"inside the jitted program — every execution round-trips "
+            f"device->host->device; set budget allow_callbacks if "
+            f"intended")
+    return diags
+
+
+# ----------------------------------------------------- executable adapters
+def extract_cost(compiled) -> Optional[Dict[str, float]]:
+    """flops/bytes_accessed from ``compiled.cost_analysis()`` (list- or
+    dict-shaped across jax versions), None when unavailable."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # pragma: no cover - backend without analysis
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    return {"flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+
+
+def collect_facts(compiled, name: str = "",
+                  lowered_text: Optional[str] = None) -> ExecutableFacts:
+    """Facts from a jax ``Compiled`` (duck-typed: only ``as_text`` /
+    ``cost_analysis`` are touched, so no jax import happens here).
+    ``lowered_text`` (the pre-compile StableHLO) pins the X003
+    concatenate count to the program-semantic number."""
+    facts = parse_program_text(compiled.as_text(), name=name)
+    facts.cost = extract_cost(compiled)
+    if lowered_text is not None:
+        facts.lowered_concats = parse_program_text(
+            lowered_text).count(*CONCAT_OPS)
+    return facts
+
+
+def lint_compiled(compiled, *, name: str = "", path: str = "<xla>",
+                  budget: Optional[Dict[str, Any]] = None,
+                  donated_params: Iterable[int] = (),
+                  opt_state: Optional[Sequence[Dict[str, Any]]] = None,
+                  lowered_text: Optional[str] = None
+                  ) -> List[Diagnostic]:
+    """Lint one compiled executable; returns the diagnostics (callers
+    decide whether to ``report()`` them)."""
+    facts = collect_facts(compiled, name=name, lowered_text=lowered_text)
+    diags = run_rules(facts, budget, path=path, name=name,
+                      donated_params=donated_params, opt_state=opt_state)
+    if _CAPTURE is not None:
+        _CAPTURE.append((facts, diags))
+    return diags
+
+
+# ------------------------------------------------------------- env + report
+def mode() -> str:
+    """'' (off) | '1' (warn + telemetry) | 'raise'.  Read per call so
+    tests/tools can toggle without reloading."""
+    v = os.environ.get(ENV_FLAG, "").strip().lower()
+    if v in ("", "0", "false", "off"):
+        return ""
+    return "raise" if v == "raise" else "1"
+
+
+def enabled() -> bool:
+    return mode() != ""
+
+
+_WARNED: Set[str] = set()
+# when a capture() scope is open, every lint_compiled records
+# (facts, diagnostics) here and report() neither warns nor raises —
+# tools/xlalint.py consumes the structured stream instead
+_CAPTURE: Optional[List[Tuple[ExecutableFacts, List[Diagnostic]]]] = None
+
+
+def reset_warned():
+    _WARNED.clear()
+
+
+@contextlib.contextmanager
+def capture():
+    """Collect every hook-side lint result (the tools/xlalint.py CLI
+    runs models under this scope: structured results, no warnings, no
+    =raise escalation)."""
+    global _CAPTURE
+    prev = _CAPTURE
+    _CAPTURE = out = []
+    try:
+        yield out
+    finally:
+        _CAPTURE = prev
+
+
+def report(diags: List[Diagnostic], raise_mode: Optional[bool] = None):
+    """Deliver diagnostics the runtime-hook way: telemetry counters per
+    rule (``analysis.xla_lint`` + ``analysis.xla_lint.<code>``), one
+    RuntimeWarning per distinct finding, MXNetError under
+    ``MXNET_XLA_LINT=raise``.  Returns ``diags`` unchanged."""
+    if not diags:
+        return diags
+    try:  # telemetry optional: the pass must work standalone (mxlint load)
+        from mxnet_tpu import telemetry as _tel
+
+        _tel.inc("analysis.xla_lint_findings", len(diags))
+        for d in diags:
+            _tel.inc(f"analysis.xla_lint.{d.code}")
+    except Exception:  # pragma: no cover
+        pass
+    if _CAPTURE is not None:
+        return diags
+    if raise_mode is None:
+        raise_mode = mode() == "raise"
+    if raise_mode:
+        try:
+            from mxnet_tpu.base import MXNetError
+        except Exception:  # pragma: no cover - standalone load
+            MXNetError = RuntimeError  # type: ignore[assignment]
+        lines = "\n".join(d.format() for d in diags)
+        raise MXNetError(
+            f"MXNET_XLA_LINT=raise: {len(diags)} graph-lint finding(s)\n"
+            f"{lines}")
+    for d in diags:
+        # fingerprint() alone is (path, symbol, code) with path always
+        # '<xla>' here — two distinct findings of one rule on the same
+        # executable (e.g. two replicated X001 leaves) must BOTH warn
+        key = f"{d.fingerprint()}::{d.message}"
+        if key not in _WARNED:
+            _WARNED.add(key)
+            warnings.warn(f"[xla_lint] {d.format()}", RuntimeWarning,
+                          stacklevel=3)
+    return diags
+
+
+# --------------------------------------------------------- runtime hooks
+def _flat_shardings(compiled) -> Optional[List[Any]]:
+    """The executable's input shardings as a flat leaf list — in the
+    executable's (pruned) parameter numbering (duck-typed; jax's pytree
+    flatten only imports lazily and only here)."""
+    try:
+        import jax  # noqa: PLC0415 — hook path, jax is loaded anyway
+
+        ins = compiled.input_shardings
+        return list(jax.tree_util.tree_leaves(ins[0])) + \
+            list(jax.tree_util.tree_leaves(ins[1]))
+    except Exception:
+        return None
+
+
+def _kept_param_map(compiled) -> Optional[Dict[int, int]]:
+    """jit PRUNES unused arguments: the executable's parameter numbering
+    (what ``input_output_alias`` and ``input_shardings`` use) skips
+    dropped leaves.  Returns {tree-flatten leaf index -> executable
+    parameter index}, or None when the mapping is unknowable (then the
+    caller must not guess — a wrong index would fabricate X004s)."""
+    kept = getattr(getattr(compiled, "_executable", None),
+                   "_kept_var_idx", None)
+    if kept is None:
+        return None
+    return {v: i for i, v in enumerate(sorted(kept))}
+
+
+def trainer_step_facts(trainer, compiled, slot: str = "step"
+                       ) -> Dict[str, Any]:
+    """Executable-specific context for one ShardedTrainer step/grad/apply
+    executable: flat donated-parameter indices and the per-opt-state-leaf
+    placement expectations X001/X004 consume.
+
+    Step args are ``(tvals, avals, key, opt_state, t, lr, scale_state,
+    x, y)``; apply args are ``(tvals, opt_state, t, lr, scale_state,
+    grads)``.  Flat parameter numbering follows jax's tree flatten of
+    the args tuple, which for the leading list-of-array groups is
+    simply concatenation in order.
+    """
+    nt, na = len(trainer.pvals), len(trainer.avals)
+    ns = len(trainer.opt_state)
+    flat_donated: List[int] = []
+    if slot == "step":
+        opt_base = nt + na + 1      # tvals + avals + rng key
+        donated = trainer._holder.get("donate_argnums", ())
+        if 0 in donated:
+            flat_donated += list(range(nt))
+        if 3 in donated:
+            flat_donated += list(range(opt_base, opt_base + ns))
+    elif slot == "apply":
+        opt_base = nt               # (tvals, opt_state, ...)
+        donated = trainer._holder.get("apply_donate_argnums", ())
+        if 0 in donated:
+            flat_donated += list(range(nt))
+        if 1 in donated:
+            flat_donated += list(range(opt_base, opt_base + ns))
+    else:                           # grad: no donation, no opt state
+        return {"donated_params": [], "opt_state": []}
+    # map tree-flatten numbering onto the executable's pruned parameter
+    # numbering; a donated leaf jit pruned entirely is dead weight, not
+    # a live double buffer — X004 skips it
+    kept = _kept_param_map(compiled)
+    shardings = _flat_shardings(compiled)
+    if kept is not None:
+        exe_donated = [kept[i] for i in flat_donated if i in kept]
+    else:
+        exe_donated = []            # unknowable mapping: never guess
+    opt_state: List[Dict[str, Any]] = []
+    arena = getattr(trainer._adapter, "arena_sharding", None)
+    for j, leaf in enumerate(trainer.opt_state):
+        pi = trainer._adapter.leaf_param_ix[j]
+        if arena is not None:
+            expected = getattr(arena, "is_fully_replicated", True) is False
+            label = f"arena[{j}]"
+        else:
+            info = trainer._zero1[pi]
+            expected = info is not None
+            label = trainer.train_names[pi]
+        actual = None
+        if kept is not None and shardings is not None:
+            exe_ix = kept.get(opt_base + j)
+            if exe_ix is not None and exe_ix < len(shardings):
+                actual = shardings[exe_ix]
+        if actual is None:
+            actual = getattr(leaf, "sharding", None)
+        replicated = bool(getattr(actual, "is_fully_replicated", False))
+        opt_state.append({
+            "label": label, "replicated": replicated,
+            "expected_sharded": bool(expected and trainer.mesh.size > 1),
+            "nbytes": int(getattr(leaf, "nbytes", 0))})
+    return {"donated_params": exe_donated, "opt_state": opt_state}
+
+
+def lint_trainer_executable(trainer, compiled, slot: str = "step",
+                            budget: Optional[Dict[str, Any]] = None,
+                            lowered_text: Optional[str] = None
+                            ) -> List[Diagnostic]:
+    """The ShardedTrainer hook: facts + trainer context + the implicit
+    arena budget (a flat-arena step carries at most 2 concatenates: the
+    grad pack and its AD dual — docs/kernels.md), reported per
+    ``MXNET_XLA_LINT``."""
+    ctx = trainer_step_facts(trainer, compiled, slot)
+    implicit: Dict[str, Any] = {}
+    from_arena = getattr(trainer._adapter, "layout", None) is not None
+    if from_arena and slot in ("step", "apply"):
+        implicit["concatenates"] = ARENA_CONCAT_BUDGET
+    if budget is None:
+        budget = getattr(trainer, "_xla_lint_budget", None)
+    name = f"trainer.{slot}:{type(trainer.net).__name__}"
+    diags = lint_compiled(
+        compiled, name=name, budget=merge_budget(implicit, budget),
+        donated_params=ctx["donated_params"], opt_state=ctx["opt_state"],
+        lowered_text=lowered_text)
+    return report(diags)
+
+
+# the flat-arena optimizer invariant (docs/kernels.md): ONE grad-arena
+# pack + its AD dual, independent of parameter count
+ARENA_CONCAT_BUDGET = 2
+
+
+def check_arena_program(text: str, name: str = "arena-step",
+                        budget: int = ARENA_CONCAT_BUDGET
+                        ) -> List[Diagnostic]:
+    """The X003 arena check as a library call — ONE implementation shared
+    by tests/test_kernels.py, tools/kernels_smoke.py and the runtime
+    hooks (the hand-rolled ``text.count("concatenate")`` greps migrated
+    here)."""
+    facts = parse_program_text(text, name=name)
+    return run_rules(facts, {"concatenates": budget}, name=name)

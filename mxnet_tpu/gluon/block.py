@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from .. import telemetry as _tel
 from ..trace import recorder as _tr
 from ..analysis import retrace as _retrace
+from ..analysis import xla_lint as _xlint
 from ..base import DeferredInitializationError, MXNetError
 from ..context import Context, current_context
 from ..jit import cache as _jit_cache
@@ -431,6 +432,31 @@ class _CachedOp:
             type(self.block).__name__, sig, self._traced, n_calls=n_calls,
             bucketed=getattr(self.block, "_bucketer", None) is not None)
 
+    def _lint_compiled(self, jit_fn, raw_inputs, lowered=None):
+        """MXNET_XLA_LINT hook — executables born here (warmup or first
+        call) get the X-rule pass (analysis/xla_lint).  ``lowered`` is
+        reused when the caller already has one; otherwise the re-lower
+        happens under the trace lock (it traces) and the compile runs
+        UNLOCKED — a disk hit when the persistent cache is armed, a
+        real second compile otherwise (the opt-in flag buys that cost).
+        Lint failures other than the =raise verdict never break the
+        compile path."""
+        if not _xlint.enabled():
+            return
+        try:
+            if lowered is None:
+                with self._trace_lock:
+                    lowered = jit_fn.lower(*raw_inputs)
+            compiled = lowered.compile()
+        except Exception:  # pragma: no cover - lint is best-effort
+            return
+        label = getattr(self.block, "_xla_lint_label",
+                        type(self.block).__name__)
+        budget = getattr(self.block, "_xla_lint_budget", None)
+        _xlint.report(_xlint.lint_compiled(
+            compiled, name=f"hybridize:{label}", budget=budget,
+            lowered_text=lowered.as_text()))
+
     def _prepare(self, args, training: bool):
         """Resolve ``(key, jit_fn, inputs, holder)`` for ``args``,
         building the jit wrapper lazily (the compile itself happens at
@@ -531,6 +557,7 @@ class _CachedOp:
         if sig in self._traced:
             return False
         t0 = _time.perf_counter()
+        lowered = None
         if _jit_cache.is_active():
             with self._trace_lock:
                 if sig in self._traced:
@@ -556,6 +583,7 @@ class _CachedOp:
                                 warmup=True)
             # n_calls omitted: warmup traces are deliberate, not churn
             self._note_trace(sig)
+        self._lint_compiled(jit_fn, raw_inputs, lowered)
         return True
 
     def __call__(self, args, kwargs):
@@ -573,6 +601,7 @@ class _CachedOp:
 
         name = f"cached_op_{type(self.block).__name__}"
         sig = self._sig_of(key, inputs)
+        lint_inputs = None
         if sig in self._traced:
             if _tel._ENABLED:
                 _tel.inc("hybridize.cache_hits")
@@ -599,6 +628,12 @@ class _CachedOp:
                     if _tel._ENABLED:
                         _tel.inc("hybridize.cache_misses")
                     self._note_trace(sig, n_calls=self._calls)
+                    lint_inputs = [x._data for x in inputs]
+        if lint_inputs is not None:
+            # outside the trace lock: without the persistent cache the
+            # lint pays a real second compile, and the lock must never
+            # be held through a compile (class lock discipline)
+            self._lint_compiled(jit_fn, lint_inputs)
         if isinstance(res, NDArray):
             res = (res,)
         n_out = holder["n_out"]

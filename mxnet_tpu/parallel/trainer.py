@@ -20,6 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import engine as _engine
 from .. import telemetry as _tel
+from ..analysis import xla_lint as _xlint
 from ..trace import cost as _cost
 from ..trace import recorder as _tr
 from ..base import MXNetError
@@ -848,9 +849,15 @@ def make_train_step(net, loss_fn, names: List[str],
         # TPU executables round-trip aliasing correctly, so only the
         # CPU backend trades donation's buffer reuse for correctness.
         donate = False
-    jitted = jax.jit(step, donate_argnums=(0, 3) if donate else ())
+    # the X004 donation-aliasing lint reads the DECLARED donations from
+    # the holder (post-CPU-adjustment) and checks them against the
+    # executable's actual input_output_alias table (analysis/xla_lint)
+    holder["donate_argnums"] = (0, 3) if donate else ()
+    holder["apply_donate_argnums"] = (0, 1) if donate else ()
+    jitted = jax.jit(step, donate_argnums=holder["donate_argnums"])
     grad_fn = jax.jit(compute_grads)
-    apply_fn = jax.jit(apply_update, donate_argnums=(0, 1) if donate else ())
+    apply_fn = jax.jit(apply_update,
+                       donate_argnums=holder["apply_donate_argnums"])
     return jitted, grad_fn, apply_fn, adapter, holder
 
 
@@ -1258,6 +1265,15 @@ class ShardedTrainer:
                 _tr.record_span("hybridize.compile", t0,
                                 _time.perf_counter() - t0,
                                 block=type(self.net).__name__, slot=slot)
+            if _xlint.enabled():
+                # X-rule pass over the newborn executable (one of the
+                # three compile seams, docs/analysis.md); =raise
+                # verdicts propagate, everything else is warn+count.
+                # The lowered StableHLO pins X003's concatenate count
+                # to the program-semantic number (the compiled CPU HLO
+                # adds backend-chosen concatenates on top).
+                _xlint.lint_trainer_executable(
+                    self, compiled, slot, lowered_text=lowered.as_text())
             return compiled
 
         wid = _tr.next_id("warmup")

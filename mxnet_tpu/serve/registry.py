@@ -56,7 +56,8 @@ def map_tree(o, fn):
 class ModelEntry:
     """One registered model (module docstring)."""
 
-    def __init__(self, name: str, block, bucketer=None, sample=None):
+    def __init__(self, name: str, block, bucketer=None, sample=None,
+                 lint_budget=None):
         from ..gluon.block import HybridBlock
 
         if not isinstance(block, HybridBlock):
@@ -93,6 +94,14 @@ class ModelEntry:
         self.max_rows: Optional[int] = bucketer.axis_bound(0)
         self.compiled: Optional[int] = None
         self.warmup_handle = None
+        # MXNET_XLA_LINT: the register-time grid warmup is a compile
+        # seam — each warmed executable runs the X-rule pass attributed
+        # to THIS serve entry (diagnostic symbol "hybridize:serve.<name>",
+        # docs/analysis.md); lint_budget overrides the default budget
+        # (e.g. {"allow_callbacks": True} for a debug model)
+        block._xla_lint_label = f"serve.{name}"
+        if lint_budget is not None:
+            block._xla_lint_budget = dict(lint_budget)
 
     # -- warmup -----------------------------------------------------------
     def warm(self, background: bool = False):
@@ -219,14 +228,18 @@ class Registry:
         self._entries: Dict[str, ModelEntry] = {}
 
     def register(self, name: str, block, bucketer=None, sample=None,
-                 warmup: bool = True, background: bool = False
-                 ) -> ModelEntry:
+                 warmup: bool = True, background: bool = False,
+                 lint_budget=None) -> ModelEntry:
         """Register (or replace) a model.  ``warmup=True`` (default)
         AOT-compiles the full bucket grid before the entry goes live —
         ``background=True`` overlaps it with other startup work; call
         ``entry.warmup_handle.wait()`` before serving traffic if the
-        zero-compile guarantee matters more than time-to-listen."""
-        entry = ModelEntry(name, block, bucketer, sample)
+        zero-compile guarantee matters more than time-to-listen.  Under
+        ``MXNET_XLA_LINT`` every warmed executable runs the graph lint
+        (X rules) attributed to this entry; ``lint_budget`` overrides
+        the default budget (docs/analysis.md)."""
+        entry = ModelEntry(name, block, bucketer, sample,
+                           lint_budget=lint_budget)
         if warmup:
             entry.warm(background=background)
         with self._lock:

@@ -233,7 +233,12 @@ def test_arena_aot_compile_and_step():
 def test_arena_no_param_concatenate_in_hlo():
     """The acceptance gate of the flat-arena design: params are sliced,
     never packed — the step HLO carries at most the single grad-arena
-    concatenate (plus its AD dual), regardless of parameter count."""
+    concatenate (plus its AD dual), regardless of parameter count.
+    Checked through the X003 rule (analysis/xla_lint.check_arena_program)
+    — ONE implementation of the invariant, shared with the CI graph
+    lint and the runtime hooks, not a hand-rolled text grep."""
+    from mxnet_tpu.analysis import xla_lint
+
     with kreg.override("interpret"):
         tr = ShardedTrainer(_mlp(), _ce(), mesh=make_mesh({"dp": -1}),
                             optimizer="sgd", momentum=0.9,
@@ -243,7 +248,12 @@ def test_arena_no_param_concatenate_in_hlo():
         txt = tr._step_fn.lower(
             tr.pvals, tr.avals, tr._key, tr.opt_state, 1,
             jnp.float32(0.05), tr._scale_state, xb, yb).as_text()
-    assert txt.count("concatenate") <= 2, txt.count("concatenate")
+    diags = xla_lint.check_arena_program(txt, name="mlp-arena-step")
+    assert diags == [], [d.format() for d in diags]
+    # the rule is live, not vacuous: a tighter budget must flag this
+    # same program (it legitimately carries the pack + AD dual)
+    assert [d.code for d in
+            xla_lint.check_arena_program(txt, budget=0)] == ["X003"]
 
 
 def test_arena_fallback_reasons():

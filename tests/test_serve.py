@@ -312,6 +312,48 @@ def test_continuous_batching_runs_ahead(fresh_telemetry):
     assert "engine.inflight_steps" not in snap
 
 
+def test_engine_check_no_false_positive_on_serve_threads():
+    """ISSUE 10 satellite: the serve dispatcher/completer threads (PR 9)
+    never ran under the engine dependency checker.  With the checker
+    active, a full serve session — registration grid warmup, coalesced
+    ragged traffic from concurrent clients, per-request slice-back on
+    the completer thread, drain + close — must produce ZERO diagnostics,
+    while a seeded under-declared push in the same session is still
+    caught (the checker is live, not disarmed)."""
+    from mxnet_tpu import engine
+    from mxnet_tpu.analysis import engine_check as echk
+
+    eng = echk.install()
+    echk.clear()
+    try:
+        try:  # drain any first-error left by earlier exception tests on
+            # the shared process-global engine (first error reports once)
+            eng.wait_for_all()
+        except MXNetError:
+            pass
+        reg, _ = _registered(buckets=(2, 8))
+        with Server(registry=reg, max_wait_ms=2, max_batch=8,
+                    max_inflight=2) as srv:
+            reqs = _reqs(24)
+            outs = [f.result(timeout=60)
+                    for f in [srv.submit("mlp", r) for r in reqs]]
+        assert len(outs) == 24 and all(o.shape == (4,) for o in outs)
+        assert echk.diagnostics() == [], echk.diagnostics()
+        # ...and the checker is still live after the serve session
+        shared = mx.nd.array(onp.arange(4, dtype="f4"))
+        owner = engine.get().new_var()
+        echk.bind(shared, owner)
+        rogue = engine.get().new_var()
+        engine.get().push(lambda: shared.asnumpy(), write=[rogue],
+                          name="rogue")
+        engine.get().wait_for_var(rogue)
+        assert [d.code for d in echk.diagnostics()] == ["E001"]
+        engine.get().delete_var(owner)
+        engine.get().delete_var(rogue)
+    finally:
+        echk.uninstall()
+
+
 # ---------------------------------------------------------------------------
 # observability
 # ---------------------------------------------------------------------------

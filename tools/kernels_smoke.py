@@ -165,15 +165,26 @@ def arena_case(report):
             hlo = tr._step_fn.lower(
                 tr.pvals, tr.avals, tr._key, tr.opt_state, 1,
                 jnp.float32(0.05), tr._scale_state, xb, yb).as_text()
+            from mxnet_tpu.analysis import xla_lint
+
+            facts = xla_lint.parse_program_text(hlo, name=f"lenet-{fo}")
             runs[fo] = {"losses": losses, "steps_per_sec": round(sps, 3),
-                        "hlo_concatenates": hlo.count("concatenate"),
-                        "n_params": len(tr.pvals)}
+                        "hlo_concatenates": facts.concat_count,
+                        "n_params": len(tr.pvals), "_hlo": hlo}
+    from mxnet_tpu.analysis import xla_lint
+
     max_dloss = max(abs(a - b) / max(abs(a), 1.0) for a, b in
                     zip(runs["off"]["losses"], runs["arena"]["losses"]))
     ok_parity = max_dloss <= 5e-6         # sgd+momentum: few-ULP bar
     # no per-leaf concatenate/stack of params: the bound is constant (the
-    # grad-arena pack + AD dual), NOT a function of the 8 lenet params
-    ok_hlo = runs["arena"]["hlo_concatenates"] <= 2
+    # grad-arena pack + AD dual), NOT a function of the 8 lenet params.
+    # ONE implementation of the invariant — the X003 rule
+    # (analysis/xla_lint), shared with make lint-graph and the runtime
+    # hooks, replaces the hand-rolled text grep of earlier revisions
+    x003 = xla_lint.check_arena_program(runs["arena"].pop("_hlo"),
+                                        name="lenet-arena-step")
+    runs["off"].pop("_hlo")
+    ok_hlo = x003 == []
     delta = runs["arena"]["steps_per_sec"] / runs["off"]["steps_per_sec"]
     report["lenet_arena"] = {
         "steps": 10, "max_rel_dloss": max_dloss, "tol": 5e-6,

@@ -1,0 +1,325 @@
+#!/usr/bin/env python
+"""xlalint — graph-level lint of the canonical models' XLA executables.
+
+The executable-lint companion of ``tools/mxlint.py``: where mxlint reads
+Python source, xlalint compiles the canonical models on CPU (StableHLO +
+compiled HLO + ``cost_analysis()`` + input-output aliasing + shardings
+need no TPU) and runs the X rules (``mxnet_tpu/analysis/xla_lint.py``,
+catalog in docs/analysis.md) against the per-model budgets checked in at
+``tools/xlalint_budgets.json``.  A surprise AllGather on a step hot
+path, a per-leaf param concatenate creeping back into the arena step, a
+replicated optimizer-state buffer under zero1, an f64 promotion or a
+stray host callback all fail CI here instead of surfacing as a perf
+regression three PRs later.
+
+Canonical models (``--list``):
+  * lenet_train_arena  — LeNet train step, flat-arena fused optimizer
+                         (the <=2-concatenate invariant, X003)
+  * lenet_train_zero1  — LeNet train step, ZeRO-1 on the 8-device mesh
+                         (X001 + the collective budget, X002)
+  * resnet_infer       — ResNet-18 v1 inference executable
+  * resnet_fused_bn_relu_infer — the fused BN+ReLU zoo variant
+  * bert_tiny_train    — tiny-BERT pretrain train step
+  * serve_mlp          — a serve Registry entry's warmed bucket grid
+
+Usage:
+  python tools/xlalint.py                     # lint all, gate vs budgets
+  python tools/xlalint.py --models lenet_train_arena serve_mlp
+  python tools/xlalint.py --update-budgets    # baseline-update flow
+  python tools/xlalint.py --format=json
+Exit codes: 0 clean, 1 findings, 2 usage.  Always writes
+``xlalint_smoke.json`` (bench-style artifact, gitignored).
+
+CI: ``make lint-graph`` (serial — single-core box, never concurrent
+with tier-1).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# the zero1 model needs the 8-device virtual CPU mesh; both must be set
+# before jax import (same dance as tests/conftest.py)
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+BUDGETS_PATH = os.path.join(ROOT, "tools", "xlalint_budgets.json")
+ARTIFACT = os.path.join(ROOT, "xlalint_smoke.json")
+
+
+# ------------------------------------------------------------- model builders
+def _ce():
+    import jax
+    import jax.numpy as jnp
+
+    def ce(pred, y):
+        logp = jax.nn.log_softmax(pred.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+
+    return ce
+
+
+def _lenet():
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.get_model("lenet")
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((2, 1, 28, 28)))
+    return net
+
+
+def _lenet_batch():
+    import numpy as onp
+
+    rs = onp.random.RandomState(0)
+    return (onp.asarray(rs.rand(16, 1, 28, 28), onp.float32),
+            onp.asarray(rs.randint(0, 10, size=(16,)), onp.int32))
+
+
+def build_lenet_train_arena(budget):
+    """The arena invariant as a CI gate: the fused-optimizer step HLO
+    must hold the <=2-concatenate budget (docs/kernels.md)."""
+    import jax
+    from mxnet_tpu.kernels import registry as kreg
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    with kreg.override("interpret"):
+        tr = ShardedTrainer(_lenet(), _ce(),
+                            mesh=make_mesh({"dp": 1},
+                                           devices=jax.devices()[:1]),
+                            optimizer="sgd", learning_rate=0.05,
+                            momentum=0.9, fused_opt="arena")
+        tr._xla_lint_budget = budget
+        tr.compile(_lenet_batch())
+
+
+def build_lenet_train_zero1(budget):
+    """ZeRO-1 on the 8-device mesh: X001 guards the dp-sharded optimizer
+    state, the collective budget pins the AllReduce/AllGather mix."""
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    tr = ShardedTrainer(_lenet(), _ce(), mesh=make_mesh({"dp": 8}),
+                        optimizer="sgd", learning_rate=0.05,
+                        momentum=0.9, partition="zero1")
+    tr._xla_lint_budget = budget
+    tr.compile(_lenet_batch())
+
+
+def _resnet_infer(budget, fused: bool):
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.get_model("resnet18_v1",
+                                       fused_bn_relu=fused)
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((1, 3, 32, 32)))
+    net.hybridize()
+    net._xla_lint_budget = budget
+    net.warmup((((2, 3, 32, 32), "float32"),), train_mode=False)
+
+
+def build_resnet_infer(budget):
+    _resnet_infer(budget, fused=False)
+
+
+def build_resnet_fused_bn_relu_infer(budget):
+    _resnet_infer(budget, fused=True)
+
+
+def build_bert_tiny_train(budget):
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo.bert import BERTForPretrain, get_bert
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    mx.random.seed(0)
+    bert = get_bert("bert_12_768_12", vocab_size=97, max_length=32,
+                    num_layers=2, units=32, hidden_size=64,
+                    num_heads=4, dropout=0.0)
+    net = BERTForPretrain(bert, vocab_size=97)
+    net.initialize(mx.init.Xavier())
+    L = gloss.SoftmaxCrossEntropyLoss()
+
+    def loss_fn(preds, yy):
+        (scores, nsp), (mlm_l, nsp_l) = preds, yy
+        a = L(mx.nd.NDArray(scores), mx.nd.NDArray(mlm_l))._data.mean()
+        b = L(mx.nd.NDArray(nsp), mx.nd.NDArray(nsp_l))._data.mean()
+        return a + b
+
+    B, T, PP = 4, 16, 4
+    rs = onp.random.RandomState(2)
+    x = (rs.randint(0, 97, (B, T)).astype("int32"),
+         onp.zeros((B, T), "int32"), onp.full((B,), T, "int32"),
+         rs.randint(0, T, (B, PP)).astype("int32"))
+    y = (rs.randint(0, 97, (B, PP)).astype("int32"),
+         rs.randint(0, 2, (B,)).astype("int32"))
+    import jax
+
+    tr = ShardedTrainer(net, loss_fn,
+                        mesh=make_mesh({"dp": 1},
+                                       devices=jax.devices()[:1]),
+                        optimizer="sgd", learning_rate=0.05,
+                        momentum=0.9, fused_opt="off")
+    tr._xla_lint_budget = budget
+    tr.compile((x, y))
+
+
+def build_serve_mlp(budget):
+    """A serve Registry entry: every executable of the warmed bucket
+    grid is linted, attributed to the entry (docs/serving.md)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.serve.registry import Registry
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=8))
+    net.add(nn.Dense(4, in_units=32))
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((1, 8)))
+    Registry().register("mlp", net, bucketer={0: [2, 8]},
+                        sample=onp.zeros((8,), "float32"),
+                        lint_budget=budget)
+
+
+MODELS = {
+    "lenet_train_arena": build_lenet_train_arena,
+    "lenet_train_zero1": build_lenet_train_zero1,
+    "resnet_infer": build_resnet_infer,
+    "resnet_fused_bn_relu_infer": build_resnet_fused_bn_relu_infer,
+    "bert_tiny_train": build_bert_tiny_train,
+    "serve_mlp": build_serve_mlp,
+}
+
+
+# ------------------------------------------------------------------ budgets
+def load_budgets(path: str) -> dict:
+    if not os.path.exists(path):
+        return {"version": 1, "models": {}}
+    with open(path) as f:
+        return json.load(f)
+
+
+def measured_budget(captures) -> dict:
+    """The baseline-update flow: observed op mix -> budget (max per
+    collective op / concatenate count across the model's executables,
+    flags stay at their strict defaults)."""
+    coll: dict = {}
+    concats = 0
+    for facts, _diags in captures:
+        for op, n in facts.collective_counts.items():
+            coll[op] = max(coll.get(op, 0), n)
+        concats = max(concats, facts.concat_count)
+    return {"concatenates": concats, "collectives": coll,
+            "allow_f64": False, "allow_callbacks": False}
+
+
+def run_model(name: str, budget) -> tuple:
+    """-> (captures, diagnostics) for one canonical model."""
+    from mxnet_tpu.analysis import xla_lint as xl
+
+    os.environ["MXNET_XLA_LINT"] = "1"
+    with xl.capture() as cap:
+        MODELS[name](budget)
+    diags = [d for _f, dg in cap for d in dg]
+    return cap, diags
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--models", nargs="*", default=None,
+                   help="subset of canonical models (default: all)")
+    p.add_argument("--budgets", default=BUDGETS_PATH,
+                   help="budget manifest (default tools/xlalint_budgets"
+                        ".json)")
+    p.add_argument("--update-budgets", action="store_true",
+                   help="write the measured op mix back as the new "
+                        "budgets (baseline-update flow)")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--list", action="store_true",
+                   help="list canonical model names")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name in MODELS:
+            print(name)
+        return 0
+    names = args.models if args.models else list(MODELS)
+    unknown = [n for n in names if n not in MODELS]
+    if unknown:
+        p.error(f"unknown model(s): {', '.join(unknown)} "
+                f"(--list shows the canonical set)")
+
+    from mxnet_tpu.analysis import xla_lint as xl
+    from mxnet_tpu.analysis.diagnostics import to_json
+
+    manifest = load_budgets(args.budgets)
+    budgets = manifest.setdefault("models", {})
+    report = {"ok": True, "budgets": os.path.relpath(args.budgets, ROOT),
+              "models": {}}
+    all_diags = []
+    for name in names:
+        budget = budgets.get(name)
+        cap, diags = run_model(name, budget)
+        if args.update_budgets:
+            budgets[name] = measured_budget(cap)
+            diags = []  # re-baselined by definition
+        all_diags += diags
+        report["models"][name] = {
+            "ok": not diags,
+            "executables": [f.to_dict() for f, _d in cap],
+            "diagnostics": [d.to_dict() for d in diags],
+            "budget": budgets.get(name),
+        }
+        report["ok"] = report["ok"] and not diags
+        if args.format == "text":
+            state = "re-baselined" if args.update_budgets else (
+                "clean" if not diags else f"{len(diags)} finding(s)")
+            print(f"xlalint: {name}: {state} "
+                  f"({len(cap)} executable(s))")
+            for d in diags:
+                print(f"  {d.format()}")
+
+    if args.update_budgets:
+        manifest["version"] = 1
+        manifest["comment"] = ("per-model XLA graph budgets; regenerate "
+                               "with tools/xlalint.py --update-budgets")
+        with open(args.budgets, "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"budgets written: {args.budgets}")
+
+    with open(ARTIFACT, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    if args.format == "json":
+        doc = to_json(all_diags, tool="xlalint",
+                      models=sorted(report["models"]))
+        json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        verdict = "OK" if report["ok"] else "FAIL"
+        print(f"lint-graph: {verdict} -> {os.path.relpath(ARTIFACT, ROOT)}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
