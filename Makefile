@@ -17,7 +17,8 @@ BUILD    := build
 
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
-	trace-smoke kernels-smoke serve-smoke lint-hybrid lint-graph ci clean
+	trace-smoke kernels-smoke serve-smoke decode-smoke lint-hybrid \
+	lint-graph ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -134,6 +135,17 @@ serve-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		python tools/serve_smoke.py
 
+decode-smoke:
+	# generative decode gate: a tiny transformer-LM DecodeEntry AOT-warmed
+	# over the prefill/step/slot-write/growth grid must serve N prompts
+	# with ZERO compiles across >=2 capacity buckets and >=2 occupancies,
+	# token-level batched decode >= 2x sequential tokens/s, per-token step
+	# p99 under bound, and the donated KV cache must lint X004-clean AND
+	# observably alias (docs/serving.md "Decode lifecycle").  Serial —
+	# single-core box, never concurrent with tier-1.
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		python tools/decode_smoke.py
+
 lint-hybrid:
 	# hybridize-safety static analysis (docs/analysis.md). The committed
 	# baseline makes legacy suppressions explicit; NEW violations fail.
@@ -155,7 +167,7 @@ lint-graph:
 
 ci: native native-test asan tsan lint-hybrid lint-graph test test-slow \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
-	trace-smoke kernels-smoke serve-smoke
+	trace-smoke kernels-smoke serve-smoke decode-smoke
 
 clean:
 	rm -rf $(BUILD)

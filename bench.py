@@ -852,6 +852,51 @@ def _serve_sweep():
 
 
 # ---------------------------------------------------------------------------
+# decode mode — the generative tier's perf trajectory (docs/serving.md
+# "Decode lifecycle").  `bench.py --decode` reuses the decode-smoke
+# measurement core (tiny transformer LM, token-level continuous batching
+# over cache slots) and reports a bench-shaped row: batched tokens/s,
+# batched-vs-sequential speedup, per-token decode-step p50/p99.  CPU-
+# capable: the decode tier is platform-agnostic, so a dead relay degrades
+# to a live CPU row, not a skip.
+# ---------------------------------------------------------------------------
+
+def _decode_child():
+    """One decode measurement in-process; prints + banks its row."""
+    import jax
+
+    # initialize the backend BEFORE importing decode_smoke: its module
+    # level setdefaults JAX_PLATFORMS=cpu (standalone-smoke safety),
+    # which would silently force a TPU child onto CPU if it ran first
+    platform = jax.devices()[0].platform
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import decode_smoke as _dsm
+    report = {}
+    entry, ok = _dsm.build_entry(report)
+    ok = _dsm.donation_gate(entry, report) and ok
+    ok = _dsm.decode_phases(entry, report) and ok
+    # ONE row schema, owned by decode_smoke (drift here would desync the
+    # banked bench row from the smoke's report["row"])
+    row = _dsm.make_row(report["decode"], platform=platform)
+    row.update(vs_baseline=None, gates_ok=bool(ok))
+    row["telemetry"] = _telemetry_snapshot()
+    _bank(row)
+    print(json.dumps(row))
+
+
+def _decode_sweep():
+    """Parent: run the decode row in a killable subprocess."""
+    platform, err = _probe_backend()
+    env = dict(os.environ) if platform == "tpu" else _cpu_env()
+    row = _run_child(["--decode-child"], env, 1800, "decode_tokens_per_s")
+    if platform is None:
+        row["relay_note"] = f"TPU backend unavailable: {err}; CPU row"
+    print(json.dumps(row))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # multichip scaling mode (BASELINE target: 8->64-chip scaling efficiency).
 # `bench.py --multichip n` measures the ResNet + BERT SPMD step on a 1-device
 # and an n-device dp mesh and reports per-device throughput + scaling
@@ -1012,6 +1057,10 @@ def main():
         return _serve_sweep()
     if len(sys.argv) == 2 and sys.argv[1] == "--serve-child":
         return _serve_child()
+    if len(sys.argv) == 2 and sys.argv[1] == "--decode":
+        return _decode_sweep()
+    if len(sys.argv) == 2 and sys.argv[1] == "--decode-child":
+        return _decode_child()
     if len(sys.argv) == 3 and sys.argv[1] == "--multichip":
         return _multichip(int(sys.argv[2]))
     if len(sys.argv) == 3 and sys.argv[1] == "--multichip-child":

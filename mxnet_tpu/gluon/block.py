@@ -432,13 +432,15 @@ class _CachedOp:
             type(self.block).__name__, sig, self._traced, n_calls=n_calls,
             bucketed=getattr(self.block, "_bucketer", None) is not None)
 
-    def _lint_compiled(self, jit_fn, raw_inputs, lowered=None):
+    def _lint_compiled(self, jit_fn, raw_inputs, lowered=None, donated=()):
         """MXNET_XLA_LINT hook — executables born here (warmup or first
         call) get the X-rule pass (analysis/xla_lint).  ``lowered`` is
         reused when the caller already has one; otherwise the re-lower
         happens under the trace lock (it traces) and the compile runs
         UNLOCKED — a disk hit when the persistent cache is armed, a
         real second compile otherwise (the opt-in flag buys that cost).
+        ``donated`` is the jit's flat donate_argnums (holder record) —
+        X004 checks each against the executable's actual aliasing.
         Lint failures other than the =raise verdict never break the
         compile path."""
         if not _xlint.enabled():
@@ -453,8 +455,18 @@ class _CachedOp:
         label = getattr(self.block, "_xla_lint_label",
                         type(self.block).__name__)
         budget = getattr(self.block, "_xla_lint_budget", None)
+        exe_donated: Tuple[int, ...] = ()
+        if donated:
+            # jit prunes unused leaves: map the flat donate_argnums onto
+            # the executable's parameter numbering.  A donated leaf jit
+            # pruned entirely is dead weight, not a live double buffer;
+            # an unknowable map (None) must never guess indices.
+            kept = _xlint._kept_param_map(compiled)
+            if kept is not None:
+                exe_donated = tuple(kept[i] for i in donated if i in kept)
         _xlint.report(_xlint.lint_compiled(
             compiled, name=f"hybridize:{label}", budget=budget,
+            donated_params=exe_donated,
             lowered_text=lowered.as_text()))
 
     def _prepare(self, args, training: bool):
@@ -485,8 +497,11 @@ class _CachedOp:
             # arm the persistent compilation cache before the first jit
             # of this block exists — the upcoming compile must already
             # be able to hit/fill the on-disk cache (mx.jit.cache)
-            _jit_cache.ensure_cache()
+            cache_armed = _jit_cache.ensure_cache() is not None
             n_state = len(state_arrays)
+            donate_argnums = self._donate_argnums(args, n_state, training,
+                                                  cache_armed)
+            holder["donate_argnums"] = donate_argnums
 
             def raw(*vals):
                 h = self._holders[key]
@@ -522,9 +537,35 @@ class _CachedOp:
 
             with self._trace_lock:
                 if key not in self._jits:
-                    self._jits[key] = jax.jit(raw)
+                    self._jits[key] = (
+                        jax.jit(raw, donate_argnums=donate_argnums)
+                        if donate_argnums else jax.jit(raw))
 
         return key, self._jits[key], state_arrays + arg_leaves, holder
+
+    def _donate_argnums(self, args, n_state: int, training: bool,
+                        cache_armed: bool) -> Tuple[int, ...]:
+        """Flat jit-arg indices to donate: the block's ``donate_args``
+        (top-level forward-arg positions, set by ``hybridize()``) mapped
+        onto the flat leaf numbering of the jitted signature (state
+        arrays first, then the args' leaves in order).  Inference-only —
+        a training graph re-reads its inputs on the backward pass.
+        Dropped on the CPU backend when the persistent compile cache is
+        armed: XLA:CPU executables deserialized from the cache corrupt
+        donated buffers (same guard as parallel/trainer.py)."""
+        donate = getattr(self.block, "_donate_args", None)
+        if not donate or training:
+            return ()
+        if cache_armed and jax.default_backend() == "cpu":
+            return ()
+        idx: List[int] = []
+        off = n_state
+        for pos, a in enumerate(args):
+            leaves, _ = _flatten_nd(a)
+            if pos in donate:
+                idx.extend(range(off, off + len(leaves)))
+            off += len(leaves)
+        return tuple(idx)
 
     @staticmethod
     def _sig_of(key, inputs) -> tuple:
@@ -583,7 +624,8 @@ class _CachedOp:
                                 warmup=True)
             # n_calls omitted: warmup traces are deliberate, not churn
             self._note_trace(sig)
-        self._lint_compiled(jit_fn, raw_inputs, lowered)
+        self._lint_compiled(jit_fn, raw_inputs, lowered,
+                            donated=_holder.get("donate_argnums", ()))
         return True
 
     def __call__(self, args, kwargs):
@@ -633,7 +675,8 @@ class _CachedOp:
             # outside the trace lock: without the persistent cache the
             # lint pays a real second compile, and the lock must never
             # be held through a compile (class lock discipline)
-            self._lint_compiled(jit_fn, lint_inputs)
+            self._lint_compiled(jit_fn, lint_inputs,
+                                donated=holder.get("donate_argnums", ()))
         if isinstance(res, NDArray):
             res = (res,)
         n_out = holder["n_out"]
@@ -684,7 +727,9 @@ class WarmupHandle:
 
 def _warmup_leaf(x) -> NDArray:
     """One warmup input leaf: NDArray/array passthrough, shape tuple or
-    (shape, dtype) pair -> zeros."""
+    (shape, dtype) pair -> zeros.  Any other tuple recurses — a sample
+    arg may be a nested state tree (the decode path's per-layer KV
+    cache), whose structure must survive into the traced signature."""
     if isinstance(x, NDArray):
         return x
     if hasattr(x, "shape") and hasattr(x, "dtype"):  # numpy / jax array
@@ -695,9 +740,11 @@ def _warmup_leaf(x) -> NDArray:
             and all(isinstance(i, int) for i in x[0]) \
             and not isinstance(x[1], tuple):
         return NDArray(jnp.zeros(x[0], jnp.dtype(x[1])))
+    if isinstance(x, tuple) and x:
+        return tuple(_warmup_leaf(e) for e in x)
     raise MXNetError(
-        f"warmup sample leaf must be an array, a shape tuple, or a "
-        f"(shape, dtype) pair; got {x!r}")
+        f"warmup sample leaf must be an array, a shape tuple, a "
+        f"(shape, dtype) pair, or a tuple tree of those; got {x!r}")
 
 
 def _normalize_warmup_samples(samples) -> List[Tuple[NDArray, ...]]:
@@ -746,12 +793,14 @@ class HybridBlock(Block):
         self._warmed_up = False
         self._flags: Dict[str, Any] = {}
         self._bucketer: Optional[ShapeBucketer] = None
+        self._donate_args: Optional[Tuple[int, ...]] = None
 
     def hybridize(self, active: bool = True, static_alloc: bool = False,
                   static_shape: bool = False, inline_limit: int = 2,
                   forward_bulk_size: Optional[int] = None,
                   backward_bulk_size: Optional[int] = None,
-                  bucketer: Optional[ShapeBucketer] = None, **kwargs):
+                  bucketer: Optional[ShapeBucketer] = None,
+                  donate_args: Optional[Tuple[int, ...]] = None, **kwargs):
         """Ref block.py:1419. static_alloc/static_shape are implicit under
         XLA (all jit'd code is statically planned); flags kept for compat.
 
@@ -761,11 +810,22 @@ class HybridBlock(Block):
         outputs sliced back, so drifting shapes compile at most
         ``len(buckets)`` programs instead of one per shape (docs/jit.md).
         The bucketer attaches to THIS block only — children are inlined
-        into its single jitted graph."""
+        into its single jitted graph.
+
+        ``donate_args`` marks top-level forward-argument POSITIONS whose
+        buffers XLA may reuse for the outputs (jax donate_argnums, with
+        the position mapped over every leaf of a nested arg).  Built for
+        functional-state loops — the decode path donates its KV cache so
+        each step updates in place instead of holding old+new cache live
+        (docs/serving.md).  Inference-only; after a call the passed-in
+        donated arrays are DELETED, so the caller must rebind to the
+        returned state, never reuse the old one.  xla_lint X004 verifies
+        the aliasing actually happened."""
         self._active = active
         if isinstance(bucketer, dict):
             bucketer = ShapeBucketer(bucketer)
         self._bucketer = bucketer
+        self._donate_args = tuple(donate_args) if donate_args else None
         self._flags = dict(static_alloc=static_alloc, static_shape=static_shape,
                            **kwargs)
         if self._cached_op is not None:

@@ -1,15 +1,19 @@
 """Model zoo (ref: python/mxnet/gluon/model_zoo/)."""
 from . import vision
 from . import bert
+from . import decoder
 from . import ssd
 from . import model_store
 from .model_store import get_model_file
 from .bert import (BERTModel, BERTForPretrain, get_bert, bert_12_768_12,
                    bert_24_1024_16)
+from .decoder import TransformerLM, LSTMLM, transformer_lm, lstm_lm
 from .ssd import SSD, ssd_512_resnet50_v1, ssd_300_resnet34_v1
 
 _SSD_MODELS = {"ssd_512_resnet50_v1": ssd_512_resnet50_v1,
                "ssd_300_resnet34_v1": ssd_300_resnet34_v1}
+
+_LM_MODELS = {"transformer_lm": transformer_lm, "lstm_lm": lstm_lm}
 
 
 def get_model(name, **kwargs):
@@ -18,4 +22,6 @@ def get_model(name, **kwargs):
         return get_bert(name, **kwargs)
     if name in _SSD_MODELS:
         return _SSD_MODELS[name](**kwargs)
+    if name in _LM_MODELS:
+        return _LM_MODELS[name](**kwargs)
     return vision.get_model(name, **kwargs)

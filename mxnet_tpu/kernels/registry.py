@@ -57,6 +57,7 @@ MODES = ("pallas", "interpret", "off")
 KERNELS: Dict[str, str] = {
     "flash_attention": "blockwise online-softmax attention forward",
     "flash_attention_bwd": "flash-attention backward (dq + dk/dv kernels)",
+    "flash_attention_decode": "single-query/chunk attention vs a KV cache",
     "opt_arena": "flat-arena fused optimizer update (sgd/momentum/adam)",
     "bn_act": "single-pass batch-norm statistics + scale/shift + act",
 }

@@ -19,7 +19,8 @@ __all__ = ["seed", "uniform", "normal", "randn", "rand", "randint", "choice",
            "shuffle", "permutation", "beta", "gamma", "exponential", "laplace",
            "logistic", "gumbel", "pareto", "power", "rayleigh", "weibull",
            "chisquare", "multinomial", "multivariate_normal", "lognormal",
-           "binomial", "bernoulli", "poisson", "geometric", "f", "standard_normal"]
+           "binomial", "bernoulli", "poisson", "geometric", "f",
+           "standard_normal", "categorical"]
 
 
 def _shape(size):
@@ -192,6 +193,38 @@ def multinomial(n, pvals, size=None, ctx=None, device=None):
                                    shape=(shp or ()) + (int(n),))
     counts = (draws[..., None] == jnp.arange(jnp.shape(p)[0])).sum(axis=-2)
     return NDArray(counts, ctx=ctx or device)
+
+
+def categorical(key, logits, temperature: float = 1.0, top_k: int = 0):
+    """Sample token ids from ``(..., V)`` logits — the decode loop's
+    sampler (docs/serving.md).  Unlike the rest of this module it takes
+    an EXPLICIT jax PRNG key instead of advancing the global one: the
+    serve decode loop derives a per-request/per-step key
+    (``jax.random.fold_in``), so generation is deterministic under a
+    fixed seed regardless of what else samples in the process.
+
+    jit-safe: ``temperature`` and ``top_k`` are static Python values, so
+    every branch resolves at trace time.
+
+    * ``temperature <= 0`` — greedy argmax (no randomness, key unused).
+    * ``top_k > 0`` — keep only the k largest logits per row (ties at
+      the k-th value all stay), renormalize, then sample.
+    * otherwise plain temperature-scaled categorical.
+
+    Returns int32 ids of shape ``logits.shape[:-1]`` (NDArray in ->
+    NDArray out, raw array in -> raw array out)."""
+    raw = _val(logits)
+    wrap = isinstance(logits, NDArray)
+    if temperature <= 0.0:
+        ids = jnp.argmax(raw, axis=-1).astype(jnp.int32)
+        return NDArray(ids) if wrap else ids
+    raw = raw.astype(jnp.float32)
+    if top_k > 0 and top_k < raw.shape[-1]:
+        kth = jax.lax.top_k(raw, top_k)[0][..., -1:]
+        raw = jnp.where(raw >= kth, raw, -jnp.inf)
+    ids = jax.random.categorical(_val(key), raw / float(temperature),
+                                 axis=-1).astype(jnp.int32)
+    return NDArray(ids) if wrap else ids
 
 
 def multivariate_normal(mean, cov, size=None, ctx=None, device=None, **kw):

@@ -527,6 +527,30 @@ def flash_attention(query, key, value, mask=None, valid_length=None,
                 name="flash_attention", out=out)
 
 
+def cache_append(cache, new, lengths, out=None):
+    """Append (B, H, T, D) rows into a (B, H, C, D) KV cache at per-row
+    ``lengths`` offsets (ops/attention.cache_append) — the decode path's
+    prefill-write/step-append primitive (docs/serving.md)."""
+    from ..ops import attention as _att
+
+    return call(lambda c, n, l: _att.cache_append(c, n, l),
+                (cache, new, lengths), {}, name="cache_append", out=out)
+
+
+def flash_attention_decode(query, key, value, cache_len, scale=None,
+                           out=None):
+    """Decode-mode attention of (B, H, Tq, D) queries against a
+    (B, H, C, D) KV cache with per-row PRE-append ``cache_len`` (B,) —
+    local query ``i`` attends cache positions ``<= cache_len + i``
+    (ops/attention.flash_attention_decode; pallas on TPU)."""
+    from ..ops import attention as _att
+
+    return call(lambda q, k, v, l: _att.flash_attention_decode(
+        q, k, v, l, scale=scale),
+        (query, key, value, cache_len), {},
+        name="flash_attention_decode", out=out)
+
+
 def multi_head_attention(query, key, value, num_heads, mask=None,
                          valid_length=None, causal=False, scale=None,
                          out=None):

@@ -31,7 +31,8 @@ import jax.numpy as jnp
 
 from ..kernels import registry as _kreg
 
-__all__ = ["flash_attention", "attention_reference"]
+__all__ = ["flash_attention", "attention_reference",
+           "flash_attention_decode", "cache_append"]
 
 _NEG_INF = float("-inf")
 
@@ -240,6 +241,216 @@ def _kernel_failed(e: Exception):
         raise e
     _kreg.fallback("flash_attention",
                    f"kernel error: {type(e).__name__}: {e}")
+
+
+# ------------------------------------------------------------------ decode
+def cache_append(cache, new, lengths):
+    """Write ``new`` (B, H, T, d) into a fixed-capacity KV cache
+    (B, H, C, d) at each row's ``lengths`` offset (B,) — prefill writes
+    and per-step appends of the generative decode path share this one
+    primitive.  Per row: ``cache[b, :, lengths[b]:lengths[b]+T] = new[b]``
+    via ``lax.dynamic_update_slice`` (no concatenate, no realloc — the
+    donation-friendly in-place shape).  The caller guarantees
+    ``lengths + T <= C``; dynamic_update_slice CLAMPS an overflowing
+    start, which would silently overwrite the newest valid entries, so
+    grow the cache to the next capacity bucket before appending."""
+    lengths = jnp.asarray(lengths).astype(jnp.int32)
+
+    def one(c, n, l):
+        return jax.lax.dynamic_update_slice(c, n.astype(c.dtype), (0, l, 0))
+
+    return jax.vmap(one)(cache, new, lengths)
+
+
+def _decode_mask(cache_len, tq, tk):
+    """(B, 1, Tq, Tk) boolean chunk-causal cache mask: local query ``i``
+    (appended at global position ``cache_len + i``) attends cache
+    positions ``<= cache_len + i``.  Fallback path only — O(B*Tq*Tk)."""
+    qidx = jnp.arange(tq, dtype=jnp.int32)
+    kpos = jnp.arange(tk, dtype=jnp.int32)
+    m = kpos[None, None, :] <= (cache_len[:, None, None] +
+                                qidx[None, :, None])
+    return m[:, None]
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
+                   bq: int, bk: int, nk: int, with_lse: bool = False):
+    """Single-q-block flash attention against a KV cache: grid
+    (B*H, nk) — the whole (padded) query chunk rides one block, kv
+    blocks stream past it with the same online softmax + block skip as
+    ``_flash_kernel``.  Per-row cache length lives in SMEM; the causal
+    rule is the chunk-offset one: ``kpos <= cache_len + qidx``."""
+    import jax.experimental.pallas as pl
+
+    if with_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        lse_ref, (acc_ref, m_ref, l_ref) = None, rest
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    cur_len = len_ref[pl.program_id(0), 0]
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)           # (bq, d)
+        k = k_ref[0].astype(jnp.float32)           # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        qidx = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        s = jnp.where(kpos <= cur_len + qidx, s, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        cur = s.max(axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, cur)
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(jnp.where(jnp.isfinite(s), s - safe_m, _NEG_INF))
+        corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
+        l_new = l_ref[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    # the last key any (valid) query may attend sits at cache_len+bq-1;
+    # kv blocks wholly past it are skipped — the kv_len block-skip
+    # machinery of _flash_kernel with the chunk offset folded in
+    run = j * bk < cur_len + bq
+    pl.when(run)(_step)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0, ...] = (acc_ref[...] /
+                         jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        if with_lse:
+            lse = m_ref[:, :1] + jnp.log(jnp.where(l == 0.0, 1.0, l))
+            lse_ref[0, :] = lse[:, 0]
+
+
+def _decode_forward_pallas(q, k, v, cache_len, scale: float,
+                           interpret: bool = False,
+                           return_lse: bool = False):
+    """(B, H, Tq, d) x (B, H, C, d) cache decode attention via
+    pallas_call.  Tq is padded up to the 8-row sublane tile; the padded
+    query rows compute garbage that is sliced off before returning."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    c = k.shape[2]
+    bq = -(-tq // 8) * 8                      # sublane-tile the chunk
+    bk = _pick_block(c)
+    if bq != tq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, bq - tq), (0, 0)))
+    qr = q.reshape(b * h, bq, d)
+    kr = k.reshape(b * h, c, d)
+    vr = v.reshape(b * h, c, d)
+    nk = c // bk
+    lens = jnp.broadcast_to(cache_len.astype(jnp.int32)[:, None],
+                            (b, h)).reshape(b * h, 1)
+    kernel = functools.partial(_decode_kernel, scale=scale, bq=bq, bk=bk,
+                               nk=nk, with_lse=return_lse)
+    o_spec = pl.BlockSpec((1, bq, d), lambda b_, j: (b_, 0, 0))
+    o_shape = jax.ShapeDtypeStruct((b * h, bq, d), q.dtype)
+    if return_lse:
+        out_specs = [o_spec, pl.BlockSpec((1, bq), lambda b_, j: (b_, 0))]
+        out_shape = [o_shape,
+                     jax.ShapeDtypeStruct((b * h, bq), jnp.float32)]
+    else:
+        out_specs, out_shape = o_spec, o_shape
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, nk),
+        in_specs=[
+            pl.BlockSpec((b * h, 1), lambda b_, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda b_, j: (b_, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b_, j: (b_, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b_, j: (b_, j, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[_vmem((bq, d)), _vmem((bq, 128)), _vmem((bq, 128))],
+        compiler_params=_kreg.tpu_compiler_params(("parallel", "arbitrary")),
+        interpret=interpret,
+    )(lens, qr, kr, vr)
+    if return_lse:
+        o, lse = out
+        return (o.reshape(b, h, bq, d)[:, :, :tq],
+                lse.reshape(b, h, bq)[:, :, :tq])
+    return out.reshape(b, h, bq, d)[:, :, :tq]
+
+
+def _select_decode_kernel(q, k):
+    kmode = _kreg.select("flash_attention_decode")
+    if kmode is None:
+        return None
+    tq, c, d = q.shape[2], k.shape[2], q.shape[-1]
+    if not (_pick_block(c) > 0 and tq <= 512 and d <= 256 and d % 8 == 0):
+        _kreg.fallback("flash_attention_decode",
+                       f"shape not tile-able (tq={tq}, cache={c}, d={d})")
+        return None
+    return kmode
+
+
+def flash_attention_decode(q, k, v, cache_len, scale: Optional[float] = None,
+                           return_lse: bool = False):
+    """Decode-mode attention: ``Tq`` freshly appended queries against a
+    fixed-capacity KV cache (the generative hot path, docs/serving.md).
+
+    q: (B, H, Tq, d) — Tq = 1 (single decode step) or a small prefill
+        chunk; k/v: (B, H, C, d) caches that ALREADY contain the chunk's
+        own keys/values (append via :func:`cache_append` first).
+    cache_len: (B,) int — valid cache entries BEFORE this chunk was
+        appended.  Local query ``i`` sits at global position
+        ``cache_len + i`` and attends cache positions ``<= cache_len + i``
+        — for Tq=1 exactly ``kpos <= cache_len``, and garbage cache rows
+        at and past ``cache_len + Tq`` are never attended (they are
+        overwritten by later appends).  A row with ``cache_len + Tq``
+        past the capacity must be grown first (see :func:`cache_append`).
+    return_lse: also return the (B, H, Tq) f32 row log-sum-exp (same
+        plumbing as the training kernel's residual).
+
+    Rows may be inert (a freed serve slot): ``cache_len = 0`` with a
+    dummy token attends only itself — finite output, no NaN.  No custom
+    VJP: decode is inference-only; gradients fall to jax's autodiff of
+    the reference path."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    cache_len = jnp.asarray(cache_len).astype(jnp.int32)
+    kmode = _select_decode_kernel(q, k)
+    if kmode:
+        try:
+            out = _decode_forward_pallas(q, k, v, cache_len, float(scale),
+                                         interpret=kmode == "interpret",
+                                         return_lse=return_lse)
+            _kreg.dispatched("flash_attention_decode", kmode)
+            return out
+        except Exception as e:  # noqa: BLE001 - degrade observably
+            import os
+
+            if os.environ.get("MXNET_FLASH_NO_FALLBACK"):
+                raise
+            _kreg.fallback("flash_attention_decode",
+                           f"kernel error: {type(e).__name__}: {e}")
+    m = _decode_mask(cache_len, q.shape[2], k.shape[2])
+    out = attention_reference(q, k, v, mask=m, scale=scale)
+    if return_lse:
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k
+                            ).astype(jnp.float32) * scale
+        logits = jnp.where(m, logits, _NEG_INF)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        return out, lse
+    return out
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))

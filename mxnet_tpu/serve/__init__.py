@@ -46,6 +46,9 @@ from typing import Optional
 
 from .coalescer import (ClosedError, RejectedError, Request, RequestQueue,
                         ServeFuture)
+from .decode import (DecodeEntry, DecodeFuture, DecodeServer, decode_server,
+                     decode_submit, generate, register_decode,
+                     shutdown_decode)
 from .registry import (ModelEntry, Registry, default_registry,
                        normalize_request)
 from .server import Server
@@ -53,7 +56,9 @@ from .server import Server
 __all__ = ["Server", "Registry", "ModelEntry", "ServeFuture",
            "RejectedError", "ClosedError", "register", "unregister",
            "models", "submit", "predict", "shutdown", "default_registry",
-           "default_server"]
+           "default_server", "DecodeEntry", "DecodeServer", "DecodeFuture",
+           "register_decode", "decode_server", "decode_submit", "generate",
+           "shutdown_decode"]
 
 _SERVER: Optional[Server] = None
 _LOCK = threading.Lock()

@@ -1,0 +1,542 @@
+"""Token-level continuous batching for autoregressive decode
+(docs/serving.md, "Decode lifecycle").
+
+The batch tier (server.py) coalesces *single-shot* forwards; generative
+decoding is a different scheduling problem — a request occupies the
+model for hundreds of sequential steps, so batching at request
+granularity would make every request wait for the longest one.  This
+module schedules at TOKEN granularity over a fixed set of cache
+*slots*:
+
+  * the batch cache is one tree of ``(S, ...)`` buffers (``S`` slots);
+    a request claims a free slot at ANY step boundary — prefill runs as
+    a one-row forward, the slot writer splices the row cache into the
+    batch, and the request rides the next decode step with everyone
+    already in flight;
+  * a request leaves on EOS / max-tokens and its slot frees
+    IMMEDIATELY — the next queued request enters at the next step, not
+    at a batch boundary;
+  * the shared capacity axis ``C`` of the cache is bucketed
+    (``capacity_buckets``): when any active row would outgrow ``C`` the
+    whole batch zero-extends to the next bucket, stepping between
+    pre-warmed executables instead of retracing (the BucketingModule
+    idea applied to decode state, docs/jit.md).
+
+Every executable the loop can hit — prefill per (prompt-bucket,
+capacity), decode step per capacity, slot write per capacity, cache
+growth per bucket pair — AOT-warms at :class:`DecodeEntry`
+construction, so steady-state serving is zero-compile
+(``hybridize.cache_misses`` stays flat; tools/decode_smoke.py gates
+it).  The LM's cache argument is DONATED (``hybridize(donate_args=)``)
+so XLA updates it in place — without aliasing, every step would hold
+old+new cache live and double decode memory (xla_lint X004 is the
+gate).
+
+Sampling happens host-side between steps via
+``mx.np.random.categorical`` — greedy (``temperature=0``) or
+temperature/top-k with a per-request PRNG key, deterministic under a
+fixed ``seed``.
+
+Telemetry (docs/telemetry.md): ``serve.tokens``,
+``serve.decode_step_seconds``, ``serve.prefill_seconds``,
+``serve.decode_slots_active`` gauge, ``serve.decode_requests``,
+``serve.cache_grows``.  Trace: a ``serve.decode_step`` span per step
+(occupancy/capacity attrs), ``serve.prefill`` per admission.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import telemetry as _tel
+from ..base import MXNetError, get_env
+from ..gluon.block import HybridBlock, _flatten_nd
+from ..jit.bucketing import _Policy
+from ..ndarray.ndarray import NDArray
+from ..numpy_extension import call as _npx_call
+from ..trace import recorder as _tr
+from .coalescer import ClosedError, RejectedError
+
+__all__ = ["DecodeEntry", "DecodeServer", "DecodeFuture", "register_decode",
+           "decode_server", "decode_submit", "generate", "shutdown_decode"]
+
+
+def _nd_i32(a) -> NDArray:
+    return NDArray(jnp.asarray(a, jnp.int32))
+
+
+def _write_leaf(batch, row, slot):
+    return _npx_call(
+        lambda b, r, s: jax.lax.dynamic_update_slice(
+            b, r.astype(b.dtype), (s,) + (0,) * (b.ndim - 1)),
+        (batch, row, slot), {}, name="slot_write")
+
+
+class _SlotWriter(HybridBlock):
+    """Splice a one-row cache into the batch cache at a TRACED slot
+    index — one executable serves every slot (a static index would
+    compile S programs).  Param-less HybridBlock so its compiles land in
+    ``hybridize.cache_misses`` (the zero-compile gate) and get linted;
+    the batch cache is donated (position 0) so the splice is in-place."""
+
+    def forward(self, batch_cache, row_cache, slot):
+        return tuple(
+            tuple(_write_leaf(b, r, slot) for b, r in zip(bpair, rpair))
+            for bpair, rpair in zip(batch_cache, row_cache))
+
+
+class _CacheGrower(HybridBlock):
+    """Zero-extend every cache leaf's capacity axis (axis 2) to the
+    next bucket.  The target rides in as the SHAPE of ``ref`` — baking
+    it into a closure would collide signatures (the jit key is
+    structural, the target must be shape-visible).  Built on
+    dynamic_update_slice into a zeros buffer, not concatenate, so the
+    decode models' X003 concat budgets stay untouched."""
+
+    def forward(self, cache, ref):
+        cap = ref.shape[0]
+
+        def grow(leaf):
+            return _npx_call(
+                lambda x: jax.lax.dynamic_update_slice(
+                    jnp.zeros(x.shape[:2] + (cap,) + x.shape[3:], x.dtype),
+                    x, (0,) * x.ndim),
+                (leaf,), {}, name="cache_grow")
+
+        return tuple(tuple(grow(leaf) for leaf in pair) for pair in cache)
+
+
+class _DecodeRequest:
+    __slots__ = ("id", "model", "prompt", "max_new_tokens", "temperature",
+                 "top_k", "key", "tokens", "truncated", "corr",
+                 "_event", "_error")
+
+    def __init__(self, rid, model, prompt, max_new_tokens, temperature,
+                 top_k, seed):
+        self.id = rid
+        self.model = model
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.key = jax.random.PRNGKey(seed if seed is not None else rid)
+        self.tokens: List[int] = []
+        self.truncated = False
+        self.corr = _tr.capture()
+        self._event = threading.Event()
+        self._error: Optional[BaseException] = None
+
+
+class DecodeFuture:
+    """Handle returned by ``submit()``; ``result()`` blocks for the
+    generated token ids."""
+
+    __slots__ = ("_req",)
+
+    def __init__(self, req: _DecodeRequest):
+        self._req = req
+
+    @property
+    def id(self) -> int:
+        return self._req.id
+
+    @property
+    def truncated(self) -> bool:
+        """True when generation stopped because the cache ran out of
+        capacity buckets (not EOS / max-tokens)."""
+        return self._req.truncated
+
+    def done(self) -> bool:
+        return self._req._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._req._event.wait(timeout):
+            raise MXNetError(
+                f"decode request {self._req.id} ({self._req.model}) still "
+                f"pending after {timeout}s")
+        if self._req._error is not None:
+            raise self._req._error
+        return self._req.tokens
+
+
+class DecodeEntry:
+    """One registered decode model: the LM plus its slot writer, cache
+    grower, bucket grids, and the registration-time AOT warmup.
+
+    ``block`` must expose the decode contract
+    (gluon/model_zoo/decoder.py): ``begin_cache(batch, capacity)`` and
+    ``forward(tokens, cache, cache_len, n_tokens) -> (logits,
+    new_cache)``.  The entry re-hybridizes it with the cache donated.
+    """
+
+    def __init__(self, name: str, block, *, slots: int = 4,
+                 prompt_buckets: Sequence[int] = (8, 16, 32),
+                 capacity_buckets: Sequence[int] = (32, 64),
+                 eos_id: Optional[int] = None, max_new_tokens: int = 32,
+                 lint_budget: Optional[dict] = None, warmup: bool = True):
+        if not hasattr(block, "begin_cache"):
+            raise MXNetError(
+                f"decode model {name!r} has no begin_cache(batch, capacity) "
+                "— see gluon/model_zoo/decoder.py for the contract")
+        if slots < 1:
+            raise MXNetError(f"slots must be >= 1, got {slots}")
+        self.name = name
+        self.block = block
+        self.slots = int(slots)
+        self.eos_id = eos_id
+        self.max_new_tokens = int(max_new_tokens)
+        self.prompt_policy = _Policy(list(prompt_buckets))
+        self.capacity_policy = _Policy(list(capacity_buckets))
+        self.prompt_buckets = tuple(self.prompt_policy.enumerate())
+        self.capacity_buckets = tuple(self.capacity_policy.enumerate())
+        if self.prompt_buckets[-1] > self.capacity_buckets[-1]:
+            raise MXNetError(
+                f"largest prompt bucket {self.prompt_buckets[-1]} exceeds "
+                f"largest capacity bucket {self.capacity_buckets[-1]} — the "
+                "prompt's KV rows must fit the cache")
+        # a capacity-independent cache (the LSTM carrier: recurrent state
+        # IS the history) makes growth a no-op — detect it structurally
+        # by probing two DISTINCT capacities (the bucket list may hold
+        # only one, which would compare a bucket against itself)
+        lo = [tuple(l.shape) for l in
+              _flatten_nd(block.begin_cache(1, 1))[0]]
+        hi = [tuple(l.shape) for l in
+              _flatten_nd(block.begin_cache(1, 2))[0]]
+        self.capacity_static = (lo == hi)
+
+        block._xla_lint_label = f"serve.{name}"
+        if lint_budget is not None:
+            block._xla_lint_budget = lint_budget
+        block.hybridize(donate_args=(1,))
+        self.slot_writer = _SlotWriter()
+        self.slot_writer._xla_lint_label = f"serve.{name}.slot_writer"
+        self.slot_writer.hybridize(donate_args=(0,))
+        self.grower = _CacheGrower()
+        self.grower._xla_lint_label = f"serve.{name}.grow"
+        self.grower.hybridize()
+        if warmup:
+            self.warmup()
+
+    # ---------------------------------------------------------- warmup
+    def warmup(self) -> int:
+        """AOT-compile the full executable grid: prefill per
+        (prompt-bucket <= capacity) pair, decode step + slot write per
+        capacity, growth per consecutive bucket pair.  Donation deletes
+        each sample's cache after its compile, so every sample gets a
+        fresh tree.  Returns the number of newly compiled signatures."""
+        s = self.slots
+        caps = self.capacity_buckets if not self.capacity_static \
+            else self.capacity_buckets[:1]
+        lm_samples = []
+        for c in caps:
+            for tp in self.prompt_buckets:
+                if tp <= c:
+                    lm_samples.append(
+                        (_nd_i32(onp.zeros((1, tp))),
+                         self.block.begin_cache(1, c),
+                         _nd_i32(onp.zeros(1)), _nd_i32(onp.ones(1))))
+            lm_samples.append(
+                (_nd_i32(onp.zeros((s, 1))), self.block.begin_cache(s, c),
+                 _nd_i32(onp.zeros(s)), _nd_i32(onp.ones(s))))
+        n = self.block.warmup(lm_samples)
+        n += self.slot_writer.warmup(
+            [(self.block.begin_cache(s, c), self.block.begin_cache(1, c),
+              _nd_i32(0)) for c in caps])
+        if not self.capacity_static and len(self.capacity_buckets) > 1:
+            pairs = zip(self.capacity_buckets, self.capacity_buckets[1:])
+            n += self.grower.warmup(
+                [(self.block.begin_cache(s, c_lo),
+                  _nd_i32(onp.zeros(c_hi))) for c_lo, c_hi in pairs])
+        return n
+
+    # ------------------------------------------------------- execution
+    def prefill(self, tokens: onp.ndarray, true_len: int, capacity: int):
+        """One-row prompt forward: returns ``(last_logits (V,) numpy,
+        row_cache)`` — ``tokens`` already padded to a prompt bucket."""
+        cache = self.block.begin_cache(1, capacity)
+        logits, cache = self.block(
+            _nd_i32(tokens), cache, _nd_i32(onp.zeros(1)),
+            _nd_i32(onp.asarray([true_len])))
+        return onp.asarray(logits._data[0, true_len - 1]), cache
+
+    def step(self, pending: onp.ndarray, cache, lens: onp.ndarray):
+        """One decode step for the whole slot batch: returns
+        ``(logits (S, V) numpy, new_cache)``."""
+        logits, cache = self.block(
+            _nd_i32(pending.reshape(self.slots, 1)), cache, _nd_i32(lens),
+            _nd_i32(onp.ones(self.slots)))
+        return onp.asarray(logits._data[:, 0, :]), cache
+
+    def insert(self, cache, row_cache, slot: int):
+        return self.slot_writer(cache, row_cache, _nd_i32(slot))
+
+    def grow(self, cache, new_capacity: int):
+        return self.grower(cache, _nd_i32(onp.zeros(new_capacity)))
+
+
+class DecodeServer:
+    """The token-level scheduler: a worker thread owning the slot batch.
+
+    All device state (cache tree, per-slot host bookkeeping) is touched
+    by the worker only; ``submit`` just enqueues under the condition
+    variable.  ``close()`` drains accepted requests before joining."""
+
+    def __init__(self, entry: DecodeEntry, queue_max: Optional[int] = None):
+        self.entry = entry
+        self._queue_max = queue_max if queue_max is not None \
+            else get_env("MXNET_SERVE_QUEUE_MAX", 1024, int)
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._seq = 0
+        # worker-owned state
+        self._cap_i = 0
+        self._cache = None
+        self._active: List[Optional[_DecodeRequest]] = [None] * entry.slots
+        self._pending = onp.zeros(entry.slots, onp.int32)
+        self._lens = onp.zeros(entry.slots, onp.int32)
+        self._steps = 0
+        self._thread = threading.Thread(
+            target=self._loop, name=f"mx-decode-{entry.name}", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- API
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               seed: Optional[int] = None) -> DecodeFuture:
+        prompt = [int(t) for t in onp.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise MXNetError("decode prompt must be non-empty")
+        with self._cv:
+            if self._closed:
+                raise ClosedError(
+                    f"decode server {self.entry.name!r} is closed")
+            if len(self._q) >= self._queue_max:
+                if _tel._ENABLED:
+                    _tel.inc("serve.rejected")
+                raise RejectedError(
+                    f"decode queue full ({self._queue_max}); shed load "
+                    "upstream or raise MXNET_SERVE_QUEUE_MAX")
+            self._seq += 1
+            req = _DecodeRequest(
+                self._seq, self.entry.name, prompt,
+                max_new_tokens if max_new_tokens is not None
+                else self.entry.max_new_tokens,
+                temperature, top_k, seed)
+            self._q.append(req)
+            self._cv.notify_all()
+        if _tel._ENABLED:
+            _tel.inc("serve.decode_submitted")
+        return DecodeFuture(req)
+
+    def generate(self, prompt, timeout: Optional[float] = None,
+                 **kw) -> List[int]:
+        """Blocking convenience: submit + result."""
+        return self.submit(prompt, **kw).result(timeout)
+
+    def close(self, timeout: float = 60.0):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise MXNetError(
+                f"decode server {self.entry.name!r} failed to drain within "
+                f"{timeout}s")
+
+    # ---------------------------------------------------------- worker
+    def _occupancy(self) -> int:
+        return sum(1 for r in self._active if r is not None)
+
+    def _loop(self):
+        e = self.entry
+        self._cache = e.block.begin_cache(e.slots, e.capacity_buckets[0])
+        while True:
+            admitted: List[_DecodeRequest] = []
+            with self._cv:
+                while not self._closed and not self._q \
+                        and self._occupancy() == 0:
+                    self._cv.wait(0.1)
+                if self._closed and not self._q and self._occupancy() == 0:
+                    return
+                free = self._active.count(None)
+                while self._q and len(admitted) < free:
+                    admitted.append(self._q.popleft())
+            for req in admitted:
+                try:
+                    self._admit(req)
+                except BaseException as err:  # noqa: BLE001 — to future
+                    req._error = err if isinstance(err, MXNetError) \
+                        else MXNetError(f"{type(err).__name__}: {err}")
+                    req._error.__cause__ = err
+                    req._event.set()
+            if self._occupancy() == 0:
+                continue
+            self._ensure_capacity()
+            if self._occupancy() == 0:
+                continue
+            self._step()
+
+    def _admit(self, req: _DecodeRequest):
+        """Slot claim -> prefill -> splice into the running batch."""
+        e = self.entry
+        caps = e.capacity_buckets
+        slot = self._active.index(None)
+        t = len(req.prompt)
+        tp = e.prompt_policy.bucket(t)      # raises on over-long prompts
+        while not e.capacity_static and caps[self._cap_i] < tp:
+            self._grow()
+        toks = onp.zeros((1, tp), onp.int32)
+        toks[0, :t] = req.prompt
+        with _tr.correlate(serve_decode=req.id), \
+                _tr.span("serve.prefill", timer="serve.prefill_seconds",
+                         request=req.id, tokens=t, slot=slot):
+            last_logits, row_cache = e.prefill(toks, t, caps[self._cap_i])
+            first = self._sample(req, last_logits)
+            req.tokens.append(first)
+            if _tel._ENABLED:
+                _tel.inc("serve.tokens")
+            if (e.eos_id is not None and first == e.eos_id) \
+                    or req.max_new_tokens <= 1:
+                self._resolve(req)
+                return
+            self._cache = e.insert(self._cache, row_cache, slot)
+        self._lens[slot] = t
+        self._pending[slot] = first
+        self._active[slot] = req
+        if _tel._ENABLED:
+            _tel.set_gauge("serve.decode_slots_active", self._occupancy())
+
+    def _ensure_capacity(self):
+        """Grow the batch before a step whose append would overflow; at
+        the last bucket, force-finish the full rows (truncated)."""
+        e = self.entry
+        if e.capacity_static:
+            return
+        caps = e.capacity_buckets
+        need = max(int(self._lens[i]) for i, r in enumerate(self._active)
+                   if r is not None)
+        if need < caps[self._cap_i]:
+            return
+        if self._cap_i + 1 < len(caps):
+            self._grow()
+            return
+        for i, r in enumerate(self._active):
+            if r is not None and int(self._lens[i]) >= caps[self._cap_i]:
+                r.truncated = True
+                self._release(i)
+
+    def _grow(self):
+        e = self.entry
+        new_cap = e.capacity_buckets[self._cap_i + 1]
+        with _tr.span("serve.cache_grow", capacity=new_cap):
+            self._cache = e.grow(self._cache, new_cap)
+        self._cap_i += 1
+        if _tel._ENABLED:
+            _tel.inc("serve.cache_grows")
+
+    def _step(self):
+        e = self.entry
+        self._steps += 1
+        with _tr.span("serve.decode_step", timer="serve.decode_step_seconds",
+                      step=self._steps, occupancy=self._occupancy(),
+                      capacity=e.capacity_buckets[self._cap_i]):
+            logits, self._cache = e.step(self._pending, self._cache,
+                                         self._lens)
+        newly = 0
+        for i, req in enumerate(self._active):
+            if req is None:
+                continue
+            self._lens[i] += 1          # this step appended pending[i]
+            tok = self._sample(req, logits[i])
+            req.tokens.append(tok)
+            newly += 1
+            if (e.eos_id is not None and tok == e.eos_id) \
+                    or len(req.tokens) >= req.max_new_tokens:
+                self._release(i)
+            else:
+                self._pending[i] = tok
+        if _tel._ENABLED:
+            _tel.inc("serve.tokens", newly)
+
+    def _sample(self, req: _DecodeRequest, logits_row: onp.ndarray) -> int:
+        if req.temperature <= 0.0:
+            return int(onp.argmax(logits_row))
+        from ..numpy import random as _rng
+        key = jax.random.fold_in(req.key, len(req.tokens))
+        return int(_rng.categorical(key, jnp.asarray(logits_row),
+                                    temperature=req.temperature,
+                                    top_k=req.top_k))
+
+    def _release(self, slot: int):
+        req = self._active[slot]
+        self._active[slot] = None
+        self._lens[slot] = 0
+        self._pending[slot] = 0
+        self._resolve(req)
+        if _tel._ENABLED:
+            _tel.set_gauge("serve.decode_slots_active", self._occupancy())
+
+    def _resolve(self, req: _DecodeRequest):
+        req._event.set()
+        if _tel._ENABLED:
+            _tel.inc("serve.decode_requests")
+        if _tr._ENABLED:
+            _tr.instant("serve.decode_done", request=req.id,
+                        tokens=len(req.tokens), truncated=req.truncated)
+
+
+# ----------------------------------------------------- module-level API
+_DECODE: Dict[str, DecodeServer] = {}
+_DLOCK = threading.Lock()
+
+
+def register_decode(name: str, block, **cfg) -> DecodeEntry:
+    """Register ``block`` for decode serving under ``name``: builds the
+    :class:`DecodeEntry` (AOT-warming the executable grid) and starts
+    its :class:`DecodeServer`.  Re-registering a name drains and
+    replaces the old server."""
+    entry = DecodeEntry(name, block, **cfg)
+    server = DecodeServer(entry)
+    with _DLOCK:
+        old = _DECODE.pop(name, None)
+        _DECODE[name] = server
+    if old is not None:
+        old.close(30.0)
+    return entry
+
+
+def decode_server(name: str) -> DecodeServer:
+    with _DLOCK:
+        try:
+            return _DECODE[name]
+        except KeyError:
+            raise MXNetError(
+                f"no decode model {name!r}; registered: "
+                f"{sorted(_DECODE)}") from None
+
+
+def decode_submit(name: str, prompt, **kw) -> DecodeFuture:
+    """Enqueue one generation request (non-blocking)."""
+    return decode_server(name).submit(prompt, **kw)
+
+
+def generate(name: str, prompt, timeout: Optional[float] = None,
+             **kw) -> List[int]:
+    """Blocking generation on the named decode server."""
+    return decode_server(name).generate(prompt, timeout=timeout, **kw)
+
+
+def shutdown_decode(timeout: float = 60.0):
+    """Drain and stop every decode server."""
+    with _DLOCK:
+        servers = list(_DECODE.values())
+        _DECODE.clear()
+    for s in servers:
+        s.close(timeout)

@@ -193,28 +193,35 @@ class ModelEntry:
                  if isinstance(l, NDArray) else None)
         return acc
 
-    @staticmethod
-    def slice_out(np_out, sl: Tuple, ref_shape: Tuple[int, ...]):
+    def slice_out(self, np_out, sl: Tuple, ref_shape: Tuple[int, ...]):
         """Cut request ``sl``'s rows out of a batched host output tree.
 
         Axis 0 is indexed by the request's row whenever the leaf carries
-        the batch axis (size == padded rows); a later output axis is
-        sliced back to the request's extent only when its size equals
-        the PADDED size of the matching stacked input axis — the same
-        size-match convention the hybridize unpad path uses, with the
-        same ambiguity when an output dimension coincides with a padded
-        input size (docs/serving.md caveat)."""
+        the batch axis (size == padded rows).  A later output axis
+        ``k - 1`` is sliced back to the request's valid size (``sl[k]``,
+        the explicit per-request per-axis extent ``pad_requests``
+        recorded) iff stacked axis ``k`` HAS a bucket policy — only
+        policy axes are ever padded — AND the output axis still carries
+        the padded extent (size == ``ref_shape[k]``).  Both conditions
+        are batch-level facts, so every request in a batch gets the SAME
+        cut decision per leaf axis; a request whose true size equals the
+        bucket takes the identical (no-op) slice instead of skipping the
+        rule, which previously made boundary requests diverge from their
+        batch-mates.  The residual ambiguity is narrower but real: an
+        output dimension that coincidentally equals the padded extent of
+        a POLICY axis at the same position still collides — pick bucket
+        sizes that avoid it (docs/serving.md caveat)."""
         b_pad = ref_shape[0]
+        spec = self.bucketer.spec
 
         def cut(leaf):
             if getattr(leaf, "ndim", 0) < 1 or leaf.shape[0] != b_pad:
                 return leaf  # no batch axis: shared across the batch
             row = leaf[sl[0]]
             for k in range(1, len(sl)):
-                orig = sl[k]
-                if (row.ndim >= k and orig.stop != ref_shape[k]
+                if (k in spec and row.ndim >= k
                         and row.shape[k - 1] == ref_shape[k]):
-                    row = row[(slice(None),) * (k - 1) + (orig,)]
+                    row = row[(slice(None),) * (k - 1) + (sl[k],)]
             return row
 
         return map_tree(np_out, cut)

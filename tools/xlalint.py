@@ -21,6 +21,9 @@ Canonical models (``--list``):
   * resnet_fused_bn_relu_infer — the fused BN+ReLU zoo variant
   * bert_tiny_train    — tiny-BERT pretrain train step
   * serve_mlp          — a serve Registry entry's warmed bucket grid
+  * serve_decode       — a DecodeEntry's decode grid (prefill / step /
+                         slot write / cache growth) with the KV cache
+                         donated (X004 gates the aliasing)
 
 Usage:
   python tools/xlalint.py                     # lint all, gate vs budgets
@@ -48,6 +51,10 @@ if "xla_force_host_platform_device_count" not in os.environ.get(
                                " --xla_force_host_platform_device_count=8"
                                ).strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# persistent compile cache OFF: the CPU donation guard drops cache
+# aliasing when the cache is armed, which would make serve_decode's
+# X004 donated-cache check vacuously pass
+os.environ["MXNET_COMPILE_CACHE"] = "0"
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
@@ -199,6 +206,23 @@ def build_serve_mlp(budget):
                         lint_budget=budget)
 
 
+def build_serve_decode(budget):
+    """The generative decode grid: every executable the decode loop can
+    hit (prefill per prompt-bucket x capacity, decode step, slot write,
+    cache growth) is linted with the KV cache donated — X004 gates the
+    donated-cache aliasing (docs/serving.md "Decode lifecycle")."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve
+
+    mx.random.seed(0)
+    lm = mx.gluon.model_zoo.get_model(
+        "transformer_lm", vocab_size=64, units=64, hidden_size=128,
+        num_heads=4, num_layers=2, max_length=64)
+    lm.initialize(mx.init.Xavier())
+    serve.DecodeEntry("decode_lm", lm, slots=2, prompt_buckets=(8,),
+                      capacity_buckets=(16, 32), lint_budget=budget)
+
+
 MODELS = {
     "lenet_train_arena": build_lenet_train_arena,
     "lenet_train_zero1": build_lenet_train_zero1,
@@ -206,6 +230,7 @@ MODELS = {
     "resnet_fused_bn_relu_infer": build_resnet_fused_bn_relu_infer,
     "bert_tiny_train": build_bert_tiny_train,
     "serve_mlp": build_serve_mlp,
+    "serve_decode": build_serve_decode,
 }
 
 
