@@ -98,11 +98,15 @@ warmup-smoke:
 		python tools/warmup_smoke.py
 
 spmd-smoke:
-	# 2-D mesh ZeRO-1 gate: LeNet (8x1) zero1 must match replicated to
-	# few ULP over 20 steps with opt-state bytes/device <= replicated/dp
-	# x 1.1, and tiny-BERT must train mp=2 tensor-sharded + zero1 on a
-	# 4x2 mesh matching the replicated run (docs/sharding.md).  Serial —
-	# single-core box, never concurrent with tier-1.
+	# 2-D/3-D mesh gate: LeNet (8x1) zero1 must match replicated to few
+	# ULP over 20 steps with opt-state bytes/device <= replicated/dp
+	# x 1.1; tiny-BERT must train mp=2 tensor-sharded + zero1 on a 4x2
+	# mesh matching the replicated run; overlap=True (bucketed flush)
+	# must match over 12 steps for sgd AND momentum; pp=2 GPipe windows
+	# must match over 20 windows with the exact bubble gauge; and the
+	# dp x mp x pp 2x2x2 composition must match with ZERO post-warmup
+	# jit compiles (docs/sharding.md).  Serial — single-core box, never
+	# concurrent with tier-1.
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		python tools/spmd_smoke.py
 
@@ -159,7 +163,8 @@ lint-graph:
 	# canonical models on CPU and gates their HLO against the per-model
 	# budgets in tools/xlalint_budgets.json (surprise collectives, arena
 	# concatenate bound, zero1 opt-state placement, unaliased donations,
-	# f64 leaks, host callbacks).  Budget drift re-baselines via
+	# f64 leaks, host callbacks, async_required collectives appearing in
+	# blocking form — X007, overlap model).  Budget drift re-baselines via
 	# tools/xlalint.py --update-budgets.  Serial — single-core box,
 	# never concurrent with tier-1.
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \

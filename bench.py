@@ -126,12 +126,18 @@ def _trainer_cols(trainer):
     measured per-device optimizer-state bytes, and the kernels config
     (MXNET_KERNELS mode + whether THIS trainer runs the flat-arena
     optimizer), so kernel-on vs kernel-off runs stay distinguishable in
-    the perf trajectory (docs/sharding.md, docs/kernels.md)."""
+    the perf trajectory (docs/sharding.md, docs/kernels.md).  ``pp``
+    (pipeline-axis degree, MXNET_PP) and ``overlap`` (bucketed
+    collective/compute overlap, MXNET_OVERLAP=1 + zero1) mark the
+    latency-hiding rows the same way."""
     from mxnet_tpu import kernels as _kern
-    from mxnet_tpu.parallel.trainer import _ArenaOptAdapter
+    from mxnet_tpu.parallel.trainer import (_ArenaOptAdapter,
+                                            _OverlapOptAdapter)
 
     return {"mesh_shape": dict(trainer.mesh.shape),
             "partition": trainer.partition,
+            "pp": trainer.mesh.shape.get("pp", 1),
+            "overlap": isinstance(trainer._adapter, _OverlapOptAdapter),
             "opt_state_bytes_per_device":
                 trainer.opt_state_bytes_per_device,
             "kernels": _kern.mode(),
@@ -916,7 +922,23 @@ def _mc_measure(config, ndev, on_tpu):
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
     mx.random.seed(0)
-    mesh = make_mesh({"dp": -1}, devices=jax.devices()[:ndev])
+    # MXNET_PP=k carves a k-deep pipeline ('pp') axis out of the bench
+    # mesh for the resnet config (GPipe path, docs/sharding.md
+    # "Pipeline axis"); bert keeps pure dp — tuple-input nets cannot
+    # pipeline.  MXNET_OVERLAP=1 (+ MXNET_PARTITION=zero1) selects the
+    # bucketed overlap update inside ShardedTrainer itself; both land
+    # in the row via _trainer_cols.
+    pp = 0
+    if config == "resnet":
+        try:
+            pp = int(os.environ.get("MXNET_PP") or 0)
+        except ValueError:
+            pp = 0
+    if pp > 1 and ndev % pp == 0:
+        mesh = make_mesh({"dp": -1, "pp": pp},
+                         devices=jax.devices()[:ndev])
+    else:
+        mesh = make_mesh({"dp": -1}, devices=jax.devices()[:ndev])
     rs = onp.random.RandomState(0)
     if config == "resnet":
         per = 64 if on_tpu else 4

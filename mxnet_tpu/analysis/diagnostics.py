@@ -208,6 +208,17 @@ RULES: Dict[str, tuple] = {
         "cast inputs/constants to float32 (or bf16) before the jit "
         "boundary; set the model budget's allow_f64 if the f64 math is "
         "intentional"),
+    "X007": (
+        "blocking-collective-in-async-budgeted-model",
+        "a collective the model budget declares async_required appears "
+        "in plain blocking (synchronous) form — no -start/-done pair, "
+        "no decomposed permute-ring — so it serializes against the "
+        "surrounding compute instead of hiding behind it, exactly the "
+        "latency the overlap restructure exists to remove",
+        "run the model with overlap enabled (ShardedTrainer "
+        "overlap=True / MXNET_OVERLAP=1) so the flush lowers to "
+        "overlappable pieces, or drop the op from the budget's "
+        "async_required list if blocking is intended (docs/analysis.md)"),
     "X006": (
         "host-callback-in-jit",
         "a host callback (pure_callback/io_callback/debug callback) is "

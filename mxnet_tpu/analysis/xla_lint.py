@@ -22,6 +22,10 @@ Rules (shared ``Diagnostic`` shape, catalog in ``diagnostics.RULES``):
 * **X004** donated argument whose buffer is not actually aliased
 * **X005** f64 ops leaked into a training/serving executable
 * **X006** host callback inside a jitted program
+* **X007** blocking collective in an async-budgeted model (the budget
+  declares ``async_required`` per op; a listed collective appearing in
+  plain synchronous form — no ``-start``/``-done`` pair, no decomposed
+  permute-ring — fails)
 
 Hooked into the three places executables are born — ``_CachedOp``
 compile/warmup, ``ShardedTrainer.compile()``/AOT, and the serve
@@ -73,18 +77,30 @@ _HLO_INSTR_RE = re.compile(
     r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?:\([^)]*\)|\S+)\s+"
     r"([a-z][a-z0-9\-]*)\(")
 # one StableHLO/MHLO op:  %0 = stablehlo.concatenate %arg0, ...
-_MLIR_INSTR_RE = re.compile(r"=\s*(?:stablehlo|mhlo)\.([a-z_0-9]+)")
+# Region-bearing ops (all_reduce, reduce_scatter, ...) print in the
+# QUOTED generic form  %0 = "stablehlo.all_reduce"(%arg0) ({ ... }) —
+# precisely the collectives X007 cares about, so match both spellings.
+_MLIR_INSTR_RE = re.compile(r"=\s*\"?(?:stablehlo|mhlo)\.([a-z_0-9]+)")
 # header entries of input_output_alias={ {out}: (param, {}, may-alias) }
 _ALIAS_RE = re.compile(r"\((\d+),\s*\{[^}]*\},\s*(?:may|must)-alias\)")
 _CUSTOM_CALL_RE = re.compile(r'custom_call_target="([^"]+)"')
 _MLIR_CUSTOM_CALL_RE = re.compile(r"stablehlo\.custom_call\s+@([\w.$-]+)")
+# an HLO computation header:  %wrapped_all-gather (param: ...) -> ... {
+# (no '=' — instruction lines never match)
+_HLO_COMP_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\([^=]*\)\s*->")
+# generic async wrapper referencing its body computation: collectives
+# without a dedicated -start opcode (reduce-scatter, all-to-all) appear
+# as  %x = (...) async-start(...), calls=%wrapped_reduce-scatter
+_ASYNC_CALLS_RE = re.compile(
+    r"async-(start|update|done)\([^)]*\)[^\n]*?calls=%?([\w.\-]+)")
 
 
 class ExecutableFacts:
     """What the linter reads out of one lowered/compiled program."""
 
     __slots__ = ("name", "op_counts", "aliased_params", "f64_count",
-                 "callback_targets", "dialect", "cost", "lowered_concats")
+                 "callback_targets", "dialect", "cost", "lowered_concats",
+                 "sync_collective_counts")
 
     def __init__(self, name: str = "", op_counts: Optional[Counter] = None,
                  aliased_params: Optional[Set[int]] = None,
@@ -92,7 +108,8 @@ class ExecutableFacts:
                  callback_targets: Optional[List[str]] = None,
                  dialect: str = "hlo",
                  cost: Optional[Dict[str, float]] = None,
-                 lowered_concats: Optional[int] = None):
+                 lowered_concats: Optional[int] = None,
+                 sync_collective_counts: Optional[Counter] = None):
         self.name = name
         self.op_counts: Counter = op_counts or Counter()
         self.aliased_params: Set[int] = aliased_params or set()
@@ -105,6 +122,11 @@ class ExecutableFacts:
         # pack + AD dual"), stable across backends — the compiled HLO
         # adds backend-chosen concatenates (padding/layout) on top
         self.lowered_concats = lowered_concats
+        # collectives that appear in plain BLOCKING form (not as a
+        # -start/-done async pair) — op_counts folds both forms together
+        # so a budget could never tell them apart; X007 reads this
+        self.sync_collective_counts: Counter = \
+            sync_collective_counts or Counter()
 
     def count(self, *ops: str) -> int:
         return sum(self.op_counts.get(o, 0) for o in ops)
@@ -126,6 +148,9 @@ class ExecutableFacts:
         return {"name": self.name, "dialect": self.dialect,
                 "op_counts": dict(sorted(self.op_counts.items())),
                 "collectives": self.collective_counts,
+                "sync_collectives": {
+                    o: self.sync_collective_counts[o] for o in COLLECTIVE_OPS
+                    if self.sync_collective_counts.get(o)},
                 "concatenates": self.concat_count,
                 "compiled_concatenates": self.count(*CONCAT_OPS),
                 "aliased_params": sorted(self.aliased_params),
@@ -145,7 +170,10 @@ def parse_program_text(text: str, name: str = "") -> ExecutableFacts:
 
     The async collective split (``all-reduce-start``/``-done``) counts
     once toward its base op; ``fusion``/``parameter``/plumbing ops are
-    counted but carry no rule.
+    counted but carry no rule.  While folding, the occurrences that were
+    in plain BLOCKING form are recorded separately in
+    ``sync_collective_counts`` (X007's input — ``op_counts`` alone can't
+    distinguish an overlappable pair from a serializing sync op).
     """
     mlir = "stablehlo." in text or "mhlo." in text \
         or text.lstrip().startswith("module @")
@@ -158,27 +186,61 @@ def parse_program_text(text: str, name: str = "") -> ExecutableFacts:
             if any(h in t.lower() for h in CALLBACK_TARGET_HINTS)]
         f64 = len(re.findall(r"xf64>|tensor<f64>", text))
     else:
+        # collectives without a dedicated -start opcode are wrapped:
+        # async-start(...), calls=%wrapped_reduce-scatter — the wrapper
+        # line carries the async evidence, the body computation holds
+        # the plain opcode.  Pre-scan the wrapper targets so body ops
+        # are attributed to the async form, not counted as blocking.
+        async_bodies: Set[str] = set()
+        async_started: Counter = Counter()
+        for m in _ASYNC_CALLS_RE.finditer(text):
+            kind, target = m.group(1), m.group(2)
+            async_bodies.add(target)
+            if kind == "start":
+                async_started[target] += 1
+        comp = None
         for line in text.splitlines():
             m = _HLO_INSTR_RE.match(line)
             if m:
-                ops[m.group(1)] += 1
+                if comp not in async_bodies:
+                    ops[m.group(1)] += 1
+                continue
+            h = _HLO_COMP_RE.match(line)
+            if h:
+                comp = h.group(1)
         callback_targets = [
             t for t in _CUSTOM_CALL_RE.findall(text)
             if any(h in t.lower() for h in CALLBACK_TARGET_HINTS)]
         f64 = len(re.findall(r"\bf64\[", text))
+    # blocking occurrences: what exists under the plain opcode BEFORE
+    # async -start forms fold in on top
+    sync: Counter = Counter(
+        {op: ops[op] for op in COLLECTIVE_OPS if ops.get(op)})
     # fold async starts into the base op (the -done is plumbing)
     for op in list(ops):
         if op.endswith("-start"):
             base = op[:-len("-start")]
             ops[base] += ops.pop(op)
             ops.pop(base + "-done", None)
+    if not mlir:
+        # fold generic async wrappers: each async-start whose body is a
+        # known collective counts once toward that collective's base op
+        for target, n in async_started.items():
+            for c in COLLECTIVE_OPS:
+                if c in _normalize_op(target):
+                    ops[c] += n
+                    break
+        ops.pop("async-start", None)
+        ops.pop("async-update", None)
+        ops.pop("async-done", None)
     aliased: Set[int] = set()
     head = text.split("\n", 1)[0]
     if "input_output_alias=" in head:
         aliased = {int(i) for i in _ALIAS_RE.findall(head)}
     return ExecutableFacts(name=name, op_counts=ops, aliased_params=aliased,
                            f64_count=f64, callback_targets=callback_targets,
-                           dialect="stablehlo" if mlir else "hlo")
+                           dialect="stablehlo" if mlir else "hlo",
+                           sync_collective_counts=sync)
 
 
 # ---------------------------------------------------------------- budgets
@@ -188,7 +250,8 @@ def default_budget() -> Dict[str, Any]:
     sets them — a generic executable has no universal collective or
     concatenate bound."""
     return {"concatenates": None, "collectives": None,
-            "allow_f64": False, "allow_callbacks": False}
+            "allow_f64": False, "allow_callbacks": False,
+            "async_required": None}
 
 
 def merge_budget(*layers: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -253,6 +316,20 @@ def run_rules(facts: ExecutableFacts, budget: Optional[Dict[str, Any]] = None,
                 f"{n} concatenate op(s) exceed the budget of "
                 f"{budget['concatenates']} — a per-leaf pack/stack of "
                 f"params scales with parameter count")
+
+    # X007 — blocking collective in an async-budgeted model
+    if budget.get("async_required"):
+        for op in budget["async_required"]:
+            op_n = _normalize_op(op)
+            n = facts.sync_collective_counts.get(op_n, 0)
+            if n > 0:
+                add("X007",
+                    f"collective {op_n} appears {n} time(s) in blocking "
+                    f"(synchronous) form although the model budget "
+                    f"declares it async_required — it serializes against "
+                    f"the surrounding compute instead of overlapping; "
+                    f"emit the -start/-done async pair or the decomposed "
+                    f"permute-ring form (docs/sharding.md, overlap=True)")
 
     # X004 — donated argument not actually aliased
     missing = sorted(set(int(i) for i in donated_params)

@@ -47,7 +47,8 @@ from ..ndarray.ndarray import NDArray, _mutation_scope
 from .parameter import Constant, Parameter
 from .. import autograd as _autograd
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock", "WarmupHandle"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "WarmupHandle",
+           "pipeline_atoms"]
 
 
 def _flatten_nd(obj):
@@ -315,6 +316,33 @@ def trace_guard():
     state (``Parameter.data()``, the RNG key holder) that may run
     concurrently with a background ``warmup()`` trace."""
     return _TRACE_LOCK
+
+
+def pipeline_atoms(block) -> "List[Block]":
+    """Flatten ``block`` into the ordered unit list that pipeline-stage
+    splitting partitions (``parallel.pipeline.split_stages``): direct
+    children in registration order, with ``(Hybrid)Sequential``
+    containers recursed into — their forward IS the children fold, so
+    their atoms may legally land in different stages.  Any other
+    composite child stays ONE atom (its forward may branch arbitrarily
+    across its children).  Whether the top-level registration order
+    itself composes to ``block``'s forward cannot be proven here;
+    ``ShardedTrainer`` validates it numerically before the first
+    pipelined step.  A block with no children is its own single atom."""
+    from .nn.basic_layers import HybridSequential, Sequential
+
+    def rec(b):
+        if isinstance(b, (Sequential, HybridSequential)):
+            out = []
+            for c in b._children.values():
+                out.extend(rec(c))
+            return out
+        return [b]
+
+    atoms = []
+    for c in block._children.values():
+        atoms.extend(rec(c))
+    return atoms if atoms else [block]
 
 
 def _pad_args(bucketer: ShapeBucketer, args):

@@ -43,8 +43,8 @@ import jax.numpy as jnp
 
 from . import registry as _registry
 
-__all__ = ["ArenaLayout", "build_layout", "arena_update", "VARIANT_STATES",
-           "LANES"]
+__all__ = ["ArenaLayout", "build_layout", "bucket_layouts", "arena_update",
+           "VARIANT_STATES", "LANES"]
 
 LANES = 128          # TPU lane width: the arena is viewed as (rows, 128)
 _BLOCK_ROWS = 64     # rows per kernel block -> 8192 elements per program
@@ -84,6 +84,48 @@ def build_layout(shapes: Sequence[Tuple[int, ...]],
     return ArenaLayout(tuple(offsets), tuple(sizes),
                        tuple(tuple(int(d) for d in s) for s in shapes),
                        off, padded)
+
+
+def bucket_layouts(shapes: Sequence[Tuple[int, ...]],
+                   bucket_bytes: int, shard_multiple: int = 1,
+                   itemsize: int = 4
+                   ) -> Tuple[Tuple[Tuple[int, ...], ...],
+                              Tuple[ArenaLayout, ...]]:
+    """Partition leaves into size-bounded buckets, one ``ArenaLayout``
+    per bucket — the grad-flush grouping of the collective/compute
+    overlap path (docs/sharding.md "Latency hiding").
+
+    Leaves are walked in REVERSE declaration order: backward produces the
+    LAST layers' gradients first, so reverse-order buckets close (and
+    their collective chains issue) while earlier layers' backward is
+    still running.  A bucket closes when adding the next leaf would push
+    it past ``bucket_bytes`` (a single over-sized leaf gets its own
+    bucket).  Returns ``(buckets, layouts)`` where ``buckets[b]`` is the
+    tuple of ORIGINAL leaf indices in bucket ``b`` and ``layouts[b]`` is
+    its arena layout (padded to the ``shard_multiple`` / block grid like
+    any arena, so bucket arenas stay kernel- and ZeRO-shard-ready)."""
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got "
+                         f"{bucket_bytes}")
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i in reversed(range(len(shapes))):
+        n = 1
+        for d in shapes[i]:
+            n *= int(d)
+        b = n * itemsize
+        if cur and cur_bytes + b > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += b
+    if cur:
+        buckets.append(cur)
+    layouts = tuple(build_layout([shapes[i] for i in bk],
+                                 shard_multiple=shard_multiple)
+                    for bk in buckets)
+    return tuple(tuple(bk) for bk in buckets), layouts
 
 
 def _arena_kernel(sc_ref, g_ref, *rest, variant: str, momentum: float,

@@ -21,8 +21,10 @@ ICI/DCN collectives. Modules:
 from .mesh import (make_mesh, default_mesh, data_parallel_spec,
                    MeshConfig, with_sharding)
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
-                          broadcast_from, barrier)
+                          ring_all_gather, broadcast_from, barrier)
 from .trainer import (ShardedTrainer, make_train_step, shard_params,
                       replicated_spec_fn, fsdp_spec_fn, mp_spec_fn)
+from .pipeline import (PipelineStage, split_stages, pipeline_apply,
+                       bubble_fraction)
 from .preemption import PreemptionGuard
 from . import ring

@@ -16,7 +16,8 @@ from jax import lax
 from .. import telemetry as _tel
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "ppermute",
-           "broadcast_from", "barrier", "axis_index", "axis_size"]
+           "ring_all_gather", "broadcast_from", "barrier", "axis_index",
+           "axis_size"]
 
 AxisName = Union[str, Sequence[str]]
 
@@ -68,6 +69,36 @@ def ppermute(x, perm, axis_name: AxisName = "sp"):
     """Neighbor exchange — the ring-attention building block."""
     _note("ppermute", x)
     return lax.ppermute(x, axis_name, perm)
+
+
+def ring_all_gather(x, axis_name: str = "dp", axis: int = 0):
+    """AllGather decomposed into ``size-1`` neighbor hops (ppermute ring),
+    per "Memory-efficient array redistribution through portable collective
+    communication" (PAPERS.md): each hop moves ONE shard-sized buffer, so
+    peak per-hop bytes stay ``total/size`` instead of the full gather, and
+    no blocking ``all-gather`` op ever appears in the executable — the
+    form the X007 lint contract (``async_required``) accepts on backends
+    without async collective pairs.  Valid inside shard_map; returns the
+    concatenation of every member's ``x`` along ``axis``, identical on
+    all members."""
+    _note("ring_all_gather", x)
+    size = axis_size(axis_name)
+    if size == 1:
+        return x
+    idx = lax.axis_index(axis_name)
+    shape = list(x.shape)
+    out = jax.numpy.zeros([size] + shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, idx, 0)
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    recv = x
+    for h in range(1, size):
+        recv = lax.ppermute(recv, axis_name, perm)
+        out = lax.dynamic_update_index_in_dim(
+            out, recv, (idx - h) % size, 0)
+    # (size, ..., d_axis, ...) -> concat along `axis`
+    out = jax.numpy.moveaxis(out, 0, axis)
+    shape[axis] *= size
+    return out.reshape(shape)
 
 
 def broadcast_from(x, axis_name: AxisName = "dp", src: int = 0):

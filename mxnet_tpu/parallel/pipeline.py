@@ -22,15 +22,141 @@ can be layered with jax.checkpoint around stage_fn).
 """
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, List, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import MXNetError
 from .collectives import axis_size as _axis_size
 
-__all__ = ["pipeline_apply", "pipeline_reference"]
+__all__ = ["pipeline_apply", "pipeline_reference", "PipelineStage",
+           "split_stages", "pipeline_apply_stages", "bubble_fraction"]
+
+
+def bubble_fraction(pp: int, n_microbatch: int) -> float:
+    """GPipe idle fraction: (pp−1)/(m+pp−1) of the schedule's ticks are
+    ramp-up/drain bubbles (published as ``trainer.pp_bubble_fraction``)."""
+    m = max(int(n_microbatch), 1)
+    return (pp - 1) / (m + pp - 1)
+
+
+class PipelineStage:
+    """One pipeline stage: an ordered slice of a net's atom blocks
+    (``gluon.block.pipeline_atoms``) whose sequential application is the
+    stage forward.  ``split_stages`` builds these; the trainer lifts each
+    functionally and runs the GPipe schedule over the 'pp' mesh axis."""
+
+    def __init__(self, blocks: Sequence):
+        if not blocks:
+            raise MXNetError("empty pipeline stage")
+        self.blocks = list(blocks)
+
+    @property
+    def n_params(self) -> int:
+        total = 0
+        for b in self.blocks:
+            for p in b.collect_params().values():
+                if p._data is not None:
+                    n = 1
+                    for d in p.data().shape:
+                        n *= int(d)
+                    total += n
+        return total
+
+    def __repr__(self):
+        names = ", ".join(type(b).__name__ for b in self.blocks)
+        return f"PipelineStage([{names}])"
+
+
+def split_stages(net, n_stages: int) -> List[PipelineStage]:
+    """Partition ``net``'s atom blocks into ``n_stages`` contiguous
+    stages, balanced by parameter count (the proxy for per-stage work
+    a static splitter can see).  Greedy cut at the cumulative targets
+    ``total*k/n``, constrained so every remaining stage keeps ≥1 atom.
+    The trainer numerically validates that the stage fold reproduces the
+    net's forward before the first pipelined step — registration order
+    alone cannot prove it for branchy nets."""
+    from ..gluon.block import pipeline_atoms
+
+    atoms = pipeline_atoms(net)
+    if n_stages < 1:
+        raise MXNetError(f"n_stages must be >= 1, got {n_stages}")
+    if len(atoms) < n_stages:
+        raise MXNetError(
+            f"net splits into {len(atoms)} pipeline atoms but the mesh "
+            f"has pp={n_stages}: fewer stages than devices (flatten the "
+            "net into more (Hybrid)Sequential children or shrink 'pp')")
+    weights = [PipelineStage([a]).n_params for a in atoms]
+    total = sum(weights) or 1
+    stages: List[PipelineStage] = []
+    j = 0
+    for k in range(n_stages):
+        hi = len(atoms) - (n_stages - 1 - k)   # leave 1 atom per later stage
+        cut = j + 1
+        target = total * (k + 1) / n_stages
+        acc = sum(weights[:cut])
+        while cut < hi and acc < target:
+            acc += weights[cut]
+            cut += 1
+        if k == n_stages - 1:
+            cut = len(atoms)
+        stages.append(PipelineStage(atoms[j:cut]))
+        j = cut
+    return stages
+
+
+def pipeline_apply_stages(stage_calls: Sequence[Callable], x,
+                          carrier_width: int, out_width: int,
+                          axis_name: str = "pp"):
+    """Heterogeneous GPipe — call inside a full-manual shard_map over
+    ``axis_name``.  Unlike :func:`pipeline_apply` (identical stage
+    signatures), stage boundary shapes may all differ: activations ride
+    a FLAT zero-padded ``(mb, carrier_width)`` carrier between ranks,
+    and each rank's ``stage_calls[k]`` unpacks its own input slice.
+
+      stage_calls[0](feed) -> (mb, carrier_width)   raw micro input
+      stage_calls[k](flat) -> (mb, carrier_width)   k >= 1
+
+    ``x``: ``(m, mb, ...)`` LOCAL micro-batched input (device 0's ranks
+    consume it).  Every rank traces ALL branches but executes only its
+    own (lax.switch on axis_index — branch bodies contain no
+    collectives, so divergence is safe); the per-tick ppermute ring and
+    the final psum are the only cross-rank ops.  Returns
+    ``(m, mb, out_width)`` last-stage outputs, identical on every rank.
+    """
+    s = _axis_size(axis_name)
+    if len(stage_calls) != s:
+        raise MXNetError(f"{len(stage_calls)} stage calls for a "
+                         f"{axis_name!r} axis of size {s}")
+    rank = lax.axis_index(axis_name)
+    m, mb = x.shape[0], x.shape[1]
+    steps = m + s - 1
+    fwd = [(i, (i + 1) % s) for i in range(s)]
+    probe = jax.eval_shape(stage_calls[0],
+                           jax.ShapeDtypeStruct(x.shape[1:], x.dtype))
+    cdtype = probe.dtype
+
+    def step(carry, t):
+        h, bank = carry
+        h_in = lax.ppermute(h, axis_name, fwd)
+        feed = lax.dynamic_index_in_dim(x, jnp.minimum(t, m - 1), axis=0,
+                                        keepdims=False)
+        branches = [(lambda _h, _c=stage_calls[0]: _c(feed))] + \
+                   [(lambda _h, _c=c: _c(_h)) for c in stage_calls[1:]]
+        h_out = lax.switch(rank, branches, h_in)
+        done = t - (s - 1)
+        updated = lax.dynamic_update_index_in_dim(
+            bank, h_out[:, :out_width], jnp.maximum(done, 0), axis=0)
+        bank = jnp.where((rank == s - 1) & (done >= 0), updated, bank)
+        return (h_out, bank), None
+
+    h0 = jnp.zeros((mb, carrier_width), cdtype)
+    bank0 = jnp.zeros((m, mb, out_width), cdtype)
+    (_, bank), _ = lax.scan(step, (h0, bank0), jnp.arange(steps))
+    bank = jnp.where(rank == s - 1, bank, jnp.zeros_like(bank))
+    return lax.psum(bank, axis_name)
 
 
 def pipeline_reference(stage_fn: Callable, stacked_params, x):

@@ -251,6 +251,55 @@ def _functional_apply(net, names: List[str], training: bool):
     return fn, arrs, holder
 
 
+def _functional_apply_stages(net, names: List[str], stages, training: bool):
+    """Per-stage functional forwards for the pipeline ('pp') axis: one fn
+    per ``PipelineStage``, all sharing ``_functional_apply``'s state-swap
+    protocol — ``stage_fns[k](all_param_vals, x)`` applies stage k's
+    blocks in declaration order and returns the raw output array.
+
+    Pipeline stages must be MUTATION-FREE: a BatchNorm running-stat or
+    RNG-key advance would fire once per (micro-batch × schedule tick),
+    outside the step's state accounting — enforced at trace time so the
+    first compile fails loudly instead of training silently-wrong
+    statistics."""
+    from ..random import key_holder
+
+    params = net.collect_params()
+    with _blk.trace_guard():
+        arrs = [params[n].data() for n in names] + [key_holder()]
+    holder: Dict[str, Any] = {"mutated_refs": [], "n_out": 1}
+
+    def make(k, blocks):
+        def fn(pvals, x):
+            saved = [(a, a._data) for a in arrs]
+            ms = _mutation_scope()
+            try:
+                with _autograd.pause(train_mode=training), ms:
+                    for a, v in zip(arrs, pvals):
+                        a._data = v
+                    h = NDArray(x)
+                    for b in blocks:
+                        h = b.forward(h)
+                if ms.mutated:
+                    raise MXNetError(
+                        f"pipeline stage {k} mutated {len(ms.mutated)} "
+                        "state array(s): the 'pp' axis needs "
+                        "mutation-free forwards (BatchNorm running "
+                        "stats / RNG draws update outside the GPipe "
+                        "schedule — docs/sharding.md 'Pipeline axis')")
+                return h._data
+            finally:
+                for a, v in saved:
+                    a._data = v
+                for a, prev in ms.mutated.values():
+                    if not isinstance(prev, jax.core.Tracer):
+                        a._data = prev
+
+        return fn
+
+    return [make(k, st.blocks) for k, st in enumerate(stages)], arrs, holder
+
+
 # -- traced optimizer adapter (reuses the full 20-optimizer registry) --------
 #
 # Every imperative optimizer follows one shape: host bookkeeping
@@ -585,6 +634,146 @@ class _ArenaOptAdapter(_OptAdapter):
         return new_p, new_leaves
 
 
+class _OverlapOptAdapter(_OptAdapter):
+    """Bucketed collective/compute-overlap update under
+    ``partition='zero1'`` (``overlap=True``; docs/sharding.md "Latency
+    hiding").
+
+    Gradients flush in REVERSE parameter order into size-bounded bucket
+    arenas (``MXNET_OVERLAP_BUCKET_BYTES``, default 4 MiB; one
+    ``ArenaLayout`` per bucket from ``mx.kernels.opt_arena
+    .bucket_layouts`` — the PR-8 layout machinery), so the collective
+    chain for the last layers' bucket issues while backward for the
+    earlier layers is still running ("Automatic Cross-Replica Sharding
+    of Weight Update in Data-Parallel Training", PAPERS.md).  Per
+    bucket: the reduced grad arena is sliced to the device's ``dp``
+    shard inside a manual shard_map, the registry optimizer's imperative
+    kernel replays on the flat shard segment (elementwise ⇒ leaf and
+    shard boundaries may fall anywhere — the flat-arena invariant), and
+    the updated segment returns through a ppermute RING gather
+    (``collectives.ring_all_gather``): per-hop buffers stay shard-sized
+    ("Memory-efficient array redistribution", PAPERS.md) and the
+    executable contains NO blocking reduce-scatter/all-gather — the
+    X007 ``async_required`` lint contract, checkable even on backends
+    that never emit ``-start/-done`` async pairs (XLA:CPU).
+
+    Optimizer state lives as per-bucket dp-sharded flat arenas (the
+    ZeRO-1 memory win, unchanged).  The same registry kernel replays
+    elementwise on the reduced gradients, so given IDENTICAL gradients
+    the sgd / momentum update is bit-exact against the per-leaf path
+    (asserted in tests/test_trainer_overlap.py); full trajectories
+    differ from classic zero1 only by gradient-reduction order
+    (all-reduce here vs reduce-scatter there — ULP-level), gated at the
+    SPMD tolerance by ``tools/spmd_smoke.py``."""
+
+    def __init__(self, optimizer, bucket_bytes: Optional[int] = None):
+        super().__init__(optimizer)
+        if bucket_bytes is None:
+            bucket_bytes = int(_os.environ.get(
+                "MXNET_OVERLAP_BUCKET_BYTES", str(4 << 20)))
+        self.bucket_bytes = int(bucket_bytes)
+        self._shard_multiple = 1     # dp degree; set by ShardedTrainer
+        self.mesh: Optional[Mesh] = None
+        self.dp_axis = "dp"
+        self.buckets: Tuple[Tuple[int, ...], ...] = ()
+        self.layouts: Tuple[Any, ...] = ()
+        self.leaf_layouts: List[Any] = []
+
+    @classmethod
+    def supports(cls, opt) -> Tuple[bool, str]:
+        """Same fusibility set as the flat arena (elementwise
+        sgd/momentum/adam with uniform multipliers): norm-based
+        optimizers read per-tensor reductions that flat shard segments
+        destroy."""
+        return _ArenaOptAdapter.supports(opt)
+
+    def init_state(self, pvals) -> List[Any]:
+        from ..kernels import opt_arena as _oa
+
+        for p in pvals:
+            if jnp.dtype(p.dtype) != jnp.float32:
+                raise MXNetError(
+                    "overlap bucketed update expects f32 parameters; "
+                    f"got {p.dtype} (drop overlap=True)")
+        self.buckets, self.layouts = _oa.bucket_layouts(
+            [tuple(p.shape) for p in pvals], self.bucket_bytes,
+            shard_multiple=self._shard_multiple)
+        self._btree: List[Any] = []
+        self._bucket_nleaves: List[int] = []
+        self.leaf_layouts = []
+        leaves: List[Any] = []
+        for b, lay in enumerate(self.layouts):
+            tmpl = self.opt.create_state(
+                b, NDArray(jnp.zeros((lay.padded,), jnp.float32)))
+            self._btree.append(tmpl)
+            ls = self._flatten(tmpl)
+            self._bucket_nleaves.append(len(ls))
+            for _ in ls:
+                leaves.append(jnp.zeros((lay.padded,), jnp.float32))
+                self.leaf_layouts.append(lay)
+        self.leaf_param_ix = [-1] * len(leaves)
+        self._tree = None
+        return leaves
+
+    def update(self, pvals, grads, leaves, lr, t):
+        from jax.experimental.shard_map import shard_map
+
+        from . import collectives as _coll
+
+        if self.mesh is None or self.dp_axis not in self.mesh.shape:
+            raise MXNetError(
+                "overlap adapter is unconfigured — ShardedTrainer sets "
+                "mesh/dp_axis before the first trace (overlap=True needs "
+                "ShardedTrainer, not a bare make_train_step)")
+        ax = self.dp_axis
+        new_p: List[Any] = [None] * len(pvals)
+        new_leaves: List[Any] = []
+        it = iter(leaves)
+        for b, (idxs, lay) in enumerate(zip(self.buckets, self.layouts)):
+            bl = [next(it) for _ in range(self._bucket_nleaves[b])]
+            ps = [pvals[i].ravel() for i in idxs]
+            gs = [grads[i].astype(jnp.float32).ravel() for i in idxs]
+            parena = ps[0] if len(ps) == 1 else jnp.concatenate(ps)
+            garena = gs[0] if len(gs) == 1 else jnp.concatenate(gs)
+            if lay.padded != lay.total:
+                parena = jnp.pad(parena, (0, lay.padded - lay.total))
+                garena = jnp.pad(garena, (0, lay.padded - lay.total))
+            # pin the arenas REPLICATED at the manual-region boundary:
+            # otherwise GSPMD back-propagates the P(dp) in_spec through
+            # the concat into the param leaves and re-GATHERS them at
+            # every forward use — blocking all-gathers that X007's
+            # async_required contract forbids.  Grads are replicated
+            # after the dp all-reduce, so the constraint costs nothing.
+            rep = NamedSharding(self.mesh, P())
+            parena = jax.lax.with_sharding_constraint(parena, rep)
+            garena = jax.lax.with_sharding_constraint(garena, rep)
+
+            def seg_update(p_seg, g_seg, lr_, t_, *state_segs, _b=b):
+                # shard-local replay of the registry kernel on this
+                # device's flat segment; the padded tail is inert zeros
+                # (zero grad keeps zero state, zero delta) — the PR-6
+                # zero1 invariant
+                opt = self._traced_opt(lr_, t_)
+                st = self._rebuild(self._btree[_b], iter(state_segs))
+                w = NDArray(p_seg)
+                opt.update(_b, w, NDArray(g_seg), st)
+                gathered = _coll.ring_all_gather(w._data, ax)
+                return (gathered,) + tuple(self._flatten(st))
+
+            n_st = self._bucket_nleaves[b]
+            out = shard_map(
+                seg_update, mesh=self.mesh,
+                in_specs=(P(ax), P(ax), P(), P()) + (P(ax),) * n_st,
+                out_specs=(P(),) + (P(ax),) * n_st,
+                check_rep=False)(parena, garena, lr, t, *bl)
+            new_leaves.extend(out[1:])
+            for i, off, size, shape in zip(idxs, lay.offsets, lay.sizes,
+                                           lay.shapes):
+                new_p[i] = jax.lax.slice_in_dim(
+                    out[0], off, off + size).reshape(shape)
+        return new_p, new_leaves
+
+
 def _pick_adapter(opt, multi_tensor: bool, fused_opt: Optional[str],
                   all_f32: bool = True):
     """Adapter selection (docs/kernels.md): ``fused_opt`` is the per-call
@@ -636,7 +825,9 @@ def make_train_step(net, loss_fn, names: List[str],
                     loss_scale_growth_interval: int = 2000,
                     multi_tensor: bool = False, shardings_box=None,
                     partition: str = "replicated",
-                    fused_opt: Optional[str] = None):
+                    fused_opt: Optional[str] = None,
+                    overlap: bool = False,
+                    pipeline: Optional[Dict[str, Any]] = None):
     """Build the jitted SPMD train machinery. Returns
     (step, grad_fn, apply_fn, adapter, holder):
 
@@ -679,7 +870,24 @@ def make_train_step(net, loss_fn, names: List[str],
     auto-picks the flat-arena Pallas kernel when the kernels layer is
     active (``MXNET_KERNELS``, docs/kernels.md), ``"arena"`` requires it,
     ``"off"`` keeps the per-param replay (or the vmap adapter under
-    ``multi_tensor=True``)."""
+    ``multi_tensor=True``).
+
+    ``overlap=True`` (zero1 only) replaces the reduce-scatter/all-gather
+    weight update with the bucketed overlappable form
+    (``_OverlapOptAdapter``): grads flush in reverse order into
+    size-bounded bucket arenas, each bucket updates shard-locally inside
+    a manual shard_map and returns through a ppermute ring gather — no
+    blocking collective in the executable (lint rule X007,
+    docs/sharding.md "Latency hiding").  Unlike ``fused_opt``'s
+    observable fallback, an unsupported configuration RAISES: overlap is
+    an explicit opt-in whose silent absence would void the lint budget.
+
+    ``pipeline`` (dict with ``stages``/``mesh``/``batch_axis``; built by
+    ShardedTrainer from a 'pp' mesh axis) switches the forward to the
+    GPipe schedule: ``x``/``y`` arrive micro-STACKED ``(m, B, ...)`` and
+    the whole window is one executable — loss and backward stay outside
+    the shard_map in GSPMD-land, which transposes the schedule for the
+    VJP."""
     if partition not in PARTITIONS:
         raise MXNetError(f"partition={partition!r} unknown; "
                          f"choose from {PARTITIONS}")
@@ -689,7 +897,12 @@ def make_train_step(net, loss_fn, names: List[str],
             "per-param placements (ShardedTrainer fills ['zero1'] / "
             "['opt_state'] before the first trace); without one the update "
             "would silently run fully replicated")
-    fn, arrs, holder = _functional_apply(net, names, training=True)
+    if pipeline is not None:
+        fn = None
+        stage_fns, arrs, holder = _functional_apply_stages(
+            net, names, pipeline["stages"], training=True)
+    else:
+        fn, arrs, holder = _functional_apply(net, names, training=True)
     params = net.collect_params()
     train_ix = [i for i, n in enumerate(names) if params[n].grad_req != "null"]
     aux_ix = [i for i, n in enumerate(names) if params[n].grad_req == "null"]
@@ -697,9 +910,30 @@ def make_train_step(net, loss_fn, names: List[str],
     with _blk.trace_guard():
         all_f32 = all(jnp.dtype(arrs[i]._data.dtype) == jnp.float32
                       for i in train_ix)
-    adapter = _pick_adapter(
-        _make_opt(optimizer, learning_rate, weight_decay, momentum),
-        multi_tensor, fused_opt, all_f32=all_f32)
+    opt = _make_opt(optimizer, learning_rate, weight_decay, momentum)
+    if overlap:
+        if partition != "zero1":
+            raise MXNetError(
+                "overlap=True is the zero1 latency-hiding path; it needs "
+                "partition='zero1' (docs/sharding.md 'Latency hiding')")
+        if fused_opt == "arena":
+            raise MXNetError(
+                "overlap=True supersedes fused_opt='arena': the bucketed "
+                "flush IS the arena machinery, one layout per bucket — "
+                "drop fused_opt")
+        ok, reason = _OverlapOptAdapter.supports(opt)
+        if ok and not all_f32:
+            ok, reason = False, "non-f32 parameters"
+        if not ok:
+            # overlap is an explicit opt-in backed by a lint budget
+            # (X007 async_required): a silent fallback would pass the
+            # training run and fail the budget later, so raise here
+            raise MXNetError(f"overlap=True unavailable: {reason} "
+                             "(docs/sharding.md 'Latency hiding')")
+        adapter = _OverlapOptAdapter(opt)
+    else:
+        adapter = _pick_adapter(opt, multi_tensor, fused_opt,
+                                all_f32=all_f32)
     dynamic_scaling = compute_dtype is not None and \
         jnp.dtype(compute_dtype) == jnp.float16
 
@@ -711,6 +945,62 @@ def make_train_step(net, loss_fn, names: List[str],
             allv[i] = v
         allv[-1] = key_val
         return allv
+
+    def pp_forward(allv, xs):
+        """GPipe forward over the 'pp' mesh axis (docs/sharding.md
+        "Pipeline axis") inside ONE full-manual shard_map: params enter
+        replicated (in_spec P() — GSPMD gathers any mp-sharded storage
+        at the boundary), the batch splits over the data axis, and the
+        schedule runs m+pp−1 ticks of collective-permute + per-rank
+        stage compute with activations on a flat padded carrier
+        (heterogeneous stage shapes).  check_rep=False because manual
+        replication claims (psum'd bank, identical mp compute) aren't
+        provable by the rep checker."""
+        from jax.experimental.shard_map import shard_map
+
+        from . import pipeline as _pl
+
+        pmesh = pipeline["mesh"]
+        dp_axis = pipeline["batch_axis"]
+        s = pmesh.shape["pp"]
+        dpn = pmesh.shape.get(dp_axis, 1)
+        m, bg = int(xs.shape[0]), int(xs.shape[1])
+        if bg % dpn:
+            raise MXNetError(f"pipeline micro-batch of {bg} does not "
+                             f"divide the {dp_axis!r} axis ({dpn})")
+        bl = bg // dpn
+        micro = jax.ShapeDtypeStruct((bl,) + tuple(xs.shape[2:]), xs.dtype)
+        bshapes = [micro]
+        for k in range(s):
+            bshapes.append(jax.eval_shape(
+                lambda a, _k=k: stage_fns[_k](allv, a), bshapes[-1]))
+        widths = [int(_prod(sd.shape[1:])) for sd in bshapes]
+        cw = max(widths[1:])             # flat carrier width
+        w_out = widths[-1]
+        out_tail = tuple(bshapes[-1].shape[1:])
+
+        def inner(*vals):
+            av_l, x_l = list(vals[:-1]), vals[-1]
+
+            def call(k, a):
+                y = stage_fns[k](av_l, a)
+                yf = y.reshape((y.shape[0], -1))
+                if yf.shape[1] < cw:
+                    yf = jnp.pad(yf, ((0, 0), (0, cw - yf.shape[1])))
+                return yf
+
+            calls = [(lambda a: call(0, a))] + \
+                    [(lambda a, _k=k: call(
+                        _k, a[:, :widths[_k]].reshape(
+                            (a.shape[0],) + tuple(bshapes[_k].shape[1:]))))
+                     for k in range(1, s)]
+            flat = _pl.pipeline_apply_stages(calls, x_l, cw, w_out)
+            return flat.reshape((m, bl) + out_tail)
+
+        specs_in = tuple(P() for _ in allv) + (P(None, dp_axis),)
+        return shard_map(inner, mesh=pmesh, in_specs=specs_in,
+                         out_specs=P(None, dp_axis),
+                         check_rep=False)(*allv, xs)
 
     def loss_of(tvals, avals, key_val, scale, x, y):
         xs = x if isinstance(x, (tuple, list)) else (x,)
@@ -725,6 +1015,18 @@ def make_train_step(net, loss_fn, names: List[str],
             xs = tuple(cast(v) for v in xs)
         else:
             tv, av = tvals, avals
+        if pipeline is not None:
+            if len(xs) != 1:
+                raise MXNetError("pipeline ('pp') steps take a single "
+                                 "array input, not a tuple batch")
+            # x/y are micro-STACKED (m, B, ...); the window loss is the
+            # mean over every sample, identical to averaging per-micro
+            # grads (the grad-accum contract)
+            preds = pp_forward(assemble(tv, av, key_val), xs[0])
+            pflat = preds.reshape((-1,) + tuple(preds.shape[2:]))
+            yflat = y.reshape((-1,) + tuple(y.shape[2:]))
+            loss = jnp.mean(loss_fn(pflat, yflat)).astype(jnp.float32)
+            return loss * scale, (loss, ())
         outs, mutated = fn(assemble(tv, av, key_val), *xs)
         pred = outs[0] if len(outs) == 1 else tuple(outs)
         loss = jnp.mean(loss_fn(pred, y)).astype(jnp.float32)
@@ -899,7 +1201,8 @@ class ShardedTrainer:
                  multi_tensor: bool = False,
                  max_inflight: Optional[int] = None,
                  partition: Optional[str] = None,
-                 fused_opt: Optional[str] = None):
+                 fused_opt: Optional[str] = None,
+                 overlap: Optional[bool] = None):
         from .mesh import default_mesh
 
         if partition is None:
@@ -907,9 +1210,28 @@ class ShardedTrainer:
         if partition not in PARTITIONS:
             raise MXNetError(f"partition={partition!r} unknown; "
                              f"choose from {PARTITIONS}")
+        if overlap is None:
+            overlap = _os.environ.get("MXNET_OVERLAP", "0").lower() \
+                not in ("", "0", "false")
+        self.overlap = bool(overlap)
         self.partition = partition
         self.net = net
         self.mesh = mesh if mesh is not None else default_mesh()
+        self._batch_spec = batch_spec
+        self._dp_axis = self._data_axis_name()
+        self.grad_accum = int(grad_accum)
+        # pipeline ('pp') axis: partition the net into one stage per pp
+        # rank; micro-batch count = grad_accum (the window IS the
+        # schedule — docs/sharding.md "Pipeline axis")
+        self._pp = self.mesh.shape.get("pp", 1)
+        pipeline_info = None
+        self._pp_stages = None
+        if self._pp > 1:
+            from .pipeline import split_stages
+
+            self._pp_stages = split_stages(net, self._pp)
+            pipeline_info = dict(stages=self._pp_stages, mesh=self.mesh,
+                                 batch_axis=self._dp_axis)
         self.names, allvals, self.specs = shard_params(net, self.mesh, spec_fn)
         if any(any(e is not None for e in tuple(s)) for s in self.specs):
             # mp/fsdp-sharded params: the arena's grad pack would gather
@@ -930,13 +1252,21 @@ class ShardedTrainer:
                     "(mp/fsdp spec_fn): the grad-arena pack would gather "
                     "them replicated")
             fused_opt = "off"
+        if self.overlap and any(any(e is not None for e in tuple(s))
+                                for s in self.specs):
+            raise MXNetError(
+                "overlap=True cannot run with sharded parameters "
+                "(mp/fsdp spec_fn): packing their gradients into bucket "
+                "arenas would gather full-model grad bytes per device — "
+                "use the per-leaf zero1 path (docs/sharding.md)")
         shardings_box = {}
         (self._step_fn, self._grad_fn, self._apply_fn, self._adapter,
          self._holder) = make_train_step(
             net, loss_fn, self.names, optimizer, learning_rate,
             weight_decay, momentum, compute_dtype=compute_dtype,
             multi_tensor=multi_tensor, shardings_box=shardings_box,
-            partition=partition, fused_opt=fused_opt)
+            partition=partition, fused_opt=fused_opt,
+            overlap=self.overlap, pipeline=pipeline_info)
         self.pvals = [allvals[i] for i in self._holder["train_ix"]]
         self.avals = [allvals[i] for i in self._holder["aux_ix"]]
         # loop-carried outputs keep their input placements (read by the
@@ -951,12 +1281,25 @@ class ShardedTrainer:
         self.train_names = [self.names[i] for i in self._holder["train_ix"]]
         self.aux_names = [self.names[i] for i in self._holder["aux_ix"]]
         tspecs = [self.specs[i] for i in self._holder["train_ix"]]
-        self._batch_spec = batch_spec
         # ZeRO-1 placement plan (None per param when replicated): the
         # sharded dim is chosen against the data axis named by batch_spec
-        self._dp_axis = self._data_axis_name()
         arena = isinstance(self._adapter, _ArenaOptAdapter)
-        if partition == "zero1" and arena:
+        ovl = isinstance(self._adapter, _OverlapOptAdapter)
+        if ovl:
+            # overlap: bucket arenas shard over dp inside the adapter's
+            # own shard_map; the per-leaf Zero1Info machinery AND the
+            # grad constraint stay disengaged (grads reduce via plain
+            # AllReduce — allowed by the X007 budget; the blocking RS/AG
+            # pair is what the overlap form eliminates)
+            if self._dp_axis not in self.mesh.shape:
+                raise MXNetError(
+                    f"overlap=True needs a {self._dp_axis!r} mesh axis; "
+                    f"mesh has {tuple(self.mesh.axis_names)}")
+            self._zero1 = [None] * len(self.pvals)
+            self._adapter._shard_multiple = self.mesh.shape[self._dp_axis]
+            self._adapter.mesh = self.mesh
+            self._adapter.dp_axis = self._dp_axis
+        elif partition == "zero1" and arena:
             # flat-arena zero1: the 1-D state arenas shard evenly over dp
             # — shard-local SEGMENTS, no per-leaf padding (the update is
             # elementwise, so leaf boundaries may fall anywhere); the
@@ -984,7 +1327,18 @@ class ShardedTrainer:
         self.opt_state = self._adapter.init_state(init_vals)
         self._state_shardings: List[NamedSharding] = []
         self._leaf_unpad: List[Optional[Tuple[int, int]]] = []
-        for s, pi in zip(self.opt_state, self._adapter.leaf_param_ix):
+        for li, (s, pi) in enumerate(zip(self.opt_state,
+                                         self._adapter.leaf_param_ix)):
+            if ovl:
+                # per-bucket flat arenas, dp-sharded (the ZeRO-1 memory
+                # win); checkpointed stripped to the bucket's true total
+                # like the single-arena path below
+                lay = self._adapter.leaf_layouts[li]
+                self._state_shardings.append(
+                    NamedSharding(self.mesh, P(self._dp_axis)))
+                self._leaf_unpad.append(
+                    (0, lay.total) if lay.padded != lay.total else None)
+                continue
             if arena:
                 # arena leaves span every param: dp-sharded under zero1,
                 # replicated otherwise.  Stored padded (inert zeros), but
@@ -1028,9 +1382,12 @@ class ShardedTrainer:
         self._lr = float(opt.lr) if optimizer is opt else learning_rate
         self.lr_scheduler = lr_scheduler if lr_scheduler is not None \
             else getattr(opt, "lr_scheduler", None)
-        self.grad_accum = int(grad_accum)
         self._accum: Optional[List[Any]] = None
         self._micro = 0
+        # pipeline window buffer: micro-batches collect host-side and the
+        # whole window dispatches as one GPipe executable (_pp_step)
+        self._pp_buf: List[Tuple[Any, Any]] = []
+        self._pp_validated = False
         self._dynamic_scaling = compute_dtype is not None and \
             jnp.dtype(compute_dtype) == jnp.float16
         # AOT-compiled step executables (compile()): (slot, batch signature
@@ -1085,6 +1442,15 @@ class ShardedTrainer:
                            self.opt_state_bytes_per_device)
             _tel.set_gauge("trainer.param_gather_bytes",
                            self.param_gather_bytes)
+            if isinstance(self._adapter, _OverlapOptAdapter):
+                _tel.set_gauge("trainer.overlap_bucket_count",
+                               len(self._adapter.buckets))
+            if self._pp > 1:
+                from .pipeline import bubble_fraction
+
+                _tel.set_gauge(
+                    "trainer.pp_bubble_fraction",
+                    bubble_fraction(self._pp, self.grad_accum))
 
     @property
     def opt_state_bytes_per_device(self) -> int:
@@ -1111,6 +1477,12 @@ class ShardedTrainer:
         dp = self.mesh.shape.get(self._dp_axis, 1)
         if dp <= 1:
             return 0
+        if isinstance(self._adapter, _OverlapOptAdapter):
+            # overlap zero1: each bucket's updated arena returns through
+            # the ppermute ring — dp−1 hops of one shard each, i.e. the
+            # same (dp−1)/dp of the arena bytes an all-gather would move
+            return sum(lay.padded * 4
+                       for lay in self._adapter.layouts) * (dp - 1) // dp
         if isinstance(self._adapter, _ArenaOptAdapter):
             # arena zero1: the dp-sharded delta arena is gathered into the
             # replicated params each step — bill the arena bytes, not the
@@ -1131,6 +1503,23 @@ class ShardedTrainer:
                     padded //= _axis_size(self.mesh, e)
             total += padded * p.dtype.itemsize * (dp - 1) // dp
         return total
+
+    @property
+    def collective_bytes_per_step(self) -> int:
+        """Analytic per-device collective bytes of ONE step
+        (docs/telemetry.md): the gradient reduction — ring AllReduce
+        moves 2(dp−1)/dp of the grad bytes, ReduceScatter (classic
+        zero1) half that — plus the param gather
+        (:attr:`param_gather_bytes`).  The comm side of the
+        ``trainer.collective_exposed_seconds`` attribution."""
+        dp = self.mesh.shape.get(self._dp_axis, 1)
+        if dp <= 1:
+            return 0
+        gbytes = sum(int(_prod(p.shape)) * 4 for p in self.pvals)
+        classic_z1 = (self.partition == "zero1"
+                      and not isinstance(self._adapter, _OverlapOptAdapter))
+        red = (1 if classic_z1 else 2) * gbytes * (dp - 1) // dp
+        return red + self.param_gather_bytes
 
     # -- lr -----------------------------------------------------------------
     @property
@@ -1215,6 +1604,107 @@ class ShardedTrainer:
         batches arrive pre-sharded and ``step`` skips its own put."""
         return self._put(batch)
 
+    # -- pipeline ('pp') window plumbing (docs/sharding.md) ------------------
+    def _put_window(self, v):
+        """Place a micro-STACKED ``(m, B, ...)`` window: the micro axis
+        replicated, the rest per batch_spec (a batch that doesn't divide
+        dp errors loudly in device_put — a config bug, like _put)."""
+        if isinstance(v, NDArray):
+            v = v._data
+        entries = (None,) + tuple(self._batch_spec)
+        spec = P(*entries[:v.ndim]) if v.ndim < len(entries) \
+            else P(*entries)
+        return jax.device_put(v, NamedSharding(self.mesh, spec))
+
+    def _pp_batch(self, batch):
+        """A sample (x, y) micro-batch → the placed window compile() /
+        xla_cost() key on (grad_accum identical micros stacked)."""
+        import numpy as onp
+
+        def host(v):
+            return onp.asarray(v._data if isinstance(v, NDArray) else v)
+
+        m = max(self.grad_accum, 1)
+        return (self._put_window(onp.stack([host(batch[0])] * m)),
+                self._put_window(onp.stack([host(batch[1])] * m)))
+
+    def _pp_validate(self, x):
+        """One-time numeric check that the stage split reproduces the
+        net: ``split_stages`` partitions by registration order, which
+        cannot be PROVEN to equal forward composition — a residual or
+        branchy top-level net must fail here loudly instead of training
+        a different function."""
+        import numpy as onp
+
+        if self._pp_validated:
+            return
+        with _blk.trace_guard():
+            h = NDArray(jnp.asarray(
+                x._data if isinstance(x, NDArray) else x))
+            want = self.net.forward(h)
+            got = h
+            for st in self._pp_stages:
+                for b in st.blocks:
+                    got = b.forward(got)
+            w = onp.asarray(want._data)
+            g = onp.asarray(got._data)
+        scale = max(float(onp.max(onp.abs(w))), 1e-6)
+        rel = float(onp.max(onp.abs(w - g))) / scale
+        if rel > 1e-5:
+            raise MXNetError(
+                f"pipeline stage split does not reproduce the net's "
+                f"forward (rel err {rel:.2e}): the net's forward is not "
+                "the fold of its registered children — restructure it "
+                "as (Hybrid)Sequential chains or drop the 'pp' axis "
+                "(docs/sharding.md 'Pipeline axis')")
+        self._pp_validated = True
+
+    def _pp_step(self, x, y) -> NDArray:
+        """Pipeline step: micro-batches buffer host-side; the grad_accum-th
+        call stacks them into one ``(m, B, ...)`` window and dispatches
+        the whole GPipe schedule as ONE executable.  Buffered calls
+        return a placeholder 0 loss; the window call returns the
+        window-mean loss (the same accounting as grad-accum: k calls,
+        one optimizer update)."""
+        import numpy as onp
+
+        if isinstance(x, (tuple, list)) or isinstance(y, (tuple, list)):
+            raise MXNetError("pipeline ('pp') trainers take single-array "
+                             "x/y batches (tuple batches unsupported)")
+        self._pp_validate(x)
+
+        def host(v):
+            return onp.asarray(v._data if isinstance(v, NDArray) else v)
+
+        self._pp_buf.append((host(x), host(y)))
+        self._micro += 1
+        if self._micro < self.grad_accum:
+            with _blk.trace_guard():
+                return NDArray(jnp.zeros((), jnp.float32))
+        xs = onp.stack([b[0] for b in self._pp_buf])
+        ys = onp.stack([b[1] for b in self._pp_buf])
+        self._pp_buf, self._micro = [], 0
+        xb, yb = self._put_window(xs), self._put_window(ys)
+        self._t += 1
+        lr = jnp.float32(self.learning_rate)
+        aot = self._aot_fn("step", xb, yb) if self._aot else None
+        with _tr.span("trainer.dispatch", aot=aot is not None,
+                      pp=self._pp):
+            if aot is not None:
+                (self.pvals, mutated, self.opt_state,
+                 self._scale_state, loss) = aot(
+                    self.pvals, self.avals, self._key, self.opt_state,
+                    self._t, lr, self._scale_state, xb, yb)
+            else:
+                (self.pvals, mutated, self.opt_state,
+                 self._scale_state, loss) = self._jit_call(
+                    self._step_fn, self.pvals, self.avals, self._key,
+                    self.opt_state, self._t, lr, self._scale_state,
+                    xb, yb)
+        self._write_back(mutated)
+        self._inflight.push(loss)
+        return NDArray(loss)
+
     # -- AOT warmup (docs/jit.md) -------------------------------------------
     @staticmethod
     def _batch_sig(xb, yb) -> tuple:
@@ -1251,7 +1741,13 @@ class ShardedTrainer:
 
         if not isinstance(batch, (tuple, list)) or len(batch) != 2:
             raise MXNetError("compile() takes a sample (x, y) batch")
-        xb, yb = self._put(batch[0]), self._put(batch[1])
+        if self._pp > 1:
+            # pipeline: the executable consumes the micro-STACKED window
+            # (one fused GPipe step per grad_accum window, no grad/apply
+            # split) — key the AOT entry on the stacked signature
+            xb, yb = self._pp_batch(batch)
+        else:
+            xb, yb = self._put(batch[0]), self._put(batch[1])
         lr = jnp.float32(self.learning_rate)
 
         def timed_compile(lowered, slot):
@@ -1285,7 +1781,7 @@ class ShardedTrainer:
                              timer_on_error=True,
                              block=type(self.net).__name__):
                 sig = self._batch_sig(xb, yb)
-                if self.grad_accum <= 1:
+                if self.grad_accum <= 1 or self._pp > 1:
                     if self._aot_fn("step", xb, yb) is None:
                         # lower() traces the functional step (state swap
                         # — trace guard); compile() is pure XLA and runs
@@ -1337,8 +1833,9 @@ class ShardedTrainer:
 
     # -- XLA cost attribution (trace.cost, docs/tracing.md) ------------------
     def _cost_key(self, sig) -> tuple:
+        fused = self.grad_accum <= 1 or self._pp > 1
         return ("trainer", type(self.net).__name__,
-                "step" if self.grad_accum <= 1 else "grad+apply", sig)
+                "step" if fused else "grad+apply", sig)
 
     def xla_cost(self, batch) -> Optional[Dict[str, Any]]:
         """XLA's own accounting of ONE ``step()`` call for ``batch``'s
@@ -1352,14 +1849,15 @@ class ShardedTrainer:
         registers the result with ``mx.trace.cost``; later calls read
         the registry.  Returns None when the backend offers no
         analysis."""
-        xb, yb = self._put(batch[0]), self._put(batch[1])
+        xb, yb = self._pp_batch(batch) if self._pp > 1 \
+            else (self._put(batch[0]), self._put(batch[1]))
         sig = self._batch_sig(xb, yb)
         key = self._cost_key(sig)
         info = _cost.get(key)
         if info is not None:
             return info
         lr = jnp.float32(self.learning_rate)
-        if self.grad_accum <= 1:
+        if self.grad_accum <= 1 or self._pp > 1:
             compiled = self._aot_fn("step", xb, yb)
             if compiled is None:
                 with _blk.trace_guard():
@@ -1367,6 +1865,17 @@ class ShardedTrainer:
                         self.pvals, self.avals, self._key, self.opt_state,
                         self._t + 1, lr, self._scale_state, xb, yb)
                 compiled = lowered.compile()
+            if self._pp > 1 and self.grad_accum > 1:
+                # the window executable runs once per grad_accum step()
+                # calls — amortize so the stored cost matches ONE call,
+                # like the grad-accum apply below
+                winfo = _cost.extract(compiled)
+                if winfo is None:
+                    return None
+                k = float(self.grad_accum)
+                return _cost.register(key, info={
+                    "flops": winfo["flops"] / k,
+                    "bytes_accessed": winfo["bytes_accessed"] / k})
             return _cost.register(key, compiled)
         compiled = self._aot_fn("grad", xb, yb)
         if compiled is None:
@@ -1404,11 +1913,31 @@ class ShardedTrainer:
         match) — on ``batch``'s shapes, and return the row-ready dict
         bench.py embeds.  Empty dict when the backend offers no cost
         analysis."""
-        if self.xla_cost(batch) is None:
+        info = self.xla_cost(batch)
+        if info is None:
             return {}
-        xb, yb = self._put(batch[0]), self._put(batch[1])
+        xb, yb = self._pp_batch(batch) if self._pp > 1 \
+            else (self._put(batch[0]), self._put(batch[1]))
         key = self._cost_key(self._batch_sig(xb, yb))
-        return _cost.publish(key, seconds_per_step, prefix=prefix)
+        cols = _cost.publish(key, seconds_per_step, prefix=prefix)
+        if info.get("bytes_accessed"):
+            # collective-vs-compute attribution: the fraction of the
+            # step's byte traffic that is collectives, times the wall
+            # time, is the upper bound on EXPOSED (un-overlapped)
+            # collective latency; the bucketed overlap path divides it
+            # by the bucket count — only the last bucket's chain has no
+            # backward compute left to hide behind (analytic figure, not
+            # a device-profile measurement — docs/telemetry.md)
+            frac = min(1.0, self.collective_bytes_per_step
+                       / float(info["bytes_accessed"]))
+            exposed = seconds_per_step * frac
+            if isinstance(self._adapter, _OverlapOptAdapter):
+                exposed /= max(len(self._adapter.buckets), 1)
+            if _tel._ENABLED:
+                _tel.observe("trainer.collective_exposed_seconds", exposed)
+            cols = dict(cols)
+            cols["collective_exposed_seconds"] = round(exposed, 9)
+        return cols
 
     def _write_back_params(self):
         params = self._params
@@ -1494,6 +2023,8 @@ class ShardedTrainer:
         return out
 
     def _step(self, x, y) -> NDArray:
+        if self._pp > 1:
+            return self._pp_step(x, y)
         xb, yb = self._put(x), self._put(y)
         if self.grad_accum <= 1:
             self._t += 1
@@ -1649,7 +2180,8 @@ class ShardedTrainer:
                 v = _pad_dim(v, up[0], self._leaf_shapes[i][up[0]])
             if v.shape == self._leaf_shapes[i]:
                 return jax.device_put(v, self._state_shardings[i])
-            if isinstance(self._adapter, _ArenaOptAdapter):
+            if isinstance(self._adapter,
+                          (_ArenaOptAdapter, _OverlapOptAdapter)):
                 # a per-param-layout checkpoint CANNOT silently feed the
                 # arena kernel (leaf 0 would be one param's momentum, not
                 # the arena) — unlike the mesh-shape fallback below this
@@ -1682,4 +2214,5 @@ class ShardedTrainer:
 
         key_holder()._set_data(self._key)
         self._accum, self._micro = None, 0
+        self._pp_buf = []
         self._publish_layout_gauges()

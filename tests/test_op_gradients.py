@@ -319,6 +319,7 @@ def test_scatter_nd_gradient():
         rtol=3e-2, atol=3e-2)
 
 
+@pytest.mark.slow
 def test_rnn_cells_gradient():
     """Fused rnn backward vs FD for all three modes."""
     rs = onp.random.RandomState(33)

@@ -37,11 +37,12 @@ def _roundtrip_check(net, *inputs, atol=1e-5):
     ("resnet18_v2", (1, 3, 32, 32)),
     ("vgg11", (1, 3, 32, 32)),
     ("alexnet", (1, 3, 224, 224)),
-    ("densenet121", (1, 3, 32, 32)),
+    pytest.param("densenet121", (1, 3, 32, 32), marks=pytest.mark.slow),
     ("squeezenet1.0", (1, 3, 224, 224)),
-    ("inceptionv3", (1, 3, 299, 299)),
+    pytest.param("inceptionv3", (1, 3, 299, 299), marks=pytest.mark.slow),
     ("mobilenet0.25", (1, 3, 32, 32)),
-    ("mobilenetv2_0.25", (1, 3, 32, 32)),
+    pytest.param("mobilenetv2_0.25", (1, 3, 32, 32),
+                 marks=pytest.mark.slow),
 ])
 def test_zoo_json_roundtrip(name, shape):
     mx.random.seed(0)

@@ -121,10 +121,87 @@ def test_parse_stablehlo_facts():
 def test_rule_catalog_has_x_series():
     from mxnet_tpu.analysis.diagnostics import RULES
 
-    for code in ("X001", "X002", "X003", "X004", "X005", "X006"):
+    for code in ("X001", "X002", "X003", "X004", "X005", "X006", "X007"):
         assert code in RULES
         title, why, fix = RULES[code]
         assert title and why and fix
+
+
+def test_sync_collective_counts_hlo():
+    """op_counts folds async pairs into the base op, so it alone cannot
+    tell an overlappable pair from a serializing sync op —
+    sync_collective_counts records the blocking occurrences BEFORE the
+    fold (X007's input)."""
+    f = xl.parse_program_text(_HLO, name="synthetic")
+    # the all-reduce is a -start/-done pair: folded, NOT sync
+    assert f.sync_collective_counts.get("all-reduce", 0) == 0
+    # the all-gather is a plain blocking op
+    assert f.sync_collective_counts["all-gather"] == 1
+    assert f.to_dict()["sync_collectives"] == {"all-gather": 1}
+
+
+_WRAPPED_ASYNC_HLO = """\
+HloModule jit_g, is_scheduled=true
+
+%wrapped_reduce-scatter (p0: f32[16]) -> f32[2] {
+  %p0 = f32[16]{0} parameter(0)
+  ROOT %rs = f32[2]{0} reduce-scatter(%p0), dimensions={0}, to_apply=%add
+}
+
+ENTRY %main (Arg_0: f32[16]) -> f32[2] {
+  %Arg_0 = f32[16]{0} parameter(0)
+  %s = ((f32[16]), f32[2]) async-start(%Arg_0), \
+calls=%wrapped_reduce-scatter
+  ROOT %d = f32[2]{0} async-done(%s), calls=%wrapped_reduce-scatter
+}
+"""
+
+
+def test_sync_counts_wrapped_async_form():
+    """Collectives with no dedicated -start opcode (reduce-scatter,
+    all-to-all) go async via the generic async-start wrapper calling a
+    %wrapped_* computation — counted toward the base op, never as
+    blocking."""
+    f = xl.parse_program_text(_WRAPPED_ASYNC_HLO, name="wrapped")
+    assert f.op_counts["reduce-scatter"] == 1
+    assert "async-start" not in f.op_counts
+    assert f.sync_collective_counts.get("reduce-scatter", 0) == 0
+
+
+def test_sync_counts_stablehlo_dialect():
+    """StableHLO has no async forms: every collective is blocking until
+    the backend schedules it, so the lowered dialect reports them all
+    in sync_collective_counts (spelled the HLO way)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh({"dp": 8})
+    txt = jax.jit(shard_map(
+        lambda a: jax.lax.psum(a, "dp"), mesh=mesh,
+        in_specs=P("dp"), out_specs=P())).lower(
+            jnp.ones((8, 4))).as_text()
+    f = xl.parse_program_text(txt)
+    assert f.dialect == "stablehlo"
+    assert f.sync_collective_counts["all-reduce"] >= 1
+    assert f.sync_collective_counts["all-reduce"] == \
+        f.op_counts["all-reduce"]
+
+
+def test_x007_fires_on_sync_only_under_async_budget():
+    base = {"allow_f64": True, "allow_callbacks": True}
+    f = xl.parse_program_text(_HLO)
+    # no async_required -> disengaged even with the sync all-gather
+    assert [d.code for d in xl.run_rules(f, dict(base))] == []
+    # the async all-reduce satisfies its contract; the sync all-gather
+    # violates its own
+    diags = xl.run_rules(f, dict(
+        base, async_required=["all-reduce", "all-gather"]))
+    assert [d.code for d in diags] == ["X007"]
+    assert "all-gather" in diags[0].message
+    # wrapped-async reduce-scatter is clean under the same contract
+    g = xl.parse_program_text(_WRAPPED_ASYNC_HLO)
+    assert [d.code for d in xl.run_rules(
+        g, dict(base, async_required=["reduce-scatter"]))] == []
 
 
 # ---------------------------------------------------------------------------
@@ -208,6 +285,37 @@ def test_x006_host_callback_flagged():
     assert [d.code for d in xl.lint_compiled(comp, name="cb")] == ["X006"]
     assert xl.lint_compiled(comp, name="cb",
                             budget={"allow_callbacks": True}) == []
+
+
+def test_x007_real_executable_forced_sync_and_clean_twin():
+    """SEEDED: a shard_map gather in plain ``lax.all_gather`` form
+    compiles to a blocking all-gather on this backend and must fail an
+    ``async_required`` budget; ``ring_all_gather`` — the decomposed
+    permute-ring form the overlap path emits — contains no all-gather
+    op at all and is the clean twin (same math, lint-acceptable)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import collectives as coll
+
+    mesh = make_mesh({"dp": 8})
+    x = jnp.arange(32, dtype=jnp.float32).reshape((8, 4))
+    budget = {"async_required": ["all-gather"], "allow_f64": True,
+              "allow_callbacks": True}
+    bad = jax.jit(shard_map(
+        lambda a: jax.lax.all_gather(a, "dp", axis=0, tiled=True),
+        mesh=mesh, in_specs=P("dp"), out_specs=P(),
+        check_rep=False)).lower(x).compile()
+    diags = xl.lint_compiled(bad, name="sync-gather", budget=budget)
+    assert [d.code for d in diags] == ["X007"], diags
+    assert "all-gather" in diags[0].message
+
+    good_fn = jax.jit(shard_map(
+        lambda a: coll.ring_all_gather(a, "dp", axis=0),
+        mesh=mesh, in_specs=P("dp"), out_specs=P(), check_rep=False))
+    good = good_fn.lower(x).compile()
+    assert xl.lint_compiled(good, name="ring-gather", budget=budget) == []
+    # the clean twin is the SAME gather, not a different computation
+    onp.testing.assert_array_equal(onp.asarray(good_fn(x)), onp.asarray(x))
 
 
 def test_x003_forced_extra_concatenate_via_arena_rule():
@@ -435,3 +543,9 @@ def test_budget_manifest_covers_canonical_models():
     # the arena model's checked-in budget IS the invariant
     assert models["lenet_train_arena"]["concatenates"] <= \
         xl.ARENA_CONCAT_BUDGET
+    # the overlap model additionally carries the X007 contract: its
+    # weight update may never fall back to blocking RS/AG
+    ovl = models["lenet_train_zero1_overlap"]
+    assert set(ovl["async_required"]) == {"reduce-scatter", "all-gather"}
+    assert "all-gather" not in ovl["collectives"]
+    assert "reduce-scatter" not in ovl["collectives"]

@@ -17,6 +17,10 @@ Canonical models (``--list``):
                          (the <=2-concatenate invariant, X003)
   * lenet_train_zero1  — LeNet train step, ZeRO-1 on the 8-device mesh
                          (X001 + the collective budget, X002)
+  * lenet_train_zero1_overlap — the bucketed overlap update
+                         (``overlap=True``): the budget declares
+                         ``async_required`` for reduce-scatter /
+                         all-gather, so any blocking form fails X007
   * resnet_infer       — ResNet-18 v1 inference executable
   * resnet_fused_bn_relu_infer — the fused BN+ReLU zoo variant
   * bert_tiny_train    — tiny-BERT pretrain train step
@@ -124,6 +128,31 @@ def build_lenet_train_zero1(budget):
     tr.compile(_lenet_batch())
 
 
+def build_lenet_train_zero1_overlap(budget):
+    """The latency-hiding contract as a CI gate (docs/sharding.md
+    "Latency hiding"): the bucketed overlap step may reduce and
+    ring-permute, but any collective the budget lists under
+    ``async_required`` (reduce-scatter, all-gather) appearing in plain
+    blocking form fails X007.  A small bucket bound forces several
+    buckets so the gate covers the multi-bucket flush."""
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    prev = os.environ.get("MXNET_OVERLAP_BUCKET_BYTES")
+    os.environ["MXNET_OVERLAP_BUCKET_BYTES"] = str(256 << 10)
+    try:
+        tr = ShardedTrainer(_lenet(), _ce(), mesh=make_mesh({"dp": 8}),
+                            optimizer="sgd", learning_rate=0.05,
+                            momentum=0.9, partition="zero1", overlap=True)
+        tr._xla_lint_budget = budget
+        tr.compile(_lenet_batch())
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_OVERLAP_BUCKET_BYTES", None)
+        else:
+            os.environ["MXNET_OVERLAP_BUCKET_BYTES"] = prev
+
+
 def _resnet_infer(budget, fused: bool):
     import mxnet_tpu as mx
 
@@ -226,6 +255,7 @@ def build_serve_decode(budget):
 MODELS = {
     "lenet_train_arena": build_lenet_train_arena,
     "lenet_train_zero1": build_lenet_train_zero1,
+    "lenet_train_zero1_overlap": build_lenet_train_zero1_overlap,
     "resnet_infer": build_resnet_infer,
     "resnet_fused_bn_relu_infer": build_resnet_fused_bn_relu_infer,
     "bert_tiny_train": build_bert_tiny_train,
@@ -242,18 +272,23 @@ def load_budgets(path: str) -> dict:
         return json.load(f)
 
 
-def measured_budget(captures) -> dict:
+def measured_budget(captures, prev: dict = None) -> dict:
     """The baseline-update flow: observed op mix -> budget (max per
     collective op / concatenate count across the model's executables,
-    flags stay at their strict defaults)."""
+    flags stay at their strict defaults).  ``async_required`` is a
+    hand-declared CONTRACT, not a measurement — ``prev`` (the model's
+    current budget) carries it through a re-baseline unchanged."""
     coll: dict = {}
     concats = 0
     for facts, _diags in captures:
         for op, n in facts.collective_counts.items():
             coll[op] = max(coll.get(op, 0), n)
         concats = max(concats, facts.concat_count)
-    return {"concatenates": concats, "collectives": coll,
-            "allow_f64": False, "allow_callbacks": False}
+    out = {"concatenates": concats, "collectives": coll,
+           "allow_f64": False, "allow_callbacks": False}
+    if prev and prev.get("async_required"):
+        out["async_required"] = list(prev["async_required"])
+    return out
 
 
 def run_model(name: str, budget) -> tuple:
@@ -306,7 +341,7 @@ def main(argv=None) -> int:
         budget = budgets.get(name)
         cap, diags = run_model(name, budget)
         if args.update_budgets:
-            budgets[name] = measured_budget(cap)
+            budgets[name] = measured_budget(cap, budgets.get(name))
             diags = []  # re-baselined by definition
         all_diags += diags
         report["models"][name] = {
