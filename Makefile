@@ -85,8 +85,11 @@ pipeline-smoke:
 chaos-smoke:
 	# short LeNet loop under MXNET_FAULT_INJECT: barrier + dataloader +
 	# checkpoint faults injected; fails unless every recovery path holds
-	# and the crash->resume run matches bit-for-bit (docs/resilience.md)
+	# and the crash->resume run matches bit-for-bit — plus the elastic
+	# reshape-resume case: heartbeat loss on an 8-device zero1 mesh,
+	# migrate to 4, trajectory matches uninterrupted (docs/resilience.md)
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		python tools/chaos_smoke.py
 
 warmup-smoke:

@@ -25,8 +25,10 @@ connect failure (``MXNET_DIST_INIT_RETRIES``, default 5;
 (``timeout=`` / ``MXNET_DIST_BARRIER_TIMEOUT``) that converts an
 infinite multi-host hang — one rank died, everyone else waits forever —
 into an ``MXNetError`` naming the collective and the elapsed time.
-Both seams are fault-injectable (``resilience.chaos`` sites
-``dist.init`` / ``dist.barrier`` / ``dist.allgather``).
+Every seam is fault-injectable (``resilience.chaos`` sites
+``dist.init`` / ``dist.barrier`` / ``dist.allgather`` /
+``dist.heartbeat`` — the last is the lost-host probe behind
+``PreemptionGuard``'s shrink-and-resume mesh migration).
 """
 from __future__ import annotations
 
@@ -287,6 +289,47 @@ def broadcast_host(x, root: int = 0):
     from jax.experimental import multihost_utils
 
     return multihost_utils.broadcast_one_to_all(x)
+
+
+def heartbeat(timeout: Optional[float] = None) -> bool:
+    """Liveness probe for elastic training (docs/resilience.md "Mesh
+    migration"): ``PreemptionGuard`` calls this at step boundaries to
+    detect a lost/wedged host *before* the next real collective hangs
+    on it.  Single-process the probe only crosses the injection seam;
+    multi-process it is a deadlined host allgather of a constant, so
+    one dead rank converts into an ``MXNetError`` naming the probe
+    instead of an infinite hang (``timeout=`` seconds, default
+    ``MXNET_DIST_HEARTBEAT_TIMEOUT`` or none).
+
+    Chaos site ``dist.heartbeat``: ``error``/``torn`` raise
+    :class:`~..resilience.chaos.ChaosError` (the lost-host stand-in the
+    guard's shrink-and-resume path reacts to), ``delay`` sleeps inside
+    the deadline.  Returns True; ticks ``dist.heartbeats`` and observes
+    ``dist.heartbeat_seconds``."""
+    if timeout is None:
+        timeout = get_env("MXNET_DIST_HEARTBEAT_TIMEOUT", None, float)
+    t0 = _time.perf_counter()
+
+    def probe():
+        if _chaos._ACTIVE:
+            _chaos.maybe_fail("dist.heartbeat")
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            import numpy as onp
+
+            multihost_utils.process_allgather(onp.asarray(1))
+
+    # phased span: a heartbeat that never returns (the dead-peer hang
+    # the deadline converts) still leaves its begin event in the
+    # flight-recorder ring, same contract as barrier/allgather
+    with _tr.span("dist.heartbeat", phased=True):
+        _with_deadline(probe, "heartbeat", timeout)
+    if _tel._ENABLED:
+        _tel.inc("dist.heartbeats")
+        _tel.observe("dist.heartbeat_seconds", _time.perf_counter() - t0)
+    return True
 
 
 def barrier(name: str = "mx_barrier",

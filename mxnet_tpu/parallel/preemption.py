@@ -22,6 +22,25 @@ or, with rolling versioned checkpoints (docs/resilience.md)::
     mgr = resilience.CheckpointManager("ckpt/run1", trainer)
     guard = PreemptionGuard(trainer, manager=mgr)
 
+Elastic topology (shrink-and-resume): construct with a ``rebuild``
+factory and a ``heartbeat_every`` cadence and the guard probes
+``dist.heartbeat()`` between steps — a failed probe (dead host, wedged
+collective, or injected ``dist.heartbeat`` chaos) is treated exactly
+like a preemption signal: checkpoint at this step boundary, ``step()``
+returns True, and the loop calls :meth:`PreemptionGuard.migrate` to
+rebuild the trainer on the surviving devices and restore onto the
+shrunken mesh (the manifest-v2 slice reader does the resharding; see
+docs/resilience.md "Manifest v2 + resharding")::
+
+    guard = PreemptionGuard(trainer, manager=mgr,
+                            rebuild=make_trainer, heartbeat_every=10)
+    for step, (x, y) in enumerate(data):
+        guard.trainer.step(x, y)
+        if guard.step():
+            if guard.heartbeat_error is None:
+                break                   # real preemption: exit, resume later
+            guard.migrate(devices=surviving_devices())   # shrink + go on
+
 Design notes (TPU-first): the signal handler itself only sets a flag —
 checkpointing from inside a signal handler would race the jit step's
 donated buffers; the write happens at the next step() boundary, where
@@ -65,7 +84,7 @@ class PreemptionGuard:
     def __init__(self, trainer, path: Optional[str] = None,
                  signals=(signal.SIGTERM,),
                  save_on_rank0_only: bool = True, check_every: int = 1,
-                 manager=None):
+                 manager=None, rebuild=None, heartbeat_every: int = 0):
         from ..base import MXNetError
 
         if path is None and manager is None:
@@ -75,8 +94,14 @@ class PreemptionGuard:
         self.trainer = trainer
         self.path = path
         self.manager = manager
+        #: trainer factory for :meth:`migrate` — ``rebuild(devices) ->
+        #: trainer`` builds a fresh trainer (fresh mesh) on the
+        #: surviving device list
+        self.rebuild = rebuild
         #: the exception of a failed preemption checkpoint (None = clean)
         self.save_error: Optional[BaseException] = None
+        #: the exception of a failed liveness probe (None = healthy)
+        self.heartbeat_error: Optional[BaseException] = None
         self._flag = threading.Event()
         self._saved = False
         self._save_on_rank0_only = save_on_rank0_only
@@ -84,6 +109,11 @@ class PreemptionGuard:
         # it (a preemption grace period is ~30s — checking every few steps
         # is plenty)
         self._check_every = max(1, int(check_every))
+        # heartbeat_every>0 probes dist.heartbeat at that step cadence;
+        # a failed probe is treated exactly like a preemption signal
+        # (checkpoint at this boundary, then migrate() to shrink).  The
+        # cadence is step-count based so every rank probes together.
+        self._heartbeat_every = max(0, int(heartbeat_every))
         self._step_count = 0
         self._prev = {}
         for sig in signals:
@@ -109,6 +139,23 @@ class PreemptionGuard:
         import jax
 
         self._step_count += 1
+        if self._heartbeat_every and not self._flag.is_set() and \
+                self._step_count % self._heartbeat_every == 0:
+            from . import dist
+
+            try:
+                dist.heartbeat()
+            except Exception as e:  # noqa: BLE001 — probe, not trainer
+                # a dead/wedged host (or injected chaos standing in for
+                # one): checkpoint at THIS boundary like a preemption
+                # signal; the train loop then calls migrate() to resume
+                # on the survivors
+                self.heartbeat_error = e
+                self._flag.set()
+                _tel.inc("resilience.heartbeat_failures")
+                logging.warning(
+                    "dist.heartbeat failed (%s); treating as preemption "
+                    "— checkpointing for mesh migration", e)
         if jax.process_count() > 1:
             # the gate must depend ONLY on the step count (identical on
             # every rank): letting a signaled rank enter the allgather on
@@ -184,6 +231,65 @@ class PreemptionGuard:
             dist.barrier("mx_preemption_ckpt")
         self._saved = True
         return True
+
+    def migrate(self, devices=None, trainer_factory=None):
+        """Shrink-and-resume mesh migration (docs/resilience.md):
+        rebuild the trainer on the surviving ``devices`` via the rebuild
+        factory, restore the newest intact checkpoint onto the new mesh
+        — the manifest-v2 reader re-slices every leaf to the shrunken
+        dp/mp factors, each rank reading only the slices its shards
+        intersect — re-arm the guard, and return the new trainer.
+
+        Call after :meth:`step` returned True on a heartbeat failure or
+        preemption notice (the checkpoint is already cut then); calling
+        with no checkpoint cut yet saves one first.  ``devices``
+        defaults to the current mesh minus its last device — on a real
+        pod pass the post-loss ``jax.devices()`` after re-initializing
+        the process group.  Ticks ``resilience.mesh_shrinks``; the whole
+        resume is one ``resilience.migrate`` trace span."""
+        from ..base import MXNetError
+        from ..trace import recorder as _tr
+
+        factory = trainer_factory if trainer_factory is not None \
+            else self.rebuild
+        if factory is None:
+            raise MXNetError(
+                "migrate() needs a trainer factory: pass rebuild= at "
+                "construction or trainer_factory= here")
+        if self.manager is None:
+            raise MXNetError(
+                "migrate() needs versioned checkpoints — construct the "
+                "guard with a resilience.CheckpointManager (manager=)")
+        if devices is None:
+            devices = list(self.trainer.mesh.devices.ravel())[:-1]
+        if not devices:
+            raise MXNetError("migrate(): no surviving devices")
+        with _tr.span("resilience.migrate", devices=len(devices)):
+            if not self._saved:
+                self.manager.save(
+                    getattr(self.trainer, "_t", self._step_count),
+                    trainer=self.trainer)
+                self.manager.wait()
+            trainer = factory(devices)
+            step = self.manager.restore_latest(trainer)
+            if step is None:
+                raise MXNetError(
+                    "migrate(): no intact checkpoint version to resume "
+                    "from")
+            self.trainer = trainer
+            # the manager follows the guard onto the new trainer so
+            # later save()/restore_latest() calls default correctly
+            self.manager._trainer = trainer
+            self._saved = False
+            self._flag.clear()
+            self.save_error = None
+            self.heartbeat_error = None
+            _tel.inc("resilience.mesh_shrinks")
+            _tel.set_gauge("resilience.mesh_devices", len(devices))
+            logging.warning(
+                "mesh migration: resumed from step %d on %d device(s)",
+                step, len(devices))
+        return trainer
 
     def restore(self):
         """Put the original signal handlers back."""

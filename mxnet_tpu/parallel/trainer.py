@@ -213,6 +213,18 @@ def _pad_dim(v, axis: int, target: int):
     return jnp.pad(v, pads)
 
 
+def _layout_mismatch_error(detail):
+    """Optimizer-state layouts (per-param vs flat-arena, leaf arity,
+    leaf rank) never reshard silently — shared by both restore paths
+    (``load_states`` and the slice-wise ``load_state_shards``)."""
+    return MXNetError(
+        f"checkpoint optimizer state does not match this "
+        f"trainer's layout ({detail}): it was saved under a "
+        "different optimizer layout (per-param vs flat-arena) or "
+        "optimizer — rebuild the trainer with the matching "
+        "fused_opt / MXNET_KERNELS setting (docs/kernels.md)")
+
+
 def _functional_apply(net, names: List[str], training: bool):
     """Lift net.forward to fn(param_vals, rng_key_val, *inputs) →
     (outputs..., new_rng, mutated_state...). Same protocol as
@@ -1188,8 +1200,14 @@ class ShardedTrainer:
     when the kernels layer is active and the optimizer is arena-fusible
     (sgd/momentum/adam, uniform multipliers), ``"arena"`` requires it,
     ``"off"`` pins the per-param replay.  Under zero1 the arenas shard
-    over dp as flat segments.  Checkpoints record the layout implicitly:
-    restoring across different ``fused_opt``/kernels configs raises."""
+    over dp as flat segments.  Checkpoints reshard across mesh shapes
+    and partitions: padding is stripped at save and re-sliced/re-padded
+    to the target dp/mp factors at load (the slice-wise path is
+    ``state_shards``/``load_state_shards``, docs/resilience.md
+    "Manifest v2 + resharding").  The optimizer LAYOUT is recorded
+    implicitly and never reshards: restoring across different
+    ``fused_opt``/kernels configs (per-param vs flat-arena leaf arity
+    or rank) raises."""
 
     def __init__(self, net, loss_fn, mesh: Optional[Mesh] = None,
                  optimizer="sgd", learning_rate: float = 0.01,
@@ -1375,6 +1393,10 @@ class ShardedTrainer:
         # THESE (not the live leaves, which a prior load's replicated
         # shape-mismatch fallback may have replaced)
         self._leaf_shapes = [tuple(s.shape) for s in self.opt_state]
+        #: byte accounting of the last load_state_shards (manifest v2)
+        #: restore — {bytes_read, sharded_full_bytes,
+        #: sharded_max_rank_bytes, leaves_resharded}; None until then
+        self.last_restore_stats: Optional[Dict[str, int]] = None
         self._t = 0
         # an Optimizer instance brings its own lr / scheduler — honor them
         # (its update() replays with the trainer-supplied traced lr)
@@ -2155,13 +2177,7 @@ class ShardedTrainer:
         self.pvals = [place(n, blob[f"param/{n}"]) for n in self.train_names]
         self.avals = [place(n, blob[f"aux/{n}"]) for n in self.aux_names]
 
-        def _layout_mismatch(detail):
-            return MXNetError(
-                f"checkpoint optimizer state does not match this "
-                f"trainer's layout ({detail}): it was saved under a "
-                "different optimizer layout (per-param vs flat-arena) or "
-                "optimizer — rebuild the trainer with the matching "
-                "fused_opt / MXNET_KERNELS setting (docs/kernels.md)")
+        _layout_mismatch = _layout_mismatch_error
 
         n_blob = sum(1 for k in blob if k.startswith("opt/"))
         if n_blob != len(self.opt_state):
@@ -2215,4 +2231,212 @@ class ShardedTrainer:
         key_holder()._set_data(self._key)
         self._accum, self._micro = None, 0
         self._pp_buf = []
+        self._publish_layout_gauges()
+
+    # -- shard-wise checkpoints (manifest v2, resilience.reshard) ------------
+
+    def _shard_leaves(self):
+        """(key, value, clip_shape) triples in checkpoint order — the
+        leaf enumeration shared by the shard-wise writer and reader.
+        ``clip_shape`` strips the zero1/arena shard padding (same
+        convention as ``save_states``) so slices live in dp-independent
+        logical coordinates."""
+        leaves = []
+        for n, v in zip(self.train_names, self.pvals):
+            leaves.append((f"param/{n}", v, None))
+        for n, v in zip(self.aux_names, self.avals):
+            leaves.append((f"aux/{n}", v, None))
+        for i, s in enumerate(self.opt_state):
+            up = self._leaf_unpad[i]
+            clip = None
+            if up is not None:
+                shp = list(self._leaf_shapes[i])
+                shp[up[0]] = up[1]
+                clip = tuple(shp)
+            leaves.append((f"opt/{i}", s, clip))
+        return leaves
+
+    def state_shards(self, dirname: str):
+        """Write this trainer's full state shard-wise under ``dirname``
+        (one ``shards.bin``): each leaf lands as the SOURCE sharding's
+        slices — replicas deduplicated, zero1/arena padding clipped per
+        slice, no full-leaf host gather for sharded leaves.  Returns
+        the ``(leaves, meta)`` sections :class:`~..resilience.checkpoint
+        .CheckpointManager` embeds in its manifest-v2 commit record."""
+        import numpy as onp
+
+        if self._micro != 0:
+            raise MXNetError(
+                f"state_shards called mid gradient-accumulation window "
+                f"({self._micro}/{self.grad_accum} micro-batches "
+                f"pending); step to a window boundary first")
+        self.drain()
+        from ..resilience import reshard as _reshard
+
+        with _tr.span("ckpt.state_shards", step=self._t):
+            leaves = _reshard.write_shards(dirname, self._shard_leaves())
+        key = onp.asarray(self._key)
+        meta = {"t": int(self._t),
+                "key": key.tolist(), "key_dtype": key.dtype.name,
+                "scale": float(self._scale_state[0]),
+                "good": int(self._scale_state[1])}
+        return leaves, meta
+
+    def _place_shardwise(self, rdr, rec, storage, sharding, stats):
+        """Place one manifest-v2 leaf onto ``sharding``.  Partitioned
+        targets assemble per-device shards from ONLY the source slices
+        each shard intersects (the all-gather-free redistribution path
+        — no rank materializes a full leaf it doesn't hold); replicated
+        targets read the leaf once.  Zero-pads from the unpadded
+        logical shape toward ``storage`` (this trainer's zero1/arena
+        layout — the reshard-instead-of-raise semantics of
+        docs/sharding.md)."""
+        import numpy as onp
+
+        from ..resilience import reshard as _reshard
+
+        storage = tuple(int(d) for d in storage)
+        src_boxes = {s.box for s in rec.slices}
+        if getattr(sharding, "is_fully_replicated", True):
+            v = rdr.read(rec.key)
+            if v.shape != storage:
+                out = onp.zeros(storage, v.dtype)
+                out[tuple(slice(d) for d in v.shape)] = v
+                v = out
+            if src_boxes != {tuple((0, d) for d in rec.shape)}:
+                stats["leaves_resharded"] += 1
+            return jax.device_put(jnp.asarray(v), sharding)
+        dmap = sharding.devices_indices_map(storage)
+        pi = jax.process_index()
+        arrs = []
+        tgt_boxes = set()
+        for d, idx in dmap.items():
+            gbox = _reshard.box_of(idx, storage)
+            cbox = _reshard.clip_box(gbox, rec.shape)
+            if cbox is not None:
+                tgt_boxes.add(cbox)
+            # manifest-only accounting, per target device: what THIS
+            # shard costs to read wherever its rank lives (on a pod each
+            # process only reads its own devices' rows of this table)
+            rb = stats["_rank_bytes"]
+            rb[d.id] = rb.get(d.id, 0) + _reshard.plan_bytes(
+                rec, [cbox] if cbox is not None else [])
+            if d.process_index != pi:
+                continue
+            local = onp.zeros(tuple(b - a for a, b in gbox), rec.dtype)
+            if cbox is not None:
+                sub = rdr.read(rec.key, cbox)
+                local[tuple(slice(c0 - g0, c1 - g0)
+                            for (g0, _), (c0, c1)
+                            in zip(gbox, cbox))] = sub
+            arrs.append(jax.device_put(jnp.asarray(local), d))
+        stats["sharded_full_bytes"] += _reshard.full_bytes(rec)
+        if tgt_boxes != src_boxes:
+            stats["leaves_resharded"] += 1
+        return jax.make_array_from_single_device_arrays(
+            storage, sharding, arrs)
+
+    def load_state_shards(self, dirname: str, manifest: dict):
+        """Restore a manifest-v2 (shard-wise) checkpoint onto THIS
+        trainer's mesh: every leaf is re-sliced from the source
+        sharding's slices straight onto the target sharding — source
+        padding stripped at save, re-padded here to the target
+        zero1/arena layout — reading only the slices the target shards
+        intersect.  Leaf-count and leaf-rank mismatches (per-param vs
+        flat-arena layouts) still raise loudly.  Restore accounting
+        lands on ``self.last_restore_stats``; a cross-sharding restore
+        ticks ``resilience.reshards``."""
+        with _tr.span("ckpt.load_state_shards"):
+            self._load_state_shards_impl(dirname, manifest)
+
+    def _load_state_shards_impl(self, dirname: str, manifest: dict):
+        import numpy as onp
+
+        from ..resilience import reshard as _reshard
+
+        leaves = _reshard.leaves_from_json(manifest["leaves"])
+        try:
+            meta = manifest["meta"]
+            meta_t = int(meta["t"])
+            meta_key = onp.asarray(meta["key"],
+                                   dtype=meta.get("key_dtype", "uint32"))
+            meta_scale = float(meta["scale"])
+            meta_good = int(meta["good"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise MXNetError(
+                f"manifest v2 'meta' section is malformed: {e}") from e
+        by_key = {leaf.key: leaf for leaf in leaves}
+        for leaf in leaves:
+            if leaf.key.startswith("param/") and \
+                    leaf.key[len("param/"):] not in self.train_names:
+                raise MXNetError(
+                    f"checkpoint param "
+                    f"'{leaf.key[len('param/'):]}' unknown")
+        n_blob = sum(1 for k in by_key if k.startswith("opt/"))
+        if n_blob != len(self.opt_state):
+            raise _layout_mismatch_error(
+                f"{n_blob} saved leaves, {len(self.opt_state)} expected")
+        spec_of = dict(zip(self.names, self.specs))
+        stats = {"bytes_read": 0, "sharded_full_bytes": 0,
+                 "sharded_max_rank_bytes": 0, "leaves_resharded": 0,
+                 "_rank_bytes": {}}
+        placed: Dict[str, Any] = {}
+        with _reshard.ShardReader(dirname, leaves) as rdr:
+            for key, cur, clip in self._shard_leaves():
+                rec = by_key.get(key)
+                if rec is None:
+                    raise MXNetError(
+                        f"checkpoint is missing leaf {key!r}")
+                if key.startswith("opt/"):
+                    i = int(key[len("opt/"):])
+                    storage = self._leaf_shapes[i]
+                    sharding = self._state_shardings[i]
+                    logical = clip if clip is not None else storage
+                    if len(rec.shape) != len(logical):
+                        raise _layout_mismatch_error(
+                            f"leaf {i} has rank {len(rec.shape)}, "
+                            f"expected rank {len(logical)}")
+                    if tuple(rec.shape) != tuple(logical):
+                        raise _layout_mismatch_error(
+                            f"leaf {i} has shape {tuple(rec.shape)}, "
+                            f"expected unpadded shape {tuple(logical)}")
+                else:
+                    name = key.split("/", 1)[1]
+                    sharding = NamedSharding(self.mesh,
+                                             spec_of.get(name, P()))
+                    storage = tuple(cur.shape)
+                    if tuple(rec.shape) != storage:
+                        raise MXNetError(
+                            f"checkpoint leaf {key!r} has shape "
+                            f"{tuple(rec.shape)}; this trainer expects "
+                            f"{storage}")
+                placed[key] = self._place_shardwise(
+                    rdr, rec, storage, sharding, stats)
+            stats["bytes_read"] = rdr.bytes_read
+        # every leaf placed and meta validated — mutate atomically from
+        # here (a failure above leaves the trainer untouched)
+        self.pvals = [placed[f"param/{n}"] for n in self.train_names]
+        self.avals = [placed[f"aux/{n}"] for n in self.aux_names]
+        self.opt_state = [placed[f"opt/{i}"]
+                          for i in range(len(self.opt_state))]
+        self._t = meta_t
+        self._key = jnp.asarray(meta_key)
+        self._scale_state = (jnp.float32(meta_scale),
+                             jnp.int32(meta_good))
+        params = self._params
+        for n, v in zip(self.train_names, self.pvals):
+            params[n].data()._set_data(v)
+        for n, v in zip(self.aux_names, self.avals):
+            params[n].data()._set_data(v)
+        from ..random import key_holder
+
+        key_holder()._set_data(self._key)
+        self._accum, self._micro = None, 0
+        self._pp_buf = []
+        rank_bytes = stats.pop("_rank_bytes")
+        stats["sharded_max_rank_bytes"] = max(rank_bytes.values(),
+                                              default=0)
+        if stats["leaves_resharded"]:
+            _tel.inc("resilience.reshards")
+        self.last_restore_stats = stats
         self._publish_layout_gauges()

@@ -11,11 +11,19 @@ the production counterpart (docs/resilience.md):
                (versioned rolling ``step-N/`` checkpoints with CRC32
                manifests, torn-write recovery, async saves, and a
                multi-process durability barrier).
+  reshard    — shard-wise manifest-v2 payloads + slice-wise
+               resharding: checkpoints written as the source sharding's
+               slices (per-slice CRC32), restored by reading only the
+               slices each rank's target shards intersect — the
+               elastic-topology substrate under cross-mesh restores and
+               ``PreemptionGuard.migrate`` (docs/resilience.md
+               "Manifest v2 + resharding").
   chaos      — deterministic fault injection at named seams
                (``MXNET_FAULT_INJECT="site:kind:prob[:after]"``): engine
                push, dataloader fetch, host collectives, dist init,
-               checkpoint writes — so every recovery path is testable on
-               one CPU host (``make chaos-smoke``).
+               checkpoint writes AND reads, heartbeats — so every
+               recovery path is testable on one CPU host
+               (``make chaos-smoke``).
 
 Hardened distributed bring-up lives where bring-up lives
 (``parallel/dist.py``): bounded ``dist.init`` retry with exponential
@@ -25,9 +33,11 @@ infinite multi-host hang into an ``MXNetError`` naming the barrier.
 """
 from . import chaos
 from . import checkpoint
+from . import reshard
 from .chaos import ChaosError
 from .checkpoint import (CheckpointManager, atomic_replace, atomic_write,
                          write_payload)
 
-__all__ = ["chaos", "checkpoint", "ChaosError", "CheckpointManager",
+__all__ = ["chaos", "checkpoint", "reshard", "ChaosError",
+           "CheckpointManager",
            "atomic_replace", "atomic_write", "write_payload"]

@@ -37,6 +37,14 @@ Instrumented sites:
   ``dist.barrier``              host-level barrier
   ``ckpt.write``                durable checkpoint payload write
                                 (atomic_write commit point)
+  ``ckpt.read``                 checkpoint payload read — the v1 restore
+                                path and every manifest-v2 slice read
+                                (``torn`` truncates the read buffer so
+                                the per-slice CRC must catch it)
+  ``dist.heartbeat``            the liveness probe behind
+                                ``PreemptionGuard(heartbeat_every=)`` —
+                                ``error`` stands in for a lost host and
+                                drives the shrink-and-resume migration
   ============================  =============================================
 
 Determinism: every site draws from its own ``random.Random`` seeded by

@@ -25,15 +25,29 @@ Checkpoint layout (docs/resilience.md)::
 
     ckpt_dir/
       step-40/
-        payload.bin        # exactly what trainer.save_states wrote
+        payload.bin        # v1: exactly what trainer.save_states wrote
+        shards.bin         # v2: per-shard slices (resilience.reshard)
         manifest.json      # commit record, written after payload fsync
       step-44/ ...
       .tmp-step-48-<pid>-<seq>/   # in-progress; invisible to restore
 
-``manifest.json``::
+``manifest.json`` (v1)::
 
     {"version": 1, "step": 44, "time": 1722800000.0,
      "files": {"payload.bin": {"crc32": 3735928559, "bytes": 81920}}}
+
+Trainers exposing the shard-wise protocol (``state_shards`` /
+``load_state_shards`` — ``ShardedTrainer`` does) are committed as
+**manifest v2**: the payload is ``shards.bin`` holding the *source
+sharding's* slices of every leaf, and the manifest carries a ``leaves``
+section (per-leaf dtype / unpadded shape / per-slice byte extents and
+CRC32s) plus a ``meta`` section (step, RNG key, loss scale).  A v2
+restore reads only the slices intersecting the target sharding's
+shards — the elastic-topology path (docs/resilience.md "Manifest v2 +
+resharding"); ``shards.bin`` is covered by its per-slice CRCs, so the
+``files`` entry records only its size (a whole-file CRC pass would
+force the full-leaf read v2 exists to avoid).  Duck-typed trainers
+(only ``save_states``/``load_states``) keep committing v1.
 
 Crash safety: the payload is written and fsynced inside a ``.tmp-*``
 directory, the manifest is written (atomically) after it, the directory
@@ -278,7 +292,8 @@ class CheckpointManager:
     before the checkpoint is durable on rank 0's disk.
 
     Telemetry: ``ckpt.saves`` / ``ckpt.save_failures`` /
-    ``ckpt.restores`` / ``ckpt.corrupt_skipped`` counters,
+    ``ckpt.restores`` / ``ckpt.corrupt_skipped`` /
+    ``ckpt.skipped_versions`` / ``ckpt.restore_bytes`` counters,
     ``ckpt.save_seconds`` / ``ckpt.restore_seconds`` timers,
     ``ckpt.last_step`` gauge (docs/telemetry.md)."""
 
@@ -372,27 +387,53 @@ class CheckpointManager:
             self.directory,
             f"{_TMP_PREFIX}{_STEP_PREFIX}{step}-{os.getpid()}-{next(_SEQ)}")
         os.makedirs(tmpdir)
+        # shard-wise (manifest v2) when the trainer speaks the protocol
+        # and no pre-captured v1 payload bytes were handed in
+        shardwise = payload is None and hasattr(trainer, "state_shards") \
+            and hasattr(trainer, "load_state_shards")
+        leaves = meta = None
         try:
-            ppath = os.path.join(tmpdir, PAYLOAD_NAME)
+            from . import reshard as _reshard
+
+            ppath = os.path.join(
+                tmpdir, _reshard.SHARDS_NAME if shardwise else PAYLOAD_NAME)
             _TLS.in_commit = True  # defer the ckpt.write fault draw
             try:
-                if payload is not None:
+                if shardwise:
+                    with _tr.span("ckpt.write",
+                                  timer="ckpt.write_seconds"):
+                        leaves, meta = trainer.state_shards(tmpdir)
+                    if _tel._ENABLED:
+                        _tel.inc("ckpt.saves")
+                elif payload is not None:
                     write_payload(ppath, payload)
                 else:
                     trainer.save_states(ppath)
-                    if not os.path.exists(ppath):
-                        raise MXNetError(
-                            f"save_states wrote nothing at {ppath}")
+                if not os.path.exists(ppath):
+                    raise MXNetError(
+                        f"{'state_shards' if shardwise else 'save_states'}"
+                        f" wrote nothing at {ppath}")
             finally:
                 _TLS.in_commit = False
             files = {}
             for name in sorted(os.listdir(tmpdir)):
                 p = os.path.join(tmpdir, name)
-                if os.path.isfile(p):
+                if not os.path.isfile(p):
+                    continue
+                if shardwise and name == _reshard.SHARDS_NAME:
+                    # per-slice CRCs in "leaves" cover the payload;
+                    # a whole-file CRC here would force verify() into
+                    # the full read the v2 format exists to avoid
+                    files[name] = {"bytes": os.path.getsize(p)}
+                else:
                     files[name] = {"crc32": _crc32_file(p),
                                    "bytes": os.path.getsize(p)}
-            manifest = {"version": _MANIFEST_VERSION, "step": step,
+            manifest = {"version": 2 if shardwise else _MANIFEST_VERSION,
+                        "step": step,
                         "time": round(_time.time(), 3), "files": files}
+            if shardwise:
+                manifest["leaves"] = leaves
+                manifest["meta"] = meta
             # manifest last: its presence marks "every file above is
             # complete"; atomic_write fsyncs it before the dir fsync
             atomic_write(os.path.join(tmpdir, MANIFEST_NAME),
@@ -505,7 +546,9 @@ class CheckpointManager:
     def verify(self, step: int) -> bool:
         """True when version ``step`` is intact: manifest present and
         parseable, every listed file present with matching size and
-        CRC32."""
+        (when recorded) CRC32.  ``shards.bin`` entries carry size only —
+        their integrity lives in the per-slice CRCs, checked by the
+        reader on exactly the slices it touches."""
         d = self.path_of(step)
         try:
             with open(os.path.join(d, MANIFEST_NAME)) as f:
@@ -520,18 +563,29 @@ class CheckpointManager:
             try:
                 if os.path.getsize(p) != meta["bytes"]:
                     return False
-                if _crc32_file(p) != meta["crc32"]:
+                crc = meta.get("crc32")
+                if crc is not None and _crc32_file(p) != crc:
                     return False
             except (OSError, KeyError, TypeError):
                 return False
         return True
 
+    def manifest_of(self, step: int) -> dict:
+        """Parse version ``step``'s manifest (raises on a torn one —
+        callers scan behind :meth:`verify`)."""
+        with open(os.path.join(self.path_of(step), MANIFEST_NAME)) as f:
+            return json.load(f)
+
     def restore_latest(self, trainer=None) -> Optional[int]:
         """Load the newest INTACT version into the trainer; returns its
         step, or None when no intact version exists.  Torn manifests,
-        CRC mismatches, and payloads ``load_states`` rejects are each
-        skipped with a loud warning (and a ``ckpt.corrupt_skipped``
-        tick) — the scanner keeps walking back until something loads.
+        CRC mismatches, and payloads the trainer rejects are each
+        skipped with a loud warning (``ckpt.corrupt_skipped`` and
+        ``ckpt.skipped_versions`` tick) — the scanner keeps walking
+        back until something loads.  Manifest v2 (shard-wise) versions
+        restore through ``trainer.load_state_shards`` — each rank reads
+        only the slices its target shards intersect; v1 versions keep
+        the full ``load_states`` payload read.
 
         If a ``load_states`` attempt failed (it may have half-mutated
         the trainer) and NO older version subsequently loaded, this
@@ -543,6 +597,7 @@ class CheckpointManager:
             raise MXNetError("restore_latest() needs a trainer")
         t0 = _time.perf_counter()
         load_failed_at = None
+        load_failed_exc = None
         # the span covers the whole scan (skipped versions included),
         # so a restore that walked back through corrupt checkpoints
         # shows the walk on the timeline; the telemetry timer keeps its
@@ -551,20 +606,40 @@ class CheckpointManager:
             for step in sorted(self.steps(), reverse=True):
                 if not self.verify(step):
                     _tel.inc("ckpt.corrupt_skipped")
+                    _tel.inc("ckpt.skipped_versions")
                     log.warning(
                         "checkpoint %s is torn/corrupt (manifest or CRC "
                         "mismatch); skipping to an older version",
                         self.path_of(step))
                     continue
                 try:
-                    trainer.load_states(self.payload_path(step))
-                except Exception:
+                    manifest = self.manifest_of(step)
+                    if manifest.get("version", 1) >= 2:
+                        # shard-wise payload: the trainer's slice reader
+                        # reshards onto ITS mesh, reading only the
+                        # slices its ranks own (resilience.reshard)
+                        if not hasattr(trainer, "load_state_shards"):
+                            raise MXNetError(
+                                f"checkpoint {self.path_of(step)} is "
+                                "manifest v2 (shard-wise) but the "
+                                "trainer has no load_state_shards")
+                        trainer.load_state_shards(self.path_of(step),
+                                                  manifest)
+                    else:
+                        if _chaos.active():
+                            # the v1 payload read crosses the same
+                            # ckpt.read seam the v2 slice reader does
+                            _chaos.maybe_fail("ckpt.read")
+                        trainer.load_states(self.payload_path(step))
+                except Exception as e:
                     _tel.inc("ckpt.corrupt_skipped")
+                    _tel.inc("ckpt.skipped_versions")
                     if load_failed_at is None:
                         load_failed_at = step
+                        load_failed_exc = e
                     log.exception(
-                        "checkpoint %s passed CRC but load_states "
-                        "rejected it; skipping to an older version",
+                        "checkpoint %s passed verify but its load "
+                        "was rejected; skipping to an older version",
                         self.path_of(step))
                     continue
                 _tel.inc("ckpt.restores")
@@ -578,5 +653,5 @@ class CheckpointManager:
                     f"{load_failed_at} (and no older version loaded) "
                     "after possibly half-mutating the trainer; its state "
                     "is undefined — reinitialize the trainer before "
-                    "training")
+                    "training") from load_failed_exc
             return None

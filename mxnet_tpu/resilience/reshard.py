@@ -1,0 +1,330 @@
+"""Shard-wise checkpoint payloads + slice-wise resharding (manifest v2).
+
+The v1 checkpoint payload is one ``.npz`` of host-gathered full leaves:
+restoring on a different mesh means EVERY rank reads EVERY full leaf and
+re-places it — O(model) bytes per rank, regardless of how little of the
+model the rank actually holds.  This module is the elastic-topology
+counterpart (ROADMAP item 4; the slice-wise redistribution scheme of
+"Memory-efficient array redistribution through portable collective
+communication", PAPERS.md): the payload is written as the *source
+sharding's* slices, and a restore reads only the slices that intersect
+the *target sharding's* shards.
+
+Write side (:func:`write_shards`): each leaf is consumed shard-by-shard
+via ``jax.Array.addressable_shards`` — replicas deduplicated, the ZeRO-1
+/ arena padding clipped off per slice — and appended to one flat
+``shards.bin``.  No full-leaf host gather happens for sharded leaves.
+The returned manifest records, per leaf, the dtype, the *unpadded*
+logical shape, and per slice the N-d box (``[start, stop)`` per dim),
+the byte extent into ``shards.bin``, and a CRC32.
+
+Read side (:class:`ShardReader`): ``read(key, box)`` assembles exactly
+the requested box from the slices that intersect it, verifying each
+slice's CRC as it is read (under the ``ckpt.read`` chaos seam — kind
+``torn`` truncates the read so the CRC detector must catch it).  When
+source and target shardings overlap, a target shard maps onto few
+source slices and the restore is all-gather-free: no rank ever
+materializes a full leaf it doesn't hold.  :func:`plan_bytes` computes
+the same intersection from the manifest alone, which is what lets
+``tools/chaos_smoke.py`` assert "per-rank restore reads strictly fewer
+bytes than full-leaf reads" without instrumenting the reader.
+
+Slices partition each leaf's unpadded box exactly (disjoint cover), so
+resharding is lossless: a dp 8 -> 4 -> 8 roundtrip is bit-identical.
+Layout / lifecycle: docs/resilience.md "Manifest v2 + resharding".
+"""
+from __future__ import annotations
+
+import os
+import time as _time
+import zlib
+from typing import (Any, Dict, Iterable, List, NamedTuple, Optional,
+                    Sequence, Tuple)
+
+from .. import telemetry as _tel
+from ..base import MXNetError, get_env
+from . import chaos as _chaos
+
+__all__ = ["SHARDS_NAME", "SliceRec", "LeafRec", "write_shards",
+           "leaves_from_json", "ShardReader", "plan_bytes", "full_bytes",
+           "box_of", "clip_box", "intersect_box"]
+
+SHARDS_NAME = "shards.bin"
+
+#: an N-d box: ``((start, stop), ...)`` per dim, in leaf-logical coords
+Box = Tuple[Tuple[int, int], ...]
+
+
+class SliceRec(NamedTuple):
+    """One contiguous slice of a leaf inside ``shards.bin``."""
+
+    box: Box
+    offset: int
+    nbytes: int
+    crc32: int
+
+
+class LeafRec(NamedTuple):
+    """One checkpointed leaf: unpadded logical shape + its slices."""
+
+    key: str
+    dtype: str
+    shape: Tuple[int, ...]
+    slices: Tuple[SliceRec, ...]
+
+
+# -- box algebra --------------------------------------------------------------
+
+def box_of(index, shape: Sequence[int]) -> Box:
+    """Normalize a ``devices_indices_map`` index (tuple of slices, Nones
+    for unsliced dims) into a concrete box over ``shape``."""
+    out = []
+    for k, d in enumerate(shape):
+        s = index[k] if k < len(index) else slice(None)
+        start, stop, step = s.indices(int(d))
+        if step != 1:
+            raise MXNetError(f"non-unit-stride shard index {s!r} is not "
+                             "resharding-compatible")
+        out.append((start, stop))
+    return tuple(out)
+
+
+def clip_box(box: Box, shape: Sequence[int]) -> Optional[Box]:
+    """Clip ``box`` to ``shape`` (the unpadded logical extent); None when
+    the box lies entirely inside the padding."""
+    out = []
+    for (a, b), d in zip(box, shape):
+        a, b = min(a, int(d)), min(b, int(d))
+        if a >= b:
+            return None
+        out.append((a, b))
+    return tuple(out)
+
+
+def intersect_box(a: Box, b: Box) -> Optional[Box]:
+    out = []
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return tuple(out)
+
+
+def _box_shape(box: Box) -> Tuple[int, ...]:
+    return tuple(b - a for a, b in box)
+
+
+def _volume(box: Box) -> int:
+    n = 1
+    for a, b in box:
+        n *= b - a
+    return n
+
+
+def _rel_slices(outer: Box, inner: Box) -> Tuple[slice, ...]:
+    """``inner`` as index slices relative to ``outer``'s origin."""
+    return tuple(slice(i0 - o0, i1 - o0)
+                 for (o0, _), (i0, i1) in zip(outer, inner))
+
+
+# -- write side ---------------------------------------------------------------
+
+def _shard_boxes(value, clip_shape: Sequence[int]):
+    """Unique (box, host_data) pairs covering ``value``'s unpadded
+    extent, one per distinct device shard (replicas deduplicated), each
+    clipped to ``clip_shape``.  Host values (plain numpy) yield one box.
+    """
+    import numpy as onp
+
+    shards = getattr(value, "addressable_shards", None)
+    if shards is None:
+        arr = onp.asarray(value)
+        box = clip_box(tuple((0, d) for d in arr.shape), clip_shape)
+        return [] if box is None else \
+            [(box, arr[tuple(slice(a, b) for a, b in box)])]
+    shape = tuple(value.shape)
+    seen: Dict[Box, Any] = {}
+    for sh in shards:
+        gbox = box_of(sh.index, shape)
+        if gbox in seen:
+            continue
+        seen[gbox] = sh
+    out = []
+    for gbox in sorted(seen):
+        cbox = clip_box(gbox, clip_shape)
+        if cbox is None:
+            continue  # the slice is pure zero1/arena padding
+        local = onp.asarray(seen[gbox].data)
+        out.append((cbox, local[_rel_slices(gbox, cbox)]))
+    return out
+
+
+def write_shards(dirpath: str,
+                 leaves: Iterable[Tuple[str, Any, Optional[Sequence[int]]]]
+                 ) -> List[dict]:
+    """Write ``shards.bin`` under ``dirpath`` from ``(key, value,
+    clip_shape)`` triples (``clip_shape`` None keeps the full shape; a
+    smaller shape strips shard padding).  Returns the JSON-able manifest
+    ``leaves`` list.  Caller owns durability of the enclosing directory
+    (CheckpointManager's tmpdir commit protocol); the file itself is
+    fsynced here."""
+    import numpy as onp
+
+    recs: List[dict] = []
+    path = os.path.join(dirpath, SHARDS_NAME)
+    off = 0
+    with open(path, "wb") as f:
+        for key, value, clip_shape in leaves:
+            shape = tuple(int(d) for d in
+                          (clip_shape if clip_shape is not None
+                           else value.shape))
+            dt = onp.dtype(getattr(value, "dtype", None) or "float32")
+            slices = []
+            for box, data in _shard_boxes(value, shape):
+                raw = onp.ascontiguousarray(data).tobytes()
+                f.write(raw)
+                slices.append({"box": [list(p) for p in box],
+                               "offset": off, "bytes": len(raw),
+                               "crc32": zlib.crc32(raw) & 0xFFFFFFFF})
+                off += len(raw)
+            recs.append({"key": key, "dtype": dt.name,
+                         "shape": list(shape), "slices": slices})
+        f.flush()
+        os.fsync(f.fileno())
+    return recs
+
+
+def leaves_from_json(doc: Sequence[dict]) -> List[LeafRec]:
+    out = []
+    try:
+        for rec in doc:
+            slices = tuple(
+                SliceRec(tuple((int(a), int(b)) for a, b in s["box"]),
+                         int(s["offset"]), int(s["bytes"]),
+                         int(s["crc32"]))
+                for s in rec["slices"])
+            out.append(LeafRec(rec["key"], rec["dtype"],
+                               tuple(int(d) for d in rec["shape"]),
+                               slices))
+    except (KeyError, TypeError, ValueError) as e:
+        raise MXNetError(f"malformed manifest v2 'leaves' section: {e}") \
+            from e
+    return out
+
+
+# -- accounting (manifest-only, no reads) -------------------------------------
+
+def full_bytes(leaf: LeafRec) -> int:
+    """Bytes a full-leaf read of ``leaf`` would cost."""
+    return sum(s.nbytes for s in leaf.slices)
+
+
+def plan_bytes(leaf: LeafRec, boxes: Sequence[Box]) -> int:
+    """Bytes a reader needs to cover ``boxes`` of ``leaf``: the summed
+    extents of the source slices intersecting any requested box, each
+    slice counted once (the reader caches slices the same way)."""
+    total = 0
+    for s in leaf.slices:
+        if any(intersect_box(s.box, b) is not None for b in boxes):
+            total += s.nbytes
+    return total
+
+
+# -- read side ----------------------------------------------------------------
+
+class ShardReader:
+    """Slice-wise reader over one checkpoint version's ``shards.bin``.
+
+    ``read(key, box)`` returns exactly the requested box, touching only
+    the intersecting slices; each slice is CRC-verified on first read
+    (then cached — a slice shared by two target shards is read and
+    counted once).  ``bytes_read`` is the deduplicated byte total, the
+    number the manifest-accounting assertion in ``tools/chaos_smoke.py``
+    cross-checks against :func:`plan_bytes`.
+
+    Chaos: every slice read crosses the ``ckpt.read`` seam — ``error``
+    raises :class:`~.chaos.ChaosError`, ``delay`` sleeps, ``torn``
+    truncates the read buffer so the per-slice CRC MUST catch it (the
+    storage-lied-on-read case, mirroring ``ckpt.write``'s torn)."""
+
+    def __init__(self, dirpath: str, leaves: Sequence[LeafRec]):
+        self.path = os.path.join(dirpath, SHARDS_NAME)
+        self.leaves = {leaf.key: leaf for leaf in leaves}
+        self.bytes_read = 0
+        self._f = None
+        self._cache: Dict[Tuple[str, int], Any] = {}
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def _read_slice(self, leaf: LeafRec, s: SliceRec):
+        import numpy as onp
+
+        ck = (leaf.key, s.offset)
+        hit = self._cache.get(ck)
+        if hit is not None:
+            return hit
+        if self._f is None:
+            self._f = open(self.path, "rb")
+        self._f.seek(s.offset)
+        raw = self._f.read(s.nbytes)
+        if _chaos.active():
+            kind = _chaos.draw("ckpt.read")
+            if kind == "delay":
+                _time.sleep(get_env("MXNET_FAULT_DELAY", 0.05, float))
+            elif kind == "torn":
+                raw = raw[:max(0, len(raw) // 2)]
+            elif kind is not None:
+                raise _chaos.ChaosError(
+                    f"injected fault at 'ckpt.read' (slice {leaf.key}@"
+                    f"{s.offset})")
+        if len(raw) != s.nbytes or \
+                zlib.crc32(raw) & 0xFFFFFFFF != s.crc32:
+            raise MXNetError(
+                f"checkpoint slice {leaf.key}@{s.offset} failed its CRC "
+                f"({len(raw)}/{s.nbytes} bytes read): torn or corrupt "
+                "shards.bin — restore_latest falls back to an older "
+                "version")
+        arr = onp.frombuffer(raw, dtype=leaf.dtype).reshape(
+            _box_shape(s.box))
+        self._cache[ck] = arr
+        self.bytes_read += s.nbytes
+        if _tel._ENABLED:
+            _tel.inc("ckpt.restore_bytes", s.nbytes)
+        return arr
+
+    def read(self, key: str, box: Optional[Box] = None):
+        """Assemble ``box`` of leaf ``key`` (default: the whole leaf)
+        from its intersecting slices."""
+        import numpy as onp
+
+        leaf = self.leaves.get(key)
+        if leaf is None:
+            raise MXNetError(f"checkpoint has no leaf {key!r}")
+        if box is None:
+            box = tuple((0, d) for d in leaf.shape)
+        out = onp.zeros(_box_shape(box), dtype=leaf.dtype)
+        covered = 0
+        for s in leaf.slices:
+            inter = intersect_box(s.box, box)
+            if inter is None:
+                continue
+            data = self._read_slice(leaf, s)
+            out[_rel_slices(box, inter)] = data[_rel_slices(s.box, inter)]
+            covered += _volume(inter)
+        if covered != _volume(box):
+            raise MXNetError(
+                f"checkpoint leaf {key!r}: slices cover {covered} of "
+                f"{_volume(box)} requested elements (box {box}) — "
+                "manifest does not partition the leaf")
+        return out

@@ -16,11 +16,20 @@ faults fired:
                 newest intact version, and the resumed run must
                 reproduce the uninterrupted run's final parameters
                 BIT-FOR-BIT.
+  topology      ``dist.heartbeat`` — elastic reshape-resume
+                (docs/resilience.md "Manifest v2 + resharding"): a
+                zero1 run on an 8-device mesh loses a heartbeat
+                mid-run, checkpoints, and migrates onto 4 devices; the
+                shrunken run's trajectory must match the uninterrupted
+                8-device run (per-param AND flat-arena adapters), and
+                the manifest accounting must prove the worst rank read
+                STRICTLY fewer bytes than full-leaf reads.
 
 FAILS (exit 1) unless every injected fault fired (telemetry
 ``chaos.injected.*``), the torn version was skipped
-(``ckpt.corrupt_skipped``), a restore happened (``ckpt.restores``), and
-the resumed params match the reference run exactly.  Companion gate to
+(``ckpt.corrupt_skipped``), a restore happened (``ckpt.restores``), the
+resumed params match the reference run exactly, and both reshape-resume
+sub-cases held (trajectory + byte accounting).  Companion gate to
 tools/telemetry_smoke.py and tools/pipeline_smoke.py.
 """
 from __future__ import annotations
@@ -30,6 +39,9 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the reshape-resume case shrinks an 8-device host mesh to 4
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
 # the whole loop runs under a fault spec, tools/launch.py-style; phases
 # reconfigure via chaos.configure() to sequence the injections
 os.environ.setdefault(
@@ -73,6 +85,95 @@ def _batch(step):
     rs = onp.random.RandomState(1000 + step)
     return (rs.rand(BATCH, 1, 28, 28).astype("float32"),
             rs.randint(0, 10, size=(BATCH,)).astype("int32"))
+
+
+def _build_mlp(ndev, fused=None):
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    def ce(pred, y):
+        logp = jax.nn.log_softmax(pred.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    # 100x30: zero1 pads axis0 100->104 on dp8 (13-row slices) but picks
+    # 25-row windows on dp4 — the reshard is a genuine re-slice
+    net.add(mx.gluon.nn.Dense(100, in_units=30), mx.gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((2, 30)))
+    return ShardedTrainer(net, ce,
+                          mesh=make_mesh({"dp": -1},
+                                         devices=jax.devices()[:ndev]),
+                          optimizer="adam", learning_rate=1e-3,
+                          partition="zero1", fused_opt=fused)
+
+
+def _mlp_batch(step):
+    import numpy as onp
+
+    rs = onp.random.RandomState(2000 + step)
+    return (rs.rand(8, 30).astype("float32"),
+            rs.randint(0, 10, size=(8,)).astype("int32"))
+
+
+def _reshape_resume(checks, label, fused, kmode):
+    """Train zero1 on dp8, fail a heartbeat at step 4, migrate to dp4,
+    finish; assert trajectory parity with the uninterrupted dp8 run and
+    the manifest-accounting byte win."""
+    import tempfile
+
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.kernels import registry as kreg
+    from mxnet_tpu.parallel.preemption import PreemptionGuard
+    from mxnet_tpu.resilience import CheckpointManager, chaos
+
+    with kreg.override(kmode):
+        ref = _build_mlp(8, fused)
+        ref_losses = [float(ref.step(*_mlp_batch(s))) for s in range(1, 9)]
+        ref.drain()
+        ref_params = [onp.asarray(v) for v in ref.pvals]
+
+        ckdir = tempfile.mkdtemp(prefix=f"mx-chaos-reshape-{label}-")
+        vic = _build_mlp(8, fused)
+        mgr = CheckpointManager(ckdir, vic, keep=3)
+        guard = PreemptionGuard(
+            vic, manager=mgr, heartbeat_every=1,
+            rebuild=lambda devs: _build_mlp(len(devs), fused))
+        chaos.configure("dist.heartbeat:error:1.0:3")  # fires at step 4
+        losses, s, stats = [], 1, None
+        while s <= 8:
+            losses.append(float(guard.trainer.step(*_mlp_batch(s))))
+            s += 1
+            if guard.step():
+                chaos.reset()
+                guard.migrate(devices=jax.devices()[:4])
+                stats = guard.trainer.last_restore_stats
+        guard.restore()
+        guard.trainer.drain()
+        checks[f"reshape.{label}.migrated"] = stats is not None
+        checks[f"reshape.{label}.losses_match"] = bool(
+            onp.allclose(ref_losses, losses, rtol=1e-5, atol=1e-6))
+        checks[f"reshape.{label}.params_match"] = bool(all(
+            onp.allclose(a, onp.asarray(b), rtol=1e-5, atol=1e-6)
+            for a, b in zip(ref_params, guard.trainer.pvals)))
+        # the elastic-topology acceptance number: the worst rank's
+        # restore reads STRICTLY fewer bytes than full-leaf reads,
+        # straight from manifest accounting (reshard.plan_bytes)
+        checks[f"reshape.{label}.rank_read_lt_full"] = bool(
+            stats and
+            0 < stats["sharded_max_rank_bytes"] < stats["sharded_full_bytes"])
+        checks[f"reshape.{label}.restore_stats"] = stats
+    return (checks[f"reshape.{label}.migrated"]
+            and checks[f"reshape.{label}.losses_match"]
+            and checks[f"reshape.{label}.params_match"]
+            and checks[f"reshape.{label}.rank_read_lt_full"])
 
 
 def main() -> int:
@@ -157,6 +258,10 @@ def main() -> int:
             onp.array_equal(a, onp.asarray(b))
             for a, b in zip(ref_params, survivor.pvals))
 
+    # -- elastic topology: heartbeat loss -> shrink 8 -> 4 and resume -------
+    reshape_ok = (_reshape_resume(checks, "per_param", None, "off")
+                  and _reshape_resume(checks, "arena", "arena", "interpret"))
+
     snap = telemetry.snapshot()
 
     def count(name):
@@ -168,20 +273,29 @@ def main() -> int:
     checks["chaos.injected.dataloader.getitem"] = count(
         "chaos.injected.dataloader.getitem")
     checks["chaos.injected.ckpt.write"] = count("chaos.injected.ckpt.write")
+    checks["chaos.injected.dist.heartbeat"] = count(
+        "chaos.injected.dist.heartbeat")
     checks["ckpt.corrupt_skipped"] = count("ckpt.corrupt_skipped")
     checks["ckpt.restores"] = count("ckpt.restores")
     checks["ckpt.saves"] = count("ckpt.saves")
+    checks["resilience.mesh_shrinks"] = count("resilience.mesh_shrinks")
+    checks["resilience.reshards"] = count("resilience.reshards")
+    checks["ckpt.restore_bytes"] = count("ckpt.restore_bytes")
 
     ok = (checks["barrier_fault_raised"]
           and checks["dataloader_fault_raised"]
           and checks["dataloader_recovered"]
           and checks["torn_version_skipped"]
           and checks["bit_for_bit_resume"]
+          and reshape_ok
           and checks["chaos.injected.dist.barrier"] >= 1
           and checks["chaos.injected.dataloader.getitem"] >= 1
           and checks["chaos.injected.ckpt.write"] >= 1
+          and checks["chaos.injected.dist.heartbeat"] >= 2
           and checks["ckpt.corrupt_skipped"] >= 1
-          and checks["ckpt.restores"] >= 1)
+          and checks["ckpt.restores"] >= 1
+          and checks["resilience.mesh_shrinks"] >= 2
+          and checks["resilience.reshards"] >= 2)
 
     out_path = os.environ.get("MXNET_CHAOS_JSON") or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -203,6 +317,14 @@ def main() -> int:
           f"(restored step-{checks['restored_step']}, "
           f"corrupt_skipped {checks['ckpt.corrupt_skipped']})")
     print(f"  bit-for-bit resume            {checks['bit_for_bit_resume']}")
+    for lbl in ("per_param", "arena"):
+        st = checks.get(f"reshape.{lbl}.restore_stats") or {}
+        print(f"  reshape 8->4 resume [{lbl}]  "
+              f"losses {checks[f'reshape.{lbl}.losses_match']}, "
+              f"params {checks[f'reshape.{lbl}.params_match']}, "
+              f"max-rank {st.get('sharded_max_rank_bytes')} B < "
+              f"full {st.get('sharded_full_bytes')} B: "
+              f"{checks[f'reshape.{lbl}.rank_read_lt_full']}")
     if not ok:
         print("chaos-smoke: FAILED — a recovery path regressed "
               "(docs/resilience.md)", file=sys.stderr)
