@@ -17,8 +17,8 @@ BUILD    := build
 
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
-	trace-smoke kernels-smoke serve-smoke decode-smoke lint-hybrid \
-	lint-graph ci clean
+	trace-smoke kernels-smoke serve-smoke decode-smoke obs-smoke \
+	lint-hybrid lint-graph ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -153,6 +153,18 @@ decode-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		python tools/decode_smoke.py
 
+obs-smoke:
+	# mx.obs gate: LeNet served with the metrics endpoint armed — a
+	# second thread scraping /metrics + /statusz mid-load gets all
+	# 200s, the windowed histogram count equals the telemetry timer
+	# count at quiesce, obs-on overhead <= 5% vs MXNET_OBS=0
+	# (min-of-3 alternated), and two real worker processes aggregate
+	# into one fleet view with EXACT merged counts + a dead URL only
+	# flagged, never raised (docs/obs.md).  Serial — single-core box,
+	# never concurrent with tier-1.
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		MXNET_OBS=1 python tools/obs_smoke.py
+
 lint-hybrid:
 	# hybridize-safety static analysis (docs/analysis.md). The committed
 	# baseline makes legacy suppressions explicit; NEW violations fail.
@@ -175,7 +187,7 @@ lint-graph:
 
 ci: native native-test asan tsan lint-hybrid lint-graph test test-slow \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
-	trace-smoke kernels-smoke serve-smoke decode-smoke
+	trace-smoke kernels-smoke serve-smoke decode-smoke obs-smoke
 
 clean:
 	rm -rf $(BUILD)

@@ -93,6 +93,7 @@ from . import parallel
 from . import jit
 from . import kernels
 from . import resilience
+from . import obs
 from . import test_utils
 
 init = initializer  # mx.init alias like reference

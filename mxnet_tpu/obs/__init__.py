@@ -1,0 +1,158 @@
+"""mx.obs — live metrics exposition, windowed SLO histograms, and
+fleet health aggregation (docs/obs.md).
+
+Until this layer, every metric in :mod:`mxnet_tpu.telemetry` was
+process-local and readable only via an in-process ``snapshot()`` —
+useless to a router balancing replicas or an autoscaler draining a
+wedged worker.  mx.obs makes the registry *live, mergeable, and
+time-windowed*:
+
+* **windowed histograms** (:mod:`.histogram`) — fixed exponential
+  bucket grid shared fleet-wide, sliding-window p50/p99/p99.9 that
+  ages warmup out; auto-attached to the hot timers
+  (``serve.e2e_seconds``, ``serve.decode_step_seconds``,
+  ``trainer.step_seconds``, ``dataloader.wait_seconds``);
+* **exposition** (:mod:`.http`) — :func:`serve_metrics` starts a
+  stdlib HTTP endpoint: ``/metrics`` (Prometheus text), ``/healthz``,
+  ``/readyz`` (warmup done + dispatcher alive + heartbeat fresh + not
+  wedged), ``/statusz`` (JSON ops snapshot);
+* **SLOs** (:mod:`.slo`) — :func:`slo` declares windowed p99/error-
+  rate objectives with burn-rate counters
+  (``obs.slo_breaches.<name>``) and trace instants on breach;
+* **fleet aggregation** (:mod:`.aggregate`) — :func:`aggregate`
+  scrapes N workers and merges histograms/counters exactly (fixed
+  buckets), flagging dead workers instead of raising — the router
+  input ROADMAP item 1 consumes.
+
+Single-flag disable, matching the ``MXNET_TELEMETRY``/``MXNET_TRACE``
+convention: ``MXNET_OBS=0`` makes every entry point inert — no
+histogram attaches, no socket binds, no thread starts (gated in
+tests/test_obs.py).  ``MXNET_OBS_PORT=<port>`` starts the endpoint at
+import with zero code changes (bind failures warn — forked workers
+racing for one port must not kill training).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence
+
+from .. import telemetry as _tel
+from ..base import get_env
+from . import histogram as _histmod
+from .aggregate import FleetView, WorkerScrape, aggregate
+from .histogram import GRID, WindowedHistogram, histogram
+from .prom import parse as parse_prometheus
+from .prom import render as render_prometheus
+from .slo import SLO, evaluate_all, slo, slos
+
+__all__ = ["enabled", "serve_metrics", "stop_metrics", "metrics_server",
+           "slo", "slos", "evaluate_all", "SLO", "aggregate",
+           "FleetView", "WorkerScrape", "histogram", "WindowedHistogram",
+           "GRID", "watch_timer", "set_enabled", "render_prometheus",
+           "parse_prometheus", "HOT_TIMERS"]
+
+log = logging.getLogger(__name__)
+
+# One flag, read once at import (same contract as telemetry._ENABLED):
+# disabled mode must add zero threads, zero sockets, zero per-event work
+_ENABLED: bool = bool(get_env("MXNET_OBS", 1, int))
+
+# The timers that get a windowed histogram by default — the serving/
+# training hot paths the router, the SLO layer, and the dumps() tail
+# columns read (ISSUE 16 tentpole list; trainer.step's timer is named
+# trainer.step_seconds)
+HOT_TIMERS = ("serve.e2e_seconds", "serve.decode_step_seconds",
+              "trainer.step_seconds", "dataloader.wait_seconds")
+
+_SERVER = None
+_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    """Whether mx.obs is armed (``MXNET_OBS``)."""
+    return _ENABLED
+
+
+def watch_timer(timer_name: str, **kwargs) -> Optional[WindowedHistogram]:
+    """Attach a windowed histogram to telemetry timer ``timer_name``
+    (created on first use if needed); every ``observe`` then feeds
+    both.  Returns the histogram, or None under ``MXNET_OBS=0``."""
+    if not _ENABLED:
+        return None
+    from .slo import _attach
+
+    return _attach(timer_name, **kwargs)
+
+
+def _wire_hot_timers():
+    for name in HOT_TIMERS:
+        watch_timer(name)
+
+
+def _unwire_hot_timers():
+    for name in HOT_TIMERS:
+        _tel.unwatch_timer(name)
+
+
+def set_enabled(flag: bool) -> bool:
+    """Flip the obs layer at runtime (tests, the obs-smoke overhead
+    gate): detaches/re-attaches the hot-timer histograms.  Does NOT
+    start/stop a running metrics server — use :func:`serve_metrics` /
+    :func:`stop_metrics`.  Returns the previous state."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(flag)
+    if _ENABLED and not prev:
+        _wire_hot_timers()
+    elif prev and not _ENABLED:
+        _unwire_hot_timers()
+    return prev
+
+
+def serve_metrics(port: Optional[int] = None, host: Optional[str] = None):
+    """Start (or return the already-running) metrics endpoint.
+
+    ``port`` defaults to ``MXNET_OBS_PORT`` (0 = ephemeral; read
+    ``.port`` on the returned :class:`~mxnet_tpu.obs.http.MetricsServer`).
+    Under ``MXNET_OBS=0`` this is a no-op returning None — the single
+    flag guarantees zero new threads or sockets."""
+    global _SERVER
+    if not _ENABLED:
+        return None
+    from .http import MetricsServer
+
+    with _LOCK:
+        if _SERVER is not None:
+            return _SERVER
+        if port is None:
+            port = get_env("MXNET_OBS_PORT", 0, int)
+        _SERVER = MetricsServer(port, host=host)
+        return _SERVER
+
+
+def metrics_server():
+    """The running :class:`MetricsServer`, or None (never starts
+    one)."""
+    return _SERVER
+
+
+def stop_metrics(timeout: float = 5.0):
+    """Stop the metrics endpoint if one is running (idempotent)."""
+    global _SERVER
+    with _LOCK:
+        srv, _SERVER = _SERVER, None
+    if srv is not None:
+        srv.close(timeout)
+
+
+# -- import-time arming -------------------------------------------------------
+if _ENABLED:
+    _wire_hot_timers()
+    if get_env("MXNET_OBS_PORT", None, int) is not None:
+        try:
+            serve_metrics()
+        except OSError as e:
+            # a forked/spawned worker inheriting MXNET_OBS_PORT loses
+            # the bind race — observability must never kill the job
+            log.warning("mx.obs: could not bind MXNET_OBS_PORT: %s", e)

@@ -1,0 +1,197 @@
+"""Fleet aggregation — scrape N workers, merge into one view
+(docs/obs.md).
+
+``mx.obs.aggregate([url, ...])`` GETs each worker's ``/metrics``
+(stdlib urllib, bounded by ``timeout``), parses the text (prom.parse)
+and merges:
+
+* counters (and timer ``_count``/``_sum`` pairs) **sum** — fleet
+  totals;
+* histograms merge **exactly** — every worker runs the same fixed
+  bucket grid (histogram.GRID), so bucket counts add and fleet
+  percentiles carry the same error bound as one worker's (a worker on
+  a different grid is refused, not interpolated);
+* gauges keep **per-worker values** plus a summed fleet value — the
+  router balances on the per-worker ``serve.queue_depth`` /
+  ``serve.decode_slots_active`` columns (ROADMAP item 1), the sum is
+  the fleet load; each gauge also carries its worker's
+  ``last_update_ts`` so a wedged worker's frozen gauge is flagged
+  ``stale`` rather than trusted.
+
+Failure containment: a dead/unreachable/slow worker NEVER fails the
+aggregate — its row is marked ``ok=False`` with the error string and
+the merged view covers the survivors (``partial=True``).  The scrape
+seam is chaos-injectable (site ``obs.scrape``: ``error`` = unreachable
+worker, ``delay`` = slow worker) so that path is testable without
+killing real processes.
+"""
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+
+from .. import telemetry as _tel
+from ..base import MXNetError, get_env
+from ..resilience import chaos as _chaos
+from . import prom as _prom
+# direct-name import: the package rebinds ``obs.histogram`` to the
+# registry function (public API), so ``from . import histogram`` after
+# package init would see the function, not the module
+from .histogram import WindowedHistogram as _WindowedHistogram
+
+__all__ = ["WorkerScrape", "FleetView", "scrape_worker", "aggregate"]
+
+
+class WorkerScrape:
+    """One worker's scrape outcome: parsed metrics or the error."""
+
+    __slots__ = ("url", "ok", "error", "parsed", "elapsed")
+
+    def __init__(self, url: str, ok: bool,
+                 parsed: Optional[_prom.ParsedScrape] = None,
+                 error: Optional[str] = None, elapsed: float = 0.0):
+        self.url = url
+        self.ok = ok
+        self.parsed = parsed
+        self.error = error
+        self.elapsed = elapsed
+
+
+def scrape_worker(url: str, timeout: float) -> WorkerScrape:
+    """GET ``<url>/metrics`` and parse it; failures return a dead row,
+    they never raise (chaos site ``obs.scrape`` fires per worker)."""
+    target = url.rstrip("/")
+    if not target.endswith("/metrics"):
+        target += "/metrics"
+    t0 = time.perf_counter()
+    try:
+        if _chaos._ACTIVE:
+            _chaos.maybe_fail("obs.scrape")
+        with urllib.request.urlopen(target, timeout=timeout) as resp:
+            text = resp.read().decode("utf-8", "replace")
+        return WorkerScrape(url, True, parsed=_prom.parse(text),
+                            elapsed=time.perf_counter() - t0)
+    except Exception as e:  # noqa: BLE001 — a dead worker is DATA
+        # (the partial fleet view), not an aggregator failure
+        return WorkerScrape(url, False,
+                            error=f"{type(e).__name__}: {e}",
+                            elapsed=time.perf_counter() - t0)
+
+
+class FleetView:
+    """Merged fleet metrics + per-worker rows (module docstring)."""
+
+    def __init__(self, workers: List[WorkerScrape],
+                 stale_after: float):
+        self.workers = workers
+        self.partial = any(not w.ok for w in workers)
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, dict] = {}
+        self._hists: Dict[str, _WindowedHistogram] = {}
+        now = time.time()
+        for w in workers:
+            if not w.ok:
+                continue
+            p = w.parsed
+            for name, value in p.values.items():
+                if p.types.get(name) == "gauge":
+                    g = self.gauges.setdefault(
+                        name, {"sum": 0.0, "workers": {}})
+                    g["sum"] += value
+                    g["workers"][w.url] = {"value": value}
+                else:
+                    # counters + timer _count/_sum pairs: fleet total
+                    self.counters[name] = \
+                        self.counters.get(name, 0.0) + value
+            # gauge staleness from the shared last_update_ts series
+            for labels, ts in p.labeled.get("mx_gauge_last_update_ts",
+                                            []):
+                pn = _prom.sanitize(labels.get("name", ""))
+                g = self.gauges.get(pn)
+                if g is None or ts <= 0:
+                    continue
+                age = now - ts
+                g["workers"].setdefault(w.url, {})["age_secs"] = \
+                    round(age, 3)
+                if age > stale_after:
+                    g["workers"][w.url]["stale"] = True
+                    g["stale"] = True
+            for base in p.hists:
+                h = self._hists.get(base)
+                if h is None:
+                    h = self._hists[base] = _WindowedHistogram(
+                        base, window_secs=1.0, subwindows=1)
+                h.merge_counts(p.hist_counts(base),
+                               p.hists[base]["sum"])
+
+    @property
+    def ok_workers(self) -> List[str]:
+        return [w.url for w in self.workers if w.ok]
+
+    @property
+    def dead_workers(self) -> Dict[str, str]:
+        return {w.url: w.error for w in self.workers if not w.ok}
+
+    def histogram(self, name: str) -> _WindowedHistogram:
+        """The merged histogram for telemetry name or Prometheus series
+        name; percentiles read the merged LIFETIME counts."""
+        h = self._hists.get(name) or self._hists.get(
+            _prom.sanitize(name))
+        if h is None:
+            raise MXNetError(
+                f"obs.aggregate: no histogram {name!r} in the fleet "
+                f"view (have {sorted(self._hists)})")
+        return h
+
+    def percentile(self, name: str, q: float) -> float:
+        return self.histogram(name).percentile(q, windowed=False)
+
+    def counter(self, name: str) -> float:
+        """Fleet-summed counter by telemetry or Prometheus name."""
+        return self.counters.get(name,
+                                 self.counters.get(_prom.sanitize(name),
+                                                   0.0))
+
+    def gauge(self, name: str) -> dict:
+        """Per-worker + summed gauge row by telemetry or Prometheus
+        name (empty row when absent)."""
+        return self.gauges.get(name, self.gauges.get(
+            _prom.sanitize(name), {"sum": 0.0, "workers": {}}))
+
+    def to_dict(self) -> dict:
+        """JSON-able fleet document (the router input / smoke
+        artifact)."""
+        return {
+            "workers": [{"url": w.url, "ok": w.ok, "error": w.error,
+                         "elapsed_secs": round(w.elapsed, 4)}
+                        for w in self.workers],
+            "partial": self.partial,
+            "counters": {k: v for k, v in sorted(self.counters.items())},
+            "gauges": {k: v for k, v in sorted(self.gauges.items())},
+            "histograms": {
+                name: {"count": h.count, "sum": round(h.sum, 9),
+                       "p50": h.percentile(0.50, windowed=False),
+                       "p99": h.percentile(0.99, windowed=False),
+                       "p999": h.percentile(0.999, windowed=False)}
+                for name, h in sorted(self._hists.items())},
+        }
+
+
+def aggregate(urls: Sequence[str],
+              timeout: Optional[float] = None) -> FleetView:
+    """Scrape every worker endpoint and merge (module docstring).
+    Sequential on purpose: N is replica count (small), and the per-
+    worker ``timeout`` (``MXNET_OBS_SCRAPE_TIMEOUT``, 2s) bounds the
+    worst case at N×timeout — no thread pool to leak.  Never raises on
+    worker failure; the dead worker is flagged in the view."""
+    if timeout is None:
+        timeout = get_env("MXNET_OBS_SCRAPE_TIMEOUT", 2.0, float)
+    stale_after = get_env("MXNET_OBS_STALE_SECS", 300.0, float)
+    workers = [scrape_worker(u, timeout) for u in urls]
+    view = FleetView(workers, stale_after)
+    if _tel._ENABLED:
+        _tel.inc("obs.scrapes", len(workers))
+        _tel.inc("obs.scrape_failures", len(view.dead_workers))
+    return view

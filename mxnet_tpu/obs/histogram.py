@@ -1,0 +1,248 @@
+"""Windowed, mergeable latency histograms — the measurement core of
+mx.obs (docs/obs.md).
+
+Why not the Timer reservoir?  Two reasons the router/SLO layer cares
+about:
+
+* the reservoir is **sample-count**-windowed (last 1024 samples), so a
+  warmup burst pollutes p99 until enough later traffic pushes it out —
+  on a low-rate timer that is the whole run; and
+* reservoirs from two workers **cannot be merged** — percentile-of-
+  merged != merge-of-percentiles.
+
+A :class:`WindowedHistogram` fixes both with the classic fixed-bucket
+design (Prometheus/HDR lineage): every histogram in every process uses
+the SAME exponential bucket grid (:data:`GRID` — 10 buckets per decade
+from 1µs to 100s, +Inf overflow), so
+
+* merging is **exact** — bucket counts add; the fleet aggregator
+  (``mx.obs.aggregate``) sums scraped buckets and reads fleet-level
+  percentiles with the same error bound as a single worker's; and
+* percentiles are **time-windowed**: observations land in the current
+  sub-window of a ring (``window_secs`` split into ``subwindows``
+  slices); a quantile query sums the live sub-windows, so anything
+  older than the window — the warmup burst — has aged out.  Rotation
+  is lazy (done on observe/query), no timer thread.
+
+Resolution: a reported quantile is the **upper edge** of the bucket the
+rank lands in, so it over-reports by at most one bucket width — ≤26%
+relative with the 10-per-decade grid (10^0.1 ≈ 1.259).  That is the
+usual exposition trade: exact mergeability for bounded relative error.
+
+Lifetime bucket counts (never windowed, monotone) back the Prometheus
+``_bucket``/``_sum``/``_count`` series — cumulative counters by
+contract, windowing happens in PromQL via ``rate()``; the in-process
+sliding window exists so local consumers (SLO tracker, ``/statusz``,
+``telemetry.dumps`` tails) get steady-state percentiles without a
+query engine.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_left
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..base import get_env
+
+__all__ = ["GRID", "WindowedHistogram", "histogram", "histograms",
+           "reset"]
+
+# The one fleet-wide bucket grid: upper bucket edges (inclusive,
+# Prometheus `le` semantics), 10 per decade across 1e-6..1e2 seconds,
+# with an implicit +Inf overflow bucket.  Fixed by design — merge
+# exactness across processes depends on every worker using the same
+# edges (the aggregator refuses mismatched grids rather than
+# interpolate).
+GRID: Sequence[float] = tuple(10.0 ** (-6.0 + i / 10.0)
+                              for i in range(81))
+
+# `le` label strings, precomputed once so every process renders the
+# same text and the aggregator can key merges on the literal label
+LE_LABELS: Sequence[str] = tuple(f"{b:.6g}" for b in GRID) + ("+Inf",)
+
+
+def bucket_index(seconds: float) -> int:
+    """Index of the bucket ``seconds`` lands in (0..len(GRID); the last
+    index is the +Inf overflow).  ``le`` semantics: a value exactly on
+    an edge counts into that edge's bucket."""
+    if seconds <= GRID[0]:
+        return 0
+    return bisect_left(GRID, seconds, 1)
+
+
+class WindowedHistogram:
+    """Fixed-grid latency histogram with lifetime counts + a sliding
+    time window (module docstring).
+
+    ``clock`` is injectable for tests (defaults to ``time.monotonic``);
+    ``window_secs`` defaults to ``MXNET_OBS_WINDOW_SECS`` (60) split
+    into ``subwindows`` (6) ring slices, so the window advances in
+    10-second steps by default."""
+
+    __slots__ = ("name", "window_secs", "subwindows", "_sub_len",
+                 "_clock", "_life", "_life_sum", "_life_count",
+                 "_sub", "_sub_sum", "_sub_epoch", "_lock")
+
+    def __init__(self, name: str, window_secs: Optional[float] = None,
+                 subwindows: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if window_secs is None:
+            window_secs = get_env("MXNET_OBS_WINDOW_SECS", 60.0, float)
+        if subwindows is None:
+            subwindows = get_env("MXNET_OBS_SUBWINDOWS", 6, int)
+        if window_secs <= 0 or subwindows < 1:
+            from ..base import MXNetError
+            raise MXNetError(
+                f"obs: histogram {name!r} needs window_secs > 0 and "
+                f"subwindows >= 1 (got {window_secs}, {subwindows})")
+        self.name = name
+        self.window_secs = float(window_secs)
+        self.subwindows = int(subwindows)
+        self._sub_len = self.window_secs / self.subwindows
+        self._clock = clock
+        n = len(GRID) + 1
+        self._life = [0] * n
+        self._life_sum = 0.0
+        self._life_count = 0
+        self._sub: List[List[int]] = [[0] * n for _ in range(subwindows)]
+        self._sub_sum = [0.0] * subwindows
+        self._sub_epoch = [-1] * subwindows
+        self._lock = threading.Lock()
+
+    # -- recording --------------------------------------------------------
+    def observe(self, seconds: float):
+        idx = bucket_index(seconds)
+        epoch = int(self._clock() // self._sub_len)
+        slot = epoch % self.subwindows
+        with self._lock:
+            self._life[idx] += 1
+            self._life_sum += seconds
+            self._life_count += 1
+            if self._sub_epoch[slot] != epoch:
+                # lazy rotation: this ring slot last served an older
+                # sub-window — recycle it for the current one
+                self._sub[slot] = [0] * (len(GRID) + 1)
+                self._sub_sum[slot] = 0.0
+                self._sub_epoch[slot] = epoch
+            self._sub[slot][idx] += 1
+            self._sub_sum[slot] += seconds
+
+    # -- reading ----------------------------------------------------------
+    def _window_locked(self, now: float) -> List[int]:
+        epoch = int(now // self._sub_len)
+        lo = epoch - self.subwindows + 1
+        counts = [0] * (len(GRID) + 1)
+        for s in range(self.subwindows):
+            e = self._sub_epoch[s]
+            if lo <= e <= epoch:
+                sub = self._sub[s]
+                for i, c in enumerate(sub):
+                    if c:
+                        counts[i] += c
+        return counts
+
+    def window_counts(self) -> List[int]:
+        """Per-bucket counts over the live sliding window."""
+        with self._lock:
+            return self._window_locked(self._clock())
+
+    def lifetime_counts(self) -> List[int]:
+        """Per-bucket counts since construction (monotone; what the
+        Prometheus ``_bucket`` series cumulates)."""
+        with self._lock:
+            return list(self._life)
+
+    @property
+    def count(self) -> int:
+        return self._life_count
+
+    @property
+    def sum(self) -> float:
+        return self._life_sum
+
+    def percentile(self, q: float, windowed: bool = True) -> float:
+        """The q-quantile (0..1) as the upper edge of the bucket the
+        rank lands in (≤ one bucket width of over-report; the overflow
+        bucket reports the largest finite edge).  ``windowed=True``
+        reads the sliding window, else the lifetime counts.  0.0 when
+        empty."""
+        if not 0.0 <= q <= 1.0:
+            from ..base import MXNetError
+            raise MXNetError(
+                f"obs: percentile wants a quantile in [0, 1] (got {q!r}"
+                " — p99 is 0.99, not 99)")
+        counts = self.window_counts() if windowed \
+            else self.lifetime_counts()
+        total = sum(counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= rank and c:
+                return GRID[i] if i < len(GRID) else GRID[-1]
+        return GRID[-1]
+
+    def summary(self) -> dict:
+        """Structured snapshot: lifetime count/sum + windowed tails
+        (what ``/statusz`` and obs_smoke.json embed)."""
+        with self._lock:
+            now = self._clock()
+            win = self._window_locked(now)
+            life_count, life_sum = self._life_count, self._life_sum
+        wtotal = sum(win)
+        return {"type": "histogram", "count": life_count,
+                "sum": round(life_sum, 9),
+                "window_secs": self.window_secs,
+                "window_count": wtotal,
+                "p50_windowed": round(self.percentile(0.50), 9),
+                "p99_windowed": round(self.percentile(0.99), 9),
+                "p999_windowed": round(self.percentile(0.999), 9)}
+
+    def merge_counts(self, counts: Sequence[int], total_sum: float = 0.0):
+        """Fold another histogram's LIFETIME bucket counts in (exact —
+        same grid by construction).  Merged data lands in lifetime only;
+        windows are per-process facts and do not merge."""
+        from ..base import MXNetError
+
+        if len(counts) != len(GRID) + 1:
+            raise MXNetError(
+                f"obs: merge into {self.name!r} got {len(counts)} "
+                f"buckets, grid has {len(GRID) + 1}")
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._life[i] += int(c)
+            self._life_count += int(sum(counts))
+            self._life_sum += float(total_sum)
+
+
+# -- process-global registry (same shape as telemetry's) ----------------------
+
+_HISTS: Dict[str, WindowedHistogram] = {}
+_LOCK = threading.Lock()
+
+
+def histogram(name: str, **kwargs) -> WindowedHistogram:
+    """Get-or-create the named histogram (kwargs apply on creation
+    only)."""
+    h = _HISTS.get(name)
+    if h is None:
+        with _LOCK:
+            h = _HISTS.get(name)
+            if h is None:
+                h = _HISTS[name] = WindowedHistogram(name, **kwargs)
+    return h
+
+
+def histograms() -> Dict[str, WindowedHistogram]:
+    """Point-in-time copy of the histogram registry (sorted by name)."""
+    with _LOCK:
+        return dict(sorted(_HISTS.items()))
+
+
+def reset():
+    """Drop every histogram (tests)."""
+    with _LOCK:
+        _HISTS.clear()

@@ -1,0 +1,235 @@
+"""Stdlib HTTP exposition — ``/metrics``, ``/healthz``, ``/readyz``,
+``/statusz`` (docs/obs.md).
+
+One daemonized :class:`ThreadingHTTPServer` per process, started
+explicitly (``mx.obs.serve_metrics(port)``) or by ``MXNET_OBS_PORT``
+at import.  Handlers only READ: a telemetry snapshot (per-metric
+locks, held per metric for a dict copy), the histogram registry, and
+thread/registry liveness flags — no jit, no device work, no trace
+lock — so a scrape returns while a training step or a serve dispatch
+is mid-flight (tools/obs_smoke.py gates exactly that).
+
+Endpoints:
+
+* ``/metrics``  — Prometheus text format 0.0.4 (prom.render); also
+  evaluates declared SLOs so scrape cadence drives burn-rate counters.
+* ``/healthz``  — liveness: 200 ``ok`` if the handler thread can
+  answer at all.
+* ``/readyz``   — readiness: 200 only when (a) every registered serve
+  model's warmup grid is complete, (b) the serve dispatcher and every
+  decode loop thread are alive, (c) the last ``dist.heartbeat()``
+  outcome is healthy and fresh, and (d) the trace-flight hang watchdog
+  (when armed) does not currently see a stalled process.  503 with a
+  JSON body naming the failed checks otherwise — the router drains a
+  replica on exactly this signal (ROADMAP item 1).
+* ``/statusz``  — JSON operational snapshot: queue depth, decode slot
+  occupancy, inflight batches, compile-cache hits, registered models,
+  per-gauge staleness, SLO verdicts.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from .. import telemetry as _tel
+from ..base import get_env
+from . import prom as _prom
+# direct-name imports: the package rebinds ``obs.histogram``/``obs.slo``
+# to their registry functions (public API), so ``from . import X``
+# would see the function, not the module
+from .histogram import histograms as _histograms
+from .slo import evaluate_all as _evaluate_slos
+
+__all__ = ["MetricsServer", "readiness", "statusz_doc"]
+
+_START_TS = time.time()
+
+
+def _heartbeat_check() -> Tuple[bool, dict]:
+    """Healthy unless a probe FAILED more recently than it succeeded
+    (``dist.heartbeat_ok`` gauge: 1/0 per outcome) or the last success
+    is older than ``MXNET_OBS_HEARTBEAT_MAX_AGE`` seconds (0/unset =
+    no age bound).  A process that never probes — single-host training,
+    plain serving — stays ready."""
+    g = _tel.peek("dist.heartbeat_ok")
+    if not isinstance(g, _tel.Gauge) or g.last_update_ts == 0.0:
+        return True, {"probed": False}
+    age = time.time() - g.last_update_ts
+    detail = {"probed": True, "ok": g.value == 1,
+              "age_secs": round(age, 3)}
+    if g.value != 1:
+        return False, detail
+    max_age = get_env("MXNET_OBS_HEARTBEAT_MAX_AGE", 0.0, float)
+    if max_age > 0 and age > max_age:
+        detail["ok"] = False
+        detail["stale"] = True
+        return False, detail
+    return True, detail
+
+
+def readiness() -> Tuple[bool, dict]:
+    """The ``/readyz`` decision: (ready, per-check detail)."""
+    checks: dict = {}
+    # (a) warmup grids complete — a replica mid-background-warmup
+    # would serve its first requests through cold compiles
+    from ..serve import default_registry
+    from ..serve import decode as _decode
+
+    reg = default_registry()
+    pending = [n for n in reg.models()
+               if not reg.get(n).warmup_done()]
+    checks["warmup_complete"] = {"ok": not pending, "pending": pending}
+    # (b) dispatcher / decode loops alive (None server = never started
+    # = nothing to be dead)
+    from .. import serve as _serve
+
+    srv = _serve.current_server()
+    checks["dispatcher_alive"] = {
+        "ok": srv is None or srv.alive is not False,
+        "started": srv is not None}
+    dead_decode = [n for n, s in _decode.servers().items()
+                   if not s.alive]
+    checks["decode_loops_alive"] = {"ok": not dead_decode,
+                                    "dead": dead_decode}
+    # (c) heartbeat fresh
+    hb_ok, hb = _heartbeat_check()
+    checks["heartbeat"] = dict(hb, ok=hb_ok)
+    # (d) hang watchdog (trace/flight.py): armed + stalled = wedged
+    from ..trace import flight as _flight
+
+    stall = _flight.stall()
+    checks["not_wedged"] = {"ok": stall is None,
+                            "stalled_secs": stall and round(stall, 1)}
+    ready = all(c["ok"] for c in checks.values())
+    return ready, checks
+
+
+def statusz_doc() -> dict:
+    """The ``/statusz`` JSON document (also embedded in
+    obs_smoke.json)."""
+    snap = _tel.snapshot()
+
+    def val(name, default=0):
+        return snap.get(name, {}).get("value", default)
+
+    from ..serve import default_registry
+    from ..serve import decode as _decode
+
+    now = time.time()
+    stale_after = get_env("MXNET_OBS_STALE_SECS", 300.0, float)
+    gauges = {}
+    for name, s in snap.items():
+        if s.get("type") != "gauge":
+            continue
+        ts = s.get("last_update_ts", 0.0)
+        age = round(now - ts, 3) if ts else None
+        gauges[name] = {"value": s["value"], "age_secs": age,
+                        "stale": bool(ts) and age > stale_after}
+    ready, checks = readiness()
+    return {
+        "pid": os.getpid(),
+        "uptime_secs": round(now - _START_TS, 3),
+        "ready": ready,
+        "checks": checks,
+        "queue_depth": val("serve.queue_depth"),
+        "decode_slots_active": val("serve.decode_slots_active"),
+        "inflight_batches": val("serve.inflight_batches"),
+        "compile_cache": {
+            "misses": val("hybridize.cache_misses"),
+            "persistent_hits": val("hybridize.persistent_cache_hits"),
+            "warmup_compiles": val("hybridize.warmup_compiles"),
+        },
+        "models": {"serve": default_registry().models(),
+                   "decode": sorted(_decode.servers())},
+        "gauges": gauges,
+        "slos": _evaluate_slos(),
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # metrics scrapers poll every few seconds; stock BaseHTTPServer
+    # logging would flood stderr
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                _evaluate_slos()  # scrape cadence = burn-rate cadence
+                body = _prom.render(_tel.snapshot(),
+                                    _histograms()).encode()
+                self._send(200, body,
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                self._send(200, b"ok\n", "text/plain; charset=utf-8")
+            elif path == "/readyz":
+                ready, checks = readiness()
+                body = json.dumps({"ready": ready, "checks": checks},
+                                  indent=2, sort_keys=True).encode()
+                self._send(200 if ready else 503, body,
+                           "application/json")
+            elif path == "/statusz":
+                body = json.dumps(statusz_doc(), indent=2,
+                                  sort_keys=True).encode()
+                self._send(200, body, "application/json")
+            else:
+                self._send(404, b"not found\n",
+                           "text/plain; charset=utf-8")
+        except BrokenPipeError:
+            pass  # scraper hung up mid-response
+        except Exception as e:  # noqa: BLE001 — a rendering bug must
+            # answer 500, not kill the handler thread silently
+            try:
+                self._send(500, f"{type(e).__name__}: {e}\n".encode(),
+                           "text/plain; charset=utf-8")
+            except OSError:
+                pass
+
+
+class MetricsServer:
+    """The exposition server: a ``ThreadingHTTPServer`` on a daemon
+    thread.  ``port=0`` binds an ephemeral port (read ``.port``)."""
+
+    def __init__(self, port: int, host: Optional[str] = None):
+        self.host = host if host is not None else \
+            get_env("MXNET_OBS_HOST", "0.0.0.0")
+        self._httpd = ThreadingHTTPServer((self.host, int(port)),
+                                          _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="mx-obs-metrics",
+            kwargs={"poll_interval": 0.5}, daemon=True)
+        self._thread.start()
+        if _tel._ENABLED:
+            _tel.set_gauge("obs.metrics_port", self.port)
+
+    @property
+    def url(self) -> str:
+        host = "127.0.0.1" if self.host in ("0.0.0.0", "") else self.host
+        return f"http://{host}:{self.port}"
+
+    def close(self, timeout: float = 5.0):
+        """Stop serving and join the listener thread (idempotent)."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "MetricsServer":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

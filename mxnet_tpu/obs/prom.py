@@ -1,0 +1,225 @@
+"""Prometheus text exposition — render and parse (docs/obs.md).
+
+One module owns both directions so the aggregator parses exactly what
+the endpoint renders: ``render()`` turns a telemetry snapshot + the
+histogram registry into text-format 0.0.4 (the format every Prometheus
+scraper speaks), ``parse()`` turns scraped text back into a structured
+dict.  Stdlib only.
+
+Naming: telemetry metric names are dotted (``serve.e2e_seconds``);
+Prometheus names are ``[a-zA-Z_:][a-zA-Z0-9_:]*``, so every series is
+emitted as ``mx_<name with non-conforming chars -> _>`` with the
+ORIGINAL dotted name in the ``# HELP`` line.  Two dotted names that
+sanitize to the same series would silently merge — keep telemetry
+names in ``[a-z0-9._]`` (the existing catalog already is).
+
+Mapping:
+
+  =============  ==========================================================
+  Counter        ``mx_<name>`` (TYPE counter)
+  Gauge          ``mx_<name>`` (TYPE gauge) + one shared
+                 ``mx_gauge_last_update_ts{name="<dotted>"}`` series per
+                 gauge (unix seconds of the last write — the staleness
+                 signal; label values are escaped per the spec)
+  Timer          ``mx_<name>_count`` / ``mx_<name>_sum`` (TYPE counter
+                 pair — rate-able request/latency totals)
+  Histogram      ``mx_<name>_bucket{le="..."}`` cumulative lifetime
+                 counts over the fixed grid, ``mx_<name>_sum``,
+                 ``mx_<name>_count`` (TYPE histogram)
+  =============  ==========================================================
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..base import MXNetError
+from .histogram import LE_LABELS, WindowedHistogram
+
+__all__ = ["sanitize", "escape_label", "render", "parse", "ParsedScrape"]
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    """Dotted telemetry name → conforming Prometheus metric name."""
+    s = _NAME_OK.sub("_", name)
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return "mx_" + s
+
+
+def escape_label(value: str) -> str:
+    """Escape a label VALUE per the text-format spec: backslash, double
+    quote, and line feed."""
+    return value.replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _unescape_label(value: str) -> str:
+    out: List[str] = []
+    it = iter(value)
+    for ch in it:
+        if ch != "\\":
+            out.append(ch)
+            continue
+        nxt = next(it, "")
+        out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, "\\" + nxt))
+    return "".join(out)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        if v != v:
+            return "NaN"
+        return f"{v:.9g}"
+    return str(v)
+
+
+def render(snapshot: Dict[str, dict],
+           hists: Dict[str, WindowedHistogram],
+           extra_lines: Optional[List[str]] = None) -> str:
+    """Text-format 0.0.4 document from a ``telemetry.snapshot()`` and
+    the obs histogram registry.  A histogram whose name matches a timer
+    REPLACES that timer's ``_count``/``_sum`` pair (same events, richer
+    series — emitting both would double-name the data)."""
+    lines: List[str] = []
+    gauge_ts: List[Tuple[str, float]] = []
+    for name, s in sorted(snapshot.items()):
+        kind = s.get("type")
+        pn = sanitize(name)
+        if kind == "counter":
+            lines.append(f"# HELP {pn} telemetry counter {name}")
+            lines.append(f"# TYPE {pn} counter")
+            lines.append(f"{pn} {_fmt(s['value'])}")
+        elif kind == "gauge":
+            lines.append(f"# HELP {pn} telemetry gauge {name}")
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {_fmt(s['value'])}")
+            gauge_ts.append((name, float(s.get("last_update_ts", 0.0))))
+        elif kind == "timer" and name not in hists:
+            lines.append(f"# HELP {pn}_count telemetry timer {name} "
+                         "observations")
+            lines.append(f"# TYPE {pn}_count counter")
+            lines.append(f"{pn}_count {s['count']}")
+            lines.append(f"# TYPE {pn}_sum counter")
+            lines.append(f"{pn}_sum {_fmt(float(s['total']))}")
+    if gauge_ts:
+        lines.append("# HELP mx_gauge_last_update_ts unix time of each "
+                     "gauge's last write (0 = never; stale gauge = wedged "
+                     "worker, not idle)")
+        lines.append("# TYPE mx_gauge_last_update_ts gauge")
+        for name, ts in gauge_ts:
+            lines.append(f'mx_gauge_last_update_ts{{name="'
+                         f'{escape_label(name)}"}} {_fmt(ts)}')
+    for name, h in sorted(hists.items()):
+        pn = sanitize(name)
+        lines.append(f"# HELP {pn} windowed latency histogram {name} "
+                     "(seconds; fixed fleet grid, docs/obs.md)")
+        lines.append(f"# TYPE {pn} histogram")
+        counts = h.lifetime_counts()
+        acc = 0
+        for le, c in zip(LE_LABELS, counts):
+            acc += c
+            lines.append(f'{pn}_bucket{{le="{le}"}} {acc}')
+        lines.append(f"{pn}_sum {_fmt(h.sum)}")
+        lines.append(f"{pn}_count {h.count}")
+    if extra_lines:
+        lines.extend(extra_lines)
+    return "\n".join(lines) + "\n"
+
+
+# -- parsing ------------------------------------------------------------------
+
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)(?:\s+\d+)?$')
+_LABEL = re.compile(r'\s*(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)='
+                    r'"(?P<v>(?:[^"\\]|\\.)*)"\s*(?:,|$)')
+
+
+class ParsedScrape:
+    """One worker's parsed ``/metrics`` document.
+
+    * ``types``   — series name → declared TYPE (from ``# TYPE``).
+    * ``values``  — plain (label-less) series name → float.
+    * ``labeled`` — series name → list of (labels dict, float).
+    * ``hists``   — histogram base name → ``{"buckets": {le: cumulative
+      count}, "sum": float, "count": float}`` (cumulative, as exposed).
+    """
+
+    def __init__(self):
+        self.types: Dict[str, str] = {}
+        self.values: Dict[str, float] = {}
+        self.labeled: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+        self.hists: Dict[str, dict] = {}
+
+    def hist_counts(self, name: str) -> List[int]:
+        """Per-bucket (de-cumulated) counts for histogram ``name`` in
+        exposition order — what ``WindowedHistogram.merge_counts``
+        consumes.  Raises on a grid that is not the fleet grid."""
+        h = self.hists.get(name)
+        if h is None:
+            raise MXNetError(f"obs: no histogram {name!r} in scrape")
+        buckets = h["buckets"]
+        if tuple(buckets) != tuple(LE_LABELS):
+            raise MXNetError(
+                f"obs: histogram {name!r} uses a different bucket grid "
+                f"({len(buckets)} buckets vs {len(LE_LABELS)}) — merge "
+                "would be inexact; all workers must run the same grid")
+        out: List[int] = []
+        prev = 0.0
+        for le in LE_LABELS:
+            c = buckets[le]
+            if c < prev:
+                raise MXNetError(
+                    f"obs: histogram {name!r} bucket counts are not "
+                    "monotone — corrupt scrape")
+            out.append(int(c - prev))
+            prev = c
+        return out
+
+
+def parse(text: str) -> ParsedScrape:
+    """Parse a text-format document (tolerant: unknown/malformed lines
+    are skipped — scrapes must survive a worker mid-write)."""
+    out = ParsedScrape()
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                out.types[parts[2]] = parts[3].strip()
+            continue
+        m = _SAMPLE.match(line)
+        if not m:
+            continue
+        name, labels_s, val_s = m.group("name", "labels", "value")
+        try:
+            value = float(val_s)
+        except ValueError:
+            continue
+        labels: Dict[str, str] = {}
+        if labels_s:
+            for lm in _LABEL.finditer(labels_s):
+                labels[lm.group("k")] = _unescape_label(lm.group("v"))
+        if name.endswith("_bucket") and "le" in labels:
+            base = name[:-len("_bucket")]
+            h = out.hists.setdefault(base,
+                                     {"buckets": {}, "sum": 0.0,
+                                      "count": 0.0})
+            h["buckets"][labels["le"]] = value
+        elif labels:
+            out.labeled.setdefault(name, []).append((labels, value))
+        else:
+            out.values[name] = value
+    # attach _sum/_count to histograms (TYPE histogram declared)
+    for base, h in out.hists.items():
+        h["sum"] = out.values.pop(base + "_sum", 0.0)
+        h["count"] = out.values.pop(base + "_count", 0.0)
+    return out

@@ -1,0 +1,185 @@
+"""Sliding-window SLO objectives with burn-rate counters (docs/obs.md).
+
+An :class:`SLO` binds a name to up to two objectives:
+
+* **latency** — windowed p99 of a timer's histogram must stay under
+  ``p99_ms`` (the histogram is attached to the timer automatically, so
+  declaring the SLO is what arms the measurement); and
+* **error rate** — ``errors / total`` over the sliding window must stay
+  under ``error_rate``, computed from two telemetry counters (default
+  ``serve.errors`` / ``serve.requests``) by differencing counter values
+  sampled at each evaluation — the window is the evaluation history,
+  so the rate is "recent", not lifetime.
+
+Evaluation is pull-driven: every ``/metrics`` scrape and every
+``evaluate_all()`` call evaluates each SLO once.  A breaching
+evaluation ticks ``obs.slo_breaches`` + ``obs.slo_breaches.<name>`` —
+the *burn-rate* counters: their increase rate IS how fast the error
+budget burns, and the fleet aggregator sums them like any counter.  The
+ok→breach transition additionally records a trace instant
+(``obs.slo_breach``) so the timeline shows when the objective was
+first violated (and ``obs.slo_recovered`` when it heals).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+from .. import telemetry as _tel
+from ..base import MXNetError, get_env
+from ..trace import recorder as _tr
+# direct-name import: the package rebinds ``obs.histogram`` to the
+# registry FUNCTION (public API), so ``from . import histogram`` would
+# see the function, not the module
+from .histogram import WindowedHistogram as _WindowedHistogram
+from .histogram import histogram as _histogram
+
+__all__ = ["SLO", "slo", "slos", "evaluate_all", "reset"]
+
+
+class SLO:
+    """One named objective set (module docstring).  Construct via
+    :func:`mx.obs.slo`, not directly — the factory registers it and
+    respects the ``MXNET_OBS`` gate."""
+
+    def __init__(self, name: str, timer: Optional[str] = None,
+                 p99_ms: Optional[float] = None,
+                 error_rate: Optional[float] = None,
+                 error_counter: str = "serve.errors",
+                 total_counter: str = "serve.requests",
+                 window_secs: Optional[float] = None):
+        if p99_ms is None and error_rate is None:
+            raise MXNetError(
+                f"obs.slo({name!r}): at least one objective needed "
+                "(p99_ms=, error_rate=)")
+        if p99_ms is not None and timer is None:
+            raise MXNetError(
+                f"obs.slo({name!r}): a p99_ms objective needs timer= "
+                "(the telemetry timer whose windowed histogram it reads)")
+        self.name = name
+        self.timer = timer
+        self.p99_ms = p99_ms
+        self.error_rate = error_rate
+        self.error_counter = error_counter
+        self.total_counter = total_counter
+        self.window_secs = (get_env("MXNET_OBS_WINDOW_SECS", 60.0, float)
+                            if window_secs is None else float(window_secs))
+        self._hist: Optional[_WindowedHistogram] = None
+        if timer is not None:
+            self._hist = _attach(timer, window_secs=self.window_secs)
+        # (ts, errors, total) samples, one per evaluation, bounded by
+        # the window during evaluate
+        self._samples: Deque[Tuple[float, float, float]] = deque()
+        self._breached = False
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _counter_value(name: str) -> float:
+        m = _tel.peek(name)
+        return float(m.value) if isinstance(m, _tel.Counter) else 0.0
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        """One evaluation: read the windowed tail + windowed error
+        rate, compare to the objectives, tick burn counters on breach.
+        Returns the verdict dict (what ``/statusz`` embeds)."""
+        now = time.time() if now is None else now
+        verdict: dict = {"name": self.name, "ok": True}
+        if self.p99_ms is not None:
+            p99 = self._hist.percentile(0.99) * 1e3
+            verdict["p99_ms"] = round(p99, 6)
+            verdict["p99_target_ms"] = self.p99_ms
+            if p99 > self.p99_ms:
+                verdict["ok"] = False
+        if self.error_rate is not None:
+            errs = self._counter_value(self.error_counter)
+            total = self._counter_value(self.total_counter)
+            with self._lock:
+                self._samples.append((now, errs, total))
+                while len(self._samples) > 1 and \
+                        self._samples[0][0] < now - self.window_secs:
+                    self._samples.popleft()
+                t0, e0, n0 = self._samples[0]
+            d_err, d_tot = errs - e0, total - n0
+            rate = (d_err / d_tot) if d_tot > 0 else 0.0
+            verdict["error_rate"] = round(rate, 9)
+            verdict["error_rate_target"] = self.error_rate
+            if rate > self.error_rate:
+                verdict["ok"] = False
+        breached = not verdict["ok"]
+        if breached:
+            _tel.inc("obs.slo_breaches")
+            _tel.inc(f"obs.slo_breaches.{self.name}")
+        with self._lock:
+            transition = breached != self._breached
+            self._breached = breached
+        if transition:
+            _tr.instant("obs.slo_breach" if breached
+                        else "obs.slo_recovered", slo=self.name,
+                        **{k: v for k, v in verdict.items()
+                           if k not in ("name", "ok")})
+        verdict["breached"] = breached
+        return verdict
+
+
+class _NullSLO:
+    """Inert stand-in returned when MXNET_OBS=0 — callers keep working,
+    nothing is measured."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def evaluate(self, now=None) -> dict:
+        return {"name": self.name, "ok": True, "breached": False,
+                "disabled": True}
+
+
+def _attach(timer_name: str, **kwargs) -> _WindowedHistogram:
+    """Create (or reuse) the histogram named after ``timer_name`` and
+    watch the telemetry timer so every observe feeds it."""
+    h = _histogram(timer_name, **kwargs)
+
+    def hook(t, _h=h):
+        t.hist = _h
+
+    _tel.watch_timer(timer_name, hook)
+    return h
+
+
+_SLOS: Dict[str, SLO] = {}
+_LOCK = threading.Lock()
+
+
+def slo(name: str, **kwargs):
+    """Declare (or replace) the named SLO — see :class:`SLO` for the
+    grammar.  Under ``MXNET_OBS=0`` returns an inert object and records
+    nothing."""
+    from . import _ENABLED
+
+    if not _ENABLED:
+        return _NullSLO(name)
+    s = SLO(name, **kwargs)
+    with _LOCK:
+        _SLOS[name] = s
+    return s
+
+
+def slos() -> Dict[str, SLO]:
+    with _LOCK:
+        return dict(sorted(_SLOS.items()))
+
+
+def evaluate_all(now: Optional[float] = None) -> Dict[str, dict]:
+    """Evaluate every declared SLO once (each ``/metrics`` scrape calls
+    this, so scrape cadence is the burn-rate sampling cadence)."""
+    return {name: s.evaluate(now) for name, s in slos().items()}
+
+
+def reset():
+    """Drop every SLO (tests)."""
+    with _LOCK:
+        for name in list(_SLOS):
+            s = _SLOS.pop(name)
+            if s.timer is not None:
+                _tel.unwatch_timer(s.timer)

@@ -305,7 +305,13 @@ def heartbeat(timeout: Optional[float] = None) -> bool:
     :class:`~..resilience.chaos.ChaosError` (the lost-host stand-in the
     guard's shrink-and-resume path reacts to), ``delay`` sleeps inside
     the deadline.  Returns True; ticks ``dist.heartbeats`` and observes
-    ``dist.heartbeat_seconds``."""
+    ``dist.heartbeat_seconds``.
+
+    Every outcome also lands in the ``dist.heartbeat_ok`` gauge (1 on
+    success, 0 on failure, timestamped like any gauge) — the readiness
+    signal ``mx.obs``'s ``/readyz`` reads, so a replica whose probe
+    failed reports not-ready to the router until a later probe
+    succeeds (docs/obs.md)."""
     if timeout is None:
         timeout = get_env("MXNET_DIST_HEARTBEAT_TIMEOUT", None, float)
     t0 = _time.perf_counter()
@@ -324,11 +330,17 @@ def heartbeat(timeout: Optional[float] = None) -> bool:
     # phased span: a heartbeat that never returns (the dead-peer hang
     # the deadline converts) still leaves its begin event in the
     # flight-recorder ring, same contract as barrier/allgather
-    with _tr.span("dist.heartbeat", phased=True):
-        _with_deadline(probe, "heartbeat", timeout)
+    try:
+        with _tr.span("dist.heartbeat", phased=True):
+            _with_deadline(probe, "heartbeat", timeout)
+    except BaseException:
+        if _tel._ENABLED:
+            _tel.set_gauge("dist.heartbeat_ok", 0)
+        raise
     if _tel._ENABLED:
         _tel.inc("dist.heartbeats")
         _tel.observe("dist.heartbeat_seconds", _time.perf_counter() - t0)
+        _tel.set_gauge("dist.heartbeat_ok", 1)
     return True
 
 

@@ -45,6 +45,12 @@ Instrumented sites:
                                 ``PreemptionGuard(heartbeat_every=)`` —
                                 ``error`` stands in for a lost host and
                                 drives the shrink-and-resume migration
+  ``obs.scrape``                each per-worker fetch inside
+                                ``mx.obs.aggregate`` — ``error`` is an
+                                unreachable worker (the partial fleet
+                                view must flag it, never raise),
+                                ``delay`` a slow scrape against the
+                                ``MXNET_OBS_SCRAPE_TIMEOUT`` deadline
   ============================  =============================================
 
 Determinism: every site draws from its own ``random.Random`` seeded by

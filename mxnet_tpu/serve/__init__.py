@@ -74,6 +74,13 @@ def default_server() -> Server:
         return _SERVER
 
 
+def current_server() -> Optional[Server]:
+    """The default server if one EXISTS, else None — a read-only peek
+    that never constructs (the ``/readyz`` dispatcher-liveness check
+    must not spin a server up just by asking, docs/obs.md)."""
+    return _SERVER
+
+
 def register(name: str, block, bucketer=None, sample=None,
              warmup: bool = True, background: bool = False) -> ModelEntry:
     """Register ``block`` under ``name`` in the default registry and

@@ -350,6 +350,13 @@ class DecodeServer:
                 f"decode server {self.entry.name!r} failed to drain within "
                 f"{timeout}s")
 
+    @property
+    def alive(self) -> bool:
+        """Liveness for the ``/readyz`` decode-loop check (docs/obs.md):
+        the worker thread is running, or the server was closed cleanly.
+        False only when the loop DIED with work possibly pending."""
+        return self._thread.is_alive() or self._closed
+
     # ---------------------------------------------------------- worker
     def _occupancy(self) -> int:
         return sum(1 for r in self._active if r is not None)
@@ -520,6 +527,13 @@ def decode_server(name: str) -> DecodeServer:
             raise MXNetError(
                 f"no decode model {name!r}; registered: "
                 f"{sorted(_DECODE)}") from None
+
+
+def servers() -> Dict[str, DecodeServer]:
+    """Snapshot of the live decode servers by name (read-only copy —
+    the ``/readyz`` decode-loop liveness check iterates this)."""
+    with _DLOCK:
+        return dict(_DECODE)
 
 
 def decode_submit(name: str, prompt, **kw) -> DecodeFuture:

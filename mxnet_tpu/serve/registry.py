@@ -125,6 +125,13 @@ class ModelEntry:
         self.compiled = res
         return res
 
+    def warmup_done(self) -> bool:
+        """True when no background warmup is still compiling — the
+        mx.obs ``/readyz`` ``warmup_complete`` check: a replica still
+        mid-grid would serve its first requests through cold compiles.
+        Synchronous (or skipped) warmup counts as done."""
+        return self.warmup_handle is None or self.warmup_handle.done()
+
     # -- data path --------------------------------------------------------
     def validate(self, req):
         """Cheap admission check against the registration sample (leaf

@@ -167,6 +167,18 @@ class Server:
                     "serve: server closed before dispatch started"))
         self._inflight.drain()
 
+    @property
+    def alive(self) -> bool:
+        """Liveness for the ``/readyz`` dispatcher check (docs/obs.md):
+        True while the server can still make progress — not yet started
+        (nothing to be dead) or both worker threads running.  False
+        means a thread died or the server was closed: a replica that
+        can admit but never answer, which readiness must surface."""
+        if not self._started:
+            return not self._closed
+        return (not self._closed and self._dispatcher.is_alive()
+                and self._completer.is_alive())
+
     def __enter__(self) -> "Server":
         return self
 

@@ -16,6 +16,17 @@ Three metric kinds:
     over a bounded reservoir of recent samples (``observe`` /
     ``with timer(name):`` / ``@timed(name)``).
 
+Percentile semantics: the Timer reservoir holds the most recent
+``RESERVOIR`` *samples* regardless of age, so ``snapshot()``'s
+``p50``/``p99`` are **sample-count-windowed, not time-windowed** — a
+warmup burst stays in the tail until 1024 later samples push it out,
+which on a low-rate timer can be the whole run.  The observability
+layer (``mx.obs``, docs/obs.md) attaches a *time-windowed* histogram to
+hot timers via :func:`watch_timer`; when one is attached the summary
+grows ``p50_windowed``/``p99_windowed``/``p999_windowed`` keys and the
+:func:`dumps` table + :func:`write_tensorboard` tail columns read the
+windowed values (the reservoir fields stay for back-compat).
+
 The registry is also the evidence layer for the resilience stack
 (docs/resilience.md): checkpoint durability (``ckpt.{saves,restores,
 corrupt_skipped,save_failures}``), injected faults (``chaos.injected``
@@ -71,7 +82,8 @@ from .base import get_env
 
 __all__ = ["enabled", "set_enabled", "counter", "gauge", "timer", "timed",
            "inc", "set_gauge", "observe", "snapshot", "reset", "dumps",
-           "dump_json", "write_tensorboard", "Counter", "Gauge", "Timer"]
+           "dump_json", "write_tensorboard", "Counter", "Gauge", "Timer",
+           "peek", "watch_timer", "unwatch_timer"]
 
 # The one flag every instrumented call site checks (module-global read).
 # Default ON: the registry is the evidence layer perf work reads, and its
@@ -123,20 +135,28 @@ class Counter:
 
 
 class Gauge:
-    """Last-written value + high-water mark (queue depth, occupancy)."""
+    """Last-written value + high-water mark (queue depth, occupancy).
 
-    __slots__ = ("name", "_value", "_max", "_lock")
+    Every ``set`` also stamps ``last_update_ts`` (unix seconds), so a
+    reader can tell a *stale* gauge from an idle one — a worker whose
+    ``serve.queue_depth`` has not moved in minutes is wedged, not
+    empty.  ``/statusz`` and the fleet aggregator (docs/obs.md) read
+    the stamp; ``0.0`` means "never written"."""
+
+    __slots__ = ("name", "_value", "_max", "_ts", "_lock")
     kind = "gauge"
 
     def __init__(self, name: str):
         self.name = name
         self._value = 0
         self._max = 0
+        self._ts = 0.0
         self._lock = threading.Lock()
 
     def set(self, value: Union[int, float]):
         with self._lock:
             self._value = value
+            self._ts = time.time()
             if value > self._max:
                 self._max = value
 
@@ -152,8 +172,14 @@ class Gauge:
     def value(self):
         return self._value
 
+    @property
+    def last_update_ts(self) -> float:
+        """Unix timestamp of the last ``set`` (0.0 = never written)."""
+        return self._ts
+
     def summary(self) -> dict:
-        return {"type": "gauge", "value": self._value, "max": self._max}
+        return {"type": "gauge", "value": self._value, "max": self._max,
+                "last_update_ts": round(self._ts, 3)}
 
 
 class Timer:
@@ -164,7 +190,7 @@ class Timer:
 
     RESERVOIR = 1024
     __slots__ = ("name", "count", "total", "min", "max", "_samples",
-                 "_lock", "_starts")
+                 "_lock", "_starts", "hist")
     kind = "timer"
 
     def __init__(self, name: str):
@@ -176,6 +202,9 @@ class Timer:
         self._samples: deque = deque(maxlen=self.RESERVOIR)
         self._lock = threading.Lock()
         self._starts = threading.local()  # per-thread start stack
+        # optional time-windowed histogram (mx.obs), fed alongside the
+        # reservoir — attached via watch_timer, None costs one read
+        self.hist = None
 
     def observe(self, seconds: float):
         with self._lock:
@@ -186,6 +215,9 @@ class Timer:
             if seconds > self.max:
                 self.max = seconds
             self._samples.append(seconds)
+        h = self.hist
+        if h is not None:
+            h.observe(seconds)
 
     # -- context-manager form: ``with telemetry.timer("x"):`` ------------
     # Start times live on a per-thread stack so concurrent/nested scopes
@@ -224,11 +256,27 @@ class Timer:
                                int(round(q * (len(samples) - 1))))]
 
         # "value" mirrors total so consumers can read every metric kind
-        # uniformly (bench rows, the smoke gate)
-        return {"type": "timer", "count": count,
-                "value": round(total, 9), "total": round(total, 9),
-                "min": round(mn, 9), "max": round(mx, 9),
-                "p50": round(pct(0.50), 9), "p99": round(pct(0.99), 9)}
+        # uniformly (bench rows, the smoke gate).  p50/p99 are the
+        # RESERVOIR percentiles (module docstring: sample-count-windowed);
+        # an attached mx.obs histogram adds the time-windowed tails.
+        out = {"type": "timer", "count": count,
+               "value": round(total, 9), "total": round(total, 9),
+               "min": round(mn, 9), "max": round(mx, 9),
+               "p50": round(pct(0.50), 9), "p99": round(pct(0.99), 9)}
+        h = self.hist
+        if h is not None:
+            out["p50_windowed"] = round(h.percentile(0.50), 9)
+            out["p99_windowed"] = round(h.percentile(0.99), 9)
+            out["p999_windowed"] = round(h.percentile(0.999), 9)
+            out["window_secs"] = h.window_secs
+        return out
+
+
+# name -> hook(Timer); applied when the named Timer is (re)created, so a
+# watch registered before any sample — or surviving a reset() — still
+# lands on the live object.  mx.obs uses this to attach windowed
+# histograms to hot timers without eagerly creating zero-count metrics.
+_TIMER_WATCHES: Dict[str, Callable] = {}
 
 
 def _get(name: str, cls):
@@ -238,9 +286,40 @@ def _get(name: str, cls):
             m = _REGISTRY.get(name)
             if m is None:
                 m = _REGISTRY[name] = cls(name)
+                if cls is Timer:
+                    hook = _TIMER_WATCHES.get(name)
+                    if hook is not None:
+                        hook(m)
     if not isinstance(m, cls):
         raise TypeError(f"metric {name!r} already registered as {m.kind}")
     return m
+
+
+def peek(name: str):
+    """The live metric object for ``name``, or None — a read-only lookup
+    that never creates (readiness probes must not mint zero-count
+    metrics just by asking)."""
+    return _REGISTRY.get(name)
+
+
+def watch_timer(name: str, hook: Callable):
+    """Register ``hook(timer)`` to run when Timer ``name`` is created
+    (and immediately, if it already exists).  One watch per name —
+    re-registering replaces.  The hook typically sets ``timer.hist``."""
+    with _REG_LOCK:
+        _TIMER_WATCHES[name] = hook
+    m = _REGISTRY.get(name)
+    if isinstance(m, Timer):
+        hook(m)
+
+
+def unwatch_timer(name: str):
+    """Drop the watch for ``name`` and detach any attached histogram."""
+    with _REG_LOCK:
+        _TIMER_WATCHES.pop(name, None)
+    m = _REGISTRY.get(name)
+    if isinstance(m, Timer):
+        m.hist = None
 
 
 def counter(name: str) -> Counter:
@@ -348,10 +427,14 @@ def dumps(reset: bool = False) -> str:
     lines = ["Telemetry Statistics:", head, "-" * len(head)]
     for name, s in snap.items():
         if s["type"] == "timer":
+            # tail columns prefer the time-windowed histogram when one
+            # is attached (mx.obs): steady-state p99, warmup aged out
+            p50 = s.get("p50_windowed", s["p50"])
+            p99 = s.get("p99_windowed", s["p99"])
             lines.append(
                 f"{name:<{name_w}}  {'timer':<7}  {s['count']:>8}  "
                 f"{s['total']:>14.6f}  {s['min']:>10.6f}  "
-                f"{s['max']:>10.6f}  {s['p50']:>10.6f}  {s['p99']:>10.6f}")
+                f"{s['max']:>10.6f}  {p50:>10.6f}  {p99:>10.6f}")
         else:
             val = s["value"]
             sval = f"{val:.6f}" if isinstance(val, float) else str(val)
@@ -398,8 +481,11 @@ def write_tensorboard(logdir: str, step: int = 0, writer=None):
             if s["type"] == "timer":
                 w.add_scalar(f"telemetry/{name}/total", s["total"], step)
                 w.add_scalar(f"telemetry/{name}/count", s["count"], step)
-                w.add_scalar(f"telemetry/{name}/p50", s["p50"], step)
-                w.add_scalar(f"telemetry/{name}/p99", s["p99"], step)
+                # same windowed-tail preference as dumps()
+                w.add_scalar(f"telemetry/{name}/p50",
+                             s.get("p50_windowed", s["p50"]), step)
+                w.add_scalar(f"telemetry/{name}/p99",
+                             s.get("p99_windowed", s["p99"]), step)
             else:
                 w.add_scalar(f"telemetry/{name}", s["value"], step)
         w.flush()

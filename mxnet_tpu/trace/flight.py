@@ -44,7 +44,7 @@ from ..base import get_env
 from . import export as _export
 from . import recorder as _rec
 
-__all__ = ["arm", "disarm", "armed", "dump", "dump_dir"]
+__all__ = ["arm", "disarm", "armed", "dump", "dump_dir", "stall"]
 
 log = logging.getLogger(__name__)
 
@@ -55,10 +55,26 @@ _DUMPED = 0
 _TLS = threading.local()
 _WATCHDOG: Optional[threading.Thread] = None
 _WATCHDOG_STOP = threading.Event()
+_HANG_TIMEOUT: Optional[float] = None
 
 
 def armed() -> bool:
     return _ARMED
+
+
+def stall() -> Optional[float]:
+    """Seconds since the last span event when the hang watchdog is
+    armed AND that silence exceeds its timeout — the mx.obs ``/readyz``
+    ``not_wedged`` check.  None when not armed, no activity yet, or the
+    process is making progress."""
+    timeout = _HANG_TIMEOUT
+    if timeout is None or _WATCHDOG is None:
+        return None
+    last = _rec.last_event_time()
+    if last <= 0.0:
+        return None
+    stalled = time.perf_counter() - last
+    return stalled if stalled >= timeout else None
 
 
 def dump_dir() -> Optional[str]:
@@ -139,9 +155,11 @@ def arm(directory: Optional[str] = None,
         if hang_timeout is None:
             hang_timeout = get_env("MXNET_TRACE_HANG_TIMEOUT", None, float)
         if hang_timeout and _WATCHDOG is None:
+            global _HANG_TIMEOUT
+            _HANG_TIMEOUT = float(hang_timeout)
             _WATCHDOG_STOP.clear()
             _WATCHDOG = threading.Thread(
-                target=_watchdog_loop, args=(float(hang_timeout),),
+                target=_watchdog_loop, args=(_HANG_TIMEOUT,),
                 name="mx-trace-watchdog", daemon=True)
             _WATCHDOG.start()
     return _DIR
@@ -149,12 +167,13 @@ def arm(directory: Optional[str] = None,
 
 def disarm():
     """Remove the error hook and stop the watchdog (tests)."""
-    global _ARMED, _WATCHDOG, _DUMPED
+    global _ARMED, _WATCHDOG, _DUMPED, _HANG_TIMEOUT
     with _LOCK:
         if _ARMED:
             _base.set_error_hook(None)
             _ARMED = False
         watchdog, _WATCHDOG = _WATCHDOG, None
+        _HANG_TIMEOUT = None
         _WATCHDOG_STOP.set()
     if watchdog is not None:
         # join OUTSIDE the lock: a watchdog mid-dump needs _LOCK for its

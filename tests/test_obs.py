@@ -1,0 +1,482 @@
+"""mx.obs — exposition, windowed histograms, SLOs, fleet aggregation
+(ISSUE 16).
+
+The load-bearing claims under test: (1) the fixed bucket grid makes
+merges EXACT — bucket counts add, so fleet percentiles carry a single
+worker's error bound; (2) the sliding window ages a warmup burst out of
+p99 while the Timer reservoir (sample-count-windowed) cannot — and
+``telemetry.dumps`` prefers the windowed tail; (3) ``/metrics`` is
+conformant Prometheus text 0.0.4 (cumulative monotone buckets, +Inf ==
+_count, label escaping round-trips); (4) ``/readyz`` flips to 503 on a
+failed heartbeat and recovers on the next good probe; (5) SLO breaches
+tick burn-rate counters and mark ok↔breach transitions with trace
+instants; (6) the endpoint answers while a serve dispatch is in
+flight; (7) ``MXNET_OBS=0`` is total — no histograms, no sockets, no
+threads; (8) a dead worker makes the fleet view PARTIAL, never an
+exception.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import obs
+from mxnet_tpu import telemetry as tel
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.obs.histogram import (GRID, LE_LABELS, WindowedHistogram,
+                                     bucket_index)
+from mxnet_tpu.obs.histogram import reset as hist_reset
+from mxnet_tpu.obs.http import MetricsServer, readiness, statusz_doc
+from mxnet_tpu.obs.slo import reset as slo_reset
+from mxnet_tpu.obs import prom
+from mxnet_tpu.parallel import dist
+from mxnet_tpu.resilience import chaos
+from mxnet_tpu.serve.registry import Registry
+from mxnet_tpu.serve.server import Server
+from mxnet_tpu.trace import recorder as tr
+
+
+@pytest.fixture()
+def fresh_obs():
+    """Armed telemetry + clean histogram/SLO registries, restored
+    after (hot-timer watches re-wired so other tests see the import-
+    time state)."""
+    prev_tel = tel.set_enabled(True)
+    prev_obs = obs.set_enabled(True)
+    tel.reset()
+    slo_reset()
+    hist_reset()
+    obs._wire_hot_timers()  # fresh hists for the fresh registry
+    yield
+    slo_reset()
+    hist_reset()
+    tel.reset()
+    obs.set_enabled(prev_obs)
+    obs._wire_hot_timers() if prev_obs else None
+    tel.set_enabled(prev_tel)
+
+
+@pytest.fixture()
+def fresh_trace():
+    prev = tr.set_enabled(True)
+    tr.reset()
+    yield
+    tr.reset()
+    tr.set_enabled(prev)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _scrape(url, path="/metrics"):
+    with urllib.request.urlopen(url + path, timeout=10) as r:
+        return r.status, r.read().decode(), dict(r.headers)
+
+
+# -- bucket math + exact merge ------------------------------------------------
+
+def test_grid_shape_and_bucket_index():
+    assert len(GRID) == 81 and len(LE_LABELS) == 82
+    assert GRID[0] == pytest.approx(1e-6) and GRID[-1] == pytest.approx(
+        100.0)
+    assert all(a < b for a, b in zip(GRID, GRID[1:]))
+    # le semantics: a value ON an edge counts into that edge's bucket
+    assert bucket_index(0.0) == 0
+    assert bucket_index(GRID[0]) == 0
+    assert bucket_index(GRID[17]) == 17
+    assert bucket_index(GRID[17] * 1.0001) == 18
+    assert bucket_index(1e9) == len(GRID)  # +Inf overflow
+
+
+def test_merge_is_exact():
+    h1 = WindowedHistogram("m1", window_secs=10, subwindows=2)
+    h2 = WindowedHistogram("m2", window_secs=10, subwindows=2)
+    vals1 = [1e-5, 3e-4, 0.002, 0.002, 1.7, 500.0]
+    vals2 = [2e-6, 0.002, 0.09, 42.0]
+    for v in vals1:
+        h1.observe(v)
+    for v in vals2:
+        h2.observe(v)
+    before = h1.lifetime_counts()
+    h1.merge_counts(h2.lifetime_counts(), h2.sum)
+    merged = h1.lifetime_counts()
+    expect = [a + b for a, b in zip(before, h2.lifetime_counts())]
+    assert merged == expect
+    assert h1.count == len(vals1) + len(vals2)
+    assert h1.sum == pytest.approx(sum(vals1) + sum(vals2))
+    with pytest.raises(MXNetError):
+        h1.merge_counts([0, 1, 2])  # wrong grid length refused
+
+
+def test_percentile_upper_edge_bound():
+    h = WindowedHistogram("pct", window_secs=10, subwindows=2)
+    for _ in range(100):
+        h.observe(0.0042)
+    p99 = h.percentile(0.99)
+    assert p99 >= 0.0042  # never under-reports
+    assert p99 <= 0.0042 * 10 ** 0.1 * 1.001  # ≤ one bucket width over
+
+
+# -- window rotation ----------------------------------------------------------
+
+def test_window_rotation_ages_out_burst():
+    clk = FakeClock()
+    h = WindowedHistogram("rot", window_secs=6.0, subwindows=3,
+                          clock=clk)
+    for _ in range(50):
+        h.observe(1.0)  # slow burst at t=0
+    assert h.percentile(0.99) >= 1.0
+    clk.t = 7.0  # past the 6s window: burst subwindow expired
+    for _ in range(20):
+        h.observe(0.001)
+    assert h.percentile(0.99) <= 0.001 * 10 ** 0.1 * 1.001
+    # lifetime still remembers everything (monotone, Prometheus-side)
+    assert h.count == 70
+    assert sum(h.lifetime_counts()) == 70
+    assert sum(h.window_counts()) == 20
+
+
+def test_window_slot_recycle_same_slot():
+    clk = FakeClock()
+    h = WindowedHistogram("rec", window_secs=3.0, subwindows=3,
+                          clock=clk)
+    h.observe(0.5)  # epoch 0, slot 0
+    clk.t = 3.0  # epoch 3 → slot 0 again: must recycle, not accumulate
+    h.observe(0.5)
+    assert sum(h.window_counts()) == 1
+    assert h.count == 2
+
+
+# -- satellite 1: reservoir bias vs windowed tail -----------------------------
+
+def test_windowed_p99_ages_warmup_out_but_reservoir_keeps_it(fresh_obs):
+    clk = FakeClock()
+    h = obs.watch_timer("unitobs.lat_seconds", window_secs=10.0,
+                        subwindows=5, clock=clk)
+    assert h is not None
+    for _ in range(100):
+        tel.observe("unitobs.lat_seconds", 1.0)  # warmup burst
+    clk.t = 60.0  # way past the window
+    for _ in range(50):
+        tel.observe("unitobs.lat_seconds", 0.001)
+    s = tel.snapshot()["unitobs.lat_seconds"]
+    # reservoir (sample-count window, 150 samples kept) still sees the
+    # burst at p99...
+    assert s["p99"] >= 0.9
+    # ...the time window does not
+    assert s["p99_windowed"] <= 0.0013
+    assert s["window_secs"] == 10.0
+    # and dumps() routes the tail columns through the windowed value:
+    # the p50/p99 columns (last two) show ~1ms, not the 1s burst
+    row = [ln for ln in tel.dumps().splitlines()
+           if "unitobs.lat_seconds" in ln][0]
+    p50_col, p99_col = row.split()[-2:]
+    assert float(p99_col) <= 0.0013 and float(p50_col) <= 0.0013
+
+
+def test_unwatch_detaches(fresh_obs):
+    obs.watch_timer("unitobs.det_seconds")
+    tel.observe("unitobs.det_seconds", 0.01)
+    assert tel.peek("unitobs.det_seconds").hist is not None
+    tel.unwatch_timer("unitobs.det_seconds")
+    assert tel.peek("unitobs.det_seconds").hist is None
+    s = tel.snapshot()["unitobs.det_seconds"]
+    assert "p99_windowed" not in s
+
+
+# -- satellite 2: gauge freshness ---------------------------------------------
+
+def test_gauge_last_update_ts(fresh_obs):
+    t0 = time.time()
+    tel.set_gauge("unitobs.g", 7)
+    s = tel.snapshot()["unitobs.g"]
+    assert s["type"] == "gauge" and s["value"] == 7
+    assert t0 - 1.0 <= s["last_update_ts"] <= time.time() + 1.0
+    assert tel.peek("unitobs.g").last_update_ts == pytest.approx(
+        s["last_update_ts"], abs=0.01)
+
+
+# -- Prometheus exposition ----------------------------------------------------
+
+def test_prometheus_render_conformance_and_escaping(fresh_obs):
+    tel.inc("unitobs.hits", 3)
+    tel.set_gauge('unitobs.we"ird\\ga\nuge', 5)
+    for v in (0.001, 0.01, 0.01, 2.5):
+        tel.observe("unitobs.hist_seconds", v)
+    obs.watch_timer("unitobs.hist_seconds")
+    for v in (0.001, 0.01, 0.01, 2.5):
+        tel.observe("unitobs.hist_seconds", v)
+    from mxnet_tpu.obs.histogram import histograms
+    text = prom.render(tel.snapshot(), histograms())
+    # counter + TYPE lines
+    assert "# TYPE mx_unitobs_hits counter" in text
+    assert "mx_unitobs_hits 3" in text
+    # label escaping: backslash, quote, newline all escaped in place
+    assert 'name="unitobs.we\\"ird\\\\ga\\nuge"' in text
+    # histogram: cumulative monotone, +Inf == _count
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("mx_unitobs_hist_seconds_bucket")]
+    assert len(lines) == len(LE_LABELS)
+    cum = [int(ln.rsplit(" ", 1)[1]) for ln in lines]
+    assert all(a <= b for a, b in zip(cum, cum[1:]))
+    assert cum[-1] == 4
+    assert "mx_unitobs_hist_seconds_count 4" in text
+    # round-trip: parse recovers values, unescapes labels, de-cumulates
+    p = prom.parse(text)
+    assert p.values["mx_unitobs_hits"] == 3
+    names = [lbl.get("name") for lbl, _ in
+             p.labeled["mx_gauge_last_update_ts"]]
+    assert 'unitobs.we"ird\\ga\nuge' in names
+    counts = p.hist_counts("mx_unitobs_hist_seconds")
+    assert sum(counts) == 4
+    h = histograms()["unitobs.hist_seconds"]
+    assert list(counts) == list(h.lifetime_counts())
+
+
+def test_parse_refuses_foreign_grid():
+    text = ("# TYPE mx_x histogram\n"
+            'mx_x_bucket{le="0.005"} 1\n'
+            'mx_x_bucket{le="+Inf"} 1\n'
+            "mx_x_sum 0.004\nmx_x_count 1\n")
+    p = prom.parse(text)
+    with pytest.raises(MXNetError):
+        p.hist_counts("mx_x")
+
+
+# -- HTTP endpoint ------------------------------------------------------------
+
+def test_endpoint_metrics_healthz_statusz(fresh_obs):
+    tel.inc("unitobs.served", 2)
+    with MetricsServer(0) as srv:
+        status, text, headers = _scrape(srv.url)
+        assert status == 200
+        assert "version=0.0.4" in headers["Content-Type"]
+        assert "mx_unitobs_served 2" in text
+        status, body, _ = _scrape(srv.url, "/healthz")
+        assert status == 200 and body == "ok\n"
+        status, body, _ = _scrape(srv.url, "/statusz")
+        doc = json.loads(body)
+        assert doc["pid"] == os.getpid()
+        assert "queue_depth" in doc and "checks" in doc
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _scrape(srv.url, "/nope")
+        assert ei.value.code == 404
+
+
+def test_readyz_flips_on_heartbeat_and_recovers(fresh_obs):
+    with MetricsServer(0) as srv:
+        status, _, _ = _scrape(srv.url, "/readyz")
+        assert status == 200  # never probed → ready
+        chaos.configure("dist.heartbeat:error:1.0")
+        try:
+            with pytest.raises(MXNetError):
+                dist.heartbeat()
+        finally:
+            chaos.reset()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _scrape(srv.url, "/readyz")
+        assert ei.value.code == 503
+        checks = json.loads(ei.value.read().decode())["checks"]
+        assert checks["heartbeat"]["ok"] is False
+        dist.heartbeat()  # healthy probe → ready again
+        status, body, _ = _scrape(srv.url, "/readyz")
+        assert status == 200
+        assert json.loads(body)["checks"]["heartbeat"]["ok"] is True
+
+
+def test_readiness_flags_dead_dispatcher(fresh_obs):
+    ready, checks = readiness()
+    assert checks["dispatcher_alive"]["ok"]  # no server = nothing dead
+    doc = statusz_doc()
+    assert isinstance(doc["gauges"], dict)
+
+
+def test_endpoint_answers_during_active_serve(fresh_obs):
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu", in_units=4))
+    net.add(nn.Dense(2, in_units=8))
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((1, 4)))
+    reg = Registry()
+    reg.register("tiny", net, bucketer={0: [2, 4]},
+                 sample=onp.zeros((4,), "float32"))
+    with MetricsServer(0) as srv, Server(registry=reg) as s:
+        results = []
+
+        def scrape_loop():
+            for _ in range(5):
+                results.append(_scrape(srv.url)[0])
+                results.append(_scrape(srv.url, "/statusz")[0])
+
+        t = threading.Thread(target=scrape_loop)
+        t.start()
+        futs = [s.submit("tiny", onp.random.rand(4).astype("float32"))
+                for _ in range(32)]
+        for f in futs:
+            f.result(timeout=30.0)
+        t.join(30.0)
+        assert not t.is_alive()
+        assert results and all(code == 200 for code in results)
+        # the hot timer picked up its windowed histogram on creation
+        assert tel.peek("serve.e2e_seconds").hist is not None
+
+
+# -- SLO tracker --------------------------------------------------------------
+
+def test_slo_breach_burn_counter_and_trace_instants(fresh_obs,
+                                                    fresh_trace):
+    clk = FakeClock()
+    obs.watch_timer("unitobs.slo_seconds", window_secs=10.0,
+                    subwindows=5, clock=clk)
+    s = obs.slo("lat", timer="unitobs.slo_seconds", p99_ms=10.0,
+                window_secs=10.0)
+    tel.observe("unitobs.slo_seconds", 0.5)  # 500ms ≫ 10ms target
+    v = s.evaluate()
+    assert v["breached"] and not v["ok"]
+    assert tel.snapshot()["obs.slo_breaches.lat"]["value"] == 1
+    v = obs.evaluate_all()["lat"]  # still breaching: burn ticks again
+    assert v["breached"]
+    assert tel.snapshot()["obs.slo_breaches.lat"]["value"] == 2
+    # breach instant recorded exactly once (transition, not per tick)
+    evs = [e for e in tr.events() if e["name"] == "obs.slo_breach"]
+    assert len(evs) == 1 and evs[0]["attrs"]["slo"] == "lat"
+    # recovery: the slow sample ages out of the window
+    clk.t = 60.0
+    tel.observe("unitobs.slo_seconds", 0.001)
+    v = s.evaluate()
+    assert v["ok"] and not v["breached"]
+    assert tel.snapshot()["obs.slo_breaches.lat"]["value"] == 2
+    rec = [e for e in tr.events() if e["name"] == "obs.slo_recovered"]
+    assert len(rec) == 1
+
+
+def test_slo_error_rate_objective(fresh_obs):
+    s = obs.slo("errs", error_rate=0.1,
+                error_counter="unitobs.errors",
+                total_counter="unitobs.requests", window_secs=60.0)
+    tel.inc("unitobs.requests", 10)
+    s.evaluate(now=1.0)  # baseline sample
+    tel.inc("unitobs.requests", 10)
+    tel.inc("unitobs.errors", 5)  # 5/10 = 50% in-window
+    v = s.evaluate(now=2.0)
+    assert v["breached"] and v["error_rate"] == pytest.approx(0.5)
+    # healthy traffic dilutes the windowed rate back under target
+    tel.inc("unitobs.requests", 1000)
+    v = s.evaluate(now=3.0)
+    assert v["ok"]
+
+
+def test_slo_grammar_validation(fresh_obs):
+    with pytest.raises(MXNetError):
+        obs.slo("bad")  # no objective
+    with pytest.raises(MXNetError):
+        obs.slo("bad2", p99_ms=5.0)  # latency objective needs timer=
+
+
+# -- fleet aggregation --------------------------------------------------------
+
+def test_aggregate_merges_exactly(fresh_obs):
+    obs.watch_timer("unitobs.agg_seconds")
+    for v in (0.001, 0.02, 0.3):
+        tel.observe("unitobs.agg_seconds", v)
+    tel.inc("unitobs.agg_hits", 4)
+    tel.set_gauge("serve.queue_depth", 3)
+    with MetricsServer(0) as srv:
+        # same endpoint twice = two identical workers: everything
+        # doubles EXACTLY
+        fv = obs.aggregate([srv.url, srv.url])
+        assert not fv.partial and len(fv.ok_workers) == 2
+        h = fv.histogram("unitobs.agg_seconds")
+        assert h.count == 6
+        assert h.sum == pytest.approx(2 * (0.001 + 0.02 + 0.3))
+        assert fv.counter("unitobs.agg_hits") == 8
+        g = fv.gauge("serve.queue_depth")
+        assert g["sum"] == 6 and len(g["workers"]) == 1  # same url key
+        doc = fv.to_dict()
+        assert doc["histograms"]["mx_unitobs_agg_seconds"]["count"] == 6
+
+
+def test_aggregate_partial_on_dead_worker(fresh_obs):
+    tel.inc("unitobs.alive", 1)
+    with MetricsServer(0) as srv:
+        # a worker that was never there: connection refused, flagged
+        fv = obs.aggregate([srv.url, "http://127.0.0.1:9"], timeout=0.5)
+        assert fv.partial
+        assert srv.url in fv.ok_workers
+        assert "http://127.0.0.1:9" in fv.dead_workers
+        assert fv.counter("unitobs.alive") == 1  # survivors still merge
+
+
+def test_aggregate_chaos_scrape_never_raises(fresh_obs):
+    tel.inc("unitobs.chaos", 1)
+    with MetricsServer(0) as srv:
+        # after-gate makes it deterministic: first scrape fine, second
+        # hits the injected error
+        chaos.configure("obs.scrape:error:1.0:1")
+        try:
+            fv = obs.aggregate([srv.url, srv.url])
+        finally:
+            chaos.reset()
+        assert fv.partial
+        assert len(fv.ok_workers) == 1 and len(fv.dead_workers) == 1
+        assert "ChaosError" in next(iter(fv.dead_workers.values()))
+        assert fv.counter("unitobs.chaos") == 1
+        assert tel.snapshot()["obs.scrape_failures"]["value"] == 1
+
+
+# -- MXNET_OBS=0 kill switch --------------------------------------------------
+
+def test_obs_disabled_is_total():
+    env = dict(os.environ, MXNET_OBS="0", JAX_PLATFORMS="cpu",
+               MXNET_OBS_PORT="0")
+    code = (
+        "import threading\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import obs, telemetry as tel\n"
+        "assert not obs.enabled()\n"
+        "assert obs.serve_metrics(0) is None\n"
+        "assert obs.metrics_server() is None\n"
+        "assert obs.watch_timer('serve.e2e_seconds') is None\n"
+        "s = obs.slo('x', error_rate=0.1)\n"
+        "assert s.evaluate()['disabled']\n"
+        "tel.set_enabled(True)\n"
+        "tel.observe('serve.e2e_seconds', 0.1)\n"
+        "assert tel.peek('serve.e2e_seconds').hist is None\n"
+        "snap = tel.snapshot()['serve.e2e_seconds']\n"
+        "assert 'p99_windowed' not in snap\n"
+        "names = [t.name for t in threading.enumerate()]\n"
+        "assert not any(n.startswith('mx-obs') for n in names), names\n"
+        "print('DISABLED-OK')\n")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert "DISABLED-OK" in out.stdout
+
+
+def test_set_enabled_detaches_hot_timers(fresh_obs):
+    tel.observe("serve.e2e_seconds", 0.01)
+    assert tel.peek("serve.e2e_seconds").hist is not None
+    prev = obs.set_enabled(False)
+    try:
+        assert tel.peek("serve.e2e_seconds").hist is None
+        assert obs.watch_timer("serve.e2e_seconds") is None
+    finally:
+        obs.set_enabled(prev)
+        obs._wire_hot_timers()
+    assert tel.peek("serve.e2e_seconds").hist is not None

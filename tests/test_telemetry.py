@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 
 import numpy as onp
 import pytest
@@ -41,7 +42,9 @@ def test_counter_gauge_timer_basics():
     tel.observe("t.lat", 1.5)
     snap = tel.snapshot()
     assert snap["t.count"] == {"type": "counter", "value": 5}
-    assert snap["t.depth"] == {"type": "gauge", "value": 1, "max": 3}
+    depth = dict(snap["t.depth"])
+    assert depth.pop("last_update_ts") == pytest.approx(time.time(), abs=60)
+    assert depth == {"type": "gauge", "value": 1, "max": 3}
     t = snap["t.lat"]
     assert t["count"] == 2
     assert t["total"] == pytest.approx(2.0)

@@ -463,8 +463,10 @@ def test_hang_watchdog_dumps_on_stalled_event_stream(tmp_path):
         dumps = []
         while time.time() < deadline and not dumps:
             time.sleep(0.1)  # no events recorded: the stream is stalled
+            # endswith filters out export.write's in-flight *.tmp.<pid>
+            # file — this loop races the watchdog's atomic rename
             dumps = [f for f in os.listdir(tmp_path)
-                     if f.startswith("flight-")]
+                     if f.startswith("flight-") and f.endswith(".json")]
         assert dumps, "watchdog never fired on a stalled event stream"
         doc = json.load(open(tmp_path / dumps[0]))
         assert "hang" in doc["metadata"]["flight"]["reason"]
