@@ -18,7 +18,7 @@ BUILD    := build
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
 	trace-smoke kernels-smoke serve-smoke decode-smoke obs-smoke \
-	lint-hybrid lint-graph ci clean
+	lint-hybrid lint-threads lint-graph ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -140,7 +140,7 @@ serve-smoke:
 	# one request (docs/serving.md).  Serial — single-core box, never
 	# concurrent with tier-1.
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
-		python tools/serve_smoke.py
+		MXNET_THREAD_CHECK=raise python tools/serve_smoke.py
 
 decode-smoke:
 	# generative decode gate: a tiny transformer-LM DecodeEntry AOT-warmed
@@ -151,7 +151,7 @@ decode-smoke:
 	# observably alias (docs/serving.md "Decode lifecycle").  Serial —
 	# single-core box, never concurrent with tier-1.
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
-		python tools/decode_smoke.py
+		MXNET_THREAD_CHECK=raise python tools/decode_smoke.py
 
 obs-smoke:
 	# mx.obs gate: LeNet served with the metrics endpoint armed — a
@@ -163,7 +163,7 @@ obs-smoke:
 	# flagged, never raised (docs/obs.md).  Serial — single-core box,
 	# never concurrent with tier-1.
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
-		MXNET_OBS=1 python tools/obs_smoke.py
+		MXNET_OBS=1 MXNET_THREAD_CHECK=raise python tools/obs_smoke.py
 
 lint-hybrid:
 	# hybridize-safety static analysis (docs/analysis.md). The committed
@@ -172,6 +172,14 @@ lint-hybrid:
 	python tools/mxlint.py --format=json \
 		--baseline tools/mxlint_baseline.json \
 		mxnet_tpu example benchmark tools
+
+lint-threads:
+	# concurrency lint (docs/analysis.md T rules): lock/thread model of
+	# the serving tier — inversions, blocking under locks, unjoined
+	# threads.  Loads mx.analysis standalone (no jax import): sub-second.
+	python tools/threadlint.py --format=json \
+		--baseline tools/threadlint_baseline.json \
+		mxnet_tpu tools
 
 lint-graph:
 	# XLA executable lint (docs/analysis.md X rules): compiles the
@@ -185,7 +193,8 @@ lint-graph:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		python tools/xlalint.py
 
-ci: native native-test asan tsan lint-hybrid lint-graph test test-slow \
+ci: native native-test asan tsan lint-hybrid lint-threads lint-graph \
+	test test-slow \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
 	trace-smoke kernels-smoke serve-smoke decode-smoke obs-smoke
 

@@ -23,21 +23,41 @@ Four layers, one diagnostic shape (``diagnostics.Diagnostic``):
   leaks, host callbacks), hooked into every compile seam behind
   ``MXNET_XLA_LINT=1|raise``.  CLI: ``tools/xlalint.py`` against
   per-model budgets; CI gate: ``make lint-graph``.
+* :mod:`~mxnet_tpu.analysis.thread_lint` — AST concurrency linter over
+  the threaded serving tier (static T001..T006: unlocked shared
+  writes, blocking calls under a lock, lock-order cycles, join-less
+  threads, daemon teardown writers, lock re-entry).
+  CLI: ``tools/threadlint.py``; CI gate: ``make lint-threads``.
+* :mod:`~mxnet_tpu.analysis.thread_check` — runtime lock-order witness
+  (``MXNET_THREAD_CHECK=1|raise``): the named locks of
+  engine/serve/decode/obs/resilience/trace feed per-thread acquisition
+  stacks and a live order graph; T101 real inversions, T102 long
+  holds (``MXNET_THREAD_CHECK_HOLD_MS``).
 
-Rule catalog: ``diagnostics.RULES`` / docs/analysis.md.  This package is
-stdlib-only at import so the linter runs without loading jax.
+Shared CLI plumbing (baselines, ``--rules``/``--explain``, json/text)
+lives once in :mod:`~mxnet_tpu.analysis.lint_cli`.  Rule catalog:
+``diagnostics.RULES`` / docs/analysis.md.  This package is stdlib-only
+at import so the linters run without loading jax.
 """
 from . import diagnostics
 from . import engine_check
 from . import hybrid_lint
+from . import lint_cli
 from . import retrace
 from . import spmd_hints
+from . import thread_check
+from . import thread_lint
 from . import xla_lint
 from .diagnostics import Diagnostic, RULES, rule_doc, to_json
 from .hybrid_lint import lint_file, lint_paths, lint_source
 from .retrace import report as retrace_report
+from .thread_lint import lint_file as thread_lint_file
+from .thread_lint import lint_paths as thread_lint_paths
+from .thread_lint import lint_source as thread_lint_source
 
-__all__ = ["diagnostics", "engine_check", "hybrid_lint", "retrace",
-           "spmd_hints", "xla_lint", "Diagnostic", "RULES", "rule_doc",
-           "to_json", "lint_source", "lint_file", "lint_paths",
-           "retrace_report"]
+__all__ = ["diagnostics", "engine_check", "hybrid_lint", "lint_cli",
+           "retrace", "spmd_hints", "thread_check", "thread_lint",
+           "xla_lint", "Diagnostic", "RULES", "rule_doc", "to_json",
+           "lint_source", "lint_file", "lint_paths", "retrace_report",
+           "thread_lint_source", "thread_lint_file",
+           "thread_lint_paths"]

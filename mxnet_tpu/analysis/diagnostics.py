@@ -227,6 +227,72 @@ RULES: Dict[str, tuple] = {
         "move the host-side consumption outside the jitted function, "
         "or set the model budget's allow_callbacks if the callback is "
         "intentional (e.g. a debugging build)"),
+    # -- threading lint (thread_lint, static T rules) -----------------------
+    "T001": (
+        "unlocked-shared-write",
+        "an attribute is written both from a Thread-target method and "
+        "from a public method with no lock held in common — the two "
+        "writers race, and the loser's update is silently lost",
+        "guard every write site with one shared lock (with self._lock:), "
+        "or hand the attribute to the worker thread exclusively"),
+    "T002": (
+        "blocking-call-under-lock",
+        "a blocking call (thread join / future result / urlopen / "
+        "time.sleep / wait on a foreign primitive) runs while a lock is "
+        "held: every other thread needing that lock stalls for the full "
+        "block — and a join on a thread that itself needs the lock "
+        "deadlocks",
+        "move the blocking call outside the with block (capture what it "
+        "needs under the lock, block after release — see "
+        "trace/flight.py disarm for the pattern)"),
+    "T003": (
+        "lock-order-inversion",
+        "two code paths acquire the same pair of locks in opposite "
+        "orders: each thread can take its first lock and block forever "
+        "on the other's — a textbook ABBA deadlock waiting for load",
+        "pick one global acquisition order for the cycle's locks and "
+        "restructure the paths that violate it (or collapse to one "
+        "lock)"),
+    "T004": (
+        "thread-without-join-path",
+        "a spawned thread has no reachable join: an object-owned thread "
+        "with no method joining it, or a local thread never joined in "
+        "its function — shutdown cannot prove the thread finished, so "
+        "teardown races its last writes",
+        "store the thread and join it from the owner's close()/wait() "
+        "(bounded timeout), or join the local before returning"),
+    "T005": (
+        "daemon-writes-at-teardown",
+        "a daemon=True thread's target writes files (open/os.replace/"
+        "shutil) — the interpreter kills daemons mid-write at exit, "
+        "leaving truncated files or half-committed state",
+        "make the worker non-daemon with an owned join path, or funnel "
+        "writes through a close()-drained queue (resilience/checkpoint "
+        "pattern)"),
+    "T006": (
+        "lock-reentry-self-deadlock",
+        "a method that holds a non-reentrant threading.Lock calls "
+        "(directly) another method that acquires the same lock: the "
+        "second acquire blocks on the first forever — guaranteed "
+        "self-deadlock on that path",
+        "split the locked method into a public locking wrapper + a "
+        "_locked helper called under the lock, or use threading.RLock "
+        "if re-entry is intended"),
+    # -- runtime thread witness rules ---------------------------------------
+    "T101": (
+        "runtime-lock-order-inversion",
+        "the runtime witness observed the same two named locks acquired "
+        "in opposite orders by live threads — the ABBA deadlock is real "
+        "in this execution, not just reachable in the source",
+        "fix the acquisition order at the reported site; the message "
+        "names both locks and the first-seen opposite-order site"),
+    "T102": (
+        "long-lock-hold",
+        "a named lock was held longer than MXNET_THREAD_CHECK_HOLD_MS — "
+        "long holds on serving-tier locks convert concurrency into a "
+        "convoy (every submit/scrape/close stalls behind the holder)",
+        "shrink the critical section: move compute/IO outside the with "
+        "block, or raise the threshold if the hold is intended"),
     # -- tool errors --------------------------------------------------------
     "X000": (
         "analysis-error",

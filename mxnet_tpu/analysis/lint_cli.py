@@ -1,0 +1,152 @@
+"""Shared CLI plumbing for the static lint tools.
+
+``tools/mxlint.py`` and ``tools/threadlint.py`` are thin bootstraps:
+they load this package standalone (no framework / jax import) and call
+:func:`run` with their lint entry point.  Everything they used to
+duplicate lives here once — fingerprint baselines (load / write /
+budget consumption), ``--rules`` / ``--explain`` catalog access,
+json-vs-text output, repo-relative path normalization, and the
+0/1/2 exit-code contract — the same one-implementation move as the
+X003 budget migration in xla_lint.
+
+Baseline semantics: a baseline is a Counter of diagnostic fingerprints
+(``path::symbol::code`` — line-drift proof); each finding consumes one
+unit of its fingerprint's budget and anything beyond is NEW and fails
+the gate (exit 1).  ``--write-baseline`` records the current state.
+
+Stdlib-only by contract, like the rest of the package.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+from typing import Callable, Iterable, List, Optional, Sequence
+
+from .diagnostics import RULES, rule_doc, to_json
+
+__all__ = ["load_baseline", "write_baseline", "split_new", "run"]
+
+
+def load_baseline(path: str) -> Counter:
+    """Baseline = counts per diagnostic fingerprint (line-drift proof)."""
+    if not path or not os.path.exists(path):
+        return Counter()
+    with open(path) as f:
+        doc = json.load(f)
+    return Counter(doc.get("fingerprints", {}))
+
+
+def write_baseline(path: str, diags, tool: str = "mxlint",
+                   root: str = "") -> None:
+    fps = Counter(d.fingerprint() for d in diags)
+    rel = os.path.relpath(path, root) if root else path
+    doc = {"version": 1,
+           "comment": f"legacy {tool} violations; regenerate with "
+                      f"tools/{tool}.py --write-baseline --baseline "
+                      + rel,
+           "fingerprints": dict(sorted(fps.items()))}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def split_new(diags, baseline: Counter):
+    """Diagnostics beyond the baselined count per fingerprint."""
+    budget = Counter(baseline)
+    new, known = [], []
+    for d in diags:
+        fp = d.fingerprint()
+        if budget[fp] > 0:
+            budget[fp] -= 1
+            known.append(d)
+        else:
+            new.append(d)
+    return new, known
+
+
+def run(argv: Optional[Sequence[str]] = None, *, tool: str,
+        lint_paths_fn: Callable[[Iterable[str]], List],
+        root: str = "", rule_prefixes: Optional[Sequence[str]] = None,
+        description: Optional[str] = None) -> int:
+    """The whole lint-CLI lifecycle; returns the process exit code
+    (0 clean / fully baselined, 1 new violations, 2 usage).
+
+    ``rule_prefixes`` restricts the ``--rules`` listing (and the
+    ``--explain`` namespace check) to this tool's families, e.g.
+    ``("T",)`` for threadlint; None means the full catalog.
+    """
+    p = argparse.ArgumentParser(
+        prog=f"{tool}.py", description=description,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*", help="files or directories to lint")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--baseline", default="",
+                   help="baseline JSON; diagnostics in it do not fail")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="record current diagnostics as the new baseline")
+    p.add_argument("--explain", metavar="CODE",
+                   help="print the rationale + fix for one rule code")
+    p.add_argument("--rules", action="store_true",
+                   help="list this tool's rule catalog")
+    args = p.parse_args(argv)
+
+    def mine(code: str) -> bool:
+        return rule_prefixes is None or \
+            any(code.startswith(pre) for pre in rule_prefixes)
+
+    if args.explain:
+        print(rule_doc(args.explain))
+        return 0 if args.explain in RULES and mine(args.explain) else 2
+    if args.rules:
+        for code in sorted(RULES):
+            if mine(code):
+                title, why, _ = RULES[code]
+                print(f"{code}  {title:<24} {why.splitlines()[0][:80]}")
+        return 0
+    if not args.paths:
+        p.error("no paths given (or use --rules / --explain)")
+    missing = [pa for pa in args.paths if not os.path.exists(pa)]
+    if missing:
+        # a silently-skipped path would turn the CI gate into a no-op
+        p.error(f"path(s) do not exist: {', '.join(missing)}")
+
+    diags = lint_paths_fn(args.paths)
+    # paths relative to repo root keep fingerprints stable across
+    # checkouts and invocation cwds
+    if root:
+        for d in diags:
+            d.path = os.path.relpath(os.path.abspath(d.path), root)
+
+    if args.write_baseline:
+        if not args.baseline:
+            p.error("--write-baseline needs --baseline FILE")
+        write_baseline(args.baseline, diags, tool=tool, root=root)
+        print(f"baseline written: {args.baseline} "
+              f"({len(diags)} diagnostics)")
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    new, known = split_new(diags, baseline)
+
+    if args.format == "json":
+        doc = to_json(new, tool=tool,
+                      baselined=[d.to_dict() for d in known],
+                      checked_paths=list(args.paths))
+        json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        for d in new:
+            print(d.format())
+        if known:
+            print(f"({len(known)} baselined violation(s) not shown; "
+                  "see --baseline)")
+        if new:
+            print(f"\n{len(new)} new violation(s). Fix them, suppress "
+                  "intentional ones with '# mxlint: disable=CODE', or "
+                  "re-baseline.")
+        else:
+            print("clean.")
+    return 1 if new else 0

@@ -1,0 +1,363 @@
+"""Runtime lock-order witness (``MXNET_THREAD_CHECK=1|raise``).
+
+The static half (:mod:`~mxnet_tpu.analysis.thread_lint`, T001..T006)
+proves properties of the *source*; this module witnesses the *live*
+process.  The threaded subsystems (engine, serve, decode, obs,
+resilience, trace) construct their locks through the factories here —
+:func:`lock` / :func:`rlock` / :func:`condition` — which return cheap
+named proxies.  Disarmed, a proxy costs one global flag read per
+acquire.  Armed (:func:`install`, or the env var at import), every
+acquire/release records into per-thread held stacks and a global
+name-keyed acquisition-order graph:
+
+* **T101 runtime lock-order inversion** — lock *b* acquired while *a*
+  is held after some thread previously acquired *a* while holding *b*:
+  the ABBA deadlock exists in this execution, not just in the source.
+  The edge is recorded at the acquire *attempt*, before blocking, so a
+  real deadlock still leaves the diagnostic behind.
+* **T102 long hold** — a lock held longer than
+  ``MXNET_THREAD_CHECK_HOLD_MS`` milliseconds (0/unset disables).
+
+Findings follow the engine_check contract: bounded structured
+diagnostics, one log warning per (site, rule), telemetry counters
+(``analysis.thread_check_findings`` + ``analysis.thread_check.<code>``),
+a trace instant per finding so it lands in the Perfetto timeline, and
+exceptions at the site under ``MXNET_THREAD_CHECK=raise``.
+
+Stdlib-only on purpose: the subsystems import this at startup and
+``tools/threadlint.py`` loads the analysis package standalone.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from .diagnostics import Diagnostic
+
+__all__ = ["lock", "rlock", "condition", "install", "uninstall",
+           "enabled", "env_mode", "diagnostics", "clear", "order_edges",
+           "ThreadCheckError"]
+
+# The one flag every proxy acquire reads when disarmed.
+_ACTIVE: bool = False
+_RAISE: bool = False
+_HOLD_S: float = 0.0  # long-hold threshold in SECONDS; 0 disables
+
+# .held: list of [name, t_acquire, site] for locks this thread holds;
+# .guard: True while the witness itself records (telemetry/trace/logging
+# may acquire witnessed locks — recursion would deadlock or loop)
+_TLS = threading.local()
+
+_LOCK = threading.Lock()
+_DIAGS: List[Diagnostic] = []
+_MAX_DIAGS = 1000    # long witnessed runs must not accumulate unboundedly
+_DROPPED = 0
+_WARNED: Set[Tuple[str, str]] = set()
+# observed acquisition order: _ORDER[a] contains b when some thread
+# acquired b while holding a; _SITE[(a, b)] is where that first happened
+_ORDER: Dict[str, Set[str]] = {}
+_SITE: Dict[Tuple[str, str], str] = {}
+
+_LOG = logging.getLogger(__name__)
+
+
+class ThreadCheckError(RuntimeError):
+    """Raised at the acquire/release site under MXNET_THREAD_CHECK=raise."""
+
+
+def env_mode() -> str:
+    """'': disabled; 'warn': record+log; 'raise': escalate."""
+    v = os.environ.get("MXNET_THREAD_CHECK", "").strip().lower()
+    if v in ("", "0", "off", "false"):
+        return ""
+    return "raise" if v == "raise" else "warn"
+
+
+def _call_site(depth: int = 3) -> str:
+    """'file.py:123' of the frame acquiring/releasing through a proxy."""
+    try:
+        f = sys._getframe(depth)
+        return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+    except Exception:
+        return "<unknown>"
+
+
+def _record(code: str, message: str, where: str):
+    global _DROPPED
+    d = Diagnostic(path="<runtime>", line=0, code=code, message=message,
+                   symbol=where, source="thread-check")
+    with _LOCK:
+        if len(_DIAGS) < _MAX_DIAGS:
+            _DIAGS.append(d)
+        else:  # bounded retention; the counter below still ticks
+            _DROPPED += 1
+        key = (where, code)
+        warn = key not in _WARNED
+        if warn:
+            _WARNED.add(key)
+    # telemetry + trace are optional here: the witness must work
+    # standalone, and both may themselves take witnessed locks — the
+    # caller has already set the TLS guard
+    try:
+        from mxnet_tpu import telemetry as _tel
+
+        if _tel._ENABLED:
+            _tel.inc("analysis.thread_check_findings")
+            _tel.inc(f"analysis.thread_check.{code}")
+    except Exception:
+        pass
+    try:
+        from mxnet_tpu.trace import recorder as _tr
+
+        if _tr._ENABLED:
+            _tr.instant("analysis.thread_check", code=code, where=where,
+                        thread=threading.current_thread().name)
+    except Exception:
+        pass
+    if _RAISE:
+        raise ThreadCheckError(f"{code} at {where}: {message}")
+    if warn:
+        _LOG.warning("thread-check %s at %s: %s", code, where, message)
+
+
+def _held() -> list:
+    h = getattr(_TLS, "held", None)
+    if h is None:
+        h = _TLS.held = []
+    return h
+
+
+def _note_attempt(name: str):
+    """Order-graph update at the acquire ATTEMPT (pre-block): a real
+    ABBA deadlock still records its inversion before hanging."""
+    held = _held()
+    if not held:
+        return
+    site = _call_site(4)
+    _TLS.guard = True
+    try:
+        for ent in held:
+            a = ent[0]
+            if a == name:
+                continue  # reentrant re-acquire; T006 is the static rule
+            with _LOCK:
+                inverted = a in _ORDER.get(name, ())
+                first = _SITE.get((name, a), "<unknown>")
+                edges = _ORDER.setdefault(a, set())
+                if name not in edges:
+                    edges.add(name)
+                    _SITE[(a, name)] = site
+            if inverted:
+                _record(
+                    "T101",
+                    f"lock order inversion: acquiring '{name}' while "
+                    f"holding '{a}' at {site}, but '{a}' was acquired "
+                    f"while holding '{name}' at {first} — opposite "
+                    "orders deadlock under contention", site)
+    finally:
+        _TLS.guard = False
+
+
+def _note_acquired(name: str):
+    _held().append([name, time.perf_counter(), _call_site(4)])
+
+
+def _note_released(name: str):
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] == name:
+            _, t0, site = held.pop(i)
+            if _HOLD_S > 0.0:
+                dur = time.perf_counter() - t0
+                if dur >= _HOLD_S:
+                    _TLS.guard = True
+                    try:
+                        _record(
+                            "T102",
+                            f"lock '{name}' held {dur * 1e3:.1f}ms "
+                            f"(acquired at {site}, threshold "
+                            f"{_HOLD_S * 1e3:.0f}ms) — shrink the "
+                            "critical section", site)
+                    finally:
+                        _TLS.guard = False
+            return
+
+
+class _NamedLock:
+    """Named proxy over a threading lock.  Delegates everything; armed,
+    it feeds the held stacks and the order graph."""
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name: str, inner=None):
+        self.name = name
+        self._lock = inner if inner is not None else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if not _ACTIVE or getattr(_TLS, "guard", False):
+            return self._lock.acquire(blocking, timeout)
+        _note_attempt(self.name)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            _note_acquired(self.name)
+        return ok
+
+    def release(self):
+        if _ACTIVE and not getattr(_TLS, "guard", False):
+            _note_released(self.name)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class _NamedCondition:
+    """Named proxy over ``threading.Condition``.  ``wait`` releases the
+    underlying lock, so the held-stack entry is popped for the wait's
+    duration (its hold time is split, not charged with the sleep) and
+    re-pushed on wakeup."""
+
+    __slots__ = ("name", "_cond")
+
+    def __init__(self, name: str, inner=None):
+        self.name = name
+        self._cond = inner if inner is not None else threading.Condition()
+
+    def acquire(self, *a) -> bool:
+        if not _ACTIVE or getattr(_TLS, "guard", False):
+            return self._cond.acquire(*a)
+        _note_attempt(self.name)
+        ok = self._cond.acquire(*a)
+        if ok:
+            _note_acquired(self.name)
+        return ok
+
+    def release(self):
+        if _ACTIVE and not getattr(_TLS, "guard", False):
+            _note_released(self.name)
+        self._cond.release()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not _ACTIVE or getattr(_TLS, "guard", False):
+            return self._cond.wait(timeout)
+        _note_released(self.name)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            _note_acquired(self.name)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        if not _ACTIVE or getattr(_TLS, "guard", False):
+            return self._cond.wait_for(predicate, timeout)
+        _note_released(self.name)
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            _note_acquired(self.name)
+
+    def notify(self, n: int = 1):
+        self._cond.notify(n)
+
+    def notify_all(self):
+        self._cond.notify_all()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+def lock(name: str) -> _NamedLock:
+    """A named ``threading.Lock`` the runtime witness can see."""
+    return _NamedLock(name)
+
+
+def rlock(name: str) -> _NamedLock:
+    """A named ``threading.RLock`` (re-entry is intended and legal)."""
+    return _NamedLock(name, threading.RLock())
+
+
+def condition(name: str) -> _NamedCondition:
+    """A named ``threading.Condition`` the runtime witness can see."""
+    return _NamedCondition(name)
+
+
+def diagnostics() -> List[Diagnostic]:
+    with _LOCK:
+        return list(_DIAGS)
+
+
+def order_edges() -> Dict[str, Set[str]]:
+    """Snapshot of the observed acquisition-order graph (tests)."""
+    with _LOCK:
+        return {k: set(v) for k, v in _ORDER.items()}
+
+
+def clear():
+    """Drop findings AND the learned order graph (test isolation)."""
+    global _DROPPED
+    with _LOCK:
+        _DIAGS.clear()
+        _WARNED.clear()
+        _ORDER.clear()
+        _SITE.clear()
+        _DROPPED = 0
+
+
+def enabled() -> bool:
+    return _ACTIVE
+
+
+def install(raise_on_violation: Optional[bool] = None,
+            hold_ms: Optional[float] = None):
+    """Arm the witness on every named lock already constructed (the
+    proxies read the module flag — nothing is rewrapped).  Idempotent."""
+    global _ACTIVE, _RAISE, _HOLD_S
+    if raise_on_violation is not None:
+        _RAISE = bool(raise_on_violation)
+    else:
+        _RAISE = env_mode() == "raise"
+    if hold_ms is None:
+        try:
+            hold_ms = float(
+                os.environ.get("MXNET_THREAD_CHECK_HOLD_MS", "") or 0.0)
+        except ValueError:
+            hold_ms = 0.0
+    _HOLD_S = max(0.0, float(hold_ms)) / 1e3
+    _ACTIVE = True
+
+
+def uninstall():
+    """Disarm and forget everything recorded."""
+    global _ACTIVE, _RAISE, _HOLD_S
+    _ACTIVE = False
+    _RAISE = False
+    _HOLD_S = 0.0
+    clear()
+
+
+# -- import-time arming (MXNET_THREAD_CHECK=1|raise in the environment;
+# the smoke gates run this way so the witness covers their whole run)
+if env_mode():
+    install()

@@ -1,0 +1,820 @@
+"""AST concurrency linter (the static T rules, T001..T006).
+
+The reference MXNet core is an async dependency engine whose
+correctness rests on concurrency discipline; this repo's Python
+equivalent (serve dispatcher/completer, DecodeServer worker, obs HTTP
+server, prefetcher, async checkpoint writer, flight watchdog) is
+checked here ahead of time, in the same spirit as the fixed-program
+serving model: everything dynamic about the threaded tier that CAN be
+verified statically IS.  Two passes:
+
+* **per-file model** — every module is walked once building a
+  lock/shared-state model: which module globals and ``self`` attributes
+  are locks (``threading.Lock/RLock/Condition`` or the
+  :mod:`~mxnet_tpu.analysis.thread_check` ``lock/rlock/condition``
+  factories), which methods are thread targets, which attributes each
+  method writes under which held locks, and where blocking calls happen
+  inside critical sections (T002 fires here).
+* **cross-module graph** — lock acquisitions are named
+  (``module.Class.attr`` / ``module.NAME``), so nested ``with`` blocks
+  and calls-while-holding stitch into one static acquisition graph
+  across the whole package; cycles are T003, lock re-entry reachable
+  through a direct call is T006, and the per-class model yields T001
+  (unlocked shared write), T004 (no join path), T005 (daemon thread
+  that writes files).
+
+The runtime twin (:mod:`~mxnet_tpu.analysis.thread_check`, T101/T102)
+witnesses the same properties in the live process.  Suppression:
+trailing ``# mxlint: disable=CODE`` (diagnostics.py).  Stdlib-only on
+purpose — ``tools/threadlint.py`` runs this without importing the
+framework, so the CI gate is sub-second.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .diagnostics import Diagnostic, is_suppressed, parse_suppressions
+from .hybrid_lint import _enclosing_symbols, iter_python_files
+
+__all__ = ["lint_source", "lint_file", "lint_paths"]
+
+# call tails that construct a lock-like primitive -> lock kind
+_LOCK_TAILS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition",
+               "Semaphore": "Lock", "BoundedSemaphore": "Lock",
+               "lock": "Lock", "rlock": "RLock", "condition": "Condition"}
+# call tails whose result is a threading/queue primitive (attributes so
+# assigned are synchronization plumbing, not shared data — T001 exempt)
+_PRIMITIVE_TAILS = set(_LOCK_TAILS) | {
+    "Event", "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+    "deque", "local", "Thread", "Barrier", "Semaphore"}
+
+# method calls that block the calling thread (T002 under a held lock)
+_BLOCKING_METHODS = {"join", "result", "getresponse"}
+# dotted calls that block
+_BLOCKING_DOTTED = {"time.sleep"}
+_BLOCKING_DOTTED_TAILS = {"urlopen"}
+# receiver-name heuristic for blocking .get(): queue-ish names only, so
+# dict.get() stays clean
+_QUEUEISH = ("q", "queue", "done", "jobs", "inbox", "results")
+
+# calls inside a daemon thread target that write durable state (T005)
+_FILE_WRITE_DOTTED = {
+    "os.replace", "os.rename", "os.makedirs", "os.remove", "os.unlink",
+    "os.rmdir", "shutil.rmtree", "shutil.move", "shutil.copy",
+    "shutil.copytree", "json.dump", "pickle.dump"}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_file_write_call(node: ast.Call) -> bool:
+    d = _dotted(node.func)
+    if d in _FILE_WRITE_DOTTED:
+        return True
+    if d == "open" or d.endswith(".open"):
+        mode = None
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        return isinstance(mode, str) and any(c in mode for c in "wax+")
+    return False
+
+
+class _Fn:
+    """One function/method's concurrency-relevant facts."""
+
+    __slots__ = ("qual", "cls", "name", "acquires", "calls_under",
+                 "writes_files", "local_thread_unjoined", "node")
+
+    def __init__(self, qual: str, cls: Optional[str], name: str, node):
+        self.qual = qual
+        self.cls = cls
+        self.name = name
+        # lock qual -> first acquire line (anywhere in this function)
+        self.acquires: Dict[str, int] = {}
+        # (held lock quals tuple, callee key, line); callee key is
+        # ("self", class, method) or ("mod", function-name)
+        self.calls_under: List[Tuple[Tuple[str, ...], tuple, int]] = []
+        self.writes_files = False
+        # (thread var name, spawn line) still unjoined at function end
+        self.local_thread_unjoined: List[Tuple[str, int]] = []
+        self.node = node
+
+
+class _Spawn:
+    """One threading.Thread construction site."""
+
+    __slots__ = ("binding", "line", "daemon", "target", "in_fn")
+
+    def __init__(self, binding, line, daemon, target, in_fn):
+        self.binding = binding    # ("attr", name) | ("name", id) |
+        #                           ("global", id) | ("none", "")
+        self.line = line
+        self.daemon = daemon      # True only for a literal daemon=True
+        self.target = target      # dotted target expression ("self._loop")
+        self.in_fn = in_fn        # _Fn the spawn happens in
+
+
+class _Class:
+    __slots__ = ("name", "methods", "writes", "thread_targets",
+                 "self_calls", "spawns", "joined_attrs",
+                 "primitive_attrs", "lock_attrs")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.methods: Dict[str, _Fn] = {}
+        # attr -> [(method name, frozenset(held lock quals), line, public)]
+        self.writes: Dict[str, List[tuple]] = {}
+        self.thread_targets: Set[str] = set()
+        self.self_calls: Dict[str, Set[str]] = {}
+        self.spawns: List[_Spawn] = []
+        self.joined_attrs: Set[str] = set()
+        self.primitive_attrs: Set[str] = set()
+        self.lock_attrs: Dict[str, str] = {}   # attr -> kind
+
+
+class _Module:
+    __slots__ = ("path", "modname", "locks", "functions", "classes",
+                 "diags", "symbols", "suppress", "name_joins",
+                 "module_spawns", "nested_edges", "imports")
+
+    def __init__(self, path: str, modname: str):
+        self.path = path
+        self.modname = modname
+        self.locks: Dict[str, str] = {}        # qual -> kind
+        self.functions: Dict[str, _Fn] = {}    # module-level fns by name
+        self.classes: Dict[str, _Class] = {}
+        self.diags: List[Diagnostic] = []
+        self.symbols: Dict[int, str] = {}
+        self.suppress = ({}, set())
+        self.name_joins: Set[str] = set()      # names .join()ed anywhere
+        self.module_spawns: List[_Spawn] = []
+        # (held qual, acquired qual, line) from lexically nested withs
+        self.nested_edges: List[Tuple[str, str, int]] = []
+        self.imports: Dict[str, str] = {}      # alias -> dotted module
+
+
+def _modname_of(path: str) -> str:
+    p = os.path.normpath(path)
+    if p.endswith(".py"):
+        p = p[:-3]
+    if os.path.basename(p) == "__init__":
+        p = os.path.dirname(p)
+    parts = [c for c in p.replace(os.sep, "/").split("/")
+             if c not in ("", ".", "..")]
+    return ".".join(parts) or "<module>"
+
+
+def _lock_kind_of_call(node: ast.AST) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    d = _dotted(node.func)
+    if not d:
+        return None
+    return _LOCK_TAILS.get(d.rsplit(".", 1)[-1])
+
+
+def _is_primitive_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    return bool(d) and d.rsplit(".", 1)[-1] in _PRIMITIVE_TAILS
+
+
+def _collect_imports(tree: ast.Module, modname: str) -> Dict[str, str]:
+    pkg_parts = modname.split(".")[:-1]
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                prefix = ".".join(base + ([node.module]
+                                          if node.module else []))
+            else:
+                prefix = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = (f"{prefix}.{a.name}"
+                                           if prefix else a.name)
+    return out
+
+
+class _FnWalker:
+    """Walk one function body with a lexical held-lock stack, emitting
+    T002 inline and collecting the facts the global passes need."""
+
+    def __init__(self, mod: _Module, cls: Optional[_Class], fn: _Fn):
+        self.mod = mod
+        self.cls = cls
+        self.fn = fn
+        self.held: List[str] = []
+        self.local_threads: Dict[str, int] = {}   # var -> spawn line
+        self.local_joins: Set[str] = set()
+        self.any_local_join = False
+        self.globals: Set[str] = set()
+
+    # -- resolution -------------------------------------------------------
+    def _resolve_lock(self, expr: ast.AST) -> Optional[Tuple[str, str]]:
+        """Lock expr -> (qualified name, kind) when statically known."""
+        d = _dotted(expr)
+        if not d:
+            return None
+        if d.startswith("self."):
+            attr = d[5:]
+            if self.cls and "." not in attr \
+                    and attr in self.cls.lock_attrs:
+                return (f"{self.mod.modname}.{self.cls.name}.{attr}",
+                        self.cls.lock_attrs[attr])
+            return None
+        if "." not in d:
+            qual = f"{self.mod.modname}.{d}"
+            if qual in self.mod.locks:
+                return qual, self.mod.locks[qual]
+            return None
+        head, attr = d.split(".", 1)
+        target_mod = self.mod.imports.get(head)
+        if target_mod and "." not in attr:
+            # foreign lock: kind unknown here — the global pass matches
+            # by name against the owning module's table
+            return f"{target_mod}.{attr}", ""
+        return None
+
+    def _record_acquire(self, qual: str, line: int):
+        self.fn.acquires.setdefault(qual, line)
+        for h in self.held:
+            if h != qual:
+                self.mod.nested_edges.append((h, qual, line))
+
+    # -- write/spawn/join collection --------------------------------------
+    def _self_attr_of_target(self, t: ast.AST) -> Optional[str]:
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            return t.attr
+        if isinstance(t, ast.Subscript):
+            return self._self_attr_of_target(t.value)
+        return None
+
+    def _note_write(self, attr: str, line: int):
+        if self.cls is None or self.fn.name.startswith("__"):
+            return
+        public = not self.fn.name.startswith("_")
+        self.cls.writes.setdefault(attr, []).append(
+            (self.fn.name, frozenset(self.held), line, public))
+
+    def _note_spawn(self, call: ast.Call, binding, line: int):
+        daemon = False
+        target = ""
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+            elif kw.arg == "target":
+                target = _dotted(kw.value)
+        sp = _Spawn(binding, line, daemon, target, self.fn)
+        if self.cls is not None:
+            self.cls.spawns.append(sp)
+            if target.startswith("self.") and "." not in target[5:]:
+                self.cls.thread_targets.add(target[5:])
+        else:
+            self.mod.module_spawns.append(sp)
+
+    # -- the walk ---------------------------------------------------------
+    def walk(self, body: Iterable[ast.stmt]):
+        for stmt in body:
+            self._stmt(stmt)
+        for name, line in self.local_threads.items():
+            if name not in self.local_joins and not self.any_local_join:
+                self.fn.local_thread_unjoined.append((name, line))
+
+    def _stmt(self, node: ast.stmt):
+        if isinstance(node, ast.With):
+            entered: List[str] = []
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    self._call(item.context_expr)
+                res = self._resolve_lock(item.context_expr)
+                if res is not None:
+                    self._record_acquire(res[0], node.lineno)
+                    self.held.append(res[0])
+                    entered.append(res[0])
+            for s in node.body:
+                self._stmt(s)
+            for _ in entered:
+                self.held.pop()
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = _Fn(f"{self.fn.qual}.{node.name}", None, node.name, node)
+            self.mod.functions.setdefault(node.name, sub)
+            w = _FnWalker(self.mod, self.cls, sub)
+            w.walk(node.body)
+            return
+        if isinstance(node, ast.Global):
+            self.globals.update(node.names)
+        if isinstance(node, ast.Assign):
+            self._assign(node)
+        elif isinstance(node, ast.AugAssign):
+            attr = self._self_attr_of_target(node.target)
+            if attr is not None:
+                self._note_write(attr, node.lineno)
+        # generic statement: nested statements recurse (except handlers
+        # included — their bodies are statements too), expressions are
+        # walked for calls
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt, ast.excepthandler)):
+                self._stmt(child)
+            else:
+                self._expr(child)
+
+    def _assign(self, node: ast.Assign):
+        value = node.value
+        is_thread = (isinstance(value, ast.Call)
+                     and _dotted(value.func).rsplit(".", 1)[-1] == "Thread")
+        for t in node.targets:
+            targets = t.elts if isinstance(t, ast.Tuple) else [t]
+            for tt in targets:
+                attr = self._self_attr_of_target(tt)
+                if attr is not None and self.cls is not None:
+                    kind = _lock_kind_of_call(value)
+                    if kind is not None:
+                        self.cls.lock_attrs[attr] = kind
+                        self.cls.primitive_attrs.add(attr)
+                    elif _is_primitive_call(value):
+                        self.cls.primitive_attrs.add(attr)
+                    else:
+                        self._note_write(attr, node.lineno)
+                    if is_thread:
+                        self._note_spawn(value, ("attr", attr),
+                                         node.lineno)
+                elif isinstance(tt, ast.Name):
+                    if is_thread:
+                        if tt.id in self.globals or self.cls is None \
+                                and self.fn.name == "<module>":
+                            self._note_spawn(value, ("global", tt.id),
+                                             node.lineno)
+                        else:
+                            self.local_threads[tt.id] = node.lineno
+                            self._note_spawn(value, ("name", tt.id),
+                                             node.lineno)
+
+    def _expr(self, node: ast.AST):
+        if isinstance(node, ast.Call):
+            self._call(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # deferred execution: not under the current holds
+        for child in ast.iter_child_nodes(node):
+            self._expr(child)
+
+    def _call(self, node: ast.Call):
+        d = _dotted(node.func)
+        tail = d.rsplit(".", 1)[-1] if d else ""
+        if not tail and isinstance(node.func, ast.Attribute):
+            # non-Name chain head (a call / subscript receiver):
+            # _dotted gives up, but the method name still matters —
+            # Thread(...).start() is the unbound-spawn repro
+            tail = node.func.attr
+        # unbound spawn: threading.Thread(...).start()
+        if tail == "start" and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Call):
+            inner = node.func.value
+            if _dotted(inner.func).rsplit(".", 1)[-1] == "Thread":
+                self._note_spawn(inner, ("none", ""), node.lineno)
+        # join bookkeeping (T004)
+        if tail == "join" and isinstance(node.func, ast.Attribute):
+            recv = _dotted(node.func.value)
+            if recv.startswith("self.") and self.cls is not None:
+                self.cls.joined_attrs.add(recv[5:].split(".")[0])
+            elif recv and "." not in recv:
+                self.mod.name_joins.add(recv)
+                self.local_joins.add(recv)
+                self.any_local_join = True
+        # self-call graph (T001 closure, T006/T003 resolution)
+        if d.startswith("self.") and "." not in d[5:] \
+                and self.cls is not None:
+            self.cls.self_calls.setdefault(self.fn.name,
+                                           set()).add(d[5:])
+            if self.held:
+                self.fn.calls_under.append(
+                    (tuple(self.held),
+                     ("self", self.cls.name, d[5:]), node.lineno))
+        elif d and "." not in d and self.held:
+            self.fn.calls_under.append(
+                (tuple(self.held), ("mod", d), node.lineno))
+        # T005 evidence
+        if _is_file_write_call(node):
+            self.fn.writes_files = True
+        # T002: blocking call while holding a lock
+        if self.held:
+            self._check_blocking(node, d, tail)
+        for child in ast.iter_child_nodes(node):
+            self._expr(child)
+
+    def _check_blocking(self, node: ast.Call, d: str, tail: str):
+        blocked = None
+        if tail in _BLOCKING_METHODS and isinstance(node.func,
+                                                    ast.Attribute):
+            blocked = f".{tail}()"
+        elif d in _BLOCKING_DOTTED or tail in _BLOCKING_DOTTED_TAILS:
+            blocked = f"{d}()"
+        elif tail == "wait" and isinstance(node.func, ast.Attribute):
+            res = self._resolve_lock(node.func.value)
+            recv_qual = res[0] if res else None
+            if recv_qual is None or recv_qual not in self.held:
+                blocked = f"wait on {_dotted(node.func.value) or '?'}"
+        elif tail == "get" and isinstance(node.func, ast.Attribute):
+            recv = _dotted(node.func.value).rsplit(".", 1)[-1].lower()
+            if recv in _QUEUEISH or recv.endswith("_q") \
+                    or "queue" in recv:
+                blocked = f".get() on {recv}"
+        if blocked is not None:
+            self.mod.diags.append(Diagnostic(
+                self.mod.path, node.lineno, "T002",
+                f"blocking call ({blocked}) while holding lock "
+                f"'{self.held[-1]}' — every thread needing that lock "
+                "stalls for the full block; move it outside the with "
+                "block", col=node.col_offset,
+                symbol=self.mod.symbols.get(node.lineno, self.fn.qual),
+                source="threadlint"))
+
+
+# -- per-file analysis --------------------------------------------------------
+
+def _analyze_source(source: str, path: str,
+                    modname: Optional[str] = None) -> Optional[_Module]:
+    mod = _Module(path, modname or _modname_of(path))
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        mod.diags.append(Diagnostic(path, e.lineno or 1, "X000",
+                                    f"syntax error: {e.msg}",
+                                    symbol="<parse>",
+                                    source="threadlint"))
+        mod.suppress = parse_suppressions(source)
+        return mod
+    mod.symbols = _enclosing_symbols(tree)
+    mod.suppress = parse_suppressions(source)
+    mod.imports = _collect_imports(tree, mod.modname)
+
+    # module-level locks first (withs in functions above the assignment
+    # still resolve)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            kind = _lock_kind_of_call(node.value)
+            if kind is not None:
+                mod.locks[f"{mod.modname}.{node.targets[0].id}"] = kind
+
+    # class lock/primitive attrs need a pre-pass so every method's
+    # resolver sees them regardless of definition order
+    classes = [n for n in tree.body if isinstance(n, ast.ClassDef)]
+    for cnode in classes:
+        c = _Class(cnode.name)
+        mod.classes[cnode.name] = c
+        for item in cnode.body:
+            if not isinstance(item, ast.FunctionDef):
+                continue
+            for sub in ast.walk(item):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            kind = _lock_kind_of_call(sub.value)
+                            if kind is not None:
+                                c.lock_attrs[t.attr] = kind
+        for attr, kind in c.lock_attrs.items():
+            mod.locks[f"{mod.modname}.{c.name}.{attr}"] = kind
+
+    for cnode in classes:
+        c = mod.classes[cnode.name]
+        for item in cnode.body:
+            if isinstance(item, ast.FunctionDef):
+                fn = _Fn(f"{c.name}.{item.name}", c.name, item.name, item)
+                c.methods[item.name] = fn
+                _FnWalker(mod, c, fn).walk(item.body)
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = _Fn(node.name, None, node.name, node)
+            mod.functions.setdefault(node.name, fn)
+            _FnWalker(mod, None, fn).walk(node.body)
+        elif not isinstance(node, ast.ClassDef):
+            # module-level statements (import-time spawns, withs)
+            fn = mod.functions.setdefault(
+                "<module>", _Fn("<module>", None, "<module>", node))
+            _FnWalker(mod, None, fn).walk([node])
+    return mod
+
+
+# -- global passes ------------------------------------------------------------
+
+def _thread_closure(c: _Class) -> Set[str]:
+    """Thread-target methods plus everything they self-call."""
+    seen: Set[str] = set()
+    frontier = list(c.thread_targets)
+    while frontier:
+        m = frontier.pop()
+        if m in seen:
+            continue
+        seen.add(m)
+        frontier.extend(c.self_calls.get(m, ()))
+    return seen
+
+
+def _check_t001(mod: _Module, c: _Class):
+    closure = _thread_closure(c)
+    if not closure:
+        return
+    for attr, sites in sorted(c.writes.items()):
+        if attr in c.primitive_attrs:
+            continue
+        tsites = [s for s in sites if s[0] in closure]
+        psites = [s for s in sites if s[3] and s[0] not in closure]
+        if not tsites or not psites:
+            continue
+        common = frozenset.intersection(
+            *[s[1] for s in tsites + psites])
+        if common:
+            continue
+        worst = min(tsites + psites, key=lambda s: (len(s[1]), s[2]))
+        mod.diags.append(Diagnostic(
+            mod.path, worst[2], "T001",
+            f"attribute 'self.{attr}' is written from thread-target "
+            f"method(s) {sorted({s[0] for s in tsites})} and public "
+            f"method(s) {sorted({s[0] for s in psites})} with no lock "
+            "held in common — the writes race",
+            symbol=f"{c.name}.{worst[0]}", source="threadlint"))
+
+
+def _check_t004_t005(mod: _Module, c: Optional[_Class],
+                     spawns: List[_Spawn]):
+    for sp in spawns:
+        where = sp.in_fn.qual
+        if sp.binding[0] == "attr":
+            if c is not None and sp.binding[1] not in c.joined_attrs:
+                mod.diags.append(Diagnostic(
+                    mod.path, sp.line, "T004",
+                    f"thread stored on 'self.{sp.binding[1]}' is never "
+                    "joined by any method of the class — shutdown "
+                    "cannot prove it finished", symbol=where,
+                    source="threadlint"))
+        elif sp.binding[0] == "none":
+            mod.diags.append(Diagnostic(
+                mod.path, sp.line, "T004",
+                "thread started without binding it to a name — nothing "
+                "can ever join it", symbol=where, source="threadlint"))
+        elif sp.binding[0] == "global":
+            if not mod.name_joins:
+                mod.diags.append(Diagnostic(
+                    mod.path, sp.line, "T004",
+                    f"module-global thread '{sp.binding[1]}' has no "
+                    "join anywhere in its module", symbol=where,
+                    source="threadlint"))
+        # local-name spawns are judged at function scope:
+    for fname, fn in (c.methods if c is not None
+                      else mod.functions).items():
+        for name, line in fn.local_thread_unjoined:
+            mod.diags.append(Diagnostic(
+                mod.path, line, "T004",
+                f"local thread '{name}' is started but never joined in "
+                f"'{fn.qual}' — the function returns with the thread "
+                "unaccounted for", symbol=fn.qual, source="threadlint"))
+        fn.local_thread_unjoined = []
+    # T005: daemon spawn whose target (plus its self-call closure)
+    # writes files
+    for sp in spawns:
+        if not sp.daemon or not sp.target:
+            continue
+        writers: List[str] = []
+        if sp.target.startswith("self.") and c is not None:
+            mname = sp.target[5:]
+            if "." not in mname:
+                todo = {mname}
+                seen: Set[str] = set()
+                while todo:
+                    m = todo.pop()
+                    if m in seen:
+                        continue
+                    seen.add(m)
+                    f = c.methods.get(m)
+                    if f is not None and f.writes_files:
+                        writers.append(m)
+                    todo.update(c.self_calls.get(m, ()))
+        elif "." not in sp.target:
+            f = mod.functions.get(sp.target)
+            if f is not None and f.writes_files:
+                writers.append(sp.target)
+        if writers:
+            mod.diags.append(Diagnostic(
+                mod.path, sp.line, "T005",
+                f"daemon=True thread target writes files (via "
+                f"{sorted(set(writers))}) — the interpreter kills "
+                "daemons mid-write at exit; give it a drained close "
+                "path and drop daemon, or stop writing from it",
+                symbol=sp.in_fn.qual, source="threadlint"))
+
+
+def _iter_fns(mod: _Module):
+    for fn in mod.functions.values():
+        yield None, fn
+    for c in mod.classes.values():
+        for fn in c.methods.values():
+            yield c, fn
+
+
+def _check_t006(mods: List[_Module]):
+    for mod in mods:
+        kinds: Dict[str, str] = dict(mod.locks)
+        for c, fn in _iter_fns(mod):
+            for held, callee, line in fn.calls_under:
+                target: Optional[_Fn] = None
+                if callee[0] == "self" and c is not None:
+                    target = c.methods.get(callee[2])
+                elif callee[0] == "mod":
+                    target = mod.functions.get(callee[1])
+                if target is None:
+                    continue
+                for h in held:
+                    if kinds.get(h) != "Lock":
+                        continue  # RLock/Condition re-entry is legal
+                    if h in target.acquires:
+                        mod.diags.append(Diagnostic(
+                            mod.path, line, "T006",
+                            f"'{fn.qual}' holds non-reentrant lock "
+                            f"'{h}' while calling '{target.qual}', "
+                            "which acquires it again — guaranteed "
+                            "self-deadlock on this path",
+                            symbol=mod.symbols.get(line, fn.qual),
+                            source="threadlint"))
+
+
+def _check_t003(mods: List[_Module]):
+    """Cycles in the cross-module static acquisition graph."""
+    edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+
+    # foreign references resolve by import alias ("bb.LOCK"), but the
+    # owning module's table keys by path-derived name ("pkg.bb.LOCK") —
+    # canonicalize by unique dotted suffix so the two spellings merge
+    known: Set[str] = set()
+    for mod in mods:
+        known.update(mod.locks)
+    by_suffix: Dict[str, Optional[str]] = {}
+    for q in known:
+        parts = q.split(".")
+        for i in range(1, len(parts)):
+            suf = ".".join(parts[i:])
+            by_suffix[suf] = None if suf in by_suffix else q
+
+    def canon(q: str) -> str:
+        if q in known:
+            return q
+        hit = by_suffix.get(q)
+        return hit if hit else q
+
+    def add(a: str, b: str, path: str, line: int):
+        a, b = canon(a), canon(b)
+        if a != b:
+            edges.setdefault(a, {}).setdefault(b, (path, line))
+
+    for mod in mods:
+        for a, b, line in mod.nested_edges:
+            add(a, b, mod.path, line)
+        for c, fn in _iter_fns(mod):
+            for held, callee, line in fn.calls_under:
+                target = None
+                if callee[0] == "self" and c is not None:
+                    target = c.methods.get(callee[2])
+                elif callee[0] == "mod":
+                    target = mod.functions.get(callee[1])
+                if target is None:
+                    continue
+                for h in held:
+                    for acq in target.acquires:
+                        add(h, acq, mod.path, line)
+    # Tarjan SCC over the name graph
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str):
+        work = [(v, iter(sorted(edges.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(edges.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(edges):
+        if v not in index:
+            strongconnect(v)
+    out: List[Diagnostic] = []
+    for comp in sccs:
+        members = set(comp)
+        # pick two opposing edges inside the component for the report
+        sites = []
+        for a in comp:
+            for b, (path, line) in sorted(edges.get(a, {}).items()):
+                if b in members:
+                    sites.append((a, b, path, line))
+        if not sites:
+            continue
+        a, b, path, line = sites[0]
+        detail = "; ".join(f"{x}->{y} at {os.path.basename(p)}:{ln}"
+                           for x, y, p, ln in sites[:4])
+        out.append(Diagnostic(
+            path, line, "T003",
+            f"lock-order inversion: locks {comp} form an acquisition "
+            f"cycle ({detail}) — opposite orders deadlock under "
+            "contention; pick one global order",
+            symbol=comp[0], source="threadlint"))
+    return out
+
+
+def _finalize(mods: List[_Module]) -> List[Diagnostic]:
+    by_path = {m.path: m for m in mods}
+    for mod in mods:
+        for c in mod.classes.values():
+            _check_t001(mod, c)
+            _check_t004_t005(mod, c, c.spawns)
+        _check_t004_t005(mod, None, mod.module_spawns)
+    _check_t006(mods)
+    cycle_diags = _check_t003(mods)
+    for d in cycle_diags:
+        owner = by_path.get(d.path)
+        (owner.diags if owner is not None else mods[0].diags).append(d)
+    out: List[Diagnostic] = []
+    for mod in mods:
+        per_line, file_wide = mod.suppress
+        kept = [d for d in mod.diags
+                if not is_suppressed(d, per_line, file_wide)]
+        out.extend(kept)
+    out.sort(key=lambda d: (d.path, d.line, d.col, d.code))
+    return out
+
+
+# -- entry points -------------------------------------------------------------
+
+def lint_source(source: str, path: str = "<string>") -> List[Diagnostic]:
+    mod = _analyze_source(source, path)
+    return _finalize([mod])
+
+
+def lint_file(path: str) -> List[Diagnostic]:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return lint_source(f.read(), path)
+
+
+def lint_paths(paths: Iterable[str]) -> List[Diagnostic]:
+    mods: List[_Module] = []
+    for f in iter_python_files(paths):
+        with open(f, "r", encoding="utf-8", errors="replace") as fh:
+            mods.append(_analyze_source(fh.read(), f))
+    if not mods:
+        return []
+    return _finalize(mods)

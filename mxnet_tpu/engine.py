@@ -21,6 +21,7 @@ from typing import Callable, Optional, Sequence
 
 from . import _native
 from . import telemetry as _tel
+from .analysis import thread_check as _tchk
 from .base import MXNetError, get_env
 from .resilience import chaos as _chaos
 from .trace import recorder as _tr
@@ -263,7 +264,7 @@ class NaiveEngine(Engine):
 # _op_registry under an integer id passed through the C ctx pointer and
 # popped exactly once, when the op runs.
 _op_registry = {}
-_op_lock = threading.Lock()
+_op_lock = _tchk.lock("engine.op_registry")
 _op_counter = 0
 
 
@@ -386,7 +387,7 @@ class NativeEngine(Engine):
 
 
 _engine: Optional[Engine] = None
-_engine_lock = threading.Lock()
+_engine_lock = _tchk.lock("engine.global")
 
 
 def get() -> Engine:

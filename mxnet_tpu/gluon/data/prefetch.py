@@ -154,7 +154,7 @@ class _Epoch:
         # helper thread (docs/tracing.md)
         self._corr = _tr.capture()
         self._thread = threading.Thread(target=self._produce,
-                                        name="mx-device-prefetch",
+                                        name="mx-prefetch",
                                         daemon=True)
         self._thread.start()
 

@@ -38,6 +38,7 @@ import threading
 from typing import Optional, Sequence
 
 from .. import telemetry as _tel
+from ..analysis import thread_check as _tchk
 from ..base import get_env
 from . import histogram as _histmod
 from .aggregate import FleetView, WorkerScrape, aggregate
@@ -66,7 +67,7 @@ HOT_TIMERS = ("serve.e2e_seconds", "serve.decode_step_seconds",
               "trainer.step_seconds", "dataloader.wait_seconds")
 
 _SERVER = None
-_LOCK = threading.Lock()
+_LOCK = _tchk.lock("obs.metrics_server")
 
 
 def enabled() -> bool:

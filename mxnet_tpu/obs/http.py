@@ -210,7 +210,7 @@ class MetricsServer:
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="mx-obs-metrics",
+            target=self._httpd.serve_forever, name="mx-obs-http",
             kwargs={"poll_interval": 0.5}, daemon=True)
         self._thread.start()
         if _tel._ENABLED:

@@ -220,7 +220,9 @@ def _with_deadline(fn: Callable, what: str, timeout: Optional[float]):
             done.set()
 
     t0 = _time.perf_counter()
-    th = threading.Thread(target=run, name=f"mx-dist-{what}", daemon=True)
+    # leaked on timeout by design (docstring) — T004 is the generic rule
+    th = threading.Thread(  # mxlint: disable=T004
+        target=run, name=f"mx-dist-{what}", daemon=True)
     th.start()
     if not done.wait(timeout):
         _tel.inc("dist.deadline_exceeded")

@@ -75,6 +75,7 @@ from contextlib import contextmanager
 from typing import Any, Callable, List, Optional, Union
 
 from .. import telemetry as _tel
+from ..analysis import thread_check as _tchk
 from ..base import MXNetError, get_env
 from ..trace import recorder as _tr
 from . import chaos as _chaos
@@ -306,7 +307,7 @@ class CheckpointManager:
         self.keep = max(1, int(keep))
         self.async_save = bool(async_save)
         self._errors: List[BaseException] = []
-        self._err_lock = threading.Lock()
+        self._err_lock = _tchk.lock("ckpt.errors")
         self._q: Optional[_queue.Queue] = None
         self._worker: Optional[threading.Thread] = None
         os.makedirs(self.directory, exist_ok=True)
@@ -495,7 +496,8 @@ class CheckpointManager:
         if self._worker is None:
             self._q = _queue.Queue()
             self._worker = threading.Thread(
-                target=self._run_worker, name="mx-ckpt-save", daemon=True)
+                target=self._run_worker, name="mx-ckpt-writer",
+                daemon=True)
             self._worker.start()
         self._q.put(job)
 

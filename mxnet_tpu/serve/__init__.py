@@ -44,6 +44,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from ..analysis import thread_check as _tchk
 from .coalescer import (ClosedError, RejectedError, Request, RequestQueue,
                         ServeFuture)
 from .decode import (DecodeEntry, DecodeFuture, DecodeServer, decode_server,
@@ -61,7 +62,7 @@ __all__ = ["Server", "Registry", "ModelEntry", "ServeFuture",
            "shutdown_decode"]
 
 _SERVER: Optional[Server] = None
-_LOCK = threading.Lock()
+_LOCK = _tchk.lock("serve.default_server")
 
 
 def default_server() -> Server:

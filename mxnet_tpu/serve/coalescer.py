@@ -20,6 +20,7 @@ from collections import deque
 from typing import Callable, List, Optional, Tuple
 
 from .. import telemetry as _tel
+from ..analysis import thread_check as _tchk
 from ..base import MXNetError
 
 __all__ = ["Request", "ServeFuture", "RejectedError", "ClosedError",
@@ -112,7 +113,7 @@ class RequestQueue:
     def __init__(self, max_depth: int):
         self.max_depth = max(1, int(max_depth))
         self._q: deque = deque()
-        self._cond = threading.Condition()
+        self._cond = _tchk.condition("serve.queue")
         self._closed = False
 
     def __len__(self) -> int:

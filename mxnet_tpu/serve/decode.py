@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as onp
 
 from .. import telemetry as _tel
+from ..analysis import thread_check as _tchk
 from ..base import MXNetError, get_env
 from ..gluon.block import HybridBlock, _flatten_nd
 from ..jit.bucketing import _Policy
@@ -292,7 +293,7 @@ class DecodeServer:
         self._queue_max = queue_max if queue_max is not None \
             else get_env("MXNET_SERVE_QUEUE_MAX", 1024, int)
         self._q: deque = deque()
-        self._cv = threading.Condition()
+        self._cv = _tchk.condition("serve.decode")
         self._closed = False
         self._seq = 0
         # worker-owned state
@@ -303,7 +304,8 @@ class DecodeServer:
         self._lens = onp.zeros(entry.slots, onp.int32)
         self._steps = 0
         self._thread = threading.Thread(
-            target=self._loop, name=f"mx-decode-{entry.name}", daemon=True)
+            target=self._loop, name=f"mx-decode-worker-{entry.name}",
+            daemon=True)
         self._thread.start()
 
     # ------------------------------------------------------------- API
@@ -501,7 +503,7 @@ class DecodeServer:
 
 # ----------------------------------------------------- module-level API
 _DECODE: Dict[str, DecodeServer] = {}
-_DLOCK = threading.Lock()
+_DLOCK = _tchk.lock("serve.decode_registry")
 
 
 def register_decode(name: str, block, **cfg) -> DecodeEntry:

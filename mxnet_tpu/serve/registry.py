@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as _onp
 
 from .. import telemetry as _tel
+from ..analysis import thread_check as _tchk
 from ..base import MXNetError
 from ..jit import ShapeBucketer
 from ..ndarray.ndarray import NDArray
@@ -238,7 +239,7 @@ class Registry:
     """Thread-safe name → :class:`ModelEntry` map."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _tchk.lock("serve.registry")
         self._entries: Dict[str, ModelEntry] = {}
 
     def register(self, name: str, block, bucketer=None, sample=None,

@@ -37,6 +37,7 @@ import time
 from typing import Any, List, Optional, Tuple
 
 from .. import telemetry as _tel
+from ..analysis import thread_check as _tchk
 from ..base import MXNetError, get_env
 from ..engine import BoundedInflight
 from ..trace import recorder as _tr
@@ -85,7 +86,7 @@ class Server:
             gauge="serve.inflight_batches", span="serve.stall",
             timer="serve.stall_seconds")
         self._done: _queue.Queue = _queue.Queue()
-        self._lock = threading.Lock()
+        self._lock = _tchk.lock("serve.server")
         self._started = False
         self._closed = False
         self._dispatcher: Optional[threading.Thread] = None
@@ -133,10 +134,10 @@ class Server:
             if self._started or self._closed:
                 return
             self._dispatcher = threading.Thread(
-                target=self._dispatch_loop, name="mx-serve-dispatch",
+                target=self._dispatch_loop, name="mx-serve-dispatcher",
                 daemon=True)
             self._completer = threading.Thread(
-                target=self._complete_loop, name="mx-serve-complete",
+                target=self._complete_loop, name="mx-serve-completer",
                 daemon=True)
             self._dispatcher.start()
             self._completer.start()

@@ -40,6 +40,7 @@ import time
 from typing import Optional
 
 from .. import base as _base
+from ..analysis import thread_check as _tchk
 from ..base import get_env
 from . import export as _export
 from . import recorder as _rec
@@ -48,7 +49,7 @@ __all__ = ["arm", "disarm", "armed", "dump", "dump_dir", "stall"]
 
 log = logging.getLogger(__name__)
 
-_LOCK = threading.Lock()
+_LOCK = _tchk.lock("trace.flight")
 _ARMED = False
 _DIR: Optional[str] = None
 _DUMPED = 0
@@ -160,7 +161,7 @@ def arm(directory: Optional[str] = None,
             _WATCHDOG_STOP.clear()
             _WATCHDOG = threading.Thread(
                 target=_watchdog_loop, args=(_HANG_TIMEOUT,),
-                name="mx-trace-watchdog", daemon=True)
+                name="mx-flight-watchdog", daemon=True)
             _WATCHDOG.start()
     return _DIR
 

@@ -46,6 +46,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import telemetry as _tel
+from ..analysis import thread_check as _tchk
 from ..base import get_env
 
 __all__ = ["span", "instant", "counter", "record_span", "correlate",
@@ -65,7 +66,7 @@ EPOCH_OFFSET: float = time.time() - time.perf_counter()
 # the watchdog by one event, never corrupts anything.
 _LAST_EVENT: float = 0.0
 
-_REG_LOCK = threading.Lock()
+_REG_LOCK = _tchk.lock("trace.registry")
 _STATES: "List[_ThreadState]" = []
 _MAX_STATES = 256  # dead-thread rings pruned past this
 _TLS = threading.local()

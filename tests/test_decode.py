@@ -456,3 +456,69 @@ def test_decode_module_api_and_eos(fresh_telemetry):
         serve.shutdown_decode(60.0)
     with pytest.raises(MXNetError):
         serve.decode_server("api_lm")
+
+
+def test_engine_check_no_false_positive_on_decode_worker(fresh_telemetry):
+    """ISSUE 17 satellite: the DecodeServer worker loop never ran under
+    the engine dependency checker.  With the checker active, a full
+    decode session — registration warmup, ragged generate() traffic from
+    concurrent clients at varying occupancy, drain + close — must
+    produce ZERO diagnostics, while a seeded under-declared push in the
+    same session is still caught (the checker is live, not disarmed)."""
+    import threading
+
+    from mxnet_tpu import engine
+    from mxnet_tpu.analysis import engine_check as echk
+
+    eng = echk.install()
+    echk.clear()
+    try:
+        try:  # drain any first-error left by earlier exception tests on
+            # the shared process-global engine (first error reports once)
+            eng.wait_for_all()
+        except MXNetError:
+            pass
+        lm = _tiny_transformer(seed=29)
+        entry = serve.DecodeEntry("echk_lm", lm, slots=2,
+                                  prompt_buckets=(4, 8),
+                                  capacity_buckets=(16,),
+                                  max_new_tokens=4)
+        srv = serve.DecodeServer(entry)
+        try:
+            prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9, 10], [11],
+                       [12, 13, 14]]
+            results = [None] * len(prompts)
+            errors = []
+
+            def client(i):
+                try:
+                    results[i] = srv.generate(prompts[i], timeout=60.0)
+                except Exception as e:  # noqa: BLE001
+                    errors.append((i, repr(e)))
+
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(len(prompts))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errors, errors
+            for p, toks in zip(prompts, results):
+                assert toks == _eager_greedy(lm, p, 4), f"prompt {p}"
+        finally:
+            srv.close(60.0)
+        assert echk.diagnostics() == [], \
+            [d.format() for d in echk.diagnostics()]
+        # ...and the checker is still live after the decode session
+        shared = mx.nd.array(onp.arange(4, dtype="f4"))
+        owner = engine.get().new_var()
+        echk.bind(shared, owner)
+        rogue = engine.get().new_var()
+        engine.get().push(lambda: shared.asnumpy(), write=[rogue],
+                          name="rogue")
+        engine.get().wait_for_var(rogue)
+        assert [d.code for d in echk.diagnostics()] == ["E001"]
+        engine.get().delete_var(owner)
+        engine.get().delete_var(rogue)
+    finally:
+        echk.uninstall()

@@ -14,7 +14,21 @@ import numpy as onp
 import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu.analysis import thread_check as _tchk
 from mxnet_tpu.base import MXNetError
+
+
+@pytest.fixture(autouse=True)
+def _witnessed():
+    """MXNET_THREAD_CHECK=1 semantics over the whole file: the lock
+    witness is armed across the exception/thread-safety traffic and
+    must end with ZERO findings (ISSUE 17)."""
+    _tchk.install(raise_on_violation=False)
+    _tchk.clear()
+    yield
+    diags = _tchk.diagnostics()
+    _tchk.uninstall()
+    assert not diags, [d.format() for d in diags]
 
 
 # ---------------------------------------------------------------------------

@@ -11,8 +11,23 @@ from __future__ import annotations
 import threading
 
 import numpy as onp
+import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu.analysis import thread_check as _tchk
+
+
+@pytest.fixture(autouse=True)
+def _witnessed():
+    """Every test in this file runs under MXNET_THREAD_CHECK=1
+    semantics: the lock witness is armed across the concurrent
+    inference traffic and must end with ZERO findings (ISSUE 17)."""
+    _tchk.install(raise_on_violation=False)
+    _tchk.clear()
+    yield
+    diags = _tchk.diagnostics()
+    _tchk.uninstall()
+    assert not diags, [d.format() for d in diags]
 
 
 def _run_threads(n, fn):

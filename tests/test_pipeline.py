@@ -147,6 +147,7 @@ def test_prefetcher_close_unblocks_waiting_consumer():
     threading.Timer(0.5, release.set).start()
     pf.close()
     assert done.wait(timeout=5.0), "consumer stayed blocked after close()"
+    t.join(timeout=5.0)
 
 
 def test_dataloader_prefetch_to_device_false_means_off():

@@ -1,0 +1,677 @@
+"""mx.analysis.thread_lint: the static T rules (ISSUE 17).
+
+Same proof obligation as the H/L rules in test_analysis.py: every code
+must catch a minimal repro AND pass a clean twin that does the same job
+the thread-safe way — the linter is only useful if the fix it
+recommends lints clean.  The cross-module T003 pass additionally gets a
+two-file repro (the inversion only exists when both modules' models are
+merged), and the CLI gets the same contract tests mxlint has.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from mxnet_tpu.analysis.diagnostics import RULES
+from mxnet_tpu.analysis.thread_lint import lint_paths, lint_source
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+def _lint(src: str, path: str = "mod.py"):
+    return lint_source(textwrap.dedent(src), path)
+
+
+# ---------------------------------------------------------------------------
+# T001 unlocked shared write
+# ---------------------------------------------------------------------------
+
+def test_t001_fires_on_unlocked_shared_write():
+    diags = _lint("""\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                self._count = self._count + 1
+
+            def reset(self):
+                self._count = 0
+
+            def close(self):
+                self._thread.join()
+        """)
+    assert "T001" in _codes(diags)
+    (d,) = [d for d in diags if d.code == "T001"]
+    assert "_count" in d.message
+
+
+def test_t001_clean_when_both_sides_hold_the_lock():
+    diags = _lint("""\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                with self._lock:
+                    self._count = self._count + 1
+
+            def reset(self):
+                with self._lock:
+                    self._count = 0
+
+            def close(self):
+                self._thread.join()
+        """)
+    assert "T001" not in _codes(diags)
+
+
+def test_t001_primitive_attrs_exempt():
+    # rebinding an Event/Queue attribute is synchronization plumbing,
+    # not shared data
+    diags = _lint("""\
+        import queue
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._q = queue.Queue()
+                self._stop = threading.Event()
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                self._stop = threading.Event()
+
+            def restart(self):
+                self._q = queue.Queue()
+
+            def close(self):
+                self._thread.join()
+        """)
+    assert "T001" not in _codes(diags)
+
+
+# ---------------------------------------------------------------------------
+# T002 blocking call under a held lock
+# ---------------------------------------------------------------------------
+
+def test_t002_fires_on_join_under_lock():
+    diags = _lint("""\
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                pass
+
+            def close(self):
+                with self._lock:
+                    self._thread.join()
+        """)
+    assert "T002" in _codes(diags)
+
+
+def test_t002_clean_when_join_moves_outside():
+    diags = _lint("""\
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                pass
+
+            def close(self):
+                with self._lock:
+                    t = self._thread
+                self._thread.join()
+        """)
+    assert "T002" not in _codes(diags)
+
+
+def test_t002_fires_on_sleep_and_foreign_wait_under_lock():
+    diags = _lint("""\
+        import threading
+        import time
+
+        _LOCK = threading.Lock()
+        _EVT = threading.Event()
+
+        def poll():
+            with _LOCK:
+                time.sleep(1.0)
+
+        def wait_evt():
+            with _LOCK:
+                _EVT.wait(5.0)
+        """)
+    assert _codes(diags).count("T002") == 2
+
+
+def test_t002_condition_wait_on_own_lock_is_clean():
+    # cv.wait() RELEASES the cv's own lock — the canonical pattern must
+    # not fire
+    diags = _lint("""\
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._items = []
+
+            def take(self):
+                with self._cond:
+                    while not self._items:
+                        self._cond.wait()
+                    return self._items.pop()
+        """)
+    assert "T002" not in _codes(diags)
+
+
+def test_t002_dict_get_under_lock_is_clean():
+    diags = _lint("""\
+        import threading
+
+        _LOCK = threading.Lock()
+        _TAB = {}
+
+        def lookup(k):
+            with _LOCK:
+                return _TAB.get(k)
+        """)
+    assert "T002" not in _codes(diags)
+
+
+def test_t002_queue_get_under_lock_fires():
+    diags = _lint("""\
+        import queue
+        import threading
+
+        _LOCK = threading.Lock()
+        _Q = queue.Queue()
+
+        def drain(q):
+            with _LOCK:
+                return q.get()
+        """)
+    assert "T002" in _codes(diags)
+
+
+# ---------------------------------------------------------------------------
+# T003 static lock-order inversion (incl. cross-module)
+# ---------------------------------------------------------------------------
+
+def test_t003_fires_on_nested_with_inversion():
+    diags = _lint("""\
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def forward():
+            with _A:
+                with _B:
+                    pass
+
+        def backward():
+            with _B:
+                with _A:
+                    pass
+        """)
+    assert "T003" in _codes(diags)
+
+
+def test_t003_clean_on_consistent_order():
+    diags = _lint("""\
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def forward():
+            with _A:
+                with _B:
+                    pass
+
+        def also_forward():
+            with _A:
+                with _B:
+                    pass
+        """)
+    assert "T003" not in _codes(diags)
+
+
+def test_t003_cross_module_inversion(tmp_path):
+    # neither file has a cycle alone; merged, aa.LOCK -> bb.LOCK and
+    # bb.LOCK -> aa.LOCK close one.  Import-alias resolution is what
+    # stitches the names together.
+    (tmp_path / "aa.py").write_text(textwrap.dedent("""\
+        import threading
+        import bb
+
+        LOCK = threading.Lock()
+
+        def down():
+            with LOCK:
+                with bb.LOCK:
+                    pass
+        """))
+    (tmp_path / "bb.py").write_text(textwrap.dedent("""\
+        import threading
+        import aa
+
+        LOCK = threading.Lock()
+
+        def up():
+            with LOCK:
+                with aa.LOCK:
+                    pass
+        """))
+    diags = lint_paths([str(tmp_path)])
+    assert "T003" in _codes(diags)
+    (d,) = [d for d in diags if d.code == "T003"]
+    assert "aa.LOCK" in d.message and "bb.LOCK" in d.message
+
+
+def test_t003_interprocedural_call_while_holding(tmp_path):
+    src = """\
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def inner():
+            with _A:
+                pass
+
+        def outer():
+            with _B:
+                inner()
+
+        def opposite():
+            with _A:
+                with _B:
+                    pass
+        """
+    diags = _lint(src)
+    assert "T003" in _codes(diags)
+
+
+# ---------------------------------------------------------------------------
+# T004 unjoined thread
+# ---------------------------------------------------------------------------
+
+def test_t004_fires_on_attr_thread_without_join():
+    diags = _lint("""\
+        import threading
+
+        class Loop:
+            def start(self):
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                pass
+        """)
+    assert "T004" in _codes(diags)
+
+
+def test_t004_clean_when_a_method_joins():
+    diags = _lint("""\
+        import threading
+
+        class Loop:
+            def start(self):
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                pass
+
+            def close(self):
+                self._thread.join(timeout=5.0)
+        """)
+    assert "T004" not in _codes(diags)
+
+
+def test_t004_fires_on_unbound_spawn():
+    diags = _lint("""\
+        import threading
+
+        def fire_and_forget(fn):
+            threading.Thread(target=fn).start()
+        """)
+    assert "T004" in _codes(diags)
+
+
+def test_t004_fires_on_local_unjoined_and_clean_with_join():
+    bad = _lint("""\
+        import threading
+
+        def run(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+        """)
+    good = _lint("""\
+        import threading
+
+        def run(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        """)
+    assert "T004" in _codes(bad)
+    assert "T004" not in _codes(good)
+
+
+def test_t004_suppression_comment_works():
+    diags = _lint("""\
+        import threading
+
+        def fire_and_forget(fn):
+            t = threading.Thread(  # mxlint: disable=T004
+                target=fn, daemon=True)
+            t.start()
+        """)
+    assert "T004" not in _codes(diags)
+
+
+# ---------------------------------------------------------------------------
+# T005 daemon thread writing files
+# ---------------------------------------------------------------------------
+
+def test_t005_fires_on_daemon_file_writer():
+    diags = _lint("""\
+        import json
+        import os
+        import threading
+
+        class Saver:
+            def start(self):
+                self._thread = threading.Thread(target=self._save,
+                                                daemon=True)
+                self._thread.start()
+
+            def _save(self):
+                with open("state.json", "w") as f:
+                    json.dump({}, f)
+                os.replace("state.json.tmp", "state.json")
+
+            def close(self):
+                self._thread.join()
+        """)
+    assert "T005" in _codes(diags)
+
+
+def test_t005_clean_without_daemon_flag():
+    diags = _lint("""\
+        import json
+        import threading
+
+        class Saver:
+            def start(self):
+                self._thread = threading.Thread(target=self._save)
+                self._thread.start()
+
+            def _save(self):
+                with open("state.json", "w") as f:
+                    json.dump({}, f)
+
+            def close(self):
+                self._thread.join()
+        """)
+    assert "T005" not in _codes(diags)
+
+
+def test_t005_clean_daemon_reader():
+    diags = _lint("""\
+        import threading
+
+        class Poller:
+            def start(self):
+                self._thread = threading.Thread(target=self._poll,
+                                                daemon=True)
+                self._thread.start()
+
+            def _poll(self):
+                with open("state.json") as f:
+                    f.read()
+
+            def close(self):
+                self._thread.join()
+        """)
+    assert "T005" not in _codes(diags)
+
+
+# ---------------------------------------------------------------------------
+# T006 non-reentrant lock re-entry through a call
+# ---------------------------------------------------------------------------
+
+def test_t006_fires_on_lock_reentry_via_self_call():
+    diags = _lint("""\
+        import threading
+
+        class Reg:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._tab = {}
+
+            def get(self, k):
+                with self._lock:
+                    return self._tab.get(k)
+
+            def get_or_make(self, k):
+                with self._lock:
+                    return self.get(k)
+        """)
+    assert "T006" in _codes(diags)
+
+
+def test_t006_clean_with_rlock():
+    diags = _lint("""\
+        import threading
+
+        class Reg:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._tab = {}
+
+            def get(self, k):
+                with self._lock:
+                    return self._tab.get(k)
+
+            def get_or_make(self, k):
+                with self._lock:
+                    return self.get(k)
+        """)
+    assert "T006" not in _codes(diags)
+
+
+def test_t006_clean_with_unlocked_helper():
+    diags = _lint("""\
+        import threading
+
+        class Reg:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._tab = {}
+
+            def _get_locked(self, k):
+                return self._tab.get(k)
+
+            def get(self, k):
+                with self._lock:
+                    return self._get_locked(k)
+
+            def get_or_make(self, k):
+                with self._lock:
+                    return self._get_locked(k)
+        """)
+    assert "T006" not in _codes(diags)
+
+
+# ---------------------------------------------------------------------------
+# thread_check factory locks are first-class lock constructions
+# ---------------------------------------------------------------------------
+
+def test_factory_locks_resolve_like_threading_locks():
+    diags = _lint("""\
+        from mxnet_tpu.analysis import thread_check as _tchk
+
+        _A = _tchk.lock("a")
+        _B = _tchk.lock("b")
+
+        def forward():
+            with _A:
+                with _B:
+                    pass
+
+        def backward():
+            with _B:
+                with _A:
+                    pass
+        """)
+    assert "T003" in _codes(diags)
+
+
+def test_factory_rlock_reentry_is_legal():
+    diags = _lint("""\
+        from mxnet_tpu.analysis import thread_check as _tchk
+
+        class Reg:
+            def __init__(self):
+                self._lock = _tchk.rlock("reg")
+
+            def get(self):
+                with self._lock:
+                    return 1
+
+            def outer(self):
+                with self._lock:
+                    return self.get()
+        """)
+    assert "T006" not in _codes(diags)
+
+
+# ---------------------------------------------------------------------------
+# rule catalog + CLI
+# ---------------------------------------------------------------------------
+
+def test_t_rules_documented():
+    for code in ("T001", "T002", "T003", "T004", "T005", "T006",
+                 "T101", "T102"):
+        assert code in RULES, f"{code} missing from diagnostics.RULES"
+        title, why, fix = RULES[code]
+        assert title and why and fix
+
+
+def _run_threadlint(args, cwd=ROOT):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "threadlint.py")]
+        + args, capture_output=True, text=True, cwd=cwd)
+
+
+def test_threadlint_cli_json_and_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""\
+        import threading
+
+        def leak(fn):
+            threading.Thread(target=fn).start()
+        """))
+    r = _run_threadlint(["--format=json", str(bad)])
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["tool"] == "threadlint"
+    assert [d["code"] for d in doc["diagnostics"]] == ["T004"]
+
+    clean = tmp_path / "ok.py"
+    clean.write_text("x = 1\n")
+    r = _run_threadlint(["--format=json", str(clean)])
+    assert r.returncode == 0
+    assert json.loads(r.stdout)["diagnostics"] == []
+
+
+def test_threadlint_cli_rules_lists_only_t_rules():
+    r = _run_threadlint(["--rules"])
+    assert r.returncode == 0
+    codes = [ln.split()[0] for ln in r.stdout.splitlines() if ln.strip()]
+    assert "T001" in codes and "T101" in codes
+    assert all(c.startswith("T") for c in codes), codes
+
+
+def test_threadlint_cli_explain():
+    r = _run_threadlint(["--explain", "T003"])
+    assert r.returncode == 0
+    assert "T003" in r.stdout
+
+
+def test_threadlint_baseline_roundtrip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""\
+        import threading
+
+        def leak(fn):
+            threading.Thread(target=fn).start()
+        """))
+    bl = tmp_path / "bl.json"
+    r = _run_threadlint(["--write-baseline", "--baseline", str(bl),
+                         str(bad)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    # baselined: same finding no longer fails
+    r = _run_threadlint(["--baseline", str(bl), str(bad)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    # a NEW finding still does
+    bad.write_text(bad.read_text() + textwrap.dedent("""\
+
+        def leak2(fn):
+            threading.Thread(target=fn).start()
+        """))
+    r = _run_threadlint(["--baseline", str(bl), str(bad)])
+    assert r.returncode == 1
+
+
+def test_threadlint_tree_is_clean():
+    """Acceptance: the in-tree sources lint clean under the committed
+    baseline (the CI gate `make lint-threads`)."""
+    r = _run_threadlint(["--baseline",
+                         os.path.join("tools", "threadlint_baseline.json"),
+                         "mxnet_tpu", "tools"])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_mxlint_cli_still_intact():
+    """The CLI dedup (lint_cli) must not change mxlint's contract."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mxlint.py"),
+         "--rules"], capture_output=True, text=True, cwd=ROOT)
+    assert r.returncode == 0
+    codes = [ln.split()[0] for ln in r.stdout.splitlines() if ln.strip()]
+    assert "H001" in codes  # hybridize rules still listed
+    assert not any(c.startswith("T") for c in codes), \
+        "mxlint must not list T rules (threadlint owns them)"

@@ -183,7 +183,7 @@ def test_prefetcher_producer_spans_carry_owner_correlation():
     fetches = [e for e in trace.events() if e["name"] == "pipeline.fetch"]
     assert fetches, "producer thread recorded no pipeline.fetch spans"
     assert all(e["corr"].get("step") == 41 for e in fetches)
-    assert all(e["thread"] == "mx-device-prefetch" for e in fetches)
+    assert all(e["thread"] == "mx-prefetch" for e in fetches)
     # the producer labels each batch it stages; the last fetch span is
     # the end-of-epoch StopIteration probe (marked with an error attr)
     good = [e for e in fetches if not (e["attrs"] or {}).get("error")]

@@ -211,11 +211,24 @@ def make_row(decode, platform="cpu"):
             "platform": platform, "ts": round(time.time(), 1)}
 
 
+
+def thread_check_gate(report):
+    """Zero-findings gate for the runtime lock witness: the Makefile
+    recipe arms MXNET_THREAD_CHECK=raise, so any inversion/long-hold in
+    the decode path fails the smoke (docs/analysis.md T1xx rules)."""
+    from mxnet_tpu.analysis import thread_check as tchk
+
+    diags = tchk.diagnostics() if tchk.enabled() else []
+    report["thread_check"] = {"armed": tchk.enabled(),
+                              "findings": [d.to_dict() for d in diags]}
+    return not diags
+
 def main():
     report = {"live": False, "platform": "cpu"}
     entry, ok = build_entry(report)
     ok = donation_gate(entry, report) and ok
     ok = decode_phases(entry, report) and ok
+    ok = thread_check_gate(report) and ok
     report["row"] = make_row(report["decode"])
     report["ok"] = bool(ok)
     out = os.path.join(ROOT, "decode_smoke.json")

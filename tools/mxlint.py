@@ -17,15 +17,14 @@ Exit codes: 0 clean (or fully baselined), 1 new violations, 2 usage.
 
 The analysis package is loaded standalone (no framework / jax import),
 so a full-tree lint is sub-second — cheap enough for a pre-commit hook.
+All CLI plumbing (baselines, output formats, catalog access) is shared
+with tools/threadlint.py via mx.analysis.lint_cli.
 """
 from __future__ import annotations
 
-import argparse
 import importlib.util
-import json
 import os
 import sys
-from collections import Counter
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -46,109 +45,14 @@ def load_analysis():
     return mod
 
 
-def load_baseline(path: str) -> Counter:
-    """Baseline = counts per diagnostic fingerprint (line-drift proof)."""
-    if not path or not os.path.exists(path):
-        return Counter()
-    with open(path) as f:
-        doc = json.load(f)
-    return Counter(doc.get("fingerprints", {}))
-
-
-def write_baseline(path: str, diags) -> None:
-    fps = Counter(d.fingerprint() for d in diags)
-    doc = {"version": 1,
-           "comment": "legacy mxlint violations; regenerate with "
-                      "tools/mxlint.py --write-baseline --baseline "
-                      + os.path.relpath(path, ROOT),
-           "fingerprints": dict(sorted(fps.items()))}
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=2, sort_keys=True)
-        f.write("\n")
-
-
-def split_new(diags, baseline: Counter):
-    """Diagnostics beyond the baselined count per fingerprint."""
-    budget = Counter(baseline)
-    new, known = [], []
-    for d in diags:
-        fp = d.fingerprint()
-        if budget[fp] > 0:
-            budget[fp] -= 1
-            known.append(d)
-        else:
-            new.append(d)
-    return new, known
-
-
 def main(argv=None) -> int:
-    p = argparse.ArgumentParser(
-        description=__doc__,
-        formatter_class=argparse.RawDescriptionHelpFormatter)
-    p.add_argument("paths", nargs="*", help="files or directories to lint")
-    p.add_argument("--format", choices=["text", "json"], default="text")
-    p.add_argument("--baseline", default="",
-                   help="baseline JSON; diagnostics in it do not fail")
-    p.add_argument("--write-baseline", action="store_true",
-                   help="record current diagnostics as the new baseline")
-    p.add_argument("--explain", metavar="CODE",
-                   help="print the rationale + fix for one rule code")
-    p.add_argument("--rules", action="store_true",
-                   help="list the full rule catalog")
-    args = p.parse_args(argv)
-
     ana = load_analysis()
-    if args.explain:
-        print(ana.rule_doc(args.explain))
-        return 0 if args.explain in ana.RULES else 2
-    if args.rules:
-        for code in sorted(ana.RULES):
-            title, why, _ = ana.RULES[code]
-            print(f"{code}  {title:<24} {why.splitlines()[0][:80]}")
-        return 0
-    if not args.paths:
-        p.error("no paths given (or use --rules / --explain)")
-    missing = [pa for pa in args.paths if not os.path.exists(pa)]
-    if missing:
-        # a silently-skipped path would turn the CI gate into a no-op
-        p.error(f"path(s) do not exist: {', '.join(missing)}")
-
-    diags = ana.lint_paths(args.paths)
-    # paths relative to repo root keep fingerprints stable across
-    # checkouts and invocation cwds
-    for d in diags:
-        d.path = os.path.relpath(os.path.abspath(d.path), ROOT)
-
-    if args.write_baseline:
-        if not args.baseline:
-            p.error("--write-baseline needs --baseline FILE")
-        write_baseline(args.baseline, diags)
-        print(f"baseline written: {args.baseline} "
-              f"({len(diags)} diagnostics)")
-        return 0
-
-    baseline = load_baseline(args.baseline)
-    new, known = split_new(diags, baseline)
-
-    if args.format == "json":
-        doc = ana.to_json(new, tool="mxlint",
-                          baselined=[d.to_dict() for d in known],
-                          checked_paths=list(args.paths))
-        json.dump(doc, sys.stdout, indent=2, sort_keys=True)
-        sys.stdout.write("\n")
-    else:
-        for d in new:
-            print(d.format())
-        if known:
-            print(f"({len(known)} baselined violation(s) not shown; "
-                  "see --baseline)")
-        if new:
-            print(f"\n{len(new)} new violation(s). Fix them, suppress "
-                  "intentional ones with '# mxlint: disable=CODE', or "
-                  "re-baseline.")
-        else:
-            print("clean.")
-    return 1 if new else 0
+    # the concurrency family (T) belongs to tools/threadlint.py; the
+    # two tools partition the catalog
+    return ana.lint_cli.run(argv, tool="mxlint",
+                            lint_paths_fn=ana.lint_paths, root=ROOT,
+                            rule_prefixes=("H", "L", "E", "X"),
+                            description=__doc__)
 
 
 if __name__ == "__main__":

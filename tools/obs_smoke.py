@@ -201,12 +201,20 @@ def main() -> int:
             except Exception:
                 w.kill()
 
+    # runtime lock witness (Makefile arms MXNET_THREAD_CHECK=raise):
+    # any inversion/long-hold in the obs/serve path fails the gate
+    from mxnet_tpu.analysis import thread_check as tchk
+    tc_diags = tchk.diagnostics() if tchk.enabled() else []
+    checks["thread_check_armed"] = tchk.enabled()
+    checks["thread_check_findings"] = len(tc_diags)
+
     ok = (checks["midload_all_200"]
           and checks["counts_match"]
           and checks["readyz_ok"]
           and checks["overhead_ratio"] <= MAX_OVERHEAD
           and checks["fleet_merge_exact"]
-          and checks["fleet_partial_flagged"])
+          and checks["fleet_partial_flagged"]
+          and not tc_diags)
 
     out_path = os.environ.get("MXNET_OBS_SMOKE_JSON") or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

@@ -214,11 +214,24 @@ def make_row(load, platform="cpu"):
             "platform": platform, "ts": round(time.time(), 1)}
 
 
+
+def thread_check_gate(report):
+    """Zero-findings gate for the runtime lock witness: the Makefile
+    recipe arms MXNET_THREAD_CHECK=raise, so any inversion/long-hold in
+    the serve path fails the smoke (docs/analysis.md T1xx rules)."""
+    from mxnet_tpu.analysis import thread_check as tchk
+
+    diags = tchk.diagnostics() if tchk.enabled() else []
+    report["thread_check"] = {"armed": tchk.enabled(),
+                              "findings": [d.to_dict() for d in diags]}
+    return not diags
+
 def main():
     report = {"live": False, "platform": "cpu"}
     reg = build_registry()
     ok = load_phases(reg, report)
     ok = shed_phase(reg, report) and ok
+    ok = thread_check_gate(report) and ok
     # the bench-style row: serving enters the perf trajectory
     report["row"] = make_row(report["load"])
     report["ok"] = bool(ok)

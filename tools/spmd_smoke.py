@@ -210,7 +210,7 @@ def pp_case(report):
     for _ in range(20):
         for _k in range(m):
             loss = tr_pp.step(x, y, block=True)
-        l_pp.append(float(loss))
+        l_pp.append(float(loss))  # mxlint: disable=L102
     max_dloss = max(abs(a - b) / max(abs(a), 1.0)
                     for a, b in zip(l_ref, l_pp))
     bubble = _tel.snapshot().get("trainer.pp_bubble_fraction", {})
@@ -331,7 +331,7 @@ def compose_3d_case(report):
     for _ in range(6):
         for _k in range(m):
             loss = tr.step(x, y, block=True)
-        l_3d.append(float(loss))
+        l_3d.append(float(loss))  # mxlint: disable=L102
     max_dloss = max(abs(a - b) / max(abs(a), 1.0)
                     for a, b in zip(l_ref, l_3d))
     n_sharded = sum(1 for s in tr.specs

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""threadlint — concurrency linter CLI over mx.analysis.thread_lint.
+
+Static T-rule analysis of the threaded serving tier (rule catalog:
+docs/analysis.md, ``--rules`` to list, ``--explain CODE`` for one):
+unlocked shared writes (T001), blocking calls under a held lock (T002),
+lock-order inversions in the cross-module acquisition graph (T003),
+threads with no join path (T004), daemon threads that write files
+(T005), and reachable lock re-entry (T006).  The runtime twin
+(``MXNET_THREAD_CHECK=1|raise``) witnesses T101/T102 in live runs.
+
+Usage:
+  python tools/threadlint.py mxnet_tpu/ tools/
+  python tools/threadlint.py --format=json --baseline tools/threadlint_baseline.json <paths>
+  python tools/threadlint.py --write-baseline --baseline tools/threadlint_baseline.json <paths>
+  python tools/threadlint.py --explain T003
+  python tools/threadlint.py --rules
+
+Exit codes: 0 clean (or fully baselined), 1 new violations, 2 usage.
+
+The analysis package is loaded standalone (no framework / jax import),
+so the full-tree lint is sub-second — the ``make lint-threads`` CI
+gate.  All CLI plumbing is shared with tools/mxlint.py via
+mx.analysis.lint_cli.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_analysis():
+    """Load mxnet_tpu.analysis WITHOUT executing mxnet_tpu/__init__.py
+    (which imports jax).  The package is stdlib-only by contract."""
+    name = "_mxlint_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(ROOT, "mxnet_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ana = load_analysis()
+    return ana.lint_cli.run(argv, tool="threadlint",
+                            lint_paths_fn=ana.thread_lint_paths,
+                            root=ROOT, rule_prefixes=("T",),
+                            description=__doc__)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
