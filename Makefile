@@ -17,8 +17,8 @@ BUILD    := build
 
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
-	trace-smoke kernels-smoke serve-smoke decode-smoke obs-smoke \
-	lint-hybrid lint-threads lint-graph ci clean
+	trace-smoke kernels-smoke serve-smoke decode-smoke disagg-smoke \
+	obs-smoke lint-hybrid lint-threads lint-graph ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -153,6 +153,18 @@ decode-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		MXNET_THREAD_CHECK=raise python tools/decode_smoke.py
 
+disagg-smoke:
+	# disaggregated prefill/decode gate (docs/serving.md): the same mixed
+	# long-prompt/short-decode open-loop workload through a unified and a
+	# prefill-pooled server — disaggregated TTFT p99 must beat unified,
+	# prefix-cache hits must skip serve.prefill_seconds entirely with
+	# bit-exact greedy outputs and beat cold tokens/s, ZERO compiles
+	# after warmup on both pools, xlalint-clean, and no mx-* thread may
+	# survive close().  Serial — single-core box, never concurrent with
+	# tier-1.
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		MXNET_THREAD_CHECK=raise python tools/disagg_smoke.py
+
 obs-smoke:
 	# mx.obs gate: LeNet served with the metrics endpoint armed — a
 	# second thread scraping /metrics + /statusz mid-load gets all
@@ -196,7 +208,8 @@ lint-graph:
 ci: native native-test asan tsan lint-hybrid lint-threads lint-graph \
 	test test-slow \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
-	trace-smoke kernels-smoke serve-smoke decode-smoke obs-smoke
+	trace-smoke kernels-smoke serve-smoke decode-smoke disagg-smoke \
+	obs-smoke
 
 clean:
 	rm -rf $(BUILD)

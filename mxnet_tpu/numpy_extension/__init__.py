@@ -537,6 +537,20 @@ def cache_append(cache, new, lengths, out=None):
                 (cache, new, lengths), {}, name="cache_append", out=out)
 
 
+def cache_page_copy(dst, src, n_pages, src_start=0, dst_start=0, dst_row=0,
+                    out=None):
+    """Copy ``n_pages`` capacity-axis pages of a (B, H, C_s, D) KV cache
+    into row ``dst_row`` of a (B_d, H, C_d, D) cache
+    (ops/attention.cache_page_copy) — the device half of the
+    prefill→decode cache shipment; ``n_pages`` static, offsets traced."""
+    from ..ops import attention as _att
+
+    return call(lambda d, s, r: _att.cache_page_copy(
+        d, s, int(n_pages), src_start=int(src_start),
+        dst_start=int(dst_start), dst_row=r),
+        (dst, src, dst_row), {}, name="cache_page_copy", out=out)
+
+
 def flash_attention_decode(query, key, value, cache_len, scale=None,
                            out=None):
     """Decode-mode attention of (B, H, Tq, D) queries against a

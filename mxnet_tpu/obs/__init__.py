@@ -62,9 +62,17 @@ _ENABLED: bool = bool(get_env("MXNET_OBS", 1, int))
 # The timers that get a windowed histogram by default — the serving/
 # training hot paths the router, the SLO layer, and the dumps() tail
 # columns read (ISSUE 16 tentpole list; trainer.step's timer is named
-# trainer.step_seconds)
+# trainer.step_seconds).  serve.ttft_seconds is the disaggregated
+# prefill/decode headline (time to first token, docs/serving.md) and
+# additionally gets a default SLO row so /statusz and /metrics expose
+# windowed TTFT p99 out of the box.
 HOT_TIMERS = ("serve.e2e_seconds", "serve.decode_step_seconds",
-              "trainer.step_seconds", "dataloader.wait_seconds")
+              "serve.ttft_seconds", "trainer.step_seconds",
+              "dataloader.wait_seconds")
+
+# name of the out-of-the-box TTFT SLO row; target via
+# MXNET_SERVE_TTFT_SLO_MS (ms, default 2000)
+DEFAULT_TTFT_SLO = "serve.ttft"
 
 _SERVER = None
 _LOCK = _tchk.lock("obs.metrics_server")
@@ -89,9 +97,20 @@ def watch_timer(timer_name: str, **kwargs) -> Optional[WindowedHistogram]:
 def _wire_hot_timers():
     for name in HOT_TIMERS:
         watch_timer(name)
+    # default TTFT objective — declared here (not at SLO import) so the
+    # tests' slo.reset() + re-wire cycle restores it
+    from .slo import slo as _slo
+
+    _slo(DEFAULT_TTFT_SLO, timer="serve.ttft_seconds",
+         p99_ms=get_env("MXNET_SERVE_TTFT_SLO_MS", 2000.0, float))
 
 
 def _unwire_hot_timers():
+    from .slo import _LOCK as _slo_lock
+    from .slo import _SLOS
+
+    with _slo_lock:
+        _SLOS.pop(DEFAULT_TTFT_SLO, None)
     for name in HOT_TIMERS:
         _tel.unwatch_timer(name)
 

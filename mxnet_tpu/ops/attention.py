@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from ..kernels import registry as _kreg
 
 __all__ = ["flash_attention", "attention_reference",
-           "flash_attention_decode", "cache_append"]
+           "flash_attention_decode", "cache_append", "cache_page_copy"]
 
 _NEG_INF = float("-inf")
 
@@ -260,6 +260,34 @@ def cache_append(cache, new, lengths):
         return jax.lax.dynamic_update_slice(c, n.astype(c.dtype), (0, l, 0))
 
     return jax.vmap(one)(cache, new, lengths)
+
+
+def cache_page_copy(dst, src, n_pages: int, *, src_start=0, dst_start=0,
+                    dst_row=0):
+    """Copy ``n_pages`` consecutive KV-cache pages (capacity-axis rows)
+    from ``src`` (B_s, H, C_s, dh) into row ``dst_row`` of ``dst``
+    (B_d, H, C_d, dh) — the device half of a cache redistribution: the
+    page window is the box intersection :mod:`~mxnet_tpu.parallel.layout`
+    plans host-side, so only intersecting slices ever move.
+
+    ``n_pages`` is STATIC (it is the copy's shape — one executable per
+    (C_s, C_d, n) triple); ``src_start``/``dst_start``/``dst_row`` may
+    be traced scalars, so one executable serves every slot and offset.
+    Built on dynamic_slice + dynamic_update_slice (donation-friendly
+    in-place shape, no concatenate — the same rule as
+    :func:`cache_append`); both clamp an out-of-range start, so the
+    caller guarantees the window fits both capacities."""
+    if dst.ndim != 4 or src.ndim != 4:
+        raise ValueError(
+            f"cache_page_copy moves (B, H, C, dh) page layouts, got "
+            f"dst.ndim={dst.ndim}, src.ndim={src.ndim}")
+    pages = jax.lax.dynamic_slice(
+        src, (0, 0, jnp.asarray(src_start, jnp.int32), 0),
+        (src.shape[0], src.shape[1], int(n_pages), src.shape[3]))
+    return jax.lax.dynamic_update_slice(
+        dst, pages.astype(dst.dtype),
+        (jnp.asarray(dst_row, jnp.int32), 0,
+         jnp.asarray(dst_start, jnp.int32), 0))
 
 
 def _decode_mask(cache_len, tq, tk):

@@ -7,6 +7,10 @@ flavors over NCCL/ps-lite). TPU-native design per the north star: a
 sharding rules annotated on parameter/activation pytrees, XLA inserting
 ICI/DCN collectives. Modules:
 
+  layout      — N-d box algebra + slice-mapped redistribution planning
+                (the "Memory-efficient array redistribution" core shared
+                by checkpoint resharding and the prefill→decode KV-cache
+                shipment — docs/sharding.md)
   mesh        — mesh construction & axis conventions
   collectives — psum/all_gather/ppermute wrappers (the NCCL-API analogue)
   trainer     — SPMD train-step builder (dp + mp/tp + sp composable;
@@ -18,6 +22,9 @@ ICI/DCN collectives. Modules:
   preemption  — SIGTERM-driven checkpoint-and-exit (PreemptionGuard,
                 durable via mx.resilience)
 """
+from . import layout
+from .layout import (Box, box_of, clip_box, intersect_box, box_shape,
+                     box_volume, rel_slices, copy_plan, scatter_into)
 from .mesh import (make_mesh, default_mesh, data_parallel_spec,
                    MeshConfig, with_sharding)
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,

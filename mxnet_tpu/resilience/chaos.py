@@ -51,6 +51,13 @@ Instrumented sites:
                                 view must flag it, never raise),
                                 ``delay`` a slow scrape against the
                                 ``MXNET_OBS_SCRAPE_TIMEOUT`` deadline
+  ``serve.prefill_transfer``    the prefill→decode cache shipment
+                                (serve/decode.py ``_admit_ready``) —
+                                fires BEFORE the batch cache is touched,
+                                so ``error`` fails only that request's
+                                future (slot stays free, the decode loop
+                                keeps serving); ``delay`` stalls the
+                                admit by ``MXNET_FAULT_DELAY``
   ============================  =============================================
 
 Determinism: every site draws from its own ``random.Random`` seeded by

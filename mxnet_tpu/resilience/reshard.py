@@ -32,6 +32,14 @@ bytes than full-leaf reads" without instrumenting the reader.
 Slices partition each leaf's unpadded box exactly (disjoint cover), so
 resharding is lossless: a dp 8 -> 4 -> 8 roundtrip is bit-identical.
 Layout / lifecycle: docs/resilience.md "Manifest v2 + resharding".
+
+The box-intersection / slice-mapping core this module was built on now
+lives in :mod:`mxnet_tpu.parallel.layout` (it is the generic N-d
+redistribution planner; the prefill→decode KV-cache shipment in
+``serve/decode.py`` is its second consumer) — this module is a consumer:
+``box_of`` / ``clip_box`` / ``intersect_box`` are re-exported unchanged
+for existing callers, and the reader's assemble loop runs on
+``layout.scatter_into``.
 """
 from __future__ import annotations
 
@@ -43,6 +51,8 @@ from typing import (Any, Dict, Iterable, List, NamedTuple, Optional,
 
 from .. import telemetry as _tel
 from ..base import MXNetError, get_env
+from ..parallel import layout as _layout
+from ..parallel.layout import Box, box_of, clip_box, intersect_box
 from . import chaos as _chaos
 
 __all__ = ["SHARDS_NAME", "SliceRec", "LeafRec", "write_shards",
@@ -50,9 +60,6 @@ __all__ = ["SHARDS_NAME", "SliceRec", "LeafRec", "write_shards",
            "box_of", "clip_box", "intersect_box"]
 
 SHARDS_NAME = "shards.bin"
-
-#: an N-d box: ``((start, stop), ...)`` per dim, in leaf-logical coords
-Box = Tuple[Tuple[int, int], ...]
 
 
 class SliceRec(NamedTuple):
@@ -71,61 +78,6 @@ class LeafRec(NamedTuple):
     dtype: str
     shape: Tuple[int, ...]
     slices: Tuple[SliceRec, ...]
-
-
-# -- box algebra --------------------------------------------------------------
-
-def box_of(index, shape: Sequence[int]) -> Box:
-    """Normalize a ``devices_indices_map`` index (tuple of slices, Nones
-    for unsliced dims) into a concrete box over ``shape``."""
-    out = []
-    for k, d in enumerate(shape):
-        s = index[k] if k < len(index) else slice(None)
-        start, stop, step = s.indices(int(d))
-        if step != 1:
-            raise MXNetError(f"non-unit-stride shard index {s!r} is not "
-                             "resharding-compatible")
-        out.append((start, stop))
-    return tuple(out)
-
-
-def clip_box(box: Box, shape: Sequence[int]) -> Optional[Box]:
-    """Clip ``box`` to ``shape`` (the unpadded logical extent); None when
-    the box lies entirely inside the padding."""
-    out = []
-    for (a, b), d in zip(box, shape):
-        a, b = min(a, int(d)), min(b, int(d))
-        if a >= b:
-            return None
-        out.append((a, b))
-    return tuple(out)
-
-
-def intersect_box(a: Box, b: Box) -> Optional[Box]:
-    out = []
-    for (a0, a1), (b0, b1) in zip(a, b):
-        lo, hi = max(a0, b0), min(a1, b1)
-        if lo >= hi:
-            return None
-        out.append((lo, hi))
-    return tuple(out)
-
-
-def _box_shape(box: Box) -> Tuple[int, ...]:
-    return tuple(b - a for a, b in box)
-
-
-def _volume(box: Box) -> int:
-    n = 1
-    for a, b in box:
-        n *= b - a
-    return n
-
-
-def _rel_slices(outer: Box, inner: Box) -> Tuple[slice, ...]:
-    """``inner`` as index slices relative to ``outer``'s origin."""
-    return tuple(slice(i0 - o0, i1 - o0)
-                 for (o0, _), (i0, i1) in zip(outer, inner))
 
 
 # -- write side ---------------------------------------------------------------
@@ -156,7 +108,7 @@ def _shard_boxes(value, clip_shape: Sequence[int]):
         if cbox is None:
             continue  # the slice is pure zero1/arena padding
         local = onp.asarray(seen[gbox].data)
-        out.append((cbox, local[_rel_slices(gbox, cbox)]))
+        out.append((cbox, local[_layout.rel_slices(gbox, cbox)]))
     return out
 
 
@@ -296,7 +248,7 @@ class ShardReader:
                 "shards.bin — restore_latest falls back to an older "
                 "version")
         arr = onp.frombuffer(raw, dtype=leaf.dtype).reshape(
-            _box_shape(s.box))
+            _layout.box_shape(s.box))
         self._cache[ck] = arr
         self.bytes_read += s.nbytes
         if _tel._ENABLED:
@@ -313,18 +265,15 @@ class ShardReader:
             raise MXNetError(f"checkpoint has no leaf {key!r}")
         if box is None:
             box = tuple((0, d) for d in leaf.shape)
-        out = onp.zeros(_box_shape(box), dtype=leaf.dtype)
+        out = onp.zeros(_layout.box_shape(box), dtype=leaf.dtype)
         covered = 0
-        for s in leaf.slices:
-            inter = intersect_box(s.box, box)
-            if inter is None:
-                continue
+        for i, inter in _layout.copy_plan(box, [s.box for s in leaf.slices]):
+            s = leaf.slices[i]
             data = self._read_slice(leaf, s)
-            out[_rel_slices(box, inter)] = data[_rel_slices(s.box, inter)]
-            covered += _volume(inter)
-        if covered != _volume(box):
+            covered += _layout.scatter_into(out, box, s.box, data)
+        if covered != _layout.box_volume(box):
             raise MXNetError(
                 f"checkpoint leaf {key!r}: slices cover {covered} of "
-                f"{_volume(box)} requested elements (box {box}) — "
+                f"{_layout.box_volume(box)} requested elements (box {box}) — "
                 "manifest does not partition the leaf")
         return out

@@ -50,6 +50,7 @@ from .coalescer import (ClosedError, RejectedError, Request, RequestQueue,
 from .decode import (DecodeEntry, DecodeFuture, DecodeServer, decode_server,
                      decode_submit, generate, register_decode,
                      shutdown_decode)
+from .prefix import PrefixCache
 from .registry import (ModelEntry, Registry, default_registry,
                        normalize_request)
 from .server import Server
@@ -58,8 +59,8 @@ __all__ = ["Server", "Registry", "ModelEntry", "ServeFuture",
            "RejectedError", "ClosedError", "register", "unregister",
            "models", "submit", "predict", "shutdown", "default_registry",
            "default_server", "DecodeEntry", "DecodeServer", "DecodeFuture",
-           "register_decode", "decode_server", "decode_submit", "generate",
-           "shutdown_decode"]
+           "PrefixCache", "register_decode", "decode_server",
+           "decode_submit", "generate", "shutdown_decode"]
 
 _SERVER: Optional[Server] = None
 _LOCK = _tchk.lock("serve.default_server")

@@ -37,11 +37,43 @@ Sampling happens host-side between steps via
 temperature/top-k with a per-request PRNG key, deterministic under a
 fixed ``seed``.
 
+**Disaggregated prefill/decode** (``prefill_workers > 0`` or
+``MXNET_PREFILL_WORKERS``): prompt forwards move OFF the decode loop
+onto a pool of ``mx-prefill-<model>-<i>`` threads.  Prefill is
+compute-bound (a whole prompt's worth of FLOPs) while the decode step
+is latency-bound (one token for every resident request) — inlining
+prefill into the loop stalls every in-flight request for the duration
+of each admission, which is exactly the TTFT tail the pool removes
+(tools/disagg_smoke.py gates disagg p99 < unified p99).  A worker runs
+the prompt bucket to completion at its own capacity bucket, samples the
+first token, and ships a :class:`_Ready` — the finished ``(row_cache,
+cache_len)`` — back to the loop, which claims a slot and moves the
+cache across with :class:`_CacheMover`.  The move is an array
+redistribution in the :mod:`mxnet_tpu.parallel.layout` sense: the
+worker's capacity bucket and the batch's current bucket may differ, so
+only the intersecting page window is copied (``ops.attention.
+cache_page_copy``), never a full host gather.  The shipment crosses
+the ``serve.prefill_transfer`` chaos seam BEFORE touching the batch
+cache: an injected fault fails only that request's future and the loop
+keeps serving.
+
+**Prefix cache** (:class:`~mxnet_tpu.serve.prefix.PrefixCache`, on by
+default with the pool for page-layout models): workers look shared
+prompt prefixes up in a block-aligned trie, materialize retained KV
+pages into the row cache, and forward only the remainder — hit
+requests never enter ``serve.prefill_seconds``, their remainder runs
+under ``serve.prefix_fill_seconds`` (that count split is the
+"prefix hits skip prefill" gate).
+
 Telemetry (docs/telemetry.md): ``serve.tokens``,
 ``serve.decode_step_seconds``, ``serve.prefill_seconds``,
-``serve.decode_slots_active`` gauge, ``serve.decode_requests``,
-``serve.cache_grows``.  Trace: a ``serve.decode_step`` span per step
-(occupancy/capacity attrs), ``serve.prefill`` per admission.
+``serve.prefix_fill_seconds``, ``serve.ttft_seconds``,
+``serve.cache_move_seconds``, ``serve.decode_slots_active`` gauge,
+``serve.decode_requests``, ``serve.cache_grows``, and the
+``serve.cache_*`` prefix-trie set.  Trace: a ``serve.decode_step``
+span per step (occupancy/capacity attrs), ``serve.prefill`` /
+``serve.prefix_fill`` per admission, ``serve.cache_move`` per
+shipment, a ``serve.prefix_hit`` instant per trie hit.
 """
 from __future__ import annotations
 
@@ -61,8 +93,12 @@ from ..gluon.block import HybridBlock, _flatten_nd
 from ..jit.bucketing import _Policy
 from ..ndarray.ndarray import NDArray
 from ..numpy_extension import call as _npx_call
+from ..ops import attention as _att
+from ..parallel import layout as _layout
+from ..resilience import chaos as _chaos
 from ..trace import recorder as _tr
 from .coalescer import ClosedError, RejectedError
+from .prefix import PrefixCache
 
 __all__ = ["DecodeEntry", "DecodeServer", "DecodeFuture", "register_decode",
            "decode_server", "decode_submit", "generate", "shutdown_decode"]
@@ -79,16 +115,41 @@ def _write_leaf(batch, row, slot):
         (batch, row, slot), {}, name="slot_write")
 
 
-class _SlotWriter(HybridBlock):
-    """Splice a one-row cache into the batch cache at a TRACED slot
+def _move_leaf(batch, row, slot, n_pages):
+    return _npx_call(
+        lambda b, r, s: _att.cache_page_copy(b, r, n_pages, dst_row=s),
+        (batch, row, slot), {}, name="cache_move")
+
+
+class _CacheMover(HybridBlock):
+    """Ship a one-row cache into the batch cache at a TRACED slot
     index — one executable serves every slot (a static index would
-    compile S programs).  Param-less HybridBlock so its compiles land in
+    compile S programs).  Two leaf paths:
+
+    * matching capacity axes (and every non-page leaf, e.g. the LSTM's
+      ``(B, U)`` state): whole-row splice, the original slot-writer;
+    * ``(1, H, Cs, dh)`` page leaves whose capacity differs from the
+      batch's ``Cd``: copy only the intersecting page window —
+      :func:`mxnet_tpu.parallel.layout.intersect_box` on the capacity
+      axis, static per (src, dst) bucket pair, executed by
+      ``ops.attention.cache_page_copy``.  This is what lets a prefill
+      worker run at ITS bucket and still land in a batch that has
+      grown (or not) independently, with no host gather.
+
+    Param-less HybridBlock so its compiles land in
     ``hybridize.cache_misses`` (the zero-compile gate) and get linted;
-    the batch cache is donated (position 0) so the splice is in-place."""
+    the batch cache is donated (position 0) so the move is in-place."""
 
     def forward(self, batch_cache, row_cache, slot):
+        def move(b, r):
+            if b.ndim == 4 and r.ndim == 4 and b.shape[2] != r.shape[2]:
+                win = _layout.intersect_box(
+                    ((0, int(r.shape[2])),), ((0, int(b.shape[2])),))
+                return _move_leaf(b, r, slot, win[0][1] - win[0][0])
+            return _write_leaf(b, r, slot)
+
         return tuple(
-            tuple(_write_leaf(b, r, slot) for b, r in zip(bpair, rpair))
+            tuple(move(b, r) for b, r in zip(bpair, rpair))
             for bpair, rpair in zip(batch_cache, row_cache))
 
 
@@ -115,7 +176,7 @@ class _CacheGrower(HybridBlock):
 
 class _DecodeRequest:
     __slots__ = ("id", "model", "prompt", "max_new_tokens", "temperature",
-                 "top_k", "key", "tokens", "truncated", "corr",
+                 "top_k", "key", "tokens", "truncated", "corr", "t0",
                  "_event", "_error")
 
     def __init__(self, rid, model, prompt, max_new_tokens, temperature,
@@ -130,8 +191,26 @@ class _DecodeRequest:
         self.tokens: List[int] = []
         self.truncated = False
         self.corr = _tr.capture()
+        self.t0 = time.perf_counter()       # submit time; TTFT anchor
         self._event = threading.Event()
         self._error: Optional[BaseException] = None
+
+
+class _Ready:
+    """A pool-prefilled request in flight from prefill to decode: the
+    finished one-row cache plus the geometry the decode loop needs to
+    redistribute it into a slot (``src_cap`` = the worker's capacity
+    bucket, ``min_capacity`` = the prompt bucket the batch must reach
+    before the valid pages fit)."""
+
+    __slots__ = ("req", "row_cache", "cache_len", "src_cap", "min_capacity")
+
+    def __init__(self, req, row_cache, cache_len, src_cap, min_capacity):
+        self.req = req
+        self.row_cache = row_cache
+        self.cache_len = cache_len
+        self.src_cap = src_cap
+        self.min_capacity = min_capacity
 
 
 class DecodeFuture:
@@ -215,9 +294,9 @@ class DecodeEntry:
         if lint_budget is not None:
             block._xla_lint_budget = lint_budget
         block.hybridize(donate_args=(1,))
-        self.slot_writer = _SlotWriter()
-        self.slot_writer._xla_lint_label = f"serve.{name}.slot_writer"
-        self.slot_writer.hybridize(donate_args=(0,))
+        self.mover = _CacheMover()
+        self.mover._xla_lint_label = f"serve.{name}.mover"
+        self.mover.hybridize(donate_args=(0,))
         self.grower = _CacheGrower()
         self.grower._xla_lint_label = f"serve.{name}.grow"
         self.grower.hybridize()
@@ -246,9 +325,18 @@ class DecodeEntry:
                 (_nd_i32(onp.zeros((s, 1))), self.block.begin_cache(s, c),
                  _nd_i32(onp.zeros(s)), _nd_i32(onp.ones(s))))
         n = self.block.warmup(lm_samples)
-        n += self.slot_writer.warmup(
-            [(self.block.begin_cache(s, c), self.block.begin_cache(1, c),
-              _nd_i32(0)) for c in caps])
+        mover_samples = [
+            (self.block.begin_cache(s, c), self.block.begin_cache(1, c),
+             _nd_i32(0)) for c in caps]
+        if not self.capacity_static:
+            # cross-capacity moves: a prefill worker's bucket and the
+            # batch's current bucket drift independently, so warm every
+            # (src != dst) pair of the page-window executable too
+            mover_samples += [
+                (self.block.begin_cache(s, cd), self.block.begin_cache(1, cs),
+                 _nd_i32(0))
+                for cd in caps for cs in caps if cs != cd]
+        n += self.mover.warmup(mover_samples)
         if not self.capacity_static and len(self.capacity_buckets) > 1:
             pairs = zip(self.capacity_buckets, self.capacity_buckets[1:])
             n += self.grower.warmup(
@@ -258,13 +346,23 @@ class DecodeEntry:
 
     # ------------------------------------------------------- execution
     def prefill(self, tokens: onp.ndarray, true_len: int, capacity: int):
-        """One-row prompt forward: returns ``(last_logits (V,) numpy,
-        row_cache)`` — ``tokens`` already padded to a prompt bucket."""
+        """One-row prompt forward from an empty cache: returns
+        ``(last_logits (V,) numpy, row_cache)`` — ``tokens`` already
+        padded to a prompt bucket."""
         cache = self.block.begin_cache(1, capacity)
+        return self.prefill_window(tokens, cache, 0, true_len)
+
+    def prefill_window(self, tokens: onp.ndarray, cache, cache_len: int,
+                       n_new: int):
+        """Forward ``n_new`` real tokens (padded window ``tokens``
+        ``(1, Tp)``) against a row cache whose first ``cache_len``
+        positions are already valid — the prefix-hit remainder path.
+        Same executable family as :meth:`prefill` (``cache_len`` /
+        ``n_tokens`` are traced), so no extra warmup signatures."""
         logits, cache = self.block(
-            _nd_i32(tokens), cache, _nd_i32(onp.zeros(1)),
-            _nd_i32(onp.asarray([true_len])))
-        return onp.asarray(logits._data[0, true_len - 1]), cache
+            _nd_i32(tokens), cache, _nd_i32(onp.asarray([cache_len])),
+            _nd_i32(onp.asarray([n_new])))
+        return onp.asarray(logits._data[0, n_new - 1]), cache
 
     def step(self, pending: onp.ndarray, cache, lens: onp.ndarray):
         """One decode step for the whole slot batch: returns
@@ -274,8 +372,14 @@ class DecodeEntry:
             _nd_i32(onp.ones(self.slots)))
         return onp.asarray(logits._data[:, 0, :]), cache
 
-    def insert(self, cache, row_cache, slot: int):
-        return self.slot_writer(cache, row_cache, _nd_i32(slot))
+    def move(self, cache, row_cache, slot: int):
+        """Ship ``row_cache`` into batch ``slot`` — whole-row splice at
+        matching capacity, page-window copy across buckets (the
+        redistribution consumer, docs/sharding.md)."""
+        return self.mover(cache, row_cache, _nd_i32(slot))
+
+    # back-compat name from the equal-capacity slot-writer era
+    insert = move
 
     def grow(self, cache, new_capacity: int):
         return self.grower(cache, _nd_i32(onp.zeros(new_capacity)))
@@ -285,14 +389,51 @@ class DecodeServer:
     """The token-level scheduler: a worker thread owning the slot batch.
 
     All device state (cache tree, per-slot host bookkeeping) is touched
-    by the worker only; ``submit`` just enqueues under the condition
-    variable.  ``close()`` drains accepted requests before joining."""
+    by the decode worker only; ``submit`` just enqueues under the
+    condition variable.  ``close()`` drains accepted requests before
+    joining.
 
-    def __init__(self, entry: DecodeEntry, queue_max: Optional[int] = None):
+    With ``prefill_workers > 0`` (default ``MXNET_PREFILL_WORKERS``,
+    0 = unified) the server is DISAGGREGATED: submits land on the
+    prefill queue, ``mx-prefill-<model>-<i>`` threads run prompt
+    forwards to completion (consulting ``prefix_cache`` — a
+    :class:`~mxnet_tpu.serve.prefix.PrefixCache`, ``None`` auto-creates
+    one for page-layout models, ``False`` disables), and finished
+    shipments re-enter the decode queue as :class:`_Ready` items.  One
+    condition variable guards both queues plus the in-flight prefill
+    count, so close() can drain exactly: the loop exits only when
+    closed AND both queues are empty AND no prefill is mid-flight AND
+    every slot has resolved."""
+
+    def __init__(self, entry: DecodeEntry, queue_max: Optional[int] = None,
+                 prefill_workers: Optional[int] = None, prefix_cache=None):
         self.entry = entry
         self._queue_max = queue_max if queue_max is not None \
             else get_env("MXNET_SERVE_QUEUE_MAX", 1024, int)
+        self._prefill_workers = int(
+            prefill_workers if prefill_workers is not None
+            else get_env("MXNET_PREFILL_WORKERS", 0, int))
+        if self._prefill_workers < 0:
+            raise MXNetError(
+                f"prefill_workers must be >= 0, got {self._prefill_workers}")
+        if prefix_cache is None:
+            self.prefix = PrefixCache(name=entry.name) \
+                if self._prefill_workers > 0 and not entry.capacity_static \
+                else None
+        elif prefix_cache is True:
+            self.prefix = PrefixCache(name=entry.name)
+        elif prefix_cache is False:
+            self.prefix = None
+        else:
+            self.prefix = prefix_cache
+        if self.prefix is not None and entry.capacity_static:
+            raise MXNetError(
+                f"decode model {entry.name!r} has a capacity-independent "
+                "cache (no per-position pages) — the prefix cache cannot "
+                "slice it; pass prefix_cache=False")
         self._q: deque = deque()
+        self._pq: deque = deque()
+        self._prefill_busy = 0
         self._cv = _tchk.condition("serve.decode")
         self._closed = False
         self._seq = 0
@@ -307,6 +448,12 @@ class DecodeServer:
             target=self._loop, name=f"mx-decode-worker-{entry.name}",
             daemon=True)
         self._thread.start()
+        self._prefill_threads = [
+            threading.Thread(target=self._prefill_loop,
+                             name=f"mx-prefill-{entry.name}-{i}", daemon=True)
+            for i in range(self._prefill_workers)]
+        for t in self._prefill_threads:
+            t.start()
 
     # ------------------------------------------------------------- API
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -319,7 +466,7 @@ class DecodeServer:
             if self._closed:
                 raise ClosedError(
                     f"decode server {self.entry.name!r} is closed")
-            if len(self._q) >= self._queue_max:
+            if len(self._q) + len(self._pq) >= self._queue_max:
                 if _tel._ENABLED:
                     _tel.inc("serve.rejected")
                 raise RejectedError(
@@ -331,7 +478,7 @@ class DecodeServer:
                 max_new_tokens if max_new_tokens is not None
                 else self.entry.max_new_tokens,
                 temperature, top_k, seed)
-            self._q.append(req)
+            (self._pq if self._prefill_workers else self._q).append(req)
             self._cv.notify_all()
         if _tel._ENABLED:
             _tel.inc("serve.decode_submitted")
@@ -346,8 +493,12 @@ class DecodeServer:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
-        self._thread.join(timeout)
-        if self._thread.is_alive():
+        deadline = time.monotonic() + timeout
+        for t in self._prefill_threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        self._thread.join(max(0.0, deadline - time.monotonic()))
+        if self._thread.is_alive() \
+                or any(t.is_alive() for t in self._prefill_threads):
             raise MXNetError(
                 f"decode server {self.entry.name!r} failed to drain within "
                 f"{timeout}s")
@@ -367,19 +518,26 @@ class DecodeServer:
         e = self.entry
         self._cache = e.block.begin_cache(e.slots, e.capacity_buckets[0])
         while True:
-            admitted: List[_DecodeRequest] = []
+            admitted: List = []
             with self._cv:
-                while not self._closed and not self._q \
-                        and self._occupancy() == 0:
+                while not self._q and self._occupancy() == 0 \
+                        and not (self._closed and not self._pq
+                                 and self._prefill_busy == 0):
                     self._cv.wait(0.1)
-                if self._closed and not self._q and self._occupancy() == 0:
+                if self._closed and not self._q and not self._pq \
+                        and self._prefill_busy == 0 \
+                        and self._occupancy() == 0:
                     return
                 free = self._active.count(None)
                 while self._q and len(admitted) < free:
                     admitted.append(self._q.popleft())
-            for req in admitted:
+            for item in admitted:
+                req = item.req if isinstance(item, _Ready) else item
                 try:
-                    self._admit(req)
+                    if isinstance(item, _Ready):
+                        self._admit_ready(item)
+                    else:
+                        self._admit(item)
                 except BaseException as err:  # noqa: BLE001 — to future
                     req._error = err if isinstance(err, MXNetError) \
                         else MXNetError(f"{type(err).__name__}: {err}")
@@ -411,16 +569,135 @@ class DecodeServer:
             req.tokens.append(first)
             if _tel._ENABLED:
                 _tel.inc("serve.tokens")
+                _tel.observe("serve.ttft_seconds",
+                             time.perf_counter() - req.t0)
             if (e.eos_id is not None and first == e.eos_id) \
                     or req.max_new_tokens <= 1:
                 self._resolve(req)
                 return
-            self._cache = e.insert(self._cache, row_cache, slot)
+            self._cache = e.move(self._cache, row_cache, slot)
         self._lens[slot] = t
         self._pending[slot] = first
         self._active[slot] = req
         if _tel._ENABLED:
             _tel.set_gauge("serve.decode_slots_active", self._occupancy())
+
+    def _admit_ready(self, ready: _Ready):
+        """Claim a slot for a pool-prefilled request and redistribute
+        its row cache into the batch.  The ``serve.prefill_transfer``
+        chaos seam fires BEFORE the move, so an injected transfer fault
+        leaves the batch cache untouched: only this request's future
+        fails, the slot stays free, and the loop keeps serving."""
+        e = self.entry
+        req = ready.req
+        caps = e.capacity_buckets
+        slot = self._active.index(None)
+        while not e.capacity_static and caps[self._cap_i] < ready.min_capacity:
+            self._grow()
+        if _chaos.active():
+            kind = _chaos.draw("serve.prefill_transfer")
+            if kind == "delay":
+                time.sleep(get_env("MXNET_FAULT_DELAY", 0.05, float))
+            elif kind is not None:
+                raise _chaos.ChaosError(
+                    "injected fault at 'serve.prefill_transfer' "
+                    f"(request {req.id})")
+        with _tr.correlate(serve_decode=req.id), \
+                _tr.span("serve.cache_move", timer="serve.cache_move_seconds",
+                         request=req.id, slot=slot, tokens=ready.cache_len,
+                         src_capacity=ready.src_cap,
+                         dst_capacity=caps[self._cap_i]):
+            self._cache = e.move(self._cache, ready.row_cache, slot)
+        ready.row_cache = None
+        self._lens[slot] = ready.cache_len
+        self._pending[slot] = req.tokens[-1]
+        self._active[slot] = req
+        if _tel._ENABLED:
+            _tel.set_gauge("serve.decode_slots_active", self._occupancy())
+
+    # ---------------------------------------------------- prefill pool
+    def _prefill_loop(self):
+        while True:
+            with self._cv:
+                while not self._closed and not self._pq:
+                    self._cv.wait(0.1)
+                if not self._pq:            # closed and drained
+                    return
+                req = self._pq.popleft()
+                self._prefill_busy += 1
+            ready = None
+            try:
+                ready = self._run_prefill(req)
+            except BaseException as err:  # noqa: BLE001 — to future
+                req._error = err if isinstance(err, MXNetError) \
+                    else MXNetError(f"{type(err).__name__}: {err}")
+                req._error.__cause__ = err
+                req._event.set()
+            with self._cv:
+                self._prefill_busy -= 1
+                if ready is not None:
+                    self._q.append(ready)
+                self._cv.notify_all()
+
+    def _run_prefill(self, req: _DecodeRequest) -> Optional[_Ready]:
+        """One request's prompt forward on the pool: prefix-trie lookup,
+        cold prefill or prefix-remainder forward, trie retention, first
+        token.  Returns the shipment for the decode loop, or None when
+        generation already finished (EOS / one-token budget)."""
+        e = self.entry
+        caps = e.capacity_buckets
+        t = len(req.prompt)
+        tp = e.prompt_policy.bucket(t)      # raises on over-long prompts
+        matched, chain = 0, []
+        if self.prefix is not None:
+            matched, chain = self.prefix.lookup(req.prompt)
+        if e.capacity_static:
+            src_cap = caps[0]
+        elif matched:
+            # the remainder window appends at `matched`, so the row
+            # needs matched + bucket(remainder) pages, which can exceed
+            # the cold bucket; an unfittable hit degrades to a miss
+            rem_bucket = e.prompt_policy.bucket(t - matched)
+            need = max(tp, matched + rem_bucket)
+            src_cap = next((c for c in caps if c >= need), None)
+            if src_cap is None:
+                matched, chain = 0, []
+        if not matched and not e.capacity_static:
+            src_cap = next(c for c in caps if c >= tp)
+        with _tr.correlate(serve_decode=req.id):
+            if matched:
+                cache = self.prefix.materialize(chain, src_cap)
+                rem = t - matched
+                toks = onp.zeros((1, rem_bucket), onp.int32)
+                toks[0, :rem] = req.prompt[matched:]
+                with _tr.span("serve.prefix_fill",
+                              timer="serve.prefix_fill_seconds",
+                              request=req.id, tokens=rem, cached=matched):
+                    last_logits, row_cache = e.prefill_window(
+                        toks, cache, matched, rem)
+                if _tr._ENABLED:
+                    _tr.instant("serve.prefix_hit", request=req.id,
+                                cached_tokens=matched, forwarded=rem)
+            else:
+                toks = onp.zeros((1, tp), onp.int32)
+                toks[0, :t] = req.prompt
+                with _tr.span("serve.prefill",
+                              timer="serve.prefill_seconds",
+                              request=req.id, tokens=t):
+                    last_logits, row_cache = e.prefill(toks, t, src_cap)
+            if self.prefix is not None:
+                self.prefix.insert(req.prompt, row_cache, t)
+            first = self._sample(req, last_logits)
+            req.tokens.append(first)
+            if _tel._ENABLED:
+                _tel.inc("serve.tokens")
+                _tel.observe("serve.ttft_seconds",
+                             time.perf_counter() - req.t0)
+            if (e.eos_id is not None and first == e.eos_id) \
+                    or req.max_new_tokens <= 1:
+                self._resolve(req)
+                return None
+        return _Ready(req, row_cache, t, src_cap, tp)
 
     def _ensure_capacity(self):
         """Grow the batch before a step whose append would overflow; at
@@ -509,10 +786,15 @@ _DLOCK = _tchk.lock("serve.decode_registry")
 def register_decode(name: str, block, **cfg) -> DecodeEntry:
     """Register ``block`` for decode serving under ``name``: builds the
     :class:`DecodeEntry` (AOT-warming the executable grid) and starts
-    its :class:`DecodeServer`.  Re-registering a name drains and
+    its :class:`DecodeServer`.  Server-level knobs (``prefill_workers``,
+    ``prefix_cache``, ``queue_max``) pass through to the server; the
+    rest configure the entry.  Re-registering a name drains and
     replaces the old server."""
+    srv_kw = {k: cfg.pop(k)
+              for k in ("prefill_workers", "prefix_cache", "queue_max")
+              if k in cfg}
     entry = DecodeEntry(name, block, **cfg)
-    server = DecodeServer(entry)
+    server = DecodeServer(entry, **srv_kw)
     with _DLOCK:
         old = _DECODE.pop(name, None)
         _DECODE[name] = server

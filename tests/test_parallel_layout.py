@@ -1,0 +1,179 @@
+"""mx.parallel.layout — the shared box-algebra/redistribution core
+(ISSUE 18 tentpole).
+
+The slice-mapping arithmetic that used to live inside
+resilience/reshard.py now has three consumers (checkpoint resharding,
+the prefill->decode cache mover, prefix-cache assembly), so it gets its
+own contract tests: (1) the box algebra is correct at the degenerate
+edges (empty intersections, padding-only clips, non-unit strides
+rejected); (2) a copy_plan over a disjoint source layout reconstructs
+any target box exactly, with cover_volume as the completeness witness;
+(3) reshard re-exports ARE the layout functions (the lift did not fork
+the implementation); (4) the DecodeEntry cache mover redistributes a
+prefill row into a batch slot bit-exactly in BOTH cross-capacity
+directions (src < dst and src > dst), touching only the intersection
+window and leaving the other slots' pages intact.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serve
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo import transformer_lm
+from mxnet_tpu.parallel import layout
+from mxnet_tpu.resilience import reshard
+
+
+# ------------------------------------------------------------ box algebra
+def test_box_of_normalizes_indices():
+    shape = (8, 6)
+    assert layout.box_of((slice(2, 5), slice(None)), shape) == \
+        ((2, 5), (0, 6))
+    # short index tuples extend with full slices
+    assert layout.box_of((slice(0, 4),), shape) == ((0, 4), (0, 6))
+    # negative/open slices resolve against the shape
+    assert layout.box_of((slice(-3, None), slice(None, 2)), shape) == \
+        ((5, 8), (0, 2))
+    with pytest.raises(MXNetError):
+        layout.box_of((slice(0, 8, 2),), shape)
+
+
+def test_clip_box_against_padding():
+    # logical extent 10 with the box reaching into padding
+    assert layout.clip_box(((8, 16),), (10,)) == ((8, 10),)
+    # entirely inside the padding -> no data
+    assert layout.clip_box(((12, 16),), (10,)) is None
+    assert layout.clip_box(((0, 4), (6, 9)), (8, 6)) is None
+
+
+def test_intersect_shape_volume():
+    a = ((0, 4), (2, 8))
+    b = ((2, 6), (0, 4))
+    assert layout.intersect_box(a, b) == ((2, 4), (2, 4))
+    assert layout.intersect_box(a, ((4, 8), (0, 4))) is None  # edge-touch
+    assert layout.box_shape(a) == (4, 6)
+    assert layout.box_volume(a) == 24
+    assert layout.box_volume(((3, 4),)) == 1
+
+
+def test_rel_slices_round_trip():
+    outer = ((10, 20), (5, 15))
+    inner = ((12, 17), (5, 8))
+    sl = layout.rel_slices(outer, inner)
+    assert sl == (slice(2, 7), slice(0, 3))
+    buf = onp.zeros(layout.box_shape(outer))
+    buf[sl] = 1.0
+    assert buf.sum() == layout.box_volume(inner)
+
+
+def _grid_layout(shape, splits):
+    """Disjoint covering layout: split each dim at the given cut
+    points."""
+    import itertools
+
+    edges = []
+    for d, cuts in zip(shape, splits):
+        pts = [0] + sorted(cuts) + [d]
+        edges.append(list(zip(pts, pts[1:])))
+    return [tuple(b) for b in itertools.product(*edges)]
+
+
+def test_copy_plan_reconstructs_any_target():
+    rs = onp.random.RandomState(3)
+    shape = (12, 10)
+    full = rs.randn(*shape).astype("float32")
+    sources = _grid_layout(shape, [(5, 9), (4,)])
+    pieces = [full[layout.rel_slices(((0, shape[0]), (0, shape[1])), b)]
+              for b in sources]
+    for target in [((0, 12), (0, 10)), ((3, 8), (2, 9)), ((5, 6), (4, 5)),
+                   ((9, 12), (0, 4))]:
+        plan = layout.copy_plan(target, sources)
+        # completeness: a disjoint covering layout covers every target
+        assert layout.cover_volume(target, sources) == \
+            layout.box_volume(target)
+        got = onp.full(layout.box_shape(target), onp.nan, "float32")
+        copied = 0
+        for i, inter in plan:
+            assert inter == layout.intersect_box(sources[i], target)
+            copied += layout.scatter_into(got, target, sources[i],
+                                          pieces[i])
+        assert copied == layout.box_volume(target)
+        want = full[layout.rel_slices(((0, 12), (0, 10)), target)]
+        onp.testing.assert_array_equal(got, want)
+
+
+def test_scatter_into_disjoint_is_noop():
+    out = onp.zeros((4, 4))
+    n = layout.scatter_into(out, ((0, 4), (0, 4)), ((4, 8), (0, 4)),
+                            onp.ones((4, 4)))
+    assert n == 0 and out.sum() == 0
+
+
+def test_reshard_reexports_are_layout():
+    # the lift must not fork the implementation: reshard's names bind
+    # the layout functions themselves
+    assert reshard.intersect_box is layout.intersect_box
+    assert reshard.box_of is layout.box_of
+    assert reshard.clip_box is layout.clip_box
+
+
+# ------------------------------------------- cache mover redistribution
+@pytest.fixture(scope="module")
+def mover_entry():
+    mx.random.seed(31)
+    lm = transformer_lm(vocab_size=32, units=32, hidden_size=64,
+                        num_heads=2, num_layers=1, max_length=64)
+    lm.initialize(mx.init.Xavier())
+    return serve.DecodeEntry("layout_mover", lm, slots=2,
+                             prompt_buckets=(4,), capacity_buckets=(16, 32),
+                             max_new_tokens=4)
+
+
+def _row_pages(entry, src_cap, seed):
+    rs = onp.random.RandomState(seed)
+    toks = onp.zeros((1, 4), onp.int32)
+    toks[0] = rs.randint(1, 32, size=4)
+    _logits, row = entry.prefill(toks, 4, src_cap)
+    # deep-copy BEFORE the move: the mover donates the batch cache and
+    # onp.asarray of a jax buffer is a zero-copy view
+    pages = [[onp.array(l._data, copy=True) for l in pair] for pair in row]
+    return row, pages
+
+
+@pytest.mark.parametrize("src_cap,dst_cap", [(16, 16), (16, 32), (32, 16)])
+def test_cache_mover_redistributes_window(mover_entry, src_cap, dst_cap):
+    e = mover_entry
+    slot = 1
+    batch = e.block.begin_cache(e.slots, dst_cap)
+    row, pages = _row_pages(e, src_cap, seed=src_cap * 100 + dst_cap)
+    batch = e.move(batch, row, slot)
+    win = min(src_cap, dst_cap)
+    for layer, pair in enumerate(batch):
+        for kv, leaf in enumerate(pair):
+            got = onp.asarray(leaf._data)
+            # the intersection window of the slot row IS the source row
+            onp.testing.assert_array_equal(
+                got[slot, :, :win], pages[layer][kv][0, :, :win],
+                err_msg=f"layer {layer} kv {kv} "
+                        f"({src_cap}->{dst_cap})")
+            # pages outside the window and other slots stay zero
+            assert not got[slot, :, win:].any()
+            assert not got[1 - slot].any()
+
+
+def test_cache_mover_second_move_preserves_first(mover_entry):
+    e = mover_entry
+    batch = e.block.begin_cache(e.slots, 32)
+    row0, pages0 = _row_pages(e, 16, seed=1)
+    batch = e.move(batch, row0, 0)
+    row1, pages1 = _row_pages(e, 32, seed=2)
+    batch = e.move(batch, row1, 1)
+    for layer, pair in enumerate(batch):
+        for kv, leaf in enumerate(pair):
+            got = onp.asarray(leaf._data)
+            onp.testing.assert_array_equal(got[0, :, :16],
+                                           pages0[layer][kv][0, :, :16])
+            onp.testing.assert_array_equal(got[1], pages1[layer][kv][0])

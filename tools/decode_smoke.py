@@ -166,6 +166,7 @@ def decode_phases(entry, report):
     snap = tel.snapshot()
     misses = seq_misses + _metric(snap, "hybridize.cache_misses")
     p99 = _metric(snap, "serve.decode_step_seconds", "p99")
+    ttft_p99 = _metric(snap, "serve.ttft_seconds", "p99")
     occ_max = _metric(snap, "serve.decode_slots_active", "max")
     grows = _metric(snap, "serve.cache_grows")
     speedup = batch_tps / seq_tps
@@ -187,6 +188,9 @@ def decode_phases(entry, report):
             _metric(snap, "serve.decode_step_seconds", "p50") * 1e3, 3),
         "step_p99_ms": round(p99 * 1e3, 3),
         "step_p99_bound_ms": STEP_P99_BOUND_S * 1e3, "p99_ok": ok_p99,
+        "ttft_p99_ms": round(ttft_p99 * 1e3, 3),
+        "prefix_hit_rate": 0.0,     # unified path; tools/disagg_smoke.py
+                                    # measures the trie-backed rate
         "compiles_after_warmup": misses, "compiles_ok": ok_compiles,
         "cache_grows": grows, "occupancy_high_water": occ_max,
         "coverage_ok": ok_coverage,
@@ -205,6 +209,8 @@ def make_row(decode, platform="cpu"):
             "batched_vs_sequential": decode["batched_vs_sequential"],
             "step_p50_ms": decode["step_p50_ms"],
             "step_p99_ms": decode["step_p99_ms"],
+            "decode_ttft_p99_ms": decode.get("ttft_p99_ms", 0.0),
+            "prefix_hit_rate": decode.get("prefix_hit_rate", 0.0),
             "occupancy_high_water": decode["occupancy_high_water"],
             "n_requests": decode["n_requests"],
             "max_new_tokens": decode["max_new_tokens"],
