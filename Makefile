@@ -18,7 +18,7 @@ BUILD    := build
 .PHONY: native native-test asan tsan test test-par test-slow test-all \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
 	trace-smoke kernels-smoke serve-smoke decode-smoke disagg-smoke \
-	obs-smoke lint-hybrid lint-threads lint-graph ci clean
+	obs-smoke fleet-smoke lint-hybrid lint-threads lint-graph ci clean
 
 native: $(BUILD)/libmxtpu.so
 
@@ -177,6 +177,20 @@ obs-smoke:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
 		MXNET_OBS=1 MXNET_THREAD_CHECK=raise python tools/obs_smoke.py
 
+fleet-smoke:
+	# network edge + elastic fleet gate (docs/serving.md "Network edge
+	# + fleet"): N worker replicas behind the router must beat
+	# sequential RPS >= 2x with every admitted request answered; a
+	# SIGKILLed replica under load loses ZERO admitted requests, is
+	# respawned warm from the persistent compile cache (warm build <=
+	# 50% of cold) with the recovery time recorded; SSE streaming
+	# delivers tokens incrementally and bit-exact vs in-process greedy;
+	# fleet.dispatch chaos at p=0.5 is absorbed by the retry path; and
+	# zero post-warmup compiles per replica.  Serial — single-core box,
+	# never concurrent with tier-1.
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+		MXNET_OBS=1 MXNET_THREAD_CHECK=raise python tools/fleet_smoke.py
+
 lint-hybrid:
 	# hybridize-safety static analysis (docs/analysis.md). The committed
 	# baseline makes legacy suppressions explicit; NEW violations fail.
@@ -209,7 +223,7 @@ ci: native native-test asan tsan lint-hybrid lint-threads lint-graph \
 	test test-slow \
 	telemetry-smoke pipeline-smoke chaos-smoke warmup-smoke spmd-smoke \
 	trace-smoke kernels-smoke serve-smoke decode-smoke disagg-smoke \
-	obs-smoke
+	obs-smoke fleet-smoke
 
 clean:
 	rm -rf $(BUILD)

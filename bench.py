@@ -903,6 +903,61 @@ def _decode_sweep():
 
 
 # ---------------------------------------------------------------------------
+# fleet mode — the network-edge + replica-fleet trajectory (docs/serving.md
+# "Network edge + fleet").  `bench.py --fleet` reuses the fleet-smoke
+# measurement core (N worker replicas behind the router, persistent
+# compile cache, SIGKILL-under-load recovery) and reports a bench-shaped
+# row: routed RPS, routed p99, streamed tokens/s, and kill->ready
+# recovery seconds.  CPU-capable: workers are plain subprocesses, so a
+# dead relay degrades to a live CPU row, not a skip.
+# ---------------------------------------------------------------------------
+
+def _fleet_child():
+    """One fleet measurement in-process; prints + banks its row."""
+    import tempfile
+
+    import jax
+
+    # initialize the backend BEFORE importing fleet_smoke: its module
+    # level setdefaults JAX_PLATFORMS=cpu (standalone-smoke safety),
+    # which would silently force a TPU child onto CPU if it ran first
+    platform = jax.devices()[0].platform
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import fleet_smoke as _fsm
+    report = {}
+    cache_dir = tempfile.mkdtemp(prefix="mx-fleet-bench-")
+    fleet, ok = _fsm.boot_fleet(report, cache_dir)
+    try:
+        ok = _fsm.throughput_phase(fleet, report) and ok
+        ok = _fsm.kill_phase(fleet, report) and ok
+        ok = _fsm.streaming_phase(fleet, report, cache_dir) and ok
+    finally:
+        fleet.close()
+        from mxnet_tpu import serve
+
+        serve.shutdown_decode(60.0)
+    # ONE row schema, owned by fleet_smoke (drift here would desync the
+    # banked bench row from the smoke's report["row"])
+    row = _fsm.make_row(report, platform=platform)
+    row.update(vs_baseline=None, gates_ok=bool(ok))
+    row["telemetry"] = _telemetry_snapshot()
+    _bank(row)
+    print(json.dumps(row))
+
+
+def _fleet_sweep():
+    """Parent: run the fleet row in a killable subprocess."""
+    platform, err = _probe_backend()
+    env = dict(os.environ) if platform == "tpu" else _cpu_env()
+    row = _run_child(["--fleet-child"], env, 2400, "fleet_rps")
+    if platform is None:
+        row["relay_note"] = f"TPU backend unavailable: {err}; CPU row"
+    print(json.dumps(row))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # multichip scaling mode (BASELINE target: 8->64-chip scaling efficiency).
 # `bench.py --multichip n` measures the ResNet + BERT SPMD step on a 1-device
 # and an n-device dp mesh and reports per-device throughput + scaling
@@ -1083,6 +1138,10 @@ def main():
         return _decode_sweep()
     if len(sys.argv) == 2 and sys.argv[1] == "--decode-child":
         return _decode_child()
+    if len(sys.argv) == 2 and sys.argv[1] == "--fleet":
+        return _fleet_sweep()
+    if len(sys.argv) == 2 and sys.argv[1] == "--fleet-child":
+        return _fleet_child()
     if len(sys.argv) == 3 and sys.argv[1] == "--multichip":
         return _multichip(int(sys.argv[2]))
     if len(sys.argv) == 3 and sys.argv[1] == "--multichip-child":

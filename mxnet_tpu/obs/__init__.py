@@ -43,6 +43,7 @@ from ..base import get_env
 from . import histogram as _histmod
 from .aggregate import FleetView, WorkerScrape, aggregate
 from .histogram import GRID, WindowedHistogram, histogram
+from .http import fleet_state, set_fleet_state
 from .prom import parse as parse_prometheus
 from .prom import render as render_prometheus
 from .slo import SLO, evaluate_all, slo, slos
@@ -51,7 +52,8 @@ __all__ = ["enabled", "serve_metrics", "stop_metrics", "metrics_server",
            "slo", "slos", "evaluate_all", "SLO", "aggregate",
            "FleetView", "WorkerScrape", "histogram", "WindowedHistogram",
            "GRID", "watch_timer", "set_enabled", "render_prometheus",
-           "parse_prometheus", "HOT_TIMERS"]
+           "parse_prometheus", "HOT_TIMERS", "set_fleet_state",
+           "fleet_state"]
 
 log = logging.getLogger(__name__)
 

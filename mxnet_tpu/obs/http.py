@@ -19,9 +19,12 @@ Endpoints:
   model's warmup grid is complete, (b) the serve dispatcher and every
   decode loop thread are alive, (c) the last ``dist.heartbeat()``
   outcome is healthy and fresh, and (d) the trace-flight hang watchdog
-  (when armed) does not currently see a stalled process.  503 with a
+  (when armed) does not currently see a stalled process, and (e) the
+  replica is not being drained by the fleet supervisor
+  (``set_fleet_state(draining=True)`` — serve/fleet.py).  503 with a
   JSON body naming the failed checks otherwise — the router drains a
-  replica on exactly this signal (ROADMAP item 1).
+  replica on exactly this signal (ROADMAP item 1), and a DRAINING
+  replica answers 503 naming ``draining`` instead of vanishing.
 * ``/statusz``  — JSON operational snapshot: queue depth, decode slot
   occupancy, inflight batches, compile-cache hits, registered models,
   per-gauge staleness, SLO verdicts.
@@ -44,9 +47,34 @@ from . import prom as _prom
 from .histogram import histograms as _histograms
 from .slo import evaluate_all as _evaluate_slos
 
-__all__ = ["MetricsServer", "readiness", "statusz_doc"]
+__all__ = ["MetricsServer", "readiness", "statusz_doc",
+           "set_fleet_state", "fleet_state"]
 
 _START_TS = time.time()
+
+# Fleet-replica identity + drain state (serve/fleet.py).  A draining
+# replica must keep ANSWERING ``/readyz`` — with a 503 naming the
+# ``draining`` check — rather than vanish, so the router's health view
+# and the supervisor's drain decision can never disagree about why a
+# replica left rotation.
+_FLEET_LOCK = threading.Lock()
+_FLEET = {"role": None, "draining": False}
+
+
+def set_fleet_state(role: Optional[str] = None,
+                    draining: Optional[bool] = None):
+    """Stamp this process's fleet role (``"worker"``/``"router"``/...)
+    and/or drain flag; ``None`` leaves a field unchanged."""
+    with _FLEET_LOCK:
+        if role is not None:
+            _FLEET["role"] = role
+        if draining is not None:
+            _FLEET["draining"] = bool(draining)
+
+
+def fleet_state() -> dict:
+    with _FLEET_LOCK:
+        return dict(_FLEET)
 
 
 def _heartbeat_check() -> Tuple[bool, dict]:
@@ -98,6 +126,12 @@ def readiness() -> Tuple[bool, dict]:
     # (c) heartbeat fresh
     hb_ok, hb = _heartbeat_check()
     checks["heartbeat"] = dict(hb, ok=hb_ok)
+    # (c') not draining — a replica being retired answers 503 naming
+    # this check (not 404/connection-refused), so the router stops
+    # routing for the stated reason while in-flight work finishes
+    fs = fleet_state()
+    checks["draining"] = {"ok": not fs["draining"],
+                          "role": fs["role"]}
     # (d) hang watchdog (trace/flight.py): armed + stalled = wedged
     from ..trace import flight as _flight
 
@@ -130,11 +164,14 @@ def statusz_doc() -> dict:
         gauges[name] = {"value": s["value"], "age_secs": age,
                         "stale": bool(ts) and age > stale_after}
     ready, checks = readiness()
+    fs = fleet_state()
     return {
         "pid": os.getpid(),
         "uptime_secs": round(now - _START_TS, 3),
         "ready": ready,
         "checks": checks,
+        "fleet_role": fs["role"],
+        "draining": fs["draining"],
         "queue_depth": val("serve.queue_depth"),
         "decode_slots_active": val("serve.decode_slots_active"),
         "inflight_batches": val("serve.inflight_batches"),

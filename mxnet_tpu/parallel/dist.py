@@ -46,6 +46,18 @@ from ..trace import recorder as _tr
 _initialized = False
 
 
+def backoff_delay(attempt: int, base: float = 0.5, cap: float = 10.0,
+                  jitter: float = 0.25) -> float:
+    """Exponential-backoff delay for retry attempt ``attempt`` (1-based):
+    ``min(base * 2**(attempt-1), cap)`` plus 0..``jitter`` relative
+    random spread, so a whole pod (or replica fleet) retrying in
+    lockstep doesn't hammer the coordinator/sibling it is retrying
+    against.  Shared by :func:`init` and the serve fleet's dispatch/
+    spawn retries (serve/fleet.py)."""
+    delay = min(base * (2.0 ** (max(1, attempt) - 1)), cap)
+    return delay * (1.0 + jitter * _random.random())
+
+
 def _env(*names, default=None):
     for n in names:
         v = os.environ.get(n)
@@ -151,8 +163,7 @@ def init(coordinator_address: Optional[str] = None,
             _tel.inc("dist.init_retries")
             # exponential backoff, 0.5s base, 10s cap, +0..25% jitter so
             # a whole pod retrying in lockstep doesn't hammer process 0
-            delay = min(0.5 * (2.0 ** (attempt - 1)), 10.0)
-            delay *= 1.0 + 0.25 * _random.random()
+            delay = backoff_delay(attempt)
             if deadline is not None:
                 delay = min(delay, max(0.0, deadline - elapsed))
             _time.sleep(delay)

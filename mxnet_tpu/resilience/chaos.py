@@ -58,6 +58,22 @@ Instrumented sites:
                                 future (slot stays free, the decode loop
                                 keeps serving); ``delay`` stalls the
                                 admit by ``MXNET_FAULT_DELAY``
+  ``edge.request``              each HTTP admission at the network edge
+                                (serve/edge.py) — ``error``/``torn``
+                                shed that request with a 503 (the
+                                router's retry path), ``delay`` stalls
+                                the handler by ``MXNET_FAULT_DELAY``
+  ``fleet.dispatch``            each router dispatch attempt to a
+                                replica (serve/fleet.py) — ``error`` is
+                                a failed dispatch that must retry a
+                                sibling with backoff (idempotent
+                                predict) or fail fast with a named
+                                error (in-flight generate)
+  ``fleet.spawn``               each replica subprocess spawn attempt
+                                (supervisor respawn path) — ``error``
+                                fails the spawn so the supervisor's
+                                bounded spawn retry is exercised,
+                                ``delay`` stalls bring-up
   ============================  =============================================
 
 Determinism: every site draws from its own ``random.Random`` seeded by

@@ -45,22 +45,26 @@ import threading
 from typing import Optional
 
 from ..analysis import thread_check as _tchk
-from .coalescer import (ClosedError, RejectedError, Request, RequestQueue,
-                        ServeFuture)
+from .coalescer import (ClosedError, DeadlineError, RejectedError, Request,
+                        RequestQueue, ServeFuture)
 from .decode import (DecodeEntry, DecodeFuture, DecodeServer, decode_server,
                      decode_submit, generate, register_decode,
                      shutdown_decode)
+from .edge import EdgeServer
+from .fleet import (DispatchError, Fleet, FleetError, NoReplicaError, Router)
 from .prefix import PrefixCache
 from .registry import (ModelEntry, Registry, default_registry,
                        normalize_request)
 from .server import Server
 
 __all__ = ["Server", "Registry", "ModelEntry", "ServeFuture",
-           "RejectedError", "ClosedError", "register", "unregister",
-           "models", "submit", "predict", "shutdown", "default_registry",
-           "default_server", "DecodeEntry", "DecodeServer", "DecodeFuture",
-           "PrefixCache", "register_decode", "decode_server",
-           "decode_submit", "generate", "shutdown_decode"]
+           "RejectedError", "ClosedError", "DeadlineError", "register",
+           "unregister", "models", "submit", "predict", "shutdown",
+           "default_registry", "default_server", "DecodeEntry",
+           "DecodeServer", "DecodeFuture", "PrefixCache", "register_decode",
+           "decode_server", "decode_submit", "generate", "shutdown_decode",
+           "EdgeServer", "Fleet", "Router", "FleetError", "NoReplicaError",
+           "DispatchError"]
 
 _SERVER: Optional[Server] = None
 _LOCK = _tchk.lock("serve.default_server")

@@ -24,7 +24,7 @@ from ..analysis import thread_check as _tchk
 from ..base import MXNetError
 
 __all__ = ["Request", "ServeFuture", "RejectedError", "ClosedError",
-           "RequestQueue"]
+           "DeadlineError", "RequestQueue"]
 
 
 class RejectedError(MXNetError):
@@ -41,6 +41,16 @@ class ClosedError(MXNetError):
     """The server is shut down; no new requests are admitted."""
 
     status = 503
+
+
+class DeadlineError(MXNetError):
+    """A per-request deadline expired before the request finished
+    (HTTP-504 analogue).  For decode requests the slot is released at
+    the next step boundary and any streaming consumer gets a terminal
+    event — the partial tokens are on the request, the future raises
+    this."""
+
+    status = 504
 
 
 class Request:

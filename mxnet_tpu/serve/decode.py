@@ -97,7 +97,7 @@ from ..ops import attention as _att
 from ..parallel import layout as _layout
 from ..resilience import chaos as _chaos
 from ..trace import recorder as _tr
-from .coalescer import ClosedError, RejectedError
+from .coalescer import ClosedError, DeadlineError, RejectedError
 from .prefix import PrefixCache
 
 __all__ = ["DecodeEntry", "DecodeServer", "DecodeFuture", "register_decode",
@@ -177,10 +177,11 @@ class _CacheGrower(HybridBlock):
 class _DecodeRequest:
     __slots__ = ("id", "model", "prompt", "max_new_tokens", "temperature",
                  "top_k", "key", "tokens", "truncated", "corr", "t0",
+                 "on_token", "deadline", "cancelled", "finish_reason",
                  "_event", "_error")
 
     def __init__(self, rid, model, prompt, max_new_tokens, temperature,
-                 top_k, seed):
+                 top_k, seed, on_token=None, deadline=None):
         self.id = rid
         self.model = model
         self.prompt = prompt
@@ -192,8 +193,47 @@ class _DecodeRequest:
         self.truncated = False
         self.corr = _tr.capture()
         self.t0 = time.perf_counter()       # submit time; TTFT anchor
+        # streaming sink: called with each token id as it is sampled,
+        # then once with None at terminal resolution (the edge tier's
+        # per-step SSE feed, serve/edge.py)
+        self.on_token = on_token
+        # absolute time.monotonic() bound; the decode loop releases the
+        # slot at the next step boundary once it passes
+        self.deadline = deadline
+        self.cancelled = False
+        # "stop" | "length" | "deadline" | "cancelled" | "error"
+        self.finish_reason: Optional[str] = None
         self._event = threading.Event()
         self._error: Optional[BaseException] = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and \
+            (now if now is not None else time.monotonic()) >= self.deadline
+
+
+def _emit(req: _DecodeRequest, tok: Optional[int]):
+    """Feed one token (or the ``None`` terminal) to the request's
+    streaming sink.  A broken sink is dropped, never raised — the
+    decode loop must keep serving the other slots."""
+    cb = req.on_token
+    if cb is None:
+        return
+    try:
+        cb(tok)
+    except Exception:  # noqa: BLE001 — sink bug, not a serving bug
+        req.on_token = None
+
+
+def _fail(req: _DecodeRequest, err: BaseException):
+    """Resolve a request with an error (same wire contract as the batch
+    tier: non-MXNetErrors surface wrapped) and fire the terminal
+    streaming event."""
+    req._error = err if isinstance(err, MXNetError) \
+        else MXNetError(f"{type(err).__name__}: {err}")
+    req._error.__cause__ = err
+    req.finish_reason = "error"
+    req._event.set()
+    _emit(req, None)
 
 
 class _Ready:
@@ -231,6 +271,25 @@ class DecodeFuture:
         """True when generation stopped because the cache ran out of
         capacity buckets (not EOS / max-tokens)."""
         return self._req.truncated
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        """Why generation ended: ``"stop"`` (EOS), ``"length"``
+        (max-tokens / truncation), ``"deadline"``, ``"cancelled"``,
+        ``"error"`` — None while still running."""
+        return self._req.finish_reason
+
+    def tokens_so_far(self) -> List[int]:
+        """Snapshot of the tokens generated so far (streaming peek)."""
+        return list(self._req.tokens)
+
+    def cancel(self):
+        """Ask the decode loop to drop this request: the slot is
+        released at the next step boundary, the future resolves with
+        the partial tokens (``finish_reason == "cancelled"``), and a
+        streaming sink gets its terminal event.  The edge tier calls
+        this on client disconnect (docs/serving.md)."""
+        self._req.cancelled = True
 
     def done(self) -> bool:
         return self._req._event.is_set()
@@ -458,10 +517,25 @@ class DecodeServer:
     # ------------------------------------------------------------- API
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
-               seed: Optional[int] = None) -> DecodeFuture:
+               seed: Optional[int] = None, on_token=None,
+               deadline: Optional[float] = None) -> DecodeFuture:
+        """``on_token`` (optional) is called with every sampled token id
+        as generation proceeds, then once with ``None`` at terminal
+        resolution — the streaming feed.  ``deadline`` (optional,
+        seconds from now) bounds the request end to end: an expired
+        request releases its slot at the next step boundary and its
+        future raises :class:`DeadlineError` (already-expired submits
+        shed immediately with the same 503-path :class:`RejectedError`
+        contract as a full queue)."""
         prompt = [int(t) for t in onp.asarray(prompt).reshape(-1)]
         if not prompt:
             raise MXNetError("decode prompt must be non-empty")
+        if deadline is not None and deadline <= 0:
+            if _tel._ENABLED:
+                _tel.inc("serve.rejected")
+            raise RejectedError(
+                f"decode request deadline {deadline!r}s already expired "
+                "at submit; shed")
         with self._cv:
             if self._closed:
                 raise ClosedError(
@@ -477,7 +551,9 @@ class DecodeServer:
                 self._seq, self.entry.name, prompt,
                 max_new_tokens if max_new_tokens is not None
                 else self.entry.max_new_tokens,
-                temperature, top_k, seed)
+                temperature, top_k, seed, on_token=on_token,
+                deadline=None if deadline is None
+                else time.monotonic() + deadline)
             (self._pq if self._prefill_workers else self._q).append(req)
             self._cv.notify_all()
         if _tel._ENABLED:
@@ -539,19 +615,37 @@ class DecodeServer:
                     else:
                         self._admit(item)
                 except BaseException as err:  # noqa: BLE001 — to future
-                    req._error = err if isinstance(err, MXNetError) \
-                        else MXNetError(f"{type(err).__name__}: {err}")
-                    req._error.__cause__ = err
-                    req._event.set()
+                    _fail(req, err)
+            self._reap()
             if self._occupancy() == 0:
                 continue
             self._ensure_capacity()
             if self._occupancy() == 0:
                 continue
             self._step()
+            self._reap()
+
+    def _dead_on_arrival(self, req: _DecodeRequest) -> bool:
+        """Cancelled/expired before claiming a slot: resolve without
+        touching the batch (the slot stays free)."""
+        if req.cancelled:
+            req.finish_reason = "cancelled"
+        elif req.expired():
+            req.finish_reason = "deadline"
+            req._error = DeadlineError(
+                f"decode request {req.id} ({req.model}) deadline expired "
+                "before admission")
+            if _tel._ENABLED:
+                _tel.inc("serve.deadline_exceeded")
+        else:
+            return False
+        self._resolve(req)
+        return True
 
     def _admit(self, req: _DecodeRequest):
         """Slot claim -> prefill -> splice into the running batch."""
+        if self._dead_on_arrival(req):
+            return
         e = self.entry
         caps = e.capacity_buckets
         slot = self._active.index(None)
@@ -567,6 +661,7 @@ class DecodeServer:
             last_logits, row_cache = e.prefill(toks, t, caps[self._cap_i])
             first = self._sample(req, last_logits)
             req.tokens.append(first)
+            _emit(req, first)
             if _tel._ENABLED:
                 _tel.inc("serve.tokens")
                 _tel.observe("serve.ttft_seconds",
@@ -590,6 +685,8 @@ class DecodeServer:
         fails, the slot stays free, and the loop keeps serving."""
         e = self.entry
         req = ready.req
+        if self._dead_on_arrival(req):
+            return
         caps = e.capacity_buckets
         slot = self._active.index(None)
         while not e.capacity_static and caps[self._cap_i] < ready.min_capacity:
@@ -629,10 +726,7 @@ class DecodeServer:
             try:
                 ready = self._run_prefill(req)
             except BaseException as err:  # noqa: BLE001 — to future
-                req._error = err if isinstance(err, MXNetError) \
-                    else MXNetError(f"{type(err).__name__}: {err}")
-                req._error.__cause__ = err
-                req._event.set()
+                _fail(req, err)
             with self._cv:
                 self._prefill_busy -= 1
                 if ready is not None:
@@ -644,6 +738,8 @@ class DecodeServer:
         cold prefill or prefix-remainder forward, trie retention, first
         token.  Returns the shipment for the decode loop, or None when
         generation already finished (EOS / one-token budget)."""
+        if self._dead_on_arrival(req):
+            return None
         e = self.entry
         caps = e.capacity_buckets
         t = len(req.prompt)
@@ -689,6 +785,7 @@ class DecodeServer:
                 self.prefix.insert(req.prompt, row_cache, t)
             first = self._sample(req, last_logits)
             req.tokens.append(first)
+            _emit(req, first)
             if _tel._ENABLED:
                 _tel.inc("serve.tokens")
                 _tel.observe("serve.ttft_seconds",
@@ -742,6 +839,7 @@ class DecodeServer:
             self._lens[i] += 1          # this step appended pending[i]
             tok = self._sample(req, logits[i])
             req.tokens.append(tok)
+            _emit(req, tok)
             newly += 1
             if (e.eos_id is not None and tok == e.eos_id) \
                     or len(req.tokens) >= req.max_new_tokens:
@@ -750,6 +848,31 @@ class DecodeServer:
                 self._pending[i] = tok
         if _tel._ENABLED:
             _tel.inc("serve.tokens", newly)
+
+    def _reap(self):
+        """Release any slot whose request was cancelled or whose
+        deadline expired mid-stream: the slot frees at THIS step
+        boundary (the next admit can claim it), the future resolves
+        with the partial tokens (cancel) or :class:`DeadlineError`
+        (deadline), and the streaming sink gets its terminal event —
+        the satellite-3 contract (tests/test_edge.py)."""
+        now = time.monotonic()
+        for i, req in enumerate(self._active):
+            if req is None:
+                continue
+            if req.cancelled:
+                req.finish_reason = "cancelled"
+            elif req.expired(now):
+                req.finish_reason = "deadline"
+                req._error = DeadlineError(
+                    f"decode request {req.id} ({req.model}) deadline "
+                    f"expired after {len(req.tokens)} token(s); slot "
+                    "released")
+                if _tel._ENABLED:
+                    _tel.inc("serve.deadline_exceeded")
+            else:
+                continue
+            self._release(i)
 
     def _sample(self, req: _DecodeRequest, logits_row: onp.ndarray) -> int:
         if req.temperature <= 0.0:
@@ -770,12 +893,19 @@ class DecodeServer:
             _tel.set_gauge("serve.decode_slots_active", self._occupancy())
 
     def _resolve(self, req: _DecodeRequest):
+        if req.finish_reason is None:
+            req.finish_reason = "length" if req.truncated \
+                or len(req.tokens) >= req.max_new_tokens else "stop"
         req._event.set()
+        _emit(req, None)                    # terminal streaming event
         if _tel._ENABLED:
             _tel.inc("serve.decode_requests")
+            if req.finish_reason == "cancelled":
+                _tel.inc("serve.cancelled")
         if _tr._ENABLED:
             _tr.instant("serve.decode_done", request=req.id,
-                        tokens=len(req.tokens), truncated=req.truncated)
+                        tokens=len(req.tokens), truncated=req.truncated,
+                        finish=req.finish_reason)
 
 
 # ----------------------------------------------------- module-level API

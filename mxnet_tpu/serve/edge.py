@@ -1,0 +1,515 @@
+"""mx.serve.edge — the HTTP network edge over the serving tier
+(docs/serving.md, "Network edge + fleet").
+
+Everything below the edge is callable only from the owning process;
+this module is the seam that turns the in-process tier (serve.submit /
+decode_submit) into a network service — stdlib only, one asyncio event
+loop on one ``mx-edge-loop`` thread.  The edge does NO model work: it
+parses, admits, and bridges to the existing thread-based futures, so
+the batching/decode schedulers keep full control of the device.
+
+Endpoints (HTTP/1.1, one request per connection, ``Connection:
+close``):
+
+* ``POST /v1/predict`` — JSON ``{"model": name, "inputs": [...]}``;
+  every input row is submitted through the continuous-batching tier
+  (they co-batch with everyone else's rows) and the response carries
+  ``{"outputs": [...]}``.
+* ``POST /v1/generate`` — JSON ``{"model": name, "prompt": [ids],
+  "stream": true, ...}``; with ``stream`` (default) the response is a
+  Server-Sent-Events stream fed PER STEP from the decode loop: each
+  sampled token rides ``data: {"i": n, "token": id}`` the moment the
+  loop emits it (a per-request ``asyncio.Queue`` bridged with
+  ``call_soon_threadsafe``), and the stream closes with a terminal
+  ``event: done`` frame naming the finish reason.  ``"stream": false``
+  returns one JSON document at the end.
+* ``GET /healthz`` — cheap liveness (``/readyz``/``/metrics`` live on
+  the obs endpoint, docs/obs.md).
+
+**Deadlines**: the ``X-MXNet-Deadline-Ms`` request header bounds the
+request end to end.  An expired-on-arrival (or non-positive) deadline
+sheds 503 through the same fail-fast path as a full queue
+(:class:`~mxnet_tpu.serve.coalescer.RejectedError`); a deadline that
+expires mid-generate releases the decode slot at the next step boundary
+(serve/decode.py ``_reap``) and answers 504 / a terminal
+``finish_reason: "deadline"`` SSE event.  A client that disconnects
+mid-stream cancels its request the same way — the slot is never
+leaked to a viewer who already hung up.
+
+**Graceful shutdown** (:meth:`EdgeServer.close`): admissions flip to
+503 first, in-flight requests (streams included) drain, THEN the
+listening socket and the loop come down — a replica being drained by
+the fleet supervisor (serve/fleet.py) finishes what it admitted.
+
+Chaos: every admission crosses the ``edge.request`` seam
+(``error``/``torn`` = shed that request 503, ``delay`` = stall the
+handler; docs/resilience.md) so overload and flaky-edge behavior are
+deterministically testable.  Telemetry: ``edge.requests``,
+``edge.streams``, ``edge.rejected`` (docs/telemetry.md).  Env:
+``MXNET_EDGE_PORT`` (0 = ephemeral), ``MXNET_EDGE_HOST``,
+``MXNET_EDGE_WAIT_THREADS``, ``MXNET_EDGE_TIMEOUT``,
+``MXNET_EDGE_MAX_BODY``.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as onp
+
+from .. import telemetry as _tel
+from ..analysis import thread_check as _tchk
+from ..base import MXNetError, get_env
+from ..resilience import chaos as _chaos
+from . import decode as _decode
+from .coalescer import ClosedError, DeadlineError, RejectedError
+
+__all__ = ["EdgeServer", "DEADLINE_HEADER"]
+
+DEADLINE_HEADER = "x-mxnet-deadline-ms"
+
+_REASON = {200: "OK", 400: "Bad Request", 404: "Not Found",
+           405: "Method Not Allowed", 413: "Payload Too Large",
+           500: "Internal Server Error", 503: "Service Unavailable",
+           504: "Gateway Timeout"}
+
+
+class _HttpRequest:
+    __slots__ = ("method", "path", "headers", "body")
+
+    def __init__(self, method, path, headers, body):
+        self.method = method
+        self.path = path
+        self.headers = headers
+        self.body = body
+
+
+def _json_body(doc: dict) -> bytes:
+    return json.dumps(doc, sort_keys=True).encode()
+
+
+class EdgeServer:
+    """The asyncio HTTP front-end (module docstring).
+
+    ``port=None`` reads ``MXNET_EDGE_PORT`` (default 0 = ephemeral —
+    read ``.port``/``.url`` after construction).  ``server`` pins the
+    batch-predict tier to an explicit
+    :class:`~mxnet_tpu.serve.server.Server` (default: the process
+    default server); generate requests always resolve through the
+    module decode registry (``serve.decode_server(name)``)."""
+
+    def __init__(self, port: Optional[int] = None,
+                 host: Optional[str] = None, server=None,
+                 wait_workers: Optional[int] = None):
+        self.host = host if host is not None \
+            else get_env("MXNET_EDGE_HOST", "127.0.0.1")
+        self._port_req = int(port) if port is not None \
+            else get_env("MXNET_EDGE_PORT", 0, int)
+        self._server = server
+        self._timeout = get_env("MXNET_EDGE_TIMEOUT", 120.0, float)
+        self._max_body = get_env("MXNET_EDGE_MAX_BODY",
+                                 64 * 1024 * 1024, int)
+        self._lock = _tchk.lock("serve.edge")
+        self._draining = False
+        self._closed = False
+        self._inflight = 0
+        self.port: Optional[int] = None
+        self._boot_error: Optional[BaseException] = None
+        self._aserver = None
+        self._stop_ev: Optional[asyncio.Event] = None
+        # dedicated pool for blocking future.result() waits — the
+        # default executor's anonymous threads would break the mx-*
+        # thread-name contract (make lint-threads)
+        self._wait_pool = ThreadPoolExecutor(
+            max_workers=wait_workers if wait_workers is not None
+            else get_env("MXNET_EDGE_WAIT_THREADS", 8, int),
+            thread_name_prefix="mx-edge-wait")
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="mx-edge-loop", daemon=True)
+        self._thread.start()
+        self._started.wait(30.0)
+        if self._boot_error is not None:
+            self._thread.join(5.0)
+            self._wait_pool.shutdown(wait=True)
+            raise MXNetError(
+                f"edge: could not bind {self.host}:{self._port_req}: "
+                f"{self._boot_error}") from self._boot_error
+        if _tel._ENABLED:
+            _tel.set_gauge("edge.port", self.port)
+
+    # ---------------------------------------------------------- lifecycle
+    @property
+    def url(self) -> str:
+        host = "127.0.0.1" if self.host in ("0.0.0.0", "") else self.host
+        return f"http://{host}:{self.port}"
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self):
+        """Stop admissions (every new request answers 503) without
+        touching in-flight work — the supervisor's first drain step."""
+        with self._lock:
+            self._draining = True
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def close(self, timeout: float = 30.0):
+        """Graceful shutdown: stop admissions, drain in-flight requests
+        (bounded by ``timeout``), then close the socket and join the
+        loop thread.  Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._draining = True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.01)
+        if self._thread.is_alive():
+            self._loop.call_soon_threadsafe(self._stop_ev.set)
+        self._thread.join(max(1.0, deadline - time.monotonic()))
+        self._wait_pool.shutdown(wait=True)
+        if self._thread.is_alive():
+            raise MXNetError(
+                f"edge: loop thread did not stop within {timeout}s")
+
+    def __enter__(self) -> "EdgeServer":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # --------------------------------------------------------- event loop
+    def _run(self):
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._stop_ev = asyncio.Event()
+            self._aserver = self._loop.run_until_complete(
+                asyncio.start_server(self._handle, self.host,
+                                     self._port_req))
+            self.port = self._aserver.sockets[0].getsockname()[1]
+        except BaseException as e:  # noqa: BLE001 — surfaced to ctor
+            self._boot_error = e
+            self._started.set()
+            self._loop.close()
+            return
+        self._started.set()
+        try:
+            self._loop.run_until_complete(self._stop_ev.wait())
+            self._aserver.close()
+            self._loop.run_until_complete(self._aserver.wait_closed())
+            pending = [t for t in asyncio.all_tasks(self._loop)
+                       if not t.done()]
+            for t in pending:
+                t.cancel()
+            if pending:
+                self._loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True))
+        finally:
+            self._loop.close()
+
+    # ------------------------------------------------------ HTTP plumbing
+    async def _read_request(self, reader) -> Optional[_HttpRequest]:
+        line = await asyncio.wait_for(reader.readline(), 10.0)
+        if not line or line.strip() == b"":
+            return None
+        parts = line.decode("latin-1").split()
+        if len(parts) < 2:
+            return None
+        method, path = parts[0].upper(), parts[1]
+        headers = {}
+        while True:
+            line = await asyncio.wait_for(reader.readline(), 10.0)
+            if not line or line.strip() == b"":
+                break
+            k, _, v = line.decode("latin-1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", 0) or 0)
+        if length > self._max_body:
+            return _HttpRequest(method, path, headers, None)  # 413 later
+        body = await reader.readexactly(length) if length else b""
+        return _HttpRequest(method, path, headers, body)
+
+    @staticmethod
+    def _respond(writer, code: int, body: bytes,
+                 ctype: str = "application/json"):
+        head = (f"HTTP/1.1 {code} {_REASON.get(code, 'Unknown')}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Connection: close\r\n\r\n").encode()
+        writer.write(head + body)
+
+    async def _handle(self, reader, writer):
+        try:
+            req = await self._read_request(reader)
+            if req is None:
+                return
+            if req.body is None:
+                self._respond(writer, 413, _json_body({
+                    "error": f"body exceeds MXNET_EDGE_MAX_BODY="
+                             f"{self._max_body}"}))
+                return
+            await self._dispatch(req, writer)
+            await writer.drain()
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ConnectionError):
+            pass                # slow/hung-up client: nothing to answer
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — a handler bug answers
+            # 500; it must not kill the connection task silently
+            try:
+                self._respond(writer, 500, _json_body({
+                    "error": f"{type(e).__name__}: {e}"}))
+            except Exception:   # noqa: BLE001 — writer already dead
+                pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:   # noqa: BLE001 — already closed/reset
+                pass
+
+    # ---------------------------------------------------------- admission
+    def _deadline_secs(self, req: _HttpRequest):
+        """Parse the deadline header; returns (budget_secs | None,
+        shed_reason | None)."""
+        raw = req.headers.get(DEADLINE_HEADER)
+        if raw is None:
+            return None, None
+        try:
+            ms = float(raw)
+        except ValueError:
+            return None, f"bad {DEADLINE_HEADER} header {raw!r}"
+        if ms <= 0:
+            return None, f"deadline {ms}ms already expired at admission"
+        return ms / 1e3, None
+
+    async def _dispatch(self, req: _HttpRequest, writer):
+        if req.method == "GET" and req.path == "/healthz":
+            self._respond(writer, 200, b"ok\n",
+                          "text/plain; charset=utf-8")
+            return
+        if req.path not in ("/v1/predict", "/v1/generate"):
+            self._respond(writer, 404, _json_body({
+                "error": f"no route {req.path!r}"}))
+            return
+        if req.method != "POST":
+            self._respond(writer, 405, _json_body({
+                "error": f"{req.path} is POST-only"}))
+            return
+        # the edge admission seam: error/torn shed THIS request (the
+        # router's retry path exercises exactly this), delay stalls it
+        if _chaos.active():
+            kind = _chaos.draw("edge.request")
+            if kind == "delay":
+                await asyncio.sleep(
+                    get_env("MXNET_FAULT_DELAY", 0.05, float))
+            elif kind is not None:
+                if _tel._ENABLED:
+                    _tel.inc("edge.rejected")
+                self._respond(writer, 503, _json_body({
+                    "error": "injected fault at 'edge.request'",
+                    "shed": True}))
+                return
+        budget, shed = self._deadline_secs(req)
+        with self._lock:
+            if self._draining and shed is None:
+                shed = "edge draining; replica is being retired"
+            if shed is None:
+                self._inflight += 1
+        if shed is not None:
+            if _tel._ENABLED:
+                _tel.inc("edge.rejected")
+            self._respond(writer, 503, _json_body({
+                "error": shed, "shed": True}))
+            return
+        try:
+            if _tel._ENABLED:
+                _tel.inc("edge.requests")
+            try:
+                doc = json.loads(req.body.decode() or "{}")
+            except (ValueError, UnicodeDecodeError) as e:
+                self._respond(writer, 400, _json_body({
+                    "error": f"bad JSON body: {e}"}))
+                return
+            if req.path == "/v1/predict":
+                await self._predict(doc, budget, writer)
+            else:
+                await self._generate(doc, budget, writer)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    # ------------------------------------------------------------ predict
+    def _batch_server(self):
+        if self._server is not None:
+            return self._server
+        from . import default_server
+        return default_server()
+
+    async def _predict(self, doc: dict, budget, writer):
+        model = doc.get("model")
+        inputs = doc.get("inputs")
+        if not model or not isinstance(inputs, list) or not inputs:
+            self._respond(writer, 400, _json_body({
+                "error": "predict body needs {'model': name, "
+                         "'inputs': [row, ...]}"}))
+            return
+        dtype = doc.get("dtype", "float32")
+        srv = self._batch_server()
+        t0 = time.monotonic()
+        try:
+            arrays = [onp.asarray(x, dtype=dtype) for x in inputs]
+            futs = [srv.submit(model, a) for a in arrays]
+        except RejectedError as e:
+            if _tel._ENABLED:
+                _tel.inc("edge.rejected")
+            self._respond(writer, e.status, _json_body({
+                "error": str(e), "shed": True}))
+            return
+        except (ClosedError, MXNetError) as e:
+            code = getattr(e, "status", None) or \
+                (404 if "no model" in str(e) else 500)
+            self._respond(writer, code,
+                          _json_body({"error": str(e)}))
+            return
+        wait = self._timeout if budget is None else budget
+        loop = asyncio.get_running_loop()
+        try:
+            outs = []
+            for f in futs:
+                left = max(0.001, wait - (time.monotonic() - t0))
+                outs.append(await loop.run_in_executor(
+                    self._wait_pool, f.result, left))
+        except MXNetError as e:
+            timed_out = budget is not None and \
+                time.monotonic() - t0 >= budget
+            code = 504 if timed_out else \
+                getattr(e, "status", None) or 500
+            self._respond(writer, code,
+                          _json_body({"error": str(e)}))
+            return
+        self._respond(writer, 200, _json_body({
+            "model": model,
+            "outputs": [onp.asarray(o).tolist() for o in outs]}))
+
+    # ----------------------------------------------------------- generate
+    async def _generate(self, doc: dict, budget, writer):
+        model = doc.get("model")
+        prompt = doc.get("prompt")
+        if not model or not isinstance(prompt, list) or not prompt:
+            self._respond(writer, 400, _json_body({
+                "error": "generate body needs {'model': name, "
+                         "'prompt': [token, ...]}"}))
+            return
+        stream = bool(doc.get("stream", True))
+        kw = {}
+        for k in ("max_new_tokens", "top_k", "seed"):
+            if doc.get(k) is not None:
+                kw[k] = int(doc[k])
+        if doc.get("temperature") is not None:
+            kw["temperature"] = float(doc["temperature"])
+        try:
+            dsrv = _decode.decode_server(model)
+        except MXNetError as e:
+            self._respond(writer, 404,
+                          _json_body({"error": str(e)}))
+            return
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_token(tok):
+            # decode-loop thread -> event loop; the queue is the
+            # per-request stream feed
+            loop.call_soon_threadsafe(q.put_nowait, tok)
+
+        try:
+            fut = dsrv.submit(prompt, deadline=budget,
+                              on_token=on_token if stream else None,
+                              **kw)
+        except RejectedError as e:
+            if _tel._ENABLED:
+                _tel.inc("edge.rejected")
+            self._respond(writer, e.status, _json_body({
+                "error": str(e), "shed": True}))
+            return
+        except (ClosedError, MXNetError) as e:
+            code = getattr(e, "status", None) or 500
+            self._respond(writer, code,
+                          _json_body({"error": str(e)}))
+            return
+        if stream:
+            await self._stream(fut, q, writer)
+            return
+        wait = self._timeout if budget is None else budget + 1.0
+        try:
+            tokens = await loop.run_in_executor(
+                self._wait_pool, fut.result, wait)
+        except DeadlineError as e:
+            self._respond(writer, e.status, _json_body({
+                "error": str(e), "finish_reason": "deadline",
+                "tokens": fut.tokens_so_far()}))
+            return
+        except MXNetError as e:
+            code = getattr(e, "status", None) or 500
+            self._respond(writer, code,
+                          _json_body({"error": str(e)}))
+            return
+        self._respond(writer, 200, _json_body({
+            "model": model, "tokens": tokens,
+            "finish_reason": fut.finish_reason,
+            "truncated": fut.truncated}))
+
+    async def _stream(self, fut, q: asyncio.Queue, writer):
+        """SSE response fed per step; EOF (Connection: close) delimits
+        the stream.  A failed write = client hung up -> cancel the
+        decode request so its slot frees at the next step boundary."""
+        if _tel._ENABLED:
+            _tel.inc("edge.streams")
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: text/event-stream\r\n"
+                     b"Cache-Control: no-store\r\n"
+                     b"Connection: close\r\n\r\n")
+        i = 0
+        try:
+            await writer.drain()
+            while True:
+                tok = await q.get()
+                if tok is None:
+                    break
+                writer.write(
+                    f"data: {{\"i\": {i}, \"token\": {tok}}}\n\n"
+                    .encode())
+                await writer.drain()
+                i += 1
+            req = fut._req
+            done = {"finish_reason": fut.finish_reason,
+                    "tokens": len(req.tokens),
+                    "truncated": fut.truncated}
+            if req._error is not None:
+                done["error"] = str(req._error)
+            writer.write(b"event: done\ndata: "
+                         + json.dumps(done, sort_keys=True).encode()
+                         + b"\n\n")
+            await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            fut.cancel()        # never leak the slot to a gone client
+            raise
+        except Exception:       # noqa: BLE001 — same: cancel, surface
+            fut.cancel()
+            raise

@@ -1,0 +1,390 @@
+"""mx.serve.edge — the HTTP network edge (ISSUE 19).
+
+The load-bearing claims under test: (1) ``POST /v1/predict`` rides the
+continuous-batching tier and returns each row's exact in-process
+answer; (2) ``POST /v1/generate`` streams SSE frames fed per step from
+the decode loop and the streamed tokens are bit-exact vs the eager
+one-row greedy reference; (3) the ``X-MXNet-Deadline-Ms`` header is
+honored end to end — expired-on-arrival sheds 503 through the
+fail-fast path, and a deadline that expires MID-stream releases the
+decode slot at the next step boundary and answers a terminal
+``finish_reason: "deadline"`` event (504 on the non-stream path) with
+the partial tokens; (4) a client that disconnects mid-stream cancels
+its request so the slot is never leaked; (5) drain flips admissions to
+503 without touching in-flight work, close leaves no ``mx-edge-*``
+thread behind; (6) the ``edge.request`` chaos seam sheds
+deterministically.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serve
+from mxnet_tpu import telemetry as tel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import transformer_lm
+from mxnet_tpu.resilience import chaos
+from mxnet_tpu.serve.edge import DEADLINE_HEADER, EdgeServer
+
+
+@pytest.fixture()
+def fresh_telemetry():
+    prev = tel.set_enabled(True)
+    tel.reset()
+    yield
+    tel.reset()
+    tel.set_enabled(prev)
+
+
+def _mlp(feat=8, classes=4, seed=0):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=feat))
+    net.add(nn.Dense(classes, in_units=16))
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((1, feat)))
+    return net
+
+
+def _tiny_transformer(seed=21, vocab=32):
+    mx.random.seed(seed)
+    lm = transformer_lm(vocab_size=vocab, units=32, hidden_size=64,
+                        num_heads=2, num_layers=1, max_length=64)
+    lm.initialize(mx.init.Xavier())
+    return lm
+
+
+@pytest.fixture(scope="module")
+def served_models():
+    """One registration (and one warmup) for the whole module: the
+    batch mlp on the default server plus the decode lm in the module
+    decode registry — exactly what a fleet worker spec would build."""
+    lm = _tiny_transformer(seed=21)
+    serve.register("edge_mlp", _mlp(), bucketer={0: [2]},
+                   sample=onp.zeros((8,), "float32"))
+    serve.register_decode("edge_lm", lm, slots=2, prompt_buckets=(4, 8),
+                          capacity_buckets=(16, 32), max_new_tokens=6)
+    yield lm
+    serve.shutdown(60.0)
+    serve.shutdown_decode(60.0)
+    serve.unregister("edge_mlp")
+
+
+@pytest.fixture()
+def edge(served_models):
+    srv = EdgeServer(port=0)
+    yield srv
+    srv.close(30.0)
+
+
+# ------------------------------------------------------------ http helpers
+def _post(edge, path, doc, headers=None, timeout=60.0):
+    req = urllib.request.Request(
+        edge.url + path, data=json.dumps(doc).encode(),
+        headers=dict({"Content-Type": "application/json"}, **(headers or {})))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+def _get(edge, path, timeout=10.0):
+    try:
+        with urllib.request.urlopen(edge.url + path, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _sse(edge, doc, headers=None, timeout=120.0):
+    """POST /v1/generate and parse the SSE stream: returns
+    (data_frames, terminal_done_payload)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", edge.port,
+                                      timeout=timeout)
+    try:
+        conn.request("POST", "/v1/generate", json.dumps(doc).encode(),
+                     dict({"Content-Type": "application/json"},
+                          **(headers or {})))
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        frames, event = [], None
+        for raw in resp:
+            line = raw.decode().strip("\r\n")
+            if not line:
+                event = None
+                continue
+            if line.startswith("event:"):
+                event = line[len("event:"):].strip()
+            elif line.startswith("data:"):
+                payload = json.loads(line[len("data:"):].strip())
+                if event == "done":
+                    return frames, payload
+                frames.append(payload)
+        raise AssertionError("SSE stream ended without a 'done' event")
+    finally:
+        conn.close()
+
+
+def _nd_i32(a):
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    return NDArray(jnp.asarray(a, jnp.int32))
+
+
+def _eager_greedy(lm, prompt, n_new, capacity=64):
+    """One-row greedy reference: eager forward (no jit signatures) —
+    the tests/test_decode.py idiom the streamed path must reproduce."""
+    toks = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits, _ = lm.forward(_nd_i32([toks]),
+                               lm.begin_cache(1, capacity),
+                               _nd_i32([0]), _nd_i32([len(toks)]))
+        nxt = int(onp.argmax(logits.asnumpy()[0, len(toks) - 1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+def _gauge(name):
+    return tel.snapshot().get(name, {"value": 0})["value"]
+
+
+def _wait_for(pred, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------------------ routing/4xx
+def test_edge_routes_and_errors(edge):
+    assert _get(edge, "/healthz")[0] == 200
+    assert _get(edge, "/nope")[0] == 404
+    code, doc = _post(edge, "/v1/predict", {})
+    assert code == 400 and "model" in doc["error"]
+    code, doc = _post(edge, "/v1/generate", {"model": "edge_lm"})
+    assert code == 400 and "prompt" in doc["error"]
+    # GET on a POST-only route
+    assert _get(edge, "/v1/predict")[0] == 405
+    # a body that is not JSON at all
+    req = urllib.request.Request(edge.url + "/v1/predict",
+                                 data=b"not json")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10.0)
+    assert ei.value.code == 400
+    # unknown models answer 404, not 500
+    assert _post(edge, "/v1/predict",
+                 {"model": "ghost", "inputs": [[0.0] * 8]})[0] == 404
+    assert _post(edge, "/v1/generate",
+                 {"model": "ghost", "prompt": [1], "stream": False})[0] == 404
+
+
+def test_edge_predict_matches_inprocess(edge, fresh_telemetry):
+    rows = [onp.arange(8, dtype="float32") / 8.0,
+            onp.ones((8,), "float32")]
+    code, doc = _post(edge, "/v1/predict",
+                      {"model": "edge_mlp",
+                       "inputs": [r.tolist() for r in rows]})
+    assert code == 200
+    want = [serve.predict("edge_mlp", r, timeout=30.0) for r in rows]
+    for got, ref in zip(doc["outputs"], want):
+        onp.testing.assert_allclose(onp.asarray(got, "float32"),
+                                    onp.asarray(ref), rtol=1e-5, atol=1e-5)
+    snap = tel.snapshot()
+    assert snap["edge.requests"]["value"] == 1
+
+
+def test_edge_predict_deadline_preexpired_sheds(edge, fresh_telemetry):
+    body = {"model": "edge_mlp", "inputs": [[0.0] * 8]}
+    code, doc = _post(edge, "/v1/predict", body,
+                      headers={DEADLINE_HEADER: "0"})
+    assert code == 503 and doc["shed"]
+    code, doc = _post(edge, "/v1/predict", body,
+                      headers={DEADLINE_HEADER: "garbage"})
+    assert code == 503 and doc["shed"]
+    assert tel.snapshot()["edge.rejected"]["value"] == 2
+    # a generous deadline admits normally
+    code, _ = _post(edge, "/v1/predict", body,
+                    headers={DEADLINE_HEADER: "30000"})
+    assert code == 200
+
+
+# -------------------------------------------------------------- generate
+def test_edge_generate_nonstream_parity(edge, served_models):
+    lm = served_models
+    code, doc = _post(edge, "/v1/generate",
+                      {"model": "edge_lm", "prompt": [1, 2, 3],
+                       "stream": False})
+    assert code == 200
+    assert doc["tokens"] == _eager_greedy(lm, [1, 2, 3], 6)
+    assert doc["finish_reason"] == "length"
+    assert not doc["truncated"]
+
+
+def test_edge_generate_sse_stream_parity(edge, served_models,
+                                         fresh_telemetry):
+    lm = served_models
+    frames, done = _sse(edge, {"model": "edge_lm", "prompt": [4, 5]})
+    toks = [f["token"] for f in frames]
+    assert toks == _eager_greedy(lm, [4, 5], 6)
+    assert [f["i"] for f in frames] == list(range(len(toks)))
+    assert done["finish_reason"] == "length"
+    assert done["tokens"] == len(toks)
+    snap = tel.snapshot()
+    assert snap["edge.streams"]["value"] == 1
+    assert snap.get("serve.decode_slots_active",
+                    {"value": 0})["value"] == 0
+
+
+def _slow_anchor(dsrv, step_secs=0.03, n=24):
+    """Occupy one decode slot with a sink that sleeps per token: every
+    co-batched step now takes >= step_secs, so a wall-clock deadline on
+    a batch-mate expires mid-stream deterministically."""
+
+    def slow(tok):
+        if tok is not None:
+            time.sleep(step_secs)
+
+    return dsrv.submit([9], max_new_tokens=n, on_token=slow)
+
+
+def test_edge_deadline_mid_stream_releases_slot(edge, served_models,
+                                                fresh_telemetry):
+    """Satellite 3 regression: a deadline that expires mid-generate
+    ends the SSE stream with a terminal ``deadline`` event carrying the
+    partial tokens, and the decode slot is back in service."""
+    dsrv = serve.decode_server("edge_lm")
+    anchor = _slow_anchor(dsrv)
+    try:
+        frames, done = _sse(edge, {"model": "edge_lm", "prompt": [3],
+                                   "max_new_tokens": 24},
+                            headers={DEADLINE_HEADER: "300"})
+    finally:
+        anchor.result(60.0)
+    assert done["finish_reason"] == "deadline"
+    assert "error" in done
+    # partial progress: something streamed, but far from completion
+    assert 1 <= len(frames) < 24
+    assert done["tokens"] == len(frames)
+    snap = tel.snapshot()
+    assert snap["serve.deadline_exceeded"]["value"] >= 1
+    # the slot freed at a step boundary — both slots idle again
+    _wait_for(lambda: _gauge("serve.decode_slots_active") == 0,
+              msg="decode slots to free after deadline")
+    # and the lane still serves
+    code, _ = _post(edge, "/v1/generate",
+                    {"model": "edge_lm", "prompt": [7], "stream": False})
+    assert code == 200
+
+
+def test_edge_deadline_mid_generate_nonstream_504(edge, served_models,
+                                                  fresh_telemetry):
+    dsrv = serve.decode_server("edge_lm")
+    anchor = _slow_anchor(dsrv)
+    try:
+        code, doc = _post(edge, "/v1/generate",
+                          {"model": "edge_lm", "prompt": [2],
+                           "stream": False, "max_new_tokens": 24},
+                          headers={DEADLINE_HEADER: "300"})
+    finally:
+        anchor.result(60.0)
+    assert code == 504
+    assert doc["finish_reason"] == "deadline"
+    assert 0 < len(doc["tokens"]) < 24
+    _wait_for(lambda: _gauge("serve.decode_slots_active") == 0,
+              msg="decode slots to free after 504")
+
+
+def test_edge_client_disconnect_releases_slot(edge, served_models,
+                                              fresh_telemetry):
+    """Satellite 3 regression: a viewer that hangs up mid-stream must
+    cancel its decode request — the slot frees at the next step
+    boundary instead of generating for a gone client."""
+    dsrv = serve.decode_server("edge_lm")
+    anchor = _slow_anchor(dsrv)
+    body = json.dumps({"model": "edge_lm", "prompt": [5],
+                       "max_new_tokens": 24}).encode()
+    s = socket.create_connection(("127.0.0.1", edge.port), timeout=30.0)
+    try:
+        s.sendall(b"POST /v1/generate HTTP/1.1\r\n"
+                  b"Host: edge\r\n"
+                  b"Content-Type: application/json\r\n"
+                  b"Content-Length: " + str(len(body)).encode() +
+                  b"\r\n\r\n" + body)
+        buf = b""
+        while b"data:" not in buf:        # at least one token streamed
+            chunk = s.recv(4096)
+            assert chunk, "stream closed before first token"
+            buf += chunk
+        # RST on close so the edge's next write fails immediately
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+    finally:
+        s.close()
+    _wait_for(lambda: tel.snapshot().get(
+        "serve.cancelled", {"value": 0})["value"] >= 1,
+        msg="disconnect to cancel the decode request")
+    anchor.result(60.0)
+    _wait_for(lambda: _gauge("serve.decode_slots_active") == 0,
+              msg="decode slots to free after disconnect")
+    _wait_for(lambda: edge.inflight() == 0, msg="edge inflight drain")
+
+
+# --------------------------------------------------------- drain / chaos
+def test_edge_drain_sheds_then_close(served_models, fresh_telemetry):
+    edge = EdgeServer(port=0)
+    try:
+        assert not edge.draining
+        edge.drain()
+        code, doc = _post(edge, "/v1/predict",
+                          {"model": "edge_mlp", "inputs": [[0.0] * 8]})
+        assert code == 503 and doc["shed"]
+        assert "draining" in doc["error"]
+        # health stays green while draining (the obs /readyz carries
+        # the draining verdict, docs/obs.md)
+        assert _get(edge, "/healthz")[0] == 200
+    finally:
+        edge.close(30.0)
+    # the socket is really gone
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", edge.port), timeout=1.0)
+    left = {t.name for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith("mx-edge")}
+    assert not left, f"edge threads survived close: {sorted(left)}"
+    edge.close(5.0)  # idempotent
+
+
+def test_edge_chaos_request_seam_sheds(edge, fresh_telemetry):
+    chaos.configure("edge.request:error:1.0", seed=0)
+    try:
+        code, doc = _post(edge, "/v1/predict",
+                          {"model": "edge_mlp", "inputs": [[0.0] * 8]})
+        assert code == 503 and doc["shed"]
+        assert "edge.request" in doc["error"]
+        snap = tel.snapshot()
+        assert snap["chaos.injected.edge.request"]["value"] == 1
+        assert snap["edge.rejected"]["value"] == 1
+    finally:
+        chaos.reset()
+    # seam clear -> the same request goes through
+    assert _post(edge, "/v1/predict",
+                 {"model": "edge_mlp", "inputs": [[0.0] * 8]})[0] == 200

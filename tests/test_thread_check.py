@@ -276,6 +276,43 @@ def test_obs_http_thread_name_and_close(witness):
     assert tchk.diagnostics() == []
 
 
+def test_edge_thread_names_and_close(witness):
+    import urllib.request
+
+    from mxnet_tpu.serve.edge import EdgeServer
+
+    srv = EdgeServer(port=0)
+    try:
+        assert "mx-edge-loop" in _mx_threads()
+        # force a wait-pool thread into existence via a live request
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=10.0) as r:
+            assert r.status == 200
+    finally:
+        srv.close(10.0)
+    left = {n for n in _mx_threads() if n.startswith("mx-edge")}
+    assert not left, f"edge threads survived close: {sorted(left)}"
+    assert tchk.diagnostics() == []
+
+
+def test_fleet_supervisor_thread_name_and_close(witness):
+    from mxnet_tpu.serve.fleet import Fleet, Replica
+
+    class _Stub(Fleet):
+        def _spawn_once(self):
+            return Replica(1, proc=None, edge_url="http://127.0.0.1:1",
+                           obs_url="http://127.0.0.1:1",
+                           doc={"pid": 0, "startup_secs": 0.01})
+
+    fleet = _Stub("stub:build", min_replicas=1, max_replicas=1,
+                  heartbeat_every=60.0)
+    try:
+        assert "mx-fleet-supervisor" in _mx_threads()
+    finally:
+        fleet.close(10.0)
+    assert "mx-fleet-supervisor" not in _mx_threads()
+    assert tchk.diagnostics() == []
+
+
 def test_ckpt_writer_thread_name_and_close(witness, tmp_path):
     from mxnet_tpu.resilience.checkpoint import CheckpointManager
 
@@ -326,6 +363,7 @@ def test_no_mx_thread_survives_subsystem_close(witness, tmp_path):
     from mxnet_tpu.obs.http import MetricsServer
     from mxnet_tpu.resilience.checkpoint import CheckpointManager
     from mxnet_tpu.serve.decode import DecodeServer
+    from mxnet_tpu.serve.edge import EdgeServer
     from mxnet_tpu.serve.server import Server
     from mxnet_tpu.trace import flight
 
@@ -334,6 +372,7 @@ def test_no_mx_thread_survives_subsystem_close(witness, tmp_path):
     srv = Server()
     srv._ensure_threads()
     dec = DecodeServer(_StubEntry())
+    edge = EdgeServer(port=0)
     obs = MetricsServer(0)
     mgr = CheckpointManager(str(tmp_path / "ck"))
     mgr._enqueue(lambda: None)
@@ -352,6 +391,7 @@ def test_no_mx_thread_survives_subsystem_close(witness, tmp_path):
     flight.disarm()
     mgr.close()
     obs.close()
+    edge.close(timeout=10.0)
     dec.close(timeout=10.0)
     srv.close(timeout=10.0)
 
