@@ -882,9 +882,11 @@ def _decode_child():
     entry, ok = _dsm.build_entry(report)
     ok = _dsm.donation_gate(entry, report) and ok
     ok = _dsm.decode_phases(entry, report) and ok
+    ok = _dsm.int8_phase(report) and ok
     # ONE row schema, owned by decode_smoke (drift here would desync the
     # banked bench row from the smoke's report["row"])
-    row = _dsm.make_row(report["decode"], platform=platform)
+    row = _dsm.make_row(report["decode"], platform=platform,
+                        int8=report.get("int8"))
     row.update(vs_baseline=None, gates_ok=bool(ok))
     row["telemetry"] = _telemetry_snapshot()
     _bank(row)

@@ -19,9 +19,9 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .loss_scaler import LossScaler
 
-__all__ = ["init", "init_trainer", "convert_hybrid_block", "convert_model",
-           "scale_loss", "unscale", "LossScaler", "list_bf16_ops",
-           "list_fp32_ops"]
+__all__ = ["init", "init_trainer", "trainer_kwargs", "convert_hybrid_block",
+           "convert_model", "scale_loss", "unscale", "LossScaler",
+           "list_bf16_ops", "list_fp32_ops"]
 
 # mirror of amp/lists/symbol_bf16.py: ops whose params/inputs go low-precision
 _BF16_OPS = ["convolution", "deconvolution", "fully_connected", "batch_dot",
@@ -52,22 +52,50 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
         _state["loss_scaler"] = LossScaler()
 
 
+def trainer_kwargs() -> dict:
+    """The ShardedTrainer constructor kwargs the active policy implies —
+    the dtype-policy transform's entry point on the jit substrate::
+
+        amp.init(target_dtype="bfloat16")
+        trainer = ShardedTrainer(net, loss, **amp.trainer_kwargs(), ...)
+
+    bf16 returns ``compute_dtype=bfloat16`` with ``loss_scaling="auto"``
+    (off — bf16 carries fp32-range exponents, and gradients then FLOW
+    bf16 through the dp reduction at half the bytes); fp16 returns
+    ``compute_dtype=float16`` (dynamic scaling auto-enables in-step).
+    Master params stay f32 in both (docs/precision.md)."""
+    if not _state["initialized"]:
+        raise MXNetError("amp.init() must be called before "
+                         "amp.trainer_kwargs()")
+    return {"compute_dtype": _state["target_dtype"],
+            "loss_scaling": "auto"}
+
+
 def init_trainer(trainer):
     """Attach dynamic loss scaling to a Trainer (fp16 path; ref amp.py
-    init_trainer). ShardedTrainer runs its scaling fused inside the jitted
-    step (all_finite + per-leaf select, parallel/trainer.py) — construct it
-    with compute_dtype=float16 and this call just validates that."""
+    init_trainer). ShardedTrainer runs the whole policy fused inside the
+    jitted step (compute_dtype cast + all_finite + per-leaf select,
+    parallel/trainer.py) — construct it with
+    ``compute_dtype=<policy dtype>`` (see :func:`trainer_kwargs`) and
+    this call just validates that."""
     if not _state["initialized"]:
         raise MXNetError("amp.init() must be called before amp.init_trainer()")
     from ..parallel.trainer import ShardedTrainer
 
     if isinstance(trainer, ShardedTrainer):
-        if _state["target_dtype"] == jnp.float16 and \
-                not trainer._dynamic_scaling:
+        want = jnp.dtype(_state["target_dtype"])
+        have = trainer.compute_dtype
+        if have is None or jnp.dtype(have) != want:
             raise MXNetError(
-                "amp fp16 with ShardedTrainer: pass "
-                "compute_dtype=jnp.float16 at construction — scaling runs "
-                "inside the jitted step")
+                f"amp {want.name} with ShardedTrainer: pass "
+                f"compute_dtype=jnp.{want.name} at construction (or use "
+                "amp.trainer_kwargs()) — the policy is traced into the "
+                "jitted step")
+        if want == jnp.float16 and not trainer._dynamic_scaling:
+            raise MXNetError(
+                "amp fp16 with ShardedTrainer: dynamic loss scaling was "
+                "disabled (loss_scaling=False) — fp16 gradients underflow "
+                "without it (docs/precision.md)")
         return
     if _state["loss_scaler"] is not None:
         trainer._amp_loss_scaler = _state["loss_scaler"]

@@ -2,10 +2,19 @@
 
 Same semantics: scale doubles every ``scale_window`` clean steps, halves on
 overflow; overflow check is a fused isfinite-scan (≈ multi_all_finite,
-src/operator/all_finite.cc)."""
+src/operator/all_finite.cc).  This is the EAGER-mode scaler (``amp.
+scale_loss`` / plain Trainer); ``ShardedTrainer(compute_dtype=float16)``
+runs the same policy fused inside the jitted step (``all_finite`` +
+per-leaf select, parallel/trainer.py) and only mirrors the counters here
+for telemetry parity.  ``skipped_steps`` counts overflow-skipped updates;
+``state_dict()``/``load_state_dict()`` checkpoint the scaler so a resumed
+run neither re-warms the scale from ``init_scale`` nor forgets its
+overflow history (docs/precision.md)."""
 from __future__ import annotations
 
 import jax.numpy as jnp
+
+from .. import telemetry as _tel
 
 
 class LossScaler:
@@ -15,6 +24,8 @@ class LossScaler:
         self._scale_window = scale_window
         self._unskipped = 0
         self.has_overflow = False
+        #: overflow-skipped updates since construction/restore
+        self.skipped_steps = 0
 
     def post_backward(self, grads) -> bool:
         """Check grads; update scale. Returns True if step must be skipped."""
@@ -24,9 +35,33 @@ class LossScaler:
         if self.has_overflow:
             self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
             self._unskipped = 0
+            self.skipped_steps += 1
         else:
             self._unskipped += 1
             if self._unskipped >= self._scale_window:
                 self.loss_scale *= self._scale_factor
                 self._unskipped = 0
+        if _tel._ENABLED:
+            _tel.set_gauge("amp.loss_scale", float(self.loss_scale))
+            _tel.set_gauge("amp.skipped_steps", self.skipped_steps)
         return self.has_overflow
+
+    def state_dict(self) -> dict:
+        """Checkpointable scaler state (plain JSON-able scalars)."""
+        return {"loss_scale": float(self.loss_scale),
+                "scale_factor": float(self._scale_factor),
+                "scale_window": int(self._scale_window),
+                "unskipped": int(self._unskipped),
+                "skipped_steps": int(self.skipped_steps)}
+
+    def load_state_dict(self, state: dict):
+        """Restore :meth:`state_dict` output; missing keys (older
+        checkpoints) keep their constructed values."""
+        self.loss_scale = float(state["loss_scale"])
+        self._scale_factor = float(state.get("scale_factor",
+                                             self._scale_factor))
+        self._scale_window = int(state.get("scale_window",
+                                           self._scale_window))
+        self._unskipped = int(state.get("unskipped", 0))
+        self.skipped_steps = int(state.get("skipped_steps", 0))
+        self.has_overflow = False

@@ -219,6 +219,19 @@ RULES: Dict[str, tuple] = {
         "overlap=True / MXNET_OVERLAP=1) so the flush lowers to "
         "overlappable pieces, or drop the op from the budget's "
         "async_required list if blocking is intended (docs/analysis.md)"),
+    "X008": (
+        "no-int8-dot-in-quantized-model",
+        "the model budget declares require_int8_dots (set automatically "
+        "by Registry.register(precision='int8')) but a dot-carrying "
+        "executable contains zero integer-accumulated dot/convolution "
+        "ops — the PTQ calibrate->rewrite pipeline was bypassed or the "
+        "quantized layers were swapped back out, so the model silently "
+        "serves full-precision math while claiming int8",
+        "register through Registry.register(precision='int8', "
+        "calib_data=...) so quantize_net rewrites the block before "
+        "warmup, or drop the precision claim / the budget's "
+        "require_int8_dots flag if f32 serving is intended "
+        "(docs/precision.md)"),
     "X006": (
         "host-callback-in-jit",
         "a host callback (pure_callback/io_callback/debug callback) is "

@@ -26,6 +26,11 @@ Rules (shared ``Diagnostic`` shape, catalog in ``diagnostics.RULES``):
   declares ``async_required`` per op; a listed collective appearing in
   plain synchronous form — no ``-start``/``-done`` pair, no decomposed
   permute-ring — fails)
+* **X008** no int8 dot in a quantized model (the budget declares
+  ``require_int8_dots``: a ``precision="int8"`` serve entry whose
+  executable carries ZERO integer-accumulated dot/convolution ops is
+  silently running the f32 math it promised to replace — the PTQ
+  rewrite was lost before lowering)
 
 Hooked into the three places executables are born — ``_CachedOp``
 compile/warmup, ``ShardedTrainer.compile()``/AOT, and the serve
@@ -85,6 +90,16 @@ _MLIR_INSTR_RE = re.compile(r"=\s*\"?(?:stablehlo|mhlo)\.([a-z_0-9]+)")
 _ALIAS_RE = re.compile(r"\((\d+),\s*\{[^}]*\},\s*(?:may|must)-alias\)")
 _CUSTOM_CALL_RE = re.compile(r'custom_call_target="([^"]+)"')
 _MLIR_CUSTOM_CALL_RE = re.compile(r"stablehlo\.custom_call\s+@([\w.$-]+)")
+# an integer-accumulated dot/convolution: the one lowering-proof trace
+# of int8 arithmetic.  XLA:CPU widens s8 operands to s32 before the dot
+# so the OPERAND types are backend-chosen; the integer OUTPUT type
+# (s32[...], from preferred_element_type=int32) survives every backend.
+_HLO_INT_DOT_RE = re.compile(
+    r"=\s*[su]\d+\[[^\]]*\]\S*\s+(?:dot|convolution)\(")
+# StableHLO spells the result type at line end:  ... -> tensor<4x5xi32>
+_MLIR_INT_DOT_RE = re.compile(
+    r"(?:stablehlo|mhlo)\.(?:dot_general|dot|convolution)\b"
+    r".*->\s*tensor<(?:[^>]*x)?[su]?i\d+>")
 # an HLO computation header:  %wrapped_all-gather (param: ...) -> ... {
 # (no '=' — instruction lines never match)
 _HLO_COMP_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\([^=]*\)\s*->")
@@ -100,7 +115,7 @@ class ExecutableFacts:
 
     __slots__ = ("name", "op_counts", "aliased_params", "f64_count",
                  "callback_targets", "dialect", "cost", "lowered_concats",
-                 "sync_collective_counts")
+                 "sync_collective_counts", "int8_dot_count")
 
     def __init__(self, name: str = "", op_counts: Optional[Counter] = None,
                  aliased_params: Optional[Set[int]] = None,
@@ -109,7 +124,8 @@ class ExecutableFacts:
                  dialect: str = "hlo",
                  cost: Optional[Dict[str, float]] = None,
                  lowered_concats: Optional[int] = None,
-                 sync_collective_counts: Optional[Counter] = None):
+                 sync_collective_counts: Optional[Counter] = None,
+                 int8_dot_count: int = 0):
         self.name = name
         self.op_counts: Counter = op_counts or Counter()
         self.aliased_params: Set[int] = aliased_params or set()
@@ -127,6 +143,10 @@ class ExecutableFacts:
         # so a budget could never tell them apart; X007 reads this
         self.sync_collective_counts: Counter = \
             sync_collective_counts or Counter()
+        # dot/convolution ops with an integer accumulator type — the
+        # evidence X008 needs that a precision="int8" model's quantized
+        # arithmetic actually survived into the lowered program
+        self.int8_dot_count = int(int8_dot_count)
 
     def count(self, *ops: str) -> int:
         return sum(self.op_counts.get(o, 0) for o in ops)
@@ -154,6 +174,7 @@ class ExecutableFacts:
                 "concatenates": self.concat_count,
                 "compiled_concatenates": self.count(*CONCAT_OPS),
                 "aliased_params": sorted(self.aliased_params),
+                "int8_dots": self.int8_dot_count,
                 "f64_count": self.f64_count,
                 "callback_targets": list(self.callback_targets),
                 "cost": self.cost}
@@ -178,9 +199,12 @@ def parse_program_text(text: str, name: str = "") -> ExecutableFacts:
     mlir = "stablehlo." in text or "mhlo." in text \
         or text.lstrip().startswith("module @")
     ops: Counter = Counter()
+    int8_dots = 0
     if mlir:
         for m in _MLIR_INSTR_RE.finditer(text):
             ops[_normalize_op(m.group(1))] += 1
+        int8_dots = sum(1 for ln in text.splitlines()
+                        if _MLIR_INT_DOT_RE.search(ln))
         callback_targets = [
             t for t in _MLIR_CUSTOM_CALL_RE.findall(text)
             if any(h in t.lower() for h in CALLBACK_TARGET_HINTS)]
@@ -204,6 +228,9 @@ def parse_program_text(text: str, name: str = "") -> ExecutableFacts:
             if m:
                 if comp not in async_bodies:
                     ops[m.group(1)] += 1
+                if m.group(1) in ("dot", "convolution") \
+                        and _HLO_INT_DOT_RE.search(line):
+                    int8_dots += 1
                 continue
             h = _HLO_COMP_RE.match(line)
             if h:
@@ -240,7 +267,8 @@ def parse_program_text(text: str, name: str = "") -> ExecutableFacts:
     return ExecutableFacts(name=name, op_counts=ops, aliased_params=aliased,
                            f64_count=f64, callback_targets=callback_targets,
                            dialect="stablehlo" if mlir else "hlo",
-                           sync_collective_counts=sync)
+                           sync_collective_counts=sync,
+                           int8_dot_count=int8_dots)
 
 
 # ---------------------------------------------------------------- budgets
@@ -251,7 +279,7 @@ def default_budget() -> Dict[str, Any]:
     concatenate bound."""
     return {"concatenates": None, "collectives": None,
             "allow_f64": False, "allow_callbacks": False,
-            "async_required": None}
+            "async_required": None, "require_int8_dots": False}
 
 
 def merge_budget(*layers: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -330,6 +358,20 @@ def run_rules(facts: ExecutableFacts, budget: Optional[Dict[str, Any]] = None,
                     f"the surrounding compute instead of overlapping; "
                     f"emit the -start/-done async pair or the decomposed "
                     f"permute-ring form (docs/sharding.md, overlap=True)")
+
+    # X008 — quantized model whose executable carries no int8 dot
+    if budget.get("require_int8_dots") and facts.count("dot",
+                                                       "convolution"):
+        if facts.int8_dot_count == 0:
+            add("X008",
+                "the model budget declares require_int8_dots (a "
+                "precision=\"int8\" serve entry) but the executable "
+                "contains ZERO integer-accumulated dot/convolution ops "
+                "— the PTQ rewrite was lost before lowering and the "
+                "model silently serves the f32 math it promised to "
+                "replace; re-register through "
+                "Registry.register(precision=\"int8\") so quantize_net "
+                "runs, or drop the precision claim (docs/precision.md)")
 
     # X004 — donated argument not actually aliased
     missing = sorted(set(int(i) for i in donated_params)

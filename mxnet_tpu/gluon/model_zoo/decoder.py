@@ -17,9 +17,12 @@ grids over (T, C) and the whole thing AOT-warms at registration.
 Signature contract (both carriers):
 
   * ``tokens``    — ``(B, T)`` int32 token ids.
-  * ``cache``     — tuple of per-layer leaf pairs.  Transformer:
+  * ``cache``     — tuple of per-layer leaf tuples.  Transformer:
     ``((k0, v0), ...)`` each ``(B, H, C, dh)`` with C the bucketed
-    capacity axis.  LSTM: ``((h0, c0), ...)`` each ``(B, U)`` —
+    capacity axis; with ``cache_dtype="int8"`` the per-layer tuple is
+    ``(k_q, k_scale, v_q, v_scale)`` — int8 payload pages plus
+    per-position f32 scales ``(B, H, C, 1)``, ~4x less HBM per page
+    (docs/precision.md).  LSTM: ``((h0, c0), ...)`` each ``(B, U)`` —
     capacity-independent, the recurrent state IS the whole history.
   * ``cache_len`` — ``(B,)`` int32, the PRE-call valid length per row.
     Transformer attention lets local query ``i`` see cache positions
@@ -77,7 +80,8 @@ class CausalSelfAttentionCell(HybridBlock):
         self.proj = nn.Dense(units, use_bias=use_bias, flatten=False,
                              in_units=units)
 
-    def forward(self, x, k_cache, v_cache, cache_len):
+    def forward(self, x, k_cache, v_cache, cache_len,
+                k_scale=None, v_scale=None):
         from ... import numpy as mnp
         q, k, v = mnp.split(self.qkv(x), 3, axis=-1)     # (B, T, U) each
         b, t = x.shape[0], x.shape[1]
@@ -85,6 +89,20 @@ class CausalSelfAttentionCell(HybridBlock):
         qh = q.reshape(b, t, h, dh).transpose(0, 2, 1, 3)   # (B, H, T, dh)
         kh = k.reshape(b, t, h, dh).transpose(0, 2, 1, 3)
         vh = v.reshape(b, t, h, dh).transpose(0, 2, 1, 3)
+        if k_scale is not None:
+            # int8 cache: quantize BEFORE the append — cache_append casts
+            # payloads to the cache dtype and a raw float->int8 astype
+            # TRUNCATES instead of rounding to scale (ops/attention.py)
+            kq, ks = npx.quantize_kv(kh)
+            vq, vs = npx.quantize_kv(vh)
+            k_new = npx.cache_append(k_cache, kq, cache_len)
+            v_new = npx.cache_append(v_cache, vq, cache_len)
+            ks_new = npx.cache_append(k_scale, ks, cache_len)
+            vs_new = npx.cache_append(v_scale, vs, cache_len)
+            out = npx.flash_attention_decode(qh, k_new, v_new, cache_len,
+                                             k_scale=ks_new, v_scale=vs_new)
+            out = out.transpose(0, 2, 1, 3).reshape(b, t, self._units)
+            return self.proj(out), k_new, ks_new, v_new, vs_new
         k_new = npx.cache_append(k_cache, kh, cache_len)
         v_new = npx.cache_append(v_cache, vh, cache_len)
         out = npx.flash_attention_decode(qh, k_new, v_new, cache_len)
@@ -109,7 +127,15 @@ class TransformerDecoderCell(HybridBlock):
         self.ln_att = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
         self.ln_ffn = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
 
-    def forward(self, x, k_cache, v_cache, cache_len):
+    def forward(self, x, k_cache, v_cache, cache_len,
+                k_scale=None, v_scale=None):
+        if k_scale is not None:
+            a, k_new, ks_new, v_new, vs_new = self.attention(
+                self.ln_att(x), k_cache, v_cache, cache_len,
+                k_scale, v_scale)
+            x = x + a
+            x = x + self.ffn(self.ln_ffn(x))
+            return x, k_new, ks_new, v_new, vs_new
         a, k_new, v_new = self.attention(self.ln_att(x), k_cache, v_cache,
                                          cache_len)
         x = x + a
@@ -127,8 +153,15 @@ class TransformerLM(HybridBlock):
 
     def __init__(self, vocab_size=256, units=128, hidden_size=None,
                  num_layers=2, num_heads=4, max_length=2048,
-                 layer_norm_eps=1e-5, dtype=jnp.float32, **kw):
+                 layer_norm_eps=1e-5, dtype=jnp.float32,
+                 cache_dtype=None, **kw):
         super().__init__(**kw)
+        if cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"cache_dtype={cache_dtype!r} unsupported; None (cache in "
+                "the model dtype) or 'int8' (quantized KV pages with "
+                "per-position scales, docs/precision.md)")
+        self._cache_dtype = cache_dtype
         self._vocab_size = vocab_size
         self._units = units
         self._num_layers = num_layers
@@ -151,6 +184,17 @@ class TransformerLM(HybridBlock):
     def begin_cache(self, batch_size, capacity):
         from ... import numpy as mnp
         shape = (batch_size, self._num_heads, capacity, self._head_dim)
+        if self._cache_dtype == "int8":
+            # (k_q, k_scale, v_q, v_scale) per layer: int8 payload pages
+            # plus per-position f32 scales (B, H, C, 1) — every leaf is
+            # a 4-D capacity-axis page layout, so the serve tier's
+            # grower/mover/prefix-trie treat scales as (thin) pages
+            sshape = shape[:3] + (1,)
+            return tuple((mnp.zeros(shape, dtype=jnp.int8),
+                          mnp.zeros(sshape, dtype=jnp.float32),
+                          mnp.zeros(shape, dtype=jnp.int8),
+                          mnp.zeros(sshape, dtype=jnp.float32))
+                         for _ in range(self._num_layers))
         return tuple((mnp.zeros(shape, dtype=self._dtype),
                       mnp.zeros(shape, dtype=self._dtype))
                      for _ in range(self._num_layers))
@@ -167,9 +211,14 @@ class TransformerLM(HybridBlock):
         emb = emb + mnp.take(self.position_weight.data(), pos, axis=0)
         x = emb
         new_cache = []
-        for cell, (k_c, v_c) in zip(self.layers, cache):
-            x, k_n, v_n = cell(x, k_c, v_c, cache_len)
-            new_cache.append((k_n, v_n))
+        for cell, pair in zip(self.layers, cache):
+            if len(pair) == 4:          # int8 cache: (kq, ks, vq, vs)
+                x, k_n, ks_n, v_n, vs_n = cell(
+                    x, pair[0], pair[2], cache_len, pair[1], pair[3])
+                new_cache.append((k_n, ks_n, v_n, vs_n))
+            else:
+                x, k_n, v_n = cell(x, pair[0], pair[1], cache_len)
+                new_cache.append((k_n, v_n))
         hid = self.ln_f(x)
         logits = npx.fully_connected(hid, self.word_embed.weight.data(),
                                      self.out_bias.data(),

@@ -552,17 +552,46 @@ def cache_page_copy(dst, src, n_pages, src_start=0, dst_start=0, dst_row=0,
 
 
 def flash_attention_decode(query, key, value, cache_len, scale=None,
-                           out=None):
+                           k_scale=None, v_scale=None, out=None):
     """Decode-mode attention of (B, H, Tq, D) queries against a
     (B, H, C, D) KV cache with per-row PRE-append ``cache_len`` (B,) —
     local query ``i`` attends cache positions ``<= cache_len + i``
-    (ops/attention.flash_attention_decode; pallas on TPU)."""
+    (ops/attention.flash_attention_decode; pallas on TPU).  With
+    ``k_scale``/``v_scale`` (B, H, C, 1) the cache is int8 per
+    :func:`quantize_kv` and dequant happens inside the kernel."""
     from ..ops import attention as _att
 
+    if k_scale is not None:
+        return call(lambda q, k, v, l, ks, vs: _att.flash_attention_decode(
+            q, k, v, l, scale=scale, k_scale=ks, v_scale=vs),
+            (query, key, value, cache_len, k_scale, v_scale), {},
+            name="flash_attention_decode", out=out)
     return call(lambda q, k, v, l: _att.flash_attention_decode(
         q, k, v, l, scale=scale),
         (query, key, value, cache_len), {},
         name="flash_attention_decode", out=out)
+
+
+def quantize_kv(x, out=None):
+    """Symmetric per-position int8 quantization of (B, H, T, D) K/V
+    rows -> ``(q int8, scale f32 (B, H, T, 1))`` — run BEFORE
+    :func:`cache_append` into an int8 cache (ops/attention.quantize_kv;
+    docs/precision.md)."""
+    from ..ops import attention as _att
+
+    return call(lambda a: _att.quantize_kv(a), (x,), {},
+                name="quantize_kv", out=out)
+
+
+def dequantize_kv(q, scale, dtype=None, out=None):
+    """Inverse of :func:`quantize_kv` (ops/attention.dequantize_kv)."""
+    import jax.numpy as _jnp
+
+    from ..ops import attention as _att
+
+    return call(lambda a, s: _att.dequantize_kv(
+        a, s, dtype=_jnp.float32 if dtype is None else dtype),
+        (q, scale), {}, name="dequantize_kv", out=out)
 
 
 def multi_head_attention(query, key, value, num_heads, mask=None,

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 from ..kernels import registry as _kreg
 
 __all__ = ["flash_attention", "attention_reference",
-           "flash_attention_decode", "cache_append", "cache_page_copy"]
+           "flash_attention_decode", "cache_append", "cache_page_copy",
+           "quantize_kv", "dequantize_kv"]
 
 _NEG_INF = float("-inf")
 
@@ -262,6 +263,34 @@ def cache_append(cache, new, lengths):
     return jax.vmap(one)(cache, new, lengths)
 
 
+def quantize_kv(x):
+    """Symmetric per-position int8 quantization of K/V rows: ``x``
+    (B, H, T, dh) float -> ``(q int8 (B, H, T, dh), scale f32
+    (B, H, T, 1))`` with one scale per (row, head, position) block —
+    the dh-wide granularity that keeps the dequant a cheap broadcast
+    inside the decode kernel (docs/precision.md, "KV-cache layout").
+
+    ``scale = amax / 127`` (symmetric, zero-point-free: attention keys
+    and values are zero-centered post-projection); an all-zero block
+    gets ``scale = 1/127`` so the roundtrip stays exact-zero instead of
+    dividing by zero.  Quantize BEFORE :func:`cache_append` — the
+    append casts payloads to the cache dtype, and a raw float->int8
+    ``astype`` TRUNCATES instead of rounding-to-scale."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0.0, amax / 127.0, 1.0 / 127.0)
+    q = jnp.clip(jnp.round(xf / scale), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv`: ``q`` int8 (..., dh) x ``scale``
+    f32 (..., 1) -> float (..., dh).  The reference decode path and the
+    host-side cache inspectors share this one definition so quantized
+    caches round-trip identically everywhere."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
 def cache_page_copy(dst, src, n_pages: int, *, src_start=0, dst_start=0,
                     dst_row=0):
     """Copy ``n_pages`` consecutive KV-cache pages (capacity-axis rows)
@@ -301,19 +330,32 @@ def _decode_mask(cache_len, tq, tk):
     return m[:, None]
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
-                   bq: int, bk: int, nk: int, with_lse: bool = False):
+def _decode_kernel(*refs, scale: float, bq: int, bk: int, nk: int,
+                   with_lse: bool = False, quantized: bool = False):
     """Single-q-block flash attention against a KV cache: grid
     (B*H, nk) — the whole (padded) query chunk rides one block, kv
     blocks stream past it with the same online softmax + block skip as
     ``_flash_kernel``.  Per-row cache length lives in SMEM; the causal
-    rule is the chunk-offset one: ``kpos <= cache_len + qidx``."""
+    rule is the chunk-offset one: ``kpos <= cache_len + qidx``.
+
+    ``quantized``: k/v blocks are int8 with per-position f32 scale
+    blocks (``(1, bk)``) riding alongside — dequant happens HERE,
+    per streamed kv block, so the cache stays int8 in HBM end to end
+    (the whole point of the precision ladder's decode half)."""
     import jax.experimental.pallas as pl
 
-    if with_lse:
-        lse_ref, acc_ref, m_ref, l_ref = rest
+    if quantized:
+        len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref = refs[:6]
+        rest = refs[6:]
     else:
-        lse_ref, (acc_ref, m_ref, l_ref) = None, rest
+        len_ref, q_ref, k_ref, v_ref = refs[:4]
+        ks_ref = vs_ref = None
+        rest = refs[4:]
+    o_ref = rest[0]
+    if with_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest[1:]
+    else:
+        lse_ref, (acc_ref, m_ref, l_ref) = None, rest[1:]
 
     j = pl.program_id(1)
 
@@ -328,6 +370,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
     def _step():
         q = q_ref[0].astype(jnp.float32)           # (bq, d)
         k = k_ref[0].astype(jnp.float32)           # (bk, d)
+        if quantized:
+            k = k * ks_ref[0][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk)
@@ -341,9 +385,15 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
         p = jnp.exp(jnp.where(jnp.isfinite(s), s - safe_m, _NEG_INF))
         corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
         l_new = l_ref[:, :1] * corr + p.sum(axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        if quantized:
+            vblk = v_ref[0].astype(jnp.float32) * vs_ref[0][:, None]
+            pv = jax.lax.dot_general(
+                p, vblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            pv = jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
         acc_ref[...] = acc_ref[...] * corr + pv
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -366,13 +416,17 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
 
 def _decode_forward_pallas(q, k, v, cache_len, scale: float,
                            interpret: bool = False,
-                           return_lse: bool = False):
+                           return_lse: bool = False,
+                           k_scale=None, v_scale=None):
     """(B, H, Tq, d) x (B, H, C, d) cache decode attention via
     pallas_call.  Tq is padded up to the 8-row sublane tile; the padded
-    query rows compute garbage that is sliced off before returning."""
+    query rows compute garbage that is sliced off before returning.
+    With ``k_scale``/``v_scale`` (B, H, C, 1) the cache is int8 and the
+    scales stream as ``(1, bk)`` f32 blocks next to their kv blocks."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    quantized = k_scale is not None
     b, h, tq, d = q.shape
     c = k.shape[2]
     bq = -(-tq // 8) * 8                      # sublane-tile the chunk
@@ -386,7 +440,8 @@ def _decode_forward_pallas(q, k, v, cache_len, scale: float,
     lens = jnp.broadcast_to(cache_len.astype(jnp.int32)[:, None],
                             (b, h)).reshape(b * h, 1)
     kernel = functools.partial(_decode_kernel, scale=scale, bq=bq, bk=bk,
-                               nk=nk, with_lse=return_lse)
+                               nk=nk, with_lse=return_lse,
+                               quantized=quantized)
     o_spec = pl.BlockSpec((1, bq, d), lambda b_, j: (b_, 0, 0))
     o_shape = jax.ShapeDtypeStruct((b * h, bq, d), q.dtype)
     if return_lse:
@@ -395,22 +450,31 @@ def _decode_forward_pallas(q, k, v, cache_len, scale: float,
                      jax.ShapeDtypeStruct((b * h, bq), jnp.float32)]
     else:
         out_specs, out_shape = o_spec, o_shape
+    kv_spec = pl.BlockSpec((1, bk, d), lambda b_, j: (b_, j, 0))
+    in_specs = [
+        pl.BlockSpec((b * h, 1), lambda b_, j: (0, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, bq, d), lambda b_, j: (b_, 0, 0)),
+    ]
+    operands = [lens, qr]
+    if quantized:
+        sc_spec = pl.BlockSpec((1, bk), lambda b_, j: (b_, j))
+        in_specs += [kv_spec, sc_spec, kv_spec, sc_spec]
+        operands += [kr, k_scale.astype(jnp.float32).reshape(b * h, c),
+                     vr, v_scale.astype(jnp.float32).reshape(b * h, c)]
+    else:
+        in_specs += [kv_spec, kv_spec]
+        operands += [kr, vr]
     out = pl.pallas_call(
         kernel,
         grid=(b * h, nk),
-        in_specs=[
-            pl.BlockSpec((b * h, 1), lambda b_, j: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, d), lambda b_, j: (b_, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda b_, j: (b_, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b_, j: (b_, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[_vmem((bq, d)), _vmem((bq, 128)), _vmem((bq, 128))],
         compiler_params=_kreg.tpu_compiler_params(("parallel", "arbitrary")),
         interpret=interpret,
-    )(lens, qr, kr, vr)
+    )(*operands)
     if return_lse:
         o, lse = out
         return (o.reshape(b, h, bq, d)[:, :, :tq],
@@ -431,7 +495,8 @@ def _select_decode_kernel(q, k):
 
 
 def flash_attention_decode(q, k, v, cache_len, scale: Optional[float] = None,
-                           return_lse: bool = False):
+                           return_lse: bool = False,
+                           k_scale=None, v_scale=None):
     """Decode-mode attention: ``Tq`` freshly appended queries against a
     fixed-capacity KV cache (the generative hot path, docs/serving.md).
 
@@ -447,11 +512,18 @@ def flash_attention_decode(q, k, v, cache_len, scale: Optional[float] = None,
         past the capacity must be grown first (see :func:`cache_append`).
     return_lse: also return the (B, H, Tq) f32 row log-sum-exp (same
         plumbing as the training kernel's residual).
+    k_scale/v_scale: per-position f32 scales (B, H, C, 1) of an int8
+        k/v cache (:func:`quantize_kv`) — dequant runs inside the
+        kernel per streamed block, so HBM holds int8 end to end
+        (~4x smaller pages; docs/precision.md).  Pass both or neither.
 
     Rows may be inert (a freed serve slot): ``cache_len = 0`` with a
     dummy token attends only itself — finite output, no NaN.  No custom
     VJP: decode is inference-only; gradients fall to jax's autodiff of
     the reference path."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("flash_attention_decode: pass both k_scale and "
+                         "v_scale (quantized cache) or neither")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     cache_len = jnp.asarray(cache_len).astype(jnp.int32)
@@ -460,7 +532,8 @@ def flash_attention_decode(q, k, v, cache_len, scale: Optional[float] = None,
         try:
             out = _decode_forward_pallas(q, k, v, cache_len, float(scale),
                                          interpret=kmode == "interpret",
-                                         return_lse=return_lse)
+                                         return_lse=return_lse,
+                                         k_scale=k_scale, v_scale=v_scale)
             _kreg.dispatched("flash_attention_decode", kmode)
             return out
         except Exception as e:  # noqa: BLE001 - degrade observably
@@ -470,6 +543,9 @@ def flash_attention_decode(q, k, v, cache_len, scale: Optional[float] = None,
                 raise
             _kreg.fallback("flash_attention_decode",
                            f"kernel error: {type(e).__name__}: {e}")
+    if k_scale is not None:
+        k = dequantize_kv(k, k_scale, dtype=q.dtype)
+        v = dequantize_kv(v, v_scale, dtype=q.dtype)
     m = _decode_mask(cache_len, q.shape[2], k.shape[2])
     out = attention_reference(q, k, v, mask=m, scale=scale)
     if return_lse:

@@ -839,7 +839,8 @@ def make_train_step(net, loss_fn, names: List[str],
                     partition: str = "replicated",
                     fused_opt: Optional[str] = None,
                     overlap: bool = False,
-                    pipeline: Optional[Dict[str, Any]] = None):
+                    pipeline: Optional[Dict[str, Any]] = None,
+                    loss_scaling: Any = "auto"):
     """Build the jitted SPMD train machinery. Returns
     (step, grad_fn, apply_fn, adapter, holder):
 
@@ -855,13 +856,21 @@ def make_train_step(net, loss_fn, names: List[str],
     imperative update() replays inside the trace with traced lr/t
     (_OptAdapter).
 
-    fp16 (compute_dtype == float16) enables dynamic loss scaling in the
-    step (ref python/mxnet/amp/loss_scaler.py + all_finite op): the loss is
-    multiplied by scale_state[0] before the backward, gradients unscaled,
-    and on overflow the update is skipped (per-leaf select) and the scale
-    halves; after ``loss_scale_growth_interval`` clean steps it doubles.
-    bf16 needs none of this (fp32-range exponents) and fp32/bf16 steps run
-    with the scale pinned at 1.
+    ``loss_scaling`` selects dynamic loss scaling (ref
+    python/mxnet/amp/loss_scaler.py + all_finite op): ``"auto"`` enables
+    it exactly for fp16 compute (bf16 carries fp32-range exponents and
+    needs none by default), ``True``/``False`` force it on/off for any
+    low-precision policy.  When active the loss is multiplied by
+    scale_state[0] before the backward, gradients unscaled, and on
+    overflow the update is skipped (per-leaf select), the scale halves
+    and ``scale_state[2]`` (skipped-step count) ticks; after
+    ``loss_scale_growth_interval`` clean steps the scale doubles.
+    Unscaled steps run with the scale pinned at 1.
+
+    bf16 without scaling is the AMP fast path: gradients LEAVE the
+    backward in bf16 and ride the dp reduction at half the AllReduce
+    bytes; every optimizer adapter casts them to f32 at update entry, so
+    the master-weight update math is untouched (docs/precision.md).
 
     grad_fn/apply_fn split the step for gradient accumulation (micro-batch
     grads summed host-side between applies).
@@ -946,8 +955,25 @@ def make_train_step(net, loss_fn, names: List[str],
     else:
         adapter = _pick_adapter(opt, multi_tensor, fused_opt,
                                 all_f32=all_f32)
-    dynamic_scaling = compute_dtype is not None and \
-        jnp.dtype(compute_dtype) == jnp.float16
+    if loss_scaling not in ("auto", True, False):
+        raise MXNetError(f"loss_scaling={loss_scaling!r} unknown; use "
+                         "'auto', True or False")
+    if loss_scaling == "auto":
+        dynamic_scaling = compute_dtype is not None and \
+            jnp.dtype(compute_dtype) == jnp.float16
+    else:
+        dynamic_scaling = bool(loss_scaling)
+        if dynamic_scaling and compute_dtype is None:
+            raise MXNetError(
+                "loss_scaling=True without a compute_dtype: f32 steps "
+                "cannot overflow, scaling would only mask a config bug")
+    # bf16 AMP fast path: no scaling needed, so gradients stay bf16
+    # through the dp reduction (half the AllReduce bytes) and are cast
+    # to f32 at the optimizer-update entry (every adapter casts on its
+    # own — master params stay f32)
+    bf16_grads = (compute_dtype is not None
+                  and jnp.dtype(compute_dtype) == jnp.bfloat16
+                  and not dynamic_scaling)
 
     def assemble(tvals, avals, key_val):
         allv: List[Any] = [None] * (len(names) + 1)
@@ -1038,11 +1064,11 @@ def make_train_step(net, loss_fn, names: List[str],
             pflat = preds.reshape((-1,) + tuple(preds.shape[2:]))
             yflat = y.reshape((-1,) + tuple(y.shape[2:]))
             loss = jnp.mean(loss_fn(pflat, yflat)).astype(jnp.float32)
-            return loss * scale, (loss, ())
+            return (loss * scale if dynamic_scaling else loss), (loss, ())
         outs, mutated = fn(assemble(tv, av, key_val), *xs)
         pred = outs[0] if len(outs) == 1 else tuple(outs)
         loss = jnp.mean(loss_fn(pred, y)).astype(jnp.float32)
-        return loss * scale, (loss, mutated)
+        return (loss * scale if dynamic_scaling else loss), (loss, mutated)
 
     def compute_grads(tvals, avals, key_val, scale, x, y):
         (_, (loss, mutated)), grads = jax.value_and_grad(
@@ -1053,7 +1079,10 @@ def make_train_step(net, loss_fn, names: List[str],
             mutated = [m.astype(jnp.float32)
                        if jnp.issubdtype(m.dtype, jnp.floating) else m
                        for m in mutated]
-        grads = [g.astype(jnp.float32) / scale for g in grads]
+        if dynamic_scaling:
+            grads = [g.astype(jnp.float32) / scale for g in grads]
+        elif not bf16_grads:
+            grads = [g.astype(jnp.float32) for g in grads]
         # zero1: pin each gradient onto its dp-sharded layout (padded dim,
         # Zero1Info) — the constraint turns XLA's gradient AllReduce into
         # ReduceScatter, so no replica ever materializes the full gradient
@@ -1097,7 +1126,7 @@ def make_train_step(net, loss_fn, names: List[str],
         return new_p, new_state
 
     def apply_update(tvals, opt_state, t, lr, scale_state, grads):
-        scale, good = scale_state
+        scale, good, skipped = scale_state
         new_p, new_state = run_update(tvals, grads, opt_state, lr, t)
         if dynamic_scaling:
             ok = all_finite(grads)
@@ -1109,7 +1138,8 @@ def make_train_step(net, loss_fn, names: List[str],
                 ok, jnp.where(grown, scale * 2.0, scale),
                 jnp.maximum(scale * 0.5, 1.0))
             new_good = jnp.where(ok, jnp.where(grown, 0, good + 1), 0)
-            scale_state = (new_scale, new_good)
+            scale_state = (new_scale, new_good,
+                           jnp.where(ok, skipped, skipped + 1))
         # pin loop-carried state to its input placement: without output
         # constraints XLA may emit a different sharding for a small param
         # (observed: a [64] BN bias coming back 'tp'-sharded), making every
@@ -1220,7 +1250,8 @@ class ShardedTrainer:
                  max_inflight: Optional[int] = None,
                  partition: Optional[str] = None,
                  fused_opt: Optional[str] = None,
-                 overlap: Optional[bool] = None):
+                 overlap: Optional[bool] = None,
+                 loss_scaling: Any = "auto"):
         from .mesh import default_mesh
 
         if partition is None:
@@ -1233,6 +1264,8 @@ class ShardedTrainer:
                 not in ("", "0", "false")
         self.overlap = bool(overlap)
         self.partition = partition
+        #: the AMP policy dtype traced into the step (None = pure f32)
+        self.compute_dtype = compute_dtype
         self.net = net
         self.mesh = mesh if mesh is not None else default_mesh()
         self._batch_spec = batch_spec
@@ -1284,7 +1317,8 @@ class ShardedTrainer:
             weight_decay, momentum, compute_dtype=compute_dtype,
             multi_tensor=multi_tensor, shardings_box=shardings_box,
             partition=partition, fused_opt=fused_opt,
-            overlap=self.overlap, pipeline=pipeline_info)
+            overlap=self.overlap, pipeline=pipeline_info,
+            loss_scaling=loss_scaling)
         self.pvals = [allvals[i] for i in self._holder["train_ix"]]
         self.avals = [allvals[i] for i in self._holder["aux_ix"]]
         # loop-carried outputs keep their input placements (read by the
@@ -1410,8 +1444,11 @@ class ShardedTrainer:
         # whole window dispatches as one GPipe executable (_pp_step)
         self._pp_buf: List[Tuple[Any, Any]] = []
         self._pp_validated = False
-        self._dynamic_scaling = compute_dtype is not None and \
-            jnp.dtype(compute_dtype) == jnp.float16
+        if loss_scaling == "auto":
+            self._dynamic_scaling = compute_dtype is not None and \
+                jnp.dtype(compute_dtype) == jnp.float16
+        else:
+            self._dynamic_scaling = bool(loss_scaling)
         # AOT-compiled step executables (compile()): (slot, batch signature
         # | None) -> jax compiled.  One executable PER batch signature per
         # slot (the mesh shape is fixed per trainer, so the key space is
@@ -1420,7 +1457,12 @@ class ShardedTrainer:
         self._aot: Dict[Tuple[str, Optional[tuple]], Any] = {}
         self._scale_state = (
             jnp.float32(init_loss_scale if self._dynamic_scaling else 1.0),
-            jnp.int32(0))
+            jnp.int32(0), jnp.int32(0))
+        # amp scale telemetry cadence: reading the device-side scale
+        # forces a host sync, so publish every N applied steps
+        # (MXNET_AMP_TELEMETRY_EVERY, 0 disables — docs/precision.md)
+        self._amp_tel_every = int(_os.environ.get(
+            "MXNET_AMP_TELEMETRY_EVERY", "50"))
         # bounded in-flight dispatch (MXNET_MAX_INFLIGHT_STEPS, default 2):
         # step() rides JAX async dispatch, blocking only on the step-(t-K)
         # loss handle — the queue stays K deep, never unbounded or depth-1
@@ -1563,6 +1605,26 @@ class ShardedTrainer:
     @property
     def loss_scale(self) -> float:
         return float(self._scale_state[0])
+
+    @property
+    def skipped_steps(self) -> int:
+        """Update steps skipped on non-finite gradients since
+        construction (or the last checkpoint restore) — dynamic loss
+        scaling only; 0 otherwise.  Reading it syncs on the last
+        dispatched step."""
+        return int(self._scale_state[2])
+
+    def _publish_amp_gauges(self):
+        """amp.loss_scale / amp.skipped_steps, every
+        ``MXNET_AMP_TELEMETRY_EVERY`` applied steps (the read blocks on
+        this step's scale_state, so it is gated to keep the async
+        dispatch pipeline deep — docs/telemetry.md)."""
+        if not (self._dynamic_scaling and _tel._ENABLED
+                and self._amp_tel_every
+                and self._t % self._amp_tel_every == 0):
+            return
+        _tel.set_gauge("amp.loss_scale", float(self._scale_state[0]))
+        _tel.set_gauge("amp.skipped_steps", int(self._scale_state[2]))
 
     def _put(self, v):
         """Shard a batch value (or tuple tree of them) per batch_spec; the
@@ -1724,6 +1786,7 @@ class ShardedTrainer:
                     self.opt_state, self._t, lr, self._scale_state,
                     xb, yb)
         self._write_back(mutated)
+        self._publish_amp_gauges()
         self._inflight.push(loss)
         return NDArray(loss)
 
@@ -2069,6 +2132,7 @@ class ShardedTrainer:
                         self.opt_state, self._t, lr,
                         self._scale_state, xb, yb)
             self._write_back(mutated)
+            self._publish_amp_gauges()
             # the loss depends on the whole fwd+bwd+update, is never fed
             # back into a donating call, and is tiny — the one safe handle
             # to bound the dispatch queue on
@@ -2086,7 +2150,11 @@ class ShardedTrainer:
                     self._grad_fn,
                     self.pvals, self.avals, self._key,
                     self._scale_state[0], xb, yb)
-        self._accum = grads if self._accum is None else \
+        # accumulate in f32 even when bf16 grads flow (bf16 window sums
+        # would round; apply_fn's AOT signature consumes f32 grads) —
+        # astype is a no-op for already-f32 grads
+        self._accum = [g.astype(jnp.float32) for g in grads] \
+            if self._accum is None else \
             [a + g for a, g in zip(self._accum, grads)]
         self._micro += 1
         self._write_back(mutated)
@@ -2107,6 +2175,7 @@ class ShardedTrainer:
                             self._t, lr, self._scale_state, avg)
             self._accum, self._micro = None, 0
             self._write_back_params()
+            self._publish_amp_gauges()
         # micro-step losses chain to the last apply through pvals, so
         # bounding on them transitively bounds the applies too
         self._inflight.push(loss)
@@ -2146,6 +2215,7 @@ class ShardedTrainer:
             blob["meta/key"] = onp.asarray(self._key)
             blob["meta/scale"] = onp.asarray(self._scale_state[0])
             blob["meta/good"] = onp.asarray(self._scale_state[1])
+            blob["meta/skipped"] = onp.asarray(self._scale_state[2])
             from ..resilience.checkpoint import write_payload
 
             # atomic (tmp + fsync + os.replace, docs/resilience.md): a
@@ -2220,7 +2290,9 @@ class ShardedTrainer:
         self._t = int(blob["meta/t"])
         self._key = jnp.asarray(blob["meta/key"])
         self._scale_state = (jnp.float32(blob["meta/scale"]),
-                             jnp.int32(blob["meta/good"]))
+                             jnp.int32(blob["meta/good"]),
+                             # absent in pre-precision-ladder checkpoints
+                             jnp.int32(blob.get("meta/skipped", 0)))
         params = self._params
         for n, v in zip(self.train_names, self.pvals):
             params[n].data()._set_data(v)
@@ -2279,7 +2351,8 @@ class ShardedTrainer:
         meta = {"t": int(self._t),
                 "key": key.tolist(), "key_dtype": key.dtype.name,
                 "scale": float(self._scale_state[0]),
-                "good": int(self._scale_state[1])}
+                "good": int(self._scale_state[1]),
+                "skipped": int(self._scale_state[2])}
         return leaves, meta
 
     def _place_shardwise(self, rdr, rec, storage, sharding, stats):
@@ -2362,6 +2435,7 @@ class ShardedTrainer:
                                    dtype=meta.get("key_dtype", "uint32"))
             meta_scale = float(meta["scale"])
             meta_good = int(meta["good"])
+            meta_skipped = int(meta.get("skipped", 0))
         except (KeyError, TypeError, ValueError) as e:
             raise MXNetError(
                 f"manifest v2 'meta' section is malformed: {e}") from e
@@ -2422,7 +2496,8 @@ class ShardedTrainer:
         self._t = meta_t
         self._key = jnp.asarray(meta_key)
         self._scale_state = (jnp.float32(meta_scale),
-                             jnp.int32(meta_good))
+                             jnp.int32(meta_good),
+                             jnp.int32(meta_skipped))
         params = self._params
         for n, v in zip(self.train_names, self.pvals):
             params[n].data()._set_data(v)

@@ -47,9 +47,9 @@ from typing import Optional
 from ..analysis import thread_check as _tchk
 from .coalescer import (ClosedError, DeadlineError, RejectedError, Request,
                         RequestQueue, ServeFuture)
-from .decode import (DecodeEntry, DecodeFuture, DecodeServer, decode_server,
-                     decode_submit, generate, register_decode,
-                     shutdown_decode)
+from .decode import (DecodeEntry, DecodeFuture, DecodeServer,
+                     TokenRangeError, decode_server, decode_submit,
+                     generate, register_decode, shutdown_decode)
 from .edge import EdgeServer
 from .fleet import (DispatchError, Fleet, FleetError, NoReplicaError, Router)
 from .prefix import PrefixCache
@@ -61,7 +61,8 @@ __all__ = ["Server", "Registry", "ModelEntry", "ServeFuture",
            "RejectedError", "ClosedError", "DeadlineError", "register",
            "unregister", "models", "submit", "predict", "shutdown",
            "default_registry", "default_server", "DecodeEntry",
-           "DecodeServer", "DecodeFuture", "PrefixCache", "register_decode",
+           "DecodeServer", "DecodeFuture", "PrefixCache", "TokenRangeError",
+           "register_decode",
            "decode_server", "decode_submit", "generate", "shutdown_decode",
            "EdgeServer", "Fleet", "Router", "FleetError", "NoReplicaError",
            "DispatchError"]
@@ -88,12 +89,19 @@ def current_server() -> Optional[Server]:
 
 
 def register(name: str, block, bucketer=None, sample=None,
-             warmup: bool = True, background: bool = False) -> ModelEntry:
+             warmup: bool = True, background: bool = False,
+             precision=None, calib_data=None,
+             calib_mode=None) -> ModelEntry:
     """Register ``block`` under ``name`` in the default registry and
-    AOT-warm its bucket grid (see :meth:`Registry.register`)."""
+    AOT-warm its bucket grid; ``precision="int8"`` runs the PTQ
+    calibrate→rewrite pipeline at registration (see
+    :meth:`Registry.register`, docs/precision.md)."""
     return default_registry().register(name, block, bucketer=bucketer,
                                        sample=sample, warmup=warmup,
-                                       background=background)
+                                       background=background,
+                                       precision=precision,
+                                       calib_data=calib_data,
+                                       calib_mode=calib_mode)
 
 
 def unregister(name: str):

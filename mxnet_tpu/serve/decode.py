@@ -100,12 +100,36 @@ from ..trace import recorder as _tr
 from .coalescer import ClosedError, DeadlineError, RejectedError
 from .prefix import PrefixCache
 
-__all__ = ["DecodeEntry", "DecodeServer", "DecodeFuture", "register_decode",
-           "decode_server", "decode_submit", "generate", "shutdown_decode"]
+__all__ = ["DecodeEntry", "DecodeServer", "DecodeFuture", "TokenRangeError",
+           "register_decode", "decode_server", "decode_submit", "generate",
+           "shutdown_decode"]
+
+
+class TokenRangeError(MXNetError):
+    """A prompt token id outside ``[0, vocab_size)``.  Raised at submit
+    (and mapped to HTTP 400 at the edge via ``status``) instead of
+    letting the id reach the embedding gather — an out-of-range gather
+    under jit FILLS the lookup with NaN on CPU, silently poisoning every
+    logit downstream (docs/known_failures.md precedent, PR 18)."""
+
+    status = 400
 
 
 def _nd_i32(a) -> NDArray:
     return NDArray(jnp.asarray(a, jnp.int32))
+
+
+def _quant_bytes_saved(cache) -> int:
+    """HBM the int8 KV cache saves vs the same geometry held in f32:
+    int8 payload pages save 3 bytes/element, their f32 scale pages
+    count against the win as overhead.  0 for unquantized caches."""
+    leaves = [leaf._data for pair in cache for leaf in pair]
+    if not any(leaf.dtype == jnp.int8 for leaf in leaves):
+        return 0
+    saved = 0
+    for leaf in leaves:
+        saved += 3 * leaf.nbytes if leaf.dtype == jnp.int8 else -leaf.nbytes
+    return saved
 
 
 def _write_leaf(batch, row, slot):
@@ -318,13 +342,31 @@ class DecodeEntry:
                  prompt_buckets: Sequence[int] = (8, 16, 32),
                  capacity_buckets: Sequence[int] = (32, 64),
                  eos_id: Optional[int] = None, max_new_tokens: int = 32,
-                 lint_budget: Optional[dict] = None, warmup: bool = True):
+                 lint_budget: Optional[dict] = None, warmup: bool = True,
+                 precision: Optional[str] = None):
         if not hasattr(block, "begin_cache"):
             raise MXNetError(
                 f"decode model {name!r} has no begin_cache(batch, capacity) "
                 "— see gluon/model_zoo/decoder.py for the contract")
         if slots < 1:
             raise MXNetError(f"slots must be >= 1, got {slots}")
+        if precision not in (None, "int8"):
+            raise MXNetError(
+                f"decode model {name!r}: precision={precision!r} "
+                "unsupported; None or 'int8'")
+        if precision == "int8" and \
+                getattr(block, "_cache_dtype", False) is False:
+            raise MXNetError(
+                f"decode model {name!r} has no quantizable KV cache "
+                "(no cache_dtype contract — the LSTM carrier's recurrent "
+                "state has no per-position pages to quantize); "
+                "precision='int8' needs the transformer family")
+        if precision == "int8":
+            # flip BEFORE the capacity probe / warmup below: begin_cache
+            # must build the (k_q, k_scale, v_q, v_scale) page layout
+            # for every executable in the grid (docs/precision.md)
+            block._cache_dtype = "int8"
+        self.precision = precision
         self.name = name
         self.block = block
         self.slots = int(slots)
@@ -530,6 +572,15 @@ class DecodeServer:
         prompt = [int(t) for t in onp.asarray(prompt).reshape(-1)]
         if not prompt:
             raise MXNetError("decode prompt must be non-empty")
+        vocab = getattr(self.entry.block, "_vocab_size", None)
+        if vocab is not None:
+            bad = [t for t in prompt if t < 0 or t >= vocab]
+            if bad:
+                raise TokenRangeError(
+                    f"decode prompt for {self.entry.name!r} has token ids "
+                    f"outside [0, {vocab}): {bad[:8]} — an out-of-range "
+                    "embedding gather fills the lookup with NaN under jit, "
+                    "poisoning the logits silently")
         if deadline is not None and deadline <= 0:
             if _tel._ENABLED:
                 _tel.inc("serve.rejected")
@@ -593,6 +644,9 @@ class DecodeServer:
     def _loop(self):
         e = self.entry
         self._cache = e.block.begin_cache(e.slots, e.capacity_buckets[0])
+        if _tel._ENABLED:
+            _tel.set_gauge("serve.cache_quant_bytes_saved",
+                           _quant_bytes_saved(self._cache))
         while True:
             admitted: List = []
             with self._cv:
@@ -823,6 +877,8 @@ class DecodeServer:
         self._cap_i += 1
         if _tel._ENABLED:
             _tel.inc("serve.cache_grows")
+            _tel.set_gauge("serve.cache_quant_bytes_saved",
+                           _quant_bytes_saved(self._cache))
 
     def _step(self):
         e = self.entry
@@ -918,8 +974,11 @@ def register_decode(name: str, block, **cfg) -> DecodeEntry:
     :class:`DecodeEntry` (AOT-warming the executable grid) and starts
     its :class:`DecodeServer`.  Server-level knobs (``prefill_workers``,
     ``prefix_cache``, ``queue_max``) pass through to the server; the
-    rest configure the entry.  Re-registering a name drains and
-    replaces the old server."""
+    rest configure the entry — ``precision="int8"`` switches the
+    model's KV cache to int8 pages with per-position scales
+    (~2x the servable slots at the same cache budget,
+    docs/precision.md).  Re-registering a name drains and replaces the
+    old server."""
     srv_kw = {k: cfg.pop(k)
               for k in ("prefill_workers", "prefix_cache", "queue_max")
               if k in cfg}

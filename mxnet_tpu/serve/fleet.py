@@ -784,7 +784,19 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     def _cnt(name):
         return snap.get(name, {}).get("value", 0)
 
+    # per-model precision (f32 vs int8 PTQ / int8 KV cache) so the
+    # fleet's READY docs carry what each worker actually serves —
+    # the worker-spec half of the precision ladder (docs/precision.md)
+    reg = _serve.default_registry()
+    precisions = {n: reg.get(n).precision or "f32"
+                  for n in _serve.models()}
+    from .decode import servers as _decode_servers
+
+    precisions.update({
+        n: s.entry.precision or "f32"
+        for n, s in _decode_servers().items()})
     doc = {"edge": edge.url, "obs": metrics.url, "pid": os.getpid(),
+           "precisions": precisions,
            "startup_secs": round(time.perf_counter() - t0, 3),
            # model build + warmup alone — the phase the persistent
            # compile cache replays (the warm-respawn gate's numerator)

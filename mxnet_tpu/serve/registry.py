@@ -58,8 +58,13 @@ class ModelEntry:
     """One registered model (module docstring)."""
 
     def __init__(self, name: str, block, bucketer=None, sample=None,
-                 lint_budget=None):
+                 lint_budget=None, precision=None):
         from ..gluon.block import HybridBlock
+
+        #: "int8" when the registered executable is the PTQ rewrite of
+        #: the original block (Registry.register(precision="int8")) —
+        #: fleet worker specs and the X008 lint contract read this
+        self.precision = precision
 
         if not isinstance(block, HybridBlock):
             raise MXNetError(
@@ -244,7 +249,8 @@ class Registry:
 
     def register(self, name: str, block, bucketer=None, sample=None,
                  warmup: bool = True, background: bool = False,
-                 lint_budget=None) -> ModelEntry:
+                 lint_budget=None, precision=None, calib_data=None,
+                 calib_mode=None) -> ModelEntry:
         """Register (or replace) a model.  ``warmup=True`` (default)
         AOT-compiles the full bucket grid before the entry goes live —
         ``background=True`` overlaps it with other startup work; call
@@ -252,9 +258,34 @@ class Registry:
         zero-compile guarantee matters more than time-to-listen.  Under
         ``MXNET_XLA_LINT`` every warmed executable runs the graph lint
         (X rules) attributed to this entry; ``lint_budget`` overrides
-        the default budget (docs/analysis.md)."""
+        the default budget (docs/analysis.md).
+
+        ``precision="int8"`` runs the PTQ rewrite
+        (:func:`~mxnet_tpu.contrib.quantization.quantize_net`) at
+        registration — ``calib_data`` (iterable of input batches) feeds
+        Monitor-hook calibration under ``calib_mode`` (default
+        ``"naive"``; ``"entropy"`` for KL thresholds; without
+        ``calib_data`` the layers fall back to dynamic per-batch
+        ranges).  The warmed executables then carry the
+        ``require_int8_dots`` lint contract: a quantized model whose
+        programs contain ZERO int8 dots silently fell back to f32 and
+        X008 fires (docs/precision.md)."""
+        if precision not in (None, "int8"):
+            raise MXNetError(
+                f"serve.register({name!r}): precision={precision!r} "
+                "unsupported; None or 'int8'")
+        if precision == "int8":
+            from ..contrib.quantization import quantize_net
+
+            if calib_mode is None:
+                calib_mode = "naive" if calib_data is not None else "none"
+            block = quantize_net(block, calib_data=calib_data,
+                                 calib_mode=calib_mode)
+            budget = dict(lint_budget or {})
+            budget.setdefault("require_int8_dots", True)
+            lint_budget = budget
         entry = ModelEntry(name, block, bucketer, sample,
-                           lint_budget=lint_budget)
+                           lint_budget=lint_budget, precision=precision)
         if warmup:
             entry.warm(background=background)
         with self._lock:

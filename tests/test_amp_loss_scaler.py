@@ -1,0 +1,143 @@
+"""mx.amp.LossScaler — the eager dynamic loss scaler (ISSUE 20).
+
+The load-bearing claims under test: (1) the scale doubles after
+``scale_window`` clean steps and halves on overflow with a floor of
+1.0, the growth counter resetting on every overflow; (2) an overflow
+step reports skip=True and the documented skip protocol leaves the
+params BIT-identical (the reference's skip-on-overflow semantics,
+python/mxnet/amp/loss_scaler.py); (3) ``state_dict`` /
+``load_state_dict`` roundtrip the full scaler state so a resumed run
+neither re-warms from ``init_scale`` nor forgets its overflow history,
+and older checkpoints missing the newer keys still load; (4) the
+``amp.loss_scale`` / ``amp.skipped_steps`` telemetry gauges track the
+scaler (docs/telemetry.md).
+"""
+from __future__ import annotations
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry as tel
+from mxnet_tpu.amp import LossScaler
+
+
+def _finite_grads(n=3):
+    return [mx.np.ones((4,)) * 0.5 for _ in range(n)]
+
+
+def _nan_grads():
+    g = _finite_grads()
+    g[1] = mx.np.array([1.0, float("nan"), 2.0, 3.0])
+    return g
+
+
+def test_scale_grows_after_window_and_counter_resets():
+    s = LossScaler(init_scale=2.0 ** 8, scale_factor=2.0, scale_window=4)
+    for i in range(3):
+        assert s.post_backward(_finite_grads()) is False
+        assert s.loss_scale == 2.0 ** 8, i  # not yet
+    assert s.post_backward(_finite_grads()) is False
+    assert s.loss_scale == 2.0 ** 9  # window full: doubled
+    # the counter restarted: another full window before the next growth
+    for _ in range(3):
+        s.post_backward(_finite_grads())
+    assert s.loss_scale == 2.0 ** 9
+    s.post_backward(_finite_grads())
+    assert s.loss_scale == 2.0 ** 10
+
+
+def test_overflow_backoff_floor_and_counter_reset():
+    s = LossScaler(init_scale=4.0, scale_factor=2.0, scale_window=2)
+    assert s.post_backward(_nan_grads()) is True
+    assert s.has_overflow and s.loss_scale == 2.0 and s.skipped_steps == 1
+    # repeated overflow floors at 1.0, never 0
+    for _ in range(5):
+        assert s.post_backward(_nan_grads()) is True
+    assert s.loss_scale == 1.0
+    assert s.skipped_steps == 6
+    # an overflow mid-window resets the growth counter: one clean step
+    # after it must NOT grow even though two cleans preceded the window
+    s2 = LossScaler(init_scale=4.0, scale_factor=2.0, scale_window=2)
+    s2.post_backward(_finite_grads())
+    s2.post_backward(_nan_grads())
+    s2.post_backward(_finite_grads())
+    assert s2.loss_scale == 2.0  # halved once, no growth yet
+    s2.post_backward(_finite_grads())
+    assert s2.loss_scale == 4.0  # full window AFTER the overflow
+
+
+def test_empty_and_inf_grads():
+    s = LossScaler(init_scale=2.0, scale_window=10)
+    # no grads at all: vacuously finite, counts toward the window
+    assert s.post_backward([]) is False
+    g = _finite_grads()
+    g[0] = mx.np.array([float("inf"), 0.0, 0.0, 0.0])
+    assert s.post_backward(g) is True
+
+
+def test_eager_skip_protocol_keeps_params_bit_identical():
+    """The documented eager flow: scale_loss + post_backward says skip
+    -> the caller does not step -> params bit-identical, scale halved."""
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Xavier())
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    mx.amp.init(target_dtype="float16")
+    mx.amp.init_trainer(trainer)
+    scaler = trainer._amp_loss_scaler
+    scaler.loss_scale = 2.0 ** 8
+    before = {n: p.data().asnumpy().copy()
+              for n, p in net.collect_params().items()}
+    x = mx.np.ones((2, 8))
+    with mx.autograd.record():
+        loss = (net(x) * float("inf")).sum()  # grads overflow
+        with mx.amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+    assert scaler.has_overflow
+    assert scaler.loss_scale == 2.0 ** 7
+    # skip the update (what has_overflow tells the loop to do)
+    for n, p in net.collect_params().items():
+        onp.testing.assert_array_equal(before[n], p.data().asnumpy(),
+                                       err_msg=n)
+
+
+def test_state_dict_roundtrip_and_backcompat():
+    s = LossScaler(init_scale=2.0 ** 8, scale_factor=2.0, scale_window=4)
+    s.post_backward(_finite_grads())      # unskipped=1
+    s.post_backward(_nan_grads())         # halved, skipped=1
+    s.post_backward(_finite_grads())      # unskipped=1 again
+    state = s.state_dict()
+    assert state == {"loss_scale": 2.0 ** 7, "scale_factor": 2.0,
+                     "scale_window": 4, "unskipped": 1,
+                     "skipped_steps": 1}
+    # restore into a DIFFERENTLY-constructed scaler: behavior identical
+    r = LossScaler(init_scale=1.0, scale_factor=4.0, scale_window=99)
+    r.load_state_dict(state)
+    for a, b in ((s, r),):
+        for _ in range(3):
+            av = a.post_backward(_finite_grads())
+            bv = b.post_backward(_finite_grads())
+            assert av == bv and a.loss_scale == b.loss_scale
+    # resumed run continued the window: 3 cleans after restore complete
+    # the 4-window (1 carried + 3) and the scale grew exactly once
+    assert r.loss_scale == 2.0 ** 8
+    # an older checkpoint carrying only loss_scale still loads
+    old = LossScaler(init_scale=2.0, scale_factor=2.0, scale_window=7)
+    old.load_state_dict({"loss_scale": 32.0})
+    assert old.loss_scale == 32.0
+    assert old.skipped_steps == 0 and old._scale_window == 7
+
+
+def test_telemetry_gauges_track_scaler():
+    tel.reset()
+    s = LossScaler(init_scale=8.0, scale_factor=2.0, scale_window=100)
+    s.post_backward(_nan_grads())
+    snap = tel.snapshot()
+    assert snap["amp.loss_scale"]["value"] == 4.0
+    assert snap["amp.skipped_steps"]["value"] == 1
+    s.post_backward(_finite_grads())
+    snap = tel.snapshot()
+    assert snap["amp.loss_scale"]["value"] == 4.0
+    assert snap["amp.skipped_steps"]["value"] == 1

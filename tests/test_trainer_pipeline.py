@@ -224,6 +224,28 @@ def test_pp_parity_with_replicated_trainer():
         assert abs(a - b) / max(abs(a), 1.0) < 1e-5, (step, a, b)
 
 
+def test_pp_composes_with_bf16_amp():
+    """The precision ladder's pp rung (ISSUE 20, docs/precision.md):
+    the GPipe window runs bf16 compute via amp.trainer_kwargs() while
+    master params stay f32, tracking the f32 replicated trainer at bf16
+    resolution rather than ULP parity."""
+    x, y = _batch()
+    tr_ref = ShardedTrainer(_mlp(seed=9), _ce, mesh=make_mesh({"dp": 8}),
+                            optimizer="sgd", learning_rate=0.05,
+                            momentum=0.9, partition="replicated")
+    mx.amp.init(target_dtype="bfloat16")
+    tr_pp = _pp_trainer(net=_mlp(seed=9), grad_accum=2,
+                        **mx.amp.trainer_kwargs())
+    mx.amp.init_trainer(tr_pp)
+    for step in range(4):
+        a = float(tr_ref.step(x, y, block=True))
+        b = [float(tr_pp.step(x, y, block=True)) for _ in range(2)][-1]
+        # bf16 mantissa noise, not the 1e-5 of the f32 parity test
+        assert abs(a - b) / max(abs(a), 1.0) < 5e-2, (step, a, b)
+    assert tr_pp._t == 4
+    assert all(v.dtype == jnp.float32 for v in tr_pp.pvals)
+
+
 def test_pp_save_states_mid_window_raises(tmp_path):
     tr = _pp_trainer(grad_accum=2)
     x, y = _batch()

@@ -429,6 +429,79 @@ def test_serve_register_hook_attributes_to_entry(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# X008: the precision="int8" contract (require_int8_dots)
+# ---------------------------------------------------------------------------
+
+def test_x008_fires_on_f32_twin_and_clean_on_int8_dot():
+    # SEEDED repro: an f32 executable linted under the int8 contract —
+    # the model claims int8 but no integer-accumulated dot survived
+    f32 = jax.jit(lambda a, b: a @ b).lower(
+        jnp.zeros((4, 8), "float32"),
+        jnp.zeros((8, 5), "float32")).compile()
+    facts = xl.parse_program_text(f32.as_text(), name="twin")
+    assert facts.int8_dot_count == 0
+    codes = [d.code for d in
+             xl.run_rules(facts, {"require_int8_dots": True})]
+    assert codes == ["X008"]
+    # without the budget flag the same facts are clean (default off)
+    assert xl.run_rules(facts, {}) == []
+
+    # clean twin: a real int8 dot, in BOTH dialects (XLA:CPU widens the
+    # s8 operands to s32 pre-dot, so the integer OUTPUT type is what
+    # the parser must key on)
+    def q(a, b):
+        return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+
+    low = jax.jit(q).lower(jnp.zeros((4, 8), jnp.int8),
+                           jnp.zeros((8, 5), jnp.int8))
+    for text in (low.as_text(), low.compile().as_text()):
+        f = xl.parse_program_text(text)
+        assert f.int8_dot_count == 1
+        assert xl.run_rules(f, {"require_int8_dots": True}) == []
+        assert f.to_dict()["int8_dots"] == 1
+
+
+def test_x008_silent_on_dotless_executable():
+    # an auxiliary executable with no dot at all (slot write, cache
+    # growth pad) must not fail the contract — only dot-carrying
+    # executables can prove or break it
+    nod = jax.jit(lambda x: x + 1).lower(
+        jnp.zeros((4,), "float32")).compile()
+    facts = xl.parse_program_text(nod.as_text())
+    assert facts.count("dot", "convolution") == 0
+    assert xl.run_rules(facts, {"require_int8_dots": True}) == []
+
+
+def test_x008_registry_int8_entry_clean_and_forced_f32_twin(monkeypatch):
+    monkeypatch.setenv("MXNET_XLA_LINT", "1")
+    from mxnet_tpu.serve.registry import Registry
+
+    # the real pipeline: precision="int8" runs quantize_net at
+    # registration and merges require_int8_dots into the lint budget —
+    # every warmed executable carries the int8 dots
+    rs = onp.random.RandomState(0)
+    calib = [rs.rand(4, 8).astype("float32")]
+    with xl.capture() as cap:
+        Registry().register("mlp_q", _mlp(), bucketer={0: [2]},
+                            sample=onp.zeros((8,), "float32"),
+                            precision="int8", calib_data=calib)
+    assert cap
+    for facts, diags in cap:
+        assert facts.int8_dot_count >= 1
+        assert diags == []
+    # forced twin: the same int8 CLAIM (budget flag) with the PTQ
+    # rewrite bypassed — the grid serves f32 math and X008 fires
+    with xl.capture() as cap2:
+        Registry().register("mlp_f32_claim", _mlp(seed=1),
+                            bucketer={0: [2]},
+                            sample=onp.zeros((8,), "float32"),
+                            lint_budget={"require_int8_dots": True})
+    codes = [d.code for _f, dg in cap2 for d in dg]
+    assert "X008" in codes, codes
+
+
+# ---------------------------------------------------------------------------
 # trainer seam: X001 (forced replicated opt state under zero1)
 # ---------------------------------------------------------------------------
 
@@ -549,3 +622,12 @@ def test_budget_manifest_covers_canonical_models():
     assert set(ovl["async_required"]) == {"reduce-scatter", "all-gather"}
     assert "all-gather" not in ovl["collectives"]
     assert "reduce-scatter" not in ovl["collectives"]
+    # the bf16 AMP twin of the overlap model carries the SAME X007
+    # contract — the dtype-policy transform must not cost the overlap
+    bf16 = models["lenet_train_zero1_overlap_bf16"]
+    assert set(bf16["async_required"]) == {"reduce-scatter", "all-gather"}
+    assert "all-gather" not in bf16["collectives"]
+    assert "reduce-scatter" not in bf16["collectives"]
+    # the quantized serve entry carries the X008 contract: its grid may
+    # never silently fall back to f32 math under the int8 claim
+    assert models["serve_mlp_int8"]["require_int8_dots"] is True

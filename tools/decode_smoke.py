@@ -21,6 +21,14 @@ decode design, checked without a chip:
     unaliased cache fails here; the check is proven non-vacuous by
     requiring donated argnums on the decode-step executable AND
     observing that a donated cache buffer is actually invalidated.
+  * **int8 KV cache (the ISSUE 20 precision ladder)**: a second entry
+    registered with ``precision="int8"`` must (a) serve >=
+    ``INT8_SLOTS_GATE``x the slots at fixed cache bytes (per-slot int8
+    pages + f32 scales vs the f32 cache), (b) add ZERO compiles after
+    its own warmup through a saturated run with capacity growth, and
+    (c) keep greedy decode within ``INT8_AGREEMENT_GATE`` agreement of
+    the f32 twin on the same weights (bounded quantization
+    divergence).
 
 ``MXNET_COMPILE_CACHE=0`` is forced: the CPU donation guard drops
 aliasing when the persistent cache is armed (deserialized executables
@@ -55,6 +63,8 @@ MAX_NEW = 24           # tokens generated per prompt (no EOS: exact);
 SLOTS = 4
 SPEEDUP_GATE = 2.0     # batched tokens/s >= GATE x sequential
 STEP_P99_BOUND_S = 0.25
+INT8_SLOTS_GATE = 1.8       # servable slots at fixed cache bytes
+INT8_AGREEMENT_GATE = 0.75  # greedy token agreement vs the f32 twin
 
 
 def _metric(snap, name, field="value", default=0):
@@ -199,10 +209,121 @@ def decode_phases(entry, report):
     return ok_speed and ok_p99 and ok_compiles and ok_coverage
 
 
-def make_row(decode, platform="cpu"):
+def _smoke_lm(**extra):
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    lm = mx.gluon.model_zoo.get_model(
+        "transformer_lm", vocab_size=64, units=64, hidden_size=128,
+        num_heads=4, num_layers=2, max_length=128, **extra)
+    lm.initialize(mx.init.Xavier())
+    return lm
+
+
+def _eager_greedy(f32_lm, prompt, n_new, capacity=64):
+    """One-row greedy reference on the f32 twin: full eager re-forward
+    per token — no jit signatures, no quantization."""
+    import numpy as onp
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    def nd(a):
+        return NDArray(jnp.asarray(a, jnp.int32))
+
+    toks, out = list(prompt), []
+    for _ in range(n_new):
+        logits, _ = f32_lm.forward(
+            nd([toks]), f32_lm.begin_cache(1, capacity), nd([0]),
+            nd([len(toks)]))
+        out.append(int(onp.argmax(logits.asnumpy()[0, len(toks) - 1])))
+        toks.append(out[-1])
+    return out
+
+
+def int8_phase(report):
+    """The ISSUE 20 int8-KV serving gates: >=INT8_SLOTS_GATE x servable
+    slots at fixed cache bytes, zero compiles after the int8 entry's
+    own warmup through saturated slots + capacity growth, and greedy
+    agreement >= INT8_AGREEMENT_GATE vs the f32 twin."""
+    from mxnet_tpu import serve
+    from mxnet_tpu import telemetry as tel
+    from mxnet_tpu.analysis import xla_lint as xl
+
+    f32 = _smoke_lm()
+    t0 = time.perf_counter()
+    with xl.capture() as cap:
+        entry = serve.DecodeEntry(
+            "decode_lm_int8", _smoke_lm(), slots=SLOTS,
+            prompt_buckets=(8, 16), capacity_buckets=(32, 64),
+            max_new_tokens=MAX_NEW, precision="int8")
+    warm_s = time.perf_counter() - t0
+    diags = [d for _f, dg in cap for d in dg]
+
+    # servable slots at fixed cache bytes: what one slot costs (int8
+    # pages + f32 per-position scales) vs the f32 cache at the same
+    # capacity — the DecodeServer serves that many more slots from the
+    # same HBM budget
+    f32_bytes = sum(leaf.nbytes for pair in f32.begin_cache(1, 64)
+                    for leaf in pair)
+    int8_bytes = sum(leaf.nbytes
+                     for pair in entry.block.begin_cache(1, 64)
+                     for leaf in pair)
+    slots_ratio = f32_bytes / int8_bytes
+
+    prompts = make_prompts(N_REQS)
+    tel.reset()
+    misses0 = _metric(tel.snapshot(), "hybridize.cache_misses")
+    srv = serve.DecodeServer(entry)
+    t0 = time.perf_counter()
+    futs = [srv.submit(p) for p in prompts]
+    outs = [f.result(300) for f in futs]
+    wall = time.perf_counter() - t0
+    srv.close(60.0)
+    snap = tel.snapshot()
+    misses = _metric(snap, "hybridize.cache_misses") - misses0
+    saved = _metric(snap, "serve.cache_quant_bytes_saved")
+    grows = _metric(snap, "serve.cache_grows")
+    tps = sum(len(o) for o in outs) / wall
+
+    # bounded greedy divergence: first 4 prompts against the eager f32
+    # reference (same seed => identical weights)
+    agree_n = tok_n = 0
+    for p, got in zip(prompts[:4], outs[:4]):
+        want = _eager_greedy(f32, p, len(got))
+        agree_n += sum(a == b for a, b in zip(got, want))
+        tok_n += len(got)
+    agreement = agree_n / max(tok_n, 1)
+
+    ok_lint = not diags
+    ok_slots = slots_ratio >= INT8_SLOTS_GATE
+    ok_compiles = misses == 0
+    ok_agree = agreement >= INT8_AGREEMENT_GATE
+    ok_savings = saved > 0
+    report["int8"] = {
+        "warmup_seconds": round(warm_s, 2),
+        "lint_findings": [d.format() for d in diags], "lint_ok": ok_lint,
+        "f32_cache_bytes_per_slot": int(f32_bytes),
+        "int8_cache_bytes_per_slot": int(int8_bytes),
+        "slots_at_fixed_cache_bytes": round(slots_ratio, 3),
+        "slots_gate": INT8_SLOTS_GATE, "slots_ok": ok_slots,
+        "tokens_per_s": round(tps, 2),
+        "compiles_after_warmup": misses, "compiles_ok": ok_compiles,
+        "cache_grows": grows,
+        "cache_quant_bytes_saved": int(saved), "savings_ok": ok_savings,
+        "greedy_agreement": round(agreement, 3),
+        "agreement_gate": INT8_AGREEMENT_GATE, "agreement_ok": ok_agree,
+        "tokens_compared": tok_n,
+    }
+    return ok_lint and ok_slots and ok_compiles and ok_agree and ok_savings
+
+
+def make_row(decode, platform="cpu", int8=None):
     """The decode_tokens_per_s row schema — ONE definition, shared by
     this smoke's report and `bench.py --decode-child` (schema drift
-    between the two would break trajectory comparisons)."""
+    between the two would break trajectory comparisons).  The int8
+    fields are zero when the int8 phase did not run (older callers)."""
+    int8 = int8 or {}
     return {"metric": "decode_tokens_per_s",
             "value": decode["batched_tokens_per_s"], "unit": "tokens/s",
             "sequential_tokens_per_s": decode["sequential_tokens_per_s"],
@@ -214,6 +335,10 @@ def make_row(decode, platform="cpu"):
             "occupancy_high_water": decode["occupancy_high_water"],
             "n_requests": decode["n_requests"],
             "max_new_tokens": decode["max_new_tokens"],
+            "int8_tokens_per_s": int8.get("tokens_per_s", 0.0),
+            "int8_slots_at_fixed_cache_bytes":
+                int8.get("slots_at_fixed_cache_bytes", 0.0),
+            "int8_greedy_agreement": int8.get("greedy_agreement", 0.0),
             "platform": platform, "ts": round(time.time(), 1)}
 
 
@@ -234,8 +359,9 @@ def main():
     entry, ok = build_entry(report)
     ok = donation_gate(entry, report) and ok
     ok = decode_phases(entry, report) and ok
+    ok = int8_phase(report) and ok
     ok = thread_check_gate(report) and ok
-    report["row"] = make_row(report["decode"])
+    report["row"] = make_row(report["decode"], int8=report.get("int8"))
     report["ok"] = bool(ok)
     out = os.path.join(ROOT, "decode_smoke.json")
     with open(out, "w") as f:

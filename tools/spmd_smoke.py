@@ -24,6 +24,14 @@ Proves the 2-D-mesh ZeRO-1 path end to end on a forced 8-device CPU mesh
     tests/test_trainer_overlap.py); across two separately compiled
     executables XLA is free to FMA-contract one and not the other, so
     the whole-trajectory gate is TOL (observed ~1e-7/step, 20x margin).
+  * **LeNet, 8x1 mesh, bf16 AMP**: the precision ladder's training rung
+    (docs/precision.md) — ``amp.trainer_kwargs()`` (bf16 compute, f32
+    master params, gradients flowing bf16 through the dp reduction)
+    composed with zero1 + overlap, vs the f32 replicated baseline.
+    bf16 carries ~3 significant digits, so the gate is the documented
+    loose tolerance ``BF16_TOL`` on the loss trajectory plus the
+    structural facts: master params still f32, loss improving, all
+    losses finite.
   * **MLP, 2x2x2 mesh (dp x mp x pp)**: all three axes composing —
     tensor-sharded Dense (mp), ZeRO-1 update (dp), GPipe stages (pp) —
     must match the replicated 8x1 run within TOL, and the first
@@ -49,6 +57,10 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 TOL = 5e-6  # few-ULP on fp32 losses O(1), linear (SGD) update path
+# bf16 has an 8-bit mantissa: per-step rounding of activations/grads
+# drifts the trajectory at the percent level after a dozen steps —
+# parity here means "the same training run at bf16 resolution"
+BF16_TOL = 5e-2
 
 
 def _ce():
@@ -289,6 +301,60 @@ def overlap_case(report):
     return ok_sgd and ok_mom and ok_buckets
 
 
+def bf16_case(report):
+    """bf16 AMP composed with zero1 + overlap (ISSUE 20): the policy
+    enters through amp.trainer_kwargs() — bf16 compute with f32 master
+    params and no loss scaling (bf16 keeps fp32-range exponents) — and
+    the trajectory must track the f32 replicated baseline at bf16
+    resolution (BF16_TOL)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    build = _lenet_builder()
+    rs = onp.random.RandomState(0)
+    x = onp.asarray(rs.rand(32, 1, 28, 28), onp.float32)
+    y = onp.asarray(rs.randint(0, 10, size=(32,)), onp.int32)
+    tr_ref = ShardedTrainer(build(), _ce(), mesh=make_mesh({"dp": 8}),
+                            optimizer="sgd", learning_rate=0.05,
+                            momentum=0.9, partition="replicated")
+    l_ref = [float(tr_ref.step(x, y, block=True)) for _ in range(12)]
+    mx.amp.init(target_dtype="bfloat16")
+    prev = os.environ.get("MXNET_OVERLAP_BUCKET_BYTES")
+    os.environ["MXNET_OVERLAP_BUCKET_BYTES"] = str(256 << 10)
+    try:
+        tr = ShardedTrainer(build(), _ce(), mesh=make_mesh({"dp": 8}),
+                            optimizer="sgd", learning_rate=0.05,
+                            momentum=0.9, partition="zero1",
+                            overlap=True, **mx.amp.trainer_kwargs())
+        mx.amp.init_trainer(tr)   # policy/trainer consistency check
+        l_bf = [float(tr.step(x, y, block=True)) for _ in range(12)]
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_OVERLAP_BUCKET_BYTES", None)
+        else:
+            os.environ["MXNET_OVERLAP_BUCKET_BYTES"] = prev
+    import jax.numpy as jnp
+
+    max_dloss = max(abs(a - b) / max(abs(a), 1.0)
+                    for a, b in zip(l_ref, l_bf))
+    ok_parity = max_dloss <= BF16_TOL
+    ok_finite = bool(onp.isfinite(l_bf).all())
+    ok_learns = l_bf[-1] < l_bf[0]
+    # the dtype policy's structural halves: bf16 compute traced into the
+    # step, master params still full-precision f32
+    ok_policy = jnp.dtype(tr.compute_dtype) == jnp.bfloat16 and \
+        all(jnp.dtype(v.dtype) == jnp.float32 for v in tr.pvals)
+    report["lenet_8x1_bf16_overlap"] = {
+        "steps": 12, "max_rel_dloss": max_dloss, "tol": BF16_TOL,
+        "replicated_f32_losses": l_ref, "bf16_zero1_overlap_losses": l_bf,
+        "parity_ok": ok_parity, "finite_ok": ok_finite,
+        "learns_ok": ok_learns, "policy_ok": ok_policy}
+    return ok_parity and ok_finite and ok_learns and ok_policy
+
+
 def compose_3d_case(report):
     """The full 3-D mesh: dp x mp x pp = 2x2x2 — tensor-sharded Dense
     layers (mp_spec_fn), ZeRO-1 sharded update on dp, GPipe stages on
@@ -356,6 +422,7 @@ def main() -> int:
     ok = bert_case(report) and ok
     ok = pp_case(report) and ok
     ok = overlap_case(report) and ok
+    ok = bf16_case(report) and ok
     ok = compose_3d_case(report) and ok
     report["ok"] = ok
     out = os.path.join(ROOT, "spmd_smoke.json")
@@ -378,6 +445,8 @@ def main() -> int:
             report["lenet_8x1_overlap"]["sgd"]["max_rel_dloss"],
         "overlap_momentum_max_rel_dloss":
             report["lenet_8x1_overlap"]["momentum"]["max_rel_dloss"],
+        "bf16_max_rel_dloss":
+            report["lenet_8x1_bf16_overlap"]["max_rel_dloss"],
         "pp3d_max_rel_dloss":
             report["mlp_2x2x2_dp_mp_pp"]["max_rel_dloss"],
         "pp3d_post_warmup_jit_compiles":

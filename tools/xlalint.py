@@ -21,10 +21,20 @@ Canonical models (``--list``):
                          (``overlap=True``): the budget declares
                          ``async_required`` for reduce-scatter /
                          all-gather, so any blocking form fails X007
+  * lenet_train_zero1_overlap_bf16 — the same overlap step under the
+                         bf16 AMP policy (``amp.trainer_kwargs()``):
+                         proves the dtype-policy transform keeps the
+                         async-collective contract — X007 stays clean
+                         with bf16 gradients (docs/precision.md)
   * resnet_infer       — ResNet-18 v1 inference executable
   * resnet_fused_bn_relu_infer — the fused BN+ReLU zoo variant
   * bert_tiny_train    — tiny-BERT pretrain train step
   * serve_mlp          — a serve Registry entry's warmed bucket grid
+  * serve_mlp_int8     — the same MLP registered with precision="int8"
+                         (PTQ calibrate->rewrite at registration): the
+                         budget declares ``require_int8_dots``, so an
+                         executable serving f32 math under the int8
+                         claim fails X008
   * serve_decode       — a DecodeEntry's decode grid (prefill / step /
                          slot write / cache growth) with the KV cache
                          donated (X004 gates the aliasing)
@@ -153,6 +163,33 @@ def build_lenet_train_zero1_overlap(budget):
             os.environ["MXNET_OVERLAP_BUCKET_BYTES"] = prev
 
 
+def build_lenet_train_zero1_overlap_bf16(budget):
+    """The overlap model under the bf16 AMP policy (docs/precision.md):
+    gradients flow bf16 through the bucketed dp reduction at half the
+    bytes, and the ``async_required`` contract (X007) must survive the
+    dtype-policy transform — a blocking reduce-scatter/all-gather
+    sneaking in with the casts fails here."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    prev = os.environ.get("MXNET_OVERLAP_BUCKET_BYTES")
+    os.environ["MXNET_OVERLAP_BUCKET_BYTES"] = str(256 << 10)
+    try:
+        mx.amp.init(target_dtype="bfloat16")
+        tr = ShardedTrainer(_lenet(), _ce(), mesh=make_mesh({"dp": 8}),
+                            optimizer="sgd", learning_rate=0.05,
+                            momentum=0.9, partition="zero1", overlap=True,
+                            **mx.amp.trainer_kwargs())
+        tr._xla_lint_budget = budget
+        tr.compile(_lenet_batch())
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_OVERLAP_BUCKET_BYTES", None)
+        else:
+            os.environ["MXNET_OVERLAP_BUCKET_BYTES"] = prev
+
+
 def _resnet_infer(budget, fused: bool):
     import mxnet_tpu as mx
 
@@ -235,6 +272,32 @@ def build_serve_mlp(budget):
                         lint_budget=budget)
 
 
+def build_serve_mlp_int8(budget):
+    """The precision ladder's serving rung as a CI gate: registering
+    with ``precision="int8"`` runs the PTQ pipeline and merges
+    ``require_int8_dots`` into the lint budget, so every dot-carrying
+    executable of the warmed grid must hold >=1 integer-accumulated dot
+    (X008, docs/precision.md)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.serve.registry import Registry
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=8))
+    net.add(nn.Dense(4, in_units=32))
+    net.initialize(mx.init.Xavier())
+    net(mx.np.zeros((1, 8)))
+    rs = onp.random.RandomState(0)
+    calib = [rs.rand(4, 8).astype("float32") for _ in range(4)]
+    Registry().register("mlp_int8", net, bucketer={0: [2, 8]},
+                        sample=onp.zeros((8,), "float32"),
+                        precision="int8", calib_data=calib,
+                        calib_mode="naive", lint_budget=budget)
+
+
 def build_serve_decode(budget):
     """The generative decode grid: every executable the decode loop can
     hit (prefill per prompt-bucket x capacity, decode step, slot write,
@@ -256,10 +319,12 @@ MODELS = {
     "lenet_train_arena": build_lenet_train_arena,
     "lenet_train_zero1": build_lenet_train_zero1,
     "lenet_train_zero1_overlap": build_lenet_train_zero1_overlap,
+    "lenet_train_zero1_overlap_bf16": build_lenet_train_zero1_overlap_bf16,
     "resnet_infer": build_resnet_infer,
     "resnet_fused_bn_relu_infer": build_resnet_fused_bn_relu_infer,
     "bert_tiny_train": build_bert_tiny_train,
     "serve_mlp": build_serve_mlp,
+    "serve_mlp_int8": build_serve_mlp_int8,
     "serve_decode": build_serve_decode,
 }
 
@@ -275,9 +340,10 @@ def load_budgets(path: str) -> dict:
 def measured_budget(captures, prev: dict = None) -> dict:
     """The baseline-update flow: observed op mix -> budget (max per
     collective op / concatenate count across the model's executables,
-    flags stay at their strict defaults).  ``async_required`` is a
-    hand-declared CONTRACT, not a measurement — ``prev`` (the model's
-    current budget) carries it through a re-baseline unchanged."""
+    flags stay at their strict defaults).  ``async_required`` and
+    ``require_int8_dots`` are hand-declared CONTRACTS, not
+    measurements — ``prev`` (the model's current budget) carries them
+    through a re-baseline unchanged."""
     coll: dict = {}
     concats = 0
     for facts, _diags in captures:
@@ -288,6 +354,8 @@ def measured_budget(captures, prev: dict = None) -> dict:
            "allow_f64": False, "allow_callbacks": False}
     if prev and prev.get("async_required"):
         out["async_required"] = list(prev["async_required"])
+    if prev and prev.get("require_int8_dots"):
+        out["require_int8_dots"] = True
     return out
 
 
